@@ -1,0 +1,51 @@
+"""Headline benchmark: ResNet-50 training throughput (tpu-cnn).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline choice: the reference publishes no numbers (BASELINE.md) —
+its benchmark harness is tf_cnn_benchmarks ResNet-50, whose
+contemporaneous published figure for the reference's era/config
+(single P100, batch 32, parameter_server) is ~219 images/sec
+(tensorflow.org/performance/benchmarks, 2018). vs_baseline is
+images/sec/chip divided by that figure, i.e. "one v5e chip vs the
+reference's one-GPU worker".
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+REFERENCE_GPU_IMAGES_PER_SEC = 219.0
+
+
+def main() -> int:
+    from kubeflow_tpu.training.benchmark import BenchConfig, run_benchmark
+
+    import jax
+
+    n = len(jax.devices())
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    config = BenchConfig(
+        model="resnet50" if on_tpu else "resnet-test",
+        batch_size=256 * n if on_tpu else 32,
+        steps=20 if on_tpu else 3,
+        warmup_steps=3 if on_tpu else 1,
+    )
+    result = run_benchmark(config)
+    per_chip = result["images_per_sec_per_chip"]
+    print(
+        json.dumps(
+            {
+                "metric": f"{result['model']}_train_images_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / REFERENCE_GPU_IMAGES_PER_SEC, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

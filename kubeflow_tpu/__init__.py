@@ -1,0 +1,23 @@
+"""kubeflow_tpu — a TPU-native ML-platform deployment framework.
+
+A ground-up rebuild of the capabilities of early Kubeflow
+(reference: chairco/kubeflow) designed TPU-first:
+
+- ``manifests``/``params``/``cli``: a typed Kubernetes manifest compiler
+  replacing the ksonnet/Jsonnet prototype layer (reference
+  ``kubeflow/*/prototypes/*.jsonnet`` + ``*.libsonnet``).
+- ``operator``: a TPUJob CRD + reconciler with gang (whole-slice)
+  scheduling, replacing the parameter-server tf-operator
+  (reference ``kubeflow/core/tf-job.libsonnet``).
+- ``models``/``ops``/``parallel``/``training``: the JAX/XLA training engine
+  (pjit/shard_map over a device mesh, pallas kernels) replacing
+  TensorFlow + tf_cnn_benchmarks.
+- ``serving``: a versioned-model TPU predictor + REST proxy replacing
+  tensorflow_model_server + the Tornado http-proxy
+  (reference ``kubeflow/tf-serving``, ``components/k8s-model-server``).
+- ``hub``: notebook-spawner configuration defaulting to jax[tpu] kernels
+  (reference ``kubeflow/core/jupyterhub*``).
+- ``testing``: junit/golden/e2e harness (reference ``testing/``).
+"""
+
+__version__ = "0.1.0"

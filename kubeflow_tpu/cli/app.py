@@ -1,0 +1,240 @@
+"""kft CLI implementation.
+
+Workflow parity with the reference's documented ks flow
+(``README.md:69-93``): an *app directory* holds per-component params
+and per-environment overlays; ``generate`` instantiates a prototype
+into the app, ``param set`` edits overlays, ``show`` renders YAML,
+``apply``/``delete`` talk to the cluster (via kubectl when present;
+``--dry-run`` otherwise). Unlike ksonnet there is no vendored jsonnet —
+prototypes are code in ``kubeflow_tpu.manifests``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from kubeflow_tpu.params.registry import get_prototype, list_prototypes
+
+APP_FILE = "kft.json"
+
+
+def _load_app(app_dir: Path) -> Dict[str, Any]:
+    path = app_dir / APP_FILE
+    if not path.exists():
+        raise SystemExit(
+            f"error: {path} not found — run `kft init {app_dir}` first"
+        )
+    return json.loads(path.read_text())
+
+
+def _save_app(app_dir: Path, app: Dict[str, Any]) -> None:
+    (app_dir / APP_FILE).write_text(json.dumps(app, indent=2, sort_keys=True) + "\n")
+
+
+def _parse_kv(pairs: List[str]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise SystemExit(f"error: expected key=value, got {pair!r}")
+        k, _, v = pair.partition("=")
+        out[k] = v
+    return out
+
+
+def _component_objects(app: Dict[str, Any], name: str,
+                       env: Optional[str]) -> List[dict]:
+    try:
+        comp = app["components"][name]
+    except KeyError:
+        raise SystemExit(
+            f"error: component {name!r} not generated; "
+            f"have {sorted(app.get('components', {}))}"
+        )
+    proto = get_prototype(comp["prototype"])
+    overrides = dict(comp.get("params", {}))
+    if env:
+        overrides.update(app.get("environments", {}).get(env, {})
+                         .get("components", {}).get(name, {}))
+    return proto.build(overrides)
+
+
+def cmd_init(args: argparse.Namespace) -> int:
+    app_dir = Path(args.dir)
+    app_dir.mkdir(parents=True, exist_ok=True)
+    if (app_dir / APP_FILE).exists() and not args.force:
+        raise SystemExit(f"error: {app_dir / APP_FILE} already exists")
+    _save_app(app_dir, {"apiVersion": "kft/v1", "components": {},
+                        "environments": {"default": {"components": {}}}})
+    print(f"initialized kft app at {app_dir}")
+    return 0
+
+
+def cmd_prototype_list(args: argparse.Namespace) -> int:
+    for proto in list_prototypes():
+        print(f"{proto.package}/{proto.name:32s} {proto.description}")
+    return 0
+
+
+def cmd_prototype_describe(args: argparse.Namespace) -> int:
+    proto = get_prototype(args.prototype)
+    print(f"{proto.name} ({proto.package}): {proto.description}")
+    for p in proto.params:
+        req = "required" if p.required else f"default={p.default!r}"
+        print(f"  --{p.name:24s} [{p.kind}] {req}  {p.doc}")
+    return 0
+
+
+def cmd_generate(args: argparse.Namespace) -> int:
+    app_dir = Path(args.app_dir)
+    app = _load_app(app_dir)
+    proto = get_prototype(args.prototype)
+    name = args.name or proto.name
+    params = _parse_kv(args.param or [])
+    # Validate early: unknown params AND bad coercions fail at generate
+    # time (missing required params stay lazy until show/apply, like ks).
+    specs = proto.param_set().overlay(params).specs
+    for key, value in params.items():
+        specs[key].coerce(value)
+    app.setdefault("components", {})[name] = {
+        "prototype": proto.name,
+        "params": params,
+    }
+    _save_app(app_dir, app)
+    print(f"generated component {name!r} from prototype {proto.name!r}")
+    return 0
+
+
+def cmd_param_set(args: argparse.Namespace) -> int:
+    app_dir = Path(args.app_dir)
+    app = _load_app(app_dir)
+    comp = app.get("components", {}).get(args.component)
+    if comp is None:
+        raise SystemExit(f"error: unknown component {args.component!r}")
+    if args.env:
+        target = (
+            app.setdefault("environments", {})
+            .setdefault(args.env, {})
+            .setdefault("components", {})
+            .setdefault(args.component, {})
+        )
+    else:
+        target = comp.setdefault("params", {})
+    target[args.name] = args.value
+    # Validate the merged overlay still resolves/coerces.
+    _component_objects(app, args.component, args.env)
+    _save_app(app_dir, app)
+    print(f"set {args.component}.{args.name}={args.value}"
+          + (f" (env {args.env})" if args.env else ""))
+    return 0
+
+
+def cmd_show(args: argparse.Namespace) -> int:
+    app = _load_app(Path(args.app_dir))
+    names = args.component or sorted(app.get("components", {}))
+    docs: List[dict] = []
+    for name in names:
+        docs.extend(_component_objects(app, name, args.env))
+    sys.stdout.write(yaml.safe_dump_all(docs, sort_keys=False))
+    return 0
+
+
+def _kubectl(objects: List[dict], verb: str, dry_run: bool) -> int:
+    manifest = yaml.safe_dump_all(objects, sort_keys=False)
+    if dry_run or shutil.which("kubectl") is None:
+        if not dry_run:
+            print("kubectl not found; printing manifests (use --dry-run to "
+                  "silence this note)", file=sys.stderr)
+        sys.stdout.write(manifest)
+        return 0
+    cmd = ["kubectl", verb, "-f", "-"]
+    if verb == "apply":
+        cmd.insert(2, "--server-side")
+    proc = subprocess.run(cmd, input=manifest.encode())
+    return proc.returncode
+
+
+def cmd_apply(args: argparse.Namespace) -> int:
+    app = _load_app(Path(args.app_dir))
+    names = args.component or sorted(app.get("components", {}))
+    objs: List[dict] = []
+    for name in names:
+        objs.extend(_component_objects(app, name, args.env))
+    return _kubectl(objs, "apply", args.dry_run)
+
+
+def cmd_delete(args: argparse.Namespace) -> int:
+    app = _load_app(Path(args.app_dir))
+    names = args.component or sorted(app.get("components", {}))
+    objs: List[dict] = []
+    for name in names:
+        objs.extend(_component_objects(app, name, args.env))
+    return _kubectl(objs, "delete", args.dry_run)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kft", description="TPU-native Kubeflow platform CLI"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("init", help="initialize an app directory")
+    p.add_argument("dir")
+    p.add_argument("--force", action="store_true")
+    p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("prototype", help="list or describe prototypes")
+    psub = p.add_subparsers(dest="subcommand", required=True)
+    pl = psub.add_parser("list")
+    pl.set_defaults(fn=cmd_prototype_list)
+    pd = psub.add_parser("describe")
+    pd.add_argument("prototype")
+    pd.set_defaults(fn=cmd_prototype_describe)
+
+    p = sub.add_parser("generate", help="instantiate a prototype as a component")
+    p.add_argument("prototype")
+    p.add_argument("name", nargs="?")
+    p.add_argument("--app-dir", default=".")
+    p.add_argument("--param", action="append", metavar="KEY=VALUE")
+    p.set_defaults(fn=cmd_generate)
+
+    p = sub.add_parser("param", help="set component params")
+    psub = p.add_subparsers(dest="subcommand", required=True)
+    ps = psub.add_parser("set")
+    ps.add_argument("component")
+    ps.add_argument("name")
+    ps.add_argument("value")
+    ps.add_argument("--app-dir", default=".")
+    ps.add_argument("--env")
+    ps.set_defaults(fn=cmd_param_set)
+
+    for verb, fn in (("show", cmd_show), ("apply", cmd_apply),
+                     ("delete", cmd_delete)):
+        p = sub.add_parser(verb)
+        p.add_argument("component", nargs="*")
+        p.add_argument("--app-dir", default=".")
+        p.add_argument("--env")
+        if verb != "show":
+            p.add_argument("--dry-run", action="store_true")
+        p.set_defaults(fn=fn)
+
+    return parser
+
+
+def run(argv: List[str]) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except (KeyError, ValueError) as e:
+        # Param/prototype errors are user errors, not crashes: print
+        # the message (KeyError reprs its arg, so unwrap) and exit 1.
+        msg = e.args[0] if e.args else str(e)
+        print(f"error: {msg}", file=sys.stderr)
+        return 1

@@ -1,0 +1,21 @@
+"""``kft`` — the platform CLI (replacement for the ksonnet ``ks`` workflow).
+
+Subcommands mirror the reference's documented user workflow
+(``README.md:69-93``, ``user_guide.md:19-77``): init / prototype list /
+generate / param set / show / apply / delete. Implemented in
+``kubeflow_tpu.cli.app``; this module is the console-script entrypoint.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    from kubeflow_tpu.cli.app import run
+
+    return run(sys.argv[1:] if argv is None else argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

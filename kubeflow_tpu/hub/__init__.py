@@ -1,0 +1,3 @@
+"""Notebook-hub assets: the KubeSpawner config deployed into the hub
+image (see kubeflow_tpu.manifests.jupyterhub) and image build files
+under images/notebook/."""

@@ -1,0 +1,34 @@
+"""Manifest components — each module registers its prototypes on import.
+
+This package is the typed replacement for the reference's
+``kubeflow/{core,tf-job,tf-serving,argo,seldon}`` jsonnet packages.
+"""
+
+# Side-effect imports: each module registers prototypes with
+# kubeflow_tpu.params.registry at import time.
+from kubeflow_tpu.manifests import k8s  # noqa: F401
+
+_COMPONENT_MODULES = [
+    "kubeflow_tpu.manifests.core",
+    "kubeflow_tpu.manifests.tpujob",
+    "kubeflow_tpu.manifests.jupyterhub",
+    "kubeflow_tpu.manifests.ambassador",
+    "kubeflow_tpu.manifests.iap",
+    "kubeflow_tpu.manifests.cert_manager",
+    "kubeflow_tpu.manifests.nfs",
+    "kubeflow_tpu.manifests.spartakus",
+    "kubeflow_tpu.manifests.argo",
+    "kubeflow_tpu.manifests.serving",
+    "kubeflow_tpu.manifests.seldon",
+]
+
+import importlib as _importlib
+
+for _mod in _COMPONENT_MODULES:
+    try:
+        _importlib.import_module(_mod)
+    except ModuleNotFoundError as _e:
+        # Allow partial builds during bootstrap; only swallow missing
+        # component modules themselves, not their broken imports.
+        if _e.name != _mod:
+            raise

@@ -1,0 +1,100 @@
+"""Ambassador API gateway (annotation-driven routing).
+
+Replaces reference ``kubeflow/core/ambassador.libsonnet``: Service
+``:14-37``, admin Service ``:39-62``, RBAC ``:64-145``, 3-replica
+Deployment + statsd sidecar ``:147-219``, k8s-dashboard route
+``:222-259``. No TPU delta — the gateway pattern carries over; other
+services self-register routes via the ``getambassador.io/config``
+annotation (see k8s.ambassador_mapping).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.manifests import k8s
+from kubeflow_tpu.params import Param, register
+
+AMBASSADOR_IMAGE = "quay.io/datawire/ambassador:0.30.1"
+STATSD_IMAGE = "quay.io/datawire/statsd:0.30.1"
+
+
+def services(namespace: str, service_type: str) -> List[Dict[str, Any]]:
+    labels = {"service": "ambassador"}
+    return [
+        k8s.service("ambassador", namespace, labels,
+                    [k8s.service_port(80, target_port=80, name="ambassador")],
+                    service_type=service_type, labels=labels),
+        k8s.service("ambassador-admin", namespace, labels,
+                    [k8s.service_port(8877, target_port=8877,
+                                      name="ambassador-admin")],
+                    labels={"service": "ambassador-admin"}),
+    ]
+
+
+def rbac(namespace: str) -> List[Dict[str, Any]]:
+    return [
+        k8s.service_account("ambassador", namespace),
+        k8s.cluster_role("ambassador", [
+            k8s.policy_rule([""], ["services", "endpoints", "namespaces",
+                                   "secrets"], ["get", "list", "watch"]),
+        ]),
+        k8s.cluster_role_binding(
+            "ambassador", "ambassador",
+            [k8s.subject("ServiceAccount", "ambassador", namespace)],
+        ),
+    ]
+
+
+def deployment(namespace: str, replicas: int = 3) -> Dict[str, Any]:
+    ambassador = k8s.container(
+        "ambassador", AMBASSADOR_IMAGE,
+        env=[
+            k8s.env_var("AMBASSADOR_NAMESPACE", field_path="metadata.namespace"),
+            k8s.env_var("AMBASSADOR_SINGLE_NAMESPACE", "true"),
+        ],
+        ports=[k8s.port(80), k8s.port(8877, "admin")],
+        resources=k8s.resources(cpu_request="200m", memory_request="100Mi",
+                                cpu_limit="1", memory_limit="400Mi"),
+        liveness_probe=k8s.http_get_probe("/ambassador/v0/check_alive", 8877),
+        readiness_probe=k8s.http_get_probe("/ambassador/v0/check_ready", 8877),
+    )
+    statsd = k8s.container("statsd", STATSD_IMAGE, ports=[k8s.port(8125, "metrics")])
+    return k8s.deployment(
+        "ambassador", namespace,
+        k8s.pod_spec([ambassador, statsd], service_account="ambassador"),
+        replicas=replicas, labels={"service": "ambassador"},
+    )
+
+
+def k8s_dashboard_route(namespace: str) -> Dict[str, Any]:
+    """Route to the cluster's kubernetes-dashboard (parity :222-259)."""
+    return k8s.service(
+        "k8s-dashboard", namespace, {"k8s-app": "kubernetes-dashboard"},
+        [k8s.service_port(443, target_port=8443)],
+        annotations={
+            "getambassador.io/config": k8s.ambassador_mapping(
+                "k8s-dashboard-ui-mapping", "/k8s/ui/",
+                "kubernetes-dashboard.kube-system", rewrite="/",
+                # tls: the upstream dashboard serves https
+            ) + "\ntls: true"
+        },
+    )
+
+
+def all_objects(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    ns = p["namespace"]
+    return [
+        *services(ns, p["ambassador_service_type"]),
+        *rbac(ns),
+        deployment(ns, p["replicas"]),
+        k8s_dashboard_route(ns),
+    ]
+
+
+register("ambassador", "Ambassador API gateway", [
+    Param("namespace", "default", "string"),
+    Param("ambassador_service_type", "ClusterIP", "string",
+          "The service type for the API Gateway."),
+    Param("replicas", 3, "int"),
+], package="core")(all_objects)

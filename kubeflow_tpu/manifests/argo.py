@@ -1,0 +1,93 @@
+"""Argo Workflows install (CI workflow engine).
+
+Replaces reference ``kubeflow/argo/argo.libsonnet``: Workflow CRD
+``:25-45``, workflow-controller Deployment + executor ConfigMap
+``:48-120,225-235``, argo-ui ``:123-223``, RBAC ``:237-427``. No TPU
+delta; versions modernized.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.manifests import k8s
+from kubeflow_tpu.params import Param, register
+
+CONTROLLER_IMAGE = "quay.io/argoproj/workflow-controller:v3.4.4"
+EXECUTOR_IMAGE = "quay.io/argoproj/argoexec:v3.4.4"
+UI_IMAGE = "quay.io/argoproj/argocli:v3.4.4"
+
+
+def crd() -> Dict[str, Any]:
+    return k8s.crd("workflows.argoproj.io", "argoproj.io", "v1alpha1",
+                   "Workflow", "workflows", short_names=["wf"])
+
+
+def controller(namespace: str) -> List[Dict[str, Any]]:
+    cm = k8s.config_map(
+        "workflow-controller-configmap", namespace,
+        {"config": f"executorImage: {EXECUTOR_IMAGE}\n"})
+    container = k8s.container(
+        "workflow-controller", CONTROLLER_IMAGE,
+        command=["workflow-controller"],
+        args=["--configmap", "workflow-controller-configmap",
+              "--executor-image", EXECUTOR_IMAGE],
+    )
+    deploy = k8s.deployment(
+        "workflow-controller", namespace,
+        k8s.pod_spec([container], service_account="argo"),
+        labels={"app": "workflow-controller"})
+    return [cm, deploy]
+
+
+def ui(namespace: str, service_type: str) -> List[Dict[str, Any]]:
+    labels = {"app": "argo-ui"}
+    container = k8s.container(
+        "argo-ui", UI_IMAGE,
+        args=["server", "--namespaced"],
+        ports=[k8s.port(2746)],
+        env=[k8s.env_var("ARGO_NAMESPACE", field_path="metadata.namespace")],
+    )
+    return [
+        k8s.deployment("argo-ui", namespace,
+                       k8s.pod_spec([container], service_account="argo-ui"),
+                       labels=labels),
+        k8s.service("argo-ui", namespace, labels,
+                    [k8s.service_port(80, target_port=2746)],
+                    service_type=service_type, labels=labels),
+    ]
+
+
+def rbac(namespace: str) -> List[Dict[str, Any]]:
+    wf_rules = [
+        k8s.policy_rule([""], ["pods", "pods/exec", "pods/log"], ["*"]),
+        k8s.policy_rule([""], ["secrets", "configmaps"], ["get", "list", "watch"]),
+        k8s.policy_rule([""], ["persistentvolumeclaims"], ["create", "delete"]),
+        k8s.policy_rule(["argoproj.io"], ["workflows", "workflows/finalizers"],
+                        ["*"]),
+    ]
+    return [
+        k8s.service_account("argo", namespace),
+        k8s.cluster_role("argo", wf_rules),
+        k8s.cluster_role_binding(
+            "argo", "argo", [k8s.subject("ServiceAccount", "argo", namespace)]),
+        k8s.service_account("argo-ui", namespace),
+        k8s.cluster_role("argo-ui", [
+            k8s.policy_rule([""], ["pods", "pods/log"], ["get", "list", "watch"]),
+            k8s.policy_rule(["argoproj.io"], ["workflows"], ["get", "list", "watch"]),
+        ]),
+        k8s.cluster_role_binding(
+            "argo-ui", "argo-ui",
+            [k8s.subject("ServiceAccount", "argo-ui", namespace)]),
+    ]
+
+
+def all_objects(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    ns = p["namespace"]
+    return [crd(), *controller(ns), *ui(ns, p["ui_service_type"]), *rbac(ns)]
+
+
+register("argo", "Argo workflow engine (CI plane)", [
+    Param("namespace", "default", "string"),
+    Param("ui_service_type", "NodePort", "string"),
+], package="argo")(all_objects)

@@ -1,0 +1,91 @@
+"""cert-manager + Let's Encrypt ACME issuer.
+
+Replaces reference ``kubeflow/core/cert-manager.libsonnet``: CRDs
+``:19-69``, RBAC ``:71-123``, controller Deployment ``:125-160``,
+ACME prod Issuer ``:162-180``. No TPU delta; pinned to a
+v1-API-era cert-manager rather than the reference's v0.2.3.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.manifests import k8s
+from kubeflow_tpu.params import Param, REQUIRED, register
+
+CONTROLLER_IMAGE = "quay.io/jetstack/cert-manager-controller:v1.5.3"
+
+
+def crds() -> List[Dict[str, Any]]:
+    group = "cert-manager.io"
+    return [
+        k8s.crd(f"{plural}.{group}", group, "v1", kind, plural)
+        for kind, plural in (
+            ("Certificate", "certificates"),
+            ("Issuer", "issuers"),
+            ("ClusterIssuer", "clusterissuers"),
+        )
+    ]
+
+
+def rbac(namespace: str) -> List[Dict[str, Any]]:
+    return [
+        k8s.service_account("cert-manager", namespace),
+        k8s.cluster_role("cert-manager", [
+            k8s.policy_rule(["cert-manager.io"], ["*"], ["*"]),
+            k8s.policy_rule([""], ["secrets", "events", "services", "pods"],
+                            ["*"]),
+            k8s.policy_rule(["networking.k8s.io"], ["ingresses"], ["*"]),
+        ]),
+        k8s.cluster_role_binding(
+            "cert-manager", "cert-manager",
+            [k8s.subject("ServiceAccount", "cert-manager", namespace)]),
+    ]
+
+
+def deployment(namespace: str) -> Dict[str, Any]:
+    container = k8s.container(
+        "cert-manager", CONTROLLER_IMAGE,
+        args=["--cluster-resource-namespace=$(POD_NAMESPACE)",
+              "--leader-election-namespace=$(POD_NAMESPACE)"],
+        env=[k8s.env_var("POD_NAMESPACE", field_path="metadata.namespace")],
+    )
+    return k8s.deployment(
+        "cert-manager", namespace,
+        k8s.pod_spec([container], service_account="cert-manager"),
+        labels={"app": "cert-manager"})
+
+
+def issuer(namespace: str, acme_email: str, acme_url: str) -> Dict[str, Any]:
+    return {
+        "apiVersion": "cert-manager.io/v1",
+        "kind": "Issuer",
+        "metadata": k8s.metadata("letsencrypt-prod", namespace),
+        "spec": {
+            "acme": {
+                "server": acme_url,
+                "email": acme_email,
+                "privateKeySecretRef": {"name": "letsencrypt-prod-secret"},
+                "solvers": [{"http01": {"ingress": {}}}],
+            }
+        },
+    }
+
+
+def all_objects(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    ns = p["namespace"]
+    return [
+        *crds(),
+        *rbac(ns),
+        deployment(ns),
+        issuer(ns, p["acme_email"], p["acme_url"]),
+    ]
+
+
+register("cert-manager", "cert-manager with Let's Encrypt ACME issuer", [
+    Param("namespace", "default", "string"),
+    Param("acme_email", REQUIRED, "string",
+          "The Lets Encrypt account email address."),
+    Param("acme_url", "https://acme-v02.api.letsencrypt.org/directory",
+          "string", "The ACME server URL."),
+], package="core")(all_objects)

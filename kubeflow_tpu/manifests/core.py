@@ -1,0 +1,64 @@
+"""kubeflow-core aggregator prototype.
+
+Replaces reference ``kubeflow/core/all.libsonnet:1-15`` +
+``kubeflow/core/prototypes/all.jsonnet``: one component deploying
+JupyterHub + TPUJob operator + Ambassador + NFS + telemetry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.manifests import ambassador, jupyterhub, nfs, spartakus, tpujob
+from kubeflow_tpu.params import Param, register
+
+
+def all_objects(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return (
+        jupyterhub.all_objects({
+            "namespace": p["namespace"],
+            "jupyter_hub_image": p["jupyter_hub_image"],
+            "notebook_image": p["notebook_image"],
+            "jupyter_hub_authenticator": p["jupyter_hub_authenticator"],
+            "jupyter_hub_service_type": p["jupyter_hub_service_type"],
+        })
+        + tpujob.all_objects({
+            "namespace": p["namespace"],
+            "tpujob_image": p["tpujob_image"],
+            "tpujob_ui_image": p["tpujob_ui_image"],
+            "tpujob_ui_service_type": p["tpujob_ui_service_type"],
+            "cloud": p["cloud"],
+        })
+        + ambassador.all_objects({
+            "namespace": p["namespace"],
+            "ambassador_service_type": p["ambassador_service_type"],
+            "replicas": 3,
+        })
+        + nfs.all_objects({
+            "namespace": p["namespace"],
+            "disks": p["disks"],
+        })
+        + spartakus.all_objects({
+            "namespace": p["namespace"],
+            "report_usage": p["report_usage"],
+            "usage_id": p["usage_id"],
+        })
+    )
+
+
+CORE_PARAMS = (
+    [Param("namespace", "default", "string",
+           "Namespace to use for the components.")]
+    + [p for p in jupyterhub.HUB_PARAMS if p.name != "namespace"]
+    + [p for p in tpujob.OPERATOR_PARAMS if p.name != "namespace"]
+    + [
+        Param("ambassador_service_type", "ClusterIP", "string"),
+        Param("disks", "", "array"),
+        Param("report_usage", "false", "bool"),
+        Param("usage_id", "unknown_cluster", "string"),
+    ]
+)
+
+register("kubeflow-core",
+         "JupyterHub + TPUJob operator + API gateway + storage + telemetry",
+         CORE_PARAMS, package="core")(all_objects)

@@ -1,0 +1,539 @@
+"""Typed Kubernetes object builders.
+
+The reference generated raw K8s objects from Jsonnet (every
+``*.libsonnet`` under ``kubeflow/``). Here the same objects are built by
+small typed constructors returning plain dicts — plain dicts because the
+output boundary is the apiserver's JSON, and golden tests diff them
+directly. Keyword-only arguments + explicit apiVersion/kind per builder
+replace Jsonnet's untyped object literals.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+Obj = Dict[str, Any]
+
+
+def _prune(obj: Any) -> Any:
+    """Drop None values recursively.
+
+    Plays the role of the reference's ``std.prune`` over the final
+    object list (``kubeflow/core/prototypes/all.jsonnet:22``), but only
+    removes ``None`` — legitimately-empty objects like a volume's
+    ``emptyDir: {}`` or a ConfigMap's ``data: {}`` must survive, so
+    builders signal "absent" with None, never with an empty container.
+    """
+    if isinstance(obj, dict):
+        return {k: _prune(v) for k, v in obj.items() if v is not None}
+    if isinstance(obj, (list, tuple)):
+        return [_prune(v) for v in obj if v is not None]
+    return obj
+
+
+def prune(objects: Sequence[Obj]) -> List[Obj]:
+    return [_prune(o) for o in objects if o]
+
+
+def metadata(
+    name: str,
+    namespace: Optional[str] = None,
+    labels: Optional[Dict[str, str]] = None,
+    annotations: Optional[Dict[str, str]] = None,
+) -> Obj:
+    return _prune(
+        {
+            "name": name,
+            "namespace": namespace,
+            "labels": labels,
+            "annotations": annotations,
+        }
+    )
+
+
+def env_var(name: str, value: Any = None, *, field_path: Optional[str] = None,
+            secret: Optional[str] = None, secret_key: Optional[str] = None) -> Obj:
+    if field_path is not None:
+        return {"name": name, "valueFrom": {"fieldRef": {"fieldPath": field_path}}}
+    if secret is not None:
+        return {
+            "name": name,
+            "valueFrom": {"secretKeyRef": {"name": secret, "key": secret_key or name}},
+        }
+    if value is None:
+        raise ValueError(
+            f"env var {name!r} needs a value, field_path, or secret "
+            "(pass value='' explicitly for an empty string)"
+        )
+    return {"name": name, "value": str(value)}
+
+
+def container(
+    name: str,
+    image: str,
+    *,
+    command: Optional[Sequence[str]] = None,
+    args: Optional[Sequence[str]] = None,
+    env: Optional[Sequence[Obj]] = None,
+    ports: Optional[Sequence[Obj]] = None,
+    resources: Optional[Obj] = None,
+    volume_mounts: Optional[Sequence[Obj]] = None,
+    working_dir: Optional[str] = None,
+    security_context: Optional[Obj] = None,
+    liveness_probe: Optional[Obj] = None,
+    readiness_probe: Optional[Obj] = None,
+    image_pull_policy: Optional[str] = None,
+) -> Obj:
+    return _prune(
+        {
+            "name": name,
+            "image": image,
+            "command": list(command) if command else None,
+            "args": list(args) if args else None,
+            "env": list(env) if env else None,
+            "ports": list(ports) if ports else None,
+            "resources": resources,
+            "volumeMounts": list(volume_mounts) if volume_mounts else None,
+            "workingDir": working_dir,
+            "securityContext": security_context,
+            "livenessProbe": liveness_probe,
+            "readinessProbe": readiness_probe,
+            "imagePullPolicy": image_pull_policy,
+        }
+    )
+
+
+def port(container_port: int, name: Optional[str] = None) -> Obj:
+    return _prune({"containerPort": container_port, "name": name})
+
+
+def resources(
+    *,
+    cpu_request: Optional[str] = None,
+    memory_request: Optional[str] = None,
+    cpu_limit: Optional[str] = None,
+    memory_limit: Optional[str] = None,
+    extra_limits: Optional[Dict[str, Any]] = None,
+    extra_requests: Optional[Dict[str, Any]] = None,
+) -> Obj:
+    req: Obj = {}
+    lim: Obj = {}
+    if cpu_request:
+        req["cpu"] = cpu_request
+    if memory_request:
+        req["memory"] = memory_request
+    if cpu_limit:
+        lim["cpu"] = cpu_limit
+    if memory_limit:
+        lim["memory"] = memory_limit
+    if extra_requests:
+        req.update({k: str(v) for k, v in extra_requests.items()})
+    if extra_limits:
+        lim.update({k: str(v) for k, v in extra_limits.items()})
+    return _prune({"requests": req or None, "limits": lim or None})
+
+
+def pod_spec(
+    containers: Sequence[Obj],
+    *,
+    volumes: Optional[Sequence[Obj]] = None,
+    service_account: Optional[str] = None,
+    restart_policy: Optional[str] = None,
+    node_selector: Optional[Dict[str, str]] = None,
+    init_containers: Optional[Sequence[Obj]] = None,
+    host_network: Optional[bool] = None,
+    dns_policy: Optional[str] = None,
+    scheduler_name: Optional[str] = None,
+    tolerations: Optional[Sequence[Obj]] = None,
+    subdomain: Optional[str] = None,
+    hostname: Optional[str] = None,
+) -> Obj:
+    return _prune(
+        {
+            "containers": list(containers),
+            "volumes": list(volumes) if volumes else None,
+            "serviceAccountName": service_account,
+            "restartPolicy": restart_policy,
+            "nodeSelector": node_selector,
+            "initContainers": list(init_containers) if init_containers else None,
+            "hostNetwork": host_network,
+            "dnsPolicy": dns_policy,
+            "schedulerName": scheduler_name,
+            "tolerations": list(tolerations) if tolerations else None,
+            "subdomain": subdomain,
+            "hostname": hostname,
+        }
+    )
+
+
+def deployment(
+    name: str,
+    namespace: str,
+    spec: Obj,
+    *,
+    replicas: int = 1,
+    labels: Optional[Dict[str, str]] = None,
+    pod_labels: Optional[Dict[str, str]] = None,
+    pod_annotations: Optional[Dict[str, str]] = None,
+) -> Obj:
+    pod_labels = pod_labels or labels or {"app": name}
+    return _prune(
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": metadata(name, namespace, labels=labels or {"app": name}),
+            "spec": {
+                "replicas": replicas,
+                "selector": {"matchLabels": pod_labels},
+                "template": {
+                    "metadata": _prune(
+                        {"labels": pod_labels, "annotations": pod_annotations}
+                    ),
+                    "spec": spec,
+                },
+            },
+        }
+    )
+
+
+def stateful_set(
+    name: str,
+    namespace: str,
+    spec: Obj,
+    *,
+    service_name: str,
+    replicas: int = 1,
+    labels: Optional[Dict[str, str]] = None,
+) -> Obj:
+    labels = labels or {"app": name}
+    return _prune(
+        {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": metadata(name, namespace, labels=labels),
+            "spec": {
+                "serviceName": service_name,
+                "replicas": replicas,
+                "selector": {"matchLabels": labels},
+                "template": {"metadata": {"labels": labels}, "spec": spec},
+            },
+        }
+    )
+
+
+def service(
+    name: str,
+    namespace: str,
+    selector: Dict[str, str],
+    ports: Sequence[Obj],
+    *,
+    service_type: Optional[str] = None,
+    labels: Optional[Dict[str, str]] = None,
+    annotations: Optional[Dict[str, str]] = None,
+    cluster_ip: Optional[str] = None,
+) -> Obj:
+    return _prune(
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": metadata(
+                name, namespace, labels=labels or {"app": name},
+                annotations=annotations,
+            ),
+            "spec": {
+                "selector": selector,
+                "ports": list(ports),
+                "type": service_type,
+                "clusterIP": cluster_ip,
+            },
+        }
+    )
+
+
+def service_port(port_: int, *, target_port: Optional[Any] = None,
+                 name: Optional[str] = None, node_port: Optional[int] = None,
+                 protocol: Optional[str] = None) -> Obj:
+    return _prune(
+        {
+            "port": port_,
+            "targetPort": target_port,
+            "name": name,
+            "nodePort": node_port,
+            "protocol": protocol,
+        }
+    )
+
+
+def config_map(name: str, namespace: str, data: Dict[str, str],
+               labels: Optional[Dict[str, str]] = None) -> Obj:
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": metadata(name, namespace, labels=labels),
+        "data": data,
+    }
+
+
+def secret(name: str, namespace: str, string_data: Dict[str, str],
+           secret_type: str = "Opaque") -> Obj:
+    return {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": metadata(name, namespace),
+        "type": secret_type,
+        "stringData": string_data,
+    }
+
+
+def namespace_obj(name: str) -> Obj:
+    return {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": name}}
+
+
+def service_account(name: str, namespace: str,
+                    labels: Optional[Dict[str, str]] = None) -> Obj:
+    return {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": metadata(name, namespace, labels=labels),
+    }
+
+
+def policy_rule(api_groups: Sequence[str], resources_: Sequence[str],
+                verbs: Sequence[str]) -> Obj:
+    return {
+        "apiGroups": list(api_groups),
+        "resources": list(resources_),
+        "verbs": list(verbs),
+    }
+
+
+def cluster_role(name: str, rules: Sequence[Obj],
+                 labels: Optional[Dict[str, str]] = None) -> Obj:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": metadata(name, labels=labels),
+        "rules": list(rules),
+    }
+
+
+def cluster_role_binding(name: str, role_name: str, subjects: Sequence[Obj],
+                         labels: Optional[Dict[str, str]] = None) -> Obj:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": metadata(name, labels=labels),
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "ClusterRole",
+            "name": role_name,
+        },
+        "subjects": list(subjects),
+    }
+
+
+def role(name: str, namespace: str, rules: Sequence[Obj],
+         labels: Optional[Dict[str, str]] = None) -> Obj:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "Role",
+        "metadata": metadata(name, namespace, labels=labels),
+        "rules": list(rules),
+    }
+
+
+def role_binding(name: str, namespace: str, role_name: str,
+                 subjects: Sequence[Obj],
+                 labels: Optional[Dict[str, str]] = None) -> Obj:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "RoleBinding",
+        "metadata": metadata(name, namespace, labels=labels),
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "Role",
+            "name": role_name,
+        },
+        "subjects": list(subjects),
+    }
+
+
+def subject(kind: str, name: str, namespace: Optional[str] = None) -> Obj:
+    return _prune({"kind": kind, "name": name, "namespace": namespace})
+
+
+def crd(
+    name: str,
+    group: str,
+    version: str,
+    kind: str,
+    plural: str,
+    *,
+    scope: str = "Namespaced",
+    singular: Optional[str] = None,
+    short_names: Optional[Sequence[str]] = None,
+    schema: Optional[Obj] = None,
+) -> Obj:
+    """CustomResourceDefinition (apiextensions v1, vs the reference's
+    v1beta1 at ``kubeflow/core/tf-job.libsonnet:14-29``)."""
+    version_obj: Obj = {
+        "name": version,
+        "served": True,
+        "storage": True,
+        "schema": {
+            "openAPIV3Schema": schema
+            or {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+        },
+    }
+    return _prune(
+        {
+            "apiVersion": "apiextensions.k8s.io/v1",
+            "kind": "CustomResourceDefinition",
+            "metadata": {"name": name},
+            "spec": {
+                "group": group,
+                "scope": scope,
+                "names": _prune(
+                    {
+                        "kind": kind,
+                        "plural": plural,
+                        "singular": singular or kind.lower(),
+                        "shortNames": list(short_names) if short_names else None,
+                    }
+                ),
+                "versions": [version_obj],
+            },
+        }
+    )
+
+
+def pvc(name: str, namespace: str, storage: str,
+        *, access_modes: Sequence[str] = ("ReadWriteOnce",),
+        storage_class: Optional[str] = None) -> Obj:
+    return _prune(
+        {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": metadata(name, namespace),
+            "spec": {
+                "accessModes": list(access_modes),
+                "storageClassName": storage_class,
+                "resources": {"requests": {"storage": storage}},
+            },
+        }
+    )
+
+
+def storage_class(name: str, provisioner: str) -> Obj:
+    return {
+        "apiVersion": "storage.k8s.io/v1",
+        "kind": "StorageClass",
+        "metadata": {"name": name},
+        "provisioner": provisioner,
+    }
+
+
+def ingress(name: str, namespace: str, *, backend_service: str,
+            backend_port: int, annotations: Optional[Dict[str, str]] = None,
+            tls_secret: Optional[str] = None, host: Optional[str] = None) -> Obj:
+    rule: Obj = {
+        "http": {
+            "paths": [
+                {
+                    "path": "/*",
+                    "pathType": "ImplementationSpecific",
+                    "backend": {
+                        "service": {
+                            "name": backend_service,
+                            "port": {"number": backend_port},
+                        }
+                    },
+                }
+            ]
+        }
+    }
+    if host:
+        rule["host"] = host
+    return _prune(
+        {
+            "apiVersion": "networking.k8s.io/v1",
+            "kind": "Ingress",
+            "metadata": metadata(name, namespace, annotations=annotations),
+            "spec": {
+                "rules": [rule],
+                "tls": [{"secretName": tls_secret, "hosts": [host] if host else None}]
+                if tls_secret
+                else None,
+            },
+        }
+    )
+
+
+def http_get_probe(path: str, port_: Any, *, initial_delay: int = 30,
+                   period: int = 30, timeout: Optional[int] = None) -> Obj:
+    return _prune(
+        {
+            "httpGet": {"path": path, "port": port_},
+            "initialDelaySeconds": initial_delay,
+            "periodSeconds": period,
+            "timeoutSeconds": timeout,
+        }
+    )
+
+
+def volume(name: str, *, config_map_name: Optional[str] = None,
+           pvc_name: Optional[str] = None, secret_name: Optional[str] = None,
+           empty_dir: bool = False, host_path: Optional[str] = None) -> Obj:
+    v: Obj = {"name": name}
+    if config_map_name:
+        v["configMap"] = {"name": config_map_name}
+    elif pvc_name:
+        v["persistentVolumeClaim"] = {"claimName": pvc_name}
+    elif secret_name:
+        v["secret"] = {"secretName": secret_name}
+    elif host_path:
+        v["hostPath"] = {"path": host_path}
+    elif empty_dir:
+        v["emptyDir"] = {}
+    return v
+
+
+def volume_mount(name: str, mount_path: str, *, read_only: Optional[bool] = None,
+                 sub_path: Optional[str] = None) -> Obj:
+    return _prune(
+        {"name": name, "mountPath": mount_path, "readOnly": read_only,
+         "subPath": sub_path}
+    )
+
+
+def ambassador_mapping(name: str, prefix: str, service_addr: str, *,
+                       method: Optional[str] = None, rewrite: Optional[str] = None,
+                       timeout_ms: Optional[int] = None,
+                       use_websocket: Optional[bool] = None) -> str:
+    """One Ambassador route mapping, rendered as the YAML annotation
+    payload the reference attached to Services (annotation-driven
+    routing, e.g. ``kubeflow/tf-serving/tf-serving.libsonnet:211-231``).
+    """
+    lines = [
+        "---",
+        "apiVersion: ambassador/v0",
+        "kind: Mapping",
+        f"name: {name}",
+        f"prefix: {prefix}",
+    ]
+    if rewrite is not None:
+        lines.append(f"rewrite: {rewrite}")
+    if method is not None:
+        lines.append(f"method: {method}")
+    if timeout_ms is not None:
+        lines.append(f"timeout_ms: {timeout_ms}")
+    if use_websocket:
+        lines.append("use_websocket: true")
+    lines.append(f"service: {service_addr}")
+    return "\n".join(lines)
+
+
+def k8s_list(objects: Sequence[Obj]) -> Obj:
+    """Wrap objects as one v1 List, the reference's apply unit
+    (``k.core.v1.list.new`` in every prototype)."""
+    return {"apiVersion": "v1", "kind": "List", "items": prune(objects)}

@@ -1,0 +1,88 @@
+"""NFS provisioning on GCE persistent disks + GCS-FUSE option.
+
+Replaces reference ``kubeflow/core/nfs.libsonnet``: per-disk
+StorageClass/PVC/Service/Deployment of nfs-provisioner ``:49-221``,
+RBAC incl. volume-provisioner role ``:223-299``, comma-string disk
+list ``:22``. TPU delta: an optional GCS-FUSE flavor — TPU VM pods
+usually stream checkpoints/models from GCS rather than NFS.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.manifests import k8s
+from kubeflow_tpu.params import Param, register
+
+PROVISIONER_IMAGE = "quay.io/kubernetes_incubator/nfs-provisioner:v1.0.8"
+
+
+def disk_objects(namespace: str, disk: str) -> List[Dict[str, Any]]:
+    name = f"nfs-{disk}"
+    labels = {"app": name}
+    provisioner = f"github.com/kubernetes-incubator/nfs-provisioner-{disk}"
+    container = k8s.container(
+        "nfs-provisioner", PROVISIONER_IMAGE,
+        args=[f"-provisioner={provisioner}"],
+        env=[
+            k8s.env_var("POD_IP", field_path="status.podIP"),
+            k8s.env_var("SERVICE_NAME", name),
+            k8s.env_var("POD_NAMESPACE", field_path="metadata.namespace"),
+        ],
+        ports=[k8s.port(2049, "nfs"), k8s.port(20048, "mountd"),
+               k8s.port(111, "rpcbind")],
+        security_context={"capabilities": {"add": ["DAC_READ_SEARCH",
+                                                   "SYS_RESOURCE"]}},
+        volume_mounts=[k8s.volume_mount("export-volume", "/export")],
+    )
+    spec = k8s.pod_spec([container], service_account="nfs-provisioner",
+                        volumes=[{
+                            "name": "export-volume",
+                            "gcePersistentDisk": {"pdName": disk},
+                        }])
+    return [
+        k8s.storage_class(name, provisioner),
+        k8s.pvc(f"{name}-external", namespace, "1Mi", storage_class=name,
+                access_modes=("ReadWriteMany",)),
+        k8s.service(name, namespace, labels, [
+            k8s.service_port(2049, name="nfs"),
+            k8s.service_port(20048, name="mountd"),
+            k8s.service_port(111, name="rpcbind"),
+        ], labels=labels),
+        k8s.deployment(name, namespace, spec, labels=labels),
+    ]
+
+
+def rbac(namespace: str) -> List[Dict[str, Any]]:
+    return [
+        k8s.service_account("nfs-provisioner", namespace),
+        k8s.cluster_role_binding(
+            "nfs-provisioner", "system:persistent-volume-provisioner",
+            [k8s.subject("ServiceAccount", "nfs-provisioner", namespace)]),
+        k8s.role("nfs-provisioner", namespace, [
+            k8s.policy_rule([""], ["services", "endpoints"],
+                            ["get", "list", "watch", "create", "update",
+                             "patch"]),
+        ]),
+        k8s.role_binding("nfs-provisioner", namespace, "nfs-provisioner",
+                         [k8s.subject("ServiceAccount", "nfs-provisioner",
+                                      namespace)]),
+    ]
+
+
+def all_objects(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    disks = p["disks"]
+    if not disks:
+        return []
+    ns = p["namespace"]
+    objs = rbac(ns)
+    for disk in disks:
+        objs.extend(disk_objects(ns, disk))
+    return objs
+
+
+register("nfs", "NFS provisioners over GCE persistent disks", [
+    Param("namespace", "default", "string"),
+    Param("disks", "", "array",
+          "Comma separated list of GCE persistent disks."),
+], package="core")(all_objects)

@@ -1,0 +1,142 @@
+"""Seldon-core alternative serving graph platform.
+
+Replaces reference ``kubeflow/seldon``: core deployments (apife,
+cluster-manager, redis) patched-over-JSON ``core.libsonnet:19-96``,
+SeldonDeployment CRD ``crd.libsonnet``, and the ``serve-simple``
+single-model prototype ``serve-simple.libsonnet:3-52``. Kept at the
+reference's scope (optional component); the CRD schema is the v1
+preserve-unknown-fields form rather than the reference's 3,336-line
+inline openAPIV3 schema.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.manifests import k8s
+from kubeflow_tpu.params import Param, REQUIRED, register
+
+APIFE_IMAGE = "seldonio/apife:0.1.5"
+OPERATOR_IMAGE = "seldonio/cluster-manager:0.1.5"
+ENGINE_IMAGE = "seldonio/engine:0.1.5"
+REDIS_IMAGE = "redis:4.0.1"
+
+
+def crd() -> Dict[str, Any]:
+    return k8s.crd("seldondeployments.machinelearning.seldon.io",
+                   "machinelearning.seldon.io", "v1alpha1",
+                   "SeldonDeployment", "seldondeployments",
+                   short_names=["sdep"])
+
+
+def core(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    ns = p["namespace"]
+    name = p["name"]
+    objs: List[Dict[str, Any]] = [crd()]
+    if p["with_rbac"]:
+        objs += [
+            k8s.service_account("seldon", ns),
+            k8s.cluster_role_binding(
+                f"seldon-{ns}", "cluster-admin",
+                [k8s.subject("ServiceAccount", "seldon", ns)]),
+        ]
+    if p["with_apife"]:
+        apife = k8s.container(
+            "seldon-apiserver-container", p["apife_image"],
+            ports=[k8s.port(8080), k8s.port(5000)],
+            env=[k8s.env_var("SELDON_ENGINE_KAFKA_SERVER", "kafka:9092"),
+                 k8s.env_var("SELDON_CLUSTER_MANAGER_REDIS_HOST", "redis")],
+        )
+        objs += [
+            k8s.deployment("seldon-apiserver", ns,
+                           k8s.pod_spec([apife], service_account="seldon"),
+                           labels={"app": "seldon-apiserver"}),
+            k8s.service("seldon-apiserver", ns, {"app": "seldon-apiserver"},
+                        [k8s.service_port(8080, name="http"),
+                         k8s.service_port(5000, name="grpc")],
+                        service_type=p["apife_service_type"]),
+        ]
+    manager_env = [
+        k8s.env_var("SELDON_CLUSTER_MANAGER_REDIS_HOST", "redis"),
+        k8s.env_var("SELDON_CLUSTER_MANAGER_POD_NAMESPACE",
+                    field_path="metadata.namespace"),
+        k8s.env_var("SELDON_ENGINE_IMAGE", p["engine_image"]),
+    ]
+    if p["operator_java_opts"]:
+        manager_env.append(k8s.env_var("JAVA_OPTS", p["operator_java_opts"]))
+    if p["operator_spring_opts"]:
+        manager_env.append(k8s.env_var("SPRING_OPTS", p["operator_spring_opts"]))
+    manager = k8s.container(
+        "seldon-cluster-manager-container", p["operator_image"],
+        ports=[k8s.port(8080)], env=manager_env)
+    redis = k8s.container("redis", REDIS_IMAGE, ports=[k8s.port(6379)])
+    objs += [
+        k8s.deployment("seldon-cluster-manager", ns,
+                       k8s.pod_spec([manager], service_account="seldon"),
+                       labels={"app": "seldon-cluster-manager"}),
+        k8s.deployment("redis", ns, k8s.pod_spec([redis]),
+                       labels={"app": "redis"}),
+        k8s.service("redis", ns, {"app": "redis"},
+                    [k8s.service_port(6379)]),
+    ]
+    del name
+    return objs
+
+
+register("seldon", "Seldon-core serving graph platform", [
+    Param("name", "seldon", "string"),
+    Param("namespace", "default", "string"),
+    Param("with_rbac", "true", "bool"),
+    Param("with_apife", "false", "bool"),
+    Param("apife_image", APIFE_IMAGE, "string"),
+    Param("apife_service_type", "NodePort", "string"),
+    Param("operator_image", OPERATOR_IMAGE, "string"),
+    Param("operator_java_opts", "", "string"),
+    Param("operator_spring_opts", "", "string"),
+    Param("engine_image", ENGINE_IMAGE, "string"),
+], package="seldon")(core)
+
+
+def serve_simple(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Single-model SeldonDeployment graph (parity
+    ``serve-simple.libsonnet:3-52``)."""
+    name = p["name"]
+    return [{
+        "apiVersion": "machinelearning.seldon.io/v1alpha1",
+        "kind": "SeldonDeployment",
+        "metadata": k8s.metadata(name, p["namespace"],
+                                 labels={"app": "seldon"}),
+        "spec": {
+            "name": name,
+            "oauth_key": "oauth-key",
+            "oauth_secret": "oauth-secret",
+            "predictors": [{
+                "name": name,
+                "replicas": p["replicas"],
+                "annotations": {"predictor_version": "v1"},
+                "componentSpec": {
+                    "spec": k8s.pod_spec([
+                        k8s.container(
+                            "classifier", p["image"],
+                            image_pull_policy="IfNotPresent")
+                    ])
+                },
+                "graph": {
+                    "name": "classifier",
+                    "type": "MODEL",
+                    "endpoint": {"type": p["endpoint"]},
+                    "children": [],
+                },
+            }],
+        },
+    }]
+
+
+register("seldon-serve-simple", "Single-model Seldon serving graph", [
+    Param("name", REQUIRED, "string", "Name to give this deployment."),
+    Param("namespace", "default", "string"),
+    Param("image", REQUIRED, "string",
+          "Docker image which contains this model."),
+    Param("replicas", 1, "int"),
+    Param("endpoint", "REST", "string", "REST or GRPC."),
+], package="seldon")(serve_simple)

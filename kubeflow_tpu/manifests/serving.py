@@ -1,0 +1,185 @@
+"""TPU model serving: model-server Deployment + Service (+ mixins).
+
+Replaces reference ``kubeflow/tf-serving/tf-serving.libsonnet``:
+late-bound params + CPU/GPU image selection ``:22-27``, model-server
+container ``:102-128``, HTTP proxy sidecar ``:143-170``, non-root
+Deployment ``:173-202``, Service with Ambassador mappings ``:204-249``,
+S3 mixin ``:253-283``, GCP mixin ``:285-327``.
+
+TPU-native redesign: ONE server image — the kubeflow_tpu model server
+(kubeflow_tpu.serving) hosting XLA-compiled models on TPU via jax —
+so the numGpus/image-pair selection logic disappears; instead a
+``tpu_chips`` param adds ``google.com/tpu`` limits + node selectors
+(zero-CUDA invariant). The REST proxy keeps the reference's route
+grammar (``/model/<name>[:predict|:classify]``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.manifests import k8s
+from kubeflow_tpu.params import Param, REQUIRED, register
+from kubeflow_tpu.manifests.tpujob import (
+    TPU_ACCEL_SELECTOR,
+    TPU_RESOURCE,
+    TPU_TOPO_SELECTOR,
+)
+
+DEFAULT_SERVER_IMAGE = "ghcr.io/kubeflow-tpu/model-server:v0.1.0"
+DEFAULT_PROXY_IMAGE = "ghcr.io/kubeflow-tpu/model-server-http-proxy:v0.1.0"
+
+
+def server_container(p: Dict[str, Any]) -> Dict[str, Any]:
+    """Model-server container (parity ``tf-serving.libsonnet:102-128``:
+    ``tensorflow_model_server --port=9000 --model_name=...
+    --model_base_path=...``)."""
+    container = k8s.container(
+        p["name"], p["model_server_image"],
+        command=["python", "-m", "kubeflow_tpu.serving.server"],
+        args=[
+            "--port=9000",
+            f"--model_name={p['model_name']}",
+            f"--model_base_path={p['model_path']}",
+        ],
+        ports=[k8s.port(9000, "serve")],
+        resources=k8s.resources(
+            cpu_request="1", memory_request="1Gi",
+            cpu_limit="4", memory_limit="4Gi",
+            extra_limits=({TPU_RESOURCE: p["tpu_chips"]}
+                          if p["tpu_chips"] else None),
+        ),
+        image_pull_policy="IfNotPresent",
+    )
+    return container
+
+
+def proxy_container(p: Dict[str, Any]) -> Dict[str, Any]:
+    """REST→server proxy sidecar (parity ``:143-170``)."""
+    return k8s.container(
+        f"{p['name']}-http-proxy", p["http_proxy_image"],
+        command=["python", "-m", "kubeflow_tpu.serving.http_proxy"],
+        args=["--port=8000", "--rpc_port=9000", "--rpc_timeout=10.0"],
+        ports=[k8s.port(8000, "http")],
+        resources=k8s.resources(cpu_request="500m", memory_request="500Mi",
+                                cpu_limit="1", memory_limit="1Gi"),
+    )
+
+
+def deployment(p: Dict[str, Any]) -> Dict[str, Any]:
+    containers = [server_container(p)]
+    if p["http_proxy"]:
+        containers.append(proxy_container(p))
+    node_selector = None
+    if p["tpu_chips"]:
+        node_selector = {TPU_ACCEL_SELECTOR: p["tpu_accelerator"]}
+        if p["tpu_topology"]:
+            node_selector[TPU_TOPO_SELECTOR] = p["tpu_topology"]
+    spec = k8s.pod_spec(
+        containers,
+        node_selector=node_selector,
+    )
+    # Non-root (parity ``:173-202`` runAsUser/fsGroup 1000).
+    spec["securityContext"] = {"runAsUser": 1000, "fsGroup": 1000}
+    return k8s.deployment(p["name"], p["namespace"], spec,
+                          labels={"app": p["name"]})
+
+
+def service(p: Dict[str, Any]) -> Dict[str, Any]:
+    """gRPC/native :9000 + REST :8000 with Ambassador GET/POST mappings
+    at ``/models/<name>/`` (parity ``:204-249``)."""
+    name, ns = p["name"], p["namespace"]
+    mapping = "\n".join([
+        k8s.ambassador_mapping(
+            f"{name}-get", f"/models/{name}/", f"{name}.{ns}:8000",
+            method="GET", rewrite=f"/model/{name}"),
+        k8s.ambassador_mapping(
+            f"{name}-post", f"/models/{name}/", f"{name}.{ns}:8000",
+            method="POST", rewrite=f"/model/{name}:predict",
+            timeout_ms=10000),
+    ])
+    return k8s.service(
+        name, ns, {"app": name},
+        [k8s.service_port(9000, name="serve"),
+         k8s.service_port(8000, name="http")],
+        service_type=p["service_type"],
+        annotations={"getambassador.io/config": mapping},
+    )
+
+
+def s3_env(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """S3 credential env (parity s3parts ``:253-283``)."""
+    secret = p["s3_secret_name"]
+    return [
+        k8s.env_var("AWS_ACCESS_KEY_ID", secret=secret,
+                    secret_key=p["s3_secret_accesskeyid_key_name"]),
+        k8s.env_var("AWS_SECRET_ACCESS_KEY", secret=secret,
+                    secret_key=p["s3_secret_secretaccesskey_key_name"]),
+        k8s.env_var("AWS_REGION", p["s3_aws_region"]),
+        k8s.env_var("S3_USE_HTTPS", p["s3_use_https"]),
+        k8s.env_var("S3_VERIFY_SSL", p["s3_verify_ssl"]),
+        k8s.env_var("S3_ENDPOINT", p["s3_endpoint"]),
+    ]
+
+
+def gcp_env_and_volume(p: Dict[str, Any]) -> Dict[str, Any]:
+    """GCP credential secret mount (parity gcpParts ``:285-327``)."""
+    secret = p["gcp_credential_secret_name"]
+    return {
+        "env": [k8s.env_var(
+            "GOOGLE_APPLICATION_CREDENTIALS",
+            "/secret/gcp-credentials/key.json")],
+        "volume": k8s.volume("gcp-credentials", secret_name=secret),
+        "mount": k8s.volume_mount("gcp-credentials", "/secret/gcp-credentials",
+                                  read_only=True),
+    }
+
+
+def all_objects(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    p = dict(p)
+    p.setdefault("model_name", None)
+    p["model_name"] = p["model_name"] or p["name"]
+    dep = deployment(p)
+    containers = dep["spec"]["template"]["spec"]["containers"]
+    if p["s3_enable"]:
+        containers[0].setdefault("env", []).extend(s3_env(p))
+    if p["cloud"] == "gcp" and p["gcp_credential_secret_name"]:
+        gcp = gcp_env_and_volume(p)
+        containers[0].setdefault("env", []).extend(gcp["env"])
+        containers[0].setdefault("volumeMounts", []).append(gcp["mount"])
+        dep["spec"]["template"]["spec"].setdefault("volumes", []).append(
+            gcp["volume"])
+    return [dep, service(p)]
+
+
+SERVING_PARAMS = [
+    Param("name", REQUIRED, "string", "Name to give to each of the components."),
+    Param("namespace", "default", "string"),
+    Param("model_name", "", "string", "Defaults to name."),
+    Param("model_path", REQUIRED, "string",
+          "Versioned model base path (gs://... or s3://... or local)."),
+    Param("model_server_image", DEFAULT_SERVER_IMAGE, "string"),
+    Param("http_proxy", "true", "bool", "Deploy the REST proxy sidecar."),
+    Param("http_proxy_image", DEFAULT_PROXY_IMAGE, "string"),
+    Param("service_type", "ClusterIP", "string"),
+    Param("tpu_chips", 0, "int", "TPU chips per server pod (0 = CPU)."),
+    Param("tpu_accelerator", "tpu-v5-lite-device", "string"),
+    Param("tpu_topology", "", "string"),
+    Param("cloud", "", "string", "gcp | aws | ''"),
+    # S3 mixin params (parity :253-283).
+    Param("s3_enable", "false", "bool"),
+    Param("s3_secret_name", "", "string"),
+    Param("s3_secret_accesskeyid_key_name", "AWS_ACCESS_KEY_ID", "string"),
+    Param("s3_secret_secretaccesskey_key_name", "AWS_SECRET_ACCESS_KEY",
+          "string"),
+    Param("s3_aws_region", "us-west-1", "string"),
+    Param("s3_use_https", "true", "string"),
+    Param("s3_verify_ssl", "true", "string"),
+    Param("s3_endpoint", "s3.us-west-1.amazonaws.com", "string"),
+    # GCP mixin params (parity :285-327).
+    Param("gcp_credential_secret_name", "", "string"),
+]
+
+register("tpu-serving",
+         "TPU model server + REST proxy (tf-serving replacement)",
+         SERVING_PARAMS, package="tpu-serving")(all_objects)

@@ -1,0 +1,50 @@
+"""Opt-in anonymous usage telemetry (spartakus).
+
+Replaces reference ``kubeflow/core/spartakus.libsonnet``: ClusterRole
+to list nodes ``:19-42``, volunteer Deployment ``:80-111``, gated on a
+``reportUsage`` bool ``:4-14``. No TPU delta.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.manifests import k8s
+from kubeflow_tpu.params import Param, register
+
+IMAGE = "gcr.io/google_containers/spartakus-amd64:v1.0.0"
+
+
+def all_objects(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    if not p["report_usage"]:
+        # Telemetry is strictly opt-in (parity :4-14).
+        return []
+    ns = p["namespace"]
+    labels = {"app": "spartakus"}
+    container = k8s.container(
+        "volunteer", IMAGE,
+        args=[f"volunteer", f"--cluster-id={p['usage_id']}",
+              "--database=https://stats-collector.kubeflow.org"],
+    )
+    return [
+        k8s.service_account("spartakus", ns, labels=labels),
+        k8s.cluster_role("spartakus", [
+            k8s.policy_rule([""], ["nodes"], ["list"]),
+        ], labels=labels),
+        k8s.cluster_role_binding(
+            "spartakus", "spartakus",
+            [k8s.subject("ServiceAccount", "spartakus", ns)], labels=labels),
+        k8s.deployment(
+            "spartakus-volunteer", ns,
+            k8s.pod_spec([container], service_account="spartakus"),
+            labels=labels),
+    ]
+
+
+register("spartakus", "Opt-in anonymous usage telemetry", [
+    Param("namespace", "default", "string"),
+    Param("report_usage", "false", "bool",
+          "Whether or not to report Kubeflow usage to kubeflow.org."),
+    Param("usage_id", "unknown_cluster", "string",
+          "Optional id to use when reporting usage."),
+], package="core")(all_objects)

@@ -1,0 +1,431 @@
+"""TPUJob CRD, operator, dashboard — and the TPUJob CR builders.
+
+Replaces the reference's tf-job package and core/tf-job component:
+
+- CRD + operator Deployment + ConfigMap + RBAC + dashboard UI:
+  reference ``kubeflow/core/tf-job.libsonnet`` (CRD ``:14-29``,
+  operator ``:31-95``, ConfigMap ``:98-148``, RBAC ``:150-269``,
+  UI ``:271-458``).
+- TFJob CR builder → TPUJob CR builder: reference
+  ``kubeflow/tf-job/tf-job.libsonnet:5-56`` and prototypes
+  ``tf-job.jsonnet`` / ``tf-cnn-benchmarks.jsonnet``.
+
+TPU-native redesign (not a port):
+
+- Replica types are {COORDINATOR, TPU_WORKER, CPU} instead of
+  {MASTER, WORKER, PS}. A TPU_WORKER replica describes a *whole pod
+  slice* (accelerator type + topology), gang-scheduled atomically —
+  there is no parameter server; gradients ride ICI all-reduce inside
+  the jitted program.
+- Instead of injecting ``TF_CONFIG`` (cluster JSON), the operator
+  injects the ``jax.distributed`` bootstrap env:
+  ``KFT_COORDINATOR_ADDRESS``, ``KFT_NUM_PROCESSES``,
+  ``KFT_PROCESS_ID``, plus ``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES``
+  for the TPU runtime (see kubeflow_tpu.operator and
+  kubeflow_tpu.training.launcher).
+- GPU resource limits (``nvidia.com/gpu``, reference
+  ``tf-job.libsonnet:18``) become ``google.com/tpu`` limits plus
+  GKE TPU node selectors (topology + accelerator).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Sequence
+
+from kubeflow_tpu.manifests import k8s
+from kubeflow_tpu.params import Param, REQUIRED, register
+
+GROUP = "kubeflow.org"
+VERSION = "v1alpha1"
+KIND = "TPUJob"
+PLURAL = "tpujobs"
+CRD_NAME = f"{PLURAL}.{GROUP}"
+
+REPLICA_TYPES = ("COORDINATOR", "TPU_WORKER", "CPU")
+
+DEFAULT_OPERATOR_IMAGE = "ghcr.io/kubeflow-tpu/tpujob-operator:v0.1.0"
+DEFAULT_UI_IMAGE = "ghcr.io/kubeflow-tpu/tpujob-dashboard:v0.1.0"
+
+# GKE TPU scheduling contract (replaces nvidia.com/gpu limits).
+TPU_RESOURCE = "google.com/tpu"
+TPU_ACCEL_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+TPU_TOPO_SELECTOR = "cloud.google.com/gke-tpu-topology"
+
+
+def replica_spec(
+    replica_type: str,
+    replicas: int,
+    *,
+    image: str,
+    args: Optional[Sequence[str]] = None,
+    command: Optional[Sequence[str]] = None,
+    tpu_accelerator: Optional[str] = None,  # e.g. "tpu-v5-lite-podslice"
+    tpu_topology: Optional[str] = None,  # e.g. "2x4"
+    chips_per_worker: int = 4,
+    env: Optional[Sequence[Dict[str, Any]]] = None,
+    resources: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """One replicaSpec of a TPUJob (parity: ``tfJobReplica``,
+    reference ``kubeflow/tf-job/tf-job.libsonnet:5-35``)."""
+    if replica_type not in REPLICA_TYPES:
+        raise ValueError(
+            f"replica_type must be one of {REPLICA_TYPES}, got {replica_type!r}"
+        )
+    if replica_type == "TPU_WORKER" and not (tpu_accelerator and tpu_topology):
+        raise ValueError(
+            "TPU_WORKER replicas need tpu_accelerator and tpu_topology "
+            "(whole-slice gang scheduling contract)"
+        )
+    container: Dict[str, Any] = {
+        "name": "kubeflow-tpu",
+        "image": image,
+    }
+    # Deep-copy so TPU limit injection below can't leak into a resources
+    # dict the caller shares across replica specs.
+    resources = copy.deepcopy(resources) if resources else None
+    if command:
+        container["command"] = list(command)
+    if args:
+        container["args"] = list(args)
+    if env:
+        container["env"] = list(env)
+    if resources:
+        container["resources"] = dict(resources)
+    node_selector: Optional[Dict[str, str]] = None
+    if replica_type == "TPU_WORKER":
+        limits = container.setdefault("resources", {}).setdefault("limits", {})
+        limits[TPU_RESOURCE] = str(chips_per_worker)
+        node_selector = {
+            TPU_ACCEL_SELECTOR: tpu_accelerator,
+            TPU_TOPO_SELECTOR: tpu_topology,
+        }
+    template: Dict[str, Any] = {
+        "spec": k8s.pod_spec(
+            [container],
+            restart_policy="OnFailure",  # parity: tf-job.libsonnet:30
+            node_selector=node_selector,
+        )
+    }
+    return k8s._prune(
+        {
+            "replicas": replicas,
+            "tpuReplicaType": replica_type,
+            "template": template,
+        }
+    )
+
+
+def termination_policy(chief_name: str = "COORDINATOR",
+                       chief_index: int = 0) -> Dict[str, Any]:
+    """Job success is defined by one chief replica finishing (parity:
+    ``tfJobTerminationPolicy``, reference ``tf-job.libsonnet:37-42``;
+    chief = WORKER 0 in ``tf-cnn-benchmarks.jsonnet:100``)."""
+    return {"chief": {"replicaName": chief_name, "replicaIndex": chief_index}}
+
+
+def tpu_job(
+    name: str,
+    namespace: str,
+    replica_specs: Sequence[Dict[str, Any]],
+    *,
+    termination: Optional[Dict[str, Any]] = None,
+    recovery: str = "restart-slice",
+) -> Dict[str, Any]:
+    """A TPUJob CR (parity: ``tfJob``, reference
+    ``tf-job.libsonnet:44-56``). ``recovery`` is new: TPU slices fail
+    as a unit, so the operator restarts the whole gang from the last
+    checkpoint ('restart-slice') or fails the job ('none')."""
+    if recovery not in ("restart-slice", "none"):
+        raise ValueError(f"unknown recovery policy {recovery!r}")
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": KIND,
+        "metadata": k8s.metadata(name, namespace),
+        "spec": k8s._prune(
+            {
+                "replicaSpecs": list(replica_specs),
+                "terminationPolicy": termination or termination_policy(),
+                "recoveryPolicy": recovery,
+            }
+        ),
+    }
+
+
+def crd() -> Dict[str, Any]:
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "replicaSpecs": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                            "properties": {
+                                "tpuReplicaType": {
+                                    "type": "string",
+                                    "enum": list(REPLICA_TYPES),
+                                },
+                                "replicas": {"type": "integer", "minimum": 1},
+                            },
+                        },
+                    },
+                    "terminationPolicy": {
+                        "type": "object",
+                        "x-kubernetes-preserve-unknown-fields": True,
+                    },
+                    "recoveryPolicy": {
+                        "type": "string",
+                        "enum": ["restart-slice", "none"],
+                    },
+                },
+            },
+            "status": {
+                "type": "object",
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+        },
+    }
+    return k8s.crd(CRD_NAME, GROUP, VERSION, KIND, PLURAL,
+                   short_names=["tpj"], schema=schema)
+
+
+def operator_config(namespace: str, cloud: str = "") -> Dict[str, Any]:
+    """Operator ConfigMap (parity: reference ``tf-job.libsonnet:98-148``
+    whose config carried ``grpcServerFilePath`` — the stock PS/worker
+    gRPC server — and per-cloud accelerator mounts ``:108-136``. The
+    TPU equivalent default entrypoint is the JAX coordinator bootstrap
+    in kubeflow_tpu.training.launcher; the per-cloud block selects the
+    TPU scheduling contract)."""
+    import json
+
+    config = {
+        "defaultEntrypoint": "python -m kubeflow_tpu.training.launcher",
+        "coordinatorPort": 8476,
+        "cloud": cloud or "gke",
+        "accelerators": {
+            # name → chips per host; used to validate topology/gang size.
+            "tpu-v5-lite-podslice": {"chipsPerHost": 4},
+            "tpu-v5p-slice": {"chipsPerHost": 4},
+            "tpu-v4-podslice": {"chipsPerHost": 4},
+        },
+    }
+    if (cloud or "gke") != "gke":
+        # Non-GKE clusters (e.g. minikube CI) have no TPU nodepools:
+        # the operator schedules TPU_WORKER replicas as CPU pods with
+        # the simulated-mesh env so e2e tests can run anywhere.
+        config["simulateTpu"] = True
+    return k8s.config_map(
+        "tpujob-operator-config", namespace,
+        {"controller_config_file.yaml": json.dumps(config, indent=2)},
+    )
+
+
+def operator_deployment(namespace: str, image: str) -> Dict[str, Any]:
+    container = k8s.container(
+        "tpujob-operator",
+        image,
+        command=["/opt/kubeflow-tpu/tpujob-operator"],
+        args=["--controller-config-file=/etc/config/controller_config_file.yaml"],
+        env=[
+            k8s.env_var("KFT_NAMESPACE", field_path="metadata.namespace"),
+        ],
+        volume_mounts=[k8s.volume_mount("config-volume", "/etc/config")],
+    )
+    return k8s.deployment(
+        "tpujob-operator", namespace,
+        k8s.pod_spec(
+            [container],
+            volumes=[k8s.volume("config-volume",
+                                config_map_name="tpujob-operator-config")],
+            service_account="tpujob-operator",
+        ),
+    )
+
+
+def operator_rbac(namespace: str) -> List[Dict[str, Any]]:
+    """Parity: reference ``tf-job.libsonnet:150-269`` (SA + ClusterRole
+    + Binding), with the rule set narrowed to what the reconciler
+    actually touches."""
+    labels = {"app": "tpujob-operator"}
+    rules = [
+        k8s.policy_rule([GROUP], [PLURAL, f"{PLURAL}/status"], ["*"]),
+        k8s.policy_rule(["apiextensions.k8s.io"], ["customresourcedefinitions"],
+                        ["get", "list", "watch", "create"]),
+        k8s.policy_rule([""], ["pods", "services", "endpoints", "events",
+                               "configmaps"], ["*"]),
+        k8s.policy_rule(["apps"], ["deployments"], ["get", "list", "watch"]),
+    ]
+    return [
+        k8s.service_account("tpujob-operator", namespace, labels=labels),
+        k8s.cluster_role("tpujob-operator", rules, labels=labels),
+        k8s.cluster_role_binding(
+            "tpujob-operator", "tpujob-operator",
+            [k8s.subject("ServiceAccount", "tpujob-operator", namespace)],
+            labels=labels,
+        ),
+    ]
+
+
+def ui(namespace: str, image: str, service_type: str) -> List[Dict[str, Any]]:
+    """TPUJob dashboard (parity: reference ``tf-job.libsonnet:271-458``,
+    served behind Ambassador at ``/tpujobs/ui/``)."""
+    labels = {"name": "tpujob-dashboard"}
+    container = k8s.container(
+        "tpujob-dashboard", image,
+        command=["/opt/kubeflow-tpu/dashboard", "--port=8080"],
+        ports=[k8s.port(8080)],
+    )
+    svc = k8s.service(
+        "tpujob-dashboard", namespace, labels,
+        [k8s.service_port(80, target_port=8080)],
+        service_type=service_type,
+        annotations={
+            "getambassador.io/config": k8s.ambassador_mapping(
+                "tpujobs-ui-mapping", "/tpujobs/ui/",
+                f"tpujob-dashboard.{namespace}:80", rewrite="/tpujobs/ui/",
+            )
+        },
+    )
+    deploy = k8s.deployment(
+        "tpujob-dashboard", namespace,
+        k8s.pod_spec([container], service_account="tpujob-dashboard"),
+        labels=labels, pod_labels=labels,
+    )
+    rbac = [
+        k8s.service_account("tpujob-dashboard", namespace),
+        k8s.cluster_role("tpujob-dashboard", [
+            k8s.policy_rule([GROUP], [PLURAL], ["*"]),
+            k8s.policy_rule([""], ["pods", "pods/log", "events"],
+                            ["get", "list", "watch"]),
+        ]),
+        k8s.cluster_role_binding(
+            "tpujob-dashboard", "tpujob-dashboard",
+            [k8s.subject("ServiceAccount", "tpujob-dashboard", namespace)],
+        ),
+    ]
+    return [svc, deploy] + rbac
+
+
+def all_objects(params: Dict[str, Any]) -> List[Dict[str, Any]]:
+    ns = params["namespace"]
+    return [
+        crd(),
+        operator_config(ns, params.get("cloud", "")),
+        operator_deployment(ns, params["tpujob_image"]),
+        *operator_rbac(ns),
+        *ui(ns, params["tpujob_ui_image"], params["tpujob_ui_service_type"]),
+    ]
+
+
+OPERATOR_PARAMS = [
+    Param("namespace", "default", "string", "Namespace to use for the components."),
+    Param("tpujob_image", DEFAULT_OPERATOR_IMAGE, "string",
+          "The image for the TPUJob controller."),
+    Param("tpujob_ui_image", DEFAULT_UI_IMAGE, "string",
+          "The image for the TPUJob dashboard."),
+    Param("tpujob_ui_service_type", "ClusterIP", "string",
+          "The service type for the UI."),
+    Param("cloud", "", "string",
+          "Cloud to customize for: gke (default) | minikube."),
+]
+
+register(
+    "tpujob-operator",
+    "TPUJob CRD, operator, and dashboard (tf-operator replacement)",
+    OPERATOR_PARAMS,
+    package="core",
+)(all_objects)
+
+
+def _generic_job_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Generic TPUJob prototype (parity: reference
+    ``kubeflow/tf-job/prototypes/tf-job.jsonnet:5-57``: num_masters/
+    num_ps/num_workers/num_gpus → coordinator + TPU workers)."""
+    args = p["args"]
+    specs = []
+    if p["num_coordinators"] > 0:
+        specs.append(replica_spec(
+            "COORDINATOR", p["num_coordinators"], image=p["image"], args=args))
+    if p["num_tpu_workers"] > 0:
+        specs.append(replica_spec(
+            "TPU_WORKER", p["num_tpu_workers"], image=p["image"], args=args,
+            tpu_accelerator=p["tpu_accelerator"], tpu_topology=p["tpu_topology"],
+            chips_per_worker=p["chips_per_worker"]))
+    if p["num_cpu_workers"] > 0:
+        specs.append(replica_spec(
+            "CPU", p["num_cpu_workers"], image=p["image"], args=args))
+    if not specs:
+        raise ValueError("job needs at least one replica")
+    # Chief: the coordinator if present, else TPU_WORKER 0 (parity with
+    # tf-job.jsonnet:41-44 MASTER-else-WORKER chief selection).
+    chief = "COORDINATOR" if p["num_coordinators"] > 0 else "TPU_WORKER"
+    return [tpu_job(p["name"], p["namespace"], specs,
+                    termination=termination_policy(chief))]
+
+
+register(
+    "tpu-job",
+    "A generic TPUJob (tf-job prototype replacement)",
+    [
+        Param("name", REQUIRED, "string", "Name for the job."),
+        Param("namespace", "default", "string"),
+        Param("image", "ghcr.io/kubeflow-tpu/trainer:v0.1.0", "string",
+              "The docker image to use for the job."),
+        Param("args", "", "array", "Comma separated args to pass to the job."),
+        Param("num_coordinators", 1, "int"),
+        Param("num_tpu_workers", 1, "int"),
+        Param("num_cpu_workers", 0, "int"),
+        Param("tpu_accelerator", "tpu-v5-lite-podslice", "string"),
+        Param("tpu_topology", "2x4", "string"),
+        Param("chips_per_worker", 4, "int"),
+    ],
+    package="tpu-job",
+)(_generic_job_builder)
+
+
+def _cnn_benchmark_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The tpu-cnn benchmark prototype (parity: reference
+    ``kubeflow/tf-job/prototypes/tf-cnn-benchmarks.jsonnet``: arg
+    assembly ``:36-43``, worker/ps validation ``:92-97``, chief =
+    worker 0 ``:100``). PS count is gone — validation is now that the
+    slice geometry is coherent."""
+    if p["num_tpu_workers"] < 1:
+        # Parity with the reference's jsonnet `error` on workers < 1.
+        raise ValueError("num_tpu_workers must be >= 1")
+    args = [
+        "python", "-m", "kubeflow_tpu.training.benchmark",
+        f"--model={p['model']}",
+        f"--batch_size={p['batch_size']}",
+    ]
+    spec = replica_spec(
+        "TPU_WORKER", p["num_tpu_workers"], image=p["image"],
+        command=args[:1], args=args[1:],
+        tpu_accelerator=p["tpu_accelerator"], tpu_topology=p["tpu_topology"],
+        chips_per_worker=p["chips_per_worker"],
+    )
+    return [tpu_job(
+        p["name"], p["namespace"], [spec],
+        termination=termination_policy("TPU_WORKER", 0),
+    )]
+
+
+register(
+    "tpu-cnn",
+    "ResNet/Inception training benchmark as a TPUJob (tf-cnn replacement)",
+    [
+        Param("name", REQUIRED, "string", "Name for the job."),
+        Param("namespace", "default", "string"),
+        Param("image", "ghcr.io/kubeflow-tpu/trainer:v0.1.0", "string"),
+        Param("model", "resnet50", "string", "Which model to use."),
+        Param("batch_size", 128, "int", "Global batch size."),
+        Param("num_tpu_workers", 1, "int"),
+        Param("tpu_accelerator", "tpu-v5-lite-podslice", "string"),
+        Param("tpu_topology", "2x4", "string"),
+        Param("chips_per_worker", 4, "int"),
+    ],
+    package="tpu-job",
+)(_cnn_benchmark_builder)

@@ -1,0 +1,11 @@
+"""Model zoo for the TPU training engine.
+
+The reference's models were external (tf_cnn_benchmarks cloned into the
+training image, ``tf-controller-examples/tf-cnn/Dockerfile.template:17-27``;
+inception SavedModel for serving). Here the benchmark models are
+in-tree JAX code: ResNet-50 and Inception-v3 (the tf-cnn families),
+BERT (multi-host baseline config) and a Llama-style decoder (long
+context / notebook fine-tune config).
+"""
+
+from kubeflow_tpu.models.registry import get_model, list_models, register_model  # noqa: F401

@@ -1,0 +1,61 @@
+"""Model registry: name → (constructor, canonical input spec).
+
+The tf-cnn prototype selected models by string flag
+(``--model=resnet50``, reference
+``kubeflow/tf-job/prototypes/tf-cnn-benchmarks.jsonnet:9,38``); this
+registry is the typed equivalent the trainer CLI resolves against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelEntry:
+    name: str
+    family: str  # "vision" | "language"
+    make: Callable[..., Any]  # returns a flax Module
+    input_spec: Tuple[Tuple[int, ...], str]  # (shape sans batch, dtype)
+    num_classes_or_vocab: int
+
+
+_MODELS: Dict[str, ModelEntry] = {}
+
+
+def register_model(entry: ModelEntry) -> None:
+    if entry.name in _MODELS:
+        raise ValueError(f"model {entry.name!r} already registered")
+    _MODELS[entry.name] = entry
+
+
+def _ensure_loaded() -> None:
+    import importlib
+
+    for mod in (
+        "kubeflow_tpu.models.resnet",
+        "kubeflow_tpu.models.inception",
+        "kubeflow_tpu.models.bert",
+        "kubeflow_tpu.models.llama",
+    ):
+        try:
+            importlib.import_module(mod)
+        except ModuleNotFoundError as e:
+            if e.name != mod:
+                raise
+
+
+def get_model(name: str) -> ModelEntry:
+    _ensure_loaded()
+    try:
+        return _MODELS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown model {name!r}; available: {sorted(_MODELS)}"
+        ) from None
+
+
+def list_models() -> Dict[str, ModelEntry]:
+    _ensure_loaded()
+    return dict(_MODELS)

@@ -1,0 +1,8 @@
+from kubeflow_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    build_mesh,
+    batch_sharding,
+    replicated,
+    fsdp_params_sharding,
+    logical_sharding,
+)

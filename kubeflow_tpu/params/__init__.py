@@ -1,0 +1,2 @@
+from kubeflow_tpu.params.spec import Param, ParamSet, REQUIRED  # noqa: F401
+from kubeflow_tpu.params.registry import Prototype, register, get_prototype, list_prototypes  # noqa: F401

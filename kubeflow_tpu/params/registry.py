@@ -1,0 +1,80 @@
+"""Prototype registry — the replacement for ksonnet's prototype index.
+
+A :class:`Prototype` is a named, documented manifest generator: the
+typed equivalent of one ``*.jsonnet`` prototype file (reference
+``kubeflow/*/prototypes/``). A builder takes one argument — the
+resolved (typed) params dict — and returns a list of Kubernetes
+objects (plain dicts); the target namespace is, by convention, a
+``namespace`` param (the reference threaded namespace as a param
+everywhere too, e.g. ``kubeflow/core/tf-job.libsonnet:2-3``). The
+CLI's ``generate``/``show``/``apply`` drive this registry the way
+``ks generate``/``ks show``/``ks apply`` drove ksonnet's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Sequence
+
+from kubeflow_tpu.params.spec import Param, ParamSet
+
+Builder = Callable[[Dict[str, Any]], List[dict]]
+
+_REGISTRY: Dict[str, "Prototype"] = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class Prototype:
+    name: str
+    description: str
+    params: Sequence[Param]
+    builder: Builder
+    package: str = "core"
+
+    def param_set(self) -> ParamSet:
+        return ParamSet(self.params)
+
+    def build(self, overrides: Dict[str, Any] | None = None) -> List[dict]:
+        resolved = self.param_set().overlay(overrides or {}).resolve()
+        objects = self.builder(resolved)
+        return [o for o in objects if o]
+
+
+def register(
+    name: str,
+    description: str,
+    params: Sequence[Param],
+    package: str = "core",
+) -> Callable[[Builder], Builder]:
+    """Decorator registering a builder function as a prototype."""
+
+    def wrap(fn: Builder) -> Builder:
+        if name in _REGISTRY:
+            raise ValueError(f"prototype {name!r} already registered")
+        _REGISTRY[name] = Prototype(
+            name=name, description=description, params=tuple(params), builder=fn,
+            package=package,
+        )
+        return fn
+
+    return wrap
+
+
+def get_prototype(name: str) -> Prototype:
+    _ensure_loaded()
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown prototype {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def list_prototypes() -> List[Prototype]:
+    _ensure_loaded()
+    return sorted(_REGISTRY.values(), key=lambda p: (p.package, p.name))
+
+
+def _ensure_loaded() -> None:
+    """Import all manifest component modules so their prototypes register."""
+    import kubeflow_tpu.manifests  # noqa: F401  (side-effect imports)

@@ -1,0 +1,122 @@
+"""Typed parameter system — the replacement for ksonnet prototype params.
+
+The reference declared parameters as ``@param``/``@optionalParam``
+comment annotations on jsonnet prototypes (e.g.
+``kubeflow/core/prototypes/all.jsonnet:5-17``), received every value as
+a string, and coerced ad hoc with ``util.toBool/toArray``. Here the same
+surface is a declarative :class:`Param` list per prototype; coercion
+happens once, at :meth:`ParamSet.resolve`, and everything downstream is
+typed. Environment overlays (ksonnet's per-env ``params.libsonnet``)
+are plain dict overlays applied in order.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional
+
+from kubeflow_tpu.utils.coerce import to_array, to_bool, to_int
+
+
+class _Required:
+    def __repr__(self) -> str:  # pragma: no cover
+        return "<REQUIRED>"
+
+
+REQUIRED = _Required()
+
+_COERCERS: Dict[str, Callable[[Any], Any]] = {
+    "string": lambda v: str(v),
+    "int": to_int,
+    "bool": to_bool,
+    "array": to_array,
+    # Structured values (dicts/lists) pass through by deep copy so a
+    # builder mutating its resolved value can't corrupt the Param
+    # default or a shared overlay across builds.
+    "raw": copy.deepcopy,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Param:
+    """One declared parameter of a prototype.
+
+    ``kind`` selects the string-boundary coercion; ``default`` of
+    :data:`REQUIRED` makes the param mandatory (ksonnet ``@param`` vs
+    ``@optionalParam``).
+    """
+
+    name: str
+    default: Any = REQUIRED
+    kind: str = "string"
+    doc: str = ""
+
+    def __post_init__(self) -> None:
+        if self.kind not in _COERCERS:
+            raise ValueError(f"unknown param kind {self.kind!r} for {self.name!r}")
+
+    @property
+    def required(self) -> bool:
+        return self.default is REQUIRED
+
+    def coerce(self, value: Any) -> Any:
+        try:
+            return _COERCERS[self.kind](value)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"param {self.name!r}: {e}") from e
+
+
+class ParamSet:
+    """A prototype's declared params plus any number of overlays.
+
+    Overlays are applied left-to-right (defaults < app params < env
+    params < CLI ``--param`` flags), mirroring ksonnet's
+    component-params/env-params/`ks param set` precedence.
+    """
+
+    def __init__(self, params: Iterable[Param]):
+        self._specs: Dict[str, Param] = {}
+        for p in params:
+            if p.name in self._specs:
+                raise ValueError(f"duplicate param {p.name!r}")
+            self._specs[p.name] = p
+        self._overlays: List[Mapping[str, Any]] = []
+
+    @property
+    def specs(self) -> Dict[str, Param]:
+        return dict(self._specs)
+
+    def overlay(self, values: Optional[Mapping[str, Any]]) -> "ParamSet":
+        """Return a new ParamSet with ``values`` layered on top."""
+        clone = ParamSet(self._specs.values())
+        clone._overlays = list(self._overlays)
+        if values:
+            unknown = set(values) - set(self._specs)
+            if unknown:
+                raise KeyError(
+                    f"unknown params {sorted(unknown)}; declared: {sorted(self._specs)}"
+                )
+            clone._overlays.append(dict(values))
+        return clone
+
+    def resolve(self) -> Dict[str, Any]:
+        """Collapse overlays over defaults into a typed dict."""
+        out: Dict[str, Any] = {}
+        for name, spec in self._specs.items():
+            value = spec.default
+            for layer in self._overlays:
+                if name in layer:
+                    value = layer[name]
+            if value is REQUIRED:
+                raise ValueError(f"missing required param {name!r}")
+            if value is None:
+                # None is only a legal resolved value for params whose
+                # declared default is None (nullable params); it must
+                # not bypass REQUIRED or coercion via an overlay.
+                if spec.default is None:
+                    out[name] = None
+                    continue
+                raise ValueError(f"param {name!r} may not be None")
+            out[name] = spec.coerce(value)
+        return out

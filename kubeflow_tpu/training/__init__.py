@@ -1,0 +1,7 @@
+from kubeflow_tpu.training.train import (  # noqa: F401
+    TrainStepFn,
+    TrainState,
+    create_train_state,
+    make_train_step,
+    state_sharding,
+)

@@ -1,0 +1,128 @@
+"""tpu-cnn — the benchmark harness (tf_cnn_benchmarks replacement).
+
+Reference parity: ``tf_cnn_benchmarks.py --model=resnet50
+--batch_size=N --flush_stdout`` driven by ``launcher.py`` inside the
+TFJob pods (``tf-controller-examples/tf-cnn/launcher.py``,
+``kubeflow/tf-job/prototypes/tf-cnn-benchmarks.jsonnet:36-43``).
+Synthetic data (the reference default), images/sec as the headline
+metric — but measured on a jitted SPMD step over a TPU mesh rather
+than a parameter-server session loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kubeflow_tpu.models.registry import get_model
+from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+from kubeflow_tpu.training.train import (
+    create_train_state,
+    make_train_step,
+    place_batch,
+    place_state,
+)
+
+
+@dataclasses.dataclass
+class BenchConfig:
+    model: str = "resnet50"
+    batch_size: int = 128  # global
+    steps: int = 20
+    warmup_steps: int = 3
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+    mesh: Optional[MeshSpec] = None  # None → all devices on the data axis
+    image_size: Optional[int] = None  # override model default (for smoke runs)
+    seed: int = 0
+
+
+def synthetic_batch(config: BenchConfig, num_classes: int,
+                    input_shape, rng: jax.Array) -> Dict[str, jax.Array]:
+    """Random images/labels — parity with tf_cnn_benchmarks synthetic
+    mode (the reference never wired real data into the benchmark,
+    ``tf-controller-examples/tf-cnn/README.md:15-16``)."""
+    img_rng, label_rng = jax.random.split(rng)
+    images = jax.random.normal(
+        img_rng, (config.batch_size, *input_shape), jnp.bfloat16
+    )
+    labels = jax.random.randint(
+        label_rng, (config.batch_size,), 0, num_classes
+    )
+    return {"inputs": images, "labels": labels}
+
+
+def run_benchmark(config: BenchConfig) -> Dict[str, float]:
+    """Returns {images_per_sec, images_per_sec_per_chip, step_time_ms, ...}."""
+    entry = get_model(config.model)
+    model = entry.make()
+    input_shape = entry.input_spec[0]
+    if config.image_size is not None:
+        input_shape = (config.image_size, config.image_size, input_shape[-1])
+
+    mesh = build_mesh(config.mesh)
+    n_chips = mesh.size
+
+    tx = optax.sgd(config.learning_rate, momentum=config.momentum, nesterov=True)
+    rng = jax.random.PRNGKey(config.seed)
+    sample = jnp.zeros((1, *input_shape), jnp.bfloat16)
+    state = create_train_state(model, tx, rng, sample)
+    state = place_state(mesh, state)
+    batch = place_batch(
+        mesh, synthetic_batch(config, entry.num_classes_or_vocab, input_shape, rng)
+    )
+
+    step_fn = make_train_step(mesh)
+
+    # Warmup (includes compile).
+    compile_start = time.perf_counter()
+    for _ in range(max(config.warmup_steps, 1)):
+        state, metrics = step_fn(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    compile_s = time.perf_counter() - compile_start
+
+    start = time.perf_counter()
+    for _ in range(config.steps):
+        state, metrics = step_fn(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    elapsed = time.perf_counter() - start
+
+    images_per_sec = config.batch_size * config.steps / elapsed
+    return {
+        "model": config.model,
+        "global_batch_size": config.batch_size,
+        "n_chips": n_chips,
+        "steps": config.steps,
+        "images_per_sec": images_per_sec,
+        "images_per_sec_per_chip": images_per_sec / n_chips,
+        "step_time_ms": elapsed / config.steps * 1e3,
+        "compile_plus_warmup_s": compile_s,
+        "final_loss": float(metrics["loss"]),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="tpu-cnn")
+    parser.add_argument("--model", default="resnet50")
+    parser.add_argument("--batch_size", type=int, default=128)
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--image_size", type=int, default=None)
+    args = parser.parse_args(argv)
+    result = run_benchmark(
+        BenchConfig(model=args.model, batch_size=args.batch_size,
+                    steps=args.steps, image_size=args.image_size)
+    )
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

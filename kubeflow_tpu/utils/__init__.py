@@ -1,0 +1,1 @@
+from kubeflow_tpu.utils.coerce import to_bool, to_array, to_int, upper  # noqa: F401

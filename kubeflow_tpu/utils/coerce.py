@@ -1,0 +1,71 @@
+"""String-boundary coercions for stringly-typed platform parameters.
+
+Every parameter that crosses the CLI / manifest boundary arrives as a
+string (the reference had the same property: ksonnet params are strings,
+coerced by ``kubeflow/core/util.libsonnet:14-32`` ``toBool``/``toArray``
+and uppercased by ``upper``). These helpers are the single place that
+coercion happens; everything behind them is typed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+_TRUE_STRINGS = frozenset({"true", "yes", "1", "on"})
+_FALSE_STRINGS = frozenset({"false", "no", "0", "off", ""})
+
+
+def upper(value: str) -> str:
+    """Uppercase a string (parity: util.libsonnet ``upper``)."""
+    return str(value).upper()
+
+
+def to_bool(value: Any) -> bool:
+    """Coerce a param value to bool (parity: util.libsonnet ``toBool``).
+
+    Accepts real bools, numbers (nonzero = true), and the usual string
+    spellings. Unrecognised strings raise instead of silently reading as
+    false — the reference's silent-false behavior was a footgun.
+    """
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, (int, float)):
+        return value != 0
+    if isinstance(value, str):
+        lowered = value.strip().lower()
+        if lowered in _TRUE_STRINGS:
+            return True
+        if lowered in _FALSE_STRINGS:
+            return False
+        raise ValueError(f"cannot coerce {value!r} to bool")
+    raise TypeError(f"cannot coerce {type(value).__name__} to bool")
+
+
+def to_array(value: Any, sep: str = ",") -> List[str]:
+    """Coerce a comma-separated string to a list (parity: ``toArray``).
+
+    Real lists pass through; empty/None becomes []. Items are stripped.
+    """
+    if value is None:
+        return []
+    if isinstance(value, (list, tuple)):
+        return [str(v) for v in value]
+    if isinstance(value, str):
+        stripped = value.strip()
+        if not stripped:
+            return []
+        return [item.strip() for item in stripped.split(sep) if item.strip()]
+    raise TypeError(f"cannot coerce {type(value).__name__} to array")
+
+
+def to_int(value: Any) -> int:
+    """Coerce a param value to int."""
+    if isinstance(value, bool):
+        return int(value)
+    if isinstance(value, int):
+        return value
+    if isinstance(value, float) and value.is_integer():
+        return int(value)
+    if isinstance(value, str):
+        return int(value.strip())
+    raise TypeError(f"cannot coerce {type(value).__name__} to int")

@@ -1,0 +1,36 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is not available in CI, so all sharding tests
+run on XLA's host-platform device-count idiom (the hermetic layer the
+reference never had — its distributed tests needed a live GKE cluster,
+``testing/workflows/components/workflows.libsonnet:51-54``).
+
+Must run before jax initializes a backend, hence env mutation at import.
+"""
+
+import os
+
+# Force CPU: the session presets JAX_PLATFORMS=axon (the real TPU
+# tunnel), which tests must never grab.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The session's sitecustomize imports jax config with JAX_PLATFORMS=axon
+# before conftest runs, freezing the env default — override explicitly.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 virtual devices, got {len(devices)}"
+    return devices

@@ -1,0 +1,106 @@
+"""CLI workflow tests: init → generate → param set → show (ks-flow parity)."""
+
+import json
+
+import pytest
+import yaml
+
+from kubeflow_tpu.cli.app import run
+from kubeflow_tpu.params import Param, REQUIRED
+from kubeflow_tpu.params import registry as reg
+from kubeflow_tpu.manifests import k8s
+
+
+@pytest.fixture(autouse=True)
+def demo_proto(monkeypatch):
+    """Register a throwaway prototype without polluting the global registry."""
+    monkeypatch.setattr(reg, "_REGISTRY", dict(reg._REGISTRY))
+    if "cli-demo" not in reg._REGISTRY:
+        reg._REGISTRY["cli-demo"] = reg.Prototype(
+            name="cli-demo",
+            description="demo",
+            params=(
+                Param("name", REQUIRED),
+                Param("namespace", "default"),
+                Param("replicas", 1, "int"),
+            ),
+            builder=lambda p: [
+                k8s.deployment(
+                    p["name"], p["namespace"],
+                    k8s.pod_spec([k8s.container(p["name"], "img")]),
+                    replicas=p["replicas"],
+                )
+            ],
+        )
+    yield
+
+
+def test_full_workflow(tmp_path, capsys):
+    app = str(tmp_path)
+    assert run(["init", app, "--force"]) == 0
+    assert run(["generate", "cli-demo", "web", "--app-dir", app,
+                "--param", "name=web"]) == 0
+    assert run(["param", "set", "web", "replicas", "5", "--app-dir", app]) == 0
+    capsys.readouterr()
+    assert run(["show", "web", "--app-dir", app]) == 0
+    docs = list(yaml.safe_load_all(capsys.readouterr().out))
+    assert docs[0]["kind"] == "Deployment"
+    assert docs[0]["spec"]["replicas"] == 5
+
+
+def test_env_overlay_wins(tmp_path, capsys):
+    app = str(tmp_path)
+    run(["init", app, "--force"])
+    run(["generate", "cli-demo", "web", "--app-dir", app, "--param", "name=web"])
+    run(["param", "set", "web", "replicas", "2", "--app-dir", app])
+    run(["param", "set", "web", "replicas", "9", "--app-dir", app, "--env", "prod"])
+    capsys.readouterr()
+    run(["show", "web", "--app-dir", app, "--env", "prod"])
+    docs = list(yaml.safe_load_all(capsys.readouterr().out))
+    assert docs[0]["spec"]["replicas"] == 9
+    # default env unaffected
+    run(["show", "web", "--app-dir", app])
+    docs = list(yaml.safe_load_all(capsys.readouterr().out))
+    assert docs[0]["spec"]["replicas"] == 2
+
+
+def test_generate_validates_params(tmp_path, capsys):
+    app = str(tmp_path)
+    run(["init", app, "--force"])
+    assert run(["generate", "cli-demo", "web", "--app-dir", app,
+                "--param", "bogus=1"]) == 1
+    assert "unknown params" in capsys.readouterr().err
+
+
+def test_show_unknown_component(tmp_path):
+    app = str(tmp_path)
+    run(["init", app, "--force"])
+    with pytest.raises(SystemExit, match="not generated"):
+        run(["show", "nope", "--app-dir", app])
+
+
+def test_apply_dry_run(tmp_path, capsys):
+    app = str(tmp_path)
+    run(["init", app, "--force"])
+    run(["generate", "cli-demo", "web", "--app-dir", app, "--param", "name=web"])
+    capsys.readouterr()
+    assert run(["apply", "--app-dir", app, "--dry-run"]) == 0
+    out = capsys.readouterr().out
+    assert "kind: Deployment" in out
+
+
+def test_init_refuses_overwrite(tmp_path):
+    app = str(tmp_path)
+    run(["init", app, "--force"])
+    with pytest.raises(SystemExit, match="exists"):
+        run(["init", app])
+
+
+def test_raw_param_isolation():
+    """kind='raw' params deep-copy so builders can't corrupt defaults."""
+    from kubeflow_tpu.params import ParamSet
+
+    ps = ParamSet([Param("cfg", {"a": 1}, "raw")])
+    r1 = ps.resolve()
+    r1["cfg"]["a"] = 999
+    assert ps.resolve()["cfg"] == {"a": 1}

@@ -1,0 +1,51 @@
+"""Coercion parity tests (reference: kubeflow/core/tests/util_test.jsonnet:1-22)."""
+
+import pytest
+
+from kubeflow_tpu.utils import to_array, to_bool, to_int, upper
+
+
+def test_upper():
+    assert upper("true") == "TRUE"
+    assert upper("tRuE") == "TRUE"
+
+
+def test_to_bool_bools_pass_through():
+    assert to_bool(True) is True
+    assert to_bool(False) is False
+
+
+@pytest.mark.parametrize("s", ["true", "True", "TRUE", "yes", "1", "on"])
+def test_to_bool_true_strings(s):
+    assert to_bool(s) is True
+
+
+@pytest.mark.parametrize("s", ["false", "False", "no", "0", "off", ""])
+def test_to_bool_false_strings(s):
+    assert to_bool(s) is False
+
+
+def test_to_bool_numbers():
+    assert to_bool(1) is True
+    assert to_bool(0) is False
+    assert to_bool(2.5) is True
+
+
+def test_to_bool_garbage_raises():
+    with pytest.raises(ValueError):
+        to_bool("maybe")
+
+
+def test_to_array():
+    assert to_array("a,b,c") == ["a", "b", "c"]
+    assert to_array(" a , b ") == ["a", "b"]
+    assert to_array("") == []
+    assert to_array(None) == []
+    assert to_array(["x", 1]) == ["x", "1"]
+
+
+def test_to_int():
+    assert to_int("42") == 42
+    assert to_int(7) == 7
+    with pytest.raises(ValueError):
+        to_int("nope")

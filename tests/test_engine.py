@@ -1,0 +1,116 @@
+"""Engine tests on the 8-device virtual CPU mesh: mesh specs, sharded
+train step, benchmark smoke, graft entries."""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from kubeflow_tpu.models.resnet import resnet18ish
+from kubeflow_tpu.parallel.mesh import (
+    MeshSpec,
+    batch_sharding,
+    build_mesh,
+    fsdp_params_sharding,
+)
+from kubeflow_tpu.training.train import (
+    create_train_state,
+    make_train_step,
+    place_batch,
+    place_state,
+)
+
+
+def test_mesh_spec_wildcard(cpu_devices):
+    spec = MeshSpec(data=-1, fsdp=2).resolve(8)
+    assert spec.data == 4 and spec.fsdp == 2
+
+
+def test_mesh_spec_mismatch():
+    with pytest.raises(ValueError, match="devices"):
+        MeshSpec(data=3).resolve(8)
+    with pytest.raises(ValueError, match="one -1"):
+        MeshSpec(data=-1, fsdp=-1).resolve(8)
+
+
+def test_build_mesh_axes(cpu_devices):
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["tensor"] == 2
+    assert mesh.size == 8
+
+
+def test_fsdp_sharding_splits_large_weights(cpu_devices):
+    mesh = build_mesh(MeshSpec(data=2, fsdp=4))
+    params = {
+        "big": jnp.zeros((1024, 512)),
+        "small": jnp.zeros((3,)),
+    }
+    sh = fsdp_params_sharding(mesh, params, min_weight_size=1024)
+    assert "fsdp" in str(sh["big"].spec)
+    assert sh["small"].spec == jax.sharding.PartitionSpec()
+
+
+@pytest.fixture(scope="module")
+def trained():
+    mesh = build_mesh(MeshSpec(data=4, fsdp=2))
+    model = resnet18ish(num_classes=10)
+    tx = optax.sgd(0.1, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    state = create_train_state(model, tx, rng, jnp.zeros((1, 32, 32, 3), jnp.bfloat16))
+    state = place_state(mesh, state)
+    batch = place_batch(mesh, {
+        "inputs": jax.random.normal(rng, (16, 32, 32, 3), jnp.bfloat16),
+        "labels": jax.random.randint(rng, (16,), 0, 10),
+    })
+    step = make_train_step(mesh)
+    metrics_log = []
+    for _ in range(3):
+        state, metrics = step(state, batch)
+        metrics_log.append(jax.tree.map(float, metrics))
+    return state, metrics_log
+
+
+def test_train_step_runs_and_advances(trained):
+    state, metrics_log = trained
+    assert int(state.step) == 3
+    assert all(m["loss"] > 0 for m in metrics_log)
+
+
+def test_train_step_learns_on_fixed_batch(trained):
+    _, metrics_log = trained
+    # Same batch 3x: loss must strictly decrease (sanity that gradients flow).
+    losses = [m["loss"] for m in metrics_log]
+    assert losses[2] < losses[0]
+
+
+def test_batch_stats_update(trained):
+    state, _ = trained
+    # BN statistics must have moved off their init (mean 0 / var 1).
+    leaves = jax.tree.leaves(state.batch_stats)
+    assert any(float(jnp.abs(l).max()) > 1e-6 for l in leaves if l.ndim)
+
+
+def test_benchmark_smoke(cpu_devices):
+    from kubeflow_tpu.training.benchmark import BenchConfig, run_benchmark
+
+    result = run_benchmark(BenchConfig(
+        model="resnet-test", batch_size=16, steps=2, warmup_steps=1))
+    assert result["images_per_sec"] > 0
+    assert result["n_chips"] == 8
+    assert result["images_per_sec_per_chip"] * 8 == pytest.approx(
+        result["images_per_sec"])
+
+
+def test_graft_entry_single(cpu_devices):
+    import __graft_entry__ as graft
+
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 1000)
+
+
+def test_graft_dryrun_multichip(cpu_devices):
+    import __graft_entry__ as graft
+
+    graft.dryrun_multichip(8)

@@ -1,0 +1,97 @@
+"""K8s builder tests: shape, pruning, list wrapping."""
+
+from kubeflow_tpu.manifests import k8s
+
+
+def test_prune_drops_none_only():
+    # Empty containers are legitimate K8s values (emptyDir: {}, data: {})
+    # and must survive; only None means "absent".
+    assert k8s._prune({"a": None, "b": {}, "c": [], "d": 0, "e": False}) == {
+        "b": {},
+        "c": [],
+        "d": 0,
+        "e": False,
+    }
+
+
+def test_empty_dir_volume_survives_prune():
+    spec = k8s.pod_spec([k8s.container("c", "i")],
+                        volumes=[k8s.volume("scratch", empty_dir=True)])
+    assert spec["volumes"][0] == {"name": "scratch", "emptyDir": {}}
+
+
+def test_env_var_requires_value():
+    import pytest
+
+    with pytest.raises(ValueError, match="FOO"):
+        k8s.env_var("FOO")
+    assert k8s.env_var("FOO", "") == {"name": "FOO", "value": ""}
+
+
+def test_deployment_shape():
+    c = k8s.container("web", "img:1", ports=[k8s.port(80)])
+    d = k8s.deployment("web", "ns", k8s.pod_spec([c]), replicas=3)
+    assert d["kind"] == "Deployment"
+    assert d["apiVersion"] == "apps/v1"
+    assert d["spec"]["replicas"] == 3
+    assert d["spec"]["selector"]["matchLabels"] == {"app": "web"}
+    assert d["spec"]["template"]["metadata"]["labels"] == {"app": "web"}
+    tpl = d["spec"]["template"]["spec"]
+    assert tpl["containers"][0]["image"] == "img:1"
+    assert "volumes" not in tpl
+
+
+def test_service_with_annotations():
+    s = k8s.service(
+        "svc", "ns", {"app": "svc"},
+        [k8s.service_port(9000, name="grpc"), k8s.service_port(8000, name="http")],
+        annotations={"getambassador.io/config": "x"},
+    )
+    assert s["metadata"]["annotations"]["getambassador.io/config"] == "x"
+    assert len(s["spec"]["ports"]) == 2
+    assert "type" not in s["spec"]
+
+
+def test_crd_v1_shape():
+    c = k8s.crd("tpujobs.kubeflow.org", "kubeflow.org", "v1alpha1", "TPUJob",
+                "tpujobs", short_names=["tpj"])
+    assert c["apiVersion"] == "apiextensions.k8s.io/v1"
+    v = c["spec"]["versions"][0]
+    assert v["served"] and v["storage"]
+    assert v["schema"]["openAPIV3Schema"]["type"] == "object"
+    assert c["spec"]["names"]["shortNames"] == ["tpj"]
+
+
+def test_ambassador_mapping_render():
+    m = k8s.ambassador_mapping(
+        "m-http", "/models/m/", "m.ns:8000", method="POST",
+        rewrite="/model/m:predict",
+    )
+    assert "kind: Mapping" in m
+    assert "prefix: /models/m/" in m
+    assert "rewrite: /model/m:predict" in m
+    assert m.rstrip().endswith("service: m.ns:8000")
+
+
+def test_rbac_builders():
+    cr = k8s.cluster_role("r", [k8s.policy_rule([""], ["pods"], ["get", "list"])])
+    crb = k8s.cluster_role_binding("rb", "r", [k8s.subject("ServiceAccount", "sa", "ns")])
+    assert cr["rules"][0]["resources"] == ["pods"]
+    assert crb["roleRef"]["name"] == "r"
+    assert crb["subjects"][0]["namespace"] == "ns"
+
+
+def test_k8s_list():
+    lst = k8s.k8s_list([k8s.namespace_obj("a"), None])
+    assert lst["kind"] == "List"
+    assert len(lst["items"]) == 1
+
+
+def test_env_var_forms():
+    assert k8s.env_var("A", 1) == {"name": "A", "value": "1"}
+    assert k8s.env_var("B", field_path="metadata.name")["valueFrom"]["fieldRef"] == {
+        "fieldPath": "metadata.name"
+    }
+    assert k8s.env_var("C", secret="s", secret_key="k")["valueFrom"]["secretKeyRef"] == {
+        "name": "s", "key": "k"
+    }

@@ -1,0 +1,193 @@
+"""Manifest component tests — the golden/assertion tier (reference:
+``testing/test_jsonnet.py`` + ``kubeflow/core/tests/util_test.jsonnet``)."""
+
+import yaml
+import pytest
+
+from kubeflow_tpu.manifests import k8s
+from kubeflow_tpu.params import get_prototype, list_prototypes
+
+# Minimal valid overrides for prototypes with required params.
+OVERRIDES = {
+    "tpu-job": {"name": "myjob"},
+    "tpu-cnn": {"name": "mycnnjob"},
+    "tpu-serving": {"name": "inception", "model_path": "gs://bucket/model"},
+    "cert-manager": {"acme_email": "a@b.com"},
+    "iap-envoy": {"audiences": "aud1,aud2"},
+    "iap-ingress": {"ip_name": "my-ip", "hostname": "kf.example.com"},
+    "seldon-serve-simple": {"name": "m", "image": "img:1"},
+    "nfs": {"disks": "disk1,disk2"},
+    "spartakus": {"report_usage": "true"},
+}
+
+
+def test_registry_has_all_components():
+    names = {p.name for p in list_prototypes()}
+    expected = {
+        "kubeflow-core", "tpujob-operator", "tpu-job", "tpu-cnn",
+        "tpu-serving", "jupyterhub", "ambassador", "iap-envoy",
+        "iap-ingress", "cert-manager", "nfs", "spartakus", "argo",
+        "seldon", "seldon-serve-simple",
+    }
+    assert expected <= names, expected - names
+
+
+@pytest.mark.parametrize("proto", [p.name for p in list_prototypes()])
+def test_every_prototype_builds_valid_objects(proto):
+    objs = get_prototype(proto).build(OVERRIDES.get(proto, {}))
+    for obj in objs:
+        assert obj.get("apiVersion"), f"{proto}: missing apiVersion in {obj}"
+        assert obj.get("kind"), f"{proto}: missing kind"
+        assert obj.get("metadata", {}).get("name"), f"{proto}: missing name"
+    # Whole list round-trips through YAML (the apply boundary).
+    yaml.safe_load_all(yaml.safe_dump_all(objs))
+
+
+def test_core_aggregates_subcomponents():
+    objs = get_prototype("kubeflow-core").build({})
+    kinds = {(o["kind"], o["metadata"]["name"]) for o in objs}
+    assert ("StatefulSet", "tpu-hub") in kinds
+    assert ("CustomResourceDefinition", "tpujobs.kubeflow.org") in kinds
+    assert ("Deployment", "tpujob-operator") in kinds
+    assert ("Deployment", "ambassador") in kinds
+    # spartakus off by default; nfs off without disks
+    assert not any(n == "spartakus-volunteer" for _, n in kinds)
+    assert not any(k == "StorageClass" for k, _ in kinds)
+
+
+def test_spartakus_gating():
+    assert get_prototype("spartakus").build({}) == []
+    objs = get_prototype("spartakus").build({"report_usage": "true",
+                                             "usage_id": "c1"})
+    deploy = [o for o in objs if o["kind"] == "Deployment"][0]
+    args = deploy["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--cluster-id=c1" in args
+
+
+def test_nfs_per_disk_objects():
+    objs = get_prototype("nfs").build({"disks": "d1,d2"})
+    sc = [o for o in objs if o["kind"] == "StorageClass"]
+    assert {o["metadata"]["name"] for o in sc} == {"nfs-d1", "nfs-d2"}
+    # Each disk: StorageClass + PVC + Service + Deployment, plus 4 RBAC objs.
+    assert len(objs) == 4 + 8
+
+
+def test_tpujob_cr_shape():
+    objs = get_prototype("tpu-job").build({"name": "j1", "num_tpu_workers": 2})
+    job = objs[0]
+    assert job["kind"] == "TPUJob"
+    specs = job["spec"]["replicaSpecs"]
+    types = [s["tpuReplicaType"] for s in specs]
+    assert types == ["COORDINATOR", "TPU_WORKER"]
+    worker = specs[1]
+    container = worker["template"]["spec"]["containers"][0]
+    assert container["resources"]["limits"]["google.com/tpu"] == "4"
+    sel = worker["template"]["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+    assert job["spec"]["terminationPolicy"]["chief"]["replicaName"] == "COORDINATOR"
+    assert job["spec"]["recoveryPolicy"] == "restart-slice"
+
+
+def test_tpu_cnn_validation_and_chief():
+    with pytest.raises(ValueError, match="num_tpu_workers"):
+        get_prototype("tpu-cnn").build({"name": "x", "num_tpu_workers": 0})
+    objs = get_prototype("tpu-cnn").build({"name": "x", "model": "resnet50",
+                                           "batch_size": 256})
+    job = objs[0]
+    assert job["spec"]["terminationPolicy"]["chief"]["replicaName"] == "TPU_WORKER"
+    args = job["spec"]["replicaSpecs"][0]["template"]["spec"]["containers"][0]["args"]
+    assert "--model=resnet50" in args and "--batch_size=256" in args
+
+
+def test_tpujob_zero_cuda_invariant():
+    """North star: no nvidia.com/gpu or CUDA image anywhere."""
+    rendered = yaml.safe_dump_all(
+        get_prototype("kubeflow-core").build({})
+        + get_prototype("tpu-cnn").build({"name": "b"})
+        + get_prototype("tpu-serving").build(
+            {"name": "m", "model_path": "gs://b/m", "tpu_chips": "1"})
+    )
+    assert "nvidia.com/gpu" not in rendered
+    assert "cuda" not in rendered.lower()
+
+
+def test_serving_mixins_and_routes():
+    proto = get_prototype("tpu-serving")
+    base = {"name": "inception", "model_path": "gs://b/m"}
+    dep, svc = proto.build(base)
+    containers = dep["spec"]["template"]["spec"]["containers"]
+    assert len(containers) == 2  # server + http proxy
+    assert dep["spec"]["template"]["spec"]["securityContext"]["runAsUser"] == 1000
+    ann = svc["metadata"]["annotations"]["getambassador.io/config"]
+    assert "prefix: /models/inception/" in ann
+    assert "rewrite: /model/inception:predict" in ann
+
+    # S3 mixin
+    dep_s3, _ = proto.build({**base, "s3_enable": "true",
+                             "s3_secret_name": "s3cred"})
+    env_names = [e["name"] for e in
+                 dep_s3["spec"]["template"]["spec"]["containers"][0]["env"]]
+    assert "AWS_ACCESS_KEY_ID" in env_names and "S3_ENDPOINT" in env_names
+
+    # GCP mixin
+    dep_gcp, _ = proto.build({**base, "cloud": "gcp",
+                              "gcp_credential_secret_name": "gcp-sa"})
+    tpl = dep_gcp["spec"]["template"]["spec"]
+    assert any(v.get("secret", {}).get("secretName") == "gcp-sa"
+               for v in tpl["volumes"])
+    env_names = [e["name"] for e in tpl["containers"][0]["env"]]
+    assert "GOOGLE_APPLICATION_CREDENTIALS" in env_names
+
+    # TPU chips → google.com/tpu limits, no proxy when disabled
+    dep_tpu, _ = proto.build({**base, "tpu_chips": "4", "http_proxy": "false"})
+    tpl = dep_tpu["spec"]["template"]["spec"]
+    assert len(tpl["containers"]) == 1
+    assert tpl["containers"][0]["resources"]["limits"]["google.com/tpu"] == "4"
+    assert "cloud.google.com/gke-tpu-accelerator" in tpl["nodeSelector"]
+
+
+def test_envoy_config_valid_and_routed():
+    from kubeflow_tpu.manifests.iap import envoy_config
+
+    cfg = yaml.safe_load(envoy_config("kubeflow", ["aud1"], False))
+    listener = cfg["static_resources"]["listeners"][0]
+    hcm = listener["filter_chains"][0]["filters"][0]["typed_config"]
+    routes = hcm["route_config"]["virtual_hosts"][0]["routes"]
+    prefixes = [r["match"]["prefix"] for r in routes]
+    assert prefixes == ["/healthz", "/hub", "/user", "/whoami", "/"]
+    filters = [f["name"] for f in hcm["http_filters"]]
+    assert filters == ["envoy.filters.http.jwt_authn",
+                       "envoy.filters.http.router"]
+    jwt = hcm["http_filters"][0]["typed_config"]
+    assert jwt["providers"]["iap"]["audiences"] == ["aud1"]
+    assert jwt["providers"]["iap"]["from_headers"][0]["name"] == \
+        "x-goog-iap-jwt-assertion"
+
+    # JWT disabled → filter dropped, router remains
+    cfg = yaml.safe_load(envoy_config("kubeflow", ["a"], True))
+    hcm = cfg["static_resources"]["listeners"][0]["filter_chains"][0][
+        "filters"][0]["typed_config"]
+    assert [f["name"] for f in hcm["http_filters"]] == \
+        ["envoy.filters.http.router"]
+
+
+def test_jupyterhub_config_assembly():
+    objs = get_prototype("jupyterhub").build(
+        {"jupyter_hub_authenticator": "iap"})
+    cm = [o for o in objs if o["kind"] == "ConfigMap"][0]
+    config = cm["data"]["jupyterhub_config.py"]
+    assert "TPUFormSpawner" in config
+    assert "RemoteUserAuthenticator" in config
+    assert "google.com/tpu" in config
+    # dummy authenticator variant
+    objs = get_prototype("jupyterhub").build({})
+    cm = [o for o in objs if o["kind"] == "ConfigMap"][0]
+    assert "DummyAuthenticator" in cm["data"]["jupyterhub_config.py"]
+
+
+def test_ui_routes_via_ambassador():
+    objs = get_prototype("tpujob-operator").build({})
+    svc = [o for o in objs if o["kind"] == "Service"
+           and o["metadata"]["name"] == "tpujob-dashboard"][0]
+    ann = svc["metadata"]["annotations"]["getambassador.io/config"]
+    assert "prefix: /tpujobs/ui/" in ann

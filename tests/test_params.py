@@ -1,0 +1,72 @@
+"""Param system tests: overlay precedence, coercion, required params."""
+
+import pytest
+
+from kubeflow_tpu.params import Param, ParamSet, REQUIRED
+
+
+def specs():
+    return [
+        Param("name", REQUIRED, "string", "component name"),
+        Param("replicas", 1, "int"),
+        Param("report_usage", "false", "bool"),
+        Param("disks", "", "array"),
+    ]
+
+
+def test_defaults_resolve():
+    ps = ParamSet(specs()).overlay({"name": "x"})
+    out = ps.resolve()
+    assert out == {"name": "x", "replicas": 1, "report_usage": False, "disks": []}
+
+
+def test_missing_required_raises():
+    with pytest.raises(ValueError, match="name"):
+        ParamSet(specs()).resolve()
+
+
+def test_overlay_precedence():
+    ps = (
+        ParamSet(specs())
+        .overlay({"name": "x", "replicas": "2"})
+        .overlay({"replicas": "3"})
+    )
+    assert ps.resolve()["replicas"] == 3
+
+
+def test_string_coercion_at_boundary():
+    out = (
+        ParamSet(specs())
+        .overlay({"name": "x", "report_usage": "true", "disks": "d1,d2"})
+        .resolve()
+    )
+    assert out["report_usage"] is True
+    assert out["disks"] == ["d1", "d2"]
+
+
+def test_unknown_param_rejected():
+    with pytest.raises(KeyError, match="bogus"):
+        ParamSet(specs()).overlay({"bogus": 1})
+
+
+def test_duplicate_param_rejected():
+    with pytest.raises(ValueError, match="duplicate"):
+        ParamSet([Param("a", 1, "int"), Param("a", 2, "int")])
+
+
+def test_none_overlay_cannot_bypass_required():
+    with pytest.raises(ValueError, match="name"):
+        ParamSet(specs()).overlay({"name": None}).resolve()
+
+
+def test_nullable_param_allows_none():
+    ps = ParamSet([Param("opt", None, "string")])
+    assert ps.resolve()["opt"] is None
+    assert ps.overlay({"opt": None}).resolve()["opt"] is None
+
+
+def test_overlay_immutable():
+    base = ParamSet(specs())
+    base.overlay({"name": "x"})
+    with pytest.raises(ValueError):
+        base.resolve()  # original unchanged, still missing required
