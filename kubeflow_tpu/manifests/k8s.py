@@ -82,6 +82,7 @@ def container(
     security_context: Optional[Obj] = None,
     liveness_probe: Optional[Obj] = None,
     readiness_probe: Optional[Obj] = None,
+    startup_probe: Optional[Obj] = None,
     image_pull_policy: Optional[str] = None,
 ) -> Obj:
     return _prune(
@@ -98,6 +99,7 @@ def container(
             "securityContext": security_context,
             "livenessProbe": liveness_probe,
             "readinessProbe": readiness_probe,
+            "startupProbe": startup_probe,
             "imagePullPolicy": image_pull_policy,
         }
     )
@@ -470,13 +472,15 @@ def ingress(name: str, namespace: str, *, backend_service: str,
 
 
 def http_get_probe(path: str, port_: Any, *, initial_delay: int = 30,
-                   period: int = 30, timeout: Optional[int] = None) -> Obj:
+                   period: int = 30, timeout: Optional[int] = None,
+                   failure_threshold: Optional[int] = None) -> Obj:
     return _prune(
         {
             "httpGet": {"path": path, "port": port_},
             "initialDelaySeconds": initial_delay,
             "periodSeconds": period,
             "timeoutSeconds": timeout,
+            "failureThreshold": failure_threshold,
         }
     )
 

@@ -43,6 +43,20 @@ def server_container(p: Dict[str, Any]) -> Dict[str, Any]:
             f"--model_base_path={p['model_path']}",
         ],
         ports=[k8s.port(9000, "serve")],
+        # Model load + first XLA compile takes tens of seconds to
+        # minutes. The server opens its port immediately and /healthz
+        # answers 503 until every model has a loaded version, so:
+        # readiness (/healthz) gates traffic on actual model
+        # availability; liveness (/livez) only checks the process;
+        # the startup probe gives slow gs:// loads a 10-minute budget
+        # before liveness can kill anything. (The reference set no
+        # probes at all — observed warmup 502s motivated these.)
+        readiness_probe=k8s.http_get_probe("/healthz", 9000,
+                                           initial_delay=5, period=5),
+        liveness_probe=k8s.http_get_probe("/livez", 9000,
+                                          initial_delay=0, period=30),
+        startup_probe=k8s.http_get_probe("/livez", 9000, initial_delay=0,
+                                         period=10, failure_threshold=60),
         resources=k8s.resources(
             cpu_request="1", memory_request="1Gi",
             cpu_limit="4", memory_limit="4Gi",

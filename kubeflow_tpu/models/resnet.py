@@ -113,7 +113,7 @@ def resnet101(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
     return ResNet(stage_sizes=(3, 4, 23, 3), num_classes=num_classes, dtype=dtype)
 
 
-def resnet18ish(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
+def resnet18ish(num_classes: int = 10, dtype: Any = jnp.bfloat16) -> ResNet:
     """Small bottleneck net for tests/CI (not a literal ResNet-18)."""
     return ResNet(stage_sizes=(1, 1, 1, 1), num_classes=num_classes,
                   width=16, dtype=dtype)

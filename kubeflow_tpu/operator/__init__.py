@@ -1,0 +1,22 @@
+"""TPUJob operator — the tf-operator replacement.
+
+A level-triggered reconciler over TPUJob custom resources (CRD in
+kubeflow_tpu.manifests.tpujob). Core differences from the reference's
+parameter-server controller (external Go tf-operator, reference
+``kubeflow/core/tf-job.libsonnet:31-95``):
+
+- **Gang semantics**: a TPU_WORKER replica set is a pod slice that is
+  created, restarted, and torn down as one unit (decision kernel in
+  C++, native/kft_runtime.cc kft_gang_decide).
+- **Bootstrap env**: pods get ``KFT_COORDINATOR_ADDRESS`` /
+  ``KFT_NUM_PROCESSES`` / ``KFT_PROCESS_ID`` (+ ``TPU_WORKER_*``) for
+  ``jax.distributed.initialize`` instead of ``TF_CONFIG``.
+- **Recovery**: ``restart-slice`` restarts the whole gang (from the
+  job's checkpoint dir) instead of individual pod restarts.
+- **Hermetic testing**: a fake apiserver (kubeflow_tpu.operator.fake)
+  — the layer the reference never had (its operator was only tested
+  against a live GKE cluster, SURVEY §4).
+"""
+
+from kubeflow_tpu.operator.reconciler import Reconciler  # noqa: F401
+from kubeflow_tpu.operator.fake import FakeApiServer  # noqa: F401

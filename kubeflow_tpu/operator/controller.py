@@ -1,0 +1,122 @@
+"""Controller loop: drives the Reconciler against an apiserver.
+
+Two client flavors: the in-memory fake (tests) and a kubectl-backed
+shim (real clusters; the environment ships no kubernetes python
+client — kubectl is the portable surface, and `kft apply` already
+uses it). The loop is deliberately level-triggered polling: TPU jobs
+are long-running and gang transitions are coarse, so a short resync
+period is simpler and more robust than a watch cache.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import subprocess
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from kubeflow_tpu.manifests.tpujob import KIND, PLURAL, GROUP
+from kubeflow_tpu.operator.fake import NotFound
+from kubeflow_tpu.operator.reconciler import Reconciler
+
+logger = logging.getLogger(__name__)
+
+
+class KubectlClient:
+    """Apiserver access via the kubectl CLI (same interface as
+    FakeApiServer's store surface)."""
+
+    def _run(self, *args: str, input_data: Optional[str] = None) -> str:
+        proc = subprocess.run(
+            ["kubectl", *args], capture_output=True, text=True,
+            input=input_data)
+        if proc.returncode != 0:
+            if "NotFound" in proc.stderr or "not found" in proc.stderr:
+                raise NotFound(proc.stderr.strip())
+            raise RuntimeError(f"kubectl {' '.join(args)}: {proc.stderr}")
+        return proc.stdout
+
+    @staticmethod
+    def _resource(kind: str) -> str:
+        return f"{PLURAL}.{GROUP}" if kind == KIND else kind.lower() + "s"
+
+    def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        out = self._run("create", "-f", "-", "-o", "json",
+                        input_data=json.dumps(obj))
+        return json.loads(out)
+
+    def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
+        out = self._run("get", self._resource(kind), name, "-n", namespace,
+                        "-o", "json")
+        return json.loads(out)
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None
+             ) -> List[Dict[str, Any]]:
+        args = ["get", self._resource(kind), "-o", "json"]
+        args += ["-n", namespace] if namespace else ["--all-namespaces"]
+        if label_selector:
+            args += ["-l", ",".join(f"{k}={v}"
+                                    for k, v in label_selector.items())]
+        return json.loads(self._run(*args)).get("items", [])
+
+    def patch(self, kind: str, namespace: str, name: str,
+              mutate: Callable[[Dict[str, Any]], None]) -> Dict[str, Any]:
+        obj = self.get(kind, namespace, name)
+        mutate(obj)
+        sub = ["--subresource=status"] if kind == KIND else []
+        out = self._run("replace", *sub, "-f", "-", "-o", "json",
+                        input_data=json.dumps(obj))
+        return json.loads(out)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._run("delete", self._resource(kind), name, "-n", namespace,
+                  "--wait=false")
+
+
+def run_controller(api, *, resync_seconds: float = 5.0,
+                   namespace: Optional[str] = None,
+                   max_iterations: Optional[int] = None) -> None:
+    reconciler = Reconciler(api)
+    iteration = 0
+    while max_iterations is None or iteration < max_iterations:
+        iteration += 1
+        try:
+            jobs = api.list(KIND, namespace)
+        except Exception:  # noqa: BLE001
+            logger.exception("listing TPUJobs failed")
+            jobs = []
+        for job in jobs:
+            try:
+                reconciler.reconcile(job)
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "reconcile failed for %s/%s",
+                    job["metadata"].get("namespace"),
+                    job["metadata"]["name"])
+        if max_iterations is None or iteration < max_iterations:
+            time.sleep(resync_seconds)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tpujob-operator")
+    parser.add_argument("--namespace", default=None)
+    parser.add_argument("--resync-seconds", type=float, default=5.0)
+    parser.add_argument("--controller-config-file", default=None)
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(levelname)s|%(asctime)s|%(pathname)s|%(lineno)d| %(message)s",
+        datefmt="%Y-%m-%dT%H:%M:%S",
+    )
+    if args.controller_config_file:
+        logger.info("controller config: %s", args.controller_config_file)
+    run_controller(KubectlClient(), resync_seconds=args.resync_seconds,
+                   namespace=args.namespace)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

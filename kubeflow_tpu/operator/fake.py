@@ -1,0 +1,105 @@
+"""A minimal in-memory apiserver for hermetic operator tests.
+
+Implements just the object-store surface the reconciler needs
+(create/get/list/patch/delete keyed by (kind, namespace, name)), plus
+test helpers to drive pod phase transitions. This is the fake layer
+SURVEY §4 calls out as missing from the reference.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+
+class Conflict(Exception):
+    pass
+
+
+class NotFound(Exception):
+    pass
+
+
+class FakeApiServer:
+    def __init__(self):
+        self._objects: Dict[Key, Dict[str, Any]] = {}
+        self._lock = threading.RLock()
+        self._revision = 0
+
+    @staticmethod
+    def _key(obj: Dict[str, Any]) -> Key:
+        meta = obj.get("metadata", {})
+        return (obj["kind"], meta.get("namespace", "default"), meta["name"])
+
+    def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            key = self._key(obj)
+            if key in self._objects:
+                raise Conflict(f"{key} already exists")
+            stored = copy.deepcopy(obj)
+            self._revision += 1
+            stored.setdefault("metadata", {})["resourceVersion"] = str(
+                self._revision)
+            self._objects[key] = stored
+            return copy.deepcopy(stored)
+
+    def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
+        with self._lock:
+            try:
+                return copy.deepcopy(self._objects[(kind, namespace, name)])
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name}") from None
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None
+             ) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in sorted(self._objects.items()):
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector:
+                    labels = obj.get("metadata", {}).get("labels", {})
+                    if any(labels.get(lk) != lv
+                           for lk, lv in label_selector.items()):
+                        continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def patch(self, kind: str, namespace: str, name: str,
+              mutate: Callable[[Dict[str, Any]], None]) -> Dict[str, Any]:
+        """Apply a mutation function under the store lock."""
+        with self._lock:
+            key = (kind, namespace, name)
+            if key not in self._objects:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            obj = self._objects[key]
+            mutate(obj)
+            self._revision += 1
+            obj["metadata"]["resourceVersion"] = str(self._revision)
+            return copy.deepcopy(obj)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            key = (kind, namespace, name)
+            if key not in self._objects:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            del self._objects[key]
+
+    # -- test helpers -----------------------------------------------------
+
+    def set_pod_phase(self, namespace: str, name: str, phase: str) -> None:
+        self.patch("Pod", namespace, name,
+                   lambda o: o.setdefault("status", {}).update(
+                       {"phase": phase}))
+
+    def set_all_pod_phases(self, namespace: str, phase: str,
+                           label_selector: Optional[Dict[str, str]] = None
+                           ) -> None:
+        for pod in self.list("Pod", namespace, label_selector):
+            self.set_pod_phase(namespace, pod["metadata"]["name"], phase)

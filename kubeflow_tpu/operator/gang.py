@@ -1,0 +1,74 @@
+"""Gang decision kernel binding (C++ kft_gang_decide via ctypes)."""
+
+from __future__ import annotations
+
+import ctypes
+import enum
+from typing import Optional, Sequence
+
+from kubeflow_tpu.serving._native import _LIB  # shared runtime library
+
+
+class PodPhase(enum.IntEnum):
+    MISSING = 0
+    PENDING = 1
+    RUNNING = 2
+    SUCCEEDED = 3
+    FAILED = 4
+
+    @staticmethod
+    def from_k8s(phase: Optional[str]) -> "PodPhase":
+        return {
+            None: PodPhase.MISSING,
+            "Pending": PodPhase.PENDING,
+            "Running": PodPhase.RUNNING,
+            "Succeeded": PodPhase.SUCCEEDED,
+            "Failed": PodPhase.FAILED,
+            # Unknown node → treat as failed: the slice collective is
+            # broken either way.
+            "Unknown": PodPhase.FAILED,
+        }[phase]
+
+
+class Decision(enum.IntEnum):
+    NONE = 0
+    CREATE_MISSING = 1
+    RESTART_SLICE = 2
+    SUCCEED = 3
+    FAIL = 4
+
+
+if _LIB is not None:
+    _LIB.kft_gang_decide.restype = ctypes.c_int
+    _LIB.kft_gang_decide.argtypes = [
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+
+
+def decide(phases: Sequence[PodPhase], chief_index: int, *,
+           allow_restart: bool, restarts: int,
+           max_restarts: int) -> Decision:
+    """Native gang decision; Python mirror if the .so isn't built."""
+    if _LIB is not None:
+        arr = (ctypes.c_int * len(phases))(*[int(p) for p in phases])
+        return Decision(_LIB.kft_gang_decide(
+            arr, len(phases), chief_index, int(allow_restart), restarts,
+            max_restarts))
+    # Pure-Python mirror of native/kft_runtime.cc kft_gang_decide.
+    if not phases or not (0 <= chief_index < len(phases)):
+        return Decision.FAIL
+    if phases[chief_index] == PodPhase.SUCCEEDED:
+        return Decision.SUCCEED
+    any_failed = any(
+        p == PodPhase.FAILED
+        or (i != chief_index and p == PodPhase.SUCCEEDED)
+        for i, p in enumerate(phases)
+    )
+    if any_failed:
+        if allow_restart and restarts < max_restarts:
+            return Decision.RESTART_SLICE
+        return Decision.FAIL
+    if any(p == PodPhase.MISSING for p in phases):
+        return Decision.CREATE_MISSING
+    return Decision.NONE

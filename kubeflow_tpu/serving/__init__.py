@@ -1,0 +1,21 @@
+"""TPU model serving (tensorflow_model_server + http-proxy replacement).
+
+Layering (parity with reference ``kubeflow/tf-serving`` +
+``components/k8s-model-server``):
+
+- :mod:`signature` / :mod:`export` — the on-disk model format:
+  versioned directories ``<base>/<N>/`` holding a signature map and
+  serialized params (the SavedModel role).
+- :mod:`model` — loads one version onto TPU and builds the jitted,
+  batch-bucketed predict function (XLA compile once per bucket).
+- :mod:`manager` — version watcher (hot reload of new ``<N>/`` dirs)
+  and the native micro-batching queue (C++ via ctypes,
+  native/kft_runtime.cc).
+- :mod:`server` — the model-server process on :9000 (HTTP/JSON; the
+  reference's was gRPC — this environment has no grpc, and the wire
+  protocol is an implementation detail behind the proxy).
+- :mod:`http_proxy` — REST proxy on :8000 with the reference's route
+  grammar ``/model/<name>[:predict|:classify]`` and b64 handling
+  (reference ``components/k8s-model-server/http-proxy/server.py``).
+- :mod:`client` — demo predict client (reference inception-client).
+"""

@@ -1,0 +1,53 @@
+"""Demo predict client (reference inception-client label.py parity).
+
+Reference: ``components/k8s-model-server/inception-client/label.py``
+built a gRPC PredictRequest with a 10s timeout (``:40-56``); this
+client POSTs the same logical request to the REST surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+import urllib.request
+
+
+def predict(server: str, model: str, instances, *, classify: bool = False,
+            timeout: float = 10.0) -> dict:
+    verb = "classify" if classify else "predict"
+    req = urllib.request.Request(
+        f"http://{server}/model/{model}:{verb}",
+        data=json.dumps({"instances": instances}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kft-predict")
+    parser.add_argument("--server", default="localhost:8000")
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--input_path", help="raw input file sent as b64")
+    parser.add_argument("--json_path", help="JSON file with instances")
+    parser.add_argument("--classify", action="store_true")
+    args = parser.parse_args(argv)
+    if args.json_path:
+        instances = json.load(open(args.json_path))["instances"]
+    elif args.input_path:
+        data = open(args.input_path, "rb").read()
+        instances = [{"b64": base64.b64encode(data).decode()}]
+    else:
+        parser.error("need --input_path or --json_path")
+    result = predict(args.server, args.model, instances,
+                     classify=args.classify)
+    json.dump(result, sys.stdout, indent=2)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

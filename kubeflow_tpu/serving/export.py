@@ -1,0 +1,63 @@
+"""Export a model to a versioned serving directory.
+
+Produces the on-disk layout TF-Serving consumed from model_base_path
+(versioned numeric dirs, reference ``kubeflow/tf-serving/
+tf-serving.libsonnet:110``; layout shown in
+``components/k8s-model-server/README.md:95-105``):
+
+    <base_path>/<version>/signature.json
+    <base_path>/<version>/params.msgpack
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from flax import serialization
+
+from kubeflow_tpu.serving.signature import ModelMetadata
+
+SIGNATURE_FILE = "signature.json"
+PARAMS_FILE = "params.msgpack"
+
+
+def export_model(
+    base_path: str,
+    version: int,
+    metadata: ModelMetadata,
+    variables: Dict[str, Any],
+) -> Path:
+    """Atomically write one model version dir (write to temp, rename —
+    the watcher must never see a half-written version)."""
+    base = Path(base_path)
+    base.mkdir(parents=True, exist_ok=True)
+    final = base / str(version)
+    if final.exists():
+        raise FileExistsError(f"version dir {final} already exists")
+    tmp = Path(tempfile.mkdtemp(dir=base, prefix=f".tmp-{version}-"))
+    try:
+        (tmp / SIGNATURE_FILE).write_text(metadata.dumps())
+        (tmp / PARAMS_FILE).write_bytes(serialization.to_bytes(variables))
+        os.rename(tmp, final)
+    except BaseException:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return final
+
+
+def read_metadata(version_dir: str) -> ModelMetadata:
+    return ModelMetadata.loads(
+        (Path(version_dir) / SIGNATURE_FILE).read_text())
+
+
+def read_variables(version_dir: str, template: Dict[str, Any]) -> Dict[str, Any]:
+    """Deserialize params against a template pytree (flax msgpack needs
+    the structure; the template comes from model.init on zeros)."""
+    data = (Path(version_dir) / PARAMS_FILE).read_bytes()
+    return serialization.from_bytes(template, data)

@@ -1,0 +1,183 @@
+"""REST→model-server proxy (:8000) — the http-proxy replacement.
+
+Route grammar and behaviors are parity with the reference proxy
+(``components/k8s-model-server/http-proxy/server.py``):
+
+- ``POST /model/<name>:predict`` and ``:classify``, with optional
+  ``/version/<v>`` (reference ``:270-283``).
+- Payload ``{"instances": [...]}``; ``{"b64": "..."}`` leaves are
+  base64-decoded before tensor conversion (reference ``:110-119``).
+- The model's signature map is fetched once and cached (reference
+  GetModelMetadata caching ``:121-160,202-203``).
+- Responses zip output tensors into ``{"predictions": [{...}]}``
+  (reference ``:233-236``).
+
+Async end-to-end on tornado, like the original (``:83-106``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+import tornado.httpclient
+import tornado.ioloop
+import tornado.web
+
+logger = logging.getLogger(__name__)
+
+
+def decode_b64_if_needed(value: Any) -> Any:
+    """Recursively decode {"b64": ...} leaves (parity reference
+    ``:110-119``, incl. idempotence on already-decoded data)."""
+    if isinstance(value, dict):
+        if set(value.keys()) == {"b64"}:
+            return base64.b64decode(value["b64"])
+        return {k: decode_b64_if_needed(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [decode_b64_if_needed(v) for v in value]
+    return value
+
+
+class ProxyHandler(tornado.web.RequestHandler):
+    @property
+    def rpc_address(self) -> str:
+        return self.application.settings["rpc_address"]
+
+    @property
+    def rpc_timeout(self) -> float:
+        return self.application.settings["rpc_timeout"]
+
+    @property
+    def _metadata_cache(self) -> Dict[str, Any]:
+        return self.application.settings["metadata_cache"]
+
+    async def get_signature_map(self, name: str) -> Dict[str, Any]:
+        if name not in self._metadata_cache:
+            client = tornado.httpclient.AsyncHTTPClient()
+            url = f"{self.rpc_address}/v1/models/{name}/metadata"
+            response = await client.fetch(url,
+                                          request_timeout=self.rpc_timeout)
+            self._metadata_cache[name] = json.loads(response.body)
+        return self._metadata_cache[name]
+
+    def write_json(self, payload: Dict[str, Any], status: int = 200) -> None:
+        self.set_status(status)
+        self.set_header("Content-Type", "application/json")
+        self.finish(json.dumps(payload))
+
+
+class InferProxyHandler(ProxyHandler):
+    async def _infer(self, name: str, version: Optional[str],
+                     verb: str) -> None:
+        try:
+            body = json.loads(self.request.body or b"{}")
+        except json.JSONDecodeError:
+            return self.write_json({"error": "request is not valid JSON"}, 400)
+        instances = body.get("instances")
+        if instances is None:
+            return self.write_json(
+                {"error": "request body needs 'instances'"}, 400)
+        try:
+            metadata = await self.get_signature_map(name)
+        except tornado.httpclient.HTTPClientError as e:
+            return self.write_json(
+                {"error": f"model metadata fetch failed: {e}"},
+                e.code if e.code else 502)
+        instances = decode_b64_if_needed(instances)
+        instances = _bytes_to_arrays(instances, metadata)
+        path = f"/v1/models/{name}"
+        if version:
+            path += f"/versions/{version}"
+        path += f":{verb}"
+        client = tornado.httpclient.AsyncHTTPClient()
+        try:
+            response = await client.fetch(
+                f"{self.rpc_address}{path}", method="POST",
+                body=json.dumps({
+                    "instances": instances,
+                    "signature_name": body.get("signature_name"),
+                }),
+                request_timeout=self.rpc_timeout,
+                raise_error=False)
+        except Exception as e:  # noqa: BLE001 — connection-level failure
+            return self.write_json({"error": f"model server unreachable: {e}"},
+                                   502)
+        payload = json.loads(response.body or b"{}")
+        if response.code != 200:
+            return self.write_json(payload, response.code)
+        self.write_json({"predictions": payload.get("predictions", [])})
+
+    async def post(self, name: str, version: Optional[str], verb: str):
+        await self._infer(name, version, verb)
+
+
+class MetadataProxyHandler(ProxyHandler):
+    async def get(self, name: str):
+        try:
+            metadata = await self.get_signature_map(name)
+        except tornado.httpclient.HTTPClientError as e:
+            return self.write_json({"error": str(e)},
+                                   e.code if e.code else 502)
+        self.write_json(metadata)
+
+
+def _bytes_to_arrays(instances: Any, metadata: Dict[str, Any]) -> Any:
+    """Convert raw-bytes leaves (from b64) into uint8 arrays where the
+    signature says so. The reference passed bytes straight into TF
+    string tensors (in-graph JPEG decode); JAX models take dense
+    arrays, so bytes are reinterpreted per the signature dtype/shape."""
+    sigs = metadata.get("metadata", {}).get("signatures", {})
+    default = sigs.get("serving_default", {})
+    input_specs = default.get("inputs", {})
+    spec = next(iter(input_specs.values()), None)
+
+    def convert(row: Any) -> Any:
+        if isinstance(row, dict):
+            return {k: convert(v) for k, v in row.items()}
+        if isinstance(row, bytes):
+            if spec is None:
+                raise ValueError("bytes input but model has no signature")
+            arr = np.frombuffer(row, dtype=np.uint8)
+            shape = [d for d in spec["shape"][1:]]
+            arr = arr.reshape(shape)
+            if spec["dtype"] != "uint8":
+                arr = arr.astype(spec["dtype"])
+            return arr.tolist()
+        return row
+
+    return [convert(r) for r in instances]
+
+
+def make_app(rpc_address: str, rpc_timeout: float = 10.0
+             ) -> tornado.web.Application:
+    return tornado.web.Application([
+        # Reference route grammar (server.py:270-283).
+        (r"/model/([^/:]+)(?:/version/(\d+))?:(predict|classify)",
+         InferProxyHandler),
+        (r"/model/([^/:]+)", MetadataProxyHandler),
+    ], rpc_address=rpc_address, rpc_timeout=rpc_timeout, metadata_cache={})
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kft-http-proxy")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--rpc_port", type=int, default=9000)
+    parser.add_argument("--rpc_address", default="localhost")
+    parser.add_argument("--rpc_timeout", type=float, default=10.0)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    app = make_app(f"http://{args.rpc_address}:{args.rpc_port}",
+                   args.rpc_timeout)
+    app.listen(args.port)
+    logger.info("http proxy on :%d → :%d", args.port, args.rpc_port)
+    tornado.ioloop.IOLoop.current().start()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,141 @@
+"""LoadedModel: one model version resident on device, jit-compiled.
+
+TPU-first serving design:
+- Predict compiles once per *batch bucket* (powers of two up to
+  max_batch): requests are padded to the bucket so XLA never sees a
+  dynamic batch dimension and the MXU always runs saturated shapes.
+- Params live on device in bfloat16-as-exported; inputs are cast per
+  the signature.
+- classify = predict + in-graph top-k (parity with the reference's
+  Classify surface, components/k8s-model-server/http-proxy/
+  server.py:239-262, but fused into the XLA program).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.registry import get_model
+from kubeflow_tpu.serving.export import read_metadata, read_variables
+from kubeflow_tpu.serving.signature import ModelMetadata, Signature
+
+_NP_DTYPES = {
+    "float32": np.float32,
+    "bfloat16": jnp.bfloat16,
+    "int32": np.int32,
+    "int64": np.int64,
+    "uint8": np.uint8,
+    "bool": np.bool_,
+}
+
+
+def _bucket(n: int, max_batch: int) -> int:
+    """Smallest power-of-two ≥ n, capped at max_batch."""
+    b = 1
+    while b < n and b < max_batch:
+        b *= 2
+    return min(b, max_batch)
+
+
+@dataclasses.dataclass
+class LoadedModel:
+    metadata: ModelMetadata
+    version: int
+    variables: Any
+    max_batch: int = 64
+    top_k: int = 5
+
+    def __post_init__(self):
+        entry = get_model(self.metadata.registry_name)
+        self._module = entry.make(**self.metadata.model_kwargs)
+        self._predict_cache: Dict[Tuple[str, int], Any] = {}
+
+    def signature(self, name: Optional[str] = None) -> Signature:
+        name = name or ModelMetadata.DEFAULT_SIGNATURE
+        try:
+            return self.metadata.signatures[name]
+        except KeyError:
+            raise KeyError(
+                f"model {self.metadata.model_name!r} has no signature "
+                f"{name!r}; available: {sorted(self.metadata.signatures)}"
+            ) from None
+
+    def _jitted(self, method: str, bucket: int):
+        key = (method, bucket)
+        if key not in self._predict_cache:
+            module = self._module
+
+            def predict(variables, x):
+                logits = module.apply(variables, x, train=False)
+                return {"logits": logits}
+
+            def classify(variables, x):
+                logits = module.apply(variables, x, train=False)
+                probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+                scores, classes = jax.lax.top_k(probs, self.top_k)
+                return {"classes": classes, "scores": scores}
+
+            fn = predict if method == "predict" else classify
+            self._predict_cache[key] = jax.jit(fn)
+        return self._predict_cache[key]
+
+    def _prepare(self, signature: Signature,
+                 inputs: Dict[str, np.ndarray]) -> Tuple[np.ndarray, int]:
+        (name, spec), = signature.inputs.items()  # single-input models
+        if name not in inputs:
+            raise ValueError(
+                f"missing input {name!r}; got {sorted(inputs)}")
+        x = np.asarray(inputs[name], dtype=_NP_DTYPES[spec.dtype])
+        expected = tuple(spec.shape[1:])
+        if x.shape[1:] != expected:
+            raise ValueError(
+                f"input {name!r} shape {x.shape[1:]} != signature {expected}")
+        return x, x.shape[0]
+
+    def run(self, inputs: Dict[str, np.ndarray],
+            signature_name: Optional[str] = None,
+            method: Optional[str] = None) -> Dict[str, np.ndarray]:
+        """Execute one (possibly already micro-batched) request batch."""
+        sig = self.signature(signature_name)
+        method = method or sig.method
+        x, n = self._prepare(sig, inputs)
+        if n == 0:
+            raise ValueError("empty batch")
+        if n > self.max_batch:
+            # Split oversized requests; concatenate results.
+            outs: List[Dict[str, np.ndarray]] = []
+            for i in range(0, n, self.max_batch):
+                outs.append(self.run(
+                    {next(iter(sig.inputs)): x[i:i + self.max_batch]},
+                    signature_name, method))
+            return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
+        bucket = _bucket(n, self.max_batch)
+        if n < bucket:
+            pad = np.zeros((bucket - n, *x.shape[1:]), dtype=x.dtype)
+            x = np.concatenate([x, pad])
+        out = self._jitted(method, bucket)(self.variables, x)
+        return {k: np.asarray(v)[:n] for k, v in out.items()}
+
+
+def load_version(version_dir: str, *, max_batch: int = 64,
+                 top_k: int = 5) -> LoadedModel:
+    metadata = read_metadata(version_dir)
+    entry = get_model(metadata.registry_name)
+    module = entry.make(**metadata.model_kwargs)
+    sig = metadata.signatures[ModelMetadata.DEFAULT_SIGNATURE]
+    (_, spec), = sig.inputs.items()
+    sample = jnp.zeros((1, *spec.shape[1:]), _NP_DTYPES[spec.dtype])
+    template = module.init(jax.random.PRNGKey(0), sample, train=False)
+    variables = read_variables(version_dir, template)
+    variables = jax.device_put(variables)
+    import os
+
+    version = int(os.path.basename(os.path.normpath(version_dir)))
+    return LoadedModel(metadata=metadata, version=version,
+                       variables=variables, max_batch=max_batch, top_k=top_k)

@@ -1,0 +1,204 @@
+"""The model-server process (:9000) — tensorflow_model_server's role.
+
+Reference: ``/usr/bin/tensorflow_model_server --port=9000
+--model_name=<n> --model_base_path=<p>`` (kubeflow/tf-serving/
+tf-serving.libsonnet:102-128), a C++ gRPC PredictionService. Here the
+native pieces are the batching queue + version watcher
+(native/kft_runtime.cc) and XLA executes the model; the transport is
+HTTP/JSON (this environment ships no grpc — the wire protocol is
+internal to the pod: the REST proxy on :8000 is the public surface,
+same as the reference).
+
+Endpoints (TF-Serving REST-compatible shapes):
+  GET  /v1/models/<name>                      → version status
+  GET  /v1/models/<name>/metadata             → signature map
+  POST /v1/models/<name>[/versions/<v>]:predict   {"instances": ...}
+  POST /v1/models/<name>[/versions/<v>]:classify  {"instances": ...}
+  GET  /healthz
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+import tornado.ioloop
+import tornado.web
+
+from kubeflow_tpu.serving.manager import ModelManager
+
+logger = logging.getLogger(__name__)
+
+
+def _json_default(obj: Any):
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    raise TypeError(f"not JSON serializable: {type(obj)}")
+
+
+class BaseHandler(tornado.web.RequestHandler):
+    @property
+    def manager(self) -> ModelManager:
+        return self.application.settings["manager"]
+
+    def write_json(self, payload: Dict[str, Any], status: int = 200) -> None:
+        self.set_status(status)
+        self.set_header("Content-Type", "application/json")
+        self.finish(json.dumps(payload, default=_json_default))
+
+    def write_error(self, status_code: int, **kwargs) -> None:
+        exc = kwargs.get("exc_info", (None, None, None))[1]
+        message = str(exc) if exc else self._reason
+        self.finish(json.dumps({"error": message}))
+
+
+class HealthHandler(BaseHandler):
+    """Readiness: 200 only once every model has a loaded version, so
+    k8s doesn't route traffic during the (slow) first model load."""
+
+    def get(self):
+        if self.manager.ready():
+            self.write_json({"status": "ok"})
+        else:
+            self.write_json({"status": "loading"}, 503)
+
+
+class LiveHandler(BaseHandler):
+    """Liveness: 200 whenever the process serves HTTP at all."""
+
+    def get(self):
+        self.write_json({"status": "alive"})
+
+
+class StatusHandler(BaseHandler):
+    def get(self, name: str):
+        try:
+            model = self.manager.get_model(name)
+        except KeyError as e:
+            return self.write_json({"error": e.args[0]}, 404)
+        self.write_json({
+            "model_version_status": [
+                {"version": str(v),
+                 "state": "AVAILABLE",
+                 "status": {"error_code": "OK"}}
+                for v in model.versions
+            ]
+        })
+
+
+class MetadataHandler(BaseHandler):
+    def get(self, name: str):
+        try:
+            loaded = self.manager.get_model(name).get()
+        except KeyError as e:
+            return self.write_json({"error": e.args[0]}, 404)
+        self.write_json({
+            "model_spec": {"name": name, "version": str(loaded.version)},
+            "metadata": loaded.metadata.to_json(),
+        })
+
+
+class InferHandler(BaseHandler):
+    async def post(self, name: str, version: Optional[str], verb: str):
+        try:
+            model = self.manager.get_model(name)
+            body = json.loads(self.request.body or b"{}")
+            instances = body.get("instances")
+            if instances is None:
+                return self.write_json(
+                    {"error": "request body needs 'instances'"}, 400)
+            loaded = model.get(int(version) if version else None)
+            sig_name = body.get("signature_name")
+            sig = loaded.signature(sig_name)
+            input_name = next(iter(sig.inputs))
+            batch = _instances_to_batch(instances, input_name)
+            future = model.submit({input_name: batch}, sig_name, verb,
+                                  int(version) if version else None)
+            # Block a pool thread, not the IO loop, while the batcher runs.
+            result = await tornado.ioloop.IOLoop.current().run_in_executor(
+                None, future.result, 30.0)
+            self.write_json({"model_spec": {"name": name,
+                                            "version": str(loaded.version)},
+                             "predictions": _batch_to_instances(result)})
+        except KeyError as e:
+            self.write_json({"error": e.args[0]}, 404)
+        except (ValueError, RuntimeError) as e:
+            self.write_json({"error": str(e)}, 400)
+
+
+def _instances_to_batch(instances: Any, input_name: str) -> np.ndarray:
+    """TF-Serving 'row format': instances is a list of rows, each either
+    a bare tensor or {input_name: tensor}."""
+    if not isinstance(instances, list) or not instances:
+        raise ValueError("'instances' must be a non-empty list")
+    rows = []
+    for row in instances:
+        if isinstance(row, dict):
+            if input_name not in row:
+                raise ValueError(
+                    f"instance missing input {input_name!r}")
+            rows.append(row[input_name])
+        else:
+            rows.append(row)
+    return np.asarray(rows)
+
+
+def _batch_to_instances(outputs: Dict[str, np.ndarray]) -> list:
+    """Zip output dict-of-batches into a list of per-row dicts (parity:
+    the proxy's response shaping, reference server.py:233-236)."""
+    keys = sorted(outputs)
+    n = len(outputs[keys[0]])
+    return [
+        {k: outputs[k][i] for k in keys}
+        for i in range(n)
+    ]
+
+
+def make_app(manager: ModelManager) -> tornado.web.Application:
+    return tornado.web.Application([
+        (r"/healthz", HealthHandler),
+        (r"/livez", LiveHandler),
+        (r"/v1/models/([^/:]+)", StatusHandler),
+        (r"/v1/models/([^/:]+)/metadata", MetadataHandler),
+        (r"/v1/models/([^/:]+)(?:/versions/(\d+))?:(predict|classify)",
+         InferHandler),
+    ], manager=manager)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kft-model-server")
+    parser.add_argument("--port", type=int, default=9000)
+    parser.add_argument("--model_name", required=True)
+    parser.add_argument("--model_base_path", required=True)
+    parser.add_argument("--max_batch", type=int, default=64)
+    parser.add_argument("--poll_interval", type=float, default=5.0)
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(levelname)s|%(asctime)s|%(pathname)s|%(lineno)d| %(message)s",
+        datefmt="%Y-%m-%dT%H:%M:%S",
+    )
+    manager = ModelManager(poll_interval_s=args.poll_interval)
+    # Defer the (slow) first model load to the poll thread: the port
+    # opens immediately and /healthz answers 503 until loaded, so
+    # kubelet probes see a live-but-not-ready pod instead of a dead one.
+    manager.add_model(args.model_name, args.model_base_path,
+                      max_batch=args.max_batch, initial_poll=False)
+    app = make_app(manager)
+    app.listen(args.port)
+    logger.info("model server listening on :%d (model=%s base=%s)",
+                args.port, args.model_name, args.model_base_path)
+    manager.start()
+    tornado.ioloop.IOLoop.current().start()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,101 @@
+"""Model signatures — the typed request/response contract.
+
+Plays the role of TF SavedModel SignatureDefs, which the reference's
+proxy fetched over gRPC GetModelMetadata and cached
+(``components/k8s-model-server/http-proxy/server.py:121-160``). A
+signature names its inputs/outputs with dtype + shape (batch dim = -1)
+and a method (predict | classify).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+DTYPES = {"float32", "bfloat16", "int32", "int64", "uint8", "bool"}
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSpec:
+    dtype: str
+    shape: Tuple[int, ...]  # -1 for the batch dimension
+
+    def __post_init__(self):
+        if self.dtype not in DTYPES:
+            raise ValueError(f"unsupported dtype {self.dtype!r}")
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"dtype": self.dtype, "shape": list(self.shape)}
+
+    @staticmethod
+    def from_json(obj: Dict[str, Any]) -> "TensorSpec":
+        return TensorSpec(obj["dtype"], tuple(obj["shape"]))
+
+
+@dataclasses.dataclass(frozen=True)
+class Signature:
+    method: str  # "predict" | "classify"
+    inputs: Dict[str, TensorSpec]
+    outputs: Dict[str, TensorSpec]
+
+    def __post_init__(self):
+        if self.method not in ("predict", "classify"):
+            raise ValueError(f"unsupported method {self.method!r}")
+        if not self.inputs:
+            raise ValueError("signature needs at least one input")
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "method": self.method,
+            "inputs": {k: v.to_json() for k, v in self.inputs.items()},
+            "outputs": {k: v.to_json() for k, v in self.outputs.items()},
+        }
+
+    @staticmethod
+    def from_json(obj: Dict[str, Any]) -> "Signature":
+        return Signature(
+            method=obj["method"],
+            inputs={k: TensorSpec.from_json(v) for k, v in obj["inputs"].items()},
+            outputs={k: TensorSpec.from_json(v) for k, v in obj["outputs"].items()},
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelMetadata:
+    """The signature.json file at the root of a model version dir."""
+
+    model_name: str
+    registry_name: str  # kubeflow_tpu.models registry key
+    signatures: Dict[str, Signature]
+    model_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    classes: Optional[List[str]] = None  # label vocabulary for classify
+
+    DEFAULT_SIGNATURE = "serving_default"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "model_name": self.model_name,
+            "registry_name": self.registry_name,
+            "signatures": {k: s.to_json() for k, s in self.signatures.items()},
+            "model_kwargs": self.model_kwargs,
+            "classes": self.classes,
+        }
+
+    @staticmethod
+    def from_json(obj: Dict[str, Any]) -> "ModelMetadata":
+        return ModelMetadata(
+            model_name=obj["model_name"],
+            registry_name=obj["registry_name"],
+            signatures={k: Signature.from_json(s)
+                        for k, s in obj["signatures"].items()},
+            model_kwargs=obj.get("model_kwargs", {}),
+            classes=obj.get("classes"),
+        )
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_json(), indent=2)
+
+    @staticmethod
+    def loads(text: str) -> "ModelMetadata":
+        return ModelMetadata.from_json(json.loads(text))

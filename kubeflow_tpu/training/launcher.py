@@ -1,0 +1,123 @@
+"""Pod entrypoint: distributed bootstrap + user-program supervision.
+
+Replaces two reference pieces:
+
+- ``tf-controller-examples/tf-cnn/launcher.py``: parsed the operator's
+  injected ``TF_CONFIG`` into PS-architecture flags (``:64-77``),
+  streamed the child's stdout to logs (``:29-54``), and slept forever
+  after success so the operator wouldn't restart the pod (``:86-90``).
+- ``grpc_tensorflow_server.py`` (referenced at
+  ``kubeflow/core/tf-job.libsonnet:99``): the stock PS/worker server
+  for replicas without a user binary.
+
+TPU-native: the operator injects the ``jax.distributed`` bootstrap env
+instead of TF_CONFIG —
+
+  KFT_COORDINATOR_ADDRESS  host:port of process 0
+  KFT_NUM_PROCESSES        gang size
+  KFT_PROCESS_ID           this process's index
+  KFT_REPLICA_TYPE/_INDEX  replica identity (chief detection)
+
+``launch()`` initializes jax.distributed (the gRPC coordinator inside
+jax replaces the stock PS server entirely), then either runs the user
+command as a supervised subprocess or falls through to the benchmark
+(the "stock server" equivalent: every replica runs the same SPMD
+program).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+ENV_COORD = "KFT_COORDINATOR_ADDRESS"
+ENV_NPROC = "KFT_NUM_PROCESSES"
+ENV_PID = "KFT_PROCESS_ID"
+ENV_REPLICA_TYPE = "KFT_REPLICA_TYPE"
+ENV_REPLICA_INDEX = "KFT_REPLICA_INDEX"
+ENV_SLEEP = "KFT_SLEEP_ON_SUCCESS"
+
+
+def distributed_config(env=os.environ) -> Optional[dict]:
+    """The operator-injected gang description, or None (single host)."""
+    if ENV_COORD not in env:
+        return None
+    return {
+        "coordinator_address": env[ENV_COORD],
+        "num_processes": int(env.get(ENV_NPROC, "1")),
+        "process_id": int(env.get(ENV_PID, "0")),
+    }
+
+
+def initialize_distributed(env=os.environ) -> bool:
+    """jax.distributed.initialize from env; True if multi-process."""
+    config = distributed_config(env)
+    if config is None:
+        logger.info("single-process run (no %s)", ENV_COORD)
+        return False
+    if config["num_processes"] <= 1:
+        logger.info("single-process run (%s=1)", ENV_NPROC)
+        return False
+    import jax
+
+    logger.info("jax.distributed.initialize(%s, num_processes=%d, "
+                "process_id=%d)", config["coordinator_address"],
+                config["num_processes"], config["process_id"])
+    jax.distributed.initialize(
+        coordinator_address=config["coordinator_address"],
+        num_processes=config["num_processes"],
+        process_id=config["process_id"],
+    )
+    return True
+
+
+def run_and_stream(command: Sequence[str]) -> int:
+    """Run the user program, streaming its output into our logs
+    (parity: reference launcher.py:29-54)."""
+    logger.info("running: %s", " ".join(command))
+    process = subprocess.Popen(
+        list(command), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    assert process.stdout is not None
+    for line in process.stdout:
+        logger.info("%s", line.rstrip("\n"))
+    process.wait()
+    logger.info("command exited with %d", process.returncode)
+    return process.returncode
+
+
+def launch(argv: Optional[List[str]] = None, env=os.environ) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(levelname)s|%(asctime)s|%(pathname)s|%(lineno)d| %(message)s",
+        datefmt="%Y-%m-%dT%H:%M:%S",
+    )
+    argv = list(sys.argv[1:] if argv is None else argv)
+    initialize_distributed(env)
+    if argv:
+        rc = run_and_stream(argv)
+    else:
+        # No user binary → run the stock SPMD benchmark (the TPU
+        # analogue of the stock grpc_tensorflow_server).
+        from kubeflow_tpu.training.benchmark import main as bench_main
+
+        rc = bench_main([])
+    if rc == 0 and env.get(ENV_SLEEP, "").lower() in ("1", "true", "yes"):
+        # Parity escape hatch with the reference's sleep-forever-on-
+        # success (launcher.py:86-90) for operators that would restart
+        # completed pods. The kubeflow_tpu operator tracks completion
+        # via terminationPolicy, so this is off by default.
+        logger.info("success; sleeping forever (%s set)", ENV_SLEEP)
+        while True:
+            time.sleep(3600)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(launch())

@@ -1,0 +1,213 @@
+// kft_runtime — native runtime core for the TPU model server.
+//
+// The reference's serving engine was C++ (tensorflow_model_server,
+// built in components/k8s-model-server/images/Dockerfile.{cpu,gpu});
+// here the TPU compute path is XLA via JAX, and this library provides
+// the native server plumbing around it:
+//
+//   * an MPMC request queue with micro-batch pop (batching is the
+//     serving-throughput lever on TPU: the MXU wants batched matmuls,
+//     and the reference served one request per session-run),
+//   * a model-version directory scanner (parity with TF-Serving's
+//     version watcher over model_base_path, kubeflow/tf-serving/
+//     tf-serving.libsonnet:110 versioned dirs),
+//   * a monotonic clock helper for latency accounting.
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in the image).
+
+#include <algorithm>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+
+#include <dirent.h>
+#include <sys/stat.h>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Queue {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<uint64_t> items;
+  size_t capacity;
+  bool closed = false;
+};
+
+int64_t now_us() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             Clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kft_queue_create(int capacity) {
+  auto* q = new Queue();
+  q->capacity = capacity > 0 ? static_cast<size_t>(capacity) : 1024;
+  return q;
+}
+
+void kft_queue_destroy(void* handle) { delete static_cast<Queue*>(handle); }
+
+// Returns 0 on success, -1 if the queue is full (caller sheds load),
+// -2 if closed.
+int kft_queue_push(void* handle, uint64_t id) {
+  auto* q = static_cast<Queue*>(handle);
+  std::lock_guard<std::mutex> lock(q->mu);
+  if (q->closed) return -2;
+  if (q->items.size() >= q->capacity) return -1;
+  q->items.push_back(id);
+  q->cv.notify_one();
+  return 0;
+}
+
+void kft_queue_close(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  std::lock_guard<std::mutex> lock(q->mu);
+  q->closed = true;
+  q->cv.notify_all();
+}
+
+int kft_queue_size(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  std::lock_guard<std::mutex> lock(q->mu);
+  return static_cast<int>(q->items.size());
+}
+
+// Pop up to max_n ids as one micro-batch.
+//
+// Waits up to timeout_us for the first item; once one item is present,
+// waits at most window_us more (the batching window) for the batch to
+// fill, then returns whatever accumulated. Returns the count (possibly
+// 0 on timeout), or -2 if the queue was closed and drained.
+int kft_queue_pop_batch(void* handle, uint64_t* out, int max_n,
+                        int64_t timeout_us, int64_t window_us) {
+  auto* q = static_cast<Queue*>(handle);
+  std::unique_lock<std::mutex> lock(q->mu);
+  const auto deadline =
+      Clock::now() + std::chrono::microseconds(timeout_us);
+  while (q->items.empty()) {
+    if (q->closed) return -2;
+    if (q->cv.wait_until(lock, deadline) == std::cv_status::timeout &&
+        q->items.empty()) {
+      return q->closed ? -2 : 0;
+    }
+  }
+  if (window_us > 0 && static_cast<int>(q->items.size()) < max_n) {
+    const auto window_deadline =
+        Clock::now() + std::chrono::microseconds(window_us);
+    while (static_cast<int>(q->items.size()) < max_n && !q->closed) {
+      if (q->cv.wait_until(lock, window_deadline) ==
+          std::cv_status::timeout) {
+        break;
+      }
+    }
+  }
+  const int n = std::min<int>(max_n, static_cast<int>(q->items.size()));
+  for (int i = 0; i < n; ++i) {
+    out[i] = q->items.front();
+    q->items.pop_front();
+  }
+  return n;
+}
+
+// Scan a model base path for numeric version subdirectories and return
+// the highest version number, or -1 if none exist / the dir is
+// unreadable. Mirrors TF-Serving's filesystem version policy (serve
+// the latest version directory).
+int64_t kft_scan_latest_version(const char* base) {
+  DIR* dir = opendir(base);
+  if (dir == nullptr) return -1;
+  int64_t best = -1;
+  struct dirent* entry;
+  while ((entry = readdir(dir)) != nullptr) {
+    const char* name = entry->d_name;
+    if (name[0] == '\0' || name[0] == '.') continue;
+    char* end = nullptr;
+    errno = 0;
+    long long v = strtoll(name, &end, 10);
+    if (errno != 0 || end == name || *end != '\0' || v < 0) continue;
+    // Must be a directory.
+    std::string path = std::string(base) + "/" + name;
+    struct stat st;
+    if (stat(path.c_str(), &st) != 0 || !S_ISDIR(st.st_mode)) continue;
+    best = std::max<int64_t>(best, v);
+  }
+  closedir(dir);
+  return best;
+}
+
+int64_t kft_now_us() { return now_us(); }
+
+// ---------------------------------------------------------------------------
+// Gang scheduling state machine (TPUJob operator core).
+//
+// The reference's job controller was native (tf-operator, Go — external
+// image gcr.io/kubeflow-images-staging/tf_operator, kubeflow/core/
+// tf-job.libsonnet:31-95) and treated replicas independently: PS/worker
+// pods restart individually (restartPolicy OnFailure). A TPU pod slice
+// fails as a UNIT — losing any worker kills the ICI collective — so the
+// decision kernel is all-or-nothing: create the whole gang, restart the
+// whole gang from checkpoint, or finish. Kept native (a) for parity
+// with the reference's native controller core and (b) so the same .so
+// can back a future C++ controller binary.
+//
+// Pod phases:   0=missing 1=pending 2=running 3=succeeded 4=failed
+// Decisions:    0=none 1=create_missing 2=restart_slice 3=succeed 4=fail
+
+enum KftPhase : int {
+  KFT_MISSING = 0,
+  KFT_PENDING = 1,
+  KFT_RUNNING = 2,
+  KFT_SUCCEEDED = 3,
+  KFT_FAILED = 4,
+};
+
+enum KftDecision : int {
+  KFT_DECIDE_NONE = 0,
+  KFT_DECIDE_CREATE_MISSING = 1,
+  KFT_DECIDE_RESTART_SLICE = 2,
+  KFT_DECIDE_SUCCEED = 3,
+  KFT_DECIDE_FAIL = 4,
+};
+
+extern "C" int kft_gang_decide(const int* phases, int n, int chief_index,
+                               int allow_restart, int restarts,
+                               int max_restarts) {
+  if (phases == nullptr || n <= 0 || chief_index < 0 || chief_index >= n) {
+    return KFT_DECIDE_FAIL;
+  }
+  // Chief finishing defines job success (terminationPolicy parity,
+  // kubeflow/tf-job/tf-job.libsonnet:37-42) — checked first so a
+  // completed job never restarts (the reference's launcher had to
+  // sleep forever to dodge exactly that, launcher.py:86-90).
+  if (phases[chief_index] == KFT_SUCCEEDED) return KFT_DECIDE_SUCCEED;
+  bool any_failed = false;
+  bool any_missing = false;
+  for (int i = 0; i < n; ++i) {
+    if (phases[i] == KFT_FAILED) any_failed = true;
+    if (phases[i] == KFT_MISSING) any_missing = true;
+    // A non-chief replica exiting "successfully" while the chief is
+    // still alive counts as a slice fault too: the collective lost a
+    // participant either way.
+    if (i != chief_index && phases[i] == KFT_SUCCEEDED) any_failed = true;
+  }
+  if (any_failed) {
+    if (allow_restart && restarts < max_restarts) {
+      return KFT_DECIDE_RESTART_SLICE;
+    }
+    return KFT_DECIDE_FAIL;
+  }
+  if (any_missing) return KFT_DECIDE_CREATE_MISSING;
+  return KFT_DECIDE_NONE;
+}
+
+}  // extern "C"

@@ -1,0 +1,51 @@
+"""Launcher tests: env parsing, subprocess streaming, stock fallback."""
+
+import logging
+
+from kubeflow_tpu.training import launcher
+
+
+def test_distributed_config_absent():
+    assert launcher.distributed_config(env={}) is None
+
+
+def test_distributed_config_parsed():
+    env = {
+        launcher.ENV_COORD: "job-tpu-worker-0.job:8476",
+        launcher.ENV_NPROC: "4",
+        launcher.ENV_PID: "2",
+    }
+    cfg = launcher.distributed_config(env=env)
+    assert cfg == {
+        "coordinator_address": "job-tpu-worker-0.job:8476",
+        "num_processes": 4,
+        "process_id": 2,
+    }
+
+
+def test_initialize_single_process_noop():
+    assert launcher.initialize_distributed(env={}) is False
+    # num_processes=1 also short-circuits (no coordinator dial-out).
+    assert launcher.initialize_distributed(env={
+        launcher.ENV_COORD: "x:1", launcher.ENV_NPROC: "1",
+        launcher.ENV_PID: "0"}) is False
+
+
+def test_run_and_stream_logs_and_exit_code(caplog):
+    with caplog.at_level(logging.INFO):
+        rc = launcher.run_and_stream(
+            ["python", "-c", "print('hello-from-child'); print('line2')"])
+    assert rc == 0
+    messages = [r.message for r in caplog.records]
+    assert "hello-from-child" in messages
+    assert "line2" in messages
+
+
+def test_run_and_stream_nonzero_exit():
+    rc = launcher.run_and_stream(["python", "-c", "import sys; sys.exit(3)"])
+    assert rc == 3
+
+
+def test_launch_runs_user_command(monkeypatch):
+    rc = launcher.launch(["python", "-c", "pass"], env={})
+    assert rc == 0

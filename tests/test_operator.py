@@ -1,0 +1,198 @@
+"""Operator tests against the fake apiserver: gang creation, env
+injection, whole-slice restart, chief success, restart budget."""
+
+import pytest
+
+from kubeflow_tpu.manifests.tpujob import replica_spec, termination_policy, tpu_job
+from kubeflow_tpu.operator import FakeApiServer, Reconciler
+from kubeflow_tpu.operator.controller import run_controller
+from kubeflow_tpu.operator.gang import Decision, PodPhase, decide
+from kubeflow_tpu.operator.reconciler import JOB_LABEL
+
+
+def make_job(name="job1", workers=4, recovery="restart-slice",
+             coordinator=False):
+    specs = []
+    if coordinator:
+        specs.append(replica_spec("COORDINATOR", 1, image="img:1"))
+    specs.append(replica_spec(
+        "TPU_WORKER", workers, image="img:1",
+        tpu_accelerator="tpu-v5-lite-podslice", tpu_topology="2x4"))
+    chief = ("COORDINATOR", 0) if coordinator else ("TPU_WORKER", 0)
+    job = tpu_job(name, "default", specs,
+                  termination=termination_policy(*chief), recovery=recovery)
+    job["metadata"]["uid"] = "uid-1"
+    return job
+
+
+def submit(api, job):
+    api.create(job)
+    return api.get("TPUJob", "default", job["metadata"]["name"])
+
+
+# -- gang kernel ----------------------------------------------------------
+
+
+def test_gang_decide_native_create_and_none():
+    P = PodPhase
+    assert decide([P.MISSING] * 4, 0, allow_restart=True, restarts=0,
+                  max_restarts=3) == Decision.CREATE_MISSING
+    assert decide([P.RUNNING] * 4, 0, allow_restart=True, restarts=0,
+                  max_restarts=3) == Decision.NONE
+
+
+def test_gang_decide_chief_success_wins():
+    P = PodPhase
+    # chief done, another worker failed: success wins (job completed).
+    assert decide([P.SUCCEEDED, P.FAILED], 0, allow_restart=True,
+                  restarts=0, max_restarts=3) == Decision.SUCCEED
+
+
+def test_gang_decide_nonchief_success_is_fault():
+    P = PodPhase
+    # A non-chief exiting while chief still runs breaks the collective.
+    assert decide([P.RUNNING, P.SUCCEEDED], 0, allow_restart=True,
+                  restarts=0, max_restarts=3) == Decision.RESTART_SLICE
+
+
+def test_gang_decide_restart_budget():
+    P = PodPhase
+    assert decide([P.FAILED, P.RUNNING], 0, allow_restart=True,
+                  restarts=2, max_restarts=3) == Decision.RESTART_SLICE
+    assert decide([P.FAILED, P.RUNNING], 0, allow_restart=True,
+                  restarts=3, max_restarts=3) == Decision.FAIL
+    assert decide([P.FAILED, P.RUNNING], 0, allow_restart=False,
+                  restarts=0, max_restarts=3) == Decision.FAIL
+
+
+def test_gang_decide_degenerate():
+    assert decide([], 0, allow_restart=True, restarts=0,
+                  max_restarts=3) == Decision.FAIL
+
+
+# -- reconciler -----------------------------------------------------------
+
+
+def test_gang_created_atomically_with_env():
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=4))
+    r = Reconciler(api)
+    assert r.reconcile(job) == "Pending"
+
+    pods = api.list("Pod", "default", {JOB_LABEL: "job1"})
+    assert len(pods) == 4  # whole gang in one pass
+    svc = api.get("Service", "default", "job1")
+    assert svc["spec"]["clusterIP"] == "None"
+
+    pod0 = api.get("Pod", "default", "job1-tpu-worker-0")
+    env = {e["name"]: e["value"] for e in
+           pod0["spec"]["containers"][0]["env"]}
+    assert env["KFT_COORDINATOR_ADDRESS"] == \
+        "job1-tpu-worker-0.job1.default:8476"
+    assert env["KFT_NUM_PROCESSES"] == "4"
+    assert env["KFT_PROCESS_ID"] == "0"
+    assert env["TPU_WORKER_ID"] == "0"
+    assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 4
+    pod3 = api.get("Pod", "default", "job1-tpu-worker-3")
+    env3 = {e["name"]: e["value"] for e in
+            pod3["spec"]["containers"][0]["env"]}
+    assert env3["KFT_PROCESS_ID"] == "3"
+    assert env3["KFT_COORDINATOR_ADDRESS"] == env["KFT_COORDINATOR_ADDRESS"]
+    # kubelet must not restart gang members individually
+    assert pod0["spec"]["restartPolicy"] == "Never"
+    assert pod0["spec"]["subdomain"] == "job1"
+
+
+def test_running_then_chief_success_cleans_up():
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=2))
+    r = Reconciler(api)
+    r.reconcile(job)
+    api.set_all_pod_phases("default", "Running", {JOB_LABEL: "job1"})
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Running"
+
+    # all workers succeed together (SPMD program finished everywhere)
+    api.set_all_pod_phases("default", "Succeeded", {JOB_LABEL: "job1"})
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Succeeded"
+    # terminal: no further reconcile effects
+    assert r.reconcile(api.get("TPUJob", "default", "job1")) == "Succeeded"
+
+
+def test_slice_restart_on_worker_failure():
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=4))
+    r = Reconciler(api)
+    r.reconcile(job)
+    api.set_all_pod_phases("default", "Running", {JOB_LABEL: "job1"})
+    api.set_pod_phase("default", "job1-tpu-worker-2", "Failed")
+
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Restarting"
+    # ALL pods deleted, not just the failed one.
+    assert api.list("Pod", "default", {JOB_LABEL: "job1"}) == []
+    assert job["status"]["restartCount"] == 1
+
+    # next pass recreates the full gang
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Running"  # restartCount>0 ⇒ Running state
+    assert len(api.list("Pod", "default", {JOB_LABEL: "job1"})) == 4
+
+
+def test_restart_budget_exhaustion_fails_job():
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=2))
+    r = Reconciler(api, max_restarts=1)
+    r.reconcile(job)
+    api.set_pod_phase("default", "job1-tpu-worker-0", "Failed")
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Restarting"
+    job = api.get("TPUJob", "default", "job1")
+    r.reconcile(job)  # recreate
+    api.set_pod_phase("default", "job1-tpu-worker-1", "Failed")
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Failed"
+    assert "exhausted" in job["status"]["reason"]
+
+
+def test_recovery_none_fails_immediately():
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=2, recovery="none"))
+    r = Reconciler(api)
+    r.reconcile(job)
+    api.set_pod_phase("default", "job1-tpu-worker-0", "Failed")
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Failed"
+
+
+def test_coordinator_chief_and_controller_loop():
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=2, coordinator=True))
+    run_controller(api, max_iterations=1)
+    pods = api.list("Pod", "default", {JOB_LABEL: "job1"})
+    assert len(pods) == 3
+    coord = api.get("Pod", "default", "job1-coordinator-0")
+    env = {e["name"]: e["value"] for e in
+           coord["spec"]["containers"][0]["env"]}
+    # Coordinator is not a TPU process: it gets its own 1-process view.
+    assert env["KFT_NUM_PROCESSES"] == "1"
+    # chief = coordinator; its success ends the job
+    api.set_pod_phase("default", "job1-coordinator-0", "Succeeded")
+    run_controller(api, max_iterations=1)
+    assert api.get("TPUJob", "default", "job1")["status"]["phase"] == \
+        "Succeeded"
+
+
+def test_fake_apiserver_conflict_and_notfound():
+    from kubeflow_tpu.operator.fake import Conflict, NotFound
+
+    api = FakeApiServer()
+    api.create({"kind": "Pod", "metadata": {"name": "p", "namespace": "ns"}})
+    with pytest.raises(Conflict):
+        api.create({"kind": "Pod",
+                    "metadata": {"name": "p", "namespace": "ns"}})
+    with pytest.raises(NotFound):
+        api.get("Pod", "ns", "ghost")
+    with pytest.raises(NotFound):
+        api.delete("Pod", "ns", "ghost")

@@ -1,0 +1,327 @@
+"""Serving stack tests: native queue, export/load, batcher, server +
+proxy over real sockets (the reference's serving smoke test tier,
+testing/test_tf_serving.py, minus the GKE cluster)."""
+
+import base64
+import json
+import threading
+
+import numpy as np
+import pytest
+import tornado.httpclient
+import tornado.httpserver
+import tornado.ioloop
+import tornado.testing
+import tornado.web
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.serving import _native
+from kubeflow_tpu.serving.export import export_model
+from kubeflow_tpu.serving.manager import ModelManager, ServedModel
+from kubeflow_tpu.serving.model import load_version
+from kubeflow_tpu.serving.signature import (
+    ModelMetadata,
+    Signature,
+    TensorSpec,
+)
+
+
+def test_native_lib_loaded():
+    assert _native.native_available(), "libkft_runtime.so must be built"
+
+
+def test_queue_push_pop_batch():
+    q = _native.RequestQueue(capacity=8)
+    for i in range(5):
+        assert q.push(i)
+    batch = q.pop_batch(max_n=3, timeout_s=0.2, window_s=0.0)
+    assert batch == [0, 1, 2]
+    assert q.pop_batch(max_n=10, timeout_s=0.2, window_s=0.0) == [3, 4]
+    assert q.pop_batch(max_n=10, timeout_s=0.01, window_s=0.0) in ([], None)
+
+
+def test_queue_capacity_sheds():
+    q = _native.RequestQueue(capacity=2)
+    assert q.push(1) and q.push(2)
+    assert not q.push(3)
+
+
+def test_queue_close_unblocks():
+    q = _native.RequestQueue()
+    results = []
+
+    def consumer():
+        results.append(q.pop_batch(4, timeout_s=5.0))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    q.close()
+    t.join(timeout=2)
+    assert not t.is_alive()
+    assert results == [None]
+
+
+def test_scan_latest_version(tmp_path):
+    assert _native.scan_latest_version(str(tmp_path)) == -1
+    (tmp_path / "1").mkdir()
+    (tmp_path / "3").mkdir()
+    (tmp_path / "07").mkdir()
+    (tmp_path / "not-a-version").mkdir()
+    (tmp_path / "12abc").mkdir()
+    (tmp_path / "99").write_text("a file, not a dir")
+    assert _native.scan_latest_version(str(tmp_path)) == 7
+    assert _native.scan_latest_version(str(tmp_path / "missing")) == -1
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    """Export a small trained-ish model as version 1."""
+    base = tmp_path_factory.mktemp("models") / "testnet"
+    from kubeflow_tpu.models.resnet import resnet18ish
+
+    model = resnet18ish(num_classes=10)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3), jnp.bfloat16),
+                           train=False)
+    metadata = ModelMetadata(
+        model_name="testnet",
+        registry_name="resnet-test",
+        model_kwargs={"num_classes": 10},
+        signatures={"serving_default": Signature(
+            method="predict",
+            inputs={"images": TensorSpec("float32", (-1, 32, 32, 3))},
+            outputs={"logits": TensorSpec("float32", (-1, 10))},
+        )},
+    )
+    export_model(str(base), 1, metadata, variables)
+    return base
+
+
+def test_export_and_load(model_dir):
+    loaded = load_version(str(model_dir / "1"))
+    assert loaded.version == 1
+    out = loaded.run({"images": np.zeros((3, 32, 32, 3), np.float32)})
+    assert out["logits"].shape == (3, 10)
+
+
+def test_load_rejects_bad_input_shape(model_dir):
+    loaded = load_version(str(model_dir / "1"))
+    with pytest.raises(ValueError, match="shape"):
+        loaded.run({"images": np.zeros((2, 16, 16, 3), np.float32)})
+    with pytest.raises(ValueError, match="missing input"):
+        loaded.run({"wrong": np.zeros((2, 32, 32, 3), np.float32)})
+
+
+def test_classify_top_k(model_dir):
+    loaded = load_version(str(model_dir / "1"))
+    out = loaded.run({"images": np.random.rand(2, 32, 32, 3).astype(np.float32)},
+                     method="classify")
+    assert out["classes"].shape == (2, 5)
+    assert out["scores"].shape == (2, 5)
+    # scores sorted descending
+    assert (np.diff(out["scores"], axis=1) <= 1e-6).all()
+
+
+def test_served_model_batching(model_dir):
+    served = ServedModel("testnet", str(model_dir), max_batch=8)
+    assert served.poll_versions()
+    assert not served.poll_versions()  # no new version
+    futures = [
+        served.submit({"images": np.random.rand(1, 32, 32, 3)}, None, None, None)
+        for _ in range(6)
+    ]
+    results = [f.result(timeout=30) for f in futures]
+    for r in results:
+        assert r["logits"].shape == (1, 10)
+    served.stop()
+
+
+def test_hot_reload_new_version(model_dir):
+    from kubeflow_tpu.models.resnet import resnet18ish
+    from kubeflow_tpu.serving.export import read_metadata
+
+    served = ServedModel("testnet", str(model_dir))
+    served.poll_versions()
+    assert served.versions == [1]
+    # Export version 2 and poll again.
+    model = resnet18ish(num_classes=10)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 32, 32, 3), jnp.bfloat16),
+                           train=False)
+    if not (model_dir / "2").exists():
+        export_model(str(model_dir), 2, read_metadata(str(model_dir / "1")),
+                     variables)
+    assert served.poll_versions()
+    assert served.get().version == 2
+    assert served.get(1).version == 1  # previous stays resident
+    served.stop()
+
+
+class ServingEndToEnd(tornado.testing.AsyncHTTPTestCase):
+    """Server + proxy wired over real sockets."""
+
+    @classmethod
+    def setUpClass(cls):
+        super().setUpClass()
+
+    def get_app(self):
+        from kubeflow_tpu.serving.server import make_app
+
+        manager = ModelManager()
+        self.manager = manager
+        manager.add_model("testnet", str(type(self).base_path))
+        return make_app(manager)
+
+    def test_status_metadata_predict(self):
+        # status
+        resp = self.fetch("/v1/models/testnet")
+        assert resp.code == 200
+        status = json.loads(resp.body)
+        assert status["model_version_status"][0]["state"] == "AVAILABLE"
+        # metadata
+        resp = self.fetch("/v1/models/testnet/metadata")
+        meta = json.loads(resp.body)
+        assert meta["model_spec"]["name"] == "testnet"
+        assert "serving_default" in meta["metadata"]["signatures"]
+        # predict (row format, bare tensors)
+        rows = np.zeros((2, 32, 32, 3)).tolist()
+        resp = self.fetch("/v1/models/testnet:predict", method="POST",
+                          body=json.dumps({"instances": rows}))
+        assert resp.code == 200, resp.body
+        preds = json.loads(resp.body)["predictions"]
+        assert len(preds) == 2
+        assert len(preds[0]["logits"]) == 10
+        # named-input rows
+        resp = self.fetch("/v1/models/testnet:predict", method="POST",
+                          body=json.dumps(
+                              {"instances": [{"images": rows[0]}]}))
+        assert resp.code == 200
+        # classify
+        resp = self.fetch("/v1/models/testnet:classify", method="POST",
+                          body=json.dumps({"instances": rows}))
+        out = json.loads(resp.body)["predictions"]
+        assert len(out[0]["classes"]) == 5
+        # errors
+        resp = self.fetch("/v1/models/nope:predict", method="POST",
+                          body=json.dumps({"instances": rows}))
+        assert resp.code == 404
+        resp = self.fetch("/v1/models/testnet:predict", method="POST",
+                          body=json.dumps({}))
+        assert resp.code == 400
+
+    def tearDown(self):
+        self.manager.stop()
+        super().tearDown()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _attach_base_path(model_dir):
+    ServingEndToEnd.base_path = model_dir
+    ProxyEndToEnd.base_path = model_dir
+    HealthGating.base_path = model_dir
+
+
+class ProxyEndToEnd(tornado.testing.AsyncHTTPTestCase):
+    """Proxy in front of an in-process model server."""
+
+    def get_app(self):
+        from kubeflow_tpu.serving.http_proxy import make_app as proxy_app
+        from kubeflow_tpu.serving.server import make_app as server_app
+
+        self.manager = ModelManager()
+        self.manager.add_model("testnet", str(type(self).base_path))
+        backend = server_app(self.manager)
+        sock, port = tornado.testing.bind_unused_port()
+        self.backend_server = tornado.httpserver.HTTPServer(backend)
+        self.backend_server.add_sockets([sock])
+        return proxy_app(f"http://127.0.0.1:{port}")
+
+    def test_proxy_routes(self):
+        rows = np.zeros((2, 32, 32, 3)).tolist()
+        # reference grammar: /model/<name>:predict
+        resp = self.fetch("/model/testnet:predict", method="POST",
+                          body=json.dumps({"instances": rows}))
+        assert resp.code == 200, resp.body
+        preds = json.loads(resp.body)["predictions"]
+        assert len(preds) == 2
+        # metadata route + caching
+        resp = self.fetch("/model/testnet")
+        assert resp.code == 200
+        assert "signatures" in json.loads(resp.body)["metadata"]
+        # versioned route (the loaded = latest version; older versions
+        # only stay resident across a hot reload, TF-Serving-style)
+        latest = self.manager.get_model("testnet").get().version
+        resp = self.fetch(f"/model/testnet/version/{latest}:predict",
+                          method="POST",
+                          body=json.dumps({"instances": rows}))
+        assert resp.code == 200, resp.body
+        # requesting an unloaded version is a clean 404
+        resp = self.fetch("/model/testnet/version/777:predict",
+                          method="POST",
+                          body=json.dumps({"instances": rows}))
+        assert resp.code == 404
+        # b64 payload: raw uint8 image bytes
+        raw = np.zeros((32, 32, 3), np.uint8).tobytes()
+        inst = [{"b64": base64.b64encode(raw).decode()}]
+        resp = self.fetch("/model/testnet:predict", method="POST",
+                          body=json.dumps({"instances": inst}))
+        assert resp.code == 200, resp.body
+        # malformed JSON
+        resp = self.fetch("/model/testnet:predict", method="POST",
+                          body="{nope")
+        assert resp.code == 400
+        # unknown model propagates 404
+        resp = self.fetch("/model/ghost:predict", method="POST",
+                          body=json.dumps({"instances": rows}))
+        assert resp.code == 404
+
+    def tearDown(self):
+        self.manager.stop()
+        super().tearDown()
+
+
+class HealthGating(tornado.testing.AsyncHTTPTestCase):
+    """/healthz is 503 until the model loads; /livez is always 200."""
+
+    def get_app(self):
+        from kubeflow_tpu.serving.server import make_app
+        import tempfile
+
+        self.manager = ModelManager()
+        # Register against an empty base path with the initial load
+        # deferred — the k8s-probe-visible "still loading" state.
+        self.empty_dir = tempfile.mkdtemp()
+        self.manager.add_model("slow", self.empty_dir, initial_poll=False)
+        return make_app(self.manager)
+
+    def test_health_gating(self):
+        assert self.fetch("/livez").code == 200
+        resp = self.fetch("/healthz")
+        assert resp.code == 503
+        assert json.loads(resp.body)["status"] == "loading"
+        # Version appears → next poll flips readiness.
+        import shutil
+
+        shutil.copytree(str(type(self).base_path / "1"),
+                        f"{self.empty_dir}/1")
+        self.manager.get_model("slow").poll_versions()
+        assert self.fetch("/healthz").code == 200
+
+    def tearDown(self):
+        self.manager.stop()
+        super().tearDown()
+
+
+def test_decode_b64_idempotent():
+    """Parity: reference server_test.py b64 idempotence (:42-57)."""
+    from kubeflow_tpu.serving.http_proxy import decode_b64_if_needed
+
+    payload = {"a": {"b64": base64.b64encode(b"hello").decode()},
+               "b": [1, 2, {"b64": base64.b64encode(b"x").decode()}],
+               "c": "plain"}
+    decoded = decode_b64_if_needed(payload)
+    assert decoded == {"a": b"hello", "b": [1, 2, b"x"], "c": "plain"}
+    # idempotent on already-decoded data
+    assert decode_b64_if_needed(decoded) == decoded
