@@ -1,0 +1,172 @@
+"""BERT encoder (base/large) in Flax, TPU-first.
+
+BASELINE target model (multi-host pretraining step time; the reference
+itself ships no sequence models — SURVEY §5 "long-context: absent").
+
+TPU design notes:
+- bf16 activations, fp32 params; attention softmax statistics in fp32
+  (:mod:`kubeflow_tpu.ops.attention`).
+- Every kernel carries *logical* axis names via ``nn.with_partitioning``
+  so one model definition serves DP, FSDP, and Megatron TP — the rule
+  table (:mod:`kubeflow_tpu.parallel.tensor_parallel`) decides the
+  mesh mapping; GSPMD inserts the collectives.
+- Static shapes end-to-end: padding is masked arithmetically
+  (``attention_mask``), never sliced.
+- ``attention_fn`` hook: dense by default; pass a sequence-parallel
+  wrapper (:func:`kubeflow_tpu.parallel.ring_attention.
+  make_sequence_parallel_attention`) for long-context runs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.registry import ModelEntry, register_model
+from kubeflow_tpu.ops.attention import dense_attention
+
+AttentionFn = Callable[..., jax.Array]
+
+
+def _dense(features, axes, dtype, name=None, use_bias=True):
+    return nn.Dense(
+        features,
+        dtype=dtype,
+        use_bias=use_bias,
+        kernel_init=nn.with_partitioning(
+            nn.initializers.normal(0.02), axes
+        ),
+        name=name,
+    )
+
+
+class BertSelfAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, x, valid):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        proj = functools.partial(
+            _dense, dtype=self.dtype
+        )
+        q = proj(d_model, ("embed", "heads"), name="query")(x)
+        k = proj(d_model, ("embed", "heads"), name="key")(x)
+        v = proj(d_model, ("embed", "heads"), name="value")(x)
+        split = lambda t: t.reshape(
+            t.shape[0], t.shape[1], self.num_heads, head_dim
+        )
+        attn = self.attention_fn or functools.partial(
+            dense_attention, kv_segment_valid=valid
+        )
+        out = attn(split(q), split(k), split(v))
+        out = out.reshape(out.shape[0], out.shape[1], d_model)
+        return proj(d_model, ("heads", "embed"), name="out")(out)
+
+
+class BertLayer(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, x, valid):
+        # Post-LN (original BERT): residual → LayerNorm.
+        attn_out = BertSelfAttention(
+            self.num_heads, self.dtype, self.attention_fn, name="attention"
+        )(x, valid)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x + attn_out)
+        h = _dense(self.mlp_dim, ("embed", "mlp"), self.dtype, "mlp_in")(x)
+        h = nn.gelu(h, approximate=True)
+        h = _dense(x.shape[-1], ("mlp", "embed"), self.dtype, "mlp_out")(h)
+        return nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x + h)
+
+
+class Bert(nn.Module):
+    """BERT encoder + tied-embedding MLM head.
+
+    ``__call__(input_ids, type_ids, valid)`` → MLM logits
+    [batch, seq, vocab]. ``valid`` is the 0/1 attention mask.
+    """
+
+    vocab_size: int = 30522
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    num_segments: int = 2
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, input_ids, type_ids=None, valid=None, train=True):
+        del train  # no dropout in the pretraining benchmark config
+        b, l = input_ids.shape
+        if type_ids is None:
+            type_ids = jnp.zeros_like(input_ids)
+        if valid is None:
+            valid = jnp.ones_like(input_ids)
+
+        embed = nn.Embed(
+            self.vocab_size, self.d_model,
+            embedding_init=nn.with_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            dtype=self.dtype, name="tok_embed",
+        )
+        x = embed(input_ids)
+        x = x + nn.Embed(
+            self.max_len, self.d_model, dtype=self.dtype, name="pos_embed",
+            embedding_init=nn.initializers.normal(0.02),
+        )(jnp.arange(l)[None, :])
+        x = x + nn.Embed(
+            self.num_segments, self.d_model, dtype=self.dtype,
+            name="seg_embed",
+            embedding_init=nn.initializers.normal(0.02),
+        )(type_ids)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_embed")(x)
+
+        for i in range(self.num_layers):
+            x = BertLayer(
+                self.num_heads, self.mlp_dim, self.dtype,
+                self.attention_fn, name=f"layer_{i}",
+            )(x, valid)
+
+        # MLM head: transform + tied output embedding (fp32 logits).
+        h = _dense(self.d_model, (None, "embed"), self.dtype,
+                   "mlm_transform")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.LayerNorm(dtype=self.dtype, name="mlm_ln")(h)
+        logits = embed.attend(h.astype(jnp.float32))
+        logits = logits + self.param(
+            "mlm_bias", nn.initializers.zeros, (self.vocab_size,), jnp.float32
+        )
+        return logits
+
+
+def bert_base(**kw) -> Bert:
+    return Bert(**kw)
+
+
+def bert_large(**kw) -> Bert:
+    return Bert(num_layers=24, d_model=1024, num_heads=16, mlp_dim=4096, **kw)
+
+
+def bert_test(**kw) -> Bert:
+    """Tiny config for CI (2 layers, d=64)."""
+    kw.setdefault("vocab_size", 512)
+    kw.setdefault("max_len", 128)
+    return Bert(num_layers=2, d_model=64, num_heads=4, mlp_dim=128, **kw)
+
+
+register_model(ModelEntry("bert-base", "language", bert_base, ((128,), "int32"), 30522))
+register_model(ModelEntry("bert-large", "language", bert_large, ((128,), "int32"), 30522))
+register_model(ModelEntry("bert-test", "language", bert_test, ((64,), "int32"), 512))

@@ -1,0 +1,195 @@
+"""Inception-v3 in Flax, TPU-first.
+
+The reference's serving demo model: its golden E2E test runs gRPC
+Predict against an inception SavedModel and compares top-5
+classes/scores textproto byte-for-byte
+(``testing/test_tf_serving.py:104-108``, golden at
+``components/k8s-model-server/images/test-worker/result.txt``). This
+is the equivalent architecture for the TPU serving path — same input
+contract (299×299×3) and head — built NHWC/bf16 like
+:mod:`kubeflow_tpu.models.resnet` (weights are not ported; the golden
+mechanism, not the 2015 checkpoint, is the parity surface).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.registry import ModelEntry, register_model
+
+
+class ConvBN(nn.Module):
+    """conv → BN → relu (inception's BasicConv2d)."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(
+            self.features, self.kernel, self.strides,
+            padding=self.padding, use_bias=False, dtype=self.dtype,
+            name="conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-3,
+            dtype=self.dtype, name="bn",
+        )(x)
+        return nn.relu(x)
+
+
+def _pool(x, kind: str):
+    if kind == "max":
+        return nn.max_pool(x, (3, 3), (1, 1), "SAME")
+    return nn.avg_pool(x, (3, 3), (1, 1), "SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = conv(64, (1, 1), name="b1x1")(x, train)
+        b5 = conv(48, (1, 1), name="b5x5_1")(x, train)
+        b5 = conv(64, (5, 5), name="b5x5_2")(b5, train)
+        b3 = conv(64, (1, 1), name="b3x3dbl_1")(x, train)
+        b3 = conv(96, (3, 3), name="b3x3dbl_2")(b3, train)
+        b3 = conv(96, (3, 3), name="b3x3dbl_3")(b3, train)
+        bp = conv(self.pool_features, (1, 1), name="bpool")(
+            _pool(x, "avg"), train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35→17."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        b3 = conv(384, (3, 3), (2, 2), "VALID", name="b3x3")(x, train)
+        bd = conv(64, (1, 1), name="b3x3dbl_1")(x, train)
+        bd = conv(96, (3, 3), name="b3x3dbl_2")(bd, train)
+        bd = conv(96, (3, 3), (2, 2), "VALID", name="b3x3dbl_3")(bd, train)
+        bp = nn.max_pool(x, (3, 3), (2, 2), "VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7×7 branches."""
+
+    c7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        c7 = self.c7
+        b1 = conv(192, (1, 1), name="b1x1")(x, train)
+        b7 = conv(c7, (1, 1), name="b7x7_1")(x, train)
+        b7 = conv(c7, (1, 7), name="b7x7_2")(b7, train)
+        b7 = conv(192, (7, 1), name="b7x7_3")(b7, train)
+        bd = conv(c7, (1, 1), name="b7x7dbl_1")(x, train)
+        bd = conv(c7, (7, 1), name="b7x7dbl_2")(bd, train)
+        bd = conv(c7, (1, 7), name="b7x7dbl_3")(bd, train)
+        bd = conv(c7, (7, 1), name="b7x7dbl_4")(bd, train)
+        bd = conv(192, (1, 7), name="b7x7dbl_5")(bd, train)
+        bp = conv(192, (1, 1), name="bpool")(_pool(x, "avg"), train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17→8."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        b3 = conv(192, (1, 1), name="b3x3_1")(x, train)
+        b3 = conv(320, (3, 3), (2, 2), "VALID", name="b3x3_2")(b3, train)
+        b7 = conv(192, (1, 1), name="b7x7x3_1")(x, train)
+        b7 = conv(192, (1, 7), name="b7x7x3_2")(b7, train)
+        b7 = conv(192, (7, 1), name="b7x7x3_3")(b7, train)
+        b7 = conv(192, (3, 3), (2, 2), "VALID", name="b7x7x3_4")(b7, train)
+        bp = nn.max_pool(x, (3, 3), (2, 2), "VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded-filter-bank output blocks."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = conv(320, (1, 1), name="b1x1")(x, train)
+        b3 = conv(384, (1, 1), name="b3x3_1")(x, train)
+        b3 = jnp.concatenate([
+            conv(384, (1, 3), name="b3x3_2a")(b3, train),
+            conv(384, (3, 1), name="b3x3_2b")(b3, train),
+        ], axis=-1)
+        bd = conv(448, (1, 1), name="b3x3dbl_1")(x, train)
+        bd = conv(384, (3, 3), name="b3x3dbl_2")(bd, train)
+        bd = jnp.concatenate([
+            conv(384, (1, 3), name="b3x3dbl_3a")(bd, train),
+            conv(384, (3, 1), name="b3x3dbl_3b")(bd, train),
+        ], axis=-1)
+        bp = conv(192, (1, 1), name="bpool")(_pool(x, "avg"), train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Inception-v3 for NHWC image batches (299×299×3 canonical)."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = conv(32, (3, 3), (2, 2), "VALID", name="stem1")(x, train)
+        x = conv(32, (3, 3), padding="VALID", name="stem2")(x, train)
+        x = conv(64, (3, 3), name="stem3")(x, train)
+        x = nn.max_pool(x, (3, 3), (2, 2), "VALID")
+        x = conv(80, (1, 1), padding="VALID", name="stem4")(x, train)
+        x = conv(192, (3, 3), padding="VALID", name="stem5")(x, train)
+        x = nn.max_pool(x, (3, 3), (2, 2), "VALID")
+
+        for i, pool_features in enumerate((32, 64, 64)):
+            x = InceptionA(pool_features, self.dtype,
+                           name=f"mixed5{'bcd'[i]}")(x, train)
+        x = InceptionB(self.dtype, name="mixed6a")(x, train)
+        for i, c7 in enumerate((128, 160, 160, 192)):
+            x = InceptionC(c7, self.dtype,
+                           name=f"mixed6{'bcde'[i]}")(x, train)
+        x = InceptionD(self.dtype, name="mixed7a")(x, train)
+        x = InceptionE(self.dtype, name="mixed7b")(x, train)
+        x = InceptionE(self.dtype, name="mixed7c")(x, train)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(
+            x.astype(jnp.float32))
+        return x
+
+
+def inception_v3(num_classes: int = 1000, dtype: Any = jnp.bfloat16
+                 ) -> InceptionV3:
+    return InceptionV3(num_classes=num_classes, dtype=dtype)
+
+
+register_model(ModelEntry(
+    "inception-v3", "vision", inception_v3, ((299, 299, 3), "bfloat16"), 1000
+))

@@ -1,0 +1,194 @@
+"""Llama-family decoder (RMSNorm / RoPE / SwiGLU / GQA) in Flax, TPU-first.
+
+BASELINE stretch target (Llama-2-7B fine-tune on a v5e slice). The
+reference has no decoder models; this is greenfield, built on the same
+logical-axis TP vocabulary as BERT (``parallel/tensor_parallel.py``)
+and the fp32-statistics attention core (``ops/attention.py``).
+
+Long-context is first-class: ``attention_fn`` accepts a sequence-
+parallel wrapper (ring attention over the ``seq`` mesh axis,
+``parallel/ring_attention.py``), and the default path uses blockwise
+attention above ``blockwise_threshold`` tokens so single-chip memory
+stays O(L·block) instead of O(L²).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.registry import ModelEntry, register_model
+from kubeflow_tpu.ops.attention import blockwise_attention, dense_attention
+
+AttentionFn = Callable[..., jax.Array]
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # Variance in fp32 regardless of activation dtype.
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        normed = x * jax.lax.rsqrt(var + self.eps).astype(x.dtype)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        return normed * scale.astype(self.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float = 10000.0) -> jax.Array:
+    """Rotary embeddings for [B, L, H, D] (D even). fp32 trig."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # B,L,1,D/2
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rotated = jnp.stack(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).reshape(x.shape)
+    return rotated.astype(x.dtype)
+
+
+def _dense(features, axes, dtype, name=None):
+    return nn.Dense(
+        features, dtype=dtype, use_bias=False,
+        kernel_init=nn.with_partitioning(
+            nn.initializers.normal(0.02), axes
+        ),
+        name=name,
+    )
+
+
+class LlamaAttention(nn.Module):
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[AttentionFn] = None
+    blockwise_threshold: int = 2048
+
+    @nn.compact
+    def __call__(self, x, positions):
+        b, l, d_model = x.shape
+        q = _dense(self.num_heads * self.head_dim, ("embed", "heads"),
+                   self.dtype, "q_proj")(x)
+        k = _dense(self.num_kv_heads * self.head_dim, ("embed", "kv"),
+                   self.dtype, "k_proj")(x)
+        v = _dense(self.num_kv_heads * self.head_dim, ("embed", "kv"),
+                   self.dtype, "v_proj")(x)
+        q = q.reshape(b, l, self.num_heads, self.head_dim)
+        k = k.reshape(b, l, self.num_kv_heads, self.head_dim)
+        v = v.reshape(b, l, self.num_kv_heads, self.head_dim)
+        q = rope(q, positions, self.rope_theta)
+        k = rope(k, positions, self.rope_theta)
+        if self.attention_fn is not None:
+            out = self.attention_fn(q, k, v)
+        elif l >= self.blockwise_threshold:
+            out = blockwise_attention(q, k, v, causal=True)
+        else:
+            out = dense_attention(q, k, v, causal=True)
+        out = out.reshape(b, l, self.num_heads * self.head_dim)
+        return _dense(d_model, ("heads", "embed"), self.dtype, "o_proj")(out)
+
+
+class LlamaBlock(nn.Module):
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    mlp_dim: int
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        h = RMSNorm(dtype=self.dtype, name="attn_norm")(x)
+        x = x + LlamaAttention(
+            self.num_heads, self.num_kv_heads, self.head_dim,
+            self.rope_theta, self.dtype, self.attention_fn, name="attention",
+        )(h, positions)
+        h = RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
+        gate = _dense(self.mlp_dim, ("embed", "mlp"), self.dtype,
+                      "gate_proj")(h)
+        up = _dense(self.mlp_dim, ("embed", "mlp"), self.dtype, "up_proj")(h)
+        h = nn.silu(gate) * up
+        return x + _dense(x.shape[-1], ("mlp", "embed"), self.dtype,
+                          "down_proj")(h)
+
+
+class Llama(nn.Module):
+    """Decoder-only LM: ``__call__(input_ids)`` → logits [B, L, vocab]."""
+
+    vocab_size: int = 32000
+    num_layers: int = 32
+    d_model: int = 4096
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    mlp_dim: int = 11008
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[AttentionFn] = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, train=True):
+        del train
+        b, l = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(l)[None, :], (b, l))
+        x = nn.Embed(
+            self.vocab_size, self.d_model,
+            embedding_init=nn.with_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            dtype=self.dtype, name="tok_embed",
+        )(input_ids)
+        block_cls = LlamaBlock
+        if self.remat:
+            # Rematerialize each block on the backward pass: the
+            # FLOPs-for-HBM trade that makes 7B+ fit a v5e slice.
+            block_cls = nn.remat(LlamaBlock)
+        head_dim = self.d_model // self.num_heads
+        for i in range(self.num_layers):
+            x = block_cls(
+                self.num_heads, self.num_kv_heads, head_dim, self.mlp_dim,
+                self.rope_theta, self.dtype, self.attention_fn,
+                name=f"layer_{i}",
+            )(x, positions)
+        x = RMSNorm(dtype=self.dtype, name="final_norm")(x)
+        logits = _dense(self.vocab_size, ("embed", "vocab"), jnp.float32,
+                        "lm_head")(x.astype(jnp.float32))
+        return logits
+
+
+def llama2_7b(**kw) -> Llama:
+    return Llama(**kw)
+
+
+def llama2_13b(**kw) -> Llama:
+    return Llama(num_layers=40, d_model=5120, num_heads=40, num_kv_heads=40,
+                 mlp_dim=13824, **kw)
+
+
+def llama3_8b(**kw) -> Llama:
+    return Llama(vocab_size=128256, num_layers=32, d_model=4096,
+                 num_heads=32, num_kv_heads=8, mlp_dim=14336,
+                 rope_theta=500000.0, **kw)
+
+
+def llama_test(**kw) -> Llama:
+    """Tiny GQA config for CI."""
+    kw.setdefault("vocab_size", 512)
+    return Llama(num_layers=2, d_model=64, num_heads=4, num_kv_heads=2,
+                 mlp_dim=128, **kw)
+
+
+register_model(ModelEntry("llama2-7b", "language", llama2_7b, ((2048,), "int32"), 32000))
+register_model(ModelEntry("llama2-13b", "language", llama2_13b, ((2048,), "int32"), 32000))
+register_model(ModelEntry("llama3-8b", "language", llama3_8b, ((2048,), "int32"), 128256))
+register_model(ModelEntry("llama-test", "language", llama_test, ((128,), "int32"), 512))

@@ -188,12 +188,22 @@ class Reconciler:
 
         pods = {p["metadata"]["name"]: p
                 for p in self.api.list("Pod", ns, {JOB_LABEL: name})}
+        restarts = int(status.get("restartCount", 0))
+
+        if phase == "Restarting":
+            # Pods were deleted last pass but terminate asynchronously
+            # on a real cluster (grace period); re-deciding while they
+            # linger as Failed would burn one restart per resync. Hold
+            # until the gang is fully gone, then fall through — every
+            # member reads MISSING and decide() says CREATE_MISSING.
+            if any(m.pod_name(name) in pods for m in members):
+                return phase
+
         phases = [
             PodPhase.from_k8s(
                 pods.get(m.pod_name(name), {}).get("status", {}).get("phase"))
             for m in members
         ]
-        restarts = int(status.get("restartCount", 0))
         allow_restart = job["spec"].get("recoveryPolicy",
                                         "restart-slice") == "restart-slice"
         decision = decide(phases, chief, allow_restart=allow_restart,
@@ -223,9 +233,8 @@ class Reconciler:
         if decision == Decision.SUCCEED:
             # Tear down the rest of the gang (the reference's workers
             # slept forever instead, launcher.py:86-90).
-            for m in members:
-                if m.pod_name(name) in pods and \
-                        phases[members.index(m)] != PodPhase.SUCCEEDED:
+            for m, p in zip(members, phases):
+                if m.pod_name(name) in pods and p != PodPhase.SUCCEEDED:
                     try:
                         self.api.delete("Pod", ns, m.pod_name(name))
                     except NotFound:

@@ -1,0 +1,1 @@
+"""TPU compute primitives (attention, fused kernels)."""

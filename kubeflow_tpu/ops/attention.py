@@ -1,0 +1,205 @@
+"""Attention primitives: dense reference + blockwise (online-softmax).
+
+The reference framework has no attention anywhere (its only model path
+is tf_cnn_benchmarks CNNs, ``kubeflow/tf-job/prototypes/
+tf-cnn-benchmarks.jsonnet:36-43``); sequence models appear only as
+BASELINE targets (BERT, Llama). These primitives are therefore
+greenfield, designed TPU-first:
+
+- All shapes static; masking is arithmetic (no boolean gather) so XLA
+  tiles cleanly onto the MXU.
+- Softmax statistics carried in float32 even for bf16 inputs.
+- The blockwise form is the building block for ring attention
+  (:mod:`kubeflow_tpu.parallel.ring_attention`): it consumes KV in
+  chunks with online-softmax rescaling, which is exactly the per-ring-
+  step update.
+
+Convention: ``q, k, v`` are ``[batch, seq, heads, head_dim]``; KV may
+have fewer heads than Q (grouped-query attention) as long as
+``q_heads % kv_heads == 0``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """Expand KV heads for grouped-query attention: [B,L,Hkv,D] →
+    [B,L,Hkv*n_rep,D]."""
+    if n_rep == 1:
+        return x
+    b, l, h, d = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (b, l, h, n_rep, d)
+    ).reshape(b, l, h * n_rep, d)
+
+
+def dense_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset: int | jax.Array = 0,
+    kv_offset: int | jax.Array = 0,
+    kv_segment_valid: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Reference softmax attention (fp32 statistics).
+
+    ``q_offset``/``kv_offset`` are the global positions of the first
+    query/key — this makes the same function usable on sequence shards
+    (ring attention's per-step block compute) and on full sequences
+    (offsets 0). ``kv_segment_valid`` is an optional [B, Lk] 0/1 mask
+    for padded keys.
+    """
+    q_heads, kv_heads = q.shape[2], k.shape[2]
+    if q_heads != kv_heads:
+        k = _repeat_kv(k, q_heads // kv_heads)
+        v = _repeat_kv(v, q_heads // kv_heads)
+    head_dim = q.shape[-1]
+    scale = head_dim ** -0.5 if scale is None else scale
+
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = kv_offset + jnp.arange(k.shape[1])
+        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+    if kv_segment_valid is not None:
+        s = jnp.where(
+            kv_segment_valid[:, None, None, :].astype(bool), s, NEG_INF
+        )
+    # Guard fully-masked rows (e.g. ring steps entirely in the causal
+    # future): keep the max finite so exp() never sees -inf - -inf.
+    m = jnp.maximum(jnp.max(s, axis=-1, keepdims=True), NEG_INF / 2)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)
+    return o.astype(q.dtype)
+
+
+def attention_block_update(
+    carry: Tuple[jax.Array, jax.Array, jax.Array],
+    q: jax.Array,
+    k_block: jax.Array,
+    v_block: jax.Array,
+    *,
+    scale: float,
+    q_offset: int | jax.Array,
+    kv_offset: int | jax.Array,
+    causal: bool,
+    kv_segment_valid: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One online-softmax update of (o, m, l) with a new KV block.
+
+    This is the flash-attention inner loop in functional form: the ring
+    variant calls it once per ring step with the block that just
+    arrived over ICI. ``o`` is the unnormalized fp32 accumulator
+    [B,Lq,H,D]; ``m``/``l`` are fp32 running max / normalizer
+    [B,H,Lq].
+    """
+    o, m, l = carry
+    q_heads, kv_heads = q.shape[2], k_block.shape[2]
+    if q_heads != kv_heads:
+        k_block = _repeat_kv(k_block, q_heads // kv_heads)
+        v_block = _repeat_kv(v_block, q_heads // kv_heads)
+
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_block, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = kv_offset + jnp.arange(k_block.shape[1])
+        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+    if kv_segment_valid is not None:
+        s = jnp.where(
+            kv_segment_valid[:, None, None, :].astype(bool), s, NEG_INF
+        )
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    m_safe = jnp.maximum(m_new, NEG_INF / 2)
+    p = jnp.exp(s - m_safe[..., None])
+    correction = jnp.exp(m - m_safe)  # == 1 where m was still -inf-ish
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v_block.dtype), v_block,
+        preferred_element_type=jnp.float32,
+    )
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def attention_init_carry(
+    batch: int, q_len: int, heads: int, head_dim: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Zero-state (o, m, l) carry for :func:`attention_block_update`."""
+    return (
+        jnp.zeros((batch, q_len, heads, head_dim), jnp.float32),
+        jnp.full((batch, heads, q_len), NEG_INF, jnp.float32),
+        jnp.zeros((batch, heads, q_len), jnp.float32),
+    )
+
+
+def attention_finalize(
+    o: jax.Array, l: jax.Array, dtype: jnp.dtype
+) -> jax.Array:
+    """Normalize the accumulator: o / l (fully-masked rows → 0)."""
+    norm = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / norm).astype(dtype)
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    block_size: int = 512,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Memory-efficient attention: scan over KV blocks with online
+    softmax. O(Lq · block) live memory instead of O(Lq · Lk); the
+    single-device analogue of ring attention.
+    """
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = d ** -0.5 if scale is None else scale
+    if lk % block_size:
+        block_size = lk  # degenerate: one block
+    n_blocks = lk // block_size
+
+    k_blocks = k.reshape(b, n_blocks, block_size, k.shape[2], d)
+    v_blocks = v.reshape(b, n_blocks, block_size, v.shape[2], d)
+
+    def body(carry, inputs):
+        idx, k_blk, v_blk = inputs
+        carry = attention_block_update(
+            carry, q, k_blk, v_blk,
+            scale=scale, q_offset=0, kv_offset=idx * block_size,
+            causal=causal,
+        )
+        return carry, None
+
+    carry = attention_init_carry(b, lq, h, d)
+    (o, _, l), _ = jax.lax.scan(
+        body,
+        carry,
+        (
+            jnp.arange(n_blocks),
+            jnp.moveaxis(k_blocks, 1, 0),
+            jnp.moveaxis(v_blocks, 1, 0),
+        ),
+    )
+    return attention_finalize(o, l, q.dtype)

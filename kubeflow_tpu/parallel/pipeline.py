@@ -1,0 +1,115 @@
+"""SPMD pipeline parallelism (GPipe-style) over the ``pipeline`` axis.
+
+Greenfield vs the reference (its only parallelism was the async
+parameter-server topology, SURVEY §2.5): stages live on the
+``pipeline`` mesh axis, activations hop stage→stage with
+``lax.ppermute`` (one ICI neighbor hop), and microbatches stream
+through the classic GPipe schedule — ``n_micro + n_stages - 1`` ticks,
+every device running the same jitted program (SPMD: no per-stage
+programs, no host-side scheduler — the schedule is arithmetic on the
+stage index inside one ``shard_map``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+StageFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def _pipeline_inner(
+    stage_fn: StageFn,
+    params: Any,
+    microbatches: jax.Array,
+    axis_name: str,
+) -> jax.Array:
+    """Runs INSIDE shard_map. ``params``: this stage's params (leading
+    stage dim of size 1 already squeezed by the in_spec reshape).
+    ``microbatches``: [n_micro, mb, ...] (replicated across stages)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    perm = [(i, i + 1) for i in range(n - 1)]
+
+    def tick(t, carry):
+        state, outputs = carry
+        # Stage 0 ingests microbatch t (clipped index is safe: the
+        # result is only *used* while t < n_micro).
+        feed = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+        )
+        state = jnp.where(idx == 0, feed, state)
+        out = stage_fn(params, state)
+        # Last stage completed microbatch t-(n-1) this tick.
+        done_idx = jnp.clip(t - (n - 1), 0, n_micro - 1)
+        write = (idx == n - 1) & (t >= n - 1)
+        outputs = jnp.where(
+            write,
+            jax.lax.dynamic_update_index_in_dim(outputs, out, done_idx, 0),
+            outputs,
+        )
+        # Hand activations to the next stage (stage 0 receives zeros,
+        # immediately overwritten by the next feed).
+        state = jax.lax.ppermute(out, axis_name, perm)
+        return state, outputs
+
+    state = jnp.zeros_like(microbatches[0])
+    outputs = jnp.zeros_like(microbatches)
+    _, outputs = jax.lax.fori_loop(
+        0, n_micro + n - 1, tick, (state, outputs)
+    )
+    # Broadcast the last stage's outputs to every stage so the result
+    # leaves shard_map replicated.
+    outputs = jnp.where(idx == n - 1, outputs, 0)
+    return jax.lax.psum(outputs, axis_name)
+
+
+def spmd_pipeline(
+    stage_fn: StageFn,
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis_name: str = "pipeline",
+) -> jax.Array:
+    """Run ``x`` through ``n_stages`` copies of ``stage_fn``.
+
+    ``stacked_params``: pytree whose leaves have a leading
+    ``n_stages`` dimension (stage i's slice feeds stage i) — sharded
+    over the pipeline axis so each device holds only its stage.
+    ``x``: [batch, ...]; batch must divide by ``n_microbatches``.
+    Output has the same shape as ``x`` run through all stages in order.
+    """
+    n_stages = mesh.shape[axis_name]
+    batch = x.shape[0]
+    if batch % n_microbatches:
+        raise ValueError(f"batch {batch} % microbatches {n_microbatches}")
+    mb = batch // n_microbatches
+    microbatches = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    param_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+
+    def inner(params, mbs):
+        params = jax.tree.map(lambda p: p[0], params)  # squeeze stage dim
+        return _pipeline_inner(stage_fn, params, mbs, axis_name)
+
+    out = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(param_spec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, microbatches)
+    del n_stages
+    return out.reshape((batch,) + out.shape[2:])
+
+
+def stack_stage_params(param_list) -> Any:
+    """Stack per-stage param pytrees into one tree with a leading
+    stage dimension (the layout :func:`spmd_pipeline` consumes)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)

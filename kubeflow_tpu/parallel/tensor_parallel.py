@@ -1,0 +1,118 @@
+"""Tensor parallelism via logical-axis sharding rules (GSPMD).
+
+Megatron-style TP the XLA way: models annotate parameters with
+*logical* axis names (flax ``nn.with_partitioning`` /
+``nn.with_logical_partitioning``), and a rule table maps logical names
+to mesh axes. ``pjit`` + GSPMD then insert the all-reduces a
+hand-written Megatron layer would issue explicitly — column-parallel
+matmul (activations gathered) followed by row-parallel (partial sums
+all-reduced) falls out of the sharding propagation.
+
+The reference has nothing comparable (SURVEY §2.5: TP/PP/EP/SP all
+absent); this is the greenfield layer the BASELINE BERT/Llama configs
+need.
+
+Standard logical axis vocabulary (used by models/bert.py, models/llama.py):
+
+- ``batch``   — batch dim                → (data, fsdp)
+- ``seq``     — sequence dim             → seq (activations only)
+- ``embed``   — residual-stream features → fsdp (ZeRO-3 shard)
+- ``mlp``     — FFN hidden dim           → tensor
+- ``heads``   — attention head dim       → tensor
+- ``kv``      — per-head feature dim     → None
+- ``vocab``   — embedding/logits vocab   → tensor
+- ``expert``  — MoE expert dim           → expert
+- ``stage``   — pipeline stage dim       → pipeline
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+DEFAULT_RULES: Dict[str, MeshAxes] = {
+    "batch": ("data", "fsdp"),
+    "seq": "seq",
+    "embed": "fsdp",
+    "mlp": "tensor",
+    "heads": "tensor",
+    "kv": None,
+    "vocab": "tensor",
+    "expert": "expert",
+    "stage": "pipeline",
+}
+
+
+def rules_for(mesh: Mesh,
+              overrides: Optional[Mapping[str, MeshAxes]] = None
+              ) -> Dict[str, MeshAxes]:
+    """DEFAULT_RULES pruned to axes the mesh actually has (size > 1) —
+    a rule pointing at a size-1 axis is harmless but noisy in debug
+    output — with optional per-model overrides."""
+    rules = dict(DEFAULT_RULES)
+    if overrides:
+        rules.update(overrides)
+
+    def live(axes: MeshAxes) -> MeshAxes:
+        if axes is None:
+            return None
+        if isinstance(axes, str):
+            return axes if mesh.shape.get(axes, 1) > 1 else None
+        kept = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
+        return kept or None
+
+    return {k: live(v) for k, v in rules.items()}
+
+
+def logical_to_sharding(
+    mesh: Mesh,
+    logical_axes: Any,
+    rules: Optional[Mapping[str, MeshAxes]] = None,
+) -> Any:
+    """Map a pytree of logical-axis tuples (flax ``get_partition_spec``
+    output style: leaves are ``PartitionSpec('embed', 'mlp')`` or
+    tuples of names) to NamedShardings."""
+    rules = dict(rules if rules is not None else rules_for(mesh))
+
+    def convert(leaf: Any) -> NamedSharding:
+        if leaf is None:
+            return NamedSharding(mesh, P())
+        names = tuple(leaf)
+        # A mesh axis may appear at most once per spec: if two logical
+        # names map to the same axis (e.g. d_model→d_model kernels),
+        # the first occurrence wins and the rest replicate.
+        used: set = set()
+        dims = []
+        for n in names:
+            axes = rules.get(n) if n else None
+            members = (axes,) if isinstance(axes, str) else tuple(axes or ())
+            kept = tuple(a for a in members if a not in used)
+            used.update(kept)
+            if not kept:
+                dims.append(None)
+            else:
+                dims.append(kept[0] if len(kept) == 1 else kept)
+        return NamedSharding(mesh, P(*dims))
+
+    return jax.tree.map(
+        convert, logical_axes,
+        is_leaf=lambda x: x is None or isinstance(x, (tuple, P)),
+    )
+
+
+def variables_sharding(
+    mesh: Mesh,
+    abstract_variables: Any,
+    rules: Optional[Mapping[str, MeshAxes]] = None,
+) -> Any:
+    """Sharding tree for a flax variable dict whose params carry
+    ``nn.Partitioned`` metadata (``nn.get_partition_spec`` under the
+    hood); unannotated leaves replicate."""
+    import flax.linen as nn
+
+    logical = nn.get_partition_spec(abstract_variables)
+    return logical_to_sharding(mesh, logical, rules)

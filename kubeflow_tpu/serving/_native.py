@@ -11,9 +11,11 @@ import time
 from pathlib import Path
 from typing import List, Optional
 
+# Env override first so sanitizer builds (native/Makefile asan/tsan
+# targets) actually get loaded over the bundled library.
 _LIB_PATHS = [
-    Path(__file__).resolve().parent.parent.parent / "native" / "libkft_runtime.so",
     Path(os.environ.get("KFT_RUNTIME_LIB", "")),
+    Path(__file__).resolve().parent.parent.parent / "native" / "libkft_runtime.so",
 ]
 
 

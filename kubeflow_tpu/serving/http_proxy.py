@@ -46,7 +46,13 @@ def decode_b64_if_needed(value: Any) -> Any:
 class ProxyHandler(tornado.web.RequestHandler):
     @property
     def rpc_address(self) -> str:
-        return self.application.settings["rpc_address"]
+        addr = self.application.settings["rpc_address"]
+        # Accept bare host:port (the manifest wires the sidecar as
+        # --rpc_address=127.0.0.1:9000, parity with the reference's
+        # --rpc_port flag, tf-serving.libsonnet:152).
+        if "://" not in addr:
+            addr = f"http://{addr}"
+        return addr
 
     @property
     def rpc_timeout(self) -> float:
@@ -171,8 +177,13 @@ def main(argv=None) -> int:
     parser.add_argument("--rpc_timeout", type=float, default=10.0)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    app = make_app(f"http://{args.rpc_address}:{args.rpc_port}",
-                   args.rpc_timeout)
+    # --rpc_address accepts bare host (reference --rpc_port style,
+    # tf-serving.libsonnet:152), host:port, or a full URL; the handler
+    # property adds the scheme when missing.
+    addr = args.rpc_address
+    if "://" not in addr and ":" not in addr.rsplit("]", 1)[-1]:
+        addr = f"{addr}:{args.rpc_port}"
+    app = make_app(addr, args.rpc_timeout)
     app.listen(args.port)
     logger.info("http proxy on :%d → :%d", args.port, args.rpc_port)
     tornado.ioloop.IOLoop.current().start()

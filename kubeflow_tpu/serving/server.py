@@ -129,8 +129,13 @@ class InferHandler(BaseHandler):
                              "predictions": _batch_to_instances(result)})
         except KeyError as e:
             self.write_json({"error": e.args[0]}, 404)
-        except (ValueError, RuntimeError) as e:
+        except ValueError as e:
             self.write_json({"error": str(e)}, 400)
+        except RuntimeError as e:
+            # Overload (queue full) / shutdown races are server-side
+            # and transient: 503 so clients and the gateway retry with
+            # backoff instead of treating it as a bad request.
+            self.write_json({"error": str(e)}, 503)
 
 
 def _instances_to_batch(instances: Any, input_name: str) -> np.ndarray:

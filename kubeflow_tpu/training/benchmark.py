@@ -80,17 +80,20 @@ def run_benchmark(config: BenchConfig) -> Dict[str, float]:
 
     step_fn = make_train_step(mesh)
 
-    # Warmup (includes compile).
+    # Warmup (includes compile). Fence with a host value pull, not
+    # block_until_ready: on remote-tunneled platforms (axon) the ready
+    # bit of a dispatched chain can report early, and a timed loop
+    # fenced that way measures dispatch, not compute.
     compile_start = time.perf_counter()
     for _ in range(max(config.warmup_steps, 1)):
         state, metrics = step_fn(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
     compile_s = time.perf_counter() - compile_start
 
     start = time.perf_counter()
     for _ in range(config.steps):
         state, metrics = step_fn(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    final_loss = float(metrics["loss"])
     elapsed = time.perf_counter() - start
 
     images_per_sec = config.batch_size * config.steps / elapsed
@@ -103,7 +106,7 @@ def run_benchmark(config: BenchConfig) -> Dict[str, float]:
         "images_per_sec_per_chip": images_per_sec / n_chips,
         "step_time_ms": elapsed / config.steps * 1e3,
         "compile_plus_warmup_s": compile_s,
-        "final_loss": float(metrics["loss"]),
+        "final_loss": final_loss,
     }
 
 

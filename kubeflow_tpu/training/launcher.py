@@ -83,7 +83,7 @@ def run_and_stream(command: Sequence[str]) -> int:
     logger.info("running: %s", " ".join(command))
     process = subprocess.Popen(
         list(command), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True)
+        text=True, errors="replace")
     assert process.stdout is not None
     for line in process.stdout:
         logger.info("%s", line.rstrip("\n"))

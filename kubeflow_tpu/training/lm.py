@@ -1,0 +1,220 @@
+"""Language-model training: sharded state + MLM / causal-LM steps.
+
+Companion to :mod:`kubeflow_tpu.training.train` (the vision path) for
+models that carry logical-axis metadata (``nn.with_partitioning`` —
+bert.py, llama.py). Params/optimizer are sharded by the TP rule table
+(:mod:`kubeflow_tpu.parallel.tensor_parallel`), batches over
+``(data, fsdp)``, and one jitted SPMD step runs on every chip with XLA
+inserting the TP all-reduces and gradient all-reduce — the replacement
+for the reference's parameter-server update loop (SURVEY §2.5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import batch_sharding
+from kubeflow_tpu.parallel.tensor_parallel import (
+    logical_to_sharding,
+    rules_for,
+)
+
+Batch = Dict[str, jax.Array]
+
+
+class LMState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: optax.OptState
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+
+def _init_variables(model: Any, rng: jax.Array, sample: Batch) -> Any:
+    return model.init(rng, *_model_args(sample))
+
+
+def _model_args(batch: Batch) -> Tuple[jax.Array, ...]:
+    """Map a batch dict to positional model inputs.
+
+    BERT batches carry ``type_ids``/``valid``; causal-LM batches just
+    ``input_ids``.
+    """
+    if "type_ids" in batch or "valid" in batch:
+        return (
+            batch["input_ids"],
+            batch.get("type_ids"),
+            batch.get("valid"),
+        )
+    return (batch["input_ids"],)
+
+
+def create_lm_state(
+    model: Any,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    sample_batch: Batch,
+    mesh: Optional[Mesh] = None,
+    rules: Optional[Mapping[str, Any]] = None,
+) -> Tuple[LMState, Optional[LMState]]:
+    """Build (state, state_shardings). Without a mesh, shardings=None.
+
+    With a mesh, params are *initialized directly into their shards*
+    (jit with out_shardings) so a 7B model never materializes
+    replicated on one host.
+    """
+
+    def init_params(rng):
+        variables = _init_variables(model, rng, sample_batch)
+        return nn.meta.unbox(variables["params"])
+
+    if mesh is None:
+        params = init_params(rng)
+        return (
+            LMState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=tx.init(params),
+                apply_fn=model.apply,
+                tx=tx,
+            ),
+            None,
+        )
+
+    rules = rules_for(mesh, rules)
+    boxed = jax.eval_shape(
+        lambda r: _init_variables(model, r, sample_batch), rng
+    )
+    logical = nn.get_partition_spec(boxed)["params"]
+    params_sh = logical_to_sharding(mesh, logical, rules)
+    params = jax.jit(init_params, out_shardings=params_sh)(rng)
+
+    # Optimizer moments mirror param leaves; shard identically.
+    replicated = NamedSharding(mesh, P())
+    opt_sh = jax.tree.map(
+        lambda leaf: _match_param_sharding(leaf, params, params_sh,
+                                           replicated),
+        jax.eval_shape(tx.init, params),
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+    )
+    opt_state = jax.jit(tx.init, out_shardings=opt_sh)(params)
+
+    state = LMState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=opt_state,
+        apply_fn=model.apply,
+        tx=tx,
+    )
+    shardings = LMState(
+        step=replicated,
+        params=params_sh,
+        opt_state=opt_sh,
+        apply_fn=model.apply,
+        tx=tx,
+    )
+    return state, shardings
+
+
+def _match_param_sharding(leaf, params, params_sh, replicated):
+    """Shard an optimizer leaf like the param with the same shape."""
+    shape = tuple(getattr(leaf, "shape", ()))
+    for p, s in zip(jax.tree.leaves(params), jax.tree.leaves(params_sh)):
+        if tuple(p.shape) == shape:
+            return s
+    return replicated
+
+
+def mlm_loss(logits: jax.Array, batch: Batch) -> Tuple[jax.Array, jax.Array]:
+    """Masked-LM loss: cross entropy at positions where
+    ``mlm_weights`` is 1 (labels in ``mlm_labels``)."""
+    labels = batch["mlm_labels"]
+    weights = batch["mlm_weights"].astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    denom = jnp.maximum(weights.sum(), 1.0)
+    loss = (ce * weights).sum() / denom
+    acc = ((jnp.argmax(logits, -1) == labels) * weights).sum() / denom
+    return loss, acc
+
+
+def causal_lm_loss(logits: jax.Array, batch: Batch
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Next-token loss. ``targets`` defaults to input_ids shifted left;
+    ``loss_weights`` (optional) masks padding."""
+    if "targets" in batch:
+        targets, logits_used = batch["targets"], logits
+    else:
+        targets = batch["input_ids"][:, 1:]
+        logits_used = logits[:, :-1]
+    weights = batch.get("loss_weights")
+    if weights is None:
+        weights = jnp.ones(targets.shape, jnp.float32)
+    elif "targets" not in batch:
+        weights = weights[:, 1:]
+    weights = weights.astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits_used, targets)
+    denom = jnp.maximum(weights.sum(), 1.0)
+    loss = (ce * weights).sum() / denom
+    acc = ((jnp.argmax(logits_used, -1) == targets) * weights).sum() / denom
+    return loss, acc
+
+
+LOSSES = {"mlm": mlm_loss, "causal": causal_lm_loss}
+
+
+def make_lm_train_step(
+    mesh: Optional[Mesh],
+    shardings: Optional[LMState],
+    *,
+    objective: str = "causal",
+    donate: bool = True,
+):
+    """Jitted SPMD train step for an LMState.
+
+    ``objective``: "mlm" (BERT pretraining) or "causal" (Llama).
+    """
+    loss_fn = LOSSES[objective]
+
+    def step(state: LMState, batch: Batch):
+        def compute(params):
+            logits = state.apply_fn({"params": params}, *_model_args(batch))
+            loss, acc = loss_fn(logits, batch)
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(compute, has_aux=True)(
+            state.params
+        )
+        updates, new_opt = state.tx.update(grads, state.opt_state,
+                                           state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss,
+            "accuracy": acc,
+            "grad_norm": optax.global_norm(grads),
+        }
+        return (
+            state.replace(step=state.step + 1, params=new_params,
+                          opt_state=new_opt),
+            metrics,
+        )
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+    batch_sh = batch_sharding(mesh)
+    return jax.jit(
+        step,
+        in_shardings=(shardings, batch_sh),
+        out_shardings=(shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def place_lm_batch(mesh: Mesh, batch: Batch) -> Batch:
+    return jax.device_put(batch, batch_sharding(mesh))

@@ -1,0 +1,111 @@
+"""The training loop: resume → step → log → checkpoint → profile.
+
+This is the in-pod driver the operator's whole-slice recovery model
+assumes (SURVEY §5 "failure detection"): on every boot it restores the
+latest checkpoint unconditionally — first boot is a fresh start, a
+gang restart resumes at the saved step — so the operator can answer
+any slice fault with "kill and recreate the gang" and lose at most
+``save_interval_steps`` of work. The reference had nothing here: its
+launcher streamed tf_cnn_benchmarks output and slept forever on
+success (``tf-controller-examples/tf-cnn/launcher.py:29-54,86-90``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+
+from kubeflow_tpu.training.checkpoint import CheckpointConfig, Checkpointer
+from kubeflow_tpu.utils.metrics import MetricsLogger
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class LoopConfig:
+    total_steps: int
+    log_every: int = 10
+    checkpoint: Optional[CheckpointConfig] = None
+    metrics_path: Optional[str] = None
+    # JAX profiler capture [start, stop) in *resumed* step numbers;
+    # traces land under profile_dir (XPlane — TensorBoard-compatible).
+    profile_start: Optional[int] = None
+    profile_stop: Optional[int] = None
+    profile_dir: str = "/tmp/kft-profile"
+
+
+def fit(
+    state: Any,
+    step_fn: Callable[[Any, Any], Tuple[Any, Dict[str, jax.Array]]],
+    batches: Iterator[Any],
+    config: LoopConfig,
+    *,
+    metrics_logger: Optional[MetricsLogger] = None,
+    hooks: Optional[list] = None,
+) -> Any:
+    """Run up to ``config.total_steps`` (counting resumed steps).
+
+    ``hooks``: callables ``(step, state, metrics) -> None`` invoked at
+    every log interval (dashboards, early-stop probes, tests).
+    """
+    ckpt = Checkpointer(config.checkpoint) if config.checkpoint else None
+    owns_logger = metrics_logger is None
+    metrics_logger = metrics_logger or MetricsLogger(config.metrics_path)
+
+    if ckpt:
+        state = ckpt.restore(state)
+    start_step = int(state.step)
+    if start_step >= config.total_steps:
+        logger.info("checkpoint already at step %d >= total %d; done",
+                    start_step, config.total_steps)
+        return state
+
+    profiling = False
+    window_start = time.perf_counter()
+    window_steps = 0
+    metrics: Dict[str, jax.Array] = {}
+    try:
+        for step in range(start_step, config.total_steps):
+            if config.profile_start is not None and step == config.profile_start:
+                jax.profiler.start_trace(config.profile_dir)
+                profiling = True
+            batch = next(batches)
+            state, metrics = step_fn(state, batch)
+            window_steps += 1
+
+            next_step = step + 1
+            if profiling and next_step == (config.profile_stop
+                                           or config.profile_start + 3):
+                float(metrics["loss"])  # fence: value pull, not ready-bit
+                jax.profiler.stop_trace()
+                profiling = False
+                logger.info("profiler trace written to %s", config.profile_dir)
+            if next_step % config.log_every == 0 or next_step == config.total_steps:
+                # The float() pulls fence the window (value pull, not
+                # ready-bit — see benchmark.py on remote platforms).
+                host_metrics = {k: float(v) for k, v in metrics.items()}
+                elapsed = time.perf_counter() - window_start
+                host_metrics["steps_per_sec"] = window_steps / max(elapsed, 1e-9)
+                metrics_logger.log(next_step, host_metrics)
+                logger.info("step %d: %s", next_step, host_metrics)
+                for hook in hooks or ():
+                    hook(next_step, state, host_metrics)
+                window_start = time.perf_counter()
+                window_steps = 0
+            if ckpt:
+                ckpt.save(next_step, state)
+        if ckpt:
+            ckpt.save(int(state.step), state, force=True)
+            ckpt.wait()
+    finally:
+        if profiling:
+            jax.profiler.stop_trace()
+        if ckpt:
+            ckpt.close()
+        if owns_logger:
+            metrics_logger.close()
+    return state

@@ -1,0 +1,131 @@
+"""tpu-lm — LM pretraining/fine-tune entrypoint (BERT MLM, Llama causal).
+
+The in-pod program for the BASELINE multi-host configs (BERT-base
+pretraining step time; Llama fine-tune stretch). Runs under the
+launcher (:mod:`kubeflow_tpu.training.launcher` initializes
+``jax.distributed`` from the operator-injected env) as one SPMD
+program per host: build mesh → shard state → stream per-host synthetic
+batches → ``fit`` with checkpoint/resume.
+
+Mesh spec strings use the standard axis names
+(:mod:`kubeflow_tpu.parallel.mesh`): ``--mesh data=-1,tensor=4``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict, Optional
+
+from kubeflow_tpu.parallel.mesh import MeshSpec
+
+OBJECTIVES = ("mlm", "causal")
+
+
+def parse_mesh(spec: Optional[str]) -> Optional[MeshSpec]:
+    """``"data=2,tensor=4"`` → MeshSpec(data=2, tensor=4)."""
+    if not spec:
+        return None
+    sizes: Dict[str, int] = {}
+    for part in spec.split(","):
+        name, _, value = part.partition("=")
+        if not value:
+            raise ValueError(f"bad mesh entry {part!r} (want axis=N)")
+        sizes[name.strip()] = int(value)
+    return MeshSpec(**sizes)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-lm")
+    p.add_argument("--model", default="bert-base")
+    p.add_argument("--objective", choices=OBJECTIVES, default=None,
+                   help="default: mlm for bert*, causal otherwise")
+    p.add_argument("--global_batch", type=int, default=256)
+    p.add_argument("--seq_len", type=int, default=128)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--log_every", type=int, default=10)
+    p.add_argument("--learning_rate", type=float, default=1e-4)
+    p.add_argument("--warmup_steps", type=int, default=10)
+    p.add_argument("--mesh", default=None,
+                   help="e.g. data=-1,tensor=4 (default: all-data)")
+    p.add_argument("--checkpoint_dir", default=None)
+    p.add_argument("--save_every", type=int, default=200)
+    p.add_argument("--metrics_path", default=None)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize blocks (llama only)")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import optax
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.parallel.mesh import build_mesh
+    from kubeflow_tpu.training.checkpoint import CheckpointConfig
+    from kubeflow_tpu.training.data import (
+        DevicePrefetcher,
+        synthetic_causal_lm,
+        synthetic_mlm,
+    )
+    from kubeflow_tpu.training.lm import create_lm_state, make_lm_train_step
+    from kubeflow_tpu.training.loop import LoopConfig, fit
+
+    entry = get_model(args.model)
+    objective = args.objective or (
+        "mlm" if entry.name.startswith("bert") else "causal")
+    kwargs = {}
+    if args.remat:
+        kwargs["remat"] = True
+    model = entry.make(**kwargs)
+    vocab = entry.num_classes_or_vocab
+
+    mesh = build_mesh(parse_mesh(args.mesh))
+    if objective == "mlm":
+        gen = synthetic_mlm(args.global_batch, args.seq_len, vocab,
+                            seed=args.seed)
+    else:
+        gen = synthetic_causal_lm(args.global_batch, args.seq_len, vocab,
+                                  seed=args.seed)
+    sample = next(gen)
+
+    tx = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(
+            optax.schedules.warmup_cosine_decay_schedule(
+                0.0, args.learning_rate, args.warmup_steps,
+                max(args.steps, args.warmup_steps + 1)),
+            weight_decay=0.01,
+        ),
+    )
+    state, shardings = create_lm_state(
+        model, tx, jax.random.PRNGKey(args.seed), sample, mesh)
+    step_fn = make_lm_train_step(mesh, shardings, objective=objective)
+
+    ckpt = None
+    if args.checkpoint_dir:
+        ckpt = CheckpointConfig(directory=args.checkpoint_dir,
+                                save_interval_steps=args.save_every)
+    config = LoopConfig(total_steps=args.steps, log_every=args.log_every,
+                        checkpoint=ckpt, metrics_path=args.metrics_path)
+    data = DevicePrefetcher(gen, mesh)
+    try:
+        state = fit(state, step_fn, data, config)
+    finally:
+        data.close()
+
+    if jax.process_index() == 0:
+        print(json.dumps({
+            "model": entry.name,
+            "objective": objective,
+            "final_step": int(state.step),
+            "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,86 @@
+"""Inception-v3 serving golden test.
+
+The reference's serving E2E asserted *golden output equality*: gRPC
+Predict with a fixed JPEG, response compared byte-for-byte against
+``components/k8s-model-server/images/test-worker/result.txt``
+(``testing/test_tf_serving.py:104-108``). Same mechanism here:
+deterministic weights (seed 0) + deterministic input → exported →
+served → top-5 classes must match the checked-in golden exactly,
+scores to 1e-3.
+
+Regenerate after an intentional model change:
+``KFT_REGEN_GOLDEN=1 pytest tests/test_inception_golden.py``.
+"""
+
+import json
+import os
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.export import export_model
+from kubeflow_tpu.serving.model import load_version
+from kubeflow_tpu.serving.signature import (
+    ModelMetadata,
+    Signature,
+    TensorSpec,
+)
+
+GOLDEN = Path(__file__).parent / "golden" / "inception_v3_top5.json"
+
+
+def _metadata() -> ModelMetadata:
+    return ModelMetadata(
+        model_name="inception",
+        registry_name="inception-v3",
+        model_kwargs={"num_classes": 1000, "dtype": "float32"},
+        signatures={
+            "serving_default": Signature(
+                method="classify",
+                inputs={"images": TensorSpec("float32", (-1, 299, 299, 3))},
+                outputs={
+                    "classes": TensorSpec("int32", (-1, 5)),
+                    "scores": TensorSpec("float32", (-1, 5)),
+                },
+            )
+        },
+    )
+
+
+def _image() -> np.ndarray:
+    """Deterministic stand-in for the reference's fixed JPEG."""
+    rng = np.random.RandomState(42)
+    return (rng.randint(0, 256, (1, 299, 299, 3)) / 255.0).astype(np.float32)
+
+
+@pytest.mark.slow
+def test_inception_serving_golden(tmp_path):
+    from kubeflow_tpu.models.registry import get_model
+
+    meta = _metadata()
+    entry = get_model(meta.registry_name)
+    module = entry.make(**meta.model_kwargs)
+    variables = module.init(
+        jax.random.PRNGKey(0), np.zeros((1, 299, 299, 3), np.float32),
+        train=False,
+    )
+    base = tmp_path / "inception"
+    export_model(str(base), 1, meta, variables)
+    loaded = load_version(str(base / "1"))
+
+    out = loaded.run({"images": _image()})
+    classes = np.asarray(out["classes"])[0].tolist()
+    scores = np.asarray(out["scores"])[0].tolist()
+
+    if os.environ.get("KFT_REGEN_GOLDEN") or not GOLDEN.exists():
+        GOLDEN.parent.mkdir(parents=True, exist_ok=True)
+        GOLDEN.write_text(json.dumps(
+            {"classes": classes, "scores": scores}, indent=2))
+        if not os.environ.get("KFT_REGEN_GOLDEN"):
+            pytest.skip("golden file created; commit it")
+
+    golden = json.loads(GOLDEN.read_text())
+    assert classes == golden["classes"], "top-5 class ids drifted"
+    np.testing.assert_allclose(scores, golden["scores"], atol=1e-3)

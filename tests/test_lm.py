@@ -1,0 +1,144 @@
+"""BERT/Llama forward + sharded LM training on the virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kubeflow_tpu.models.bert import bert_test
+from kubeflow_tpu.models.llama import Llama, llama_test, rope
+from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+from kubeflow_tpu.training.lm import (
+    causal_lm_loss,
+    create_lm_state,
+    make_lm_train_step,
+    mlm_loss,
+    place_lm_batch,
+)
+
+
+def bert_batch(key, b=8, l=32, vocab=512):
+    k1, k2 = jax.random.split(key)
+    ids = jax.random.randint(k1, (b, l), 0, vocab)
+    labels = jax.random.randint(k2, (b, l), 0, vocab)
+    weights = (jnp.arange(l)[None, :] < 4).astype(jnp.int32) * jnp.ones((b, 1), jnp.int32)
+    return {
+        "input_ids": ids,
+        "type_ids": jnp.zeros((b, l), jnp.int32),
+        "valid": jnp.ones((b, l), jnp.int32),
+        "mlm_labels": labels,
+        "mlm_weights": weights,
+    }
+
+
+def test_bert_forward_shape():
+    model = bert_test()
+    batch = bert_batch(jax.random.PRNGKey(0))
+    variables = model.init(jax.random.PRNGKey(1), batch["input_ids"])
+    import flax.linen as nn
+
+    params = nn.meta.unbox(variables["params"])
+    logits = model.apply({"params": params}, batch["input_ids"],
+                         batch["type_ids"], batch["valid"])
+    assert logits.shape == (8, 32, 512)
+    assert logits.dtype == jnp.float32
+
+
+def test_llama_forward_and_rope():
+    model = llama_test()
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 512)
+    import flax.linen as nn
+
+    variables = model.init(jax.random.PRNGKey(1), ids)
+    params = nn.meta.unbox(variables["params"])
+    logits = model.apply({"params": params}, ids)
+    assert logits.shape == (2, 16, 512)
+
+    # RoPE preserves norms and is identity at position 0.
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 4, 2, 8))
+    pos = jnp.broadcast_to(jnp.arange(4)[None, :], (1, 4))
+    r = rope(x, pos)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(r), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(r[:, 0]), np.asarray(x[:, 0]),
+                               rtol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "spec", [MeshSpec(data=8), MeshSpec(data=2, fsdp=2, tensor=2)]
+)
+def test_bert_mlm_train_step_sharded(spec):
+    mesh = build_mesh(spec)
+    model = bert_test()
+    batch = bert_batch(jax.random.PRNGKey(0))
+    state, shardings = create_lm_state(
+        model, optax.adamw(1e-3), jax.random.PRNGKey(1), batch, mesh
+    )
+    step = make_lm_train_step(mesh, shardings, objective="mlm")
+    batch = place_lm_batch(mesh, batch)
+    losses = []
+    for _ in range(3):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert int(state.step) == 3
+    assert losses[-1] < losses[0]  # memorizes a fixed batch
+
+
+def test_llama_causal_train_step_tp():
+    mesh = build_mesh(MeshSpec(data=2, tensor=4))
+    model = llama_test()
+    ids = jax.random.randint(jax.random.PRNGKey(0), (8, 32), 0, 512)
+    batch = {"input_ids": ids}
+    state, shardings = create_lm_state(
+        model, optax.adamw(1e-3), jax.random.PRNGKey(1), batch, mesh
+    )
+    # TP actually shards the MLP: gate_proj kernel split over tensor.
+    gate = state.params["layer_0"]["gate_proj"]["kernel"]
+    assert gate.sharding.spec == jax.sharding.PartitionSpec("fsdp", "tensor") \
+        or "tensor" in str(gate.sharding.spec)
+    step = make_lm_train_step(mesh, shardings, objective="causal")
+    batch = place_lm_batch(mesh, batch)
+    losses = []
+    for _ in range(3):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_loss_masking():
+    logits = jnp.zeros((2, 4, 8))
+    batch = {
+        "mlm_labels": jnp.zeros((2, 4), jnp.int32),
+        "mlm_weights": jnp.zeros((2, 4), jnp.int32),
+    }
+    loss, acc = mlm_loss(logits, batch)
+    assert float(loss) == 0.0  # fully masked → zero, not NaN
+
+    ids = jnp.array([[1, 2, 3, 4]])
+    loss, _ = causal_lm_loss(jnp.zeros((1, 4, 8)), {"input_ids": ids})
+    np.testing.assert_allclose(float(loss), np.log(8), rtol=1e-5)
+
+
+def test_bert_with_ring_attention_matches_dense():
+    from kubeflow_tpu.parallel.ring_attention import (
+        make_sequence_parallel_attention,
+    )
+
+    mesh = build_mesh(MeshSpec(data=2, seq=4))
+    batch = bert_batch(jax.random.PRNGKey(0), b=4, l=32)
+    dense_model = bert_test()
+    ring_model = bert_test(
+        attention_fn=make_sequence_parallel_attention(
+            mesh, strategy="ring", head_axis=None
+        )
+    )
+    import flax.linen as nn
+
+    variables = dense_model.init(jax.random.PRNGKey(1), batch["input_ids"])
+    params = nn.meta.unbox(variables["params"])
+    ref = dense_model.apply({"params": params}, batch["input_ids"])
+    out = ring_model.apply({"params": params}, batch["input_ids"])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)

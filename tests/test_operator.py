@@ -196,3 +196,36 @@ def test_fake_apiserver_conflict_and_notfound():
         api.get("Pod", "ns", "ghost")
     with pytest.raises(NotFound):
         api.delete("Pod", "ns", "ghost")
+
+
+def test_restarting_holds_while_pods_terminate():
+    """A real cluster deletes pods asynchronously: while the old gang
+    lingers in Terminating (still listed, phase Failed), a resync must
+    NOT burn another restart or recreate pods early."""
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=2))
+    r = Reconciler(api)
+    r.reconcile(job)
+    api.set_pod_phase("default", "job1-tpu-worker-0", "Failed")
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Restarting"
+
+    # Simulate slow termination: put the old (failed) pods back, as a
+    # real apiserver would still list them during the grace period.
+    from kubeflow_tpu.operator.reconciler import ReplicaMember, expected_members
+    for m in expected_members(job):
+        pod = r._member_pod(job, m, expected_members(job))
+        pod.setdefault("status", {})["phase"] = "Failed"
+        api.create(pod)
+
+    for _ in range(5):  # many resyncs while terminating
+        job = api.get("TPUJob", "default", "job1")
+        assert r.reconcile(job) == "Restarting"
+    assert job["status"]["restartCount"] == 1  # no budget burned
+
+    # Termination completes → next pass recreates the gang.
+    for m in expected_members(job):
+        api.delete("Pod", "default", m.pod_name("job1"))
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Running"
+    assert len(api.list("Pod", "default", {JOB_LABEL: "job1"})) == 2

@@ -1,0 +1,56 @@
+"""Pipeline parallelism vs sequential reference on the virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+from kubeflow_tpu.parallel.pipeline import spmd_pipeline, stack_stage_params
+
+
+def stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def make_params(key, n_stages, d):
+    out = []
+    for i in range(n_stages):
+        k1, k2, key = jax.random.split(key, 3)
+        out.append({
+            "w": jax.random.normal(k1, (d, d)) / jnp.sqrt(d),
+            "b": jax.random.normal(k2, (d,)) * 0.1,
+        })
+    return out
+
+
+def test_pipeline_matches_sequential():
+    n_stages, d = 4, 16
+    mesh = build_mesh(MeshSpec(data=2, pipeline=n_stages))
+    params = make_params(jax.random.PRNGKey(0), n_stages, d)
+    x = jax.random.normal(jax.random.PRNGKey(1), (24, d))
+
+    ref = x
+    for p in params:
+        ref = stage_fn(p, ref)
+
+    stacked = stack_stage_params(params)
+    out = spmd_pipeline(
+        stage_fn, stacked, x, mesh=mesh, n_microbatches=8
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_grad_flows():
+    n_stages, d = 2, 8
+    mesh = build_mesh(MeshSpec(data=4, pipeline=n_stages))
+    params = stack_stage_params(make_params(jax.random.PRNGKey(2), n_stages, d))
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, d))
+
+    def loss(p):
+        out = spmd_pipeline(stage_fn, p, x, mesh=mesh, n_microbatches=4)
+        return jnp.sum(out ** 2)
+
+    grads = jax.grad(loss)(params)
+    assert grads["w"].shape == (n_stages, d, d)
+    assert float(jnp.abs(grads["w"]).sum()) > 0

@@ -1,0 +1,46 @@
+"""tpu-lm entrypoint: mesh spec parsing + end-to-end tiny runs."""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.parallel.mesh import MeshSpec
+from kubeflow_tpu.training.pretrain import main, parse_mesh
+
+
+def test_parse_mesh():
+    assert parse_mesh(None) is None
+    assert parse_mesh("data=2,tensor=4") == MeshSpec(data=2, tensor=4)
+    assert parse_mesh("data=-1") == MeshSpec(data=-1)
+    with pytest.raises(ValueError):
+        parse_mesh("data")
+    with pytest.raises(TypeError):
+        parse_mesh("bogus=2")
+
+
+def test_pretrain_bert_mlm_tiny(capsys):
+    rc = main([
+        "--model", "bert-test", "--global_batch", "8", "--seq_len", "32",
+        "--steps", "2", "--log_every", "1", "--mesh", "data=4,tensor=2",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["objective"] == "mlm"
+    assert out["final_step"] == 2
+
+
+def test_pretrain_llama_causal_with_ckpt(tmp_path, capsys):
+    args = [
+        "--model", "llama-test", "--global_batch", "8", "--seq_len", "16",
+        "--steps", "2", "--log_every", "1", "--mesh", "data=8",
+        "--checkpoint_dir", str(tmp_path / "ckpt"), "--save_every", "1",
+        "--metrics_path", str(tmp_path / "m.jsonl"),
+    ]
+    assert main(args) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["objective"] == "causal"
+    # Resume: bump steps, same checkpoint dir — continues from step 2.
+    args[7] = "4"
+    assert main(args) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["final_step"] == 4
