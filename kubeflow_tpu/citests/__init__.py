@@ -1,0 +1,8 @@
+"""CI E2E test drivers (the reference's ``testing/*.py`` tier).
+
+Each module is an Argo-step entrypoint (see manifests/ci.py) that
+emits junit XML. All drivers take ``--fake`` to run against the
+in-process fake apiserver / a local server — the hermetic tier the
+reference never had (SURVEY §4: its distributed tests required a live
+GKE cluster).
+"""

@@ -1,0 +1,69 @@
+"""CI artifact plumbing: PR symlink + junit/log upload.
+
+Reference: the create-pr-symlink and copy-artifacts steps
+(``testing/workflows/components/workflows.libsonnet:163-175,218-225``)
+that fed junit XML to gubernator via GCS. ``copy`` shells out to
+gsutil when present and otherwise copies to a local dir (minikube-
+style runs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+
+def artifacts_dir() -> Path:
+    return Path(os.environ.get("KFT_ARTIFACTS_DIR", "artifacts"))
+
+
+def create_pr_symlink() -> Path:
+    """Record the PR→artifacts association gubernator expects: a
+    metadata file naming the job run (symlinks don't survive GCS, the
+    reference wrote a marker object too)."""
+    out = artifacts_dir()
+    out.mkdir(parents=True, exist_ok=True)
+    marker = out / "pr_metadata.json"
+    marker.write_text(json.dumps({
+        "job": os.environ.get("JOB_NAME", "manual"),
+        "pull": os.environ.get("PULL_NUMBER", ""),
+        "commit": os.environ.get("PULL_PULL_SHA", ""),
+    }, indent=2))
+    return marker
+
+
+def copy(bucket: str) -> None:
+    src = artifacts_dir()
+    if shutil.which("gsutil"):
+        subprocess.check_call(
+            ["gsutil", "-m", "cp", "-r", str(src),
+             f"gs://{bucket}/{os.environ.get('JOB_NAME', 'manual')}/"])
+        return
+    dest = Path("/tmp/kft-artifacts") / bucket
+    dest.mkdir(parents=True, exist_ok=True)
+    shutil.copytree(src, dest / src.name, dirs_exist_ok=True)
+    logger.info("gsutil unavailable; artifacts copied to %s", dest)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kft-ci-artifacts")
+    parser.add_argument("command", choices=["create-pr-symlink", "copy"])
+    parser.add_argument("--bucket", default="kubeflow-tpu-ci-results")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    if args.command == "create-pr-symlink":
+        create_pr_symlink()
+    else:
+        copy(args.bucket)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

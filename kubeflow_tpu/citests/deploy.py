@@ -1,0 +1,105 @@
+"""E2E deploy test: apply kubeflow-core, wait for the control plane.
+
+Reference: ``testing/test_deploy.py`` — create namespace (``:43-69``),
+``ks generate core`` + apply (``:148-171``), wait for the
+``tf-job-operator`` Deployment and ``tf-hub`` StatefulSet
+(``:173-182``), teardown deletes the namespace (``:219-224``), all
+wrapped in junit cases (``:231-248``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time
+from typing import List
+
+from kubeflow_tpu.manifests import k8s
+from kubeflow_tpu.params.registry import get_prototype
+from kubeflow_tpu.utils import junit
+
+logger = logging.getLogger(__name__)
+
+OPERATOR_DEPLOYMENT = "tpujob-operator"
+HUB_STATEFULSET = "tpu-hub"
+
+
+def make_client(fake: bool):
+    if fake:
+        from kubeflow_tpu.operator.fake import FakeApiServer
+
+        return FakeApiServer()
+    from kubeflow_tpu.operator.controller import KubectlClient
+
+    return KubectlClient()
+
+
+def core_objects(namespace: str) -> List[dict]:
+    return get_prototype("kubeflow-core").build({"namespace": namespace})
+
+
+def setup(api, namespace: str, *, fake: bool,
+          timeout_s: float = 300.0) -> None:
+    from kubeflow_tpu.operator.fake import NotFound
+
+    try:
+        api.get("Namespace", "", namespace)
+    except (NotFound, RuntimeError):
+        api.create(k8s.namespace_obj(namespace))
+    for obj in core_objects(namespace):
+        try:
+            api.create(obj)
+        except RuntimeError as e:  # already exists on a re-run
+            if "AlreadyExists" not in str(e):
+                raise
+    deadline = time.monotonic() + (0 if fake else timeout_s)
+    while True:
+        try:
+            deploy = api.get("Deployment", namespace, OPERATOR_DEPLOYMENT)
+            hub = api.get("StatefulSet", namespace, HUB_STATEFULSET)
+            if fake:
+                break  # fake apiserver has no kubelet; existence is ready
+            if (deploy.get("status", {}).get("readyReplicas", 0) >= 1
+                    and hub.get("status", {}).get("readyReplicas", 0) >= 1):
+                break
+        except NotFound:
+            pass
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"control plane not ready in {timeout_s}s")
+        time.sleep(5)
+    logger.info("control plane ready in %s", namespace)
+
+
+def teardown(api, namespace: str) -> None:
+    api.delete("Namespace", "", namespace)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kft-e2e-deploy")
+    parser.add_argument("command", choices=["setup", "teardown"])
+    parser.add_argument("--namespace", default="kubeflow-e2e")
+    parser.add_argument("--junit_path", default=None)
+    parser.add_argument("--fake", action="store_true")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    api = make_client(args.fake)
+    if args.command == "setup":
+        case = junit.run_case(
+            "deploy-kubeflow-core",
+            lambda: setup(api, args.namespace, fake=args.fake))
+    else:
+        case = junit.run_case(
+            "teardown", lambda: teardown(api, args.namespace))
+    if args.junit_path:
+        junit.write_report(args.junit_path, "e2e-deploy", [case])
+    if not case.ok:
+        print(case.failure or case.error, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

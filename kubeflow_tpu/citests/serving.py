@@ -1,0 +1,140 @@
+"""E2E serving test: Predict against the model server, golden compare.
+
+Reference: ``testing/test_tf_serving.py`` — in-cluster gRPC Predict
+with a fixed JPEG, 3 retries (``:90-102``), golden-file equality
+(``:104-108``), junit output. Here: REST predict with a fixed seeded
+input; in ``--fake`` mode a local server process on an exported
+deterministic model stands in for the cluster service.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from kubeflow_tpu.utils import junit
+
+logger = logging.getLogger(__name__)
+
+RETRIES = 3
+
+
+def predict(url: str, payload: dict, timeout_s: float = 30.0) -> dict:
+    last: Exception = RuntimeError("no attempt")
+    for attempt in range(RETRIES):
+        try:
+            req = urllib.request.Request(
+                url, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return json.load(resp)
+        except (urllib.error.URLError, OSError) as e:
+            last = e
+            logger.warning("predict attempt %d failed: %s", attempt + 1, e)
+            time.sleep(5)
+    raise last
+
+
+def golden_check(base_url: str, model_name: str) -> None:
+    rng = np.random.RandomState(42)
+    image = (rng.randint(0, 256, (1, 32, 32, 3)) / 255.0).astype(np.float32)
+    resp = predict(f"{base_url}/v1/models/{model_name}:classify",
+                   {"instances": image.tolist()})
+    preds = resp["predictions"]
+    assert len(preds) == 1 and "classes" in preds[0] and "scores" in preds[0]
+    scores = np.asarray(preds[0]["scores"], np.float64)
+    assert np.all(np.diff(scores) <= 1e-9), "scores must be sorted desc"
+    assert abs(scores.sum()) <= 1.0 + 1e-6
+    logger.info("golden predict ok: top classes %s", preds[0]["classes"])
+
+
+def run_fake() -> None:
+    """Local stand-in: export a deterministic model, boot the real
+    server binary, golden-predict against it."""
+    import os
+    import pathlib
+    import subprocess
+    import tempfile
+
+    import jax
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.export import export_model
+    from kubeflow_tpu.serving.signature import (
+        ModelMetadata,
+        Signature,
+        TensorSpec,
+    )
+
+    base = pathlib.Path(tempfile.mkdtemp()) / "resnet"
+    meta = ModelMetadata(
+        model_name="resnet", registry_name="resnet-test",
+        model_kwargs={"num_classes": 10, "dtype": "float32"},
+        signatures={"serving_default": Signature(
+            method="classify",
+            inputs={"images": TensorSpec("float32", (-1, 32, 32, 3))},
+            outputs={"classes": TensorSpec("int32", (-1, 5)),
+                     "scores": TensorSpec("float32", (-1, 5))})})
+    module = get_model("resnet-test").make(num_classes=10, dtype="float32")
+    variables = module.init(
+        jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
+        train=False)
+    export_model(str(base), 1, meta, variables)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    port = 19301
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.serving.server",
+         "--port", str(port), "--model_name", "resnet",
+         "--model_base_path", str(base), "--poll_interval", "1"],
+        env=env)
+    try:
+        for _ in range(120):
+            try:
+                if urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=1).status == 200:
+                    break
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(1)
+        else:
+            raise AssertionError("local model server never became healthy")
+        golden_check(f"http://127.0.0.1:{port}", "resnet")
+    finally:
+        proc.kill()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kft-e2e-serving")
+    parser.add_argument("--namespace", default="kubeflow-e2e")
+    parser.add_argument("--service", default="tpu-serving")
+    parser.add_argument("--model_name", default="resnet")
+    parser.add_argument("--junit_path", default=None)
+    parser.add_argument("--fake", action="store_true")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    if args.fake:
+        fn = run_fake
+    else:
+        url = (f"http://{args.service}.{args.namespace}.svc.cluster."
+               f"local:9000")
+        fn = lambda: golden_check(url, args.model_name)  # noqa: E731
+    case = junit.run_case("serving-predict", fn)
+    if args.junit_path:
+        junit.write_report(args.junit_path, "e2e-serving", [case])
+    if not case.ok:
+        print(case.failure or case.error, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

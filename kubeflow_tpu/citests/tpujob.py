@@ -1,0 +1,85 @@
+"""E2E TPUJob test: submit a small training job, wait for success.
+
+Reference: the tfjob-test step delegated to tf-operator's
+``py.test_runner`` with a ``simple_tfjob`` component
+(``testing/workflows/components/workflows.libsonnet:233-245``) — i.e.
+multi-pod training verified by running it, small, on the cluster. In
+``--fake`` mode the reconciler + fake apiserver stand in for the
+cluster and pod phases are driven programmatically (fresh hermetic
+tier; SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time
+
+from kubeflow_tpu.citests.deploy import make_client
+from kubeflow_tpu.params.registry import get_prototype
+from kubeflow_tpu.utils import junit
+
+logger = logging.getLogger(__name__)
+
+
+def submit_and_wait(api, namespace: str, *, fake: bool,
+                    timeout_s: float = 600.0) -> None:
+    objs = get_prototype("tpu-cnn").build({
+        "name": "e2e-tpu-cnn",
+        "namespace": namespace,
+        "model": "resnet-test",
+        "batch_size": "32",
+        "num_tpu_workers": "2",
+        "tpu_accelerator": "tpu-v5-lite-podslice",
+        "tpu_topology": "2x4",
+    })
+    job = next(o for o in objs if o["kind"] == "TPUJob")
+    api.create(job)
+    name = job["metadata"]["name"]
+
+    if fake:
+        from kubeflow_tpu.operator.reconciler import Reconciler
+
+        rec = Reconciler(api)
+        rec.reconcile(api.get("TPUJob", namespace, name))
+        api.set_all_pod_phases(namespace, "Running")
+        rec.reconcile(api.get("TPUJob", namespace, name))
+        assert api.get("TPUJob", namespace, name)["status"]["phase"] == \
+            "Running"
+        api.set_all_pod_phases(namespace, "Succeeded")
+        rec.reconcile(api.get("TPUJob", namespace, name))
+    else:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            phase = api.get("TPUJob", namespace, name).get(
+                "status", {}).get("phase")
+            if phase in ("Succeeded", "Failed"):
+                break
+            time.sleep(10)
+
+    phase = api.get("TPUJob", namespace, name)["status"]["phase"]
+    assert phase == "Succeeded", f"TPUJob ended {phase!r}"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kft-e2e-tpujob")
+    parser.add_argument("--namespace", default="kubeflow-e2e")
+    parser.add_argument("--junit_path", default=None)
+    parser.add_argument("--fake", action="store_true")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    api = make_client(args.fake)
+    case = junit.run_case(
+        "tpujob-train",
+        lambda: submit_and_wait(api, args.namespace, fake=args.fake))
+    if args.junit_path:
+        junit.write_report(args.junit_path, "e2e-tpujob", [case])
+    if not case.ok:
+        print(case.failure or case.error, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,31 @@
+"""Unit-test CI step: run pytest with junit output.
+
+Reference analogue: the jsonnet-test step (``testing/workflows/
+components/workflows.libsonnet:226-232`` running ``test_jsonnet.py``)
+plus the http-proxy ``make test`` tier — here one pytest invocation
+covers both (manifest golden tests and runtime unit tests live in the
+same suite).
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kft-ci-unit")
+    parser.add_argument("--junit_path", default="junit_unit.xml")
+    parser.add_argument("--tests", default="tests/")
+    parser.add_argument("-k", dest="keyword", default=None)
+    args = parser.parse_args(argv)
+    cmd = [sys.executable, "-m", "pytest", args.tests, "-q",
+           f"--junitxml={args.junit_path}"]
+    if args.keyword:
+        cmd += ["-k", args.keyword]
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -20,6 +20,7 @@ _COMPONENT_MODULES = [
     "kubeflow_tpu.manifests.argo",
     "kubeflow_tpu.manifests.serving",
     "kubeflow_tpu.manifests.seldon",
+    "kubeflow_tpu.manifests.ci",
 ]
 
 import importlib as _importlib

@@ -1,0 +1,272 @@
+"""CI plane: Argo e2e and release Workflow builders.
+
+Replaces the reference's ksonnet CI components:
+
+- presubmit e2e DAG: ``testing/workflows/components/workflows.libsonnet``
+  — step DAG checkout → {setup, create-pr-symlink} → {tpujob-test,
+  unit-test, serving-test}, onExit teardown → copy-artifacts
+  (``:132-176``), with a buildTemplate helper injecting
+  PYTHONPATH/creds env + the shared NFS volume into every step
+  (``:58-99``), and prow env plumbing (``:5-20``).
+- release DAG: ``releasing/releaser/components/workflows.libsonnet``
+  — checkout → parallel image builds (DinD ``build_image.sh``) →
+  deploy + smoke test (``:135-163,197-337``).
+
+Same DAG shapes, TPU deltas: the tpujob E2E runs on a TPU nodepool,
+images are the zero-CUDA families (serving-tpu, notebook-tpu,
+trainer), and tests emit junit via kubeflow_tpu.utils.junit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from kubeflow_tpu.manifests import k8s
+from kubeflow_tpu.params import Param, register
+
+TEST_WORKER_IMAGE = "ghcr.io/kubeflow-tpu/test-worker:v0.1.0"
+DIND_IMAGE = "docker:24-dind"
+
+MOUNT_PATH = "/mnt/test-data-volume"
+
+
+def _step_template(
+    name: str,
+    command: Sequence[str],
+    *,
+    params: Dict[str, Any],
+    image: str = TEST_WORKER_IMAGE,
+    extra_env: Optional[List[Dict[str, Any]]] = None,
+    sidecars: Optional[List[Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """The buildTemplate equivalent (reference ``workflows.libsonnet:
+    58-99``): every step shares the NFS volume, artifact dir, and
+    credential env."""
+    env = [
+        k8s.env_var("PYTHONPATH", f"{params['src_dir']}"),
+        k8s.env_var("KFT_ARTIFACTS_DIR", params["artifacts_dir"]),
+        k8s.env_var("JOB_NAME", params["job_name"]),
+    ]
+    if params.get("gcp_credentials_secret"):
+        env.append(k8s.env_var(
+            "GOOGLE_APPLICATION_CREDENTIALS",
+            f"{MOUNT_PATH}/secrets/gcp-credentials/key.json"))
+    env.extend(extra_env or [])
+    template = {
+        "name": name,
+        "container": k8s._prune({
+            "name": name,
+            "image": image,
+            "command": list(command),
+            "env": env,
+            "volumeMounts": [
+                k8s.volume_mount(params["volume_name"], MOUNT_PATH),
+            ],
+            "workingDir": params["src_dir"],
+        }),
+    }
+    if sidecars:
+        template["sidecars"] = sidecars
+    return template
+
+
+def _dag_task(name: str, deps: Sequence[str]) -> Dict[str, Any]:
+    task = {"name": name, "template": name}
+    if deps:
+        task["dependencies"] = list(deps)
+    return task
+
+
+def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
+    """The presubmit Workflow CR (reference ``workflows.libsonnet:
+    100-248``)."""
+    name = params["name"]
+    namespace = params["namespace"]
+    src = params["src_dir"]
+    py = "python"
+
+    steps = {
+        "checkout": [
+            "/bin/sh", "-c",
+            f"mkdir -p {src} && git clone --depth=1 "
+            f"{params['repo']} {src} && cd {src} && "
+            f"git fetch origin {params['commit']} && "
+            f"git checkout {params['commit']}",
+        ],
+        "create-pr-symlink": [
+            py, "-m", "kubeflow_tpu.citests.artifacts", "create-pr-symlink",
+        ],
+        "unit-test": [
+            py, "-m", "kubeflow_tpu.citests.unit",
+            "--junit_path", f"{params['artifacts_dir']}/junit_unit.xml",
+        ],
+        "deploy-test": [
+            py, "-m", "kubeflow_tpu.citests.deploy", "setup",
+            "--namespace", params["test_namespace"],
+            "--junit_path", f"{params['artifacts_dir']}/junit_deploy.xml",
+        ],
+        "tpujob-test": [
+            py, "-m", "kubeflow_tpu.citests.tpujob",
+            "--namespace", params["test_namespace"],
+            "--junit_path", f"{params['artifacts_dir']}/junit_tpujob.xml",
+        ],
+        "serving-test": [
+            py, "-m", "kubeflow_tpu.citests.serving",
+            "--namespace", params["test_namespace"],
+            "--junit_path", f"{params['artifacts_dir']}/junit_serving.xml",
+        ],
+        "teardown": [
+            py, "-m", "kubeflow_tpu.citests.deploy", "teardown",
+            "--namespace", params["test_namespace"],
+            "--junit_path", f"{params['artifacts_dir']}/junit_teardown.xml",
+        ],
+        "copy-artifacts": [
+            py, "-m", "kubeflow_tpu.citests.artifacts", "copy",
+            "--bucket", params["bucket"],
+        ],
+    }
+    templates = [
+        _step_template(step, cmd, params=params)
+        for step, cmd in steps.items()
+    ]
+    templates.append({
+        "name": "e2e",
+        "dag": {"tasks": [
+            _dag_task("checkout", []),
+            _dag_task("create-pr-symlink", ["checkout"]),
+            _dag_task("unit-test", ["checkout"]),
+            _dag_task("deploy-test", ["checkout"]),
+            _dag_task("tpujob-test", ["deploy-test"]),
+            _dag_task("serving-test", ["deploy-test"]),
+        ]},
+    })
+    templates.append({
+        "name": "exit-handler",
+        "dag": {"tasks": [
+            _dag_task("teardown", []),
+            {"name": "copy-artifacts", "template": "copy-artifacts",
+             "dependencies": ["teardown"]},
+        ]},
+    })
+
+    return {
+        "apiVersion": "argoproj.io/v1alpha1",
+        "kind": "Workflow",
+        "metadata": k8s.metadata(name, namespace,
+                                 labels={"workflow": "kubeflow-tpu-e2e"}),
+        "spec": {
+            "entrypoint": "e2e",
+            "onExit": "exit-handler",
+            "volumes": [
+                {"name": params["volume_name"],
+                 "persistentVolumeClaim": {"claimName": params["nfs_claim"]}},
+            ],
+            "templates": templates,
+        },
+    }
+
+
+def release_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Image-release Workflow (reference ``releasing/releaser/components/
+    workflows.libsonnet:135-337``): checkout → parallel DinD image
+    builds → deploy → smoke test."""
+    name = params["name"]
+    registry = params["registry"]
+    tag = params["version_tag"]
+    src = params["src_dir"]
+
+    dind_sidecar = [{
+        "name": "dind",
+        "image": DIND_IMAGE,
+        "securityContext": {"privileged": True},
+        "mirrorVolumeMounts": True,
+    }]
+    build_env = [k8s.env_var("DOCKER_HOST", "127.0.0.1")]
+
+    image_families = ["serving-tpu", "serving-cpu", "http-proxy",
+                      "notebook-tpu", "trainer"]
+    templates = [
+        _step_template("checkout", [
+            "/bin/sh", "-c",
+            f"mkdir -p {src} && git clone --depth=1 {params['repo']} {src} "
+            f"&& cd {src} && git checkout {params['commit']}",
+        ], params=params),
+    ]
+    for family in image_families:
+        templates.append(_step_template(
+            f"build-{family}",
+            ["/bin/sh", f"{src}/images/build_image.sh",
+             family, f"{registry}/{family}:{tag}"],
+            params=params, extra_env=build_env, sidecars=dind_sidecar,
+        ))
+    templates.append(_step_template(
+        "smoke-test",
+        ["python", "-m", "kubeflow_tpu.citests.serving",
+         "--namespace", params["test_namespace"],
+         "--junit_path", f"{params['artifacts_dir']}/junit_release.xml"],
+        params=params,
+    ))
+    templates.append({
+        "name": "release",
+        "dag": {"tasks": [
+            _dag_task("checkout", []),
+            *[_dag_task(f"build-{f}", ["checkout"]) for f in image_families],
+            _dag_task("smoke-test",
+                      [f"build-{f}" for f in image_families]),
+        ]},
+    })
+    return {
+        "apiVersion": "argoproj.io/v1alpha1",
+        "kind": "Workflow",
+        "metadata": k8s.metadata(name, params["namespace"],
+                                 labels={"workflow": "kubeflow-tpu-release"}),
+        "spec": {
+            "entrypoint": "release",
+            "volumes": [
+                {"name": params["volume_name"],
+                 "persistentVolumeClaim": {"claimName": params["nfs_claim"]}},
+            ],
+            "templates": templates,
+        },
+    }
+
+
+_COMMON_PARAMS = [
+    Param("name", "workflow object name", required=True),
+    Param("namespace", "namespace to run the workflow in",
+          default="kubeflow-test-infra"),
+    Param("repo", "git repo URL to test",
+          default="https://github.com/kubeflow-tpu/kubeflow-tpu.git"),
+    Param("commit", "commit/ref to check out", default="HEAD"),
+    Param("bucket", "GCS bucket for junit artifacts",
+          default="kubeflow-tpu-ci-results"),
+    Param("nfs_claim", "shared NFS PVC for step state",
+          default="nfs-external"),
+    Param("volume_name", "workflow volume name", default="test-data-volume"),
+    Param("src_dir", "checkout dir on the shared volume",
+          default=f"{MOUNT_PATH}/src/kubeflow-tpu"),
+    Param("artifacts_dir", "junit/log output dir",
+          default=f"{MOUNT_PATH}/artifacts"),
+    Param("job_name", "prow job name (env passthrough)", default="manual"),
+    Param("test_namespace", "ephemeral namespace for the deploy test",
+          default="kubeflow-e2e"),
+    Param("gcp_credentials_secret", "secret with GCP SA key (optional)",
+          default=""),
+]
+
+
+@register("ci-e2e", "Presubmit E2E Argo workflow (deploy, tpujob, serving)",
+          _COMMON_PARAMS, package="ci")
+def _build_e2e(params: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return [e2e_workflow(params)]
+
+
+@register("ci-release",
+          "Image release Argo workflow (DinD builds + smoke test)",
+          _COMMON_PARAMS + [
+              Param("registry", "image registry",
+                    default="ghcr.io/kubeflow-tpu"),
+              Param("version_tag", "image tag to publish", required=True),
+          ], package="ci")
+def _build_release(params: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return [release_workflow(params)]

@@ -1,0 +1,85 @@
+"""junit XML emission — the CI artifact contract.
+
+The reference wrapped every E2E phase in junit TestCases uploaded to
+GCS for gubernator (``testing/test_deploy.py:231-248`` via the
+kubeflow.testing helper package). Same shape here, dependency-free:
+``TestCase`` records wrap callables, a suite serializes to junit XML.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import traceback
+from pathlib import Path
+from typing import Callable, List, Optional
+from xml.sax.saxutils import escape
+
+
+@dataclasses.dataclass
+class TestCase:
+    name: str
+    class_name: str = "e2e"
+    time_s: float = 0.0
+    failure: Optional[str] = None
+    error: Optional[str] = None
+    skipped: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return self.failure is None and self.error is None
+
+
+def run_case(name: str, fn: Callable[[], None],
+             class_name: str = "e2e") -> TestCase:
+    """Run ``fn`` as a junit case: assertion → failure, other
+    exceptions → error (the junit distinction gubernator renders)."""
+    case = TestCase(name=name, class_name=class_name)
+    start = time.perf_counter()
+    try:
+        fn()
+    except AssertionError:
+        case.failure = traceback.format_exc()
+    except Exception:  # noqa: BLE001 — the harness must keep going
+        case.error = traceback.format_exc()
+    case.time_s = time.perf_counter() - start
+    return case
+
+
+def to_xml(suite_name: str, cases: List[TestCase]) -> str:
+    failures = sum(1 for c in cases if c.failure is not None)
+    errors = sum(1 for c in cases if c.error is not None)
+    skipped = sum(1 for c in cases if c.skipped)
+    total_time = sum(c.time_s for c in cases)
+    lines = [
+        '<?xml version="1.0" encoding="UTF-8"?>',
+        f'<testsuite name="{escape(suite_name)}" tests="{len(cases)}" '
+        f'failures="{failures}" errors="{errors}" skipped="{skipped}" '
+        f'time="{total_time:.3f}">',
+    ]
+    for c in cases:
+        open_tag = (f'  <testcase name="{escape(c.name)}" '
+                    f'classname="{escape(c.class_name)}" '
+                    f'time="{c.time_s:.3f}"')
+        if c.ok and not c.skipped:
+            lines.append(open_tag + "/>")
+            continue
+        lines.append(open_tag + ">")
+        if c.skipped:
+            lines.append("    <skipped/>")
+        if c.failure is not None:
+            lines.append(
+                f'    <failure message="failed">{escape(c.failure)}</failure>')
+        if c.error is not None:
+            lines.append(
+                f'    <error message="error">{escape(c.error)}</error>')
+        lines.append("  </testcase>")
+    lines.append("</testsuite>")
+    return "\n".join(lines)
+
+
+def write_report(path: str, suite_name: str, cases: List[TestCase]) -> Path:
+    out = Path(path)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(to_xml(suite_name, cases))
+    return out

@@ -1,0 +1,88 @@
+"""CI plane: junit emission, workflow manifests, E2E drivers in fake
+mode (the full presubmit DAG exercised hermetically)."""
+
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from kubeflow_tpu.citests import deploy as ci_deploy
+from kubeflow_tpu.citests import tpujob as ci_tpujob
+from kubeflow_tpu.params.registry import get_prototype
+from kubeflow_tpu.utils import junit
+
+
+def test_junit_xml_shape(tmp_path):
+    cases = [
+        junit.run_case("passes", lambda: None),
+        junit.run_case("fails", lambda: (_ for _ in ()).throw(
+            AssertionError("nope"))),
+        junit.run_case("errors", lambda: (_ for _ in ()).throw(
+            RuntimeError("boom"))),
+    ]
+    path = junit.write_report(str(tmp_path / "junit.xml"), "suite", cases)
+    root = ET.parse(path).getroot()
+    assert root.tag == "testsuite"
+    assert root.get("tests") == "3"
+    assert root.get("failures") == "1"
+    assert root.get("errors") == "1"
+    kinds = {c.get("name"): [e.tag for e in c] for c in root}
+    assert kinds["passes"] == []
+    assert kinds["fails"] == ["failure"]
+    assert kinds["errors"] == ["error"]
+
+
+def test_e2e_workflow_manifest():
+    objs = get_prototype("ci-e2e").build({"name": "pr-123"})
+    wf = objs[0]
+    assert wf["kind"] == "Workflow"
+    assert wf["spec"]["entrypoint"] == "e2e"
+    assert wf["spec"]["onExit"] == "exit-handler"
+    names = {t["name"] for t in wf["spec"]["templates"]}
+    for step in ("checkout", "unit-test", "deploy-test", "tpujob-test",
+                 "serving-test", "teardown", "copy-artifacts", "e2e"):
+        assert step in names, step
+    dag = next(t for t in wf["spec"]["templates"] if t["name"] == "e2e")
+    deps = {t["name"]: t.get("dependencies", [])
+            for t in dag["dag"]["tasks"]}
+    assert deps["tpujob-test"] == ["deploy-test"]
+    assert deps["deploy-test"] == ["checkout"]
+
+
+def test_release_workflow_manifest():
+    objs = get_prototype("ci-release").build(
+        {"name": "rel-1", "version_tag": "v0.2.0"})
+    wf = objs[0]
+    names = {t["name"] for t in wf["spec"]["templates"]}
+    assert "build-serving-tpu" in names
+    assert "build-notebook-tpu" in names
+    build = next(t for t in wf["spec"]["templates"]
+                 if t["name"] == "build-serving-tpu")
+    assert build["sidecars"][0]["securityContext"]["privileged"]
+    assert "v0.2.0" in " ".join(build["container"]["command"])
+    # zero-CUDA invariant: no gpu image family anywhere
+    assert not any("gpu" in n for n in names)
+
+
+def test_deploy_and_tpujob_fake_e2e(tmp_path):
+    junit_deploy = tmp_path / "junit_deploy.xml"
+    rc = ci_deploy.main(["setup", "--fake", "--namespace", "e2e-ns",
+                         "--junit_path", str(junit_deploy)])
+    assert rc == 0
+    root = ET.parse(junit_deploy).getroot()
+    assert root.get("failures") == "0" and root.get("errors") == "0"
+
+    junit_job = tmp_path / "junit_tpujob.xml"
+    rc = ci_tpujob.main(["--fake", "--namespace", "e2e-ns",
+                         "--junit_path", str(junit_job)])
+    assert rc == 0
+    root = ET.parse(junit_job).getroot()
+    assert root.get("failures") == "0" and root.get("errors") == "0"
+
+
+@pytest.mark.slow
+def test_serving_fake_e2e(tmp_path):
+    from kubeflow_tpu.citests import serving as ci_serving
+
+    junit_path = tmp_path / "junit_serving.xml"
+    rc = ci_serving.main(["--fake", "--junit_path", str(junit_path)])
+    assert rc == 0
