@@ -22,7 +22,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Sequence
 
 from kubeflow_tpu.manifests import k8s
-from kubeflow_tpu.params import Param, register
+from kubeflow_tpu.params import Param, REQUIRED, register
 
 TEST_WORKER_IMAGE = "ghcr.io/kubeflow-tpu/test-worker:v0.1.0"
 DIND_IMAGE = "docker:24-dind"
@@ -232,26 +232,28 @@ def release_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
 
 
 _COMMON_PARAMS = [
-    Param("name", "workflow object name", required=True),
-    Param("namespace", "namespace to run the workflow in",
-          default="kubeflow-test-infra"),
-    Param("repo", "git repo URL to test",
-          default="https://github.com/kubeflow-tpu/kubeflow-tpu.git"),
-    Param("commit", "commit/ref to check out", default="HEAD"),
-    Param("bucket", "GCS bucket for junit artifacts",
-          default="kubeflow-tpu-ci-results"),
-    Param("nfs_claim", "shared NFS PVC for step state",
-          default="nfs-external"),
-    Param("volume_name", "workflow volume name", default="test-data-volume"),
-    Param("src_dir", "checkout dir on the shared volume",
-          default=f"{MOUNT_PATH}/src/kubeflow-tpu"),
-    Param("artifacts_dir", "junit/log output dir",
-          default=f"{MOUNT_PATH}/artifacts"),
-    Param("job_name", "prow job name (env passthrough)", default="manual"),
-    Param("test_namespace", "ephemeral namespace for the deploy test",
-          default="kubeflow-e2e"),
-    Param("gcp_credentials_secret", "secret with GCP SA key (optional)",
-          default=""),
+    Param("name", REQUIRED, "string", "workflow object name"),
+    Param("namespace", "kubeflow-test-infra", "string",
+          "namespace to run the workflow in"),
+    Param("repo", "https://github.com/kubeflow-tpu/kubeflow-tpu.git",
+          "string", "git repo URL to test"),
+    Param("commit", "HEAD", "string", "commit/ref to check out"),
+    Param("bucket", "kubeflow-tpu-ci-results", "string",
+          "GCS bucket for junit artifacts"),
+    Param("nfs_claim", "nfs-external", "string",
+          "shared NFS PVC for step state"),
+    Param("volume_name", "test-data-volume", "string",
+          "workflow volume name"),
+    Param("src_dir", f"{MOUNT_PATH}/src/kubeflow-tpu", "string",
+          "checkout dir on the shared volume"),
+    Param("artifacts_dir", f"{MOUNT_PATH}/artifacts", "string",
+          "junit/log output dir"),
+    Param("job_name", "manual", "string",
+          "prow job name (env passthrough)"),
+    Param("test_namespace", "kubeflow-e2e", "string",
+          "ephemeral namespace for the deploy test"),
+    Param("gcp_credentials_secret", "", "string",
+          "secret with GCP SA key (optional)"),
 ]
 
 
@@ -264,9 +266,10 @@ def _build_e2e(params: Dict[str, Any]) -> List[Dict[str, Any]]:
 @register("ci-release",
           "Image release Argo workflow (DinD builds + smoke test)",
           _COMMON_PARAMS + [
-              Param("registry", "image registry",
-                    default="ghcr.io/kubeflow-tpu"),
-              Param("version_tag", "image tag to publish", required=True),
+              Param("registry", "ghcr.io/kubeflow-tpu", "string",
+                    "image registry"),
+              Param("version_tag", REQUIRED, "string",
+                    "image tag to publish"),
           ], package="ci")
 def _build_release(params: Dict[str, Any]) -> List[Dict[str, Any]]:
     return [release_workflow(params)]

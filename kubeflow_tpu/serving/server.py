@@ -190,6 +190,9 @@ def main(argv=None) -> int:
         format="%(levelname)s|%(asctime)s|%(pathname)s|%(lineno)d| %(message)s",
         datefmt="%Y-%m-%dT%H:%M:%S",
     )
+    from kubeflow_tpu.utils.platform import sync_platform_from_env
+
+    sync_platform_from_env()
     manager = ModelManager(poll_interval_s=args.poll_interval)
     # Defer the (slow) first model load to the poll thread: the port
     # opens immediately and /healthz answers 503 until loaded, so

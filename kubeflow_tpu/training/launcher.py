@@ -99,6 +99,9 @@ def launch(argv: Optional[List[str]] = None, env=os.environ) -> int:
         datefmt="%Y-%m-%dT%H:%M:%S",
     )
     argv = list(sys.argv[1:] if argv is None else argv)
+    from kubeflow_tpu.utils.platform import sync_platform_from_env
+
+    sync_platform_from_env()
     initialize_distributed(env)
     if argv:
         rc = run_and_stream(argv)

@@ -1,0 +1,29 @@
+"""Make the JAX_PLATFORMS env var authoritative.
+
+Some environments (notably hosted TPU tunnels) register their PJRT
+plugin from ``sitecustomize`` and force the platform with an explicit
+``jax.config.update("jax_platforms", ...)`` — which silently overrides
+the ``JAX_PLATFORMS`` env var a parent process set when spawning a
+subprocess. A worker meant to run CPU-only (tests, the fake-mode
+serving server, multi-chip dry runs) then dispatches every eager op to
+the remote TPU instead.
+
+Entry points that honor the env contract call
+:func:`sync_platform_from_env` before touching any backend.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def sync_platform_from_env() -> None:
+    """Re-assert ``JAX_PLATFORMS`` from the environment over any value
+    baked into jax config by site hooks. No-op when the env var is
+    unset. Must run before the first backend use."""
+    platforms = os.environ.get("JAX_PLATFORMS", "").strip()
+    if not platforms:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", platforms)

@@ -18,6 +18,8 @@ OVERRIDES = {
     "seldon-serve-simple": {"name": "m", "image": "img:1"},
     "nfs": {"disks": "disk1,disk2"},
     "spartakus": {"report_usage": "true"},
+    "ci-e2e": {"name": "kubeflow-tpu-e2e"},
+    "ci-release": {"name": "kubeflow-tpu-release", "version_tag": "v0.1.0"},
 }
 
 
