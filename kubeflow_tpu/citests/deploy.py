@@ -23,6 +23,7 @@ logger = logging.getLogger(__name__)
 
 OPERATOR_DEPLOYMENT = "tpujob-operator"
 HUB_STATEFULSET = "tpu-hub"
+SERVING_NAME = "tpu-serving"
 
 
 def make_client(fake: bool):
@@ -72,13 +73,50 @@ def setup(api, namespace: str, *, fake: bool,
     logger.info("control plane ready in %s", namespace)
 
 
+def deploy_serving(api, namespace: str, *, fake: bool,
+                   model_path: str = "gs://kubeflow-tpu-models/resnet",
+                   timeout_s: float = 300.0) -> None:
+    """Apply the tpu-serving prototype and wait for the server to come
+    up — kubeflow-core alone never creates the serving Service the
+    serving e2e targets (reference ``test_deploy.py deploy_model``,
+    ``:184-217``)."""
+    from kubeflow_tpu.operator.fake import NotFound
+
+    objs = get_prototype("tpu-serving").build({
+        "name": SERVING_NAME, "namespace": namespace,
+        "model_path": model_path,
+        # The serving e2e queries /v1/models/resnet; without this the
+        # server would default model_name to the component name.
+        "model_name": "resnet",
+    })
+    for obj in objs:
+        try:
+            api.create(obj)
+        except RuntimeError as e:
+            if "AlreadyExists" not in str(e):
+                raise
+    deadline = time.monotonic() + (0 if fake else timeout_s)
+    while True:
+        try:
+            deploy = api.get("Deployment", namespace, SERVING_NAME)
+            if fake or deploy.get("status", {}).get("readyReplicas", 0) >= 1:
+                break
+        except NotFound:
+            pass
+        if time.monotonic() > deadline:
+            raise AssertionError(f"serving not ready in {timeout_s}s")
+        time.sleep(5)
+    logger.info("serving %s ready in %s", SERVING_NAME, namespace)
+
+
 def teardown(api, namespace: str) -> None:
     api.delete("Namespace", "", namespace)
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kft-e2e-deploy")
-    parser.add_argument("command", choices=["setup", "teardown"])
+    parser.add_argument("command",
+                        choices=["setup", "deploy-serving", "teardown"])
     parser.add_argument("--namespace", default="kubeflow-e2e")
     parser.add_argument("--junit_path", default=None)
     parser.add_argument("--fake", action="store_true")
@@ -90,6 +128,10 @@ def main(argv=None) -> int:
         case = junit.run_case(
             "deploy-kubeflow-core",
             lambda: setup(api, args.namespace, fake=args.fake))
+    elif args.command == "deploy-serving":
+        case = junit.run_case(
+            "deploy-serving",
+            lambda: deploy_serving(api, args.namespace, fake=args.fake))
     else:
         case = junit.run_case(
             "teardown", lambda: teardown(api, args.namespace))

@@ -105,6 +105,14 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             "--namespace", params["test_namespace"],
             "--junit_path", f"{params['artifacts_dir']}/junit_deploy.xml",
         ],
+        # kubeflow-core has no serving objects; the serving e2e needs
+        # the tpu-serving prototype applied first.
+        "deploy-serving": [
+            py, "-m", "kubeflow_tpu.citests.deploy", "deploy-serving",
+            "--namespace", params["test_namespace"],
+            "--junit_path",
+            f"{params['artifacts_dir']}/junit_deploy_serving.xml",
+        ],
         "tpujob-test": [
             py, "-m", "kubeflow_tpu.citests.tpujob",
             "--namespace", params["test_namespace"],
@@ -136,8 +144,9 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             _dag_task("create-pr-symlink", ["checkout"]),
             _dag_task("unit-test", ["checkout"]),
             _dag_task("deploy-test", ["checkout"]),
+            _dag_task("deploy-serving", ["deploy-test"]),
             _dag_task("tpujob-test", ["deploy-test"]),
-            _dag_task("serving-test", ["deploy-test"]),
+            _dag_task("serving-test", ["deploy-serving"]),
         ]},
     })
     templates.append({

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu.models.registry import ModelEntry, register_model
-from kubeflow_tpu.ops.attention import dense_attention
+from kubeflow_tpu.ops.flash_attention import flash_attention
 
 AttentionFn = Callable[..., jax.Array]
 
@@ -62,10 +62,12 @@ class BertSelfAttention(nn.Module):
         split = lambda t: t.reshape(
             t.shape[0], t.shape[1], self.num_heads, head_dim
         )
-        attn = self.attention_fn or functools.partial(
-            dense_attention, kv_segment_valid=valid
-        )
-        out = attn(split(q), split(k), split(v))
+        # Every attention impl (dense/blockwise/flash/ring/ulysses)
+        # takes the padding mask as kv_segment_valid, so a custom
+        # attention_fn (the sequence-parallel path) masks padded keys
+        # exactly like the default — not silently attending to them.
+        attn = self.attention_fn or flash_attention
+        out = attn(split(q), split(k), split(v), kv_segment_valid=valid)
         out = out.reshape(out.shape[0], out.shape[1], d_model)
         return proj(d_model, ("heads", "embed"), name="out")(out)
 
@@ -112,8 +114,9 @@ class Bert(nn.Module):
         b, l = input_ids.shape
         if type_ids is None:
             type_ids = jnp.zeros_like(input_ids)
-        if valid is None:
-            valid = jnp.ones_like(input_ids)
+        # valid=None stays None: the no-padding case skips the mask
+        # branch in every attention impl instead of carrying an
+        # all-ones array through the kernel.
 
         embed = nn.Embed(
             self.vocab_size, self.d_model,

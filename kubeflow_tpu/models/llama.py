@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu.models.registry import ModelEntry, register_model
-from kubeflow_tpu.ops.attention import blockwise_attention, dense_attention
+from kubeflow_tpu.ops.flash_attention import flash_attention
 
 AttentionFn = Callable[..., jax.Array]
 
@@ -70,7 +70,6 @@ class LlamaAttention(nn.Module):
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[AttentionFn] = None
-    blockwise_threshold: int = 2048
 
     @nn.compact
     def __call__(self, x, positions):
@@ -88,10 +87,11 @@ class LlamaAttention(nn.Module):
         k = rope(k, positions, self.rope_theta)
         if self.attention_fn is not None:
             out = self.attention_fn(q, k, v)
-        elif l >= self.blockwise_threshold:
-            out = blockwise_attention(q, k, v, causal=True)
         else:
-            out = dense_attention(q, k, v, causal=True)
+            # Default: fused Pallas flash kernel (falls back to XLA
+            # blockwise internally on non-dividing shapes), O(L·block)
+            # memory at any length.
+            out = flash_attention(q, k, v, causal=True)
         out = out.reshape(b, l, self.num_heads * self.head_dim)
         return _dense(d_model, ("heads", "embed"), self.dtype, "o_proj")(out)
 

@@ -160,6 +160,19 @@ def attention_finalize(
     return (o / norm).astype(dtype)
 
 
+def _fit_block_size(length: int, block_size: int) -> int:
+    """Largest divisor of ``length`` ≤ ``block_size`` — keeps the
+    O(Lq · block) memory bound when lengths don't divide the requested
+    block (degenerating to one full-size block would silently lose it,
+    exactly for the long odd sequences that need it most)."""
+    if length % block_size == 0:
+        return block_size
+    for candidate in range(block_size, 0, -1):
+        if length % candidate == 0:
+            return candidate
+    return length
+
+
 def blockwise_attention(
     q: jax.Array,
     k: jax.Array,
@@ -168,38 +181,56 @@ def blockwise_attention(
     block_size: int = 512,
     causal: bool = False,
     scale: Optional[float] = None,
+    kv_segment_valid: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Memory-efficient attention: scan over KV blocks with online
     softmax. O(Lq · block) live memory instead of O(Lq · Lk); the
-    single-device analogue of ring attention.
+    single-device analogue of ring attention. ``kv_segment_valid`` is
+    an optional [B, Lk] 0/1 mask for padded keys.
     """
     b, lq, h, d = q.shape
     lk = k.shape[1]
     scale = d ** -0.5 if scale is None else scale
+    block_size = min(block_size, lk)
     if lk % block_size:
-        block_size = lk  # degenerate: one block
+        best = _fit_block_size(lk, block_size)
+        if best >= min(128, block_size):
+            block_size = best
+        else:
+            # Awkward lengths (primes, near-primes) have no usable
+            # divisor; a tiny block would turn the scan into Lk
+            # sequential single-key updates. Pad KV to a block
+            # multiple instead — the validity mask makes padded keys
+            # inert.
+            pad = block_size - lk % block_size
+            k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            if kv_segment_valid is None:
+                kv_segment_valid = jnp.ones((b, lk), jnp.int32)
+            kv_segment_valid = jnp.pad(kv_segment_valid,
+                                       ((0, 0), (0, pad)))
+            lk += pad
     n_blocks = lk // block_size
 
-    k_blocks = k.reshape(b, n_blocks, block_size, k.shape[2], d)
-    v_blocks = v.reshape(b, n_blocks, block_size, v.shape[2], d)
+    k_blocks = jnp.moveaxis(
+        k.reshape(b, n_blocks, block_size, k.shape[2], d), 1, 0)
+    v_blocks = jnp.moveaxis(
+        v.reshape(b, n_blocks, block_size, v.shape[2], d), 1, 0)
+    xs = (jnp.arange(n_blocks), k_blocks, v_blocks)
+    if kv_segment_valid is not None:
+        xs = xs + (jnp.moveaxis(
+            kv_segment_valid.reshape(b, n_blocks, block_size), 1, 0),)
 
     def body(carry, inputs):
-        idx, k_blk, v_blk = inputs
+        idx, k_blk, v_blk = inputs[:3]
+        mask_blk = inputs[3] if len(inputs) > 3 else None
         carry = attention_block_update(
             carry, q, k_blk, v_blk,
             scale=scale, q_offset=0, kv_offset=idx * block_size,
-            causal=causal,
+            causal=causal, kv_segment_valid=mask_blk,
         )
         return carry, None
 
     carry = attention_init_carry(b, lq, h, d)
-    (o, _, l), _ = jax.lax.scan(
-        body,
-        carry,
-        (
-            jnp.arange(n_blocks),
-            jnp.moveaxis(k_blocks, 1, 0),
-            jnp.moveaxis(v_blocks, 1, 0),
-        ),
-    )
+    (o, _, l), _ = jax.lax.scan(body, carry, xs)
     return attention_finalize(o, l, q.dtype)

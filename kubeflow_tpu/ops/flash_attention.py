@@ -46,8 +46,13 @@ DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale: float, causal: bool, block_q: int, block_k: int):
+def _flash_kernel(q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
+                  block_q: int, block_k: int, has_mask: bool):
+    if has_mask:
+        mask_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        mask_ref = None
+        o_ref, acc_ref, m_ref, l_ref = rest
     i = pl.program_id(1)  # q block
     j = pl.program_id(2)  # kv block
     nk = pl.num_programs(2)
@@ -71,6 +76,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if has_mask:
+            # (1, block_k) 0/1 row of padded-key validity, broadcast
+            # over the q rows.
+            s = jnp.where(mask_ref[:] != 0, s, NEG_INF)
 
         m_prev = m_ref[:, :1]  # (block_q, 1)
         l_prev = l_ref[:, :1]
@@ -102,23 +111,31 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         o_ref[0] = (acc_ref[:] / norm).astype(o_ref.dtype)
 
 
-def _flash_bhld(q, k, v, *, scale: float, causal: bool,
-                block_q: int, block_k: int, interpret: bool):
-    """Kernel launch on [BH, L, D] tensors."""
+def _flash_bhld(q, k, v, mask, *, num_heads: int, scale: float,
+                causal: bool, block_q: int, block_k: int, interpret: bool):
+    """Kernel launch on [BH, L, D] tensors; ``mask`` is [B, Lk] or None."""
     bh, lq, d = q.shape
     lk = k.shape[1]
     grid = (bh, lq // block_q, lk // block_k)
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k)
+        block_q=block_q, block_k=block_k, has_mask=mask is not None)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    inputs = (q, k, v)
+    if mask is not None:
+        # One (1, block_k) row per kv block, shared by every head of
+        # the same batch element (grid dim 0 is batch-major b*h).
+        in_specs.append(pl.BlockSpec(
+            (1, block_k), lambda b, i, j: (b // num_heads, j)))
+        inputs = inputs + (mask,)
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
         scratch_shapes=[
@@ -127,7 +144,7 @@ def _flash_bhld(q, k, v, *, scale: float, causal: bool,
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
 
 
 def _to_bhld(x):
@@ -144,7 +161,7 @@ def _from_bhld(x, b, h):
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
     b, lq, h, d = q.shape
     out = _flash_bhld(
-        _to_bhld(q), _to_bhld(k), _to_bhld(v),
+        _to_bhld(q), _to_bhld(k), _to_bhld(v), None, num_heads=h,
         scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret)
     return _from_bhld(out, b, h)
@@ -170,6 +187,37 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_masked(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+    b, lq, h, d = q.shape
+    out = _flash_bhld(
+        _to_bhld(q), _to_bhld(k), _to_bhld(v), mask, num_heads=h,
+        scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+    return _from_bhld(out, b, h)
+
+
+def _flash_masked_fwd(q, k, v, mask, causal, scale, block_q, block_k,
+                      interpret):
+    out = _flash_masked(q, k, v, mask, causal, scale, block_q, block_k,
+                        interpret)
+    return out, (q, k, v, mask)
+
+
+def _flash_masked_bwd(causal, scale, block_q, block_k, interpret,
+                      residuals, g):
+    q, k, v, mask = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, block_size=block_k, causal=causal, scale=scale,
+            kv_segment_valid=mask),
+        q, k, v)
+    return vjp(g) + (jnp.zeros_like(mask),)
+
+
+_flash_masked.defvjp(_flash_masked_fwd, _flash_masked_bwd)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -179,13 +227,15 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    kv_segment_valid: Optional[jax.Array] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Fused attention on [B, L, H, D]; GQA KV heads are expanded.
 
-    Falls back to :func:`blockwise_attention` when sequence lengths
-    don't divide the block sizes (or head_dim < 8, below the fp32
-    sublane tile).
+    ``kv_segment_valid`` is an optional [B, Lk] 0/1 mask for padded
+    keys (threaded into the kernel as a per-block row). Falls back to
+    :func:`blockwise_attention` when sequence lengths don't divide the
+    block sizes (or head_dim < 8, below the fp32 sublane tile).
     """
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -197,7 +247,21 @@ def flash_attention(
     block_k = min(block_k, lk)
     if lq % block_q or lk % block_k or d % 8:
         return blockwise_attention(q, k, v, block_size=min(512, lk),
-                                   causal=causal, scale=scale)
+                                   causal=causal, scale=scale,
+                                   kv_segment_valid=kv_segment_valid)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+        if jax.default_backend() != "tpu":
+            # Non-TPU: run the XLA blockwise path — the same online-
+            # softmax algorithm, compiled. Interpret-mode Pallas is a
+            # kernel-debugging tool (python-level grid loops), far too
+            # slow as a routine CPU path; pass interpret=True to force
+            # the kernel (kernel-correctness tests do).
+            return blockwise_attention(q, k, v, block_size=min(512, lk),
+                                       causal=causal, scale=scale,
+                                       kv_segment_valid=kv_segment_valid)
+        interpret = False
+    if kv_segment_valid is None:
+        return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    mask = kv_segment_valid.astype(jnp.float32)
+    return _flash_masked(q, k, v, mask, causal, scale, block_q, block_k,
+                         interpret)

@@ -137,6 +137,35 @@ def fsdp_params_sharding(mesh: Mesh, params: Any,
     return jax.tree.map(spec_for, params)
 
 
+def mirror_param_shardings(opt_tree: Any, params_sh: Any,
+                           replicated_sh: NamedSharding) -> Any:
+    """Shard optimizer-state leaves like the params they mirror.
+
+    Optax states embed copies of the param tree (adam ``mu``/``nu``,
+    sgd ``trace``), so a mirrored leaf's tree path *ends with* the full
+    path of its param. Matching by path rather than shape keeps
+    same-shaped params with different layouts (e.g. an attention query
+    kernel ``('embed','heads')`` vs its out kernel
+    ``('heads','embed')``, both (d, d)) on their own shardings —
+    a shape match would force resharding collectives between grads and
+    moments every step. Leaves that mirror no param (step counters)
+    replicate.
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(params_sh)
+    by_path = {tuple(map(str, path)): sh for path, sh in flat}
+
+    def lookup(path, leaf):
+        del leaf
+        keys = tuple(map(str, path))
+        for start in range(len(keys)):
+            sh = by_path.get(keys[start:])
+            if sh is not None:
+                return sh
+        return replicated_sh
+
+    return jax.tree_util.tree_map_with_path(lookup, opt_tree)
+
+
 def logical_sharding(mesh: Mesh, logical_axes: Any,
                      rules: Dict[str, Optional[str]]) -> Any:
     """Map a pytree of logical-axis tuples to NamedShardings via rules.

@@ -51,12 +51,15 @@ def ring_attention(
     axis_name: str = "seq",
     causal: bool = False,
     scale: Optional[float] = None,
+    kv_segment_valid: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Ring attention over ``axis_name``. Call INSIDE shard_map.
 
     ``q, k, v``: local shards ``[batch, seq_local, heads, head_dim]``,
     the global sequence laid out contiguously along the axis (device i
-    holds positions ``[i*L, (i+1)*L)``).
+    holds positions ``[i*L, (i+1)*L)``). ``kv_segment_valid`` is the
+    local [batch, seq_local] 0/1 padding mask; it rotates around the
+    ring with its KV shard.
     """
     b, l_local, h, d = q.shape
     scale = d ** -0.5 if scale is None else scale
@@ -66,26 +69,29 @@ def ring_attention(
     # Rotate KV shards "forward" one neighbor per step: after s steps,
     # device i holds the shard that started on device (i - s) mod n.
     perm = [(j, (j + 1) % n) for j in range(n)]
+    has_mask = kv_segment_valid is not None
 
     def body(step, carry):
-        o, m, l, k_blk, v_blk = carry
+        o, m, l, ring = carry
         src_idx = (my_idx - step) % n
         o, m, l = attention_block_update(
-            (o, m, l), q, k_blk, v_blk,
+            (o, m, l), q, ring[0], ring[1],
             scale=scale, q_offset=q_offset,
             kv_offset=src_idx * l_local, causal=causal,
+            kv_segment_valid=ring[2] if has_mask else None,
         )
         # No permute needed after the final accumulation.
-        k_blk, v_blk = jax.lax.cond(
+        ring = jax.lax.cond(
             step < n - 1,
-            lambda kv: jax.lax.ppermute(kv, axis_name, perm),
-            lambda kv: kv,
-            (k_blk, v_blk),
+            lambda t: jax.lax.ppermute(t, axis_name, perm),
+            lambda t: t,
+            ring,
         )
-        return o, m, l, k_blk, v_blk
+        return o, m, l, ring
 
-    carry = (*attention_init_carry(b, l_local, h, d), k, v)
-    o, _, l, _, _ = jax.lax.fori_loop(0, n, body, carry)
+    ring = (k, v, kv_segment_valid) if has_mask else (k, v)
+    carry = (*attention_init_carry(b, l_local, h, d), ring)
+    o, _, l, _ = jax.lax.fori_loop(0, n, body, carry)
     return attention_finalize(o, l, q.dtype)
 
 
@@ -97,16 +103,19 @@ def ulysses_attention(
     axis_name: str = "seq",
     causal: bool = False,
     scale: Optional[float] = None,
+    kv_segment_valid: Optional[jax.Array] = None,
 ) -> jax.Array:
     """All-to-all sequence parallelism. Call INSIDE shard_map.
 
     Re-shards [B, L/N, H, D] → [B, L, H/N, D] (full sequence, head
     subset), runs dense attention, and re-shards back. Head counts must
-    divide by the axis size.
+    divide by the axis size. ``kv_segment_valid`` is the local
+    [B, L/N] padding mask.
     """
     n = jax.lax.axis_size(axis_name)
     if n == 1:
-        return dense_attention(q, k, v, causal=causal, scale=scale)
+        return dense_attention(q, k, v, causal=causal, scale=scale,
+                               kv_segment_valid=kv_segment_valid)
 
     def seq_to_heads(x):
         # [B, L/N, H, D] → [B, L, H/N, D]: split heads, gather seq.
@@ -119,9 +128,15 @@ def ulysses_attention(
             x, axis_name, split_axis=1, concat_axis=2, tiled=True
         )
 
+    full_mask = None
+    if kv_segment_valid is not None:
+        # Heads are re-sharded but keys become full-length: every
+        # device needs the whole [B, L] padding mask.
+        full_mask = jax.lax.all_gather(
+            kv_segment_valid, axis_name, axis=1, tiled=True)
     o = dense_attention(
         seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
-        causal=causal, scale=scale,
+        causal=causal, scale=scale, kv_segment_valid=full_mask,
     )
     return heads_to_seq(o)
 
@@ -157,14 +172,23 @@ def make_sequence_parallel_attention(
         raise ValueError(f"unknown strategy {strategy!r}")
 
     spec = P(batch_axes, seq_axis, h_axis, None)
+    mask_spec = P(batch_axes, seq_axis)
 
-    def fn(q, k, v):
+    def fn(q, k, v, *, kv_segment_valid=None):
+        if kv_segment_valid is None:
+            return jax.shard_map(
+                lambda a, b, c: inner(a, b, c),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )(q, k, v)
         return jax.shard_map(
-            lambda a, b, c: inner(a, b, c),
+            lambda a, b, c, mv: inner(a, b, c, kv_segment_valid=mv),
             mesh=mesh,
-            in_specs=(spec, spec, spec),
+            in_specs=(spec, spec, spec, mask_spec),
             out_specs=spec,
             check_vma=False,
-        )(q, k, v)
+        )(q, k, v, kv_segment_valid)
 
     return fn

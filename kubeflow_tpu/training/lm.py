@@ -20,7 +20,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubeflow_tpu.parallel.mesh import batch_sharding
+from kubeflow_tpu.parallel.mesh import batch_sharding, mirror_param_shardings
 from kubeflow_tpu.parallel.tensor_parallel import (
     logical_to_sharding,
     rules_for,
@@ -96,14 +96,10 @@ def create_lm_state(
     params_sh = logical_to_sharding(mesh, logical, rules)
     params = jax.jit(init_params, out_shardings=params_sh)(rng)
 
-    # Optimizer moments mirror param leaves; shard identically.
+    # Optimizer moments mirror the param tree; shard by tree path.
     replicated = NamedSharding(mesh, P())
-    opt_sh = jax.tree.map(
-        lambda leaf: _match_param_sharding(leaf, params, params_sh,
-                                           replicated),
-        jax.eval_shape(tx.init, params),
-        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
-    )
+    opt_sh = mirror_param_shardings(
+        jax.eval_shape(tx.init, params), params_sh, replicated)
     opt_state = jax.jit(tx.init, out_shardings=opt_sh)(params)
 
     state = LMState(
@@ -121,15 +117,6 @@ def create_lm_state(
         tx=tx,
     )
     return state, shardings
-
-
-def _match_param_sharding(leaf, params, params_sh, replicated):
-    """Shard an optimizer leaf like the param with the same shape."""
-    shape = tuple(getattr(leaf, "shape", ()))
-    for p, s in zip(jax.tree.leaves(params), jax.tree.leaves(params_sh)):
-        if tuple(p.shape) == shape:
-            return s
-    return replicated
 
 
 def mlm_loss(logits: jax.Array, batch: Batch) -> Tuple[jax.Array, jax.Array]:

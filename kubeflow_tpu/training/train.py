@@ -19,7 +19,11 @@ from flax import core as flax_core
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubeflow_tpu.parallel.mesh import batch_sharding, fsdp_params_sharding
+from kubeflow_tpu.parallel.mesh import (
+    batch_sharding,
+    fsdp_params_sharding,
+    mirror_param_shardings,
+)
 
 Batch = Dict[str, jax.Array]
 TrainStepFn = Callable[[Any, Batch], Tuple[Any, Dict[str, jax.Array]]]
@@ -60,19 +64,11 @@ def state_sharding(mesh: Mesh, state: TrainState) -> TrainState:
     params_sh = fsdp_params_sharding(mesh, state.params)
     replicated = NamedSharding(mesh, P())
 
-    # Optimizer state mirrors param shapes (adam moments etc.); shard
-    # leaves that match a param shape the same way, replicate the rest.
-    shape_to_spec: Dict[Tuple[int, ...], NamedSharding] = {}
-    for p, s in zip(jax.tree.leaves(state.params), jax.tree.leaves(params_sh)):
-        shape_to_spec.setdefault(tuple(p.shape), s)
-
-    def opt_spec(x: Any) -> NamedSharding:
-        return shape_to_spec.get(tuple(getattr(x, "shape", ())), replicated)
-
     return TrainState(
         step=replicated,
         params=params_sh,
-        opt_state=jax.tree.map(opt_spec, state.opt_state),
+        opt_state=mirror_param_shardings(state.opt_state, params_sh,
+                                         replicated),
         batch_stats=None
         if state.batch_stats is None
         else jax.tree.map(lambda _: replicated, state.batch_stats),

@@ -64,3 +64,58 @@ def test_ring_with_tensor_sharded_heads():
     out = jax.jit(fn)(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def _pad_mask(key, b, l):
+    """Random 0/1 padding mask with at least one valid key per row."""
+    lengths = jax.random.randint(key, (b,), 1, l + 1)
+    return (jnp.arange(l)[None, :] < lengths[:, None]).astype(jnp.int32)
+
+
+def test_blockwise_mask_matches_dense():
+    q, k, v = make_qkv(jax.random.PRNGKey(4))
+    mask = _pad_mask(jax.random.PRNGKey(5), 2, 64)
+    dense = dense_attention(q, k, v, kv_segment_valid=mask)
+    block = blockwise_attention(q, k, v, block_size=16,
+                                kv_segment_valid=mask)
+    np.testing.assert_allclose(dense, block, atol=2e-5, rtol=2e-5)
+
+
+def test_blockwise_indivisible_keeps_blocking():
+    # lk=96, requested block 64 → largest divisor 48, not one 96 block.
+    from kubeflow_tpu.ops.attention import _fit_block_size
+    assert _fit_block_size(96, 64) == 48
+    assert _fit_block_size(128, 64) == 64
+    q, k, v = make_qkv(jax.random.PRNGKey(6), l=96)
+    dense = dense_attention(q, k, v, causal=True)
+    block = blockwise_attention(q, k, v, block_size=64, causal=True)
+    np.testing.assert_allclose(dense, block, atol=2e-5, rtol=2e-5)
+
+
+def test_blockwise_prime_length_pads():
+    # Prime KV length: no divisor — KV is padded and masked, never a
+    # 1-key-per-step scan.
+    q, k, v = make_qkv(jax.random.PRNGKey(9), l=97)
+    dense = dense_attention(q, k, v, causal=True)
+    block = blockwise_attention(q, k, v, block_size=64, causal=True)
+    np.testing.assert_allclose(dense, block, atol=2e-5, rtol=2e-5)
+    mask = _pad_mask(jax.random.PRNGKey(10), 2, 97)
+    dense_m = dense_attention(q, k, v, kv_segment_valid=mask)
+    block_m = blockwise_attention(q, k, v, block_size=64,
+                                  kv_segment_valid=mask)
+    np.testing.assert_allclose(dense_m, block_m, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_sequence_parallel_mask_matches_dense(strategy):
+    mesh = build_mesh(MeshSpec(data=2, seq=4))
+    q, k, v = make_qkv(jax.random.PRNGKey(7), b=4, l=128, h=4, d=8)
+    mask = _pad_mask(jax.random.PRNGKey(8), 4, 128)
+    ref = dense_attention(q, k, v, kv_segment_valid=mask)
+    fn = make_sequence_parallel_attention(
+        mesh, strategy=strategy, head_axis=None
+    )
+    out = jax.jit(lambda a, b_, c, m: fn(a, b_, c, kv_segment_valid=m))(
+        q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

@@ -3,10 +3,13 @@
 The reference's serving E2E asserted *golden output equality*: gRPC
 Predict with a fixed JPEG, response compared byte-for-byte against
 ``components/k8s-model-server/images/test-worker/result.txt``
-(``testing/test_tf_serving.py:104-108``). Same mechanism here:
-deterministic weights (seed 0) + deterministic input → exported →
-served → top-5 classes must match the checked-in golden exactly,
-scores to 1e-3.
+(``testing/test_tf_serving.py:104-108``). A byte-exact rank compare is
+wrong for a randomly-initialized model, though: its softmax scores are
+separated by ~1e-6, so any backend/XLA version change reorders the
+top-5 and flakes. Instead the golden pins *logit values at fixed probe
+classes* (tolerant to numeric noise, sensitive to real model drift),
+and a separate property check asserts the served classify output is
+consistent with direct model evaluation.
 
 Regenerate after an intentional model change:
 ``KFT_REGEN_GOLDEN=1 pytest tests/test_inception_golden.py``.
@@ -28,7 +31,11 @@ from kubeflow_tpu.serving.signature import (
     TensorSpec,
 )
 
-GOLDEN = Path(__file__).parent / "golden" / "inception_v3_top5.json"
+GOLDEN = Path(__file__).parent / "golden" / "inception_v3_logits.json"
+
+# Fixed probe classes spread over the logit vector; their values move
+# if (and only if) weights/architecture/preprocessing change.
+PROBE_CLASSES = [0, 7, 42, 123, 256, 400, 512, 640, 777, 999]
 
 
 def _metadata() -> ModelMetadata:
@@ -70,17 +77,38 @@ def test_inception_serving_golden(tmp_path):
     export_model(str(base), 1, meta, variables)
     loaded = load_version(str(base / "1"))
 
-    out = loaded.run({"images": _image()})
-    classes = np.asarray(out["classes"])[0].tolist()
-    scores = np.asarray(out["scores"])[0].tolist()
+    image = _image()
+    logits = np.asarray(
+        module.apply(variables, image, train=False), np.float64)[0]
+    probe = logits[PROBE_CLASSES].tolist()
 
     if os.environ.get("KFT_REGEN_GOLDEN") or not GOLDEN.exists():
         GOLDEN.parent.mkdir(parents=True, exist_ok=True)
         GOLDEN.write_text(json.dumps(
-            {"classes": classes, "scores": scores}, indent=2))
+            {"probe_classes": PROBE_CLASSES, "logits": probe}, indent=2))
         if not os.environ.get("KFT_REGEN_GOLDEN"):
             pytest.skip("golden file created; commit it")
 
     golden = json.loads(GOLDEN.read_text())
-    assert classes == golden["classes"], "top-5 class ids drifted"
-    np.testing.assert_allclose(scores, golden["scores"], atol=1e-3)
+    assert golden["probe_classes"] == PROBE_CLASSES
+    # Model drift gate: logits at the probes, tolerant to backend noise.
+    np.testing.assert_allclose(probe, golden["logits"], atol=1e-3)
+
+    # Serving-parity property: what the export/load/serve path returns
+    # must be consistent with direct model evaluation.
+    out = loaded.run({"images": image})
+    classes = np.asarray(out["classes"])[0]
+    scores = np.asarray(out["scores"])[0]
+    softmax = np.exp(logits - logits.max())
+    softmax /= softmax.sum()
+    np.testing.assert_allclose(
+        scores, softmax[classes], atol=1e-5,
+        err_msg="served scores disagree with direct model eval")
+    assert np.all(np.diff(scores) <= 1e-9), "scores must be sorted desc"
+    # Every served class must genuinely be in the top tier: no class
+    # outside the response may beat the served minimum by more than
+    # numeric noise. The margin must exceed the serving-parity
+    # tolerance above, or near-ties reintroduce ordering flakiness.
+    floor = scores.min() + 2e-5
+    others = np.delete(softmax, classes)
+    assert not np.any(others > floor), "top-5 classes are not the top-5"

@@ -142,3 +142,36 @@ def test_bert_with_ring_attention_matches_dense():
     out = ring_model.apply({"params": params}, batch["input_ids"])
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-2, rtol=2e-2)
+
+
+def test_opt_state_shardings_by_tree_path():
+    """Same-shaped params with different layouts must get their own
+    moment shardings (path match, not shape match)."""
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from kubeflow_tpu.parallel.mesh import (
+        MeshSpec, build_mesh, mirror_param_shardings,
+    )
+
+    mesh = build_mesh(MeshSpec(data=4, tensor=2))
+    params = {
+        "q": {"kernel": jnp.zeros((8, 8))},
+        "out": {"kernel": jnp.zeros((8, 8))},  # same shape, other layout
+    }
+    params_sh = {
+        "q": {"kernel": NamedSharding(mesh, P(None, "tensor"))},
+        "out": {"kernel": NamedSharding(mesh, P("tensor", None))},
+    }
+    replicated = NamedSharding(mesh, P())
+    tx = optax.adam(1e-3)
+    opt_sh = mirror_param_shardings(
+        jax.eval_shape(tx.init, params), params_sh, replicated)
+    flat = jax.tree_util.tree_flatten_with_path(opt_sh)[0]
+    mu_nu = {tuple(map(str, path)): sh for path, sh in flat}
+    for path, sh in mu_nu.items():
+        if "'q'" in str(path) and "kernel" in str(path):
+            assert sh.spec == P(None, "tensor"), (path, sh)
+        elif "'out'" in str(path) and "kernel" in str(path):
+            assert sh.spec == P("tensor", None), (path, sh)
+        else:  # count scalars etc.
+            assert sh.spec == P(), (path, sh)
