@@ -58,6 +58,44 @@ def synthetic_batch(config: BenchConfig, num_classes: int,
     return {"inputs": images, "labels": labels}
 
 
+PEAK_BF16_FLOPS = {
+    # device_kind → peak bf16 FLOP/s (MFU denominator).
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def peak_flops_per_chip() -> float:
+    kind = jax.devices()[0].device_kind
+    for prefix, peak in PEAK_BF16_FLOPS.items():
+        if kind.startswith(prefix):
+            return peak
+    return 197e12  # assume v5e-class if unknown
+
+
+def step_flops_per_device(step_fn, *args) -> Optional[float]:
+    """PER-DEVICE FLOPs of the exact (already-jitted) step that was
+    timed, from XLA's cost model. For an SPMD-partitioned computation
+    cost_analysis() counts one device's share; multiply by mesh size
+    for the global figure. None if the backend can't report it.
+
+    ``step_fn`` may be a plain ``jax.jit`` result or the dispatch
+    wrapper from :func:`kubeflow_tpu.training.train.make_train_step`
+    (which exposes ``.jitted`` after the first call).
+    """
+    jitted = getattr(step_fn, "jitted", step_fn)
+    try:
+        analysis = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0]
+        return float(analysis["flops"])
+    except Exception:  # cost analysis is backend-dependent
+        return None
+
+
 def run_benchmark(config: BenchConfig) -> Dict[str, float]:
     """Returns {images_per_sec, images_per_sec_per_chip, step_time_ms, ...}."""
     entry = get_model(config.model)
@@ -72,7 +110,10 @@ def run_benchmark(config: BenchConfig) -> Dict[str, float]:
     tx = optax.sgd(config.learning_rate, momentum=config.momentum, nesterov=True)
     rng = jax.random.PRNGKey(config.seed)
     sample = jnp.zeros((1, *input_shape), jnp.bfloat16)
-    state = create_train_state(model, tx, rng, sample)
+    # Jit the init: on remote-tunneled backends eager init dispatches
+    # hundreds of tiny ops individually (minutes); compiled it is one.
+    state = jax.jit(
+        lambda r: create_train_state(model, tx, r, sample))(rng)
     state = place_state(mesh, state)
     batch = place_batch(
         mesh, synthetic_batch(config, entry.num_classes_or_vocab, input_shape, rng)
@@ -97,7 +138,7 @@ def run_benchmark(config: BenchConfig) -> Dict[str, float]:
     elapsed = time.perf_counter() - start
 
     images_per_sec = config.batch_size * config.steps / elapsed
-    return {
+    result = {
         "model": config.model,
         "global_batch_size": config.batch_size,
         "n_chips": n_chips,
@@ -108,6 +149,91 @@ def run_benchmark(config: BenchConfig) -> Dict[str, float]:
         "compile_plus_warmup_s": compile_s,
         "final_loss": final_loss,
     }
+    flops = step_flops_per_device(step_fn, state, batch)
+    if flops is not None:
+        step_time_s = elapsed / config.steps
+        result["flops_per_step"] = flops * n_chips  # global
+        # Per-device share over one chip's peak: n_chips cancels.
+        result["mfu_pct"] = round(
+            flops / step_time_s / peak_flops_per_chip() * 100, 2)
+    return result
+
+
+@dataclasses.dataclass
+class LMBenchConfig:
+    model: str = "bert-base"
+    batch_size: int = 32
+    seq_len: int = 512
+    steps: int = 10
+    warmup_steps: int = 2
+    learning_rate: float = 1e-4
+    objective: str = "mlm"
+    seed: int = 0
+
+
+def run_lm_benchmark(config: LMBenchConfig) -> Dict[str, float]:
+    """BERT/Llama pretraining step benchmark (BASELINE.md LM target).
+
+    Single-process: the whole mesh is local (one chip on the bench
+    runner, the 8-device CPU mesh in tests). Reports step time, tokens/
+    sec, and MFU from XLA's FLOP count.
+    """
+    from kubeflow_tpu.training.lm import (
+        create_lm_state,
+        make_lm_train_step,
+        place_lm_batch,
+    )
+
+    entry = get_model(config.model)
+    model = entry.make()
+    vocab = entry.num_classes_or_vocab
+    mesh = build_mesh(None)
+    n_chips = mesh.size
+    rng = jax.random.PRNGKey(config.seed)
+    ids_rng, label_rng, weight_rng, init_rng = jax.random.split(rng, 4)
+    b, l = config.batch_size, config.seq_len
+    batch = {"input_ids": jax.random.randint(ids_rng, (b, l), 0, vocab)}
+    if config.objective == "mlm":
+        batch["mlm_labels"] = jax.random.randint(label_rng, (b, l), 0, vocab)
+        batch["mlm_weights"] = (
+            jax.random.uniform(weight_rng, (b, l)) < 0.15).astype(jnp.float32)
+
+    tx = optax.adamw(config.learning_rate)
+    state, shardings = create_lm_state(model, tx, init_rng, batch, mesh=mesh)
+    step_fn = make_lm_train_step(mesh, shardings,
+                                 objective=config.objective)
+    batch = place_lm_batch(mesh, batch)
+
+    compile_start = time.perf_counter()
+    for _ in range(max(config.warmup_steps, 1)):
+        state, metrics = step_fn(state, batch)
+    float(metrics["loss"])  # host-pull fence (see run_benchmark)
+    compile_s = time.perf_counter() - compile_start
+
+    start = time.perf_counter()
+    for _ in range(config.steps):
+        state, metrics = step_fn(state, batch)
+    final_loss = float(metrics["loss"])
+    elapsed = time.perf_counter() - start
+    step_time_s = elapsed / config.steps
+
+    result = {
+        "model": config.model,
+        "global_batch_size": b,
+        "seq_len": l,
+        "n_chips": n_chips,
+        "steps": config.steps,
+        "step_time_ms": step_time_s * 1e3,
+        "tokens_per_sec": b * l / step_time_s,
+        "compile_plus_warmup_s": compile_s,
+        "final_loss": final_loss,
+    }
+    flops = step_flops_per_device(step_fn, state, batch)
+    if flops is not None:
+        result["flops_per_step"] = flops * n_chips  # global
+        result["mfu_pct"] = round(
+            flops / step_time_s / peak_flops_per_chip() * 100, 2)
+    return result
 
 
 def main(argv=None) -> int:
@@ -115,14 +241,24 @@ def main(argv=None) -> int:
 
     parser = argparse.ArgumentParser(prog="tpu-cnn")
     parser.add_argument("--model", default="resnet50")
-    parser.add_argument("--batch_size", type=int, default=128)
+    parser.add_argument("--batch_size", type=int, default=None,
+                        help="default: 128 (vision) / 32 (language)")
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--image_size", type=int, default=None)
+    parser.add_argument("--seq_len", type=int, default=512)
     args = parser.parse_args(argv)
-    result = run_benchmark(
-        BenchConfig(model=args.model, batch_size=args.batch_size,
-                    steps=args.steps, image_size=args.image_size)
-    )
+    entry = get_model(args.model)
+    if entry.family == "language":
+        result = run_lm_benchmark(
+            LMBenchConfig(model=args.model,
+                          batch_size=args.batch_size or 32,
+                          steps=args.steps, seq_len=args.seq_len))
+    else:
+        result = run_benchmark(
+            BenchConfig(model=args.model,
+                        batch_size=args.batch_size or 128,
+                        steps=args.steps, image_size=args.image_size)
+        )
     print(json.dumps(result))
     return 0
 
