@@ -1,0 +1,29 @@
+#!/bin/sh
+# DinD build step used by the ci-release Argo workflow.
+# Parity: reference components/k8s-model-server/images/build_image.sh
+# (docker build + push inside the Argo DinD sidecar).
+#
+# Usage: build_image.sh <family> <image:tag> [push]
+#   family    directory under images/ holding the Dockerfile
+#   image:tag fully-qualified target image
+#   push      "push" to docker push after building (default: build only)
+set -eu
+
+FAMILY="$1"
+IMAGE="$2"
+PUSH="${3:-}"
+
+cd "$(dirname "$0")/.."
+
+if [ ! -f "images/${FAMILY}/Dockerfile" ]; then
+    echo "unknown image family '${FAMILY}' (no images/${FAMILY}/Dockerfile)" >&2
+    exit 1
+fi
+
+# Build context is the repo root so Dockerfiles can COPY the package.
+docker build -f "images/${FAMILY}/Dockerfile" -t "${IMAGE}" .
+
+if [ "${PUSH}" = "push" ]; then
+    docker push "${IMAGE}"
+fi
+echo "built ${IMAGE}"

@@ -1,0 +1,15 @@
+# Notebook server defaults for the kubeflow-tpu notebook image.
+# Parity: reference components/tensorflow-notebook-image/
+# jupyter_notebook_config.py (origin-tolerant websocket config behind
+# the hub/gateway).
+
+c = get_config()  # noqa: F821
+
+c.ServerApp.ip = "0.0.0.0"
+c.ServerApp.open_browser = False
+c.ServerApp.allow_origin = "*"
+c.ServerApp.trust_xheaders = True
+c.ServerApp.root_dir = "/home/jovyan"
+# TPU runtime wants the whole chip from one process: don't let stray
+# kernels grab it. Users opt into the TPU by creating a jax session.
+c.ServerApp.terminals_enabled = True

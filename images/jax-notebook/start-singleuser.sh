@@ -1,0 +1,28 @@
+#!/bin/bash
+# Map the JupyterHub-injected JPY_* env vars to jupyterhub-singleuser
+# flags. Parity: reference start-singleuser.sh:20-49.
+set -e
+
+NOTEBOOK_ARGS=""
+if [ -n "${JPY_PORT:-}" ]; then
+    NOTEBOOK_ARGS="${NOTEBOOK_ARGS} --port=${JPY_PORT}"
+fi
+if [ -n "${JPY_USER:-}" ]; then
+    NOTEBOOK_ARGS="${NOTEBOOK_ARGS} --user=${JPY_USER}"
+fi
+if [ -n "${JPY_COOKIE_NAME:-}" ]; then
+    NOTEBOOK_ARGS="${NOTEBOOK_ARGS} --cookie-name=${JPY_COOKIE_NAME}"
+fi
+if [ -n "${JPY_BASE_URL:-}" ]; then
+    NOTEBOOK_ARGS="${NOTEBOOK_ARGS} --base-url=${JPY_BASE_URL}"
+fi
+if [ -n "${JPY_HUB_PREFIX:-}" ]; then
+    NOTEBOOK_ARGS="${NOTEBOOK_ARGS} --hub-prefix=${JPY_HUB_PREFIX}"
+fi
+if [ -n "${JPY_HUB_API_URL:-}" ]; then
+    NOTEBOOK_ARGS="${NOTEBOOK_ARGS} --hub-api-url=${JPY_HUB_API_URL}"
+fi
+NOTEBOOK_ARGS="${NOTEBOOK_ARGS} --ip=0.0.0.0"
+
+exec /usr/local/bin/start.sh jupyterhub-singleuser \
+    --config=/etc/jupyter/jupyter_notebook_config.py ${NOTEBOOK_ARGS} "$@"

@@ -1,0 +1,24 @@
+#!/bin/bash
+# Entry shim: remap the notebook user's uid/gid to the volume's owner
+# when the pod runs as root, then drop privileges.
+# Parity: reference components/tensorflow-notebook-image/start.sh:19-55.
+set -e
+
+NB_USER="${NB_USER:-jovyan}"
+NB_UID="${NB_UID:-1000}"
+NB_GID="${NB_GID:-}"
+
+if [ "$(id -u)" = "0" ]; then
+    if [ -n "${NB_GID}" ]; then
+        groupmod -g "${NB_GID}" -o "$(id -g -n "${NB_USER}")"
+    fi
+    usermod -u "${NB_UID}" -o "${NB_USER}" 2>/dev/null || true
+    chown -R "${NB_UID}" "/home/${NB_USER}" 2>/dev/null || true
+    if [ "${GRANT_SUDO}" = "1" ] || [ "${GRANT_SUDO}" = "yes" ]; then
+        echo "${NB_USER} ALL=(ALL) NOPASSWD:ALL" > /etc/sudoers.d/notebook
+    fi
+    exec sudo -E -H -u "${NB_USER}" \
+        PATH="${PATH}" PYTHONPATH="${PYTHONPATH:-}" "$@"
+else
+    exec "$@"
+fi

@@ -1,0 +1,1 @@
+from kubeflow_tpu.dashboard.server import make_app, main  # noqa: F401

@@ -1,0 +1,180 @@
+"""TPUJob dashboard: REST + HTML view of TPUJobs in the cluster.
+
+The reference deployed a TFJob dashboard backend + UI behind Ambassador
+at ``/tfjobs/ui/`` (``kubeflow/core/tf-job.libsonnet:271-458``, backend
+``/opt/tensorflow_k8s/dashboard/backend`` on :8080). This is its
+TPUJob equivalent: one process serving
+
+  GET /tpujobs/ui/                    HTML job table
+  GET /tpujobs/api/tpujob             all TPUJobs (JSON)
+  GET /tpujobs/api/tpujob/<ns>/<name> one TPUJob + its gang pods
+  GET /healthz
+
+against either a real apiserver (kubectl shim) or the in-repo fake
+(hermetic citest). Deployed by ``manifests/tpujob.py`` as the
+``tpujob-dashboard`` Deployment with the Ambassador route rewrite
+``/tpujobs/ui/``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import logging
+from typing import Any, Dict, List
+
+import tornado.ioloop
+import tornado.web
+
+from kubeflow_tpu.manifests.tpujob import KIND
+from kubeflow_tpu.operator.reconciler import JOB_LABEL
+
+logger = logging.getLogger(__name__)
+
+
+def job_summary(job: Dict[str, Any]) -> Dict[str, Any]:
+    meta = job.get("metadata", {})
+    status = job.get("status", {})
+    replicas = {
+        spec.get("replicaType", "?"): spec.get("replicas", 0)
+        for spec in job.get("spec", {}).get("replicaSpecs", [])
+    }
+    return {
+        "name": meta.get("name", ""),
+        "namespace": meta.get("namespace", ""),
+        "phase": status.get("phase", "Pending"),
+        "restartCount": status.get("restartCount", 0),
+        "replicas": replicas,
+        "creationTimestamp": meta.get("creationTimestamp", ""),
+    }
+
+
+class BaseHandler(tornado.web.RequestHandler):
+    @property
+    def api(self):
+        return self.application.settings["api"]
+
+    def write_json(self, payload: Any, status: int = 200) -> None:
+        self.set_status(status)
+        self.set_header("Content-Type", "application/json")
+        self.finish(json.dumps(payload))
+
+
+class HealthHandler(BaseHandler):
+    def get(self):
+        self.write_json({"status": "ok"})
+
+
+class JobListHandler(BaseHandler):
+    def get(self):
+        jobs = self.api.list(KIND)
+        self.write_json({"items": [job_summary(j) for j in jobs]})
+
+
+class JobDetailHandler(BaseHandler):
+    def get(self, namespace: str, name: str):
+        from kubeflow_tpu.operator.fake import NotFound
+
+        try:
+            job = self.api.get(KIND, namespace, name)
+        except NotFound:
+            return self.write_json(
+                {"error": f"{KIND} {namespace}/{name} not found"}, 404)
+        pods = [
+            {
+                "name": p["metadata"]["name"],
+                "phase": p.get("status", {}).get("phase", "Unknown"),
+            }
+            for p in self.api.list(
+                "Pod", namespace, label_selector={JOB_LABEL: name})
+        ]
+        self.write_json({"job": job, "summary": job_summary(job),
+                         "pods": pods})
+
+
+_PHASE_COLORS = {
+    "Running": "#1a7f37", "Succeeded": "#0969da", "Pending": "#9a6700",
+    "Restarting": "#bc4c00", "Failed": "#cf222e",
+}
+
+_PAGE = """<!doctype html>
+<html><head><title>TPUJobs</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem; }}
+ table {{ border-collapse: collapse; min-width: 48rem; }}
+ th, td {{ text-align: left; padding: .4rem .9rem;
+          border-bottom: 1px solid #d0d7de; }}
+ th {{ background: #f6f8fa; }}
+ .phase {{ font-weight: 600; }}
+</style></head>
+<body>
+<h1>TPUJobs</h1>
+<table>
+<tr><th>Namespace</th><th>Name</th><th>Phase</th><th>Restarts</th>
+<th>Replicas</th></tr>
+{rows}
+</table>
+<p>{count} job(s). JSON: <a href="/tpujobs/api/tpujob">/tpujobs/api/tpujob</a></p>
+</body></html>
+"""
+
+
+class UIHandler(BaseHandler):
+    def get(self):
+        jobs = [job_summary(j) for j in self.api.list(KIND)]
+        rows = []
+        for j in jobs:
+            color = _PHASE_COLORS.get(j["phase"], "#57606a")
+            replicas = ", ".join(
+                f"{html.escape(str(t))}×{int(n)}"
+                for t, n in sorted(j["replicas"].items()))
+            detail = (f"/tpujobs/api/tpujob/{j['namespace']}/{j['name']}")
+            rows.append(
+                "<tr>"
+                f"<td>{html.escape(j['namespace'])}</td>"
+                f"<td><a href=\"{html.escape(detail)}\">"
+                f"{html.escape(j['name'])}</a></td>"
+                f"<td class=\"phase\" style=\"color:{color}\">"
+                f"{html.escape(j['phase'])}</td>"
+                f"<td>{int(j['restartCount'])}</td>"
+                f"<td>{replicas}</td>"
+                "</tr>")
+        self.set_header("Content-Type", "text/html; charset=utf-8")
+        self.finish(_PAGE.format(rows="\n".join(rows), count=len(jobs)))
+
+
+def make_app(api) -> tornado.web.Application:
+    return tornado.web.Application([
+        (r"/healthz", HealthHandler),
+        (r"/tpujobs/api/tpujob", JobListHandler),
+        (r"/tpujobs/api/tpujob/([^/]+)/([^/]+)", JobDetailHandler),
+        (r"/tpujobs/ui/?", UIHandler),
+        (r"/", tornado.web.RedirectHandler, {"url": "/tpujobs/ui/"}),
+    ], api=api)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tpujob-dashboard")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--fake", action="store_true",
+                        help="serve an in-memory apiserver (tests/demo)")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    if args.fake:
+        from kubeflow_tpu.operator.fake import FakeApiServer
+
+        api = FakeApiServer()
+    else:
+        from kubeflow_tpu.operator.controller import KubectlClient
+
+        api = KubectlClient()
+    app = make_app(api)
+    app.listen(args.port)
+    logger.info("tpujob-dashboard listening on :%d", args.port)
+    tornado.ioloop.IOLoop.current().start()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

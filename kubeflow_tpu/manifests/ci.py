@@ -192,8 +192,12 @@ def release_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
     }]
     build_env = [k8s.env_var("DOCKER_HOST", "127.0.0.1")]
 
-    image_families = ["serving-tpu", "serving-cpu", "http-proxy",
-                      "notebook-tpu", "trainer"]
+    # One family per first-party image the manifests reference; each
+    # has images/<family>/Dockerfile (tests assert the mapping).
+    image_families = ["model-server", "model-server-http-proxy",
+                      "trainer", "jax-notebook", "jupyterhub-k8s",
+                      "tpujob-operator", "tpujob-dashboard",
+                      "test-worker"]
     templates = [
         _step_template("checkout", [
             "/bin/sh", "-c",

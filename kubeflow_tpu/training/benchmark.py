@@ -76,24 +76,55 @@ def peak_flops_per_chip() -> float:
     return 197e12  # assume v5e-class if unknown
 
 
-def step_flops_per_device(step_fn, *args) -> Optional[float]:
-    """PER-DEVICE FLOPs of the exact (already-jitted) step that was
-    timed, from XLA's cost model. For an SPMD-partitioned computation
-    cost_analysis() counts one device's share; multiply by mesh size
-    for the global figure. None if the backend can't report it.
+def _run_timed_steps(step_fn, state, batch, warmup_steps: int, steps: int):
+    """AOT-compile the exact step once, run warmup + the timed loop on
+    that executable, and read its XLA FLOP count.
 
-    ``step_fn`` may be a plain ``jax.jit`` result or the dispatch
-    wrapper from :func:`kubeflow_tpu.training.train.make_train_step`
-    (which exposes ``.jitted`` after the first call).
+    Fencing is a host value pull (``float(loss)``), not
+    ``block_until_ready``: on remote-tunneled platforms the ready bit
+    of a dispatched chain can report early, and a loop fenced that way
+    measures dispatch, not compute.
+
+    Returns (elapsed_s, compile_s, final_loss, flops_per_device).
+    ``flops_per_device`` is ONE device's share for an SPMD-partitioned
+    computation (XLA cost_analysis semantics); None if the backend
+    can't report it.
     """
-    jitted = getattr(step_fn, "jitted", step_fn)
+    compile_start = time.perf_counter()
+    compiled = step_fn.lower(state, batch).compile()
+    flops = None
     try:
-        analysis = jitted.lower(*args).compile().cost_analysis()
+        analysis = compiled.cost_analysis()
         if isinstance(analysis, (list, tuple)):
             analysis = analysis[0]
-        return float(analysis["flops"])
+        flops = float(analysis["flops"])
     except Exception:  # cost analysis is backend-dependent
-        return None
+        pass
+    for _ in range(max(warmup_steps, 1)):
+        state, metrics = compiled(state, batch)
+    float(metrics["loss"])
+    compile_s = time.perf_counter() - compile_start
+
+    start = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = compiled(state, batch)
+    final_loss = float(metrics["loss"])
+    elapsed = time.perf_counter() - start
+    return elapsed, compile_s, final_loss, flops
+
+
+def _attach_mfu(result: Dict[str, float], flops_per_device: Optional[float],
+                step_time_s: float, n_chips: int) -> None:
+    """MFU is only meaningful against a TPU peak; skip on other
+    backends (the CPU smoke path must not publish a fake MFU)."""
+    if flops_per_device is None:
+        return
+    result["flops_per_step"] = flops_per_device * n_chips  # global
+    if jax.devices()[0].platform != "tpu":
+        return
+    # Per-device share over one chip's peak: n_chips cancels.
+    result["mfu_pct"] = round(
+        flops_per_device / step_time_s / peak_flops_per_chip() * 100, 2)
 
 
 def run_benchmark(config: BenchConfig) -> Dict[str, float]:
@@ -120,22 +151,8 @@ def run_benchmark(config: BenchConfig) -> Dict[str, float]:
     )
 
     step_fn = make_train_step(mesh)
-
-    # Warmup (includes compile). Fence with a host value pull, not
-    # block_until_ready: on remote-tunneled platforms (axon) the ready
-    # bit of a dispatched chain can report early, and a timed loop
-    # fenced that way measures dispatch, not compute.
-    compile_start = time.perf_counter()
-    for _ in range(max(config.warmup_steps, 1)):
-        state, metrics = step_fn(state, batch)
-    float(metrics["loss"])
-    compile_s = time.perf_counter() - compile_start
-
-    start = time.perf_counter()
-    for _ in range(config.steps):
-        state, metrics = step_fn(state, batch)
-    final_loss = float(metrics["loss"])
-    elapsed = time.perf_counter() - start
+    elapsed, compile_s, final_loss, flops = _run_timed_steps(
+        step_fn, state, batch, config.warmup_steps, config.steps)
 
     images_per_sec = config.batch_size * config.steps / elapsed
     result = {
@@ -149,13 +166,7 @@ def run_benchmark(config: BenchConfig) -> Dict[str, float]:
         "compile_plus_warmup_s": compile_s,
         "final_loss": final_loss,
     }
-    flops = step_flops_per_device(step_fn, state, batch)
-    if flops is not None:
-        step_time_s = elapsed / config.steps
-        result["flops_per_step"] = flops * n_chips  # global
-        # Per-device share over one chip's peak: n_chips cancels.
-        result["mfu_pct"] = round(
-            flops / step_time_s / peak_flops_per_chip() * 100, 2)
+    _attach_mfu(result, flops, elapsed / config.steps, n_chips)
     return result
 
 
@@ -204,17 +215,8 @@ def run_lm_benchmark(config: LMBenchConfig) -> Dict[str, float]:
                                  objective=config.objective)
     batch = place_lm_batch(mesh, batch)
 
-    compile_start = time.perf_counter()
-    for _ in range(max(config.warmup_steps, 1)):
-        state, metrics = step_fn(state, batch)
-    float(metrics["loss"])  # host-pull fence (see run_benchmark)
-    compile_s = time.perf_counter() - compile_start
-
-    start = time.perf_counter()
-    for _ in range(config.steps):
-        state, metrics = step_fn(state, batch)
-    final_loss = float(metrics["loss"])
-    elapsed = time.perf_counter() - start
+    elapsed, compile_s, final_loss, flops = _run_timed_steps(
+        step_fn, state, batch, config.warmup_steps, config.steps)
     step_time_s = elapsed / config.steps
 
     result = {
@@ -228,11 +230,7 @@ def run_lm_benchmark(config: LMBenchConfig) -> Dict[str, float]:
         "compile_plus_warmup_s": compile_s,
         "final_loss": final_loss,
     }
-    flops = step_flops_per_device(step_fn, state, batch)
-    if flops is not None:
-        result["flops_per_step"] = flops * n_chips  # global
-        result["mfu_pct"] = round(
-            flops / step_time_s / peak_flops_per_chip() * 100, 2)
+    _attach_mfu(result, flops, step_time_s, n_chips)
     return result
 
 

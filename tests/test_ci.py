@@ -53,10 +53,10 @@ def test_release_workflow_manifest():
         {"name": "rel-1", "version_tag": "v0.2.0"})
     wf = objs[0]
     names = {t["name"] for t in wf["spec"]["templates"]}
-    assert "build-serving-tpu" in names
-    assert "build-notebook-tpu" in names
+    assert "build-model-server" in names
+    assert "build-jax-notebook" in names
     build = next(t for t in wf["spec"]["templates"]
-                 if t["name"] == "build-serving-tpu")
+                 if t["name"] == "build-model-server")
     assert build["sidecars"][0]["securityContext"]["privileged"]
     assert "v0.2.0" in " ".join(build["container"]["command"])
     # zero-CUDA invariant: no gpu image family anywhere

@@ -1,0 +1,79 @@
+"""TPUJob dashboard served against the fake apiserver (the hermetic
+equivalent of the reference's TFJob UI tier, tf-job.libsonnet:271-458)."""
+
+import json
+
+import tornado.testing
+
+from kubeflow_tpu.dashboard.server import make_app
+from kubeflow_tpu.manifests.tpujob import KIND
+from kubeflow_tpu.operator.fake import FakeApiServer
+from kubeflow_tpu.operator.reconciler import JOB_LABEL
+
+
+def _job(name, namespace="default", phase="Running", restarts=1):
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1",
+        "kind": KIND,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"replicaSpecs": [
+            {"replicaType": "COORDINATOR", "replicas": 1},
+            {"replicaType": "TPU_WORKER", "replicas": 4},
+        ]},
+        "status": {"phase": phase, "restartCount": restarts},
+    }
+
+
+class DashboardTest(tornado.testing.AsyncHTTPTestCase):
+    def get_app(self):
+        self.api = FakeApiServer()
+        self.api.create(_job("mnist", phase="Running"))
+        self.api.create(_job("bert", namespace="research",
+                             phase="Restarting", restarts=2))
+        self.api.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "mnist-tpu-worker-0",
+                         "namespace": "default",
+                         "labels": {JOB_LABEL: "mnist"}},
+            "status": {"phase": "Running"},
+        })
+        return make_app(self.api)
+
+    def test_health(self):
+        resp = self.fetch("/healthz")
+        assert resp.code == 200
+
+    def test_list_jobs(self):
+        resp = self.fetch("/tpujobs/api/tpujob")
+        assert resp.code == 200
+        items = json.loads(resp.body)["items"]
+        assert {i["name"] for i in items} == {"mnist", "bert"}
+        bert = next(i for i in items if i["name"] == "bert")
+        assert bert["phase"] == "Restarting"
+        assert bert["restartCount"] == 2
+        assert bert["replicas"] == {"COORDINATOR": 1, "TPU_WORKER": 4}
+
+    def test_job_detail_includes_gang_pods(self):
+        resp = self.fetch("/tpujobs/api/tpujob/default/mnist")
+        assert resp.code == 200
+        detail = json.loads(resp.body)
+        assert detail["summary"]["phase"] == "Running"
+        assert detail["pods"] == [
+            {"name": "mnist-tpu-worker-0", "phase": "Running"}]
+
+    def test_job_detail_404(self):
+        resp = self.fetch("/tpujobs/api/tpujob/default/nope")
+        assert resp.code == 404
+
+    def test_ui_renders_table(self):
+        resp = self.fetch("/tpujobs/ui/")
+        assert resp.code == 200
+        page = resp.body.decode()
+        assert "mnist" in page and "bert" in page
+        assert "Restarting" in page
+        assert "TPU_WORKER×4" in page
+
+    def test_root_redirects_to_ui(self):
+        resp = self.fetch("/", follow_redirects=False)
+        assert resp.code in (301, 302)
+        assert resp.headers["Location"] == "/tpujobs/ui/"

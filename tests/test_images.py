@@ -1,0 +1,102 @@
+"""Image-plane tests: every first-party image referenced by the
+manifests must be buildable from this repo, and the zero-CUDA
+north-star invariant must hold across every Dockerfile (reference
+shipped 9 Dockerfiles incl. a CUDA build, Dockerfile.gpu; the TPU
+rebuild must have none)."""
+
+import re
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu.params import get_prototype, list_prototypes
+
+REPO = Path(__file__).resolve().parent.parent
+IMAGES = REPO / "images"
+
+# Minimal overrides for required params (mirrors test_manifests.py).
+OVERRIDES = {
+    "tpu-job": {"name": "j"},
+    "tpu-cnn": {"name": "c"},
+    "tpu-serving": {"name": "s", "model_path": "gs://b/m"},
+    "cert-manager": {"acme_email": "a@b.com"},
+    "iap-envoy": {"audiences": "aud"},
+    "iap-ingress": {"ip_name": "ip", "hostname": "h.example.com"},
+    "seldon-serve-simple": {"name": "m", "image": "img:1"},
+    "nfs": {"disks": "d1"},
+    "ci-e2e": {"name": "e"},
+    "ci-release": {"name": "r", "version_tag": "v0"},
+}
+
+FIRST_PARTY = re.compile(r"ghcr\.io/kubeflow-tpu/([a-z0-9-]+):")
+
+
+def _all_manifest_json() -> str:
+    import json
+
+    chunks = []
+    for proto in list_prototypes():
+        objs = get_prototype(proto.name).build(OVERRIDES.get(proto.name, {}))
+        chunks.append(json.dumps(objs))
+    return "\n".join(chunks)
+
+
+def test_every_referenced_image_has_a_dockerfile():
+    referenced = set(FIRST_PARTY.findall(_all_manifest_json()))
+    assert referenced, "no first-party images found — regex broken?"
+    missing = {
+        name for name in referenced
+        if not (IMAGES / name / "Dockerfile").is_file()
+    }
+    assert not missing, f"manifests reference unbuildable images: {missing}"
+
+
+def test_release_workflow_covers_every_image_dir():
+    from kubeflow_tpu.manifests.ci import release_workflow
+
+    families = {
+        p.name for p in IMAGES.iterdir() if (p / "Dockerfile").is_file()
+    }
+    wf = get_prototype("ci-release").build(
+        {"name": "r", "version_tag": "v0"})[0]
+    built = {
+        t["name"].removeprefix("build-")
+        for t in wf["spec"]["templates"]
+        if t["name"].startswith("build-")
+    }
+    assert built == families, (
+        f"release DAG != images/: only-in-dag={built - families}, "
+        f"unreleased={families - built}")
+    del release_workflow
+
+
+FORBIDDEN = re.compile(r"cuda|nccl|nvidia|cudnn", re.IGNORECASE)
+
+
+@pytest.mark.parametrize(
+    "path",
+    [p for p in IMAGES.rglob("*") if p.is_file()],
+    ids=lambda p: str(p.relative_to(IMAGES)),
+)
+def test_zero_cuda_invariant(path):
+    text = path.read_text(errors="replace")
+    match = FORBIDDEN.search(text)
+    assert match is None, (
+        f"{path} mentions {match.group(0)!r} — zero-CUDA invariant")
+
+
+def test_manifests_reference_no_gpu_resources():
+    text = _all_manifest_json()
+    assert FORBIDDEN.search(text) is None, "GPU/CUDA leaked into manifests"
+    assert "google.com/tpu" in text
+
+
+def test_build_script_rejects_unknown_family(tmp_path):
+    import subprocess
+
+    r = subprocess.run(
+        ["/bin/sh", str(IMAGES / "build_image.sh"), "no-such-family",
+         "ghcr.io/kubeflow-tpu/no-such-family:v0"],
+        capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "unknown image family" in r.stderr
