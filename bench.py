@@ -67,6 +67,23 @@ def main() -> int:
     except Exception as e:  # LM line is secondary; never sink the bench
         extra["lm_bench_error"] = str(e)[:200]
 
+    try:
+        from kubeflow_tpu.serving.benchmark import (
+            ServingBenchConfig,
+            run_serving_benchmark,
+        )
+
+        serving = run_serving_benchmark(ServingBenchConfig(
+            model="inception-v3" if on_tpu else "resnet-test",
+            image_hw=299 if on_tpu else 32,
+            clients=2, requests_per_client=16, warmup_requests=4,
+        ))
+        extra[f"{serving['model']}_serving_p50_ms"] = serving["p50_ms"]
+        extra[f"{serving['model']}_serving_p99_ms"] = serving["p99_ms"]
+        extra[f"{serving['model']}_serving_rps"] = serving["throughput_rps"]
+    except Exception as e:  # serving line is secondary too
+        extra["serving_bench_error"] = str(e)[:200]
+
     print(
         json.dumps(
             {

@@ -93,7 +93,9 @@ def run_fake() -> None:
     proc = subprocess.Popen(
         [sys.executable, "-m", "kubeflow_tpu.serving.server",
          "--port", str(port), "--model_name", "resnet",
-         "--model_base_path", str(base), "--poll_interval", "1"],
+         "--model_base_path", str(base), "--poll_interval", "1",
+         # Small bucket set: load-time warmup compiles every bucket.
+         "--max_batch", "4"],
         env=env)
     try:
         for _ in range(120):

@@ -67,17 +67,22 @@ class HealthHandler(BaseHandler):
 
 
 class JobListHandler(BaseHandler):
-    def get(self):
-        jobs = self.api.list(KIND)
+    async def get(self):
+        # Apiserver access shells out to kubectl in the real client;
+        # run off the IO loop so a slow apiserver can't stall /healthz.
+        jobs = await tornado.ioloop.IOLoop.current().run_in_executor(
+            None, self.api.list, KIND)
         self.write_json({"items": [job_summary(j) for j in jobs]})
 
 
 class JobDetailHandler(BaseHandler):
-    def get(self, namespace: str, name: str):
+    async def get(self, namespace: str, name: str):
         from kubeflow_tpu.operator.fake import NotFound
 
+        loop = tornado.ioloop.IOLoop.current()
         try:
-            job = self.api.get(KIND, namespace, name)
+            job = await loop.run_in_executor(
+                None, self.api.get, KIND, namespace, name)
         except NotFound:
             return self.write_json(
                 {"error": f"{KIND} {namespace}/{name} not found"}, 404)
@@ -86,8 +91,9 @@ class JobDetailHandler(BaseHandler):
                 "name": p["metadata"]["name"],
                 "phase": p.get("status", {}).get("phase", "Unknown"),
             }
-            for p in self.api.list(
-                "Pod", namespace, label_selector={JOB_LABEL: name})
+            for p in await loop.run_in_executor(
+                None, lambda: self.api.list(
+                    "Pod", namespace, label_selector={JOB_LABEL: name}))
         ]
         self.write_json({"job": job, "summary": job_summary(job),
                          "pods": pods})
@@ -121,8 +127,10 @@ _PAGE = """<!doctype html>
 
 
 class UIHandler(BaseHandler):
-    def get(self):
-        jobs = [job_summary(j) for j in self.api.list(KIND)]
+    async def get(self):
+        raw = await tornado.ioloop.IOLoop.current().run_in_executor(
+            None, self.api.list, KIND)
+        jobs = [job_summary(j) for j in raw]
         rows = []
         for j in jobs:
             color = _PHASE_COLORS.get(j["phase"], "#57606a")

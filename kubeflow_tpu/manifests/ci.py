@@ -100,6 +100,11 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             py, "-m", "kubeflow_tpu.citests.unit",
             "--junit_path", f"{params['artifacts_dir']}/junit_unit.xml",
         ],
+        # Race-detection tier (SURVEY §5): tsan+asan stress of the
+        # native queue/gang kernel. Hermetic — needs only g++.
+        "sanitizer-test": [
+            "make", "-C", f"{src}/native", "check-sanitizers",
+        ],
         "deploy-test": [
             py, "-m", "kubeflow_tpu.citests.deploy", "setup",
             "--namespace", params["test_namespace"],
@@ -143,6 +148,7 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             _dag_task("checkout", []),
             _dag_task("create-pr-symlink", ["checkout"]),
             _dag_task("unit-test", ["checkout"]),
+            _dag_task("sanitizer-test", ["checkout"]),
             _dag_task("deploy-test", ["checkout"]),
             _dag_task("deploy-serving", ["deploy-test"]),
             _dag_task("tpujob-test", ["deploy-test"]),

@@ -1,0 +1,201 @@
+"""Serving latency benchmark: p50/p99 predict latency + throughput.
+
+BASELINE.md target "Inception-v3 p50 predict latency" (the reference
+measured nothing — its serving test was a correctness golden with a
+10 s timeout, testing/test_tf_serving.py:75-108). This drives the real
+HTTP server (tornado, real sockets) with concurrent clients and a
+deterministic image, and also times the bare model execution so the
+Python data-plane overhead (HTTP + JSON + batcher) is quantified
+rather than guessed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import tempfile
+import threading
+import time
+import urllib.request
+from typing import Dict
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ServingBenchConfig:
+    model: str = "inception-v3"  # registry name
+    image_hw: int = 299
+    clients: int = 4
+    requests_per_client: int = 32
+    warmup_requests: int = 8
+    # Buckets 1..max_batch all compile at load; keep small so the
+    # bench doesn't spend minutes warming buckets it never fills.
+    max_batch: int = 4
+    port: int = 0  # 0 = ephemeral (repeat runs can't collide)
+
+
+def _export(config: ServingBenchConfig) -> str:
+    import jax
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.export import export_model
+    from kubeflow_tpu.serving.signature import (
+        ModelMetadata,
+        Signature,
+        TensorSpec,
+    )
+
+    hw = config.image_hw
+    meta = ModelMetadata(
+        model_name="bench", registry_name=config.model,
+        model_kwargs={"dtype": "float32"},
+        signatures={"serving_default": Signature(
+            method="classify",
+            inputs={"images": TensorSpec("float32", (-1, hw, hw, 3))},
+            outputs={"classes": TensorSpec("int32", (-1, 5)),
+                     "scores": TensorSpec("float32", (-1, 5))})})
+    module = get_model(config.model).make(dtype="float32")
+    variables = jax.jit(module.init, static_argnames=("train",))(
+        jax.random.PRNGKey(0), np.zeros((1, hw, hw, 3), np.float32),
+        train=False)
+    base = pathlib.Path(tempfile.mkdtemp()) / "bench"
+    export_model(str(base), 1, meta, variables)
+    return str(base)
+
+
+class _ServerHandle:
+    def __init__(self):
+        self.port: int = 0
+        self.started = threading.Event()
+        self.loop = None
+
+
+def _serve(manager, port: int, handle: _ServerHandle):
+    import asyncio
+
+    import tornado.ioloop
+
+    from kubeflow_tpu.serving.server import make_app
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    app = make_app(manager)
+    server = app.listen(port)
+    handle.port = next(iter(server._sockets.values())).getsockname()[1]
+    handle.loop = tornado.ioloop.IOLoop.current()
+    handle.started.set()
+    handle.loop.start()
+
+
+def run_serving_benchmark(config: ServingBenchConfig) -> Dict[str, float]:
+    from kubeflow_tpu.serving.manager import ModelManager
+
+    base = _export(config)
+    manager = ModelManager(poll_interval_s=3600)
+    model = manager.add_model("bench", base, max_batch=config.max_batch)
+
+    handle = _ServerHandle()
+    server_thread = threading.Thread(
+        target=_serve, args=(manager, config.port, handle), daemon=True)
+    server_thread.start()
+    assert handle.started.wait(30), "server thread never started"
+    try:
+        return _drive(config, manager, model, handle)
+    finally:
+        handle.loop.add_callback(handle.loop.stop)
+        server_thread.join(10)
+        manager.stop()
+
+
+def _drive(config: ServingBenchConfig, manager, model,
+           handle: _ServerHandle) -> Dict[str, float]:
+    hw = config.image_hw
+    rng = np.random.RandomState(42)
+    image = (rng.randint(0, 256, (1, hw, hw, 3)) / 255.0).astype(np.float32)
+    payload = json.dumps({"instances": image.tolist()}).encode()
+    url = (f"http://127.0.0.1:{handle.port}/v1/models/bench:classify")
+
+    def one_request(timeout=120.0) -> float:
+        req = urllib.request.Request(
+            url, data=payload, headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            body = json.load(resp)
+        dt = time.perf_counter() - t0
+        assert "predictions" in body, body
+        return dt
+
+    # Warmup: first request compiles the predict buckets.
+    for _ in range(config.warmup_requests):
+        one_request()
+
+    latencies = []
+    lat_lock = threading.Lock()
+    errors = []
+
+    def client():
+        try:
+            mine = []
+            for _ in range(config.requests_per_client):
+                mine.append(one_request())
+            with lat_lock:
+                latencies.extend(mine)
+        except Exception as e:  # noqa: BLE001
+            with lat_lock:
+                errors.append(repr(e))
+
+    start = time.perf_counter()
+    threads = [threading.Thread(target=client)
+               for _ in range(config.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    elapsed = time.perf_counter() - start
+    assert not errors, errors[:3]
+
+    # Bare model execution for the same single image: quantifies the
+    # HTTP+JSON+batcher overhead on top of XLA.
+    loaded = model.get()
+    direct = []
+    for _ in range(16):
+        t0 = time.perf_counter()
+        out = loaded.run({"images": image})
+        np.asarray(out["scores"])  # host fence
+        direct.append(time.perf_counter() - t0)
+
+    lat = np.asarray(latencies) * 1e3
+    return {
+        "model": config.model,
+        "clients": config.clients,
+        "requests": len(latencies),
+        "p50_ms": round(float(np.percentile(lat, 50)), 2),
+        "p90_ms": round(float(np.percentile(lat, 90)), 2),
+        "p99_ms": round(float(np.percentile(lat, 99)), 2),
+        "throughput_rps": round(len(latencies) / elapsed, 1),
+        "direct_model_ms": round(float(np.median(direct)) * 1e3, 2),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="kft-serving-bench")
+    parser.add_argument("--model", default="inception-v3")
+    parser.add_argument("--image_hw", type=int, default=299)
+    parser.add_argument("--clients", type=int, default=4)
+    parser.add_argument("--requests_per_client", type=int, default=32)
+    parser.add_argument("--port", type=int, default=0,
+                        help="0 = ephemeral")
+    args = parser.parse_args(argv)
+    result = run_serving_benchmark(ServingBenchConfig(
+        model=args.model, image_hw=args.image_hw, clients=args.clients,
+        requests_per_client=args.requests_per_client, port=args.port))
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

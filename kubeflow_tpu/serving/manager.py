@@ -39,9 +39,19 @@ class ServedModel:
         self._latest: Optional[int] = None
         self._lock = threading.Lock()
         self._queue = _native.RequestQueue()
+        # _pending is touched by every request thread and the batcher;
+        # GIL-atomicity of single dict ops is not a contract worth
+        # betting on (submit's push-fail cleanup + a concurrent pop of
+        # a neighboring id interleave arbitrarily), so all access goes
+        # through _pending_lock. _worker_lock serializes batcher
+        # start/stop (two concurrent first requests must not spawn two
+        # batch loops).
+        self._pending_lock = threading.Lock()
+        self._worker_lock = threading.Lock()
         self._pending: Dict[int, Any] = {}
         self._ids = itertools.count(1)
         self._worker: Optional[threading.Thread] = None
+        self._closed = False
 
     # -- version lifecycle ------------------------------------------------
 
@@ -53,8 +63,10 @@ class ServedModel:
             return False
         logger.info("model %s: loading version %d from %s",
                     self.name, latest, self.base_path)
+        # warmup=True: every batch bucket compiles during load (health
+        # stays 503), so no request ever hits a cold-compile cliff.
         loaded = load_version(f"{self.base_path}/{latest}",
-                              max_batch=self.max_batch)
+                              max_batch=self.max_batch, warmup=True)
         with self._lock:
             self._versions[latest] = loaded
             previous = self._latest
@@ -85,17 +97,27 @@ class ServedModel:
     # -- batched execution -------------------------------------------------
 
     def start_batcher(self) -> None:
-        if self._worker is None:
-            self._worker = threading.Thread(
-                target=self._batch_loop, name=f"batcher-{self.name}",
-                daemon=True)
-            self._worker.start()
+        with self._worker_lock:
+            if self._worker is None and not self._closed:
+                self._worker = threading.Thread(
+                    target=self._batch_loop, name=f"batcher-{self.name}",
+                    daemon=True)
+                self._worker.start()
 
     def stop(self) -> None:
+        with self._worker_lock:
+            self._closed = True
+            worker, self._worker = self._worker, None
         self._queue.close()
-        if self._worker is not None:
-            self._worker.join(timeout=5)
-            self._worker = None
+        if worker is not None:
+            worker.join(timeout=5)
+        # Fail anything the batcher never drained.
+        with self._pending_lock:
+            leftovers = list(self._pending.values())
+            self._pending.clear()
+        for *_, future in leftovers:
+            if not future.done():
+                future.set_exception(RuntimeError("server shutting down"))
 
     def submit(self, inputs: Dict[str, np.ndarray],
                signature_name: Optional[str],
@@ -106,12 +128,22 @@ class ServedModel:
         self.start_batcher()
         future: Future = Future()
         request_id = next(self._ids)
-        self._pending[request_id] = (inputs, signature_name, method,
-                                     version, future)
-        if not self._queue.push(request_id):
-            del self._pending[request_id]
-            future.set_exception(
-                RuntimeError("server overloaded: request queue full"))
+        with self._pending_lock:
+            self._pending[request_id] = (inputs, signature_name, method,
+                                         version, future)
+        try:
+            pushed = self._queue.push(request_id)
+            error = "server overloaded: request queue full"
+        except RuntimeError:  # queue closed mid-flight (shutdown race)
+            pushed = False
+            error = "server shutting down"
+        if not pushed:
+            with self._pending_lock:
+                self._pending.pop(request_id, None)
+            # stop() may have failed this future already (it clears
+            # _pending concurrently); a second set_exception raises.
+            if not future.done():
+                future.set_exception(RuntimeError(error))
         return future
 
     def _batch_loop(self) -> None:
@@ -122,7 +154,14 @@ class ServedModel:
                 return
             if not ids:
                 continue
-            requests = [self._pending.pop(i) for i in ids]
+            with self._pending_lock:
+                # Entries may be gone if stop() cleared _pending while
+                # this thread outlived the join timeout.
+                requests = [r for r in
+                            (self._pending.pop(i, None) for i in ids)
+                            if r is not None]
+            if not requests:
+                continue
             # Group by (signature, method, version): only same-signature
             # requests can share an XLA execution.
             groups: Dict[Any, List[Any]] = {}

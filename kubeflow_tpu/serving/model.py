@@ -122,20 +122,48 @@ class LoadedModel:
         out = self._jitted(method, bucket)(self.variables, x)
         return {k: np.asarray(v)[:n] for k, v in out.items()}
 
+    def warmup(self) -> None:
+        """Compile every (method, bucket) pair before traffic arrives.
+        A cold compile mid-request is a 20-40 s latency cliff on TPU;
+        servers call this during load, while /healthz still answers
+        503 (TF-Serving's warmup-assets role). Both HTTP verbs are
+        warmed — the URL can request :predict against a classify
+        signature and vice versa."""
+        sig = self.signature()
+        (name, spec), = sig.inputs.items()
+        bucket = 1
+        while True:
+            x = np.zeros((bucket, *spec.shape[1:]),
+                         dtype=_NP_DTYPES[spec.dtype])
+            for method in ("predict", "classify"):
+                out = self._jitted(method, bucket)(self.variables, x)
+                jax.block_until_ready(out)
+            if bucket >= self.max_batch:
+                break
+            bucket = min(bucket * 2, self.max_batch)
+
 
 def load_version(version_dir: str, *, max_batch: int = 64,
-                 top_k: int = 5) -> LoadedModel:
+                 top_k: int = 5, warmup: bool = False) -> LoadedModel:
     metadata = read_metadata(version_dir)
     entry = get_model(metadata.registry_name)
     module = entry.make(**metadata.model_kwargs)
     sig = metadata.signatures[ModelMetadata.DEFAULT_SIGNATURE]
     (_, spec), = sig.inputs.items()
     sample = jnp.zeros((1, *spec.shape[1:]), _NP_DTYPES[spec.dtype])
-    template = module.init(jax.random.PRNGKey(0), sample, train=False)
+    # Jit the template init: eager init dispatches every layer's op
+    # individually (minutes over a remote-tunneled backend).
+    template = jax.jit(
+        functools.partial(module.init, train=False))(
+            jax.random.PRNGKey(0), sample)
     variables = read_variables(version_dir, template)
     variables = jax.device_put(variables)
     import os
 
     version = int(os.path.basename(os.path.normpath(version_dir)))
-    return LoadedModel(metadata=metadata, version=version,
-                       variables=variables, max_batch=max_batch, top_k=top_k)
+    loaded = LoadedModel(metadata=metadata, version=version,
+                         variables=variables, max_batch=max_batch,
+                         top_k=top_k)
+    if warmup:
+        loaded.warmup()
+    return loaded

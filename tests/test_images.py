@@ -52,8 +52,6 @@ def test_every_referenced_image_has_a_dockerfile():
 
 
 def test_release_workflow_covers_every_image_dir():
-    from kubeflow_tpu.manifests.ci import release_workflow
-
     families = {
         p.name for p in IMAGES.iterdir() if (p / "Dockerfile").is_file()
     }
@@ -67,7 +65,6 @@ def test_release_workflow_covers_every_image_dir():
     assert built == families, (
         f"release DAG != images/: only-in-dag={built - families}, "
         f"unreleased={families - built}")
-    del release_workflow
 
 
 FORBIDDEN = re.compile(r"cuda|nccl|nvidia|cudnn", re.IGNORECASE)
@@ -91,7 +88,7 @@ def test_manifests_reference_no_gpu_resources():
     assert "google.com/tpu" in text
 
 
-def test_build_script_rejects_unknown_family(tmp_path):
+def test_build_script_rejects_unknown_family():
     import subprocess
 
     r = subprocess.run(

@@ -142,7 +142,7 @@ def test_hot_reload_new_version(model_dir):
     from kubeflow_tpu.models.resnet import resnet18ish
     from kubeflow_tpu.serving.export import read_metadata
 
-    served = ServedModel("testnet", str(model_dir))
+    served = ServedModel("testnet", str(model_dir), max_batch=8)
     served.poll_versions()
     assert served.versions == [1]
     # Export version 2 and poll again.
@@ -171,7 +171,7 @@ class ServingEndToEnd(tornado.testing.AsyncHTTPTestCase):
 
         manager = ModelManager()
         self.manager = manager
-        manager.add_model("testnet", str(type(self).base_path))
+        manager.add_model("testnet", str(type(self).base_path), max_batch=8)
         return make_app(manager)
 
     def test_status_metadata_predict(self):
@@ -231,7 +231,7 @@ class ProxyEndToEnd(tornado.testing.AsyncHTTPTestCase):
         from kubeflow_tpu.serving.server import make_app as server_app
 
         self.manager = ModelManager()
-        self.manager.add_model("testnet", str(type(self).base_path))
+        self.manager.add_model("testnet", str(type(self).base_path), max_batch=8)
         backend = server_app(self.manager)
         sock, port = tornado.testing.bind_unused_port()
         self.backend_server = tornado.httpserver.HTTPServer(backend)

