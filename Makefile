@@ -1,0 +1,42 @@
+# Developer/CI entry points — the reference's presubmit shape
+# (Makefile:15-18 boilerplate gate + scripts/autoformat_jsonnet.sh),
+# rebuilt for this repo: a stdlib lint gate, the test tiers, and the
+# native sanitizer stress.
+
+PY ?= python
+
+.PHONY: all lint test test-fast presubmit native sanitizers clean
+
+all: presubmit
+
+lint:
+	$(PY) scripts/lint.py
+
+test:
+	$(PY) -m pytest tests/ -q
+
+# The hermetic, engine-free tiers (manifest compiler, params, CLI,
+# operator, CI plane, images, examples, dashboard) — a couple of
+# minutes, no model compiles. The full suite is `make test`.
+FAST_TESTS := tests/test_params.py tests/test_coerce.py \
+    tests/test_k8s_builders.py tests/test_manifests.py tests/test_cli.py \
+    tests/test_operator.py tests/test_ci.py tests/test_images.py \
+    tests/test_examples.py tests/test_dashboard.py
+
+test-fast:
+	$(PY) -m pytest $(FAST_TESTS) -q
+
+native:
+	$(MAKE) -C native
+
+sanitizers:
+	$(MAKE) -C native check-sanitizers
+
+# The gate every commit must pass: lint (syntax + import smoke + CLI
+# boot + unused imports) and the fast test tier. The round-1-ending
+# import bug class cannot reach a commit through this.
+presubmit: lint test-fast
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

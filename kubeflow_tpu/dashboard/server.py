@@ -22,7 +22,7 @@ import argparse
 import html
 import json
 import logging
-from typing import Any, Dict, List
+from typing import Any, Dict
 
 import tornado.ioloop
 import tornado.web

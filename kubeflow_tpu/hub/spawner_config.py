@@ -3,7 +3,6 @@
 # (kubeflow/core/jupyterhub_spawner.py:7-113) with TPU chip resources
 # in place of the free-text GPU extra_resource_limits field (:29,56-62).
 
-import json
 
 
 class TPUFormSpawner(__import__("kubespawner").KubeSpawner):

@@ -100,6 +100,12 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             py, "-m", "kubeflow_tpu.citests.unit",
             "--junit_path", f"{params['artifacts_dir']}/junit_unit.xml",
         ],
+        # Presubmit lint gate (reference Makefile:15-18 shape): syntax,
+        # import smoke, CLI boot, unused imports. The round-1 import
+        # bug class dies here, before any cluster work starts.
+        "lint-test": [
+            py, f"{src}/scripts/lint.py",
+        ],
         # Race-detection tier (SURVEY §5): tsan+asan stress of the
         # native queue/gang kernel. Hermetic — needs only g++.
         "sanitizer-test": [
@@ -147,6 +153,7 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
         "dag": {"tasks": [
             _dag_task("checkout", []),
             _dag_task("create-pr-symlink", ["checkout"]),
+            _dag_task("lint-test", ["checkout"]),
             _dag_task("unit-test", ["checkout"]),
             _dag_task("sanitizer-test", ["checkout"]),
             _dag_task("deploy-test", ["checkout"]),

@@ -14,7 +14,7 @@ mechanism, not the 2015 checkpoint, is the parity surface).
 from __future__ import annotations
 
 import functools
-from typing import Any, Sequence, Tuple
+from typing import Any, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp

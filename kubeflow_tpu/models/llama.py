@@ -7,14 +7,13 @@ and the fp32-statistics attention core (``ops/attention.py``).
 
 Long-context is first-class: ``attention_fn`` accepts a sequence-
 parallel wrapper (ring attention over the ``seq`` mesh axis,
-``parallel/ring_attention.py``), and the default path uses blockwise
-attention above ``blockwise_threshold`` tokens so single-chip memory
-stays O(L·block) instead of O(L²).
+``parallel/ring_attention.py``), and the default path is the fused
+Pallas flash kernel (``ops/flash_attention.py``) so single-chip memory
+stays O(L·block) instead of O(L²) at any length.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Optional
 
 import flax.linen as nn

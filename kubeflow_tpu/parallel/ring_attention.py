@@ -32,7 +32,6 @@ import functools
 from typing import Callable, Optional
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kubeflow_tpu.ops.attention import (

@@ -107,6 +107,9 @@ def run_serving_benchmark(config: ServingBenchConfig) -> Dict[str, float]:
         handle.loop.add_callback(handle.loop.stop)
         server_thread.join(10)
         manager.stop()
+        import shutil
+
+        shutil.rmtree(pathlib.Path(base).parent, ignore_errors=True)
 
 
 def _drive(config: ServingBenchConfig, manager, model,
@@ -153,6 +156,10 @@ def _drive(config: ServingBenchConfig, manager, model,
         t.start()
     for t in threads:
         t.join(600)
+    stragglers = [t for t in threads if t.is_alive()]
+    assert not stragglers, (
+        f"{len(stragglers)} client thread(s) still running — refusing to "
+        "report statistics over a partial latency list")
     elapsed = time.perf_counter() - start
     assert not errors, errors[:3]
 

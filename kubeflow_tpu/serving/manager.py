@@ -111,13 +111,13 @@ class ServedModel:
         self._queue.close()
         if worker is not None:
             worker.join(timeout=5)
-        # Fail anything the batcher never drained.
+        # Fail anything the batcher never drained (popping under the
+        # lock transfers resolution ownership to this thread).
         with self._pending_lock:
             leftovers = list(self._pending.values())
             self._pending.clear()
         for *_, future in leftovers:
-            if not future.done():
-                future.set_exception(RuntimeError("server shutting down"))
+            future.set_exception(RuntimeError("server shutting down"))
 
     def submit(self, inputs: Dict[str, np.ndarray],
                signature_name: Optional[str],
@@ -138,11 +138,12 @@ class ServedModel:
             pushed = False
             error = "server shutting down"
         if not pushed:
+            # Ownership protocol: whoever pops the _pending entry (this
+            # thread, the batcher, or stop()'s drain) is the only one
+            # allowed to resolve the future — no set_exception races.
             with self._pending_lock:
-                self._pending.pop(request_id, None)
-            # stop() may have failed this future already (it clears
-            # _pending concurrently); a second set_exception raises.
-            if not future.done():
+                owned = self._pending.pop(request_id, None) is not None
+            if owned:
                 future.set_exception(RuntimeError(error))
         return future
 

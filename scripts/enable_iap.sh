@@ -1,0 +1,59 @@
+#!/bin/bash
+# Enable GCP Identity-Aware Proxy on the envoy ingress and derive the
+# JWT audience the envoy config verifies.
+#
+# Parity: reference docs/gke/enable_iap.sh:56-99 — find the GCP
+# backend-service created for the envoy NodePort service, turn IAP on,
+# point its healthcheck at /healthz, raise the backend timeout for
+# websockets, and print the audience for `kft param set iap-envoy
+# audiences=...`.
+#
+# Usage: enable_iap.sh <project> <namespace> <oauth-client-id> <oauth-client-secret>
+set -euo pipefail
+
+PROJECT="${1:?project id}"
+NAMESPACE="${2:?k8s namespace}"
+CLIENT_ID="${3:?OAuth client id}"
+CLIENT_SECRET="${4:?OAuth client secret}"
+SERVICE="${ENVOY_SERVICE:-envoy}"
+
+# The GCE backend-service name embeds the service's NodePort.
+NODE_PORT=$(kubectl --namespace="${NAMESPACE}" get svc "${SERVICE}" \
+    -o jsonpath='{.spec.ports[0].nodePort}')
+echo "envoy NodePort: ${NODE_PORT}"
+
+BACKEND_NAME=""
+while [[ -z "${BACKEND_NAME}" ]]; do
+    BACKEND_NAME=$(gcloud compute --project="${PROJECT}" \
+        backend-services list \
+        --filter="name~k8s-be-${NODE_PORT}-" \
+        --format='value(name)')
+    [[ -z "${BACKEND_NAME}" ]] && echo "waiting for backend-service..." \
+        && sleep 10
+done
+echo "backend-service: ${BACKEND_NAME}"
+
+gcloud compute --project="${PROJECT}" backend-services update \
+    "${BACKEND_NAME}" --global \
+    --iap=enabled,oauth2-client-id="${CLIENT_ID}",oauth2-client-secret="${CLIENT_SECRET}"
+
+# Envoy serves its health at /healthz, not the GCE default /.
+HC_NAME=$(gcloud compute --project="${PROJECT}" health-checks list \
+    --filter="name~k8s-be-${NODE_PORT}-" --format='value(name)' | head -1)
+if [[ -n "${HC_NAME}" ]]; then
+    gcloud compute --project="${PROJECT}" health-checks update http \
+        "${HC_NAME}" --request-path=/healthz
+fi
+
+# Long-lived websockets (notebook kernels) need a long backend timeout
+# (reference raised it to 3600 s for exactly this).
+gcloud compute --project="${PROJECT}" backend-services update \
+    "${BACKEND_NAME}" --global --timeout=3600
+
+BACKEND_ID=$(gcloud compute --project="${PROJECT}" backend-services \
+    describe "${BACKEND_NAME}" --global --format='value(id)')
+PROJECT_NUM=$(gcloud projects describe "${PROJECT}" \
+    --format='value(projectNumber)')
+AUDIENCE="/projects/${PROJECT_NUM}/global/backendServices/${BACKEND_ID}"
+echo "JWT audience: ${AUDIENCE}"
+echo "wire it in with: kft param set iap-envoy audiences=${AUDIENCE}"
