@@ -60,6 +60,16 @@ def envoy_config(namespace: str, audiences: List[str],
     http_filters: List[Dict[str, Any]] = []
     if not disable_jwt:
         http_filters.append(jwt_filter)
+    # Bridge native gRPC clients to the model server's gRPC-Web
+    # PredictionService surface (serving/wire.py): the filter
+    # translates HTTP/2 gRPC ⇄ gRPC-Web over HTTP/1.1 upstream.
+    http_filters.append({
+        "name": "envoy.filters.http.grpc_web",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions.filters."
+                     "http.grpc_web.v3.GrpcWeb"
+        },
+    })
     http_filters.append({
         "name": "envoy.filters.http.router",
         "typed_config": {

@@ -111,6 +111,14 @@ def service(p: Dict[str, Any]) -> Dict[str, Any]:
             f"{name}-post", f"/models/{name}/", f"{name}.{ns}:8000",
             method="POST", rewrite=f"/model/{name}:predict",
             timeout_ms=10000),
+        # gRPC-Web PredictionService surface (serving/wire.py); the
+        # IAP Envoy's grpc_web filter bridges native gRPC clients
+        # down to this path.
+        k8s.ambassador_mapping(
+            f"{name}-grpc-web",
+            "/tensorflow.serving.PredictionService/",
+            f"{name}.{ns}:9000", method="POST", rewrite="",
+            timeout_ms=30000),
     ])
     return k8s.service(
         name, ns, {"app": name},

@@ -27,6 +27,49 @@ def predict(server: str, model: str, instances, *, classify: bool = False,
         return json.loads(resp.read())
 
 
+def grpc_web_predict(server: str, model: str, inputs: dict, *,
+                     signature_name: str = "", version=None,
+                     timeout: float = 10.0) -> dict:
+    """Predict over the gRPC-Web wire surface (PredictionService
+    schema, serving/wire.py) — the reference gRPC client's request
+    shape (label.py:40-56) without needing grpcio."""
+    import numpy as np
+
+    from kubeflow_tpu.serving import wire
+
+    body = wire.frame_message(wire.encode_predict_request(
+        model, {k: np.asarray(v) for k, v in inputs.items()},
+        signature_name=signature_name, version=version))
+    req = urllib.request.Request(
+        f"http://{server}/tensorflow.serving.PredictionService/Predict",
+        data=body,
+        headers={"Content-Type": "application/grpc-web+proto"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        frames = wire.unframe_messages(resp.read())
+    status = None
+    message = ""
+    outputs = {}
+    for flags, frame in frames:
+        if flags & 0x80:
+            for line in frame.decode().splitlines():
+                key, _, value = line.partition(":")
+                if key.strip() == "grpc-status":
+                    status = int(value.strip())
+                elif key.strip() == "grpc-message":
+                    message = value.strip()
+        else:
+            _, outputs = wire.decode_predict_response(frame)
+    if status is None:
+        # A truncated body parses as zero frames; missing trailers
+        # means the response is incomplete, never a success.
+        raise RuntimeError("response ended without grpc-status trailers")
+    if status != 0:
+        raise RuntimeError(f"grpc-status {status}: {message}")
+    return outputs
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kft-predict")
     parser.add_argument("--server", default="localhost:8000")

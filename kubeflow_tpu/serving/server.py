@@ -4,16 +4,19 @@ Reference: ``/usr/bin/tensorflow_model_server --port=9000
 --model_name=<n> --model_base_path=<p>`` (kubeflow/tf-serving/
 tf-serving.libsonnet:102-128), a C++ gRPC PredictionService. Here the
 native pieces are the batching queue + version watcher
-(native/kft_runtime.cc) and XLA executes the model; the transport is
-HTTP/JSON (this environment ships no grpc — the wire protocol is
-internal to the pod: the REST proxy on :8000 is the public surface,
-same as the reference).
+(native/kft_runtime.cc) and XLA executes the model. Transports:
+HTTP/JSON (TF-Serving REST shapes; the proxy on :8000 is the public
+surface, same as the reference) plus the PredictionService schema over
+gRPC-Web — grpcio/h2 are unavailable in this environment, so native
+gRPC clients reach it through Envoy's grpc_web filter (design
+rationale: serving/wire.py).
 
-Endpoints (TF-Serving REST-compatible shapes):
+Endpoints:
   GET  /v1/models/<name>                      → version status
   GET  /v1/models/<name>/metadata             → signature map
   POST /v1/models/<name>[/versions/<v>]:predict   {"instances": ...}
   POST /v1/models/<name>[/versions/<v>]:classify  {"instances": ...}
+  POST /tensorflow.serving.PredictionService/Predict  (grpc-web+proto)
   GET  /healthz
 """
 
@@ -166,6 +169,99 @@ def _batch_to_instances(outputs: Dict[str, np.ndarray]) -> list:
     ]
 
 
+class GrpcWebPredictHandler(BaseHandler):
+    """gRPC-Web Predict: the PredictionService wire surface.
+
+    POST /tensorflow.serving.PredictionService/Predict with
+    application/grpc-web+proto — the same PredictRequest/
+    PredictResponse schema the reference's gRPC clients speak
+    (inception-client/label.py:40-56); Envoy's grpc_web filter bridges
+    native gRPC clients to this over HTTP/1.1. See serving/wire.py for
+    why a raw-HTTP/2 gRPC listener isn't built here.
+    """
+
+    async def post(self):
+        import base64
+        import concurrent.futures
+
+        from kubeflow_tpu.serving import wire
+
+        ctype = self.request.headers.get("Content-Type", "")
+        self._text_mode = "-text" in ctype.split(";")[0]
+        if not any(ctype.startswith(t)
+                   for t in wire.GRPC_WEB_CONTENT_TYPES + (
+                       "application/grpc-web-text",)):
+            return self.write_json(
+                {"error": f"unsupported content-type {ctype!r}"}, 415)
+        try:
+            body = self.request.body
+            if self._text_mode:  # grpc-web-text = base64-wrapped frames
+                body = base64.b64decode(body)
+            frames = wire.unframe_messages(body)
+            data = [m for flags, m in frames if not flags & 0x80]
+            if len(data) != 1:
+                raise ValueError(f"expected 1 message frame, got {len(data)}")
+            spec, inputs, output_filter = wire.decode_predict_request(data[0])
+            model = self.manager.get_model(spec["name"])
+            loaded = model.get(spec["version"])
+            sig = loaded.signature(spec["signature_name"] or None)
+            unknown = set(inputs) - set(sig.inputs)
+            if unknown:
+                raise ValueError(
+                    f"unknown inputs {sorted(unknown)}; signature has "
+                    f"{sorted(sig.inputs)}")
+            input_name = next(iter(sig.inputs))
+            if input_name not in inputs:
+                raise ValueError(
+                    f"request missing input {input_name!r}; "
+                    f"got {sorted(inputs)}")
+            future = model.submit({input_name: inputs[input_name]},
+                                  spec["signature_name"] or None,
+                                  "predict", spec["version"])
+            outputs = await tornado.ioloop.IOLoop.current().run_in_executor(
+                None, future.result, 30.0)
+            if output_filter:
+                missing = set(output_filter) - set(outputs)
+                if missing:
+                    raise ValueError(
+                        f"output_filter names unknown outputs "
+                        f"{sorted(missing)}; available {sorted(outputs)}")
+                outputs = {k: outputs[k] for k in output_filter}
+            body = wire.encode_predict_response(
+                outputs, spec["name"], loaded.version)
+            self._grpc_reply(wire.frame_message(body)
+                             + wire.trailers_frame(0))
+        except KeyError as e:
+            self._grpc_error(5, str(e))  # NOT_FOUND
+        except ValueError as e:
+            self._grpc_error(3, str(e))  # INVALID_ARGUMENT
+        except concurrent.futures.TimeoutError:
+            self._grpc_error(4, "predict timed out")  # DEADLINE_EXCEEDED
+        except RuntimeError as e:
+            self._grpc_error(14, str(e))  # UNAVAILABLE
+        except Exception as e:  # malformed frames etc. must not 500:
+            # gRPC-Web clients can only map grpc-status trailers.
+            self._grpc_error(3, f"malformed request: {type(e).__name__}")
+
+    def _grpc_reply(self, payload: bytes) -> None:
+        import base64
+
+        if self._text_mode:
+            self.set_header("Content-Type",
+                            "application/grpc-web-text+proto")
+            self.finish(base64.b64encode(payload))
+        else:
+            self.set_header("Content-Type", "application/grpc-web+proto")
+            self.finish(payload)
+
+    def _grpc_error(self, status: int, message: str) -> None:
+        from kubeflow_tpu.serving import wire
+
+        self.set_status(200)  # gRPC-Web carries status in trailers
+        self._grpc_reply(wire.trailers_frame(
+            status, message.replace("\n", " ")))
+
+
 def make_app(manager: ModelManager) -> tornado.web.Application:
     return tornado.web.Application([
         (r"/healthz", HealthHandler),
@@ -174,6 +270,8 @@ def make_app(manager: ModelManager) -> tornado.web.Application:
         (r"/v1/models/([^/:]+)/metadata", MetadataHandler),
         (r"/v1/models/([^/:]+)(?:/versions/(\d+))?:(predict|classify)",
          InferHandler),
+        (r"/tensorflow\.serving\.PredictionService/Predict",
+         GrpcWebPredictHandler),
     ], manager=manager)
 
 

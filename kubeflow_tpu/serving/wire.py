@@ -1,0 +1,351 @@
+"""TF-Serving PredictionService wire compatibility (protobuf + gRPC
+framing) without grpcio/protobuf runtimes.
+
+The reference's serving surface was gRPC on :9000
+(``kubeflow/tf-serving/tf-serving.libsonnet:106-111``; client
+``components/k8s-model-server/inception-client/label.py:40-56``). This
+environment ships neither grpcio nor an HTTP/2 stack, so a native gRPC
+listener is not buildable here; the deliberate surface design is:
+
+- REST/JSON (server.py) as the in-pod + gateway surface (the
+  reference's http-proxy already made REST the public surface);
+- a **gRPC-Web** endpoint (``POST /tensorflow.serving.
+  PredictionService/Predict``, content-type ``application/grpc-web+
+  proto``) speaking the exact PredictRequest/PredictResponse schema.
+  gRPC-Web runs over HTTP/1.1 (no HPACK/h2 needed), real gRPC-Web
+  clients call it directly, and the Envoy already deployed for IAP
+  (manifests/iap.py) bridges native gRPC clients via its grpc_web
+  filter.
+
+This module is the protobuf wire codec for that surface: a minimal
+encoder/decoder for the tensorflow.serving messages, hand-rolled
+against the public proto schemas (field numbers below are the public
+API contract):
+
+  TensorProto        tensorflow/core/framework/tensor.proto
+  TensorShapeProto   tensorflow/core/framework/tensor_shape.proto
+  ModelSpec          tensorflow_serving/apis/model.proto
+  PredictRequest     tensorflow_serving/apis/predict.proto
+  PredictResponse    tensorflow_serving/apis/predict.proto
+
+Tests cross-validate byte-level round-trips against
+``tf.make_tensor_proto`` where tensorflow is available.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+# --- protobuf wire primitives ---------------------------------------------
+
+_VARINT = 0
+_I64 = 1
+_LEN = 2
+_I32 = 5
+
+
+def _encode_varint(value: int) -> bytes:
+    out = bytearray()
+    value &= (1 << 64) - 1
+    while True:
+        byte = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(byte | 0x80)
+        else:
+            out.append(byte)
+            return bytes(out)
+
+
+def _decode_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        byte = buf[pos]
+        pos += 1
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _tag(field: int, wire_type: int) -> bytes:
+    return _encode_varint((field << 3) | wire_type)
+
+
+def _field_varint(field: int, value: int) -> bytes:
+    return _tag(field, _VARINT) + _encode_varint(value)
+
+
+def _field_bytes(field: int, data: bytes) -> bytes:
+    return _tag(field, _LEN) + _encode_varint(len(data)) + data
+
+
+def _iter_fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) over a message."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _decode_varint(buf, pos)
+        field, wire_type = key >> 3, key & 7
+        if wire_type == _VARINT:
+            value, pos = _decode_varint(buf, pos)
+        elif wire_type == _LEN:
+            length, pos = _decode_varint(buf, pos)
+            value = buf[pos:pos + length]
+            pos += length
+        elif wire_type == _I64:
+            value = buf[pos:pos + 8]
+            pos += 8
+        elif wire_type == _I32:
+            value = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire_type}")
+        yield field, wire_type, value
+
+
+# --- DataType enum (tensorflow/core/framework/types.proto) -----------------
+
+DT_FLOAT = 1
+DT_DOUBLE = 2
+DT_INT32 = 3
+DT_UINT8 = 4
+DT_STRING = 7
+DT_INT64 = 9
+DT_BOOL = 10
+DT_BFLOAT16 = 14
+
+_NP_TO_DT = {
+    np.dtype(np.float32): DT_FLOAT,
+    np.dtype(np.float64): DT_DOUBLE,
+    np.dtype(np.int32): DT_INT32,
+    np.dtype(np.uint8): DT_UINT8,
+    np.dtype(np.int64): DT_INT64,
+    np.dtype(np.bool_): DT_BOOL,
+}
+_DT_TO_NP = {v: k for k, v in _NP_TO_DT.items()}
+
+
+# --- messages ---------------------------------------------------------------
+
+def encode_tensor(array: np.ndarray) -> bytes:
+    """numpy → TensorProto bytes (dtype=1, tensor_shape=2,
+    tensor_content=4)."""
+    array = np.ascontiguousarray(array)
+    dt = _NP_TO_DT.get(array.dtype)
+    if dt is None:
+        raise ValueError(f"unsupported dtype {array.dtype}")
+    shape = b"".join(
+        _field_bytes(2, _field_varint(1, dim)) for dim in array.shape)
+    return (_field_varint(1, dt)
+            + _field_bytes(2, shape)
+            + _field_bytes(4, array.tobytes()))
+
+
+def decode_tensor(buf: bytes) -> np.ndarray:
+    """TensorProto bytes → numpy. Handles tensor_content and the
+    repeated *_val fallbacks clients like tf.make_tensor_proto emit
+    for small tensors."""
+    dtype_enum: Optional[int] = None
+    dims: List[int] = []
+    content = b""
+    float_vals: List[float] = []
+    int_vals: List[int] = []
+    string_vals: List[bytes] = []
+    for field, wire_type, value in _iter_fields(buf):
+        if field == 1 and wire_type == _VARINT:
+            dtype_enum = int(value)
+        elif field == 2 and wire_type == _LEN:
+            for sfield, swt, sval in _iter_fields(value):
+                if sfield == 2 and swt == _LEN:  # Dim message
+                    for dfield, dwt, dval in _iter_fields(sval):
+                        if dfield == 1 and dwt == _VARINT:
+                            # size is int64; -1 (unknown) arrives as
+                            # 2^64-1 — reject, shapes must be static.
+                            size = int(dval)
+                            if size >= 1 << 63:
+                                raise ValueError("unknown dim size")
+                            dims.append(size)
+        elif field == 4 and wire_type == _LEN:
+            content = bytes(value)
+        elif field == 5:  # float_val (packed or not)
+            if wire_type == _LEN:
+                float_vals.extend(
+                    struct.unpack(f"<{len(value) // 4}f", value))
+            else:
+                float_vals.append(struct.unpack("<f", value)[0])
+        elif field == 7 and wire_type == _VARINT:  # int_val
+            int_vals.append(int(value))
+        elif field == 7 and wire_type == _LEN:  # packed int_val
+            pos = 0
+            while pos < len(value):
+                v, pos = _decode_varint(value, pos)
+                int_vals.append(v)
+        elif field == 8 and wire_type == _LEN:  # string_val
+            string_vals.append(bytes(value))
+        elif field == 10:  # int64_val
+            if wire_type == _VARINT:
+                int_vals.append(int(value))
+            else:
+                pos = 0
+                while pos < len(value):
+                    v, pos = _decode_varint(value, pos)
+                    int_vals.append(v)
+    if dtype_enum is None:
+        raise ValueError("TensorProto without dtype")
+    if dtype_enum == DT_STRING:
+        raise ValueError("string tensors are not supported")
+    np_dtype = _DT_TO_NP.get(dtype_enum)
+    if np_dtype is None:
+        raise ValueError(f"unsupported DataType enum {dtype_enum}")
+    shape = tuple(dims)
+    if content:
+        return np.frombuffer(content, dtype=np_dtype).reshape(shape)
+    if float_vals:
+        values = np.asarray(float_vals, dtype=np_dtype)
+    elif int_vals:
+        # Varints are two's-complement for negative ints.
+        values = np.asarray(
+            [v - (1 << 64) if v >= 1 << 63 else v for v in int_vals],
+            dtype=np_dtype)
+    else:
+        values = np.zeros(0, np_dtype)
+    if values.size == 1 and int(np.prod(shape or (1,))) > 1:
+        # Proto3 scalar broadcast (tf.make_tensor_proto fill).
+        return np.full(shape, values[0], np_dtype)
+    return values.reshape(shape)
+
+
+def encode_model_spec(name: str, version: Optional[int] = None,
+                      signature_name: str = "") -> bytes:
+    out = _field_bytes(1, name.encode())
+    if version is not None:
+        out += _field_bytes(2, _field_varint(1, version))  # Int64Value
+    if signature_name:
+        out += _field_bytes(3, signature_name.encode())
+    return out
+
+
+def decode_model_spec(buf: bytes) -> Dict[str, object]:
+    spec: Dict[str, object] = {"name": "", "version": None,
+                               "signature_name": ""}
+    for field, wire_type, value in _iter_fields(buf):
+        if field == 1 and wire_type == _LEN:
+            spec["name"] = bytes(value).decode()
+        elif field == 2 and wire_type == _LEN:
+            for f2, wt2, v2 in _iter_fields(value):
+                if f2 == 1 and wt2 == _VARINT:
+                    spec["version"] = int(v2)
+        elif field == 3 and wire_type == _LEN:
+            spec["signature_name"] = bytes(value).decode()
+    return spec
+
+
+def encode_predict_request(model_name: str,
+                           inputs: Dict[str, np.ndarray],
+                           signature_name: str = "",
+                           version: Optional[int] = None) -> bytes:
+    out = _field_bytes(1, encode_model_spec(model_name, version,
+                                            signature_name))
+    for key, tensor in inputs.items():
+        entry = (_field_bytes(1, key.encode())
+                 + _field_bytes(2, encode_tensor(tensor)))
+        out += _field_bytes(2, entry)  # map<string, TensorProto> inputs
+    return out
+
+
+def decode_predict_request(buf: bytes):
+    """→ (model_spec dict, {input_name: ndarray}, [output_filter])."""
+    spec: Dict[str, object] = {"name": "", "version": None,
+                               "signature_name": ""}
+    inputs: Dict[str, np.ndarray] = {}
+    output_filter: List[str] = []
+    for field, wire_type, value in _iter_fields(buf):
+        if field == 1 and wire_type == _LEN:
+            spec = decode_model_spec(value)
+        elif field == 2 and wire_type == _LEN:  # inputs map entry
+            key = ""
+            tensor = None
+            for f2, wt2, v2 in _iter_fields(value):
+                if f2 == 1 and wt2 == _LEN:
+                    key = bytes(v2).decode()
+                elif f2 == 2 and wt2 == _LEN:
+                    tensor = decode_tensor(v2)
+            if key and tensor is not None:
+                inputs[key] = tensor
+        elif field == 3 and wire_type == _LEN:
+            output_filter.append(bytes(value).decode())
+    return spec, inputs, output_filter
+
+
+def encode_predict_response(outputs: Dict[str, np.ndarray],
+                            model_name: str,
+                            version: Optional[int] = None) -> bytes:
+    out = b""
+    for key, tensor in outputs.items():
+        entry = (_field_bytes(1, key.encode())
+                 + _field_bytes(2, encode_tensor(np.asarray(tensor))))
+        out += _field_bytes(1, entry)  # map<string, TensorProto> outputs
+    out += _field_bytes(2, encode_model_spec(model_name, version))
+    return out
+
+
+def decode_predict_response(buf: bytes):
+    """→ (model_spec dict, {output_name: ndarray})."""
+    spec: Dict[str, object] = {"name": "", "version": None,
+                               "signature_name": ""}
+    outputs: Dict[str, np.ndarray] = {}
+    for field, wire_type, value in _iter_fields(buf):
+        if field == 1 and wire_type == _LEN:
+            key = ""
+            tensor = None
+            for f2, wt2, v2 in _iter_fields(value):
+                if f2 == 1 and wt2 == _LEN:
+                    key = bytes(v2).decode()
+                elif f2 == 2 and wt2 == _LEN:
+                    tensor = decode_tensor(v2)
+            if key and tensor is not None:
+                outputs[key] = tensor
+        elif field == 2 and wire_type == _LEN:
+            spec = decode_model_spec(value)
+    return spec, outputs
+
+
+# --- gRPC / gRPC-Web framing -----------------------------------------------
+
+GRPC_WEB_CONTENT_TYPES = (
+    "application/grpc-web+proto",
+    "application/grpc-web",
+    "application/grpc+proto",
+    "application/grpc",
+)
+
+
+def frame_message(message: bytes, *, trailers: bool = False) -> bytes:
+    """One gRPC length-prefixed frame: flags(1) + len(4, BE) + body."""
+    flags = 0x80 if trailers else 0x00
+    return struct.pack(">BI", flags, len(message)) + message
+
+
+def unframe_messages(body: bytes) -> List[Tuple[int, bytes]]:
+    """→ [(flags, message_bytes)] (data frames and trailer frames)."""
+    frames = []
+    pos = 0
+    while pos + 5 <= len(body):
+        flags, length = struct.unpack(">BI", body[pos:pos + 5])
+        pos += 5
+        frames.append((flags, body[pos:pos + length]))
+        pos += length
+    return frames
+
+
+def trailers_frame(status: int = 0, message: str = "") -> bytes:
+    text = f"grpc-status:{status}\r\n"
+    if message:
+        text += f"grpc-message:{message}\r\n"
+    return frame_message(text.encode(), trailers=True)

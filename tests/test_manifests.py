@@ -159,6 +159,7 @@ def test_envoy_config_valid_and_routed():
     assert prefixes == ["/healthz", "/hub", "/user", "/whoami", "/"]
     filters = [f["name"] for f in hcm["http_filters"]]
     assert filters == ["envoy.filters.http.jwt_authn",
+                       "envoy.filters.http.grpc_web",
                        "envoy.filters.http.router"]
     jwt = hcm["http_filters"][0]["typed_config"]
     assert jwt["providers"]["iap"]["audiences"] == ["aud1"]
@@ -170,7 +171,7 @@ def test_envoy_config_valid_and_routed():
     hcm = cfg["static_resources"]["listeners"][0]["filter_chains"][0][
         "filters"][0]["typed_config"]
     assert [f["name"] for f in hcm["http_filters"]] == \
-        ["envoy.filters.http.router"]
+        ["envoy.filters.http.grpc_web", "envoy.filters.http.router"]
 
 
 def test_jupyterhub_config_assembly():

@@ -211,6 +211,93 @@ class ServingEndToEnd(tornado.testing.AsyncHTTPTestCase):
                           body=json.dumps({}))
         assert resp.code == 400
 
+    def test_grpc_web_predict_wire_surface(self):
+        """The PredictionService wire path end-to-end: framed
+        PredictRequest in, framed PredictResponse + trailers out,
+        numerically identical to the REST path."""
+        from kubeflow_tpu.serving import wire
+
+        x = np.random.RandomState(3).rand(2, 32, 32, 3).astype(np.float32)
+        body = wire.frame_message(wire.encode_predict_request(
+            "testnet", {"images": x}))
+        resp = self.fetch(
+            "/tensorflow.serving.PredictionService/Predict",
+            method="POST", body=body,
+            headers={"Content-Type": "application/grpc-web+proto"})
+        assert resp.code == 200, resp.body
+        frames = wire.unframe_messages(resp.body)
+        data = [m for flags, m in frames if not flags & 0x80]
+        trailers = [m for flags, m in frames if flags & 0x80]
+        assert b"grpc-status:0" in trailers[0]
+        _, outputs = wire.decode_predict_response(data[0])
+        assert outputs["logits"].shape == (2, 10)
+        rest = json.loads(self.fetch(
+            "/v1/models/testnet:predict", method="POST",
+            body=json.dumps({"instances": x.tolist()})).body)
+        np.testing.assert_allclose(
+            outputs["logits"],
+            np.asarray([p["logits"] for p in rest["predictions"]]),
+            atol=1e-5)
+        # Unknown model → NOT_FOUND in trailers, HTTP still 200.
+        bad = wire.frame_message(wire.encode_predict_request(
+            "nope", {"images": x}))
+        resp = self.fetch(
+            "/tensorflow.serving.PredictionService/Predict",
+            method="POST", body=bad,
+            headers={"Content-Type": "application/grpc-web+proto"})
+        assert resp.code == 200
+        trailer = wire.unframe_messages(resp.body)[0][1]
+        assert b"grpc-status:5" in trailer
+
+    def test_grpc_web_edge_cases(self):
+        import base64
+
+        from kubeflow_tpu.serving import wire
+
+        x = np.zeros((1, 32, 32, 3), np.float32)
+        good = wire.frame_message(wire.encode_predict_request(
+            "testnet", {"images": x}))
+        url = "/tensorflow.serving.PredictionService/Predict"
+
+        # grpc-web-text: base64 both ways.
+        resp = self.fetch(url, method="POST",
+                          body=base64.b64encode(good),
+                          headers={"Content-Type":
+                                   "application/grpc-web-text+proto"})
+        assert resp.code == 200
+        assert resp.headers["Content-Type"].startswith(
+            "application/grpc-web-text")
+        frames = wire.unframe_messages(base64.b64decode(resp.body))
+        assert any(b"grpc-status:0" in m for f, m in frames if f & 0x80)
+
+        # Malformed frame bytes → INVALID_ARGUMENT trailers, never 500.
+        resp = self.fetch(url, method="POST",
+                          body=wire.frame_message(b"\x0a"),
+                          headers={"Content-Type":
+                                   "application/grpc-web+proto"})
+        assert resp.code == 200
+        assert b"grpc-status:3" in wire.unframe_messages(resp.body)[0][1]
+
+        # Unknown extra input → INVALID_ARGUMENT.
+        extra = wire.frame_message(wire.encode_predict_request(
+            "testnet", {"images": x, "bogus": x}))
+        resp = self.fetch(url, method="POST", body=extra,
+                          headers={"Content-Type":
+                                   "application/grpc-web+proto"})
+        assert b"grpc-status:3" in wire.unframe_messages(resp.body)[0][1]
+
+        # output_filter narrows the response.
+        filtered = wire.frame_message(
+            wire.encode_predict_request("testnet", {"images": x})
+            + wire._field_bytes(3, b"logits"))
+        resp = self.fetch(url, method="POST", body=filtered,
+                          headers={"Content-Type":
+                                   "application/grpc-web+proto"})
+        data = [m for f, m in wire.unframe_messages(resp.body)
+                if not f & 0x80]
+        _, outputs = wire.decode_predict_response(data[0])
+        assert set(outputs) == {"logits"}
+
     def tearDown(self):
         self.manager.stop()
         super().tearDown()
