@@ -103,6 +103,10 @@ def cmd_generate(args: argparse.Namespace) -> int:
     specs = proto.param_set().overlay(params).specs
     for key, value in params.items():
         specs[key].coerce(value)
+    # ksonnet passed the component name as the prototype's `name` param
+    # implicitly (`ks generate tf-job myjob` ⇒ name=myjob); same here.
+    if "name" in specs and "name" not in params:
+        params["name"] = name
     app.setdefault("components", {})[name] = {
         "prototype": proto.name,
         "params": params,

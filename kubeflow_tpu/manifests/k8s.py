@@ -526,7 +526,11 @@ def ambassador_mapping(name: str, prefix: str, service_addr: str, *,
         f"prefix: {prefix}",
     ]
     if rewrite is not None:
-        lines.append(f"rewrite: {rewrite}")
+        # Empty = explicit no-rewrite; must be quoted or YAML reads
+        # the bare value as null (and a trailing space forces ugly
+        # escaped quoting on the whole annotation).
+        lines.append(f'rewrite: "{rewrite}"' if rewrite == ""
+                     else f"rewrite: {rewrite}")
     if method is not None:
         lines.append(f"method: {method}")
     if timeout_ms is not None:

@@ -104,3 +104,22 @@ def test_raw_param_isolation():
     r1 = ps.resolve()
     r1["cfg"]["a"] = 999
     assert ps.resolve()["cfg"] == {"a": 1}
+
+
+def test_generate_defaults_name_to_component(tmp_path, capsys):
+    """ksonnet parity: `ks generate tf-job myjob` implied name=myjob;
+    generate must seed the prototype's required `name` param from the
+    component name so show/apply work without an explicit --param."""
+    app = str(tmp_path)
+    run(["init", app, "--force"])
+    run(["generate", "tpu-job", "myjob", "--app-dir", app])
+    capsys.readouterr()
+    assert run(["show", "myjob", "--app-dir", app]) == 0
+    out = capsys.readouterr().out
+    assert "name: myjob" in out
+    # An explicit --param name=... still wins.
+    run(["generate", "tpu-job", "other", "--app-dir", app,
+         "--param", "name=custom"])
+    capsys.readouterr()
+    run(["show", "other", "--app-dir", app])
+    assert "name: custom" in capsys.readouterr().out
