@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from kubeflow_tpu.models.registry import ModelEntry, register_model
 from kubeflow_tpu.ops.flash_attention import flash_attention
+from kubeflow_tpu.ops.moe import MoE
 
 AttentionFn = Callable[..., jax.Array]
 
@@ -103,6 +104,8 @@ class LlamaBlock(nn.Module):
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[AttentionFn] = None
+    num_experts: int = 0  # >0 → MoE FFN (expert-parallel)
+    num_selected: int = 2
 
     @nn.compact
     def __call__(self, x, positions):
@@ -112,6 +115,12 @@ class LlamaBlock(nn.Module):
             self.rope_theta, self.dtype, self.attention_fn, name="attention",
         )(h, positions)
         h = RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
+        if self.num_experts > 0:
+            return x + MoE(
+                num_experts=self.num_experts, mlp_dim=self.mlp_dim,
+                num_selected=self.num_selected, dtype=self.dtype,
+                name="moe",
+            )(h)
         gate = _dense(self.mlp_dim, ("embed", "mlp"), self.dtype,
                       "gate_proj")(h)
         up = _dense(self.mlp_dim, ("embed", "mlp"), self.dtype, "up_proj")(h)
@@ -133,6 +142,8 @@ class Llama(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[AttentionFn] = None
     remat: bool = False
+    num_experts: int = 0  # >0 → MoE FFN in every block
+    num_selected: int = 2
 
     @nn.compact
     def __call__(self, input_ids, positions=None, train=True):
@@ -157,6 +168,7 @@ class Llama(nn.Module):
             x = block_cls(
                 self.num_heads, self.num_kv_heads, head_dim, self.mlp_dim,
                 self.rope_theta, self.dtype, self.attention_fn,
+                self.num_experts, self.num_selected,
                 name=f"layer_{i}",
             )(x, positions)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)
@@ -187,7 +199,16 @@ def llama_test(**kw) -> Llama:
                  mlp_dim=128, **kw)
 
 
+def llama_moe_test(**kw) -> Llama:
+    """Tiny MoE config for CI (4 experts, top-2, expert-parallel)."""
+    kw.setdefault("vocab_size", 512)
+    kw.setdefault("num_experts", 4)
+    return Llama(num_layers=2, d_model=64, num_heads=4, num_kv_heads=2,
+                 mlp_dim=128, **kw)
+
+
 register_model(ModelEntry("llama2-7b", "language", llama2_7b, ((2048,), "int32"), 32000))
 register_model(ModelEntry("llama2-13b", "language", llama2_13b, ((2048,), "int32"), 32000))
 register_model(ModelEntry("llama3-8b", "language", llama3_8b, ((2048,), "int32"), 128256))
 register_model(ModelEntry("llama-test", "language", llama_test, ((128,), "int32"), 512))
+register_model(ModelEntry("llama-moe-test", "language", llama_moe_test, ((128,), "int32"), 512))

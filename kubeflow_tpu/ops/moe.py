@@ -1,0 +1,145 @@
+"""Mixture-of-Experts FFN with expert parallelism (GShard-style).
+
+Greenfield vs the reference (SURVEY §2.5: no model parallelism of any
+kind); fills the ``expert`` axis of the standard mesh
+(:mod:`kubeflow_tpu.parallel.mesh`).
+
+TPU-first design:
+- **Static shapes everywhere**: top-k routing with a fixed per-expert
+  capacity; over-capacity tokens are dropped (their FFN contribution
+  is zero, and transformer blocks add the residual stream back, the
+  Switch-Transformer convention). No dynamic gathers.
+- **Dispatch/combine as einsums** against one-hot tensors: with tokens
+  sharded over (data, fsdp) and expert weights sharded over the
+  ``expert`` mesh axis (logical axis name ``"expert"`` in the rule
+  table, parallel/tensor_parallel.py), GSPMD lowers these einsums to
+  the all-to-all exchanges a hand-written MoE would issue — same
+  recipe as TP: annotate, let XLA insert collectives.
+- Router math in fp32; load-balance auxiliary loss sown into the
+  ``"losses"`` collection (collect with
+  ``mutable=["losses"]`` / ``nn.apply(..., mutable=...)``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def compute_capacity(tokens: int, num_experts: int, num_selected: int,
+                     capacity_factor: float) -> int:
+    """Per-expert token slots: even share × capacity factor, floor 4
+    and rounded up to a multiple of 4 (sublane-friendly)."""
+    ideal = tokens * num_selected / num_experts
+    capacity = int(ideal * capacity_factor) + 1
+    return max(4, -(-capacity // 4) * 4)
+
+
+def top_k_dispatch(probs: jax.Array, num_selected: int,
+                   capacity: int) -> Tuple[jax.Array, jax.Array]:
+    """Build the combine tensor for top-k routing with capacity.
+
+    ``probs``: [T, E] fp32 router probabilities.
+    Returns (combine [T, E, C] fp32, aux_fraction [E]): ``combine``
+    carries the (renormalized) gate weight at each token's assigned
+    (expert, slot); ``aux_fraction`` is the fraction of tokens whose
+    i-th choice landed on each expert (for the balance loss).
+    """
+    t, e = probs.shape
+    remaining = probs
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    taken = jnp.zeros((e,), jnp.int32)  # slots already filled per expert
+    chosen_fraction = jnp.zeros((e,), jnp.float32)
+    kept_gate_sum = jnp.zeros((t,), jnp.float32)
+    for _ in range(num_selected):
+        choice = jnp.argmax(remaining, axis=-1)  # [T]
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)  # [T, E]
+        # Arrival rank of each token within its chosen expert, offset
+        # by slots previous rounds already filled.
+        rank = jnp.cumsum(onehot, axis=0) - onehot  # [T, E] rank among round
+        pos = (jnp.take_along_axis(rank, choice[:, None], 1)[:, 0]
+               + taken[choice])  # [T]
+        keep = (pos < capacity)
+        gate = jnp.take_along_axis(remaining, choice[:, None], 1)[:, 0]
+        gate = jnp.where(keep, gate, 0.0)
+        slot = jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity,
+                              dtype=jnp.float32)  # [T, C]
+        combine = combine + (gate[:, None, None]
+                             * onehot.astype(jnp.float32)[:, :, None]
+                             * slot[:, None, :])
+        taken = taken + jnp.sum(onehot * keep[:, None].astype(jnp.int32),
+                                axis=0)
+        chosen_fraction = chosen_fraction + jnp.mean(
+            onehot.astype(jnp.float32), axis=0)
+        kept_gate_sum = kept_gate_sum + gate
+        remaining = remaining * (1.0 - onehot.astype(probs.dtype))
+    # Renormalize over the kept choices so gates sum to 1 per token
+    # (dropped tokens keep 0 everywhere → pure residual passthrough).
+    combine = combine / jnp.maximum(kept_gate_sum, 1e-9)[:, None, None]
+    return combine, chosen_fraction / num_selected
+
+
+class MoE(nn.Module):
+    """Top-k routed expert FFN: [B, S, D] → [B, S, D].
+
+    Expert weights carry the ``"expert"`` logical axis so the rule
+    table shards them over the ``expert`` mesh axis; the dispatch
+    einsums become all-to-alls under GSPMD.
+    """
+
+    num_experts: int
+    mlp_dim: int
+    num_selected: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, s, d = x.shape
+        tokens = b * s
+        flat = x.reshape(tokens, d)
+
+        router = nn.Dense(
+            self.num_experts, use_bias=False, dtype=jnp.float32,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.normal(0.02), ("embed", None)),
+            name="router")
+        probs = jax.nn.softmax(router(flat.astype(jnp.float32)), axis=-1)
+
+        capacity = compute_capacity(tokens, self.num_experts,
+                                    self.num_selected,
+                                    self.capacity_factor)
+        combine, chosen_fraction = top_k_dispatch(
+            probs, self.num_selected, capacity)
+
+        # Load-balance loss (Switch eq. 4): E · Σ_e fraction_e · mean
+        # router prob_e; minimized at uniform routing.
+        aux = self.num_experts * jnp.sum(
+            chosen_fraction * jnp.mean(probs, axis=0))
+        self.sow("losses", "moe_aux", aux)
+
+        w_in = self.param(
+            "w_in",
+            nn.with_partitioning(nn.initializers.normal(0.02),
+                                 ("expert", "embed", "mlp")),
+            (self.num_experts, d, self.mlp_dim))
+        w_out = self.param(
+            "w_out",
+            nn.with_partitioning(nn.initializers.normal(0.02),
+                                 ("expert", "mlp", "embed")),
+            (self.num_experts, self.mlp_dim, d))
+
+        dispatch = (combine > 0).astype(self.dtype)  # [T, E, C]
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch, flat.astype(self.dtype))
+        h = jnp.einsum("ecd,edf->ecf", expert_in,
+                       jnp.asarray(w_in, self.dtype))
+        h = nn.gelu(h, approximate=True)
+        expert_out = jnp.einsum("ecf,efd->ecd", h,
+                                jnp.asarray(w_out, self.dtype))
+        y = jnp.einsum("tec,ecd->td", combine.astype(self.dtype),
+                       expert_out)
+        return y.reshape(b, s, d)

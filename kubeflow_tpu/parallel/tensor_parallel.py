@@ -98,10 +98,16 @@ def logical_to_sharding(
                 dims.append(kept[0] if len(kept) == 1 else kept)
         return NamedSharding(mesh, P(*dims))
 
-    return jax.tree.map(
-        convert, logical_axes,
-        is_leaf=lambda x: x is None or isinstance(x, (tuple, P)),
-    )
+    def is_axes_leaf(x: Any) -> bool:
+        # An axis spec is None, a PartitionSpec, or a tuple of axis
+        # names — NOT any tuple (collections like flax `sow` wrap
+        # values in tuples, which must flatten as containers).
+        if x is None or isinstance(x, P):
+            return True
+        return isinstance(x, tuple) and all(
+            e is None or isinstance(e, str) for e in x)
+
+    return jax.tree.map(convert, logical_axes, is_leaf=is_axes_leaf)
 
 
 def variables_sharding(

@@ -162,28 +162,39 @@ def make_lm_train_step(
     *,
     objective: str = "causal",
     donate: bool = True,
+    aux_loss_weight: float = 0.01,
 ):
     """Jitted SPMD train step for an LMState.
 
     ``objective``: "mlm" (BERT pretraining) or "causal" (Llama).
+    Auxiliary losses sown into the ``"losses"`` collection (the MoE
+    load-balance loss, ops/moe.py) are collected every step and added
+    with ``aux_loss_weight``; models that sow nothing contribute zero.
     """
     loss_fn = LOSSES[objective]
 
     def step(state: LMState, batch: Batch):
         def compute(params):
-            logits = state.apply_fn({"params": params}, *_model_args(batch))
+            logits, mutated = state.apply_fn(
+                {"params": params}, *_model_args(batch),
+                mutable=["losses"])
             loss, acc = loss_fn(logits, batch)
-            return loss, acc
+            aux = sum(
+                jnp.sum(leaf)
+                for leaf in jax.tree.leaves(mutated.get("losses", {}))
+            )
+            aux = jnp.asarray(aux, loss.dtype)
+            return loss + aux_loss_weight * aux, (loss, acc, aux)
 
-        (loss, acc), grads = jax.value_and_grad(compute, has_aux=True)(
-            state.params
-        )
+        (_, (loss, acc, aux)), grads = jax.value_and_grad(
+            compute, has_aux=True)(state.params)
         updates, new_opt = state.tx.update(grads, state.opt_state,
                                            state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {
             "loss": loss,
             "accuracy": acc,
+            "aux_loss": aux,
             "grad_norm": optax.global_norm(grads),
         }
         return (
