@@ -20,6 +20,7 @@ import functools
 from typing import Any, Callable, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu.models.registry import ModelEntry, register_model
@@ -58,13 +59,49 @@ class BottleneckBlock(nn.Module):
         return self.act(residual + y)
 
 
+def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
+    """[B, H, W, C] → [B, H/b, W/b, b²·C]; channel order (a, b, c)
+    with a/b the within-block spatial offsets (the order
+    :func:`stem_kernel_to_s2d` assumes)."""
+    bsz, h, w, c = x.shape
+    x = x.reshape(bsz, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(bsz, h // block, w // block, block * block * c)
+
+
+def stem_kernel_to_s2d(w7: jax.Array) -> jax.Array:
+    """Reparametrize a (7,7,C,O) stride-2 stem kernel into the
+    equivalent (4,4,4C,O) stride-1 kernel over space-to-depth input.
+
+    Derivation: with SAME padding (2 left, 3 right) the original
+    output is y[i,j] = Σ w[u,v,c]·x[2i+u−2, 2j+v−2, c]. Writing
+    u = 2a' + a (a ∈ {0,1} the s2d channel offset, a' the s2d spatial
+    tap −1..2) maps every (u,v) into a 4×4 window over s2d pixels with
+    padding (1,2); u=7 taps don't exist, so the 7×7 kernel is
+    zero-padded to 8×8 first.
+    """
+    k, _, c, o = w7.shape
+    assert k == 7, w7.shape
+    w8 = jnp.pad(w7, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    # [8,8,C,O] → [4,2(a),4,2(b),C,O] → [4,4,2,2,C,O] → [4,4,4C,O]
+    w = w8.reshape(4, 2, 4, 2, c, o).transpose(0, 2, 1, 3, 4, 5)
+    return w.reshape(4, 4, 4 * c, o)
+
+
 class ResNet(nn.Module):
-    """ResNet v1.5 for NHWC image batches."""
+    """ResNet v1.5 for NHWC image batches.
+
+    ``stem``: "conv7" (the textbook 7×7/s2) or "s2d" — the MLPerf
+    space-to-depth reparametrization: mathematically the same function
+    (see :func:`stem_kernel_to_s2d`), but the conv sees 12 input
+    channels at 112² instead of 3 at 224², a far better MXU shape.
+    """
 
     stage_sizes: Sequence[int]
     num_classes: int = 1000
     width: int = 64
     dtype: Any = jnp.bfloat16
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -81,7 +118,12 @@ class ResNet(nn.Module):
         act = nn.relu
 
         x = x.astype(self.dtype)
-        x = conv(self.width, (7, 7), (2, 2), name="conv_init")(x)
+        if self.stem == "s2d":
+            x = space_to_depth(x)
+            x = conv(self.width, (4, 4), (1, 1),
+                     padding=((1, 2), (1, 2)), name="conv_init")(x)
+        else:
+            x = conv(self.width, (7, 7), (2, 2), name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
@@ -105,8 +147,10 @@ class ResNet(nn.Module):
         return x
 
 
-def resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
-    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype)
+def resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+             stem: str = "conv7") -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
+                  dtype=dtype, stem=stem)
 
 
 def resnet101(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:

@@ -76,10 +76,25 @@ def top_k_dispatch(probs: jax.Array, num_selected: int,
             onehot.astype(jnp.float32), axis=0)
         kept_gate_sum = kept_gate_sum + gate
         remaining = remaining * (1.0 - onehot.astype(probs.dtype))
-    # Renormalize over the kept choices so gates sum to 1 per token
-    # (dropped tokens keep 0 everywhere → pure residual passthrough).
-    combine = combine / jnp.maximum(kept_gate_sum, 1e-9)[:, None, None]
+    if num_selected > 1:
+        # Renormalize over the kept choices so gates sum to 1 per
+        # token (dropped tokens keep 0 everywhere → pure residual
+        # passthrough).
+        combine = combine / jnp.maximum(kept_gate_sum, 1e-9)[:, None, None]
+    # num_selected == 1: keep the RAW router probability as the scale
+    # (Switch Transformer). Renormalizing would make the weight a
+    # constant 1.0 — zero gradient into the router from the main loss,
+    # and top-1 routing could never be learned.
     return combine, chosen_fraction / num_selected
+
+
+def _fit_group_size(tokens: int, group_size: int) -> int:
+    """Largest divisor of ``tokens`` ≤ ``group_size``."""
+    group_size = min(group_size, tokens)
+    for candidate in range(group_size, 0, -1):
+        if tokens % candidate == 0:
+            return candidate
+    return tokens
 
 
 class MoE(nn.Module):
@@ -88,37 +103,49 @@ class MoE(nn.Module):
     Expert weights carry the ``"expert"`` logical axis so the rule
     table shards them over the ``expert`` mesh axis; the dispatch
     einsums become all-to-alls under GSPMD.
+
+    Routing happens within fixed-size token *groups* (GShard): the
+    combine tensor is [groups, G, E, C] with C ∝ G/E, so dispatch
+    memory is O(T·G·k) instead of the O(T²·k/E) a global dispatch
+    would cost — the difference between toy shapes and batch·seq in
+    the millions.
     """
 
     num_experts: int
     mlp_dim: int
     num_selected: int = 2
     capacity_factor: float = 1.25
+    group_size: int = 512
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         b, s, d = x.shape
         tokens = b * s
-        flat = x.reshape(tokens, d)
+        group = _fit_group_size(tokens, self.group_size)
+        n_groups = tokens // group
+        grouped = x.reshape(n_groups, group, d)
 
         router = nn.Dense(
             self.num_experts, use_bias=False, dtype=jnp.float32,
             kernel_init=nn.with_partitioning(
                 nn.initializers.normal(0.02), ("embed", None)),
             name="router")
-        probs = jax.nn.softmax(router(flat.astype(jnp.float32)), axis=-1)
+        probs = jax.nn.softmax(
+            router(grouped.astype(jnp.float32)), axis=-1)  # [n, G, E]
 
-        capacity = compute_capacity(tokens, self.num_experts,
+        capacity = compute_capacity(group, self.num_experts,
                                     self.num_selected,
                                     self.capacity_factor)
-        combine, chosen_fraction = top_k_dispatch(
-            probs, self.num_selected, capacity)
+        combine, chosen_fraction = jax.vmap(
+            lambda p: top_k_dispatch(p, self.num_selected, capacity)
+        )(probs)  # combine [n, G, E, C]; fraction [n, E]
 
         # Load-balance loss (Switch eq. 4): E · Σ_e fraction_e · mean
         # router prob_e; minimized at uniform routing.
         aux = self.num_experts * jnp.sum(
-            chosen_fraction * jnp.mean(probs, axis=0))
+            jnp.mean(chosen_fraction, axis=0)
+            * jnp.mean(probs, axis=(0, 1)))
         self.sow("losses", "moe_aux", aux)
 
         w_in = self.param(
@@ -132,14 +159,16 @@ class MoE(nn.Module):
                                  ("expert", "mlp", "embed")),
             (self.num_experts, self.mlp_dim, d))
 
-        dispatch = (combine > 0).astype(self.dtype)  # [T, E, C]
+        dispatch = (combine > 0).astype(self.dtype)  # [n, G, E, C]
+        # [n, E, C, d] expert inputs → per-expert FFN (n and C are
+        # batch-like dims for the expert matmuls).
         expert_in = jnp.einsum(
-            "tec,td->ecd", dispatch, flat.astype(self.dtype))
-        h = jnp.einsum("ecd,edf->ecf", expert_in,
+            "ngec,ngd->necd", dispatch, grouped.astype(self.dtype))
+        h = jnp.einsum("necd,edf->necf", expert_in,
                        jnp.asarray(w_in, self.dtype))
         h = nn.gelu(h, approximate=True)
-        expert_out = jnp.einsum("ecf,efd->ecd", h,
+        expert_out = jnp.einsum("necf,efd->necd", h,
                                 jnp.asarray(w_out, self.dtype))
-        y = jnp.einsum("tec,ecd->td", combine.astype(self.dtype),
+        y = jnp.einsum("ngec,necd->ngd", combine.astype(self.dtype),
                        expert_out)
         return y.reshape(b, s, d)

@@ -114,3 +114,42 @@ def test_graft_dryrun_multichip(cpu_devices):
     import __graft_entry__ as graft
 
     graft.dryrun_multichip(8)
+
+
+def test_s2d_stem_is_equivalent_reparametrization():
+    """The space-to-depth stem must compute the SAME function as the
+    7x7/s2 stem once the kernel is transformed (MLPerf conv0 trick)."""
+    import numpy as np
+
+    from kubeflow_tpu.models.resnet import (
+        ResNet,
+        space_to_depth,
+        stem_kernel_to_s2d,
+    )
+
+    ref = ResNet(stage_sizes=(1,), num_classes=10, width=16,
+                 dtype=jnp.float32, stem="conv7")
+    s2d = ResNet(stage_sizes=(1,), num_classes=10, width=16,
+                 dtype=jnp.float32, stem="s2d")
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 64, 3),
+                          jnp.float32)
+    variables = ref.init(jax.random.PRNGKey(1), x, train=False)
+    w7 = variables["params"]["conv_init"]["kernel"]
+    s2d_vars = jax.tree_util.tree_map(lambda v: v, variables)
+    s2d_params = dict(s2d_vars["params"])
+    s2d_params["conv_init"] = {"kernel": stem_kernel_to_s2d(w7)}
+    out_ref = ref.apply(variables, x, train=False)
+    out_s2d = s2d.apply(
+        {"params": s2d_params, "batch_stats": variables["batch_stats"]},
+        x, train=False)
+    np.testing.assert_allclose(np.asarray(out_s2d), np.asarray(out_ref),
+                               atol=2e-4, rtol=2e-4)
+    # And the raw packing matches the kernel derivation's channel order.
+    probe = jnp.arange(2 * 4 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 4, 3)
+    packed = space_to_depth(probe)
+    assert packed.shape == (2, 2, 2, 12)
+    np.testing.assert_array_equal(
+        np.asarray(packed[0, 0, 0]),
+        np.asarray(jnp.concatenate(
+            [probe[0, 0, 0], probe[0, 0, 1], probe[0, 1, 0],
+             probe[0, 1, 1]])))

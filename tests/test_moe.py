@@ -21,14 +21,34 @@ def test_top1_dispatch_routes_every_token_with_ample_capacity():
     rng = jax.random.PRNGKey(0)
     probs = jax.nn.softmax(jax.random.normal(rng, (16, 4)), -1)
     combine, fraction = top_k_dispatch(probs, 1, capacity=16)
-    # Each token lands exactly one slot with weight 1 (renormalized).
+    # Top-1 keeps the RAW router prob as the scale (Switch): the
+    # weight must equal the argmax probability, not 1.0.
     per_token = np.asarray(combine.sum(axis=(1, 2)))
-    np.testing.assert_allclose(per_token, 1.0, atol=1e-6)
+    np.testing.assert_allclose(per_token, np.asarray(probs.max(axis=1)),
+                               atol=1e-6)
     # Slot assignment matches argmax routing.
     expert_of_token = np.asarray(combine.sum(axis=2)).argmax(axis=1)
     np.testing.assert_array_equal(expert_of_token,
                                   np.asarray(probs.argmax(axis=1)))
     assert abs(float(fraction.sum()) - 1.0) < 1e-6
+
+
+def test_top1_router_receives_main_loss_gradient():
+    """Switch-style scaling exists exactly so the router learns from
+    the task loss with k=1; a renormalized (constant-1) gate would
+    zero this gradient."""
+    moe = MoE(num_experts=4, mlp_dim=16, num_selected=1,
+              dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(8), (2, 8, 16), jnp.float32)
+    variables = moe.init(jax.random.PRNGKey(9), x)
+
+    def main_loss(params):
+        out = moe.apply({"params": params}, x)
+        return jnp.sum(out ** 2)
+
+    grads = nn.meta.unbox(jax.grad(main_loss)(variables["params"]))
+    router_grad = float(jnp.abs(grads["router"]["kernel"]).sum())
+    assert router_grad > 0, "top-1 router got no gradient from the task"
 
 
 def test_top2_gates_renormalized():
@@ -48,14 +68,15 @@ def test_capacity_drops_are_clean():
     probs = jnp.tile(jnp.array([[0.97, 0.01, 0.01, 0.01]]), (32, 1))
     combine, _ = top_k_dispatch(probs, 1, capacity=4)
     total = np.asarray(combine.sum(axis=(1, 2)))
-    assert (total[:4] > 0.99).all()
+    np.testing.assert_allclose(total[:4], 0.97, atol=1e-6)  # raw gate
     assert (total[4:] == 0).all()
     assert np.isfinite(np.asarray(combine)).all()
 
 
 def test_moe_matches_manual_expert_computation():
     """Top-1, ample capacity: the layer must equal routing each token
-    through its argmax expert's FFN."""
+    through its argmax expert's FFN, scaled by the router prob
+    (Switch-style)."""
     moe = MoE(num_experts=4, mlp_dim=32, num_selected=1,
               capacity_factor=8.0, dtype=jnp.float32)
     x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, 16), jnp.float32)
@@ -65,15 +86,40 @@ def test_moe_matches_manual_expert_computation():
     params = nn.meta.unbox(variables["params"])
     flat = np.asarray(x.reshape(16, 16))
     logits = flat @ np.asarray(params["router"]["kernel"])
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), -1))
     choice = logits.argmax(axis=1)
     w_in = np.asarray(params["w_in"])
     w_out = np.asarray(params["w_out"])
     expected = np.stack([
-        np.asarray(nn.gelu(jnp.asarray(tok @ w_in[e]), approximate=True))
-        @ w_out[e]
-        for tok, e in zip(flat, choice)
+        probs[t, e]
+        * (np.asarray(nn.gelu(jnp.asarray(tok @ w_in[e]),
+                              approximate=True)) @ w_out[e])
+        for t, (tok, e) in enumerate(zip(flat, choice))
     ]).reshape(2, 8, 16)
     np.testing.assert_allclose(np.asarray(out), expected,
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_moe_grouped_dispatch_bounds_memory():
+    """Dispatch memory is O(T·G·k), not O(T²): group_size caps the
+    capacity dim, and grouped routing equals global routing when the
+    router is identical per group (ample capacity)."""
+    from kubeflow_tpu.ops.moe import _fit_group_size
+
+    assert _fit_group_size(16384, 512) == 512
+    assert _fit_group_size(100, 512) == 100
+    assert _fit_group_size(96, 64) == 48
+    moe = MoE(num_experts=4, mlp_dim=16, group_size=8,
+              capacity_factor=8.0, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(10), (2, 32, 16),
+                          jnp.float32)
+    variables = moe.init(jax.random.PRNGKey(11), x)
+    out_grouped = moe.apply(variables, x)
+    moe_global = MoE(num_experts=4, mlp_dim=16, group_size=64,
+                     capacity_factor=8.0, dtype=jnp.float32)
+    out_global = moe_global.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(out_grouped),
+                               np.asarray(out_global),
                                atol=2e-5, rtol=2e-5)
 
 
