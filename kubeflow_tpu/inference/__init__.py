@@ -1,0 +1,1 @@
+from kubeflow_tpu.inference.generate import generate  # noqa: F401

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu.models.registry import ModelEntry, register_model
+from kubeflow_tpu.ops.attention import dense_attention
 from kubeflow_tpu.ops.flash_attention import flash_attention
 from kubeflow_tpu.ops.moe import MoE
 
@@ -70,6 +71,7 @@ class LlamaAttention(nn.Module):
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[AttentionFn] = None
+    cache_size: int = 0  # >0 → autoregressive KV cache (generation)
 
     @nn.compact
     def __call__(self, x, positions):
@@ -85,7 +87,39 @@ class LlamaAttention(nn.Module):
         v = v.reshape(b, l, self.num_kv_heads, self.head_dim)
         q = rope(q, positions, self.rope_theta)
         k = rope(k, positions, self.rope_theta)
-        if self.attention_fn is not None:
+        if self.cache_size:
+            if self.attention_fn is not None:
+                raise ValueError(
+                    "cache_size and attention_fn are mutually exclusive: "
+                    "the decode path always uses dense attention over the "
+                    "cache, which would silently replace a sequence-"
+                    "parallel attention_fn")
+            # Decode path: append this call's K/V into the static-size
+            # cache at the running index, attend over the valid prefix.
+            # All shapes static (TPU rule); validity is arithmetic.
+            cached_k = self.variable(
+                "cache", "k", jnp.zeros,
+                (b, self.cache_size, self.num_kv_heads, self.head_dim),
+                self.dtype)
+            cached_v = self.variable(
+                "cache", "v", jnp.zeros,
+                (b, self.cache_size, self.num_kv_heads, self.head_dim),
+                self.dtype)
+            index = self.variable(
+                "cache", "index", lambda: jnp.zeros((), jnp.int32))
+            start = index.value
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(self.dtype), (0, start, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(self.dtype), (0, start, 0, 0))
+            index.value = start + l
+            valid = (jnp.arange(self.cache_size)[None, :]
+                     < (start + l)).astype(jnp.int32)
+            valid = jnp.broadcast_to(valid, (b, self.cache_size))
+            out = dense_attention(
+                q, cached_k.value, cached_v.value, causal=True,
+                q_offset=start, kv_offset=0, kv_segment_valid=valid)
+        elif self.attention_fn is not None:
             out = self.attention_fn(q, k, v)
         else:
             # Default: fused Pallas flash kernel (falls back to XLA
@@ -106,13 +140,15 @@ class LlamaBlock(nn.Module):
     attention_fn: Optional[AttentionFn] = None
     num_experts: int = 0  # >0 → MoE FFN (expert-parallel)
     num_selected: int = 2
+    cache_size: int = 0
 
     @nn.compact
     def __call__(self, x, positions):
         h = RMSNorm(dtype=self.dtype, name="attn_norm")(x)
         x = x + LlamaAttention(
             self.num_heads, self.num_kv_heads, self.head_dim,
-            self.rope_theta, self.dtype, self.attention_fn, name="attention",
+            self.rope_theta, self.dtype, self.attention_fn,
+            self.cache_size, name="attention",
         )(h, positions)
         h = RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
         if self.num_experts > 0:
@@ -144,6 +180,7 @@ class Llama(nn.Module):
     remat: bool = False
     num_experts: int = 0  # >0 → MoE FFN in every block
     num_selected: int = 2
+    cache_size: int = 0  # >0 → KV cache (inference/generate.py)
 
     @nn.compact
     def __call__(self, input_ids, positions=None, train=True):
@@ -168,7 +205,7 @@ class Llama(nn.Module):
             x = block_cls(
                 self.num_heads, self.num_kv_heads, head_dim, self.mlp_dim,
                 self.rope_theta, self.dtype, self.attention_fn,
-                self.num_experts, self.num_selected,
+                self.num_experts, self.num_selected, self.cache_size,
                 name=f"layer_{i}",
             )(x, positions)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)

@@ -122,8 +122,11 @@ class ResNet(nn.Module):
             x = space_to_depth(x)
             x = conv(self.width, (4, 4), (1, 1),
                      padding=((1, 2), (1, 2)), name="conv_init")(x)
-        else:
+        elif self.stem == "conv7":
             x = conv(self.width, (7, 7), (2, 2), name="conv_init")(x)
+        else:
+            raise ValueError(
+                f"unknown stem {self.stem!r}; expected 'conv7' or 's2d'")
         x = norm(name="bn_init")(x)
         x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
