@@ -1,0 +1,83 @@
+"""Subprocess body for the multi-process gang test (test_multiprocess).
+
+Runs the PRODUCTION bootstrap: the operator-injected env
+(KFT_COORDINATOR_ADDRESS / KFT_NUM_PROCESSES / KFT_PROCESS_ID) through
+``training.launcher.initialize_distributed`` — then a real sharded
+train step over the GLOBAL mesh (2 processes × 2 local CPU devices),
+with each host feeding only its own rows
+(``jax.make_array_from_process_local_data``). Prints one line the
+parent asserts on.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root (no install needed)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from kubeflow_tpu.models.resnet import resnet18ish  # noqa: E402
+from kubeflow_tpu.parallel.mesh import (  # noqa: E402
+    MeshSpec,
+    batch_sharding,
+    build_mesh,
+)
+from kubeflow_tpu.training.launcher import (  # noqa: E402
+    initialize_distributed,
+)
+from kubeflow_tpu.training.data import host_shard_range  # noqa: E402
+from kubeflow_tpu.training.train import (  # noqa: E402
+    create_train_state,
+    make_train_step,
+    place_state,
+)
+
+
+def main() -> int:
+    assert initialize_distributed(), "env must describe a 2-process gang"
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 4  # 2 hosts × 2 local devices
+
+    mesh = build_mesh(MeshSpec(data=4))
+    model = resnet18ish(num_classes=10)
+    state = create_train_state(
+        model, optax.sgd(0.1), jax.random.PRNGKey(0),
+        jnp.zeros((1, 32, 32, 3), jnp.bfloat16))
+    state = place_state(mesh, state)
+
+    global_batch = 8
+    rows = host_shard_range(global_batch)
+    rng = np.random.RandomState(0)  # same stream on both hosts
+    images = rng.randn(global_batch, 32, 32, 3).astype(np.float32)
+    labels = rng.randint(0, 10, global_batch)
+    sharding = batch_sharding(mesh)
+    batch = {
+        "inputs": jax.make_array_from_process_local_data(
+            sharding, images[rows.start:rows.stop].astype(jnp.bfloat16)),
+        "labels": jax.make_array_from_process_local_data(
+            sharding, labels[rows.start:rows.stop]),
+    }
+
+    step = make_train_step(mesh)
+    for _ in range(2):
+        state, metrics = step(state, batch)
+    loss = float(metrics["loss"])
+    print(f"GANG_OK process={jax.process_index()} "
+          f"devices={len(jax.devices())} loss={loss:.6f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
