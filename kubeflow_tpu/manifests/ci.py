@@ -134,6 +134,12 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             "--namespace", params["test_namespace"],
             "--junit_path", f"{params['artifacts_dir']}/junit_serving.xml",
         ],
+        "dashboard-test": [
+            py, "-m", "kubeflow_tpu.citests.dashboard",
+            "--namespace", params["test_namespace"],
+            "--junit_path",
+            f"{params['artifacts_dir']}/junit_dashboard.xml",
+        ],
         "teardown": [
             py, "-m", "kubeflow_tpu.citests.deploy", "teardown",
             "--namespace", params["test_namespace"],
@@ -160,6 +166,7 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             _dag_task("deploy-serving", ["deploy-test"]),
             _dag_task("tpujob-test", ["deploy-test"]),
             _dag_task("serving-test", ["deploy-serving"]),
+            _dag_task("dashboard-test", ["deploy-test"]),
         ]},
     })
     templates.append({

@@ -86,3 +86,12 @@ def test_serving_fake_e2e(tmp_path):
     junit_path = tmp_path / "junit_serving.xml"
     rc = ci_serving.main(["--fake", "--junit_path", str(junit_path)])
     assert rc == 0
+
+
+def test_dashboard_fake_e2e(tmp_path):
+    from kubeflow_tpu.citests import dashboard as ci_dashboard
+
+    junit_path = tmp_path / "junit_dashboard.xml"
+    rc = ci_dashboard.main(["--fake", "--junit_path", str(junit_path)])
+    assert rc == 0
+    assert b"dashboard-ui" in junit_path.read_bytes()
