@@ -37,12 +37,43 @@ def init_cache(model: Any, params: Any, batch: int) -> Any:
                         shapes["cache"])
 
 
+def _truncate_logits(logits: jax.Array, top_k: Optional[int],
+                     top_p: Optional[float]) -> jax.Array:
+    """Mask logits outside the top-k set and/or the top-p (nucleus)
+    set to -inf. Static shapes throughout: top-p uses a full
+    descending sort (one ``lax.top_k`` over vocab — cheap on TPU next
+    to the decode matmuls) and converts the kept set into a value
+    threshold, avoiding any scatter back to token order."""
+    neg_inf = jnp.asarray(-jnp.inf, logits.dtype)
+    # top_k in (None, 0) and top_p in (None, >=1.0) mean "disabled"
+    # (the conventional sentinels); top_k >= vocab is a no-op.
+    if top_k is not None and 0 < top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg_inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jax.lax.top_k(logits, logits.shape[-1])[0]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with mass ≥ top_p; the top token is
+        # force-kept so top_p ≤ 0 degrades to greedy rather than to an
+        # all--inf row (categorical over which would emit token 0).
+        keep = (cum - probs) < top_p
+        keep = keep.at[..., 0].set(True)
+        threshold = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+            keepdims=True)
+        logits = jnp.where(logits < threshold, neg_inf, logits)
+    return logits
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "max_new_tokens", "temperature", "eos_id"))
+    static_argnames=("model", "max_new_tokens", "temperature", "eos_id",
+                     "top_k", "top_p"))
 def _generate_jit(model, params, prompt_ids, rng, cache, *,
                   max_new_tokens: int, temperature: float,
-                  eos_id: Optional[int]):
+                  eos_id: Optional[int], top_k: Optional[int] = None,
+                  top_p: Optional[float] = None):
     """Module-level jit: repeat calls with the same (model, shapes,
     config) hit the trace cache instead of recompiling per call."""
     b, prompt_len = prompt_ids.shape
@@ -50,9 +81,10 @@ def _generate_jit(model, params, prompt_ids, rng, cache, *,
     def sample(logits, step_rng):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits.astype(jnp.float32) / temperature
+        logits = _truncate_logits(logits, top_k, top_p)
         return jax.random.categorical(
-            step_rng, logits.astype(jnp.float32) / temperature, axis=-1
-        ).astype(jnp.int32)
+            step_rng, logits, axis=-1).astype(jnp.int32)
 
     def decode_step(carry, step_rng):
         cache, token, position, done = carry
@@ -98,6 +130,8 @@ def generate(
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
     eos_id: Optional[int] = None,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Generate ``max_new_tokens`` continuations of ``prompt_ids``.
 
@@ -105,7 +139,9 @@ def generate(
     ``cache_size >= prompt_len + max_new_tokens``. Returns
     ``(tokens [B, max_new_tokens], logits [B, max_new_tokens, V])``.
     With ``eos_id``, tokens after the first EOS are replaced by EOS
-    (shapes stay static; callers trim).
+    (shapes stay static; callers trim). ``top_k``/``top_p`` truncate
+    the sampling distribution (nucleus sampling); both only apply when
+    ``temperature > 0``.
     """
     if model.cache_size < prompt_ids.shape[1] + max_new_tokens:
         raise ValueError(
@@ -116,4 +152,5 @@ def generate(
     cache = init_cache(model, params, prompt_ids.shape[0])
     return _generate_jit(model, params, prompt_ids, rng, cache,
                          max_new_tokens=max_new_tokens,
-                         temperature=temperature, eos_id=eos_id)
+                         temperature=temperature, eos_id=eos_id,
+                         top_k=top_k, top_p=top_p)

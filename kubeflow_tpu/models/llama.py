@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from kubeflow_tpu.models.registry import ModelEntry, register_model
 from kubeflow_tpu.ops.attention import dense_attention
 from kubeflow_tpu.ops.flash_attention import flash_attention
+from kubeflow_tpu.ops.lora import LoRADense
 from kubeflow_tpu.ops.moe import MoE
 
 AttentionFn = Callable[..., jax.Array]
@@ -54,7 +55,10 @@ def rope(x: jax.Array, positions: jax.Array, theta: float = 10000.0) -> jax.Arra
     return rotated.astype(x.dtype)
 
 
-def _dense(features, axes, dtype, name=None):
+def _dense(features, axes, dtype, name=None, lora_rank=0, lora_alpha=16.0):
+    if lora_rank:
+        return LoRADense(features, axes, dtype, lora_rank, lora_alpha,
+                         name=name)
     return nn.Dense(
         features, dtype=dtype, use_bias=False,
         kernel_init=nn.with_partitioning(
@@ -72,16 +76,18 @@ class LlamaAttention(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[AttentionFn] = None
     cache_size: int = 0  # >0 → autoregressive KV cache (generation)
+    lora_rank: int = 0  # >0 → LoRA adapters on q/k/v/o (ops/lora.py)
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x, positions):
         b, l, d_model = x.shape
         q = _dense(self.num_heads * self.head_dim, ("embed", "heads"),
-                   self.dtype, "q_proj")(x)
+                   self.dtype, "q_proj", self.lora_rank, self.lora_alpha)(x)
         k = _dense(self.num_kv_heads * self.head_dim, ("embed", "kv"),
-                   self.dtype, "k_proj")(x)
+                   self.dtype, "k_proj", self.lora_rank, self.lora_alpha)(x)
         v = _dense(self.num_kv_heads * self.head_dim, ("embed", "kv"),
-                   self.dtype, "v_proj")(x)
+                   self.dtype, "v_proj", self.lora_rank, self.lora_alpha)(x)
         q = q.reshape(b, l, self.num_heads, self.head_dim)
         k = k.reshape(b, l, self.num_kv_heads, self.head_dim)
         v = v.reshape(b, l, self.num_kv_heads, self.head_dim)
@@ -127,7 +133,8 @@ class LlamaAttention(nn.Module):
             # memory at any length.
             out = flash_attention(q, k, v, causal=True)
         out = out.reshape(b, l, self.num_heads * self.head_dim)
-        return _dense(d_model, ("heads", "embed"), self.dtype, "o_proj")(out)
+        return _dense(d_model, ("heads", "embed"), self.dtype, "o_proj",
+                      self.lora_rank, self.lora_alpha)(out)
 
 
 class LlamaBlock(nn.Module):
@@ -141,6 +148,8 @@ class LlamaBlock(nn.Module):
     num_experts: int = 0  # >0 → MoE FFN (expert-parallel)
     num_selected: int = 2
     cache_size: int = 0
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x, positions):
@@ -148,7 +157,8 @@ class LlamaBlock(nn.Module):
         x = x + LlamaAttention(
             self.num_heads, self.num_kv_heads, self.head_dim,
             self.rope_theta, self.dtype, self.attention_fn,
-            self.cache_size, name="attention",
+            self.cache_size, self.lora_rank, self.lora_alpha,
+            name="attention",
         )(h, positions)
         h = RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
         if self.num_experts > 0:
@@ -181,6 +191,8 @@ class Llama(nn.Module):
     num_experts: int = 0  # >0 → MoE FFN in every block
     num_selected: int = 2
     cache_size: int = 0  # >0 → KV cache (inference/generate.py)
+    lora_rank: int = 0  # >0 → LoRA fine-tuning (training/finetune.py)
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, input_ids, positions=None, train=True):
@@ -206,6 +218,7 @@ class Llama(nn.Module):
                 self.num_heads, self.num_kv_heads, head_dim, self.mlp_dim,
                 self.rope_theta, self.dtype, self.attention_fn,
                 self.num_experts, self.num_selected, self.cache_size,
+                self.lora_rank, self.lora_alpha,
                 name=f"layer_{i}",
             )(x, positions)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)

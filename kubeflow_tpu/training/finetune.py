@@ -1,0 +1,170 @@
+"""Parameter-efficient fine-tuning: frozen base + trainable LoRA.
+
+The BASELINE.md stretch row ("Llama-2-7B fine-tune … v5e") needs a
+trainer where the base model contributes **no gradient buffers and no
+optimizer moments** — that is what makes 7B fit a 16 GB chip:
+
+    full fine-tune:  params + grads + 2×adam moments ≈ 4× param bytes
+    LoRA fine-tune:  params (frozen, bf16) + ~0.1% adapter state
+
+Mechanics: the adapters live in the flax ``"lora"`` collection
+(ops/lora.py), so ``jax.value_and_grad`` here differentiates *only*
+the adapter tree — XLA dead-code-eliminates every ``dW`` matmul of the
+frozen kernels on the backward pass (the structural guarantee; the
+optax.masked alternative would still materialize full-size grads).
+
+Sharding follows the same logical-axis rule table as pretraining
+(parallel/tensor_parallel.py): adapters annotate ``(in_axis, "lora")``
+/ ``("lora", out_axis)``, so under a (data, fsdp, tensor) mesh the
+skinny A/B factors shard alongside their frozen kernels while the
+rank axis replicates.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import batch_sharding
+from kubeflow_tpu.parallel.tensor_parallel import rules_for
+from kubeflow_tpu.training.lm import (
+    LOSSES,
+    Batch,
+    _model_args,
+    sharded_collection_init,
+    sharded_opt_init,
+)
+
+
+class LoRAState(struct.PyTreeNode):
+    """Train state where only ``lora`` (and its moments) update."""
+
+    step: jax.Array
+    base_params: Any  # frozen
+    lora: Any  # trainable adapters
+    opt_state: optax.OptState  # moments over ``lora`` only
+    apply_fn: Any = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+
+def create_lora_state(
+    model: Any,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    sample_batch: Batch,
+    mesh: Optional[Mesh] = None,
+    rules: Optional[Mapping[str, Any]] = None,
+    base_dtype: Any = None,
+) -> Tuple[LoRAState, Optional[LoRAState]]:
+    """Build (state, state_shardings) for a ``lora_rank > 0`` model.
+
+    ``base_dtype=jnp.bfloat16`` stores the frozen weights in bf16 —
+    halves the resident footprint vs flax's f32 param default, and is
+    lossless for training since the base never receives updates. The
+    cast happens inside the init jit, so per-tensor f32 temporaries
+    are freed as each param is produced (no 2× peak).
+    """
+
+    def cast_base(split):
+        params, lora = split
+        if base_dtype is not None:
+            params = jax.tree.map(
+                lambda x: x.astype(base_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                params)
+        return params, lora
+
+    if mesh is None:
+        def init_split(rng):
+            variables = model.init(rng, *_model_args(sample_batch))
+            return cast_base((nn.meta.unbox(variables["params"]),
+                              nn.meta.unbox(variables["lora"])))
+
+        params, lora = jax.jit(init_split)(rng)
+        state = LoRAState(
+            step=jnp.zeros((), jnp.int32),
+            base_params=params, lora=lora, opt_state=tx.init(lora),
+            apply_fn=model.apply, tx=tx)
+        return state, None
+
+    rules = rules_for(mesh, rules)
+    (params, lora), (params_sh, lora_sh) = sharded_collection_init(
+        model, rng, sample_batch, mesh, rules,
+        split_fn=lambda v: (v["params"], v["lora"]),
+        transform_fn=cast_base)
+    opt_state, opt_sh = sharded_opt_init(tx, lora, lora_sh, mesh)
+    replicated = NamedSharding(mesh, P())
+
+    state = LoRAState(
+        step=jnp.zeros((), jnp.int32),
+        base_params=params, lora=lora, opt_state=opt_state,
+        apply_fn=model.apply, tx=tx)
+    shardings = LoRAState(
+        step=replicated,
+        base_params=params_sh, lora=lora_sh, opt_state=opt_sh,
+        apply_fn=model.apply, tx=tx)
+    return state, shardings
+
+
+def make_lora_train_step(
+    mesh: Optional[Mesh],
+    shardings: Optional[LoRAState],
+    *,
+    objective: str = "causal",
+    donate: bool = True,
+    aux_loss_weight: float = 0.01,
+):
+    """Jitted SPMD step: grads and updates over ``state.lora`` only.
+
+    Auxiliary losses sown into the ``"losses"`` collection (the MoE
+    load-balance loss, ops/moe.py) are collected and weighted exactly
+    as in the pretraining step — a LoRA fine-tune of an MoE model must
+    keep routing-balance pressure even though the router is frozen.
+    """
+    loss_fn = LOSSES[objective]
+
+    def step(state: LoRAState, batch: Batch):
+        def compute(lora):
+            logits, mutated = state.apply_fn(
+                {"params": state.base_params, "lora": lora},
+                *_model_args(batch), mutable=["losses"])
+            loss, acc = loss_fn(logits, batch)
+            aux = sum(
+                jnp.sum(leaf)
+                for leaf in jax.tree.leaves(mutated.get("losses", {}))
+            )
+            aux = jnp.asarray(aux, loss.dtype)
+            return loss + aux_loss_weight * aux, (loss, acc, aux)
+
+        (_, (loss, acc, aux)), grads = jax.value_and_grad(
+            compute, has_aux=True)(state.lora)
+        updates, new_opt = state.tx.update(grads, state.opt_state,
+                                           state.lora)
+        new_lora = optax.apply_updates(state.lora, updates)
+        metrics = {
+            "loss": loss,
+            "accuracy": acc,
+            "aux_loss": aux,
+            "grad_norm": optax.global_norm(grads),
+        }
+        return (
+            state.replace(step=state.step + 1, lora=new_lora,
+                          opt_state=new_opt),
+            metrics,
+        )
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+    batch_sh = batch_sharding(mesh)
+    return jax.jit(
+        step,
+        in_shardings=(shardings, batch_sh),
+        out_shardings=(shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,) if donate else (),
+    )

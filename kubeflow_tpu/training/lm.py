@@ -56,6 +56,55 @@ def _model_args(batch: Batch) -> Tuple[jax.Array, ...]:
     return (batch["input_ids"],)
 
 
+def sharded_collection_init(
+    model: Any,
+    rng: jax.Array,
+    sample_batch: Batch,
+    mesh: Mesh,
+    rules: Mapping[str, Any],
+    split_fn: Callable[[Any], Any],
+    transform_fn: Optional[Callable[[Any], Any]] = None,
+) -> Tuple[Any, Any]:
+    """Initialize ``split_fn(variables)`` *directly into its shards*.
+
+    The shared recipe behind both trainers (pretraining here, LoRA in
+    training/finetune.py): eval_shape the init to get logical axis
+    metadata, map it through the TP rule table, then jit the real init
+    with ``out_shardings`` so a 7B model never materializes replicated
+    on one host. ``split_fn`` picks which collections to keep;
+    ``transform_fn`` (optional) post-processes the unboxed values
+    inside the init jit — e.g. a bf16 cast, which then frees each f32
+    temporary per tensor instead of doubling peak memory. It must
+    preserve tree structure. Returns (values, shardings) with matching
+    structure.
+    """
+    boxed = jax.eval_shape(
+        lambda r: _init_variables(model, r, sample_batch), rng)
+    logical = split_fn(nn.get_partition_spec(boxed))
+    shardings = logical_to_sharding(mesh, logical, rules)
+
+    def init(rng):
+        variables = _init_variables(model, rng, sample_batch)
+        values = nn.meta.unbox(split_fn(variables))
+        return transform_fn(values) if transform_fn else values
+
+    values = jax.jit(init, out_shardings=shardings)(rng)
+    return values, shardings
+
+
+def sharded_opt_init(
+    tx: optax.GradientTransformation,
+    params: Any,
+    params_sh: Any,
+    mesh: Mesh,
+) -> Tuple[optax.OptState, Any]:
+    """Optimizer moments mirror the param tree; shard by tree path."""
+    replicated = NamedSharding(mesh, P())
+    opt_sh = mirror_param_shardings(
+        jax.eval_shape(tx.init, params), params_sh, replicated)
+    return jax.jit(tx.init, out_shardings=opt_sh)(params), opt_sh
+
+
 def create_lm_state(
     model: Any,
     tx: optax.GradientTransformation,
@@ -89,18 +138,10 @@ def create_lm_state(
         )
 
     rules = rules_for(mesh, rules)
-    boxed = jax.eval_shape(
-        lambda r: _init_variables(model, r, sample_batch), rng
-    )
-    logical = nn.get_partition_spec(boxed)["params"]
-    params_sh = logical_to_sharding(mesh, logical, rules)
-    params = jax.jit(init_params, out_shardings=params_sh)(rng)
-
-    # Optimizer moments mirror the param tree; shard by tree path.
+    params, params_sh = sharded_collection_init(
+        model, rng, sample_batch, mesh, rules, lambda v: v["params"])
+    opt_state, opt_sh = sharded_opt_init(tx, params, params_sh, mesh)
     replicated = NamedSharding(mesh, P())
-    opt_sh = mirror_param_shardings(
-        jax.eval_shape(tx.init, params), params_sh, replicated)
-    opt_state = jax.jit(tx.init, out_shardings=opt_sh)(params)
 
     state = LMState(
         step=jnp.zeros((), jnp.int32),

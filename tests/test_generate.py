@@ -78,3 +78,53 @@ def test_cache_too_small_raises():
     params = _params(llama_test(dtype=jnp.float32), prompt)
     with pytest.raises(ValueError, match="cache_size"):
         generate(model, params, prompt, max_new_tokens=8)
+
+
+def test_truncate_logits_top_k_and_top_p():
+    from kubeflow_tpu.inference.generate import _truncate_logits
+
+    logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.2, 0.05, 0.05]]))
+    k2 = _truncate_logits(logits, 2, None)
+    assert np.isfinite(np.asarray(k2[0, :2])).all()
+    assert (np.asarray(k2[0, 2:]) == -np.inf).all()
+
+    # top_p=0.6: smallest prefix with mass >= 0.6 is {0.4, 0.3}.
+    p = _truncate_logits(logits, None, 0.6)
+    assert np.isfinite(np.asarray(p[0, :2])).all()
+    assert (np.asarray(p[0, 2:]) == -np.inf).all()
+
+    # top_p ~ 1 keeps everything; the top token always survives.
+    keep_all = _truncate_logits(logits, None, 0.9999)
+    assert np.isfinite(np.asarray(keep_all)).all()
+    tiny = _truncate_logits(logits, None, 1e-6)
+    assert np.isfinite(np.asarray(tiny[0, 0]))
+    assert (np.asarray(tiny[0, 1:]) == -np.inf).all()
+
+
+def test_top_k_sampling_stays_in_top_k_set():
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 4), 0, 512)
+    model = llama_test(dtype=jnp.float32, cache_size=16)
+    params = _params(llama_test(dtype=jnp.float32), prompt)
+    # k=1 at any temperature must equal greedy decoding.
+    greedy, _ = generate(model, params, prompt, max_new_tokens=8,
+                         temperature=0.0)
+    k1, _ = generate(model, params, prompt, max_new_tokens=8,
+                     temperature=1.5, top_k=1,
+                     rng=jax.random.PRNGKey(11))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+    # top_p near zero likewise collapses to greedy.
+    p0, _ = generate(model, params, prompt, max_new_tokens=8,
+                     temperature=1.5, top_p=1e-6,
+                     rng=jax.random.PRNGKey(12))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(p0))
+
+
+def test_top_p_zero_collapses_to_greedy_not_token_zero():
+    from kubeflow_tpu.inference.generate import _truncate_logits
+
+    logits = jnp.log(jnp.asarray([[0.1, 0.2, 0.4, 0.3]]))
+    z = _truncate_logits(logits, None, 0.0)
+    # Only the argmax survives — never an all--inf row.
+    assert np.isfinite(np.asarray(z[0, 2]))
+    assert (np.asarray(z[0, [0, 1, 3]]) == -np.inf).all()
