@@ -429,3 +429,51 @@ register(
     ],
     package="tpu-job",
 )(_cnn_benchmark_builder)
+
+
+def _finetune_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """LoRA fine-tune prototype: a TPUJob whose workers run the LoRA
+    trainer (training/finetune.py via the benchmark CLI). Greenfield —
+    the reference has no fine-tuning prototype; shape mirrors tpu-cnn
+    so `kft generate tpu-finetune` slots into the same workflow."""
+    if p["num_tpu_workers"] < 1:
+        raise ValueError("num_tpu_workers must be >= 1")
+    if p["lora_rank"] < 1:
+        raise ValueError("lora_rank must be >= 1 for a LoRA fine-tune")
+    args = [
+        "python", "-m", "kubeflow_tpu.training.benchmark",
+        f"--model={p['model']}",
+        f"--lora_rank={p['lora_rank']}",
+        f"--batch_size={p['batch_size']}",
+        f"--seq_len={p['seq_len']}",
+    ]
+    spec = replica_spec(
+        "TPU_WORKER", p["num_tpu_workers"], image=p["image"],
+        command=args[:1], args=args[1:],
+        tpu_accelerator=p["tpu_accelerator"], tpu_topology=p["tpu_topology"],
+        chips_per_worker=p["chips_per_worker"],
+    )
+    return [tpu_job(
+        p["name"], p["namespace"], [spec],
+        termination=termination_policy("TPU_WORKER", 0),
+    )]
+
+
+register(
+    "tpu-finetune",
+    "LoRA fine-tune of a language model as a TPUJob",
+    [
+        Param("name", REQUIRED, "string", "Name for the job."),
+        Param("namespace", "default", "string"),
+        Param("image", "ghcr.io/kubeflow-tpu/trainer:v0.1.0", "string"),
+        Param("model", "llama2-7b", "string", "Which language model."),
+        Param("lora_rank", 16, "int", "Adapter rank (r)."),
+        Param("batch_size", 1, "int", "Global batch size."),
+        Param("seq_len", 1024, "int", "Sequence length."),
+        Param("num_tpu_workers", 1, "int"),
+        Param("tpu_accelerator", "tpu-v5-lite-podslice", "string"),
+        Param("tpu_topology", "2x4", "string"),
+        Param("chips_per_worker", 4, "int"),
+    ],
+    package="tpu-job",
+)(_finetune_builder)

@@ -234,6 +234,75 @@ def run_lm_benchmark(config: LMBenchConfig) -> Dict[str, float]:
     return result
 
 
+@dataclasses.dataclass
+class LoRABenchConfig:
+    model: str = "llama2-7b"
+    lora_rank: int = 16
+    batch_size: int = 1
+    seq_len: int = 1024
+    steps: int = 5
+    warmup_steps: int = 1
+    learning_rate: float = 1e-4
+    seed: int = 0
+
+
+def run_lora_benchmark(config: LoRABenchConfig) -> Dict[str, float]:
+    """LoRA fine-tune step benchmark (BASELINE.md stretch row:
+    "Llama-2-7B fine-tune … v5e").
+
+    What makes 7B fit one 16 GB chip: the base weights are frozen in
+    bf16 (no grad/moment buffers — training/finetune.py), blocks
+    rematerialize on the backward pass, and adapters (~0.1% of params)
+    are the only train state. Reports step time, tokens/sec, MFU, and
+    the trainable-parameter fraction.
+    """
+    from kubeflow_tpu.training.finetune import (
+        create_lora_state,
+        make_lora_train_step,
+    )
+    from kubeflow_tpu.training.lm import place_lm_batch
+
+    entry = get_model(config.model)
+    model = entry.make(lora_rank=config.lora_rank, remat=True)
+    vocab = entry.num_classes_or_vocab
+    mesh = build_mesh(None)
+    n_chips = mesh.size
+    rng = jax.random.PRNGKey(config.seed)
+    ids_rng, init_rng = jax.random.split(rng)
+    b, l = config.batch_size, config.seq_len
+    batch = {"input_ids": jax.random.randint(ids_rng, (b, l), 0, vocab)}
+
+    tx = optax.adamw(config.learning_rate)
+    state, shardings = create_lora_state(
+        model, tx, init_rng, batch, mesh=mesh, base_dtype=jnp.bfloat16)
+    step_fn = make_lora_train_step(mesh, shardings)
+    batch = place_lm_batch(mesh, batch)
+
+    elapsed, compile_s, final_loss, flops = _run_timed_steps(
+        step_fn, state, batch, config.warmup_steps, config.steps)
+    step_time_s = elapsed / config.steps
+
+    n_base = sum(x.size for x in jax.tree.leaves(state.base_params))
+    n_lora = sum(x.size for x in jax.tree.leaves(state.lora))
+    result = {
+        "model": config.model,
+        "lora_rank": config.lora_rank,
+        "global_batch_size": b,
+        "seq_len": l,
+        "n_chips": n_chips,
+        "steps": config.steps,
+        "step_time_ms": step_time_s * 1e3,
+        "tokens_per_sec": b * l / step_time_s,
+        "compile_plus_warmup_s": compile_s,
+        "final_loss": final_loss,
+        "base_params": n_base,
+        "trainable_params": n_lora,
+        "trainable_pct": round(n_lora / max(n_base, 1) * 100, 4),
+    }
+    _attach_mfu(result, flops, step_time_s, n_chips)
+    return result
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -244,9 +313,24 @@ def main(argv=None) -> int:
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--image_size", type=int, default=None)
     parser.add_argument("--seq_len", type=int, default=512)
+    parser.add_argument("--lora_rank", type=int, default=0,
+                        help=">0: LoRA fine-tune benchmark "
+                             "(language models only)")
     args = parser.parse_args(argv)
     entry = get_model(args.model)
-    if entry.family == "language":
+    if args.lora_rank > 0 and entry.family != "language":
+        # Never fall through to the wrong benchmark: a tpu-finetune
+        # job with a vision model must fail loudly, not run (and
+        # report success for) a pretraining benchmark.
+        parser.error(
+            f"--lora_rank requires a language model; {args.model!r} is "
+            f"{entry.family}")
+    if entry.family == "language" and args.lora_rank > 0:
+        result = run_lora_benchmark(
+            LoRABenchConfig(model=args.model, lora_rank=args.lora_rank,
+                            batch_size=args.batch_size or 1,
+                            steps=args.steps, seq_len=args.seq_len))
+    elif entry.family == "language":
         result = run_lm_benchmark(
             LMBenchConfig(model=args.model,
                           batch_size=args.batch_size or 32,

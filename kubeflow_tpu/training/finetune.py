@@ -31,12 +31,13 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubeflow_tpu.parallel.mesh import batch_sharding
 from kubeflow_tpu.parallel.tensor_parallel import rules_for
 from kubeflow_tpu.training.lm import (
     LOSSES,
     Batch,
     _model_args,
+    jit_train_step,
+    lm_forward_with_aux,
     sharded_collection_init,
     sharded_opt_init,
 )
@@ -131,16 +132,10 @@ def make_lora_train_step(
 
     def step(state: LoRAState, batch: Batch):
         def compute(lora):
-            logits, mutated = state.apply_fn(
+            return lm_forward_with_aux(
+                state.apply_fn,
                 {"params": state.base_params, "lora": lora},
-                *_model_args(batch), mutable=["losses"])
-            loss, acc = loss_fn(logits, batch)
-            aux = sum(
-                jnp.sum(leaf)
-                for leaf in jax.tree.leaves(mutated.get("losses", {}))
-            )
-            aux = jnp.asarray(aux, loss.dtype)
-            return loss + aux_loss_weight * aux, (loss, acc, aux)
+                batch, loss_fn, aux_loss_weight)
 
         (_, (loss, acc, aux)), grads = jax.value_and_grad(
             compute, has_aux=True)(state.lora)
@@ -159,12 +154,4 @@ def make_lora_train_step(
             metrics,
         )
 
-    if mesh is None:
-        return jax.jit(step, donate_argnums=(0,) if donate else ())
-    batch_sh = batch_sharding(mesh)
-    return jax.jit(
-        step,
-        in_shardings=(shardings, batch_sh),
-        out_shardings=(shardings, NamedSharding(mesh, P())),
-        donate_argnums=(0,) if donate else (),
-    )
+    return jit_train_step(step, mesh, shardings, donate)

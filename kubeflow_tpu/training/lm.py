@@ -197,6 +197,39 @@ def causal_lm_loss(logits: jax.Array, batch: Batch
 LOSSES = {"mlm": mlm_loss, "causal": causal_lm_loss}
 
 
+def lm_forward_with_aux(apply_fn, variables, batch, loss_fn,
+                        aux_loss_weight):
+    """Shared forward for both trainers (pretraining here, LoRA in
+    training/finetune.py): apply with the ``"losses"`` collection
+    mutable so sown auxiliary losses (the MoE load-balance loss,
+    ops/moe.py) are collected and weighted identically everywhere.
+    Returns (total_loss, (loss, accuracy, aux))."""
+    logits, mutated = apply_fn(variables, *_model_args(batch),
+                               mutable=["losses"])
+    loss, acc = loss_fn(logits, batch)
+    aux = sum(
+        jnp.sum(leaf)
+        for leaf in jax.tree.leaves(mutated.get("losses", {}))
+    )
+    aux = jnp.asarray(aux, loss.dtype)
+    return loss + aux_loss_weight * aux, (loss, acc, aux)
+
+
+def jit_train_step(step, mesh, shardings, donate):
+    """Jit a (state, batch) → (state, metrics) step with the standard
+    SPMD placement: state by its sharding tree, batch over
+    (data, fsdp), metrics replicated."""
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+    batch_sh = batch_sharding(mesh)
+    return jax.jit(
+        step,
+        in_shardings=(shardings, batch_sh),
+        out_shardings=(shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
 def make_lm_train_step(
     mesh: Optional[Mesh],
     shardings: Optional[LMState],
@@ -216,16 +249,9 @@ def make_lm_train_step(
 
     def step(state: LMState, batch: Batch):
         def compute(params):
-            logits, mutated = state.apply_fn(
-                {"params": params}, *_model_args(batch),
-                mutable=["losses"])
-            loss, acc = loss_fn(logits, batch)
-            aux = sum(
-                jnp.sum(leaf)
-                for leaf in jax.tree.leaves(mutated.get("losses", {}))
-            )
-            aux = jnp.asarray(aux, loss.dtype)
-            return loss + aux_loss_weight * aux, (loss, acc, aux)
+            return lm_forward_with_aux(
+                state.apply_fn, {"params": params}, batch, loss_fn,
+                aux_loss_weight)
 
         (_, (loss, acc, aux)), grads = jax.value_and_grad(
             compute, has_aux=True)(state.params)
@@ -244,15 +270,7 @@ def make_lm_train_step(
             metrics,
         )
 
-    if mesh is None:
-        return jax.jit(step, donate_argnums=(0,) if donate else ())
-    batch_sh = batch_sharding(mesh)
-    return jax.jit(
-        step,
-        in_shardings=(shardings, batch_sh),
-        out_shardings=(shardings, NamedSharding(mesh, P())),
-        donate_argnums=(0,) if donate else (),
-    )
+    return jit_train_step(step, mesh, shardings, donate)
 
 
 def place_lm_batch(mesh: Mesh, batch: Batch) -> Batch:

@@ -132,3 +132,28 @@ def test_lora_moe_collects_aux_loss():
     _, metrics = step(state, batch)
     # The router sows a load-balance loss; it must reach the metrics.
     assert float(metrics["aux_loss"]) > 0.0
+
+
+def test_lora_benchmark_smoke():
+    from kubeflow_tpu.training.benchmark import (
+        LoRABenchConfig,
+        run_lora_benchmark,
+    )
+
+    # batch must divide the 8-device data axis of the test mesh
+    result = run_lora_benchmark(LoRABenchConfig(
+        model="llama-test", lora_rank=4, batch_size=8, seq_len=32,
+        steps=2, warmup_steps=1))
+    assert result["tokens_per_sec"] > 0
+    assert result["trainable_params"] < 0.2 * result["base_params"]
+    assert result["lora_rank"] == 4
+
+
+def test_lora_rank_rejected_for_vision_models():
+    import pytest as _pytest
+
+    from kubeflow_tpu.training.benchmark import main as bench_main
+
+    with _pytest.raises(SystemExit) as exc:
+        bench_main(["--model", "resnet-test", "--lora_rank", "4"])
+    assert exc.value.code != 0

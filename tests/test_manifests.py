@@ -11,6 +11,7 @@ from kubeflow_tpu.params import get_prototype, list_prototypes
 OVERRIDES = {
     "tpu-job": {"name": "myjob"},
     "tpu-cnn": {"name": "mycnnjob"},
+    "tpu-finetune": {"name": "myftjob"},
     "tpu-serving": {"name": "inception", "model_path": "gs://bucket/model"},
     "cert-manager": {"acme_email": "a@b.com"},
     "iap-envoy": {"audiences": "aud1,aud2"},
@@ -194,3 +195,18 @@ def test_ui_routes_via_ambassador():
            and o["metadata"]["name"] == "tpujob-dashboard"][0]
     ann = svc["metadata"]["annotations"]["getambassador.io/config"]
     assert "prefix: /tpujobs/ui/" in ann
+
+
+def test_tpu_finetune_prototype():
+    with pytest.raises(ValueError, match="lora_rank"):
+        get_prototype("tpu-finetune").build({"name": "x", "lora_rank": 0})
+    objs = get_prototype("tpu-finetune").build(
+        {"name": "ft", "model": "llama2-7b", "lora_rank": 8,
+         "seq_len": 2048})
+    assert len(objs) == 1
+    spec = objs[0]["spec"]["replicaSpecs"][0]
+    container = spec["template"]["spec"]["containers"][0]
+    joined = " ".join(container["args"])
+    assert "--model=llama2-7b" in joined
+    assert "--lora_rank=8" in joined
+    assert "--seq_len=2048" in joined
