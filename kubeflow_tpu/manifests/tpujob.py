@@ -440,6 +440,14 @@ def _finetune_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
         raise ValueError("num_tpu_workers must be >= 1")
     if p["lora_rank"] < 1:
         raise ValueError("lora_rank must be >= 1 for a LoRA fine-tune")
+    total_chips = p["num_tpu_workers"] * p["chips_per_worker"]
+    if p["batch_size"] % total_chips:
+        # The trainer shards the batch over the (data, fsdp) mesh of
+        # all slice chips; an indivisible batch fails at runtime with
+        # a sharding error — fail at generate time instead.
+        raise ValueError(
+            f"batch_size {p['batch_size']} must be divisible by "
+            f"num_tpu_workers*chips_per_worker = {total_chips}")
     args = [
         "python", "-m", "kubeflow_tpu.training.benchmark",
         f"--model={p['model']}",
@@ -468,12 +476,15 @@ register(
         Param("image", "ghcr.io/kubeflow-tpu/trainer:v0.1.0", "string"),
         Param("model", "llama2-7b", "string", "Which language model."),
         Param("lora_rank", 16, "int", "Adapter rank (r)."),
-        Param("batch_size", 1, "int", "Global batch size."),
+        Param("batch_size", 1, "int",
+              "Global batch size (must divide the slice's chip count)."),
         Param("seq_len", 1024, "int", "Sequence length."),
         Param("num_tpu_workers", 1, "int"),
         Param("tpu_accelerator", "tpu-v5-lite-podslice", "string"),
-        Param("tpu_topology", "2x4", "string"),
-        Param("chips_per_worker", 4, "int"),
+        # Default = the measured one-chip config (PERF.md: 7B LoRA on
+        # a single v5e chip) — batch 1 cannot shard over a 2x4 slice.
+        Param("tpu_topology", "1x1", "string"),
+        Param("chips_per_worker", 1, "int"),
     ],
     package="tpu-job",
 )(_finetune_builder)

@@ -117,6 +117,83 @@ def synthetic_causal_lm(
         step += 1
 
 
+def token_shard_batches(
+    paths: Sequence[str],
+    global_batch: int,
+    seq_len: int,
+    *,
+    seed: int = 0,
+    epochs: Optional[int] = None,
+    dtype: str = "int32",
+    bin_dtype: str = "uint16",
+) -> Iterator[Batch]:
+    """Causal-LM batches from binary token shards on disk.
+
+    The real-data path for fine-tuning (``training/finetune.py``) and
+    pretraining: each shard is a flat token array — ``.npy`` (dtype
+    self-describing) or raw ``.bin`` interpreted as ``bin_dtype``
+    (default uint16, the common tokenizer-dump layout; pass
+    ``bin_dtype="int32"`` for 32-bit dumps — raw files carry no dtype
+    header, so it must be stated). TPU-first mechanics:
+
+    - **mmap, not read**: shards map read-only; the OS page cache
+      feeds the prefetch thread and nothing is resident twice.
+    - **Static shapes**: the stream is chunked into fixed
+      ``[batch, seq_len]`` blocks; the tail that doesn't fill a batch
+      is dropped (never a ragged final batch that would retrace jit).
+    - **Per-host sharding**: as with the synthetic generators, each
+      process materializes only its ``1/num_processes`` rows.
+    - **Seeded shuffle** of chunk order each epoch (shuffling fixed
+      chunks, not documents — the standard packed-LM recipe).
+    """
+    if not paths:
+        raise ValueError("token_shard_batches needs at least one shard")
+    arrays = []
+    for path in paths:
+        if str(path).endswith(".npy"):
+            arr = np.load(path, mmap_mode="r")
+        else:
+            arr = np.memmap(path, dtype=np.dtype(bin_dtype), mode="r")
+        arrays.append(arr.reshape(-1))
+    total = sum(a.shape[0] for a in arrays)
+    n_chunks = total // seq_len
+    if n_chunks < global_batch:
+        raise ValueError(
+            f"{total} tokens / seq_len {seq_len} = {n_chunks} chunks "
+            f"< global batch {global_batch}")
+
+    # Flat index space over all shards: chunk i covers tokens
+    # [i*seq_len, (i+1)*seq_len) of the concatenated stream.
+    offsets = np.cumsum([0] + [a.shape[0] for a in arrays])
+
+    def read_chunk(i: int) -> np.ndarray:
+        start, stop = i * seq_len, (i + 1) * seq_len
+        s = int(np.searchsorted(offsets, start, side="right") - 1)
+        out = np.empty((seq_len,), np.int64)
+        filled = 0
+        while filled < seq_len:
+            local = start + filled - offsets[s]
+            take = min(seq_len - filled,
+                       arrays[s].shape[0] - int(local))
+            out[filled:filled + take] = arrays[s][local:local + take]
+            filled += take
+            s += 1
+        return out
+
+    rows = host_shard_range(global_batch)
+    per_epoch = n_chunks // global_batch
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        rng = np.random.RandomState((seed * 7_000_003 + epoch) % (2 ** 31))
+        order = rng.permutation(n_chunks)
+        for b in range(per_epoch):
+            mine = order[b * global_batch + rows.start:
+                         b * global_batch + rows.stop]
+            batch = np.stack([read_chunk(int(i)) for i in mine])
+            yield {"input_ids": batch.astype(dtype)}
+        epoch += 1
+
+
 class DevicePrefetcher:
     """Background thread that device_puts upcoming batches.
 

@@ -83,3 +83,37 @@ def test_save_interval_policy(tmp_path):
     ckpt.wait()
     assert ckpt.latest_step() == 5
     ckpt.close()
+
+
+def test_lora_adapter_checkpoint_roundtrip(tmp_path):
+    """Fine-tune checkpointing: only the tiny adapter state needs
+    saving (the frozen base restores from its pretrained source)."""
+    from kubeflow_tpu.training.finetune import (
+        create_lora_state,
+        make_lora_train_step,
+    )
+
+    model = llama_test(lora_rank=4)
+    batch = {"input_ids": jax.random.randint(
+        jax.random.PRNGKey(0), (4, 16), 0, 512)}
+    state, _ = create_lora_state(
+        model, optax.adamw(1e-2), jax.random.PRNGKey(1), batch)
+    step = make_lora_train_step(None, None, donate=False)
+    for _ in range(3):
+        state, _ = step(state, batch)
+
+    ckpt = Checkpointer(CheckpointConfig(
+        directory=str(tmp_path / "lora_ckpt"),
+        save_interval_steps=1, async_save=False))
+    adapter_state = {"step": state.step, "lora": state.lora,
+                     "opt_state": state.opt_state}
+    assert ckpt.save(int(state.step), adapter_state, force=True)
+    ckpt.wait()
+
+    zeros = jax.tree.map(jnp.zeros_like, adapter_state)
+    restored = ckpt.restore(zeros)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        adapter_state, restored)
+    ckpt.close()

@@ -123,3 +123,48 @@ def test_statsd_client_emits_udp():
     assert seen == {"t.loss:1.5|g", "t.requests:1|c", "t.predict:12.5|ms"}
     client.close()
     recv.close()
+
+
+def test_token_shard_batches_roundtrip(tmp_path):
+    """File-backed token shards: exact coverage, static shapes,
+    cross-shard chunk stitching, seeded epoch shuffle."""
+    import numpy as np
+
+    from kubeflow_tpu.training.data import token_shard_batches
+
+    # 3 shards of awkward sizes; total 1000 tokens; values = position.
+    tokens = np.arange(1000, dtype=np.int64)
+    paths = []
+    for i, sl in enumerate([(0, 333), (333, 700), (700, 1000)]):
+        p = tmp_path / f"shard{i}.npy"
+        np.save(p, tokens[sl[0]:sl[1]].astype(np.uint16))
+        paths.append(str(p))
+
+    seq_len, batch = 16, 4  # 62 chunks -> 15 batches/epoch
+    it = token_shard_batches(paths, batch, seq_len, seed=3, epochs=1)
+    seen = []
+    for b in it:
+        assert b["input_ids"].shape == (batch, seq_len)
+        assert b["input_ids"].dtype == np.int32
+        for row in b["input_ids"]:
+            # Every row is a contiguous run from the global stream —
+            # including runs that straddle shard boundaries.
+            assert (np.diff(row) == 1).all()
+            seen.append(int(row[0]))
+    assert len(seen) == 15 * batch
+    assert len(set(seen)) == len(seen)  # no chunk repeats in an epoch
+
+    # Same seed -> same order; different seed -> different order.
+    a = [int(b["input_ids"][0, 0]) for b in
+         token_shard_batches(paths, batch, seq_len, seed=3, epochs=1)]
+    a_again = [int(b["input_ids"][0, 0]) for b in
+               token_shard_batches(paths, batch, seq_len, seed=3, epochs=1)]
+    b2 = [int(b["input_ids"][0, 0]) for b in
+          token_shard_batches(paths, batch, seq_len, seed=4, epochs=1)]
+    assert a == a_again  # deterministic for a fixed seed
+    assert a != b2
+
+    # Too-small stream fails loudly.
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="chunks"):
+        token_shard_batches(paths[:1], 64, 512, epochs=1).__next__()

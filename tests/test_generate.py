@@ -128,3 +128,16 @@ def test_top_p_zero_collapses_to_greedy_not_token_zero():
     # Only the argmax survives — never an all--inf row.
     assert np.isfinite(np.asarray(z[0, 2]))
     assert (np.asarray(z[0, [0, 1, 3]]) == -np.inf).all()
+
+
+def test_decode_benchmark_smoke():
+    from kubeflow_tpu.inference.benchmark import (
+        DecodeBenchConfig,
+        run_decode_benchmark,
+    )
+
+    result = run_decode_benchmark(DecodeBenchConfig(
+        model="llama-test", batch_size=2, prompt_len=8,
+        max_new_tokens=8))
+    assert result["decode_tokens_per_sec"] > 0
+    assert result["param_bytes"] > 0

@@ -18,6 +18,7 @@ IMAGES = REPO / "images"
 OVERRIDES = {
     "tpu-job": {"name": "j"},
     "tpu-cnn": {"name": "c"},
+    "tpu-finetune": {"name": "f"},
     "tpu-serving": {"name": "s", "model_path": "gs://b/m"},
     "cert-manager": {"acme_email": "a@b.com"},
     "iap-envoy": {"audiences": "aud"},
