@@ -54,13 +54,13 @@ def run_decode_benchmark(config: DecodeBenchConfig) -> Dict[str, Any]:
     plain = entry.make()
 
     def init_params(r):
-        variables = plain.init(r, prompt[:, :1])
         import flax.linen as nn
 
-        return jax.tree.map(
-            lambda x: x.astype(jnp.bfloat16)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x,
-            nn.meta.unbox(variables["params"]))
+        from kubeflow_tpu.utils.trees import cast_floating
+
+        variables = plain.init(r, prompt[:, :1])
+        return cast_floating(nn.meta.unbox(variables["params"]),
+                             jnp.bfloat16)
 
     params = jax.jit(init_params)(rng)
     jax.block_until_ready(params)

@@ -455,6 +455,8 @@ def _finetune_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
         f"--batch_size={p['batch_size']}",
         f"--seq_len={p['seq_len']}",
     ]
+    if p["data"]:
+        args.append(f"--data={p['data']}")
     spec = replica_spec(
         "TPU_WORKER", p["num_tpu_workers"], image=p["image"],
         command=args[:1], args=args[1:],
@@ -477,8 +479,12 @@ register(
         Param("model", "llama2-7b", "string", "Which language model."),
         Param("lora_rank", 16, "int", "Adapter rank (r)."),
         Param("batch_size", 1, "int",
-              "Global batch size (must divide the slice's chip count)."),
+              "Global batch size (the slice's chip count must divide "
+              "it)."),
         Param("seq_len", 1024, "int", "Sequence length."),
+        Param("data", "", "string",
+              "Glob of token shards (.npy / raw .bin) mounted in the "
+              "pod; empty = synthetic data."),
         Param("num_tpu_workers", 1, "int"),
         Param("tpu_accelerator", "tpu-v5-lite-podslice", "string"),
         # Default = the measured one-chip config (PERF.md: 7B LoRA on

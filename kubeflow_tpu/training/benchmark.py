@@ -76,7 +76,8 @@ def peak_flops_per_chip() -> float:
     return 197e12  # assume v5e-class if unknown
 
 
-def _run_timed_steps(step_fn, state, batch, warmup_steps: int, steps: int):
+def _run_timed_steps(step_fn, state, batch, warmup_steps: int, steps: int,
+                     batch_iter=None):
     """AOT-compile the exact step once, run warmup + the timed loop on
     that executable, and read its XLA FLOP count.
 
@@ -84,6 +85,11 @@ def _run_timed_steps(step_fn, state, batch, warmup_steps: int, steps: int):
     ``block_until_ready``: on remote-tunneled platforms the ready bit
     of a dispatched chain can report early, and a loop fenced that way
     measures dispatch, not compute.
+
+    ``batch_iter`` (optional) supplies a fresh same-shape batch per
+    timed step — the real-data path (token shards through
+    ``DevicePrefetcher``); without it the fixed ``batch`` repeats
+    (synthetic mode, the reference's default).
 
     Returns (elapsed_s, compile_s, final_loss, flops_per_device).
     ``flops_per_device`` is ONE device's share for an SPMD-partitioned
@@ -107,6 +113,8 @@ def _run_timed_steps(step_fn, state, batch, warmup_steps: int, steps: int):
 
     start = time.perf_counter()
     for _ in range(steps):
+        if batch_iter is not None:
+            batch = next(batch_iter)
         state, metrics = compiled(state, batch)
     final_loss = float(metrics["loss"])
     elapsed = time.perf_counter() - start
@@ -234,6 +242,19 @@ def run_lm_benchmark(config: LMBenchConfig) -> Dict[str, float]:
     return result
 
 
+def _shard_batch_iter(data_paths, mesh, batch_size, seq_len, seed):
+    """Token shards → per-host batches → device-placed iterator (the
+    real-data path; ``training/data.py``)."""
+    from kubeflow_tpu.training.data import (
+        DevicePrefetcher,
+        token_shard_batches,
+    )
+
+    stream = token_shard_batches(
+        list(data_paths), batch_size, seq_len, seed=seed)
+    return DevicePrefetcher(stream, mesh, prefetch=2)
+
+
 @dataclasses.dataclass
 class LoRABenchConfig:
     model: str = "llama2-7b"
@@ -244,6 +265,7 @@ class LoRABenchConfig:
     warmup_steps: int = 1
     learning_rate: float = 1e-4
     seed: int = 0
+    data_paths: Optional[tuple] = None  # token shards; None → synthetic
 
 
 def run_lora_benchmark(config: LoRABenchConfig) -> Dict[str, float]:
@@ -276,10 +298,19 @@ def run_lora_benchmark(config: LoRABenchConfig) -> Dict[str, float]:
     state, shardings = create_lora_state(
         model, tx, init_rng, batch, mesh=mesh, base_dtype=jnp.bfloat16)
     step_fn = make_lora_train_step(mesh, shardings)
-    batch = place_lm_batch(mesh, batch)
+    batch_iter = None
+    if config.data_paths:
+        batch_iter = _shard_batch_iter(
+            config.data_paths, mesh, b, l, config.seed)
+        batch = next(batch_iter)
+    else:
+        batch = place_lm_batch(mesh, batch)
 
     elapsed, compile_s, final_loss, flops = _run_timed_steps(
-        step_fn, state, batch, config.warmup_steps, config.steps)
+        step_fn, state, batch, config.warmup_steps, config.steps,
+        batch_iter=batch_iter)
+    if batch_iter is not None:
+        batch_iter.close()
     step_time_s = elapsed / config.steps
 
     n_base = sum(x.size for x in jax.tree.leaves(state.base_params))
@@ -316,6 +347,10 @@ def main(argv=None) -> int:
     parser.add_argument("--lora_rank", type=int, default=0,
                         help=">0: LoRA fine-tune benchmark "
                              "(language models only)")
+    parser.add_argument("--data", default=None,
+                        help="glob of token shards (.npy / raw .bin) "
+                             "for the fine-tune path; default is the "
+                             "reference-parity synthetic mode")
     args = parser.parse_args(argv)
     entry = get_model(args.model)
     if args.lora_rank > 0 and entry.family != "language":
@@ -325,11 +360,19 @@ def main(argv=None) -> int:
         parser.error(
             f"--lora_rank requires a language model; {args.model!r} is "
             f"{entry.family}")
+    data_paths = None
+    if args.data:
+        import glob as _glob
+
+        data_paths = tuple(sorted(_glob.glob(args.data)))
+        if not data_paths:
+            parser.error(f"--data {args.data!r} matched no shards")
     if entry.family == "language" and args.lora_rank > 0:
         result = run_lora_benchmark(
             LoRABenchConfig(model=args.model, lora_rank=args.lora_rank,
                             batch_size=args.batch_size or 1,
-                            steps=args.steps, seq_len=args.seq_len))
+                            steps=args.steps, seq_len=args.seq_len,
+                            data_paths=data_paths))
     elif entry.family == "language":
         result = run_lm_benchmark(
             LMBenchConfig(model=args.model,

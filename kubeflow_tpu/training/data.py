@@ -145,6 +145,10 @@ def token_shard_batches(
       process materializes only its ``1/num_processes`` rows.
     - **Seeded shuffle** of chunk order each epoch (shuffling fixed
       chunks, not documents — the standard packed-LM recipe).
+
+    Validation (missing shards, too-small stream) happens eagerly at
+    call time — not at first ``next()`` from inside a prefetch thread
+    mid-training.
     """
     if not paths:
         raise ValueError("token_shard_batches needs at least one shard")
@@ -165,6 +169,12 @@ def token_shard_batches(
     # Flat index space over all shards: chunk i covers tokens
     # [i*seq_len, (i+1)*seq_len) of the concatenated stream.
     offsets = np.cumsum([0] + [a.shape[0] for a in arrays])
+    return _token_shard_iter(arrays, offsets, n_chunks, global_batch,
+                             seq_len, seed, epochs, dtype)
+
+
+def _token_shard_iter(arrays, offsets, n_chunks, global_batch, seq_len,
+                      seed, epochs, dtype) -> Iterator[Batch]:
 
     def read_chunk(i: int) -> np.ndarray:
         start, stop = i * seq_len, (i + 1) * seq_len

@@ -72,13 +72,12 @@ def create_lora_state(
     are freed as each param is produced (no 2× peak).
     """
 
+    from kubeflow_tpu.utils.trees import cast_floating
+
     def cast_base(split):
         params, lora = split
         if base_dtype is not None:
-            params = jax.tree.map(
-                lambda x: x.astype(base_dtype)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x,
-                params)
+            params = cast_floating(params, base_dtype)
         return params, lora
 
     if mesh is None:

@@ -1,0 +1,24 @@
+"""Small pytree utilities shared across trainers and benchmarks."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def cast_floating(tree: Any, dtype: Any) -> Any:
+    """Cast every floating-point leaf to ``dtype``; leave integer /
+    bool leaves (embedding ids, step counters) untouched.
+
+    Shared by the LoRA trainer (frozen bf16 base,
+    training/finetune.py) and the decode benchmark
+    (inference/benchmark.py) — run it *inside* a jit so each f32
+    temporary frees as its cast is produced instead of doubling peak
+    memory for a 7B tree.
+    """
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        tree)

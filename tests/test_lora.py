@@ -157,3 +157,25 @@ def test_lora_rank_rejected_for_vision_models():
     with _pytest.raises(SystemExit) as exc:
         bench_main(["--model", "resnet-test", "--lora_rank", "4"])
     assert exc.value.code != 0
+
+
+def test_lora_benchmark_with_token_shards(tmp_path):
+    """The real-data path: shards → prefetcher → timed LoRA steps."""
+    import numpy as np
+
+    from kubeflow_tpu.training.benchmark import (
+        LoRABenchConfig,
+        run_lora_benchmark,
+    )
+
+    rng = np.random.RandomState(0)
+    paths = []
+    for i in range(2):
+        p = tmp_path / f"s{i}.npy"
+        np.save(p, rng.randint(0, 512, 20_000).astype(np.uint16))
+        paths.append(str(p))
+
+    result = run_lora_benchmark(LoRABenchConfig(
+        model="llama-test", lora_rank=4, batch_size=8, seq_len=32,
+        steps=2, warmup_steps=1, data_paths=tuple(paths)))
+    assert result["tokens_per_sec"] > 0
