@@ -58,6 +58,17 @@ def read_metadata(version_dir: str) -> ModelMetadata:
 
 def read_variables(version_dir: str, template: Dict[str, Any]) -> Dict[str, Any]:
     """Deserialize params against a template pytree (flax msgpack needs
-    the structure; the template comes from model.init on zeros)."""
+    the structure; the template comes from model.init on zeros).
+
+    The template is restricted to the collections actually present in
+    the file: a generation model exports bare ``{"params"}`` while its
+    init template also contains the per-request ``cache`` collection,
+    which is never serialized."""
     data = (Path(version_dir) / PARAMS_FILE).read_bytes()
-    return serialization.from_bytes(template, data)
+    stored = serialization.msgpack_restore(data)
+    if isinstance(template, dict) and isinstance(stored, dict):
+        template = {k: v for k, v in template.items() if k in stored}
+    # from_state_dict reuses the already-restored tree — parsing the
+    # bytes a second time with from_bytes would double deserialization
+    # time and transiently hold two host copies of a 13.5 GB export.
+    return serialization.from_state_dict(template, stored)

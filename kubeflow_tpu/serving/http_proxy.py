@@ -163,7 +163,7 @@ def make_app(rpc_address: str, rpc_timeout: float = 10.0
              ) -> tornado.web.Application:
     return tornado.web.Application([
         # Reference route grammar (server.py:270-283).
-        (r"/model/([^/:]+)(?:/version/(\d+))?:(predict|classify)",
+        (r"/model/([^/:]+)(?:/version/(\d+))?:(predict|classify|generate)",
          InferProxyHandler),
         (r"/model/([^/:]+)", MetadataProxyHandler),
     ], rpc_address=rpc_address, rpc_timeout=rpc_timeout, metadata_cache={})

@@ -52,9 +52,13 @@ class LoadedModel:
     top_k: int = 5
 
     def __post_init__(self):
+        import threading
+
         entry = get_model(self.metadata.registry_name)
         self._module = entry.make(**self.metadata.model_kwargs)
         self._predict_cache: Dict[Tuple[str, int], Any] = {}
+        self._gen_counter = 0  # per-request rng fold for sampling
+        self._gen_lock = threading.Lock()
 
     def signature(self, name: Optional[str] = None) -> Signature:
         name = name or ModelMetadata.DEFAULT_SIGNATURE
@@ -81,8 +85,38 @@ class LoadedModel:
                 scores, classes = jax.lax.top_k(probs, self.top_k)
                 return {"classes": classes, "scores": scores}
 
-            fn = predict if method == "predict" else classify
-            self._predict_cache[key] = jax.jit(fn)
+            def generate_fn(variables, x):
+                # inference/generate.py jits internally (trace-cached
+                # on model + shapes + config); config is fixed at
+                # export time so every bucket compiles exactly once.
+                # The rng is a *traced* argument, so folding the
+                # request counter in costs zero recompiles — sampling
+                # yields fresh completions per request unless the
+                # export pins `deterministic: true` (replayable
+                # serving for goldens/CI).
+                from kubeflow_tpu.inference.generate import generate
+
+                cfg = self.metadata.generate_config
+                rng = jax.random.PRNGKey(int(cfg.get("seed", 0)))
+                if not cfg.get("deterministic", False):
+                    with self._gen_lock:
+                        self._gen_counter += 1
+                        rng = jax.random.fold_in(rng, self._gen_counter)
+                tokens, _ = generate(
+                    module, variables["params"], x,
+                    max_new_tokens=int(cfg.get("max_new_tokens", 32)),
+                    temperature=float(cfg.get("temperature", 0.0)),
+                    rng=rng,
+                    eos_id=cfg.get("eos_id"),
+                    top_k=cfg.get("top_k"),
+                    top_p=cfg.get("top_p"))
+                return {"tokens": tokens}
+
+            if method == "generate":
+                self._predict_cache[key] = generate_fn  # jitted inside
+            else:
+                fn = predict if method == "predict" else classify
+                self._predict_cache[key] = jax.jit(fn)
         return self._predict_cache[key]
 
     def _prepare(self, signature: Signature,
@@ -104,6 +138,14 @@ class LoadedModel:
         """Execute one (possibly already micro-batched) request batch."""
         sig = self.signature(signature_name)
         method = method or sig.method
+        if (method == "generate") != (sig.method == "generate"):
+            # predict/classify interchange freely; generation does not
+            # (the decode program needs a KV-cache module and the
+            # predict program has no cache) — fail with a clear 400
+            # instead of a flax collection error.
+            raise ValueError(
+                f"method {method!r} incompatible with signature method "
+                f"{sig.method!r}")
         x, n = self._prepare(sig, inputs)
         if n == 0:
             raise ValueError("empty batch")
@@ -126,16 +168,19 @@ class LoadedModel:
         """Compile every (method, bucket) pair before traffic arrives.
         A cold compile mid-request is a 20-40 s latency cliff on TPU;
         servers call this during load, while /healthz still answers
-        503 (TF-Serving's warmup-assets role). Both HTTP verbs are
-        warmed — the URL can request :predict against a classify
-        signature and vice versa."""
+        503 (TF-Serving's warmup-assets role). For predict/classify
+        models both HTTP verbs are warmed — the URL can request
+        :predict against a classify signature and vice versa;
+        generate-method models warm the decode program per bucket."""
         sig = self.signature()
         (name, spec), = sig.inputs.items()
+        methods = (("generate",) if sig.method == "generate"
+                   else ("predict", "classify"))
         bucket = 1
         while True:
             x = np.zeros((bucket, *spec.shape[1:]),
                          dtype=_NP_DTYPES[spec.dtype])
-            for method in ("predict", "classify"):
+            for method in methods:
                 out = self._jitted(method, bucket)(self.variables, x)
                 jax.block_until_ready(out)
             if bucket >= self.max_batch:

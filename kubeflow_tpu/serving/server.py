@@ -16,6 +16,7 @@ Endpoints:
   GET  /v1/models/<name>/metadata             → signature map
   POST /v1/models/<name>[/versions/<v>]:predict   {"instances": ...}
   POST /v1/models/<name>[/versions/<v>]:classify  {"instances": ...}
+  POST /v1/models/<name>[/versions/<v>]:generate  {"instances": ...}
   POST /tensorflow.serving.PredictionService/Predict  (grpc-web+proto)
   GET  /healthz
 """
@@ -268,7 +269,7 @@ def make_app(manager: ModelManager) -> tornado.web.Application:
         (r"/livez", LiveHandler),
         (r"/v1/models/([^/:]+)", StatusHandler),
         (r"/v1/models/([^/:]+)/metadata", MetadataHandler),
-        (r"/v1/models/([^/:]+)(?:/versions/(\d+))?:(predict|classify)",
+        (r"/v1/models/([^/:]+)(?:/versions/(\d+))?:(predict|classify|generate)",
          InferHandler),
         (r"/tensorflow\.serving\.PredictionService/Predict",
          GrpcWebPredictHandler),

@@ -4,7 +4,7 @@ Plays the role of TF SavedModel SignatureDefs, which the reference's
 proxy fetched over gRPC GetModelMetadata and cached
 (``components/k8s-model-server/http-proxy/server.py:121-160``). A
 signature names its inputs/outputs with dtype + shape (batch dim = -1)
-and a method (predict | classify).
+and a method (predict | classify | generate).
 """
 
 from __future__ import annotations
@@ -35,12 +35,12 @@ class TensorSpec:
 
 @dataclasses.dataclass(frozen=True)
 class Signature:
-    method: str  # "predict" | "classify"
+    method: str  # "predict" | "classify" | "generate"
     inputs: Dict[str, TensorSpec]
     outputs: Dict[str, TensorSpec]
 
     def __post_init__(self):
-        if self.method not in ("predict", "classify"):
+        if self.method not in ("predict", "classify", "generate"):
             raise ValueError(f"unsupported method {self.method!r}")
         if not self.inputs:
             raise ValueError("signature needs at least one input")
@@ -70,6 +70,10 @@ class ModelMetadata:
     signatures: Dict[str, Signature]
     model_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
     classes: Optional[List[str]] = None  # label vocabulary for classify
+    # For generate-method models: max_new_tokens, temperature, top_k,
+    # top_p, eos_id, seed. Fixed at export time so serving shapes and
+    # compiled programs are static (no per-request recompiles).
+    generate_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     DEFAULT_SIGNATURE = "serving_default"
 
@@ -80,6 +84,7 @@ class ModelMetadata:
             "signatures": {k: s.to_json() for k, s in self.signatures.items()},
             "model_kwargs": self.model_kwargs,
             "classes": self.classes,
+            "generate_config": self.generate_config,
         }
 
     @staticmethod
@@ -91,6 +96,7 @@ class ModelMetadata:
                         for k, s in obj["signatures"].items()},
             model_kwargs=obj.get("model_kwargs", {}),
             classes=obj.get("classes"),
+            generate_config=obj.get("generate_config", {}),
         )
 
     def dumps(self) -> str:

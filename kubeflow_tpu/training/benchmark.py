@@ -299,18 +299,22 @@ def run_lora_benchmark(config: LoRABenchConfig) -> Dict[str, float]:
         model, tx, init_rng, batch, mesh=mesh, base_dtype=jnp.bfloat16)
     step_fn = make_lora_train_step(mesh, shardings)
     batch_iter = None
-    if config.data_paths:
-        batch_iter = _shard_batch_iter(
-            config.data_paths, mesh, b, l, config.seed)
-        batch = next(batch_iter)
-    else:
-        batch = place_lm_batch(mesh, batch)
+    try:
+        if config.data_paths:
+            batch_iter = _shard_batch_iter(
+                config.data_paths, mesh, b, l, config.seed)
+            batch = next(batch_iter)
+        else:
+            batch = place_lm_batch(mesh, batch)
 
-    elapsed, compile_s, final_loss, flops = _run_timed_steps(
-        step_fn, state, batch, config.warmup_steps, config.steps,
-        batch_iter=batch_iter)
-    if batch_iter is not None:
-        batch_iter.close()
+        elapsed, compile_s, final_loss, flops = _run_timed_steps(
+            step_fn, state, batch, config.warmup_steps, config.steps,
+            batch_iter=batch_iter)
+    finally:
+        # An OOM in lowering or a shard-read error mid-loop must not
+        # leak the prefetch thread and its device-resident batches.
+        if batch_iter is not None:
+            batch_iter.close()
     step_time_s = elapsed / config.steps
 
     n_base = sum(x.size for x in jax.tree.leaves(state.base_params))
@@ -362,6 +366,11 @@ def main(argv=None) -> int:
             f"{entry.family}")
     data_paths = None
     if args.data:
+        if args.lora_rank <= 0:
+            # Only the fine-tune path consumes shards today; silently
+            # timing synthetic batches while the operator believes
+            # real data was measured is the worst failure mode.
+            parser.error("--data requires --lora_rank > 0")
         import glob as _glob
 
         data_paths = tuple(sorted(_glob.glob(args.data)))
