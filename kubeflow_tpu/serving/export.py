@@ -67,6 +67,15 @@ def read_variables(version_dir: str, template: Dict[str, Any]) -> Dict[str, Any]
     data = (Path(version_dir) / PARAMS_FILE).read_bytes()
     stored = serialization.msgpack_restore(data)
     if isinstance(template, dict) and isinstance(stored, dict):
+        # Only "cache" is legitimately absent (per-request state,
+        # never serialized). Any other missing collection means a bad
+        # export — keep from_bytes's loud load-time failure instead of
+        # deferring to an opaque KeyError at first request.
+        missing = set(template) - set(stored) - {"cache"}
+        if missing:
+            raise ValueError(
+                f"export {version_dir} lacks collections "
+                f"{sorted(missing)}; stored: {sorted(stored)}")
         template = {k: v for k, v in template.items() if k in stored}
     # from_state_dict reuses the already-restored tree — parsing the
     # bytes a second time with from_bytes would double deserialization

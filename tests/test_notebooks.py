@@ -1,0 +1,32 @@
+"""Execute the checked-in example notebooks (the reference's notebook
+walkthrough tier: user_guide.md MNIST-softmax flow, accuracy golden
+0.9014 — here rerun hermetically on every CI pass instead of by hand).
+"""
+
+import re
+from pathlib import Path
+
+import nbformat
+import pytest
+
+NOTEBOOKS = sorted(
+    (Path(__file__).resolve().parent.parent / "examples" / "notebooks")
+    .glob("*.ipynb"))
+
+
+@pytest.mark.parametrize("path", NOTEBOOKS, ids=lambda p: p.name)
+def test_notebook_executes_and_hits_accuracy(path):
+    from nbclient import NotebookClient
+
+    nb = nbformat.read(path, as_version=4)
+    client = NotebookClient(nb, timeout=300, kernel_name="python3")
+    client.execute()  # raises CellExecutionError on any failing cell
+
+    text = "\n".join(
+        out.get("text", "")
+        for cell in nb.cells if cell.cell_type == "code"
+        for out in cell.get("outputs", []))
+    match = re.search(r"test accuracy: ([0-9.]+)", text)
+    assert match, f"no accuracy line in outputs of {path.name}:\n{text}"
+    # Reference golden: 0.9014 (user_guide.md); hold the same bar.
+    assert float(match.group(1)) >= 0.90

@@ -152,3 +152,35 @@ def test_sampling_fresh_per_request_unless_pinned(lm_dir, tmp_path):
     c = pinned.run({"input_ids": prompt})["tokens"]
     d = pinned.run({"input_ids": prompt})["tokens"]
     np.testing.assert_array_equal(c, d)
+
+
+class GenerateProxyEndToEnd(tornado.testing.AsyncHTTPTestCase):
+    """:generate through the REST proxy in front of the server."""
+
+    @pytest.fixture(autouse=True)
+    def _dir(self, lm_dir):
+        type(self).base_path = lm_dir
+
+    def get_app(self):
+        import tornado.httpserver
+
+        from kubeflow_tpu.serving.http_proxy import make_app as proxy_app
+        from kubeflow_tpu.serving.server import make_app as server_app
+
+        self.manager = ModelManager()
+        self.manager.add_model("tinyllama", str(type(self).base_path),
+                               max_batch=8)
+        backend = server_app(self.manager)
+        sock, port = tornado.testing.bind_unused_port()
+        self.backend_server = tornado.httpserver.HTTPServer(backend)
+        self.backend_server.add_sockets([sock])
+        return proxy_app(f"http://127.0.0.1:{port}")
+
+    def test_proxy_generate(self):
+        resp = self.fetch(
+            "/model/tinyllama:generate", method="POST",
+            body=json.dumps({"instances": [[3] * PROMPT_LEN]}))
+        assert resp.code == 200, resp.body
+        preds = json.loads(resp.body)["predictions"]
+        assert len(preds) == 1 and len(preds[0]["tokens"]) == NEW_TOKENS
+        self.manager.stop()
