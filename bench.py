@@ -91,6 +91,27 @@ def main() -> int:
     except Exception as e:  # stretch line; never sink the bench
         extra["lora_bench_error"] = str(e)[:200]
 
+    # Decode throughput (generation serving): 7B KV-cache decode is
+    # HBM-bound; measured r2 at 20.1 ms/token ≈ 82% of peak HBM bw.
+    try:
+        from kubeflow_tpu.inference.benchmark import (
+            DecodeBenchConfig,
+            run_decode_benchmark,
+        )
+
+        dc = run_decode_benchmark(DecodeBenchConfig(
+            model="llama2-7b" if on_tpu else "llama-test",
+            batch_size=1 if on_tpu else 2,
+            prompt_len=64 if on_tpu else 8,
+            max_new_tokens=64 if on_tpu else 8,
+        ))
+        extra[f"{dc['model']}_decode_tokens_per_sec"] = round(
+            dc["decode_tokens_per_sec"], 1)
+        extra[f"{dc['model']}_decode_ms_per_token"] = round(
+            dc["per_token_ms"], 2)
+    except Exception as e:  # secondary line; never sink the bench
+        extra["decode_bench_error"] = str(e)[:200]
+
     try:
         from kubeflow_tpu.serving.benchmark import (
             ServingBenchConfig,

@@ -14,6 +14,13 @@ NOTEBOOKS = sorted(
     .glob("*.ipynb"))
 
 
+def test_notebooks_are_present():
+    # An empty glob must fail loudly — a silently-skipped tier would
+    # let BASELINE.md's "executed in CI" claim rot (e.g. an image
+    # that forgets to COPY examples/).
+    assert NOTEBOOKS, "examples/notebooks/*.ipynb missing"
+
+
 @pytest.mark.parametrize("path", NOTEBOOKS, ids=lambda p: p.name)
 def test_notebook_executes_and_hits_accuracy(path):
     from nbclient import NotebookClient

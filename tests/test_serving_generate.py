@@ -118,13 +118,16 @@ class GenerateEndToEnd(tornado.testing.AsyncHTTPTestCase):
             "/v1/models/tinyllama:generate", method="POST",
             body=json.dumps({"instances": prompt}))
         assert json.loads(resp2.body)["predictions"] == preds
-        self.manager.stop()
 
     def test_wrong_verb_is_400(self):
         resp = self.fetch(
             "/v1/models/tinyllama:predict", method="POST",
             body=json.dumps({"instances": [[1] * PROMPT_LEN]}))
         assert resp.code == 400
+
+    def tearDown(self):
+        self.manager.stop()
+        super().tearDown()
 
 
 def test_sampling_fresh_per_request_unless_pinned(lm_dir, tmp_path):
@@ -183,4 +186,7 @@ class GenerateProxyEndToEnd(tornado.testing.AsyncHTTPTestCase):
         assert resp.code == 200, resp.body
         preds = json.loads(resp.body)["predictions"]
         assert len(preds) == 1 and len(preds[0]["tokens"]) == NEW_TOKENS
+
+    def tearDown(self):
         self.manager.stop()
+        super().tearDown()
