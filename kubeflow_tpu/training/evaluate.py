@@ -1,0 +1,84 @@
+"""Held-out evaluation: loss / perplexity / accuracy over a stream.
+
+The trainers (training/lm.py, training/finetune.py) report *training*
+metrics; this is the eval side — a no-grad jitted step accumulating
+weighted sums so the reported numbers are exact over the stream, not
+means-of-means across ragged batches. Works with the same batch dicts
+the trainers consume (mlm or causal) and with either a plain params
+tree or a params+lora pair (evaluating a fine-tune without merging).
+
+The reference's only eval artifact was a notebook accuracy print
+(user_guide.md MNIST flow); this is the library-grade equivalent for
+the LM families.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kubeflow_tpu.training.lm import Batch, _model_args, lm_targets
+
+
+@functools.partial(jax.jit, static_argnames=("apply_fn", "objective"))
+def _eval_sums(apply_fn, variables, batch, objective: str):
+    """Returns (sum weighted CE, sum weights, sum weighted correct).
+
+    Target/weight selection comes from :func:`lm_targets` — the same
+    rules the training losses use, so train and eval can never
+    disagree about batch conventions (incl. pre-shifted ``targets``).
+    """
+    logits = apply_fn(variables, *_model_args(batch))
+    logits, targets, weights = lm_targets(logits, batch, objective)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    correct = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+    return ((ce * weights).sum(), weights.sum(), (correct * weights).sum())
+
+
+def evaluate_lm(
+    apply_fn: Any,
+    variables: Dict[str, Any],
+    batches: Iterator[Batch],
+    *,
+    objective: str = "causal",
+    max_batches: Optional[int] = None,
+) -> Dict[str, float]:
+    """Exact aggregate metrics over ``batches`` (or the first
+    ``max_batches`` of them). ``variables`` is the dict the model
+    applies with — ``{"params": p}`` or ``{"params": p, "lora": l}``
+    for an unmerged fine-tune."""
+    # Accumulate as device scalars: a float() per batch would fence
+    # every step and serialize the eval loop; one pull at the end
+    # lets dispatch pipeline ahead of the device.
+    total_ce = total_w = total_correct = None
+    n = 0
+    for batch in batches:
+        ce, w, correct = _eval_sums(apply_fn, variables, batch, objective)
+        if total_ce is None:
+            total_ce, total_w, total_correct = ce, w, correct
+        else:
+            total_ce, total_w, total_correct = (
+                total_ce + ce, total_w + w, total_correct + correct)
+        n += 1
+        if max_batches is not None and n >= max_batches:
+            break
+    if n == 0:
+        raise ValueError("evaluation stream produced no weighted tokens")
+    total_ce = float(total_ce)
+    total_w = float(total_w)
+    total_correct = float(total_correct)
+    if total_w == 0:
+        raise ValueError("evaluation stream produced no weighted tokens")
+    loss = total_ce / total_w
+    return {
+        "loss": loss,
+        "perplexity": math.exp(min(loss, 80.0)),  # overflow guard
+        "accuracy": total_correct / total_w,
+        "tokens": total_w,
+        "batches": float(n),
+    }

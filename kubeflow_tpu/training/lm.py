@@ -160,22 +160,21 @@ def create_lm_state(
     return state, shardings
 
 
-def mlm_loss(logits: jax.Array, batch: Batch) -> Tuple[jax.Array, jax.Array]:
-    """Masked-LM loss: cross entropy at positions where
-    ``mlm_weights`` is 1 (labels in ``mlm_labels``)."""
-    labels = batch["mlm_labels"]
-    weights = batch["mlm_weights"].astype(jnp.float32)
-    ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-    denom = jnp.maximum(weights.sum(), 1.0)
-    loss = (ce * weights).sum() / denom
-    acc = ((jnp.argmax(logits, -1) == labels) * weights).sum() / denom
-    return loss, acc
+def lm_targets(logits: jax.Array, batch: Batch, objective: str
+               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The single source of truth for batch conventions: map (logits,
+    batch) to aligned ``(logits_used, targets, weights)``.
 
-
-def causal_lm_loss(logits: jax.Array, batch: Batch
-                   ) -> Tuple[jax.Array, jax.Array]:
-    """Next-token loss. ``targets`` defaults to input_ids shifted left;
-    ``loss_weights`` (optional) masks padding."""
+    mlm: labels in ``mlm_labels``, weights in ``mlm_weights``.
+    causal: ``targets`` (pre-shifted) if present, else input_ids
+    shifted left; ``loss_weights`` (optional) masks padding and is
+    sliced to match when the shift is implicit. Both the training
+    losses below and training/evaluate.py build on this — eval must
+    never re-derive (and drift from) these rules.
+    """
+    if objective == "mlm":
+        return (logits, batch["mlm_labels"],
+                batch["mlm_weights"].astype(jnp.float32))
     if "targets" in batch:
         targets, logits_used = batch["targets"], logits
     else:
@@ -186,12 +185,30 @@ def causal_lm_loss(logits: jax.Array, batch: Batch
         weights = jnp.ones(targets.shape, jnp.float32)
     elif "targets" not in batch:
         weights = weights[:, 1:]
-    weights = weights.astype(jnp.float32)
-    ce = optax.softmax_cross_entropy_with_integer_labels(logits_used, targets)
+    return logits_used, targets, weights.astype(jnp.float32)
+
+
+def _weighted_loss(logits: jax.Array, batch: Batch, objective: str
+                   ) -> Tuple[jax.Array, jax.Array]:
+    logits, targets, weights = lm_targets(logits, batch, objective)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
     denom = jnp.maximum(weights.sum(), 1.0)
     loss = (ce * weights).sum() / denom
-    acc = ((jnp.argmax(logits_used, -1) == targets) * weights).sum() / denom
+    acc = ((jnp.argmax(logits, -1) == targets) * weights).sum() / denom
     return loss, acc
+
+
+def mlm_loss(logits: jax.Array, batch: Batch) -> Tuple[jax.Array, jax.Array]:
+    """Masked-LM loss: cross entropy at positions where
+    ``mlm_weights`` is 1 (labels in ``mlm_labels``)."""
+    return _weighted_loss(logits, batch, "mlm")
+
+
+def causal_lm_loss(logits: jax.Array, batch: Batch
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Next-token loss. ``targets`` defaults to input_ids shifted left;
+    ``loss_weights`` (optional) masks padding."""
+    return _weighted_loss(logits, batch, "causal")
 
 
 LOSSES = {"mlm": mlm_loss, "causal": causal_lm_loss}
