@@ -147,3 +147,24 @@ def test_bert_sequence_parallel_respects_padding():
     out = ring_model.apply({"params": params}, ids, None, valid)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_fit_block_alignment_and_floor():
+    from kubeflow_tpu.ops.flash_attention import _fit_block
+
+    assert _fit_block(2048, 2048) == 2048
+    assert _fit_block(3072, 2048) == 1024   # degrade to dividing pow2
+    assert _fit_block(1500, 2048) == 512    # pow2 only, never 1500
+    assert 1500 % _fit_block(1500, 2048) != 0  # -> XLA fallback
+    assert _fit_block(2176, 2048) == 512    # floor at 512, not 128
+    assert 2176 % 512 != 0                  # -> XLA fallback
+    assert _fit_block(128, 2048) == 128     # short L: exact block
+
+
+def test_non_dividing_length_falls_back_not_crashes():
+    # L=1500 must route to the XLA path (any backend), not a
+    # misaligned Pallas launch.
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 1500, 4, 64),
+                          jnp.bfloat16)
+    out = flash_attention(q, q, q, causal=True)
+    assert out.shape == q.shape
