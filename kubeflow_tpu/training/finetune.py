@@ -36,6 +36,7 @@ from kubeflow_tpu.training.lm import (
     LOSSES,
     Batch,
     _model_args,
+    accumulated_value_and_grad,
     jit_train_step,
     lm_forward_with_aux,
     sharded_collection_init,
@@ -119,6 +120,7 @@ def make_lora_train_step(
     objective: str = "causal",
     donate: bool = True,
     aux_loss_weight: float = 0.01,
+    grad_accum: int = 1,
 ):
     """Jitted SPMD step: grads and updates over ``state.lora`` only.
 
@@ -126,18 +128,21 @@ def make_lora_train_step(
     load-balance loss, ops/moe.py) are collected and weighted exactly
     as in the pretraining step — a LoRA fine-tune of an MoE model must
     keep routing-balance pressure even though the router is frozen.
+    ``grad_accum`` > 1 runs sequential microbatches
+    (lm.accumulated_value_and_grad) — with the frozen base already
+    memory-cheap, this is the lever for long-sequence fine-tunes.
     """
     loss_fn = LOSSES[objective]
 
     def step(state: LoRAState, batch: Batch):
-        def compute(lora):
+        def compute(lora, mb):
             return lm_forward_with_aux(
                 state.apply_fn,
                 {"params": state.base_params, "lora": lora},
-                batch, loss_fn, aux_loss_weight)
+                mb, loss_fn, aux_loss_weight)
 
-        (_, (loss, acc, aux)), grads = jax.value_and_grad(
-            compute, has_aux=True)(state.lora)
+        (loss, acc, aux), grads = accumulated_value_and_grad(
+            compute, state.lora, batch, grad_accum, objective)
         updates, new_opt = state.tx.update(grads, state.opt_state,
                                            state.lora)
         new_lora = optax.apply_updates(state.lora, updates)

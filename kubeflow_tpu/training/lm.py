@@ -188,6 +188,26 @@ def lm_targets(logits: jax.Array, batch: Batch, objective: str
     return logits_used, targets, weights.astype(jnp.float32)
 
 
+def lm_token_weight(batch: Batch, objective: str) -> jax.Array:
+    """Total token weight of a batch under the same conventions as
+    :func:`lm_targets` (no logits needed) — the normalizer gradient
+    accumulation must use so unevenly-weighted microbatches (mlm
+    masks, padded causal rows) still average to the exact full-batch
+    gradient."""
+    if objective == "mlm":
+        return batch["mlm_weights"].astype(jnp.float32).sum()
+    weights = batch.get("loss_weights")
+    if "targets" in batch:
+        shape = batch["targets"].shape
+        if weights is None:
+            return jnp.asarray(float(shape[0] * shape[1]), jnp.float32)
+        return weights.astype(jnp.float32).sum()
+    b, l = batch["input_ids"].shape
+    if weights is None:
+        return jnp.asarray(float(b * (l - 1)), jnp.float32)
+    return weights[:, 1:].astype(jnp.float32).sum()
+
+
 def _weighted_loss(logits: jax.Array, batch: Batch, objective: str
                    ) -> Tuple[jax.Array, jax.Array]:
     logits, targets, weights = lm_targets(logits, batch, objective)
@@ -232,6 +252,59 @@ def lm_forward_with_aux(apply_fn, variables, batch, loss_fn,
     return loss + aux_loss_weight * aux, (loss, acc, aux)
 
 
+def accumulated_value_and_grad(compute, params, batch: Batch, n: int,
+                               objective: str = "causal"):
+    """value_and_grad over ``n`` sequential microbatches.
+
+    ``compute(params, microbatch) -> (total_loss, (loss, acc, aux))``
+    where ``total_loss = loss + aux_term``. The batch's leading dim
+    splits into ``n`` equal microbatches run under ``lax.scan`` —
+    live activation memory drops ~n× while the optimizer sees the
+    **exact full-batch gradient**: each microbatch's CE contribution
+    is re-weighted by its share of the batch's token weight
+    (``lm_token_weight``), so mlm masks and padded causal rows — whose
+    per-microbatch weight sums differ — don't bias the average, and a
+    zero-weight microbatch contributes nothing. The aux term (a mean-
+    style regularizer, e.g. the MoE load-balance loss) averages
+    equally over microbatches.
+    """
+    if n <= 1:
+        (_, aux), grads = jax.value_and_grad(
+            lambda p: compute(p, batch), has_aux=True)(params)
+        return aux, grads
+
+    def split(x):
+        if x.shape[0] % n:
+            raise ValueError(
+                f"batch dim {x.shape[0]} not divisible by "
+                f"grad_accum={n}")
+        return x.reshape(n, x.shape[0] // n, *x.shape[1:])
+
+    micro = jax.tree.map(split, batch)
+    total_w = jnp.maximum(lm_token_weight(batch, objective), 1.0)
+
+    def body(carry, mb):
+        g_acc, l_acc, a_acc, x_acc = carry
+        frac = lm_token_weight(mb, objective) / total_w
+
+        def scaled(p):
+            total, (loss, acc, aux) = compute(p, mb)
+            aux_term = total - loss  # aux_loss_weight · aux, by construction
+            return loss * frac + aux_term / n, (loss, acc, aux)
+
+        (_, (loss, acc, aux)), g = jax.value_and_grad(
+            scaled, has_aux=True)(params)
+        g_acc = jax.tree.map(jnp.add, g_acc, g)
+        return (g_acc, l_acc + loss * frac, a_acc + acc * frac,
+                x_acc + aux / n), None
+
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    zero = jnp.zeros((), jnp.float32)
+    (grads, loss, acc, aux), _ = jax.lax.scan(
+        body, (zeros, zero, zero, zero), micro)
+    return (loss, acc, aux), grads
+
+
 def jit_train_step(step, mesh, shardings, donate):
     """Jit a (state, batch) → (state, metrics) step with the standard
     SPMD placement: state by its sharding tree, batch over
@@ -254,6 +327,7 @@ def make_lm_train_step(
     objective: str = "causal",
     donate: bool = True,
     aux_loss_weight: float = 0.01,
+    grad_accum: int = 1,
 ):
     """Jitted SPMD train step for an LMState.
 
@@ -261,17 +335,19 @@ def make_lm_train_step(
     Auxiliary losses sown into the ``"losses"`` collection (the MoE
     load-balance loss, ops/moe.py) are collected every step and added
     with ``aux_loss_weight``; models that sow nothing contribute zero.
+    ``grad_accum`` > 1 splits each batch into that many sequential
+    microbatches (see :func:`accumulated_value_and_grad`).
     """
     loss_fn = LOSSES[objective]
 
     def step(state: LMState, batch: Batch):
-        def compute(params):
+        def compute(params, mb):
             return lm_forward_with_aux(
-                state.apply_fn, {"params": params}, batch, loss_fn,
+                state.apply_fn, {"params": params}, mb, loss_fn,
                 aux_loss_weight)
 
-        (_, (loss, acc, aux)), grads = jax.value_and_grad(
-            compute, has_aux=True)(state.params)
+        (loss, acc, aux), grads = accumulated_value_and_grad(
+            compute, state.params, batch, grad_accum, objective)
         updates, new_opt = state.tx.update(grads, state.opt_state,
                                            state.params)
         new_params = optax.apply_updates(state.params, updates)

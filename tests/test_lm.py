@@ -175,3 +175,87 @@ def test_opt_state_shardings_by_tree_path():
             assert sh.spec == P("tensor", None), (path, sh)
         else:  # count scalars etc.
             assert sh.spec == P(), (path, sh)
+
+
+def test_grad_accum_matches_full_batch():
+    """grad_accum=4 must produce the same update as one full batch
+    (uniform token weights → exact average), at ~1/4 the live
+    activation memory."""
+    from kubeflow_tpu.training.lm import make_lm_train_step
+
+    model = llama_test()
+    batch = {"input_ids": jax.random.randint(
+        jax.random.PRNGKey(0), (8, 32), 0, 512)}
+    tx = optax.sgd(0.1)
+
+    def run(grad_accum):
+        state, _ = create_lm_state(model, tx, jax.random.PRNGKey(1), batch)
+        step = make_lm_train_step(None, None, objective="causal",
+                                  donate=False, grad_accum=grad_accum)
+        state, metrics = step(state, batch)
+        return state, metrics
+
+    s1, m1 = run(1)
+    s4, m4 = run(4)
+    np.testing.assert_allclose(float(m1["loss"]), float(m4["loss"]),
+                               rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-2, atol=2e-4),
+        s1.params, s4.params)
+
+
+def test_grad_accum_rejects_indivisible_batch():
+    from kubeflow_tpu.training.lm import make_lm_train_step
+
+    model = llama_test()
+    batch = {"input_ids": jax.random.randint(
+        jax.random.PRNGKey(0), (6, 16), 0, 512)}
+    state, _ = create_lm_state(model, optax.sgd(0.1),
+                               jax.random.PRNGKey(1), batch)
+    step = make_lm_train_step(None, None, donate=False, grad_accum=4)
+    with pytest.raises(ValueError, match="grad_accum"):
+        step(state, batch)
+
+
+def test_grad_accum_exact_for_uneven_mlm_masks():
+    """Microbatches with very different mask counts must still yield
+    the full-batch gradient (token-weighted accumulation)."""
+    from kubeflow_tpu.models.bert import bert_test
+    from kubeflow_tpu.training.lm import make_lm_train_step
+
+    model = bert_test()
+    b, l = 8, 32
+    rng = jax.random.PRNGKey(0)
+    # Deliberately skewed: rows 0-3 carry 12 masked tokens, rows 4-7
+    # carry 2 — microbatch weight sums differ 6x at grad_accum=2.
+    weights = np.zeros((b, l), np.int32)
+    weights[:4, :12] = 1
+    weights[4:, :2] = 1
+    batch = {
+        "input_ids": jax.random.randint(rng, (b, l), 0, 512),
+        "type_ids": jnp.zeros((b, l), jnp.int32),
+        "valid": jnp.ones((b, l), jnp.int32),
+        "mlm_labels": jax.random.randint(jax.random.fold_in(rng, 1),
+                                         (b, l), 0, 512),
+        "mlm_weights": jnp.asarray(weights),
+    }
+    tx = optax.sgd(0.1)
+
+    def run(grad_accum):
+        state, _ = create_lm_state(model, tx, jax.random.PRNGKey(1), batch)
+        step = make_lm_train_step(None, None, objective="mlm",
+                                  donate=False, grad_accum=grad_accum)
+        state, metrics = step(state, batch)
+        return state, metrics
+
+    s1, m1 = run(1)
+    s2, m2 = run(2)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-2, atol=2e-4),
+        s1.params, s2.params)
