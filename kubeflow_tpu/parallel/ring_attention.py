@@ -16,8 +16,8 @@ rebuild spec:
   one chip could hold; compute overlaps the next shard's transfer
   because XLA pipelines the ppermute DMA against the einsum.
 - **Ulysses (all-to-all)**: re-shard from sequence-parallel to
-  head-parallel with ``lax.all_to_all``, run dense attention on full
-  sequences for a subset of heads, and re-shard back. Cheaper at
+  head-parallel with ``lax.all_to_all``, run fused flash attention on
+  full sequences for a subset of heads, and re-shard back. Cheaper at
   moderate lengths (2 all-to-alls vs N-1 ring steps), but caps the seq
   axis at the head count; ring has no such cap.
 
@@ -34,11 +34,11 @@ from typing import Callable, Optional
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubeflow_tpu.ops.flash_attention import flash_attention
 from kubeflow_tpu.ops.attention import (
     attention_block_update,
     attention_finalize,
     attention_init_carry,
-    dense_attention,
 )
 
 
@@ -107,13 +107,16 @@ def ulysses_attention(
     """All-to-all sequence parallelism. Call INSIDE shard_map.
 
     Re-shards [B, L/N, H, D] → [B, L, H/N, D] (full sequence, head
-    subset), runs dense attention, and re-shards back. Head counts must
-    divide by the axis size. ``kv_segment_valid`` is the local
-    [B, L/N] padding mask.
+    subset), runs fused flash attention, and re-shards back. Head
+    counts must divide by the axis size. ``kv_segment_valid`` is the
+    local [B, L/N] padding mask.
     """
     n = jax.lax.axis_size(axis_name)
     if n == 1:
-        return dense_attention(q, k, v, causal=causal, scale=scale,
+        # Same O(L·block) local path as the n > 1 case — dense here
+        # would materialize the L×L scores exactly at the lengths
+        # this strategy exists for.
+        return flash_attention(q, k, v, causal=causal, scale=scale,
                                kv_segment_valid=kv_segment_valid)
 
     def seq_to_heads(x):
@@ -133,7 +136,13 @@ def ulysses_attention(
         # device needs the whole [B, L] padding mask.
         full_mask = jax.lax.all_gather(
             kv_segment_valid, axis_name, axis=1, tiled=True)
-    o = dense_attention(
+    # Local attention over the gathered FULL sequence: use the fused
+    # flash kernel — at the long contexts that motivate sequence
+    # parallelism, a dense local attention would materialize the
+    # (L × L) score matrix this strategy exists to avoid (on non-TPU
+    # backends / odd shapes flash_attention degrades to the XLA
+    # blockwise path, still O(L·block) memory).
+    o = flash_attention(
         seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
         causal=causal, scale=scale, kv_segment_valid=full_mask,
     )
