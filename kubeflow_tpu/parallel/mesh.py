@@ -10,7 +10,12 @@ collectives (all-reduce over ICI within a slice, DCN across slices).
 
 Standard axis names, used consistently across models and the trainer:
 
-- ``data``  — data parallelism (batch axis).
+- ``dcn_data`` — OUTERMOST: data parallelism *across slices/pods*
+  (gradient all-reduce over DCN). Hierarchical collectives fall out
+  of axis order: XLA reduce-scatters within a slice over ICI, then
+  all-reduces the per-slice partial over DCN — the bandwidth-correct
+  decomposition, without any NCCL/MPI-style topology code.
+- ``data``  — data parallelism within a slice (batch axis, ICI).
 - ``fsdp``  — parameter sharding (ZeRO-3 style), also used as a second
   batch axis.
 - ``tensor`` — tensor (megatron-style) model parallelism.
@@ -30,7 +35,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXIS_ORDER: Tuple[str, ...] = ("data", "fsdp", "pipeline", "seq", "expert", "tensor")
+AXIS_ORDER: Tuple[str, ...] = (
+    "dcn_data", "data", "fsdp", "pipeline", "seq", "expert", "tensor")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,6 +50,7 @@ class MeshSpec:
     seq: int = 1
     expert: int = 1
     tensor: int = 1
+    dcn_data: int = 1  # cross-slice (DCN) data parallelism, outermost
 
     def sizes(self) -> Dict[str, int]:
         return {name: getattr(self, name) for name in AXIS_ORDER}
@@ -74,11 +81,11 @@ def build_mesh(
 ) -> Mesh:
     """Build a Mesh over ``devices`` (default: all).
 
-    Axis order puts ``data`` outermost and ``tensor`` innermost so
-    tensor-parallel collectives ride the fastest ICI links — the
-    scaling-book recipe: bandwidth-hungry axes get the contiguous
-    device neighborhoods that ``mesh_utils`` maps to physical torus
-    proximity.
+    Axis order puts ``dcn_data`` outermost (slice boundaries), then
+    ``data``, with ``tensor`` innermost so tensor-parallel collectives
+    ride the fastest ICI links — the scaling-book recipe:
+    bandwidth-hungry axes get the contiguous device neighborhoods that
+    ``mesh_utils`` maps to physical torus proximity.
     """
     devices = list(devices if devices is not None else jax.devices())
     spec = (spec or MeshSpec(data=-1)).resolve(len(devices))
@@ -87,19 +94,37 @@ def build_mesh(
     try:
         from jax.experimental import mesh_utils
 
-        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        if sizes["dcn_data"] > 1:
+            # Hybrid layout: the dcn axis spans slice/granule
+            # boundaries, all other axes stay within a slice so their
+            # collectives ride ICI. Falls back to a plain reshape when
+            # slice metadata is unavailable (CPU simulation).
+            ici_shape = (1,) + shape[1:]
+            dcn_shape = (sizes["dcn_data"],) + (1,) * (len(shape) - 1)
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices)
+        else:
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=devices)
     except Exception:
+        if (sizes["dcn_data"] > 1 and devices
+                and getattr(devices[0], "platform", "") == "tpu"):
+            # On real TPU slices a failed hybrid construction (e.g.
+            # dcn_data != slice count) must not silently degrade to a
+            # reshape that routes ICI-intensity axes over DCN.
+            raise
         dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, AXIS_ORDER)
 
 
 def batch_sharding(mesh: Mesh, ndim: int = 0) -> NamedSharding:
-    """Sharding for a batch: leading axis split over (data, fsdp).
+    """Sharding for a batch: leading axis split over
+    (dcn_data, data, fsdp).
 
     ``ndim`` 0 means "any rank" (only the leading dim is constrained).
     """
     del ndim
-    return NamedSharding(mesh, P(("data", "fsdp")))
+    return NamedSharding(mesh, P(("dcn_data", "data", "fsdp")))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

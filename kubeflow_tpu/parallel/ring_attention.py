@@ -22,8 +22,9 @@ rebuild spec:
   axis at the head count; ring has no such cap.
 
 Both run inside :func:`jax.shard_map` over the standard mesh
-(:mod:`kubeflow_tpu.parallel.mesh`): batch on ``(data, fsdp)``,
-sequence on ``seq``, heads optionally on ``tensor``.
+(:mod:`kubeflow_tpu.parallel.mesh`): batch on
+``(dcn_data, data, fsdp)``, sequence on ``seq``, heads optionally on
+``tensor``.
 """
 
 from __future__ import annotations
@@ -155,7 +156,7 @@ def make_sequence_parallel_attention(
     strategy: str = "ring",
     causal: bool = False,
     scale: Optional[float] = None,
-    batch_axes=("data", "fsdp"),
+    batch_axes=("dcn_data", "data", "fsdp"),
     seq_axis: str = "seq",
     head_axis: Optional[str] = "tensor",
 ) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:

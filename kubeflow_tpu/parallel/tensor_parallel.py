@@ -14,7 +14,7 @@ need.
 
 Standard logical axis vocabulary (used by models/bert.py, models/llama.py):
 
-- ``batch``   — batch dim                → (data, fsdp)
+- ``batch``   — batch dim                → (dcn_data, data, fsdp)
 - ``seq``     — sequence dim             → seq (activations only)
 - ``embed``   — residual-stream features → fsdp (ZeRO-3 shard)
 - ``mlp``     — FFN hidden dim           → tensor
@@ -35,7 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 MeshAxes = Union[None, str, Tuple[str, ...]]
 
 DEFAULT_RULES: Dict[str, MeshAxes] = {
-    "batch": ("data", "fsdp"),
+    "batch": ("dcn_data", "data", "fsdp"),
     "seq": "seq",
     "embed": "fsdp",
     "mlp": "tensor",

@@ -7,8 +7,9 @@ the device. Design:
 
 - **Per-host sharding**: in a multi-host gang each process yields only
   its ``1/num_processes`` slice of the global batch (keyed by
-  ``jax.process_index()``), matching the batch's (data, fsdp) sharding
-  so ``device_put`` is a local copy, never a cross-host shuffle.
+  ``jax.process_index()``), matching the batch's
+  (dcn_data, data, fsdp) sharding so ``device_put`` is a local copy,
+  never a cross-host shuffle.
 - **Prefetch**: a background thread keeps ``prefetch`` batches already
   transferred (device_put is async under the hood), so the step loop
   never waits on host→HBM PCIe latency.
