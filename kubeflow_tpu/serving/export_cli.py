@@ -1,0 +1,273 @@
+"""Checkpoint → serving-directory exporter (CLI).
+
+Closes the loop the reference closed with its SavedModel export
+scripts (``components/k8s-model-server/README.md:95-105`` documents
+exporting a model into the versioned layout the server watches): take
+a training checkpoint (Orbax, training/checkpoint.py), optionally
+fold LoRA adapters into the base weights (ops/lora.merge_lora), and
+write a version directory the model server hot-loads.
+
+    python -m kubeflow_tpu.serving.export_cli \
+        --model llama2-7b --objective causal \
+        --checkpoint /ckpts/myft --lora --version 2 \
+        --out gs-mounted/models/myllama \
+        --generate '{"max_new_tokens": 256, "temperature": 0.8}'
+
+Without ``--checkpoint`` it exports freshly-initialized weights (the
+smoke-test path the citests use).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _build_metadata(model_name: str, registry_name: str, entry,
+                    seq_len: int, signature_kind: str,
+                    generate_config: Dict[str, Any],
+                    model_kwargs: Dict[str, Any]):
+    from kubeflow_tpu.serving.signature import (
+        ModelMetadata,
+        Signature,
+        TensorSpec,
+    )
+
+    if signature_kind == "generate":
+        max_new = int(generate_config.get("max_new_tokens", 32))
+        sig = Signature(
+            "generate",
+            {"input_ids": TensorSpec("int32", (-1, seq_len))},
+            {"tokens": TensorSpec("int32", (-1, max_new))})
+        model_kwargs = dict(model_kwargs)
+        model_kwargs.setdefault("cache_size", seq_len + max_new)
+    elif entry.family == "language":
+        sig = Signature(
+            "predict",
+            {"input_ids": TensorSpec("int32", (-1, seq_len))},
+            {"logits": TensorSpec(
+                "float32", (-1, seq_len, entry.num_classes_or_vocab))})
+    else:
+        shape, dtype = entry.input_spec
+        sig = Signature(
+            signature_kind if signature_kind != "auto" else "predict",
+            {"images": TensorSpec("float32", (-1, *shape))},
+            {"logits": TensorSpec(
+                "float32", (-1, entry.num_classes_or_vocab))})
+    return ModelMetadata(
+        model_name=model_name,
+        registry_name=registry_name,
+        signatures={ModelMetadata.DEFAULT_SIGNATURE: sig},
+        model_kwargs=model_kwargs,
+        generate_config=generate_config,
+    )
+
+
+def export_from_checkpoint(
+    *,
+    registry_name: str,
+    out: str,
+    version: int,
+    model_name: Optional[str] = None,
+    checkpoint: Optional[str] = None,
+    lora: bool = False,
+    lora_rank: int = 16,
+    lora_alpha: Optional[float] = None,
+    seq_len: int = 128,
+    signature_kind: str = "auto",
+    generate_config: Optional[Dict[str, Any]] = None,
+    model_kwargs: Optional[Dict[str, Any]] = None,
+    seed: int = 0,
+) -> str:
+    """Export one serving version; returns the version dir path.
+
+    With ``lora=True`` the checkpoint is an adapter checkpoint (the
+    ``{"step", "lora", "opt_state"}`` layout the fine-tune loop saves)
+    and the adapters are merged into the (freshly initialized or
+    separately restored) base — the zero-runtime-overhead serving
+    form.
+    """
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.export import export_model
+    from kubeflow_tpu.training.checkpoint import (
+        CheckpointConfig,
+        Checkpointer,
+    )
+
+    entry = get_model(registry_name)
+    model_kwargs = dict(model_kwargs or {})
+    generate_config = dict(generate_config or {})
+    if signature_kind == "auto":
+        signature_kind = ("generate" if generate_config
+                          and entry.family == "language" else "predict")
+    # Incoherent signature/model combinations must fail at export
+    # time, not produce a version dir that can never serve.
+    if signature_kind == "generate" and entry.family != "language":
+        raise ValueError(
+            f"generate signatures need a language model; "
+            f"{registry_name!r} is {entry.family}")
+    if signature_kind == "classify" and entry.family == "language":
+        raise ValueError("classify signatures need a vision model")
+    if generate_config and signature_kind != "generate":
+        raise ValueError(
+            "--generate config given but the signature is "
+            f"{signature_kind!r}")
+
+    build_kwargs = dict(model_kwargs)
+    if lora:
+        build_kwargs["lora_rank"] = lora_rank
+        if lora_alpha is not None:
+            # Must equal the training lora_alpha — a mismatched merge
+            # silently mis-scales every adapter delta (ops/lora.py).
+            build_kwargs["lora_alpha"] = lora_alpha
+    module = entry.make(**build_kwargs)
+
+    if entry.family == "language":
+        sample = jnp.zeros((1, seq_len), jnp.int32)
+    else:
+        shape, _ = entry.input_spec
+        sample = jnp.zeros((1, *shape), jnp.bfloat16)
+    import flax.linen as nn
+
+    # Restore first: when the checkpoint supplies every value, only
+    # the *boxed structure* is needed (eval_shape — zero FLOPs), not
+    # a full random init that would materialize 13 GB at 7B.
+    restored = None
+    if checkpoint:
+        ckpt = Checkpointer(CheckpointConfig(directory=checkpoint,
+                                             async_save=False))
+        restored = ckpt.restore_raw()
+        ckpt.close()
+        if restored is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {checkpoint!r}")
+        restored.pop("opt_state", None)  # never exported; free early
+
+    need_init_values = (
+        restored is None
+        or (lora and "base_params" not in restored))
+    rng = jax.random.PRNGKey(seed)
+    if need_init_values:
+        variables = jax.jit(module.init)(rng, sample)
+    else:
+        variables = jax.eval_shape(module.init, rng, sample)
+    boxed = variables  # all collections, nn.Partitioned metadata kept
+
+    def rebox(values):
+        # The serving layout stores params with their partitioning
+        # boxes (load_version's init template is boxed); restored/
+        # merged values are plain arrays and must be re-boxed.
+        return jax.tree.map(
+            lambda b, v: (b.replace_boxed(jnp.asarray(v))
+                          if isinstance(b, nn.meta.AxisMetadata) else
+                          jnp.asarray(v)),
+            boxed["params"], values,
+            is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata))
+
+    params = nn.meta.unbox(boxed["params"]) if need_init_values else None
+
+    if restored is not None and lora:
+        from kubeflow_tpu.ops.lora import merge_lora
+
+        if "lora" not in restored:
+            raise ValueError(
+                f"--lora expects an adapter checkpoint with a "
+                f"'lora' subtree; found {sorted(restored)}")
+        if "base_params" in restored:
+            # fit()-saved LoRAState: base and adapters travel in one
+            # checkpoint — no init-seed coordination needed.
+            params = restored["base_params"]
+        # else: adapters-only checkpoint; the base comes from this
+        # process's init (same --seed as training) or a prior
+        # export — the caller owns that coordination.
+        params = merge_lora(params, restored["lora"],
+                            alpha=float(module.lora_alpha))
+    elif restored is not None:
+        if "params" not in restored:
+            raise ValueError(
+                f"checkpoint has no 'params' subtree; found "
+                f"{sorted(restored)}")
+        params = restored["params"]
+
+    # Export every non-transient collection the model owns (vision
+    # models carry batch_stats that load_version's template expects;
+    # the lora collection is merged away, the cache is per-request).
+    export_vars: Dict[str, Any] = {"params": rebox(params)}
+    for collection, value in variables.items():
+        if collection in ("params", "lora", "cache"):
+            continue
+        if not need_init_values:
+            raise ValueError(
+                f"model has collection {collection!r} but the "
+                f"checkpoint layout does not carry it; export from a "
+                f"full-variables checkpoint instead")
+        export_vars[collection] = value
+
+    metadata = _build_metadata(
+        model_name or registry_name, registry_name, entry, seq_len,
+        signature_kind, generate_config, model_kwargs)
+    path = export_model(out, version, metadata, export_vars)
+    return str(path)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kft-export")
+    parser.add_argument("--model", required=True,
+                        help="registry name (kft prototype for names)")
+    parser.add_argument("--out", required=True,
+                        help="serving base path (versioned dirs)")
+    parser.add_argument("--version", type=int, default=1)
+    parser.add_argument("--name", default=None, help="served model name")
+    parser.add_argument("--checkpoint", default=None,
+                        help="Orbax checkpoint dir to restore")
+    parser.add_argument("--lora", action="store_true",
+                        help="checkpoint is an adapter checkpoint; "
+                             "merge into the base for serving")
+    parser.add_argument("--lora_rank", type=int, default=16)
+    parser.add_argument("--lora_alpha", type=float, default=None,
+                        help="MUST match the training lora_alpha "
+                             "(default: the model's default)")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="base init seed; must match training for "
+                             "adapters-only LoRA checkpoints")
+    parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--signature", default="auto",
+                        choices=("auto", "predict", "classify",
+                                 "generate"))
+    parser.add_argument("--generate", default=None,
+                        help='JSON generate config, e.g. '
+                             '\'{"max_new_tokens": 64, '
+                             '"temperature": 0.8}\'')
+    parser.add_argument("--model_kwargs", default=None,
+                        help="JSON kwargs for the model constructor")
+    args = parser.parse_args(argv)
+    from kubeflow_tpu.utils.platform import sync_platform_from_env
+
+    sync_platform_from_env()
+    path = export_from_checkpoint(
+        registry_name=args.model,
+        out=args.out,
+        version=args.version,
+        model_name=args.name,
+        checkpoint=args.checkpoint,
+        lora=args.lora,
+        lora_rank=args.lora_rank,
+        lora_alpha=args.lora_alpha,
+        seed=args.seed,
+        seq_len=args.seq_len,
+        signature_kind=args.signature,
+        generate_config=json.loads(args.generate) if args.generate else None,
+        model_kwargs=(json.loads(args.model_kwargs)
+                      if args.model_kwargs else None),
+    )
+    print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

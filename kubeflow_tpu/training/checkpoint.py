@@ -95,6 +95,17 @@ class Checkpointer:
                     self.config.directory)
         return restored
 
+    def restore_raw(self, step: Optional[int] = None) -> Any:
+        """Restore the checkpoint's own structure (plain arrays) with
+        no live-state target — the export path's entry point
+        (serving/export_cli.py), where only a subtree (e.g. the LoRA
+        adapters) is wanted and the saver's optimizer state need not
+        be reconstructible. Returns None if no checkpoint exists."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        return self._manager.restore(step)
+
     def wait(self) -> None:
         """Block until pending async saves are durable (call before
         declaring job success)."""
