@@ -3,8 +3,10 @@
 Reference: ``testing/test_tf_serving.py`` — in-cluster gRPC Predict
 with a fixed JPEG, 3 retries (``:90-102``), golden-file equality
 (``:104-108``), junit output. Here: REST predict with a fixed seeded
-input; in ``--fake`` mode a local server process on an exported
-deterministic model stands in for the cluster service.
+input PLUS the native-gRPC PredictionService verbs (Predict, Classify,
+GetModelMetadata) through a real grpc channel; in ``--fake`` mode a
+local server process on an exported deterministic model stands in for
+the cluster service.
 """
 
 from __future__ import annotations
@@ -55,9 +57,40 @@ def golden_check(base_url: str, model_name: str) -> None:
     logger.info("golden predict ok: top classes %s", preds[0]["classes"])
 
 
+def grpc_check(address: str, model_name: str) -> None:
+    """Drive the native gRPC surface — the reference's actual serving
+    contract (tf-serving.libsonnet:106-111) — through a real channel:
+    GetModelMetadata (the proxy's bootstrap call), Predict, Classify."""
+    import numpy as np
+
+    from kubeflow_tpu.serving import client
+
+    signatures = client.grpc_get_metadata(address, model_name)
+    assert "serving_default" in signatures, signatures
+    sig = signatures["serving_default"]
+    assert sig["inputs"], "GetModelMetadata returned no input tensors"
+    logger.info("grpc GetModelMetadata ok: %s", sorted(signatures))
+
+    rng = np.random.RandomState(42)
+    image = (rng.randint(0, 256, (1, 32, 32, 3)) / 255.0).astype(np.float32)
+    input_name = next(iter(sig["inputs"]))
+    outputs = client.grpc_predict(address, model_name, {input_name: image})
+    assert outputs, "grpc Predict returned no outputs"
+    logger.info("grpc Predict ok: outputs %s", sorted(outputs))
+
+    rows = client.grpc_classify(
+        address, model_name, [{input_name: image.reshape(-1)}])
+    assert len(rows) == 1 and rows[0], rows
+    scores = [score for _, score in rows[0]]
+    assert all(b <= a + 1e-9 for a, b in zip(scores, scores[1:])), \
+        "classify scores must be sorted desc"
+    logger.info("grpc Classify ok: top label %s", rows[0][0][0])
+
+
 def run_fake() -> None:
     """Local stand-in: export a deterministic model, boot the real
-    server binary, golden-predict against it."""
+    server binary, golden-predict against it over REST and native
+    gRPC."""
     import os
     import pathlib
     import subprocess
@@ -89,10 +122,11 @@ def run_fake() -> None:
     export_model(str(base), 1, meta, variables)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    port = 19301
+    grpc_port, rest_port = 19300, 19301
     proc = subprocess.Popen(
         [sys.executable, "-m", "kubeflow_tpu.serving.server",
-         "--port", str(port), "--model_name", "resnet",
+         "--port", str(grpc_port), "--rest_port", str(rest_port),
+         "--model_name", "resnet",
          "--model_base_path", str(base), "--poll_interval", "1",
          # Small bucket set: load-time warmup compiles every bucket.
          "--max_batch", "4"],
@@ -101,7 +135,7 @@ def run_fake() -> None:
         for _ in range(120):
             try:
                 if urllib.request.urlopen(
-                        f"http://127.0.0.1:{port}/healthz",
+                        f"http://127.0.0.1:{rest_port}/healthz",
                         timeout=1).status == 200:
                     break
             except (urllib.error.URLError, OSError):
@@ -109,7 +143,8 @@ def run_fake() -> None:
             time.sleep(1)
         else:
             raise AssertionError("local model server never became healthy")
-        golden_check(f"http://127.0.0.1:{port}", "resnet")
+        golden_check(f"http://127.0.0.1:{rest_port}", "resnet")
+        grpc_check(f"127.0.0.1:{grpc_port}", "resnet")
     finally:
         proc.kill()
 
@@ -126,9 +161,11 @@ def main(argv=None) -> int:
     if args.fake:
         fn = run_fake
     else:
-        url = (f"http://{args.service}.{args.namespace}.svc.cluster."
-               f"local:9000")
-        fn = lambda: golden_check(url, args.model_name)  # noqa: E731
+        host = f"{args.service}.{args.namespace}.svc.cluster.local"
+
+        def fn() -> None:
+            golden_check(f"http://{host}:8500", args.model_name)
+            grpc_check(f"{host}:9000", args.model_name)
     case = junit.run_case("serving-predict", fn)
     if args.junit_path:
         junit.write_report(args.junit_path, "e2e-serving", [case])

@@ -38,24 +38,25 @@ def server_container(p: Dict[str, Any]) -> Dict[str, Any]:
         p["name"], p["model_server_image"],
         command=["python", "-m", "kubeflow_tpu.serving.server"],
         args=[
-            "--port=9000",
+            "--port=9000",        # native gRPC PredictionService
+            "--rest_port=8500",   # REST + gRPC-Web
             f"--model_name={p['model_name']}",
             f"--model_base_path={p['model_path']}",
         ],
-        ports=[k8s.port(9000, "serve")],
+        ports=[k8s.port(9000, "grpc"), k8s.port(8500, "rest")],
         # Model load + first XLA compile takes tens of seconds to
-        # minutes. The server opens its port immediately and /healthz
+        # minutes. The server opens its ports immediately and /healthz
         # answers 503 until every model has a loaded version, so:
         # readiness (/healthz) gates traffic on actual model
         # availability; liveness (/livez) only checks the process;
         # the startup probe gives slow gs:// loads a 10-minute budget
         # before liveness can kill anything. (The reference set no
         # probes at all — observed warmup 502s motivated these.)
-        readiness_probe=k8s.http_get_probe("/healthz", 9000,
+        readiness_probe=k8s.http_get_probe("/healthz", 8500,
                                            initial_delay=5, period=5),
-        liveness_probe=k8s.http_get_probe("/livez", 9000,
+        liveness_probe=k8s.http_get_probe("/livez", 8500,
                                           initial_delay=0, period=30),
-        startup_probe=k8s.http_get_probe("/livez", 9000, initial_delay=0,
+        startup_probe=k8s.http_get_probe("/livez", 8500, initial_delay=0,
                                          period=10, failure_threshold=60),
         resources=k8s.resources(
             cpu_request="1", memory_request="1Gi",
@@ -73,7 +74,7 @@ def proxy_container(p: Dict[str, Any]) -> Dict[str, Any]:
     return k8s.container(
         f"{p['name']}-http-proxy", p["http_proxy_image"],
         command=["python", "-m", "kubeflow_tpu.serving.http_proxy"],
-        args=["--port=8000", "--rpc_port=9000", "--rpc_timeout=10.0"],
+        args=["--port=8000", "--rpc_port=8500", "--rpc_timeout=10.0"],
         ports=[k8s.port(8000, "http")],
         resources=k8s.resources(cpu_request="500m", memory_request="500Mi",
                                 cpu_limit="1", memory_limit="1Gi"),
@@ -100,8 +101,9 @@ def deployment(p: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def service(p: Dict[str, Any]) -> Dict[str, Any]:
-    """gRPC/native :9000 + REST :8000 with Ambassador GET/POST mappings
-    at ``/models/<name>/`` (parity ``:204-249``)."""
+    """Native gRPC :9000 (reference contract) + REST proxy :8000 +
+    server REST :8500, with Ambassador GET/POST mappings at
+    ``/models/<name>/`` (parity ``:204-249``)."""
     name, ns = p["name"], p["namespace"]
     mapping = "\n".join([
         k8s.ambassador_mapping(
@@ -112,17 +114,18 @@ def service(p: Dict[str, Any]) -> Dict[str, Any]:
             method="POST", rewrite=f"/model/{name}:predict",
             timeout_ms=10000),
         # gRPC-Web PredictionService surface (serving/wire.py); the
-        # IAP Envoy's grpc_web filter bridges native gRPC clients
-        # down to this path.
+        # IAP Envoy's grpc_web filter bridges browser gRPC-Web
+        # clients down to this path. Native gRPC clients dial :9000.
         k8s.ambassador_mapping(
             f"{name}-grpc-web",
             "/tensorflow.serving.PredictionService/",
-            f"{name}.{ns}:9000", method="POST", rewrite="",
+            f"{name}.{ns}:8500", method="POST", rewrite="",
             timeout_ms=30000),
     ])
     return k8s.service(
         name, ns, {"app": name},
-        [k8s.service_port(9000, name="serve"),
+        [k8s.service_port(9000, name="grpc"),
+         k8s.service_port(8500, name="rest"),
          k8s.service_port(8000, name="http")],
         service_type=p["service_type"],
         annotations={"getambassador.io/config": mapping},

@@ -8,14 +8,19 @@ Layering (parity with reference ``kubeflow/tf-serving`` +
   serialized params (the SavedModel role).
 - :mod:`model` — loads one version onto TPU and builds the jitted,
   batch-bucketed predict function (XLA compile once per bucket).
-- :mod:`manager` — version watcher (hot reload of new ``<N>/`` dirs)
-  and the native micro-batching queue (C++ via ctypes,
-  native/kft_runtime.cc).
-- :mod:`server` — the model-server process on :9000 (HTTP/JSON; the
-  reference's was gRPC — this environment has no grpc, and the wire
-  protocol is an implementation detail behind the proxy).
+- :mod:`manager` — version watcher (hot reload of new ``<N>/`` dirs;
+  POSIX via the native C++ scanner, gs://-style object stores via
+  :mod:`remote`'s fsspec scanner + download cache) and the native
+  micro-batching queue (C++ via ctypes, native/kft_runtime.cc).
+- :mod:`wire` / :mod:`grpc_server` — the PredictionService wire
+  surface: hand-rolled protobuf codec + native gRPC listener on
+  :9000 (Predict / Classify / GetModelMetadata — the reference's
+  serving contract, tf-serving.libsonnet:106-111).
+- :mod:`server` — the model-server process: native gRPC on :9000,
+  HTTP/JSON + gRPC-Web on :8500.
 - :mod:`http_proxy` — REST proxy on :8000 with the reference's route
   grammar ``/model/<name>[:predict|:classify]`` and b64 handling
   (reference ``components/k8s-model-server/http-proxy/server.py``).
-- :mod:`client` — demo predict client (reference inception-client).
+- :mod:`client` — demo predict client (reference inception-client):
+  native gRPC, gRPC-Web, and REST paths.
 """

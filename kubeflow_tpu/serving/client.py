@@ -1,8 +1,10 @@
 """Demo predict client (reference inception-client label.py parity).
 
 Reference: ``components/k8s-model-server/inception-client/label.py``
-built a gRPC PredictRequest with a 10s timeout (``:40-56``); this
-client POSTs the same logical request to the REST surface.
+built a gRPC PredictRequest with a 10s timeout (``:40-56``). This
+client speaks all three surfaces: native gRPC (grpc_predict /
+grpc_classify / grpc_get_metadata — the label.py path), gRPC-Web, and
+REST via the proxy.
 """
 
 from __future__ import annotations
@@ -70,6 +72,62 @@ def grpc_web_predict(server: str, model: str, inputs: dict, *,
     return outputs
 
 
+def _grpc_call(server: str, method: str, request: bytes,
+               timeout: float) -> bytes:
+    """One raw-bytes unary call on an insecure channel. grpcio passes
+    bytes through untouched when no serializers are given — the wire
+    codec (serving/wire.py) is the (de)serializer."""
+    import grpc
+
+    with grpc.insecure_channel(server) as channel:
+        call = channel.unary_unary(
+            f"/tensorflow.serving.PredictionService/{method}")
+        return call(request, timeout=timeout)
+
+
+def grpc_predict(server: str, model: str, inputs: dict, *,
+                 signature_name: str = "", version=None,
+                 timeout: float = 10.0) -> dict:
+    """Native-gRPC Predict — the reference client's exact flow
+    (label.py:40-56: channel → PredictRequest → stub.Predict(req, 10))."""
+    import numpy as np
+
+    from kubeflow_tpu.serving import wire
+
+    request = wire.encode_predict_request(
+        model, {k: np.asarray(v) for k, v in inputs.items()},
+        signature_name=signature_name, version=version)
+    _, outputs = wire.decode_predict_response(
+        _grpc_call(server, "Predict", request, timeout))
+    return outputs
+
+
+def grpc_classify(server: str, model: str, examples, *,
+                  signature_name: str = "", version=None,
+                  timeout: float = 10.0):
+    """Native-gRPC Classify with tf.Example rows → [[(label, score)]]."""
+    from kubeflow_tpu.serving import wire
+
+    request = wire.encode_classification_request(
+        model, examples, signature_name=signature_name, version=version)
+    _, classifications = wire.decode_classification_response(
+        _grpc_call(server, "Classify", request, timeout))
+    return classifications
+
+
+def grpc_get_metadata(server: str, model: str, *, version=None,
+                      timeout: float = 10.0) -> dict:
+    """Native-gRPC GetModelMetadata → {sig_name: signature dict}
+    (the reference proxy's signature-map fetch, server.py:121-160)."""
+    from kubeflow_tpu.serving import wire
+
+    request = wire.encode_get_model_metadata_request(
+        model, version=version)
+    _, signatures = wire.decode_get_model_metadata_response(
+        _grpc_call(server, "GetModelMetadata", request, timeout))
+    return signatures
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kft-predict")
     parser.add_argument("--server", default="localhost:8000")
@@ -77,6 +135,10 @@ def main(argv=None) -> int:
     parser.add_argument("--input_path", help="raw input file sent as b64")
     parser.add_argument("--json_path", help="JSON file with instances")
     parser.add_argument("--classify", action="store_true")
+    parser.add_argument("--grpc", action="store_true",
+                        help="dial the native gRPC port instead of REST")
+    parser.add_argument("--input_name", default="inputs",
+                        help="tensor name for --grpc requests")
     args = parser.parse_args(argv)
     if args.json_path:
         instances = json.load(open(args.json_path))["instances"]
@@ -85,8 +147,21 @@ def main(argv=None) -> int:
         instances = [{"b64": base64.b64encode(data).decode()}]
     else:
         parser.error("need --input_path or --json_path")
-    result = predict(args.server, args.model, instances,
-                     classify=args.classify)
+    if args.grpc:
+        if args.input_path:
+            parser.error("--grpc takes --json_path (dense tensors)")
+        if args.classify:
+            examples = [{args.input_name: row} for row in instances]
+            result = {"classifications": [
+                [{"label": label, "score": score} for label, score in row]
+                for row in grpc_classify(args.server, args.model, examples)]}
+        else:
+            outputs = grpc_predict(args.server, args.model,
+                                   {args.input_name: instances})
+            result = {k: v.tolist() for k, v in outputs.items()}
+    else:
+        result = predict(args.server, args.model, instances,
+                         classify=args.classify)
     json.dump(result, sys.stdout, indent=2)
     print()
     return 0

@@ -1,0 +1,243 @@
+"""Native gRPC PredictionService listener — the :9000 contract.
+
+The reference served raw gRPC on :9000 (``kubeflow/tf-serving/
+tf-serving.libsonnet:106-111``) and its clients spoke it directly
+(``components/k8s-model-server/inception-client/label.py:40-56``); the
+reference proxy was built on GetModelMetadata (``components/
+k8s-model-server/http-proxy/server.py:121-160``) and Classify
+(``server.py:239-262``). This module is that surface: Predict,
+Classify and GetModelMetadata on a real grpcio server.
+
+No generated stubs: the methods are registered as *generic* raw-bytes
+handlers (serializer/deserializer omitted, so grpcio hands the
+request frame through untouched) and the hand-rolled codec in
+serving/wire.py does the (de)serialization. That keeps the tree free
+of a protoc step while serving the exact public wire format.
+
+Execution goes through the same ``ServedModel.submit`` micro-batching
+path as the REST surface, so gRPC and REST requests share batch
+buckets on the TPU.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from kubeflow_tpu.serving import wire
+from kubeflow_tpu.serving.manager import ModelManager
+
+logger = logging.getLogger(__name__)
+
+SERVICE_NAME = "tensorflow.serving.PredictionService"
+
+
+def _abort_for(context, exc) -> None:
+    """Map Python-side failures onto canonical gRPC status codes
+    (mirrors the gRPC-Web handler's mapping, serving/server.py)."""
+    import grpc
+
+    if isinstance(exc, KeyError):
+        context.abort(grpc.StatusCode.NOT_FOUND, str(exc.args[0]))
+    if isinstance(exc, ValueError):
+        context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+    if isinstance(exc, concurrent.futures.TimeoutError):
+        context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                      "predict timed out")
+    if isinstance(exc, RuntimeError):
+        context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
+    logger.exception("unhandled error in gRPC handler")
+    context.abort(grpc.StatusCode.INTERNAL, type(exc).__name__)
+
+
+def start_predict(manager: ModelManager, request_bytes: bytes):
+    """Shared Predict front half for both transports (native gRPC here,
+    gRPC-Web in serving/server.py): decode → validate against the
+    signature → submit to the micro-batcher. Returns
+    (spec, loaded, future, output_filter); the caller awaits the
+    future in its own concurrency style."""
+    spec, inputs, output_filter = wire.decode_predict_request(
+        request_bytes)
+    model = manager.get_model(spec["name"])
+    loaded = model.get(spec["version"])
+    sig = loaded.signature(spec["signature_name"] or None)
+    unknown = set(inputs) - set(sig.inputs)
+    if unknown:
+        raise ValueError(
+            f"unknown inputs {sorted(unknown)}; signature has "
+            f"{sorted(sig.inputs)}")
+    input_name = next(iter(sig.inputs))
+    if input_name not in inputs:
+        raise ValueError(
+            f"request missing input {input_name!r}; "
+            f"got {sorted(inputs)}")
+    future = model.submit({input_name: inputs[input_name]},
+                          spec["signature_name"] or None,
+                          "predict", spec["version"])
+    return spec, loaded, future, output_filter
+
+
+def finish_predict(spec, loaded, outputs, output_filter) -> bytes:
+    """Shared Predict back half: apply output_filter, encode."""
+    if output_filter:
+        missing = set(output_filter) - set(outputs)
+        if missing:
+            raise ValueError(
+                f"output_filter names unknown outputs "
+                f"{sorted(missing)}; available {sorted(outputs)}")
+        outputs = {k: outputs[k] for k in output_filter}
+    return wire.encode_predict_response(
+        outputs, spec["name"], loaded.version)
+
+
+class PredictionService:
+    """Raw-bytes method behaviors for the generic handler."""
+
+    def __init__(self, manager: ModelManager, *, timeout_s: float = 30.0):
+        self._manager = manager
+        self._timeout_s = timeout_s
+
+    # -- Predict -----------------------------------------------------------
+
+    def Predict(self, request: bytes, context) -> bytes:
+        try:
+            spec, loaded, future, output_filter = start_predict(
+                self._manager, request)
+            outputs = future.result(self._timeout_s)
+            return finish_predict(spec, loaded, outputs, output_filter)
+        except Exception as e:  # noqa: BLE001 — mapped to grpc status
+            _abort_for(context, e)
+
+    # -- Classify ----------------------------------------------------------
+
+    def Classify(self, request: bytes, context) -> bytes:
+        try:
+            spec, examples = wire.decode_classification_request(request)
+            if not examples:
+                raise ValueError("ClassificationRequest carries no examples")
+            model = self._manager.get_model(spec["name"])
+            loaded = model.get(spec["version"])
+            sig = loaded.signature(spec["signature_name"] or None)
+            input_name, input_spec = next(iter(sig.inputs.items()))
+            batch = _examples_to_batch(examples, input_name,
+                                       tuple(input_spec.shape[1:]))
+            future = model.submit({input_name: batch},
+                                  spec["signature_name"] or None,
+                                  "classify", spec["version"])
+            outputs = future.result(self._timeout_s)
+            classifications = _to_classifications(
+                outputs, loaded.metadata.classes)
+            return wire.encode_classification_response(
+                classifications, spec["name"], loaded.version)
+        except Exception as e:  # noqa: BLE001
+            _abort_for(context, e)
+
+    # -- GetModelMetadata --------------------------------------------------
+
+    def GetModelMetadata(self, request: bytes, context) -> bytes:
+        try:
+            spec, fields = wire.decode_get_model_metadata_request(request)
+            unsupported = [f for f in fields if f != "signature_def"]
+            if unsupported:
+                raise ValueError(
+                    f"unsupported metadata_field {unsupported}; "
+                    f"only 'signature_def' is served")
+            model = self._manager.get_model(spec["name"])
+            loaded = model.get(spec["version"])
+            signatures = {
+                name: {
+                    "method": sig.method,
+                    "inputs": {k: (v.dtype, v.shape)
+                               for k, v in sig.inputs.items()},
+                    "outputs": {k: (v.dtype, v.shape)
+                                for k, v in sig.outputs.items()},
+                }
+                for name, sig in loaded.metadata.signatures.items()
+            }
+            return wire.encode_get_model_metadata_response(
+                spec["name"], loaded.version, signatures)
+        except Exception as e:  # noqa: BLE001
+            _abort_for(context, e)
+
+
+def _examples_to_batch(examples: List[dict], input_name: str,
+                       row_shape: Tuple[int, ...]) -> np.ndarray:
+    """tf.Example feature dicts → one dense batch for the signature's
+    single input. Dense float/int features are reshaped to the
+    signature row shape; bytes features are rejected (JAX models take
+    dense arrays — the REST surface's b64 path covers raw payloads)."""
+    rows = []
+    row_size = int(np.prod(row_shape)) if row_shape else 1
+    for i, example in enumerate(examples):
+        if input_name in example:
+            value = example[input_name]
+        elif len(example) == 1:
+            value = next(iter(example.values()))
+        else:
+            raise ValueError(
+                f"example {i} missing feature {input_name!r}; "
+                f"got {sorted(example)}")
+        if isinstance(value, list):  # bytes_list
+            raise ValueError(
+                f"example {i}: bytes features are not supported; send "
+                f"dense float_list/int64_list of size {row_size}")
+        arr = np.asarray(value)
+        if arr.size != row_size:
+            raise ValueError(
+                f"example {i}: feature {input_name!r} has {arr.size} "
+                f"values, signature row needs {row_size}")
+        rows.append(arr.reshape(row_shape))
+    return np.stack(rows)
+
+
+def _to_classifications(outputs: dict,
+                        classes: Optional[List[str]]
+                        ) -> List[List[Tuple[str, float]]]:
+    """{classes: (n,k) int, scores: (n,k) float} → per-example
+    (label, score) pairs, using the export-time label vocabulary when
+    the model ships one."""
+    if "classes" not in outputs or "scores" not in outputs:
+        raise ValueError(
+            f"signature outputs {sorted(outputs)} do not carry "
+            "classes/scores; use Predict for this model")
+    idx = np.asarray(outputs["classes"])
+    scores = np.asarray(outputs["scores"])
+    result = []
+    for row_idx, row_scores in zip(idx, scores):
+        row = []
+        for c, s in zip(row_idx, row_scores):
+            label = (classes[int(c)]
+                     if classes and 0 <= int(c) < len(classes)
+                     else str(int(c)))
+            row.append((label, float(s)))
+        result.append(row)
+    return result
+
+
+def make_server(manager: ModelManager, port: int, *,
+                max_workers: int = 16, timeout_s: float = 30.0):
+    """Build + bind (not start) the gRPC server. Returns (server,
+    bound_port); bound_port is the OS-assigned port when port=0."""
+    import grpc
+
+    service = PredictionService(manager, timeout_s=timeout_s)
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(behavior)
+        for name, behavior in (("Predict", service.Predict),
+                               ("Classify", service.Classify),
+                               ("GetModelMetadata",
+                                service.GetModelMetadata))
+    }
+    server = grpc.server(
+        concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers,
+            thread_name_prefix="grpc-prediction"))
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),))
+    bound = server.add_insecure_port(f"[::]:{port}")
+    if bound == 0:
+        raise RuntimeError(f"could not bind gRPC port {port}")
+    return server, bound

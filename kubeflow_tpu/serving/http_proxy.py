@@ -7,8 +7,10 @@ Route grammar and behaviors are parity with the reference proxy
   ``/version/<v>`` (reference ``:270-283``).
 - Payload ``{"instances": [...]}``; ``{"b64": "..."}`` leaves are
   base64-decoded before tensor conversion (reference ``:110-119``).
-- The model's signature map is fetched once and cached (reference
-  GetModelMetadata caching ``:121-160,202-203``).
+- The model's signature map is cached per model and invalidated when
+  a response reveals a new served version (the reference cached
+  forever, ``:121-160,202-203`` — its server never hot-swapped
+  signatures; this one does).
 - Responses zip output tensors into ``{"predictions": [{...}]}``
   (reference ``:233-236``).
 
@@ -48,8 +50,9 @@ class ProxyHandler(tornado.web.RequestHandler):
     def rpc_address(self) -> str:
         addr = self.application.settings["rpc_address"]
         # Accept bare host:port (the manifest wires the sidecar as
-        # --rpc_address=127.0.0.1:9000, parity with the reference's
-        # --rpc_port flag, tf-serving.libsonnet:152).
+        # --rpc_port=8500 → the server's REST port; flag name is
+        # parity with the reference's --rpc_port,
+        # tf-serving.libsonnet:152).
         if "://" not in addr:
             addr = f"http://{addr}"
         return addr
@@ -62,14 +65,32 @@ class ProxyHandler(tornado.web.RequestHandler):
     def _metadata_cache(self) -> Dict[str, Any]:
         return self.application.settings["metadata_cache"]
 
-    async def get_signature_map(self, name: str) -> Dict[str, Any]:
-        if name not in self._metadata_cache:
+    async def get_signature_map(self, name: str, *,
+                                refresh: bool = False) -> Dict[str, Any]:
+        """Cached signature map, keyed by model and invalidated on
+        version change (the reference cached forever, server.py:202-203
+        — safe there because its server never hot-swapped signatures;
+        this one does, via the export CLI + version watcher)."""
+        if refresh or name not in self._metadata_cache:
             client = tornado.httpclient.AsyncHTTPClient()
             url = f"{self.rpc_address}/v1/models/{name}/metadata"
             response = await client.fetch(url,
                                           request_timeout=self.rpc_timeout)
-            self._metadata_cache[name] = json.loads(response.body)
-        return self._metadata_cache[name]
+            payload = json.loads(response.body)
+            self._metadata_cache[name] = {
+                "version": payload.get("model_spec", {}).get("version"),
+                "payload": payload,
+            }
+        return self._metadata_cache[name]["payload"]
+
+    def invalidate_if_version_changed(self, name: str,
+                                      served_version: Any) -> None:
+        """Drop the cached signature map when an upstream response
+        reveals a different served version (hot reload happened)."""
+        entry = self._metadata_cache.get(name)
+        if (entry is not None and served_version is not None
+                and entry["version"] != served_version):
+            del self._metadata_cache[name]
 
     def write_json(self, payload: Dict[str, Any], status: int = 200) -> None:
         self.set_status(status)
@@ -95,7 +116,14 @@ class InferProxyHandler(ProxyHandler):
                 {"error": f"model metadata fetch failed: {e}"},
                 e.code if e.code else 502)
         instances = decode_b64_if_needed(instances)
-        instances = _bytes_to_arrays(instances, metadata)
+        try:
+            instances = _bytes_to_arrays(instances, metadata)
+        except ValueError as e:
+            # Possibly converting against a stale signature (hot
+            # reload): drop the cache so the next attempt is fresh.
+            self._metadata_cache.pop(name, None)
+            return self.write_json(
+                {"error": f"payload does not match signature: {e}"}, 400)
         path = f"/v1/models/{name}"
         if version:
             path += f"/versions/{version}"
@@ -115,7 +143,19 @@ class InferProxyHandler(ProxyHandler):
                                    502)
         payload = json.loads(response.body or b"{}")
         if response.code != 200:
+            # The failure may itself be caused by stale cached
+            # metadata (hot reload changed the input signature → the
+            # converted payload no longer matches): drop the entry so
+            # the next request reconverts against fresh metadata
+            # instead of failing forever.
+            self._metadata_cache.pop(name, None)
             return self.write_json(payload, response.code)
+        # A hot reload shows up as a changed served version in the
+        # response's model_spec; drop the stale signature cache so the
+        # NEXT request converts against the new signature.
+        if not version:  # pinned-version requests say nothing re latest
+            self.invalidate_if_version_changed(
+                name, payload.get("model_spec", {}).get("version"))
         self.write_json({"predictions": payload.get("predictions", [])})
 
     async def post(self, name: str, version: Optional[str], verb: str):
@@ -125,7 +165,10 @@ class InferProxyHandler(ProxyHandler):
 class MetadataProxyHandler(ProxyHandler):
     async def get(self, name: str):
         try:
-            metadata = await self.get_signature_map(name)
+            # Direct metadata GETs always revalidate upstream (and
+            # refresh the cache the infer path uses): a user asking
+            # for metadata after an export wants the new signature.
+            metadata = await self.get_signature_map(name, refresh=True)
         except tornado.httpclient.HTTPClientError as e:
             return self.write_json({"error": str(e)},
                                    e.code if e.code else 502)
@@ -172,7 +215,10 @@ def make_app(rpc_address: str, rpc_timeout: float = 10.0
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kft-http-proxy")
     parser.add_argument("--port", type=int, default=8000)
-    parser.add_argument("--rpc_port", type=int, default=9000)
+    # Upstream is the model server's REST port (8500); its native gRPC
+    # lives on 9000 (reference contract) but this proxy's async REST
+    # upstream path does not need it.
+    parser.add_argument("--rpc_port", type=int, default=8500)
     parser.add_argument("--rpc_address", default="localhost")
     parser.add_argument("--rpc_timeout", type=float, default=10.0)
     args = parser.parse_args(argv)

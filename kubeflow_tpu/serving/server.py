@@ -1,17 +1,21 @@
-"""The model-server process (:9000) — tensorflow_model_server's role.
+"""The model-server process — tensorflow_model_server's role.
 
 Reference: ``/usr/bin/tensorflow_model_server --port=9000
 --model_name=<n> --model_base_path=<p>`` (kubeflow/tf-serving/
 tf-serving.libsonnet:102-128), a C++ gRPC PredictionService. Here the
 native pieces are the batching queue + version watcher
-(native/kft_runtime.cc) and XLA executes the model. Transports:
-HTTP/JSON (TF-Serving REST shapes; the proxy on :8000 is the public
-surface, same as the reference) plus the PredictionService schema over
-gRPC-Web — grpcio/h2 are unavailable in this environment, so native
-gRPC clients reach it through Envoy's grpc_web filter (design
-rationale: serving/wire.py).
+(native/kft_runtime.cc) and XLA executes the model.
 
-Endpoints:
+Transports, sharing one ModelManager/batcher:
+  - native gRPC PredictionService on ``--port`` (default 9000, the
+    reference's contract): Predict / Classify / GetModelMetadata
+    (serving/grpc_server.py over the wire.py codec);
+  - HTTP on ``--rest_port`` (default 8500): TF-Serving REST shapes
+    (the proxy on :8000 is the public surface, same as the reference)
+    plus the PredictionService schema over gRPC-Web for browser/Envoy
+    grpc_web clients.
+
+HTTP endpoints:
   GET  /v1/models/<name>                      → version status
   GET  /v1/models/<name>/metadata             → signature map
   POST /v1/models/<name>[/versions/<v>]:predict   {"instances": ...}
@@ -195,6 +199,11 @@ class GrpcWebPredictHandler(BaseHandler):
             return self.write_json(
                 {"error": f"unsupported content-type {ctype!r}"}, 415)
         try:
+            from kubeflow_tpu.serving.grpc_server import (
+                finish_predict,
+                start_predict,
+            )
+
             body = self.request.body
             if self._text_mode:  # grpc-web-text = base64-wrapped frames
                 body = base64.b64decode(body)
@@ -202,34 +211,13 @@ class GrpcWebPredictHandler(BaseHandler):
             data = [m for flags, m in frames if not flags & 0x80]
             if len(data) != 1:
                 raise ValueError(f"expected 1 message frame, got {len(data)}")
-            spec, inputs, output_filter = wire.decode_predict_request(data[0])
-            model = self.manager.get_model(spec["name"])
-            loaded = model.get(spec["version"])
-            sig = loaded.signature(spec["signature_name"] or None)
-            unknown = set(inputs) - set(sig.inputs)
-            if unknown:
-                raise ValueError(
-                    f"unknown inputs {sorted(unknown)}; signature has "
-                    f"{sorted(sig.inputs)}")
-            input_name = next(iter(sig.inputs))
-            if input_name not in inputs:
-                raise ValueError(
-                    f"request missing input {input_name!r}; "
-                    f"got {sorted(inputs)}")
-            future = model.submit({input_name: inputs[input_name]},
-                                  spec["signature_name"] or None,
-                                  "predict", spec["version"])
+            # Same decode→validate→submit→filter→encode halves as the
+            # native-gRPC transport; only the await style differs.
+            spec, loaded, future, output_filter = start_predict(
+                self.manager, data[0])
             outputs = await tornado.ioloop.IOLoop.current().run_in_executor(
                 None, future.result, 30.0)
-            if output_filter:
-                missing = set(output_filter) - set(outputs)
-                if missing:
-                    raise ValueError(
-                        f"output_filter names unknown outputs "
-                        f"{sorted(missing)}; available {sorted(outputs)}")
-                outputs = {k: outputs[k] for k in output_filter}
-            body = wire.encode_predict_response(
-                outputs, spec["name"], loaded.version)
+            body = finish_predict(spec, loaded, outputs, output_filter)
             self._grpc_reply(wire.frame_message(body)
                              + wire.trailers_frame(0))
         except KeyError as e:
@@ -278,7 +266,11 @@ def make_app(manager: ModelManager) -> tornado.web.Application:
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kft-model-server")
+    # --port is the gRPC port, exactly like tensorflow_model_server
+    # (tf-serving.libsonnet:107 pins --port=9000 for gRPC); REST rides
+    # --rest_port, mirroring TF-Serving's --rest_api_port split.
     parser.add_argument("--port", type=int, default=9000)
+    parser.add_argument("--rest_port", type=int, default=8500)
     parser.add_argument("--model_name", required=True)
     parser.add_argument("--model_base_path", required=True)
     parser.add_argument("--max_batch", type=int, default=64)
@@ -293,15 +285,20 @@ def main(argv=None) -> int:
 
     sync_platform_from_env()
     manager = ModelManager(poll_interval_s=args.poll_interval)
-    # Defer the (slow) first model load to the poll thread: the port
-    # opens immediately and /healthz answers 503 until loaded, so
+    # Defer the (slow) first model load to the poll thread: the ports
+    # open immediately and /healthz answers 503 until loaded, so
     # kubelet probes see a live-but-not-ready pod instead of a dead one.
     manager.add_model(args.model_name, args.model_base_path,
                       max_batch=args.max_batch, initial_poll=False)
+    from kubeflow_tpu.serving.grpc_server import make_server
+
+    grpc_srv, _ = make_server(manager, args.port)
+    grpc_srv.start()
     app = make_app(manager)
-    app.listen(args.port)
-    logger.info("model server listening on :%d (model=%s base=%s)",
-                args.port, args.model_name, args.model_base_path)
+    app.listen(args.rest_port)
+    logger.info("model server: gRPC on :%d, REST on :%d "
+                "(model=%s base=%s)", args.port, args.rest_port,
+                args.model_name, args.model_base_path)
     manager.start()
     tornado.ioloop.IOLoop.current().start()
     return 0

@@ -1,32 +1,36 @@
-"""TF-Serving PredictionService wire compatibility (protobuf + gRPC
-framing) without grpcio/protobuf runtimes.
+"""TF-Serving PredictionService wire codec (protobuf + gRPC framing).
 
 The reference's serving surface was gRPC on :9000
 (``kubeflow/tf-serving/tf-serving.libsonnet:106-111``; client
-``components/k8s-model-server/inception-client/label.py:40-56``). This
-environment ships neither grpcio nor an HTTP/2 stack, so a native gRPC
-listener is not buildable here; the deliberate surface design is:
+``components/k8s-model-server/inception-client/label.py:40-56``).
+This codec backs BOTH transports of that surface:
 
-- REST/JSON (server.py) as the in-pod + gateway surface (the
-  reference's http-proxy already made REST the public surface);
-- a **gRPC-Web** endpoint (``POST /tensorflow.serving.
-  PredictionService/Predict``, content-type ``application/grpc-web+
-  proto``) speaking the exact PredictRequest/PredictResponse schema.
-  gRPC-Web runs over HTTP/1.1 (no HPACK/h2 needed), real gRPC-Web
-  clients call it directly, and the Envoy already deployed for IAP
-  (manifests/iap.py) bridges native gRPC clients via its grpc_web
-  filter.
+- the **native gRPC** listener on :9000 (serving/grpc_server.py) —
+  grpcio is available here and serves these messages as raw bytes via
+  generic method handlers, so no .proto compilation step or generated
+  stubs are needed anywhere in the tree;
+- the **gRPC-Web** endpoint on the REST port (``POST
+  /tensorflow.serving.PredictionService/Predict``, content-type
+  ``application/grpc-web+proto``), which lets browser/Envoy gRPC-Web
+  clients reach the same schema over HTTP/1.1 (the IAP Envoy in
+  manifests/iap.py uses its grpc_web filter for this).
 
-This module is the protobuf wire codec for that surface: a minimal
-encoder/decoder for the tensorflow.serving messages, hand-rolled
-against the public proto schemas (field numbers below are the public
-API contract):
+Hand-rolling the codec (rather than compiling the tensorflow_serving
+protos) is deliberate: the wire format IS the public contract, the
+messages involved are small and stable, and this keeps the serving
+stack free of a protoc build step and of a tensorflow/tf-serving
+dependency. Field numbers below are the public API contract:
 
-  TensorProto        tensorflow/core/framework/tensor.proto
-  TensorShapeProto   tensorflow/core/framework/tensor_shape.proto
-  ModelSpec          tensorflow_serving/apis/model.proto
-  PredictRequest     tensorflow_serving/apis/predict.proto
-  PredictResponse    tensorflow_serving/apis/predict.proto
+  TensorProto            tensorflow/core/framework/tensor.proto
+  TensorShapeProto       tensorflow/core/framework/tensor_shape.proto
+  ModelSpec              tensorflow_serving/apis/model.proto
+  PredictRequest/Response    tensorflow_serving/apis/predict.proto
+  ClassificationRequest/Response, Input, Example
+                         tensorflow_serving/apis/classification.proto,
+                         input.proto; tensorflow/core/example/*.proto
+  GetModelMetadataRequest/Response, SignatureDefMap
+                         tensorflow_serving/apis/get_model_metadata.proto
+  SignatureDef, TensorInfo   tensorflow/core/protobuf/meta_graph.proto
 
 Tests cross-validate byte-level round-trips against
 ``tf.make_tensor_proto`` where tensorflow is available.
@@ -314,6 +318,323 @@ def decode_predict_response(buf: bytes):
         elif field == 2 and wire_type == _LEN:
             spec = decode_model_spec(value)
     return spec, outputs
+
+
+# --- tf.Example / Classification messages ----------------------------------
+
+_DT_FROM_STR = {
+    "float32": DT_FLOAT,
+    "bfloat16": DT_BFLOAT16,
+    "int32": DT_INT32,
+    "int64": DT_INT64,
+    "uint8": DT_UINT8,
+    "bool": DT_BOOL,
+}
+
+
+def encode_example(features: Dict[str, object]) -> bytes:
+    """{name: value} → tensorflow.Example bytes. Floats go to
+    float_list, ints to int64_list, bytes to bytes_list."""
+    entries = b""
+    for name, value in features.items():
+        if isinstance(value, bytes):
+            feature = _field_bytes(1, _field_bytes(1, value))  # BytesList
+        else:
+            arr = np.asarray(value).reshape(-1)
+            if np.issubdtype(arr.dtype, np.integer):
+                packed = b"".join(_encode_varint(int(v) & (1 << 64) - 1)
+                                  for v in arr)
+                feature = _field_bytes(3, _field_bytes(1, packed))
+            else:
+                packed = struct.pack(f"<{arr.size}f",
+                                     *arr.astype(np.float32))
+                feature = _field_bytes(2, _field_bytes(1, packed))
+        entry = _field_bytes(1, name.encode()) + _field_bytes(2, feature)
+        entries += _field_bytes(1, entry)  # Features.feature map entry
+    return _field_bytes(1, entries)  # Example.features
+
+
+def decode_example(buf: bytes) -> Dict[str, object]:
+    """tensorflow.Example bytes → {name: ndarray | [bytes]}."""
+    out: Dict[str, object] = {}
+    for field, wire_type, value in _iter_fields(buf):
+        if field != 1 or wire_type != _LEN:
+            continue
+        for f2, wt2, v2 in _iter_fields(value):  # Features.feature entries
+            if f2 != 1 or wt2 != _LEN:
+                continue
+            name = ""
+            feature: object = None
+            for f3, wt3, v3 in _iter_fields(v2):
+                if f3 == 1 and wt3 == _LEN:
+                    name = bytes(v3).decode()
+                elif f3 == 2 and wt3 == _LEN:
+                    feature = _decode_feature(v3)
+            if name and feature is not None:
+                out[name] = feature
+    return out
+
+
+def _decode_feature(buf: bytes):
+    bytes_vals: List[bytes] = []
+    float_vals: List[float] = []
+    int_vals: List[int] = []
+    for field, wire_type, value in _iter_fields(buf):
+        if field == 1 and wire_type == _LEN:  # BytesList
+            for f2, wt2, v2 in _iter_fields(value):
+                if f2 == 1 and wt2 == _LEN:
+                    bytes_vals.append(bytes(v2))
+        elif field == 2 and wire_type == _LEN:  # FloatList
+            for f2, wt2, v2 in _iter_fields(value):
+                if f2 == 1 and wt2 == _LEN:  # packed
+                    float_vals.extend(
+                        struct.unpack(f"<{len(v2) // 4}f", v2))
+                elif f2 == 1 and wt2 == _I32:
+                    float_vals.append(struct.unpack("<f", v2)[0])
+        elif field == 3 and wire_type == _LEN:  # Int64List
+            for f2, wt2, v2 in _iter_fields(value):
+                if f2 == 1 and wt2 == _LEN:  # packed
+                    pos = 0
+                    while pos < len(v2):
+                        v, pos = _decode_varint(v2, pos)
+                        int_vals.append(
+                            v - (1 << 64) if v >= 1 << 63 else v)
+                elif f2 == 1 and wt2 == _VARINT:
+                    v = int(v2)
+                    int_vals.append(v - (1 << 64) if v >= 1 << 63 else v)
+    if bytes_vals:
+        return bytes_vals
+    if float_vals:
+        return np.asarray(float_vals, np.float32)
+    return np.asarray(int_vals, np.int64)
+
+
+def encode_classification_request(model_name: str,
+                                  examples: List[Dict[str, object]],
+                                  signature_name: str = "",
+                                  version: Optional[int] = None) -> bytes:
+    example_list = b"".join(
+        _field_bytes(1, encode_example(ex)) for ex in examples)
+    return (_field_bytes(1, encode_model_spec(model_name, version,
+                                              signature_name))
+            + _field_bytes(2, _field_bytes(1, example_list)))
+
+
+def decode_classification_request(buf: bytes):
+    """→ (model_spec dict, [example feature dicts])."""
+    spec: Dict[str, object] = {"name": "", "version": None,
+                               "signature_name": ""}
+    examples: List[Dict[str, object]] = []
+    for field, wire_type, value in _iter_fields(buf):
+        if field == 1 and wire_type == _LEN:
+            spec = decode_model_spec(value)
+        elif field == 2 and wire_type == _LEN:  # Input
+            for f2, wt2, v2 in _iter_fields(value):
+                if f2 == 1 and wt2 == _LEN:  # ExampleList
+                    for f3, wt3, v3 in _iter_fields(v2):
+                        if f3 == 1 and wt3 == _LEN:
+                            examples.append(decode_example(v3))
+                elif f2 == 2 and wt2 == _LEN:
+                    raise ValueError(
+                        "ExampleListWithContext is not supported")
+    return spec, examples
+
+
+def encode_classification_response(
+        classifications: List[List[Tuple[str, float]]],
+        model_name: str, version: Optional[int] = None) -> bytes:
+    """[[(label, score), ...] per example] → ClassificationResponse."""
+    result = b""
+    for classes in classifications:
+        row = b"".join(
+            _field_bytes(1, _field_bytes(1, label.encode())
+                         + _tag(2, _I32) + struct.pack("<f", score))
+            for label, score in classes)
+        result += _field_bytes(1, row)  # Classifications
+    return (_field_bytes(1, result)
+            + _field_bytes(2, encode_model_spec(model_name, version)))
+
+
+def decode_classification_response(buf: bytes):
+    """→ (model_spec dict, [[(label, score), ...] per example])."""
+    spec: Dict[str, object] = {"name": "", "version": None,
+                               "signature_name": ""}
+    classifications: List[List[Tuple[str, float]]] = []
+    for field, wire_type, value in _iter_fields(buf):
+        if field == 2 and wire_type == _LEN:
+            spec = decode_model_spec(value)
+        elif field == 1 and wire_type == _LEN:  # ClassificationResult
+            for f2, wt2, v2 in _iter_fields(value):
+                if f2 != 1 or wt2 != _LEN:
+                    continue
+                classes: List[Tuple[str, float]] = []
+                for f3, wt3, v3 in _iter_fields(v2):
+                    if f3 != 1 or wt3 != _LEN:
+                        continue
+                    label, score = "", 0.0
+                    for f4, wt4, v4 in _iter_fields(v3):
+                        if f4 == 1 and wt4 == _LEN:
+                            label = bytes(v4).decode()
+                        elif f4 == 2 and wt4 == _I32:
+                            score = struct.unpack("<f", v4)[0]
+                    classes.append((label, score))
+                classifications.append(classes)
+    return spec, classifications
+
+
+# --- GetModelMetadata / SignatureDefMap -------------------------------------
+
+SIGNATURE_DEF_TYPE_URL = (
+    "type.googleapis.com/tensorflow.serving.SignatureDefMap")
+
+
+def encode_get_model_metadata_request(
+        model_name: str, metadata_fields: Tuple[str, ...] = ("signature_def",),
+        version: Optional[int] = None) -> bytes:
+    out = _field_bytes(1, encode_model_spec(model_name, version))
+    for f in metadata_fields:
+        out += _field_bytes(2, f.encode())
+    return out
+
+
+def decode_get_model_metadata_request(buf: bytes):
+    """→ (model_spec dict, [metadata_field])."""
+    spec: Dict[str, object] = {"name": "", "version": None,
+                               "signature_name": ""}
+    fields: List[str] = []
+    for field, wire_type, value in _iter_fields(buf):
+        if field == 1 and wire_type == _LEN:
+            spec = decode_model_spec(value)
+        elif field == 2 and wire_type == _LEN:
+            fields.append(bytes(value).decode())
+    return spec, fields
+
+
+def _encode_tensor_info(name: str, dtype: str,
+                        shape: Tuple[int, ...]) -> bytes:
+    dt = _DT_FROM_STR.get(dtype)
+    if dt is None:
+        raise ValueError(f"unsupported signature dtype {dtype!r}")
+    dims = b"".join(_field_bytes(2, _field_varint(1, d & (1 << 64) - 1))
+                    for d in shape)
+    return (_field_bytes(1, name.encode())
+            + _field_varint(2, dt)
+            + _field_bytes(3, dims))
+
+
+def _decode_tensor_info(buf: bytes) -> Dict[str, object]:
+    info: Dict[str, object] = {"name": "", "dtype": 0, "shape": []}
+    for field, wire_type, value in _iter_fields(buf):
+        if field == 1 and wire_type == _LEN:
+            info["name"] = bytes(value).decode()
+        elif field == 2 and wire_type == _VARINT:
+            info["dtype"] = int(value)
+        elif field == 3 and wire_type == _LEN:
+            dims: List[int] = []
+            for f2, wt2, v2 in _iter_fields(value):
+                if f2 == 2 and wt2 == _LEN:
+                    for f3, wt3, v3 in _iter_fields(v2):
+                        if f3 == 1 and wt3 == _VARINT:
+                            size = int(v3)
+                            dims.append(
+                                size - (1 << 64) if size >= 1 << 63
+                                else size)
+            info["shape"] = dims
+    return info
+
+
+def encode_signature_def_map(signatures: Dict[str, Dict[str, object]]
+                             ) -> bytes:
+    """{sig_name: {"method": str, "inputs": {n: (dtype, shape)},
+    "outputs": ...}} → SignatureDefMap bytes."""
+    out = b""
+    for sig_name, sig in signatures.items():
+        body = b""
+        for field_no, key in ((1, "inputs"), (2, "outputs")):
+            for tensor_name, (dtype, shape) in sig[key].items():
+                entry = (_field_bytes(1, tensor_name.encode())
+                         + _field_bytes(2, _encode_tensor_info(
+                             tensor_name, dtype, tuple(shape))))
+                body += _field_bytes(field_no, entry)
+        body += _field_bytes(
+            3, f"tensorflow/serving/{sig['method']}".encode())
+        entry = _field_bytes(1, sig_name.encode()) + _field_bytes(2, body)
+        out += _field_bytes(1, entry)
+    return out
+
+
+def decode_signature_def_map(buf: bytes) -> Dict[str, Dict[str, object]]:
+    sigs: Dict[str, Dict[str, object]] = {}
+    for field, wire_type, value in _iter_fields(buf):
+        if field != 1 or wire_type != _LEN:
+            continue
+        name = ""
+        sig: Dict[str, object] = {"inputs": {}, "outputs": {},
+                                  "method_name": ""}
+        for f2, wt2, v2 in _iter_fields(value):
+            if f2 == 1 and wt2 == _LEN:
+                name = bytes(v2).decode()
+            elif f2 == 2 and wt2 == _LEN:  # SignatureDef
+                for f3, wt3, v3 in _iter_fields(v2):
+                    if f3 in (1, 2) and wt3 == _LEN:
+                        key = "inputs" if f3 == 1 else "outputs"
+                        tname, tinfo = "", None
+                        for f4, wt4, v4 in _iter_fields(v3):
+                            if f4 == 1 and wt4 == _LEN:
+                                tname = bytes(v4).decode()
+                            elif f4 == 2 and wt4 == _LEN:
+                                tinfo = _decode_tensor_info(v4)
+                        if tname and tinfo is not None:
+                            sig[key][tname] = tinfo
+                    elif f3 == 3 and wt3 == _LEN:
+                        sig["method_name"] = bytes(v3).decode()
+        if name:
+            sigs[name] = sig
+    return sigs
+
+
+def encode_get_model_metadata_response(
+        model_name: str, version: Optional[int],
+        signatures: Dict[str, Dict[str, object]]) -> bytes:
+    """signatures in encode_signature_def_map's shape; packed into the
+    response's metadata["signature_def"] google.protobuf.Any."""
+    any_msg = (_field_bytes(1, SIGNATURE_DEF_TYPE_URL.encode())
+               + _field_bytes(2, encode_signature_def_map(signatures)))
+    entry = (_field_bytes(1, b"signature_def")
+             + _field_bytes(2, any_msg))
+    return (_field_bytes(1, encode_model_spec(model_name, version))
+            + _field_bytes(2, entry))
+
+
+def decode_get_model_metadata_response(buf: bytes):
+    """→ (model_spec dict, {sig_name: signature dict}). Unpacks the
+    signature_def Any; other metadata keys are ignored."""
+    spec: Dict[str, object] = {"name": "", "version": None,
+                               "signature_name": ""}
+    sigs: Dict[str, Dict[str, object]] = {}
+    for field, wire_type, value in _iter_fields(buf):
+        if field == 1 and wire_type == _LEN:
+            spec = decode_model_spec(value)
+        elif field == 2 and wire_type == _LEN:  # metadata map entry
+            key, type_url, packed = "", "", b""
+            for f2, wt2, v2 in _iter_fields(value):
+                if f2 == 1 and wt2 == _LEN:
+                    key = bytes(v2).decode()
+                elif f2 == 2 and wt2 == _LEN:  # Any
+                    for f3, wt3, v3 in _iter_fields(v2):
+                        if f3 == 1 and wt3 == _LEN:
+                            type_url = bytes(v3).decode()
+                        elif f3 == 2 and wt3 == _LEN:
+                            packed = bytes(v3)
+            if key == "signature_def":
+                if type_url != SIGNATURE_DEF_TYPE_URL:
+                    raise ValueError(
+                        f"unexpected Any type_url {type_url!r}")
+                sigs = decode_signature_def_map(packed)
+    return spec, sigs
+
+
+DT_TO_STR = {v: k for k, v in _DT_FROM_STR.items()}
 
 
 # --- gRPC / gRPC-Web framing -----------------------------------------------
