@@ -3,11 +3,14 @@
 Parity with TF-Serving's model lifecycle (the reference ran
 ``tensorflow_model_server --model_base_path=...`` which watches the
 base path and hot-loads new numeric version dirs): a background thread
-polls via the native scanner (C++, native/kft_runtime.cc) and swaps in
-new versions atomically; a native request queue micro-batches predict
-calls so the TPU runs saturated batch buckets instead of per-request
-executions (the reference served one session-run per request — this is
-the main serving-throughput win of the rebuild).
+polls the base path — the native scanner (C++, native/kft_runtime.cc)
+for POSIX paths, the fsspec scanner + download cache
+(serving/remote.py) for gs://-style object stores, the reference's
+primary flow (tf-serving.libsonnet:110) — and swaps in new versions
+atomically; a native request queue micro-batches predict calls so the
+TPU runs saturated batch buckets instead of per-request executions
+(the reference served one session-run per request — this is the main
+serving-throughput win of the rebuild).
 """
 
 from __future__ import annotations
@@ -20,7 +23,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from kubeflow_tpu.serving import _native
+from kubeflow_tpu.serving import _native, remote
 from kubeflow_tpu.serving.model import LoadedModel, load_version
 
 logger = logging.getLogger(__name__)
@@ -58,14 +61,24 @@ class ServedModel:
     def poll_versions(self) -> bool:
         """Scan base_path; load the latest version if it's new.
         Returns True if a (re)load happened."""
-        latest = _native.scan_latest_version(self.base_path)
+        if remote.is_remote(self.base_path):
+            latest = remote.scan_latest_version(self.base_path)
+        else:
+            latest = _native.scan_latest_version(self.base_path)
         if latest < 0 or latest == self._latest:
             return False
         logger.info("model %s: loading version %d from %s",
                     self.name, latest, self.base_path)
+        if remote.is_remote(self.base_path):
+            # Object stores can't be mmapped/opendir'd: pull the
+            # version dir into the local cache first, then load it
+            # through the ordinary local path.
+            version_dir = remote.materialize(self.base_path, latest)
+        else:
+            version_dir = f"{self.base_path}/{latest}"
         # warmup=True: every batch bucket compiles during load (health
         # stays 503), so no request ever hits a cold-compile cliff.
-        loaded = load_version(f"{self.base_path}/{latest}",
+        loaded = load_version(version_dir,
                               max_batch=self.max_batch, warmup=True)
         with self._lock:
             self._versions[latest] = loaded
@@ -76,6 +89,9 @@ class ServedModel:
             for v in list(self._versions):
                 if v not in (latest, previous):
                     del self._versions[v]
+            resident = sorted(self._versions)
+        if remote.is_remote(self.base_path):
+            remote.prune_cache(self.base_path, resident)
         return True
 
     def get(self, version: Optional[int] = None) -> LoadedModel:

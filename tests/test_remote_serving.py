@@ -1,0 +1,128 @@
+"""Remote model_base_path (gs://-style) serving: fsspec scanner +
+download cache (serving/remote.py) behind ServedModel.poll_versions.
+
+The reference's primary flow served from GCS
+(tf-serving.libsonnet:110); here a fsspec ``memory://`` filesystem
+stands in for the object store, so the test exercises the exact
+protocol path (scan → materialize → load) with zero network."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.serving import remote
+from kubeflow_tpu.serving.export import export_model
+from kubeflow_tpu.serving.manager import ServedModel
+from kubeflow_tpu.serving.signature import (
+    ModelMetadata,
+    Signature,
+    TensorSpec,
+)
+
+fsspec = pytest.importorskip("fsspec")
+
+
+def _export_to_memory(base_url: str, version: int, tmp_path, seed=0):
+    """Export locally, then upload into the fake object store."""
+    local = tmp_path / f"stage-v{version}"
+    from kubeflow_tpu.models.resnet import resnet18ish
+
+    model = resnet18ish(num_classes=10)
+    variables = model.init(jax.random.PRNGKey(seed),
+                           jnp.zeros((1, 32, 32, 3), jnp.bfloat16),
+                           train=False)
+    metadata = ModelMetadata(
+        model_name="remotenet", registry_name="resnet-test",
+        model_kwargs={"num_classes": 10},
+        signatures={"serving_default": Signature(
+            method="predict",
+            inputs={"images": TensorSpec("float32", (-1, 32, 32, 3))},
+            outputs={"logits": TensorSpec("float32", (-1, 10))})})
+    export_model(str(local), version, metadata, variables)
+    fs, root = fsspec.core.url_to_fs(base_url)
+    for f in (local / str(version)).iterdir():
+        fs.put_file(str(f), f"{root}/{version}/{f.name}")
+
+
+@pytest.fixture()
+def mem_base(tmp_path, monkeypatch):
+    """A unique memory:// base path + isolated local cache root."""
+    monkeypatch.setenv("KFT_MODEL_CACHE", str(tmp_path / "cache"))
+    base = f"memory://models-{tmp_path.name}/remotenet"
+    yield base
+    fs, root = fsspec.core.url_to_fs(base)
+    try:
+        fs.rm(root, recursive=True)
+    except FileNotFoundError:
+        pass
+
+
+def test_is_remote():
+    assert remote.is_remote("gs://bucket/models/m")
+    assert remote.is_remote("s3://bucket/m")
+    assert remote.is_remote("memory://m")
+    assert not remote.is_remote("/var/models/m")
+    assert not remote.is_remote("relative/path")
+    assert not remote.is_remote("file:///var/models/m")
+
+
+def test_scan_latest_version_remote(mem_base, tmp_path):
+    assert remote.scan_latest_version(mem_base) == -1
+    _export_to_memory(mem_base, 1, tmp_path)
+    _export_to_memory(mem_base, 3, tmp_path)
+    assert remote.scan_latest_version(mem_base) == 3
+
+
+def test_materialize_downloads_and_caches(mem_base, tmp_path):
+    _export_to_memory(mem_base, 1, tmp_path)
+    local = remote.materialize(mem_base, 1)
+    import pathlib
+
+    p = pathlib.Path(local)
+    assert (p / "signature.json").is_file()
+    assert (p / "params.msgpack").is_file()
+    # Second call is a cache hit (same path, no re-download).
+    assert remote.materialize(mem_base, 1) == local
+    with pytest.raises(FileNotFoundError, match="missing or empty"):
+        remote.materialize(mem_base, 9)
+
+
+def test_served_model_from_remote_base_path(mem_base, tmp_path):
+    """The VERDICT's done-criterion: a model whose base path is not a
+    local directory string gets served."""
+    _export_to_memory(mem_base, 1, tmp_path)
+    served = ServedModel("remotenet", mem_base, max_batch=4)
+    assert served.poll_versions()
+    assert served.versions == [1]
+    future = served.submit(
+        {"images": np.zeros((2, 32, 32, 3), np.float32)},
+        None, None, None)
+    out = future.result(timeout=60)
+    assert out["logits"].shape == (2, 10)
+
+    # Hot reload: push v2 into the bucket, poll again.
+    _export_to_memory(mem_base, 2, tmp_path, seed=1)
+    assert served.poll_versions()
+    assert served.get().version == 2
+    assert served.get(1).version == 1  # previous stays resident
+    served.stop()
+
+
+def test_remote_cache_prunes_old_versions(mem_base, tmp_path):
+    import pathlib
+
+    for v in (1, 2, 3):
+        _export_to_memory(mem_base, v, tmp_path, seed=v)
+    served = ServedModel("remotenet", mem_base, max_batch=4)
+    assert served.poll_versions()  # loads 3 (latest)
+    local = remote.materialize(mem_base, 3)
+    cache_root = pathlib.Path(local).parent
+    # Manually materialize an old version, then prune to residents.
+    remote.materialize(mem_base, 1)
+    assert (cache_root / "1").is_dir()
+    remote.prune_cache(mem_base, served.versions)
+    assert not (cache_root / "1").exists()
+    assert (cache_root / "3").is_dir()
+    served.stop()
