@@ -36,36 +36,43 @@ class Decision(enum.IntEnum):
     RESTART_SLICE = 2
     SUCCEED = 3
     FAIL = 4
+    # Non-chief Succeeded while the chief is still non-terminal and no
+    # pod Failed: pod-status propagation skew on a normally-finishing
+    # job looks exactly like this, so re-observe instead of burning a
+    # slice restart. The reconciler counts consecutive holds and
+    # passes completion_grace=False once patience runs out.
+    HOLD_COMPLETION = 5
 
 
 if _LIB is not None:
     _LIB.kft_gang_decide.restype = ctypes.c_int
     _LIB.kft_gang_decide.argtypes = [
         ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
     ]
 
 
 def decide(phases: Sequence[PodPhase], chief_index: int, *,
            allow_restart: bool, restarts: int,
-           max_restarts: int) -> Decision:
+           max_restarts: int, completion_grace: bool = True) -> Decision:
     """Native gang decision; Python mirror if the .so isn't built."""
     if _LIB is not None:
         arr = (ctypes.c_int * len(phases))(*[int(p) for p in phases])
         return Decision(_LIB.kft_gang_decide(
             arr, len(phases), chief_index, int(allow_restart), restarts,
-            max_restarts))
+            max_restarts, int(completion_grace)))
     # Pure-Python mirror of native/kft_runtime.cc kft_gang_decide.
     if not phases or not (0 <= chief_index < len(phases)):
         return Decision.FAIL
     if phases[chief_index] == PodPhase.SUCCEEDED:
         return Decision.SUCCEED
-    any_failed = any(
-        p == PodPhase.FAILED
-        or (i != chief_index and p == PodPhase.SUCCEEDED)
-        for i, p in enumerate(phases)
-    )
-    if any_failed:
+    any_failed = any(p == PodPhase.FAILED for p in phases)
+    nonchief_succeeded = any(
+        i != chief_index and p == PodPhase.SUCCEEDED
+        for i, p in enumerate(phases))
+    if nonchief_succeeded and not any_failed and completion_grace:
+        return Decision.HOLD_COMPLETION
+    if any_failed or nonchief_succeeded:
         if allow_restart and restarts < max_restarts:
             return Decision.RESTART_SLICE
         return Decision.FAIL

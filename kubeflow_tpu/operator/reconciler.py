@@ -36,6 +36,11 @@ logger = logging.getLogger(__name__)
 
 COORDINATOR_PORT = 8476
 DEFAULT_MAX_RESTARTS = 3
+# Consecutive reconcile passes to re-observe a non-chief Succeeded
+# before calling it a slice fault (pod-status propagation skew on a
+# normally-finishing SPMD job shows exactly this signature; see
+# gang.Decision.HOLD_COMPLETION).
+DEFAULT_COMPLETION_GRACE_PASSES = 3
 JOB_LABEL = "kubeflow.org/tpujob"
 REPLICA_TYPE_LABEL = "kubeflow.org/replica-type"
 REPLICA_INDEX_LABEL = "kubeflow.org/replica-index"
@@ -76,9 +81,12 @@ def chief_member_index(job: Dict[str, Any],
 
 
 class Reconciler:
-    def __init__(self, api, *, max_restarts: int = DEFAULT_MAX_RESTARTS):
+    def __init__(self, api, *, max_restarts: int = DEFAULT_MAX_RESTARTS,
+                 completion_grace_passes: int =
+                 DEFAULT_COMPLETION_GRACE_PASSES):
         self.api = api
         self.max_restarts = max_restarts
+        self.completion_grace_passes = completion_grace_passes
 
     # -- object builders --------------------------------------------------
 
@@ -206,11 +214,21 @@ class Reconciler:
         ]
         allow_restart = job["spec"].get("recoveryPolicy",
                                         "restart-slice") == "restart-slice"
-        decision = decide(phases, chief, allow_restart=allow_restart,
-                          restarts=restarts, max_restarts=self.max_restarts)
+        skew_passes = int(status.get("completionSkewPasses", 0))
+        decision = decide(
+            phases, chief, allow_restart=allow_restart,
+            restarts=restarts, max_restarts=self.max_restarts,
+            completion_grace=skew_passes < self.completion_grace_passes)
         logger.info("tpujob %s/%s: phases=%s decision=%s", ns, name,
                     [p.name for p in phases], decision.name)
 
+        if decision == Decision.HOLD_COMPLETION:
+            # Completion skew observed: count the pass and re-observe
+            # next resync; once the counter hits the grace budget,
+            # decide() gets completion_grace=False and rules it a
+            # slice fault for real.
+            return self._set_status(job, phase, restart_count=restarts,
+                                    completion_skew=skew_passes + 1)
         if decision == Decision.CREATE_MISSING:
             # Gang creation is all-or-nothing: every missing pod is
             # created in this pass (no partial slices waiting on PS
@@ -253,6 +271,7 @@ class Reconciler:
 
     def _set_status(self, job: Dict[str, Any], phase: str, *,
                     restart_count: int = 0,
+                    completion_skew: int = 0,
                     reason: Optional[str] = None) -> str:
         name = job["metadata"]["name"]
         ns = job["metadata"].get("namespace", "default")
@@ -261,6 +280,8 @@ class Reconciler:
             status = obj.setdefault("status", {})
             status["phase"] = phase
             status["restartCount"] = restart_count
+            # Any non-hold decision resets the skew counter (writes 0).
+            status["completionSkewPasses"] = completion_skew
             if reason:
                 status["reason"] = reason
 

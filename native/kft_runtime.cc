@@ -162,6 +162,7 @@ int64_t kft_now_us() { return now_us(); }
 //
 // Pod phases:   0=missing 1=pending 2=running 3=succeeded 4=failed
 // Decisions:    0=none 1=create_missing 2=restart_slice 3=succeed 4=fail
+//               5=hold_completion
 
 enum KftPhase : int {
   KFT_MISSING = 0,
@@ -177,11 +178,12 @@ enum KftDecision : int {
   KFT_DECIDE_RESTART_SLICE = 2,
   KFT_DECIDE_SUCCEED = 3,
   KFT_DECIDE_FAIL = 4,
+  KFT_DECIDE_HOLD_COMPLETION = 5,
 };
 
 extern "C" int kft_gang_decide(const int* phases, int n, int chief_index,
                                int allow_restart, int restarts,
-                               int max_restarts) {
+                               int max_restarts, int completion_grace) {
   if (phases == nullptr || n <= 0 || chief_index < 0 || chief_index >= n) {
     return KFT_DECIDE_FAIL;
   }
@@ -192,15 +194,28 @@ extern "C" int kft_gang_decide(const int* phases, int n, int chief_index,
   if (phases[chief_index] == KFT_SUCCEEDED) return KFT_DECIDE_SUCCEED;
   bool any_failed = false;
   bool any_missing = false;
+  bool nonchief_succeeded = false;
   for (int i = 0; i < n; ++i) {
     if (phases[i] == KFT_FAILED) any_failed = true;
     if (phases[i] == KFT_MISSING) any_missing = true;
-    // A non-chief replica exiting "successfully" while the chief is
-    // still alive counts as a slice fault too: the collective lost a
-    // participant either way.
-    if (i != chief_index && phases[i] == KFT_SUCCEEDED) any_failed = true;
+    if (i != chief_index && phases[i] == KFT_SUCCEEDED) {
+      nonchief_succeeded = true;
+    }
   }
-  if (any_failed) {
+  // A non-chief replica exiting "successfully" while the chief is
+  // still alive is AMBIGUOUS: in SPMD all workers exit together, but
+  // pod-status propagation is not atomic — a reconcile pass can see
+  // worker-1 Succeeded while the chief still reads Running moments
+  // before it too flips to Succeeded. Restarting immediately would
+  // burn slice restarts on normally-finishing jobs, so while the
+  // caller still has completion grace (consecutive re-observations
+  // tracked by the reconciler) and no pod actually FAILED, hold and
+  // re-observe. Once grace is exhausted — or a real failure is
+  // present — the lost collective participant is a slice fault.
+  if (nonchief_succeeded && !any_failed && completion_grace > 0) {
+    return KFT_DECIDE_HOLD_COMPLETION;
+  }
+  if (any_failed || nonchief_succeeded) {
     if (allow_restart && restarts < max_restarts) {
       return KFT_DECIDE_RESTART_SLICE;
     }

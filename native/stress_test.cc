@@ -24,7 +24,8 @@ int kft_queue_size(void* handle);
 int kft_queue_pop_batch(void* handle, uint64_t* out, int max_n,
                         int64_t timeout_us, int64_t window_us);
 int kft_gang_decide(const int* phases, int n, int chief_index,
-                    int allow_restart, int restarts, int max_restarts);
+                    int allow_restart, int restarts, int max_restarts,
+                    int completion_grace);
 }
 
 namespace {
@@ -134,15 +135,42 @@ void gang_decide_fuzz() {
     for (auto& p : phases) p = phase_dist(rng);
     const int chief = static_cast<int>(rng() % n);
     const int restarts = static_cast<int>(rng() % 5);
+    const int grace = static_cast<int>(rng() % 2);
     const int decision =
-        kft_gang_decide(phases.data(), n, chief, 1, restarts, 3);
-    assert(decision >= 0 && decision <= 4);
+        kft_gang_decide(phases.data(), n, chief, 1, restarts, 3, grace);
+    assert(decision >= 0 && decision <= 5);
     if (phases[chief] == 3) assert(decision == 3);  // chief success wins
+    // The completion-skew invariants: with grace, a non-chief success
+    // and no failed pod must HOLD (5), never restart/fail; without
+    // grace it must never HOLD.
+    bool any_failed = false, nonchief_ok = false;
+    for (int i = 0; i < n; ++i) {
+      if (phases[i] == 4) any_failed = true;
+      if (i != chief && phases[i] == 3) nonchief_ok = true;
+    }
+    if (phases[chief] != 3 && nonchief_ok && !any_failed) {
+      assert(decision == (grace ? 5 : (restarts < 3 ? 2 : 4)));
+    }
+    if (!grace) assert(decision != 5);
   }
+  // The staggered-completion scenario that used to burn restarts:
+  // worker-1 Succeeded while chief worker-0 still Running must HOLD
+  // with grace and only become a restart once grace is exhausted.
+  int staggered[4] = {2, 3, 2, 2};
+  assert(kft_gang_decide(staggered, 4, 0, 1, 0, 3, 1) == 5);
+  assert(kft_gang_decide(staggered, 4, 0, 1, 0, 3, 0) == 2);
+  // ...and once the chief catches up, success wins regardless.
+  staggered[0] = 3;
+  assert(kft_gang_decide(staggered, 4, 0, 1, 0, 3, 1) == 3);
+  assert(kft_gang_decide(staggered, 4, 0, 1, 0, 3, 0) == 3);
+  // A real failure never holds, grace or not.
+  int failed[4] = {2, 3, 4, 2};
+  assert(kft_gang_decide(failed, 4, 0, 1, 0, 3, 1) == 2);
+  assert(kft_gang_decide(failed, 4, 0, 1, 3, 3, 1) == 4);
   // Hostile inputs must not crash.
-  assert(kft_gang_decide(nullptr, 4, 0, 1, 0, 3) == 4);
+  assert(kft_gang_decide(nullptr, 4, 0, 1, 0, 3, 1) == 4);
   int one = 2;
-  assert(kft_gang_decide(&one, 1, 5, 1, 0, 3) == 4);
+  assert(kft_gang_decide(&one, 1, 5, 1, 0, 3, 1) == 4);
   std::printf("gang_decide_fuzz ok\n");
 }
 

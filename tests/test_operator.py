@@ -48,11 +48,22 @@ def test_gang_decide_chief_success_wins():
                   restarts=0, max_restarts=3) == Decision.SUCCEED
 
 
-def test_gang_decide_nonchief_success_is_fault():
+def test_gang_decide_nonchief_success_holds_then_faults():
     P = PodPhase
-    # A non-chief exiting while chief still runs breaks the collective.
+    # A non-chief exiting while chief still runs is AMBIGUOUS —
+    # completion skew on a finishing job or a genuine early exit. With
+    # grace: hold and re-observe; with grace exhausted: it broke the
+    # collective, restart.
     assert decide([P.RUNNING, P.SUCCEEDED], 0, allow_restart=True,
-                  restarts=0, max_restarts=3) == Decision.RESTART_SLICE
+                  restarts=0, max_restarts=3,
+                  completion_grace=True) == Decision.HOLD_COMPLETION
+    assert decide([P.RUNNING, P.SUCCEEDED], 0, allow_restart=True,
+                  restarts=0, max_restarts=3,
+                  completion_grace=False) == Decision.RESTART_SLICE
+    # A real pod failure never holds, grace or not.
+    assert decide([P.RUNNING, P.SUCCEEDED, P.FAILED], 0,
+                  allow_restart=True, restarts=0, max_restarts=3,
+                  completion_grace=True) == Decision.RESTART_SLICE
 
 
 def test_gang_decide_restart_budget():
@@ -118,6 +129,62 @@ def test_running_then_chief_success_cleans_up():
     assert r.reconcile(job) == "Succeeded"
     # terminal: no further reconcile effects
     assert r.reconcile(api.get("TPUJob", "default", "job1")) == "Succeeded"
+
+
+def test_staggered_completion_does_not_burn_restarts():
+    """Pod-status propagation is not atomic: a reconcile pass that
+    sees worker-1 Succeeded while chief worker-0 still reads Running
+    must NOT restart the slice (the round-2 verdict's completion
+    race). The job must end Succeeded with restartCount == 0."""
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=2))
+    r = Reconciler(api)
+    r.reconcile(job)
+    api.set_all_pod_phases("default", "Running", {JOB_LABEL: "job1"})
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Running"
+
+    # Worker 1's status lands first; chief still Running.
+    api.set_pod_phase("default", "job1-tpu-worker-1", "Succeeded")
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Running"  # held, not restarted
+    job = api.get("TPUJob", "default", "job1")
+    assert job["status"]["restartCount"] == 0
+    assert job["status"]["completionSkewPasses"] == 1
+    # Both pods still exist — nothing was deleted.
+    assert len(api.list("Pod", "default", {JOB_LABEL: "job1"})) == 2
+
+    # Chief's status catches up on the next pass → clean success.
+    api.set_pod_phase("default", "job1-tpu-worker-0", "Succeeded")
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Succeeded"
+    job = api.get("TPUJob", "default", "job1")
+    assert job["status"]["restartCount"] == 0
+
+
+def test_completion_grace_exhaustion_is_a_slice_fault():
+    """A worker that really did exit early (chief keeps Running well
+    past the grace window) is a slice fault: collectives lost a
+    participant, so the gang restarts once patience runs out."""
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=2))
+    r = Reconciler(api, completion_grace_passes=3)
+    r.reconcile(job)
+    api.set_all_pod_phases("default", "Running", {JOB_LABEL: "job1"})
+    job = api.get("TPUJob", "default", "job1")
+    r.reconcile(job)
+    api.set_pod_phase("default", "job1-tpu-worker-1", "Succeeded")
+    for expected_skew in (1, 2, 3):
+        job = api.get("TPUJob", "default", "job1")
+        assert r.reconcile(job) == "Running"
+        job = api.get("TPUJob", "default", "job1")
+        assert job["status"]["completionSkewPasses"] == expected_skew
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Restarting"
+    job = api.get("TPUJob", "default", "job1")
+    assert job["status"]["restartCount"] == 1
+    # The hold counter resets on the non-hold decision.
+    assert job["status"]["completionSkewPasses"] == 0
 
 
 def test_slice_restart_on_worker_failure():
