@@ -76,6 +76,7 @@ def spmd_pipeline(
     mesh: Mesh,
     n_microbatches: int,
     axis_name: str = "pipeline",
+    batch_axis: str = None,
 ) -> jax.Array:
     """Run ``x`` through ``n_stages`` copies of ``stage_fn``.
 
@@ -83,6 +84,10 @@ def spmd_pipeline(
     ``n_stages`` dimension (stage i's slice feeds stage i) — sharded
     over the pipeline axis so each device holds only its stage.
     ``x``: [batch, ...]; batch must divide by ``n_microbatches``.
+    ``batch_axis``: optional mesh axis (e.g. ``"data"``) the
+    microbatch rows are sharded over — pp×dp composition: each
+    data-coordinate pipelines its own rows instead of redundantly
+    recomputing the full batch.
     Output has the same shape as ``x`` run through all stages in order.
     """
     n_stages = mesh.shape[axis_name]
@@ -90,9 +95,14 @@ def spmd_pipeline(
     if batch % n_microbatches:
         raise ValueError(f"batch {batch} % microbatches {n_microbatches}")
     mb = batch // n_microbatches
+    if batch_axis is not None and mb % mesh.shape[batch_axis]:
+        raise ValueError(
+            f"microbatch rows {mb} % {batch_axis} axis "
+            f"{mesh.shape[batch_axis]}")
     microbatches = x.reshape((n_microbatches, mb) + x.shape[1:])
 
     param_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    mb_spec = P(None, batch_axis) if batch_axis else P()
 
     def inner(params, mbs):
         params = jax.tree.map(lambda p: p[0], params)  # squeeze stage dim
@@ -101,8 +111,8 @@ def spmd_pipeline(
     out = jax.shard_map(
         inner,
         mesh=mesh,
-        in_specs=(param_spec, P()),
-        out_specs=P(),
+        in_specs=(param_spec, mb_spec),
+        out_specs=mb_spec,
         check_vma=False,
     )(stacked_params, microbatches)
     del n_stages

@@ -1,0 +1,240 @@
+"""Pipeline-parallel LM training: real decoder blocks on the
+``pipeline`` mesh axis.
+
+This is the trainer-layer integration of :func:`spmd_pipeline`
+(SURVEY §2.5: pipeline parallelism "as sharding presets in the new
+trainer layer", not an orphan primitive): a Llama model's decoder
+blocks are partitioned into contiguous stage groups, each stage's
+layer params are stacked and sharded over the pipeline axis, and the
+GPipe schedule streams microbatches stage→stage over ``ppermute``
+while the ``data`` axis shards microbatch rows (pp × dp composition).
+
+Embedding, final norm and lm head run outside the pipeline (they are
+a tiny fraction of FLOPs and live replicated); the stage function
+``lax.scan``s the per-stage layers so every stage runs literally the
+same block code the unpipelined :class:`~kubeflow_tpu.models.llama.
+Llama` runs — which is what makes the numerical-equality test
+against the unpipelined model possible (tests/test_pipeline_lm.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models.llama import Llama, LlamaBlock, RMSNorm, _dense
+from kubeflow_tpu.parallel.pipeline import spmd_pipeline, stack_stage_params
+from kubeflow_tpu.training.lm import LOSSES, Batch
+
+PIPELINE_AXIS = "pipeline"
+
+
+class PipelineLMState(struct.PyTreeNode):
+    """Step + staged params + optimizer state."""
+
+    step: jax.Array
+    params: Dict[str, Any]  # {tok_embed, stages, final_norm, lm_head}
+    opt_state: optax.OptState
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+
+def partition_llama_params(params: Dict[str, Any],
+                           n_stages: int) -> Dict[str, Any]:
+    """Regroup a flat Llama param tree into the staged layout.
+
+    ``layer_i`` subtrees are stacked into contiguous stage groups:
+    leaves of ``stages`` get shape [n_stages, layers_per_stage, ...].
+    """
+    layer_keys = sorted(
+        (k for k in params if k.startswith("layer_")),
+        key=lambda k: int(k.split("_")[1]))
+    n_layers = len(layer_keys)
+    if n_layers == 0:
+        raise ValueError("param tree has no layer_<i> subtrees")
+    if n_layers % n_stages:
+        raise ValueError(
+            f"{n_layers} layers not divisible into {n_stages} stages")
+    per = n_layers // n_stages
+    stage_trees = []
+    for s in range(n_stages):
+        group = [params[layer_keys[s * per + j]] for j in range(per)]
+        stage_trees.append(jax.tree.map(lambda *xs: jnp.stack(xs), *group))
+    return {
+        "tok_embed": params["tok_embed"],
+        "stages": stack_stage_params(stage_trees),
+        "final_norm": params["final_norm"],
+        "lm_head": params["lm_head"],
+    }
+
+
+def _block_for(model: Llama) -> LlamaBlock:
+    if model.num_experts or model.cache_size or model.lora_rank:
+        raise ValueError(
+            "pipeline trainer supports dense training blocks only "
+            "(no MoE/cache/LoRA) — compose ep or LoRA with dp/fsdp/tp "
+            "presets instead")
+    return LlamaBlock(
+        model.num_heads, model.num_kv_heads,
+        model.d_model // model.num_heads, model.mlp_dim,
+        model.rope_theta, model.dtype, model.attention_fn)
+
+
+def staged_llama_forward(
+    model: Llama,
+    params: Dict[str, Any],
+    input_ids: jax.Array,
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+    batch_axis: Optional[str] = "data",
+) -> jax.Array:
+    """Forward pass equal to ``model.apply`` on the unstaged params
+    (same block code, same math), with the block stack pipelined."""
+    x = jnp.take(params["tok_embed"]["embedding"], input_ids,
+                 axis=0).astype(model.dtype)
+    block = _block_for(model)
+
+    def stage_fn(stage_params, h):
+        mb, length = h.shape[0], h.shape[1]
+        pos = jnp.broadcast_to(jnp.arange(length)[None, :], (mb, length))
+
+        def body(carry, layer_params):
+            return block.apply({"params": layer_params}, carry, pos), None
+
+        h, _ = jax.lax.scan(body, h, stage_params)
+        return h
+
+    x = spmd_pipeline(stage_fn, params["stages"], x, mesh=mesh,
+                      n_microbatches=n_microbatches,
+                      batch_axis=batch_axis)
+    x = RMSNorm(dtype=model.dtype).apply(
+        {"params": params["final_norm"]}, x)
+    return _dense(model.vocab_size, ("embed", "vocab"),
+                  jnp.float32).apply(
+        {"params": params["lm_head"]}, x.astype(jnp.float32))
+
+
+def pipeline_state_shardings(mesh: Mesh,
+                             state: PipelineLMState) -> PipelineLMState:
+    """stages over the pipeline axis; embed/norm/head + moments of
+    each follow their param's sharding; scalars replicated."""
+    replicated = NamedSharding(mesh, P())
+    stage_sh = NamedSharding(mesh, P(PIPELINE_AXIS))
+
+    def shard_params(tree):
+        return {
+            "tok_embed": jax.tree.map(lambda _: replicated,
+                                      tree["tok_embed"]),
+            "stages": jax.tree.map(lambda _: stage_sh, tree["stages"]),
+            "final_norm": jax.tree.map(lambda _: replicated,
+                                       tree["final_norm"]),
+            "lm_head": jax.tree.map(lambda _: replicated,
+                                    tree["lm_head"]),
+        }
+
+    params_sh = shard_params(state.params)
+
+    def opt_sharding(leaf_tree):
+        # Optimizer state mirrors the param tree wherever its subtree
+        # structure matches (adam mu/nu do); scalars replicate.
+        def match(entry):
+            if (isinstance(entry, dict)
+                    and set(entry) == set(state.params)):
+                return shard_params(entry)
+            return jax.tree.map(lambda _: replicated, entry)
+
+        return jax.tree.map(
+            match, leaf_tree,
+            is_leaf=lambda e: (isinstance(e, dict)
+                               and set(e) == set(state.params)))
+
+    return PipelineLMState(
+        step=replicated,
+        params=params_sh,
+        opt_state=opt_sharding(state.opt_state),
+        tx=state.tx,
+    )
+
+
+def create_pipeline_lm_state(
+    model: Llama,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    sample_batch: Batch,
+    mesh: Mesh,
+    n_stages: Optional[int] = None,
+) -> Tuple[PipelineLMState, PipelineLMState]:
+    """Init a staged state + its sharding tree.
+
+    ``n_stages`` defaults to the mesh's pipeline-axis size.
+    """
+    n_stages = n_stages or mesh.shape[PIPELINE_AXIS]
+    if n_stages != mesh.shape[PIPELINE_AXIS]:
+        raise ValueError(
+            f"n_stages {n_stages} != mesh pipeline axis "
+            f"{mesh.shape[PIPELINE_AXIS]}")
+    variables = jax.jit(model.init)(rng, sample_batch["input_ids"])
+    params = partition_llama_params(
+        nn.meta.unbox(variables["params"]), n_stages)
+    state = PipelineLMState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        tx=tx,
+    )
+    shardings = pipeline_state_shardings(mesh, state)
+    state = jax.device_put(state, shardings)
+    return state, shardings
+
+
+def make_pipeline_lm_train_step(
+    mesh: Mesh,
+    shardings: PipelineLMState,
+    model: Llama,
+    *,
+    n_microbatches: int = 4,
+    objective: str = "causal",
+    donate: bool = True,
+):
+    """The ``pipeline=N`` trainer preset: jitted (state, batch) →
+    (state, metrics) with the block stack on the pipeline axis and
+    batch rows on the data axis."""
+    loss_fn = LOSSES[objective]
+
+    def step(state: PipelineLMState, batch: Batch):
+        def compute(params):
+            logits = staged_llama_forward(
+                model, params, batch["input_ids"], mesh=mesh,
+                n_microbatches=n_microbatches)
+            loss, acc = loss_fn(logits, batch)
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(
+            compute, has_aux=True)(state.params)
+        updates, new_opt = state.tx.update(grads, state.opt_state,
+                                           state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss,
+            "accuracy": acc,
+            "grad_norm": optax.global_norm(grads),
+        }
+        return (
+            state.replace(step=state.step + 1, params=new_params,
+                          opt_state=new_opt),
+            metrics,
+        )
+
+    batch_sh = NamedSharding(mesh, P(("dcn_data", "data", "fsdp")))
+    return jax.jit(
+        step,
+        in_shardings=(shardings, batch_sh),
+        out_shardings=(shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,) if donate else (),
+    )

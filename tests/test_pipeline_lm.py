@@ -1,0 +1,126 @@
+"""Pipeline parallelism through a REAL model (round-2 verdict #4):
+staged Llama on a (data × pipeline) mesh must reproduce the
+unpipelined model's loss and train."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kubeflow_tpu.models.llama import llama_test
+from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+from kubeflow_tpu.training.lm import (
+    create_lm_state,
+    make_lm_train_step,
+    place_lm_batch,
+)
+from kubeflow_tpu.training.pipeline_lm import (
+    create_pipeline_lm_state,
+    make_pipeline_lm_train_step,
+    partition_llama_params,
+    staged_llama_forward,
+)
+
+VOCAB = 512
+
+
+def _model():
+    # 2 layers → 2 stages × 1 layer; fp32 so the equality check is
+    # tight.
+    return llama_test(dtype="float32")
+
+
+def _batch(rows=8, length=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"input_ids": jnp.asarray(
+        rng.randint(0, VOCAB, (rows, length)), jnp.int32)}
+
+
+def test_staged_forward_matches_unpipelined():
+    model = _model()
+    batch = _batch()
+    variables = model.init(jax.random.PRNGKey(0), batch["input_ids"])
+    import flax.linen as nn
+
+    params = nn.meta.unbox(variables["params"])
+    want = model.apply({"params": params}, batch["input_ids"])
+
+    mesh = build_mesh(MeshSpec(data=2, pipeline=2),
+                      jax.devices("cpu")[:4])
+    staged = partition_llama_params(params, 2)
+    got = jax.jit(lambda p, x: staged_llama_forward(
+        model, p, x, mesh=mesh, n_microbatches=2))(
+        staged, batch["input_ids"])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_partition_llama_params_validates():
+    model = _model()
+    batch = _batch()
+    import flax.linen as nn
+
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), batch["input_ids"])["params"])
+    with pytest.raises(ValueError, match="not divisible"):
+        partition_llama_params(params, 3)
+    staged = partition_llama_params(params, 2)
+    # leaves of stages: [n_stages=2, layers_per_stage=1, ...]
+    leaf = jax.tree.leaves(staged["stages"])[0]
+    assert leaf.shape[0] == 2 and leaf.shape[1] == 1
+
+
+def test_pipeline_train_step_matches_unpipelined_loss():
+    """Same init, same batch: the pp train step's first-step loss and
+    the dp-only train step's first-step loss must agree."""
+    model = _model()
+    batch = _batch(rows=8, length=16)
+    tx = optax.sgd(0.0)  # lr 0: isolate the loss computation
+
+    mesh = build_mesh(MeshSpec(data=2, pipeline=2),
+                      jax.devices("cpu")[:4])
+    pstate, pshard = create_pipeline_lm_state(
+        model, tx, jax.random.PRNGKey(0), batch, mesh)
+    pstep = make_pipeline_lm_train_step(mesh, pshard, model,
+                                        n_microbatches=2, donate=False)
+    pstate, pmetrics = pstep(pstate, place_lm_batch(mesh, batch))
+
+    ref_state, _ = create_lm_state(
+        model, tx, jax.random.PRNGKey(0), batch)
+    ref_step = make_lm_train_step(None, None, objective="causal",
+                                  donate=False)
+    _, ref_metrics = ref_step(ref_state, batch)
+
+    assert int(pstate.step) == 1
+    np.testing.assert_allclose(float(pmetrics["loss"]),
+                               float(ref_metrics["loss"]),
+                               rtol=2e-4)
+    np.testing.assert_allclose(float(pmetrics["grad_norm"]),
+                               float(ref_metrics["grad_norm"]),
+                               rtol=2e-3)
+
+
+def test_pipeline_training_reduces_loss():
+    model = _model()
+    batch = _batch(rows=8, length=16)
+    mesh = build_mesh(MeshSpec(data=2, pipeline=2),
+                      jax.devices("cpu")[:4])
+    state, shardings = create_pipeline_lm_state(
+        model, optax.adamw(5e-3), jax.random.PRNGKey(0), batch, mesh)
+    step = make_pipeline_lm_train_step(mesh, shardings, model,
+                                       n_microbatches=2, donate=False)
+    placed = place_lm_batch(mesh, batch)
+    _, first = step(state, placed)
+    for _ in range(10):
+        state, metrics = step(state, placed)
+    assert float(metrics["loss"]) < float(first["loss"])
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_pipeline_rejects_unsupported_blocks():
+    from kubeflow_tpu.training.pipeline_lm import _block_for
+
+    with pytest.raises(ValueError, match="dense training blocks"):
+        _block_for(llama_test(lora_rank=4))
