@@ -60,8 +60,18 @@ def create_train_state(
 
 def state_sharding(mesh: Mesh, state: TrainState) -> TrainState:
     """Sharding tree matching a TrainState: fsdp-shard params and
-    optimizer moments, replicate scalars and BN stats."""
-    params_sh = fsdp_params_sharding(mesh, state.params)
+    optimizer moments, replicate scalars and BN stats.
+
+    min_weight_size is raised to 2^18 for conv nets: fsdp-sharding the
+    small late-stage 1×1 conv kernels saves <1 MB/device but their
+    kernel-grad computation (batch-sharded dy → channel-sharded grad,
+    with spatial collapsed to 1×1) hits a GSPMD resharding cliff —
+    "Involuntary full rematerialization", measured on the dcn×dp×fsdp
+    dryrun layout. Replicating them removes the transition entirely;
+    the large kernels that actually dominate memory stay sharded.
+    """
+    params_sh = fsdp_params_sharding(mesh, state.params,
+                                     min_weight_size=2 ** 18)
     replicated = NamedSharding(mesh, P())
 
     return TrainState(
