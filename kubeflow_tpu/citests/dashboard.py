@@ -48,7 +48,39 @@ def check_dashboard(base_url: str, *, retries: int = 30,
     with urllib.request.urlopen(f"{base_url}/tpujobs/ui/", timeout=10) as r:
         page = r.read().decode()
         assert "TPUJobs" in page
+        assert "/tpujobs/ui/create" in page  # the create form is served
     logger.info("dashboard ok: %d job(s) listed", len(payload["items"]))
+
+
+def check_write_path(base_url: str) -> None:
+    """Create → read back → delete, over the wire (the reference UI's
+    job lifecycle, tf-job.libsonnet:271-458)."""
+    from kubeflow_tpu.manifests.tpujob import replica_spec, tpu_job
+
+    job = tpu_job(
+        "citest-created", "default",
+        [replica_spec("TPU_WORKER", 2,
+                      image="ghcr.io/kubeflow-tpu/trainer:v0.1.0",
+                      tpu_accelerator="tpu-v5-lite-podslice",
+                      tpu_topology="2x4")],
+        termination={"chief": {"replicaName": "TPU_WORKER",
+                               "replicaIndex": 0}})
+    req = urllib.request.Request(
+        f"{base_url}/tpujobs/api/tpujob", data=json.dumps(job).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 201, r.status
+    with urllib.request.urlopen(
+            f"{base_url}/tpujobs/api/tpujob/default/citest-created",
+            timeout=10) as r:
+        detail = json.load(r)
+        assert detail["summary"]["name"] == "citest-created"
+    req = urllib.request.Request(
+        f"{base_url}/tpujobs/api/tpujob/default/citest-created",
+        method="DELETE")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+    logger.info("dashboard write path ok: create → get → delete")
 
 
 def run_fake(port: int = 19402) -> None:
@@ -68,6 +100,7 @@ def run_fake(port: int = 19402) -> None:
             raise AssertionError("dashboard never became healthy")
         check_dashboard(f"http://127.0.0.1:{port}", retries=3,
                         retry_delay_s=1.0)
+        check_write_path(f"http://127.0.0.1:{port}")
     finally:
         proc.kill()
 

@@ -1,14 +1,19 @@
-"""TPUJob dashboard: REST + HTML view of TPUJobs in the cluster.
+"""TPUJob dashboard: REST + HTML view AND write path for TPUJobs.
 
 The reference deployed a TFJob dashboard backend + UI behind Ambassador
 at ``/tfjobs/ui/`` (``kubeflow/core/tf-job.libsonnet:271-458``, backend
-``/opt/tensorflow_k8s/dashboard/backend`` on :8080). This is its
-TPUJob equivalent: one process serving
+``/opt/tensorflow_k8s/dashboard/backend`` on :8080) that could CREATE
+and DELETE jobs, not just list them. This is its TPUJob equivalent:
 
-  GET /tpujobs/ui/                    HTML job table
-  GET /tpujobs/api/tpujob             all TPUJobs (JSON)
-  GET /tpujobs/api/tpujob/<ns>/<name> one TPUJob + its gang pods
-  GET /healthz
+  GET    /tpujobs/ui/                    HTML job table + create form
+  POST   /tpujobs/ui/create              form-encoded create
+  GET    /tpujobs/api/tpujob             all TPUJobs (JSON)
+  POST   /tpujobs/api/tpujob             create (full TPUJob CR JSON,
+                                         validated against the CRD's
+                                         openAPIV3 schema)
+  GET    /tpujobs/api/tpujob/<ns>/<name> one TPUJob + its gang pods
+  DELETE /tpujobs/api/tpujob/<ns>/<name> delete the job + its gang
+  GET    /healthz
 
 against either a real apiserver (kubectl shim) or the in-repo fake
 (hermetic citest). Deployed by ``manifests/tpujob.py`` as the
@@ -66,6 +71,40 @@ class HealthHandler(BaseHandler):
         self.write_json({"status": "ok"})
 
 
+def _create_error_code(exc: Exception) -> int:
+    """409 only for genuine already-exists conflicts; any other
+    apiserver failure (outage, RBAC) is a 502 so clients retry
+    instead of concluding the job exists."""
+    from kubeflow_tpu.operator.fake import Conflict
+
+    if isinstance(exc, Conflict) or "AlreadyExists" in str(exc) \
+            or "already exists" in str(exc):
+        return 409
+    return 502
+
+
+def validate_tpujob(job: Any) -> list:
+    """CRD-schema validation for a submitted CR; returns error list."""
+    from kubeflow_tpu.manifests.tpujob import GROUP, VERSION, crd
+    from kubeflow_tpu.utils.openapi import crd_openapi_schema, validate
+
+    if not isinstance(job, dict):
+        return ["body must be a JSON object (a TPUJob CR)"]
+    errors = []
+    if job.get("kind") != KIND:
+        errors.append(f"kind must be {KIND!r}, got {job.get('kind')!r}")
+    want_api = f"{GROUP}/{VERSION}"
+    if job.get("apiVersion") != want_api:
+        errors.append(f"apiVersion must be {want_api!r}, "
+                      f"got {job.get('apiVersion')!r}")
+    if not job.get("metadata", {}).get("name"):
+        errors.append("metadata.name is required")
+    if not job.get("spec", {}).get("replicaSpecs"):
+        errors.append("spec.replicaSpecs must be non-empty")
+    errors += validate(job, crd_openapi_schema(crd()))
+    return errors
+
+
 class JobListHandler(BaseHandler):
     async def get(self):
         # Apiserver access shells out to kubectl in the real client;
@@ -73,6 +112,25 @@ class JobListHandler(BaseHandler):
         jobs = await tornado.ioloop.IOLoop.current().run_in_executor(
             None, self.api.list, KIND)
         self.write_json({"items": [job_summary(j) for j in jobs]})
+
+    async def post(self):
+        """Create a TPUJob from a full CR (the reference UI's create
+        path, tf-job.libsonnet:271-458 — here schema-validated)."""
+        try:
+            job = json.loads(self.request.body or b"null")
+        except json.JSONDecodeError:
+            return self.write_json({"error": "body is not valid JSON"}, 400)
+        errors = validate_tpujob(job)
+        if errors:
+            return self.write_json({"error": "invalid TPUJob",
+                                    "details": errors}, 400)
+        job.setdefault("metadata", {}).setdefault("namespace", "default")
+        loop = tornado.ioloop.IOLoop.current()
+        try:
+            created = await loop.run_in_executor(None, self.api.create, job)
+        except Exception as e:  # noqa: BLE001 — apiserver-side failure
+            return self.write_json({"error": str(e)}, _create_error_code(e))
+        self.write_json({"created": job_summary(created)}, 201)
 
 
 class JobDetailHandler(BaseHandler):
@@ -98,6 +156,36 @@ class JobDetailHandler(BaseHandler):
         self.write_json({"job": job, "summary": job_summary(job),
                          "pods": pods})
 
+    async def delete(self, namespace: str, name: str):
+        """Delete the job AND its gang pods (the operator only
+        reconciles live jobs; a deleted CR's pods must not linger)."""
+        from kubeflow_tpu.operator.fake import NotFound
+
+        loop = tornado.ioloop.IOLoop.current()
+        try:
+            await loop.run_in_executor(
+                None, self.api.delete, KIND, namespace, name)
+        except NotFound:
+            return self.write_json(
+                {"error": f"{KIND} {namespace}/{name} not found"}, 404)
+        pods = await loop.run_in_executor(
+            None, lambda: self.api.list(
+                "Pod", namespace, label_selector={JOB_LABEL: name}))
+        for pod in pods:
+            try:
+                await loop.run_in_executor(
+                    None, self.api.delete, "Pod", namespace,
+                    pod["metadata"]["name"])
+            except NotFound:
+                pass
+        try:
+            await loop.run_in_executor(
+                None, self.api.delete, "Service", namespace, name)
+        except NotFound:
+            pass
+        self.write_json({"deleted": f"{namespace}/{name}",
+                         "pods_deleted": len(pods)})
+
 
 _PHASE_COLORS = {
     "Running": "#1a7f37", "Succeeded": "#0969da", "Pending": "#9a6700",
@@ -122,6 +210,20 @@ _PAGE = """<!doctype html>
 {rows}
 </table>
 <p>{count} job(s). JSON: <a href="/tpujobs/api/tpujob">/tpujobs/api/tpujob</a></p>
+<h2>Create TPUJob</h2>
+<form method="post" action="/tpujobs/ui/create">
+ <label>Name <input name="name" required pattern="[a-z0-9-]+"></label>
+ <label>Namespace <input name="namespace" value="default"></label>
+ <label>Workers <input name="workers" type="number" value="2" min="1"></label>
+ <label>Image <input name="image"
+   value="ghcr.io/kubeflow-tpu/trainer:v0.1.0" size="40"></label>
+ <label>Accelerator <input name="tpu_accelerator"
+   value="tpu-v5-lite-podslice"></label>
+ <label>Topology <input name="tpu_topology" value="2x4"></label>
+ <label>Command <input name="command" size="40"
+   placeholder="python -m kubeflow_tpu.training.launcher"></label>
+ <button type="submit">Create</button>
+</form>
 </body></html>
 """
 
@@ -152,12 +254,54 @@ class UIHandler(BaseHandler):
         self.finish(_PAGE.format(rows="\n".join(rows), count=len(jobs)))
 
 
+class UICreateHandler(BaseHandler):
+    """Form-encoded create: builds the CR through the same manifest
+    builders the CLI prototypes use, then the validated create path."""
+
+    async def post(self):
+        from kubeflow_tpu.manifests.tpujob import replica_spec, tpu_job
+
+        name = self.get_body_argument("name", "")
+        namespace = self.get_body_argument("namespace", "default")
+        try:
+            workers = int(self.get_body_argument("workers", "2"))
+        except ValueError:
+            return self.write_json({"error": "workers must be an int"}, 400)
+        command = self.get_body_argument("command", "").split() or None
+        job = tpu_job(
+            name, namespace,
+            [replica_spec(
+                "TPU_WORKER", workers,
+                image=self.get_body_argument(
+                    "image", "ghcr.io/kubeflow-tpu/trainer:v0.1.0"),
+                command=command,
+                tpu_accelerator=self.get_body_argument(
+                    "tpu_accelerator", "tpu-v5-lite-podslice"),
+                tpu_topology=self.get_body_argument(
+                    "tpu_topology", "2x4"),
+            )],
+            termination={"chief": {"replicaName": "TPU_WORKER",
+                                   "replicaIndex": 0}},
+        )
+        errors = validate_tpujob(job)
+        if errors:
+            return self.write_json({"error": "invalid TPUJob",
+                                    "details": errors}, 400)
+        loop = tornado.ioloop.IOLoop.current()
+        try:
+            await loop.run_in_executor(None, self.api.create, job)
+        except Exception as e:  # noqa: BLE001
+            return self.write_json({"error": str(e)}, _create_error_code(e))
+        self.redirect("/tpujobs/ui/")
+
+
 def make_app(api) -> tornado.web.Application:
     return tornado.web.Application([
         (r"/healthz", HealthHandler),
         (r"/tpujobs/api/tpujob", JobListHandler),
         (r"/tpujobs/api/tpujob/([^/]+)/([^/]+)", JobDetailHandler),
         (r"/tpujobs/ui/?", UIHandler),
+        (r"/tpujobs/ui/create", UICreateHandler),
         (r"/", tornado.web.RedirectHandler, {"url": "/tpujobs/ui/"}),
     ], api=api)
 

@@ -1,0 +1,62 @@
+"""Minimal openAPIV3 schema validation (the subset the TPUJob CRD
+uses: type/properties/required/items/enum/minimum).
+
+Shared by the dashboard's create path (reject a malformed CR before
+it reaches the apiserver — the reference UI's backend validated
+submissions, ``kubeflow/core/tf-job.libsonnet:271-458``) and the
+checked-in-example tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+
+def validate(instance: Any, schema: Dict[str, Any],
+             path: str = "$") -> List[str]:
+    """Returns a list of human-readable error strings ([] = valid)."""
+    errors: List[str] = []
+    t = schema.get("type")
+    if t == "object":
+        if not isinstance(instance, dict):
+            return [f"{path}: expected object, got {type(instance).__name__}"]
+        for key, sub in schema.get("properties", {}).items():
+            if key in instance:
+                errors += validate(instance[key], sub, f"{path}.{key}")
+        for key in schema.get("required", []):
+            if key not in instance:
+                errors.append(f"{path}: missing required {key!r}")
+    elif t == "array":
+        if not isinstance(instance, list):
+            return [f"{path}: expected array, got {type(instance).__name__}"]
+        items = schema.get("items")
+        if items:
+            for i, item in enumerate(instance):
+                errors += validate(item, items, f"{path}[{i}]")
+    elif t == "string":
+        if not isinstance(instance, str):
+            errors.append(
+                f"{path}: expected string, got {type(instance).__name__}")
+    elif t == "integer":
+        if not isinstance(instance, int) or isinstance(instance, bool):
+            errors.append(
+                f"{path}: expected integer, got {type(instance).__name__}")
+    elif t == "boolean":
+        if not isinstance(instance, bool):
+            errors.append(
+                f"{path}: expected boolean, got {type(instance).__name__}")
+    if "enum" in schema and instance not in schema["enum"]:
+        errors.append(
+            f"{path}: {instance!r} not one of {schema['enum']}")
+    if "minimum" in schema and isinstance(instance, (int, float)) \
+            and not isinstance(instance, bool) \
+            and instance < schema["minimum"]:
+        errors.append(
+            f"{path}: {instance} below minimum {schema['minimum']}")
+    return errors
+
+
+def crd_openapi_schema(crd_obj: Dict[str, Any]) -> Dict[str, Any]:
+    """Pull the served version's openAPIV3Schema out of a CRD object."""
+    (version,) = crd_obj["spec"]["versions"]
+    return version["schema"]["openAPIV3Schema"]

@@ -73,6 +73,95 @@ class DashboardTest(tornado.testing.AsyncHTTPTestCase):
         assert "Restarting" in page
         assert "TPU_WORKER×4" in page
 
+    def test_create_validates_and_operator_reconciles(self):
+        """Round-2 verdict #7: POST a CR through the dashboard, then
+        the operator reconciles it into a gang (write-path parity with
+        the reference UI, tf-job.libsonnet:271-458)."""
+        from kubeflow_tpu.manifests.tpujob import replica_spec, tpu_job
+        from kubeflow_tpu.operator.reconciler import Reconciler
+
+        job = tpu_job(
+            "fromui", "default",
+            [replica_spec("TPU_WORKER", 2,
+                          image="ghcr.io/kubeflow-tpu/trainer:v0.1.0",
+                          tpu_accelerator="tpu-v5-lite-podslice",
+                          tpu_topology="2x4")],
+            termination={"chief": {"replicaName": "TPU_WORKER",
+                                   "replicaIndex": 0}})
+        resp = self.fetch("/tpujobs/api/tpujob", method="POST",
+                          body=json.dumps(job))
+        assert resp.code == 201, resp.body
+        assert json.loads(resp.body)["created"]["name"] == "fromui"
+
+        # The operator picks the created CR up and builds the gang.
+        stored = self.api.get(KIND, "default", "fromui")
+        Reconciler(self.api).reconcile(stored)
+        pods = self.api.list("Pod", "default", {JOB_LABEL: "fromui"})
+        assert len(pods) == 2
+
+        # Duplicate create is a clean conflict, not a 500.
+        resp = self.fetch("/tpujobs/api/tpujob", method="POST",
+                          body=json.dumps(job))
+        assert resp.code == 409
+
+    def test_create_rejects_invalid_cr(self):
+        bad = {"kind": "TPUJob", "apiVersion": "kubeflow.org/v1alpha1",
+               "metadata": {"name": "bad"},
+               "spec": {"replicaSpecs": [
+                   {"tpuReplicaType": "NOT_A_TYPE", "replicas": 0}]}}
+        resp = self.fetch("/tpujobs/api/tpujob", method="POST",
+                          body=json.dumps(bad))
+        assert resp.code == 400
+        details = json.loads(resp.body)["details"]
+        assert any("NOT_A_TYPE" in d for d in details)
+        assert any("minimum" in d or "below" in d for d in details)
+        resp = self.fetch("/tpujobs/api/tpujob", method="POST",
+                          body=b"{nope")
+        assert resp.code == 400
+
+    def test_delete_removes_job_and_gang(self):
+        from kubeflow_tpu.manifests.tpujob import replica_spec, tpu_job
+        from kubeflow_tpu.operator.reconciler import Reconciler
+
+        job = tpu_job(
+            "togo", "default",
+            [replica_spec("TPU_WORKER", 2,
+                          image="ghcr.io/kubeflow-tpu/trainer:v0.1.0",
+                          tpu_accelerator="tpu-v5-lite-podslice",
+                          tpu_topology="2x4")],
+            termination={"chief": {"replicaName": "TPU_WORKER",
+                                   "replicaIndex": 0}})
+        self.api.create(job)
+        Reconciler(self.api).reconcile(
+            self.api.get(KIND, "default", "togo"))
+        assert len(self.api.list("Pod", "default",
+                                 {JOB_LABEL: "togo"})) == 2
+
+        resp = self.fetch("/tpujobs/api/tpujob/default/togo",
+                          method="DELETE")
+        assert resp.code == 200
+        assert json.loads(resp.body)["pods_deleted"] == 2
+        assert self.api.list("Pod", "default", {JOB_LABEL: "togo"}) == []
+        resp = self.fetch("/tpujobs/api/tpujob/default/togo")
+        assert resp.code == 404
+        resp = self.fetch("/tpujobs/api/tpujob/default/togo",
+                          method="DELETE")
+        assert resp.code == 404
+
+    def test_ui_form_create(self):
+        body = ("name=formjob&namespace=default&workers=2"
+                "&image=ghcr.io/kubeflow-tpu/trainer:v0.1.0"
+                "&tpu_accelerator=tpu-v5-lite-podslice"
+                "&tpu_topology=2x4&command=")
+        resp = self.fetch("/tpujobs/ui/create", method="POST",
+                          body=body, follow_redirects=False)
+        assert resp.code == 302, resp.body
+        created = self.api.get(KIND, "default", "formjob")
+        assert created["spec"]["replicaSpecs"][0]["replicas"] == 2
+        # The form is on the UI page.
+        page = self.fetch("/tpujobs/ui/").body.decode()
+        assert "/tpujobs/ui/create" in page
+
     def test_root_redirects_to_ui(self):
         resp = self.fetch("/", follow_redirects=False)
         assert resp.code in (301, 302)
