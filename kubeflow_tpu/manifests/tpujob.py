@@ -103,7 +103,11 @@ def replica_spec(
     template: Dict[str, Any] = {
         "spec": k8s.pod_spec(
             [container],
-            restart_policy="OnFailure",  # parity: tf-job.libsonnet:30
+            # Never, not the reference's OnFailure (tf-job.libsonnet:30):
+            # recovery is slice-granular here — the operator restarts
+            # the whole gang (operator/reconciler.py forces Never too),
+            # so per-pod kubelet restarts would only desync the gang.
+            restart_policy="Never",
             node_selector=node_selector,
         )
     }

@@ -28,6 +28,69 @@ import jax
 import jax.numpy as jnp
 
 
+# generate_config comes from user JSON but lands in jit-static args
+# (inference/generate.py): a float top_k or string temperature would
+# pass export and then break the first :generate request with an
+# opaque XLA error. Coerce + reject unknown keys here so bad configs
+# fail before a version dir is produced.
+_GENERATE_CONFIG_COERCERS = {
+    "max_new_tokens": int,
+    "temperature": float,
+    "top_k": int,
+    "top_p": float,
+    "eos_id": int,
+    "seed": int,
+    "deterministic": bool,
+}
+
+
+def validate_generate_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    unknown = sorted(set(config) - set(_GENERATE_CONFIG_COERCERS))
+    if unknown:
+        raise ValueError(
+            f"unknown generate config keys {unknown}; supported: "
+            f"{sorted(_GENERATE_CONFIG_COERCERS)}")
+    out: Dict[str, Any] = {}
+    for key, value in config.items():
+        coerce = _GENERATE_CONFIG_COERCERS[key]
+        if coerce is bool:
+            # bool("false") is True — require a real JSON boolean.
+            if not isinstance(value, bool):
+                raise ValueError(
+                    f"generate config {key!r} must be a boolean; "
+                    f"got {value!r}")
+            out[key] = value
+            continue
+        if isinstance(value, bool):
+            # bool subclasses int: {"top_k": true} would silently
+            # become top_k=1 (near-greedy sampling) — reject instead.
+            raise ValueError(
+                f"generate config {key!r} must be "
+                f"{coerce.__name__}-like; got {value!r}")
+        try:
+            coerced = coerce(value)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"generate config {key!r} must be "
+                f"{coerce.__name__}-like; got {value!r}") from None
+        if coerce is int and isinstance(value, float) and value != coerced:
+            raise ValueError(
+                f"generate config {key!r} must be an integer; "
+                f"got {value!r}")
+        out[key] = coerced
+    if "top_p" in out and not 0.0 < out["top_p"] <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1]; got {out['top_p']}")
+    if "top_k" in out and out["top_k"] < 1:
+        raise ValueError(f"top_k must be >= 1; got {out['top_k']}")
+    if "max_new_tokens" in out and out["max_new_tokens"] < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1; got {out['max_new_tokens']}")
+    if "temperature" in out and out["temperature"] < 0.0:
+        raise ValueError(
+            f"temperature must be >= 0; got {out['temperature']}")
+    return out
+
+
 def _build_metadata(model_name: str, registry_name: str, entry,
                     seq_len: int, signature_kind: str,
                     generate_config: Dict[str, Any],
@@ -101,7 +164,7 @@ def export_from_checkpoint(
 
     entry = get_model(registry_name)
     model_kwargs = dict(model_kwargs or {})
-    generate_config = dict(generate_config or {})
+    generate_config = validate_generate_config(dict(generate_config or {}))
     if signature_kind == "auto":
         signature_kind = ("generate" if generate_config
                           and entry.family == "language" else "predict")
@@ -158,15 +221,15 @@ def export_from_checkpoint(
         variables = jax.eval_shape(module.init, rng, sample)
     boxed = variables  # all collections, nn.Partitioned metadata kept
 
-    def rebox(values):
-        # The serving layout stores params with their partitioning
+    def rebox(values, collection="params"):
+        # The serving layout stores variables with their partitioning
         # boxes (load_version's init template is boxed); restored/
         # merged values are plain arrays and must be re-boxed.
         return jax.tree.map(
             lambda b, v: (b.replace_boxed(jnp.asarray(v))
                           if isinstance(b, nn.meta.AxisMetadata) else
                           jnp.asarray(v)),
-            boxed["params"], values,
+            boxed[collection], values,
             is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata))
 
     params = nn.meta.unbox(boxed["params"]) if need_init_values else None
@@ -197,16 +260,23 @@ def export_from_checkpoint(
     # Export every non-transient collection the model owns (vision
     # models carry batch_stats that load_version's template expects;
     # the lora collection is merged away, the cache is per-request).
+    # Checkpointed values win (fit()-saved vision TrainStates carry
+    # trained batch_stats); init values back-fill a collection only
+    # when a real init was run.
     export_vars: Dict[str, Any] = {"params": rebox(params)}
     for collection, value in variables.items():
         if collection in ("params", "lora", "cache"):
             continue
-        if not need_init_values:
+        if restored is not None and collection in restored:
+            export_vars[collection] = rebox(restored[collection],
+                                            collection)
+        elif need_init_values:
+            export_vars[collection] = value
+        else:
             raise ValueError(
                 f"model has collection {collection!r} but the "
-                f"checkpoint layout does not carry it; export from a "
-                f"full-variables checkpoint instead")
-        export_vars[collection] = value
+                f"checkpoint carries neither it nor 'base_params'; "
+                f"export from a full-variables checkpoint instead")
 
     metadata = _build_metadata(
         model_name or registry_name, registry_name, entry, seq_len,

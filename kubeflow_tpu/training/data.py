@@ -170,12 +170,17 @@ def token_shard_batches(
     # Flat index space over all shards: chunk i covers tokens
     # [i*seq_len, (i+1)*seq_len) of the concatenated stream.
     offsets = np.cumsum([0] + [a.shape[0] for a in arrays])
+    # Divisibility check runs HERE, not in the generator body: a
+    # generator defers its body to first next(), which in training
+    # happens inside the DevicePrefetcher thread — exactly the
+    # deferred failure this function promises not to have.
+    rows = host_shard_range(global_batch)
     return _token_shard_iter(arrays, offsets, n_chunks, global_batch,
-                             seq_len, seed, epochs, dtype)
+                             seq_len, seed, epochs, dtype, rows)
 
 
 def _token_shard_iter(arrays, offsets, n_chunks, global_batch, seq_len,
-                      seed, epochs, dtype) -> Iterator[Batch]:
+                      seed, epochs, dtype, rows) -> Iterator[Batch]:
 
     def read_chunk(i: int) -> np.ndarray:
         start, stop = i * seq_len, (i + 1) * seq_len
@@ -191,7 +196,6 @@ def _token_shard_iter(arrays, offsets, n_chunks, global_batch, seq_len,
             s += 1
         return out
 
-    rows = host_shard_range(global_batch)
     per_epoch = n_chunks // global_batch
     epoch = 0
     while epochs is None or epoch < epochs:

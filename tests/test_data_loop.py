@@ -168,3 +168,13 @@ def test_token_shard_batches_roundtrip(tmp_path):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="chunks"):
         token_shard_batches(paths[:1], 64, 512, epochs=1).__next__()
+
+    # Host-indivisible global batch fails AT CALL TIME, before any
+    # next() — in multi-host training the first next() happens inside
+    # the DevicePrefetcher thread, and a deferred raise there is
+    # exactly the mid-training failure the API promises not to have.
+    import unittest.mock as _mock
+    with _mock.patch("jax.process_count", return_value=3), \
+         _mock.patch("jax.process_index", return_value=0):
+        with _pytest.raises(ValueError, match="% hosts"):
+            token_shard_batches(paths, batch, seq_len, epochs=1)

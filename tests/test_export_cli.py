@@ -111,6 +111,83 @@ def test_export_vision_model_with_batch_stats(tmp_path):
     assert out["logits"].shape[0] == 2
 
 
+def test_export_vision_fit_checkpoint_carries_trained_batch_stats(tmp_path):
+    """The documented checkpoint→serving loop for VISION models: a
+    fit()-saved TrainState carries batch_stats, and the export must
+    serve the TRAINED statistics, not fresh-init ones."""
+    import optax as _optax
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.training.train import (
+        create_train_state,
+        make_train_step,
+    )
+
+    model = get_model("resnet-test").make(num_classes=10)
+    state = create_train_state(
+        model, _optax.sgd(0.1), jax.random.PRNGKey(0),
+        jnp.zeros((1, 32, 32, 3), jnp.bfloat16))
+    step = make_train_step(None)
+    rng = np.random.RandomState(0)
+    batch = {"inputs": jnp.asarray(rng.rand(4, 32, 32, 3), jnp.bfloat16),
+             "labels": jnp.asarray(rng.randint(0, 10, 4))}
+    for _ in range(2):
+        state, _ = step(state, batch)
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt = Checkpointer(CheckpointConfig(directory=ckpt_dir,
+                                         async_save=False))
+    assert ckpt.save(int(state.step), state, force=True)
+    ckpt.close()
+
+    path = export_from_checkpoint(
+        registry_name="resnet-test", out=str(tmp_path / "served"),
+        version=1, checkpoint=ckpt_dir)
+    loaded = load_version(path)
+    # Trained BN stats differ from init zeros/ones; the export must
+    # carry the trained values.
+    trained = jax.tree.leaves(
+        jax.tree.map(np.asarray, state.batch_stats))
+    served = jax.tree.leaves(
+        jax.tree.map(np.asarray, loaded.variables["batch_stats"]))
+    assert any(np.abs(t).sum() > 0 for t in trained)
+    for t, s in zip(trained, served):
+        np.testing.assert_allclose(t, s, rtol=1e-6)
+    out = loaded.run({"images": np.zeros((2, 32, 32, 3), np.float32)})
+    assert out["logits"].shape == (2, 10)
+
+
+def test_generate_config_validation(tmp_path):
+    from kubeflow_tpu.serving.export_cli import validate_generate_config
+
+    # Coercion: JSON floats that are integral ints pass; e.g. 50.0.
+    cfg = validate_generate_config(
+        {"top_k": 50.0, "temperature": 1, "max_new_tokens": 8})
+    assert cfg["top_k"] == 50 and isinstance(cfg["top_k"], int)
+    assert isinstance(cfg["temperature"], float)
+    with pytest.raises(ValueError, match="unknown generate config"):
+        validate_generate_config({"max_tokens": 8})
+    with pytest.raises(ValueError, match="must be an integer"):
+        validate_generate_config({"top_k": 50.5})
+    with pytest.raises(ValueError, match="int-like"):
+        validate_generate_config({"max_new_tokens": "many"})
+    with pytest.raises(ValueError, match="top_p"):
+        validate_generate_config({"top_p": 1.5})
+    with pytest.raises(ValueError, match="boolean"):
+        validate_generate_config({"deterministic": "false"})
+    # bool subclasses int: {"top_k": true} must not become top_k=1.
+    with pytest.raises(ValueError, match="int-like"):
+        validate_generate_config({"top_k": True})
+    # And the exporter runs it: a bad config must not produce a
+    # version dir.
+    with pytest.raises(ValueError, match="unknown generate config"):
+        export_from_checkpoint(
+            registry_name="llama-test", out=str(tmp_path / "bad"),
+            version=1, seq_len=8,
+            generate_config={"max_new_tokens": 4, "typo_key": 1},
+            model_kwargs={"dtype": "float32"})
+    assert not (tmp_path / "bad").exists()
+
+
 def test_export_rejects_incoherent_signatures(tmp_path):
     with pytest.raises(ValueError, match="language model"):
         export_from_checkpoint(
