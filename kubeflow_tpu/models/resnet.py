@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu.models.registry import ModelEntry, register_model
+from kubeflow_tpu.ops.batch_norm import GhostBatchNorm
 
 ModuleDef = Any
 
@@ -102,6 +103,11 @@ class ResNet(nn.Module):
     width: int = 64
     dtype: Any = jnp.bfloat16
     stem: str = "conv7"
+    # Training BN statistics over the first N batch rows (0 = all).
+    # Distributed-parity semantics — per-replica BN granularity on a
+    # single chip; the step is BN-stat-HBM-bound, so this is the
+    # measured throughput lever (ops/batch_norm.py, PERF.md).
+    bn_stat_rows: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -109,11 +115,12 @@ class ResNet(nn.Module):
             nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
         )
         norm = functools.partial(
-            nn.BatchNorm,
+            GhostBatchNorm,
             use_running_average=not train,
             momentum=0.9,
             epsilon=1e-5,
             dtype=self.dtype,
+            stat_rows=self.bn_stat_rows,
         )
         act = nn.relu
 
@@ -151,19 +158,22 @@ class ResNet(nn.Module):
 
 
 def resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
-             stem: str = "conv7") -> ResNet:
+             stem: str = "conv7", bn_stat_rows: int = 0) -> ResNet:
     return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
-                  dtype=dtype, stem=stem)
+                  dtype=dtype, stem=stem, bn_stat_rows=bn_stat_rows)
 
 
-def resnet101(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
-    return ResNet(stage_sizes=(3, 4, 23, 3), num_classes=num_classes, dtype=dtype)
+def resnet101(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+              bn_stat_rows: int = 0) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), num_classes=num_classes,
+                  dtype=dtype, bn_stat_rows=bn_stat_rows)
 
 
-def resnet18ish(num_classes: int = 10, dtype: Any = jnp.bfloat16) -> ResNet:
+def resnet18ish(num_classes: int = 10, dtype: Any = jnp.bfloat16,
+                bn_stat_rows: int = 0) -> ResNet:
     """Small bottleneck net for tests/CI (not a literal ResNet-18)."""
     return ResNet(stage_sizes=(1, 1, 1, 1), num_classes=num_classes,
-                  width=16, dtype=dtype)
+                  width=16, dtype=dtype, bn_stat_rows=bn_stat_rows)
 
 
 register_model(ModelEntry("resnet50", "vision", resnet50, ((224, 224, 3), "bfloat16"), 1000))

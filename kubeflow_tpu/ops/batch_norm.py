@@ -1,0 +1,106 @@
+"""Batch normalization with distributed-parity ("ghost") statistics.
+
+Why this exists: the ResNet-50 train step on one chip is NOT
+MXU-bound — the XPlane trace (PERF.md) shows the BN statistics
+reductions are >50% of step time, i.e. the step spends most of its
+HBM bandwidth re-reading activations to compute per-channel
+mean/var. The FLOPs are trivial; the READ of the full activation
+tensor is the cost, and it is proportional to the number of rows the
+statistics are computed over.
+
+``stat_rows`` caps that: training statistics are computed over the
+first ``stat_rows`` rows of the batch (0 = all rows, exactly flax's
+``nn.BatchNorm``). This is the *distributed-parity* semantics, not an
+approximation hack: a global batch of 256 spread over 8 chips
+computes per-device BN statistics over 32 rows each (per-replica BN,
+standard since the original large-batch training papers — "ghost
+batch norm", Hoffer et al. 2017 — and what MLPerf ResNet submissions
+do). Running a 256-batch on ONE chip with ``stat_rows=64`` uses
+*more* rows per statistic than the 8-chip run it stands in for.
+
+Normalization, scale/bias and the running-average update are
+unchanged; only which rows feed the mean/var estimate differs. The
+module's param/collection layout matches ``nn.BatchNorm`` exactly
+(params: scale/bias; batch_stats: mean/var), so checkpoints and
+exports are interchangeable — verified by equivalence test at
+``stat_rows=0`` (tests/test_batch_norm.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class GhostBatchNorm(nn.Module):
+    """``nn.BatchNorm``-compatible BN with ``stat_rows`` row capping.
+
+    Only the feature-last layout (reduction over all axes but -1) is
+    supported — the NHWC convention every model in this tree uses.
+
+    ``stat_rows`` is a SINGLE-CHIP lever: with the batch dim sharded
+    over a data axis, ``x[:stat_rows]`` names rows resident on a
+    device subset, so XLA inserts collectives to share them with
+    every device and the HBM saving disappears (use ``stat_rows=0``
+    on a mesh — there the stats reduce across devices as sync-BN,
+    per-channel scalars over ICI, which is cheap). The benchmark
+    applies it only on the single-chip layout (training/benchmark.py).
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    stat_rows: int = 0  # 0 → full batch (exact nn.BatchNorm)
+    scale_init: Callable = nn.initializers.ones
+    bias_init: Callable = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x):
+        features = x.shape[-1]
+        reduce_axes = tuple(range(x.ndim - 1))
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda *_: jnp.zeros(features, jnp.float32),
+                                None)
+        ra_var = self.variable("batch_stats", "var",
+                               lambda *_: jnp.ones(features, jnp.float32),
+                               None)
+        scale = self.param("scale", self.scale_init, (features,))
+        bias = self.param("bias", self.bias_init, (features,))
+
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xs = x
+            if 0 < self.stat_rows < x.shape[0]:
+                # Stats over the leading rows only: the reduction —
+                # and its HBM read — shrinks by batch/stat_rows.
+                # lax.stop_gradient? No: grads flow through the stat
+                # rows exactly as in per-replica BN on a real mesh.
+                xs = x[: self.stat_rows]
+            xf = xs.astype(jnp.float32)
+            mean = jnp.mean(xf, reduce_axes)
+            # Fast variance (E[x²] − E[x]²): one pass over the data,
+            # matching flax's use_fast_variance=True default.
+            var = jnp.maximum(
+                jnp.mean(jnp.square(xf), reduce_axes) - jnp.square(mean),
+                0.0)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+
+        # Mirror flax's _normalize op-for-op (promotion to f32 via the
+        # f32 mean/var, THEN mul-by-scale, THEN bias, cast to dtype
+        # last) so the module is bitwise-identical to nn.BatchNorm at
+        # stat_rows=0 — asserted for f32 AND bf16 in
+        # tests/test_batch_norm.py.
+        y = x - mean  # promotes to f32 (mean is f32), like flax
+        mul = jax.lax.rsqrt(var + self.epsilon)
+        mul = mul * scale
+        y = y * mul
+        y = y + bias
+        return jnp.asarray(y, self.dtype)

@@ -1,0 +1,119 @@
+"""Pallas experiment: fused BN-train forward (stats + normalize).
+
+Status: **measured, does not beat XLA** — kept as the experiment the
+perf write-up cites (PERF.md "Round 3: attacking the BN-stat
+bottleneck"). The traffic argument, confirmed by measurement:
+
+exact BN-train forward must (1) reduce x to per-channel mean/var and
+(2) normalize x with those stats. Whatever the kernel structure, pass
+2 cannot start before pass 1 finishes, and a ResNet activation
+(hundreds of MB) cannot stay resident in 16 MB VMEM between the
+passes — so the minimum HBM traffic is read-x, read-x, write-y, which
+is exactly what XLA's `convert_reduce_fusion` + elementwise-fusion
+schedule already does (with the normalize fused into neighboring
+elementwise work for free). A hand kernel can only tie the traffic
+while giving up XLA's cross-op fusion; the measured numbers
+(scripts/bn_pallas_bench.py on the chip: 3-17x slower than the XLA
+schedule across the four ResNet-50 BN shapes — table in PERF.md)
+show it losing outright.
+
+The kernel stays for two reasons: it is the measured evidence, and it
+is the template for cases where a fused epilogue DOES pay (a producer
+XLA cannot fuse stats into, e.g. a custom attention output).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _bn_fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, stats_ref,
+                   acc_ref, *, eps: float, m_total: int):
+    """grid = (2, m_tiles): phase 0 accumulates per-channel sum/sumsq
+    into VMEM scratch; phase 1 normalizes with the finished stats.
+    Scratch persists across the sequential TPU grid loop."""
+    phase = pl.program_id(0)
+    m_idx = pl.program_id(1)
+
+    @pl.when((phase == 0) & (m_idx == 0))
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(phase == 0)
+    def _accumulate():
+        x = x_ref[...].astype(jnp.float32)
+        acc_ref[0, :] += jnp.sum(x, axis=0)
+        acc_ref[1, :] += jnp.sum(x * x, axis=0)
+
+    @pl.when((phase == 1) & (m_idx == 0))
+    def _finalize_stats():
+        n = jnp.float32(m_total)
+        mean = acc_ref[0, :] / n
+        var = jnp.maximum(acc_ref[1, :] / n - mean * mean, 0.0)
+        stats_ref[0, :] = mean
+        stats_ref[1, :] = var
+        # Cache (mean, rsqrt) in the accumulator for the normalize.
+        acc_ref[0, :] = mean
+        acc_ref[1, :] = jax.lax.rsqrt(var + eps)
+
+    @pl.when(phase == 1)
+    def _normalize():
+        x = x_ref[...].astype(jnp.float32)
+        y = (x - acc_ref[0, :]) * acc_ref[1, :]
+        y = y * scale_ref[...].astype(jnp.float32) \
+            + bias_ref[...].astype(jnp.float32)
+        y_ref[...] = y.astype(y_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("eps", "block_m", "interpret"))
+def fused_bn_train_forward(x: jax.Array, scale: jax.Array,
+                           bias: jax.Array, *, eps: float = 1e-5,
+                           block_m: int = 512,
+                           interpret: bool = False):
+    """[M, C] x → (y, mean, var), stats over axis 0, one pallas_call.
+
+    C must be a multiple of 128 (lane width); M a multiple of
+    ``block_m``. Flatten NHWC inputs to (N·H·W, C) first.
+    """
+    m, c = x.shape
+    if m % block_m:
+        raise ValueError(f"M {m} % block_m {block_m}")
+    if c % 128:
+        raise ValueError(f"C {c} must be a multiple of 128")
+    m_tiles = m // block_m
+    y, stats = pl.pallas_call(
+        functools.partial(_bn_fwd_kernel, eps=eps, m_total=m),
+        grid=(2, m_tiles),
+        in_specs=[
+            pl.BlockSpec((block_m, c), lambda p, i: (i, 0)),
+            pl.BlockSpec((c,), lambda p, i: (0,)),
+            pl.BlockSpec((c,), lambda p, i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_m, c), lambda p, i: (i, 0)),
+            pl.BlockSpec((2, c), lambda p, i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, c), x.dtype),
+            jax.ShapeDtypeStruct((2, c), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((2, c), jnp.float32)],
+        interpret=interpret,  # CPU tests; real lowering on TPU
+    )(x, scale, bias)
+    return y, stats[0], stats[1]
+
+
+def reference_bn_train_forward(x, scale, bias, *, eps: float = 1e-5):
+    """The XLA-scheduled equivalent (what the model actually runs)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=0)
+    var = jnp.maximum(jnp.mean(xf * xf, axis=0) - mean * mean, 0.0)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(x.dtype), mean, var

@@ -41,6 +41,7 @@ class BenchConfig:
     mesh: Optional[MeshSpec] = None  # None → all devices on the data axis
     image_size: Optional[int] = None  # override model default (for smoke runs)
     seed: int = 0
+    model_kwargs: Optional[Dict] = None  # e.g. {"bn_stat_rows": 64}
 
 
 def synthetic_batch(config: BenchConfig, num_classes: int,
@@ -138,7 +139,7 @@ def _attach_mfu(result: Dict[str, float], flops_per_device: Optional[float],
 def run_benchmark(config: BenchConfig) -> Dict[str, float]:
     """Returns {images_per_sec, images_per_sec_per_chip, step_time_ms, ...}."""
     entry = get_model(config.model)
-    model = entry.make()
+    model = entry.make(**(config.model_kwargs or {}))
     input_shape = entry.input_spec[0]
     if config.image_size is not None:
         input_shape = (config.image_size, config.image_size, input_shape[-1])

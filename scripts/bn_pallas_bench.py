@@ -1,0 +1,65 @@
+"""Measure the fused BN-forward pallas kernel vs the XLA schedule on
+the chip (the evidence PERF.md cites). Prints one JSON line per shape."""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.ops.bn_pallas import (
+    fused_bn_train_forward,
+    reference_bn_train_forward,
+)
+
+
+def timed(fn, *args, iters=30):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    # Host-value fence (the tunnel reports early via block_until_ready
+    # alone; see training/benchmark.py).
+    float(jnp.sum(out[0][:1].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    rng = np.random.RandomState(0)
+    # ResNet-50-shaped BN instances (b256): (M=N·H·W, C).
+    shapes = [(256 * 56 * 56, 256), (256 * 28 * 28, 512),
+              (256 * 14 * 14, 1024), (256 * 7 * 7, 2048)]
+    for m, c in shapes:
+        x = jnp.asarray(rng.randn(m, c), jnp.bfloat16)
+        scale = jnp.ones((c,), jnp.float32)
+        bias = jnp.zeros((c,), jnp.float32)
+        y_p, mean_p, var_p = fused_bn_train_forward(x, scale, bias,
+                                                    block_m=256)
+        y_r, mean_r, var_r = reference_bn_train_forward(x, scale, bias)
+        np.testing.assert_allclose(np.asarray(mean_p),
+                                   np.asarray(mean_r), atol=2e-2)
+        np.testing.assert_allclose(
+            np.asarray(y_p[:512], np.float32),
+            np.asarray(y_r[:512], np.float32), atol=0.1)
+        t_pallas = timed(
+            lambda *a: fused_bn_train_forward(*a, block_m=256),
+            x, scale, bias)
+        # Jitted: the comparison target is XLA's FUSED schedule
+        # (convert_reduce_fusion + elementwise fusion), not eager
+        # op-by-op dispatch.
+        t_xla = timed(jax.jit(reference_bn_train_forward), x, scale,
+                      bias)
+        gbytes = (2 * x.size * 2 + x.size * 2) / 1e9
+        print(json.dumps({
+            "shape": [m, c],
+            "pallas_ms": round(t_pallas, 3),
+            "xla_ms": round(t_xla, 3),
+            "pallas_gbps": round(gbytes / (t_pallas / 1e3), 1),
+            "xla_gbps": round(gbytes / (t_xla / 1e3), 1),
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Fused BN-forward pallas kernel: correctness vs the XLA schedule
+(the PERF.md experiment's test tier; runs in interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.bn_pallas import (
+    fused_bn_train_forward,
+    reference_bn_train_forward,
+)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fused_bn_matches_reference(dtype):
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(1024, 128) * 2 + 0.5, dtype)
+    scale = jnp.asarray(np.random.RandomState(1).rand(128), jnp.float32)
+    bias = jnp.asarray(np.random.RandomState(2).randn(128), jnp.float32)
+    y_p, mean_p, var_p = fused_bn_train_forward(x, scale, bias,
+                                                block_m=256,
+                                                interpret=True)
+    y_r, mean_r, var_r = reference_bn_train_forward(x, scale, bias)
+    tol = 1e-4 if dtype == jnp.float32 else 5e-2
+    np.testing.assert_allclose(np.asarray(mean_p), np.asarray(mean_r),
+                               atol=tol)
+    np.testing.assert_allclose(np.asarray(var_p), np.asarray(var_r),
+                               atol=tol)
+    np.testing.assert_allclose(np.asarray(y_p, np.float32),
+                               np.asarray(y_r, np.float32),
+                               atol=10 * tol)
+
+
+def test_fused_bn_validates_shapes():
+    x = jnp.zeros((100, 128), jnp.float32)
+    s = jnp.ones((128,), jnp.float32)
+    with pytest.raises(ValueError, match="block_m"):
+        fused_bn_train_forward(x, s, s, block_m=512, interpret=True)
+    with pytest.raises(ValueError, match="multiple of 128"):
+        fused_bn_train_forward(jnp.zeros((512, 100), jnp.float32),
+                               jnp.ones((100,), jnp.float32),
+                               jnp.ones((100,), jnp.float32),
+                               block_m=256, interpret=True)
