@@ -264,6 +264,34 @@ def make_app(manager: ModelManager) -> tornado.web.Application:
     ], manager=manager)
 
 
+def load_model_config(path: str):
+    """TF-Serving's --model_config_file role, as JSON:
+    ``[{"name": ..., "base_path": ..., "max_batch": 64}, ...]``
+    (the proto ModelServerConfig's model_config_list fields)."""
+    with open(path) as f:
+        entries = json.load(f)
+    if not isinstance(entries, list) or not entries:
+        raise ValueError("model config must be a non-empty JSON list")
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict):
+            raise ValueError(
+                f"model config entry {i} must be an object, got "
+                f"{type(entry).__name__}")
+        missing = {"name", "base_path"} - set(entry)
+        if missing:
+            raise ValueError(
+                f"model config entry {i} missing {sorted(missing)}")
+        unknown = set(entry) - {"name", "base_path", "max_batch"}
+        if unknown:
+            raise ValueError(
+                f"model config entry {i} has unknown keys "
+                f"{sorted(unknown)}")
+    names = [e["name"] for e in entries]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate model names in config: {names}")
+    return entries
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kft-model-server")
     # --port is the gRPC port, exactly like tensorflow_model_server
@@ -271,11 +299,21 @@ def main(argv=None) -> int:
     # --rest_port, mirroring TF-Serving's --rest_api_port split.
     parser.add_argument("--port", type=int, default=9000)
     parser.add_argument("--rest_port", type=int, default=8500)
-    parser.add_argument("--model_name", required=True)
-    parser.add_argument("--model_base_path", required=True)
+    parser.add_argument("--model_name")
+    parser.add_argument("--model_base_path")
+    parser.add_argument("--model_config_file",
+                        help="JSON list of {name, base_path[, max_batch]}"
+                             " — multi-model serving (TF-Serving's "
+                             "--model_config_file role)")
     parser.add_argument("--max_batch", type=int, default=64)
     parser.add_argument("--poll_interval", type=float, default=5.0)
     args = parser.parse_args(argv)
+    single = bool(args.model_name or args.model_base_path)
+    if bool(args.model_config_file) == single:
+        parser.error("exactly one of --model_name/--model_base_path "
+                     "or --model_config_file is required")
+    if single and not (args.model_name and args.model_base_path):
+        parser.error("--model_name and --model_base_path go together")
     logging.basicConfig(
         level=logging.INFO,
         format="%(levelname)s|%(asctime)s|%(pathname)s|%(lineno)d| %(message)s",
@@ -285,20 +323,29 @@ def main(argv=None) -> int:
 
     sync_platform_from_env()
     manager = ModelManager(poll_interval_s=args.poll_interval)
-    # Defer the (slow) first model load to the poll thread: the ports
+    # Defer the (slow) first model loads to the poll thread: the ports
     # open immediately and /healthz answers 503 until loaded, so
     # kubelet probes see a live-but-not-ready pod instead of a dead one.
-    manager.add_model(args.model_name, args.model_base_path,
-                      max_batch=args.max_batch, initial_poll=False)
+    if args.model_config_file:
+        models = load_model_config(args.model_config_file)
+    else:
+        models = [{"name": args.model_name,
+                   "base_path": args.model_base_path,
+                   "max_batch": args.max_batch}]
+    for entry in models:
+        manager.add_model(entry["name"], entry["base_path"],
+                          max_batch=int(entry.get("max_batch",
+                                                  args.max_batch)),
+                          initial_poll=False)
     from kubeflow_tpu.serving.grpc_server import make_server
 
     grpc_srv, _ = make_server(manager, args.port)
     grpc_srv.start()
     app = make_app(manager)
     app.listen(args.rest_port)
-    logger.info("model server: gRPC on :%d, REST on :%d "
-                "(model=%s base=%s)", args.port, args.rest_port,
-                args.model_name, args.model_base_path)
+    logger.info("model server: gRPC on :%d, REST on :%d (models=%s)",
+                args.port, args.rest_port,
+                [m["name"] for m in models])
     manager.start()
     tornado.ioloop.IOLoop.current().start()
     return 0

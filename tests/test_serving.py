@@ -308,6 +308,7 @@ def _attach_base_path(model_dir):
     ServingEndToEnd.base_path = model_dir
     ProxyEndToEnd.base_path = model_dir
     HealthGating.base_path = model_dir
+    MultiModelServing.base_path = model_dir
 
 
 class ProxyEndToEnd(tornado.testing.AsyncHTTPTestCase):
@@ -449,6 +450,65 @@ class HealthGating(tornado.testing.AsyncHTTPTestCase):
         shutil.copytree(str(type(self).base_path / "1"),
                         f"{self.empty_dir}/1")
         self.manager.get_model("slow").poll_versions()
+        assert self.fetch("/healthz").code == 200
+
+    def tearDown(self):
+        self.manager.stop()
+        super().tearDown()
+
+
+def test_load_model_config(tmp_path):
+    """--model_config_file parsing (TF-Serving's multi-model role)."""
+    import json as _json
+
+    from kubeflow_tpu.serving.server import load_model_config
+
+    path = tmp_path / "models.json"
+    path.write_text(_json.dumps([
+        {"name": "a", "base_path": "/m/a"},
+        {"name": "b", "base_path": "gs://bucket/b", "max_batch": 8},
+    ]))
+    entries = load_model_config(str(path))
+    assert [e["name"] for e in entries] == ["a", "b"]
+
+    path.write_text(_json.dumps([{"name": "a"}]))
+    with pytest.raises(ValueError, match="missing"):
+        load_model_config(str(path))
+    path.write_text(_json.dumps([
+        {"name": "a", "base_path": "x"},
+        {"name": "a", "base_path": "y"}]))
+    with pytest.raises(ValueError, match="duplicate"):
+        load_model_config(str(path))
+    path.write_text(_json.dumps(
+        [{"name": "a", "base_path": "x", "typo": 1}]))
+    with pytest.raises(ValueError, match="unknown keys"):
+        load_model_config(str(path))
+    path.write_text("{}")
+    with pytest.raises(ValueError, match="non-empty JSON list"):
+        load_model_config(str(path))
+
+
+class MultiModelServing(tornado.testing.AsyncHTTPTestCase):
+    """Two models behind one manager: per-model routing end-to-end."""
+
+    def get_app(self):
+        from kubeflow_tpu.serving.server import make_app
+
+        self.manager = ModelManager()
+        self.manager.add_model("first", str(type(self).base_path),
+                               max_batch=8)
+        self.manager.add_model("second", str(type(self).base_path),
+                               max_batch=8)
+        return make_app(self.manager)
+
+    def test_both_models_serve(self):
+        rows = np.zeros((1, 32, 32, 3)).tolist()
+        for name in ("first", "second"):
+            resp = self.fetch(f"/v1/models/{name}:predict", method="POST",
+                              body=json.dumps({"instances": rows}))
+            assert resp.code == 200, resp.body
+            resp = self.fetch(f"/v1/models/{name}")
+            assert json.loads(resp.body)["model_version_status"]
         assert self.fetch("/healthz").code == 200
 
     def tearDown(self):
