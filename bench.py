@@ -44,8 +44,8 @@ def main() -> int:
         batch_size=256 * n if on_tpu else 32,
         steps=20 if on_tpu else 3,
         warmup_steps=3 if on_tpu else 1,
-        # Distributed-parity BN statistics (32 of 256 rows): the step
-        # is BN-stat-HBM-bound; measured 103.7 → 97.2 ms/step
+        # Ghost-batch BN statistics (32 of 256 shuffled rows): the
+        # step is BN-stat-HBM-bound; measured 103.7 → 97.2 ms/step
         # (ops/batch_norm.py, PERF.md). Single-chip-only lever — the
         # bench mesh here is one device.
         model_kwargs={"bn_stat_rows": 32} if (on_tpu and n == 1) else None,

@@ -103,10 +103,10 @@ class ResNet(nn.Module):
     width: int = 64
     dtype: Any = jnp.bfloat16
     stem: str = "conv7"
-    # Training BN statistics over the first N batch rows (0 = all).
-    # Distributed-parity semantics — per-replica BN granularity on a
-    # single chip; the step is BN-stat-HBM-bound, so this is the
-    # measured throughput lever (ops/batch_norm.py, PERF.md).
+    # Training BN statistics over the first N batch rows (0 = all):
+    # ghost-batch estimation — the step is BN-stat-HBM-bound, so this
+    # is the measured throughput lever; needs a shuffled pipeline
+    # (ops/batch_norm.py, PERF.md).
     bn_stat_rows: int = 0
 
     @nn.compact
