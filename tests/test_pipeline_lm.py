@@ -119,6 +119,31 @@ def test_pipeline_training_reduces_loss():
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_staged_forward_multiple_layers_per_stage():
+    """4 layers on 2 stages: the per-stage lax.scan runs depth >1."""
+    from kubeflow_tpu.models.llama import Llama
+
+    model = Llama(vocab_size=VOCAB, num_layers=4, d_model=64,
+                  num_heads=4, num_kv_heads=2, mlp_dim=128,
+                  dtype="float32")
+    batch = _batch(rows=4, length=8)
+    import flax.linen as nn
+
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), batch["input_ids"])["params"])
+    want = model.apply({"params": params}, batch["input_ids"])
+    mesh = build_mesh(MeshSpec(data=2, pipeline=2),
+                      jax.devices("cpu")[:4])
+    staged = partition_llama_params(params, 2)
+    leaf = jax.tree.leaves(staged["stages"])[0]
+    assert leaf.shape[:2] == (2, 2)  # 2 stages × 2 layers each
+    got = jax.jit(lambda p, x: staged_llama_forward(
+        model, p, x, mesh=mesh, n_microbatches=2))(
+        staged, batch["input_ids"])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_pipeline_rejects_unsupported_blocks():
     from kubeflow_tpu.training.pipeline_lm import _block_for
 
