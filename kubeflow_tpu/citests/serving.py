@@ -145,6 +145,14 @@ def run_fake() -> None:
             raise AssertionError("local model server never became healthy")
         golden_check(f"http://127.0.0.1:{rest_port}", "resnet")
         grpc_check(f"127.0.0.1:{grpc_port}", "resnet")
+        # Graceful shutdown: SIGTERM (what the kubelet sends) must
+        # drain and exit 0 within the grace period, not require KILL.
+        import signal
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        assert rc == 0, f"server exited {rc} on SIGTERM"
+        logger.info("graceful shutdown ok (exit 0 on SIGTERM)")
     finally:
         proc.kill()
 

@@ -74,9 +74,15 @@ def start_predict(manager: ModelManager, request_bytes: bytes):
         raise ValueError(
             f"request missing input {input_name!r}; "
             f"got {sorted(inputs)}")
+    # sig.method → the signature's own method runs (TF-Serving
+    # semantics: Predict executes the named signature, whatever it
+    # computes — so generate-method exports serve over gRPC too).
+    # Submitting the resolved method (not None) keeps the batcher's
+    # (signature, method, version) grouping aligned with REST
+    # requests, so both transports share batch buckets.
     future = model.submit({input_name: inputs[input_name]},
                           spec["signature_name"] or None,
-                          "predict", spec["version"])
+                          sig.method, spec["version"])
     return spec, loaded, future, output_filter
 
 

@@ -347,7 +347,36 @@ def main(argv=None) -> int:
                 args.port, args.rest_port,
                 [m["name"] for m in models])
     manager.start()
-    tornado.ioloop.IOLoop.current().start()
+
+    # k8s sends SIGTERM then waits terminationGracePeriodSeconds:
+    # stop taking new RPCs, let in-flight batches drain, then exit so
+    # rolling updates never cut requests mid-predict. The drain runs
+    # in ITS OWN THREAD: blocking on the IOLoop would freeze health
+    # probes and the executor-resume callbacks that in-flight REST
+    # handlers need to finish their responses.
+    import signal
+    import threading
+
+    loop = tornado.ioloop.IOLoop.current()
+    draining = threading.Event()
+
+    def _drain_and_stop():
+        grpc_srv.stop(grace=10).wait(timeout=15)
+        manager.stop()
+        loop.add_callback(loop.stop)
+
+    def _graceful_exit(signum, frame):
+        del frame
+        if draining.is_set():
+            return  # second signal while already draining
+        draining.set()
+        logger.info("signal %d: draining and shutting down", signum)
+        threading.Thread(target=_drain_and_stop, daemon=True,
+                         name="graceful-drain").start()
+
+    signal.signal(signal.SIGTERM, _graceful_exit)
+    signal.signal(signal.SIGINT, _graceful_exit)
+    loop.start()
     return 0
 
 

@@ -68,6 +68,9 @@ def _call(address, method, request):
 
 
 def test_grpc_predict(served):
+    """Predict executes the named signature (TF-Serving semantics):
+    classnet's serving_default is classify-method, so Predict returns
+    the signature's declared outputs (classes/scores)."""
     address, _ = served
     x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
     request = wire.encode_predict_request("classnet", {"images": x})
@@ -75,7 +78,8 @@ def test_grpc_predict(served):
         _call(address, "Predict", request))
     assert spec["name"] == "classnet"
     assert spec["version"] == 1
-    assert outputs["logits"].shape == (2, 10)
+    assert outputs["classes"].shape == (2, 5)
+    assert outputs["scores"].shape == (2, 5)
 
 
 def test_grpc_predict_matches_direct_run(served):
@@ -84,10 +88,11 @@ def test_grpc_predict_matches_direct_run(served):
     request = wire.encode_predict_request("classnet", {"images": x})
     _, outputs = wire.decode_predict_response(
         _call(address, "Predict", request))
-    direct = manager.get_model("classnet").get().run(
-        {"images": x}, method="predict")
-    np.testing.assert_allclose(outputs["logits"], direct["logits"],
+    direct = manager.get_model("classnet").get().run({"images": x})
+    np.testing.assert_allclose(outputs["scores"], direct["scores"],
                                rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(outputs["classes"],
+                                  direct["classes"])
 
 
 def test_grpc_classify_labels_and_scores(served):
@@ -160,7 +165,7 @@ def test_client_helpers_against_live_server(served):
     address, _ = served
     x = np.random.RandomState(2).rand(1, 32, 32, 3).astype(np.float32)
     outputs = client.grpc_predict(address, "classnet", {"images": x})
-    assert outputs["logits"].shape == (1, 10)
+    assert outputs["scores"].shape == (1, 5)  # signature's outputs
     rows = client.grpc_classify(
         address, "classnet",
         [{"images": x.reshape(-1)}])
@@ -173,10 +178,10 @@ def test_output_filter_on_grpc(served):
     address, _ = served
     x = np.zeros((1, 32, 32, 3), np.float32)
     request = (wire.encode_predict_request("classnet", {"images": x})
-               + wire._field_bytes(3, b"logits"))  # output_filter
+               + wire._field_bytes(3, b"scores"))  # output_filter
     _, outputs = wire.decode_predict_response(
         _call(address, "Predict", request))
-    assert set(outputs) == {"logits"}
+    assert set(outputs) == {"scores"}
 
 
 # --- wire codec roundtrips for the new messages ----------------------------
