@@ -118,6 +118,97 @@ def synthetic_causal_lm(
         step += 1
 
 
+def image_shard_batches(
+    image_paths: Sequence[str],
+    label_paths: Sequence[str],
+    global_batch: int,
+    *,
+    seed: int = 0,
+    epochs: Optional[int] = None,
+    dtype: str = "bfloat16",
+    scale: float = 1.0 / 255.0,
+) -> Iterator[Batch]:
+    """Vision batches {"inputs", "labels"} from paired .npy shards.
+
+    The real-data path for the vision trainer (training/train.py),
+    mirroring :func:`token_shard_batches`' mechanics: mmapped shards
+    (uint8 images [N, H, W, C] + integer labels [N]), static shapes
+    (trailing partial batches dropped), per-host row sharding, seeded
+    epoch shuffle of example order, and eager validation — shard
+    mismatches raise HERE, not from inside the prefetch thread.
+
+    ``scale`` maps stored uint8 to model range at batch-build time
+    (the cast itself runs on host; the device sees ``dtype``).
+    """
+    if len(image_paths) != len(label_paths) or not image_paths:
+        raise ValueError(
+            f"need equal non-empty shard lists; got "
+            f"{len(image_paths)} image vs {len(label_paths)} label")
+    images, labels = [], []
+    for ip, lp in zip(image_paths, label_paths):
+        img = np.load(ip, mmap_mode="r")
+        lab = np.load(lp, mmap_mode="r")
+        if img.ndim != 4:
+            raise ValueError(f"{ip}: expected [N,H,W,C], got {img.shape}")
+        if img.dtype != np.uint8:
+            # The scale default assumes uint8 storage; float shards
+            # would silently double-normalize — refuse eagerly.
+            raise ValueError(
+                f"{ip}: image shards must be uint8 (got {img.dtype}); "
+                f"store raw pixels and let `scale` normalize")
+        if not np.issubdtype(lab.dtype, np.integer):
+            raise ValueError(
+                f"{lp}: labels must be integers (got {lab.dtype})")
+        if lab.shape != (img.shape[0],):
+            raise ValueError(
+                f"{lp}: {lab.shape} labels for {img.shape[0]} images")
+        if images and img.shape[1:] != images[0].shape[1:]:
+            raise ValueError(
+                f"{ip}: shape {img.shape[1:]} != {images[0].shape[1:]}")
+        images.append(img)
+        labels.append(lab)
+    sizes = [i.shape[0] for i in images]
+    total = sum(sizes)
+    if total < global_batch:
+        raise ValueError(
+            f"{total} examples < global batch {global_batch}")
+    import jax.numpy as jnp
+
+    rows = host_shard_range(global_batch)
+    offsets = np.cumsum([0] + sizes)
+    np_dtype = (jnp.bfloat16 if dtype == "bfloat16"
+                else np.dtype(dtype))
+    return _image_shard_iter(images, labels, offsets, total,
+                             global_batch, seed, epochs, np_dtype,
+                             scale, rows)
+
+
+def _image_shard_iter(images, labels, offsets, total, global_batch,
+                      seed, epochs, np_dtype, scale, rows
+                      ) -> Iterator[Batch]:
+    def read(i: int):
+        s = int(np.searchsorted(offsets, i, side="right") - 1)
+        local = i - offsets[s]
+        return images[s][local], labels[s][local]
+
+    per_epoch = total // global_batch
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        rng = np.random.RandomState((seed * 9_999_991 + epoch) % (2 ** 31))
+        order = rng.permutation(total)
+        for b in range(per_epoch):
+            mine = order[b * global_batch + rows.start:
+                         b * global_batch + rows.stop]
+            pairs = [read(int(i)) for i in mine]
+            batch_images = np.stack([p[0] for p in pairs])
+            batch = (batch_images.astype(np.float32) * scale
+                     ).astype(np_dtype)
+            yield {"inputs": batch,
+                   "labels": np.stack([p[1] for p in pairs]).astype(
+                       np.int32)}
+        epoch += 1
+
+
 def token_shard_batches(
     paths: Sequence[str],
     global_batch: int,

@@ -40,6 +40,63 @@ def _eval_sums(apply_fn, variables, batch, objective: str):
     return ((ce * weights).sum(), weights.sum(), (correct * weights).sum())
 
 
+def _accumulate(batches: Iterator[Batch], step, max_batches):
+    """Sum a per-batch tuple of device scalars over the stream.
+
+    Accumulates as device values: a float() per batch would fence
+    every step and serialize the eval loop; the caller pulls host
+    values once at the end.
+    """
+    totals = None
+    n = 0
+    for batch in batches:
+        sums = step(batch)
+        totals = sums if totals is None else tuple(
+            a + b for a, b in zip(totals, sums))
+        n += 1
+        if max_batches is not None and n >= max_batches:
+            break
+    return totals, n
+
+
+@functools.partial(jax.jit, static_argnames=("apply_fn",))
+def _vision_eval_sums(apply_fn, variables, batch):
+    logits = apply_fn(variables, batch["inputs"], train=False)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["labels"])
+    correct = (jnp.argmax(logits, -1) == batch["labels"]).astype(
+        jnp.float32)
+    n = jnp.asarray(batch["labels"].shape[0], jnp.float32)
+    return ce.sum(), n, correct.sum()
+
+
+def evaluate_vision(
+    apply_fn: Any,
+    variables: Dict[str, Any],
+    batches: Iterator[Batch],
+    *,
+    max_batches: Optional[int] = None,
+) -> Dict[str, float]:
+    """Exact top-1 accuracy + mean CE over an image stream (the eval
+    side of training/train.py; eval-mode BN uses the running
+    statistics in ``variables["batch_stats"]``). Batches are the
+    trainer's {"inputs", "labels"} dicts — e.g. from
+    :func:`~kubeflow_tpu.training.data.image_shard_batches`."""
+    totals, n_batches = _accumulate(
+        batches,
+        lambda b: _vision_eval_sums(apply_fn, variables, b),
+        max_batches)
+    if n_batches == 0:
+        raise ValueError("evaluation stream produced no examples")
+    total_ce, total_n, total_correct = (float(t) for t in totals)
+    return {
+        "loss": total_ce / total_n,
+        "accuracy": total_correct / total_n,
+        "examples": total_n,
+        "batches": float(n_batches),
+    }
+
+
 def evaluate_lm(
     apply_fn: Any,
     variables: Dict[str, Any],
@@ -52,26 +109,13 @@ def evaluate_lm(
     ``max_batches`` of them). ``variables`` is the dict the model
     applies with — ``{"params": p}`` or ``{"params": p, "lora": l}``
     for an unmerged fine-tune."""
-    # Accumulate as device scalars: a float() per batch would fence
-    # every step and serialize the eval loop; one pull at the end
-    # lets dispatch pipeline ahead of the device.
-    total_ce = total_w = total_correct = None
-    n = 0
-    for batch in batches:
-        ce, w, correct = _eval_sums(apply_fn, variables, batch, objective)
-        if total_ce is None:
-            total_ce, total_w, total_correct = ce, w, correct
-        else:
-            total_ce, total_w, total_correct = (
-                total_ce + ce, total_w + w, total_correct + correct)
-        n += 1
-        if max_batches is not None and n >= max_batches:
-            break
+    totals, n = _accumulate(
+        batches,
+        lambda b: _eval_sums(apply_fn, variables, b, objective),
+        max_batches)
     if n == 0:
         raise ValueError("evaluation stream produced no weighted tokens")
-    total_ce = float(total_ce)
-    total_w = float(total_w)
-    total_correct = float(total_correct)
+    total_ce, total_w, total_correct = (float(t) for t in totals)
     if total_w == 0:
         raise ValueError("evaluation stream produced no weighted tokens")
     loss = total_ce / total_w
