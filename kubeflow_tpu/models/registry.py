@@ -36,6 +36,7 @@ def _ensure_loaded() -> None:
     for mod in (
         "kubeflow_tpu.models.resnet",
         "kubeflow_tpu.models.inception",
+        "kubeflow_tpu.models.vit",
         "kubeflow_tpu.models.bert",
         "kubeflow_tpu.models.llama",
     ):

@@ -1,0 +1,155 @@
+"""Vision Transformer (ViT) family, TPU-first.
+
+Beyond-parity model family (the reference's vision set was
+CNN-only — tf_cnn_benchmarks resnet/inception): a pre-LN ViT whose
+encoder reuses the same TPU conventions as the BERT stack
+(``models/bert.py``): bf16 activations with fp32 LayerNorm/head,
+partitioning-annotated kernels so the tensor-parallel rule table
+applies unchanged, the shared attention entry point (which routes
+short token counts like 224²/p16's 196 to the XLA blockwise path —
+the Pallas flash kernel needs block-divisible lengths and only
+engages for longer/padded sequences), and zero data-dependent
+control flow (static patch grid, unrolled depth).
+
+TPU design notes:
+- Patch embedding is a stride-p conv — one big MXU matmul of shape
+  [B·(H/p)·(W/p), p²·C] × [p²·C, D]; no gather/reshape scatter.
+- Mean-pool head (no CLS token): keeps every token's FLOPs useful
+  and the sequence length a clean multiple of the 128-lane width
+  (196 tokens for 224²/p16 pads poorly; mean-pool is insensitive).
+- Pre-LN blocks (ViT standard), GELU MLP, learned 2D-flattened
+  position embeddings.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.registry import ModelEntry, register_model
+from kubeflow_tpu.ops.flash_attention import flash_attention
+
+AttentionFn = Callable[..., jax.Array]
+
+
+def _dense(features, axes, dtype, name=None, use_bias=True):
+    return nn.Dense(
+        features, dtype=dtype, use_bias=use_bias,
+        kernel_init=nn.with_partitioning(
+            nn.initializers.normal(0.02), axes),
+        name=name,
+    )
+
+
+class ViTBlock(nn.Module):
+    """Pre-LN transformer block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        proj = functools.partial(_dense, dtype=self.dtype)
+
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
+        q = proj(d_model, ("embed", "heads"), name="query")(h)
+        k = proj(d_model, ("embed", "heads"), name="key")(h)
+        v = proj(d_model, ("embed", "heads"), name="value")(h)
+        split = lambda t: t.reshape(  # noqa: E731
+            t.shape[0], t.shape[1], self.num_heads, head_dim)
+        attn = self.attention_fn or flash_attention
+        out = attn(split(q), split(k), split(v))
+        out = out.reshape(out.shape[0], out.shape[1], d_model)
+        x = x + proj(d_model, ("heads", "embed"), name="out")(out)
+
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
+        h = _dense(self.mlp_dim, ("embed", "mlp"), self.dtype,
+                   "mlp_in")(h)
+        h = nn.gelu(h, approximate=True)
+        return x + _dense(d_model, ("mlp", "embed"), self.dtype,
+                          "mlp_out")(h)
+
+
+class ViT(nn.Module):
+    """``__call__(images, train=...)`` → logits [B, num_classes].
+
+    Images are NHWC with H, W divisible by ``patch``.
+    """
+
+    num_classes: int = 1000
+    patch: int = 16
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train  # no dropout in the benchmark config (BERT parity)
+        b, h, w, _ = x.shape
+        if h % self.patch or w % self.patch:
+            raise ValueError(
+                f"image {h}x{w} not divisible by patch {self.patch}")
+        x = x.astype(self.dtype)
+        # Stride-p conv patch embedding — lowers to one MXU matmul.
+        x = nn.Conv(
+            self.d_model, (self.patch, self.patch),
+            strides=(self.patch, self.patch), padding="VALID",
+            dtype=self.dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.normal(0.02),
+                (None, None, None, "embed")),
+            name="patch_embed",
+        )(x)
+        tokens = (h // self.patch) * (w // self.patch)
+        x = x.reshape(b, tokens, self.d_model)
+        pos = self.param(
+            "pos_embed",
+            nn.with_partitioning(nn.initializers.normal(0.02),
+                                 (None, "embed")),
+            (tokens, self.d_model))
+        x = x + pos.astype(self.dtype)[None, :, :]
+
+        for i in range(self.num_layers):
+            x = ViTBlock(self.num_heads, self.mlp_dim, self.dtype,
+                         self.attention_fn, name=f"layer_{i}")(x)
+
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
+        x = jnp.mean(x, axis=1)  # mean-pool over tokens
+        # Head in fp32 (numerically clean logits; resnet.py parity).
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x.astype(jnp.float32))
+
+
+def vit_base16(**kw) -> ViT:
+    return ViT(**kw)
+
+
+def vit_large16(**kw) -> ViT:
+    return ViT(num_layers=24, d_model=1024, num_heads=16,
+               mlp_dim=4096, **kw)
+
+
+def vit_test(**kw) -> ViT:
+    """Tiny config for CI: 8x8 patches over 32x32 → 16 tokens."""
+    kw.setdefault("num_classes", 10)
+    return ViT(patch=8, num_layers=2, d_model=64, num_heads=4,
+               mlp_dim=128, **kw)
+
+
+register_model(ModelEntry(
+    "vit-b16", "vision", vit_base16, ((224, 224, 3), "bfloat16"), 1000))
+register_model(ModelEntry(
+    "vit-l16", "vision", vit_large16, ((224, 224, 3), "bfloat16"), 1000))
+register_model(ModelEntry(
+    "vit-test", "vision", vit_test, ((32, 32, 3), "bfloat16"), 10))

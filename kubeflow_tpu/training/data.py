@@ -118,6 +118,29 @@ def synthetic_causal_lm(
         step += 1
 
 
+def _epoch_batch_indices(n_items, global_batch, seed, epochs, rows,
+                         seed_stride):
+    """Shared epoch loop for the shard iterators: seeded permutation
+    of item order each epoch, this host's row window of each global
+    batch, partial trailing batches dropped."""
+    per_epoch = n_items // global_batch
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        rng = np.random.RandomState(
+            (seed * seed_stride + epoch) % (2 ** 31))
+        order = rng.permutation(n_items)
+        for b in range(per_epoch):
+            yield order[b * global_batch + rows.start:
+                        b * global_batch + rows.stop]
+        epoch += 1
+
+
+def _locate(offsets, i: int):
+    """Flat index → (shard, local offset) via the cumulative sizes."""
+    s = int(np.searchsorted(offsets, i, side="right") - 1)
+    return s, int(i - offsets[s])
+
+
 def image_shard_batches(
     image_paths: Sequence[str],
     label_paths: Sequence[str],
@@ -187,26 +210,17 @@ def _image_shard_iter(images, labels, offsets, total, global_batch,
                       seed, epochs, np_dtype, scale, rows
                       ) -> Iterator[Batch]:
     def read(i: int):
-        s = int(np.searchsorted(offsets, i, side="right") - 1)
-        local = i - offsets[s]
+        s, local = _locate(offsets, i)
         return images[s][local], labels[s][local]
 
-    per_epoch = total // global_batch
-    epoch = 0
-    while epochs is None or epoch < epochs:
-        rng = np.random.RandomState((seed * 9_999_991 + epoch) % (2 ** 31))
-        order = rng.permutation(total)
-        for b in range(per_epoch):
-            mine = order[b * global_batch + rows.start:
-                         b * global_batch + rows.stop]
-            pairs = [read(int(i)) for i in mine]
-            batch_images = np.stack([p[0] for p in pairs])
-            batch = (batch_images.astype(np.float32) * scale
-                     ).astype(np_dtype)
-            yield {"inputs": batch,
-                   "labels": np.stack([p[1] for p in pairs]).astype(
-                       np.int32)}
-        epoch += 1
+    for mine in _epoch_batch_indices(total, global_batch, seed, epochs,
+                                     rows, seed_stride=9_999_991):
+        pairs = [read(int(i)) for i in mine]
+        batch = (np.stack([p[0] for p in pairs]).astype(np.float32)
+                 * scale).astype(np_dtype)
+        yield {"inputs": batch,
+               "labels": np.stack([p[1] for p in pairs]).astype(
+                   np.int32)}
 
 
 def token_shard_batches(
@@ -274,30 +288,24 @@ def _token_shard_iter(arrays, offsets, n_chunks, global_batch, seq_len,
                       seed, epochs, dtype, rows) -> Iterator[Batch]:
 
     def read_chunk(i: int) -> np.ndarray:
-        start, stop = i * seq_len, (i + 1) * seq_len
-        s = int(np.searchsorted(offsets, start, side="right") - 1)
+        start = i * seq_len
+        s, local = _locate(offsets, start)
         out = np.empty((seq_len,), np.int64)
         filled = 0
         while filled < seq_len:
-            local = start + filled - offsets[s]
             take = min(seq_len - filled,
-                       arrays[s].shape[0] - int(local))
+                       arrays[s].shape[0] - local)
             out[filled:filled + take] = arrays[s][local:local + take]
             filled += take
             s += 1
+            local = 0
         return out
 
-    per_epoch = n_chunks // global_batch
-    epoch = 0
-    while epochs is None or epoch < epochs:
-        rng = np.random.RandomState((seed * 7_000_003 + epoch) % (2 ** 31))
-        order = rng.permutation(n_chunks)
-        for b in range(per_epoch):
-            mine = order[b * global_batch + rows.start:
-                         b * global_batch + rows.stop]
-            batch = np.stack([read_chunk(int(i)) for i in mine])
-            yield {"input_ids": batch.astype(dtype)}
-        epoch += 1
+    for mine in _epoch_batch_indices(n_chunks, global_batch, seed,
+                                     epochs, rows,
+                                     seed_stride=7_000_003):
+        batch = np.stack([read_chunk(int(i)) for i in mine])
+        yield {"input_ids": batch.astype(dtype)}
 
 
 class DevicePrefetcher:

@@ -1,0 +1,123 @@
+"""ViT family: shapes, training, tensor-parallel mesh step, serving."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kubeflow_tpu.models.registry import get_model
+from kubeflow_tpu.models.vit import ViT, vit_test
+from kubeflow_tpu.training.train import (
+    create_train_state,
+    make_train_step,
+    place_batch,
+    place_state,
+)
+
+
+def test_forward_shapes_and_registry():
+    model = get_model("vit-test").make()
+    x = jnp.zeros((2, 32, 32, 3), jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    # 16 tokens for 32²/p8; pos embedding matches.
+    pos = variables["params"]["pos_embed"]
+    import flax.linen as nn
+
+    assert nn.meta.unbox(pos).shape == (16, 64)
+
+
+def test_patch_divisibility_validated():
+    model = vit_test()
+    with pytest.raises(ValueError, match="divisible by patch"):
+        model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, 30, 30, 3), jnp.bfloat16))
+
+
+def test_vit_trains_single_device():
+    model = vit_test(dtype=jnp.float32)
+    state = create_train_state(
+        model, optax.adamw(1e-3), jax.random.PRNGKey(0),
+        jnp.zeros((1, 32, 32, 3), jnp.float32))
+    assert state.batch_stats is None  # LN, not BN
+    step = make_train_step(None, donate=False)
+    rng = np.random.RandomState(0)
+    batch = {"inputs": jnp.asarray(rng.rand(8, 32, 32, 3), jnp.float32),
+             "labels": jnp.asarray(rng.randint(0, 10, 8))}
+    _, first = step(state, batch)
+    for _ in range(10):
+        state, metrics = step(state, batch)
+    assert float(metrics["loss"]) < float(first["loss"])
+
+
+def test_vit_dp_fsdp_mesh_step():
+    """The vision trainer's sharded path runs ViT unchanged (the
+    partitioning annotations ride the same rule set as BERT's)."""
+    from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2),
+                      jax.devices("cpu")[:4])
+    model = vit_test()
+    state = create_train_state(
+        model, optax.sgd(0.1), jax.random.PRNGKey(0),
+        jnp.zeros((1, 32, 32, 3), jnp.bfloat16))
+    state = place_state(mesh, state)
+    rng = jax.random.PRNGKey(1)
+    batch = place_batch(mesh, {
+        "inputs": jax.random.normal(rng, (8, 32, 32, 3), jnp.bfloat16),
+        "labels": jax.random.randint(rng, (8,), 0, 10)})
+    step = make_train_step(mesh, donate=False)
+    state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    assert int(state.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_vit_serves_through_export():
+    """Export → load → predict/classify through the serving stack."""
+    import pathlib
+    import tempfile
+
+    from kubeflow_tpu.serving.export import export_model
+    from kubeflow_tpu.serving.model import load_version
+    from kubeflow_tpu.serving.signature import (
+        ModelMetadata,
+        Signature,
+        TensorSpec,
+    )
+
+    base = pathlib.Path(tempfile.mkdtemp()) / "vit"
+    model = vit_test()
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3), jnp.bfloat16),
+                           train=False)
+    meta = ModelMetadata(
+        model_name="vit", registry_name="vit-test",
+        signatures={"serving_default": Signature(
+            method="classify",
+            inputs={"images": TensorSpec("float32", (-1, 32, 32, 3))},
+            outputs={"classes": TensorSpec("int32", (-1, 5)),
+                     "scores": TensorSpec("float32", (-1, 5))})})
+    export_model(str(base), 1, meta, variables)
+    loaded = load_version(str(base / "1"))
+    out = loaded.run({"images": np.zeros((2, 32, 32, 3), np.float32)})
+    assert out["classes"].shape == (2, 5)
+    assert np.allclose(out["scores"].sum(axis=1) <= 1.0 + 1e-5, True)
+
+
+def test_vit_export_cli_path():
+    import tempfile
+
+    from kubeflow_tpu.serving.export_cli import export_from_checkpoint
+    from kubeflow_tpu.serving.model import load_version
+
+    out = tempfile.mkdtemp()
+    path = export_from_checkpoint(
+        registry_name="vit-test", out=out, version=1)
+    loaded = load_version(path)
+    got = loaded.run({"images": np.zeros((1, 32, 32, 3), np.float32)})
+    assert got["logits"].shape == (1, 10)
