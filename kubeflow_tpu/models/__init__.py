@@ -4,8 +4,9 @@ The reference's models were external (tf_cnn_benchmarks cloned into the
 training image, ``tf-controller-examples/tf-cnn/Dockerfile.template:17-27``;
 inception SavedModel for serving). Here the benchmark models are
 in-tree JAX code: ResNet-50 and Inception-v3 (the tf-cnn families),
-BERT (multi-host baseline config) and a Llama-style decoder (long
-context / notebook fine-tune config).
+ViT-B/16-L/16 (beyond-parity vision transformer, the tree's highest
+measured MFU), BERT (multi-host baseline config) and a Llama-style
+decoder (long context / notebook fine-tune config).
 """
 
 from kubeflow_tpu.models.registry import get_model, list_models, register_model  # noqa: F401
