@@ -17,6 +17,7 @@ jax.distributed env.
 from __future__ import annotations
 
 import dataclasses
+import datetime
 import logging
 from typing import Any, Dict, List, Optional
 
@@ -44,6 +45,34 @@ DEFAULT_COMPLETION_GRACE_PASSES = 3
 JOB_LABEL = "kubeflow.org/tpujob"
 REPLICA_TYPE_LABEL = "kubeflow.org/replica-type"
 REPLICA_INDEX_LABEL = "kubeflow.org/replica-index"
+
+
+def _update_conditions(status: Dict[str, Any], phase: str,
+                       reason: Optional[str]) -> None:
+    """Maintain k8s-conventional status.conditions (one entry per
+    phase type; `status` True on the current phase, False on the
+    rest; lastTransitionTime only moves on actual transitions) —
+    the tf-operator's TFJobCondition surface, which kubectl
+    describe/wait and the dashboard consume."""
+    now = datetime.datetime.now(datetime.timezone.utc).isoformat()
+    conditions = {c["type"]: c for c in status.get("conditions", [])}
+    for cond_type in ("Pending", "Running", "Restarting",
+                      "Succeeded", "Failed"):
+        active = cond_type == phase
+        entry = conditions.get(cond_type)
+        wanted = "True" if active else "False"
+        if entry is None:
+            if not active:
+                continue  # don't materialize never-entered states
+            entry = {"type": cond_type, "status": wanted,
+                     "lastTransitionTime": now}
+            conditions[cond_type] = entry
+        elif entry["status"] != wanted:
+            entry["status"] = wanted
+            entry["lastTransitionTime"] = now
+        if active and reason:
+            entry["reason"] = reason
+    status["conditions"] = list(conditions.values())
 
 
 @dataclasses.dataclass
@@ -284,6 +313,11 @@ class Reconciler:
             status["completionSkewPasses"] = completion_skew
             if reason:
                 status["reason"] = reason
+            else:
+                # A reason describes THIS phase only: a recovered job
+                # must not carry a stale 'slice fault' into Succeeded.
+                status.pop("reason", None)
+            _update_conditions(status, phase, reason)
 
         try:
             self.api.patch(KIND, ns, name, mutate)

@@ -296,3 +296,42 @@ def test_restarting_holds_while_pods_terminate():
     job = api.get("TPUJob", "default", "job1")
     assert r.reconcile(job) == "Running"
     assert len(api.list("Pod", "default", {JOB_LABEL: "job1"})) == 2
+
+
+def test_status_conditions_track_lifecycle():
+    """k8s-conventional status.conditions (the tf-operator's
+    TFJobCondition surface): one entry per entered phase, exactly one
+    True, transition times only move on transitions."""
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=2))
+    r = Reconciler(api)
+    r.reconcile(job)
+    job = api.get("TPUJob", "default", "job1")
+    conds = {c["type"]: c for c in job["status"]["conditions"]}
+    assert conds["Pending"]["status"] == "True"
+    assert "Running" not in conds  # never entered yet
+
+    api.set_all_pod_phases("default", "Running", {JOB_LABEL: "job1"})
+    r.reconcile(api.get("TPUJob", "default", "job1"))
+    job = api.get("TPUJob", "default", "job1")
+    conds = {c["type"]: c for c in job["status"]["conditions"]}
+    assert conds["Running"]["status"] == "True"
+    assert conds["Pending"]["status"] == "False"
+    running_t0 = conds["Running"]["lastTransitionTime"]
+
+    # A second identical pass must not move the transition time.
+    r.reconcile(api.get("TPUJob", "default", "job1"))
+    job = api.get("TPUJob", "default", "job1")
+    conds = {c["type"]: c for c in job["status"]["conditions"]}
+    assert conds["Running"]["lastTransitionTime"] == running_t0
+
+    # Failure path: worker dies → Restarting condition with reason.
+    api.set_pod_phase("default", "job1-tpu-worker-1", "Failed")
+    r.reconcile(api.get("TPUJob", "default", "job1"))
+    job = api.get("TPUJob", "default", "job1")
+    conds = {c["type"]: c for c in job["status"]["conditions"]}
+    assert conds["Restarting"]["status"] == "True"
+    assert "slice fault" in conds["Restarting"]["reason"]
+    assert conds["Running"]["status"] == "False"
+    assert sum(c["status"] == "True"
+               for c in job["status"]["conditions"]) == 1
