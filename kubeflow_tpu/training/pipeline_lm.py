@@ -79,7 +79,8 @@ def _block_for(model: Llama) -> LlamaBlock:
             "pipeline trainer supports dense training blocks only "
             "(no MoE/cache/LoRA) — compose ep or LoRA with dp/fsdp/tp "
             "presets instead")
-    return LlamaBlock(
+    block_cls = nn.remat(LlamaBlock) if model.remat else LlamaBlock
+    return block_cls(
         model.num_heads, model.num_kv_heads,
         model.d_model // model.num_heads, model.mlp_dim,
         model.rope_theta, model.dtype, model.attention_fn)

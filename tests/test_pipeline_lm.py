@@ -149,3 +149,32 @@ def test_pipeline_rejects_unsupported_blocks():
 
     with pytest.raises(ValueError, match="dense training blocks"):
         _block_for(llama_test(lora_rank=4))
+
+
+def test_staged_forward_respects_remat():
+    """A remat=True model pipelines with rematerialized blocks and
+    still matches the unpipelined forward (remat changes memory, not
+    math)."""
+    model = llama_test(dtype="float32", remat=True)
+    batch = _batch(rows=4, length=8)
+    import flax.linen as nn
+
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), batch["input_ids"])["params"])
+    want = model.apply({"params": params}, batch["input_ids"])
+    mesh = build_mesh(MeshSpec(data=2, pipeline=2),
+                      jax.devices("cpu")[:4])
+    staged = partition_llama_params(params, 2)
+
+    def loss(p, x):
+        logits = staged_llama_forward(model, p, x, mesh=mesh,
+                                      n_microbatches=2)
+        return jnp.mean(logits ** 2), logits
+
+    (l, got), grads = jax.jit(jax.value_and_grad(loss, has_aux=True))(
+        staged, batch["input_ids"])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    assert np.isfinite(float(l))
+    assert all(np.isfinite(np.asarray(g)).all()
+               for g in jax.tree.leaves(grads))
