@@ -99,6 +99,56 @@ def finish_predict(spec, loaded, outputs, output_filter) -> bytes:
         outputs, spec["name"], loaded.version)
 
 
+def start_classify(manager: ModelManager, request_bytes: bytes):
+    """Shared Classify front half: decode tf.Examples → dense batch →
+    submit. Returns (spec, loaded, future)."""
+    spec, examples = wire.decode_classification_request(request_bytes)
+    if not examples:
+        raise ValueError("ClassificationRequest carries no examples")
+    model = manager.get_model(spec["name"])
+    loaded = model.get(spec["version"])
+    sig = loaded.signature(spec["signature_name"] or None)
+    input_name, input_spec = next(iter(sig.inputs.items()))
+    batch = _examples_to_batch(examples, input_name,
+                               tuple(input_spec.shape[1:]))
+    future = model.submit({input_name: batch},
+                          spec["signature_name"] or None,
+                          "classify", spec["version"])
+    return spec, loaded, future
+
+
+def finish_classify(spec, loaded, outputs) -> bytes:
+    classifications = _to_classifications(
+        outputs, loaded.metadata.classes)
+    return wire.encode_classification_response(
+        classifications, spec["name"], loaded.version)
+
+
+def get_model_metadata(manager: ModelManager,
+                       request_bytes: bytes) -> bytes:
+    """Shared GetModelMetadata body (no batcher round trip)."""
+    spec, fields = wire.decode_get_model_metadata_request(request_bytes)
+    unsupported = [f for f in fields if f != "signature_def"]
+    if unsupported:
+        raise ValueError(
+            f"unsupported metadata_field {unsupported}; "
+            f"only 'signature_def' is served")
+    model = manager.get_model(spec["name"])
+    loaded = model.get(spec["version"])
+    signatures = {
+        name: {
+            "method": sig.method,
+            "inputs": {k: (v.dtype, v.shape)
+                       for k, v in sig.inputs.items()},
+            "outputs": {k: (v.dtype, v.shape)
+                        for k, v in sig.outputs.items()},
+        }
+        for name, sig in loaded.metadata.signatures.items()
+    }
+    return wire.encode_get_model_metadata_response(
+        spec["name"], loaded.version, signatures)
+
+
 class PredictionService:
     """Raw-bytes method behaviors for the generic handler."""
 
@@ -121,23 +171,9 @@ class PredictionService:
 
     def Classify(self, request: bytes, context) -> bytes:
         try:
-            spec, examples = wire.decode_classification_request(request)
-            if not examples:
-                raise ValueError("ClassificationRequest carries no examples")
-            model = self._manager.get_model(spec["name"])
-            loaded = model.get(spec["version"])
-            sig = loaded.signature(spec["signature_name"] or None)
-            input_name, input_spec = next(iter(sig.inputs.items()))
-            batch = _examples_to_batch(examples, input_name,
-                                       tuple(input_spec.shape[1:]))
-            future = model.submit({input_name: batch},
-                                  spec["signature_name"] or None,
-                                  "classify", spec["version"])
+            spec, loaded, future = start_classify(self._manager, request)
             outputs = future.result(self._timeout_s)
-            classifications = _to_classifications(
-                outputs, loaded.metadata.classes)
-            return wire.encode_classification_response(
-                classifications, spec["name"], loaded.version)
+            return finish_classify(spec, loaded, outputs)
         except Exception as e:  # noqa: BLE001
             _abort_for(context, e)
 
@@ -145,26 +181,7 @@ class PredictionService:
 
     def GetModelMetadata(self, request: bytes, context) -> bytes:
         try:
-            spec, fields = wire.decode_get_model_metadata_request(request)
-            unsupported = [f for f in fields if f != "signature_def"]
-            if unsupported:
-                raise ValueError(
-                    f"unsupported metadata_field {unsupported}; "
-                    f"only 'signature_def' is served")
-            model = self._manager.get_model(spec["name"])
-            loaded = model.get(spec["version"])
-            signatures = {
-                name: {
-                    "method": sig.method,
-                    "inputs": {k: (v.dtype, v.shape)
-                               for k, v in sig.inputs.items()},
-                    "outputs": {k: (v.dtype, v.shape)
-                                for k, v in sig.outputs.items()},
-                }
-                for name, sig in loaded.metadata.signatures.items()
-            }
-            return wire.encode_get_model_metadata_response(
-                spec["name"], loaded.version, signatures)
+            return get_model_metadata(self._manager, request)
         except Exception as e:  # noqa: BLE001
             _abort_for(context, e)
 

@@ -21,7 +21,8 @@ HTTP endpoints:
   POST /v1/models/<name>[/versions/<v>]:predict   {"instances": ...}
   POST /v1/models/<name>[/versions/<v>]:classify  {"instances": ...}
   POST /v1/models/<name>[/versions/<v>]:generate  {"instances": ...}
-  POST /tensorflow.serving.PredictionService/Predict  (grpc-web+proto)
+  POST /tensorflow.serving.PredictionService/
+       (Predict|Classify|GetModelMetadata)           (grpc-web+proto)
   GET  /healthz
 """
 
@@ -39,6 +40,10 @@ import tornado.web
 from kubeflow_tpu.serving.manager import ModelManager
 
 logger = logging.getLogger(__name__)
+
+# Batcher-await deadline for the gRPC-Web bridge (matches the native
+# transport's make_server(timeout_s=...) default).
+GRPC_WEB_TIMEOUT_S = 30.0
 
 
 def _json_default(obj: Any):
@@ -175,17 +180,18 @@ def _batch_to_instances(outputs: Dict[str, np.ndarray]) -> list:
 
 
 class GrpcWebPredictHandler(BaseHandler):
-    """gRPC-Web Predict: the PredictionService wire surface.
+    """gRPC-Web PredictionService: Predict, Classify, GetModelMetadata.
 
-    POST /tensorflow.serving.PredictionService/Predict with
-    application/grpc-web+proto — the same PredictRequest/
-    PredictResponse schema the reference's gRPC clients speak
-    (inception-client/label.py:40-56); Envoy's grpc_web filter bridges
-    native gRPC clients to this over HTTP/1.1. See serving/wire.py for
-    why a raw-HTTP/2 gRPC listener isn't built here.
+    POST /tensorflow.serving.PredictionService/<Method> with
+    application/grpc-web+proto — the same message schemas the
+    reference's gRPC clients speak (inception-client/label.py:40-56);
+    Envoy's grpc_web filter bridges browser gRPC-Web clients to these
+    over HTTP/1.1 (all three verbs, so the bridged surface equals the
+    native :9000 one). The service bodies are shared with the native
+    transport (serving/grpc_server.py); only the await style differs.
     """
 
-    async def post(self):
+    async def post(self, method: str):
         import base64
         import concurrent.futures
 
@@ -199,10 +205,7 @@ class GrpcWebPredictHandler(BaseHandler):
             return self.write_json(
                 {"error": f"unsupported content-type {ctype!r}"}, 415)
         try:
-            from kubeflow_tpu.serving.grpc_server import (
-                finish_predict,
-                start_predict,
-            )
+            from kubeflow_tpu.serving import grpc_server as svc
 
             body = self.request.body
             if self._text_mode:  # grpc-web-text = base64-wrapped frames
@@ -211,13 +214,24 @@ class GrpcWebPredictHandler(BaseHandler):
             data = [m for flags, m in frames if not flags & 0x80]
             if len(data) != 1:
                 raise ValueError(f"expected 1 message frame, got {len(data)}")
-            # Same decode→validate→submit→filter→encode halves as the
-            # native-gRPC transport; only the await style differs.
-            spec, loaded, future, output_filter = start_predict(
-                self.manager, data[0])
-            outputs = await tornado.ioloop.IOLoop.current().run_in_executor(
-                None, future.result, 30.0)
-            body = finish_predict(spec, loaded, outputs, output_filter)
+            loop = tornado.ioloop.IOLoop.current()
+            if method == "Predict":
+                spec, loaded, future, output_filter = svc.start_predict(
+                    self.manager, data[0])
+                finish = lambda out: svc.finish_predict(  # noqa: E731
+                    spec, loaded, out, output_filter)
+            elif method == "Classify":
+                spec, loaded, future = svc.start_classify(
+                    self.manager, data[0])
+                finish = lambda out: svc.finish_classify(  # noqa: E731
+                    spec, loaded, out)
+            else:  # GetModelMetadata (route regex restricts the set)
+                future, finish = None, None
+                body = svc.get_model_metadata(self.manager, data[0])
+            if future is not None:
+                outputs = await loop.run_in_executor(
+                    None, future.result, GRPC_WEB_TIMEOUT_S)
+                body = finish(outputs)
             self._grpc_reply(wire.frame_message(body)
                              + wire.trailers_frame(0))
         except KeyError as e:
@@ -259,7 +273,8 @@ def make_app(manager: ModelManager) -> tornado.web.Application:
         (r"/v1/models/([^/:]+)/metadata", MetadataHandler),
         (r"/v1/models/([^/:]+)(?:/versions/(\d+))?:(predict|classify|generate)",
          InferHandler),
-        (r"/tensorflow\.serving\.PredictionService/Predict",
+        (r"/tensorflow\.serving\.PredictionService/"
+         r"(Predict|Classify|GetModelMetadata)",
          GrpcWebPredictHandler),
     ], manager=manager)
 

@@ -79,7 +79,11 @@ def _block_for(model: Llama) -> LlamaBlock:
             "pipeline trainer supports dense training blocks only "
             "(no MoE/cache/LoRA) — compose ep or LoRA with dp/fsdp/tp "
             "presets instead")
-    block_cls = nn.remat(LlamaBlock) if model.remat else LlamaBlock
+    # prevent_cse=False: the block applies inside the per-stage
+    # lax.scan, where checkpointing doesn't need (and shouldn't pay
+    # for) the CSE-blocking barriers the default inserts.
+    block_cls = (nn.remat(LlamaBlock, prevent_cse=False)
+                 if model.remat else LlamaBlock)
     return block_cls(
         model.num_heads, model.num_kv_heads,
         model.d_model // model.num_heads, model.mlp_dim,

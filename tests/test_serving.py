@@ -211,6 +211,39 @@ class ServingEndToEnd(tornado.testing.AsyncHTTPTestCase):
                           body=json.dumps({}))
         assert resp.code == 400
 
+    def test_grpc_web_classify_and_metadata(self):
+        """The bridged surface carries ALL three PredictionService
+        verbs, not just Predict — Envoy's grpc_web filter routes any
+        method to POST /<service>/<Method>."""
+        from kubeflow_tpu.serving import wire
+
+        def call(method, message):
+            resp = self.fetch(
+                f"/tensorflow.serving.PredictionService/{method}",
+                method="POST", body=wire.frame_message(message),
+                headers={"Content-Type": "application/grpc-web+proto"})
+            assert resp.code == 200, resp.body
+            frames = wire.unframe_messages(resp.body)
+            payloads = [m for flags, m in frames if not flags & 0x80]
+            trailers = [m for flags, m in frames if flags & 0x80]
+            assert trailers and b"grpc-status:0" in trailers[0], frames
+            return payloads[0]
+
+        # GetModelMetadata — the reference proxy's bootstrap call.
+        reply = call("GetModelMetadata",
+                     wire.encode_get_model_metadata_request("testnet"))
+        _, signatures = wire.decode_get_model_metadata_response(reply)
+        assert "serving_default" in signatures
+
+        # Classify with tf.Example rows.
+        x = np.random.RandomState(5).rand(32 * 32 * 3).astype(np.float32)
+        reply = call("Classify", wire.encode_classification_request(
+            "testnet", [{"images": x}]))
+        _, rows = wire.decode_classification_response(reply)
+        assert len(rows) == 1 and len(rows[0]) == 5
+        scores = [s for _, s in rows[0]]
+        assert all(np.diff(scores) <= 1e-6)
+
     def test_grpc_web_predict_wire_surface(self):
         """The PredictionService wire path end-to-end: framed
         PredictRequest in, framed PredictResponse + trailers out,
