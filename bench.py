@@ -58,6 +58,27 @@ def main() -> int:
         extra[f"{result['model']}_mfu_pct"] = result["mfu_pct"]
         extra[f"{result['model']}_step_time_ms"] = round(
             result["step_time_ms"], 2)
+    # ViT-B/16: the tree's highest-MFU model (42% nominal measured,
+    # PERF.md) — recorded alongside the CNN headline as the
+    # transformer-vision row.
+    try:
+        vit = run_benchmark(BenchConfig(
+            model="vit-b16" if on_tpu else "vit-test",
+            # Scale with device count like the headline row so the
+            # per-chip batch (256) matches the PERF.md measurement.
+            batch_size=256 * n if on_tpu else 16,
+            steps=15 if on_tpu else 2,
+            warmup_steps=2 if on_tpu else 1,
+        ))
+        extra[f"{vit['model']}_images_per_sec_per_chip"] = round(
+            vit["images_per_sec_per_chip"], 1)
+        extra[f"{vit['model']}_step_time_ms"] = round(
+            vit["step_time_ms"], 2)
+        if "mfu_pct" in vit:
+            extra[f"{vit['model']}_mfu_pct"] = vit["mfu_pct"]
+    except Exception as e:  # secondary line; never sink the bench
+        extra["vit_bench_error"] = str(e)[:200]
+
     lm_config = LMBenchConfig(
         model="bert-base" if on_tpu else "bert-test",
         batch_size=32 if on_tpu else 8,  # CPU: divisible by the 8-dev mesh
