@@ -151,10 +151,15 @@ def main() -> int:
             model="inception-v3" if on_tpu else "resnet-test",
             image_hw=299 if on_tpu else 32,
             clients=2, requests_per_client=16, warmup_requests=4,
+            transport="both",
         ))
-        extra[f"{serving['model']}_serving_p50_ms"] = serving["p50_ms"]
-        extra[f"{serving['model']}_serving_p99_ms"] = serving["p99_ms"]
-        extra[f"{serving['model']}_serving_rps"] = serving["throughput_rps"]
+        m = serving["model"]
+        extra[f"{m}_serving_p50_ms"] = serving["http_p50_ms"]
+        extra[f"{m}_serving_p99_ms"] = serving["http_p99_ms"]
+        extra[f"{m}_serving_rps"] = serving["http_throughput_rps"]
+        extra[f"{m}_serving_grpc_p50_ms"] = serving["grpc_p50_ms"]
+        extra[f"{m}_serving_grpc_p99_ms"] = serving["grpc_p99_ms"]
+        extra[f"{m}_serving_grpc_rps"] = serving["grpc_throughput_rps"]
     except Exception as e:  # serving line is secondary too
         extra["serving_bench_error"] = str(e)[:200]
 

@@ -74,7 +74,8 @@ def proxy_container(p: Dict[str, Any]) -> Dict[str, Any]:
     return k8s.container(
         f"{p['name']}-http-proxy", p["http_proxy_image"],
         command=["python", "-m", "kubeflow_tpu.serving.http_proxy"],
-        args=["--port=8000", "--rpc_port=8500", "--rpc_timeout=10.0"],
+        args=["--port=8000", "--rpc_port=8500", "--grpc_port=9000",
+              "--rpc_timeout=10.0"],
         ports=[k8s.port(8000, "http")],
         resources=k8s.resources(cpu_request="500m", memory_request="500Mi",
                                 cpu_limit="1", memory_limit="1Gi"),

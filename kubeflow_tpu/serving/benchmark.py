@@ -3,10 +3,20 @@
 BASELINE.md target "Inception-v3 p50 predict latency" (the reference
 measured nothing — its serving test was a correctness golden with a
 10 s timeout, testing/test_tf_serving.py:75-108). This drives the real
-HTTP server (tornado, real sockets) with concurrent clients and a
-deterministic image, and also times the bare model execution so the
-Python data-plane overhead (HTTP + JSON + batcher) is quantified
-rather than guessed.
+servers over real sockets and quantifies, rather than guesses, the
+data-plane overhead on top of XLA:
+
+- transport "http": the REST/JSON surface (tornado, :8500-equivalent).
+- transport "grpc": the native :9000 PredictionService with binary
+  TensorProto payloads — the reference client's wire
+  (components/k8s-model-server/inception-client/label.py:40-56,
+  proxy upstream http-proxy/server.py:219-236).
+- transport "both": same server process, same loaded model, both
+  wires — a controlled JSON-vs-binary comparison.
+
+A sweep mode re-runs the drive at increasing client counts and reads
+the micro-batcher's fill statistics (ServedModel.batch_stats), so the
+batching win is measured, not asserted.
 """
 
 from __future__ import annotations
@@ -18,7 +28,7 @@ import tempfile
 import threading
 import time
 import urllib.request
-from typing import Dict
+from typing import Callable, Dict, List, Sequence
 
 import numpy as np
 
@@ -34,6 +44,11 @@ class ServingBenchConfig:
     # bench doesn't spend minutes warming buckets it never fills.
     max_batch: int = 4
     port: int = 0  # 0 = ephemeral (repeat runs can't collide)
+    transport: str = "http"  # http | grpc | both
+    # Non-empty → concurrency sweep: for each N run the drive with N
+    # clients and report rps + mean batch fill (uses `transport`, or
+    # grpc when transport="both" — the cheaper wire isolates batching).
+    sweep_clients: Sequence[int] = ()
 
 
 def _export(config: ServingBenchConfig) -> str:
@@ -89,38 +104,12 @@ def _serve(manager, port: int, handle: _ServerHandle):
     handle.loop.start()
 
 
-def run_serving_benchmark(config: ServingBenchConfig) -> Dict[str, float]:
-    from kubeflow_tpu.serving.manager import ModelManager
+def _http_request_fn(port: int, payload: bytes) -> Callable[[], float]:
+    """One JSON :classify round trip (urllib, fresh connection per
+    request — the reference client's behavior)."""
+    url = f"http://127.0.0.1:{port}/v1/models/bench:classify"
 
-    base = _export(config)
-    manager = ModelManager(poll_interval_s=3600)
-    model = manager.add_model("bench", base, max_batch=config.max_batch)
-
-    handle = _ServerHandle()
-    server_thread = threading.Thread(
-        target=_serve, args=(manager, config.port, handle), daemon=True)
-    server_thread.start()
-    assert handle.started.wait(30), "server thread never started"
-    try:
-        return _drive(config, manager, model, handle)
-    finally:
-        handle.loop.add_callback(handle.loop.stop)
-        server_thread.join(10)
-        manager.stop()
-        import shutil
-
-        shutil.rmtree(pathlib.Path(base).parent, ignore_errors=True)
-
-
-def _drive(config: ServingBenchConfig, manager, model,
-           handle: _ServerHandle) -> Dict[str, float]:
-    hw = config.image_hw
-    rng = np.random.RandomState(42)
-    image = (rng.randint(0, 256, (1, hw, hw, 3)) / 255.0).astype(np.float32)
-    payload = json.dumps({"instances": image.tolist()}).encode()
-    url = (f"http://127.0.0.1:{handle.port}/v1/models/bench:classify")
-
-    def one_request(timeout=120.0) -> float:
+    def one_request(timeout: float = 120.0) -> float:
         req = urllib.request.Request(
             url, data=payload, headers={"Content-Type": "application/json"})
         t0 = time.perf_counter()
@@ -130,19 +119,76 @@ def _drive(config: ServingBenchConfig, manager, model,
         assert "predictions" in body, body
         return dt
 
-    # Warmup: first request compiles the predict buckets.
-    for _ in range(config.warmup_requests):
-        one_request()
+    return one_request
 
-    latencies = []
+
+def _grpc_request_fn(channel, request: bytes) -> Callable[[], float]:
+    """One binary Predict round trip on a persistent channel (the
+    reference client dialed once and reused the stub, label.py:40-43)."""
+    from kubeflow_tpu.serving import wire
+
+    call = channel.unary_unary("/tensorflow.serving.PredictionService/Predict")
+
+    def one_request(timeout: float = 120.0) -> float:
+        t0 = time.perf_counter()
+        response = call(request, timeout=timeout)
+        dt = time.perf_counter() - t0
+        _, outputs = wire.decode_predict_response(response)
+        assert "scores" in outputs, sorted(outputs)
+        return dt
+
+    return one_request
+
+
+def run_serving_benchmark(config: ServingBenchConfig) -> Dict[str, float]:
+    from kubeflow_tpu.serving.manager import ModelManager
+
+    if config.transport not in ("http", "grpc", "both"):
+        raise ValueError(f"unknown transport {config.transport!r}")
+    # http-only runs stay grpcio-free (the pre-r4 behavior): the gRPC
+    # listener only starts when that wire is actually under test.
+    want_grpc = config.transport in ("grpc", "both")
+    base = _export(config)
+    manager = ModelManager(poll_interval_s=3600)
+    model = manager.add_model("bench", base, max_batch=config.max_batch)
+
+    handle = _ServerHandle()
+    server_thread = threading.Thread(
+        target=_serve, args=(manager, config.port, handle), daemon=True)
+    server_thread.start()
+    assert handle.started.wait(30), "server thread never started"
+    grpc_server, grpc_port = None, 0
+    if want_grpc:
+        from kubeflow_tpu.serving.grpc_server import make_server
+
+        grpc_server, grpc_port = make_server(manager, 0)
+        grpc_server.start()
+    try:
+        return _drive(config, manager, model, handle, grpc_port)
+    finally:
+        if grpc_server is not None:
+            grpc_server.stop(grace=1)
+        handle.loop.add_callback(handle.loop.stop)
+        server_thread.join(10)
+        manager.stop()
+        import shutil
+
+        shutil.rmtree(pathlib.Path(base).parent, ignore_errors=True)
+
+
+def _measure(request_fn: Callable[[], float], clients: int,
+             requests_per_client: int) -> Dict[str, float]:
+    """Run `clients` threads × `requests_per_client` requests through
+    request_fn; return latency percentiles + aggregate rps."""
+    latencies: List[float] = []
     lat_lock = threading.Lock()
-    errors = []
+    errors: List[str] = []
 
     def client():
         try:
             mine = []
-            for _ in range(config.requests_per_client):
-                mine.append(one_request())
+            for _ in range(requests_per_client):
+                mine.append(request_fn())
             with lat_lock:
                 latencies.extend(mine)
         except Exception as e:  # noqa: BLE001
@@ -150,8 +196,7 @@ def _drive(config: ServingBenchConfig, manager, model,
                 errors.append(repr(e))
 
     start = time.perf_counter()
-    threads = [threading.Thread(target=client)
-               for _ in range(config.clients)]
+    threads = [threading.Thread(target=client) for _ in range(clients)]
     for t in threads:
         t.start()
     for t in threads:
@@ -163,8 +208,76 @@ def _drive(config: ServingBenchConfig, manager, model,
     elapsed = time.perf_counter() - start
     assert not errors, errors[:3]
 
+    lat = np.asarray(latencies) * 1e3
+    return {
+        "requests": len(latencies),
+        "p50_ms": round(float(np.percentile(lat, 50)), 2),
+        "p90_ms": round(float(np.percentile(lat, 90)), 2),
+        "p99_ms": round(float(np.percentile(lat, 99)), 2),
+        "throughput_rps": round(len(latencies) / elapsed, 1),
+    }
+
+
+def _drive(config: ServingBenchConfig, manager, model,
+           handle: _ServerHandle, grpc_port: int) -> Dict[str, float]:
+    hw = config.image_hw
+    rng = np.random.RandomState(42)
+    image = (rng.randint(0, 256, (1, hw, hw, 3)) / 255.0).astype(np.float32)
+
+    json_payload = json.dumps({"instances": image.tolist()}).encode()
+    sizes = {"json_request_bytes": len(json_payload)}
+    transports: Dict[str, Callable[[], float]] = {}
+    channel = None
+    if config.transport in ("http", "both"):
+        transports["http"] = _http_request_fn(handle.port, json_payload)
+    if config.transport in ("grpc", "both"):
+        import grpc
+
+        from kubeflow_tpu.serving import wire
+
+        grpc_request = wire.encode_predict_request(
+            "bench", {"images": image})
+        sizes["grpc_request_bytes"] = len(grpc_request)
+        channel = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
+        transports["grpc"] = _grpc_request_fn(channel, grpc_request)
+
+    # Warmup: first requests compile the predict buckets; warm every
+    # wire under test so neither pays first-touch costs in the timed run.
+    for fn in transports.values():
+        for _ in range(config.warmup_requests):
+            fn()
+
+    result: Dict[str, float] = {
+        "model": config.model,
+        "clients": config.clients,
+        **sizes,
+    }
+    single = len(transports) == 1
+    for name, fn in transports.items():
+        stats = _measure(fn, config.clients, config.requests_per_client)
+        for key, value in stats.items():
+            result[key if single else f"{name}_{key}"] = value
+
+    # Concurrency sweep: batching win vs client count on one wire.
+    if config.sweep_clients:
+        sweep_fn = transports.get("grpc", transports.get("http"))
+        sweep_rows = []
+        for n in config.sweep_clients:
+            model.batch_stats(reset=True)
+            stats = _measure(sweep_fn, n, config.requests_per_client)
+            fill = model.batch_stats()
+            sweep_rows.append({
+                "clients": n,
+                "throughput_rps": stats["throughput_rps"],
+                "p50_ms": stats["p50_ms"],
+                "p99_ms": stats["p99_ms"],
+                "batches": fill["batches"],
+                "mean_batch_fill": fill["mean_fill"],
+            })
+        result["sweep"] = sweep_rows
+
     # Bare model execution for the same single image: quantifies the
-    # HTTP+JSON+batcher overhead on top of XLA.
+    # wire + batcher overhead on top of XLA.
     loaded = model.get()
     direct = []
     for _ in range(16):
@@ -172,18 +285,10 @@ def _drive(config: ServingBenchConfig, manager, model,
         out = loaded.run({"images": image})
         np.asarray(out["scores"])  # host fence
         direct.append(time.perf_counter() - t0)
-
-    lat = np.asarray(latencies) * 1e3
-    return {
-        "model": config.model,
-        "clients": config.clients,
-        "requests": len(latencies),
-        "p50_ms": round(float(np.percentile(lat, 50)), 2),
-        "p90_ms": round(float(np.percentile(lat, 90)), 2),
-        "p99_ms": round(float(np.percentile(lat, 99)), 2),
-        "throughput_rps": round(len(latencies) / elapsed, 1),
-        "direct_model_ms": round(float(np.median(direct)) * 1e3, 2),
-    }
+    result["direct_model_ms"] = round(float(np.median(direct)) * 1e3, 2)
+    if channel is not None:
+        channel.close()
+    return result
 
 
 def main(argv=None) -> int:
@@ -194,12 +299,21 @@ def main(argv=None) -> int:
     parser.add_argument("--image_hw", type=int, default=299)
     parser.add_argument("--clients", type=int, default=4)
     parser.add_argument("--requests_per_client", type=int, default=32)
+    parser.add_argument("--transport", default="http",
+                        choices=("http", "grpc", "both"))
+    parser.add_argument("--sweep", default="",
+                        help="comma-separated client counts, e.g. 1,2,4,8")
+    parser.add_argument("--max_batch", type=int, default=4)
     parser.add_argument("--port", type=int, default=0,
                         help="0 = ephemeral")
     args = parser.parse_args(argv)
+    sweep: Sequence[int] = tuple(
+        int(s) for s in args.sweep.split(",") if s.strip())
     result = run_serving_benchmark(ServingBenchConfig(
         model=args.model, image_hw=args.image_hw, clients=args.clients,
-        requests_per_client=args.requests_per_client, port=args.port))
+        requests_per_client=args.requests_per_client,
+        max_batch=args.max_batch, port=args.port,
+        transport=args.transport, sweep_clients=sweep))
     print(json.dumps(result))
     return 0
 

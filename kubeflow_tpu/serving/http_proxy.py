@@ -15,6 +15,14 @@ Route grammar and behaviors are parity with the reference proxy
   (reference ``:233-236``).
 
 Async end-to-end on tornado, like the original (``:83-106``).
+
+Upstream wire: binary gRPC Predict against the model server's :9000
+(the reference proxy's own upstream design — it built PredictRequest /
+ClassificationRequest protos over a gRPC channel, ``:219-236`` — and
+the measured winner: PERF.md's serving section, binary TensorProto vs
+JSON). The REST/JSON hop remains as fallback for verb/signature-method
+mismatches (the gRPC Predict executes the signature's method) and for
+environments without grpcio.
 """
 
 from __future__ import annotations
@@ -99,6 +107,103 @@ class ProxyHandler(tornado.web.RequestHandler):
 
 
 class InferProxyHandler(ProxyHandler):
+    def _grpc_channel(self):
+        """Lazily-dialed persistent grpc.aio channel to :9000 (the
+        reference dialed once per process, server.py:41-43). Returns
+        None when the binary upstream is disabled or grpcio is absent."""
+        addr = self.application.settings.get("grpc_address")
+        if not addr:
+            return None
+        channel = self.application.settings.get("_grpc_channel")
+        if channel is None:
+            try:
+                import grpc
+            except ImportError:
+                self.application.settings["grpc_address"] = None
+                return None
+            channel = grpc.aio.insecure_channel(addr)
+            self.application.settings["_grpc_channel"] = channel
+        return channel
+
+    async def _grpc_infer(self, name: str, version: Optional[str],
+                          verb: str, instances, body, metadata) -> bool:
+        """Try the binary Predict upstream. Returns True when the
+        response was written (success or mapped gRPC error); False when
+        this request can't ride the binary wire (no channel, unknown
+        signature, or URL verb != signature method — gRPC Predict runs
+        the signature's own method) and the REST hop should run."""
+        channel = self._grpc_channel()
+        if channel is None:
+            return False
+        from kubeflow_tpu.serving import wire
+
+        sig_name = body.get("signature_name") or "serving_default"
+        sig = (metadata.get("metadata", {}).get("signatures", {})
+               .get(sig_name))
+        if not sig or sig.get("method") != verb:
+            return False
+        try:
+            (input_name, spec), = sig["inputs"].items()
+        except ValueError:  # multi-input signature: REST hop handles it
+            return False
+        rows = []
+        for row in instances:
+            value = row[input_name] if (isinstance(row, dict)
+                                        and input_name in row) else row
+            rows.append(value)
+        dtype = spec["dtype"] if spec["dtype"] != "bfloat16" else "float32"
+        try:
+            batch = np.asarray(rows, dtype=dtype)
+        except (ValueError, TypeError) as e:
+            self._metadata_cache.pop(name, None)
+            self.write_json(
+                {"error": f"payload does not match signature: {e}"}, 400)
+            return True
+        request = wire.encode_predict_request(
+            name, {input_name: batch},
+            signature_name=body.get("signature_name") or "",
+            version=int(version) if version else None)
+        call = channel.unary_unary(
+            "/tensorflow.serving.PredictionService/Predict")
+        import grpc
+
+        try:
+            response = await call(request, timeout=self.rpc_timeout)
+        except grpc.aio.AioRpcError as e:
+            if e.code() == grpc.StatusCode.UNAVAILABLE:
+                # :9000 unreachable (older server image, firewalled
+                # port, or genuine overload): fall back to the REST hop
+                # rather than 503-ing traffic a REST-only backend would
+                # serve fine. If the server is truly down, the REST hop
+                # reports its own 502/503 with the accurate story.
+                logger.warning(
+                    "gRPC upstream unavailable (%s); falling back to "
+                    "REST for this request", e.details())
+                return False
+            code = {
+                grpc.StatusCode.NOT_FOUND: 404,
+                grpc.StatusCode.INVALID_ARGUMENT: 400,
+                grpc.StatusCode.DEADLINE_EXCEEDED: 504,
+            }.get(e.code(), 502)
+            # Stale signature cache may be the real culprit (hot
+            # reload): drop it so the next request reconverts fresh.
+            self._metadata_cache.pop(name, None)
+            self.write_json({"error": e.details() or e.code().name}, code)
+            return True
+        spec_out, outputs = wire.decode_predict_response(response)
+        if not version:
+            served = spec_out.get("version")
+            # Cache stores the REST metadata's string version; the wire
+            # decodes an int — normalize or every request invalidates.
+            self.invalidate_if_version_changed(
+                name, str(served) if served is not None else None)
+        keys = sorted(outputs)
+        n = len(outputs[keys[0]]) if keys else 0
+        self.write_json({"predictions": [
+            {k: np.asarray(outputs[k][i]).tolist() for k in keys}
+            for i in range(n)]})
+        return True
+
     async def _infer(self, name: str, version: Optional[str],
                      verb: str) -> None:
         try:
@@ -124,6 +229,12 @@ class InferProxyHandler(ProxyHandler):
             self._metadata_cache.pop(name, None)
             return self.write_json(
                 {"error": f"payload does not match signature: {e}"}, 400)
+        # Binary upstream first (measured winner, PERF.md serving
+        # section); falls through to the REST hop when the request
+        # can't ride it (verb/method mismatch, no grpcio, multi-input).
+        if await self._grpc_infer(name, version, verb, instances, body,
+                                  metadata):
+            return
         path = f"/v1/models/{name}"
         if version:
             path += f"/versions/{version}"
@@ -202,36 +313,47 @@ def _bytes_to_arrays(instances: Any, metadata: Dict[str, Any]) -> Any:
     return [convert(r) for r in instances]
 
 
-def make_app(rpc_address: str, rpc_timeout: float = 10.0
+def make_app(rpc_address: str, rpc_timeout: float = 10.0,
+             grpc_address: Optional[str] = None
              ) -> tornado.web.Application:
     return tornado.web.Application([
         # Reference route grammar (server.py:270-283).
         (r"/model/([^/:]+)(?:/version/(\d+))?:(predict|classify|generate)",
          InferProxyHandler),
         (r"/model/([^/:]+)", MetadataProxyHandler),
-    ], rpc_address=rpc_address, rpc_timeout=rpc_timeout, metadata_cache={})
+    ], rpc_address=rpc_address, rpc_timeout=rpc_timeout,
+       grpc_address=grpc_address, metadata_cache={})
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kft-http-proxy")
     parser.add_argument("--port", type=int, default=8000)
-    # Upstream is the model server's REST port (8500); its native gRPC
-    # lives on 9000 (reference contract) but this proxy's async REST
-    # upstream path does not need it.
+    # REST upstream is the model server's REST port (8500) — the
+    # metadata fetch and the fallback infer hop; the primary infer hop
+    # is binary gRPC to --grpc_port (9000, the reference's contract).
     parser.add_argument("--rpc_port", type=int, default=8500)
     parser.add_argument("--rpc_address", default="localhost")
     parser.add_argument("--rpc_timeout", type=float, default=10.0)
+    parser.add_argument("--grpc_port", type=int, default=9000,
+                        help="model server's native gRPC port; 0 "
+                             "disables the binary upstream")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     # --rpc_address accepts bare host (reference --rpc_port style,
     # tf-serving.libsonnet:152), host:port, or a full URL; the handler
     # property adds the scheme when missing.
     addr = args.rpc_address
+    host = args.rpc_address
+    if "://" in host:  # strip scheme/port for the gRPC dial target
+        host = host.split("://", 1)[1]
+    host = host.rsplit(":", 1)[0] if (":" in host.rsplit("]", 1)[-1]) else host
     if "://" not in addr and ":" not in addr.rsplit("]", 1)[-1]:
         addr = f"{addr}:{args.rpc_port}"
-    app = make_app(addr, args.rpc_timeout)
+    grpc_address = f"{host}:{args.grpc_port}" if args.grpc_port else None
+    app = make_app(addr, args.rpc_timeout, grpc_address=grpc_address)
     app.listen(args.port)
-    logger.info("http proxy on :%d → :%d", args.port, args.rpc_port)
+    logger.info("http proxy on :%d → REST :%d, gRPC %s", args.port,
+                args.rpc_port, grpc_address or "disabled")
     tornado.ioloop.IOLoop.current().start()
     return 0
 

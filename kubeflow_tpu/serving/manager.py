@@ -55,6 +55,12 @@ class ServedModel:
         self._ids = itertools.count(1)
         self._worker: Optional[threading.Thread] = None
         self._closed = False
+        # Batch-fill accounting (PERF/benchmark instrumentation): how
+        # many XLA executions the batcher issued and how many request
+        # rows they carried. Written only by the batcher thread;
+        # readers get snapshot-grade values (ints, GIL-atomic).
+        self._stat_batches = 0
+        self._stat_rows = 0
 
     # -- version lifecycle ------------------------------------------------
 
@@ -188,6 +194,17 @@ class ServedModel:
             for (sig_name, method, version), group in groups.items():
                 self._run_group(sig_name, method, version, group)
 
+    def batch_stats(self, reset: bool = False) -> Dict[str, float]:
+        """Batcher fill statistics since start (or last reset): number
+        of XLA executions, total rows, mean rows per execution. Reset
+        is only safe while traffic is quiescent (benchmark phases)."""
+        batches, rows = self._stat_batches, self._stat_rows
+        if reset:
+            self._stat_batches = 0
+            self._stat_rows = 0
+        return {"batches": batches, "rows": rows,
+                "mean_fill": round(rows / batches, 3) if batches else 0.0}
+
     def _run_group(self, sig_name, method, version, group) -> None:
         futures = [g[4] for g in group]
         try:
@@ -197,6 +214,8 @@ class ServedModel:
             arrays = [np.asarray(g[0][input_name]) for g in group]
             counts = [a.shape[0] for a in arrays]
             batch = np.concatenate(arrays) if len(arrays) > 1 else arrays[0]
+            self._stat_batches += 1
+            self._stat_rows += int(batch.shape[0])
             out = model.run({input_name: batch}, sig_name, method)
             offset = 0
             for future, count in zip(futures, counts):

@@ -340,8 +340,107 @@ class ServingEndToEnd(tornado.testing.AsyncHTTPTestCase):
 def _attach_base_path(model_dir):
     ServingEndToEnd.base_path = model_dir
     ProxyEndToEnd.base_path = model_dir
+    ProxyGrpcUpstream.base_path = model_dir
+    ProxyGrpcDeadUpstream.base_path = model_dir
     HealthGating.base_path = model_dir
     MultiModelServing.base_path = model_dir
+
+
+class ProxyGrpcUpstream(tornado.testing.AsyncHTTPTestCase):
+    """Proxy riding the binary gRPC upstream to a real :9000 server
+    (the adopted default wire — PERF.md serving section; the reference
+    proxy's own upstream design, http-proxy/server.py:219-236)."""
+
+    def get_app(self):
+        from kubeflow_tpu.serving.grpc_server import make_server
+        from kubeflow_tpu.serving.http_proxy import make_app as proxy_app
+        from kubeflow_tpu.serving.server import make_app as server_app
+
+        self.manager = ModelManager()
+        self.manager.add_model("testnet", str(type(self).base_path),
+                               max_batch=8)
+        backend = server_app(self.manager)
+        sock, port = tornado.testing.bind_unused_port()
+        self.backend_server = tornado.httpserver.HTTPServer(backend)
+        self.backend_server.add_sockets([sock])
+        self.grpc_server, grpc_port = make_server(self.manager, 0)
+        self.grpc_server.start()
+        return proxy_app(f"http://127.0.0.1:{port}",
+                         grpc_address=f"127.0.0.1:{grpc_port}")
+
+    def test_predict_rides_binary_wire(self):
+        rows = np.random.RandomState(7).rand(2, 32, 32, 3).tolist()
+        resp = self.fetch("/model/testnet:predict", method="POST",
+                          body=json.dumps({"instances": rows}))
+        assert resp.code == 200, resp.body
+        preds = json.loads(resp.body)["predictions"]
+        assert len(preds) == 2 and len(preds[0]["logits"]) == 10
+        # The binary path dialed the channel (proves the verb matched
+        # the signature method and the gRPC hop wrote this response).
+        assert self._app.settings.get("_grpc_channel") is not None
+        # Numerically identical to the direct model execution.
+        direct = self.manager.get_model("testnet").get().run(
+            {"images": np.asarray(rows, np.float32)})
+        np.testing.assert_allclose(
+            np.asarray(preds[0]["logits"]), direct["logits"][0],
+            rtol=2e-5, atol=2e-5)
+
+    def test_verb_mismatch_falls_back_to_rest(self):
+        # testnet's signature method is "predict": a :classify URL
+        # can't ride gRPC Predict (it runs the signature's method),
+        # so the REST hop must serve it — transparently.
+        rows = np.zeros((1, 32, 32, 3)).tolist()
+        resp = self.fetch("/model/testnet:classify", method="POST",
+                          body=json.dumps({"instances": rows}))
+        assert resp.code == 200, resp.body
+        preds = json.loads(resp.body)["predictions"]
+        assert len(preds[0]["classes"]) == 5
+
+    def test_binary_wire_maps_grpc_status(self):
+        # Pinned unloaded version → NOT_FOUND over the wire → 404.
+        rows = np.zeros((1, 32, 32, 3)).tolist()
+        resp = self.fetch("/model/testnet/version/777:predict",
+                          method="POST",
+                          body=json.dumps({"instances": rows}))
+        assert resp.code == 404, resp.body
+
+    def tearDown(self):
+        self.grpc_server.stop(grace=None)
+        self.manager.stop()
+        super().tearDown()
+
+
+class ProxyGrpcDeadUpstream(tornado.testing.AsyncHTTPTestCase):
+    """gRPC upstream configured but unreachable: traffic must fall
+    back to the REST hop, not 503 (a REST-only backend keeps working
+    under a proxy upgrade that turned on the binary wire)."""
+
+    def get_app(self):
+        from kubeflow_tpu.serving.http_proxy import make_app as proxy_app
+        from kubeflow_tpu.serving.server import make_app as server_app
+
+        self.manager = ModelManager()
+        self.manager.add_model("testnet", str(type(self).base_path),
+                               max_batch=8)
+        backend = server_app(self.manager)
+        sock, port = tornado.testing.bind_unused_port()
+        self.backend_server = tornado.httpserver.HTTPServer(backend)
+        self.backend_server.add_sockets([sock])
+        dead_sock, dead_port = tornado.testing.bind_unused_port()
+        dead_sock.close()  # nothing listens on dead_port
+        return proxy_app(f"http://127.0.0.1:{port}",
+                         grpc_address=f"127.0.0.1:{dead_port}")
+
+    def test_falls_back_when_grpc_unreachable(self):
+        rows = np.zeros((1, 32, 32, 3)).tolist()
+        resp = self.fetch("/model/testnet:predict", method="POST",
+                          body=json.dumps({"instances": rows}))
+        assert resp.code == 200, resp.body
+        assert len(json.loads(resp.body)["predictions"]) == 1
+
+    def tearDown(self):
+        self.manager.stop()
+        super().tearDown()
 
 
 class ProxyEndToEnd(tornado.testing.AsyncHTTPTestCase):
