@@ -87,6 +87,84 @@ def grpc_check(address: str, model_name: str) -> None:
     logger.info("grpc Classify ok: top label %s", rows[0][0][0])
 
 
+def _served_versions(base_url: str, model_name: str) -> list:
+    with urllib.request.urlopen(f"{base_url}/v1/models/{model_name}",
+                                timeout=5) as resp:
+        status = json.load(resp)
+    return sorted(int(s["version"])
+                  for s in status["model_version_status"])
+
+
+def _wait_for_version(base_url: str, model_name: str, version: int,
+                      timeout_s: float = 120.0) -> None:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            if version in _served_versions(base_url, model_name):
+                return
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(1)
+    raise AssertionError(
+        f"version {version} never became AVAILABLE on {model_name}")
+
+
+def rollback_check(base: "pathlib.Path", base_url: str,
+                   model_name: str) -> None:
+    """Publish v2/v3, pin v1 through eviction (load-on-demand), against
+    the live server — the version-policy data path over the wire
+    (reference version-dir contract,
+    components/k8s-model-server/README.md:95-105)."""
+    import shutil
+
+    rng = np.random.RandomState(42)
+    image = (rng.randint(0, 256, (1, 32, 32, 3)) / 255.0).astype(np.float32)
+    pin1 = f"{base_url}/v1/models/{model_name}/versions/1:classify"
+
+    # Publish v2 (identical weights; the lifecycle is what's under
+    # test); the 1 s poll hot-loads it.
+    shutil.copytree(str(base / "1"), str(base / "2"))
+    _wait_for_version(base_url, model_name, 2)
+    resp = predict(pin1, {"instances": image.tolist()})
+    assert resp["model_spec"]["version"] == "1", resp.get("model_spec")
+    logger.info("pinned v1 ok while v2 is default")
+
+    # Publish v3: the latest-policy reload evicts v1 ({3,2} stay)...
+    shutil.copytree(str(base / "1"), str(base / "3"))
+    _wait_for_version(base_url, model_name, 3)
+    served = _served_versions(base_url, model_name)
+    assert 1 not in served, f"v1 should be evicted, got {served}"
+    # ...but pinned-v1 traffic (rollback clients) still works: the
+    # server loads it back on demand.
+    resp = predict(pin1, {"instances": image.tolist()}, timeout_s=120.0)
+    assert resp["model_spec"]["version"] == "1", resp.get("model_spec")
+    assert 1 in _served_versions(base_url, model_name)
+    logger.info("load-on-demand rollback target ok (v1 after eviction)")
+
+
+def pinned_policy_check(base_url: str, model_name: str) -> None:
+    """Against a server booted with --version_policy specific:1 while
+    v1..v3 sit on disk: v1 is the default serve, unpinned versions are
+    rejected — the operator's rollback flow."""
+    rng = np.random.RandomState(42)
+    image = (rng.randint(0, 256, (1, 32, 32, 3)) / 255.0).astype(np.float32)
+    served = _served_versions(base_url, model_name)
+    assert served == [1], f"specific:1 must serve exactly [1], got {served}"
+    resp = predict(f"{base_url}/v1/models/{model_name}:classify",
+                   {"instances": image.tolist()})
+    assert resp["model_spec"]["version"] == "1", resp.get("model_spec")
+    req = urllib.request.Request(
+        f"{base_url}/v1/models/{model_name}/versions/3:classify",
+        data=json.dumps({"instances": image.tolist()}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        raise AssertionError("unpinned version 3 must be rejected")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404, e.code
+    logger.info("rollback policy ok (specific:1 serves v1, rejects v3)")
+
+
 def run_fake() -> None:
     """Local stand-in: export a deterministic model, boot the real
     server binary, golden-predict against it over REST and native
@@ -123,28 +201,30 @@ def run_fake() -> None:
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     grpc_port, rest_port = 19300, 19301
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "kubeflow_tpu.serving.server",
-         "--port", str(grpc_port), "--rest_port", str(rest_port),
-         "--model_name", "resnet",
-         "--model_base_path", str(base), "--poll_interval", "1",
-         # Small bucket set: load-time warmup compiles every bucket.
-         "--max_batch", "4"],
-        env=env)
-    try:
+    base_url = f"http://127.0.0.1:{rest_port}"
+
+    def boot(*extra_args):
+        return subprocess.Popen(
+            [sys.executable, "-m", "kubeflow_tpu.serving.server",
+             "--port", str(grpc_port), "--rest_port", str(rest_port),
+             "--model_name", "resnet",
+             "--model_base_path", str(base), "--poll_interval", "1",
+             # Small bucket set: load-time warmup compiles every bucket.
+             "--max_batch", "4", *extra_args],
+            env=env)
+
+    def wait_healthy():
         for _ in range(120):
             try:
-                if urllib.request.urlopen(
-                        f"http://127.0.0.1:{rest_port}/healthz",
-                        timeout=1).status == 200:
-                    break
+                if urllib.request.urlopen(f"{base_url}/healthz",
+                                          timeout=1).status == 200:
+                    return
             except (urllib.error.URLError, OSError):
                 pass
             time.sleep(1)
-        else:
-            raise AssertionError("local model server never became healthy")
-        golden_check(f"http://127.0.0.1:{rest_port}", "resnet")
-        grpc_check(f"127.0.0.1:{grpc_port}", "resnet")
+        raise AssertionError("local model server never became healthy")
+
+    def drain(proc):
         # Graceful shutdown: SIGTERM (what the kubelet sends) must
         # drain and exit 0 within the grace period, not require KILL.
         import signal
@@ -152,7 +232,25 @@ def run_fake() -> None:
         proc.send_signal(signal.SIGTERM)
         rc = proc.wait(timeout=30)
         assert rc == 0, f"server exited {rc} on SIGTERM"
+
+    proc = boot()
+    try:
+        wait_healthy()
+        golden_check(base_url, "resnet")
+        grpc_check(f"127.0.0.1:{grpc_port}", "resnet")
+        rollback_check(base, base_url, "resnet")
+        drain(proc)
         logger.info("graceful shutdown ok (exit 0 on SIGTERM)")
+    finally:
+        proc.kill()
+
+    # Operator rollback: reboot the same base path pinned to v1 while
+    # v1..v3 (plus v1's on-demand reload) sit on disk.
+    proc = boot("--version_policy", "specific:1")
+    try:
+        wait_healthy()
+        pinned_policy_check(base_url, "resnet")
+        drain(proc)
     finally:
         proc.kill()
 

@@ -42,6 +42,7 @@ def server_container(p: Dict[str, Any]) -> Dict[str, Any]:
             "--rest_port=8500",   # REST + gRPC-Web
             f"--model_name={p['model_name']}",
             f"--model_base_path={p['model_path']}",
+            f"--version_policy={p['version_policy']}",
         ],
         ports=[k8s.port(9000, "grpc"), k8s.port(8500, "rest")],
         # Model load + first XLA compile takes tens of seconds to
@@ -162,9 +163,13 @@ def gcp_env_and_volume(p: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def all_objects(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from kubeflow_tpu.serving.version_policy import parse_version_policy
+
     p = dict(p)
     p.setdefault("model_name", None)
+    p.setdefault("version_policy", "latest")
     p["model_name"] = p["model_name"] or p["name"]
+    parse_version_policy(p["version_policy"])  # fail at generate time
     dep = deployment(p)
     containers = dep["spec"]["template"]["spec"]["containers"]
     if p["s3_enable"]:
@@ -185,6 +190,9 @@ SERVING_PARAMS = [
     Param("model_path", REQUIRED, "string",
           "Versioned model base path (gs://... or s3://... or local)."),
     Param("model_server_image", DEFAULT_SERVER_IMAGE, "string"),
+    Param("version_policy", "latest", "string",
+          "latest | all | specific:<v>[,<v>...] — which version dirs "
+          "to serve (rollback = specific:<old-version>)."),
     Param("http_proxy", "true", "bool", "Deploy the REST proxy sidecar."),
     Param("http_proxy_image", DEFAULT_PROXY_IMAGE, "string"),
     Param("service_type", "ClusterIP", "string"),

@@ -220,6 +220,8 @@ def _measure(request_fn: Callable[[], float], clients: int,
 
 def _drive(config: ServingBenchConfig, manager, model,
            handle: _ServerHandle, grpc_port: int) -> Dict[str, float]:
+    import contextlib
+
     hw = config.image_hw
     rng = np.random.RandomState(42)
     image = (rng.randint(0, 256, (1, hw, hw, 3)) / 255.0).astype(np.float32)
@@ -227,19 +229,28 @@ def _drive(config: ServingBenchConfig, manager, model,
     json_payload = json.dumps({"instances": image.tolist()}).encode()
     sizes = {"json_request_bytes": len(json_payload)}
     transports: Dict[str, Callable[[], float]] = {}
-    channel = None
-    if config.transport in ("http", "both"):
-        transports["http"] = _http_request_fn(handle.port, json_payload)
-    if config.transport in ("grpc", "both"):
-        import grpc
+    with contextlib.ExitStack() as stack:
+        if config.transport in ("http", "both"):
+            transports["http"] = _http_request_fn(handle.port, json_payload)
+        if config.transport in ("grpc", "both"):
+            import grpc
 
-        from kubeflow_tpu.serving import wire
+            from kubeflow_tpu.serving import wire
 
-        grpc_request = wire.encode_predict_request(
-            "bench", {"images": image})
-        sizes["grpc_request_bytes"] = len(grpc_request)
-        channel = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
-        transports["grpc"] = _grpc_request_fn(channel, grpc_request)
+            grpc_request = wire.encode_predict_request(
+                "bench", {"images": image})
+            sizes["grpc_request_bytes"] = len(grpc_request)
+            # Closed on exit even when a measurement assertion fires
+            # mid-drive (bench.py catches and carries on — the
+            # channel's worker threads must not outlive this run).
+            channel = stack.enter_context(contextlib.closing(
+                grpc.insecure_channel(f"127.0.0.1:{grpc_port}")))
+            transports["grpc"] = _grpc_request_fn(channel, grpc_request)
+        return _drive_measurements(config, model, transports, sizes, image)
+
+
+def _drive_measurements(config: ServingBenchConfig, model, transports,
+                        sizes, image) -> Dict[str, float]:
 
     # Warmup: first requests compile the predict buckets; warm every
     # wire under test so neither pays first-touch costs in the timed run.
@@ -286,8 +297,6 @@ def _drive(config: ServingBenchConfig, manager, model,
         np.asarray(out["scores"])  # host fence
         direct.append(time.perf_counter() - t0)
     result["direct_model_ms"] = round(float(np.median(direct)) * 1e3, 2)
-    if channel is not None:
-        channel.close()
     return result
 
 

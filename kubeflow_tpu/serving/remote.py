@@ -54,9 +54,10 @@ def _fs_and_root(base_path: str):
     return fsspec.core.url_to_fs(base_path)
 
 
-def scan_latest_version(base_path: str) -> int:
-    """Highest numeric version dir under a remote base path, or -1
-    (mirrors the native scanner's contract for POSIX paths)."""
+def scan_versions(base_path: str) -> List[int]:
+    """All numeric version dirs under a remote base path, ascending
+    (the version-policy scanner: latest/all/specific need the full
+    set, not just the max)."""
     try:
         fs, root = _fs_and_root(base_path)
         # fsspec filesystems are instance-cached and gcsfs/s3fs keep a
@@ -66,18 +67,53 @@ def scan_latest_version(base_path: str) -> int:
         fs.invalidate_cache(root.rstrip("/"))
         entries = fs.ls(root.rstrip("/"), detail=True)
     except (FileNotFoundError, OSError):
-        return -1
-    best = -1
+        return []
+    found = set()
     for entry in entries:
         name = os.path.basename(str(entry.get("name", "")).rstrip("/"))
         if name.isdigit() and entry.get("type") == "directory":
-            best = max(best, int(name))
-    return best
+            found.add(int(name))
+    return sorted(found)
 
 
-def _cache_dir_for(base_path: str, cache_root: str) -> Path:
+def scan_latest_version(base_path: str) -> int:
+    """Highest numeric version dir under a remote base path, or -1
+    (mirrors the native scanner's contract for POSIX paths)."""
+    versions = scan_versions(base_path)
+    return versions[-1] if versions else -1
+
+
+def cache_dir_for(base_path: str, cache_root: str) -> Path:
+    """Local cache dir for a remote base path (content-addressed by
+    the full path — same-named files under different remote dirs must
+    never collide). Shared by the model cache here and the training
+    data cache (training/data.py)."""
     digest = hashlib.sha256(base_path.encode()).hexdigest()[:16]
     return Path(cache_root) / digest
+
+
+_cache_dir_for = cache_dir_for  # internal alias (pre-r4 name)
+
+
+def atomic_get_file(fs, remote_file: str, dest: str) -> None:
+    """Download one file so a crash can never leave a partial file at
+    ``dest``: fetch to a temp sibling, then atomically replace. No-op
+    when ``dest`` already exists (immutable-artifact caches)."""
+    if os.path.exists(dest):
+        return
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(dest),
+        prefix=f".tmp-{os.path.basename(dest)}-")
+    os.close(fd)
+    try:
+        fs.get_file(remote_file, tmp)
+        os.replace(tmp, dest)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def materialize(base_path: str, version: int,

@@ -127,7 +127,11 @@ class InferHandler(BaseHandler):
             if instances is None:
                 return self.write_json(
                     {"error": "request body needs 'instances'"}, 400)
-            loaded = model.get(int(version) if version else None)
+            # get() may load a pinned version on demand (seconds to
+            # minutes of device put + warmup compiles): run it on a
+            # pool thread, never the IO loop.
+            loaded = await tornado.ioloop.IOLoop.current().run_in_executor(
+                None, model.get, int(version) if version else None)
             sig_name = body.get("signature_name")
             sig = loaded.signature(sig_name)
             input_name = next(iter(sig.inputs))
@@ -215,19 +219,23 @@ class GrpcWebPredictHandler(BaseHandler):
             if len(data) != 1:
                 raise ValueError(f"expected 1 message frame, got {len(data)}")
             loop = tornado.ioloop.IOLoop.current()
+            # start_* resolve the model version, which may load a
+            # pinned version on demand — pool thread, not the IO loop.
             if method == "Predict":
-                spec, loaded, future, output_filter = svc.start_predict(
-                    self.manager, data[0])
+                spec, loaded, future, output_filter = (
+                    await loop.run_in_executor(
+                        None, svc.start_predict, self.manager, data[0]))
                 finish = lambda out: svc.finish_predict(  # noqa: E731
                     spec, loaded, out, output_filter)
             elif method == "Classify":
-                spec, loaded, future = svc.start_classify(
-                    self.manager, data[0])
+                spec, loaded, future = await loop.run_in_executor(
+                    None, svc.start_classify, self.manager, data[0])
                 finish = lambda out: svc.finish_classify(  # noqa: E731
                     spec, loaded, out)
             else:  # GetModelMetadata (route regex restricts the set)
                 future, finish = None, None
-                body = svc.get_model_metadata(self.manager, data[0])
+                body = await loop.run_in_executor(
+                    None, svc.get_model_metadata, self.manager, data[0])
             if future is not None:
                 outputs = await loop.run_in_executor(
                     None, future.result, GRPC_WEB_TIMEOUT_S)
@@ -296,7 +304,8 @@ def load_model_config(path: str):
         if missing:
             raise ValueError(
                 f"model config entry {i} missing {sorted(missing)}")
-        unknown = set(entry) - {"name", "base_path", "max_batch"}
+        unknown = set(entry) - {"name", "base_path", "max_batch",
+                                "version_policy"}
         if unknown:
             raise ValueError(
                 f"model config entry {i} has unknown keys "
@@ -321,6 +330,11 @@ def main(argv=None) -> int:
                              " — multi-model serving (TF-Serving's "
                              "--model_config_file role)")
     parser.add_argument("--max_batch", type=int, default=64)
+    parser.add_argument("--version_policy", default="latest",
+                        help="latest | all | specific:<v>[,<v>...] — "
+                             "which version dirs to serve (TF-Serving "
+                             "ServableVersionPolicy role; rollback = "
+                             "specific:<old>)")
     parser.add_argument("--poll_interval", type=float, default=5.0)
     args = parser.parse_args(argv)
     single = bool(args.model_name or args.model_base_path)
@@ -329,6 +343,12 @@ def main(argv=None) -> int:
                      "or --model_config_file is required")
     if single and not (args.model_name and args.model_base_path):
         parser.error("--model_name and --model_base_path go together")
+    from kubeflow_tpu.serving.manager import parse_version_policy
+
+    try:
+        parse_version_policy(args.version_policy)
+    except ValueError as e:
+        parser.error(str(e))
     logging.basicConfig(
         level=logging.INFO,
         format="%(levelname)s|%(asctime)s|%(pathname)s|%(lineno)d| %(message)s",
@@ -351,6 +371,8 @@ def main(argv=None) -> int:
         manager.add_model(entry["name"], entry["base_path"],
                           max_batch=int(entry.get("max_batch",
                                                   args.max_batch)),
+                          version_policy=entry.get("version_policy",
+                                                   args.version_policy),
                           initial_poll=False)
     from kubeflow_tpu.serving.grpc_server import make_server
 

@@ -1,0 +1,38 @@
+"""Version-policy grammar — shared by the server and the manifest
+compiler (which must stay jax-free, so this lives outside manager.py).
+
+TF-Serving's ServableVersionPolicy surface (the reference served
+versioned ``model_base_path`` dirs, version-dir contract
+``components/k8s-model-server/README.md:95-105``; the serving manifest
+pinned the base path, ``kubeflow/tf-serving/tf-serving.libsonnet:110``):
+``latest`` serves the newest version dir, ``all`` serves every version
+dir, ``specific:<v>[,<v>...]`` serves exactly the listed versions —
+rollback = pin the old version and drop the bad one.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+
+def parse_version_policy(policy: str) -> Tuple[str, Tuple[int, ...]]:
+    """``latest`` | ``all`` | ``specific:<v>[,<v>...]`` → (kind, pins)."""
+    if policy == "latest":
+        return "latest", ()
+    if policy == "all":
+        return "all", ()
+    if policy.startswith("specific:"):
+        raw = policy[len("specific:"):]
+        try:
+            pins = tuple(sorted({int(v) for v in raw.split(",")
+                                 if v.strip()}))
+        except ValueError:
+            raise ValueError(
+                f"version_policy {policy!r}: versions must be integers")
+        if not pins:
+            raise ValueError(
+                "version_policy 'specific:' needs at least one version")
+        return "specific", pins
+    raise ValueError(
+        f"unknown version_policy {policy!r}; expected latest | all | "
+        f"specific:<v>[,<v>...]")
