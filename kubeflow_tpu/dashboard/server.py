@@ -13,6 +13,10 @@ and DELETE jobs, not just list them. This is its TPUJob equivalent:
                                          openAPIV3 schema)
   GET    /tpujobs/api/tpujob/<ns>/<name> one TPUJob + its gang pods
   DELETE /tpujobs/api/tpujob/<ns>/<name> delete the job + its gang
+  GET    /tpujobs/api/traces             profiler runs under --trace_root
+                                         (XPlane dirs; SURVEY §5's
+                                         "surfaced through the
+                                         dashboard" target)
   GET    /healthz
 
 against either a real apiserver (kubectl shim) or the in-repo fake
@@ -187,6 +191,20 @@ class JobDetailHandler(BaseHandler):
                          "pods_deleted": len(pods)})
 
 
+class TraceListHandler(BaseHandler):
+    """Profiler traces under the shared trace root (written by
+    trainer ``--profile_dir`` / ``LoopConfig.profile_dir``; recipe for
+    opening them: docs/profiling.md)."""
+
+    async def get(self):
+        from kubeflow_tpu.utils.traces import list_traces
+
+        root = self.application.settings["trace_root"]
+        traces = await tornado.ioloop.IOLoop.current().run_in_executor(
+            None, list_traces, root)
+        self.write_json({"trace_root": root, "items": traces})
+
+
 _PHASE_COLORS = {
     "Running": "#1a7f37", "Succeeded": "#0969da", "Pending": "#9a6700",
     "Restarting": "#bc4c00", "Failed": "#cf222e",
@@ -210,6 +228,15 @@ _PAGE = """<!doctype html>
 {rows}
 </table>
 <p>{count} job(s). JSON: <a href="/tpujobs/api/tpujob">/tpujobs/api/tpujob</a></p>
+<h2>Profiler traces</h2>
+<table>
+<tr><th>Job</th><th>Run</th><th>Files</th><th>Trace dir</th></tr>
+{trace_rows}
+</table>
+<p>{trace_count} trace run(s) under {trace_root}.
+JSON: <a href="/tpujobs/api/traces">/tpujobs/api/traces</a> &middot;
+open with <code>tensorboard --logdir &lt;trace dir&gt;</code>
+(docs/profiling.md)</p>
 <h2>Create TPUJob</h2>
 <form method="post" action="/tpujobs/ui/create">
  <label>Name <input name="name" required pattern="[a-z0-9-]+"></label>
@@ -230,8 +257,10 @@ _PAGE = """<!doctype html>
 
 class UIHandler(BaseHandler):
     async def get(self):
-        raw = await tornado.ioloop.IOLoop.current().run_in_executor(
-            None, self.api.list, KIND)
+        from kubeflow_tpu.utils.traces import list_traces
+
+        loop = tornado.ioloop.IOLoop.current()
+        raw = await loop.run_in_executor(None, self.api.list, KIND)
         jobs = [job_summary(j) for j in raw]
         rows = []
         for j in jobs:
@@ -250,8 +279,23 @@ class UIHandler(BaseHandler):
                 f"<td>{int(j['restartCount'])}</td>"
                 f"<td>{replicas}</td>"
                 "</tr>")
+        trace_root = self.application.settings["trace_root"]
+        traces = await loop.run_in_executor(None, list_traces, trace_root)
+        trace_rows = []
+        for t in traces:
+            files = ", ".join(f["name"] for f in t["files"])
+            trace_rows.append(
+                "<tr>"
+                f"<td>{html.escape(t['job'] or '-')}</td>"
+                f"<td>{html.escape(t['run'])}</td>"
+                f"<td>{html.escape(files)}</td>"
+                f"<td><code>{html.escape(t['dir'])}</code></td>"
+                "</tr>")
         self.set_header("Content-Type", "text/html; charset=utf-8")
-        self.finish(_PAGE.format(rows="\n".join(rows), count=len(jobs)))
+        self.finish(_PAGE.format(
+            rows="\n".join(rows), count=len(jobs),
+            trace_rows="\n".join(trace_rows), trace_count=len(traces),
+            trace_root=html.escape(trace_root)))
 
 
 class UICreateHandler(BaseHandler):
@@ -295,15 +339,20 @@ class UICreateHandler(BaseHandler):
         self.redirect("/tpujobs/ui/")
 
 
-def make_app(api) -> tornado.web.Application:
+DEFAULT_TRACE_ROOT = "/tmp/kft-profile"
+
+
+def make_app(api, trace_root: str = DEFAULT_TRACE_ROOT
+             ) -> tornado.web.Application:
     return tornado.web.Application([
         (r"/healthz", HealthHandler),
         (r"/tpujobs/api/tpujob", JobListHandler),
         (r"/tpujobs/api/tpujob/([^/]+)/([^/]+)", JobDetailHandler),
+        (r"/tpujobs/api/traces", TraceListHandler),
         (r"/tpujobs/ui/?", UIHandler),
         (r"/tpujobs/ui/create", UICreateHandler),
         (r"/", tornado.web.RedirectHandler, {"url": "/tpujobs/ui/"}),
-    ], api=api)
+    ], api=api, trace_root=trace_root)
 
 
 def main(argv=None) -> int:
@@ -311,6 +360,10 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, default=8080)
     parser.add_argument("--fake", action="store_true",
                         help="serve an in-memory apiserver (tests/demo)")
+    parser.add_argument("--trace_root", default=DEFAULT_TRACE_ROOT,
+                        help="shared dir (volume-mounted in-cluster) "
+                             "where trainer --profile_dir traces land; "
+                             "listed at /tpujobs/api/traces")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     if args.fake:
@@ -321,7 +374,7 @@ def main(argv=None) -> int:
         from kubeflow_tpu.operator.controller import KubectlClient
 
         api = KubectlClient()
-    app = make_app(api)
+    app = make_app(api, trace_root=args.trace_root)
     app.listen(args.port)
     logger.info("tpujob-dashboard listening on :%d", args.port)
     tornado.ioloop.IOLoop.current().start()

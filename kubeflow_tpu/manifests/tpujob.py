@@ -405,6 +405,8 @@ def _cnn_benchmark_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
         f"--model={p['model']}",
         f"--batch_size={p['batch_size']}",
     ]
+    if p["profile_dir"]:
+        args.append(f"--profile_dir={p['profile_dir']}")
     spec = replica_spec(
         "TPU_WORKER", p["num_tpu_workers"], image=p["image"],
         command=args[:1], args=args[1:],
@@ -430,6 +432,9 @@ register(
         Param("tpu_accelerator", "tpu-v5-lite-podslice", "string"),
         Param("tpu_topology", "2x4", "string"),
         Param("chips_per_worker", 4, "int"),
+        Param("profile_dir", "", "string",
+              "Capture the timed steps as an XPlane trace under this "
+              "dir (mount a shared volume; the dashboard lists it)."),
     ],
     package="tpu-job",
 )(_cnn_benchmark_builder)
@@ -461,6 +466,8 @@ def _finetune_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
     ]
     if p["data"]:
         args.append(f"--data={p['data']}")
+    if p["profile_dir"]:
+        args.append(f"--profile_dir={p['profile_dir']}")
     spec = replica_spec(
         "TPU_WORKER", p["num_tpu_workers"], image=p["image"],
         command=args[:1], args=args[1:],
@@ -495,6 +502,9 @@ register(
         # a single v5e chip) — batch 1 cannot shard over a 2x4 slice.
         Param("tpu_topology", "1x1", "string"),
         Param("chips_per_worker", 1, "int"),
+        Param("profile_dir", "", "string",
+              "Capture the timed steps as an XPlane trace under this "
+              "dir (mount a shared volume; the dashboard lists it)."),
     ],
     package="tpu-job",
 )(_finetune_builder)

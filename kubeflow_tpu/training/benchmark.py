@@ -42,6 +42,7 @@ class BenchConfig:
     image_size: Optional[int] = None  # override model default (for smoke runs)
     seed: int = 0
     model_kwargs: Optional[Dict] = None  # e.g. {"bn_stat_rows": 64}
+    profile_dir: Optional[str] = None  # capture timed steps as XPlane
 
 
 def synthetic_batch(config: BenchConfig, num_classes: int,
@@ -78,7 +79,7 @@ def peak_flops_per_chip() -> float:
 
 
 def _run_timed_steps(step_fn, state, batch, warmup_steps: int, steps: int,
-                     batch_iter=None):
+                     batch_iter=None, profile_dir: Optional[str] = None):
     """AOT-compile the exact step once, run warmup + the timed loop on
     that executable, and read its XLA FLOP count.
 
@@ -112,13 +113,22 @@ def _run_timed_steps(step_fn, state, batch, warmup_steps: int, steps: int,
     float(metrics["loss"])
     compile_s = time.perf_counter() - compile_start
 
+    # Optional XPlane capture of exactly the timed steps (compile and
+    # warmup stay out of the trace) — the dashboard's trace tab and
+    # docs/profiling.md consume what lands here.
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
     start = time.perf_counter()
-    for _ in range(steps):
-        if batch_iter is not None:
-            batch = next(batch_iter)
-        state, metrics = compiled(state, batch)
-    final_loss = float(metrics["loss"])
-    elapsed = time.perf_counter() - start
+    try:
+        for _ in range(steps):
+            if batch_iter is not None:
+                batch = next(batch_iter)
+            state, metrics = compiled(state, batch)
+        final_loss = float(metrics["loss"])  # fence inside the trace
+        elapsed = time.perf_counter() - start
+    finally:
+        if profile_dir:
+            jax.profiler.stop_trace()
     return elapsed, compile_s, final_loss, flops
 
 
@@ -161,7 +171,8 @@ def run_benchmark(config: BenchConfig) -> Dict[str, float]:
 
     step_fn = make_train_step(mesh)
     elapsed, compile_s, final_loss, flops = _run_timed_steps(
-        step_fn, state, batch, config.warmup_steps, config.steps)
+        step_fn, state, batch, config.warmup_steps, config.steps,
+        profile_dir=config.profile_dir)
 
     images_per_sec = config.batch_size * config.steps / elapsed
     result = {
@@ -189,6 +200,7 @@ class LMBenchConfig:
     learning_rate: float = 1e-4
     objective: str = "mlm"
     seed: int = 0
+    profile_dir: Optional[str] = None  # capture timed steps as XPlane
 
 
 def run_lm_benchmark(config: LMBenchConfig) -> Dict[str, float]:
@@ -225,7 +237,8 @@ def run_lm_benchmark(config: LMBenchConfig) -> Dict[str, float]:
     batch = place_lm_batch(mesh, batch)
 
     elapsed, compile_s, final_loss, flops = _run_timed_steps(
-        step_fn, state, batch, config.warmup_steps, config.steps)
+        step_fn, state, batch, config.warmup_steps, config.steps,
+        profile_dir=config.profile_dir)
     step_time_s = elapsed / config.steps
 
     result = {
@@ -267,6 +280,7 @@ class LoRABenchConfig:
     learning_rate: float = 1e-4
     seed: int = 0
     data_paths: Optional[tuple] = None  # token shards; None → synthetic
+    profile_dir: Optional[str] = None  # capture timed steps as XPlane
 
 
 def run_lora_benchmark(config: LoRABenchConfig) -> Dict[str, float]:
@@ -310,7 +324,7 @@ def run_lora_benchmark(config: LoRABenchConfig) -> Dict[str, float]:
 
         elapsed, compile_s, final_loss, flops = _run_timed_steps(
             step_fn, state, batch, config.warmup_steps, config.steps,
-            batch_iter=batch_iter)
+            batch_iter=batch_iter, profile_dir=config.profile_dir)
     finally:
         # An OOM in lowering or a shard-read error mid-loop must not
         # leak the prefetch thread and its device-resident batches.
@@ -353,10 +367,22 @@ def main(argv=None) -> int:
                         help=">0: LoRA fine-tune benchmark "
                              "(language models only)")
     parser.add_argument("--data", default=None,
-                        help="glob of token shards (.npy / raw .bin) "
-                             "for the fine-tune path; default is the "
-                             "reference-parity synthetic mode")
+                        help="token shards (.npy / raw .bin) for the "
+                             "fine-tune path: comma-separated files, "
+                             "dirs, or globs; gs://-style fsspec paths "
+                             "download into a local cache. Default is "
+                             "the reference-parity synthetic mode")
+    parser.add_argument("--profile_dir", default=None,
+                        help="capture the timed steps as an XPlane "
+                             "trace under this dir (TensorBoard/XProf-"
+                             "readable; surfaced by the dashboard's "
+                             "trace tab — docs/profiling.md)")
     args = parser.parse_args(argv)
+    from kubeflow_tpu.utils.platform import sync_platform_from_env
+
+    # Honor JAX_PLATFORMS from the spawning process (a CPU-smoke
+    # tpu-cnn job must not dispatch to a tunnel-registered TPU).
+    sync_platform_from_env()
     entry = get_model(args.model)
     if args.lora_rank > 0 and entry.family != "language":
         # Never fall through to the wrong benchmark: a tpu-finetune
@@ -372,27 +398,31 @@ def main(argv=None) -> int:
             # timing synthetic batches while the operator believes
             # real data was measured is the worst failure mode.
             parser.error("--data requires --lora_rank > 0")
-        import glob as _glob
+        from kubeflow_tpu.training.data import resolve_shards
 
-        data_paths = tuple(sorted(_glob.glob(args.data)))
-        if not data_paths:
-            parser.error(f"--data {args.data!r} matched no shards")
+        try:
+            data_paths = tuple(resolve_shards(args.data))
+        except ValueError as e:
+            parser.error(str(e))
     if entry.family == "language" and args.lora_rank > 0:
         result = run_lora_benchmark(
             LoRABenchConfig(model=args.model, lora_rank=args.lora_rank,
                             batch_size=args.batch_size or 1,
                             steps=args.steps, seq_len=args.seq_len,
-                            data_paths=data_paths))
+                            data_paths=data_paths,
+                            profile_dir=args.profile_dir))
     elif entry.family == "language":
         result = run_lm_benchmark(
             LMBenchConfig(model=args.model,
                           batch_size=args.batch_size or 32,
-                          steps=args.steps, seq_len=args.seq_len))
+                          steps=args.steps, seq_len=args.seq_len,
+                          profile_dir=args.profile_dir))
     else:
         result = run_benchmark(
             BenchConfig(model=args.model,
                         batch_size=args.batch_size or 128,
-                        steps=args.steps, image_size=args.image_size)
+                        steps=args.steps, image_size=args.image_size,
+                        profile_dir=args.profile_dir)
         )
     print(json.dumps(result))
     return 0

@@ -59,6 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from kubeflow_tpu.utils.platform import sync_platform_from_env
+
+    sync_platform_from_env()
 
     import jax
     import optax

@@ -1,0 +1,71 @@
+"""XPlane trace discovery — what the dashboard's trace tab lists.
+
+The JAX profiler writes TensorBoard-compatible traces as
+``<logdir>/plugins/profile/<run_ts>/<host>.xplane.pb`` (plus
+``.trace.json.gz`` when the viewer export runs). Trainers point
+``--profile_dir`` (tpu-cnn / tpu-finetune prototypes) or
+``LoopConfig.profile_dir`` at a per-job directory under a shared trace
+root — in-cluster that root is a mounted volume (the NFS component,
+manifests/nfs.py) so the dashboard pod sees every job's traces.
+
+Reference parity: users of the reference opened traces in the
+TensorBoard bundled with the notebook image
+(``components/tensorflow-notebook-image/Dockerfile:186``); SURVEY §5's
+rebuild target is traces *surfaced through the dashboard*. The recipe
+for opening a listed trace is docs/profiling.md.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List
+
+#: File suffixes the profiler emits that are worth listing.
+TRACE_SUFFIXES = (".xplane.pb", ".trace.json.gz")
+
+
+def list_traces(root: str) -> List[Dict[str, Any]]:
+    """Walk ``root`` for profiler runs.
+
+    Returns one entry per (job, run): ``job`` is the path between
+    ``root`` and the ``plugins/profile`` marker ("" when traces sit
+    directly under root), ``run`` is the profiler's timestamp dir,
+    ``files`` the trace artifacts with sizes, ``mtime`` the newest
+    artifact's epoch seconds. Sorted newest-first.
+    """
+    runs: Dict[tuple, Dict[str, Any]] = {}
+    root = os.path.abspath(root)
+    if not os.path.isdir(root):
+        return []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        traces = [f for f in filenames if f.endswith(TRACE_SUFFIXES)]
+        if not traces:
+            continue
+        rel = os.path.relpath(dirpath, root)
+        parts = rel.split(os.sep)
+        # <job...>/plugins/profile/<run> is the profiler layout; be
+        # tolerant of traces dumped at other depths (job = parent dir).
+        if len(parts) >= 3 and parts[-3] == "plugins" \
+                and parts[-2] == "profile":
+            job = os.sep.join(parts[:-3])
+            run = parts[-1]
+        else:
+            job = os.sep.join(parts[:-1]) if len(parts) > 1 else ""
+            run = parts[-1] if parts != ["."] else ""
+        key = (job, run)
+        entry = runs.setdefault(key, {
+            "job": job, "run": run, "dir": dirpath,
+            "files": [], "mtime": 0.0,
+        })
+        for f in sorted(traces):
+            path = os.path.join(dirpath, f)
+            try:
+                stat = os.stat(path)
+            except OSError:
+                continue
+            entry["files"].append({"name": f, "size_bytes": stat.st_size})
+            entry["mtime"] = max(entry["mtime"], stat.st_mtime)
+    out = sorted(runs.values(), key=lambda e: e["mtime"], reverse=True)
+    for entry in out:
+        entry["mtime"] = round(entry["mtime"], 3)
+    return out

@@ -26,6 +26,8 @@ def _job(name, namespace="default", phase="Running", restarts=1):
 
 class DashboardTest(tornado.testing.AsyncHTTPTestCase):
     def get_app(self):
+        import tempfile
+
         self.api = FakeApiServer()
         self.api.create(_job("mnist", phase="Running"))
         self.api.create(_job("bert", namespace="research",
@@ -37,7 +39,8 @@ class DashboardTest(tornado.testing.AsyncHTTPTestCase):
                          "labels": {JOB_LABEL: "mnist"}},
             "status": {"phase": "Running"},
         })
-        return make_app(self.api)
+        self.trace_root = tempfile.mkdtemp()
+        return make_app(self.api, trace_root=self.trace_root)
 
     def test_health(self):
         resp = self.fetch("/healthz")
@@ -166,3 +169,49 @@ class DashboardTest(tornado.testing.AsyncHTTPTestCase):
         resp = self.fetch("/", follow_redirects=False)
         assert resp.code in (301, 302)
         assert resp.headers["Location"] == "/tpujobs/ui/"
+
+
+class TraceTabTest(tornado.testing.AsyncHTTPTestCase):
+    """Profiler traces surfaced through the dashboard (SURVEY §5's
+    stated rebuild target; VERDICT-r3 missing #3)."""
+
+    def get_app(self):
+        import pathlib
+        import tempfile
+
+        self.api = FakeApiServer()
+        self.trace_root = tempfile.mkdtemp()
+        # The jax profiler layout: <job>/plugins/profile/<run>/<host>.xplane.pb
+        run = (pathlib.Path(self.trace_root) / "mnist-profile" / "plugins"
+               / "profile" / "2026_07_31_05_00_00")
+        run.mkdir(parents=True)
+        (run / "host0.xplane.pb").write_bytes(b"\x00" * 128)
+        (run / "host0.trace.json.gz").write_bytes(b"\x00" * 64)
+        (run / "README.txt").write_text("not a trace artifact")
+        return make_app(self.api, trace_root=self.trace_root)
+
+    def test_trace_api_lists_runs(self):
+        resp = self.fetch("/tpujobs/api/traces")
+        assert resp.code == 200
+        payload = json.loads(resp.body)
+        assert payload["trace_root"] == self.trace_root
+        (item,) = payload["items"]
+        assert item["job"] == "mnist-profile"
+        assert item["run"] == "2026_07_31_05_00_00"
+        names = [f["name"] for f in item["files"]]
+        assert names == ["host0.trace.json.gz", "host0.xplane.pb"]
+        assert all(f["size_bytes"] > 0 for f in item["files"])
+
+    def test_trace_api_empty_root_is_empty_list(self):
+        import shutil
+
+        shutil.rmtree(self.trace_root)
+        resp = self.fetch("/tpujobs/api/traces")
+        assert json.loads(resp.body)["items"] == []
+
+    def test_ui_shows_trace_table(self):
+        resp = self.fetch("/tpujobs/ui/")
+        body = resp.body.decode()
+        assert "Profiler traces" in body
+        assert "mnist-profile" in body
+        assert "tensorboard --logdir" in body

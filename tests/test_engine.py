@@ -102,6 +102,24 @@ def test_benchmark_smoke(cpu_devices):
         result["images_per_sec"])
 
 
+def test_benchmark_profile_capture(cpu_devices, tmp_path):
+    """--profile_dir writes an XPlane trace of the timed steps that the
+    trace scanner (utils/traces.py — the dashboard's source) finds."""
+    from kubeflow_tpu.training.benchmark import BenchConfig, run_benchmark
+    from kubeflow_tpu.utils.traces import list_traces
+
+    profile_dir = tmp_path / "prof" / "smokejob"
+    result = run_benchmark(BenchConfig(
+        model="resnet-test", batch_size=16, steps=2, warmup_steps=1,
+        profile_dir=str(profile_dir)))
+    assert result["images_per_sec"] > 0
+    traces = list_traces(str(tmp_path / "prof"))
+    assert traces, "profiler wrote no discoverable trace"
+    assert traces[0]["job"].startswith("smokejob")
+    assert any(f["name"].endswith(".xplane.pb")
+               for f in traces[0]["files"])
+
+
 def test_graft_entry_single(cpu_devices):
     import __graft_entry__ as graft
 
