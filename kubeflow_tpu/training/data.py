@@ -118,6 +118,102 @@ def synthetic_causal_lm(
         step += 1
 
 
+def resolve_shards(spec, cache_root: Optional[str] = None) -> list:
+    """Data-path spec → local shard files, fetching remote entries.
+
+    ``spec`` is a comma-separated string (or sequence) of files,
+    directories, or glob patterns. ``gs://``-style entries resolve
+    through fsspec and are downloaded into a local content cache with
+    the same atomicity discipline as the serving model cache
+    (serving/remote.py: temp dir + rename, skip-if-cached) — SURVEY
+    §2.4's storage row: training data on the TPU-VM path lives in
+    object stores, not on local disk.
+
+    Per-host note: every host materializes the full shard list; the
+    batch iterators shard *rows* per host (``host_shard_range``), so
+    the duplicate download costs bandwidth, never correctness. The
+    reference's equivalent was TF reading gs:// paths natively.
+    """
+    import glob as _glob
+    import os
+
+    entries = ([e.strip() for e in spec.split(",") if e.strip()]
+               if isinstance(spec, str) else [str(e) for e in spec])
+    if not entries:
+        raise ValueError("empty data spec")
+    out: list = []
+    for entry in entries:
+        from kubeflow_tpu.serving.remote import is_remote
+
+        if is_remote(entry):
+            out.extend(_materialize_remote_shards(entry, cache_root))
+        elif os.path.isdir(entry):
+            files = sorted(
+                os.path.join(entry, f) for f in os.listdir(entry)
+                if f.endswith((".npy", ".bin")))
+            if not files:
+                raise ValueError(f"{entry}: no .npy/.bin shards inside")
+            out.extend(files)
+        elif _glob.has_magic(entry):
+            files = sorted(_glob.glob(entry))
+            if not files:
+                raise ValueError(f"{entry!r} matched no shards")
+            out.extend(files)
+        elif os.path.exists(entry):
+            out.append(entry)
+        else:
+            raise ValueError(f"data shard {entry!r} does not exist")
+    return out
+
+
+def _materialize_remote_shards(entry: str,
+                               cache_root: Optional[str] = None) -> list:
+    """One remote spec entry → cached local files (cache keying +
+    atomic fetch shared with the serving model cache,
+    serving/remote.py)."""
+    import os
+    import tempfile
+
+    import fsspec
+    import glob as _glob
+
+    from kubeflow_tpu.serving.remote import atomic_get_file, cache_dir_for
+
+    fs, root = fsspec.core.url_to_fs(entry)
+    # Listings caches serve stale results forever without this
+    # (same gotcha as serving/remote.py's scanner).
+    fs.invalidate_cache()
+    if _glob.has_magic(root):
+        files = sorted(f for f in fs.glob(root) if not fs.isdir(f))
+    elif fs.isdir(root):
+        files = sorted(
+            f for f in fs.ls(root, detail=False)
+            if str(f).endswith((".npy", ".bin")) and not fs.isdir(f))
+    elif fs.exists(root):
+        files = [root]
+    else:
+        files = []
+    if not files:
+        raise ValueError(f"remote data spec {entry!r} matched no shards")
+    cache_root = cache_root or os.environ.get(
+        "KFT_DATA_CACHE",
+        os.path.join(tempfile.gettempdir(), "kft-data-cache"))
+    proto = (fs.protocol if isinstance(fs.protocol, str)
+             else fs.protocol[0])
+    out = []
+    for remote_file in files:
+        # Cache key = the FILE's remote parent dir (not the spec entry):
+        # same-named shards from different remote dirs — other buckets,
+        # other runs, recursive-glob matches — must never collide.
+        parent = f"{proto}://{os.path.dirname(str(remote_file))}"
+        local_dir = cache_dir_for(parent, cache_root)
+        local_dir.mkdir(parents=True, exist_ok=True)
+        dest = str(local_dir / os.path.basename(str(remote_file)))
+        atomic_get_file(fs, remote_file, dest)
+        out.append(dest)
+    return out
+
+
 def _epoch_batch_indices(n_items, global_batch, seed, epochs, rows,
                          seed_stride):
     """Shared epoch loop for the shard iterators: seeded permutation
