@@ -1,12 +1,21 @@
-"""Subprocess body for the multi-process gang test (test_multiprocess).
+"""Subprocess body for the multi-process gang tests (test_multiprocess).
 
 Runs the PRODUCTION bootstrap: the operator-injected env
 (KFT_COORDINATOR_ADDRESS / KFT_NUM_PROCESSES / KFT_PROCESS_ID) through
 ``training.launcher.initialize_distributed`` — then a real sharded
-train step over the GLOBAL mesh (2 processes × 2 local CPU devices),
-with each host feeding only its own rows
-(``jax.make_array_from_process_local_data``). Prints one line the
+train step over the GLOBAL mesh, with each host feeding only its own
+rows (``jax.make_array_from_process_local_data``). Prints one line the
 parent asserts on.
+
+Modes (KFT_GANG_MODE):
+- ``resnet`` (default): flat data=4 mesh, 2 procs × 2 local devices —
+  the basic cross-process gradient all-reduce.
+- ``bert_dcn``: the BASELINE multi-host BERT row — hierarchical
+  (dcn_data=2, data=2, fsdp=2) mesh over 2 procs × 4 local devices,
+  where the ``dcn_data`` axis lies exactly on the process boundary, so
+  the cross-slice gradient reduction truly crosses the jax.distributed
+  transport (Gloo over loopback — the DCN stand-in), not a
+  single-process emulation.
 """
 
 import os
@@ -15,10 +24,12 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # repo root (no install needed)
 os.environ["JAX_PLATFORMS"] = "cpu"
+LOCAL_DEVICES = int(os.environ.get("KFT_LOCAL_DEVICES", "2"))
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=2").strip()
+        f"{flags} --xla_force_host_platform_device_count="
+        f"{LOCAL_DEVICES}").strip()
 
 import jax  # noqa: E402
 
@@ -28,7 +39,6 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 import optax  # noqa: E402
 
-from kubeflow_tpu.models.resnet import resnet18ish  # noqa: E402
 from kubeflow_tpu.parallel.mesh import (  # noqa: E402
     MeshSpec,
     batch_sharding,
@@ -38,17 +48,23 @@ from kubeflow_tpu.training.launcher import (  # noqa: E402
     initialize_distributed,
 )
 from kubeflow_tpu.training.data import host_shard_range  # noqa: E402
-from kubeflow_tpu.training.train import (  # noqa: E402
-    create_train_state,
-    make_train_step,
-    place_state,
-)
 
 
-def main() -> int:
-    assert initialize_distributed(), "env must describe a 2-process gang"
-    assert jax.process_count() == 2
-    assert len(jax.devices()) == 4  # 2 hosts × 2 local devices
+def _feed(mesh, host_batch):
+    sharding = batch_sharding(mesh)
+    return {
+        k: jax.make_array_from_process_local_data(sharding, v)
+        for k, v in host_batch.items()
+    }
+
+
+def run_resnet() -> float:
+    from kubeflow_tpu.models.resnet import resnet18ish
+    from kubeflow_tpu.training.train import (
+        create_train_state,
+        make_train_step,
+        place_state,
+    )
 
     mesh = build_mesh(MeshSpec(data=4))
     model = resnet18ish(num_classes=10)
@@ -62,19 +78,75 @@ def main() -> int:
     rng = np.random.RandomState(0)  # same stream on both hosts
     images = rng.randn(global_batch, 32, 32, 3).astype(np.float32)
     labels = rng.randint(0, 10, global_batch)
-    sharding = batch_sharding(mesh)
-    batch = {
-        "inputs": jax.make_array_from_process_local_data(
-            sharding, images[rows.start:rows.stop].astype(jnp.bfloat16)),
-        "labels": jax.make_array_from_process_local_data(
-            sharding, labels[rows.start:rows.stop]),
-    }
+    batch = _feed(mesh, {
+        "inputs": images[rows.start:rows.stop].astype(jnp.bfloat16),
+        "labels": labels[rows.start:rows.stop],
+    })
 
     step = make_train_step(mesh)
     for _ in range(2):
         state, metrics = step(state, batch)
-    loss = float(metrics["loss"])
-    print(f"GANG_OK process={jax.process_index()} "
+    return float(metrics["loss"])
+
+
+def run_bert_dcn() -> float:
+    """BASELINE row 3's code path: BERT MLM on the hierarchical
+    dcn_data × data mesh with dcn_data spanning the two processes
+    (SURVEY §2.5 topology row; no fsdp — see the SPMD-quality note in
+    ``__graft_entry__._dryrun_bert_dcn``)."""
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.training.lm import (
+        create_lm_state,
+        make_lm_train_step,
+    )
+
+    mesh = build_mesh(MeshSpec(dcn_data=2, data=4))
+    # The whole point: the outermost (cross-slice) axis must lie on
+    # the process boundary, so its gradient reduction crosses the
+    # jax.distributed transport.
+    dev = np.asarray(mesh.devices)
+    slice0 = {d.process_index for d in dev[0].ravel()}
+    slice1 = {d.process_index for d in dev[1].ravel()}
+    assert slice0 == {0} and slice1 == {1}, (slice0, slice1)
+
+    model = get_model("bert-test").make()
+    global_batch, seq_len, vocab = 16, 16, 512
+    rng = np.random.RandomState(7)  # same stream on both hosts
+    ids = rng.randint(5, vocab, (global_batch, seq_len))
+    mask = rng.random_sample((global_batch, seq_len)) < 0.3
+    # Global-shaped sample for tracing/init (values identical on both
+    # hosts; only shapes matter to the jitted init); this host's rows
+    # of the SAME dict feed the step.
+    sample = {
+        "input_ids": np.where(mask, 3, ids).astype(np.int32),
+        "type_ids": np.zeros_like(ids).astype(np.int32),
+        "valid": np.ones_like(ids).astype(np.int32),
+        "mlm_labels": ids.astype(np.int32),
+        "mlm_weights": mask.astype(np.int32),
+    }
+    host = host_shard_range(global_batch)
+    host_batch = {k: v[host.start:host.stop] for k, v in sample.items()}
+    state, shardings = create_lm_state(
+        model, optax.adamw(1e-3), jax.random.PRNGKey(0), sample, mesh)
+    step = make_lm_train_step(mesh, shardings, objective="mlm",
+                              donate=False)
+    batch = _feed(mesh, host_batch)
+    for _ in range(2):
+        state, metrics = step(state, batch)
+    assert int(jax.device_get(state.step)) == 2
+    return float(metrics["loss"])
+
+
+MODES = {"resnet": run_resnet, "bert_dcn": run_bert_dcn}
+
+
+def main() -> int:
+    mode = os.environ.get("KFT_GANG_MODE", "resnet")
+    assert initialize_distributed(), "env must describe a 2-process gang"
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 2 * LOCAL_DEVICES
+    loss = MODES[mode]()
+    print(f"GANG_OK mode={mode} process={jax.process_index()} "
           f"devices={len(jax.devices())} loss={loss:.6f}", flush=True)
     return 0
 

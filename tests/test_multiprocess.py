@@ -1,11 +1,18 @@
-"""Real multi-process gang test: 2 jax.distributed processes (Gloo
+"""Real multi-process gang tests: 2 jax.distributed processes (Gloo
 over loopback — the DCN stand-in), operator env contract → launcher
-bootstrap → one SPMD train step on the global 4-device mesh.
+bootstrap → SPMD train steps on the global mesh.
 
 This is the tier the reference could only run on a live GKE cluster
 (SURVEY §4); here it's hermetic. Both processes must converge to the
 SAME loss — the gradient all-reduce across processes is the thing
-under test."""
+under test. Two layouts:
+
+- flat data-parallel resnet (2×2 devices);
+- the BASELINE multi-host BERT row: hierarchical dcn_data=2 × data=4
+  mesh (2×4 devices) with the cross-slice axis on the process
+  boundary — the coordinator + DCN-spanning-mesh combination, not its
+  single-process dryrun emulation (VERDICT-r3 weak #2).
+"""
 
 import os
 import re
@@ -25,8 +32,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_gang_trains_to_identical_loss():
+def _run_gang(mode: str, local_devices: int):
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -38,8 +44,11 @@ def test_two_process_gang_trains_to_identical_loss():
             KFT_PROCESS_ID=str(pid),
             KFT_REPLICA_TYPE="TPU_WORKER",
             KFT_REPLICA_INDEX=str(pid),
+            KFT_GANG_MODE=mode,
+            KFT_LOCAL_DEVICES=str(local_devices),
         )
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={local_devices}")
         procs.append(subprocess.Popen(
             [sys.executable, str(WORKER)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
@@ -50,8 +59,26 @@ def test_two_process_gang_trains_to_identical_loss():
         assert p.returncode == 0, out[-2000:]
     losses = []
     for out in outputs:
-        m = re.search(r"GANG_OK process=(\d) devices=4 loss=([0-9.]+)", out)
+        m = re.search(
+            rf"GANG_OK mode={mode} process=(\d) "
+            rf"devices={2 * local_devices} loss=([0-9.]+)", out)
         assert m, out[-2000:]
         losses.append(float(m.group(2)))
+    return losses
+
+
+@pytest.mark.slow
+def test_two_process_gang_trains_to_identical_loss():
+    losses = _run_gang("resnet", local_devices=2)
     # The all-reduce makes the state identical on both hosts.
+    assert losses[0] == losses[1], losses
+
+
+@pytest.mark.slow
+def test_two_process_bert_dcn_hierarchical_mesh():
+    """BASELINE row 3 end-to-end: BERT MLM over a dcn_data=2 × data=4
+    mesh whose outer axis crosses the process boundary. The
+    cross-slice gradient reduction rides the jax.distributed
+    transport; both processes end at the same loss."""
+    losses = _run_gang("bert_dcn", local_devices=4)
     assert losses[0] == losses[1], losses
