@@ -11,11 +11,12 @@ Modes (KFT_GANG_MODE):
 - ``resnet`` (default): flat data=4 mesh, 2 procs × 2 local devices —
   the basic cross-process gradient all-reduce.
 - ``bert_dcn``: the BASELINE multi-host BERT row — hierarchical
-  (dcn_data=2, data=2, fsdp=2) mesh over 2 procs × 4 local devices,
-  where the ``dcn_data`` axis lies exactly on the process boundary, so
-  the cross-slice gradient reduction truly crosses the jax.distributed
+  (dcn_data=2, data=4) mesh over 2 procs × 4 local devices, where the
+  ``dcn_data`` axis lies exactly on the process boundary, so the
+  cross-slice gradient reduction truly crosses the jax.distributed
   transport (Gloo over loopback — the DCN stand-in), not a
-  single-process emulation.
+  single-process emulation. Deliberately no fsdp in this layout: see
+  the SPMD-quality note in ``__graft_entry__._dryrun_bert_dcn``.
 """
 
 import os

@@ -1,5 +1,7 @@
 """Evaluation harness: exact aggregates, ppl sanity, lora variables."""
 
+import os
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -99,3 +101,26 @@ def test_evaluate_honors_preshifted_targets():
     # what the implicit shift does).
     np.testing.assert_allclose(implicit["loss"], explicit["loss"],
                                rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_convergence_vision_smoke(tmp_path):
+    """The on-chip convergence proof's full path (data gen → shards →
+    prefetch → train → eval) on CPU at smoke scale: must beat chance
+    clearly on the easy prototype task."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    script = Path(__file__).parent.parent / "scripts" / "convergence_vision.py"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, str(script), "--steps", "40", "--batch", "32",
+         "--n_train", "512", "--n_eval", "256", "--lr", "0.05",
+         "--data_dir", str(tmp_path), "--min_accuracy", "0.2"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["eval_accuracy"] >= 0.2  # chance = 0.1
+    assert result["eval_examples"] == 256
