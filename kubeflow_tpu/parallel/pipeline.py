@@ -119,6 +119,155 @@ def spmd_pipeline(
     return out.reshape((batch,) + out.shape[2:])
 
 
+def _interleaved_inner(
+    stage_fn: StageFn,
+    params: Any,
+    microbatches: jax.Array,
+    axis_name: str,
+    n_virtual: int,
+) -> jax.Array:
+    """Runs INSIDE shard_map. ``params``: this device's virtual-stage
+    params, leaves [n_virtual, ...] (device dim already squeezed).
+    ``microbatches``: [n_micro, mb, ...] (replicated across stages).
+
+    Circular (interleaved / "looping") schedule: total stage count
+    S = n_virtual * n_devices, stage ``s`` living on device
+    ``s % n`` as virtual stage ``s // n``. Microbatch ``m`` enters
+    stage 0 at tick ``(m // n) * n * v + (m % n)`` and then advances
+    one stage per tick without stalling; activations hop device→
+    device on a circular ``ppermute`` (the wrap n-1→0 carries a
+    microbatch to its next virtual stage). The schedule arithmetic
+    below decodes, for every (tick, device), which microbatch and
+    virtual stage that slot holds — each slot is unique, so the whole
+    schedule is index math inside one SPMD loop, exactly like the
+    GPipe variant.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    v = n_virtual
+    n_micro = microbatches.shape[0]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # Last microbatch enters stage 0 at this tick, then needs S ticks.
+    total_ticks = ((n_micro - 1) // n) * n * v + ((n_micro - 1) % n) + n * v
+
+    def tick(t, carry):
+        state, outputs = carry
+        # Decode this (tick, device) slot. K = floor((t - idx) / n) is
+        # the device's slot counter; it splits into (group, virtual
+        # stage), and the microbatch residue r completes the id.
+        r = jnp.mod(t - idx, n)
+        big_k = (t - idx - r) // n
+        group = big_k // v
+        virt = jnp.mod(big_k, v)
+        m = group * n + r
+        active = (big_k >= 0) & (m < n_micro)
+        m_safe = jnp.clip(m, 0, n_micro - 1)
+        feed = jax.lax.dynamic_index_in_dim(
+            microbatches, m_safe, 0, keepdims=False)
+        ingest = active & (virt == 0) & (idx == 0)
+        state = jnp.where(ingest, feed, state)
+        # virt = mod(·, v) is already in [0, v) even for negative
+        # big_k (inactive slots), so it indexes safely as-is.
+        stage_params = jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(
+                p, virt, 0, keepdims=False),
+            params)
+        out = stage_fn(stage_params, state)
+        write = active & (virt == v - 1) & (idx == n - 1)
+        outputs = jnp.where(
+            write,
+            jax.lax.dynamic_update_index_in_dim(outputs, out, m_safe, 0),
+            outputs,
+        )
+        state = jax.lax.ppermute(out, axis_name, perm)
+        return state, outputs
+
+    state = jnp.zeros_like(microbatches[0])
+    outputs = jnp.zeros_like(microbatches)
+    _, outputs = jax.lax.fori_loop(
+        0, total_ticks, tick, (state, outputs)
+    )
+    outputs = jnp.where(idx == n - 1, outputs, 0)
+    return jax.lax.psum(outputs, axis_name)
+
+
+def interleave_stage_params(stacked_params: Any, n_devices: int) -> Any:
+    """[S, ...]-stacked stage params → the [v, n_devices, ...] layout
+    :func:`spmd_pipeline_interleaved` consumes (stage ``s = q*n + d``
+    lands at position ``[q, d]``, i.e. device ``d`` holds the cyclic
+    set of stages — a plain reshape, since ``s → (s // n, s % n)``)."""
+
+    def reshape(p):
+        if p.shape[0] % n_devices:
+            raise ValueError(
+                f"stage count {p.shape[0]} % devices {n_devices}")
+        return p.reshape((p.shape[0] // n_devices, n_devices)
+                         + p.shape[1:])
+
+    return jax.tree.map(reshape, stacked_params)
+
+
+def spmd_pipeline_interleaved(
+    stage_fn: StageFn,
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+    n_virtual: int,
+    axis_name: str = "pipeline",
+    batch_axis: str = None,
+) -> jax.Array:
+    """Interleaved (virtual-stage / "circular") pipeline schedule.
+
+    Same contract as :func:`spmd_pipeline` but with
+    ``S = n_virtual * n_devices`` total stages, device ``d`` holding
+    the cyclic stage set ``{q*n + d}``. Each tick runs ONE virtual
+    stage (1/v of a GPipe tick), and the fill/drain cost stays at
+    ``n - 1`` of these small ticks — so the idle fraction drops from
+    GPipe's ``(n-1)/(n_micro + n-1)`` to
+    ``(n-1)/(n_micro*v + n-1)`` (see
+    :func:`bubble_fraction_interleaved`), bought with v× more
+    ppermute hops per microbatch (cheap on ICI).
+
+    ``stacked_params``: pytree with leading dims ``[n_virtual,
+    n_devices, ...]`` in the layout of :func:`interleave_stage_params`.
+    """
+    n_stages = mesh.shape[axis_name]
+    leaf = jax.tree.leaves(stacked_params)[0]
+    if leaf.shape[:1] != (n_virtual,) or leaf.shape[1] != n_stages:
+        raise ValueError(
+            f"stacked_params leading dims {leaf.shape[:2]} != "
+            f"(n_virtual={n_virtual}, pipeline={n_stages})")
+    batch = x.shape[0]
+    if batch % n_microbatches:
+        raise ValueError(f"batch {batch} % microbatches {n_microbatches}")
+    mb = batch // n_microbatches
+    if batch_axis is not None and mb % mesh.shape[batch_axis]:
+        raise ValueError(
+            f"microbatch rows {mb} % {batch_axis} axis "
+            f"{mesh.shape[batch_axis]}")
+    microbatches = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    param_spec = jax.tree.map(lambda _: P(None, axis_name),
+                              stacked_params)
+    mb_spec = P(None, batch_axis) if batch_axis else P()
+
+    def inner(params, mbs):
+        params = jax.tree.map(lambda p: p[:, 0], params)  # squeeze dev
+        return _interleaved_inner(stage_fn, params, mbs, axis_name,
+                                  n_virtual)
+
+    out = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(param_spec, mb_spec),
+        out_specs=mb_spec,
+        check_vma=False,
+    )(stacked_params, microbatches)
+    return out.reshape((batch,) + out.shape[2:])
+
+
 def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
     """GPipe schedule idle fraction — the depth-usability number.
 
@@ -129,15 +278,39 @@ def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
     the backward pass (autodiff reverses the same loop), so this is
     the whole-step figure. 1F1B *reorders* fwd/bwd work (an activation-
     memory win) but fills none of these idle slots; only interleaved /
-    virtual-stage schedules shrink the bubble, at the cost of
-    ``v``-fold more ppermute hops. Microbatch count is the lever:
-    bubble < 10% needs ``n_micro > 9 * (n_stages - 1)``.
+    virtual-stage schedules shrink the bubble
+    (:func:`spmd_pipeline_interleaved`,
+    :func:`bubble_fraction_interleaved`), at the cost of ``v``-fold
+    more ppermute hops. Microbatch count is the lever: bubble < 10%
+    needs ``n_micro > 9 * (n_stages - 1)``.
     """
     if n_stages < 1 or n_microbatches < 1:
         raise ValueError(
             f"need n_stages >= 1 and n_microbatches >= 1; got "
             f"{n_stages}, {n_microbatches}")
     return (n_stages - 1) / (n_microbatches + n_stages - 1)
+
+
+def bubble_fraction_interleaved(n_stages: int, n_microbatches: int,
+                                n_virtual: int) -> float:
+    """Idle fraction of the circular schedule in
+    :func:`spmd_pipeline_interleaved`.
+
+    The loop issues ``((M-1)//n)*n*v + (M-1)%n + n*v`` ticks; each
+    device carries ``M * v`` live virtual-stage executions. When
+    ``n`` divides ``M`` this reduces to ``(n-1)/(M*v + n-1)`` — the
+    GPipe bubble with the microbatch count multiplied by ``v``
+    (Megatron-LM's interleaved-schedule result: fill/drain is still
+    ``n-1`` hops, but each hop is 1/v of a device's per-microbatch
+    work). Doubling ``v`` roughly halves the bubble at fixed M.
+    """
+    if n_stages < 1 or n_microbatches < 1 or n_virtual < 1:
+        raise ValueError(
+            f"need n_stages, n_microbatches, n_virtual >= 1; got "
+            f"{n_stages}, {n_microbatches}, {n_virtual}")
+    n, m, v = n_stages, n_microbatches, n_virtual
+    ticks = ((m - 1) // n) * n * v + ((m - 1) % n) + n * v
+    return (ticks - m * v) / ticks
 
 
 def stack_stage_params(param_list) -> Any:
