@@ -163,6 +163,24 @@ def main() -> int:
     except Exception as e:  # serving line is secondary too
         extra["serving_bench_error"] = str(e)[:200]
 
+    # LM generation serving (r4): a generate-signature export driven
+    # through :generate / gRPC Predict — the serve-side counterpart
+    # of the decode row above (llama-test isolates stack overhead;
+    # weight streaming is the decode bench's job).
+    try:
+        lm_serving = run_serving_benchmark(ServingBenchConfig(
+            model="llama-test", clients=2, requests_per_client=8,
+            warmup_requests=2, transport="grpc",
+            prompt_len=32, new_tokens=16))
+        extra["llama-test_generate_serving_p50_ms"] = (
+            lm_serving["p50_ms"])
+        extra["llama-test_generate_serving_rps"] = (
+            lm_serving["throughput_rps"])
+        extra["llama-test_generate_direct_ms"] = (
+            lm_serving["direct_model_ms"])
+    except Exception as e:  # secondary line; never sink the bench
+        extra["lm_serving_bench_error"] = str(e)[:200]
+
     print(
         json.dumps(
             {

@@ -20,6 +20,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from kubeflow_tpu.models.registry import ModelEntry, register_model
+from kubeflow_tpu.ops.batch_norm import GhostBatchNorm
 
 
 class ConvBN(nn.Module):
@@ -30,6 +31,7 @@ class ConvBN(nn.Module):
     strides: Tuple[int, int] = (1, 1)
     padding: str = "SAME"
     dtype: Any = jnp.bfloat16
+    bn_stat_rows: int = 0  # ghost-BN stats cap; 0 = exact BN
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -38,9 +40,13 @@ class ConvBN(nn.Module):
             padding=self.padding, use_bias=False, dtype=self.dtype,
             name="conv",
         )(x)
-        x = nn.BatchNorm(
+        # GhostBatchNorm == nn.BatchNorm bit-for-bit at stat_rows=0
+        # (same param/collection layout — tests/test_batch_norm.py);
+        # stat_rows>0 is the BN-stat-HBM lever measured on resnet
+        # (PERF.md), same single-chip caveats.
+        x = GhostBatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-3,
-            dtype=self.dtype, name="bn",
+            dtype=self.dtype, stat_rows=self.bn_stat_rows, name="bn",
         )(x)
         return nn.relu(x)
 
@@ -54,10 +60,12 @@ def _pool(x, kind: str):
 class InceptionA(nn.Module):
     pool_features: int
     dtype: Any = jnp.bfloat16
+    bn_stat_rows: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool):
-        conv = functools.partial(ConvBN, dtype=self.dtype)
+        conv = functools.partial(ConvBN, dtype=self.dtype,
+                                 bn_stat_rows=self.bn_stat_rows)
         b1 = conv(64, (1, 1), name="b1x1")(x, train)
         b5 = conv(48, (1, 1), name="b5x5_1")(x, train)
         b5 = conv(64, (5, 5), name="b5x5_2")(b5, train)
@@ -73,10 +81,12 @@ class InceptionB(nn.Module):
     """Grid reduction 35→17."""
 
     dtype: Any = jnp.bfloat16
+    bn_stat_rows: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool):
-        conv = functools.partial(ConvBN, dtype=self.dtype)
+        conv = functools.partial(ConvBN, dtype=self.dtype,
+                                 bn_stat_rows=self.bn_stat_rows)
         b3 = conv(384, (3, 3), (2, 2), "VALID", name="b3x3")(x, train)
         bd = conv(64, (1, 1), name="b3x3dbl_1")(x, train)
         bd = conv(96, (3, 3), name="b3x3dbl_2")(bd, train)
@@ -90,10 +100,12 @@ class InceptionC(nn.Module):
 
     c7: int
     dtype: Any = jnp.bfloat16
+    bn_stat_rows: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool):
-        conv = functools.partial(ConvBN, dtype=self.dtype)
+        conv = functools.partial(ConvBN, dtype=self.dtype,
+                                 bn_stat_rows=self.bn_stat_rows)
         c7 = self.c7
         b1 = conv(192, (1, 1), name="b1x1")(x, train)
         b7 = conv(c7, (1, 1), name="b7x7_1")(x, train)
@@ -112,10 +124,12 @@ class InceptionD(nn.Module):
     """Grid reduction 17→8."""
 
     dtype: Any = jnp.bfloat16
+    bn_stat_rows: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool):
-        conv = functools.partial(ConvBN, dtype=self.dtype)
+        conv = functools.partial(ConvBN, dtype=self.dtype,
+                                 bn_stat_rows=self.bn_stat_rows)
         b3 = conv(192, (1, 1), name="b3x3_1")(x, train)
         b3 = conv(320, (3, 3), (2, 2), "VALID", name="b3x3_2")(b3, train)
         b7 = conv(192, (1, 1), name="b7x7x3_1")(x, train)
@@ -130,10 +144,12 @@ class InceptionE(nn.Module):
     """Expanded-filter-bank output blocks."""
 
     dtype: Any = jnp.bfloat16
+    bn_stat_rows: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool):
-        conv = functools.partial(ConvBN, dtype=self.dtype)
+        conv = functools.partial(ConvBN, dtype=self.dtype,
+                                 bn_stat_rows=self.bn_stat_rows)
         b1 = conv(320, (1, 1), name="b1x1")(x, train)
         b3 = conv(384, (1, 1), name="b3x3_1")(x, train)
         b3 = jnp.concatenate([
@@ -155,10 +171,12 @@ class InceptionV3(nn.Module):
 
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    bn_stat_rows: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        conv = functools.partial(ConvBN, dtype=self.dtype)
+        conv = functools.partial(ConvBN, dtype=self.dtype,
+                                 bn_stat_rows=self.bn_stat_rows)
         x = x.astype(self.dtype)
         x = conv(32, (3, 3), (2, 2), "VALID", name="stem1")(x, train)
         x = conv(32, (3, 3), padding="VALID", name="stem2")(x, train)
@@ -168,16 +186,17 @@ class InceptionV3(nn.Module):
         x = conv(192, (3, 3), padding="VALID", name="stem5")(x, train)
         x = nn.max_pool(x, (3, 3), (2, 2), "VALID")
 
+        rows = self.bn_stat_rows
         for i, pool_features in enumerate((32, 64, 64)):
-            x = InceptionA(pool_features, self.dtype,
+            x = InceptionA(pool_features, self.dtype, rows,
                            name=f"mixed5{'bcd'[i]}")(x, train)
-        x = InceptionB(self.dtype, name="mixed6a")(x, train)
+        x = InceptionB(self.dtype, rows, name="mixed6a")(x, train)
         for i, c7 in enumerate((128, 160, 160, 192)):
-            x = InceptionC(c7, self.dtype,
+            x = InceptionC(c7, self.dtype, rows,
                            name=f"mixed6{'bcde'[i]}")(x, train)
-        x = InceptionD(self.dtype, name="mixed7a")(x, train)
-        x = InceptionE(self.dtype, name="mixed7b")(x, train)
-        x = InceptionE(self.dtype, name="mixed7c")(x, train)
+        x = InceptionD(self.dtype, rows, name="mixed7a")(x, train)
+        x = InceptionE(self.dtype, rows, name="mixed7b")(x, train)
+        x = InceptionE(self.dtype, rows, name="mixed7c")(x, train)
 
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(
@@ -185,9 +204,10 @@ class InceptionV3(nn.Module):
         return x
 
 
-def inception_v3(num_classes: int = 1000, dtype: Any = jnp.bfloat16
-                 ) -> InceptionV3:
-    return InceptionV3(num_classes=num_classes, dtype=dtype)
+def inception_v3(num_classes: int = 1000, dtype: Any = jnp.bfloat16,
+                 bn_stat_rows: int = 0) -> InceptionV3:
+    return InceptionV3(num_classes=num_classes, dtype=dtype,
+                       bn_stat_rows=bn_stat_rows)
 
 
 register_model(ModelEntry(

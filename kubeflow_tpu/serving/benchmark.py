@@ -49,6 +49,17 @@ class ServingBenchConfig:
     # clients and report rps + mean batch fill (uses `transport`, or
     # grpc when transport="both" — the cheaper wire isolates batching).
     sweep_clients: Sequence[int] = ()
+    # Language models (family == "language" in the registry) are
+    # exported with a generate signature and driven through
+    # ``:generate`` / gRPC Predict instead of ``:classify``:
+    prompt_len: int = 32
+    new_tokens: int = 16
+
+
+def _is_language(model: str) -> bool:
+    from kubeflow_tpu.models.registry import get_model
+
+    return get_model(model).family == "language"
 
 
 def _export(config: ServingBenchConfig) -> str:
@@ -62,19 +73,36 @@ def _export(config: ServingBenchConfig) -> str:
         TensorSpec,
     )
 
-    hw = config.image_hw
-    meta = ModelMetadata(
-        model_name="bench", registry_name=config.model,
-        model_kwargs={"dtype": "float32"},
-        signatures={"serving_default": Signature(
-            method="classify",
-            inputs={"images": TensorSpec("float32", (-1, hw, hw, 3))},
-            outputs={"classes": TensorSpec("int32", (-1, 5)),
-                     "scores": TensorSpec("float32", (-1, 5))})})
-    module = get_model(config.model).make(dtype="float32")
-    variables = jax.jit(module.init, static_argnames=("train",))(
-        jax.random.PRNGKey(0), np.zeros((1, hw, hw, 3), np.float32),
-        train=False)
+    if _is_language(config.model):
+        # Generate-signature export through the export CLI's own
+        # metadata builder, so the benchmark measures exactly the
+        # artifact `kft-export --generate` produces (cache_size =
+        # prompt + new tokens, greedy decode baked at export).
+        from kubeflow_tpu.serving.export_cli import _build_metadata
+
+        meta = _build_metadata(
+            "bench", config.model, get_model(config.model),
+            config.prompt_len, "generate",
+            {"max_new_tokens": config.new_tokens, "temperature": 0.0},
+            {"dtype": "float32"})
+        module = get_model(config.model).make(dtype="float32")
+        ids = np.zeros((1, config.prompt_len), np.int32)
+        variables = jax.jit(module.init)(jax.random.PRNGKey(0), ids)
+        variables = {"params": variables["params"]}
+    else:
+        hw = config.image_hw
+        meta = ModelMetadata(
+            model_name="bench", registry_name=config.model,
+            model_kwargs={"dtype": "float32"},
+            signatures={"serving_default": Signature(
+                method="classify",
+                inputs={"images": TensorSpec("float32", (-1, hw, hw, 3))},
+                outputs={"classes": TensorSpec("int32", (-1, 5)),
+                         "scores": TensorSpec("float32", (-1, 5))})})
+        module = get_model(config.model).make(dtype="float32")
+        variables = jax.jit(module.init, static_argnames=("train",))(
+            jax.random.PRNGKey(0), np.zeros((1, hw, hw, 3), np.float32),
+            train=False)
     base = pathlib.Path(tempfile.mkdtemp()) / "bench"
     export_model(str(base), 1, meta, variables)
     return str(base)
@@ -104,10 +132,11 @@ def _serve(manager, port: int, handle: _ServerHandle):
     handle.loop.start()
 
 
-def _http_request_fn(port: int, payload: bytes) -> Callable[[], float]:
-    """One JSON :classify round trip (urllib, fresh connection per
-    request — the reference client's behavior)."""
-    url = f"http://127.0.0.1:{port}/v1/models/bench:classify"
+def _http_request_fn(port: int, payload: bytes,
+                     verb: str = "classify") -> Callable[[], float]:
+    """One JSON round trip (urllib, fresh connection per request —
+    the reference client's behavior)."""
+    url = f"http://127.0.0.1:{port}/v1/models/bench:{verb}"
 
     def one_request(timeout: float = 120.0) -> float:
         req = urllib.request.Request(
@@ -122,9 +151,12 @@ def _http_request_fn(port: int, payload: bytes) -> Callable[[], float]:
     return one_request
 
 
-def _grpc_request_fn(channel, request: bytes) -> Callable[[], float]:
+def _grpc_request_fn(channel, request: bytes,
+                     expect_key: str = "scores") -> Callable[[], float]:
     """One binary Predict round trip on a persistent channel (the
-    reference client dialed once and reused the stub, label.py:40-43)."""
+    reference client dialed once and reused the stub, label.py:40-43).
+    Predict executes the signature's own method, so the same RPC
+    serves classify and generate exports."""
     from kubeflow_tpu.serving import wire
 
     call = channel.unary_unary("/tensorflow.serving.PredictionService/Predict")
@@ -134,7 +166,7 @@ def _grpc_request_fn(channel, request: bytes) -> Callable[[], float]:
         response = call(request, timeout=timeout)
         dt = time.perf_counter() - t0
         _, outputs = wire.decode_predict_response(response)
-        assert "scores" in outputs, sorted(outputs)
+        assert expect_key in outputs, sorted(outputs)
         return dt
 
     return one_request
@@ -222,35 +254,45 @@ def _drive(config: ServingBenchConfig, manager, model,
            handle: _ServerHandle, grpc_port: int) -> Dict[str, float]:
     import contextlib
 
-    hw = config.image_hw
     rng = np.random.RandomState(42)
-    image = (rng.randint(0, 256, (1, hw, hw, 3)) / 255.0).astype(np.float32)
+    if _is_language(config.model):
+        inputs = {"input_ids": rng.randint(
+            0, 128, (1, config.prompt_len)).astype(np.int32)}
+        verb, expect_key = "generate", "tokens"
+    else:
+        hw = config.image_hw
+        inputs = {"images": (rng.randint(0, 256, (1, hw, hw, 3))
+                             / 255.0).astype(np.float32)}
+        verb, expect_key = "classify", "scores"
+    (feed_name, feed), = inputs.items()
 
-    json_payload = json.dumps({"instances": image.tolist()}).encode()
+    json_payload = json.dumps({"instances": feed.tolist()}).encode()
     sizes = {"json_request_bytes": len(json_payload)}
     transports: Dict[str, Callable[[], float]] = {}
     with contextlib.ExitStack() as stack:
         if config.transport in ("http", "both"):
-            transports["http"] = _http_request_fn(handle.port, json_payload)
+            transports["http"] = _http_request_fn(handle.port, json_payload,
+                                                  verb)
         if config.transport in ("grpc", "both"):
             import grpc
 
             from kubeflow_tpu.serving import wire
 
-            grpc_request = wire.encode_predict_request(
-                "bench", {"images": image})
+            grpc_request = wire.encode_predict_request("bench", inputs)
             sizes["grpc_request_bytes"] = len(grpc_request)
             # Closed on exit even when a measurement assertion fires
             # mid-drive (bench.py catches and carries on — the
             # channel's worker threads must not outlive this run).
             channel = stack.enter_context(contextlib.closing(
                 grpc.insecure_channel(f"127.0.0.1:{grpc_port}")))
-            transports["grpc"] = _grpc_request_fn(channel, grpc_request)
-        return _drive_measurements(config, model, transports, sizes, image)
+            transports["grpc"] = _grpc_request_fn(channel, grpc_request,
+                                                  expect_key)
+        return _drive_measurements(config, model, transports, sizes,
+                                   inputs)
 
 
 def _drive_measurements(config: ServingBenchConfig, model, transports,
-                        sizes, image) -> Dict[str, float]:
+                        sizes, inputs) -> Dict[str, float]:
 
     # Warmup: first requests compile the predict buckets; warm every
     # wire under test so neither pays first-touch costs in the timed run.
@@ -290,11 +332,13 @@ def _drive_measurements(config: ServingBenchConfig, model, transports,
     # Bare model execution for the same single image: quantifies the
     # wire + batcher overhead on top of XLA.
     loaded = model.get()
+    out_key = next(iter(loaded.metadata.signatures[
+        "serving_default"].outputs))
     direct = []
     for _ in range(16):
         t0 = time.perf_counter()
-        out = loaded.run({"images": image})
-        np.asarray(out["scores"])  # host fence
+        out = loaded.run(inputs)
+        np.asarray(out[out_key])  # host fence
         direct.append(time.perf_counter() - t0)
     result["direct_model_ms"] = round(float(np.median(direct)) * 1e3, 2)
     return result
@@ -313,6 +357,12 @@ def main(argv=None) -> int:
     parser.add_argument("--sweep", default="",
                         help="comma-separated client counts, e.g. 1,2,4,8")
     parser.add_argument("--max_batch", type=int, default=4)
+    parser.add_argument("--prompt_len", type=int, default=32,
+                        help="language models: prompt length of the "
+                             ":generate requests")
+    parser.add_argument("--new_tokens", type=int, default=16,
+                        help="language models: tokens generated per "
+                             "request (baked at export)")
     parser.add_argument("--port", type=int, default=0,
                         help="0 = ephemeral")
     args = parser.parse_args(argv)
@@ -322,7 +372,8 @@ def main(argv=None) -> int:
         model=args.model, image_hw=args.image_hw, clients=args.clients,
         requests_per_client=args.requests_per_client,
         max_batch=args.max_batch, port=args.port,
-        transport=args.transport, sweep_clients=sweep))
+        transport=args.transport, sweep_clients=sweep,
+        prompt_len=args.prompt_len, new_tokens=args.new_tokens))
     print(json.dumps(result))
     return 0
 

@@ -377,6 +377,10 @@ def main(argv=None) -> int:
                              "trace under this dir (TensorBoard/XProf-"
                              "readable; surfaced by the dashboard's "
                              "trace tab — docs/profiling.md)")
+    parser.add_argument("--bn_stat_rows", type=int, default=0,
+                        help="ghost-BN statistics row cap for vision "
+                             "models (0 = exact BN; single-chip "
+                             "lever, see PERF.md)")
     args = parser.parse_args(argv)
     from kubeflow_tpu.utils.platform import sync_platform_from_env
 
@@ -384,6 +388,17 @@ def main(argv=None) -> int:
     # tpu-cnn job must not dispatch to a tunnel-registered TPU).
     sync_platform_from_env()
     entry = get_model(args.model)
+    if args.bn_stat_rows and entry.family != "vision":
+        # Silently ignoring the flag would report an exact-BN number
+        # as a ghost-BN one; models without BN fail loudly below.
+        parser.error(
+            f"--bn_stat_rows applies to vision models; {args.model!r} "
+            f"is {entry.family}")
+    if args.bn_stat_rows < 0:
+        # GhostBatchNorm's `0 < stat_rows` guard would silently fall
+        # back to exact BN — the same misreport, negative edition.
+        parser.error(f"--bn_stat_rows must be >= 0; got "
+                     f"{args.bn_stat_rows}")
     if args.lora_rank > 0 and entry.family != "language":
         # Never fall through to the wrong benchmark: a tpu-finetune
         # job with a vision model must fail loudly, not run (and
@@ -422,7 +437,9 @@ def main(argv=None) -> int:
             BenchConfig(model=args.model,
                         batch_size=args.batch_size or 128,
                         steps=args.steps, image_size=args.image_size,
-                        profile_dir=args.profile_dir)
+                        profile_dir=args.profile_dir,
+                        model_kwargs=({"bn_stat_rows": args.bn_stat_rows}
+                                      if args.bn_stat_rows else None))
         )
     print(json.dumps(result))
     return 0

@@ -172,3 +172,28 @@ def test_ghost_bn_grads_flow_through_stat_rows():
     # Rows outside the stat subset still receive gradients (they are
     # normalized, just don't contribute to the stats).
     assert np.abs(np.asarray(g[4:])).sum() > 0
+
+
+def test_inception_ghost_bn_layout_and_exactness():
+    """Inception's ConvBN carries the same ghost-BN lever as resnet:
+    identical param/collection tree to the exact-BN module, and
+    stat_rows ≥ batch degenerates to exact BN (train and eval).
+    (On the chip the lever measured SLOWER for inception — PERF.md —
+    so the default stays exact; this test pins the wiring.)"""
+    from kubeflow_tpu.models.inception import inception_v3
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 75, 75, 3))
+    m0 = inception_v3(num_classes=10, dtype=jnp.float32)
+    m32 = inception_v3(num_classes=10, dtype=jnp.float32,
+                       bn_stat_rows=32)
+    v0 = m0.init(jax.random.PRNGKey(1), x)
+    v32 = m32.init(jax.random.PRNGKey(1), x)
+    assert jax.tree.structure(v0) == jax.tree.structure(v32)
+    o0, _ = m0.apply(v0, x, train=True, mutable=["batch_stats"])
+    o32, _ = m32.apply(v32, x, train=True, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(o0), np.asarray(o32),
+                               atol=1e-6)
+    e0 = m0.apply(v0, x, train=False)
+    e32 = m32.apply(v32, x, train=False)
+    np.testing.assert_allclose(np.asarray(e0), np.asarray(e32),
+                               atol=1e-6)

@@ -764,3 +764,25 @@ def test_decode_b64_idempotent():
     assert decoded == {"a": b"hello", "b": [1, 2, b"x"], "c": "plain"}
     # idempotent on already-decoded data
     assert decode_b64_if_needed(decoded) == decoded
+
+
+@pytest.mark.slow
+def test_serving_benchmark_lm_generate_branch():
+    """The serving benchmark's language branch: a generate-signature
+    export driven over both wires end-to-end (bench.py's LM serving
+    row). Asserts real latencies and that the gRPC Predict path
+    returned tokens (expect_key check inside the request fn)."""
+    from kubeflow_tpu.serving.benchmark import (
+        ServingBenchConfig,
+        run_serving_benchmark,
+    )
+
+    result = run_serving_benchmark(ServingBenchConfig(
+        model="llama-test", clients=2, requests_per_client=3,
+        warmup_requests=1, transport="both", max_batch=2,
+        prompt_len=8, new_tokens=4))
+    assert result["http_requests"] == 6
+    assert result["grpc_requests"] == 6
+    assert result["http_p50_ms"] > 0
+    assert result["grpc_p50_ms"] > 0
+    assert result["direct_model_ms"] > 0
