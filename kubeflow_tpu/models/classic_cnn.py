@@ -1,0 +1,120 @@
+"""Classic CNN zoo: VGG-16 and AlexNet, TPU-first (NHWC, bf16).
+
+The reference's benchmark harness selected models by string flag
+(``--model`` on tf_cnn_benchmarks, surfaced by the tpu-cnn prototype —
+reference ``kubeflow/tf-job/prototypes/tf-cnn-benchmarks.jsonnet:8-9``
+``@optionalParam model string resnet50``); resnet50/inception3 ship in
+:mod:`resnet` / :mod:`inception`, and these two complete the flag's
+classic values. TPU notes: both are giant-FC models — VGG-16 carries
+~90 % of its parameters in three dense layers and AlexNet ~95 % —
+which map straight onto the MXU as large matmuls, so unlike the
+BN-bound resnet these run close to FLOP-limited. Dropout is omitted
+(the harness measures throughput with synthetic labels; adding rng
+plumbing for a regularizer the benchmark never evaluates would change
+the trainer contract for nothing — same choice the no-BN VGG of the
+original harness made).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.registry import ModelEntry, register_model
+
+
+class VGG(nn.Module):
+    """Stacked 3×3 conv stages + two 4096-wide FC layers (VGG-A..E
+    shape; ``stage_sizes`` picks the depth — (2,2,3,3,3) = VGG-16)."""
+
+    stage_sizes: Sequence[int] = (2, 2, 3, 3, 3)
+    widths: Sequence[int] = (64, 128, 256, 512, 512)
+    num_classes: int = 1000
+    dense_width: int = 4096
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train  # no BN/dropout: train == eval (docstring)
+        conv = functools.partial(nn.Conv, kernel_size=(3, 3),
+                                 padding="SAME", dtype=self.dtype)
+        x = x.astype(self.dtype)
+        for stage, (depth, width) in enumerate(
+                zip(self.stage_sizes, self.widths)):
+            for i in range(depth):
+                x = nn.relu(conv(width, name=f"conv{stage}_{i}")(x))
+            x = nn.max_pool(x, (2, 2), (2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.dense_width, dtype=self.dtype,
+                             name="fc1")(x))
+        x = nn.relu(nn.Dense(self.dense_width, dtype=self.dtype,
+                             name="fc2")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x.astype(jnp.float32))
+
+
+class AlexNet(nn.Module):
+    """Five convs + three FC layers (the 2012 single-tower shape the
+    benchmark harness used; LRN dropped — it predates BN and buys
+    nothing on modern hardware)."""
+
+    num_classes: int = 1000
+    dense_width: int = 4096
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(96, (11, 11), (4, 4), padding="SAME",
+                            dtype=self.dtype, name="conv1")(x))
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        x = nn.relu(nn.Conv(256, (5, 5), padding="SAME",
+                            dtype=self.dtype, name="conv2")(x))
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        x = nn.relu(nn.Conv(384, (3, 3), padding="SAME",
+                            dtype=self.dtype, name="conv3")(x))
+        x = nn.relu(nn.Conv(384, (3, 3), padding="SAME",
+                            dtype=self.dtype, name="conv4")(x))
+        x = nn.relu(nn.Conv(256, (3, 3), padding="SAME",
+                            dtype=self.dtype, name="conv5")(x))
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.dense_width, dtype=self.dtype,
+                             name="fc1")(x))
+        x = nn.relu(nn.Dense(self.dense_width, dtype=self.dtype,
+                             name="fc2")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x.astype(jnp.float32))
+
+
+def vgg16(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> VGG:
+    return VGG(num_classes=num_classes, dtype=dtype)
+
+
+def vgg_test(num_classes: int = 10, dtype: Any = jnp.bfloat16) -> VGG:
+    """3-stage narrow VGG for 32² CI inputs."""
+    return VGG(stage_sizes=(1, 1, 1), widths=(8, 16, 32),
+               num_classes=num_classes, dense_width=64, dtype=dtype)
+
+
+def alexnet(num_classes: int = 1000, dtype: Any = jnp.bfloat16
+            ) -> AlexNet:
+    return AlexNet(num_classes=num_classes, dtype=dtype)
+
+
+# bench_lr: no normalization layers anywhere in these nets — they
+# diverge (NaN within ~15 steps, measured) at the BN-era sgd 0.1;
+# 0.01 is their classic training rate.
+register_model(ModelEntry(
+    "vgg16", "vision", vgg16, ((224, 224, 3), "bfloat16"), 1000,
+    bench_lr=0.01))
+register_model(ModelEntry(
+    "vgg-test", "vision", vgg_test, ((32, 32, 3), "bfloat16"), 10,
+    bench_lr=0.01))
+register_model(ModelEntry(
+    "alexnet", "vision", alexnet, ((224, 224, 3), "bfloat16"), 1000,
+    bench_lr=0.01))

@@ -9,7 +9,7 @@ registry is the typed equivalent the trainer CLI resolves against.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -19,6 +19,11 @@ class ModelEntry:
     make: Callable[..., Any]  # returns a flax Module
     input_spec: Tuple[Tuple[int, ...], str]  # (shape sans batch, dtype)
     num_classes_or_vocab: int
+    # Benchmark sgd lr override for models whose training dynamics
+    # reject the family default (no-norm classics NaN at the BN-era
+    # 0.1); recorded here, next to the model, so new registrations
+    # carry the fact with them.
+    bench_lr: Optional[float] = None
 
 
 _MODELS: Dict[str, ModelEntry] = {}
@@ -39,6 +44,7 @@ def _ensure_loaded() -> None:
         "kubeflow_tpu.models.vit",
         "kubeflow_tpu.models.bert",
         "kubeflow_tpu.models.llama",
+        "kubeflow_tpu.models.classic_cnn",
     ):
         try:
             importlib.import_module(mod)

@@ -264,7 +264,7 @@ def _drive(config: ServingBenchConfig, manager, model,
         inputs = {"images": (rng.randint(0, 256, (1, hw, hw, 3))
                              / 255.0).astype(np.float32)}
         verb, expect_key = "classify", "scores"
-    (feed_name, feed), = inputs.items()
+    feed, = inputs.values()
 
     json_payload = json.dumps({"instances": feed.tolist()}).encode()
     sizes = {"json_request_bytes": len(json_payload)}

@@ -36,7 +36,7 @@ class BenchConfig:
     batch_size: int = 128  # global
     steps: int = 20
     warmup_steps: int = 3
-    learning_rate: float = 0.1
+    learning_rate: Optional[float] = None  # None → per-model default
     momentum: float = 0.9
     mesh: Optional[MeshSpec] = None  # None → all devices on the data axis
     image_size: Optional[int] = None  # override model default (for smoke runs)
@@ -157,7 +157,11 @@ def run_benchmark(config: BenchConfig) -> Dict[str, float]:
     mesh = build_mesh(config.mesh)
     n_chips = mesh.size
 
-    tx = optax.sgd(config.learning_rate, momentum=config.momentum, nesterov=True)
+    # Per-model lr overrides live on the registry entry (the no-norm
+    # classics NaN at the BN-era 0.1 — models/classic_cnn.py).
+    lr = (config.learning_rate if config.learning_rate is not None
+          else (entry.bench_lr if entry.bench_lr is not None else 0.1))
+    tx = optax.sgd(lr, momentum=config.momentum, nesterov=True)
     rng = jax.random.PRNGKey(config.seed)
     sample = jnp.zeros((1, *input_shape), jnp.bfloat16)
     # Jit the init: on remote-tunneled backends eager init dispatches
@@ -381,6 +385,9 @@ def main(argv=None) -> int:
                         help="ghost-BN statistics row cap for vision "
                              "models (0 = exact BN; single-chip "
                              "lever, see PERF.md)")
+    parser.add_argument("--learning_rate", type=float, default=None,
+                        help="vision sgd lr (default: 0.1, or 0.01 "
+                             "for the no-BN classics vgg16/alexnet)")
     args = parser.parse_args(argv)
     from kubeflow_tpu.utils.platform import sync_platform_from_env
 
@@ -399,6 +406,13 @@ def main(argv=None) -> int:
         # back to exact BN — the same misreport, negative edition.
         parser.error(f"--bn_stat_rows must be >= 0; got "
                      f"{args.bn_stat_rows}")
+    if args.learning_rate is not None and entry.family != "vision":
+        # Only the vision config consumes it; silently measuring the
+        # LM benchmarks at their hardcoded adamw lr while reporting
+        # the flag's value is the same misreport class.
+        parser.error(
+            f"--learning_rate applies to vision models; {args.model!r} "
+            f"is {entry.family}")
     if args.lora_rank > 0 and entry.family != "language":
         # Never fall through to the wrong benchmark: a tpu-finetune
         # job with a vision model must fail loudly, not run (and
@@ -438,6 +452,7 @@ def main(argv=None) -> int:
                         batch_size=args.batch_size or 128,
                         steps=args.steps, image_size=args.image_size,
                         profile_dir=args.profile_dir,
+                        learning_rate=args.learning_rate,
                         model_kwargs=({"bn_stat_rows": args.bn_stat_rows}
                                       if args.bn_stat_rows else None))
         )

@@ -1,0 +1,75 @@
+"""Classic CNN zoo (vgg16/alexnet): shapes, training, mesh step —
+the remaining values of the reference's tf-cnn ``--model`` flag."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kubeflow_tpu.models.registry import get_model
+from kubeflow_tpu.models.classic_cnn import alexnet, vgg_test
+from kubeflow_tpu.training.train import (
+    create_train_state,
+    make_train_step,
+    place_batch,
+    place_state,
+)
+
+
+def test_registry_and_forward_shapes():
+    model = get_model("vgg-test").make()
+    x = jnp.zeros((2, 32, 32, 3), jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    # Full-size entries resolve and declare the canonical input.
+    assert get_model("vgg16").input_spec == ((224, 224, 3), "bfloat16")
+    assert get_model("alexnet").input_spec == ((224, 224, 3), "bfloat16")
+
+
+def test_alexnet_forward_small_input():
+    # 64² exercises all three pools (the canonical 224² is too heavy
+    # for CI; stride arithmetic is input-size-independent with SAME).
+    model = alexnet(num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+
+
+def test_vgg_trains_single_device():
+    model = vgg_test(dtype=jnp.float32)
+    state = create_train_state(
+        model, optax.adamw(1e-3), jax.random.PRNGKey(0),
+        jnp.zeros((1, 32, 32, 3), jnp.float32))
+    assert state.batch_stats is None  # no BN in classic VGG
+    step = make_train_step(None, donate=False)
+    rng = np.random.RandomState(0)
+    batch = {"inputs": jnp.asarray(rng.rand(8, 32, 32, 3), jnp.float32),
+             "labels": jnp.asarray(rng.randint(0, 10, 8))}
+    _, first = step(state, batch)
+    for _ in range(10):
+        state, metrics = step(state, batch)
+    assert float(metrics["loss"]) < float(first["loss"])
+
+
+def test_vgg_dp_fsdp_mesh_step():
+    from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2), jax.devices("cpu")[:4])
+    model = vgg_test()
+    state = create_train_state(
+        model, optax.sgd(0.1), jax.random.PRNGKey(0),
+        jnp.zeros((1, 32, 32, 3), jnp.bfloat16))
+    state = place_state(mesh, state)
+    rng = jax.random.PRNGKey(1)
+    batch = place_batch(mesh, {
+        "inputs": jax.random.normal(rng, (8, 32, 32, 3), jnp.bfloat16),
+        "labels": jax.random.randint(rng, (8,), 0, 10)})
+    step = make_train_step(mesh, donate=False)
+    state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    assert int(state.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
