@@ -23,7 +23,7 @@ from typing import Any, Dict, List, Optional
 
 from kubeflow_tpu.manifests import k8s
 from kubeflow_tpu.manifests.tpujob import GROUP, KIND, VERSION
-from kubeflow_tpu.operator.fake import NotFound
+from kubeflow_tpu.operator.fake import Conflict, NotFound
 from kubeflow_tpu.operator.gang import Decision, PodPhase, decide
 from kubeflow_tpu.training.launcher import (
     ENV_COORD,
@@ -236,9 +236,16 @@ class Reconciler:
             if any(m.pod_name(name) in pods for m in members):
                 return phase
 
+        # MISSING means the pod OBJECT is absent. A pod that exists
+        # but has no status.phase yet (the window between create and
+        # the kubelet's first status write) is PENDING — reading it
+        # as MISSING made a resync in that window re-create a live
+        # pod (Conflict; found by the reconciler fuzz).
         phases = [
             PodPhase.from_k8s(
-                pods.get(m.pod_name(name), {}).get("status", {}).get("phase"))
+                pods[m.pod_name(name)].get("status", {}).get("phase")
+                or "Pending")
+            if m.pod_name(name) in pods else PodPhase.MISSING
             for m in members
         ]
         allow_restart = job["spec"].get("recoveryPolicy",
@@ -264,7 +271,13 @@ class Reconciler:
             # quota like the reference's independent replicas).
             for m, p in zip(members, phases):
                 if p == PodPhase.MISSING:
-                    self.api.create(self._member_pod(job, m, members))
+                    try:
+                        self.api.create(self._member_pod(job, m, members))
+                    except Conflict:
+                        # Lost a race (concurrent resync / second
+                        # controller replica): the pod exists, which
+                        # is what this pass wanted. Idempotent.
+                        pass
             return self._set_status(job, "Running" if restarts else "Pending",
                                     restart_count=restarts)
         if decision == Decision.RESTART_SLICE:

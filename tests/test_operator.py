@@ -335,3 +335,22 @@ def test_status_conditions_track_lifecycle():
     assert conds["Running"]["status"] == "False"
     assert sum(c["status"] == "True"
                for c in job["status"]["conditions"]) == 1
+
+
+def test_resync_before_kubelet_status_is_idempotent():
+    """Regression (found by tests/test_operator_fuzz.py): a resync in
+    the window between gang creation and the kubelet's first status
+    write must read status-less pods as PENDING, not MISSING — the
+    MISSING reading made the second pass re-create live pods and
+    crash on Conflict."""
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=3))
+    r = Reconciler(api)
+    assert r.reconcile(job) == "Pending"
+    # Immediately resync: pods exist but carry no status.phase yet.
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Pending"  # no Conflict, no re-create
+    pods = api.list("Pod", "default", {JOB_LABEL: "job1"})
+    assert len(pods) == 3
+    job = api.get("TPUJob", "default", "job1")
+    assert job["status"]["restartCount"] == 0
