@@ -18,7 +18,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from kubeflow_tpu.manifests.tpujob import KIND, PLURAL, GROUP
-from kubeflow_tpu.operator.fake import NotFound
+from kubeflow_tpu.operator.fake import Conflict, NotFound
 from kubeflow_tpu.operator.reconciler import Reconciler
 
 logger = logging.getLogger(__name__)
@@ -35,6 +35,11 @@ class KubectlClient:
         if proc.returncode != 0:
             if "NotFound" in proc.stderr or "not found" in proc.stderr:
                 raise NotFound(proc.stderr.strip())
+            if "AlreadyExists" in proc.stderr or "already exists" in proc.stderr:
+                # Same taxonomy as the fake store, so the reconciler's
+                # idempotent-create handling works on real clusters
+                # too (the dashboard maps this string the same way).
+                raise Conflict(proc.stderr.strip())
             raise RuntimeError(f"kubectl {' '.join(args)}: {proc.stderr}")
         return proc.stdout
 

@@ -354,3 +354,35 @@ def test_resync_before_kubelet_status_is_idempotent():
     assert len(pods) == 3
     job = api.get("TPUJob", "default", "job1")
     assert job["status"]["restartCount"] == 0
+
+
+def test_kubectl_client_error_taxonomy(monkeypatch):
+    """KubectlClient maps kubectl stderr onto the same exception
+    taxonomy as the fake store — without the Conflict mapping the
+    reconciler's idempotent-create handling would only work in
+    tests (found by review of the fuzz fix)."""
+    import subprocess
+    from types import SimpleNamespace
+
+    from kubeflow_tpu.operator.controller import KubectlClient
+    from kubeflow_tpu.operator.fake import Conflict, NotFound
+
+    stderrs = {}
+
+    def fake_run(cmd, **kwargs):
+        return SimpleNamespace(returncode=1, stdout="",
+                               stderr=stderrs["value"])
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    client = KubectlClient()
+
+    stderrs["value"] = 'Error: pods "x" not found (NotFound)'
+    with pytest.raises(NotFound):
+        client._run("get", "pods", "x")
+    stderrs["value"] = ('Error from server (AlreadyExists): '
+                        'pods "x" already exists')
+    with pytest.raises(Conflict):
+        client._run("create", "-f", "-")
+    stderrs["value"] = "Error from server (Forbidden): nope"
+    with pytest.raises(RuntimeError):
+        client._run("get", "pods", "x")
