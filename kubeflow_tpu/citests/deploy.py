@@ -42,7 +42,7 @@ def core_objects(namespace: str) -> List[dict]:
 
 def setup(api, namespace: str, *, fake: bool,
           timeout_s: float = 300.0) -> None:
-    from kubeflow_tpu.operator.fake import NotFound
+    from kubeflow_tpu.operator.fake import Conflict, NotFound
 
     try:
         api.get("Namespace", "", namespace)
@@ -51,7 +51,9 @@ def setup(api, namespace: str, *, fake: bool,
     for obj in core_objects(namespace):
         try:
             api.create(obj)
-        except RuntimeError as e:  # already exists on a re-run
+        except Conflict:  # already exists on a re-run
+            pass
+        except RuntimeError as e:  # pre-taxonomy kubectl surface
             if "AlreadyExists" not in str(e):
                 raise
     deadline = time.monotonic() + (0 if fake else timeout_s)
@@ -80,7 +82,7 @@ def deploy_serving(api, namespace: str, *, fake: bool,
     up — kubeflow-core alone never creates the serving Service the
     serving e2e targets (reference ``test_deploy.py deploy_model``,
     ``:184-217``)."""
-    from kubeflow_tpu.operator.fake import NotFound
+    from kubeflow_tpu.operator.fake import Conflict, NotFound
 
     objs = get_prototype("tpu-serving").build({
         "name": SERVING_NAME, "namespace": namespace,
@@ -92,6 +94,8 @@ def deploy_serving(api, namespace: str, *, fake: bool,
     for obj in objs:
         try:
             api.create(obj)
+        except Conflict:
+            pass
         except RuntimeError as e:
             if "AlreadyExists" not in str(e):
                 raise

@@ -44,3 +44,26 @@ def test_pretrain_llama_causal_with_ckpt(tmp_path, capsys):
     assert main(args) == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["final_step"] == 4
+
+
+def test_pretrain_pipeline_mesh_routes_to_pp_preset(capsys):
+    """A pipeline mesh axis on the tpu-lm CLI selects the pipeline
+    trainer preset (training/pipeline_lm.py) instead of the flat LM
+    trainer — the pp preset's operator-facing entry point."""
+    rc = main([
+        "--model", "llama-test", "--global_batch", "8", "--seq_len",
+        "16", "--steps", "2", "--log_every", "1",
+        "--mesh", "data=4,pipeline=2", "--microbatches", "2",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["mesh"]["pipeline"] == 2
+    assert out["final_step"] == 2
+
+
+def test_pretrain_pipeline_rejects_mlm():
+    with pytest.raises(SystemExit, match="causal decoder"):
+        main([
+            "--model", "bert-test", "--steps", "1",
+            "--mesh", "data=4,pipeline=2",
+        ])
