@@ -54,6 +54,9 @@ class ServingBenchConfig:
     # ``:generate`` / gRPC Predict instead of ``:classify``:
     prompt_len: int = 32
     new_tokens: int = 16
+    # f32 keeps the toy-model latency comparisons exact; bf16 is the
+    # real serving dtype and the only one a 7B fits a 16 GB chip in.
+    model_dtype: str = "float32"
 
 
 def _is_language(model: str) -> bool:
@@ -84,25 +87,54 @@ def _export(config: ServingBenchConfig) -> str:
             "bench", config.model, get_model(config.model),
             config.prompt_len, "generate",
             {"max_new_tokens": config.new_tokens, "temperature": 0.0},
-            {"dtype": "float32"})
-        module = get_model(config.model).make(dtype="float32")
+            {"dtype": config.model_dtype})
+        module = get_model(config.model).make(dtype=config.model_dtype)
         ids = np.zeros((1, config.prompt_len), np.int32)
-        variables = jax.jit(module.init)(jax.random.PRNGKey(0), ids)
-        variables = {"params": variables["params"]}
+
+        def init_params(rng):
+            # Cast to the serving dtype INSIDE the jit: flax param
+            # init is f32 (2× the bytes — a 7B would OOM the chip
+            # before the cast); fusing init+cast frees each f32 temp
+            # as it is consumed (same trick as inference/benchmark).
+            # Partitioned boxes stay on (the export/restore target
+            # structure keeps them); cast_floating maps through them.
+            import jax.numpy as jnp
+
+            from kubeflow_tpu.utils.trees import cast_floating
+
+            variables = module.init(rng, ids)
+            return cast_floating(variables["params"],
+                                 jnp.dtype(config.model_dtype))
+
+        variables = {"params": jax.jit(init_params)(
+            jax.random.PRNGKey(0))}
     else:
         hw = config.image_hw
         meta = ModelMetadata(
             model_name="bench", registry_name=config.model,
-            model_kwargs={"dtype": "float32"},
+            model_kwargs={"dtype": config.model_dtype},
             signatures={"serving_default": Signature(
                 method="classify",
                 inputs={"images": TensorSpec("float32", (-1, hw, hw, 3))},
                 outputs={"classes": TensorSpec("int32", (-1, 5)),
                          "scores": TensorSpec("float32", (-1, 5))})})
-        module = get_model(config.model).make(dtype="float32")
-        variables = jax.jit(module.init, static_argnames=("train",))(
-            jax.random.PRNGKey(0), np.zeros((1, hw, hw, 3), np.float32),
-            train=False)
+        module = get_model(config.model).make(dtype=config.model_dtype)
+
+        def init_vision(rng):
+            # Same in-jit weight cast as the language branch (BN
+            # running stats stay f32 — the standard mixed layout).
+            import jax.numpy as jnp
+
+            from kubeflow_tpu.utils.trees import cast_floating
+
+            variables = module.init(
+                rng, np.zeros((1, hw, hw, 3), np.float32), train=False)
+            variables = dict(variables)
+            variables["params"] = cast_floating(
+                variables["params"], jnp.dtype(config.model_dtype))
+            return variables
+
+        variables = jax.jit(init_vision)(jax.random.PRNGKey(0))
     base = pathlib.Path(tempfile.mkdtemp()) / "bench"
     export_model(str(base), 1, meta, variables)
     return str(base)
@@ -302,6 +334,7 @@ def _drive_measurements(config: ServingBenchConfig, model, transports,
 
     result: Dict[str, float] = {
         "model": config.model,
+        "model_dtype": config.model_dtype,
         "clients": config.clients,
         **sizes,
     }
@@ -363,17 +396,29 @@ def main(argv=None) -> int:
     parser.add_argument("--new_tokens", type=int, default=16,
                         help="language models: tokens generated per "
                              "request (baked at export)")
+    parser.add_argument("--model_dtype", default="float32",
+                        help="export/serve dtype ('bfloat16' for "
+                             "real-size LLMs; 'float32' default keeps "
+                             "toy comparisons exact)")
     parser.add_argument("--port", type=int, default=0,
                         help="0 = ephemeral")
     args = parser.parse_args(argv)
     sweep: Sequence[int] = tuple(
         int(s) for s in args.sweep.split(",") if s.strip())
+    try:
+        import numpy as _np
+
+        _np.dtype(args.model_dtype)
+    except TypeError:
+        parser.error(f"unknown --model_dtype {args.model_dtype!r} "
+                     "(use 'float32' or 'bfloat16')")
     result = run_serving_benchmark(ServingBenchConfig(
         model=args.model, image_hw=args.image_hw, clients=args.clients,
         requests_per_client=args.requests_per_client,
         max_batch=args.max_batch, port=args.port,
         transport=args.transport, sweep_clients=sweep,
-        prompt_len=args.prompt_len, new_tokens=args.new_tokens))
+        prompt_len=args.prompt_len, new_tokens=args.new_tokens,
+        model_dtype=args.model_dtype))
     print(json.dumps(result))
     return 0
 
