@@ -65,6 +65,8 @@ def replica_spec(
     chips_per_worker: int = 4,
     env: Optional[Sequence[Dict[str, Any]]] = None,
     resources: Optional[Dict[str, Any]] = None,
+    volumes: Optional[Sequence[Dict[str, Any]]] = None,
+    volume_mounts: Optional[Sequence[Dict[str, Any]]] = None,
 ) -> Dict[str, Any]:
     """One replicaSpec of a TPUJob (parity: ``tfJobReplica``,
     reference ``kubeflow/tf-job/tf-job.libsonnet:5-35``)."""
@@ -92,6 +94,8 @@ def replica_spec(
         container["env"] = list(env)
     if resources:
         container["resources"] = dict(resources)
+    if volume_mounts:
+        container["volumeMounts"] = list(volume_mounts)
     node_selector: Optional[Dict[str, str]] = None
     if replica_type == "TPU_WORKER":
         limits = container.setdefault("resources", {}).setdefault("limits", {})
@@ -109,6 +113,7 @@ def replica_spec(
             # so per-pod kubelet restarts would only desync the gang.
             restart_policy="Never",
             node_selector=node_selector,
+            volumes=volumes,
         )
     }
     return k8s._prune(
@@ -508,3 +513,142 @@ register(
     ],
     package="tpu-job",
 )(_finetune_builder)
+
+
+def _lm_pretrain_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """LM pretraining prototype: a TPUJob whose workers run the tpu-lm
+    trainer (training/pretrain.py) — mlm/causal objectives, any mesh
+    preset incl. pipeline parallelism. Greenfield (the reference's only
+    training prototype was the CNN benchmark); shape mirrors tpu-cnn."""
+    if p["num_tpu_workers"] < 1:
+        raise ValueError("num_tpu_workers must be >= 1")
+    total_chips = p["num_tpu_workers"] * p["chips_per_worker"]
+    # Validate the mesh against the slice geometry at GENERATE time: a
+    # mesh whose axis product mismatches the chip count fails in-pod
+    # minutes later. The arithmetic mirrors parallel/mesh.py MeshSpec
+    # .resolve (one -1 wildcard, product == chip count) but stays
+    # jax-free — the manifest compiler must import only pyyaml
+    # (pyproject: the engine lives behind the "engine" extra).
+    batch_axes_product = total_chips  # flat all-data default mesh
+    if p["mesh"]:
+        axes = ("dcn_data", "data", "fsdp", "pipeline", "seq",
+                "expert", "tensor")
+        sizes = {}
+        for part in p["mesh"].split(","):
+            axis, _, value = part.partition("=")
+            axis = axis.strip()
+            if axis not in axes or not value:
+                raise ValueError(
+                    f"bad mesh entry {part!r} (want <axis>=N with "
+                    f"axis in {axes})")
+            sizes[axis] = int(value)
+        wildcards = [a for a, v in sizes.items() if v == -1]
+        fixed = 1
+        for v in sizes.values():
+            if v != -1:
+                fixed *= v
+        if len(wildcards) > 1 or (not wildcards and fixed != total_chips) \
+                or (wildcards and total_chips % fixed):
+            raise ValueError(
+                f"mesh {p['mesh']!r} does not fit "
+                f"num_tpu_workers*chips_per_worker = {total_chips}")
+        if wildcards:
+            sizes[wildcards[0]] = total_chips // fixed
+        # Batch rows shard over the data-parallel axes only
+        # (parallel/mesh.py batch_sharding: dcn_data × data × fsdp).
+        batch_axes_product = (sizes.get("dcn_data", 1)
+                              * sizes.get("data", 1)
+                              * sizes.get("fsdp", 1))
+    if p["global_batch"] % batch_axes_product:
+        raise ValueError(
+            f"global_batch {p['global_batch']} must be divisible by "
+            f"the mesh's data axes (dcn_data*data*fsdp = "
+            f"{batch_axes_product})")
+    if p["mesh"] and "pipeline=" in p["mesh"]:
+        # The pipeline schedule additionally splits each step's batch
+        # into microbatches whose rows shard over the data axis.
+        if p["global_batch"] % (p["microbatches"] * max(
+                batch_axes_product, 1)):
+            raise ValueError(
+                f"global_batch {p['global_batch']} must be divisible "
+                f"by microbatches*data axes = "
+                f"{p['microbatches'] * batch_axes_product}")
+    args = [
+        "python", "-m", "kubeflow_tpu.training.pretrain",
+        f"--model={p['model']}",
+        f"--global_batch={p['global_batch']}",
+        f"--seq_len={p['seq_len']}",
+        f"--steps={p['steps']}",
+    ]
+    if p["objective"]:
+        args.append(f"--objective={p['objective']}")
+    if p["mesh"]:
+        args.append(f"--mesh={p['mesh']}")
+        if "pipeline=" in p["mesh"]:
+            args.append(f"--microbatches={p['microbatches']}")
+            if p["virtual_stages"] > 1:
+                args.append(f"--virtual_stages={p['virtual_stages']}")
+    if p["remat"]:
+        args.append("--remat")
+    volumes = volume_mounts = None
+    if p["checkpoint_dir"]:
+        args.append(f"--checkpoint_dir={p['checkpoint_dir']}")
+        if p["checkpoint_pvc"]:
+            # Without a durable mount, restart-slice recovery would
+            # resume from an empty ephemeral dir — i.e. from step 0.
+            volumes = [k8s.volume("ckpt", pvc_name=p["checkpoint_pvc"])]
+            volume_mounts = [k8s.volume_mount("ckpt",
+                                              p["checkpoint_dir"])]
+    spec = replica_spec(
+        "TPU_WORKER", p["num_tpu_workers"], image=p["image"],
+        command=args[:1], args=args[1:],
+        tpu_accelerator=p["tpu_accelerator"], tpu_topology=p["tpu_topology"],
+        chips_per_worker=p["chips_per_worker"],
+        volumes=volumes, volume_mounts=volume_mounts,
+    )
+    return [tpu_job(
+        p["name"], p["namespace"], [spec],
+        termination=termination_policy("TPU_WORKER", 0),
+    )]
+
+
+register(
+    "tpu-lm",
+    "LM pretraining (BERT mlm / Llama causal) as a TPUJob",
+    [
+        Param("name", REQUIRED, "string", "Name for the job."),
+        Param("namespace", "default", "string"),
+        Param("image", "ghcr.io/kubeflow-tpu/trainer:v0.1.0", "string"),
+        Param("model", "bert-base", "string", "Which language model."),
+        Param("objective", "", "string",
+              "mlm | causal (empty = the model family's default)."),
+        Param("global_batch", 256, "int", "Global batch size."),
+        Param("seq_len", 128, "int", "Sequence length."),
+        Param("steps", 1000, "int", "Training steps."),
+        Param("mesh", "", "string",
+              "Mesh spec, e.g. data=-1 or data=4,pipeline=2 "
+              "(validated against the slice geometry at generate "
+              "time)."),
+        Param("microbatches", 4, "int",
+              "Pipeline schedule microbatch count (pipeline meshes)."),
+        Param("virtual_stages", 1, "int",
+              ">1 = interleaved pipeline schedule (~v× smaller "
+              "bubble)."),
+        Param("checkpoint_dir", "", "string",
+              "Orbax checkpoint dir (enables slice-restart resume; "
+              "pair with checkpoint_pvc for a durable mount)."),
+        Param("checkpoint_pvc", "", "string",
+              "ReadWriteMany PVC (e.g. from the nfs prototype) "
+              "mounted at checkpoint_dir — without it checkpoints "
+              "land on ephemeral storage and a slice restart starts "
+              "from step 0."),
+        Param("remat", False, "bool",
+              "Rematerialize decoder blocks (trade FLOPs for "
+              "activation memory; llama only)."),
+        Param("num_tpu_workers", 1, "int"),
+        Param("tpu_accelerator", "tpu-v5-lite-podslice", "string"),
+        Param("tpu_topology", "2x4", "string"),
+        Param("chips_per_worker", 4, "int"),
+    ],
+    package="tpu-job",
+)(_lm_pretrain_builder)

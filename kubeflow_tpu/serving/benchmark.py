@@ -215,6 +215,11 @@ def run_serving_benchmark(config: ServingBenchConfig) -> Dict[str, float]:
     base = _export(config)
     manager = ModelManager(poll_interval_s=3600)
     model = manager.add_model("bench", base, max_batch=config.max_batch)
+    # Fail HERE if the synchronous first load didn't produce a
+    # version (load errors are logged-and-swallowed by the poll, and
+    # letting the drive start turns them into opaque per-request
+    # "no loaded version" failures minutes later).
+    model.get()
 
     handle = _ServerHandle()
     server_thread = threading.Thread(
@@ -397,6 +402,7 @@ def main(argv=None) -> int:
                         help="language models: tokens generated per "
                              "request (baked at export)")
     parser.add_argument("--model_dtype", default="float32",
+                        choices=("float32", "bfloat16", "float16"),
                         help="export/serve dtype ('bfloat16' for "
                              "real-size LLMs; 'float32' default keeps "
                              "toy comparisons exact)")
@@ -405,13 +411,6 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     sweep: Sequence[int] = tuple(
         int(s) for s in args.sweep.split(",") if s.strip())
-    try:
-        import numpy as _np
-
-        _np.dtype(args.model_dtype)
-    except TypeError:
-        parser.error(f"unknown --model_dtype {args.model_dtype!r} "
-                     "(use 'float32' or 'bfloat16')")
     result = run_serving_benchmark(ServingBenchConfig(
         model=args.model, image_hw=args.image_hw, clients=args.clients,
         requests_per_client=args.requests_per_client,
