@@ -266,6 +266,8 @@ def operator_rbac(namespace: str) -> List[Dict[str, Any]]:
                         ["get", "list", "watch", "create"]),
         k8s.policy_rule([""], ["pods", "services", "endpoints", "events",
                                "configmaps"], ["*"]),
+        # Whole-gang disruption budgets (reconciler._gang_pdb).
+        k8s.policy_rule(["policy"], ["poddisruptionbudgets"], ["*"]),
         k8s.policy_rule(["apps"], ["deployments"], ["get", "list", "watch"]),
     ]
     return [
@@ -541,7 +543,15 @@ def _lm_pretrain_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
                 raise ValueError(
                     f"bad mesh entry {part!r} (want <axis>=N with "
                     f"axis in {axes})")
-            sizes[axis] = int(value)
+            size = int(value)
+            if size < 1 and size != -1:
+                # 0 / negative sizes crash or silently resolve to
+                # garbage meshes; only the single -1 wildcard is
+                # meaningful.
+                raise ValueError(
+                    f"bad mesh entry {part!r} (axis size must be "
+                    f">= 1, or -1 as the wildcard)")
+            sizes[axis] = size
         wildcards = [a for a, v in sizes.items() if v == -1]
         fixed = 1
         for v in sizes.values():
@@ -567,12 +577,20 @@ def _lm_pretrain_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
     if p["mesh"] and "pipeline=" in p["mesh"]:
         # The pipeline schedule additionally splits each step's batch
         # into microbatches whose rows shard over the data axis.
-        if p["global_batch"] % (p["microbatches"] * max(
-                batch_axes_product, 1)):
+        if p["microbatches"] < 1:
+            raise ValueError("microbatches must be >= 1")
+        if p["global_batch"] % (p["microbatches"] * batch_axes_product):
             raise ValueError(
                 f"global_batch {p['global_batch']} must be divisible "
                 f"by microbatches*data axes = "
                 f"{p['microbatches'] * batch_axes_product}")
+    if p["objective"] not in ("", "mlm", "causal"):
+        # Mirrors pretrain's argparse choices — a typo'd objective
+        # would otherwise burn the whole restart budget on instant
+        # arg-parse crashes.
+        raise ValueError(
+            f"objective must be mlm or causal (or empty for the "
+            f"model default); got {p['objective']!r}")
     args = [
         "python", "-m", "kubeflow_tpu.training.pretrain",
         f"--model={p['model']}",

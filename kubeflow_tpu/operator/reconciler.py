@@ -134,6 +134,39 @@ class Reconciler:
         svc["spec"]["publishNotReadyAddresses"] = True
         return svc
 
+    def _gang_pdb(self, job: Dict[str, Any],
+                  gang_size: int) -> Dict[str, Any]:
+        """PodDisruptionBudget with ``minAvailable`` = the full gang:
+        an SPMD slice has no partial-degradation mode — ANY voluntary
+        eviction (node drain, autoscaler bin-packing) kills the
+        collective, burns a restart, and rolls the job back to its
+        checkpoint. The PDB makes the apiserver refuse such evictions
+        outright. (Involuntary failures still flow through the
+        restart-slice state machine.) Beyond reference parity: the
+        2018 operator let replicas die independently by design."""
+        name = job["metadata"]["name"]
+        ns = job["metadata"].get("namespace", "default")
+        return {
+            "apiVersion": "policy/v1",
+            "kind": "PodDisruptionBudget",
+            "metadata": {
+                "name": name,
+                "namespace": ns,
+                "labels": {JOB_LABEL: name},
+                "ownerReferences": [{
+                    "apiVersion": f"{GROUP}/{VERSION}",
+                    "kind": KIND,
+                    "name": name,
+                    "uid": job["metadata"].get("uid", ""),
+                    "controller": True,
+                }],
+            },
+            "spec": {
+                "minAvailable": gang_size,
+                "selector": {"matchLabels": {JOB_LABEL: name}},
+            },
+        }
+
     def _member_pod(self, job: Dict[str, Any], member: ReplicaMember,
                     members: List[ReplicaMember]) -> Dict[str, Any]:
         name = job["metadata"]["name"]
@@ -217,11 +250,23 @@ class Reconciler:
                                     reason="no replicaSpecs")
         chief = chief_member_index(job, members)
 
-        # Ensure the gang DNS service.
-        try:
-            self.api.get("Service", ns, name)
-        except NotFound:
-            self.api.create(self._gang_service(job))
+        # Ensure the gang DNS service + the whole-gang disruption
+        # budget (minAvailable = gang size: voluntary evictions are
+        # refused rather than burning a slice restart).
+        for kind, make in (("Service", lambda: self._gang_service(job)),
+                           ("PodDisruptionBudget",
+                            lambda: self._gang_pdb(job, len(members)))):
+            try:
+                self.api.get(kind, ns, name)
+            except NotFound:
+                try:
+                    self.api.create(make())
+                except Conflict:
+                    # Concurrent resync / second controller replica
+                    # won the create race — the object exists, which
+                    # is all this pass wanted (same rule as the pod
+                    # creates below).
+                    pass
 
         pods = {p["metadata"]["name"]: p
                 for p in self.api.list("Pod", ns, {JOB_LABEL: name})}

@@ -386,3 +386,21 @@ def test_kubectl_client_error_taxonomy(monkeypatch):
     stderrs["value"] = "Error from server (Forbidden): nope"
     with pytest.raises(RuntimeError):
         client._run("get", "pods", "x")
+
+
+def test_gang_pod_disruption_budget():
+    """The reconciler guards the gang with a PDB (minAvailable = the
+    whole gang): voluntary evictions have no partial-degradation mode
+    on an SPMD slice, so the apiserver should refuse them instead of
+    burning a slice restart."""
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=3, coordinator=True))
+    Reconciler(api).reconcile(job)
+    pdb = api.get("PodDisruptionBudget", "default", "job1")
+    assert pdb["spec"]["minAvailable"] == 4  # coordinator + 3 workers
+    assert pdb["spec"]["selector"]["matchLabels"] == {JOB_LABEL: "job1"}
+    owner = pdb["metadata"]["ownerReferences"][0]
+    assert owner["kind"] == "TPUJob" and owner["name"] == "job1"
+    # Idempotent across resyncs.
+    Reconciler(api).reconcile(api.get("TPUJob", "default", "job1"))
+    assert len(api.list("PodDisruptionBudget", "default")) == 1
