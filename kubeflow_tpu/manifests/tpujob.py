@@ -608,6 +608,10 @@ def _lm_pretrain_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
                 args.append(f"--virtual_stages={p['virtual_stages']}")
     if p["remat"]:
         args.append("--remat")
+    if p["data"]:
+        args.append(f"--data={p['data']}")
+        if p["bin_dtype"] != "uint16":
+            args.append(f"--bin_dtype={p['bin_dtype']}")
     volumes = volume_mounts = None
     if p["checkpoint_dir"]:
         args.append(f"--checkpoint_dir={p['checkpoint_dir']}")
@@ -652,6 +656,13 @@ register(
         Param("virtual_stages", 1, "int",
               ">1 = interleaved pipeline schedule (~v× smaller "
               "bubble)."),
+        Param("data", "", "string",
+              "Token shards (.npy / raw .bin): files, dirs, or globs "
+              "mounted in the pod, or gs://-style remote paths; "
+              "empty = synthetic data. mlm gets dynamic masking."),
+        Param("bin_dtype", "uint16", "string",
+              "dtype of raw .bin token dumps (headerless — a wrong "
+              "value reads garbage tokens; .npy self-describes)."),
         Param("checkpoint_dir", "", "string",
               "Orbax checkpoint dir (enables slice-restart resume; "
               "pair with checkpoint_pvc for a durable mount)."),

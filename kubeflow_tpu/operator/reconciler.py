@@ -257,7 +257,17 @@ class Reconciler:
                            ("PodDisruptionBudget",
                             lambda: self._gang_pdb(job, len(members)))):
             try:
-                self.api.get(kind, ns, name)
+                existing = self.api.get(kind, ns, name)
+                if (kind == "PodDisruptionBudget"
+                        and existing["spec"].get("minAvailable")
+                        != len(members)):
+                    # replicaSpecs were rescaled: a stale budget would
+                    # let the apiserver evict the difference — the
+                    # exact slice-restart burn the PDB prevents.
+                    self.api.patch(
+                        kind, ns, name,
+                        lambda o: o["spec"].update(
+                            {"minAvailable": len(members)}))
             except NotFound:
                 try:
                     self.api.create(make())

@@ -76,6 +76,22 @@ def synthetic_images(
         step += 1
 
 
+def _apply_mlm_mask(ids: np.ndarray, rng: np.random.RandomState,
+                    mask_rate: float, mask_token: int) -> Batch:
+    """THE mlm batch construction (one implementation for synthetic
+    and real-shard streams): mask positions at ``mask_rate``, inputs
+    carry ``mask_token`` there, labels = original tokens, weights =
+    the mask."""
+    mask = rng.random_sample(ids.shape) < mask_rate
+    return {
+        "input_ids": np.where(mask, mask_token, ids).astype(np.int32),
+        "type_ids": np.zeros_like(ids, dtype=np.int32),
+        "valid": np.ones_like(ids, dtype=np.int32),
+        "mlm_labels": ids.astype(np.int32),
+        "mlm_weights": mask.astype(np.int32),
+    }
+
+
 def synthetic_mlm(
     global_batch: int,
     seq_len: int = 128,
@@ -84,21 +100,18 @@ def synthetic_mlm(
     mask_token: int = 103,
     seed: int = 0,
 ) -> Iterator[Batch]:
-    """Synthetic BERT pretraining batches with dynamic masking."""
+    """Synthetic BERT pretraining batches with dynamic masking.
+
+    The mask is drawn over the GLOBAL batch before per-host row
+    sharding, so the stream is host-count-invariant (the 2-process
+    gang equality test depends on this)."""
     rows = host_shard_range(global_batch)
     step = 0
     while True:
         rng = np.random.RandomState((seed * 2_000_003 + step) % (2 ** 31))
         ids = rng.randint(5, vocab_size, (global_batch, seq_len))
-        mask = rng.random_sample((global_batch, seq_len)) < mask_rate
-        masked = np.where(mask, mask_token, ids)
-        yield {
-            "input_ids": masked[rows.start:rows.stop].astype(np.int32),
-            "type_ids": np.zeros((len(rows), seq_len), np.int32),
-            "valid": np.ones((len(rows), seq_len), np.int32),
-            "mlm_labels": ids[rows.start:rows.stop].astype(np.int32),
-            "mlm_weights": mask[rows.start:rows.stop].astype(np.int32),
-        }
+        batch = _apply_mlm_mask(ids, rng, mask_rate, mask_token)
+        yield {k: v[rows.start:rows.stop] for k, v in batch.items()}
         step += 1
 
 
@@ -116,6 +129,28 @@ def synthetic_causal_lm(
         ids = rng.randint(0, vocab_size, (global_batch, seq_len))
         yield {"input_ids": ids[rows.start:rows.stop].astype(np.int32)}
         step += 1
+
+
+def mlm_mask_batches(
+    source: Iterator[Batch],
+    *,
+    mask_rate: float = 0.15,
+    mask_token: int = 103,
+    seed: int = 0,
+) -> Iterator[Batch]:
+    """Dynamic BERT masking over a causal token stream.
+
+    Wraps any ``{"input_ids"}`` iterator (``token_shard_batches`` for
+    real shards) into mlm batches: inputs masked at ``mask_rate``,
+    labels = the original tokens, weights = the mask. The mask is
+    re-drawn every batch (dynamic masking — each epoch sees different
+    masks of the same text), seeded for reproducibility. Masking
+    happens after per-host sharding, on each host's own rows.
+    """
+    for step, batch in enumerate(source):
+        ids = np.asarray(batch["input_ids"])
+        rng = np.random.RandomState((seed * 5_000_011 + step) % (2 ** 31))
+        yield _apply_mlm_mask(ids, rng, mask_rate, mask_token)
 
 
 def resolve_shards(spec, cache_root: Optional[str] = None) -> list:

@@ -48,6 +48,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup_steps", type=int, default=10)
     p.add_argument("--mesh", default=None,
                    help="e.g. data=-1,tensor=4 (default: all-data)")
+    p.add_argument("--data", default=None,
+                   help="token shards (.npy / raw .bin): comma-"
+                        "separated files, dirs, or globs; gs://-style "
+                        "fsspec paths download into a local cache. "
+                        "Default: reference-parity synthetic data. "
+                        "mlm objectives get dynamic masking over the "
+                        "shards.")
+    p.add_argument("--bin_dtype", default="uint16",
+                   help="dtype of raw .bin token dumps (headerless; "
+                        ".npy shards self-describe)")
     p.add_argument("--checkpoint_dir", default=None)
     p.add_argument("--save_every", type=int, default=200)
     p.add_argument("--metrics_path", default=None)
@@ -78,8 +88,11 @@ def main(argv=None) -> int:
     from kubeflow_tpu.training.checkpoint import CheckpointConfig
     from kubeflow_tpu.training.data import (
         DevicePrefetcher,
+        mlm_mask_batches,
+        resolve_shards,
         synthetic_causal_lm,
         synthetic_mlm,
+        token_shard_batches,
     )
     from kubeflow_tpu.training.lm import create_lm_state, make_lm_train_step
     from kubeflow_tpu.training.loop import LoopConfig, fit
@@ -94,7 +107,31 @@ def main(argv=None) -> int:
     vocab = entry.num_classes_or_vocab
 
     mesh = build_mesh(parse_mesh(args.mesh))
-    if objective == "mlm":
+    if args.data:
+        # Real token shards (local or gs://-style — SURVEY §2.4's
+        # storage row on the pretraining path, not just fine-tuning).
+        paths = resolve_shards(args.data)
+        gen = token_shard_batches(
+            paths, args.global_batch, args.seq_len, seed=args.seed,
+            bin_dtype=args.bin_dtype)
+
+        def check_vocab(source, bound=vocab):
+            # Out-of-range ids silently CLAMP in the embedding gather
+            # (XLA semantics) — a wrong-vocab tokenizer dump or a
+            # misdeclared bin_dtype would train to convergence on
+            # garbage. Fail loudly instead.
+            for batch in source:
+                top = int(batch["input_ids"].max())
+                if top >= bound:
+                    raise ValueError(
+                        f"shard token id {top} >= model vocab {bound} "
+                        f"— wrong tokenizer or wrong --bin_dtype?")
+                yield batch
+
+        gen = check_vocab(gen)
+        if objective == "mlm":
+            gen = mlm_mask_batches(gen, seed=args.seed)
+    elif objective == "mlm":
         gen = synthetic_mlm(args.global_batch, args.seq_len, vocab,
                             seed=args.seed)
     else:

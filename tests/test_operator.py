@@ -404,3 +404,20 @@ def test_gang_pod_disruption_budget():
     # Idempotent across resyncs.
     Reconciler(api).reconcile(api.get("TPUJob", "default", "job1"))
     assert len(api.list("PodDisruptionBudget", "default")) == 1
+
+
+def test_gang_pdb_tracks_rescaled_gang():
+    """A rescaled gang must re-size its disruption budget — a stale
+    minAvailable would permit evicting the difference."""
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=2))
+    r = Reconciler(api)
+    r.reconcile(job)
+    assert api.get("PodDisruptionBudget", "default",
+                   "job1")["spec"]["minAvailable"] == 2
+    api.patch("TPUJob", "default", "job1",
+              lambda o: o["spec"]["replicaSpecs"][0].update(
+                  {"replicas": 4}))
+    r.reconcile(api.get("TPUJob", "default", "job1"))
+    assert api.get("PodDisruptionBudget", "default",
+                   "job1")["spec"]["minAvailable"] == 4

@@ -67,3 +67,48 @@ def test_pretrain_pipeline_rejects_mlm():
             "--model", "bert-test", "--steps", "1",
             "--mesh", "data=4,pipeline=2",
         ])
+
+
+def test_pretrain_on_real_token_shards(tmp_path, capsys):
+    """--data: both objectives train from the same token shards —
+    causal directly, mlm through dynamic masking (the SURVEY §2.4
+    storage row on the pretraining path)."""
+    import numpy as np
+
+    toks = np.random.RandomState(0).randint(
+        0, 500, 40_000).astype(np.int32)
+    np.save(tmp_path / "shard0.npy", toks[:20_000])
+    np.save(tmp_path / "shard1.npy", toks[20_000:])
+    for model, objective in (("llama-test", "causal"),
+                             ("bert-test", "mlm")):
+        rc = main([
+            "--model", model, "--objective", objective,
+            "--global_batch", "8", "--seq_len", "32", "--steps", "2",
+            "--log_every", "1", "--mesh", "data=8",
+            "--data", str(tmp_path / "*.npy"),
+        ])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["objective"] == objective
+        assert out["final_step"] == 2
+
+
+def test_mlm_mask_batches_dynamic_masking():
+    import numpy as np
+
+    from kubeflow_tpu.training.data import mlm_mask_batches
+
+    ids = np.arange(200, dtype=np.int32).reshape(2, 100) + 200
+    stream = mlm_mask_batches(iter([{"input_ids": ids}] * 2), seed=1)
+    a, b = list(stream)
+    for batch in (a, b):
+        mask = batch["mlm_weights"].astype(bool)
+        # Labels carry the ORIGINAL tokens everywhere; inputs carry
+        # the mask token exactly on the masked positions.
+        np.testing.assert_array_equal(batch["mlm_labels"], ids)
+        assert (batch["input_ids"][mask] == 103).all()
+        np.testing.assert_array_equal(batch["input_ids"][~mask],
+                                      ids[~mask])
+        assert 0 < mask.sum() < ids.size
+    # Dynamic: the two epochs mask different positions.
+    assert (a["mlm_weights"] != b["mlm_weights"]).any()
