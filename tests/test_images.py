@@ -19,6 +19,7 @@ OVERRIDES = {
     "tpu-job": {"name": "j"},
     "tpu-cnn": {"name": "c"},
     "tpu-finetune": {"name": "f"},
+    "tpu-lm": {"name": "lm"},
     "tpu-serving": {"name": "s", "model_path": "gs://b/m"},
     "cert-manager": {"acme_email": "a@b.com"},
     "iap-envoy": {"audiences": "aud"},
