@@ -19,6 +19,10 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Tornado AsyncHTTPTestCase default is 5 s — observed flaking when the
+# suite shares the box with a chip benchmark; the tests assert
+# behavior, not latency.
+os.environ.setdefault("ASYNC_TEST_TIMEOUT", "30")
 
 import jax  # noqa: E402
 
