@@ -574,6 +574,13 @@ def _lm_pretrain_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
             f"global_batch {p['global_batch']} must be divisible by "
             f"the mesh's data axes (dcn_data*data*fsdp = "
             f"{batch_axes_product})")
+    if p["global_batch"] % p["num_tpu_workers"]:
+        # Each host feeds its own 1/num_hosts rows (host_shard_range);
+        # a tensor- or pipeline-only mesh passes the data-axes check
+        # with product 1 yet still fails in-pod on this split.
+        raise ValueError(
+            f"global_batch {p['global_batch']} must be divisible by "
+            f"num_tpu_workers = {p['num_tpu_workers']}")
     if p["mesh"] and "pipeline=" in p["mesh"]:
         # The pipeline schedule additionally splits each step's batch
         # into microbatches whose rows shard over the data axis.

@@ -264,10 +264,17 @@ class Reconciler:
                     # replicaSpecs were rescaled: a stale budget would
                     # let the apiserver evict the difference — the
                     # exact slice-restart burn the PDB prevents.
-                    self.api.patch(
-                        kind, ns, name,
-                        lambda o: o["spec"].update(
-                            {"minAvailable": len(members)}))
+                    try:
+                        self.api.patch(
+                            kind, ns, name,
+                            lambda o: o["spec"].update(
+                                {"minAvailable": len(members)}))
+                    except Conflict:
+                        # The real client's patch is read-modify-
+                        # replace; a concurrent controller replica can
+                        # race it into a resourceVersion conflict.
+                        # Next resync re-observes and re-sizes.
+                        pass
             except NotFound:
                 try:
                     self.api.create(make())

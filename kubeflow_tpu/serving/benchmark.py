@@ -65,6 +65,19 @@ def _is_language(model: str) -> bool:
     return get_model(model).family == "language"
 
 
+def _is_causal_decoder(model: str) -> bool:
+    """Whether the model has a decode path the generate export can
+    drive. BERT encoders are family == "language" too, but have no
+    cache/generate machinery — exporting them with a generate
+    signature only fails later at model load with an opaque
+    ``cache_size`` constructor error."""
+    from kubeflow_tpu.models.llama import Llama
+    from kubeflow_tpu.models.registry import get_model
+
+    entry = get_model(model)
+    return entry.family == "language" and isinstance(entry.make(), Llama)
+
+
 def _export(config: ServingBenchConfig) -> str:
     import jax
 
@@ -209,6 +222,13 @@ def run_serving_benchmark(config: ServingBenchConfig) -> Dict[str, float]:
 
     if config.transport not in ("http", "grpc", "both"):
         raise ValueError(f"unknown transport {config.transport!r}")
+    if _is_language(config.model) and not _is_causal_decoder(config.model):
+        raise ValueError(
+            f"model {config.model!r} is an encoder-only language model "
+            f"with no generate path; the serving benchmark drives "
+            f"language models through :generate (use a causal decoder "
+            f"like llama-test, or benchmark encoders via classify "
+            f"models)")
     # http-only runs stay grpcio-free (the pre-r4 behavior): the gRPC
     # listener only starts when that wire is actually under test.
     want_grpc = config.transport in ("grpc", "both")
@@ -409,6 +429,16 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, default=0,
                         help="0 = ephemeral")
     args = parser.parse_args(argv)
+    if _is_language(args.model) and not _is_causal_decoder(args.model):
+        # Same check run_serving_benchmark enforces, surfaced as an
+        # argparse error so the CLI fails in milliseconds, not at
+        # model load.
+        parser.error(
+            f"--model {args.model} is an encoder-only language model "
+            f"with no generate path; the serving benchmark drives "
+            f"language models through :generate (use a causal decoder "
+            f"like llama-test, or benchmark encoders via classify "
+            f"models)")
     sweep: Sequence[int] = tuple(
         int(s) for s in args.sweep.split(",") if s.strip())
     result = run_serving_benchmark(ServingBenchConfig(

@@ -145,11 +145,16 @@ def mlm_mask_batches(
     labels = the original tokens, weights = the mask. The mask is
     re-drawn every batch (dynamic masking — each epoch sees different
     masks of the same text), seeded for reproducibility. Masking
-    happens after per-host sharding, on each host's own rows.
+    happens after per-host sharding, on each host's own rows, so the
+    per-step seed folds in ``jax.process_index()`` — without it every
+    host would draw the IDENTICAL mask pattern over its own rows and
+    masked positions would be correlated across the gang.
     """
+    pi = jax.process_index()
     for step, batch in enumerate(source):
         ids = np.asarray(batch["input_ids"])
-        rng = np.random.RandomState((seed * 5_000_011 + step) % (2 ** 31))
+        rng = np.random.RandomState(
+            (seed * 5_000_011 + step * 1_000_003 + pi) % (2 ** 31))
         yield _apply_mlm_mask(ids, rng, mask_rate, mask_token)
 
 

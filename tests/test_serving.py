@@ -766,6 +766,17 @@ def test_decode_b64_idempotent():
     assert decode_b64_if_needed(decoded) == decoded
 
 
+def test_serving_benchmark_rejects_encoder_generate():
+    """An encoder-only language model (bert) has no decode path; the
+    CLI must reject it up front with an argparse error instead of
+    failing minutes later at model load (ADVICE r4)."""
+    from kubeflow_tpu.serving.benchmark import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["--model", "bert-test"])
+    assert exc.value.code == 2  # argparse error exit
+
+
 @pytest.mark.slow
 def test_serving_benchmark_lm_generate_branch():
     """The serving benchmark's language branch: a generate-signature
