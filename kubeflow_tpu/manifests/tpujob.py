@@ -140,13 +140,25 @@ def tpu_job(
     *,
     termination: Optional[Dict[str, Any]] = None,
     recovery: str = "restart-slice",
+    num_slices: int = 1,
 ) -> Dict[str, Any]:
     """A TPUJob CR (parity: ``tfJob``, reference
     ``tf-job.libsonnet:44-56``). ``recovery`` is new: TPU slices fail
     as a unit, so the operator restarts the whole gang from the last
-    checkpoint ('restart-slice') or fails the job ('none')."""
+    checkpoint ('restart-slice') or fails the job ('none').
+
+    ``num_slices`` > 1 makes this a multi-slice (megascale) job: the
+    operator provisions the replicaSpecs once PER SLICE — one gang per
+    slice, all-or-nothing across the union — and injects
+    ``MEGASCALE_COORDINATOR_ADDRESS`` / ``MEGASCALE_NUM_SLICES`` /
+    ``MEGASCALE_SLICE_ID`` so the trainer's hybrid ``dcn_data`` mesh
+    axis comes from the deployment. The TPU translation of the
+    reference operator's cluster-spec assembly
+    (``kubeflow/core/tf-job.libsonnet:31-95``, consumed as TF_CONFIG)."""
     if recovery not in ("restart-slice", "none"):
         raise ValueError(f"unknown recovery policy {recovery!r}")
+    if num_slices < 1:
+        raise ValueError(f"num_slices must be >= 1, got {num_slices}")
     return {
         "apiVersion": f"{GROUP}/{VERSION}",
         "kind": KIND,
@@ -156,6 +168,10 @@ def tpu_job(
                 "replicaSpecs": list(replica_specs),
                 "terminationPolicy": termination or termination_policy(),
                 "recoveryPolicy": recovery,
+                # Single-slice jobs stay schema-identical to pre-r5
+                # manifests (goldens, kubectl diffs): the field only
+                # materializes when it means something.
+                "numSlices": num_slices if num_slices > 1 else None,
             }
         ),
     }
@@ -190,6 +206,7 @@ def crd() -> Dict[str, Any]:
                         "type": "string",
                         "enum": ["restart-slice", "none"],
                     },
+                    "numSlices": {"type": "integer", "minimum": 1},
                 },
             },
             "status": {
@@ -375,7 +392,8 @@ def _generic_job_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
     # tf-job.jsonnet:41-44 MASTER-else-WORKER chief selection).
     chief = "COORDINATOR" if p["num_coordinators"] > 0 else "TPU_WORKER"
     return [tpu_job(p["name"], p["namespace"], specs,
-                    termination=termination_policy(chief))]
+                    termination=termination_policy(chief),
+                    num_slices=p["num_slices"])]
 
 
 register(
@@ -393,6 +411,10 @@ register(
         Param("tpu_accelerator", "tpu-v5-lite-podslice", "string"),
         Param("tpu_topology", "2x4", "string"),
         Param("chips_per_worker", 4, "int"),
+        Param("num_slices", 1, "int",
+              ">1 = multi-slice (megascale) job: the replicaSpecs are "
+              "provisioned once per slice and MEGASCALE_* env is "
+              "injected."),
     ],
     package="tpu-job",
 )(_generic_job_builder)
@@ -423,6 +445,7 @@ def _cnn_benchmark_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
     return [tpu_job(
         p["name"], p["namespace"], [spec],
         termination=termination_policy("TPU_WORKER", 0),
+        num_slices=p["num_slices"],
     )]
 
 
@@ -439,6 +462,10 @@ register(
         Param("tpu_accelerator", "tpu-v5-lite-podslice", "string"),
         Param("tpu_topology", "2x4", "string"),
         Param("chips_per_worker", 4, "int"),
+        Param("num_slices", 1, "int",
+              ">1 = multi-slice (megascale) job: workers are "
+              "provisioned once per slice; the trainer's dcn_data "
+              "mesh axis follows from the injected MEGASCALE env."),
         Param("profile_dir", "", "string",
               "Capture the timed steps as an XPlane trace under this "
               "dir (mount a shared volume; the dashboard lists it)."),
@@ -524,13 +551,21 @@ def _lm_pretrain_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
     training prototype was the CNN benchmark); shape mirrors tpu-cnn."""
     if p["num_tpu_workers"] < 1:
         raise ValueError("num_tpu_workers must be >= 1")
-    total_chips = p["num_tpu_workers"] * p["chips_per_worker"]
+    num_slices = p["num_slices"]
+    if num_slices < 1:
+        raise ValueError("num_slices must be >= 1")
+    # Multi-slice: the replicaSpecs are per-slice, so the job's chip
+    # and host counts scale by num_slices.
+    total_chips = num_slices * p["num_tpu_workers"] * p["chips_per_worker"]
+    total_hosts = num_slices * p["num_tpu_workers"]
     # Validate the mesh against the slice geometry at GENERATE time: a
     # mesh whose axis product mismatches the chip count fails in-pod
     # minutes later. The arithmetic mirrors parallel/mesh.py MeshSpec
-    # .resolve (one -1 wildcard, product == chip count) but stays
-    # jax-free — the manifest compiler must import only pyyaml
-    # (pyproject: the engine lives behind the "engine" extra).
+    # .resolve (one -1 wildcard, product == chip count) AND build_mesh's
+    # megascale-env rule (dcn_data defaults to the slice count, a
+    # conflicting explicit value is an error) but stays jax-free — the
+    # manifest compiler must import only pyyaml (pyproject: the engine
+    # lives behind the "engine" extra).
     batch_axes_product = total_chips  # flat all-data default mesh
     if p["mesh"]:
         axes = ("dcn_data", "data", "fsdp", "pipeline", "seq",
@@ -552,6 +587,18 @@ def _lm_pretrain_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
                     f"bad mesh entry {part!r} (axis size must be "
                     f">= 1, or -1 as the wildcard)")
             sizes[axis] = size
+        if num_slices > 1:
+            # Mirror build_mesh: the injected MEGASCALE_NUM_SLICES
+            # sets dcn_data when the spec leaves it unset (or
+            # wildcarded); an explicit conflicting value fails in-pod,
+            # so fail here first.
+            if sizes.get("dcn_data", 1) in (1, -1):
+                sizes["dcn_data"] = num_slices
+            elif sizes["dcn_data"] != num_slices:
+                raise ValueError(
+                    f"mesh {p['mesh']!r} sets dcn_data="
+                    f"{sizes['dcn_data']} but the job provisions "
+                    f"num_slices = {num_slices}")
         wildcards = [a for a, v in sizes.items() if v == -1]
         fixed = 1
         for v in sizes.values():
@@ -561,7 +608,8 @@ def _lm_pretrain_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
                 or (wildcards and total_chips % fixed):
             raise ValueError(
                 f"mesh {p['mesh']!r} does not fit "
-                f"num_tpu_workers*chips_per_worker = {total_chips}")
+                f"num_slices*num_tpu_workers*chips_per_worker = "
+                f"{total_chips}")
         if wildcards:
             sizes[wildcards[0]] = total_chips // fixed
         # Batch rows shard over the data-parallel axes only
@@ -574,13 +622,14 @@ def _lm_pretrain_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
             f"global_batch {p['global_batch']} must be divisible by "
             f"the mesh's data axes (dcn_data*data*fsdp = "
             f"{batch_axes_product})")
-    if p["global_batch"] % p["num_tpu_workers"]:
+    if p["global_batch"] % total_hosts:
         # Each host feeds its own 1/num_hosts rows (host_shard_range);
         # a tensor- or pipeline-only mesh passes the data-axes check
         # with product 1 yet still fails in-pod on this split.
         raise ValueError(
             f"global_batch {p['global_batch']} must be divisible by "
-            f"num_tpu_workers = {p['num_tpu_workers']}")
+            f"the host count (num_slices*num_tpu_workers = "
+            f"{total_hosts})")
     if p["mesh"] and "pipeline=" in p["mesh"]:
         # The pipeline schedule additionally splits each step's batch
         # into microbatches whose rows shard over the data axis.
@@ -638,6 +687,7 @@ def _lm_pretrain_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
     return [tpu_job(
         p["name"], p["namespace"], [spec],
         termination=termination_policy("TPU_WORKER", 0),
+        num_slices=num_slices,
     )]
 
 
@@ -681,10 +731,16 @@ register(
         Param("remat", False, "bool",
               "Rematerialize decoder blocks (trade FLOPs for "
               "activation memory; llama only)."),
-        Param("num_tpu_workers", 1, "int"),
+        Param("num_tpu_workers", 1, "int",
+              "TPU hosts PER SLICE (multiply by num_slices for the "
+              "job's host count)."),
         Param("tpu_accelerator", "tpu-v5-lite-podslice", "string"),
         Param("tpu_topology", "2x4", "string"),
         Param("chips_per_worker", 4, "int"),
+        Param("num_slices", 1, "int",
+              ">1 = multi-slice (megascale) job: one gang per slice, "
+              "all-or-nothing recovery across the union; the mesh's "
+              "dcn_data axis defaults to this count in-pod."),
     ],
     package="tpu-job",
 )(_lm_pretrain_builder)

@@ -257,8 +257,13 @@ def llama_moe_test(**kw) -> Llama:
                  mlp_dim=128, **kw)
 
 
-register_model(ModelEntry("llama2-7b", "language", llama2_7b, ((2048,), "int32"), 32000))
-register_model(ModelEntry("llama2-13b", "language", llama2_13b, ((2048,), "int32"), 32000))
-register_model(ModelEntry("llama3-8b", "language", llama3_8b, ((2048,), "int32"), 128256))
-register_model(ModelEntry("llama-test", "language", llama_test, ((128,), "int32"), 512))
-register_model(ModelEntry("llama-moe-test", "language", llama_moe_test, ((128,), "int32"), 512))
+register_model(ModelEntry("llama2-7b", "language", llama2_7b, ((2048,), "int32"), 32000,
+                          decoder=True))
+register_model(ModelEntry("llama2-13b", "language", llama2_13b, ((2048,), "int32"), 32000,
+                          decoder=True))
+register_model(ModelEntry("llama3-8b", "language", llama3_8b, ((2048,), "int32"), 128256,
+                          decoder=True))
+register_model(ModelEntry("llama-test", "language", llama_test, ((128,), "int32"), 512,
+                          decoder=True))
+register_model(ModelEntry("llama-moe-test", "language", llama_moe_test, ((128,), "int32"), 512,
+                          decoder=True))

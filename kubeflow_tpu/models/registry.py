@@ -24,6 +24,10 @@ class ModelEntry:
     # 0.1); recorded here, next to the model, so new registrations
     # carry the fact with them.
     bench_lr: Optional[float] = None
+    # Causal decoder with a generate/decode path (KV cache, greedy
+    # decode export). family == "language" alone doesn't imply it:
+    # BERT encoders are language models with no decode machinery.
+    decoder: bool = False
 
 
 _MODELS: Dict[str, ModelEntry] = {}

@@ -98,6 +98,22 @@ class FakeApiServer:
                    lambda o: o.setdefault("status", {}).update(
                        {"phase": phase}))
 
+    def set_pod_terminated(self, namespace: str, name: str,
+                           exit_code: int) -> None:
+        """Pod finished with ``exit_code``, the way a kubelet reports
+        it: phase from the code (0 → Succeeded, else Failed) plus the
+        containerStatuses.terminated record the drain detection reads
+        (reconciler.pod_drained)."""
+        self.patch(
+            "Pod", namespace, name,
+            lambda o: o.setdefault("status", {}).update({
+                "phase": "Succeeded" if exit_code == 0 else "Failed",
+                "containerStatuses": [{
+                    "name": "kubeflow-tpu",
+                    "state": {"terminated": {"exitCode": exit_code}},
+                }],
+            }))
+
     def set_all_pod_phases(self, namespace: str, phase: str,
                            label_selector: Optional[Dict[str, str]] = None
                            ) -> None:

@@ -12,6 +12,17 @@ Replaces tf-operator's per-replica reconcile (reference config at
 SURVEY §3.2): per-replica Services + independent pod restarts +
 TF_CONFIG injection become one gang service + whole-slice lifecycle +
 jax.distributed env.
+
+Multi-slice (megascale) jobs: ``spec.numSlices`` > 1 provisions the
+replicaSpecs once per slice — slice-major pod ordering, one shared
+headless service and PDB over the union, and per-worker
+``MEGASCALE_COORDINATOR_ADDRESS`` / ``MEGASCALE_NUM_SLICES`` /
+``MEGASCALE_SLICE_ID`` injection on top of the flat ``KFT_*`` gang
+env. Recovery stays all-or-nothing across the UNION: one failed pod on
+any slice restarts every slice (an SPMD program spanning slices has no
+partial-degradation mode). The TPU translation of the reference
+operator's cluster-spec assembly
+(``kubeflow/core/tf-job.libsonnet:31-95``).
 """
 
 from __future__ import annotations
@@ -26,6 +37,7 @@ from kubeflow_tpu.manifests.tpujob import GROUP, KIND, VERSION
 from kubeflow_tpu.operator.fake import Conflict, NotFound
 from kubeflow_tpu.operator.gang import Decision, PodPhase, decide
 from kubeflow_tpu.training.launcher import (
+    DRAIN_EXIT_CODE,
     ENV_COORD,
     ENV_NPROC,
     ENV_PID,
@@ -36,6 +48,9 @@ from kubeflow_tpu.training.launcher import (
 logger = logging.getLogger(__name__)
 
 COORDINATOR_PORT = 8476
+# The megascale (cross-slice DCN) coordinator rides a separate port on
+# slice 0's first worker, next to the jax.distributed one.
+MEGASCALE_PORT = 8477
 DEFAULT_MAX_RESTARTS = 3
 # Consecutive reconcile passes to re-observe a non-chief Succeeded
 # before calling it a slice fault (pod-status propagation skew on a
@@ -45,6 +60,23 @@ DEFAULT_COMPLETION_GRACE_PASSES = 3
 JOB_LABEL = "kubeflow.org/tpujob"
 REPLICA_TYPE_LABEL = "kubeflow.org/replica-type"
 REPLICA_INDEX_LABEL = "kubeflow.org/replica-index"
+SLICE_INDEX_LABEL = "kubeflow.org/slice-index"
+
+
+def pod_drained(pod: Optional[Dict[str, Any]]) -> bool:
+    """Whether a Failed pod actually DRAINED: its container exited
+    with DRAIN_EXIT_CODE (training/loop.py's SIGTERM path — finish the
+    step, checkpoint, exit). Kubernetes phases any nonzero exit as
+    Failed; the exit code is the only signal distinguishing 'the
+    platform preempted us mid-checkpointed-run' from 'the program
+    crashed'."""
+    if not pod:
+        return False
+    for cs in pod.get("status", {}).get("containerStatuses", []):
+        term = (cs.get("state") or {}).get("terminated")
+        if term and term.get("exitCode") == DRAIN_EXIT_CODE:
+            return True
+    return False
 
 
 def _update_conditions(status: Dict[str, Any], phase: str,
@@ -77,22 +109,44 @@ def _update_conditions(status: Dict[str, Any], phase: str,
 
 @dataclasses.dataclass
 class ReplicaMember:
-    """One expected pod of the gang."""
+    """One expected pod of the job — of ONE gang, on one slice.
+
+    Multi-slice (``spec.numSlices`` > 1) jobs provision the
+    replicaSpecs once per slice; ``slice_id`` identifies which copy,
+    and ``num_slices`` travels along so pod naming and megascale env
+    need no extra context."""
 
     replica_type: str
     index: int
     spec: Dict[str, Any]
+    slice_id: int = 0
+    num_slices: int = 1
 
     def pod_name(self, job_name: str) -> str:
-        return f"{job_name}-{self.replica_type.lower().replace('_', '-')}-{self.index}"
+        kind = self.replica_type.lower().replace("_", "-")
+        if self.num_slices > 1:
+            return f"{job_name}-s{self.slice_id}-{kind}-{self.index}"
+        # Single-slice pods keep the pre-r5 names (dashboards, docs,
+        # kubectl muscle memory).
+        return f"{job_name}-{kind}-{self.index}"
+
+
+def job_num_slices(job: Dict[str, Any]) -> int:
+    return int(job["spec"].get("numSlices", 1) or 1)
 
 
 def expected_members(job: Dict[str, Any]) -> List[ReplicaMember]:
+    """Every expected pod, slice-major (slice 0's replicas first) —
+    the order that makes the global TPU_WORKER process ids put the
+    ``dcn_data`` mesh axis exactly on slice boundaries."""
+    num_slices = job_num_slices(job)
     members: List[ReplicaMember] = []
-    for spec in job["spec"].get("replicaSpecs", []):
-        for index in range(int(spec.get("replicas", 1))):
-            members.append(ReplicaMember(
-                replica_type=spec["tpuReplicaType"], index=index, spec=spec))
+    for slice_id in range(num_slices):
+        for spec in job["spec"].get("replicaSpecs", []):
+            for index in range(int(spec.get("replicas", 1))):
+                members.append(ReplicaMember(
+                    replica_type=spec["tpuReplicaType"], index=index,
+                    spec=spec, slice_id=slice_id, num_slices=num_slices))
     return members
 
 
@@ -102,7 +156,10 @@ def chief_member_index(job: Dict[str, Any],
     chief_type = policy.get("replicaName", "COORDINATOR")
     chief_idx = int(policy.get("replicaIndex", 0))
     for i, m in enumerate(members):
-        if m.replica_type == chief_type and m.index == chief_idx:
+        # The chief lives on slice 0 (a multi-slice job has one chief,
+        # not one per slice).
+        if (m.replica_type == chief_type and m.index == chief_idx
+                and m.slice_id == 0):
             return i
     # Fall back to the first member (a job with no matching chief
     # replica still needs a success definition).
@@ -180,13 +237,28 @@ class Reconciler:
                            "image": "ghcr.io/kubeflow-tpu/trainer:v0.1.0"}]
 
         # Distributed bootstrap env (replaces TF_CONFIG injection).
+        # jax.distributed sees ONE FLAT GANG across every slice:
+        # num_processes counts all workers of all slices and
+        # process_id is the slice-major global index (expected_members
+        # order), so the mesh's outermost dcn_data axis lands exactly
+        # on slice boundaries. The TPU runtime's own TPU_WORKER_* vars
+        # stay PER-SLICE (each slice's runtime bootstraps its own ICI
+        # domain); MEGASCALE_* wires the cross-slice DCN transport.
         workers = [m for m in members if m.replica_type == "TPU_WORKER"]
         n_proc = len(workers) if member.replica_type == "TPU_WORKER" else 1
         coord_pod = (workers[0] if workers else members[0]).pod_name(name)
         coordinator = f"{coord_pod}.{name}.{ns}:{COORDINATOR_PORT}"
-        process_id = member.index if member.replica_type == "TPU_WORKER" else 0
+        if member.replica_type == "TPU_WORKER":
+            process_id = next(
+                gid for gid, w in enumerate(workers)
+                if w.slice_id == member.slice_id
+                and w.index == member.index)
+        else:
+            process_id = 0
+        slice_workers = [w for w in workers
+                         if w.slice_id == member.slice_id]
         hostnames = ",".join(
-            f"{w.pod_name(name)}.{name}.{ns}" for w in workers)
+            f"{w.pod_name(name)}.{name}.{ns}" for w in slice_workers)
         env = [
             k8s.env_var(ENV_COORD, coordinator),
             k8s.env_var(ENV_NPROC, n_proc),
@@ -199,6 +271,17 @@ class Reconciler:
                 k8s.env_var("TPU_WORKER_ID", member.index),
                 k8s.env_var("TPU_WORKER_HOSTNAMES", hostnames),
             ]
+            if member.num_slices > 1:
+                # The megascale contract (SURVEY §2.4): coordinator =
+                # slice 0's first worker, on its own port; build_mesh
+                # reads MEGASCALE_NUM_SLICES for the dcn_data axis.
+                ms_coord = (f"{workers[0].pod_name(name)}.{name}.{ns}"
+                            f":{MEGASCALE_PORT}")
+                env += [
+                    k8s.env_var("MEGASCALE_COORDINATOR_ADDRESS", ms_coord),
+                    k8s.env_var("MEGASCALE_NUM_SLICES", member.num_slices),
+                    k8s.env_var("MEGASCALE_SLICE_ID", member.slice_id),
+                ]
         for container in containers:
             merged = {e["name"]: e for e in container.get("env", [])}
             for e in env:
@@ -221,6 +304,7 @@ class Reconciler:
                     JOB_LABEL: name,
                     REPLICA_TYPE_LABEL: member.replica_type,
                     REPLICA_INDEX_LABEL: str(member.index),
+                    SLICE_INDEX_LABEL: str(member.slice_id),
                 },
                 "ownerReferences": [{
                     "apiVersion": f"{GROUP}/{VERSION}",
@@ -313,12 +397,25 @@ class Reconciler:
         allow_restart = job["spec"].get("recoveryPolicy",
                                         "restart-slice") == "restart-slice"
         skew_passes = int(status.get("completionSkewPasses", 0))
+        # Preemption drain: when EVERY failed pod exited with the
+        # drain code (SIGTERM → finish step → checkpoint → exit 77),
+        # the slice restart is the platform's fault, not the job's —
+        # it must not consume the restart budget, and budget
+        # exhaustion must not fail a job that only ever drained. Any
+        # genuinely crashed pod in the mix disables the exemption.
+        failed_pods = [pods.get(m.pod_name(name))
+                       for m, p in zip(members, phases)
+                       if p == PodPhase.FAILED]
+        drained_only = bool(failed_pods) and all(
+            pod_drained(pod) for pod in failed_pods)
         decision = decide(
             phases, chief, allow_restart=allow_restart,
-            restarts=restarts, max_restarts=self.max_restarts,
+            restarts=0 if drained_only else restarts,
+            max_restarts=self.max_restarts,
             completion_grace=skew_passes < self.completion_grace_passes)
-        logger.info("tpujob %s/%s: phases=%s decision=%s", ns, name,
-                    [p.name for p in phases], decision.name)
+        logger.info("tpujob %s/%s: phases=%s decision=%s drained=%s",
+                    ns, name, [p.name for p in phases], decision.name,
+                    drained_only)
 
         if decision == Decision.HOLD_COMPLETION:
             # Completion skew observed: count the pass and re-observe
@@ -340,14 +437,27 @@ class Reconciler:
                         # controller replica): the pod exists, which
                         # is what this pass wanted. Idempotent.
                         pass
-            return self._set_status(job, "Running" if restarts else "Pending",
-                                    restart_count=restarts)
+            # "Has this job restarted?" must come from the phase, not
+            # the budget counter: a drain-exempted restart leaves
+            # restartCount at 0 by design, and a long-running job
+            # regressing to Pending after a spot preemption would read
+            # as never-started on every dashboard.
+            recreating = restarts > 0 or phase == "Restarting"
+            return self._set_status(
+                job, "Running" if recreating else "Pending",
+                restart_count=restarts)
         if decision == Decision.RESTART_SLICE:
             for m in members:
                 try:
                     self.api.delete("Pod", ns, m.pod_name(name))
                 except NotFound:
                     pass
+            if drained_only:
+                return self._set_status(
+                    job, "Restarting", restart_count=restarts,
+                    reason="preemption drain; restarting from drain "
+                           f"checkpoint (budget {restarts}/"
+                           f"{self.max_restarts} unchanged)")
             return self._set_status(
                 job, "Restarting", restart_count=restarts + 1,
                 reason=f"slice fault; restart {restarts + 1}/"

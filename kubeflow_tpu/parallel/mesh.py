@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -37,6 +38,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS_ORDER: Tuple[str, ...] = (
     "dcn_data", "data", "fsdp", "pipeline", "seq", "expert", "tensor")
+
+# The multi-slice (megascale) env contract: on a real multi-slice TPU
+# deployment the runtime reads these to wire the cross-slice DCN
+# transport; the TPUJob operator injects them on every worker of a
+# numSlices > 1 job (operator/reconciler.py), and build_mesh() below
+# reads the slice count so the hybrid dcn_data layout comes from the
+# deployment env instead of per-program mesh flags. Parity: the
+# reference operator's essential job was assembling the cluster spec
+# and injecting it into every pod as TF_CONFIG
+# (kubeflow/core/tf-job.libsonnet:31-95); MEGASCALE_* + KFT_* is the
+# TPU translation (SURVEY §2.4).
+ENV_MEGASCALE_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+ENV_MEGASCALE_COORD = "MEGASCALE_COORDINATOR_ADDRESS"
+
+
+def slice_count_from_env(env=os.environ) -> int:
+    """Number of TPU slices this job spans, per the megascale env
+    (1 when unset — single-slice jobs carry no MEGASCALE_* vars)."""
+    raw = env.get(ENV_MEGASCALE_SLICES, "").strip()
+    if not raw:
+        return 1
+    count = int(raw)
+    if count < 1:
+        raise ValueError(f"{ENV_MEGASCALE_SLICES}={raw!r} must be >= 1")
+    return count
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,9 +113,27 @@ def build_mesh(
     ride the fastest ICI links — the scaling-book recipe:
     bandwidth-hungry axes get the contiguous device neighborhoods that
     ``mesh_utils`` maps to physical torus proximity.
+
+    Multi-slice: when the operator injected ``MEGASCALE_NUM_SLICES``
+    (numSlices > 1 TPUJobs), a spec that doesn't name ``dcn_data``
+    gets it set to the slice count automatically — the program
+    describes its within-slice layout, the deployment env supplies the
+    cross-slice axis. A spec that NAMES a conflicting dcn_data fails
+    loudly (a mesh disagreeing with the provisioned topology would
+    route ICI-intensity collectives over DCN or crash at runtime).
     """
     devices = list(devices if devices is not None else jax.devices())
-    spec = (spec or MeshSpec(data=-1)).resolve(len(devices))
+    spec = spec or MeshSpec(data=-1)
+    env_slices = slice_count_from_env()
+    if env_slices > 1:
+        if spec.dcn_data in (1, -1):
+            spec = dataclasses.replace(spec, dcn_data=env_slices)
+        elif spec.dcn_data != env_slices:
+            raise ValueError(
+                f"mesh spec dcn_data={spec.dcn_data} contradicts "
+                f"{ENV_MEGASCALE_SLICES}={env_slices} — the job was "
+                f"provisioned with {env_slices} slices")
+    spec = spec.resolve(len(devices))
     sizes = spec.sizes()
     shape = tuple(sizes[name] for name in AXIS_ORDER)
     try:

@@ -28,7 +28,7 @@ import tempfile
 import threading
 import time
 import urllib.request
-from typing import Callable, Dict, List, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -65,17 +65,24 @@ def _is_language(model: str) -> bool:
     return get_model(model).family == "language"
 
 
-def _is_causal_decoder(model: str) -> bool:
-    """Whether the model has a decode path the generate export can
-    drive. BERT encoders are family == "language" too, but have no
-    cache/generate machinery — exporting them with a generate
-    signature only fails later at model load with an opaque
-    ``cache_size`` constructor error."""
-    from kubeflow_tpu.models.llama import Llama
+def _encoder_rejection(model: str) -> Optional[str]:
+    """Error message when ``model`` is an encoder-only language model
+    the :generate wire can't drive, else None. BERT encoders are
+    family == "language" too, but have no cache/generate machinery —
+    exporting them with a generate signature only fails later at model
+    load with an opaque ``cache_size`` constructor error, so both the
+    CLI and run_serving_benchmark reject them up front (one message,
+    one registry-flag check)."""
     from kubeflow_tpu.models.registry import get_model
 
     entry = get_model(model)
-    return entry.family == "language" and isinstance(entry.make(), Llama)
+    if entry.family == "language" and not entry.decoder:
+        return (
+            f"model {model!r} is an encoder-only language model with "
+            f"no generate path; the serving benchmark drives language "
+            f"models through :generate (use a causal decoder like "
+            f"llama-test, or benchmark encoders via classify models)")
+    return None
 
 
 def _export(config: ServingBenchConfig) -> str:
@@ -222,13 +229,9 @@ def run_serving_benchmark(config: ServingBenchConfig) -> Dict[str, float]:
 
     if config.transport not in ("http", "grpc", "both"):
         raise ValueError(f"unknown transport {config.transport!r}")
-    if _is_language(config.model) and not _is_causal_decoder(config.model):
-        raise ValueError(
-            f"model {config.model!r} is an encoder-only language model "
-            f"with no generate path; the serving benchmark drives "
-            f"language models through :generate (use a causal decoder "
-            f"like llama-test, or benchmark encoders via classify "
-            f"models)")
+    rejection = _encoder_rejection(config.model)
+    if rejection:
+        raise ValueError(rejection)
     # http-only runs stay grpcio-free (the pre-r4 behavior): the gRPC
     # listener only starts when that wire is actually under test.
     want_grpc = config.transport in ("grpc", "both")
@@ -429,16 +432,12 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, default=0,
                         help="0 = ephemeral")
     args = parser.parse_args(argv)
-    if _is_language(args.model) and not _is_causal_decoder(args.model):
+    rejection = _encoder_rejection(args.model)
+    if rejection:
         # Same check run_serving_benchmark enforces, surfaced as an
         # argparse error so the CLI fails in milliseconds, not at
         # model load.
-        parser.error(
-            f"--model {args.model} is an encoder-only language model "
-            f"with no generate path; the serving benchmark drives "
-            f"language models through :generate (use a causal decoder "
-            f"like llama-test, or benchmark encoders via classify "
-            f"models)")
+        parser.error(rejection)
     sweep: Sequence[int] = tuple(
         int(s) for s in args.sweep.split(",") if s.strip())
     result = run_serving_benchmark(ServingBenchConfig(

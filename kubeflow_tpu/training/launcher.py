@@ -43,15 +43,51 @@ ENV_REPLICA_TYPE = "KFT_REPLICA_TYPE"
 ENV_REPLICA_INDEX = "KFT_REPLICA_INDEX"
 ENV_SLEEP = "KFT_SLEEP_ON_SUCCESS"
 
+# Exit code for a preemption drain: the pod was told to terminate
+# (SIGTERM — spot reclaim, maintenance, node drain), finished its
+# in-flight step, wrote a checkpoint, and exited. Distinguishable from
+# success (0) and from a crash (1, 134, 139, ...) so the operator can
+# restart the slice WITHOUT burning a restart-budget slot — preemption
+# is the platform's doing, not the job's. 77 is outside the shell/
+# signal ranges (126+) and unused by Python/abseil conventions.
+DRAIN_EXIT_CODE = 77
+
 
 def distributed_config(env=os.environ) -> Optional[dict]:
-    """The operator-injected gang description, or None (single host)."""
+    """The operator-injected gang description, or None (single host).
+
+    Multi-slice (numSlices > 1) jobs describe ONE flat gang here —
+    ``num_processes`` counts every worker across every slice, and
+    ``process_id`` is the slice-major global index — while the
+    MEGASCALE_* vars (read by :func:`slice_config` and by
+    ``parallel.mesh.build_mesh`` for the ``dcn_data`` axis) carry the
+    slice structure. jax.distributed wants the flat view; the mesh
+    wants the hierarchy."""
     if ENV_COORD not in env:
         return None
     return {
         "coordinator_address": env[ENV_COORD],
         "num_processes": int(env.get(ENV_NPROC, "1")),
         "process_id": int(env.get(ENV_PID, "0")),
+    }
+
+
+def slice_config(env=os.environ) -> Optional[dict]:
+    """The operator-injected multi-slice (megascale) description, or
+    None for single-slice jobs (which carry no MEGASCALE_* vars)."""
+    from kubeflow_tpu.parallel.mesh import (
+        ENV_MEGASCALE_COORD,
+        ENV_MEGASCALE_SLICE_ID,
+        slice_count_from_env,
+    )
+
+    num_slices = slice_count_from_env(env)
+    if num_slices <= 1:
+        return None
+    return {
+        "num_slices": num_slices,
+        "slice_id": int(env.get(ENV_MEGASCALE_SLICE_ID, "0")),
+        "coordinator_address": env.get(ENV_MEGASCALE_COORD),
     }
 
 
@@ -66,6 +102,13 @@ def initialize_distributed(env=os.environ) -> bool:
         return False
     import jax
 
+    slices = slice_config(env)
+    if slices:
+        logger.info(
+            "multi-slice gang: slice %d of %d (megascale coordinator "
+            "%s); mesh dcn_data axis comes from the env",
+            slices["slice_id"], slices["num_slices"],
+            slices["coordinator_address"])
     logger.info("jax.distributed.initialize(%s, num_processes=%d, "
                 "process_id=%d)", config["coordinator_address"],
                 config["num_processes"], config["process_id"])

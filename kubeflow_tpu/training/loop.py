@@ -1,4 +1,4 @@
-"""The training loop: resume → step → log → checkpoint → profile.
+"""The training loop: resume → step → log → checkpoint → profile → drain.
 
 This is the in-pod driver the operator's whole-slice recovery model
 assumes (SURVEY §5 "failure detection"): on every boot it restores the
@@ -8,21 +8,45 @@ any slice fault with "kill and recreate the gang" and lose at most
 ``save_interval_steps`` of work. The reference had nothing here: its
 launcher streamed tf_cnn_benchmarks output and slept forever on
 success (``tf-controller-examples/tf-cnn/launcher.py:29-54,86-90``).
+
+Preemption drain: TPU spot reclaims and node maintenance deliver
+SIGTERM with a grace period — *the* TPU-cloud failure mode. ``fit``
+catches it, finishes the in-flight step, force-saves a checkpoint, and
+raises :class:`DrainInterrupt`; entrypoints exit with
+``DRAIN_EXIT_CODE`` so the operator restarts the slice without burning
+a restart-budget slot and the job resumes from the drain step — losing
+zero work instead of everything since the last periodic save.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import logging
+import signal
+import threading
 import time
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import jax
 
 from kubeflow_tpu.training.checkpoint import CheckpointConfig, Checkpointer
+from kubeflow_tpu.training.launcher import DRAIN_EXIT_CODE  # noqa: F401
 from kubeflow_tpu.utils.metrics import MetricsLogger
 
 logger = logging.getLogger(__name__)
+
+
+class DrainInterrupt(Exception):
+    """Raised by ``fit`` after a drain signal: the in-flight step
+    finished and (if checkpointing) a checkpoint is durable at
+    ``.step``. Entrypoints translate this to ``DRAIN_EXIT_CODE``."""
+
+    def __init__(self, step: int, checkpointed: bool):
+        super().__init__(
+            f"drained at step {step} "
+            f"({'checkpoint saved' if checkpointed else 'no checkpoint'})")
+        self.step = step
+        self.checkpointed = checkpointed
 
 
 @dataclasses.dataclass
@@ -36,6 +60,20 @@ class LoopConfig:
     profile_start: Optional[int] = None
     profile_stop: Optional[int] = None
     profile_dir: str = "/tmp/kft-profile"
+    # Preemption drain: on any of these signals, finish the in-flight
+    # step, force-save a checkpoint, raise DrainInterrupt. Installed
+    # only when fit runs on the main thread (signal API constraint);
+    # () disables.
+    drain_signals: Tuple[int, ...] = (signal.SIGTERM,)
+    # Multi-host gangs must AGREE on the drain step: the Orbax save is
+    # itself a collective, so a host draining unilaterally while its
+    # peers sit in the train-step psum deadlocks the gang until the
+    # kubelet SIGKILLs it (which then reads as a crash, burning
+    # budget). Every N steps the hosts all-gather their local drain
+    # flags and drain together iff any host saw the signal. Trade-off:
+    # up to N extra steps run inside the grace period — keep
+    # N * step_time well under terminationGracePeriodSeconds.
+    drain_sync_steps: int = 5
 
 
 def fit(
@@ -64,12 +102,58 @@ def fit(
                     start_step, config.total_steps)
         return state
 
+    # Preemption drain: the handler only flips a flag — the loop body
+    # observes it between steps, so the in-flight step always
+    # completes and the saved state is a real step boundary. Signals
+    # can only be installed from the main thread; elsewhere (tests
+    # driving fit from a worker thread) drain is simply unavailable.
+    drain_requested = threading.Event()
+    prev_handlers = {}
+    if (config.drain_signals
+            and threading.current_thread() is threading.main_thread()):
+        def _on_drain(signum, frame):
+            del frame
+            logger.info("drain signal %d: finishing in-flight step, "
+                        "then checkpoint + exit", signum)
+            drain_requested.set()
+
+        for sig in config.drain_signals:
+            prev_handlers[sig] = signal.signal(sig, _on_drain)
+
+    multi_host = jax.process_count() > 1
     profiling = False
     window_start = time.perf_counter()
     window_steps = 0
     metrics: Dict[str, jax.Array] = {}
     try:
         for step in range(start_step, config.total_steps):
+            if multi_host:
+                # Collective drain agreement: every host evaluates
+                # this at the SAME iterations (same start_step, same
+                # stride), so the allgather below lines up. A host
+                # that saw no signal still participates and learns a
+                # peer was preempted.
+                drain_now = False
+                if (step - start_step) % max(config.drain_sync_steps,
+                                             1) == 0:
+                    from jax.experimental import multihost_utils
+
+                    flags = multihost_utils.process_allgather(
+                        drain_requested.is_set())
+                    drain_now = bool(flags.any())
+            else:
+                drain_now = drain_requested.is_set()
+            if drain_now:
+                drained_step = int(state.step)
+                if ckpt:
+                    # Safe collectively: every host reached this exact
+                    # step with the same drain verdict.
+                    ckpt.save(drained_step, state, force=True)
+                    ckpt.wait()
+                logger.info("drained at step %d (checkpoint %s)",
+                            drained_step,
+                            "saved" if ckpt else "not configured")
+                raise DrainInterrupt(drained_step, ckpt is not None)
             if config.profile_start is not None and step == config.profile_start:
                 jax.profiler.start_trace(config.profile_dir)
                 profiling = True
@@ -102,6 +186,9 @@ def fit(
             ckpt.save(int(state.step), state, force=True)
             ckpt.wait()
     finally:
+        for sig, handler in prev_handlers.items():
+            if handler is not None:  # None = prior handler was C-level
+                signal.signal(sig, handler)
         if profiling:
             jax.profiler.stop_trace()
         if ckpt:

@@ -95,7 +95,12 @@ def main(argv=None) -> int:
         token_shard_batches,
     )
     from kubeflow_tpu.training.lm import create_lm_state, make_lm_train_step
-    from kubeflow_tpu.training.loop import LoopConfig, fit
+    from kubeflow_tpu.training.loop import (
+        DRAIN_EXIT_CODE,
+        DrainInterrupt,
+        LoopConfig,
+        fit,
+    )
 
     entry = get_model(args.model)
     objective = args.objective or (
@@ -186,6 +191,18 @@ def main(argv=None) -> int:
     data = DevicePrefetcher(gen, mesh)
     try:
         state = fit(state, step_fn, data, config)
+    except DrainInterrupt as drain:
+        # Preemption (SIGTERM): the in-flight step finished and the
+        # checkpoint is durable. The distinguishable exit code tells
+        # the operator to restart the slice WITHOUT burning a
+        # restart-budget slot; the restarted pod resumes at the drain
+        # step.
+        print(json.dumps({
+            "drained": True,
+            "step": drain.step,
+            "checkpointed": drain.checkpointed,
+        }))
+        return DRAIN_EXIT_CODE
     finally:
         data.close()
 

@@ -1,0 +1,172 @@
+"""Preemption drain (SURVEY §5 failure-detection, VERDICT-r4 next #3):
+SIGTERM — the TPU-cloud spot-reclaim/maintenance signal — makes the
+training loop finish its in-flight step, force-save a checkpoint, and
+exit with DRAIN_EXIT_CODE; the operator restarts the slice without
+burning a restart-budget slot (tests/test_operator.py), and the
+restarted job resumes bitwise from the drain checkpoint.
+"""
+
+import itertools
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from kubeflow_tpu.models.llama import llama_test
+from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+from kubeflow_tpu.training.checkpoint import CheckpointConfig, Checkpointer
+from kubeflow_tpu.training.launcher import DRAIN_EXIT_CODE
+from kubeflow_tpu.training.lm import (
+    create_lm_state,
+    make_lm_train_step,
+    place_lm_batch,
+)
+from kubeflow_tpu.training.loop import DrainInterrupt, LoopConfig, fit
+
+
+def _setup(mesh):
+    model = llama_test()
+    batch = {"input_ids": jax.random.randint(
+        jax.random.PRNGKey(0), (8, 16), 0, 512)}
+    state, shardings = create_lm_state(
+        model, optax.adamw(1e-3), jax.random.PRNGKey(1), batch, mesh)
+    step = make_lm_train_step(mesh, shardings, objective="causal",
+                              donate=False)
+    return state, step, place_lm_batch(mesh, batch)
+
+
+def test_fit_drains_on_sigterm_and_resumes_bitwise(tmp_path):
+    """In-process drain: a real SIGTERM (os.kill on ourselves, raised
+    from a training hook) interrupts fit mid-run. The in-flight step
+    completes, the checkpoint lands at the drain step, and resuming
+    to the original step budget yields params BITWISE equal to an
+    uninterrupted run — zero work lost, zero work diverged."""
+    mesh = build_mesh(MeshSpec(data=8))
+    ckpt_cfg = CheckpointConfig(
+        directory=str(tmp_path / "ckpt"),
+        # Interval far beyond the run: the only save that can explain
+        # a resume is the drain's force-save.
+        save_interval_steps=1000, async_save=False)
+
+    def preempt(step_i, state, metrics):
+        del state, metrics
+        if step_i == 3:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    state, step, placed = _setup(mesh)
+    with pytest.raises(DrainInterrupt) as excinfo:
+        fit(state, step, itertools.repeat(placed),
+            LoopConfig(total_steps=10, log_every=1, checkpoint=ckpt_cfg),
+            hooks=[preempt])
+    drain = excinfo.value
+    assert drain.checkpointed
+    assert 3 <= drain.step < 10  # mid-run, after the in-flight step
+    probe = Checkpointer(CheckpointConfig(
+        directory=str(tmp_path / "ckpt"), save_interval_steps=1))
+    assert probe.latest_step() == drain.step
+    probe.close()
+    # The drain handler was uninstalled on exit (next SIGTERM would
+    # kill the process, as it should outside fit).
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+    # Resume from the drain checkpoint to the full 10 steps.
+    state2, step2, placed = _setup(mesh)
+    resumed = fit(state2, step2, itertools.repeat(placed),
+                  LoopConfig(total_steps=10, log_every=5,
+                             checkpoint=ckpt_cfg))
+    assert int(resumed.step) == 10
+
+    # Uninterrupted reference run: same init, same batches, no drain.
+    state3, step3, placed = _setup(mesh)
+    straight = fit(state3, step3, itertools.repeat(placed),
+                   LoopConfig(total_steps=10, log_every=5))
+    for a, b in zip(jax.tree.leaves(resumed.params),
+                    jax.tree.leaves(straight.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fit_without_checkpoint_still_drains(tmp_path):
+    """No checkpoint configured: the drain still interrupts promptly
+    with checkpointed=False (the operator restarts; the job restarts
+    from step 0 — exactly what the config asked for)."""
+    mesh = build_mesh(MeshSpec(data=8))
+    state, step, placed = _setup(mesh)
+
+    def preempt(step_i, state, metrics):
+        del state, metrics
+        if step_i == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    with pytest.raises(DrainInterrupt) as excinfo:
+        fit(state, step, itertools.repeat(placed),
+            LoopConfig(total_steps=10, log_every=1), hooks=[preempt])
+    assert not excinfo.value.checkpointed
+
+
+@pytest.mark.slow
+def test_pretrain_cli_sigterm_drain_exit_code(tmp_path):
+    """The REAL training process: SIGTERM a `python -m
+    kubeflow_tpu.training.pretrain` subprocess mid-run. It must exit
+    with DRAIN_EXIT_CODE, report the drain step on stdout, leave a
+    checkpoint at that step, and a rerun must resume FROM it (first
+    logged step = drain step + 1), not from zero."""
+    ckpt_dir = tmp_path / "ckpt"
+    metrics1 = tmp_path / "m1.jsonl"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+
+    def trainer_args(steps, metrics_path):
+        return [sys.executable, "-m", "kubeflow_tpu.training.pretrain",
+                "--model", "llama-test", "--global_batch", "8",
+                "--seq_len", "16", "--steps", str(steps),
+                "--log_every", "1", "--mesh", "data=8",
+                "--checkpoint_dir", str(ckpt_dir),
+                # Interval far beyond the window: only the drain's
+                # force-save can explain the resume.
+                "--save_every", "50000",
+                "--metrics_path", str(metrics_path)]
+
+    proc = subprocess.Popen(
+        trainer_args(100000, metrics1), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=str(Path(__file__).parent.parent))
+    # Wait until training demonstrably progresses (a few logged steps
+    # past compile), then preempt.
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        if metrics1.exists() and len(
+                metrics1.read_text().splitlines()) >= 3:
+            break
+        if proc.poll() is not None:
+            pytest.fail(f"trainer died early:\n{proc.stdout.read()[-2000:]}")
+        time.sleep(0.5)
+    else:
+        proc.kill()
+        pytest.fail("trainer never reached step 3")
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=180)
+    assert proc.returncode == DRAIN_EXIT_CODE, out[-2000:]
+    drain = json.loads(out.strip().splitlines()[-1])
+    assert drain["drained"] and drain["checkpointed"]
+    drain_step = drain["step"]
+    assert drain_step >= 3
+
+    # Resume for two more steps: must continue from the drain step.
+    metrics2 = tmp_path / "m2.jsonl"
+    rerun = subprocess.run(
+        trainer_args(drain_step + 2, metrics2),
+        env=env, capture_output=True, text=True, timeout=300,
+        cwd=str(Path(__file__).parent.parent))
+    assert rerun.returncode == 0, rerun.stdout[-2000:] + rerun.stderr[-500:]
+    final = json.loads(rerun.stdout.strip().splitlines()[-1])
+    assert final["final_step"] == drain_step + 2
+    first_logged = json.loads(metrics2.read_text().splitlines()[0])
+    assert first_logged["step"] == drain_step + 1

@@ -40,6 +40,45 @@ def test_build_mesh_axes(cpu_devices):
     assert mesh.size == 8
 
 
+def test_build_mesh_megascale_env(cpu_devices, monkeypatch):
+    """The operator-injected MEGASCALE_NUM_SLICES supplies the
+    dcn_data axis: a spec that doesn't name it gets the slice count
+    automatically, a conflicting explicit value fails loudly, and an
+    agreeing one passes through."""
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+    mesh = build_mesh(MeshSpec(data=-1))
+    assert mesh.shape["dcn_data"] == 2
+    assert mesh.shape["data"] == 4
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2))  # 2×2×2 = 8
+    assert mesh.shape["dcn_data"] == 2
+    mesh = build_mesh(MeshSpec(dcn_data=2, data=4))  # explicit, agrees
+    assert mesh.shape["dcn_data"] == 2
+    with pytest.raises(ValueError, match="provisioned"):
+        build_mesh(MeshSpec(dcn_data=4, data=2))
+    # Absent (single-slice) env leaves specs untouched.
+    monkeypatch.delenv("MEGASCALE_NUM_SLICES")
+    assert build_mesh(MeshSpec(data=-1)).shape["dcn_data"] == 1
+
+
+def test_launcher_slice_config(monkeypatch):
+    """slice_config surfaces the megascale identity to in-pod code;
+    single-slice pods (no MEGASCALE vars) read None."""
+    from kubeflow_tpu.training.launcher import slice_config
+
+    assert slice_config({}) is None
+    env = {
+        "MEGASCALE_NUM_SLICES": "2",
+        "MEGASCALE_SLICE_ID": "1",
+        "MEGASCALE_COORDINATOR_ADDRESS": "j-s0-tpu-worker-0.j.ns:8477",
+    }
+    cfg = slice_config(env)
+    assert cfg == {
+        "num_slices": 2,
+        "slice_id": 1,
+        "coordinator_address": "j-s0-tpu-worker-0.j.ns:8477",
+    }
+
+
 def test_fsdp_sharding_splits_large_weights(cpu_devices):
     mesh = build_mesh(MeshSpec(data=2, fsdp=4))
     params = {

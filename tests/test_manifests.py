@@ -327,6 +327,50 @@ def test_tpu_lm_prototype_args_and_validation():
     assert not any("microbatches" in a for a in args)
 
 
+def test_tpu_lm_multislice_validation():
+    """num_slices scales the generate-time geometry the way
+    build_mesh's megascale-env rule scales the in-pod mesh: dcn_data
+    defaults to the slice count, a conflicting explicit value fails,
+    and the host-divisibility check counts every slice's workers."""
+    # 2 slices × 2 hosts × 4 chips = 16 chips; dcn_data=2 implied, so
+    # mesh data=-1 resolves to 8 and batch 64 shards over 2×8.
+    objs = get_prototype("tpu-lm").build({
+        "name": "lmjob", "mesh": "data=-1", "global_batch": "64",
+        "num_tpu_workers": "2", "chips_per_worker": "4",
+        "num_slices": "2",
+    })
+    assert objs[0]["spec"]["numSlices"] == 2
+    # Explicit matching dcn_data is fine...
+    objs = get_prototype("tpu-lm").build({
+        "name": "lmjob", "mesh": "dcn_data=2,data=8",
+        "global_batch": "64",
+        "num_tpu_workers": "2", "chips_per_worker": "4",
+        "num_slices": "2",
+    })
+    assert objs
+    # ...a contradicting one is the in-pod build_mesh error, caught
+    # at generate time instead.
+    with pytest.raises(ValueError, match="num_slices"):
+        get_prototype("tpu-lm").build({
+            "name": "lmjob", "mesh": "dcn_data=4,data=4",
+            "global_batch": "64",
+            "num_tpu_workers": "2", "chips_per_worker": "4",
+            "num_slices": "2",
+        })
+    # Host divisibility counts slices: 6 hosts total, batch 64 fails
+    # (tensor=12 × implied dcn_data=2 = the 24 provisioned chips, and
+    # dcn_data alone divides 64, so only the host check can catch it).
+    with pytest.raises(ValueError, match="host count"):
+        get_prototype("tpu-lm").build({
+            "name": "lmjob", "mesh": "tensor=12", "global_batch": "64",
+            "num_tpu_workers": "3", "chips_per_worker": "4",
+            "num_slices": "2",
+        })
+    # Single-slice jobs keep the pre-r5 CR shape: no numSlices field.
+    objs = get_prototype("tpu-lm").build({"name": "lmjob"})
+    assert "numSlices" not in objs[0]["spec"]
+
+
 def test_tpu_lm_checkpoint_pvc_mounts():
     """checkpoint_pvc makes the resume path real: the PVC is mounted
     at checkpoint_dir (without it, restart-slice recovery would

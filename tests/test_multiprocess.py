@@ -1,21 +1,25 @@
-"""Real multi-process gang tests: 2 jax.distributed processes (Gloo
+"""Real multi-process gang tests: jax.distributed processes (Gloo
 over loopback — the DCN stand-in), operator env contract → launcher
 bootstrap → SPMD train steps on the global mesh.
 
 This is the tier the reference could only run on a live GKE cluster
-(SURVEY §4); here it's hermetic. Both processes must converge to the
+(SURVEY §4); here it's hermetic. All processes must converge to the
 SAME loss — the gradient all-reduce across processes is the thing
-under test. Two layouts:
+under test. Three layouts:
 
-- flat data-parallel resnet (2×2 devices);
+- flat data-parallel resnet (2 procs × 2 devices);
 - the BASELINE multi-host BERT row: hierarchical dcn_data=2 × data=4
-  mesh (2×4 devices) with the cross-slice axis on the process
+  mesh (2 procs × 4 devices) with the cross-slice axis on the process
   boundary — the coordinator + DCN-spanning-mesh combination, not its
-  single-process dryrun emulation (VERDICT-r3 weak #2).
+  single-process dryrun emulation (VERDICT-r3 weak #2);
+- the multi-slice (megascale) operator contract: 4 procs as 2 slices
+  × 2 hosts, dcn_data derived from the injected MEGASCALE env
+  (VERDICT-r4 next #1/#7).
 """
 
 import os
 import re
+import signal
 import socket
 import subprocess
 import sys
@@ -32,21 +36,30 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_gang(mode: str, local_devices: int):
+def _run_gang(mode: str, local_devices: int, n_procs: int = 2,
+              num_slices: int = 1):
     port = _free_port()
     procs = []
-    for pid in range(2):
+    for pid in range(n_procs):
         env = dict(
             os.environ,
             JAX_PLATFORMS="cpu",
             KFT_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-            KFT_NUM_PROCESSES="2",
+            KFT_NUM_PROCESSES=str(n_procs),
             KFT_PROCESS_ID=str(pid),
             KFT_REPLICA_TYPE="TPU_WORKER",
-            KFT_REPLICA_INDEX=str(pid),
+            KFT_REPLICA_INDEX=str(pid % max(n_procs // num_slices, 1)),
             KFT_GANG_MODE=mode,
             KFT_LOCAL_DEVICES=str(local_devices),
         )
+        if num_slices > 1:
+            # The operator's multi-slice injection (slice-major
+            # process ids → slice = pid // hosts_per_slice), minus the
+            # real DCN transport — Gloo over loopback stands in.
+            hosts_per_slice = n_procs // num_slices
+            env["MEGASCALE_NUM_SLICES"] = str(num_slices)
+            env["MEGASCALE_SLICE_ID"] = str(pid // hosts_per_slice)
+            env["MEGASCALE_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port + 1}"
         env["XLA_FLAGS"] = (
             f"--xla_force_host_platform_device_count={local_devices}")
         procs.append(subprocess.Popen(
@@ -61,7 +74,7 @@ def _run_gang(mode: str, local_devices: int):
     for out in outputs:
         m = re.search(
             rf"GANG_OK mode={mode} process=(\d) "
-            rf"devices={2 * local_devices} loss=([0-9.]+)", out)
+            rf"devices={n_procs * local_devices} loss=([0-9.]+)", out)
         assert m, out[-2000:]
         losses.append(float(m.group(2)))
     return losses
@@ -82,3 +95,88 @@ def test_two_process_bert_dcn_hierarchical_mesh():
     transport; both processes end at the same loss."""
     losses = _run_gang("bert_dcn", local_devices=4)
     assert losses[0] == losses[1], losses
+
+
+@pytest.mark.slow
+def test_two_process_gang_drains_collectively(tmp_path):
+    """Preemption hits ONE host of a 2-process gang (SIGTERM to
+    process 1 only). The drain-flag allgather must propagate the
+    verdict so BOTH processes exit DRAIN_EXIT_CODE at the SAME step
+    with the collective checkpoint durable — a unilateral drain would
+    instead deadlock the peer inside the train-step psum until
+    SIGKILL (budget-burning crash)."""
+    import json
+    import time
+
+    from kubeflow_tpu.training.launcher import DRAIN_EXIT_CODE
+
+    port = _free_port()
+    ckpt_dir = tmp_path / "ckpt"
+    metrics = [tmp_path / "m0.jsonl", tmp_path / "m1.jsonl"]
+    procs = []
+    for pid in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            KFT_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            KFT_NUM_PROCESSES="2",
+            KFT_PROCESS_ID=str(pid),
+            KFT_REPLICA_TYPE="TPU_WORKER",
+            KFT_REPLICA_INDEX=str(pid),
+            KFT_GANG_MODE="drain",
+            KFT_LOCAL_DEVICES="2",
+            KFT_DRAIN_CKPT=str(ckpt_dir),
+            KFT_DRAIN_METRICS=str(metrics[pid]),
+            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, str(WORKER)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    # Wait for demonstrable progress on both hosts, then preempt ONE.
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        if all(m.exists() and len(m.read_text().splitlines()) >= 3
+               for m in metrics):
+            break
+        for p in procs:
+            if p.poll() is not None:
+                out, _ = p.communicate()
+                raise AssertionError(f"worker died early:\n{out[-2000:]}")
+        time.sleep(0.3)
+    else:
+        for p in procs:
+            p.kill()
+        raise AssertionError("gang never reached step 3")
+    procs[1].send_signal(signal.SIGTERM)
+
+    steps = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        assert p.returncode == DRAIN_EXIT_CODE, out[-2000:]
+        m = re.search(r"GANG_DRAINED process=(\d) step=(\d+) ckpt=True",
+                      out)
+        assert m, out[-2000:]
+        steps.append(int(m.group(2)))
+    # Both hosts agreed on the drain step (the allgather worked).
+    assert steps[0] == steps[1], steps
+    # The collective checkpoint is durable at exactly that step.
+    latest = json.loads((tmp_path / "m0.jsonl").read_text()
+                        .splitlines()[-1])
+    assert latest["step"] <= steps[0]
+    step_dirs = [d.name for d in ckpt_dir.iterdir() if d.is_dir()]
+    assert str(steps[0]) in step_dirs, (steps, step_dirs)
+
+
+@pytest.mark.slow
+def test_four_process_two_slice_megascale_gang():
+    """The multi-slice operator contract across REAL process
+    boundaries: 4 processes as 2 slices × 2 hosts, topology described
+    ONLY by the injected MEGASCALE_* + KFT_* env (exactly what the
+    reconciler writes into a numSlices=2 job's pods). The worker
+    derives its dcn_data axis from the env inside build_mesh, asserts
+    the slice boundary falls between process pairs, and trains BERT
+    MLM; all four processes must end at the same loss — the
+    cross-slice gradient all-reduce is the thing under test."""
+    losses = _run_gang("bert_dcn_megascale", local_devices=2,
+                       n_procs=4, num_slices=2)
+    assert len(set(losses)) == 1, losses

@@ -406,6 +406,184 @@ def test_gang_pod_disruption_budget():
     assert len(api.list("PodDisruptionBudget", "default")) == 1
 
 
+def test_preemption_drain_does_not_burn_restart_budget():
+    """A pod SIGTERM-drained by the platform (spot reclaim, node
+    maintenance) exits with DRAIN_EXIT_CODE after checkpointing
+    (training/loop.py); the slice restarts — all-or-nothing as ever —
+    but WITHOUT consuming a restart-budget slot: preemption is the
+    platform's fault, not the job's."""
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=2))
+    r = Reconciler(api, max_restarts=1)
+    r.reconcile(job)
+    api.set_all_pod_phases("default", "Running", {JOB_LABEL: "job1"})
+    r.reconcile(api.get("TPUJob", "default", "job1"))
+
+    from kubeflow_tpu.training.launcher import DRAIN_EXIT_CODE
+
+    # Repeated preemptions never exhaust the budget (max_restarts=1).
+    for round_i in range(3):
+        api.set_pod_terminated("default", "job1-tpu-worker-0",
+                               DRAIN_EXIT_CODE)
+        job = api.get("TPUJob", "default", "job1")
+        assert r.reconcile(job) == "Restarting", round_i
+        assert job["status"]["restartCount"] == 0
+        assert "preemption drain" in job["status"]["reason"]
+        assert api.list("Pod", "default", {JOB_LABEL: "job1"}) == []
+        job = api.get("TPUJob", "default", "job1")
+        # The recreate pass reports Running (the job HAS restarted,
+        # even though the budget counter stayed at 0): a preempted
+        # long-running job must not regress to Pending on dashboards.
+        assert r.reconcile(job) == "Running"
+        api.set_all_pod_phases("default", "Running", {JOB_LABEL: "job1"})
+        r.reconcile(api.get("TPUJob", "default", "job1"))
+
+    # A REAL crash still burns the budget and, at max_restarts=1,
+    # the next one fails the job.
+    api.set_pod_terminated("default", "job1-tpu-worker-1", 139)
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Restarting"
+    assert job["status"]["restartCount"] == 1
+    r.reconcile(api.get("TPUJob", "default", "job1"))  # recreate
+    api.set_pod_terminated("default", "job1-tpu-worker-0", 1)
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Failed"
+
+
+def test_mixed_drain_and_crash_burns_budget():
+    """One drained pod + one genuinely crashed pod is a slice fault,
+    not a preemption: the crash rules, the budget decrements."""
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=2))
+    r = Reconciler(api)
+    r.reconcile(job)
+    api.set_all_pod_phases("default", "Running", {JOB_LABEL: "job1"})
+    r.reconcile(api.get("TPUJob", "default", "job1"))
+
+    from kubeflow_tpu.training.launcher import DRAIN_EXIT_CODE
+
+    api.set_pod_terminated("default", "job1-tpu-worker-0",
+                           DRAIN_EXIT_CODE)
+    api.set_pod_terminated("default", "job1-tpu-worker-1", 134)
+    job = api.get("TPUJob", "default", "job1")
+    assert r.reconcile(job) == "Restarting"
+    assert job["status"]["restartCount"] == 1
+    assert "slice fault" in job["status"]["reason"]
+
+
+def make_multislice_job(name="ms1", workers=2, num_slices=2):
+    spec = replica_spec(
+        "TPU_WORKER", workers, image="img:1",
+        tpu_accelerator="tpu-v5-lite-podslice", tpu_topology="2x4")
+    job = tpu_job(name, "default", [spec],
+                  termination=termination_policy("TPU_WORKER", 0),
+                  num_slices=num_slices)
+    job["metadata"]["uid"] = "uid-ms"
+    return job
+
+
+def test_multislice_gang_naming_env_and_pdb():
+    """numSlices=2 provisions the replicaSpecs once per slice with
+    slice-major global process ids, per-slice TPU runtime env, and the
+    MEGASCALE_* cross-slice contract (SURVEY §2.4) — one PDB over the
+    union."""
+    api = FakeApiServer()
+    job = submit(api, make_multislice_job(workers=2, num_slices=2))
+    r = Reconciler(api)
+    assert r.reconcile(job) == "Pending"
+
+    pods = api.list("Pod", "default", {JOB_LABEL: "ms1"})
+    assert sorted(p["metadata"]["name"] for p in pods) == [
+        "ms1-s0-tpu-worker-0", "ms1-s0-tpu-worker-1",
+        "ms1-s1-tpu-worker-0", "ms1-s1-tpu-worker-1"]
+
+    def env_of(pod_name):
+        pod = api.get("Pod", "default", pod_name)
+        return {e["name"]: e["value"]
+                for e in pod["spec"]["containers"][0]["env"]}
+
+    # Slice 1's second worker: global process id 3 of a FLAT 4-process
+    # jax gang, but slice-local TPU runtime identity.
+    env = env_of("ms1-s1-tpu-worker-1")
+    assert env["KFT_NUM_PROCESSES"] == "4"
+    assert env["KFT_PROCESS_ID"] == "3"
+    assert env["KFT_COORDINATOR_ADDRESS"] == \
+        "ms1-s0-tpu-worker-0.ms1.default:8476"
+    assert env["TPU_WORKER_ID"] == "1"
+    # TPU_WORKER_HOSTNAMES lists only THIS slice's workers (each
+    # slice's runtime bootstraps its own ICI domain).
+    hosts = env["TPU_WORKER_HOSTNAMES"].split(",")
+    assert hosts == ["ms1-s1-tpu-worker-0.ms1.default",
+                     "ms1-s1-tpu-worker-1.ms1.default"]
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == \
+        "ms1-s0-tpu-worker-0.ms1.default:8477"
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    # Slice 0 worker 0 is process 0 / slice 0.
+    env0 = env_of("ms1-s0-tpu-worker-0")
+    assert env0["KFT_PROCESS_ID"] == "0"
+    assert env0["MEGASCALE_SLICE_ID"] == "0"
+
+    pod = api.get("Pod", "default", "ms1-s1-tpu-worker-0")
+    assert pod["metadata"]["labels"]["kubeflow.org/slice-index"] == "1"
+    # One disruption budget over the union of slices.
+    assert api.get("PodDisruptionBudget", "default",
+                   "ms1")["spec"]["minAvailable"] == 4
+
+
+def test_single_slice_job_has_no_megascale_env():
+    """Single-slice jobs keep the pre-r5 pod names and carry no
+    MEGASCALE_* vars (build_mesh treats their absence as 1 slice)."""
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=2))
+    Reconciler(api).reconcile(job)
+    pod = api.get("Pod", "default", "job1-tpu-worker-0")
+    names = {e["name"] for e in pod["spec"]["containers"][0]["env"]}
+    assert not any(n.startswith("MEGASCALE_") for n in names)
+
+
+def test_multislice_failure_restarts_every_slice():
+    """All-or-nothing across the UNION: one failed pod on slice 1
+    deletes both slices' gangs and burns one restart; the next pass
+    recreates everything."""
+    api = FakeApiServer()
+    job = submit(api, make_multislice_job(workers=2, num_slices=2))
+    r = Reconciler(api)
+    r.reconcile(job)
+    api.set_all_pod_phases("default", "Running", {JOB_LABEL: "ms1"})
+    job = api.get("TPUJob", "default", "ms1")
+    assert r.reconcile(job) == "Running"
+
+    api.set_pod_phase("default", "ms1-s1-tpu-worker-0", "Failed")
+    job = api.get("TPUJob", "default", "ms1")
+    assert r.reconcile(job) == "Restarting"
+    assert api.list("Pod", "default", {JOB_LABEL: "ms1"}) == []
+    assert job["status"]["restartCount"] == 1
+
+    job = api.get("TPUJob", "default", "ms1")
+    assert r.reconcile(job) == "Running"
+    assert len(api.list("Pod", "default", {JOB_LABEL: "ms1"})) == 4
+
+
+def test_multislice_chief_is_slice0_worker0():
+    """One chief per JOB (slice 0's worker 0), not one per slice: its
+    success completes the job and tears down the other slices."""
+    api = FakeApiServer()
+    job = submit(api, make_multislice_job(workers=2, num_slices=2))
+    r = Reconciler(api)
+    r.reconcile(job)
+    api.set_all_pod_phases("default", "Running", {JOB_LABEL: "ms1"})
+    r.reconcile(api.get("TPUJob", "default", "ms1"))
+    api.set_pod_phase("default", "ms1-s0-tpu-worker-0", "Succeeded")
+    job = api.get("TPUJob", "default", "ms1")
+    assert r.reconcile(job) == "Succeeded"
+    # Non-chief pods (incl. all of slice 1) were torn down; only the
+    # Succeeded chief remains.
+    left = api.list("Pod", "default", {JOB_LABEL: "ms1"})
+    assert [p["metadata"]["name"] for p in left] == [
+        "ms1-s0-tpu-worker-0"]
+
+
 def test_gang_pdb_tracks_rescaled_gang():
     """A rescaled gang must re-size its disruption budget — a stale
     minAvailable would permit evicting the difference."""
