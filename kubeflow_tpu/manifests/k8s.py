@@ -375,9 +375,14 @@ def crd(
     singular: Optional[str] = None,
     short_names: Optional[Sequence[str]] = None,
     schema: Optional[Obj] = None,
+    status_subresource: bool = False,
 ) -> Obj:
     """CustomResourceDefinition (apiextensions v1, vs the reference's
-    v1beta1 at ``kubeflow/core/tf-job.libsonnet:14-29``)."""
+    v1beta1 at ``kubeflow/core/tf-job.libsonnet:14-29``).
+
+    ``status_subresource`` declares ``subresources.status`` — REQUIRED
+    for any controller writing status through the ``/status``
+    endpoint (the apiserver 404s the endpoint when undeclared)."""
     version_obj: Obj = {
         "name": version,
         "served": True,
@@ -387,6 +392,8 @@ def crd(
             or {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
         },
     }
+    if status_subresource:
+        version_obj["subresources"] = {"status": {}}
     return _prune(
         {
             "apiVersion": "apiextensions.k8s.io/v1",

@@ -216,7 +216,13 @@ def crd() -> Dict[str, Any]:
         },
     }
     return k8s.crd(CRD_NAME, GROUP, VERSION, KIND, PLURAL,
-                   short_names=["tpj"], schema=schema)
+                   short_names=["tpj"], schema=schema,
+                   # The operator writes status through /status (both
+                   # the kubectl shim's --subresource=status and the
+                   # HTTP client's PUT); without this declaration the
+                   # apiserver 404s that endpoint and every status
+                   # update would be silently dropped.
+                   status_subresource=True)
 
 
 def operator_config(namespace: str, cloud: str = "") -> Dict[str, Any]:

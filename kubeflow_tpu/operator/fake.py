@@ -1,16 +1,18 @@
 """A minimal in-memory apiserver for hermetic operator tests.
 
 Implements just the object-store surface the reconciler needs
-(create/get/list/patch/delete keyed by (kind, namespace, name)), plus
-test helpers to drive pod phase transitions. This is the fake layer
-SURVEY §4 calls out as missing from the reference.
+(create/get/list/patch/delete keyed by (kind, namespace, name)) plus
+WATCH streams with resourceVersion resume (the surface the
+event-driven controller consumes), and test helpers to drive pod
+phase transitions. This is the fake layer SURVEY §4 calls out as
+missing from the reference.
 """
 
 from __future__ import annotations
 
 import copy
 import threading
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 Key = Tuple[str, str, str]  # (kind, namespace, name)
 
@@ -23,11 +25,46 @@ class NotFound(Exception):
     pass
 
 
+class Gone(Exception):
+    """The requested resourceVersion is no longer in the event window
+    (k8s 410 Gone): the watcher must relist and re-watch."""
+
+
+def _labels_match(obj: Dict[str, Any],
+                  selector: Optional[Dict[str, Optional[str]]]) -> bool:
+    """k8s label-selector subset: value None = key-existence match
+    (``labelSelector=key``), else exact equality (``key=value``)."""
+    if not selector:
+        return True
+    labels = obj.get("metadata", {}).get("labels", {})
+    for lk, lv in selector.items():
+        if lv is None:
+            if lk not in labels:
+                return False
+        elif labels.get(lk) != lv:
+            return False
+    return True
+
+
 class FakeApiServer:
+    # Events retained for watch resume; older revisions answer Gone,
+    # like a real apiserver compacting its watch cache.
+    EVENT_WINDOW = 10_000
+
     def __init__(self):
         self._objects: Dict[Key, Dict[str, Any]] = {}
         self._lock = threading.RLock()
         self._revision = 0
+        # (revision, event_type, object snapshot) — the watch log.
+        self._events: List[Tuple[int, str, Dict[str, Any]]] = []
+        self._cond = threading.Condition(self._lock)
+
+    def _record(self, event_type: str, obj: Dict[str, Any]) -> None:
+        self._events.append((self._revision, event_type,
+                             copy.deepcopy(obj)))
+        if len(self._events) > self.EVENT_WINDOW:
+            del self._events[:len(self._events) - self.EVENT_WINDOW]
+        self._cond.notify_all()
 
     @staticmethod
     def _key(obj: Dict[str, Any]) -> Key:
@@ -44,6 +81,7 @@ class FakeApiServer:
             stored.setdefault("metadata", {})["resourceVersion"] = str(
                 self._revision)
             self._objects[key] = stored
+            self._record("ADDED", stored)
             return copy.deepcopy(stored)
 
     def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
@@ -63,33 +101,122 @@ class FakeApiServer:
                     continue
                 if namespace is not None and ns != namespace:
                     continue
-                if label_selector:
-                    labels = obj.get("metadata", {}).get("labels", {})
-                    if any(labels.get(lk) != lv
-                           for lk, lv in label_selector.items()):
-                        continue
+                if not _labels_match(obj, label_selector):
+                    continue
                 out.append(copy.deepcopy(obj))
             return out
 
     def patch(self, kind: str, namespace: str, name: str,
               mutate: Callable[[Dict[str, Any]], None]) -> Dict[str, Any]:
-        """Apply a mutation function under the store lock."""
+        """Apply a mutation function under the store lock.
+
+        No-op mutations neither bump resourceVersion nor emit a
+        MODIFIED event — the real apiserver's no-change-PUT
+        suppression. Without it the controller's own steady-state
+        status write would re-enqueue the job it just reconciled,
+        a self-sustaining hot loop (r5 review)."""
         with self._lock:
             key = (kind, namespace, name)
             if key not in self._objects:
                 raise NotFound(f"{kind} {namespace}/{name}")
             obj = self._objects[key]
+            before = copy.deepcopy(obj)
             mutate(obj)
+            if obj == before:
+                return copy.deepcopy(obj)
             self._revision += 1
             obj["metadata"]["resourceVersion"] = str(self._revision)
+            self._record("MODIFIED", obj)
             return copy.deepcopy(obj)
+
+    def replace(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """PUT semantics with optimistic concurrency: the incoming
+        object's resourceVersion must match the stored one (k8s 409
+        otherwise) — the contract HttpApiClient.patch relies on to
+        turn concurrent writers into Conflicts instead of lost
+        updates."""
+        with self._lock:
+            key = self._key(obj)
+            stored = self._objects.get(key)
+            if stored is None:
+                raise NotFound(f"{key}")
+            sent = obj.get("metadata", {}).get("resourceVersion")
+            held = stored.get("metadata", {}).get("resourceVersion")
+            if sent is not None and sent != held:
+                raise Conflict(
+                    f"{key}: resourceVersion {sent} != {held}")
+            if obj == stored:
+                # No-change PUT: no version bump, no event (see patch).
+                return copy.deepcopy(stored)
+            new = copy.deepcopy(obj)
+            self._revision += 1
+            new.setdefault("metadata", {})["resourceVersion"] = str(
+                self._revision)
+            self._objects[key] = new
+            self._record("MODIFIED", new)
+            return copy.deepcopy(new)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
             key = (kind, namespace, name)
             if key not in self._objects:
                 raise NotFound(f"{kind} {namespace}/{name}")
-            del self._objects[key]
+            gone = self._objects.pop(key)
+            self._revision += 1
+            self._record("DELETED", gone)
+
+    # -- watch ------------------------------------------------------------
+
+    def current_revision(self) -> int:
+        with self._lock:
+            return self._revision
+
+    def list_with_version(self, kind: str, namespace: Optional[str] = None,
+                          label_selector: Optional[Dict[str, str]] = None
+                          ) -> Tuple[List[Dict[str, Any]], int]:
+        """(items, revision horizon) under one lock acquisition —
+        watching from the returned revision replays exactly the
+        events after this list (same contract as HttpApiClient)."""
+        with self._lock:
+            return self.list(kind, namespace, label_selector), \
+                self._revision
+
+    def watch(self, kind: str, namespace: Optional[str] = None,
+              resource_version: int = 0,
+              stop: Optional[threading.Event] = None,
+              timeout: Optional[float] = None,
+              label_selector: Optional[Dict[str, Optional[str]]] = None,
+              ) -> Iterator[Tuple[str, Dict[str, Any]]]:
+        """Stream (event_type, object) for ``kind`` after
+        ``resource_version``, blocking for new events until ``stop``
+        is set (or ``timeout`` elapses with no event — which ends the
+        stream like a server-side watch timeout). Raises Gone when the
+        requested version predates the retained window, mirroring the
+        apiserver's 410. ``label_selector`` matches like ``list``
+        (None values = key existence)."""
+        last = resource_version
+        while stop is None or not stop.is_set():
+            with self._cond:
+                if (self._events
+                        and last < self._events[0][0] - 1
+                        and last < self._revision):
+                    raise Gone(f"resourceVersion {last} compacted")
+                pending = [e for e in self._events if e[0] > last]
+                if not pending:
+                    if not self._cond.wait(timeout=timeout or 0.5):
+                        if timeout is not None:
+                            return  # server-side watch timeout
+                    continue
+            for rev, event_type, obj in pending:
+                last = rev
+                if obj.get("kind") != kind:
+                    continue
+                ns = obj.get("metadata", {}).get("namespace", "default")
+                if namespace is not None and ns != namespace:
+                    continue
+                if not _labels_match(obj, label_selector):
+                    continue
+                yield event_type, copy.deepcopy(obj)
 
     # -- test helpers -----------------------------------------------------
 

@@ -1,0 +1,205 @@
+"""A real-socket HTTP facade over FakeApiServer: the k8s REST subset
+the operator's HttpApiClient speaks (typed paths, list/watch
+semantics, optimistic-concurrency PUT, the 404/409/410 taxonomy).
+
+Lets tests drive the PRODUCTION client — urllib request building,
+streaming watch parsing, error mapping — over an actual HTTP
+connection instead of injecting the fake directly (closing the r4
+weakness: the client layer was the one place prod and test behavior
+could diverge).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubeflow_tpu.operator.fake import (
+    Conflict,
+    FakeApiServer,
+    Gone,
+    NotFound,
+)
+
+_PLURAL_TO_KIND = {
+    "tpujobs": "TPUJob",
+    "pods": "Pod",
+    "services": "Service",
+    "poddisruptionbudgets": "PodDisruptionBudget",
+    "events": "Event",
+    "configmaps": "ConfigMap",
+}
+
+
+def _parse_selector(query):
+    """labelSelector → dict; ``key`` (no =) is existence → None value,
+    matching FakeApiServer._labels_match."""
+    if "labelSelector" not in query:
+        return None
+    out = {}
+    for pair in query["labelSelector"][0].split(","):
+        key, eq, value = pair.partition("=")
+        out[key] = value if eq else None
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.0: close-delimited bodies, so the watch stream needs no
+    # chunked framing — urllib reads lines as they flush.
+    protocol_version = "HTTP/1.0"
+
+    @property
+    def fake(self) -> FakeApiServer:
+        return self.server.fake  # type: ignore[attr-defined]
+
+    def log_message(self, *args):  # quiet test output
+        pass
+
+    def _parse(self):
+        """path → (kind, namespace, name, subresource, query)."""
+        parsed = urllib.parse.urlsplit(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        parts = [p for p in parsed.path.split("/") if p]
+        # /api/v1/... or /apis/<group>/<version>/...
+        parts = parts[2:] if parts[0] == "api" else parts[3:]
+        namespace = name = subresource = None
+        if parts and parts[0] == "namespaces":
+            namespace = parts[1]
+            parts = parts[2:]
+        plural = parts[0] if parts else ""
+        if len(parts) > 1:
+            name = parts[1]
+        if len(parts) > 2:
+            subresource = parts[2]
+        kind = _PLURAL_TO_KIND.get(plural)
+        return kind, namespace, name, subresource, query
+
+    def _send(self, code: int, body: dict) -> None:
+        payload = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _error(self, code: int, message: str) -> None:
+        self._send(code, {"kind": "Status", "code": code,
+                          "message": message})
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length)) if length else {}
+
+    def _authorized(self) -> bool:
+        token = getattr(self.server, "token", None)
+        if not token:
+            return True
+        return self.headers.get("Authorization") == f"Bearer {token}"
+
+    # -- verbs ------------------------------------------------------------
+
+    def do_GET(self):
+        if not self._authorized():
+            return self._error(401, "bad bearer token")
+        kind, ns, name, _, query = self._parse()
+        if kind is None:
+            return self._error(404, "unknown resource")
+        if name is not None:
+            try:
+                return self._send(200, self.fake.get(kind, ns, name))
+            except NotFound as err:
+                return self._error(404, str(err))
+        if query.get("watch", ["0"])[0] in ("1", "true"):
+            return self._watch(kind, ns, query)
+        items, version = self.fake.list_with_version(
+            kind, ns, _parse_selector(query))
+        return self._send(200, {
+            "kind": f"{kind}List",
+            "items": items,
+            "metadata": {"resourceVersion": str(version)},
+        })
+
+    def _watch(self, kind, ns, query):
+        version = int(query.get("resourceVersion", ["0"])[0] or 0)
+        timeout = float(query.get("timeoutSeconds", ["5"])[0])
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+
+        def emit(event: dict) -> None:
+            self.wfile.write(json.dumps(event).encode() + b"\n")
+            self.wfile.flush()
+
+        try:
+            for event_type, obj in self.fake.watch(
+                    kind, ns, resource_version=version, timeout=timeout,
+                    label_selector=_parse_selector(query)):
+                emit({"type": event_type, "object": obj})
+        except Gone as err:
+            emit({"type": "ERROR",
+                  "object": {"kind": "Status", "code": 410,
+                             "message": str(err)}})
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client hung up
+
+    def do_POST(self):
+        if not self._authorized():
+            return self._error(401, "bad bearer token")
+        try:
+            return self._send(201, self.fake.create(self._body()))
+        except Conflict as err:
+            return self._error(409, str(err))
+
+    def do_PUT(self):
+        if not self._authorized():
+            return self._error(401, "bad bearer token")
+        kind, ns, name, subresource, _ = self._parse()
+        if subresource not in (None, "status"):
+            # Only the declared status subresource exists (the CRD
+            # declares subresources.status; anything else 404s on a
+            # real apiserver).
+            return self._error(404, f"no subresource {subresource}")
+        obj = self._body()
+        # Status subresource PUTs replace the whole object here (the
+        # fake stores status inline).
+        try:
+            return self._send(200, self.fake.replace(obj))
+        except NotFound as err:
+            return self._error(404, str(err))
+        except Conflict as err:
+            return self._error(409, str(err))
+
+    def do_DELETE(self):
+        if not self._authorized():
+            return self._error(401, "bad bearer token")
+        kind, ns, name, _, _ = self._parse()
+        try:
+            self.fake.delete(kind, ns, name)
+            return self._send(200, {"kind": "Status", "status": "Success"})
+        except NotFound as err:
+            return self._error(404, str(err))
+
+
+class HttpFakeApiServer:
+    """ThreadingHTTPServer wrapper; use as a context manager."""
+
+    def __init__(self, fake: FakeApiServer = None, token: str = ""):
+        self.fake = fake or FakeApiServer()
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self.server.fake = self.fake  # type: ignore[attr-defined]
+        self.server.token = token  # type: ignore[attr-defined]
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        self.token = token
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+
+    def __enter__(self) -> "HttpFakeApiServer":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        self._thread.join(timeout=5)

@@ -1,0 +1,401 @@
+"""Watch-driven operator (VERDICT-r4 next #2): event streams with
+resourceVersion resume on the fake apiserver, the informer-style
+controller's sub-second reaction, the relist safety net, the
+production stdlib-HTTP client driven over REAL sockets (REST + a
+streaming watch against an HTTP facade of the fake), and event-driven
+chaos fuzz.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.manifests.tpujob import KIND
+from kubeflow_tpu.operator import FakeApiServer
+from kubeflow_tpu.operator.controller import WatchController
+from kubeflow_tpu.operator.fake import Conflict, Gone, NotFound
+from kubeflow_tpu.operator.http_client import HttpApiClient
+from kubeflow_tpu.operator.reconciler import JOB_LABEL
+
+from tests._http_apiserver import HttpFakeApiServer
+from tests.test_operator import make_job, submit
+
+
+def _collect(api, kind, n, resource_version=0, timeout=5.0):
+    """First n watch events of `kind` (helper thread + join)."""
+    out = []
+    stop = threading.Event()
+
+    def run():
+        for event in api.watch(kind, resource_version=resource_version,
+                               stop=stop):
+            out.append(event)
+            if len(out) >= n:
+                return
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout)
+    stop.set()
+    return out
+
+
+# -- fake watch semantics -------------------------------------------------
+
+
+def test_fake_watch_streams_and_resumes():
+    api = FakeApiServer()
+    job = make_job(name="w1", workers=1)
+    api.create(job)
+    events = _collect(api, KIND, 1)
+    assert [(t, o["metadata"]["name"]) for t, o in events] == \
+        [("ADDED", "w1")]
+    horizon = int(events[0][1]["metadata"]["resourceVersion"])
+
+    api.patch(KIND, "default", "w1",
+              lambda o: o.setdefault("status", {}).update({"phase": "X"}))
+    api.delete(KIND, "default", "w1")
+    # Resume AFTER the ADDED: exactly the two later events replay.
+    events = _collect(api, KIND, 2, resource_version=horizon)
+    assert [t for t, _ in events] == ["MODIFIED", "DELETED"]
+
+
+def test_fake_watch_filters_kind_and_namespace():
+    api = FakeApiServer()
+    api.create({"kind": "Pod", "metadata": {"name": "p", "namespace": "a",
+                                            "labels": {}}})
+    api.create(make_job(name="w2", workers=1))
+    events = _collect(api, "Pod", 1)
+    assert events[0][1]["metadata"]["name"] == "p"
+    assert _collect(api, "Pod", 1, timeout=0.5,
+                    resource_version=api.current_revision()) == []
+
+
+def test_fake_watch_gone_on_compacted_version(monkeypatch):
+    monkeypatch.setattr(FakeApiServer, "EVENT_WINDOW", 2)
+    api = FakeApiServer()
+    for i in range(5):
+        api.create({"kind": "Pod",
+                    "metadata": {"name": f"p{i}", "namespace": "a"}})
+    with pytest.raises(Gone):
+        list(api.watch("Pod", resource_version=1, timeout=0.1))
+
+
+# -- watch controller -----------------------------------------------------
+
+
+@pytest.fixture()
+def controller_on(request):
+    """Start a WatchController over an api in a thread; stop at exit."""
+
+    def start(api, **kwargs):
+        ctl = WatchController(api, relist_seconds=kwargs.pop(
+            "relist_seconds", 30.0), **kwargs)
+        t = threading.Thread(target=ctl.run, daemon=True)
+        t.start()
+        request.addfinalizer(lambda: (ctl.stop.set(), t.join(timeout=10)))
+        return ctl
+
+    return start
+
+
+def _wait_for(predicate, timeout=5.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_watch_controller_subsecond_reaction(controller_on):
+    """The r4 poll loop reacted in up to resync_seconds (5 s); the
+    watch controller must react to job creation AND to a pod failure
+    in event latency — asserted here at well under a second each."""
+    api = FakeApiServer()
+    controller_on(api)
+
+    t0 = time.monotonic()
+    submit(api, make_job(name="wjob", workers=2))
+    assert _wait_for(lambda: len(
+        api.list("Pod", "default", {JOB_LABEL: "wjob"})) == 2, 1.0), \
+        "gang not created within 1s of the TPUJob event"
+    created_in = time.monotonic() - t0
+
+    api.set_all_pod_phases("default", "Running", {JOB_LABEL: "wjob"})
+    assert _wait_for(lambda: api.get(KIND, "default", "wjob")
+                     .get("status", {}).get("phase") == "Running", 1.0)
+
+    t1 = time.monotonic()
+    api.set_pod_phase("default", "wjob-tpu-worker-1", "Failed")
+    assert _wait_for(lambda: api.get(KIND, "default", "wjob")
+                     .get("status", {}).get("restartCount", 0) == 1, 1.0), \
+        "slice fault not reacted to within 1s of the pod event"
+    reacted_in = time.monotonic() - t1
+    # Both reactions are event-driven, not resync-period-driven.
+    assert created_in < 1.0 and reacted_in < 1.0, (created_in, reacted_in)
+
+
+def test_watch_controller_relist_fallback_survives_broken_watch(
+        controller_on):
+    """Watch streams can drop events (compaction, restarts); the
+    periodic relist must still converge the world. Break watch()
+    entirely — the controller's only signal is the relist."""
+    api = FakeApiServer()
+
+    def broken_watch(*a, **k):
+        raise RuntimeError("watch transport down")
+        yield  # pragma: no cover
+
+    api.watch = broken_watch
+    controller_on(api, relist_seconds=0.2)
+    submit(api, make_job(name="rjob", workers=1))
+    assert _wait_for(lambda: len(
+        api.list("Pod", "default", {JOB_LABEL: "rjob"})) == 1, 5.0), \
+        "relist fallback never reconciled the job"
+
+
+# -- production HTTP client over real sockets -----------------------------
+
+
+def test_http_client_store_surface_and_taxonomy():
+    with HttpFakeApiServer(token="sekret") as srv:
+        client = HttpApiClient(srv.url, token="sekret")
+        job = make_job(name="hjob", workers=1)
+        created = client.create(job)
+        assert created["metadata"]["name"] == "hjob"
+        with pytest.raises(Conflict):
+            client.create(job)
+
+        got = client.get(KIND, "default", "hjob")
+        assert got["spec"]["replicaSpecs"]
+
+        client.patch(KIND, "default", "hjob",
+                     lambda o: o.setdefault("status", {}).update(
+                         {"phase": "Running"}))
+        assert client.get(KIND, "default", "hjob")["status"]["phase"] == \
+            "Running"
+
+        items, version = client.list_with_version(KIND, "default")
+        assert [i["metadata"]["name"] for i in items] == ["hjob"]
+        assert version > 0
+        # Label selectors ride the query string.
+        srv.fake.create({"kind": "Pod", "metadata": {
+            "name": "lp", "namespace": "default",
+            "labels": {JOB_LABEL: "hjob"}}})
+        assert [p["metadata"]["name"] for p in client.list(
+            "Pod", "default", {JOB_LABEL: "hjob"})] == ["lp"]
+
+        client.delete("Pod", "default", "lp")
+        with pytest.raises(NotFound):
+            client.get("Pod", "default", "lp")
+        with pytest.raises(NotFound):
+            client.delete("Pod", "default", "lp")
+
+        # Bad token → RuntimeError (401), not silent success.
+        with pytest.raises(RuntimeError):
+            HttpApiClient(srv.url, token="wrong").get(
+                KIND, "default", "hjob")
+
+
+def test_http_client_optimistic_concurrency_conflict():
+    """Two writers read the same resourceVersion; the slower PUT must
+    Conflict (the reconciler's retry taxonomy), not lose the update."""
+    with HttpFakeApiServer() as srv:
+        client = HttpApiClient(srv.url)
+        client.create(make_job(name="cjob", workers=1))
+
+        def racing_mutate(obj):
+            # Interleave: another writer commits AFTER our read.
+            srv.fake.patch(KIND, "default", "cjob",
+                           lambda o: o.setdefault("status", {}).update(
+                               {"phase": "Sneaky"}))
+            obj.setdefault("status", {})["phase"] = "Mine"
+
+        with pytest.raises(Conflict):
+            client.patch(KIND, "default", "cjob", racing_mutate)
+
+
+def test_http_client_watch_stream_and_gone():
+    with HttpFakeApiServer() as srv:
+        client = HttpApiClient(srv.url)
+        client.create(make_job(name="wjob", workers=1))
+        events = list(client.watch(KIND, "default", timeout=1))
+        assert [(t, o["metadata"]["name"]) for t, o in events] == \
+            [("ADDED", "wjob")]
+        # Compacted resume point → Gone surfaced from the ERROR event.
+        srv.fake.EVENT_WINDOW = 1
+        for i in range(4):
+            srv.fake.create({"kind": "Pod", "metadata": {
+                "name": f"p{i}", "namespace": "default"}})
+        with pytest.raises(Gone):
+            list(client.watch("Pod", "default", resource_version=1,
+                              timeout=1))
+
+
+def test_watch_controller_end_to_end_over_http(controller_on):
+    """The full production stack minus the real apiserver: reconciler
+    → WatchController → HttpApiClient → HTTP socket → store. Job
+    creation and slice fault both flow through the wire."""
+    with HttpFakeApiServer(token="t0k") as srv:
+        client = HttpApiClient(srv.url, token="t0k")
+        controller_on(client)
+        submit(client, make_job(name="ejob", workers=2))
+        assert _wait_for(lambda: len(srv.fake.list(
+            "Pod", "default", {JOB_LABEL: "ejob"})) == 2, 5.0)
+        srv.fake.set_all_pod_phases("default", "Running",
+                                    {JOB_LABEL: "ejob"})
+        assert _wait_for(
+            lambda: srv.fake.get(KIND, "default", "ejob")
+            .get("status", {}).get("phase") == "Running", 5.0)
+        srv.fake.set_pod_phase("default", "ejob-tpu-worker-0", "Failed")
+        assert _wait_for(
+            lambda: srv.fake.get(KIND, "default", "ejob")
+            .get("status", {}).get("restartCount", 0) == 1, 5.0)
+
+
+def test_crd_declares_status_subresource():
+    """The operator writes status through /status (kubectl
+    --subresource and the HTTP client's PUT); a CRD without
+    subresources.status makes the real apiserver 404 that endpoint —
+    and _set_status swallows NotFound, silently dropping every status
+    update (r5 review finding)."""
+    from kubeflow_tpu.manifests.tpujob import crd
+
+    version = crd()["spec"]["versions"][0]
+    assert version["subresources"] == {"status": {}}
+
+
+def test_noop_status_write_emits_no_event():
+    """Steady state must be quiescent: re-writing an identical status
+    bumps nothing and emits nothing — otherwise the controller's own
+    status write would re-enqueue the job it just reconciled, forever
+    (r5 review finding)."""
+    api = FakeApiServer()
+    submit(api, make_job(name="q", workers=1))
+    rev = api.current_revision()
+
+    def same_status(obj):
+        obj.setdefault("status", {}).update({"phase": "Pending"})
+
+    api.patch(KIND, "default", "q", same_status)
+    first_write = api.current_revision()
+    assert first_write > rev  # real change: event
+    api.patch(KIND, "default", "q", same_status)
+    assert api.current_revision() == first_write  # no-op: no event
+    assert _collect(api, KIND, 1, resource_version=first_write,
+                    timeout=0.3) == []
+
+
+def test_watch_controller_is_quiescent_at_steady_state(controller_on):
+    """With no-op suppression in place, a Running job generates zero
+    further events: the controller must go idle (no reconcile churn),
+    observable as a frozen store revision."""
+    api = FakeApiServer()
+    controller_on(api)
+    submit(api, make_job(name="idle", workers=1))
+    assert _wait_for(lambda: len(
+        api.list("Pod", "default", {JOB_LABEL: "idle"})) == 1, 2.0)
+    api.set_all_pod_phases("default", "Running", {JOB_LABEL: "idle"})
+    assert _wait_for(lambda: api.get(KIND, "default", "idle")
+                     .get("status", {}).get("phase") == "Running", 2.0)
+    time.sleep(0.3)  # several event-latency periods
+    rev = api.current_revision()
+    time.sleep(0.5)
+    assert api.current_revision() == rev, \
+        "controller churned events at steady state"
+
+
+def test_pod_watch_is_label_bounded():
+    """The operator's pod watch/list must be selector-bounded: it
+    scales with gang count, not with unrelated cluster churn (r5
+    review finding). Presence selectors work over the wire too."""
+    api = FakeApiServer()
+    api.create({"kind": "Pod", "metadata": {
+        "name": "unrelated", "namespace": "default", "labels": {}}})
+    api.create({"kind": "Pod", "metadata": {
+        "name": "ours", "namespace": "default",
+        "labels": {JOB_LABEL: "j"}}})
+    assert [p["metadata"]["name"] for p in api.list(
+        "Pod", "default", {JOB_LABEL: None})] == ["ours"]
+    # And over HTTP: labelSelector=key (existence, no '=').
+    with HttpFakeApiServer(fake=api) as srv:
+        client = HttpApiClient(srv.url)
+        assert [p["metadata"]["name"] for p in client.list(
+            "Pod", "default", {JOB_LABEL: None})] == ["ours"]
+        events = list(client.watch(
+            "Pod", "default", timeout=0.5,
+            label_selector={JOB_LABEL: None}))
+        assert [o["metadata"]["name"] for _, o in events] == ["ours"]
+
+
+# -- event-driven chaos fuzz ----------------------------------------------
+
+
+def test_watch_controller_fuzz_event_driven(controller_on):
+    """The r4 fuzz drove reconcile() synchronously; event delivery
+    adds a new interleaving class (events landing while a pass is
+    mid-flight). Chaos-mutate pod phases under a LIVE controller,
+    sample the safety invariants, then require liveness: once chaos
+    stops, the job reaches a terminal phase and stays there."""
+    import random
+
+    for seed in range(12):
+        rng = random.Random(seed)
+        api = FakeApiServer()
+        max_restarts = rng.randint(0, 3)
+        from kubeflow_tpu.operator.reconciler import Reconciler
+
+        ctl = WatchController(
+            api, relist_seconds=0.3,
+            reconciler=Reconciler(api, max_restarts=max_restarts))
+        t = threading.Thread(target=ctl.run, daemon=True)
+        t.start()
+        try:
+            submit(api, make_job(name="fz", workers=rng.randint(1, 3),
+                                 recovery="restart-slice"))
+            prev_restarts = 0
+            for _ in range(rng.randint(10, 25)):
+                pods = api.list("Pod", "default", {JOB_LABEL: "fz"})
+                roll = rng.random()
+                if pods and roll < 0.6:
+                    victim = rng.choice(pods)["metadata"]["name"]
+                    try:
+                        api.set_pod_phase(
+                            "default", victim,
+                            rng.choice(("Pending", "Running",
+                                        "Succeeded", "Failed")))
+                    except NotFound:
+                        pass  # reconciler deleted it mid-roll
+                elif pods and roll < 0.8:
+                    try:
+                        api.delete("Pod", "default",
+                                   rng.choice(pods)["metadata"]["name"])
+                    except NotFound:
+                        pass
+                time.sleep(rng.random() * 0.02)
+                status = api.get(KIND, "default", "fz").get("status", {})
+                restarts = int(status.get("restartCount", 0))
+                assert restarts <= max_restarts
+                assert restarts >= prev_restarts  # monotone
+                prev_restarts = restarts
+
+            # Liveness: chaos over; drive every pod that appears to
+            # Succeeded until the job goes terminal.
+            def terminal():
+                api.set_all_pod_phases("default", "Succeeded",
+                                       {JOB_LABEL: "fz"})
+                return api.get(KIND, "default", "fz").get(
+                    "status", {}).get("phase") in ("Succeeded", "Failed")
+
+            assert _wait_for(terminal, 15.0, interval=0.05), seed
+            phase = api.get(KIND, "default", "fz")["status"]["phase"]
+            time.sleep(0.5)  # controller keeps running; must not move
+            assert api.get(KIND, "default", "fz")["status"]["phase"] == \
+                phase, seed
+        finally:
+            ctl.stop.set()
+            t.join(timeout=10)
