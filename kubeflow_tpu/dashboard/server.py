@@ -1,3 +1,17 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
 """TPUJob dashboard: REST + HTML view AND write path for TPUJobs.
 
 The reference deployed a TFJob dashboard backend + UI behind Ambassador
@@ -12,6 +26,12 @@ and DELETE jobs, not just list them. This is its TPUJob equivalent:
                                          validated against the CRD's
                                          openAPIV3 schema)
   GET    /tpujobs/api/tpujob/<ns>/<name> one TPUJob + its gang pods
+                                         (per-replica phase/slice/exit
+                                         code/drained + conditions)
+  GET    /tpujobs/api/tpujob/<ns>/<name>/logs/<pod>?tail=N
+                                         recent log tail, proxied
+                                         through the apiserver client
+  GET    /tpujobs/ui/job/<ns>/<name>     HTML per-pod drill-down
   DELETE /tpujobs/api/tpujob/<ns>/<name> delete the job + its gang
   GET    /tpujobs/api/traces             profiler runs under --trace_root
                                          (XPlane dirs; SURVEY §5's
@@ -49,13 +69,52 @@ def job_summary(job: Dict[str, Any]) -> Dict[str, Any]:
         spec.get("replicaType", "?"): spec.get("replicas", 0)
         for spec in job.get("spec", {}).get("replicaSpecs", [])
     }
+    # The active condition's transition is "when did the job last
+    # change state" — the reference UI's per-job timeline anchor.
+    active = next((c for c in status.get("conditions", [])
+                   if c.get("status") == "True"), {})
     return {
         "name": meta.get("name", ""),
         "namespace": meta.get("namespace", ""),
         "phase": status.get("phase", "Pending"),
         "restartCount": status.get("restartCount", 0),
         "replicas": replicas,
+        "numSlices": int(job.get("spec", {}).get("numSlices", 1) or 1),
+        "lastTransitionTime": active.get("lastTransitionTime", ""),
+        "reason": status.get("reason", ""),
         "creationTimestamp": meta.get("creationTimestamp", ""),
+    }
+
+
+def pod_summary(pod: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-replica drill-down row (parity: the reference UI backend's
+    per-replica views, ``kubeflow/core/tf-job.libsonnet:271-458``)."""
+    from kubeflow_tpu.operator.reconciler import (
+        REPLICA_INDEX_LABEL,
+        REPLICA_TYPE_LABEL,
+        SLICE_INDEX_LABEL,
+        pod_drained,
+    )
+
+    meta = pod.get("metadata", {})
+    labels = meta.get("labels", {})
+    status = pod.get("status", {})
+    exit_code = None
+    container_restarts = 0
+    for cs in status.get("containerStatuses", []):
+        container_restarts += int(cs.get("restartCount", 0))
+        term = (cs.get("state") or {}).get("terminated")
+        if term and exit_code is None:
+            exit_code = term.get("exitCode")
+    return {
+        "name": meta.get("name", ""),
+        "phase": status.get("phase", "Unknown"),
+        "replicaType": labels.get(REPLICA_TYPE_LABEL, ""),
+        "replicaIndex": labels.get(REPLICA_INDEX_LABEL, ""),
+        "slice": labels.get(SLICE_INDEX_LABEL, "0"),
+        "exitCode": exit_code,
+        "drained": pod_drained(pod),
+        "containerRestarts": container_restarts,
     }
 
 
@@ -149,15 +208,14 @@ class JobDetailHandler(BaseHandler):
             return self.write_json(
                 {"error": f"{KIND} {namespace}/{name} not found"}, 404)
         pods = [
-            {
-                "name": p["metadata"]["name"],
-                "phase": p.get("status", {}).get("phase", "Unknown"),
-            }
+            pod_summary(p)
             for p in await loop.run_in_executor(
                 None, lambda: self.api.list(
                     "Pod", namespace, label_selector={JOB_LABEL: name}))
         ]
         self.write_json({"job": job, "summary": job_summary(job),
+                         "conditions": job.get("status", {}).get(
+                             "conditions", []),
                          "pods": pods})
 
     async def delete(self, namespace: str, name: str):
@@ -189,6 +247,45 @@ class JobDetailHandler(BaseHandler):
             pass
         self.write_json({"deleted": f"{namespace}/{name}",
                          "pods_deleted": len(pods)})
+
+
+class PodLogsHandler(BaseHandler):
+    """Recent log tail of one gang pod, proxied through the apiserver
+    client (kubectl logs / GET pods/<name>/log) — the last piece of
+    the reference UI backend's per-replica view."""
+
+    async def get(self, namespace: str, name: str, pod: str):
+        from kubeflow_tpu.operator.fake import NotFound
+
+        try:
+            tail = int(self.get_query_argument("tail", "100"))
+        except ValueError:
+            return self.write_json({"error": "tail must be an int"}, 400)
+        tail = max(1, min(tail, 10_000))
+        loop = tornado.ioloop.IOLoop.current()
+        # Only pods of THIS job are served (the dashboard's RBAC is
+        # pods/log cluster-wide; the route contract is narrower). One
+        # GET, not a gang-sized LIST per click.
+        try:
+            obj = await loop.run_in_executor(
+                None, self.api.get, "Pod", namespace, pod)
+        except NotFound:
+            obj = None
+        if (obj is None or obj.get("metadata", {}).get("labels", {})
+                .get(JOB_LABEL) != name):
+            return self.write_json(
+                {"error": f"pod {pod} is not part of "
+                          f"{namespace}/{name}"}, 404)
+        try:
+            text = await loop.run_in_executor(
+                None, lambda: self.api.pod_logs(namespace, pod,
+                                                tail=tail))
+        except NotFound:
+            return self.write_json({"error": f"pod {pod} not found"}, 404)
+        except Exception as e:  # noqa: BLE001 — kubelet/apiserver side
+            return self.write_json({"error": str(e)}, 502)
+        self.set_header("Content-Type", "text/plain; charset=utf-8")
+        self.finish(text)
 
 
 class TraceListHandler(BaseHandler):
@@ -255,6 +352,107 @@ open with <code>tensorboard --logdir &lt;trace dir&gt;</code>
 """
 
 
+_DETAIL_PAGE = """<!doctype html>
+<html><head><title>TPUJob {name}</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem; }}
+ table {{ border-collapse: collapse; min-width: 48rem;
+          margin-bottom: 1.5rem; }}
+ th, td {{ text-align: left; padding: .4rem .9rem;
+          border-bottom: 1px solid #d0d7de; }}
+ th {{ background: #f6f8fa; }}
+ .phase {{ font-weight: 600; }}
+</style></head>
+<body>
+<p><a href="/tpujobs/ui/">&larr; all jobs</a></p>
+<h1>{name} <small style="color:{phase_color}">{phase}</small></h1>
+<p>{namespace} &middot; restarts {restarts} &middot; slices {slices}
+&middot; last transition {transition} {reason}</p>
+<h2>Replicas</h2>
+<table>
+<tr><th>Pod</th><th>Slice</th><th>Type</th><th>Index</th><th>Phase</th>
+<th>Exit</th><th>Logs</th></tr>
+{pod_rows}
+</table>
+<h2>Conditions</h2>
+<table>
+<tr><th>Type</th><th>Status</th><th>Last transition</th><th>Reason</th></tr>
+{cond_rows}
+</table>
+<p>JSON: <a href="{api}">{api}</a></p>
+</body></html>
+"""
+
+
+class UIJobDetailHandler(BaseHandler):
+    """HTML per-pod drill-down (the reference UI's job page)."""
+
+    async def get(self, namespace: str, name: str):
+        from kubeflow_tpu.operator.fake import NotFound
+
+        loop = tornado.ioloop.IOLoop.current()
+        try:
+            job = await loop.run_in_executor(
+                None, self.api.get, KIND, namespace, name)
+        except NotFound:
+            self.set_status(404)
+            return self.finish(f"TPUJob {namespace}/{name} not found")
+        summary = job_summary(job)
+        pods = [pod_summary(p) for p in await loop.run_in_executor(
+            None, lambda: self.api.list(
+                "Pod", namespace, label_selector={JOB_LABEL: name}))]
+        def _num(s: str) -> int:
+            return int(s) if s.isdigit() else 0
+
+        pods.sort(key=lambda p: (_num(p["slice"]), p["replicaType"],
+                                 _num(p["replicaIndex"])))
+        pod_rows = []
+        for p in pods:
+            color = _PHASE_COLORS.get(p["phase"], "#57606a")
+            exit_txt = "-" if p["exitCode"] is None else str(p["exitCode"])
+            if p["drained"]:
+                exit_txt += " (drained)"
+            logs = (f"/tpujobs/api/tpujob/{namespace}/{name}/logs/"
+                    f"{p['name']}?tail=100")
+            pod_rows.append(
+                "<tr>"
+                f"<td><code>{html.escape(p['name'])}</code></td>"
+                f"<td>{html.escape(p['slice'])}</td>"
+                f"<td>{html.escape(p['replicaType'])}</td>"
+                f"<td>{html.escape(p['replicaIndex'])}</td>"
+                f"<td class=\"phase\" style=\"color:{color}\">"
+                f"{html.escape(p['phase'])}</td>"
+                f"<td>{html.escape(exit_txt)}</td>"
+                f"<td><a href=\"{html.escape(logs)}\">tail</a></td>"
+                "</tr>")
+        cond_rows = []
+        for c in job.get("status", {}).get("conditions", []):
+            cond_rows.append(
+                "<tr>"
+                f"<td>{html.escape(c.get('type', ''))}</td>"
+                f"<td>{html.escape(c.get('status', ''))}</td>"
+                f"<td>{html.escape(c.get('lastTransitionTime', ''))}</td>"
+                f"<td>{html.escape(c.get('reason', ''))}</td>"
+                "</tr>")
+        self.set_header("Content-Type", "text/html; charset=utf-8")
+        self.finish(_DETAIL_PAGE.format(
+            name=html.escape(name),
+            namespace=html.escape(namespace),
+            phase=html.escape(summary["phase"]),
+            phase_color=_PHASE_COLORS.get(summary["phase"], "#57606a"),
+            restarts=int(summary["restartCount"]),
+            slices=int(summary["numSlices"]),
+            transition=html.escape(summary["lastTransitionTime"] or "-"),
+            reason=html.escape(
+                f"({summary['reason']})" if summary["reason"] else ""),
+            pod_rows="\n".join(pod_rows) or
+            "<tr><td colspan=7>no pods</td></tr>",
+            cond_rows="\n".join(cond_rows) or
+            "<tr><td colspan=4>none</td></tr>",
+            api=html.escape(f"/tpujobs/api/tpujob/{namespace}/{name}"),
+        ))
+
+
 class UIHandler(BaseHandler):
     async def get(self):
         from kubeflow_tpu.utils.traces import list_traces
@@ -268,7 +466,7 @@ class UIHandler(BaseHandler):
             replicas = ", ".join(
                 f"{html.escape(str(t))}×{int(n)}"
                 for t, n in sorted(j["replicas"].items()))
-            detail = (f"/tpujobs/api/tpujob/{j['namespace']}/{j['name']}")
+            detail = (f"/tpujobs/ui/job/{j['namespace']}/{j['name']}")
             rows.append(
                 "<tr>"
                 f"<td>{html.escape(j['namespace'])}</td>"
@@ -348,8 +546,11 @@ def make_app(api, trace_root: str = DEFAULT_TRACE_ROOT
         (r"/healthz", HealthHandler),
         (r"/tpujobs/api/tpujob", JobListHandler),
         (r"/tpujobs/api/tpujob/([^/]+)/([^/]+)", JobDetailHandler),
+        (r"/tpujobs/api/tpujob/([^/]+)/([^/]+)/logs/([^/]+)",
+         PodLogsHandler),
         (r"/tpujobs/api/traces", TraceListHandler),
         (r"/tpujobs/ui/?", UIHandler),
+        (r"/tpujobs/ui/job/([^/]+)/([^/]+)", UIJobDetailHandler),
         (r"/tpujobs/ui/create", UICreateHandler),
         (r"/", tornado.web.RedirectHandler, {"url": "/tpujobs/ui/"}),
     ], api=api, trace_root=trace_root)

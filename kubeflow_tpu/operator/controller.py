@@ -1,3 +1,17 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
 """Controller loops: drive the Reconciler against an apiserver.
 
 Primary mode is WATCH-driven (the reference's informer pattern — its
@@ -88,6 +102,11 @@ class KubectlClient:
     def delete(self, kind: str, namespace: str, name: str) -> None:
         self._run("delete", self._resource(kind), name, "-n", namespace,
                   "--wait=false")
+
+    def pod_logs(self, namespace: str, name: str, *,
+                 tail: int = 100) -> str:
+        return self._run("logs", name, "-n", namespace,
+                         f"--tail={tail}")
 
 
 class WatchController:

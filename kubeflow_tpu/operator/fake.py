@@ -1,3 +1,17 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
 """A minimal in-memory apiserver for hermetic operator tests.
 
 Implements just the object-store surface the reconciler needs
@@ -58,6 +72,8 @@ class FakeApiServer:
         # (revision, event_type, object snapshot) — the watch log.
         self._events: List[Tuple[int, str, Dict[str, Any]]] = []
         self._cond = threading.Condition(self._lock)
+        # (namespace, pod) → container log text (set_pod_log helper).
+        self._logs: Dict[Tuple[str, str], str] = {}
 
     def _record(self, event_type: str, obj: Dict[str, Any]) -> None:
         self._events.append((self._revision, event_type,
@@ -218,7 +234,24 @@ class FakeApiServer:
                     continue
                 yield event_type, copy.deepcopy(obj)
 
+    def pod_logs(self, namespace: str, name: str, *,
+                 tail: int = 100) -> str:
+        """Last ``tail`` log lines of a pod's container (the kubelet's
+        GET /pods/<name>/log surface; same method on the kubectl and
+        HTTP clients so the dashboard proxies logs through whichever
+        client it was given)."""
+        with self._lock:
+            if ("Pod", namespace, name) not in self._objects:
+                raise NotFound(f"Pod {namespace}/{name}")
+            text = self._logs.get((namespace, name), "")
+        lines = text.splitlines()
+        return "\n".join(lines[-tail:]) + ("\n" if lines else "")
+
     # -- test helpers -----------------------------------------------------
+
+    def set_pod_log(self, namespace: str, name: str, text: str) -> None:
+        with self._lock:
+            self._logs[(namespace, name)] = text
 
     def set_pod_phase(self, namespace: str, name: str, phase: str) -> None:
         self.patch("Pod", namespace, name,

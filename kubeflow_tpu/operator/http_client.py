@@ -1,3 +1,17 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
 """Stdlib HTTP client for the Kubernetes apiserver.
 
 The production surface of the watch-driven operator: no kubectl
@@ -183,6 +197,14 @@ class HttpApiClient:
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         self._json("DELETE", self._path(kind, namespace, name))
+
+    def pod_logs(self, namespace: str, name: str, *,
+                 tail: int = 100) -> str:
+        """GET the pod's log subresource (text/plain, not JSON)."""
+        url = (self._path("Pod", namespace, name, subresource="log")
+               + "?" + urllib.parse.urlencode({"tailLines": str(tail)}))
+        with self._request("GET", url) as resp:
+            return resp.read().decode(errors="replace")
 
     # -- watch ------------------------------------------------------------
 

@@ -1,4 +1,18 @@
 #!/usr/bin/env python3
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
 """Presubmit lint: syntax, import smoke, CLI boot, unused imports.
 
 The reference's presubmit gate was `make check` (boilerplate headers,
@@ -92,6 +106,75 @@ def check_cli_boots() -> list:
     return []
 
 
+# License boilerplate (parity: reference build/check_boilerplate.sh +
+# build/boilerplate/boilerplate.py wired at Makefile:15-18). Any
+# copyright year is accepted; `--fix-boilerplate` inserts the header
+# (after a shebang, before everything else).
+BOILERPLATE_YEAR_LINE = "Copyright {year} The kubeflow-tpu Authors."
+BOILERPLATE_BODY = [
+    "",
+    'Licensed under the Apache License, Version 2.0 (the "License");',
+    "you may not use this file except in compliance with the License.",
+    "You may obtain a copy of the License at",
+    "",
+    "    http://www.apache.org/licenses/LICENSE-2.0",
+    "",
+    "Unless required by applicable law or agreed to in writing, software",
+    'distributed under the License is distributed on an "AS IS" BASIS,',
+    "WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or "
+    "implied.",
+    "See the License for the specific language governing permissions and",
+    "limitations under the License.",
+]
+
+
+def _boilerplate_lines(comment: str, year: str = "2026") -> list:
+    lines = [BOILERPLATE_YEAR_LINE.format(year=year)] + BOILERPLATE_BODY
+    return [f"{comment} {line}".rstrip() for line in lines]
+
+
+def iter_boilerplate_files():
+    yield from iter_py_files()
+    for pattern in ("*.cc", "*.h"):
+        yield from sorted((REPO / "native").rglob(pattern))
+
+
+def _has_boilerplate(path: Path) -> bool:
+    comment = "//" if path.suffix in (".cc", ".h") else "#"
+    want = _boilerplate_lines(comment)
+    lines = path.read_text().splitlines()
+    if lines and lines[0].startswith("#!"):
+        lines = lines[1:]
+    if len(lines) < len(want):
+        return False
+    # First line: accept any copyright year.
+    if not (lines[0].startswith(f"{comment} Copyright ")
+            and lines[0].endswith("The kubeflow-tpu Authors.")):
+        return False
+    return lines[1:len(want)] == want[1:]
+
+
+def check_boilerplate(fix: bool = False) -> list:
+    errors = []
+    for f in iter_boilerplate_files():
+        if _has_boilerplate(f):
+            continue
+        if not fix:
+            errors.append(
+                f"boilerplate: {f.relative_to(REPO)} missing the "
+                f"Apache-2.0 header (scripts/lint.py --fix-boilerplate)")
+            continue
+        comment = "//" if f.suffix in (".cc", ".h") else "#"
+        header = "\n".join(_boilerplate_lines(comment)) + "\n\n"
+        text = f.read_text()
+        if text.startswith("#!"):
+            shebang, _, rest = text.partition("\n")
+            f.write_text(f"{shebang}\n{header}{rest}")
+        else:
+            f.write_text(header + text)
+    return errors
+
+
 def check_unused_imports() -> list:
     errors = []
     for f in iter_py_files():
@@ -145,9 +228,14 @@ def main() -> int:
 
     sync_platform_from_env()
 
+    if "--fix-boilerplate" in sys.argv:
+        check_boilerplate(fix=True)
+        print("boilerplate headers inserted where missing")
+        return 0
+
     errors = []
     for check in (check_syntax, check_imports_all_modules, check_cli_boots,
-                  check_unused_imports):
+                  check_unused_imports, check_boilerplate):
         found = check()
         print(f"{check.__name__}: {'ok' if not found else f'{len(found)} errors'}")
         errors.extend(found)

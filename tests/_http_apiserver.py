@@ -1,3 +1,17 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
 """A real-socket HTTP facade over FakeApiServer: the k8s REST subset
 the operator's HttpApiClient speaks (typed paths, list/watch
 semantics, optimistic-concurrency PUT, the 404/409/410 taxonomy).
@@ -103,9 +117,22 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if not self._authorized():
             return self._error(401, "bad bearer token")
-        kind, ns, name, _, query = self._parse()
+        kind, ns, name, subresource, query = self._parse()
         if kind is None:
             return self._error(404, "unknown resource")
+        if kind == "Pod" and subresource == "log":
+            tail = int(query.get("tailLines", ["100"])[0])
+            try:
+                text = self.fake.pod_logs(ns, name, tail=tail)
+            except NotFound as err:
+                return self._error(404, str(err))
+            payload = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
         if name is not None:
             try:
                 return self._send(200, self.fake.get(kind, ns, name))
