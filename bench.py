@@ -109,6 +109,34 @@ def main() -> int:
     except Exception as e:  # LM line is secondary; never sink the bench
         extra["lm_bench_error"] = str(e)[:200]
 
+    # Expert parallelism priced (VERDICT-r4 next #6): llama-moe-bench
+    # (8 experts, top-2) vs its FLOP-matched dense twin — the
+    # tokens/s ratio IS the router+dispatch+extra-HBM cost. Measured
+    # r5: 84.6 vs 83.0 ms/step (2% — dispatch effectively free at
+    # 8k tokens/step on one chip; the delta matches the extra HBM
+    # traffic of the 3.4× larger resident FFN parameter set, not
+    # router compute). PERF.md has the analysis.
+    try:
+        moe = run_lm_benchmark(LMBenchConfig(
+            model="llama-moe-bench" if on_tpu else "llama-moe-test",
+            batch_size=8, seq_len=1024 if on_tpu else 64,
+            steps=8 if on_tpu else 2, warmup_steps=2 if on_tpu else 1,
+            objective="causal"))
+        twin = run_lm_benchmark(LMBenchConfig(
+            model="llama-moe-dense-twin" if on_tpu else "llama-test",
+            batch_size=8, seq_len=1024 if on_tpu else 64,
+            steps=8 if on_tpu else 2, warmup_steps=2 if on_tpu else 1,
+            objective="causal"))
+        extra["moe_step_time_ms"] = round(moe["step_time_ms"], 2)
+        extra["moe_dense_twin_step_time_ms"] = round(
+            twin["step_time_ms"], 2)
+        extra["moe_dispatch_overhead_x"] = round(
+            moe["step_time_ms"] / twin["step_time_ms"], 3)
+        if "mfu_pct" in moe:
+            extra["moe_mfu_pct"] = moe["mfu_pct"]
+    except Exception as e:  # secondary line; never sink the bench
+        extra["moe_bench_error"] = str(e)[:200]
+
     # BASELINE.md stretch row: Llama-2-7B LoRA fine-tune on one chip
     # (frozen bf16 base + rank-16 adapters + remat fits 16 GB HBM).
     # Measured r2: 312 ms/step at B=1/L=1024 → ~3.3k tokens/s/chip.

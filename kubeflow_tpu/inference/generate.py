@@ -93,12 +93,7 @@ def _generate_jit(model, params, prompt_ids, rng, cache, *,
     b, prompt_len = prompt_ids.shape
 
     def sample(logits, step_rng):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits.astype(jnp.float32) / temperature
-        logits = _truncate_logits(logits, top_k, top_p)
-        return jax.random.categorical(
-            step_rng, logits, axis=-1).astype(jnp.int32)
+        return _sample_logits(logits, step_rng, temperature, top_k, top_p)
 
     def decode_step(carry, step_rng):
         cache, token, position, done = carry
@@ -135,6 +130,67 @@ def _generate_jit(model, params, prompt_ids, rng, cache, *,
     return tokens.swapaxes(0, 1), logits.swapaxes(0, 1)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "temperature", "eos_id", "top_k", "top_p"))
+def _prefill_jit(model, params, prompt_ids, first_rng, cache, *,
+                 temperature: float, eos_id: Optional[int],
+                 top_k: Optional[int], top_p: Optional[float]):
+    """Prompt pass + first sampled token (the chunked path's head)."""
+    b, prompt_len = prompt_ids.shape
+    positions = jnp.broadcast_to(
+        jnp.arange(prompt_len)[None, :], (b, prompt_len))
+    prefill_logits, mutated = model.apply(
+        {"params": params, "cache": cache}, prompt_ids, positions,
+        mutable=["cache"])
+    last_logits = prefill_logits[:, -1]
+    first = _sample_logits(last_logits, first_rng, temperature,
+                           top_k, top_p)
+    done = (first == eos_id) if eos_id is not None else \
+        jnp.zeros((b,), bool)
+    position = jnp.full((b,), prompt_len, jnp.int32)
+    return (mutated["cache"], first, position, done), last_logits
+
+
+def _sample_logits(logits, step_rng, temperature, top_k, top_p):
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    logits = _truncate_logits(logits, top_k, top_p)
+    return jax.random.categorical(
+        step_rng, logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "temperature", "eos_id", "top_k", "top_p"))
+def _decode_chunk_jit(model, params, carry, step_rngs, *,
+                      temperature: float, eos_id: Optional[int],
+                      top_k: Optional[int], top_p: Optional[float]):
+    """One K-token decode slice (K = step_rngs length, static by
+    shape). Same decode_step math as the monolithic scan; the carry
+    round-trips between slices."""
+    b = carry[1].shape[0]
+
+    def decode_step(c, step_rng):
+        cache, token, position, done = c
+        positions = jnp.broadcast_to(position[:, None], (b, 1))
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, token[:, None], positions,
+            mutable=["cache"])
+        logits = logits[:, 0]
+        next_token = _sample_logits(logits, step_rng, temperature,
+                                    top_k, top_p)
+        if eos_id is not None:
+            next_token = jnp.where(done, eos_id, next_token)
+            done = done | (next_token == eos_id)
+        return ((mutated["cache"], next_token, position + 1, done),
+                (next_token, logits))
+
+    carry, (tokens, logits) = jax.lax.scan(decode_step, carry, step_rngs)
+    return carry, tokens.swapaxes(0, 1), logits.swapaxes(0, 1)
+
+
 def generate(
     model: Any,
     params: Any,
@@ -146,6 +202,7 @@ def generate(
     eos_id: Optional[int] = None,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    chunk_tokens: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Generate ``max_new_tokens`` continuations of ``prompt_ids``.
 
@@ -156,15 +213,60 @@ def generate(
     (shapes stay static; callers trim). ``top_k``/``top_p`` truncate
     the sampling distribution (nucleus sampling); both only apply when
     ``temperature > 0``.
+
+    ``chunk_tokens`` — decode-slicing for SHARED executors (the
+    serving head-of-line fix, PERF.md r5): instead of one monolithic
+    dispatch whose multi-second decode monopolizes the device, decode
+    runs in K-token slices with a host sync between them, creating
+    yield points where concurrently-queued work (classify batches)
+    can interleave. Token output is identical to the monolithic path
+    (same per-step rng stream); cost is one dispatch per slice. None/
+    ``>= max_new_tokens`` = monolithic (the single-stream optimum,
+    and the only sensible choice over high-latency tunnels).
     """
     if model.cache_size < prompt_ids.shape[1] + max_new_tokens:
         raise ValueError(
             f"cache_size {model.cache_size} < prompt "
             f"{prompt_ids.shape[1]} + max_new_tokens {max_new_tokens}")
+    if chunk_tokens is not None and chunk_tokens < 1:
+        # A negative K would make the chunk count negative and
+        # silently truncate the output to the prefill token.
+        raise ValueError(f"chunk_tokens must be >= 1, got {chunk_tokens}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
     cache = init_cache(model, params, prompt_ids.shape[0])
-    return _generate_jit(model, params, prompt_ids, rng, cache,
-                         max_new_tokens=max_new_tokens,
-                         temperature=temperature, eos_id=eos_id,
-                         top_k=top_k, top_p=top_p)
+    if not chunk_tokens or chunk_tokens >= max_new_tokens:
+        return _generate_jit(model, params, prompt_ids, rng, cache,
+                             max_new_tokens=max_new_tokens,
+                             temperature=temperature, eos_id=eos_id,
+                             top_k=top_k, top_p=top_p)
+
+    # The SAME rng stream as the monolithic path (split once over
+    # max_new_tokens), padded to whole slices — padding steps produce
+    # trimmed tokens only, so outputs match bitwise.
+    step_rngs = jax.random.split(rng, max_new_tokens)
+    n_decode = max_new_tokens - 1
+    n_chunks = -(-n_decode // chunk_tokens)
+    pad = n_chunks * chunk_tokens - n_decode
+    decode_rngs = jnp.concatenate(
+        [step_rngs[1:]] + [step_rngs[-1:]] * pad) if pad else step_rngs[1:]
+    sample_kw = dict(temperature=temperature, eos_id=eos_id,
+                     top_k=top_k, top_p=top_p)
+    carry, last_logits = _prefill_jit(
+        model, params, prompt_ids, step_rngs[0], cache, **sample_kw)
+    tokens_out = [carry[1][:, None]]
+    logits_out = [last_logits[:, None]]
+    for c in range(n_chunks):
+        rngs = decode_rngs[c * chunk_tokens:(c + 1) * chunk_tokens]
+        carry, toks, logs = _decode_chunk_jit(
+            model, params, carry, rngs, **sample_kw)
+        tokens_out.append(toks)
+        logits_out.append(logs)
+        # The yield point: wait for THIS slice before dispatching the
+        # next, so the device queue drains and other requests' batches
+        # get a slot. (Without it, async dispatch would enqueue every
+        # slice back-to-back and re-monopolize the device.)
+        jax.block_until_ready(toks)
+    tokens = jnp.concatenate(tokens_out, axis=1)[:, :max_new_tokens]
+    logits = jnp.concatenate(logits_out, axis=1)[:, :max_new_tokens]
+    return tokens, logits

@@ -271,6 +271,25 @@ def llama_moe_test(**kw) -> Llama:
                  mlp_dim=128, **kw)
 
 
+def llama_moe_bench(**kw) -> Llama:
+    """Single-chip MoE pricing config: 8 experts, top-2. Its ACTIVE
+    FLOPs per token equal llama-moe-dense-twin's (2 selected experts
+    × mlp 3584 = the twin's dense mlp 7168), so the tokens/s ratio
+    between the two directly prices the router + capacity-dispatch
+    overhead (VERDICT-r4 next #6; bench.py extras, PERF.md)."""
+    kw.setdefault("vocab_size", 8192)
+    kw.setdefault("num_experts", 8)
+    return Llama(num_layers=4, d_model=1024, num_heads=16,
+                 num_kv_heads=8, mlp_dim=3584, **kw)
+
+
+def llama_moe_dense_twin(**kw) -> Llama:
+    """FLOP-matched dense twin of llama_moe_bench (see above)."""
+    kw.setdefault("vocab_size", 8192)
+    return Llama(num_layers=4, d_model=1024, num_heads=16,
+                 num_kv_heads=8, mlp_dim=7168, **kw)
+
+
 register_model(ModelEntry("llama2-7b", "language", llama2_7b, ((2048,), "int32"), 32000,
                           decoder=True))
 register_model(ModelEntry("llama2-13b", "language", llama2_13b, ((2048,), "int32"), 32000,
@@ -280,4 +299,9 @@ register_model(ModelEntry("llama3-8b", "language", llama3_8b, ((2048,), "int32")
 register_model(ModelEntry("llama-test", "language", llama_test, ((128,), "int32"), 512,
                           decoder=True))
 register_model(ModelEntry("llama-moe-test", "language", llama_moe_test, ((128,), "int32"), 512,
+                          decoder=True))
+register_model(ModelEntry("llama-moe-bench", "language", llama_moe_bench,
+                          ((1024,), "int32"), 8192, decoder=True))
+register_model(ModelEntry("llama-moe-dense-twin", "language",
+                          llama_moe_dense_twin, ((1024,), "int32"), 8192,
                           decoder=True))

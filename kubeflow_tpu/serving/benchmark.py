@@ -42,7 +42,7 @@ import tempfile
 import threading
 import time
 import urllib.request
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -68,6 +68,10 @@ class ServingBenchConfig:
     # ``:generate`` / gRPC Predict instead of ``:classify``:
     prompt_len: int = 32
     new_tokens: int = 16
+    # Decode-slicing: export generate with K-token slices (None =
+    # monolithic decode). The head-of-line mitigation measured by the
+    # mixed-load mode.
+    decode_chunk: Optional[int] = None
     # f32 keeps the toy-model latency comparisons exact; bf16 is the
     # real serving dtype and the only one a 7B fits a 16 GB chip in.
     model_dtype: str = "float32"
@@ -117,10 +121,13 @@ def _export(config: ServingBenchConfig) -> str:
         # prompt + new tokens, greedy decode baked at export).
         from kubeflow_tpu.serving.export_cli import _build_metadata
 
+        generate_config = {"max_new_tokens": config.new_tokens,
+                           "temperature": 0.0}
+        if config.decode_chunk:
+            generate_config["decode_chunk_tokens"] = config.decode_chunk
         meta = _build_metadata(
             "bench", config.model, get_model(config.model),
-            config.prompt_len, "generate",
-            {"max_new_tokens": config.new_tokens, "temperature": 0.0},
+            config.prompt_len, "generate", generate_config,
             {"dtype": config.model_dtype})
         module = get_model(config.model).make(dtype=config.model_dtype)
         ids = np.zeros((1, config.prompt_len), np.int32)
@@ -419,6 +426,133 @@ def _drive_measurements(config: ServingBenchConfig, model, transports,
     return result
 
 
+@dataclasses.dataclass
+class MixedLoadConfig:
+    """Classify + generate on ONE server/executor (VERDICT-r4 next
+    #5): each model has its own queue and batcher thread, but XLA
+    executions share the device — a multi-second decode can still
+    head-of-line-block millisecond classify batches at the executor.
+    This measures exactly that: classify p50/p99 alone vs while M
+    generate clients stream continuously."""
+
+    classify_model: str = "resnet-test"
+    image_hw: int = 32
+    generate_model: str = "llama-test"
+    prompt_len: int = 32
+    new_tokens: int = 64
+    classify_clients: int = 4
+    classify_requests: int = 40
+    generate_clients: int = 2
+    generate_requests: int = 8  # generate-alone phase, per client
+    max_batch: int = 8
+    model_dtype: str = "float32"
+    decode_chunk: Optional[int] = None  # K-token decode slices
+
+
+def run_mixed_load_benchmark(config: MixedLoadConfig) -> Dict[str, Any]:
+    import contextlib
+    import shutil
+
+    import grpc
+
+    from kubeflow_tpu.serving import wire
+    from kubeflow_tpu.serving.grpc_server import make_server
+    from kubeflow_tpu.serving.manager import ModelManager
+
+    cls_base = _export(ServingBenchConfig(
+        model=config.classify_model, image_hw=config.image_hw,
+        max_batch=config.max_batch, model_dtype=config.model_dtype))
+    gen_base = _export(ServingBenchConfig(
+        model=config.generate_model, prompt_len=config.prompt_len,
+        new_tokens=config.new_tokens, max_batch=config.max_batch,
+        model_dtype=config.model_dtype,
+        decode_chunk=config.decode_chunk))
+    manager = ModelManager(poll_interval_s=3600)
+    manager.add_model("cls", cls_base, max_batch=config.max_batch)
+    manager.add_model("gen", gen_base, max_batch=config.max_batch)
+    server, port = make_server(manager, 0)
+    server.start()
+    try:
+        rng = np.random.RandomState(7)
+        hw = config.image_hw
+        cls_request = wire.encode_predict_request("cls", {
+            "images": (rng.randint(0, 256, (1, hw, hw, 3)) / 255.0
+                       ).astype(np.float32)})
+        gen_request = wire.encode_predict_request("gen", {
+            "input_ids": rng.randint(
+                0, 128, (1, config.prompt_len)).astype(np.int32)})
+        with contextlib.closing(grpc.insecure_channel(
+                f"127.0.0.1:{port}")) as channel:
+            cls_fn = _grpc_request_fn(channel, cls_request, "scores")
+            gen_fn = _grpc_request_fn(channel, gen_request, "tokens")
+            for _ in range(3):  # compile both paths
+                cls_fn()
+                gen_fn()
+
+            gen_alone = _measure(gen_fn, config.generate_clients,
+                                 config.generate_requests)
+            cls_alone = _measure(cls_fn, config.classify_clients,
+                                 config.classify_requests)
+
+            # Mixed phase: M generate streamers run CONTINUOUSLY while
+            # the classify fleet is measured. A streamer dying
+            # mid-phase would silently measure an UNLOADED server and
+            # report degradation ~1.0 as if the problem were fixed —
+            # record failures and refuse to report over a dead load.
+            stop = threading.Event()
+            gen_done = [0] * config.generate_clients
+            gen_errors: List[str] = []
+
+            def streamer(i: int) -> None:
+                while not stop.is_set():
+                    try:
+                        gen_fn()
+                    except Exception as e:  # noqa: BLE001
+                        gen_errors.append(repr(e))
+                        return
+                    gen_done[i] += 1
+
+            streamers = [threading.Thread(target=streamer, args=(i,),
+                                          daemon=True)
+                         for i in range(config.generate_clients)]
+            t0 = time.perf_counter()
+            for t in streamers:
+                t.start()
+            cls_mixed = _measure(cls_fn, config.classify_clients,
+                                 config.classify_requests)
+            stop.set()
+            for t in streamers:
+                t.join(120)
+            gen_elapsed = time.perf_counter() - t0
+            assert not gen_errors, (
+                f"generate stream collapsed mid-measurement — the "
+                f"mixed numbers would describe an idle device: "
+                f"{gen_errors[:2]}")
+
+        return {
+            "classify_model": config.classify_model,
+            "generate_model": config.generate_model,
+            "new_tokens": config.new_tokens,
+            "decode_chunk": config.decode_chunk,
+            "generate_clients": config.generate_clients,
+            "classify_clients": config.classify_clients,
+            "generate_alone": gen_alone,
+            "classify_alone": cls_alone,
+            "classify_under_generate": cls_mixed,
+            "generate_rps_under_mix": round(sum(gen_done) / gen_elapsed,
+                                            2),
+            "classify_p99_degradation_x": round(
+                cls_mixed["p99_ms"] / max(cls_alone["p99_ms"], 1e-9), 2),
+            "classify_p50_degradation_x": round(
+                cls_mixed["p50_ms"] / max(cls_alone["p50_ms"], 1e-9), 2),
+        }
+    finally:
+        server.stop(grace=1)
+        manager.stop()
+        for base in (cls_base, gen_base):
+            shutil.rmtree(pathlib.Path(base).parent, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -445,7 +579,32 @@ def main(argv=None) -> int:
                              "toy comparisons exact)")
     parser.add_argument("--port", type=int, default=0,
                         help="0 = ephemeral")
+    parser.add_argument("--mixed", action="store_true",
+                        help="mixed-load mode: classify p50/p99 alone "
+                             "vs under a continuous generate stream "
+                             "(one server, shared executor); ignores "
+                             "--model/--transport")
+    parser.add_argument("--new_tokens_mixed", type=int, default=64,
+                        help="mixed mode: decode length per generate "
+                             "request")
+    parser.add_argument("--generate_clients", type=int, default=2,
+                        help="mixed mode: continuous generate streamers")
+    parser.add_argument("--decode_chunk", type=int, default=0,
+                        help="mixed mode: decode-slicing K (0 = "
+                             "monolithic decode)")
     args = parser.parse_args(argv)
+    if args.mixed:
+        result = run_mixed_load_benchmark(MixedLoadConfig(
+            classify_clients=args.clients,
+            classify_requests=args.requests_per_client,
+            generate_clients=args.generate_clients,
+            prompt_len=args.prompt_len,
+            new_tokens=args.new_tokens_mixed,
+            model_dtype=args.model_dtype,
+            decode_chunk=args.decode_chunk or None,
+        ))
+        print(json.dumps(result))
+        return 0
     rejection = _encoder_rejection(args.model)
     if rejection:
         # Same check run_serving_benchmark enforces, surfaced as an

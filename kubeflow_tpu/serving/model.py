@@ -116,6 +116,7 @@ class LoadedModel:
                     with self._gen_lock:
                         self._gen_counter += 1
                         rng = jax.random.fold_in(rng, self._gen_counter)
+                chunk = cfg.get("decode_chunk_tokens")
                 tokens, _ = generate(
                     module, variables["params"], x,
                     max_new_tokens=int(cfg.get("max_new_tokens", 32)),
@@ -123,7 +124,12 @@ class LoadedModel:
                     rng=rng,
                     eos_id=cfg.get("eos_id"),
                     top_k=cfg.get("top_k"),
-                    top_p=cfg.get("top_p"))
+                    top_p=cfg.get("top_p"),
+                    # Decode-slicing (PERF.md r5): K-token slices with
+                    # host sync between them, so classify batches on
+                    # the same executor interleave instead of queueing
+                    # behind the whole decode.
+                    chunk_tokens=int(chunk) if chunk else None)
                 return {"tokens": tokens}
 
             if method == "generate":

@@ -56,6 +56,44 @@ def test_greedy_generation_matches_full_forward():
         seq = np.concatenate([seq, expected[:, None]], axis=1)
 
 
+def test_chunked_decode_matches_monolithic():
+    """Decode-slicing (the serving head-of-line fix, PERF.md r5) must
+    be a pure scheduling change: tokens AND logits identical to the
+    monolithic scan — greedy and sampled, chunk sizes that divide the
+    decode and that don't (padded last slice), chunk 1 (the extreme)."""
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 5), 0, 512)
+    model = llama_test(dtype=jnp.float32, cache_size=32)
+    params = _params(llama_test(dtype=jnp.float32), prompt)
+
+    for temperature in (0.0, 0.8):
+        ref_t, ref_l = generate(model, params, prompt, max_new_tokens=9,
+                                temperature=temperature,
+                                rng=jax.random.PRNGKey(5))
+        for chunk in (1, 3, 4, 8, 9, 100):
+            t, l = generate(model, params, prompt, max_new_tokens=9,
+                            temperature=temperature,
+                            rng=jax.random.PRNGKey(5),
+                            chunk_tokens=chunk)
+            np.testing.assert_array_equal(
+                np.asarray(t), np.asarray(ref_t),
+                f"temp={temperature} chunk={chunk}")
+            np.testing.assert_allclose(
+                np.asarray(l), np.asarray(ref_l), atol=2e-4, rtol=2e-4)
+
+
+def test_chunked_decode_eos_latches_across_chunks():
+    """EOS latched in slice c must stay latched in slice c+1 (the
+    done flag rides the carry across dispatches)."""
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 3), 0, 512)
+    model = llama_test(dtype=jnp.float32, cache_size=24)
+    params = _params(llama_test(dtype=jnp.float32), prompt)
+    ref, _ = generate(model, params, prompt, max_new_tokens=8,
+                      eos_id=7)
+    t, _ = generate(model, params, prompt, max_new_tokens=8, eos_id=7,
+                    chunk_tokens=3)
+    np.testing.assert_array_equal(np.asarray(t), np.asarray(ref))
+
+
 def test_temperature_sampling_is_seeded_and_in_vocab():
     prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 4), 0, 512)
     model = llama_test(dtype=jnp.float32, cache_size=12)
