@@ -95,19 +95,8 @@ def _generate_jit(model, params, prompt_ids, rng, cache, *,
     def sample(logits, step_rng):
         return _sample_logits(logits, step_rng, temperature, top_k, top_p)
 
-    def decode_step(carry, step_rng):
-        cache, token, position, done = carry
-        positions = jnp.broadcast_to(position[:, None], (b, 1))
-        logits, mutated = model.apply(
-            {"params": params, "cache": cache}, token[:, None], positions,
-            mutable=["cache"])
-        logits = logits[:, 0]
-        next_token = sample(logits, step_rng)
-        if eos_id is not None:
-            next_token = jnp.where(done, eos_id, next_token)
-            done = done | (next_token == eos_id)
-        return ((mutated["cache"], next_token, position + 1, done),
-                (next_token, logits))
+    decode_step = _make_decode_step(model, params, b, temperature,
+                                    eos_id, top_k, top_p)
 
     positions = jnp.broadcast_to(
         jnp.arange(prompt_len)[None, :], (b, prompt_len))
@@ -161,19 +150,15 @@ def _sample_logits(logits, step_rng, temperature, top_k, top_p):
         step_rng, logits, axis=-1).astype(jnp.int32)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("model", "temperature", "eos_id", "top_k", "top_p"))
-def _decode_chunk_jit(model, params, carry, step_rngs, *,
-                      temperature: float, eos_id: Optional[int],
-                      top_k: Optional[int], top_p: Optional[float]):
-    """One K-token decode slice (K = step_rngs length, static by
-    shape). Same decode_step math as the monolithic scan; the carry
-    round-trips between slices."""
-    b = carry[1].shape[0]
+def _make_decode_step(model, params, b, temperature, eos_id, top_k,
+                      top_p):
+    """THE one-token decode step (cache write + sample + EOS latch),
+    shared by the monolithic scan and the chunked slices — the
+    bitwise equivalence between those paths rests on this being one
+    function."""
 
-    def decode_step(c, step_rng):
-        cache, token, position, done = c
+    def decode_step(carry, step_rng):
+        cache, token, position, done = carry
         positions = jnp.broadcast_to(position[:, None], (b, 1))
         logits, mutated = model.apply(
             {"params": params, "cache": cache}, token[:, None], positions,
@@ -187,6 +172,20 @@ def _decode_chunk_jit(model, params, carry, step_rngs, *,
         return ((mutated["cache"], next_token, position + 1, done),
                 (next_token, logits))
 
+    return decode_step
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "temperature", "eos_id", "top_k", "top_p"))
+def _decode_chunk_jit(model, params, carry, step_rngs, *,
+                      temperature: float, eos_id: Optional[int],
+                      top_k: Optional[int], top_p: Optional[float]):
+    """One K-token decode slice (K = step_rngs length, static by
+    shape). The SAME decode_step as the monolithic scan
+    (_make_decode_step); the carry round-trips between slices."""
+    decode_step = _make_decode_step(model, params, carry[1].shape[0],
+                                    temperature, eos_id, top_k, top_p)
     carry, (tokens, logits) = jax.lax.scan(decode_step, carry, step_rngs)
     return carry, tokens.swapaxes(0, 1), logits.swapaxes(0, 1)
 

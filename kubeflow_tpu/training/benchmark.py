@@ -403,11 +403,19 @@ def main(argv=None) -> int:
                         help="vision sgd lr (default: 0.1, or 0.01 "
                              "for the no-BN classics vgg16/alexnet)")
     args = parser.parse_args(argv)
+    from kubeflow_tpu.training.launcher import initialize_distributed
     from kubeflow_tpu.utils.platform import sync_platform_from_env
 
     # Honor JAX_PLATFORMS from the spawning process (a CPU-smoke
     # tpu-cnn job must not dispatch to a tunnel-registered TPU).
     sync_platform_from_env()
+    # Multi-host bootstrap from the operator-injected KFT_* env. The
+    # trainer CLI is the POD COMMAND of tpu-cnn jobs (the prototype
+    # sets it directly — not via the launcher wrapper, whose
+    # jax.distributed init would die with its own process anyway), so
+    # the gang join must happen HERE: without it every host builds a
+    # local-devices mesh and silently trains its own model copy.
+    initialize_distributed()
     entry = get_model(args.model)
     if args.bn_stat_rows and entry.family != "vision":
         # Silently ignoring the flag would report an exact-BN number

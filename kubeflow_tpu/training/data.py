@@ -475,7 +475,18 @@ class DevicePrefetcher:
             self._place = place
         elif mesh is not None:
             sharding = batch_sharding(mesh)
-            self._place = lambda b: jax.device_put(b, sharding)
+            if jax.process_count() > 1:
+                # Each host holds only ITS rows of the global batch
+                # (host_shard_range); device_put with the global
+                # sharding would demand global-shaped arrays and fail
+                # on divisibility (found by the real-CLI gang test).
+                # make_array assembles the global array from the
+                # per-process shards without any cross-host copy.
+                self._place = lambda b: jax.tree.map(
+                    lambda v: jax.make_array_from_process_local_data(
+                        sharding, np.asarray(v)), b)
+            else:
+                self._place = lambda b: jax.device_put(b, sharding)
         else:
             self._place = jax.device_put
         self._source = source

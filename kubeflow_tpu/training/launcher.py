@@ -37,6 +37,14 @@ jax replaces the stock PS server entirely), then either runs the user
 command as a supervised subprocess or falls through to the benchmark
 (the "stock server" equivalent: every replica runs the same SPMD
 program).
+
+Supervised-subprocess caveat: a jax.distributed runtime dies with its
+process — the launcher's init does NOT transfer to a child command.
+The in-tree trainer CLIs (pretrain, benchmark) therefore call
+``initialize_distributed()`` themselves from the same env (which DOES
+travel to the child), and prototypes set them as the pod command
+directly; launcher-wrapping is for log supervision + the stock
+benchmark fallthrough, not for providing the child's gang join.
 """
 
 from __future__ import annotations
@@ -106,7 +114,11 @@ def slice_config(env=os.environ) -> Optional[dict]:
 
 
 def initialize_distributed(env=os.environ) -> bool:
-    """jax.distributed.initialize from env; True if multi-process."""
+    """jax.distributed.initialize from env; True if multi-process.
+
+    Idempotent within a process: the launcher's no-argv fallthrough
+    initializes and then runs the benchmark CLI in-process, whose own
+    call must be a no-op (a second initialize raises)."""
     config = distributed_config(env)
     if config is None:
         logger.info("single-process run (no %s)", ENV_COORD)
@@ -115,6 +127,10 @@ def initialize_distributed(env=os.environ) -> bool:
         logger.info("single-process run (%s=1)", ENV_NPROC)
         return False
     import jax
+
+    if jax.distributed.is_initialized():
+        logger.info("jax.distributed already initialized; skipping")
+        return True
 
     slices = slice_config(env)
     if slices:
@@ -159,12 +175,18 @@ def launch(argv: Optional[List[str]] = None, env=os.environ) -> int:
     from kubeflow_tpu.utils.platform import sync_platform_from_env
 
     sync_platform_from_env()
-    initialize_distributed(env)
     if argv:
+        # The CHILD owns the gang join (the env travels to it; the
+        # in-tree trainer CLIs call initialize_distributed on boot).
+        # A parent-side init here would collide with the child's join
+        # — same process_id, same coordinator bind — hanging the gang
+        # (r5 review finding).
         rc = run_and_stream(argv)
     else:
         # No user binary → run the stock SPMD benchmark (the TPU
-        # analogue of the stock grpc_tensorflow_server).
+        # analogue of the stock grpc_tensorflow_server) in-process:
+        # init here; the CLI's own call no-ops (idempotence guard).
+        initialize_distributed(env)
         from kubeflow_tpu.training.benchmark import main as bench_main
 
         rc = bench_main([])

@@ -15,11 +15,12 @@
 """tpu-lm — LM pretraining/fine-tune entrypoint (BERT MLM, Llama causal).
 
 The in-pod program for the BASELINE multi-host configs (BERT-base
-pretraining step time; Llama fine-tune stretch). Runs under the
-launcher (:mod:`kubeflow_tpu.training.launcher` initializes
-``jax.distributed`` from the operator-injected env) as one SPMD
-program per host: build mesh → shard state → stream per-host synthetic
-batches → ``fit`` with checkpoint/resume.
+pretraining step time; Llama fine-tune stretch): the tpu-lm
+prototype's POD COMMAND. It initializes ``jax.distributed`` itself
+from the operator-injected env (launcher.initialize_distributed) and
+runs one SPMD program per host: build mesh (multi-slice dcn_data from
+the MEGASCALE env) → shard state → stream per-host batches → ``fit``
+with checkpoint/resume + preemption drain.
 
 Mesh spec strings use the standard axis names
 (:mod:`kubeflow_tpu.parallel.mesh`): ``--mesh data=-1,tensor=4``.
@@ -90,9 +91,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from kubeflow_tpu.training.launcher import initialize_distributed
     from kubeflow_tpu.utils.platform import sync_platform_from_env
 
     sync_platform_from_env()
+    # Multi-host bootstrap from the operator-injected KFT_* env: this
+    # CLI is the tpu-lm pod command, so the gang join happens here —
+    # without it each host would see only local devices, read
+    # process_count()==1, feed itself the FULL batch, and train an
+    # independent model copy whose loss curves look plausible (the
+    # silent-wrongness failure mode; test_multiprocess pretrain_cli
+    # mode proves the real command joins the gang).
+    initialize_distributed()
 
     import jax
     import optax

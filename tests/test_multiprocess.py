@@ -112,6 +112,58 @@ def test_two_process_bert_dcn_hierarchical_mesh():
 
 
 @pytest.mark.slow
+def test_pretrain_cli_joins_megascale_gang(tmp_path):
+    """The REAL pod command end-to-end: 4 × `python -m
+    kubeflow_tpu.training.pretrain` processes under the exact env the
+    operator injects for a 2-slice × 2-host tpu-lm job. The CLI must
+    join the jax.distributed gang ITSELF (r5 fix: neither trainer CLI
+    called initialize_distributed — each host silently trained an
+    independent model copy; the earlier gang tests masked it by
+    bootstrapping in the test worker) and derive dcn_data=2 from the
+    MEGASCALE env. Identical per-step losses across all four hosts
+    prove the cross-host gradient sync."""
+    import json
+
+    port = _free_port()
+    procs = []
+    for pid in range(4):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            KFT_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            KFT_NUM_PROCESSES="4",
+            KFT_PROCESS_ID=str(pid),
+            MEGASCALE_NUM_SLICES="2",
+            MEGASCALE_SLICE_ID=str(pid // 2),
+            MEGASCALE_COORDINATOR_ADDRESS=f"127.0.0.1:{port + 1}",
+            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "kubeflow_tpu.training.pretrain",
+             "--model", "bert-test", "--global_batch", "16",
+             "--seq_len", "16", "--steps", "3", "--log_every", "1",
+             "--mesh", "data=4",
+             "--metrics_path", str(tmp_path / f"m{pid}.jsonl")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=str(Path(__file__).parent.parent)))
+    outputs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outputs.append(out)
+        assert p.returncode == 0, out[-2000:]
+    # Process 0 reports the resolved mesh: the dcn axis came from env.
+    summary = json.loads(outputs[0].strip().splitlines()[-1])
+    assert summary["mesh"]["dcn_data"] == 2, summary
+    assert summary["mesh"]["data"] == 4, summary
+    assert summary["final_step"] == 3
+    final_losses = []
+    for pid in range(4):
+        lines = (tmp_path / f"m{pid}.jsonl").read_text().splitlines()
+        final_losses.append(json.loads(lines[-1])["loss"])
+    assert len(set(final_losses)) == 1, final_losses
+
+
+@pytest.mark.slow
 def test_two_process_gang_drains_collectively(tmp_path):
     """Preemption hits ONE host of a 2-process gang (SIGTERM to
     process 1 only). The drain-flag allgather must propagate the

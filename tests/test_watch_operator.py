@@ -346,6 +346,53 @@ def test_pod_watch_is_label_bounded():
         assert [o["metadata"]["name"] for _, o in events] == ["ours"]
 
 
+def test_reconciler_fuzz_through_http_client():
+    """The r4 weakness: the fuzz exercised the reconciler against the
+    fake directly, never the production client layer. Re-run seeded
+    chaos episodes with every reconciler operation flowing through
+    HttpApiClient → HTTP socket → facade → store (chaos still mutates
+    the store directly, as a kubelet would)."""
+    import random
+
+    from kubeflow_tpu.operator.reconciler import Reconciler
+
+    with HttpFakeApiServer(token="fz") as srv:
+        client = HttpApiClient(srv.url, token="fz")
+        for seed in range(6):
+            rng = random.Random(seed)
+            name = f"fz{seed}"
+            max_restarts = rng.randint(0, 2)
+            job = make_job(name=name, workers=rng.randint(1, 3))
+            client.create(job)
+            r = Reconciler(client, max_restarts=max_restarts)
+            for _ in range(rng.randint(10, 25)):
+                pods = srv.fake.list("Pod", "default", {JOB_LABEL: name})
+                roll = rng.random()
+                if roll < 0.5 or not pods:
+                    r.reconcile(client.get(KIND, "default", name))
+                elif roll < 0.85:
+                    srv.fake.set_pod_phase(
+                        "default",
+                        rng.choice(pods)["metadata"]["name"],
+                        rng.choice(("Pending", "Running", "Succeeded",
+                                    "Failed")))
+                else:
+                    srv.fake.delete(
+                        "Pod", "default",
+                        rng.choice(pods)["metadata"]["name"])
+                status = client.get(KIND, "default", name).get(
+                    "status", {})
+                assert int(status.get("restartCount", 0)) <= max_restarts
+            # Liveness wind-down over the wire.
+            for _ in range(4 * (max_restarts + 1) + 7):
+                srv.fake.set_all_pod_phases("default", "Succeeded",
+                                            {JOB_LABEL: name})
+                phase = r.reconcile(client.get(KIND, "default", name))
+                if phase in ("Succeeded", "Failed"):
+                    break
+            assert phase in ("Succeeded", "Failed"), (seed, phase)
+
+
 # -- event-driven chaos fuzz ----------------------------------------------
 
 
