@@ -305,6 +305,8 @@ def operator_rbac(namespace: str) -> List[Dict[str, Any]]:
                                "configmaps"], ["*"]),
         # Whole-gang disruption budgets (reconciler._gang_pdb).
         k8s.policy_rule(["policy"], ["poddisruptionbudgets"], ["*"]),
+        # Leader-election leases (operator/leader.py).
+        k8s.policy_rule(["coordination.k8s.io"], ["leases"], ["*"]),
         k8s.policy_rule(["apps"], ["deployments"], ["get", "list", "watch"]),
     ]
     return [

@@ -117,11 +117,15 @@ class WatchController:
 
     def __init__(self, api, *, namespace: Optional[str] = None,
                  relist_seconds: float = 30.0,
-                 reconciler: Optional[Reconciler] = None):
+                 reconciler: Optional[Reconciler] = None,
+                 elector=None):
         self.api = api
         self.namespace = namespace
         self.relist_seconds = relist_seconds
         self.reconciler = reconciler or Reconciler(api)
+        # Optional LeaderElector (operator/leader.py): watchers run
+        # regardless (warm cache), reconciles only while leading.
+        self.elector = elector
         self.stop = threading.Event()
         self._queue: Set[Tuple[str, str]] = set()  # (ns, name)
         self._cond = threading.Condition()
@@ -201,13 +205,41 @@ class WatchController:
                                  name=f"watch-{kind}", daemon=True)
             t.start()
             self._watchers.append(t)
+        if self.elector is not None:
+            t = threading.Thread(target=self.elector.loop,
+                                 name="leader-elector", daemon=True)
+            t.start()
+            self._watchers.append(t)
         deadline = (time.monotonic() + max_seconds
                     if max_seconds is not None else None)
         last_relist = time.monotonic()
+        was_leader = False
         try:
             while not self.stop.is_set():
                 if deadline is not None and time.monotonic() >= deadline:
                     break
+                if self.elector is not None:
+                    if self.elector.broken.is_set():
+                        # The lease path is persistently failing (e.g.
+                        # 403 from stale RBAC): crash loudly so the
+                        # pod restarts visibly instead of idling as a
+                        # forever-follower — a silent outage.
+                        raise RuntimeError(
+                            "leader elector broken: lease API "
+                            "persistently unavailable")
+                    if not self.elector.is_leader():
+                        # Follower: keep the queue (events accumulate
+                        # for the takeover), reconcile nothing.
+                        was_leader = False
+                        self.stop.wait(0.05)
+                        continue
+                    if not was_leader:
+                        # Fresh leadership: force an immediate relist —
+                        # anything the previous leader half-finished
+                        # must be re-observed now, not a relist period
+                        # from now.
+                        was_leader = True
+                        last_relist = float("-inf")
                 now = time.monotonic()
                 if now - last_relist >= self.relist_seconds:
                     # Level-triggered safety net: a dropped event can
@@ -235,6 +267,8 @@ class WatchController:
                         self.stop.wait(0.5)
         finally:
             self.stop.set()
+            if self.elector is not None:
+                self.elector.stop.set()
             for t in self._watchers:
                 t.join(timeout=5.0)
 
@@ -284,6 +318,10 @@ def main(argv=None) -> int:
         help="auto: watch via the in-cluster HTTP client when the "
              "ServiceAccount mount exists (the operator image path), "
              "else kubectl polling (dev clusters)")
+    parser.add_argument(
+        "--no-leader-election", action="store_true",
+        help="watch mode without a coordination.k8s.io lease (single-"
+             "replica deployments / clusters without the RBAC rule)")
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -298,12 +336,27 @@ def main(argv=None) -> int:
                 else "poll")
     if mode == "watch":
         from kubeflow_tpu.operator.http_client import HttpApiClient
+        from kubeflow_tpu.operator.leader import LeaderElector
 
+        client = HttpApiClient.in_cluster()
+        elector = None
+        if not args.no_leader_election:
+            lease_ns = os.environ.get("KFT_NAMESPACE", "default")
+            # The lease NAME carries the watch scope: two operators
+            # watching different namespaces run disjoint workloads and
+            # must not contend one lock (the loser's namespace would
+            # silently never reconcile).
+            lease_name = ("tpujob-operator" if args.namespace is None
+                          else f"tpujob-operator-{args.namespace}")
+            elector = LeaderElector(client, namespace=lease_ns,
+                                    name=lease_name)
+            logger.info("lease %s/%s as %s", lease_ns, lease_name,
+                        elector.identity)
         logger.info("watch mode: in-cluster HTTP client, relist %.0fs",
                     args.relist_seconds)
-        run_watch_controller(HttpApiClient.in_cluster(),
-                             namespace=args.namespace,
-                             relist_seconds=args.relist_seconds)
+        WatchController(client, namespace=args.namespace,
+                        relist_seconds=args.relist_seconds,
+                        elector=elector).run()
     else:
         logger.info("poll mode: kubectl client, resync %.1fs",
                     args.resync_seconds)

@@ -58,6 +58,7 @@ _RESOURCES: Dict[str, Tuple[str, str, str]] = {
     "PodDisruptionBudget": ("apis", "policy/v1", "poddisruptionbudgets"),
     "Event": ("api", "v1", "events"),
     "ConfigMap": ("api", "v1", "configmaps"),
+    "Lease": ("apis", "coordination.k8s.io/v1", "leases"),
 }
 
 

@@ -1,0 +1,214 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Lease-based leader election for the TPUJob controller.
+
+Two controller replicas (rolling updates overlap even at replicas=1)
+must not reconcile the same jobs concurrently — the Conflict-tolerant
+create/patch paths keep that SAFE, but every race costs a wasted pass
+and a retry. The reference's Go operator got election from
+client-go's leaderelection package (resource-lock contention); this is
+the same protocol on ``coordination.k8s.io/v1 Lease`` objects through
+whichever apiserver client the controller runs with (fake, kubectl,
+or the stdlib HTTP client):
+
+- acquire: create the Lease (Conflict → someone else holds it), or
+  take over when ``renewTime + leaseDurationSeconds`` has passed;
+- renew: re-write ``renewTime`` under optimistic concurrency — a
+  Conflict means another holder won, and leadership is dropped
+  immediately (never assume leadership through a failed write);
+- followers re-check at ``retry_seconds``; the controller only
+  reconciles while ``is_leader()``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from kubeflow_tpu.operator.fake import Conflict, NotFound
+
+logger = logging.getLogger(__name__)
+
+LEASE_API_VERSION = "coordination.k8s.io/v1"
+
+
+class _LostRace(Exception):
+    """Raised inside the patch mutation when the freshly-read lease is
+    held live by someone else — the read-modify-write client re-reads
+    the object, so the _tick-time holder check alone is a TOCTOU."""
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def default_identity() -> str:
+    return f"{os.environ.get('HOSTNAME', 'tpujob-operator')}_{os.getpid()}"
+
+
+class LeaderElector:
+    """Run :meth:`loop` in a thread; gate work on :meth:`is_leader`."""
+
+    # Consecutive lease-path ERRORS (not lost races — real apiserver
+    # failures like an unmapped 403 from stale RBAC) before the
+    # elector declares itself broken. Followership is a normal state,
+    # so an elector that can never even TALK to the lease must not
+    # masquerade as a follower forever — that is a silent outage.
+    MAX_CONSECUTIVE_ERRORS = 20
+
+    def __init__(self, api, *, namespace: str = "default",
+                 name: str = "tpujob-operator",
+                 identity: Optional[str] = None,
+                 lease_seconds: float = 15.0,
+                 retry_seconds: Optional[float] = None):
+        self.api = api
+        self.namespace = namespace
+        self.name = name
+        self.identity = identity or default_identity()
+        self.lease_seconds = lease_seconds
+        self.retry_seconds = retry_seconds or max(lease_seconds / 3, 0.05)
+        self.stop = threading.Event()
+        self._leader = threading.Event()
+        # Set when the lease path errored MAX_CONSECUTIVE_ERRORS times
+        # in a row; the controller treats it as fatal (crash-loop the
+        # pod — visible — instead of idling forever).
+        self.broken = threading.Event()
+
+    def is_leader(self) -> bool:
+        return self._leader.is_set()
+
+    # -- lease protocol ---------------------------------------------------
+
+    def _lease_body(self, transitions: int) -> Dict[str, Any]:
+        now = _now().isoformat()
+        return {
+            "apiVersion": LEASE_API_VERSION,
+            "kind": "Lease",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(self.lease_seconds) or 1,
+                "acquireTime": now,
+                "renewTime": now,
+                "leaseTransitions": transitions,
+            },
+        }
+
+    @staticmethod
+    def _expired(spec: Dict[str, Any]) -> bool:
+        renew = spec.get("renewTime")
+        if not renew:
+            return True
+        try:
+            renewed = datetime.datetime.fromisoformat(renew)
+        except ValueError:
+            return True
+        duration = float(spec.get("leaseDurationSeconds", 15))
+        return _now() >= renewed + datetime.timedelta(seconds=duration)
+
+    def _tick(self) -> bool:
+        """One acquire-or-renew attempt; returns leadership."""
+        try:
+            lease = self.api.get("Lease", self.namespace, self.name)
+        except NotFound:
+            try:
+                self.api.create(self._lease_body(transitions=0))
+                logger.info("lease %s acquired by %s (created)",
+                            self.name, self.identity)
+                return True
+            except Conflict:
+                return False  # lost the create race
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity")
+        if holder != self.identity and not self._expired(spec):
+            return False  # someone else holds a live lease
+
+        def take(obj: Dict[str, Any]) -> None:
+            s = obj.setdefault("spec", {})
+            # Re-validate against the object the PATCH actually read:
+            # the client is read-modify-write, so between _tick's GET
+            # and this mutation another elector may have renewed or
+            # taken over (r5 review: without this, an expired-then-
+            # renewed lease could be overwritten and two leaders
+            # coexist for a retry period). Raising BEFORE any mutation
+            # aborts the write cleanly on every client.
+            current = s.get("holderIdentity")
+            if (current and current != self.identity
+                    and not self._expired(s)):
+                raise _LostRace(current)
+            now = _now().isoformat()
+            if current != self.identity:
+                s["leaseTransitions"] = int(
+                    s.get("leaseTransitions", 0)) + 1
+                s["acquireTime"] = now
+            s["holderIdentity"] = self.identity
+            s["leaseDurationSeconds"] = int(self.lease_seconds) or 1
+            s["renewTime"] = now
+
+        try:
+            self.api.patch("Lease", self.namespace, self.name, take)
+        except (_LostRace, Conflict, NotFound):
+            # A concurrent writer won (or the lease vanished): NEVER
+            # keep leadership through a failed renewal.
+            return False
+        if holder != self.identity:
+            logger.info("lease %s taken over by %s (was %s)",
+                        self.name, self.identity, holder)
+        return True
+
+    # -- loop -------------------------------------------------------------
+
+    def loop(self) -> None:
+        errors = 0
+        while not self.stop.is_set():
+            try:
+                leading = self._tick()
+                errors = 0
+            except Exception:  # noqa: BLE001 — apiserver hiccup
+                logger.exception("lease tick failed")
+                leading = False
+                errors += 1
+                if errors >= self.MAX_CONSECUTIVE_ERRORS:
+                    logger.critical(
+                        "lease path failed %d consecutive times "
+                        "(RBAC for coordination.k8s.io/leases "
+                        "missing?); declaring the elector broken",
+                        errors)
+                    self._leader.clear()
+                    self.broken.set()
+                    return
+            was = self._leader.is_set()
+            if leading and not was:
+                self._leader.set()
+            elif not leading and was:
+                logger.warning("lease %s lost by %s", self.name,
+                               self.identity)
+                self._leader.clear()
+            self.stop.wait(self.retry_seconds)
+        # On clean shutdown, release so a peer takes over immediately
+        # instead of waiting out the lease duration.
+        if self._leader.is_set():
+            self._leader.clear()
+            try:
+                self.api.patch(
+                    "Lease", self.namespace, self.name,
+                    lambda o: o.setdefault("spec", {}).update(
+                        {"holderIdentity": "",
+                         "renewTime": None}))
+            except Exception:  # noqa: BLE001 — best-effort release
+                pass

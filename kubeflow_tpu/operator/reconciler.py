@@ -492,10 +492,80 @@ class Reconciler:
                 job, "Failed", restart_count=restarts,
                 reason="slice fault and restart budget exhausted"
                        if restarts >= self.max_restarts else "slice fault")
-        # Decision.NONE — all pods exist; Running once any runs.
-        running = any(p == PodPhase.RUNNING for p in phases)
+        # Decision.NONE — all pods exist; Running once any runs. A job
+        # already Running must not flap back to Pending in the window
+        # where freshly-recreated pods (post-restart) lack kubelet
+        # status — the same dashboard regression as the CREATE_MISSING
+        # branch (exposed by the r5 event-emission test: the flap
+        # emitted spurious Pending/Running event pairs every restart).
+        running = (any(p == PodPhase.RUNNING for p in phases)
+                   or phase == "Running")
         return self._set_status(job, "Running" if running else "Pending",
                                 restart_count=restarts)
+
+    def _emit_event(self, job: Dict[str, Any], phase: str,
+                    restart_count: int,
+                    reason: Optional[str]) -> None:
+        """One k8s Event per phase transition (the tf-operator
+        recorded lifecycle events; `kubectl describe tpujob` shows
+        these). Best-effort: an event that can't be written must
+        never fail the reconcile pass. Name carries the phase +
+        restart count so retries of the same transition dedupe via
+        Conflict instead of piling up."""
+        name = job["metadata"]["name"]
+        ns = job["metadata"].get("namespace", "default")
+        now = datetime.datetime.now(
+            datetime.timezone.utc).isoformat()
+        event = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"{name}.{phase.lower()}.r{restart_count}",
+                "namespace": ns,
+            },
+            "involvedObject": {
+                "apiVersion": f"{GROUP}/{VERSION}",
+                "kind": KIND,
+                "name": name,
+                "namespace": ns,
+                "uid": job["metadata"].get("uid", ""),
+            },
+            "reason": phase,
+            "message": reason or f"TPUJob entered phase {phase}",
+            "type": ("Warning" if phase in ("Restarting", "Failed")
+                     else "Normal"),
+            "source": {"component": "tpujob-operator"},
+            "firstTimestamp": now,
+            "lastTimestamp": now,
+            "count": 1,
+        }
+        uid = job["metadata"].get("uid", "")
+        try:
+            self.api.create(event)
+        except Conflict:
+            # Same transition recorded before. If it belongs to THIS
+            # job incarnation, bump the aggregate count k8s-style; if
+            # it's a leftover from a deleted same-name job (event TTL
+            # outlives the object), record under a uid-suffixed name —
+            # kubectl describe filters by involvedObject.uid, so the
+            # new job must get its own event.
+            try:
+                existing = self.api.get("Event", ns,
+                                        event["metadata"]["name"])
+                if existing.get("involvedObject", {}).get("uid") == uid:
+                    self.api.patch(
+                        "Event", ns, event["metadata"]["name"],
+                        lambda o: o.update({
+                            "count": int(o.get("count", 1)) + 1,
+                            "lastTimestamp": now,
+                        }))
+                else:
+                    event["metadata"]["name"] += f".{uid[:8]}"
+                    self.api.create(event)
+            except Exception:  # noqa: BLE001
+                pass
+        except Exception:  # noqa: BLE001 — events are best-effort
+            logger.exception("event emission failed for %s/%s", ns, name)
 
     def _set_status(self, job: Dict[str, Any], phase: str, *,
                     restart_count: int = 0,
@@ -503,6 +573,7 @@ class Reconciler:
                     reason: Optional[str] = None) -> str:
         name = job["metadata"]["name"]
         ns = job["metadata"].get("namespace", "default")
+        previous_phase = job.get("status", {}).get("phase")
 
         def mutate(obj):
             status = obj.setdefault("status", {})
@@ -521,7 +592,12 @@ class Reconciler:
         try:
             self.api.patch(KIND, ns, name, mutate)
         except NotFound:
-            # Job object deleted mid-pass; nothing to record.
-            pass
+            # Job object deleted mid-pass: nothing to record — and no
+            # Event either (an event for a nonexistent job would
+            # orphan in the namespace until its TTL).
+            mutate(job)
+            return phase
         mutate(job)
+        if phase != previous_phase:
+            self._emit_event(job, phase, restart_count, reason)
         return phase

@@ -44,6 +44,7 @@ _PLURAL_TO_KIND = {
     "poddisruptionbudgets": "PodDisruptionBudget",
     "events": "Event",
     "configmaps": "ConfigMap",
+    "leases": "Lease",
 }
 
 

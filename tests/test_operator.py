@@ -420,6 +420,89 @@ def test_gang_pod_disruption_budget():
     assert len(api.list("PodDisruptionBudget", "default")) == 1
 
 
+def test_phase_transitions_emit_events():
+    """tf-operator parity: lifecycle Events on every phase transition
+    (`kubectl describe tpujob` surface) — Normal for healthy phases,
+    Warning for Restarting/Failed, repeated identical transitions
+    aggregate via count instead of piling up objects."""
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=2))
+    r = Reconciler(api)
+    r.reconcile(job)
+    events = {e["metadata"]["name"]: e for e in api.list("Event")}
+    assert "job1.pending.r0" in events
+    pend = events["job1.pending.r0"]
+    assert pend["type"] == "Normal"
+    assert pend["involvedObject"]["kind"] == "TPUJob"
+    assert pend["involvedObject"]["name"] == "job1"
+
+    api.set_all_pod_phases("default", "Running", {JOB_LABEL: "job1"})
+    r.reconcile(api.get("TPUJob", "default", "job1"))
+    api.set_pod_phase("default", "job1-tpu-worker-0", "Failed")
+    r.reconcile(api.get("TPUJob", "default", "job1"))
+    events = {e["metadata"]["name"]: e for e in api.list("Event")}
+    assert events["job1.running.r0"]["type"] == "Normal"
+    restarting = events["job1.restarting.r1"]
+    assert restarting["type"] == "Warning"
+    assert "slice fault" in restarting["message"]
+    # Recreate pass: Restarting → Running is a transition, with its
+    # own event at the new restart count...
+    r.reconcile(api.get("TPUJob", "default", "job1"))
+    events = {e["metadata"]["name"]: e for e in api.list("Event")}
+    assert "job1.running.r1" in events
+    # ...but a steady-state pass emits nothing new.
+    n = len(events)
+    r.reconcile(api.get("TPUJob", "default", "job1"))
+    assert len(api.list("Event")) == n
+
+
+def test_recreated_job_gets_its_own_events():
+    """A new same-name job must not bump the deleted predecessor's
+    Events (kubectl describe filters by involvedObject.uid): the
+    collision records under a uid-suffixed name instead (r5 review)."""
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=1))
+    Reconciler(api).reconcile(job)
+    assert api.list("Event")[0]["involvedObject"]["uid"] == "uid-1"
+
+    api.delete("TPUJob", "default", "job1")  # old Events outlive it
+    job2 = make_job(workers=1)
+    job2["metadata"]["uid"] = "uid-2"
+    submit(api, job2)
+    Reconciler(api).reconcile(api.get("TPUJob", "default", "job1"))
+    events = api.list("Event")
+    old = next(e for e in events
+               if e["metadata"]["name"] == "job1.pending.r0")
+    assert old["involvedObject"]["uid"] == "uid-1"
+    assert old["count"] == 1  # NOT bumped by the new incarnation
+    fresh = next(e for e in events
+                 if e["metadata"]["name"] == "job1.pending.r0.uid-2")
+    assert fresh["involvedObject"]["uid"] == "uid-2"
+
+
+def test_repeated_drain_events_aggregate_count():
+    """Two preemption drains at the same restart count: one Event
+    whose count reaches 2 (k8s aggregation), not two objects."""
+    from kubeflow_tpu.training.launcher import DRAIN_EXIT_CODE
+
+    api = FakeApiServer()
+    job = submit(api, make_job(workers=1))
+    r = Reconciler(api)
+    r.reconcile(job)
+    for _ in range(2):
+        api.set_all_pod_phases("default", "Running", {JOB_LABEL: "job1"})
+        r.reconcile(api.get("TPUJob", "default", "job1"))
+        api.set_pod_terminated("default", "job1-tpu-worker-0",
+                               DRAIN_EXIT_CODE)
+        r.reconcile(api.get("TPUJob", "default", "job1"))  # Restarting
+        r.reconcile(api.get("TPUJob", "default", "job1"))  # recreate
+    drains = [e for e in api.list("Event")
+              if e["metadata"]["name"] == "job1.restarting.r0"]
+    assert len(drains) == 1
+    assert drains[0]["count"] == 2
+    assert "preemption drain" in drains[0]["message"]
+
+
 def test_preemption_drain_does_not_burn_restart_budget():
     """A pod SIGTERM-drained by the platform (spot reclaim, node
     maintenance) exits with DRAIN_EXIT_CODE after checkpointing
