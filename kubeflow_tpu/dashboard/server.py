@@ -196,6 +196,36 @@ class JobListHandler(BaseHandler):
         self.write_json({"created": job_summary(created)}, 201)
 
 
+def _job_events(api, namespace: str, name: str,
+                job: Dict[str, Any]) -> list:
+    """The operator's lifecycle Events for THIS job incarnation
+    (kubectl-describe semantics: filtered by involvedObject name +
+    uid), newest last. Best-effort — a client without event access
+    yields an empty list, never a failed detail view."""
+    uid = job.get("metadata", {}).get("uid", "")
+    try:
+        events = api.list("Event", namespace)
+    except Exception:  # noqa: BLE001
+        return []
+    # `or`-coalesce, not get() defaults: other writers (EventsV1
+    # recorders, `kubectl create event`) legally store explicit nulls
+    # in these fields, and a null must not 500 the detail view.
+    mine = [
+        {
+            "reason": e.get("reason") or "",
+            "type": e.get("type") or "Normal",
+            "message": e.get("message") or "",
+            "count": e.get("count") or 1,
+            "lastTimestamp": e.get("lastTimestamp") or "",
+        }
+        for e in events
+        if e.get("involvedObject", {}).get("name") == name
+        and (e.get("involvedObject", {}).get("uid") or "") in ("", uid)
+    ]
+    mine.sort(key=lambda e: e["lastTimestamp"])
+    return mine
+
+
 class JobDetailHandler(BaseHandler):
     async def get(self, namespace: str, name: str):
         from kubeflow_tpu.operator.fake import NotFound
@@ -207,16 +237,21 @@ class JobDetailHandler(BaseHandler):
         except NotFound:
             return self.write_json(
                 {"error": f"{KIND} {namespace}/{name} not found"}, 404)
-        pods = [
-            pod_summary(p)
-            for p in await loop.run_in_executor(
+        import asyncio
+
+        # Pods and events are independent apiserver calls (each a
+        # kubectl subprocess on the real client): fetch concurrently.
+        raw_pods, events = await asyncio.gather(
+            loop.run_in_executor(
                 None, lambda: self.api.list(
-                    "Pod", namespace, label_selector={JOB_LABEL: name}))
-        ]
+                    "Pod", namespace, label_selector={JOB_LABEL: name})),
+            loop.run_in_executor(
+                None, _job_events, self.api, namespace, name, job))
         self.write_json({"job": job, "summary": job_summary(job),
                          "conditions": job.get("status", {}).get(
                              "conditions", []),
-                         "pods": pods})
+                         "pods": [pod_summary(p) for p in raw_pods],
+                         "events": events})
 
     async def delete(self, namespace: str, name: str):
         """Delete the job AND its gang pods (the operator only
@@ -379,6 +414,12 @@ _DETAIL_PAGE = """<!doctype html>
 <tr><th>Type</th><th>Status</th><th>Last transition</th><th>Reason</th></tr>
 {cond_rows}
 </table>
+<h2>Events</h2>
+<table>
+<tr><th>Type</th><th>Reason</th><th>Count</th><th>Last seen</th>
+<th>Message</th></tr>
+{event_rows}
+</table>
 <p>JSON: <a href="{api}">{api}</a></p>
 </body></html>
 """
@@ -397,10 +438,18 @@ class UIJobDetailHandler(BaseHandler):
         except NotFound:
             self.set_status(404)
             return self.finish(f"TPUJob {namespace}/{name} not found")
+        import asyncio
+
         summary = job_summary(job)
-        pods = [pod_summary(p) for p in await loop.run_in_executor(
-            None, lambda: self.api.list(
-                "Pod", namespace, label_selector={JOB_LABEL: name}))]
+        # Pods and events concurrently (independent apiserver calls).
+        raw_pods, events = await asyncio.gather(
+            loop.run_in_executor(
+                None, lambda: self.api.list(
+                    "Pod", namespace, label_selector={JOB_LABEL: name})),
+            loop.run_in_executor(
+                None, _job_events, self.api, namespace, name, job))
+        pods = [pod_summary(p) for p in raw_pods]
+
         def _num(s: str) -> int:
             return int(s) if s.isdigit() else 0
 
@@ -434,6 +483,18 @@ class UIJobDetailHandler(BaseHandler):
                 f"<td>{html.escape(c.get('lastTransitionTime', ''))}</td>"
                 f"<td>{html.escape(c.get('reason', ''))}</td>"
                 "</tr>")
+        event_rows = []
+        for e in events:
+            color = "#cf222e" if e["type"] == "Warning" else "#57606a"
+            event_rows.append(
+                "<tr>"
+                f"<td style=\"color:{color}\">"
+                f"{html.escape(e['type'])}</td>"
+                f"<td>{html.escape(e['reason'])}</td>"
+                f"<td>{int(e['count'])}</td>"
+                f"<td>{html.escape(e['lastTimestamp'][:19])}</td>"
+                f"<td>{html.escape(e['message'])}</td>"
+                "</tr>")
         self.set_header("Content-Type", "text/html; charset=utf-8")
         self.finish(_DETAIL_PAGE.format(
             name=html.escape(name),
@@ -449,6 +510,8 @@ class UIJobDetailHandler(BaseHandler):
             "<tr><td colspan=7>no pods</td></tr>",
             cond_rows="\n".join(cond_rows) or
             "<tr><td colspan=4>none</td></tr>",
+            event_rows="\n".join(event_rows) or
+            "<tr><td colspan=5>none</td></tr>",
             api=html.escape(f"/tpujobs/api/tpujob/{namespace}/{name}"),
         ))
 

@@ -129,6 +129,53 @@ class DashboardTest(tornado.testing.AsyncHTTPTestCase):
         assert "all pods up" in page
         assert self.fetch("/tpujobs/ui/job/default/nope").code == 404
 
+    def test_job_events_surface_in_detail_and_ui(self):
+        """The operator's lifecycle Events ride the detail API and
+        the HTML page, filtered to THIS job incarnation (uid) —
+        kubectl-describe semantics."""
+        from kubeflow_tpu.manifests.tpujob import (
+            replica_spec,
+            termination_policy,
+            tpu_job,
+        )
+        from kubeflow_tpu.operator.reconciler import Reconciler
+
+        job = tpu_job("evjob", "default", [replica_spec(
+            "TPU_WORKER", 1, image="img",
+            tpu_accelerator="tpu-v5-lite-podslice",
+            tpu_topology="2x4")],
+            termination=termination_policy("TPU_WORKER", 0))
+        job["metadata"]["uid"] = "uid-ev"
+        self.api.create(job)
+        r = Reconciler(self.api)
+        r.reconcile(self.api.get(KIND, "default", "evjob"))
+        self.api.set_pod_phase("default", "evjob-tpu-worker-0",
+                               "Failed")
+        r.reconcile(self.api.get(KIND, "default", "evjob"))
+        # A stale same-name event from a PREVIOUS incarnation must
+        # not surface.
+        self.api.create({
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": "evjob.old", "namespace": "default"},
+            "involvedObject": {"kind": KIND, "name": "evjob",
+                               "uid": "uid-OLD"},
+            "reason": "Pending", "type": "Normal",
+            "message": "stale incarnation", "count": 1,
+            "lastTimestamp": "2020-01-01T00:00:00"})
+
+        detail = json.loads(
+            self.fetch("/tpujobs/api/tpujob/default/evjob").body)
+        reasons = [e["reason"] for e in detail["events"]]
+        assert "Pending" in reasons and "Restarting" in reasons
+        assert all(e["message"] != "stale incarnation"
+                   for e in detail["events"])
+        warn = next(e for e in detail["events"]
+                    if e["reason"] == "Restarting")
+        assert warn["type"] == "Warning"
+        page = self.fetch("/tpujobs/ui/job/default/evjob").body.decode()
+        assert "slice fault" in page
+        assert "stale incarnation" not in page
+
     def test_pod_log_tail_proxied(self):
         """Log tails flow through the apiserver client; pods outside
         the job 404 even if they exist (route contract narrower than
