@@ -176,25 +176,35 @@ def main() -> int:
 
     # Decode throughput (generation serving): 7B KV-cache decode is
     # HBM-bound; measured r2 at 20.1 ms/token ≈ 82% of peak HBM bw.
+    # The B=1/4/8 sweep prices batched decode (the serving batcher's
+    # coalescing lever): each step streams the whole weight set
+    # whatever the batch, so aggregate tokens/s should scale ~B until
+    # KV-cache traffic or matmul compute catches up.
     try:
         from kubeflow_tpu.inference.benchmark import (
             DecodeBenchConfig,
-            run_decode_benchmark,
+            run_decode_batch_sweep,
         )
 
         # 128 decode steps: short decode segments drown in tunnel
         # timing noise (a 64-token run once measured "1150 GB/s",
         # above physical HBM peak — pure jitter in the differencing).
-        dc = run_decode_benchmark(DecodeBenchConfig(
+        sweep = run_decode_batch_sweep(DecodeBenchConfig(
             model="llama2-7b" if on_tpu else "llama-test",
-            batch_size=1 if on_tpu else 2,
             prompt_len=64 if on_tpu else 8,
             max_new_tokens=128 if on_tpu else 8,
-        ))
-        extra[f"{dc['model']}_decode_tokens_per_sec"] = round(
-            dc["decode_tokens_per_sec"], 1)
-        extra[f"{dc['model']}_decode_ms_per_token"] = round(
-            dc["per_token_ms"], 2)
+        ), batch_sizes=(1, 4, 8))
+        m = sweep["model"]
+        for row in sweep["rows"]:
+            b = row["batch_size"]
+            suffix = "" if b == 1 else f"_b{b}"
+            extra[f"{m}_decode_tokens_per_sec{suffix}"] = round(
+                row["decode_tokens_per_sec"], 1)
+            if b == 1:
+                extra[f"{m}_decode_ms_per_token"] = round(
+                    row["per_token_ms"], 2)
+        extra[f"{m}_decode_batch_speedup_b8"] = sweep[
+            "speedup_vs_b1"].get("8")
     except Exception as e:  # secondary line; never sink the bench
         extra["decode_bench_error"] = str(e)[:200]
 
@@ -223,18 +233,28 @@ def main() -> int:
     # LM generation serving (r4): a generate-signature export driven
     # through :generate / gRPC Predict — the serve-side counterpart
     # of the decode row above (llama-test isolates stack overhead;
-    # weight streaming is the decode bench's job).
+    # weight streaming is the decode bench's job). The client sweep
+    # (r6) measures generate COALESCING through the real server: the
+    # micro-batcher folds N concurrent decodes into one KV-cache
+    # dispatch, so batches < requests and rps scales with fill.
     try:
         lm_serving = run_serving_benchmark(ServingBenchConfig(
             model="llama-test", clients=2, requests_per_client=8,
-            warmup_requests=2, transport="grpc",
-            prompt_len=32, new_tokens=16))
+            warmup_requests=2, transport="grpc", max_batch=8,
+            prompt_len=32, new_tokens=16,
+            sweep_clients=(1, 4, 8)))
         extra["llama-test_generate_serving_p50_ms"] = (
             lm_serving["p50_ms"])
         extra["llama-test_generate_serving_rps"] = (
             lm_serving["throughput_rps"])
         extra["llama-test_generate_direct_ms"] = (
             lm_serving["direct_model_ms"])
+        for row in lm_serving.get("sweep", ()):
+            n = row["clients"]
+            extra[f"llama-test_generate_rps_c{n}"] = (
+                row["throughput_rps"])
+            extra[f"llama-test_generate_batch_fill_c{n}"] = (
+                row["mean_batch_fill"])
     except Exception as e:  # secondary line; never sink the bench
         extra["lm_serving_bench_error"] = str(e)[:200]
 

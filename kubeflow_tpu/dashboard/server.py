@@ -196,15 +196,44 @@ class JobListHandler(BaseHandler):
         self.write_json({"created": job_summary(created)}, 201)
 
 
+#: Fallback cap when the apiserver client can't filter server-side: a
+#: busy shared namespace holds thousands of Events, and a detail-page
+#: click must not shuttle (or sort) them all.
+_EVENT_FALLBACK_CAP = 500
+
+
 def _job_events(api, namespace: str, name: str,
                 job: Dict[str, Any]) -> list:
     """The operator's lifecycle Events for THIS job incarnation
     (kubectl-describe semantics: filtered by involvedObject name +
-    uid), newest last. Best-effort — a client without event access
-    yields an empty list, never a failed detail view."""
+    uid), newest last. The name filter runs SERVER-side via
+    fieldSelector (involvedObject.name=<job>) so each detail-page
+    click costs one small list, not the namespace's whole event
+    history; clients without field_selector support fall back to a
+    client-side filter over a capped list. Best-effort — a client
+    without event access yields an empty list, never a failed detail
+    view."""
     uid = job.get("metadata", {}).get("uid", "")
     try:
-        events = api.list("Event", namespace)
+        try:
+            events = api.list("Event", namespace,
+                              field_selector={"involvedObject.name": name})
+        except TypeError:
+            # Older/duck-typed clients without the field_selector
+            # parameter: list and filter here, bounded by the cap
+            # (keep the NEWEST slice — kubectl-describe shows the
+            # recent lifecycle, not the genesis).
+            events = api.list("Event", namespace)
+            if len(events) > _EVENT_FALLBACK_CAP:
+                # Coalesce across timestamp fields: EventsV1 recorders
+                # store eventTime and an explicit null lastTimestamp —
+                # sorting on lastTimestamp alone would trim exactly
+                # those (possibly newest) events first.
+                events = sorted(
+                    events,
+                    key=lambda e: (e.get("lastTimestamp")
+                                   or e.get("eventTime") or ""),
+                )[-_EVENT_FALLBACK_CAP:]
     except Exception:  # noqa: BLE001
         return []
     # `or`-coalesce, not get() defaults: other writers (EventsV1

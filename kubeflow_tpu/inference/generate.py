@@ -27,6 +27,14 @@ only); TPU-first shape discipline throughout:
   is also the difference between one round-trip and max_new_tokens of
   them).
 - Greedy (``temperature=0``) or temperature sampling.
+- Batched decode is first-class: mixed-length prompts ride one decode
+  dispatch via LEFT-padding + ``prompt_lengths`` (per-row position
+  offsets and a cache-slot mask keep each row identical to its B=1
+  run), and ``rng`` accepts per-row keys ``[B, 2]`` so sampled rows
+  reproduce their single-request streams inside any batch. Decode is
+  HBM-bound (every step streams the full weight set), so the batch
+  rows are near-free throughput — the serving micro-batcher
+  (serving/manager.py) exists to exploit exactly this.
 """
 
 from __future__ import annotations
@@ -80,11 +88,34 @@ def _truncate_logits(logits: jax.Array, top_k: Optional[int],
     return logits
 
 
+def _split_step_rngs(rng: jax.Array, n: int) -> jax.Array:
+    """Per-step rngs from either one shared key (``[2]`` → ``[N, 2]``,
+    one stream for the whole batch — the classic path) or per-row keys
+    (``[B, 2]`` → ``[N, B, 2]``, one independent stream per row, so a
+    row sampled inside a coalesced batch is bitwise identical to the
+    same request run at B=1 with its own key)."""
+    if rng.ndim == 2:
+        return jnp.swapaxes(
+            jax.vmap(lambda k: jax.random.split(k, n))(rng), 0, 1)
+    return jax.random.split(rng, n)
+
+
+def _prompt_positions(b, prompt_len, pad_lengths):
+    """RoPE positions for a (possibly left-padded) prompt: row i's
+    real tokens get positions 0..len_i-1 whatever slot they occupy;
+    pad slots clamp to 0 (their K/V are masked out of attention)."""
+    if pad_lengths is None:
+        return jnp.broadcast_to(
+            jnp.arange(prompt_len)[None, :], (b, prompt_len))
+    return jnp.maximum(
+        jnp.arange(prompt_len)[None, :] - pad_lengths[:, None], 0)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("model", "max_new_tokens", "temperature", "eos_id",
                      "top_k", "top_p"))
-def _generate_jit(model, params, prompt_ids, rng, cache, *,
+def _generate_jit(model, params, prompt_ids, rng, cache, pad_lengths, *,
                   max_new_tokens: int, temperature: float,
                   eos_id: Optional[int], top_k: Optional[int] = None,
                   top_p: Optional[float] = None):
@@ -96,20 +127,23 @@ def _generate_jit(model, params, prompt_ids, rng, cache, *,
         return _sample_logits(logits, step_rng, temperature, top_k, top_p)
 
     decode_step = _make_decode_step(model, params, b, temperature,
-                                    eos_id, top_k, top_p)
+                                    eos_id, top_k, top_p, pad_lengths)
 
-    positions = jnp.broadcast_to(
-        jnp.arange(prompt_len)[None, :], (b, prompt_len))
+    positions = _prompt_positions(b, prompt_len, pad_lengths)
+    mkw = {} if pad_lengths is None else {"pad_lengths": pad_lengths}
     prefill_logits, mutated = model.apply(
         {"params": params, "cache": cache}, prompt_ids, positions,
-        mutable=["cache"])
+        mutable=["cache"], **mkw)
     last_logits = prefill_logits[:, -1]
-    step_rngs = jax.random.split(rng, max_new_tokens)
+    step_rngs = _split_step_rngs(rng, max_new_tokens)
     first = sample(last_logits, step_rngs[0])
     done = jnp.zeros((b,), bool)
     if eos_id is not None:
         done = first == eos_id
-    position = jnp.full((b,), prompt_len, jnp.int32)
+    if pad_lengths is None:
+        position = jnp.full((b,), prompt_len, jnp.int32)
+    else:
+        position = (prompt_len - pad_lengths).astype(jnp.int32)
     carry = (mutated["cache"], first, position, done)
     # Steps 2..N inside one scan: single dispatch for the decode.
     _, (tokens, logits) = jax.lax.scan(decode_step, carry, step_rngs[1:])
@@ -122,22 +156,26 @@ def _generate_jit(model, params, prompt_ids, rng, cache, *,
 @functools.partial(
     jax.jit,
     static_argnames=("model", "temperature", "eos_id", "top_k", "top_p"))
-def _prefill_jit(model, params, prompt_ids, first_rng, cache, *,
+def _prefill_jit(model, params, prompt_ids, first_rng, cache,
+                 pad_lengths, *,
                  temperature: float, eos_id: Optional[int],
                  top_k: Optional[int], top_p: Optional[float]):
     """Prompt pass + first sampled token (the chunked path's head)."""
     b, prompt_len = prompt_ids.shape
-    positions = jnp.broadcast_to(
-        jnp.arange(prompt_len)[None, :], (b, prompt_len))
+    positions = _prompt_positions(b, prompt_len, pad_lengths)
+    mkw = {} if pad_lengths is None else {"pad_lengths": pad_lengths}
     prefill_logits, mutated = model.apply(
         {"params": params, "cache": cache}, prompt_ids, positions,
-        mutable=["cache"])
+        mutable=["cache"], **mkw)
     last_logits = prefill_logits[:, -1]
     first = _sample_logits(last_logits, first_rng, temperature,
                            top_k, top_p)
     done = (first == eos_id) if eos_id is not None else \
         jnp.zeros((b,), bool)
-    position = jnp.full((b,), prompt_len, jnp.int32)
+    if pad_lengths is None:
+        position = jnp.full((b,), prompt_len, jnp.int32)
+    else:
+        position = (prompt_len - pad_lengths).astype(jnp.int32)
     return (mutated["cache"], first, position, done), last_logits
 
 
@@ -146,23 +184,29 @@ def _sample_logits(logits, step_rng, temperature, top_k, top_p):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / temperature
     logits = _truncate_logits(logits, top_k, top_p)
+    if step_rng.ndim == 2:
+        # Per-row keys: each row consumes its own stream, so the same
+        # (prompt, key) samples the same tokens at any batch position.
+        return jax.vmap(jax.random.categorical)(
+            step_rng, logits).astype(jnp.int32)
     return jax.random.categorical(
         step_rng, logits, axis=-1).astype(jnp.int32)
 
 
 def _make_decode_step(model, params, b, temperature, eos_id, top_k,
-                      top_p):
+                      top_p, pad_lengths=None):
     """THE one-token decode step (cache write + sample + EOS latch),
     shared by the monolithic scan and the chunked slices — the
     bitwise equivalence between those paths rests on this being one
     function."""
+    mkw = {} if pad_lengths is None else {"pad_lengths": pad_lengths}
 
     def decode_step(carry, step_rng):
         cache, token, position, done = carry
         positions = jnp.broadcast_to(position[:, None], (b, 1))
         logits, mutated = model.apply(
             {"params": params, "cache": cache}, token[:, None], positions,
-            mutable=["cache"])
+            mutable=["cache"], **mkw)
         logits = logits[:, 0]
         next_token = _sample_logits(logits, step_rng, temperature,
                                     top_k, top_p)
@@ -178,14 +222,15 @@ def _make_decode_step(model, params, b, temperature, eos_id, top_k,
 @functools.partial(
     jax.jit,
     static_argnames=("model", "temperature", "eos_id", "top_k", "top_p"))
-def _decode_chunk_jit(model, params, carry, step_rngs, *,
+def _decode_chunk_jit(model, params, carry, step_rngs, pad_lengths, *,
                       temperature: float, eos_id: Optional[int],
                       top_k: Optional[int], top_p: Optional[float]):
     """One K-token decode slice (K = step_rngs length, static by
     shape). The SAME decode_step as the monolithic scan
     (_make_decode_step); the carry round-trips between slices."""
     decode_step = _make_decode_step(model, params, carry[1].shape[0],
-                                    temperature, eos_id, top_k, top_p)
+                                    temperature, eos_id, top_k, top_p,
+                                    pad_lengths)
     carry, (tokens, logits) = jax.lax.scan(decode_step, carry, step_rngs)
     return carry, tokens.swapaxes(0, 1), logits.swapaxes(0, 1)
 
@@ -202,6 +247,7 @@ def generate(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     chunk_tokens: Optional[int] = None,
+    prompt_lengths: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Generate ``max_new_tokens`` continuations of ``prompt_ids``.
 
@@ -212,6 +258,20 @@ def generate(
     (shapes stay static; callers trim). ``top_k``/``top_p`` truncate
     the sampling distribution (nucleus sampling); both only apply when
     ``temperature > 0``.
+
+    ``prompt_lengths`` — batched mixed-length decode: ``[B]`` true
+    per-row token counts, with ``prompt_ids`` LEFT-padded (each row's
+    real tokens right-aligned; pad ids are arbitrary). Per-row
+    position offsets + a cache-slot mask make every row's computation
+    attend over exactly its own tokens at its own positions, so row i
+    of a batch equals the same prompt run alone at B=1. None = every
+    row is full-width (the classic path).
+
+    ``rng`` — one PRNG key (``[2]``: the whole batch shares one
+    per-step stream, the classic behavior), or per-row keys
+    (``[B, 2]``: row i samples from ``rng[i]``'s stream, so a request
+    coalesced into a batch reproduces its B=1 tokens bitwise — the
+    serving batcher's contract).
 
     ``chunk_tokens`` — decode-slicing for SHARED executors (the
     serving head-of-line fix, PERF.md r5): instead of one monolithic
@@ -233,9 +293,27 @@ def generate(
         raise ValueError(f"chunk_tokens must be >= 1, got {chunk_tokens}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    pad_lengths = None
+    if prompt_lengths is not None:
+        prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
+        if prompt_lengths.shape != (prompt_ids.shape[0],):
+            raise ValueError(
+                f"prompt_lengths shape {prompt_lengths.shape} != "
+                f"(batch,) = ({prompt_ids.shape[0]},)")
+        # Host-side range check (values are concrete here — generate
+        # is an eager wrapper): an out-of-range length would silently
+        # shift every RoPE position / unmask garbage cache slots
+        # instead of erroring.
+        lo, hi = int(jnp.min(prompt_lengths)), int(jnp.max(prompt_lengths))
+        if lo < 1 or hi > prompt_ids.shape[1]:
+            raise ValueError(
+                f"prompt_lengths must be in [1, {prompt_ids.shape[1]}] "
+                f"(the padded prompt width); got range [{lo}, {hi}]")
+        pad_lengths = prompt_ids.shape[1] - prompt_lengths
     cache = init_cache(model, params, prompt_ids.shape[0])
     if not chunk_tokens or chunk_tokens >= max_new_tokens:
         return _generate_jit(model, params, prompt_ids, rng, cache,
+                             pad_lengths,
                              max_new_tokens=max_new_tokens,
                              temperature=temperature, eos_id=eos_id,
                              top_k=top_k, top_p=top_p)
@@ -243,7 +321,7 @@ def generate(
     # The SAME rng stream as the monolithic path (split once over
     # max_new_tokens), padded to whole slices — padding steps produce
     # trimmed tokens only, so outputs match bitwise.
-    step_rngs = jax.random.split(rng, max_new_tokens)
+    step_rngs = _split_step_rngs(rng, max_new_tokens)
     n_decode = max_new_tokens - 1
     n_chunks = -(-n_decode // chunk_tokens)
     pad = n_chunks * chunk_tokens - n_decode
@@ -252,13 +330,14 @@ def generate(
     sample_kw = dict(temperature=temperature, eos_id=eos_id,
                      top_k=top_k, top_p=top_p)
     carry, last_logits = _prefill_jit(
-        model, params, prompt_ids, step_rngs[0], cache, **sample_kw)
+        model, params, prompt_ids, step_rngs[0], cache, pad_lengths,
+        **sample_kw)
     tokens_out = [carry[1][:, None]]
     logits_out = [last_logits[:, None]]
     for c in range(n_chunks):
         rngs = decode_rngs[c * chunk_tokens:(c + 1) * chunk_tokens]
         carry, toks, logs = _decode_chunk_jit(
-            model, params, carry, rngs, **sample_kw)
+            model, params, carry, rngs, pad_lengths, **sample_kw)
         tokens_out.append(toks)
         logits_out.append(logs)
         # The yield point: wait for THIS slice before dispatching the

@@ -94,7 +94,7 @@ class LlamaAttention(nn.Module):
     lora_alpha: float = 16.0
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, pad_lengths=None):
         b, l, d_model = x.shape
         q = _dense(self.num_heads * self.head_dim, ("embed", "heads"),
                    self.dtype, "q_proj", self.lora_rank, self.lora_alpha)(x)
@@ -114,6 +114,15 @@ class LlamaAttention(nn.Module):
                     "the decode path always uses dense attention over the "
                     "cache, which would silently replace a sequence-"
                     "parallel attention_fn")
+        elif pad_lengths is not None:
+            # Left-padding is a decode-path concept (batched generation
+            # coalesces mixed-length prompts); the training/full-forward
+            # paths have no cache slots to mask, and silently ignoring
+            # the argument would attend over pad garbage.
+            raise ValueError(
+                "pad_lengths requires a cache_size model (batched "
+                "generation left-pads into the KV cache)")
+        if self.cache_size:
             # Decode path: append this call's K/V into the static-size
             # cache at the running index, attend over the valid prefix.
             # All shapes static (TPU rule); validity is arithmetic.
@@ -136,6 +145,14 @@ class LlamaAttention(nn.Module):
             valid = (jnp.arange(self.cache_size)[None, :]
                      < (start + l)).astype(jnp.int32)
             valid = jnp.broadcast_to(valid, (b, self.cache_size))
+            if pad_lengths is not None:
+                # Batched mixed-length prompts are LEFT-padded: row i's
+                # first pad_lengths[i] cache slots hold pad-token K/V
+                # that must never receive attention mass. Slot order
+                # still equals time order per row (pads are "earliest"),
+                # so the scalar causal q_offset stays correct.
+                valid = valid * (jnp.arange(self.cache_size)[None, :]
+                                 >= pad_lengths[:, None]).astype(jnp.int32)
             out = dense_attention(
                 q, cached_k.value, cached_v.value, causal=True,
                 q_offset=start, kv_offset=0, kv_segment_valid=valid)
@@ -166,14 +183,14 @@ class LlamaBlock(nn.Module):
     lora_alpha: float = 16.0
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, pad_lengths=None):
         h = RMSNorm(dtype=self.dtype, name="attn_norm")(x)
         x = x + LlamaAttention(
             self.num_heads, self.num_kv_heads, self.head_dim,
             self.rope_theta, self.dtype, self.attention_fn,
             self.cache_size, self.lora_rank, self.lora_alpha,
             name="attention",
-        )(h, positions)
+        )(h, positions, pad_lengths)
         h = RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
         if self.num_experts > 0:
             return x + MoE(
@@ -209,7 +226,12 @@ class Llama(nn.Module):
     lora_alpha: float = 16.0
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, train=True):
+    def __call__(self, input_ids, positions=None, train=True,
+                 pad_lengths=None):
+        """``pad_lengths`` (optional, [B] int32, cache models only):
+        per-row count of LEFT-pad slots in a batched mixed-length
+        decode — those cache slots are masked out of attention
+        (inference/generate.py owns the matching position offsets)."""
         del train
         b, l = input_ids.shape
         if positions is None:
@@ -234,7 +256,7 @@ class Llama(nn.Module):
                 self.num_experts, self.num_selected, self.cache_size,
                 self.lora_rank, self.lora_alpha,
                 name=f"layer_{i}",
-            )(x, positions)
+            )(x, positions, pad_lengths)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)
         logits = _dense(self.vocab_size, ("embed", "vocab"), jnp.float32,
                         "lm_head")(x.astype(jnp.float32))

@@ -81,13 +81,18 @@ class KubectlClient:
         return json.loads(out)
 
     def list(self, kind: str, namespace: Optional[str] = None,
-             label_selector: Optional[Dict[str, str]] = None
+             label_selector: Optional[Dict[str, str]] = None,
+             field_selector: Optional[Dict[str, str]] = None
              ) -> List[Dict[str, Any]]:
         args = ["get", self._resource(kind), "-o", "json"]
         args += ["-n", namespace] if namespace else ["--all-namespaces"]
         if label_selector:
             args += ["-l", ",".join(f"{k}={v}"
                                     for k, v in label_selector.items())]
+        if field_selector:
+            args += ["--field-selector",
+                     ",".join(f"{k}={v}"
+                              for k, v in field_selector.items())]
         return json.loads(self._run(*args)).get("items", [])
 
     def patch(self, kind: str, namespace: str, name: str,

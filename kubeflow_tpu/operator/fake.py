@@ -60,6 +60,25 @@ def _labels_match(obj: Dict[str, Any],
     return True
 
 
+def _fields_match(obj: Dict[str, Any],
+                  selector: Optional[Dict[str, str]]) -> bool:
+    """k8s field-selector subset: dotted-path equality against the
+    object (``involvedObject.name=myjob``, ``metadata.namespace=ns``).
+    Like the apiserver, comparison is on string representations and a
+    missing path only matches the empty string."""
+    if not selector:
+        return True
+    for path, want in selector.items():
+        node: Any = obj
+        for part in path.split("."):
+            node = node.get(part) if isinstance(node, dict) else None
+            if node is None:
+                break
+        if str(node if node is not None else "") != str(want):
+            return False
+    return True
+
+
 class FakeApiServer:
     # Events retained for watch resume; older revisions answer Gone,
     # like a real apiserver compacting its watch cache.
@@ -108,7 +127,8 @@ class FakeApiServer:
                 raise NotFound(f"{kind} {namespace}/{name}") from None
 
     def list(self, kind: str, namespace: Optional[str] = None,
-             label_selector: Optional[Dict[str, str]] = None
+             label_selector: Optional[Dict[str, str]] = None,
+             field_selector: Optional[Dict[str, str]] = None
              ) -> List[Dict[str, Any]]:
         with self._lock:
             out = []
@@ -118,6 +138,8 @@ class FakeApiServer:
                 if namespace is not None and ns != namespace:
                     continue
                 if not _labels_match(obj, label_selector):
+                    continue
+                if not _fields_match(obj, field_selector):
                     continue
                 out.append(copy.deepcopy(obj))
             return out
@@ -188,14 +210,15 @@ class FakeApiServer:
             return self._revision
 
     def list_with_version(self, kind: str, namespace: Optional[str] = None,
-                          label_selector: Optional[Dict[str, str]] = None
+                          label_selector: Optional[Dict[str, str]] = None,
+                          field_selector: Optional[Dict[str, str]] = None
                           ) -> Tuple[List[Dict[str, Any]], int]:
         """(items, revision horizon) under one lock acquisition —
         watching from the returned revision replays exactly the
         events after this list (same contract as HttpApiClient)."""
         with self._lock:
-            return self.list(kind, namespace, label_selector), \
-                self._revision
+            return self.list(kind, namespace, label_selector,
+                             field_selector), self._revision
 
     def watch(self, kind: str, namespace: Optional[str] = None,
               resource_version: int = 0,

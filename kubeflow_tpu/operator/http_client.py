@@ -157,22 +157,32 @@ class HttpApiClient:
                         for k, v in label_selector.items())
 
     def list(self, kind: str, namespace: Optional[str] = None,
-             label_selector: Optional[Dict[str, Optional[str]]] = None
+             label_selector: Optional[Dict[str, Optional[str]]] = None,
+             field_selector: Optional[Dict[str, str]] = None
              ) -> List[Dict[str, Any]]:
-        return self.list_with_version(kind, namespace, label_selector)[0]
+        return self.list_with_version(kind, namespace, label_selector,
+                                      field_selector)[0]
 
     def list_with_version(self, kind: str,
                           namespace: Optional[str] = None,
                           label_selector: Optional[
-                              Dict[str, Optional[str]]] = None
+                              Dict[str, Optional[str]]] = None,
+                          field_selector: Optional[Dict[str, str]] = None
                           ) -> Tuple[List[Dict[str, Any]], int]:
         """(items, collection resourceVersion) — the version is the
         watch resume horizon: watching from it replays exactly the
-        events after this list."""
+        events after this list. ``field_selector`` filters server-side
+        (``fieldSelector=involvedObject.name=myjob``) so e.g. a
+        dashboard event query never lists a whole busy namespace."""
         url = self._path(kind, namespace)
+        params = {}
         if label_selector:
-            url += "?" + urllib.parse.urlencode({
-                "labelSelector": self._selector(label_selector)})
+            params["labelSelector"] = self._selector(label_selector)
+        if field_selector:
+            params["fieldSelector"] = ",".join(
+                f"{k}={v}" for k, v in field_selector.items())
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
         body = self._json("GET", url)
         version = int(
             body.get("metadata", {}).get("resourceVersion", 0) or 0)

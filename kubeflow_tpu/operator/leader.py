@@ -115,9 +115,26 @@ class LeaderElector:
         if not renew:
             return True
         try:
+            # client-go writes RFC3339 with a trailing 'Z', which
+            # Python 3.10's fromisoformat (this package's floor)
+            # rejects — and "unparseable" means "expired", i.e. a LIVE
+            # Go-held lease would be stolen every tick (two leaders).
+            # Map it to the +00:00 spelling 3.10 accepts.
+            if isinstance(renew, str) and renew.endswith(("Z", "z")):
+                renew = renew[:-1] + "+00:00"
             renewed = datetime.datetime.fromisoformat(renew)
-        except ValueError:
+        except (TypeError, ValueError):
+            # Unparseable renewTime (or a non-string) = no live renewal.
             return True
+        if renewed.tzinfo is None:
+            # Non-Python holders (client-go writes RFC3339, but other
+            # writers exist) may store an offset-less timestamp; k8s
+            # times are UTC by convention. Normalize instead of letting
+            # the aware-vs-naive comparison raise TypeError below —
+            # which the loop would count toward MAX_CONSECUTIVE_ERRORS
+            # and eventually declare the elector broken over a peer's
+            # formatting.
+            renewed = renewed.replace(tzinfo=datetime.timezone.utc)
         duration = float(spec.get("leaseDurationSeconds", 15))
         return _now() >= renewed + datetime.timedelta(seconds=duration)
 
@@ -204,11 +221,23 @@ class LeaderElector:
         # instead of waiting out the lease duration.
         if self._leader.is_set():
             self._leader.clear()
+
+            def release(obj: Dict[str, Any]) -> None:
+                # Guarded like take(): leadership may have been lost
+                # between the last tick and shutdown (lease expired, a
+                # peer took over) — releasing unconditionally would
+                # zero the LIVE peer's lease and hand a second
+                # follower an instant takeover (brief two-leader
+                # window). Raising before any mutation aborts the
+                # write cleanly on every client.
+                s = obj.setdefault("spec", {})
+                if s.get("holderIdentity") != self.identity:
+                    raise _LostRace(s.get("holderIdentity"))
+                s["holderIdentity"] = ""
+                s["renewTime"] = None
+
             try:
-                self.api.patch(
-                    "Lease", self.namespace, self.name,
-                    lambda o: o.setdefault("spec", {}).update(
-                        {"holderIdentity": "",
-                         "renewTime": None}))
+                self.api.patch("Lease", self.namespace, self.name,
+                               release)
             except Exception:  # noqa: BLE001 — best-effort release
                 pass

@@ -55,16 +55,30 @@ _GENERATE_CONFIG_COERCERS = {
     "eos_id": int,
     "seed": int,
     "deterministic": bool,
+    "decode_chunk_tokens": int,
 }
 
 
 def validate_generate_config(config: Dict[str, Any]) -> Dict[str, Any]:
-    unknown = sorted(set(config) - set(_GENERATE_CONFIG_COERCERS))
+    unknown = sorted(set(config) - set(_GENERATE_CONFIG_COERCERS)
+                     - {"prompt_buckets"})
     if unknown:
         raise ValueError(
             f"unknown generate config keys {unknown}; supported: "
-            f"{sorted(_GENERATE_CONFIG_COERCERS)}")
+            f"{sorted(_GENERATE_CONFIG_COERCERS) + ['prompt_buckets']}")
     out: Dict[str, Any] = {}
+    config = dict(config)
+    if "prompt_buckets" in config:
+        # Serving prompt-length buckets (list, not a scalar — handled
+        # outside the coercer table): positive ints, deduped ascending.
+        buckets = config.pop("prompt_buckets")
+        if (not isinstance(buckets, (list, tuple)) or not buckets
+                or any(isinstance(v, bool) or not isinstance(v, int)
+                       or v < 1 for v in buckets)):
+            raise ValueError(
+                f"generate config 'prompt_buckets' must be a non-empty "
+                f"list of positive integers; got {buckets!r}")
+        out["prompt_buckets"] = sorted(set(int(v) for v in buckets))
     for key, value in config.items():
         coerce = _GENERATE_CONFIG_COERCERS[key]
         if coerce is bool:
@@ -99,6 +113,10 @@ def validate_generate_config(config: Dict[str, Any]) -> Dict[str, Any]:
     if "max_new_tokens" in out and out["max_new_tokens"] < 1:
         raise ValueError(
             f"max_new_tokens must be >= 1; got {out['max_new_tokens']}")
+    if "decode_chunk_tokens" in out and out["decode_chunk_tokens"] < 1:
+        raise ValueError(
+            f"decode_chunk_tokens must be >= 1; got "
+            f"{out['decode_chunk_tokens']}")
     if "temperature" in out and out["temperature"] < 0.0:
         raise ValueError(
             f"temperature must be >= 0; got {out['temperature']}")

@@ -24,7 +24,11 @@ primary flow (tf-serving.libsonnet:110) — and swaps in new versions
 atomically; a native request queue micro-batches predict calls so the
 TPU runs saturated batch buckets instead of per-request executions
 (the reference served one session-run per request — this is the main
-serving-throughput win of the rebuild).
+serving-throughput win of the rebuild). Generate requests ride the
+same queue: concurrent decodes coalesce into ONE KV-cache dispatch
+(mixed-length prompts left-pad to a bucket; per-request rng keys keep
+each request's tokens equal to its sequential B=1 run) — decode is
+HBM-bound, so the extra rows are near-free throughput.
 """
 
 from __future__ import annotations
@@ -363,10 +367,14 @@ class ServedModel:
             input_name = next(iter(sig.inputs))
             arrays = [np.asarray(g[0][input_name]) for g in group]
             counts = [a.shape[0] for a in arrays]
-            batch = np.concatenate(arrays) if len(arrays) > 1 else arrays[0]
-            self._stat_batches += 1
-            self._stat_rows += int(batch.shape[0])
-            out = model.run({input_name: batch}, sig_name, method)
+            if (method or getattr(sig, "method", None)) == "generate":
+                out = self._run_generate_group(model, sig_name, method,
+                                               input_name, arrays, counts)
+            else:
+                batch = (np.concatenate(arrays) if len(arrays) > 1
+                         else arrays[0])
+                self._count_executions(int(batch.shape[0]))
+                out = model.run({input_name: batch}, sig_name, method)
             offset = 0
             for future, count in zip(futures, counts):
                 sliced = {k: v[offset:offset + count] for k, v in out.items()}
@@ -376,6 +384,41 @@ class ServedModel:
             for future in futures:
                 if not future.done():
                     future.set_exception(e)
+
+    def _run_generate_group(self, model, sig_name, method, input_name,
+                            arrays, counts):
+        """Coalesce concurrent generate requests into ONE decode
+        dispatch: decode is HBM-bound (each step streams the whole
+        weight set), so rows are near-free — the same lever the
+        predict batcher exploits, applied to the KV-cache path.
+        Mixed-length prompts LEFT-pad to the widest request here (the
+        model pads on to its length bucket); each request keeps its
+        own per-row rng keys, so its rows match a sequential B=1 run
+        whatever batch the coalescer placed them in."""
+        max_len = max(a.shape[1] for a in arrays)
+        lengths = np.concatenate(
+            [np.full((a.shape[0],), a.shape[1], np.int32)
+             for a in arrays])
+        padded = [np.pad(a, ((0, 0), (max_len - a.shape[1], 0)))
+                  if a.shape[1] < max_len else a for a in arrays]
+        batch = np.concatenate(padded) if len(padded) > 1 else padded[0]
+        # Keys are minted per REQUEST (row index resets at each
+        # request boundary): deterministic exports replay per request,
+        # not per batch position.
+        rngs = np.concatenate([model.request_rngs(c) for c in counts])
+        self._count_executions(int(batch.shape[0]))
+        return model.run({input_name: batch}, sig_name, method,
+                         prompt_lengths=lengths, row_rngs=rngs)
+
+    def _count_executions(self, rows: int) -> None:
+        """batch_stats accounting: pop_batch caps REQUEST count at
+        max_batch, but multi-row requests can push the group's row
+        total past it, and model.run() then splits into
+        ceil(rows/max_batch) separate XLA executions — count those,
+        not 1, or mean_fill could report an impossible > max_batch
+        and the coalescing contract (< N dispatches) would overstate."""
+        self._stat_batches += -(-rows // self.max_batch)
+        self._stat_rows += rows
 
 
 class ModelManager:

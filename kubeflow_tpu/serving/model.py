@@ -99,29 +99,24 @@ class LoadedModel:
                 scores, classes = jax.lax.top_k(probs, self.top_k)
                 return {"classes": classes, "scores": scores}
 
-            def generate_fn(variables, x):
+            def generate_fn(variables, x, lengths, rngs):
                 # inference/generate.py jits internally (trace-cached
                 # on model + shapes + config); config is fixed at
-                # export time so every bucket compiles exactly once.
-                # The rng is a *traced* argument, so folding the
-                # request counter in costs zero recompiles — sampling
-                # yields fresh completions per request unless the
-                # export pins `deterministic: true` (replayable
-                # serving for goldens/CI).
+                # export time so every (batch bucket, length bucket)
+                # compiles exactly once. ``lengths``/``rngs`` are
+                # *traced* arguments ([B] true prompt lengths of the
+                # left-padded rows, [B, 2] per-row sampling keys), so
+                # coalescing mixed-length requests and folding request
+                # counters costs zero recompiles.
                 from kubeflow_tpu.inference.generate import generate
 
                 cfg = self.metadata.generate_config
-                rng = jax.random.PRNGKey(int(cfg.get("seed", 0)))
-                if not cfg.get("deterministic", False):
-                    with self._gen_lock:
-                        self._gen_counter += 1
-                        rng = jax.random.fold_in(rng, self._gen_counter)
                 chunk = cfg.get("decode_chunk_tokens")
                 tokens, _ = generate(
                     module, variables["params"], x,
                     max_new_tokens=int(cfg.get("max_new_tokens", 32)),
                     temperature=float(cfg.get("temperature", 0.0)),
-                    rng=rng,
+                    rng=jnp.asarray(rngs),
                     eos_id=cfg.get("eos_id"),
                     top_k=cfg.get("top_k"),
                     top_p=cfg.get("top_p"),
@@ -129,7 +124,8 @@ class LoadedModel:
                     # host sync between them, so classify batches on
                     # the same executor interleave instead of queueing
                     # behind the whole decode.
-                    chunk_tokens=int(chunk) if chunk else None)
+                    chunk_tokens=int(chunk) if chunk else None,
+                    prompt_lengths=jnp.asarray(lengths))
                 return {"tokens": tokens}
 
             if method == "generate":
@@ -139,8 +135,8 @@ class LoadedModel:
                 self._predict_cache[key] = jax.jit(fn)
         return self._predict_cache[key]
 
-    def _prepare(self, signature: Signature,
-                 inputs: Dict[str, np.ndarray]) -> Tuple[np.ndarray, int]:
+    def _prepare(self, signature: Signature, inputs: Dict[str, np.ndarray],
+                 variable_length: bool = False) -> Tuple[np.ndarray, int]:
         (name, spec), = signature.inputs.items()  # single-input models
         if name not in inputs:
             raise ValueError(
@@ -148,14 +144,61 @@ class LoadedModel:
         x = np.asarray(inputs[name], dtype=_NP_DTYPES[spec.dtype])
         expected = tuple(spec.shape[1:])
         if x.shape[1:] != expected:
-            raise ValueError(
-                f"input {name!r} shape {x.shape[1:]} != signature {expected}")
+            # Generate signatures treat the exported prompt length as
+            # a MAXIMUM: shorter prompts are admitted and padded to a
+            # length bucket (mixed-length micro-batching).
+            short_ok = (variable_length and len(expected) == 1
+                        and x.ndim == 2 and 1 <= x.shape[1] <= expected[0])
+            if not short_ok:
+                raise ValueError(
+                    f"input {name!r} shape {x.shape[1:]} != signature "
+                    f"{expected}" + (" (generate prompts may be shorter "
+                                     "than the signature max, never "
+                                     "longer)" if variable_length else ""))
         return x, x.shape[0]
+
+    def _length_bucket(self, n: int, max_len: int) -> int:
+        """Prompt-length bucket: the export's ``prompt_buckets`` list
+        when present, else powers of two — either way capped at the
+        signature max, so the compile count stays bounded however many
+        distinct prompt lengths traffic brings."""
+        buckets = self.metadata.generate_config.get("prompt_buckets")
+        if buckets:
+            for b in sorted(int(v) for v in buckets):
+                if b >= n:
+                    return min(b, max_len)
+            return max_len
+        return _bucket(n, max_len)  # same pow-2-capped policy as rows
+
+    def request_rngs(self, n: int) -> np.ndarray:
+        """Per-row sampling keys ``[n, 2]`` for one request's rows:
+        row i gets ``fold_in(base, i)``, where base folds a process-
+        wide request counter (fresh completions per request) unless
+        the export pins ``deterministic: true`` (replayable serving
+        for goldens/CI). Keys are per-ROW so a request's outputs don't
+        depend on where the batcher placed it inside a coalesced
+        batch."""
+        cfg = self.metadata.generate_config
+        base = jax.random.PRNGKey(int(cfg.get("seed", 0)))
+        if not cfg.get("deterministic", False):
+            with self._gen_lock:
+                self._gen_counter += 1
+                counter = self._gen_counter
+            base = jax.random.fold_in(base, counter)
+        return np.asarray(
+            jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(n)))
 
     def run(self, inputs: Dict[str, np.ndarray],
             signature_name: Optional[str] = None,
-            method: Optional[str] = None) -> Dict[str, np.ndarray]:
-        """Execute one (possibly already micro-batched) request batch."""
+            method: Optional[str] = None, *,
+            prompt_lengths: Optional[np.ndarray] = None,
+            row_rngs: Optional[np.ndarray] = None) -> Dict[str, np.ndarray]:
+        """Execute one (possibly already micro-batched) request batch.
+
+        Generate-method extras (the batcher's coalescing contract):
+        ``prompt_lengths`` [n] true token counts of LEFT-padded rows
+        (None = every row is full-width), ``row_rngs`` [n, 2] per-row
+        sampling keys (None = mint fresh ones via request_rngs)."""
         sig = self.signature(signature_name)
         method = method or sig.method
         if (method == "generate") != (sig.method == "generate"):
@@ -166,22 +209,67 @@ class LoadedModel:
             raise ValueError(
                 f"method {method!r} incompatible with signature method "
                 f"{sig.method!r}")
-        x, n = self._prepare(sig, inputs)
+        x, n = self._prepare(sig, inputs, variable_length=(
+            method == "generate"))
         if n == 0:
             raise ValueError("empty batch")
+        if method == "generate":
+            if prompt_lengths is None:
+                prompt_lengths = np.full((n,), x.shape[1], np.int32)
+            else:
+                prompt_lengths = np.asarray(prompt_lengths, np.int32)
+                if prompt_lengths.shape != (n,):
+                    raise ValueError(
+                        f"prompt_lengths shape {prompt_lengths.shape} "
+                        f"!= ({n},)")
+            row_rngs = (self.request_rngs(n) if row_rngs is None
+                        else np.asarray(row_rngs))
         if n > self.max_batch:
             # Split oversized requests; concatenate results.
             outs: List[Dict[str, np.ndarray]] = []
             for i in range(0, n, self.max_batch):
+                sl = slice(i, i + self.max_batch)
                 outs.append(self.run(
-                    {next(iter(sig.inputs)): x[i:i + self.max_batch]},
-                    signature_name, method))
+                    {next(iter(sig.inputs)): x[sl]}, signature_name,
+                    method,
+                    prompt_lengths=(None if prompt_lengths is None
+                                    else prompt_lengths[sl]),
+                    row_rngs=None if row_rngs is None else row_rngs[sl]))
             return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
         bucket = _bucket(n, self.max_batch)
+        if method == "generate":
+            return self._run_generate(sig, x, n, bucket, prompt_lengths,
+                                      row_rngs)
         if n < bucket:
             pad = np.zeros((bucket - n, *x.shape[1:]), dtype=x.dtype)
             x = np.concatenate([x, pad])
         out = self._jitted(method, bucket)(self.variables, x)
+        return {k: np.asarray(v)[:n] for k, v in out.items()}
+
+    def _run_generate(self, sig: Signature, x: np.ndarray, n: int,
+                      bucket: int, prompt_lengths: np.ndarray,
+                      row_rngs: np.ndarray) -> Dict[str, np.ndarray]:
+        """One coalesced decode dispatch: pad the prompt axis (LEFT)
+        to a length bucket and the batch axis to its power-of-two
+        bucket, run generate once, trim both paddings."""
+        (_, spec), = sig.inputs.items()
+        target_len = self._length_bucket(x.shape[1], spec.shape[1])
+        if x.shape[1] < target_len:
+            x = np.pad(x, ((0, 0), (target_len - x.shape[1], 0)))
+        if n < bucket:
+            # Pad rows are full-length zero prompts with throwaway
+            # keys; their tokens are trimmed below.
+            x = np.concatenate(
+                [x, np.zeros((bucket - n, x.shape[1]), x.dtype)])
+            prompt_lengths = np.concatenate(
+                [prompt_lengths,
+                 np.full((bucket - n,), x.shape[1], np.int32)])
+            row_rngs = np.concatenate(
+                [row_rngs,
+                 np.zeros((bucket - n, *row_rngs.shape[1:]),
+                          row_rngs.dtype)])
+        out = self._jitted("generate", bucket)(
+            self.variables, x, prompt_lengths, row_rngs)
         return {k: np.asarray(v)[:n] for k, v in out.items()}
 
     def warmup(self) -> None:
@@ -196,13 +284,28 @@ class LoadedModel:
         (name, spec), = sig.inputs.items()
         methods = (("generate",) if sig.method == "generate"
                    else ("predict", "classify"))
+        # Generate models also warm every explicitly-exported prompt
+        # bucket (generate_config.prompt_buckets): the config author
+        # opted into that compile bill to keep mixed-length traffic
+        # off the cold-compile cliff. Without the knob only the
+        # full-length program warms; shorter power-of-two length
+        # buckets compile lazily on first use.
+        lengths = [spec.shape[1]]
+        if sig.method == "generate":
+            lengths = sorted({
+                min(int(v), spec.shape[1])
+                for v in self.metadata.generate_config.get(
+                    "prompt_buckets", ())} | {spec.shape[1]})
         bucket = 1
         while True:
-            x = np.zeros((bucket, *spec.shape[1:]),
-                         dtype=_NP_DTYPES[spec.dtype])
-            for method in methods:
-                out = self._jitted(method, bucket)(self.variables, x)
-                jax.block_until_ready(out)
+            for length in lengths:
+                x = np.zeros((bucket, length) if sig.method == "generate"
+                             else (bucket, *spec.shape[1:]),
+                             dtype=_NP_DTYPES[spec.dtype])
+                for method in methods:
+                    # Through run(): the warmed program is exactly the
+                    # one traffic executes (np.asarray = host fence).
+                    self.run({name: x}, method=method)
             if bucket >= self.max_batch:
                 break
             bucket = min(bucket * 2, self.max_batch)

@@ -201,8 +201,14 @@ def fit(
             ckpt.wait()
     finally:
         for sig, handler in prev_handlers.items():
-            if handler is not None:  # None = prior handler was C-level
-                signal.signal(sig, handler)
+            # getsignal/signal return None when the prior handler was
+            # installed at C level — unrepresentable in Python, so the
+            # closest restore is SIG_DFL. Leaving _on_drain installed
+            # instead would bind future signals to THIS completed
+            # run's Event: a later SIGTERM sets an orphaned flag and
+            # the process silently ignores its own termination.
+            signal.signal(sig, signal.SIG_DFL if handler is None
+                          else handler)
         if profiling:
             jax.profiler.stop_trace()
         if ckpt:

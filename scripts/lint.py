@@ -175,6 +175,29 @@ def check_boilerplate(fix: bool = False) -> list:
     return errors
 
 
+def check_license_file() -> list:
+    """Every source header says "obtain a copy of the License at ..."
+    — the repo must actually SHIP that license (VERDICT r5 item 6):
+    LICENSE at the root with the Apache-2.0 terms, cited from
+    pyproject.toml's license field."""
+    errors = []
+    license_path = REPO / "LICENSE"
+    if not license_path.is_file():
+        return ["license: LICENSE file missing at repo root (every "
+                "source header cites the Apache-2.0 license)"]
+    text = license_path.read_text()
+    for needle in ("Apache License", "Version 2.0",
+                   "TERMS AND CONDITIONS FOR USE"):
+        if needle not in text:
+            errors.append(f"license: LICENSE is not the Apache-2.0 "
+                          f"text (missing {needle!r})")
+    if 'license = {file = "LICENSE"}' not in (
+            REPO / "pyproject.toml").read_text():
+        errors.append("license: pyproject.toml must declare "
+                      'license = {file = "LICENSE"}')
+    return errors
+
+
 def check_unused_imports() -> list:
     errors = []
     for f in iter_py_files():
@@ -235,7 +258,8 @@ def main() -> int:
 
     errors = []
     for check in (check_syntax, check_imports_all_modules, check_cli_boots,
-                  check_unused_imports, check_boilerplate):
+                  check_unused_imports, check_boilerplate,
+                  check_license_file):
         found = check()
         print(f"{check.__name__}: {'ok' if not found else f'{len(found)} errors'}")
         errors.extend(found)

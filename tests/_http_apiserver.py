@@ -60,6 +60,18 @@ def _parse_selector(query):
     return out
 
 
+def _parse_field_selector(query):
+    """fieldSelector → dict of dotted-path equality terms, matching
+    FakeApiServer._fields_match."""
+    if "fieldSelector" not in query:
+        return None
+    out = {}
+    for pair in query["fieldSelector"][0].split(","):
+        key, _, value = pair.partition("=")
+        out[key] = value
+    return out
+
+
 class _Handler(BaseHTTPRequestHandler):
     # HTTP/1.0: close-delimited bodies, so the watch stream needs no
     # chunked framing — urllib reads lines as they flush.
@@ -142,7 +154,7 @@ class _Handler(BaseHTTPRequestHandler):
         if query.get("watch", ["0"])[0] in ("1", "true"):
             return self._watch(kind, ns, query)
         items, version = self.fake.list_with_version(
-            kind, ns, _parse_selector(query))
+            kind, ns, _parse_selector(query), _parse_field_selector(query))
         return self._send(200, {
             "kind": f"{kind}List",
             "items": items,

@@ -176,6 +176,73 @@ class DashboardTest(tornado.testing.AsyncHTTPTestCase):
         assert "slice fault" in page
         assert "stale incarnation" not in page
 
+    def test_event_listing_uses_field_selector(self):
+        """ADVICE r5: each detail-page click must NOT list every Event
+        in the namespace — the name filter runs server-side via
+        fieldSelector; clients without the parameter fall back to a
+        capped client-side filter."""
+        import kubeflow_tpu.dashboard.server as dash
+
+        job = {"metadata": {"name": "mnist", "namespace": "default",
+                            "uid": "u1"}}
+        for i in range(3):
+            self.api.create({
+                "apiVersion": "v1", "kind": "Event",
+                "metadata": {"name": f"mnist.{i}",
+                             "namespace": "default"},
+                "involvedObject": {"kind": KIND, "name": "mnist",
+                                   "uid": "u1"},
+                "reason": f"Mine{i}", "type": "Normal", "message": "",
+                "count": 1,
+                "lastTimestamp": f"2026-08-01T00:00:0{i}"})
+        for i in range(6):
+            self.api.create({
+                "apiVersion": "v1", "kind": "Event",
+                "metadata": {"name": f"noise.{i}",
+                             "namespace": "default"},
+                "involvedObject": {"kind": KIND, "name": "other",
+                                   "uid": "u2"},
+                "reason": "Noise", "type": "Normal", "message": "",
+                "count": 1,
+                "lastTimestamp": f"2020-01-01T00:00:0{i}"})
+
+        api = self.api
+        selectors = []
+
+        class Spy:
+            def list(self, kind, namespace=None, label_selector=None,
+                     field_selector=None):
+                selectors.append(field_selector)
+                return api.list(kind, namespace, label_selector,
+                                field_selector)
+
+        events = dash._job_events(Spy(), "default", "mnist", job)
+        assert [e["reason"] for e in events] == [
+            "Mine0", "Mine1", "Mine2"]
+        assert selectors == [{"involvedObject.name": "mnist"}]
+
+        class Legacy:
+            """A client predating field_selector: the fallback filters
+            client-side over a CAPPED, newest-first slice."""
+
+            def list(self, kind, namespace=None, label_selector=None):
+                return api.list(kind, namespace, label_selector)
+
+        events = dash._job_events(Legacy(), "default", "mnist", job)
+        assert [e["reason"] for e in events] == [
+            "Mine0", "Mine1", "Mine2"]
+        # Cap: with 9 events and a cap of 4, only the NEWEST 4 are
+        # scanned — the job's (recent) events survive, ancient noise
+        # is never shuttled.
+        old_cap = dash._EVENT_FALLBACK_CAP
+        dash._EVENT_FALLBACK_CAP = 4
+        try:
+            events = dash._job_events(Legacy(), "default", "mnist", job)
+            assert [e["reason"] for e in events] == [
+                "Mine0", "Mine1", "Mine2"]
+        finally:
+            dash._EVENT_FALLBACK_CAP = old_cap
+
     def test_pod_log_tail_proxied(self):
         """Log tails flow through the apiserver client; pods outside
         the job 404 even if they exist (route contract narrower than

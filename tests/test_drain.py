@@ -107,6 +107,32 @@ def test_fit_drains_on_sigterm_and_resumes_bitwise(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_restore_falls_back_to_sig_dfl_for_c_level_prior(monkeypatch):
+    """signal.signal returns None when the prior handler was installed
+    at C level (unrepresentable in Python). The restore must then
+    install SIG_DFL, NOT skip the restore: leaving _on_drain bound to
+    the completed run's Event makes every later SIGTERM set an
+    orphaned flag instead of terminating the process (ADVICE r5)."""
+    from kubeflow_tpu.training import loop as loop_mod
+
+    calls = []
+
+    def fake_signal(sig, handler):
+        calls.append((sig, handler))
+        return None  # simulate a C-level prior handler
+
+    monkeypatch.setattr(loop_mod.signal, "signal", fake_signal)
+    mesh = build_mesh(MeshSpec(data=8))
+    state, step, placed = _setup(mesh)
+    fit(state, step, itertools.repeat(placed),
+        LoopConfig(total_steps=1, log_every=1))
+    installs = [c for c in calls if c[1] not in (signal.SIG_DFL,)]
+    assert installs, "drain handler never installed"
+    assert calls[-1] == (signal.SIGTERM, signal.SIG_DFL), (
+        "prior-None handler must restore to SIG_DFL, got "
+        f"{calls[-1]!r}")
+
+
 def test_fit_without_checkpoint_still_drains(tmp_path):
     """No checkpoint configured: the drain still interrupts promptly
     with checkpointed=False (the operator restarts; the job restarts

@@ -191,6 +191,20 @@ def test_generate_config_validation(tmp_path):
     # bool subclasses int: {"top_k": true} must not become top_k=1.
     with pytest.raises(ValueError, match="int-like"):
         validate_generate_config({"top_k": True})
+    # Serving batching knobs are exportable: decode-slicing K and
+    # prompt-length buckets (deduped ascending).
+    cfg = validate_generate_config(
+        {"decode_chunk_tokens": 16, "prompt_buckets": [512, 128, 128]})
+    assert cfg["decode_chunk_tokens"] == 16
+    assert cfg["prompt_buckets"] == [128, 512]
+    with pytest.raises(ValueError, match="decode_chunk_tokens"):
+        validate_generate_config({"decode_chunk_tokens": 0})
+    with pytest.raises(ValueError, match="prompt_buckets"):
+        validate_generate_config({"prompt_buckets": []})
+    with pytest.raises(ValueError, match="prompt_buckets"):
+        validate_generate_config({"prompt_buckets": [0, 8]})
+    with pytest.raises(ValueError, match="prompt_buckets"):
+        validate_generate_config({"prompt_buckets": "128,512"})
     # And the exporter runs it: a bad config must not produce a
     # version dir.
     with pytest.raises(ValueError, match="unknown generate config"):

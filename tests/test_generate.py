@@ -182,6 +182,113 @@ def test_top_p_zero_collapses_to_greedy_not_token_zero():
     assert (np.asarray(z[0, [0, 1, 3]]) == -np.inf).all()
 
 
+def test_batched_mixed_length_matches_b1():
+    """THE batched-decode contract: a left-padded batch of different-
+    length prompts with per-row rng keys produces, row for row, the
+    same tokens as each prompt run alone at B=1 with its own key —
+    greedy and sampled. This is what lets the serving batcher coalesce
+    concurrent generate requests into one decode dispatch."""
+    model = llama_test(dtype=jnp.float32, cache_size=24)
+    params = _params(llama_test(dtype=jnp.float32),
+                     jnp.zeros((1, 4), jnp.int32))
+    prompts = [
+        jax.random.randint(jax.random.PRNGKey(31), (1, 3), 0, 512),
+        jax.random.randint(jax.random.PRNGKey(32), (1, 7), 0, 512),
+        jax.random.randint(jax.random.PRNGKey(33), (1, 5), 0, 512),
+    ]
+    keys = [jax.random.PRNGKey(100 + i) for i in range(3)]
+    width = max(p.shape[1] for p in prompts)
+    batch = jnp.concatenate([
+        jnp.pad(p, ((0, 0), (width - p.shape[1], 0))) for p in prompts])
+    lengths = jnp.asarray([p.shape[1] for p in prompts])
+
+    for temperature in (0.0, 0.8):
+        singles = [
+            generate(model, params, p, max_new_tokens=6,
+                     temperature=temperature, rng=k[None])[0]
+            for p, k in zip(prompts, keys)
+        ]
+        tokens, logits = generate(
+            model, params, batch, max_new_tokens=6,
+            temperature=temperature, rng=jnp.stack(keys),
+            prompt_lengths=lengths)
+        for i, single in enumerate(singles):
+            np.testing.assert_array_equal(
+                np.asarray(tokens[i]), np.asarray(single[0]),
+                f"row {i} temp {temperature}")
+        assert logits.shape == (3, 6, 512)
+
+
+def test_batched_mixed_length_chunked_matches_monolithic():
+    """Decode-slicing composes with batched mixed-length prompts: the
+    chunked path is still a pure scheduling change."""
+    model = llama_test(dtype=jnp.float32, cache_size=24)
+    params = _params(llama_test(dtype=jnp.float32),
+                     jnp.zeros((1, 4), jnp.int32))
+    batch = jax.random.randint(jax.random.PRNGKey(41), (2, 6), 0, 512)
+    lengths = jnp.asarray([4, 6])
+    rngs = jnp.stack([jax.random.PRNGKey(1), jax.random.PRNGKey(2)])
+    ref_t, ref_l = generate(model, params, batch, max_new_tokens=7,
+                            temperature=0.7, rng=rngs,
+                            prompt_lengths=lengths)
+    for chunk in (1, 3, 7):
+        t, l = generate(model, params, batch, max_new_tokens=7,
+                        temperature=0.7, rng=rngs,
+                        prompt_lengths=lengths, chunk_tokens=chunk)
+        np.testing.assert_array_equal(np.asarray(t), np.asarray(ref_t),
+                                      f"chunk={chunk}")
+        np.testing.assert_allclose(np.asarray(l), np.asarray(ref_l),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_per_row_rng_keys_are_independent_streams():
+    """Two rows with the same prompt but different keys sample
+    different continuations; same keys sample identical ones — the
+    per-row stream property the coalescer's determinism rests on."""
+    model = llama_test(dtype=jnp.float32, cache_size=16)
+    prompt_row = jax.random.randint(jax.random.PRNGKey(6), (1, 4), 0, 512)
+    prompt = jnp.concatenate([prompt_row, prompt_row])
+    params = _params(llama_test(dtype=jnp.float32), prompt)
+    k = jax.random.PRNGKey(5)
+    distinct, _ = generate(model, params, prompt, max_new_tokens=8,
+                           temperature=1.0,
+                           rng=jnp.stack([k, jax.random.PRNGKey(9)]))
+    assert not np.array_equal(np.asarray(distinct[0]),
+                              np.asarray(distinct[1]))
+    same, _ = generate(model, params, prompt, max_new_tokens=8,
+                       temperature=1.0, rng=jnp.stack([k, k]))
+    np.testing.assert_array_equal(np.asarray(same[0]),
+                                  np.asarray(same[1]))
+
+
+def test_prompt_lengths_validates_shape_and_range():
+    model = llama_test(dtype=jnp.float32, cache_size=16)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    params = _params(llama_test(dtype=jnp.float32), prompt)
+    with pytest.raises(ValueError, match="prompt_lengths"):
+        generate(model, params, prompt, max_new_tokens=4,
+                 prompt_lengths=jnp.asarray([4]))
+    # Out-of-range lengths would silently shift RoPE positions /
+    # unmask garbage cache slots — must be a loud error instead.
+    with pytest.raises(ValueError, match="must be in"):
+        generate(model, params, prompt, max_new_tokens=4,
+                 prompt_lengths=jnp.asarray([5, 4]))
+    with pytest.raises(ValueError, match="must be in"):
+        generate(model, params, prompt, max_new_tokens=4,
+                 prompt_lengths=jnp.asarray([0, 4]))
+
+
+def test_pad_lengths_rejected_without_cache():
+    """The training/full-forward path must refuse pad_lengths instead
+    of silently attending over pad garbage."""
+    model = llama_test(dtype=jnp.float32)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    params = _params(model, prompt)
+    with pytest.raises(ValueError, match="pad_lengths"):
+        model.apply({"params": params}, prompt,
+                    pad_lengths=jnp.asarray([1, 0]))
+
+
 def test_decode_benchmark_smoke():
     from kubeflow_tpu.inference.benchmark import (
         DecodeBenchConfig,
@@ -193,6 +300,21 @@ def test_decode_benchmark_smoke():
         max_new_tokens=8))
     assert result["decode_tokens_per_sec"] > 0
     assert result["param_bytes"] > 0
+
+
+def test_decode_batch_sweep_smoke():
+    from kubeflow_tpu.inference.benchmark import (
+        DecodeBenchConfig,
+        run_decode_batch_sweep,
+    )
+
+    sweep = run_decode_batch_sweep(DecodeBenchConfig(
+        model="llama-test", prompt_len=8, max_new_tokens=8),
+        batch_sizes=(1, 2))
+    assert [r["batch_size"] for r in sweep["rows"]] == [1, 2]
+    assert all(r["decode_tokens_per_sec"] > 0 for r in sweep["rows"])
+    assert set(sweep["speedup_vs_b1"]) == {"1", "2"}
+    assert sweep["speedup_vs_b1"]["1"] == 1.0
 
 
 def test_sharded_generation_matches_unsharded():

@@ -214,6 +214,59 @@ def test_clean_shutdown_releases_lease():
     assert b._tick() is True
 
 
+def test_shutdown_release_never_clobbers_new_holder():
+    """Leadership lost between the last tick and shutdown (lease
+    expired, peer took over): the clean-shutdown release must ABORT
+    instead of zeroing the live peer's lease — an unconditional
+    release would hand a second follower an instant takeover
+    (two-leader window, ADVICE r5)."""
+    api = FakeApiServer()
+    el = LeaderElector(api, identity="a", lease_seconds=1,
+                       retry_seconds=0.05)
+    assert el._tick() is True
+    # Peer "b" takes over after a's lease expires, before a shuts down.
+    time.sleep(1.1)
+    b = LeaderElector(api, identity="b", lease_seconds=30)
+    assert b._tick() is True
+    # a still believes it leads (no tick since): run its shutdown path.
+    el._leader.set()
+    el.stop.set()
+    el.loop()
+    lease = api.get("Lease", "default", "tpujob-operator")
+    assert lease["spec"]["holderIdentity"] == "b", (
+        "release clobbered the live peer's lease")
+    assert lease["spec"]["renewTime"] is not None
+
+
+def test_expired_handles_naive_renew_time():
+    """A non-Python holder may write an offset-less renewTime; the
+    aware-vs-naive comparison must not raise TypeError (which the
+    loop counts toward MAX_CONSECUTIVE_ERRORS and eventually declares
+    the elector broken over a peer's formatting, ADVICE r5). Naive
+    timestamps normalize to UTC: a live one is respected, a stale one
+    is expired."""
+    import datetime
+
+    live = (datetime.datetime.now(datetime.timezone.utc)
+            .replace(tzinfo=None).isoformat())  # naive "now", UTC wall
+    assert LeaderElector._expired(
+        {"renewTime": live, "leaseDurationSeconds": 3600}) is False
+    # client-go's RFC3339 'Z' suffix: Python 3.10 fromisoformat
+    # rejects it, and "unparseable = expired" would steal a LIVE
+    # Go-held lease every tick. A live Z-stamped lease must be live.
+    assert LeaderElector._expired(
+        {"renewTime": live + "Z", "leaseDurationSeconds": 3600}) is False
+    assert LeaderElector._expired(
+        {"renewTime": "2020-01-01T00:00:00Z",
+         "leaseDurationSeconds": 15}) is True
+    assert LeaderElector._expired(
+        {"renewTime": "2020-01-01T00:00:00",
+         "leaseDurationSeconds": 15}) is True
+    # Garbage stays "expired", never an exception.
+    assert LeaderElector._expired({"renewTime": 12345}) is True
+    assert LeaderElector._expired({"renewTime": "not-a-time"}) is True
+
+
 def test_lease_protocol_over_http_client():
     """The Lease kind rides the production wire: coordination.k8s.io
     path mapping, optimistic-concurrency renewal, takeover."""

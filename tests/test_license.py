@@ -1,0 +1,44 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""The license every source header cites must actually ship: LICENSE
+(Apache-2.0 text) at the repo root, declared in pyproject.toml
+(VERDICT r5 item 6). The same invariant gates presubmit via
+scripts/lint.py check_license_file."""
+
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_license_file_ships_apache2():
+    text = (REPO / "LICENSE").read_text()
+    assert "Apache License" in text
+    assert "Version 2.0" in text
+    assert "TERMS AND CONDITIONS FOR USE" in text
+
+
+def test_pyproject_declares_license():
+    assert 'license = {file = "LICENSE"}' in (
+        REPO / "pyproject.toml").read_text()
+
+
+def test_lint_gate_checks_license():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "kft_lint", REPO / "scripts" / "lint.py")
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    assert lint.check_license_file() == []

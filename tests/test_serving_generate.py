@@ -144,6 +144,51 @@ class GenerateEndToEnd(tornado.testing.AsyncHTTPTestCase):
         super().tearDown()
 
 
+def test_short_prompts_ride_length_buckets(lm_dir):
+    """Generate signatures treat the exported prompt length as a MAX:
+    shorter prompts left-pad to a power-of-two length bucket and
+    return exactly the unpadded B=1 result (greedy export)."""
+    loaded = load_version(str(lm_dir / "1"))
+    model = llama_test(dtype=jnp.float32, cache_size=CACHE)
+    for length in (3, 5, PROMPT_LEN):
+        prompt = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(length), (1, length), 0, 512))
+        out = loaded.run({"input_ids": prompt})
+        want, _ = direct_generate(
+            model, loaded.variables["params"], jnp.asarray(prompt),
+            max_new_tokens=NEW_TOKENS, temperature=0.0)
+        np.testing.assert_array_equal(out["tokens"], np.asarray(want),
+                                      f"length {length}")
+    # Longer than the signature max stays a hard error.
+    with pytest.raises(ValueError, match="signature"):
+        loaded.run({"input_ids": np.zeros((1, PROMPT_LEN + 1),
+                                          np.int32)})
+
+
+def test_explicit_prompt_buckets_respected(lm_dir):
+    """generate_config.prompt_buckets overrides the power-of-two
+    lengths; outputs stay identical to the unpadded run."""
+    import dataclasses
+
+    loaded = load_version(str(lm_dir / "1"))
+    md = dataclasses.replace(
+        loaded.metadata,
+        generate_config={"max_new_tokens": NEW_TOKENS,
+                         "temperature": 0.0,
+                         "prompt_buckets": [6, PROMPT_LEN]})
+    bucketed = dataclasses.replace(loaded, metadata=md)
+    assert bucketed._length_bucket(3, PROMPT_LEN) == 6
+    assert bucketed._length_bucket(7, PROMPT_LEN) == PROMPT_LEN
+    prompt = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(44), (2, 5), 0, 512))
+    out = bucketed.run({"input_ids": prompt})
+    model = llama_test(dtype=jnp.float32, cache_size=CACHE)
+    want, _ = direct_generate(
+        model, loaded.variables["params"], jnp.asarray(prompt),
+        max_new_tokens=NEW_TOKENS, temperature=0.0)
+    np.testing.assert_array_equal(out["tokens"], np.asarray(want))
+
+
 def test_sampling_fresh_per_request_unless_pinned(lm_dir, tmp_path):
     """Default sampling varies across requests (rng folds a request
     counter); `deterministic: true` pins it for golden replay."""

@@ -122,6 +122,25 @@ def test_concurrent_first_requests_single_batcher():
     m.stop()
 
 
+def test_batch_stats_count_split_executions():
+    """pop_batch caps REQUEST count, not row count: a group whose rows
+    exceed max_batch splits into ceil(rows/max_batch) XLA executions
+    inside LoadedModel.run — batch_stats must count those, never
+    report an impossible fill > max_batch."""
+    m = ServedModel("stub", "/nonexistent", max_batch=2,
+                    batch_window_s=0.001)
+    m._versions[1] = _StubLoaded()
+    m._latest = 1
+    out = m.submit({"x": np.ones((5, 3), np.float32)},
+                   None, None, None).result(10)
+    assert out["y"].shape == (5, 3)
+    stats = m.batch_stats()
+    assert stats["rows"] == 5
+    assert stats["batches"] == 3  # ceil(5/2)
+    assert stats["mean_fill"] <= m.max_batch
+    m.stop()
+
+
 def test_stop_fails_undrained_requests():
     m, _ = _make_model()
     m.start_batcher()
