@@ -39,7 +39,43 @@ import sys
 REFERENCE_GPU_IMAGES_PER_SEC = 219.0
 
 
+def controller_main() -> int:
+    """`python bench.py --controller`: the operator control-plane
+    load benchmark (no accelerator — pure fake-apiserver chaos; see
+    kubeflow_tpu/operator/benchmark.py). Prints ONE JSON line shaped
+    like the headline bench; requeue-latency percentiles and
+    steady-state QPS per worker count live in "extra"."""
+    from kubeflow_tpu.operator.benchmark import run_controller_load_bench
+
+    result = run_controller_load_bench()
+    rows = {row["workers"]: row for row in result["rows"]}
+    best = max(
+        (row for row in result["rows"] if row["converged"]),
+        key=lambda r: r["reconciles_per_sec"],
+        default=result["rows"][0])
+    print(json.dumps({
+        "metric": "controller_reconciles_per_sec",
+        "value": best["reconciles_per_sec"],
+        "unit": f"reconciles/sec ({best['jobs']} jobs, "
+                f"{best['workers']} workers, chaos faults on)",
+        "vs_baseline": None,  # the reference never measured its operator
+        "extra": {
+            "fault_rates": result["fault_rates"],
+            **{f"w{w}_{k}": row[k]
+               for w, row in sorted(rows.items())
+               for k in ("converged", "converge_seconds",
+                         "reconciles_per_sec", "steady_state_qps")},
+            **{f"w{w}_requeue_{p}_ms": row["requeue_latency_ms"][p]
+               for w, row in sorted(rows.items())
+               for p in ("p50", "p90", "p99")},
+        },
+    }))
+    return 0
+
+
 def main() -> int:
+    if "--controller" in sys.argv:
+        return controller_main()
     from kubeflow_tpu.utils.platform import sync_platform_from_env
 
     # Honor JAX_PLATFORMS from the caller (the session preset pins the

@@ -37,6 +37,10 @@ and DELETE jobs, not just list them. This is its TPUJob equivalent:
                                          (XPlane dirs; SURVEY §5's
                                          "surfaced through the
                                          dashboard" target)
+  GET    /tpujobs/api/operator          controller workqueue/reconcile
+                                         metrics (read from the
+                                         ConfigMap the operator
+                                         publishes; ?namespace=)
   GET    /healthz
 
 against either a real apiserver (kubectl shim) or the in-repo fake
@@ -62,6 +66,26 @@ from kubeflow_tpu.operator.reconciler import JOB_LABEL
 logger = logging.getLogger(__name__)
 
 
+#: Non-phase conditions the operator raises for jobs needing operator
+#: (human) attention: quarantined poison jobs and gangs that blew
+#: their scheduling deadline. Surfaced as warnings in the job views.
+_WARNING_CONDITIONS = ("ReconcileStalled", "DeadlineExceeded")
+
+
+def job_warnings(job: Dict[str, Any]) -> list:
+    """Active warning conditions, as [{type, reason, since}]."""
+    out = []
+    for cond in job.get("status", {}).get("conditions", []):
+        if (cond.get("type") in _WARNING_CONDITIONS
+                and cond.get("status") == "True"):
+            out.append({
+                "type": cond.get("type"),
+                "reason": cond.get("reason") or "",
+                "since": cond.get("lastTransitionTime") or "",
+            })
+    return out
+
+
 def job_summary(job: Dict[str, Any]) -> Dict[str, Any]:
     meta = job.get("metadata", {})
     status = job.get("status", {})
@@ -71,8 +95,10 @@ def job_summary(job: Dict[str, Any]) -> Dict[str, Any]:
     }
     # The active condition's transition is "when did the job last
     # change state" — the reference UI's per-job timeline anchor.
+    # Warning conditions (also True) must not steal the anchor.
     active = next((c for c in status.get("conditions", [])
-                   if c.get("status") == "True"), {})
+                   if c.get("status") == "True"
+                   and c.get("type") not in _WARNING_CONDITIONS), {})
     return {
         "name": meta.get("name", ""),
         "namespace": meta.get("namespace", ""),
@@ -83,6 +109,7 @@ def job_summary(job: Dict[str, Any]) -> Dict[str, Any]:
         "lastTransitionTime": active.get("lastTransitionTime", ""),
         "reason": status.get("reason", ""),
         "creationTimestamp": meta.get("creationTimestamp", ""),
+        "warnings": job_warnings(job),
     }
 
 
@@ -279,6 +306,7 @@ class JobDetailHandler(BaseHandler):
         self.write_json({"job": job, "summary": job_summary(job),
                          "conditions": job.get("status", {}).get(
                              "conditions", []),
+                         "warnings": job_warnings(job),
                          "pods": [pod_summary(p) for p in raw_pods],
                          "events": events})
 
@@ -350,6 +378,45 @@ class PodLogsHandler(BaseHandler):
             return self.write_json({"error": str(e)}, 502)
         self.set_header("Content-Type", "text/plain; charset=utf-8")
         self.finish(text)
+
+
+class OperatorMetricsHandler(BaseHandler):
+    """The controller's workqueue/reconcile metrics, read from the
+    ConfigMap it publishes (operator/controller.py publish_metrics) —
+    the dashboard and the load benchmark read the SAME numbers:
+    queue depth, per-key retry counts and backoff state, quarantined
+    jobs, reconcile totals, watch health."""
+
+    async def get(self):
+        from kubeflow_tpu.operator.controller import (
+            METRICS_CONFIGMAP,
+            METRICS_KEY,
+        )
+        from kubeflow_tpu.operator.fake import NotFound
+
+        namespace = self.get_query_argument("namespace", "default")
+        loop = tornado.ioloop.IOLoop.current()
+        try:
+            cm = await loop.run_in_executor(
+                None, self.api.get, "ConfigMap", namespace,
+                METRICS_CONFIGMAP)
+        except NotFound:
+            return self.write_json(
+                {"available": False,
+                 "error": f"ConfigMap {namespace}/{METRICS_CONFIGMAP} "
+                          f"not found (operator not publishing?)"}, 404)
+        except Exception as e:  # noqa: BLE001 — apiserver-side
+            return self.write_json({"available": False,
+                                    "error": str(e)}, 502)
+        try:
+            metrics = json.loads(
+                cm.get("data", {}).get(METRICS_KEY, "{}"))
+        except json.JSONDecodeError:
+            return self.write_json(
+                {"available": False,
+                 "error": "metrics ConfigMap holds invalid JSON"}, 502)
+        self.write_json({"available": True, "namespace": namespace,
+                         "metrics": metrics})
 
 
 class TraceListHandler(BaseHandler):
@@ -432,6 +499,7 @@ _DETAIL_PAGE = """<!doctype html>
 <h1>{name} <small style="color:{phase_color}">{phase}</small></h1>
 <p>{namespace} &middot; restarts {restarts} &middot; slices {slices}
 &middot; last transition {transition} {reason}</p>
+{warning_banner}
 <h2>Replicas</h2>
 <table>
 <tr><th>Pod</th><th>Slice</th><th>Type</th><th>Index</th><th>Phase</th>
@@ -478,6 +546,16 @@ class UIJobDetailHandler(BaseHandler):
             loop.run_in_executor(
                 None, _job_events, self.api, namespace, name, job))
         pods = [pod_summary(p) for p in raw_pods]
+        # Operator-attention banner: quarantined reconcile (the
+        # controller is failing to act on this job) or a blown
+        # scheduling deadline (gang torn down, slices released).
+        warning_rows = [
+            f"<p style=\"background:#fff1f0;border:1px solid #cf222e;"
+            f"padding:.5rem .9rem\"><strong>"
+            f"{html.escape(w['type'])}</strong> since "
+            f"{html.escape(w['since'][:19] or '-')}: "
+            f"{html.escape(w['reason'])}</p>"
+            for w in job_warnings(job)]
 
         def _num(s: str) -> int:
             return int(s) if s.isdigit() else 0
@@ -535,6 +613,7 @@ class UIJobDetailHandler(BaseHandler):
             transition=html.escape(summary["lastTransitionTime"] or "-"),
             reason=html.escape(
                 f"({summary['reason']})" if summary["reason"] else ""),
+            warning_banner="\n".join(warning_rows),
             pod_rows="\n".join(pod_rows) or
             "<tr><td colspan=7>no pods</td></tr>",
             cond_rows="\n".join(cond_rows) or
@@ -641,6 +720,7 @@ def make_app(api, trace_root: str = DEFAULT_TRACE_ROOT
         (r"/tpujobs/api/tpujob/([^/]+)/([^/]+)/logs/([^/]+)",
          PodLogsHandler),
         (r"/tpujobs/api/traces", TraceListHandler),
+        (r"/tpujobs/api/operator", OperatorMetricsHandler),
         (r"/tpujobs/ui/?", UIHandler),
         (r"/tpujobs/ui/job/([^/]+)/([^/]+)", UIJobDetailHandler),
         (r"/tpujobs/ui/create", UICreateHandler),

@@ -155,6 +155,7 @@ def tpu_job(
     termination: Optional[Dict[str, Any]] = None,
     recovery: str = "restart-slice",
     num_slices: int = 1,
+    scheduling_deadline_seconds: Optional[int] = None,
 ) -> Dict[str, Any]:
     """A TPUJob CR (parity: ``tfJob``, reference
     ``tf-job.libsonnet:44-56``). ``recovery`` is new: TPU slices fail
@@ -173,6 +174,11 @@ def tpu_job(
         raise ValueError(f"unknown recovery policy {recovery!r}")
     if num_slices < 1:
         raise ValueError(f"num_slices must be >= 1, got {num_slices}")
+    if scheduling_deadline_seconds is not None \
+            and scheduling_deadline_seconds < 1:
+        raise ValueError(
+            f"scheduling_deadline_seconds must be >= 1 (omit for no "
+            f"deadline), got {scheduling_deadline_seconds}")
     return {
         "apiVersion": f"{GROUP}/{VERSION}",
         "kind": KIND,
@@ -186,6 +192,12 @@ def tpu_job(
                 # manifests (goldens, kubectl diffs): the field only
                 # materializes when it means something.
                 "numSlices": num_slices if num_slices > 1 else None,
+                # Gang scheduling deadline: a job still Pending this
+                # many seconds after submission Fails with a
+                # DeadlineExceeded condition and its gang is torn
+                # down, releasing the TPU slices (operator/reconciler
+                # enforces it). Absent = wait forever.
+                "schedulingDeadlineSeconds": scheduling_deadline_seconds,
             }
         ),
     }
@@ -221,6 +233,9 @@ def crd() -> Dict[str, Any]:
                         "enum": ["restart-slice", "none"],
                     },
                     "numSlices": {"type": "integer", "minimum": 1},
+                    "schedulingDeadlineSeconds": {
+                        "type": "integer", "minimum": 1,
+                    },
                 },
             },
             "status": {
@@ -415,7 +430,9 @@ def _generic_job_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
     chief = "COORDINATOR" if p["num_coordinators"] > 0 else "TPU_WORKER"
     return [tpu_job(p["name"], p["namespace"], specs,
                     termination=termination_policy(chief),
-                    num_slices=p["num_slices"])]
+                    num_slices=p["num_slices"],
+                    scheduling_deadline_seconds=(
+                        p["scheduling_deadline_seconds"] or None))]
 
 
 register(
@@ -437,6 +454,11 @@ register(
               ">1 = multi-slice (megascale) job: the replicaSpecs are "
               "provisioned once per slice and MEGASCALE_* env is "
               "injected."),
+        Param("scheduling_deadline_seconds", 0, "int",
+              "Fail the job (DeadlineExceeded) and release its gang "
+              "if it is still Pending after this many seconds; 0 = "
+              "wait forever. See docs/operator.md for picking a "
+              "value on spot-heavy pools."),
     ],
     package="tpu-job",
 )(_generic_job_builder)

@@ -29,8 +29,19 @@ parameter-server controller (external Go tf-operator, reference
   job's checkpoint dir) instead of individual pod restarts.
 - **Hermetic testing**: a fake apiserver (kubeflow_tpu.operator.fake)
   — the layer the reference never had (its operator was only tested
-  against a live GKE cluster, SURVEY §4).
+  against a live GKE cluster, SURVEY §4) — with injectable faults
+  (conflict storms, 429/500 bursts, dropped watches, latency) and a
+  request log for asserting apiserver load under chaos.
+- **Work scheduling**: a rate-limited workqueue
+  (kubeflow_tpu.operator.workqueue) — per-key exponential backoff
+  with jitter, a global token bucket, N workers with per-key dedup,
+  and poison-job quarantine surfaced as a ReconcileStalled condition.
 """
 
 from kubeflow_tpu.operator.reconciler import Reconciler  # noqa: F401
 from kubeflow_tpu.operator.fake import FakeApiServer  # noqa: F401
+from kubeflow_tpu.operator.workqueue import (  # noqa: F401
+    ExponentialBackoff,
+    TokenBucket,
+    WorkQueue,
+)

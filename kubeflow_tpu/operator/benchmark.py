@@ -1,0 +1,234 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Controller load benchmark: M jobs × injected fault rates.
+
+The control plane had never been measured under load (VERDICT r5):
+this drives the REAL WatchController — watchers, workqueue, worker
+threads, reconciler — against the fake apiserver with chaos faults
+enabled (409 conflict storms, 429/500 bursts, dropped watch streams)
+and reports, per worker count:
+
+- convergence: seconds until every job's gang is Running,
+- reconcile throughput (successful reconciles / second to converge),
+- requeue latency percentiles (workqueue enqueue → dequeue),
+- steady-state apiserver QPS (request-log rate after convergence —
+  the hot-loop detector: a converged controller should be near-idle).
+
+Run via ``python bench.py --controller`` (PERF.md records the
+numbers) or pytest's smoke test (tests/test_controller_chaos.py).
+No jax, no accelerator — this is a pure control-plane benchmark.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from kubeflow_tpu.manifests.tpujob import (
+    KIND,
+    replica_spec,
+    termination_policy,
+    tpu_job,
+)
+from kubeflow_tpu.operator.controller import WatchController
+from kubeflow_tpu.operator.fake import (
+    Conflict,
+    FakeApiServer,
+    ServerError,
+    TooManyRequests,
+)
+from kubeflow_tpu.operator.reconciler import JOB_LABEL
+from kubeflow_tpu.operator.workqueue import ExponentialBackoff, TokenBucket
+
+
+def _percentile(samples: List[float], pct: float) -> float:
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    idx = min(len(ordered) - 1,
+              max(0, round(pct / 100.0 * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+def _bench_job(name: str) -> Dict[str, Any]:
+    spec = replica_spec(
+        "TPU_WORKER", 1, image="bench:img",
+        tpu_accelerator="tpu-v5-lite-podslice", tpu_topology="1x1",
+        chips_per_worker=1)
+    job = tpu_job(name, "default", [spec],
+                  termination=termination_policy("TPU_WORKER", 0))
+    job["metadata"]["uid"] = f"uid-{name}"
+    return job
+
+
+def _install_faults(api: FakeApiServer, *, conflict_rate: float,
+                    throttle_rate: float, error_rate: float,
+                    watch_drop_events: Optional[int],
+                    latency: float = 0.0) -> None:
+    writes = ("create", "patch", "replace", "delete")
+    if conflict_rate:
+        api.faults.add_rule(lambda: Conflict("injected conflict storm"),
+                            verbs=writes, rate=conflict_rate)
+    if throttle_rate:
+        api.faults.add_rule(
+            lambda: TooManyRequests("injected 429 burst"),
+            rate=throttle_rate)
+    if error_rate:
+        api.faults.add_rule(lambda: ServerError("injected 500"),
+                            rate=error_rate)
+    api.faults.watch_max_events = watch_drop_events
+    api.faults.latency = latency
+
+
+def run_controller_load_bench(
+        *, jobs: int = 50,
+        workers_list: Sequence[int] = (1, 4),
+        conflict_rate: float = 0.05,
+        throttle_rate: float = 0.03,
+        error_rate: float = 0.02,
+        watch_drop_events: Optional[int] = 40,
+        latency: float = 0.002,
+        converge_timeout: float = 60.0,
+        steady_window: float = 3.0,
+        relist_seconds: float = 1.0,
+        backoff: Optional[ExponentialBackoff] = None,
+        qps: float = 200.0) -> Dict[str, Any]:
+    """One row per worker count; see the module docstring for the
+    metrics. ``backoff`` defaults to a fast test-scale curve (base
+    25 ms, cap 2 s) so the bench converges in seconds — production
+    keeps the 50 ms → 5 min defaults. ``latency`` (default 2 ms) is
+    per-request apiserver RTT: without it the in-memory store answers
+    at GIL speed and worker parallelism has nothing to overlap. Note
+    steady-state QPS scales with ``relist_seconds``: the relist
+    safety net IS the converged controller's remaining traffic."""
+    with _quiet_operator_logs():
+        return _run(jobs=jobs, workers_list=workers_list,
+                    conflict_rate=conflict_rate,
+                    throttle_rate=throttle_rate,
+                    error_rate=error_rate,
+                    watch_drop_events=watch_drop_events,
+                    latency=latency,
+                    converge_timeout=converge_timeout,
+                    steady_window=steady_window,
+                    relist_seconds=relist_seconds,
+                    backoff=backoff, qps=qps)
+
+
+@contextlib.contextmanager
+def _quiet_operator_logs():
+    """Injected faults are the POINT of this bench: the controller's
+    exception logging would drown the one JSON output line."""
+    targets = [logging.getLogger("kubeflow_tpu.operator." + mod)
+               for mod in ("controller", "reconciler", "fake")]
+    levels = [t.level for t in targets]
+    for t in targets:
+        t.setLevel(logging.CRITICAL)
+    try:
+        yield
+    finally:
+        for t, level in zip(targets, levels):
+            t.setLevel(level)
+
+
+def _run(*, jobs, workers_list, conflict_rate, throttle_rate,
+         error_rate, watch_drop_events, latency, converge_timeout,
+         steady_window, relist_seconds, backoff, qps) -> Dict[str, Any]:
+    rows = []
+    for workers in workers_list:
+        api = FakeApiServer()
+        _install_faults(api, conflict_rate=conflict_rate,
+                        throttle_rate=throttle_rate,
+                        error_rate=error_rate,
+                        watch_drop_events=watch_drop_events,
+                        latency=latency)
+        ctl = WatchController(
+            api, relist_seconds=relist_seconds, workers=workers,
+            backoff=backoff or ExponentialBackoff(base=0.025, cap=2.0),
+            limiter=TokenBucket(qps=qps, burst=int(qps)))
+        thread = threading.Thread(target=ctl.run, daemon=True)
+        t0 = time.monotonic()
+        thread.start()
+        names = [f"load-{i:03d}" for i in range(jobs)]
+        for name in names:
+            with api.as_kubelet():
+                api.create(_bench_job(name))
+
+        def _running() -> int:
+            done = 0
+            with api.as_kubelet():
+                for name in names:
+                    # Kubelet stand-in: any created pod starts Running.
+                    for pod in api._list("Pod", "default",
+                                         {JOB_LABEL: name}):
+                        if (pod.get("status", {}).get("phase")
+                                != "Running"):
+                            api.set_pod_phase(
+                                "default", pod["metadata"]["name"],
+                                "Running")
+                    job = api.get(KIND, "default", name)
+                    if job.get("status", {}).get("phase") == "Running":
+                        done += 1
+            return done
+
+        converged_at = None
+        deadline = t0 + converge_timeout
+        while time.monotonic() < deadline:
+            if _running() == jobs:
+                converged_at = time.monotonic()
+                break
+            time.sleep(0.05)
+        converge_seconds = ((converged_at or time.monotonic()) - t0)
+
+        # Steady state: converged controller vs the apiserver.
+        steady_start = time.monotonic()
+        time.sleep(steady_window)
+        steady_requests = api.request_count(since=steady_start)
+        stats = ctl.stats()
+        latencies = ctl.queue.latencies()
+        ctl.stop.set()
+        thread.join(timeout=10)
+        rows.append({
+            "workers": workers,
+            "jobs": jobs,
+            "relist_seconds": relist_seconds,
+            "converged": converged_at is not None,
+            "converge_seconds": round(converge_seconds, 2),
+            "reconciles": stats["reconciles"],
+            "reconcile_failures": stats["reconcileFailures"],
+            "reconciles_per_sec": round(
+                stats["reconciles"] / max(converge_seconds, 1e-9), 1),
+            "requeue_latency_ms": {
+                "p50": round(_percentile(latencies, 50) * 1e3, 1),
+                "p90": round(_percentile(latencies, 90) * 1e3, 1),
+                "p99": round(_percentile(latencies, 99) * 1e3, 1),
+            },
+            "steady_state_qps": round(
+                steady_requests / steady_window, 2),
+            "watch_gone": sum(stats["watchGone"].values()),
+            "watch_errors": sum(stats["watchErrors"].values()),
+            "total_apiserver_requests": len(api.request_log()),
+        })
+    return {
+        "bench": "controller_load",
+        "fault_rates": {"conflict": conflict_rate,
+                        "throttle429": throttle_rate,
+                        "error500": error_rate,
+                        "watch_drop_events": watch_drop_events,
+                        "latency_ms": round(latency * 1e3, 2)},
+        "rows": rows,
+    }

@@ -27,6 +27,16 @@ Clients: the in-memory fake (tests), the stdlib-HTTP apiserver client
 the operator image), and a kubectl-backed shim kept for dev
 clusters/`kft apply` parity. The old polling loop remains as
 ``run_controller`` for the kubectl shim, which has no watch surface.
+
+Work scheduling (r7): events land in a rate-limited
+:class:`~kubeflow_tpu.operator.workqueue.WorkQueue` — per-key
+deduplication (one job is never reconciled concurrently), N worker
+threads, per-key exponential backoff with jitter on failure (the r6
+loop retried at a flat 0.5 s from a single worker), a global
+token-bucket limiter, and poison-job quarantine: after
+``quarantine_after`` consecutive failures the key parks at the
+backoff cap and the job carries a ``ReconcileStalled`` condition +
+Event until a reconcile succeeds again.
 """
 
 from __future__ import annotations
@@ -38,13 +48,24 @@ import os
 import subprocess
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.manifests.tpujob import KIND, PLURAL, GROUP
 from kubeflow_tpu.operator.fake import Conflict, Gone, NotFound
 from kubeflow_tpu.operator.reconciler import JOB_LABEL, Reconciler
+from kubeflow_tpu.operator.workqueue import (
+    ExponentialBackoff,
+    TokenBucket,
+    WorkQueue,
+)
 
 logger = logging.getLogger(__name__)
+
+#: ConfigMap through which the controller publishes its workqueue /
+#: reconcile metrics (the dashboard's /tpujobs/api/operator endpoint
+#: and the load benchmark read the same numbers).
+METRICS_CONFIGMAP = "tpujob-operator-metrics"
+METRICS_KEY = "metrics.json"
 
 
 class KubectlClient:
@@ -116,14 +137,21 @@ class KubectlClient:
 
 class WatchController:
     """Informer-style controller: watch TPUJobs + pods, enqueue the
-    owning job per event, reconcile from one worker loop (serialized —
+    owning job per event into a rate-limited workqueue, reconcile from
+    ``workers`` threads (per-key dedup keeps any one job serialized —
     the reconciler is pass-atomic but not designed for concurrent
     passes over one job), periodic relist as the safety net."""
 
     def __init__(self, api, *, namespace: Optional[str] = None,
                  relist_seconds: float = 30.0,
                  reconciler: Optional[Reconciler] = None,
-                 elector=None):
+                 elector=None,
+                 workers: int = 1,
+                 queue: Optional[WorkQueue] = None,
+                 backoff: Optional[ExponentialBackoff] = None,
+                 limiter: Optional[TokenBucket] = None,
+                 quarantine_after: int = 6,
+                 metrics_namespace: Optional[str] = None):
         self.api = api
         self.namespace = namespace
         self.relist_seconds = relist_seconds
@@ -131,25 +159,43 @@ class WatchController:
         # Optional LeaderElector (operator/leader.py): watchers run
         # regardless (warm cache), reconciles only while leading.
         self.elector = elector
+        self.workers = max(1, int(workers))
         self.stop = threading.Event()
-        self._queue: Set[Tuple[str, str]] = set()  # (ns, name)
-        self._cond = threading.Condition()
+        self.queue = queue or WorkQueue(
+            backoff=backoff or ExponentialBackoff(),
+            limiter=limiter or TokenBucket(qps=50.0, burst=100),
+            quarantine_after=quarantine_after)
+        # Metrics ConfigMap home; None = alongside the watch scope
+        # (its namespace, or "default" for cluster-wide controllers).
+        self.metrics_namespace = (metrics_namespace or namespace
+                                  or "default")
         self._watchers: List[threading.Thread] = []
+        # Keys whose ReconcileStalled condition has been written (so
+        # quarantined retries don't re-patch it every cap interval).
+        self._stalled: set = set()
+        self._counters_lock = threading.Lock()
+        self._reconciles = 0
+        self._reconcile_failures = 0
+        # Watch-loop health: transport errors back off exponentially;
+        # a 410 Gone is NOT an error — the server compacted our resume
+        # point and the contract is an immediate relist.
+        self.watch_gone: Dict[str, int] = {}
+        self.watch_errors: Dict[str, int] = {}
+        self._watch_backoff = ExponentialBackoff(base=0.2, cap=30.0)
 
     # -- queue ------------------------------------------------------------
 
     def enqueue(self, namespace: str, name: str) -> None:
-        with self._cond:
-            self._queue.add((namespace, name))
-            self._cond.notify()
+        """Event path: supersedes any pending backoff timer (the
+        event may carry exactly the change that fixes a failing
+        job)."""
+        self.queue.add((namespace, name))
 
-    def _drain_queue(self) -> List[Tuple[str, str]]:
-        with self._cond:
-            if not self._queue:
-                self._cond.wait(timeout=0.2)
-            keys = sorted(self._queue)
-            self._queue.clear()
-            return keys
+    def enqueue_relisted(self, namespace: str, name: str) -> None:
+        """Relist path: no new information — backing-off keys keep
+        their timers (quarantined poison jobs stay parked at the cap
+        instead of being re-admitted every relist period)."""
+        self.queue.add_unless_delayed((namespace, name))
 
     # -- watchers ---------------------------------------------------------
 
@@ -171,7 +217,9 @@ class WatchController:
         else runs on the cluster."""
         selector = {JOB_LABEL: None} if kind == "Pod" else None
         version = 0
+        consecutive_errors = 0
         while not self.stop.is_set():
+            delay = 0.0
             try:
                 if version == 0:
                     # Fresh horizon: everything current is (re)queued
@@ -181,26 +229,163 @@ class WatchController:
                     for obj in items:
                         key = self._job_key_of(kind, obj)
                         if key:
-                            self.enqueue(*key)
+                            self.enqueue_relisted(*key)
                 for event_type, obj in self.api.watch(
                         kind, self.namespace, resource_version=version,
                         stop=self.stop, timeout=self.relist_seconds,
                         label_selector=selector):
                     version = int(obj.get("metadata", {})
                                   .get("resourceVersion", version))
+                    consecutive_errors = 0
                     if event_type == "BOOKMARK":
                         continue  # payload IS the fresh resume point
                     key = self._job_key_of(kind, obj)
                     if key:
                         self.enqueue(*key)
                 # Server-side watch timeout: re-watch from `version`.
+                consecutive_errors = 0
             except Gone:
-                logger.info("%s watch compacted; relisting", kind)
+                # 410: our resourceVersion fell out of the server's
+                # watch window. Not a transport fault — the sanctioned
+                # reaction is an immediate relist-and-resume, with the
+                # error counter untouched (counting it toward backoff
+                # would punish the controller for the server's
+                # compaction cadence).
+                logger.info("%s watch compacted (410); relisting", kind)
+                self.watch_gone[kind] = self.watch_gone.get(kind, 0) + 1
                 version = 0
             except Exception:  # noqa: BLE001
                 logger.exception("%s watch failed; relisting", kind)
+                self.watch_errors[kind] = (
+                    self.watch_errors.get(kind, 0) + 1)
+                consecutive_errors += 1
                 version = 0
-                self.stop.wait(1.0)
+                delay = self._watch_backoff.delay(consecutive_errors)
+            if delay:
+                self.stop.wait(delay)
+
+    # -- workers ----------------------------------------------------------
+
+    def _reconcile_allowed(self) -> bool:
+        return self.elector is None or self.elector.is_leader()
+
+    def _worker_loop(self) -> None:
+        while not self.stop.is_set():
+            if not self._reconcile_allowed():
+                # Follower: keep the queue (events accumulate for the
+                # takeover), reconcile nothing.
+                self.stop.wait(0.05)
+                continue
+            key = self.queue.get(timeout=0.2, stop=self.stop)
+            if key is None:
+                continue
+            ns, name = key
+            try:
+                self._reconcile_one(key, ns, name)
+            finally:
+                self.queue.done(key)
+
+    def _reconcile_one(self, key: Tuple[str, str], ns: str,
+                       name: str) -> None:
+        try:
+            job = self.api.get(KIND, ns, name)
+        except NotFound:
+            # Deleted; GC is ownerReference-driven. Nothing left to
+            # retry against either.
+            self.queue.forget(key)
+            self._stalled.discard(key)
+            return
+        except Exception:  # noqa: BLE001 — apiserver-side failure
+            logger.exception("get failed for %s/%s", ns, name)
+            self._note_failure(key, ns, name)
+            return
+        self.reconciler.requeue_after = None
+        try:
+            self.reconciler.reconcile(job)
+        except Exception:  # noqa: BLE001
+            logger.exception("reconcile failed for %s/%s", ns, name)
+            self._note_failure(key, ns, name)
+            return
+        with self._counters_lock:
+            self._reconciles += 1
+        self.queue.forget(key)
+        if key in self._stalled:
+            # The job recovered: lift the ReconcileStalled condition.
+            self._stalled.discard(key)
+            try:
+                self.reconciler.clear_stalled(ns, name)
+            except Exception:  # noqa: BLE001 — best-effort
+                logger.exception("clear_stalled failed for %s/%s",
+                                 ns, name)
+        # The reconciler can ask to be re-observed (e.g. a pending
+        # schedulingDeadlineSeconds): schedule a timer wake-up so the
+        # deadline doesn't wait for the next relist period.
+        if self.reconciler.requeue_after is not None:
+            self.queue.add_after(key,
+                                 max(0.05, self.reconciler.requeue_after))
+
+    def _note_failure(self, key: Tuple[str, str], ns: str,
+                      name: str) -> None:
+        with self._counters_lock:
+            self._reconcile_failures += 1
+        delay = self.queue.retry(key)
+        failures = self.queue.failures(key)
+        if self.queue.is_quarantined(key) and key not in self._stalled:
+            # Poison job: park at the cap (queue.retry already did)
+            # and surface it — a ReconcileStalled condition + Event so
+            # `kubectl describe` / the dashboard show WHY the job
+            # stopped converging. Best-effort: the job's API is the
+            # thing that's failing; re-attempted at every capped retry
+            # until the write lands.
+            try:
+                self.reconciler.mark_stalled(ns, name, failures)
+                self._stalled.add(key)
+            except Exception:  # noqa: BLE001
+                logger.warning("mark_stalled failed for %s/%s "
+                               "(will retry at next capped attempt)",
+                               ns, name)
+        logger.info("requeue %s/%s in %.2fs (failure #%d)",
+                    ns, name, delay, failures)
+
+    # -- metrics ----------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._counters_lock:
+            reconciles = self._reconciles
+            failures = self._reconcile_failures
+        return {
+            "workers": self.workers,
+            "reconciles": reconciles,
+            "reconcileFailures": failures,
+            "watchGone": dict(self.watch_gone),
+            "watchErrors": dict(self.watch_errors),
+            "queue": self.queue.stats(),
+        }
+
+    def publish_metrics(self) -> None:
+        """Write the stats snapshot to the operator metrics ConfigMap
+        (best-effort; identical snapshots are no-op writes, so a
+        quiescent controller publishes nothing). The dashboard's
+        /tpujobs/api/operator endpoint and the load benchmark read
+        this same object."""
+        payload = json.dumps(self.stats(), sort_keys=True)
+        ns = self.metrics_namespace
+        try:
+            try:
+                self.api.patch(
+                    "ConfigMap", ns, METRICS_CONFIGMAP,
+                    lambda o: o.setdefault("data", {}).update(
+                        {METRICS_KEY: payload}))
+            except NotFound:
+                self.api.create({
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {"name": METRICS_CONFIGMAP,
+                                 "namespace": ns},
+                    "data": {METRICS_KEY: payload},
+                })
+        except Exception:  # noqa: BLE001 — metrics must never wedge
+            logger.debug("metrics publish failed", exc_info=True)
 
     # -- main loop --------------------------------------------------------
 
@@ -213,6 +398,12 @@ class WatchController:
         if self.elector is not None:
             t = threading.Thread(target=self.elector.loop,
                                  name="leader-elector", daemon=True)
+            t.start()
+            self._watchers.append(t)
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker_loop,
+                                 name=f"reconcile-worker-{i}",
+                                 daemon=True)
             t.start()
             self._watchers.append(t)
         deadline = (time.monotonic() + max_seconds
@@ -233,8 +424,8 @@ class WatchController:
                             "leader elector broken: lease API "
                             "persistently unavailable")
                     if not self.elector.is_leader():
-                        # Follower: keep the queue (events accumulate
-                        # for the takeover), reconcile nothing.
+                        # Follower: the workers idle on the same
+                        # check; the main loop just keeps the clock.
                         was_leader = False
                         self.stop.wait(0.05)
                         continue
@@ -253,23 +444,13 @@ class WatchController:
                     try:
                         for job in self.api.list(KIND, self.namespace):
                             meta = job["metadata"]
-                            self.enqueue(
+                            self.enqueue_relisted(
                                 meta.get("namespace", "default"),
                                 meta["name"])
                     except Exception:  # noqa: BLE001
                         logger.exception("relist failed")
-                for ns, name in self._drain_queue():
-                    try:
-                        job = self.api.get(KIND, ns, name)
-                    except NotFound:
-                        continue  # deleted; GC is ownerReference-driven
-                    try:
-                        self.reconciler.reconcile(job)
-                    except Exception:  # noqa: BLE001
-                        logger.exception("reconcile failed for %s/%s",
-                                         ns, name)
-                        self.enqueue(ns, name)  # retry next wake-up
-                        self.stop.wait(0.5)
+                    self.publish_metrics()
+                self.stop.wait(0.05)
         finally:
             self.stop.set()
             if self.elector is not None:
@@ -280,16 +461,20 @@ class WatchController:
 
 def run_watch_controller(api, *, namespace: Optional[str] = None,
                          relist_seconds: float = 30.0,
+                         workers: int = 1,
                          max_seconds: Optional[float] = None) -> None:
     WatchController(
         api, namespace=namespace, relist_seconds=relist_seconds,
+        workers=workers,
     ).run(max_seconds=max_seconds)
 
 
 def run_controller(api, *, resync_seconds: float = 5.0,
                    namespace: Optional[str] = None,
-                   max_iterations: Optional[int] = None) -> None:
+                   max_iterations: Optional[int] = None,
+                   stop: Optional[threading.Event] = None) -> None:
     reconciler = Reconciler(api)
+    stop = stop or threading.Event()
     iteration = 0
     while max_iterations is None or iteration < max_iterations:
         iteration += 1
@@ -307,7 +492,10 @@ def run_controller(api, *, resync_seconds: float = 5.0,
                     job["metadata"].get("namespace"),
                     job["metadata"]["name"])
         if max_iterations is None or iteration < max_iterations:
-            time.sleep(resync_seconds)
+            # Interruptible resync period (NOT a retry loop: failures
+            # above are level-triggered away on the next full pass).
+            if stop.wait(resync_seconds):
+                return
 
 
 def main(argv=None) -> int:
@@ -317,6 +505,10 @@ def main(argv=None) -> int:
                         help="poll mode resync period")
     parser.add_argument("--relist-seconds", type=float, default=30.0,
                         help="watch mode relist safety-net period")
+    parser.add_argument("--workers", type=int, default=4,
+                        help="watch mode reconcile worker threads "
+                             "(per-job serialization is preserved by "
+                             "the workqueue's key dedup)")
     parser.add_argument("--controller-config-file", default=None)
     parser.add_argument(
         "--mode", choices=("auto", "watch", "poll"), default="auto",
@@ -361,6 +553,7 @@ def main(argv=None) -> int:
                     args.relist_seconds)
         WatchController(client, namespace=args.namespace,
                         relist_seconds=args.relist_seconds,
+                        workers=args.workers,
                         elector=elector).run()
     else:
         logger.info("poll mode: kubectl client, resync %.1fs",

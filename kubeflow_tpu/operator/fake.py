@@ -20,12 +20,26 @@ WATCH streams with resourceVersion resume (the surface the
 event-driven controller consumes), and test helpers to drive pod
 phase transitions. This is the fake layer SURVEY §4 calls out as
 missing from the reference.
+
+Adversity (r7): every front-door request passes through a
+:class:`FaultInjector` — rule-matched 409 conflict storms, 429/500
+bursts, added latency, and early-terminated watch streams — and is
+recorded in a timestamped request log so tests can assert *apiserver
+load*, not just final state (e.g. that a quarantined poison job's
+request rate decays to the backoff cap). Test helpers that play the
+kubelet (``set_pod_phase`` & co.) bypass both: chaos must not be
+throttled by its own faults, nor counted as controller traffic.
 """
 
 from __future__ import annotations
 
+import contextlib
 import copy
+import dataclasses
+import random
+import re
 import threading
+import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 Key = Tuple[str, str, str]  # (kind, namespace, name)
@@ -37,6 +51,15 @@ class Conflict(Exception):
 
 class NotFound(Exception):
     pass
+
+
+class TooManyRequests(Exception):
+    """k8s 429: the apiserver (or its priority-and-fairness layer) is
+    shedding load; the client must back off."""
+
+
+class ServerError(Exception):
+    """k8s 5xx: transient apiserver-side failure."""
 
 
 class Gone(Exception):
@@ -79,6 +102,80 @@ def _fields_match(obj: Dict[str, Any],
     return True
 
 
+@dataclasses.dataclass
+class FaultRule:
+    """One injectable fault: raise ``exc`` when a request matches.
+
+    ``verbs``/``kind``/``name`` are None-means-any filters (``name``
+    is a regex, searched). ``rate`` is the match probability;
+    ``times`` bounds total firings (None = unbounded)."""
+
+    exc: Callable[[], Exception]
+    verbs: Optional[Tuple[str, ...]] = None
+    kind: Optional[str] = None
+    name: Optional[str] = None
+    rate: float = 1.0
+    times: Optional[int] = None
+    fired: int = 0
+
+    def matches(self, verb: str, kind: str, name: Optional[str],
+                rng: random.Random) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.verbs is not None and verb not in self.verbs:
+            return False
+        if self.kind is not None and kind != self.kind:
+            return False
+        if self.name is not None and not re.search(self.name,
+                                                   name or ""):
+            return False
+        return self.rate >= 1.0 or rng.random() < self.rate
+
+
+class FaultInjector:
+    """Chaos front door for :class:`FakeApiServer` (and hence the
+    HTTP facade): 409/429/500 storms, latency, dropped watches."""
+
+    def __init__(self, seed: int = 0):
+        self.rules: List[FaultRule] = []
+        self.rng = random.Random(seed)
+        #: seconds added to every front-door request.
+        self.latency: float = 0.0
+        #: end each watch stream after this many yielded events (a
+        #: dropped connection; the client must resume from its last
+        #: resourceVersion). None = never.
+        self.watch_max_events: Optional[int] = None
+        self._lock = threading.Lock()
+
+    def add_rule(self, exc: Callable[[], Exception], *,
+                 verbs: Optional[Tuple[str, ...]] = None,
+                 kind: Optional[str] = None,
+                 name: Optional[str] = None,
+                 rate: float = 1.0,
+                 times: Optional[int] = None) -> FaultRule:
+        rule = FaultRule(exc=exc, verbs=verbs, kind=kind, name=name,
+                         rate=rate, times=times)
+        with self._lock:
+            self.rules.append(rule)
+        return rule
+
+    def clear(self) -> None:
+        with self._lock:
+            self.rules = []
+            self.latency = 0.0
+            self.watch_max_events = None
+
+    def check(self, verb: str, kind: str,
+              name: Optional[str]) -> None:
+        if self.latency:
+            time.sleep(self.latency)
+        with self._lock:
+            for rule in self.rules:
+                if rule.matches(verb, kind, name, self.rng):
+                    rule.fired += 1
+                    raise rule.exc()
+
+
 class FakeApiServer:
     # Events retained for watch resume; older revisions answer Gone,
     # like a real apiserver compacting its watch cache.
@@ -93,6 +190,60 @@ class FakeApiServer:
         self._cond = threading.Condition(self._lock)
         # (namespace, pod) → container log text (set_pod_log helper).
         self._logs: Dict[Tuple[str, str], str] = {}
+        # Chaos surface: fault rules + the timestamped request log
+        # (what the CONTROLLER asked of the apiserver; kubelet-helper
+        # writes bypass both — see _admit/as_kubelet).
+        self.faults = FaultInjector()
+        self._request_log: List[Dict[str, Any]] = []
+        self._internal = threading.local()
+
+    # -- front door (faults + request accounting) -------------------------
+
+    @contextlib.contextmanager
+    def as_kubelet(self):
+        """Suspend fault injection + request logging for helper writes
+        that simulate the kubelet/chaos, not the controller."""
+        depth = getattr(self._internal, "depth", 0)
+        self._internal.depth = depth + 1
+        try:
+            yield self
+        finally:
+            self._internal.depth = depth
+
+    def _admit(self, verb: str, kind: str,
+               namespace: Optional[str] = None,
+               name: Optional[str] = None) -> None:
+        if getattr(self._internal, "depth", 0):
+            return
+        # list.append is atomic under the GIL; readers snapshot.
+        self._request_log.append({
+            "ts": time.monotonic(), "verb": verb, "kind": kind,
+            "namespace": namespace, "name": name,
+        })
+        self.faults.check(verb, kind, name)
+
+    def request_log(self) -> List[Dict[str, Any]]:
+        return list(self._request_log)
+
+    def request_count(self, *, verb: Optional[str] = None,
+                      kind: Optional[str] = None,
+                      name: Optional[str] = None,
+                      since: Optional[float] = None) -> int:
+        """Filtered request count; ``name`` is a substring match (a
+        job's requests include its pods/events, which embed the job
+        name)."""
+        n = 0
+        for entry in self.request_log():
+            if verb is not None and entry["verb"] != verb:
+                continue
+            if kind is not None and entry["kind"] != kind:
+                continue
+            if name is not None and name not in (entry["name"] or ""):
+                continue
+            if since is not None and entry["ts"] < since:
+                continue
+            n += 1
+        return n
 
     def _record(self, event_type: str, obj: Dict[str, Any]) -> None:
         self._events.append((self._revision, event_type,
@@ -107,6 +258,9 @@ class FakeApiServer:
         return (obj["kind"], meta.get("namespace", "default"), meta["name"])
 
     def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        meta = obj.get("metadata", {})
+        self._admit("create", obj.get("kind", "?"),
+                    meta.get("namespace", "default"), meta.get("name"))
         with self._lock:
             key = self._key(obj)
             if key in self._objects:
@@ -120,6 +274,7 @@ class FakeApiServer:
             return copy.deepcopy(stored)
 
     def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
+        self._admit("get", kind, namespace, name)
         with self._lock:
             try:
                 return copy.deepcopy(self._objects[(kind, namespace, name)])
@@ -130,6 +285,14 @@ class FakeApiServer:
              label_selector: Optional[Dict[str, str]] = None,
              field_selector: Optional[Dict[str, str]] = None
              ) -> List[Dict[str, Any]]:
+        self._admit("list", kind, namespace)
+        return self._list(kind, namespace, label_selector,
+                          field_selector)
+
+    def _list(self, kind: str, namespace: Optional[str] = None,
+              label_selector: Optional[Dict[str, str]] = None,
+              field_selector: Optional[Dict[str, str]] = None
+              ) -> List[Dict[str, Any]]:
         with self._lock:
             out = []
             for (k, ns, _), obj in sorted(self._objects.items()):
@@ -153,6 +316,7 @@ class FakeApiServer:
         suppression. Without it the controller's own steady-state
         status write would re-enqueue the job it just reconciled,
         a self-sustaining hot loop (r5 review)."""
+        self._admit("patch", kind, namespace, name)
         with self._lock:
             key = (kind, namespace, name)
             if key not in self._objects:
@@ -173,6 +337,9 @@ class FakeApiServer:
         otherwise) — the contract HttpApiClient.patch relies on to
         turn concurrent writers into Conflicts instead of lost
         updates."""
+        meta = obj.get("metadata", {})
+        self._admit("replace", obj.get("kind", "?"),
+                    meta.get("namespace", "default"), meta.get("name"))
         with self._lock:
             key = self._key(obj)
             stored = self._objects.get(key)
@@ -195,6 +362,7 @@ class FakeApiServer:
             return copy.deepcopy(new)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._admit("delete", kind, namespace, name)
         with self._lock:
             key = (kind, namespace, name)
             if key not in self._objects:
@@ -216,9 +384,10 @@ class FakeApiServer:
         """(items, revision horizon) under one lock acquisition —
         watching from the returned revision replays exactly the
         events after this list (same contract as HttpApiClient)."""
+        self._admit("list", kind, namespace)
         with self._lock:
-            return self.list(kind, namespace, label_selector,
-                             field_selector), self._revision
+            return self._list(kind, namespace, label_selector,
+                              field_selector), self._revision
 
     def watch(self, kind: str, namespace: Optional[str] = None,
               resource_version: int = 0,
@@ -232,8 +401,13 @@ class FakeApiServer:
         stream like a server-side watch timeout). Raises Gone when the
         requested version predates the retained window, mirroring the
         apiserver's 410. ``label_selector`` matches like ``list``
-        (None values = key existence)."""
+        (None values = key existence). An injected
+        ``faults.watch_max_events`` ends the stream early after that
+        many yields — a dropped connection the client must resume
+        from its last seen resourceVersion."""
+        self._admit("watch", kind, namespace)
         last = resource_version
+        yielded = 0
         while stop is None or not stop.is_set():
             with self._cond:
                 if (self._events
@@ -256,6 +430,10 @@ class FakeApiServer:
                 if not _labels_match(obj, label_selector):
                     continue
                 yield event_type, copy.deepcopy(obj)
+                yielded += 1
+                drop_after = self.faults.watch_max_events
+                if drop_after is not None and yielded >= drop_after:
+                    return  # injected connection drop
 
     def pod_logs(self, namespace: str, name: str, *,
                  tail: int = 100) -> str:
@@ -263,6 +441,7 @@ class FakeApiServer:
         GET /pods/<name>/log surface; same method on the kubectl and
         HTTP clients so the dashboard proxies logs through whichever
         client it was given)."""
+        self._admit("logs", "Pod", namespace, name)
         with self._lock:
             if ("Pod", namespace, name) not in self._objects:
                 raise NotFound(f"Pod {namespace}/{name}")
@@ -277,9 +456,10 @@ class FakeApiServer:
             self._logs[(namespace, name)] = text
 
     def set_pod_phase(self, namespace: str, name: str, phase: str) -> None:
-        self.patch("Pod", namespace, name,
-                   lambda o: o.setdefault("status", {}).update(
-                       {"phase": phase}))
+        with self.as_kubelet():
+            self.patch("Pod", namespace, name,
+                       lambda o: o.setdefault("status", {}).update(
+                           {"phase": phase}))
 
     def set_pod_terminated(self, namespace: str, name: str,
                            exit_code: int) -> None:
@@ -287,18 +467,21 @@ class FakeApiServer:
         it: phase from the code (0 → Succeeded, else Failed) plus the
         containerStatuses.terminated record the drain detection reads
         (reconciler.pod_drained)."""
-        self.patch(
-            "Pod", namespace, name,
-            lambda o: o.setdefault("status", {}).update({
-                "phase": "Succeeded" if exit_code == 0 else "Failed",
-                "containerStatuses": [{
-                    "name": "kubeflow-tpu",
-                    "state": {"terminated": {"exitCode": exit_code}},
-                }],
-            }))
+        with self.as_kubelet():
+            self.patch(
+                "Pod", namespace, name,
+                lambda o: o.setdefault("status", {}).update({
+                    "phase": "Succeeded" if exit_code == 0 else "Failed",
+                    "containerStatuses": [{
+                        "name": "kubeflow-tpu",
+                        "state": {"terminated": {"exitCode": exit_code}},
+                    }],
+                }))
 
     def set_all_pod_phases(self, namespace: str, phase: str,
                            label_selector: Optional[Dict[str, str]] = None
                            ) -> None:
-        for pod in self.list("Pod", namespace, label_selector):
-            self.set_pod_phase(namespace, pod["metadata"]["name"], phase)
+        with self.as_kubelet():
+            for pod in self._list("Pod", namespace, label_selector):
+                self.set_pod_phase(namespace, pod["metadata"]["name"],
+                                   phase)

@@ -27,7 +27,8 @@ Same method surface as the in-memory fake
 (:mod:`kubeflow_tpu.operator.fake`) plus ``watch`` — so the
 reconciler, the watch controller, and the fuzz suite run unchanged
 against either. Error taxonomy maps HTTP onto the fake's exceptions:
-404 → NotFound, 409 → Conflict, 410 → Gone.
+404 → NotFound, 409 → Conflict, 410 → Gone, 429 → TooManyRequests,
+5xx → ServerError.
 """
 
 from __future__ import annotations
@@ -43,7 +44,13 @@ import urllib.request
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from kubeflow_tpu.manifests.tpujob import GROUP, KIND, PLURAL, VERSION
-from kubeflow_tpu.operator.fake import Conflict, Gone, NotFound
+from kubeflow_tpu.operator.fake import (
+    Conflict,
+    Gone,
+    NotFound,
+    ServerError,
+    TooManyRequests,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -131,6 +138,12 @@ class HttpApiClient:
                 raise Conflict(f"{method} {url}: {detail}") from None
             if err.code == 410:
                 raise Gone(f"{method} {url}: {detail}") from None
+            if err.code == 429:
+                raise TooManyRequests(
+                    f"{method} {url}: {detail}") from None
+            if err.code >= 500:
+                raise ServerError(
+                    f"{method} {url} -> {err.code}: {detail}") from None
             raise RuntimeError(
                 f"{method} {url} -> {err.code}: {detail}") from None
 
@@ -254,8 +267,15 @@ class HttpApiClient:
                 event_type = event.get("type")
                 obj = event.get("object", {})
                 if event_type == "ERROR":
-                    if obj.get("code") == 410:
+                    code = obj.get("code")
+                    if code == 410:
                         raise Gone(obj.get("message", "compacted"))
+                    if code == 429:
+                        raise TooManyRequests(
+                            obj.get("message", "throttled"))
+                    if code is not None and code >= 500:
+                        raise ServerError(
+                            obj.get("message", f"watch error {code}"))
                     raise RuntimeError(f"watch error: {obj}")
                 obj.setdefault("kind", kind)
                 yield event_type, obj

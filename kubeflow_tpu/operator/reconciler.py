@@ -44,7 +44,8 @@ from __future__ import annotations
 import dataclasses
 import datetime
 import logging
-from typing import Any, Dict, List, Optional
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.manifests import k8s
 from kubeflow_tpu.manifests.tpujob import GROUP, KIND, VERSION
@@ -75,6 +76,10 @@ JOB_LABEL = "kubeflow.org/tpujob"
 REPLICA_TYPE_LABEL = "kubeflow.org/replica-type"
 REPLICA_INDEX_LABEL = "kubeflow.org/replica-index"
 SLICE_INDEX_LABEL = "kubeflow.org/slice-index"
+# Non-phase conditions: set alongside the phase conditions, never
+# flipped by the phase machinery in _update_conditions.
+STALLED_CONDITION = "ReconcileStalled"
+DEADLINE_CONDITION = "DeadlineExceeded"
 
 
 def pod_drained(pod: Optional[Dict[str, Any]]) -> bool:
@@ -99,7 +104,9 @@ def _update_conditions(status: Dict[str, Any], phase: str,
     phase type; `status` True on the current phase, False on the
     rest; lastTransitionTime only moves on actual transitions) —
     the tf-operator's TFJobCondition surface, which kubectl
-    describe/wait and the dashboard consume."""
+    describe/wait and the dashboard consume. Non-phase condition
+    types (ReconcileStalled, DeadlineExceeded) pass through
+    untouched."""
     now = datetime.datetime.now(datetime.timezone.utc).isoformat()
     conditions = {c["type"]: c for c in status.get("conditions", [])}
     for cond_type in ("Pending", "Running", "Restarting",
@@ -119,6 +126,44 @@ def _update_conditions(status: Dict[str, Any], phase: str,
         if active and reason:
             entry["reason"] = reason
     status["conditions"] = list(conditions.values())
+
+
+def _set_extra_condition(status: Dict[str, Any], cond_type: str,
+                         cond_status: str, reason: str) -> bool:
+    """Upsert a non-phase condition (ReconcileStalled,
+    DeadlineExceeded); returns whether anything changed.
+    lastTransitionTime only moves on actual status flips, matching
+    the phase-condition convention."""
+    now = datetime.datetime.now(datetime.timezone.utc).isoformat()
+    conditions = status.setdefault("conditions", [])
+    for entry in conditions:
+        if entry.get("type") == cond_type:
+            changed = False
+            if entry.get("status") != cond_status:
+                entry["status"] = cond_status
+                entry["lastTransitionTime"] = now
+                changed = True
+            if entry.get("reason") != reason:
+                entry["reason"] = reason
+                changed = True
+            return changed
+    conditions.append({"type": cond_type, "status": cond_status,
+                       "reason": reason, "lastTransitionTime": now})
+    return True
+
+
+def _parse_k8s_time(value: Optional[str]
+                    ) -> Optional[datetime.datetime]:
+    if not value:
+        return None
+    try:
+        parsed = datetime.datetime.fromisoformat(
+            value.replace("Z", "+00:00"))
+    except ValueError:
+        return None
+    if parsed.tzinfo is None:
+        parsed = parsed.replace(tzinfo=datetime.timezone.utc)
+    return parsed
 
 
 @dataclasses.dataclass
@@ -147,6 +192,20 @@ class ReplicaMember:
 
 def job_num_slices(job: Dict[str, Any]) -> int:
     return int(job["spec"].get("numSlices", 1) or 1)
+
+
+def _scheduling_deadline(job: Dict[str, Any]) -> Optional[float]:
+    """spec.schedulingDeadlineSeconds as a float, or None (off).
+    Zero/negative/garbage reads as off — a bad value must not
+    instantly fail every job."""
+    raw = job["spec"].get("schedulingDeadlineSeconds")
+    if raw is None:
+        return None
+    try:
+        deadline = float(raw)
+    except (TypeError, ValueError):
+        return None
+    return deadline if deadline > 0 else None
 
 
 def expected_members(job: Dict[str, Any]) -> List[ReplicaMember]:
@@ -187,6 +246,19 @@ class Reconciler:
         self.api = api
         self.max_restarts = max_restarts
         self.completion_grace_passes = completion_grace_passes
+        # Per-pass, PER-THREAD (N controller workers share one
+        # Reconciler): seconds after which this job wants another
+        # look even with no events (a pending schedulingDeadline).
+        # The watch controller turns it into a workqueue timer.
+        self._pass_state = threading.local()
+
+    @property
+    def requeue_after(self) -> Optional[float]:
+        return getattr(self._pass_state, "requeue_after", None)
+
+    @requeue_after.setter
+    def requeue_after(self, value: Optional[float]) -> None:
+        self._pass_state.requeue_after = value
 
     # -- object builders --------------------------------------------------
 
@@ -339,6 +411,7 @@ class Reconciler:
         ns = job["metadata"].get("namespace", "default")
         status = job.get("status", {})
         phase = status.get("phase", "Pending")
+        self.requeue_after = None
         if phase in ("Succeeded", "Failed"):
             return phase
 
@@ -347,6 +420,11 @@ class Reconciler:
             return self._set_status(job, "Failed",
                                     reason="no replicaSpecs")
         chief = chief_member_index(job, members)
+
+        # Gang scheduling deadline bookkeeping happens after the pod
+        # scan below — the verdict must come from LIVE pod state, not
+        # from a possibly-stale status.phase.
+        deadline = _scheduling_deadline(job)
 
         # Ensure the gang DNS service + the whole-gang disruption
         # budget (minAvailable = gang size: voluntary evictions are
@@ -408,6 +486,48 @@ class Reconciler:
             if m.pod_name(name) in pods else PodPhase.MISSING
             for m in members
         ]
+
+        # Gang scheduling deadline: a gang that can never place sits
+        # Pending forever — on TPUs that is held hardware. Enforced
+        # from LIVE pod state: it fires only while the gang has a
+        # scheduling attempt outstanding (pods exist, none has ever
+        # started — a Running/Succeeded/Failed pod means scheduling
+        # happened and other machinery owns the outcome) so a timer
+        # racing the pod-event pass can never tear down a healthy
+        # gang. On expiry the job Fails with a DeadlineExceeded
+        # condition + Event and the gang's pods are torn down so the
+        # slices release.
+        if deadline is not None and phase == "Pending":
+            age = self._pending_age(job)
+            awaiting_schedule = (
+                any(p != PodPhase.MISSING for p in phases)
+                and all(p in (PodPhase.PENDING, PodPhase.MISSING)
+                        for p in phases))
+            if (age is not None and age >= deadline
+                    and awaiting_schedule):
+                for m in members:
+                    try:
+                        self.api.delete("Pod", ns, m.pod_name(name))
+                    except NotFound:
+                        pass
+                return self._set_status(
+                    job, "Failed",
+                    reason=f"gang not scheduled within "
+                           f"schedulingDeadlineSeconds={int(deadline)} "
+                           f"(Pending for {age:.0f}s); gang torn down",
+                    extra_condition=(
+                        DEADLINE_CONDITION,
+                        f"Pending {age:.0f}s >= deadline "
+                        f"{int(deadline)}s"),
+                    event_reason=DEADLINE_CONDITION)
+            if age is not None and all(
+                    p in (PodPhase.PENDING, PodPhase.MISSING)
+                    for p in phases):
+                # Ask to be re-observed right when the deadline lands
+                # (events are quiescent for a stuck-Pending gang; the
+                # relist period alone could overshoot by a resync).
+                self.requeue_after = max(0.0, deadline - age)
+
         allow_restart = job["spec"].get("recoveryPolicy",
                                         "restart-slice") == "restart-slice"
         skew_passes = int(status.get("completionSkewPasses", 0))
@@ -503,15 +623,70 @@ class Reconciler:
         return self._set_status(job, "Running" if running else "Pending",
                                 restart_count=restarts)
 
-    def _emit_event(self, job: Dict[str, Any], phase: str,
-                    restart_count: int,
-                    reason: Optional[str]) -> None:
-        """One k8s Event per phase transition (the tf-operator
-        recorded lifecycle events; `kubectl describe tpujob` shows
-        these). Best-effort: an event that can't be written must
-        never fail the reconcile pass. Name carries the phase +
-        restart count so retries of the same transition dedupe via
-        Conflict instead of piling up."""
+    def _pending_age(self, job: Dict[str, Any]) -> Optional[float]:
+        """Seconds this job has been Pending, anchored on the Pending
+        condition's lastTransitionTime — i.e. on the operator's OWN
+        first observation, never metadata.creationTimestamp: a job
+        submitted while the operator was down must get its full
+        deadline of scheduling time after the operator returns, not
+        be executed on the operator's first pass. None until this
+        pass's own status write materializes the anchor."""
+        now = datetime.datetime.now(datetime.timezone.utc)
+        for cond in job.get("status", {}).get("conditions", []):
+            if (cond.get("type") == "Pending"
+                    and cond.get("status") == "True"):
+                anchor = _parse_k8s_time(cond.get("lastTransitionTime"))
+                if anchor is not None:
+                    return (now - anchor).total_seconds()
+        return None
+
+    # -- quarantine surface (driven by the watch controller) --------------
+
+    def mark_stalled(self, namespace: str, name: str,
+                     failures: int) -> None:
+        """Surface a poison job: ReconcileStalled condition + Warning
+        Event. Called by the controller when a key crosses the
+        quarantine threshold; exceptions propagate (the caller treats
+        this write as best-effort and retries at the capped
+        interval)."""
+        reason = (f"{failures} consecutive reconcile failures; "
+                  f"retrying at the backoff cap")
+        try:
+            job = self.api.get(KIND, namespace, name)
+        except NotFound:
+            return
+        self.api.patch(
+            KIND, namespace, name,
+            lambda o: _set_extra_condition(
+                o.setdefault("status", {}), STALLED_CONDITION,
+                "True", reason))
+        self._record_event(
+            job, f"{name}.reconcilestalled", STALLED_CONDITION,
+            f"TPUJob reconcile stalled: {reason}", "Warning")
+
+    def clear_stalled(self, namespace: str, name: str) -> None:
+        """Reconcile succeeded again: flip ReconcileStalled to False
+        (only if it was materialized)."""
+
+        def mutate(obj: Dict[str, Any]) -> None:
+            status = obj.get("status", {})
+            if any(c.get("type") == STALLED_CONDITION
+                   for c in status.get("conditions", [])):
+                _set_extra_condition(status, STALLED_CONDITION,
+                                     "False", "reconcile recovered")
+
+        try:
+            self.api.patch(KIND, namespace, name, mutate)
+        except NotFound:
+            pass
+
+    def _record_event(self, job: Dict[str, Any], event_name: str,
+                      reason: str, message: str,
+                      event_type: str) -> None:
+        """Create-or-aggregate one k8s Event. Best-effort: an event
+        that can't be written must never fail the reconcile pass.
+        The deterministic name makes retries of the same transition
+        dedupe via Conflict instead of piling up."""
         name = job["metadata"]["name"]
         ns = job["metadata"].get("namespace", "default")
         now = datetime.datetime.now(
@@ -520,7 +695,7 @@ class Reconciler:
             "apiVersion": "v1",
             "kind": "Event",
             "metadata": {
-                "name": f"{name}.{phase.lower()}.r{restart_count}",
+                "name": event_name,
                 "namespace": ns,
             },
             "involvedObject": {
@@ -530,10 +705,9 @@ class Reconciler:
                 "namespace": ns,
                 "uid": job["metadata"].get("uid", ""),
             },
-            "reason": phase,
-            "message": reason or f"TPUJob entered phase {phase}",
-            "type": ("Warning" if phase in ("Restarting", "Failed")
-                     else "Normal"),
+            "reason": reason,
+            "message": message,
+            "type": event_type,
             "source": {"component": "tpujob-operator"},
             "firstTimestamp": now,
             "lastTimestamp": now,
@@ -567,10 +741,28 @@ class Reconciler:
         except Exception:  # noqa: BLE001 — events are best-effort
             logger.exception("event emission failed for %s/%s", ns, name)
 
+    def _emit_event(self, job: Dict[str, Any], phase: str,
+                    restart_count: int, reason: Optional[str],
+                    event_reason: Optional[str] = None) -> None:
+        """One k8s Event per phase transition (the tf-operator
+        recorded lifecycle events; `kubectl describe tpujob` shows
+        these). Name carries the phase + restart count so retries of
+        the same transition aggregate. ``event_reason`` overrides the
+        Event's reason field (e.g. DeadlineExceeded) while the name
+        stays phase-keyed."""
+        name = job["metadata"]["name"]
+        self._record_event(
+            job, f"{name}.{phase.lower()}.r{restart_count}",
+            event_reason or phase,
+            reason or f"TPUJob entered phase {phase}",
+            "Warning" if phase in ("Restarting", "Failed") else "Normal")
+
     def _set_status(self, job: Dict[str, Any], phase: str, *,
                     restart_count: int = 0,
                     completion_skew: int = 0,
-                    reason: Optional[str] = None) -> str:
+                    reason: Optional[str] = None,
+                    extra_condition: Optional[Tuple[str, str]] = None,
+                    event_reason: Optional[str] = None) -> str:
         name = job["metadata"]["name"]
         ns = job["metadata"].get("namespace", "default")
         previous_phase = job.get("status", {}).get("phase")
@@ -588,6 +780,20 @@ class Reconciler:
                 # must not carry a stale 'slice fault' into Succeeded.
                 status.pop("reason", None)
             _update_conditions(status, phase, reason)
+            if extra_condition is not None:
+                _set_extra_condition(status, extra_condition[0],
+                                     "True", extra_condition[1])
+            # Any completed pass IS recovery from a reconcile stall:
+            # clear the condition from apiserver state here (not from
+            # the controller's memory of having set it — that memory
+            # dies with the process, and a job must not wear a stale
+            # ReconcileStalled banner across operator restarts or
+            # leader failovers).
+            if any(c.get("type") == STALLED_CONDITION
+                   and c.get("status") == "True"
+                   for c in status.get("conditions", [])):
+                _set_extra_condition(status, STALLED_CONDITION,
+                                     "False", "reconcile recovered")
 
         try:
             self.api.patch(KIND, ns, name, mutate)
@@ -599,5 +805,6 @@ class Reconciler:
             return phase
         mutate(job)
         if phase != previous_phase:
-            self._emit_event(job, phase, restart_count, reason)
+            self._emit_event(job, phase, restart_count, reason,
+                             event_reason)
         return phase

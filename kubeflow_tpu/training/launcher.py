@@ -113,6 +113,20 @@ def slice_config(env=os.environ) -> Optional[dict]:
     }
 
 
+def _distributed_initialized(jax) -> bool:
+    """Whether jax.distributed.initialize already ran in this
+    process. ``jax.distributed.is_initialized`` only exists from
+    jax 0.4.39; older versions expose the same fact as the private
+    global state's client handle."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    from jax._src import distributed
+
+    state = getattr(distributed, "global_state", None)
+    return getattr(state, "client", None) is not None
+
+
 def initialize_distributed(env=os.environ) -> bool:
     """jax.distributed.initialize from env; True if multi-process.
 
@@ -128,7 +142,7 @@ def initialize_distributed(env=os.environ) -> bool:
         return False
     import jax
 
-    if jax.distributed.is_initialized():
+    if _distributed_initialized(jax):
         logger.info("jax.distributed already initialized; skipping")
         return True
 
@@ -139,6 +153,19 @@ def initialize_distributed(env=os.environ) -> bool:
             "%s); mesh dcn_data axis comes from the env",
             slices["slice_id"], slices["num_slices"],
             slices["coordinator_address"])
+    if (env.get("JAX_PLATFORMS") or "").strip().lower() == "cpu":
+        # CPU gangs (operator `simulateTpu` mode, hermetic multi-
+        # process tests) need an explicit cross-host collectives
+        # transport — without it this jaxlib answers every multi-
+        # process computation with "not implemented on the CPU
+        # backend". Must happen BEFORE any backend touch; newer jax
+        # versions default to gloo and ignore the re-set.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:  # noqa: BLE001 — flag renamed/absent
+            logger.info("jax_cpu_collectives_implementation not "
+                        "settable; relying on the version default")
     logger.info("jax.distributed.initialize(%s, num_processes=%d, "
                 "process_id=%d)", config["coordinator_address"],
                 config["num_processes"], config["process_id"])

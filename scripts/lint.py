@@ -198,6 +198,56 @@ def check_license_file() -> list:
     return errors
 
 
+def check_operator_wait_discipline() -> list:
+    """The workqueue is the operator's ONLY sanctioned wait path
+    (ISSUE 2): under ``kubeflow_tpu/operator/`` — excluding
+    workqueue.py itself — forbid (a) any ``time.sleep`` call and
+    (b) any ``.wait(...)`` call lexically inside an ``except``
+    handler. Both are the flat-retry hot-loop shape the rate-limited
+    workqueue replaced; failure handling must route delays through
+    ExponentialBackoff/WorkQueue so they are capped, jittered, and
+    observable in the metrics surface."""
+    # Exempt: the sanctioned wait path itself; the fault injector
+    # (whose time.sleep IS the injected apiserver latency); and the
+    # load-bench driver (its sleeps pace the measurement harness, not
+    # the control loop under test).
+    exempt = {"workqueue.py", "fake.py", "benchmark.py"}
+    errors = []
+    operator_dir = REPO / "kubeflow_tpu" / "operator"
+    for f in sorted(operator_dir.glob("*.py")):
+        if f.name in exempt:
+            continue
+        tree = ast.parse(f.read_text(), str(f))
+        except_spans = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler):
+                except_spans.append((node.lineno, node.end_lineno))
+
+        def in_except(lineno: int) -> bool:
+            return any(lo <= lineno <= hi for lo, hi in except_spans)
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if (func.attr == "sleep"
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "time"):
+                errors.append(
+                    f"operator-wait: {f.relative_to(REPO)}:"
+                    f"{node.lineno}: time.sleep — route waits through "
+                    f"the workqueue (operator/workqueue.py)")
+            elif func.attr == "wait" and in_except(node.lineno):
+                errors.append(
+                    f"operator-wait: {f.relative_to(REPO)}:"
+                    f"{node.lineno}: .wait() inside an except handler "
+                    f"is a flat retry loop — use "
+                    f"ExponentialBackoff/WorkQueue instead")
+    return errors
+
+
 def check_unused_imports() -> list:
     errors = []
     for f in iter_py_files():
@@ -258,8 +308,8 @@ def main() -> int:
 
     errors = []
     for check in (check_syntax, check_imports_all_modules, check_cli_boots,
-                  check_unused_imports, check_boilerplate,
-                  check_license_file):
+                  check_unused_imports, check_operator_wait_discipline,
+                  check_boilerplate, check_license_file):
         found = check()
         print(f"{check.__name__}: {'ok' if not found else f'{len(found)} errors'}")
         errors.extend(found)

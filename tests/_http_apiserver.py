@@ -35,6 +35,8 @@ from kubeflow_tpu.operator.fake import (
     FakeApiServer,
     Gone,
     NotFound,
+    ServerError,
+    TooManyRequests,
 )
 
 _PLURAL_TO_KIND = {
@@ -128,6 +130,14 @@ class _Handler(BaseHTTPRequestHandler):
     # -- verbs ------------------------------------------------------------
 
     def do_GET(self):
+        try:
+            return self._do_get()
+        except TooManyRequests as err:  # injected 429 (fake.faults)
+            return self._error(429, str(err))
+        except ServerError as err:  # injected 5xx
+            return self._error(500, str(err))
+
+    def _do_get(self):
         if not self._authorized():
             return self._error(401, "bad bearer token")
         kind, ns, name, subresource, query = self._parse()
@@ -181,6 +191,17 @@ class _Handler(BaseHTTPRequestHandler):
             emit({"type": "ERROR",
                   "object": {"kind": "Status", "code": 410,
                              "message": str(err)}})
+        except TooManyRequests as err:
+            # Injected throttle mid-stream: headers are already out,
+            # so the 429 rides the stream as an ERROR event (the
+            # client maps it back onto the exception taxonomy).
+            emit({"type": "ERROR",
+                  "object": {"kind": "Status", "code": 429,
+                             "message": str(err)}})
+        except ServerError as err:
+            emit({"type": "ERROR",
+                  "object": {"kind": "Status", "code": 500,
+                             "message": str(err)}})
         except (BrokenPipeError, ConnectionResetError):
             pass  # client hung up
 
@@ -191,6 +212,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(201, self.fake.create(self._body()))
         except Conflict as err:
             return self._error(409, str(err))
+        except TooManyRequests as err:
+            return self._error(429, str(err))
+        except ServerError as err:
+            return self._error(500, str(err))
 
     def do_PUT(self):
         if not self._authorized():
@@ -210,6 +235,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(404, str(err))
         except Conflict as err:
             return self._error(409, str(err))
+        except TooManyRequests as err:
+            return self._error(429, str(err))
+        except ServerError as err:
+            return self._error(500, str(err))
 
     def do_DELETE(self):
         if not self._authorized():
@@ -220,6 +249,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(200, {"kind": "Status", "status": "Success"})
         except NotFound as err:
             return self._error(404, str(err))
+        except TooManyRequests as err:
+            return self._error(429, str(err))
+        except ServerError as err:
+            return self._error(500, str(err))
 
 
 class HttpFakeApiServer:

@@ -370,6 +370,95 @@ class DashboardTest(tornado.testing.AsyncHTTPTestCase):
         assert resp.headers["Location"] == "/tpujobs/ui/"
 
 
+    def test_warning_conditions_surface_in_detail_and_ui(self):
+        """ReconcileStalled / DeadlineExceeded (the operator's
+        quarantine + gang-deadline surface, r7) ride the summary, the
+        detail API's `warnings`, and an HTML banner — while NOT
+        stealing the phase-condition transition anchor."""
+        self.api.patch(KIND, "default", "mnist",
+                       lambda o: o["status"].update({"conditions": [
+                           {"type": "ReconcileStalled", "status": "True",
+                            "reason": "6 consecutive reconcile failures",
+                            "lastTransitionTime": "2026-08-01T00:00:01"},
+                           {"type": "Running", "status": "True",
+                            "lastTransitionTime": "2026-07-31T00:00:00"},
+                       ]}))
+        resp = self.fetch("/tpujobs/api/tpujob/default/mnist")
+        detail = json.loads(resp.body)
+        assert detail["warnings"] == [{
+            "type": "ReconcileStalled",
+            "reason": "6 consecutive reconcile failures",
+            "since": "2026-08-01T00:00:01"}]
+        assert detail["summary"]["warnings"] == detail["warnings"]
+        # The timeline anchor stays on the PHASE condition.
+        assert detail["summary"]["lastTransitionTime"] == \
+            "2026-07-31T00:00:00"
+        page = self.fetch("/tpujobs/ui/job/default/mnist").body.decode()
+        assert "ReconcileStalled" in page
+        assert "6 consecutive reconcile failures" in page
+        # List view carries the warnings too (dashboards can badge).
+        items = json.loads(
+            self.fetch("/tpujobs/api/tpujob").body)["items"]
+        mnist = next(i for i in items if i["name"] == "mnist")
+        assert mnist["warnings"][0]["type"] == "ReconcileStalled"
+
+    def test_deadline_exceeded_condition_in_detail(self):
+        """A deadline-failed job shows the DeadlineExceeded banner
+        alongside its Failed phase — straight from the reconciler's
+        own writes, not hand-built conditions."""
+        from kubeflow_tpu.operator import Reconciler
+        from kubeflow_tpu.operator.reconciler import DEADLINE_CONDITION
+
+        from tests.test_deadline import (
+            _age_pending_condition,
+            make_deadline_job,
+        )
+
+        self.api.create(make_deadline_job(name="dlweb", deadline=5))
+        r = Reconciler(self.api)
+        r.reconcile(self.api.get(KIND, "default", "dlweb"))
+        _age_pending_condition(self.api, "dlweb", seconds=10)
+        r.reconcile(self.api.get(KIND, "default", "dlweb"))
+
+        resp = self.fetch("/tpujobs/api/tpujob/default/dlweb")
+        detail = json.loads(resp.body)
+        assert detail["summary"]["phase"] == "Failed"
+        assert [w["type"] for w in detail["warnings"]] == \
+            [DEADLINE_CONDITION]
+        page = self.fetch(
+            "/tpujobs/ui/job/default/dlweb").body.decode()
+        assert DEADLINE_CONDITION in page
+        # The deadline Event surfaces in the events table.
+        assert any(e["reason"] == DEADLINE_CONDITION
+                   for e in detail["events"]), detail["events"]
+
+    def test_operator_metrics_endpoint(self):
+        """GET /tpujobs/api/operator serves the metrics ConfigMap the
+        controller publishes — the dashboard and the load bench read
+        the same numbers."""
+        from kubeflow_tpu.operator.controller import (
+            METRICS_CONFIGMAP,
+            METRICS_KEY,
+        )
+
+        resp = self.fetch("/tpujobs/api/operator")
+        assert resp.code == 404  # not publishing yet
+        assert json.loads(resp.body)["available"] is False
+
+        metrics = {"workers": 4, "reconciles": 123,
+                   "queue": {"depth": 1, "quarantined": ["default/p"]}}
+        self.api.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": METRICS_CONFIGMAP,
+                         "namespace": "default"},
+            "data": {METRICS_KEY: json.dumps(metrics)},
+        })
+        resp = self.fetch("/tpujobs/api/operator")
+        assert resp.code == 200
+        payload = json.loads(resp.body)
+        assert payload["available"] is True
+        assert payload["metrics"] == metrics
+
 class TraceTabTest(tornado.testing.AsyncHTTPTestCase):
     """Profiler traces surfaced through the dashboard (SURVEY §5's
     stated rebuild target; VERDICT-r3 missing #3)."""

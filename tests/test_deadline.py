@@ -1,0 +1,270 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Gang scheduling deadlines (spec.schedulingDeadlineSeconds): a gang
+that can never place must not hold TPU slices forever — on expiry the
+job Fails with a DeadlineExceeded condition + Event and its pods are
+torn down. Unit tests against the fake, plus the acceptance e2e:
+reconciler → WatchController → HttpApiClient → real socket → facade.
+"""
+
+import datetime
+import threading
+import time
+
+from kubeflow_tpu.manifests.tpujob import (
+    KIND,
+    crd,
+    replica_spec,
+    termination_policy,
+    tpu_job,
+)
+from kubeflow_tpu.operator import FakeApiServer, Reconciler
+from kubeflow_tpu.operator.controller import WatchController
+from kubeflow_tpu.operator.http_client import HttpApiClient
+from kubeflow_tpu.operator.reconciler import (
+    DEADLINE_CONDITION,
+    JOB_LABEL,
+)
+from kubeflow_tpu.operator.workqueue import ExponentialBackoff
+
+from tests._http_apiserver import HttpFakeApiServer
+from tests.test_operator import submit
+
+
+def make_deadline_job(name="dj", workers=2, deadline=30):
+    spec = replica_spec(
+        "TPU_WORKER", workers, image="img:1",
+        tpu_accelerator="tpu-v5-lite-podslice", tpu_topology="2x4")
+    job = tpu_job(name, "default", [spec],
+                  termination=termination_policy("TPU_WORKER", 0),
+                  scheduling_deadline_seconds=deadline)
+    job["metadata"]["uid"] = "uid-dl"
+    return job
+
+
+def _age_pending_condition(api, name, seconds):
+    """Kubelet-less time travel: move the Pending condition's
+    transition time into the past."""
+    past = (datetime.datetime.now(datetime.timezone.utc)
+            - datetime.timedelta(seconds=seconds)).isoformat()
+
+    def mutate(obj):
+        for cond in obj.get("status", {}).get("conditions", []):
+            if cond["type"] == "Pending":
+                cond["lastTransitionTime"] = past
+
+    with api.as_kubelet():
+        api.patch(KIND, "default", name, mutate)
+
+
+def test_crd_schema_carries_scheduling_deadline():
+    schema = (crd()["spec"]["versions"][0]["schema"]
+              ["openAPIV3Schema"]["properties"]["spec"]["properties"])
+    assert schema["schedulingDeadlineSeconds"] == {
+        "type": "integer", "minimum": 1}
+    job = make_deadline_job(deadline=120)
+    assert job["spec"]["schedulingDeadlineSeconds"] == 120
+    # Jobs without a deadline stay schema-identical to pre-r7 CRs.
+    plain = tpu_job("p", "default", [replica_spec(
+        "TPU_WORKER", 1, image="i", tpu_accelerator="a",
+        tpu_topology="1x1")])
+    assert "schedulingDeadlineSeconds" not in plain["spec"]
+
+
+def test_deadline_expiry_fails_job_and_releases_gang():
+    api = FakeApiServer()
+    job = submit(api, make_deadline_job(workers=3, deadline=5))
+    r = Reconciler(api)
+    assert r.reconcile(job) == "Pending"
+    assert len(api.list("Pod", "default", {JOB_LABEL: "dj"})) == 3
+
+    # Not yet expired: the reconciler asks for a wake-up at expiry.
+    job = api.get(KIND, "default", "dj")
+    assert r.reconcile(job) == "Pending"
+    assert r.requeue_after is not None
+    assert 0 < r.requeue_after <= 5.0
+
+    _age_pending_condition(api, "dj", seconds=6)
+    job = api.get(KIND, "default", "dj")
+    assert r.reconcile(job) == "Failed"
+    # TPU slices released: every gang pod deleted.
+    assert api.list("Pod", "default", {JOB_LABEL: "dj"}) == []
+    job = api.get(KIND, "default", "dj")
+    assert "schedulingDeadlineSeconds" in job["status"]["reason"]
+    conds = {c["type"]: c for c in job["status"]["conditions"]}
+    assert conds["Failed"]["status"] == "True"
+    assert conds[DEADLINE_CONDITION]["status"] == "True"
+    assert "deadline" in conds[DEADLINE_CONDITION]["reason"]
+    # The Event carries reason DeadlineExceeded (kubectl describe).
+    events = [e for e in api.list("Event", "default")
+              if e["involvedObject"]["name"] == "dj"]
+    assert any(e["reason"] == DEADLINE_CONDITION
+               and e["type"] == "Warning" for e in events), events
+    # Terminal is absorbing: a later pass changes nothing.
+    assert r.reconcile(api.get(KIND, "default", "dj")) == "Failed"
+
+
+def test_deadline_verdict_uses_live_pods_not_stale_phase():
+    """Review finding: a deadline timer firing in the same pass that
+    first observes the gang Running (per-key dedup coalesces the pod
+    event and the timer) must NOT tear down the healthy gang just
+    because status.phase still reads Pending."""
+    api = FakeApiServer()
+    job = submit(api, make_deadline_job(workers=2, deadline=5))
+    r = Reconciler(api)
+    r.reconcile(job)  # creates the gang; phase Pending
+    # Kubelet starts the pods, but no pass has observed it yet —
+    # status.phase is still Pending AND the deadline has expired.
+    api.set_all_pod_phases("default", "Running", {JOB_LABEL: "dj"})
+    _age_pending_condition(api, "dj", seconds=60)
+    job = api.get(KIND, "default", "dj")
+    assert job["status"]["phase"] == "Pending"  # stale, by design
+    assert r.reconcile(job) == "Running"  # NOT Failed
+    assert len(api.list("Pod", "default", {JOB_LABEL: "dj"})) == 2
+    conds = {c["type"] for c in api.get(KIND, "default", "dj")
+             ["status"]["conditions"]}
+    assert DEADLINE_CONDITION not in conds
+
+
+def test_deadline_counts_from_operator_observation_not_creation():
+    """Review finding: a job submitted while the operator was down
+    must get its full deadline of scheduling time after the operator
+    returns — the anchor is the operator's own Pending write, never
+    metadata.creationTimestamp."""
+    api = FakeApiServer()
+    job = make_deadline_job(workers=1, deadline=5)
+    # Submitted an hour ago, operator down the whole time.
+    job["metadata"]["creationTimestamp"] = "2020-01-01T00:00:00+00:00"
+    job = submit(api, job)
+    r = Reconciler(api)
+    # First pass after the outage: creates the gang, anchors Pending
+    # NOW — must not instantly execute the deadline.
+    assert r.reconcile(job) == "Pending"
+    assert len(api.list("Pod", "default", {JOB_LABEL: "dj"})) == 1
+    job = api.get(KIND, "default", "dj")
+    assert r.reconcile(job) == "Pending"  # still within the deadline
+    assert len(api.list("Pod", "default", {JOB_LABEL: "dj"})) == 1
+
+
+def test_stalled_condition_cleared_without_process_memory():
+    """Review finding: ReconcileStalled=True written by a previous
+    operator incarnation is cleared by any successful pass of a NEW
+    process (no in-memory _stalled set) — the clear rides the status
+    write itself."""
+    from kubeflow_tpu.operator.reconciler import STALLED_CONDITION
+
+    api = FakeApiServer()
+    job = submit(api, make_deadline_job(workers=1, deadline=600))
+    old = Reconciler(api)
+    old.reconcile(job)
+    old.mark_stalled("default", "dj", failures=7)
+    conds = {c["type"]: c["status"]
+             for c in api.get(KIND, "default", "dj")
+             ["status"]["conditions"]}
+    assert conds[STALLED_CONDITION] == "True"
+
+    fresh = Reconciler(api)  # the restarted operator
+    fresh.reconcile(api.get(KIND, "default", "dj"))
+    conds = {c["type"]: c["status"]
+             for c in api.get(KIND, "default", "dj")
+             ["status"]["conditions"]}
+    assert conds[STALLED_CONDITION] == "False"
+
+
+def test_deadline_not_enforced_once_running():
+    """The deadline is about SCHEDULING: a gang that started must
+    never be deadline-killed, however long it runs."""
+    api = FakeApiServer()
+    job = submit(api, make_deadline_job(workers=1, deadline=5))
+    r = Reconciler(api)
+    r.reconcile(job)
+    api.set_all_pod_phases("default", "Running", {JOB_LABEL: "dj"})
+    r.reconcile(api.get(KIND, "default", "dj"))
+    _age_pending_condition(api, "dj", seconds=600)  # stale, now False
+    job = api.get(KIND, "default", "dj")
+    assert r.reconcile(job) == "Running"
+    assert r.requeue_after is None
+    assert len(api.list("Pod", "default", {JOB_LABEL: "dj"})) == 1
+
+
+def test_no_deadline_means_wait_forever():
+    api = FakeApiServer()
+    job = submit(api, tpu_job("nd", "default", [replica_spec(
+        "TPU_WORKER", 1, image="i", tpu_accelerator="a",
+        tpu_topology="1x1")],
+        termination=termination_policy("TPU_WORKER", 0)))
+    r = Reconciler(api)
+    r.reconcile(job)
+    _age_pending_condition(api, "nd", seconds=10_000)
+    job = api.get(KIND, "default", "nd")
+    assert r.reconcile(job) == "Pending"
+    assert r.requeue_after is None
+    assert len(api.list("Pod", "default", {JOB_LABEL: "nd"})) == 1
+
+
+def test_deadline_e2e_over_http_apiserver():
+    """Acceptance: an unsatisfiable gang (pods never scheduled — no
+    kubelet ever writes a status) fails within
+    schedulingDeadlineSeconds ± one resync, its pods are deleted, and
+    the job carries the DeadlineExceeded condition + Event — all
+    through the production HTTP client over a real socket."""
+    with HttpFakeApiServer(token="dl") as srv:
+        client = HttpApiClient(srv.url, token="dl")
+        ctl = WatchController(
+            client, relist_seconds=0.5,
+            backoff=ExponentialBackoff(base=0.02, cap=0.5))
+        t = threading.Thread(target=ctl.run, daemon=True)
+        t.start()
+        try:
+            deadline_s = 1
+            t0 = time.monotonic()
+            client.create(make_deadline_job(workers=2,
+                                            deadline=deadline_s))
+            failed_at = None
+            while time.monotonic() - t0 < 10.0:
+                job = srv.fake.get(KIND, "default", "dj")
+                if job.get("status", {}).get("phase") == "Failed":
+                    failed_at = time.monotonic() - t0
+                    break
+                time.sleep(0.02)
+            assert failed_at is not None, "deadline never fired"
+            # Within the deadline ± one resync period (+ scheduling
+            # slack): the reconciler's requeue_after timer fires at
+            # expiry, the relist is only the safety net.
+            assert failed_at >= deadline_s * 0.5
+            assert failed_at <= deadline_s + 0.5 + 1.0, failed_at
+            assert srv.fake.list("Pod", "default",
+                                 {JOB_LABEL: "dj"}) == []
+            job = srv.fake.get(KIND, "default", "dj")
+            conds = {c["type"]: c["status"]
+                     for c in job["status"]["conditions"]}
+            assert conds[DEADLINE_CONDITION] == "True"
+            assert conds["Failed"] == "True"
+            # The Event write follows the status write by one HTTP
+            # round trip — poll briefly instead of racing it.
+            def deadline_event_recorded():
+                return any(
+                    e["reason"] == DEADLINE_CONDITION
+                    for e in srv.fake.list("Event", "default")
+                    if e["involvedObject"]["name"] == "dj")
+
+            t1 = time.monotonic()
+            while (not deadline_event_recorded()
+                   and time.monotonic() - t1 < 3.0):
+                time.sleep(0.02)
+            assert deadline_event_recorded()
+        finally:
+            ctl.stop.set()
+            t.join(timeout=10)

@@ -1,0 +1,152 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""The operator→launcher env contract, closed in ONE loop (VERDICT
+gap): the reconciler's OWN pod-spec env — extracted verbatim from the
+pods it creates on the fake apiserver — feeds
+``training/launcher.py``'s config parsers, and the resulting
+distributed topology is asserted. No hand-mirrored env strings: a
+deliberate env-name typo in the reconciler now fails these tests (and
+the real multi-process gang tests, which derive their subprocess env
+from the same helper), not just a string assert.
+"""
+
+from typing import Dict
+
+from kubeflow_tpu.manifests.tpujob import (
+    replica_spec,
+    termination_policy,
+    tpu_job,
+)
+from kubeflow_tpu.operator import FakeApiServer, Reconciler
+from kubeflow_tpu.operator.reconciler import JOB_LABEL
+from kubeflow_tpu.training import launcher
+
+
+def reconciled_pod_envs(job) -> Dict[str, Dict[str, str]]:
+    """Reconcile ``job`` on a fresh fake apiserver and return each
+    created pod's container env verbatim: {pod_name: {name: value}}.
+    THE single source of truth for what the operator injects — the
+    multi-process gang tests (tests/test_multiprocess.py) build their
+    subprocess env from this, substituting only loopback addresses.
+    """
+    api = FakeApiServer()
+    api.create(job)
+    Reconciler(api).reconcile(
+        api.get(job["kind"], job["metadata"].get("namespace", "default"),
+                job["metadata"]["name"]))
+    envs: Dict[str, Dict[str, str]] = {}
+    for pod in api.list("Pod", job["metadata"].get("namespace", "default"),
+                        {JOB_LABEL: job["metadata"]["name"]}):
+        (container,) = pod["spec"]["containers"]
+        envs[pod["metadata"]["name"]] = {
+            e["name"]: e["value"] for e in container["env"]}
+    return envs
+
+
+def make_contract_job(name="ct", workers=2, num_slices=1,
+                      coordinator=False):
+    specs = []
+    if coordinator:
+        specs.append(replica_spec("COORDINATOR", 1, image="img:1"))
+    specs.append(replica_spec(
+        "TPU_WORKER", workers, image="img:1",
+        tpu_accelerator="tpu-v5-lite-podslice", tpu_topology="2x4"))
+    chief = ("COORDINATOR", 0) if coordinator else ("TPU_WORKER", 0)
+    job = tpu_job(name, "default", specs,
+                  termination=termination_policy(*chief),
+                  num_slices=num_slices)
+    job["metadata"]["uid"] = "uid-ct"
+    return job
+
+
+def test_multislice_env_feeds_launcher_verbatim():
+    """2 slices × 2 hosts: the launcher, reading ONLY what the
+    reconciler injected, must see one flat 4-process jax gang with
+    slice-major process ids and the 2-slice megascale hierarchy."""
+    envs = reconciled_pod_envs(make_contract_job(workers=2,
+                                                 num_slices=2))
+    assert len(envs) == 4
+
+    configs = {pod: launcher.distributed_config(env=env)
+               for pod, env in envs.items()}
+    slices = {pod: launcher.slice_config(env=env)
+              for pod, env in envs.items()}
+
+    # One flat gang: every pod agrees on size and coordinator.
+    assert {c["num_processes"] for c in configs.values()} == {4}
+    coords = {c["coordinator_address"] for c in configs.values()}
+    assert len(coords) == 1
+    # The coordinator is slice 0's first worker at the operator port.
+    assert coords == {"ct-s0-tpu-worker-0.ct.default:8476"}
+
+    # Slice-major global process ids: 0..3 unique, slice 0 first.
+    pids = {pod: c["process_id"] for pod, c in configs.items()}
+    assert sorted(pids.values()) == [0, 1, 2, 3]
+    assert pids["ct-s0-tpu-worker-0"] == 0
+    assert pids["ct-s0-tpu-worker-1"] == 1
+    assert pids["ct-s1-tpu-worker-0"] == 2
+    assert pids["ct-s1-tpu-worker-1"] == 3
+
+    # The megascale hierarchy rides the same env.
+    assert {s["num_slices"] for s in slices.values()} == {2}
+    assert slices["ct-s1-tpu-worker-1"]["slice_id"] == 1
+    assert slices["ct-s0-tpu-worker-0"]["slice_id"] == 0
+    ms_coords = {s["coordinator_address"] for s in slices.values()}
+    assert ms_coords == {"ct-s0-tpu-worker-0.ct.default:8477"}
+
+
+def test_single_slice_env_feeds_launcher():
+    envs = reconciled_pod_envs(make_contract_job(workers=3))
+    assert len(envs) == 3
+    for pod, env in envs.items():
+        config = launcher.distributed_config(env=env)
+        assert config is not None, f"{pod} env unparseable: {env}"
+        assert config["num_processes"] == 3
+        # No MEGASCALE_* vars on single-slice jobs.
+        assert launcher.slice_config(env=env) is None
+    pids = sorted(launcher.distributed_config(env=e)["process_id"]
+                  for e in envs.values())
+    assert pids == [0, 1, 2]
+
+
+def test_coordinator_replica_sees_single_process_view():
+    """A COORDINATOR replica is not a TPU process: the launcher must
+    parse its env as a 1-process view pointed at itself."""
+    envs = reconciled_pod_envs(make_contract_job(workers=2,
+                                                 coordinator=True))
+    config = launcher.distributed_config(env=envs["ct-coordinator-0"])
+    assert config["num_processes"] == 1
+    assert config["process_id"] == 0
+    # The workers still form their own 2-process gang.
+    worker = launcher.distributed_config(env=envs["ct-tpu-worker-1"])
+    assert worker["num_processes"] == 2
+    assert worker["process_id"] == 1
+
+
+def test_env_contract_has_single_source_of_truth():
+    """The gang tests' subprocess env derives from the reconciler:
+    the launcher-side replica identity vars the workers read must be
+    exactly the operator-injected ones (a typo in either constant
+    set breaks this assertion, not a mirrored string)."""
+    envs = reconciled_pod_envs(make_contract_job(workers=2))
+    env = envs["ct-tpu-worker-1"]
+    assert env[launcher.ENV_REPLICA_TYPE] == "TPU_WORKER"
+    assert env[launcher.ENV_REPLICA_INDEX] == "1"
+    assert env[launcher.ENV_NPROC] == "2"
+    assert env[launcher.ENV_PID] == "1"
+    assert env[launcher.ENV_COORD].endswith(":8476")
+    # TPU runtime identity travels alongside.
+    assert env["TPU_WORKER_ID"] == "1"
+    assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 2

@@ -52,30 +52,44 @@ def _free_port() -> int:
 
 def _run_gang(mode: str, local_devices: int, n_procs: int = 2,
               num_slices: int = 1):
+    # The subprocess env comes from the RECONCILER's own pod specs
+    # (tests/test_env_contract.py reconciled_pod_envs — the contract's
+    # single source of truth; the pre-r7 version hand-mirrored the
+    # operator's env construction here). Only the network addresses
+    # are substituted: pod-DNS coordinators become loopback ports.
+    from tests.test_env_contract import (
+        make_contract_job,
+        reconciled_pod_envs,
+    )
+
+    assert n_procs % num_slices == 0
+    pod_envs = reconciled_pod_envs(make_contract_job(
+        name="gang", workers=n_procs // num_slices,
+        num_slices=num_slices))
+    assert len(pod_envs) == n_procs
     port = _free_port()
     procs = []
-    for pid in range(n_procs):
-        env = dict(
-            os.environ,
+    # Launch in the operator's slice-major process-id order.
+    for pod_name, pod_env in sorted(
+            pod_envs.items(),
+            key=lambda kv: int(kv[1]["KFT_PROCESS_ID"])):
+        env = dict(os.environ)
+        env.update(pod_env)
+        env.update(
             JAX_PLATFORMS="cpu",
             KFT_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-            KFT_NUM_PROCESSES=str(n_procs),
-            KFT_PROCESS_ID=str(pid),
-            KFT_REPLICA_TYPE="TPU_WORKER",
-            KFT_REPLICA_INDEX=str(pid % max(n_procs // num_slices, 1)),
             KFT_GANG_MODE=mode,
             KFT_LOCAL_DEVICES=str(local_devices),
+            XLA_FLAGS=(
+                f"--xla_force_host_platform_device_count={local_devices}"),
         )
-        if num_slices > 1:
-            # The operator's multi-slice injection (slice-major
-            # process ids → slice = pid // hosts_per_slice), minus the
-            # real DCN transport — Gloo over loopback stands in.
-            hosts_per_slice = n_procs // num_slices
-            env["MEGASCALE_NUM_SLICES"] = str(num_slices)
-            env["MEGASCALE_SLICE_ID"] = str(pid // hosts_per_slice)
-            env["MEGASCALE_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port + 1}"
-        env["XLA_FLAGS"] = (
-            f"--xla_force_host_platform_device_count={local_devices}")
+        if "MEGASCALE_COORDINATOR_ADDRESS" in env:
+            env["MEGASCALE_COORDINATOR_ADDRESS"] = \
+                f"127.0.0.1:{port + 1}"
+        # TPU_WORKER_HOSTNAMES carries pod DNS names that don't
+        # resolve here; the CPU backend ignores TPU runtime vars, but
+        # drop them anyway so a future TPU-sim path can't half-bind.
+        env.pop("TPU_WORKER_HOSTNAMES", None)
         procs.append(subprocess.Popen(
             [sys.executable, str(WORKER)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
@@ -124,20 +138,28 @@ def test_pretrain_cli_joins_megascale_gang(tmp_path):
     prove the cross-host gradient sync."""
     import json
 
+    from tests.test_env_contract import (
+        make_contract_job,
+        reconciled_pod_envs,
+    )
+
     port = _free_port()
     procs = []
-    for pid in range(4):
-        env = dict(
-            os.environ,
+    pod_envs = reconciled_pod_envs(make_contract_job(
+        name="gang", workers=2, num_slices=2))
+    for pid, (pod_name, pod_env) in enumerate(sorted(
+            pod_envs.items(),
+            key=lambda kv: int(kv[1]["KFT_PROCESS_ID"]))):
+        assert int(pod_env["KFT_PROCESS_ID"]) == pid
+        env = dict(os.environ)
+        env.update(pod_env)
+        env.update(
             JAX_PLATFORMS="cpu",
             KFT_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-            KFT_NUM_PROCESSES="4",
-            KFT_PROCESS_ID=str(pid),
-            MEGASCALE_NUM_SLICES="2",
-            MEGASCALE_SLICE_ID=str(pid // 2),
             MEGASCALE_COORDINATOR_ADDRESS=f"127.0.0.1:{port + 1}",
             XLA_FLAGS="--xla_force_host_platform_device_count=2",
         )
+        env.pop("TPU_WORKER_HOSTNAMES", None)
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "kubeflow_tpu.training.pretrain",
              "--model", "bert-test", "--global_batch", "16",

@@ -153,6 +153,59 @@ def test_watch_controller_subsecond_reaction(controller_on):
     assert created_in < 1.0 and reacted_in < 1.0, (created_in, reacted_in)
 
 
+def test_watch_gone_relists_immediately_without_error_backoff(
+        controller_on):
+    """410 Gone (compacted resourceVersion) is NOT a transport error:
+    the watch loop must relist-and-resume immediately — counted in
+    watch_gone, never in watch_errors, and never delayed by the
+    error backoff (a compaction storm must not slow reconciliation).
+    """
+    api = FakeApiServer()
+    real_watch = api.watch
+    gone_raised = threading.Event()
+
+    def watch_gone_once(kind, *args, **kwargs):
+        if kind == KIND and not gone_raised.is_set():
+            gone_raised.set()
+            raise Gone("resourceVersion 1 compacted")
+        return real_watch(kind, *args, **kwargs)
+
+    api.watch = watch_gone_once
+    ctl = controller_on(api)
+    submit(api, make_job(name="gjob", workers=1))
+    t0 = time.monotonic()
+    assert _wait_for(lambda: len(
+        api.list("Pod", "default", {JOB_LABEL: "gjob"})) == 1, 2.0), \
+        "job not reconciled after a Gone'd watch"
+    # Sub-second reaction even though the first watch died with 410:
+    # the relist-and-resume is immediate, not error-backoff-delayed.
+    assert time.monotonic() - t0 < 2.0
+    assert gone_raised.is_set()
+    assert ctl.watch_gone.get(KIND, 0) >= 1
+    assert ctl.watch_errors == {}, ctl.watch_errors
+
+
+def test_watch_transport_errors_are_counted_and_backed_off(
+        controller_on):
+    """Contrast with Gone: a genuine transport failure increments
+    watch_errors and the loop retries with backoff (but the relist
+    safety net still converges the world — see the broken-watch test
+    below)."""
+    api = FakeApiServer()
+
+    def broken_watch(*a, **k):
+        raise RuntimeError("watch transport down")
+        yield  # pragma: no cover
+
+    api.watch = broken_watch
+    ctl = controller_on(api, relist_seconds=0.2)
+    submit(api, make_job(name="tjob", workers=1))
+    assert _wait_for(lambda: len(
+        api.list("Pod", "default", {JOB_LABEL: "tjob"})) == 1, 5.0)
+    assert _wait_for(lambda: sum(ctl.watch_errors.values()) >= 2, 5.0)
+    assert ctl.watch_gone == {}
+
+
 def test_watch_controller_relist_fallback_survives_broken_watch(
         controller_on):
     """Watch streams can drop events (compaction, restarts); the

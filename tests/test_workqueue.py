@@ -1,0 +1,230 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""The rate-limited workqueue: client-go semantics — per-key dedup,
+processing/dirty serialization, delay heap, exponential backoff with
+jitter, global token bucket, quarantine accounting."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.operator.workqueue import (
+    ExponentialBackoff,
+    TokenBucket,
+    WorkQueue,
+)
+
+
+# -- backoff --------------------------------------------------------------
+
+
+def test_backoff_doubles_and_caps():
+    b = ExponentialBackoff(base=0.05, cap=1.0, jitter=0.0)
+    assert b.delay(0) == 0.0
+    assert b.delay(1) == pytest.approx(0.05)
+    assert b.delay(2) == pytest.approx(0.10)
+    assert b.delay(5) == pytest.approx(0.80)
+    assert b.delay(6) == pytest.approx(1.0)  # capped
+    assert b.delay(50) == pytest.approx(1.0)  # huge counts stay capped
+
+def test_backoff_jitter_bounded_and_not_synchronized():
+    b = ExponentialBackoff(base=0.05, cap=300.0, jitter=0.2,
+                           rng=random.Random(7))
+    delays = [b.delay(4) for _ in range(200)]  # nominal 0.4
+    assert all(0.32 - 1e-9 <= d <= 0.48 + 1e-9 for d in delays), \
+        (min(delays), max(delays))
+    # The point of jitter: N keys failing together must NOT all get
+    # the same retry instant.
+    assert len({round(d, 6) for d in delays}) > 50
+
+
+def test_backoff_validates():
+    with pytest.raises(ValueError):
+        ExponentialBackoff(base=0.0)
+    with pytest.raises(ValueError):
+        ExponentialBackoff(base=1.0, cap=0.5)
+
+
+# -- token bucket ---------------------------------------------------------
+
+
+def test_token_bucket_burst_then_rate():
+    clock = [0.0]
+    tb = TokenBucket(qps=10.0, burst=3, clock=lambda: clock[0])
+    assert [tb.try_acquire() for _ in range(4)] == [
+        True, True, True, False]  # burst exhausted
+    clock[0] += 0.1  # one refill period
+    assert tb.try_acquire() is True
+    assert tb.try_acquire() is False
+
+
+def test_token_bucket_acquire_blocks_until_refill():
+    tb = TokenBucket(qps=100.0, burst=1)
+    assert tb.acquire() is True
+    t0 = time.monotonic()
+    assert tb.acquire() is True  # must wait ~10ms for a token
+    assert time.monotonic() - t0 >= 0.005
+
+
+def test_token_bucket_acquire_honors_stop_and_timeout():
+    tb = TokenBucket(qps=0.1, burst=1)  # one token per 10s
+    assert tb.acquire() is True
+    assert tb.acquire(timeout=0.05) is False
+    stop = threading.Event()
+    stop.set()
+    assert tb.acquire(stop=stop) is False
+
+
+# -- workqueue ------------------------------------------------------------
+
+
+def _queue(**kwargs):
+    kwargs.setdefault("backoff",
+                      ExponentialBackoff(base=0.02, cap=0.2, jitter=0.0))
+    return WorkQueue(**kwargs)
+
+
+def test_add_deduplicates():
+    q = _queue()
+    for _ in range(5):
+        q.add("k")
+    assert q.get(0.1) == "k"
+    q.done("k")
+    assert q.get(0.05) is None  # held once, not five times
+
+
+def test_processing_key_is_never_concurrent_and_dirty_requeues():
+    q = _queue()
+    q.add("k")
+    assert q.get(0.1) == "k"
+    # Event arrives mid-pass: the key must not be handed out again...
+    q.add("k")
+    assert q.get(0.05) is None
+    # ...until the in-flight pass finishes.
+    q.done("k")
+    assert q.get(0.1) == "k"
+    q.done("k")
+    assert q.get(0.05) is None
+
+
+def test_add_after_delivers_after_delay_and_events_beat_timers():
+    q = _queue()
+    q.add_after("k", 0.08)
+    assert q.get(0.02) is None  # not due yet
+    assert q.get(0.3) == "k"  # due
+    q.done("k")
+    # A fresh event supersedes a pending timer entirely.
+    q.add_after("k", 10.0)
+    q.add("k")
+    assert q.get(0.1) == "k"
+    q.done("k")
+    assert q.get(0.05) is None  # the 10s timer did not double-fire
+
+
+def test_add_unless_delayed_respects_backoff():
+    q = _queue()
+    q.add_after("k", 10.0)
+    q.add_unless_delayed("k")  # relist: no new information
+    assert q.get(0.05) is None  # still parked
+    q.add_unless_delayed("fresh")  # no timer → normal enqueue
+    assert q.get(0.1) == "fresh"
+
+
+def test_relist_during_failing_attempt_does_not_bypass_backoff():
+    """Review finding: a relist landing while a failing key's capped
+    attempt is mid-flight (timer entry consumed, key processing) must
+    not dirty it — otherwise done() would cancel the retry the
+    attempt schedules and re-admit the key immediately, one
+    unthrottled attempt per relist period."""
+    q = _queue(quarantine_after=1)
+    q.retry("k")  # quarantined: parked at the 0.2s cap
+    assert q.get(0.5) == "k"  # the capped attempt starts
+    q.add_unless_delayed("k")  # relist fires mid-attempt
+    q.retry("k")  # the attempt fails again → next cap timer
+    q.done("k")
+    # The key must NOT be immediately ready — it is parked at the cap.
+    assert q.get(0.05) is None
+    assert "k" in q.stats()["backoff"]
+    # An explicit EVENT still beats the timer (new information).
+    q.add("k")
+    assert q.get(0.1) == "k"
+
+
+def test_retry_backs_off_then_quarantines_at_cap():
+    q = _queue(quarantine_after=3)
+    delays = [q.retry("k") for _ in range(5)]
+    assert delays[0] == pytest.approx(0.02)
+    assert delays[1] == pytest.approx(0.04)
+    # At and beyond the quarantine threshold: parked at the cap.
+    assert delays[2] == pytest.approx(0.2)
+    assert delays[4] == pytest.approx(0.2)
+    assert q.failures("k") == 5
+    assert q.is_quarantined("k")
+    q.forget("k")
+    assert q.failures("k") == 0
+    assert not q.is_quarantined("k")
+
+
+def test_get_blocks_for_ready_key_and_respects_stop():
+    q = _queue()
+    stop = threading.Event()
+    got = []
+
+    def worker():
+        got.append(q.get(timeout=5.0, stop=stop))
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.05)
+    q.add("late")
+    t.join(2.0)
+    assert got == ["late"]
+
+    stop.set()
+    assert q.get(timeout=5.0, stop=stop) is None  # returns fast
+
+
+def test_global_limiter_paces_gets():
+    q = _queue(limiter=TokenBucket(qps=50.0, burst=1))
+    for i in range(4):
+        q.add(f"k{i}")
+    t0 = time.monotonic()
+    for _ in range(4):
+        key = q.get(1.0)
+        assert key is not None
+        q.done(key)
+    elapsed = time.monotonic() - t0
+    # 4 admissions through a 50/s bucket with burst 1: >= ~60ms.
+    assert elapsed >= 0.045, elapsed
+
+
+def test_stats_and_latency_samples():
+    q = _queue(quarantine_after=2)
+    q.add(("ns", "a"))
+    assert q.get(0.1) == ("ns", "a")
+    q.retry(("ns", "a"))
+    q.retry(("ns", "a"))
+    q.done(("ns", "a"))
+    stats = q.stats()
+    assert stats["adds"] == 1
+    assert stats["gets"] == 1
+    assert stats["retries"] == 2
+    assert stats["failing"] == {"ns/a": 2}
+    assert stats["quarantined"] == ["ns/a"]
+    assert "ns/a" in stats["backoff"]  # seconds-until-retry exposed
+    assert len(q.latencies()) == 1
+    assert q.latencies()[0] >= 0.0
