@@ -73,9 +73,58 @@ def controller_main() -> int:
     return 0
 
 
+def serving_overload_main() -> int:
+    """`python bench.py --serving-overload`: offered-load sweep past
+    capacity with deadline-aware shedding on vs off (ISSUE 3
+    acceptance: goodput ≈ capacity at 2× offered load with shedding,
+    collapse without). Pure serving stack — runs the same on CPU and
+    chip; prints ONE JSON line shaped like the headline bench."""
+    from kubeflow_tpu.utils.platform import sync_platform_from_env
+
+    sync_platform_from_env()
+
+    from kubeflow_tpu.serving.benchmark import (
+        OverloadBenchConfig,
+        run_overload_benchmark,
+    )
+
+    result = run_overload_benchmark(OverloadBenchConfig())
+    worst = max(OverloadBenchConfig().offered_x)
+    on = [r for r in result["phases"] if r["shedding"]]
+    off = [r for r in result["phases"] if not r["shedding"]]
+    print(json.dumps({
+        "metric": "serving_overload_goodput_vs_capacity",
+        "value": result["goodput_overload_on_vs_capacity"],
+        "unit": (f"goodput/capacity at {worst}x offered load, "
+                 f"shedding on (ceiling "
+                 f"{result['goodput_ceiling_rps']} rps)"),
+        "vs_baseline": None,  # the reference had no overload story
+        "extra": {
+            "capacity_rps": result["capacity_rps"],
+            "goodput_ceiling_rps": result["goodput_ceiling_rps"],
+            "deadline_ms": result["deadline_ms"],
+            "never_dispatched_ok": result["never_dispatched_ok"],
+            "goodput_off_vs_capacity": result[
+                "goodput_overload_off_vs_capacity"],
+            **{f"on_x{r['offered_x']}_{k}": r[k]
+               for r in on for k in ("goodput_rps", "shed", "expired",
+                                     "ok_p50_ms", "ok_p99_ms")
+               if k in r},
+            **{f"off_x{r['offered_x']}_{k}": r[k]
+               for r in off
+               for k in ("goodput_rps", "client_timeout", "ok_p50_ms",
+                         "ok_p99_ms")
+               if k in r},
+        },
+    }))
+    return 0
+
+
 def main() -> int:
     if "--controller" in sys.argv:
         return controller_main()
+    if "--serving-overload" in sys.argv:
+        return serving_overload_main()
     from kubeflow_tpu.utils.platform import sync_platform_from_env
 
     # Honor JAX_PLATFORMS from the caller (the session preset pins the

@@ -553,6 +553,208 @@ def run_mixed_load_benchmark(config: MixedLoadConfig) -> Dict[str, Any]:
             shutil.rmtree(pathlib.Path(base).parent, ignore_errors=True)
 
 
+@dataclasses.dataclass
+class OverloadBenchConfig:
+    """Offered-load sweep past capacity, deadline-aware shedding ON vs
+    OFF (ISSUE 3 acceptance): with shedding, goodput at 2× offered
+    load should hold near capacity and p99 of SUCCESSFUL requests
+    stays bounded by the deadline; without it, the queue admits work
+    whose deadline can only lapse, the batcher burns dispatches on
+    abandoned requests, and goodput collapses.
+
+    The drive hits ServedModel.submit directly — the queue, batcher,
+    admission controller and real XLA model, minus the HTTP hop. The
+    wire layer's deadline mapping is covered by tests/test_overload.py;
+    on a small CPU host the JSON hop saturates before the queue does
+    and would measure the codec, not the overload economics."""
+
+    model: str = "resnet-test"
+    image_hw: int = 64
+    max_batch: int = 2  # small on purpose: bounded capacity so the
+    # sweep can exceed it with ~100s of requests, not tens of 1000s.
+    # queue_capacity stays at the production default: the pre-deadline
+    # stack's queue really was this deep, and an effectively-unbounded
+    # queue is half the collapse mechanism (the other half: dispatching
+    # work whose caller already hung up).
+    queue_capacity: int = 4096
+    deadline_ms: float = 500.0
+    phase_seconds: float = 4.0
+    offered_x: Sequence[float] = (0.5, 1.0, 2.0)
+    capacity_clients: int = 16
+    capacity_requests: int = 20
+    model_dtype: str = "float32"
+
+
+def _overload_drive(model, inputs, rate_rps: float, duration_s: float,
+                    deadline_ms: float, shedding: bool) -> Dict[str, Any]:
+    """Fire submits at a fixed arrival rate (open loop — arrivals do
+    NOT slow down when the server does, unlike _measure's closed
+    loop; overload only exists in open-loop traffic). Every client
+    abandons at the deadline either way; with shedding OFF the server
+    just never hears about it (the pre-deadline stack: client-side
+    socket timeouts only)."""
+    import concurrent.futures
+
+    from kubeflow_tpu.serving import overload
+
+    results: List[Any] = []
+    lock = threading.Lock()
+    budget_s = deadline_ms / 1e3
+
+    def one():
+        t0 = time.perf_counter()
+        deadline = overload.deadline_after(budget_s) if shedding else None
+        try:
+            future = model.submit(inputs, None, None, None,
+                                  deadline=deadline)
+            future.result(budget_s)
+            outcome = "ok"
+        except overload.OverloadedError:
+            outcome = "shed"
+        except overload.DeadlineExceededError:
+            outcome = "expired"
+        except concurrent.futures.TimeoutError:
+            outcome = "client_timeout"  # abandoned; server unaware
+        with lock:
+            results.append((outcome, time.perf_counter() - t0))
+
+    n = max(1, int(rate_rps * duration_s))
+    interval = 1.0 / rate_rps
+    # Pre-spawned worker pool with striped arrival schedules (worker i
+    # takes arrivals i, i+P, i+2P, ...): thread-per-request spawn in
+    # the hot loop costs enough CPU on a small host to depress the
+    # very capacity being measured. P is sized so a worker is always
+    # free by its next slot (per-request time ≤ the deadline budget,
+    # stripes are budget × 1.5 apart).
+    pool = min(n, max(8, int(rate_rps * budget_s * 1.5) + 1))
+    start = time.perf_counter()
+
+    def worker(i: int):
+        for k in range(i, n, pool):
+            delay = start + k * interval - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            one()
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(pool)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(duration_s + budget_s + 30)
+    counts: Dict[str, int] = {}
+    for outcome, _ in results:
+        counts[outcome] = counts.get(outcome, 0) + 1
+    ok_lat = np.asarray([lat for outcome, lat in results
+                         if outcome == "ok"]) * 1e3
+    row: Dict[str, Any] = {
+        "shedding": shedding,
+        "offered_rps": round(rate_rps, 1),
+        "sent": n,
+        "ok": counts.get("ok", 0),
+        "shed": counts.get("shed", 0),
+        "expired": counts.get("expired", 0),
+        "client_timeout": counts.get("client_timeout", 0),
+        "goodput_rps": round(counts.get("ok", 0) / duration_s, 1),
+    }
+    if ok_lat.size:
+        row["ok_p50_ms"] = round(float(np.percentile(ok_lat, 50)), 1)
+        row["ok_p99_ms"] = round(float(np.percentile(ok_lat, 99)), 1)
+    return row
+
+
+def run_overload_benchmark(config: OverloadBenchConfig) -> Dict[str, Any]:
+    from kubeflow_tpu.serving.manager import ModelManager
+
+    base = _export(ServingBenchConfig(
+        model=config.model, image_hw=config.image_hw,
+        max_batch=config.max_batch, model_dtype=config.model_dtype))
+    manager = ModelManager(poll_interval_s=3600)
+    model = manager.add_model("bench", base,
+                              max_batch=config.max_batch,
+                              queue_capacity=config.queue_capacity)
+    model.get()
+    try:
+        rng = np.random.RandomState(11)
+        hw = config.image_hw
+        inputs = {"images": (rng.randint(0, 256, (1, hw, hw, 3))
+                             / 255.0).astype(np.float32)}
+
+        def closed_loop_request(timeout: float = 120.0) -> float:
+            t0 = time.perf_counter()
+            model.submit(inputs, None, None, None).result(timeout)
+            return time.perf_counter() - t0
+
+        for _ in range(6):  # warm the buckets
+            closed_loop_request()
+        # Closed-loop capacity: the goodput ceiling the sweep is
+        # priced against.
+        capacity = _measure(closed_loop_request, config.capacity_clients,
+                            config.capacity_requests)["throughput_rps"]
+        phases = []
+        # Inner loop over shedding so both modes of one offered-load
+        # point run back to back (same thermal/contention regime) —
+        # OFF first, matching the before/after story.
+        for x in config.offered_x:
+            for shedding in (False, True):
+                model.batch_stats(reset=True)
+                row = _overload_drive(model, inputs, x * capacity,
+                                      config.phase_seconds,
+                                      config.deadline_ms, shedding)
+                row["offered_x"] = x
+                # Drain before snapshotting/next phase so one phase's
+                # backlog doesn't poison the next measurement.
+                drain_by = time.monotonic() + 30
+                while (model.queue_depth() > 0
+                       and time.monotonic() < drain_by):
+                    time.sleep(0.05)
+                time.sleep(config.deadline_ms / 1e3)
+                server = model.batch_stats()
+                row["server"] = server
+                # The acceptance invariant, asserted from batch_stats:
+                # every shed/expired request is one the model NEVER
+                # dispatched (rows == sent − shed − expired; each
+                # request is one row).
+                row["never_dispatched_ok"] = (
+                    server["rows"] == row["sent"] - server["shed"]
+                    - server["expired"])
+                phases.append(row)
+
+        def goodput(shedding: bool, x: float) -> float:
+            return next(r["goodput_rps"] for r in phases
+                        if r["shedding"] is shedding
+                        and r["offered_x"] == x)
+
+        worst_x = max(config.offered_x)
+        # The goodput ceiling: the best rate the stack demonstrated
+        # anywhere in the run. The closed-loop probe UNDERestimates it
+        # (a modest client count can't keep max_batch-deep backlog the
+        # way open-loop overload does, so batch fill differs); ratios
+        # against the larger of the two are the honest ones.
+        ceiling = max(capacity,
+                      max(r["goodput_rps"] for r in phases))
+        return {
+            "model": config.model,
+            "max_batch": config.max_batch,
+            "queue_capacity": config.queue_capacity,
+            "deadline_ms": config.deadline_ms,
+            "capacity_rps": capacity,
+            "goodput_ceiling_rps": ceiling,
+            "phases": phases,
+            "goodput_overload_on_vs_capacity": round(
+                goodput(True, worst_x) / ceiling, 3),
+            "goodput_overload_off_vs_capacity": round(
+                goodput(False, worst_x) / ceiling, 3),
+            "never_dispatched_ok": all(r["never_dispatched_ok"]
+                                       for r in phases),
+        }
+    finally:
+        manager.stop()
+        import shutil
+
+        shutil.rmtree(pathlib.Path(base).parent, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     import argparse
 

@@ -19,6 +19,14 @@ built a gRPC PredictRequest with a 10s timeout (``:40-56``). This
 client speaks all three surfaces: native gRPC (grpc_predict /
 grpc_classify / grpc_get_metadata — the label.py path), gRPC-Web, and
 REST via the proxy.
+
+REST requests carry a retry budget (serving/overload.py RetryPolicy):
+capped attempts, exponential backoff with jitter, ``Retry-After``
+honored, only retriable codes (429/502/503 and transport failures)
+retried, and — when the caller sets ``deadline_ms`` — never a retry
+that could not finish inside the deadline. The deadline also rides
+the ``X-Deadline-Ms`` header so the server sheds instead of serving a
+response nobody is waiting for.
 """
 
 from __future__ import annotations
@@ -27,20 +35,75 @@ import argparse
 import base64
 import json
 import sys
+import time
+import urllib.error
 import urllib.request
+
+from kubeflow_tpu.serving.overload import (
+    DEADLINE_HEADER,
+    RetryPolicy,
+    deadline_after,
+)
+
+
+def _parse_retry_after(value) -> float | None:
+    """Retry-After delta-seconds → float; date-format or junk → None
+    (fall back to the policy's own backoff)."""
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return None
+
+
+def post_json(url: str, payload: dict, *, timeout: float = 10.0,
+              deadline_ms: float | None = None,
+              retry: RetryPolicy | None = None) -> dict:
+    """POST JSON with the retry budget. Raises the last error when the
+    budget (attempts or deadline) is exhausted."""
+    policy = retry or RetryPolicy()
+    deadline = deadline_after(deadline_ms / 1000.0) if deadline_ms else None
+    body = dict(payload)
+    attempt = 0
+    while True:
+        headers = {"Content-Type": "application/json"}
+        per_request_timeout = timeout
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError("client deadline expired")
+            headers[DEADLINE_HEADER] = str(max(1, int(remaining * 1000)))
+            per_request_timeout = min(timeout, remaining)
+        req = urllib.request.Request(url, data=json.dumps(body).encode(),
+                                     headers=headers, method="POST")
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=per_request_timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            error: Exception = e
+            code: int | None = e.code
+            retry_after = _parse_retry_after(e.headers.get("Retry-After"))
+        except (urllib.error.URLError, OSError) as e:
+            # Connection refused/reset/timed out: code None — worth a
+            # retry within budget (the breaker-protected proxy answers
+            # these in microseconds once its circuit opens).
+            error, code, retry_after = e, None, None
+        attempt += 1
+        if attempt >= policy.max_attempts or not policy.retriable(code):
+            raise error
+        sleep = policy.backoff_s(attempt - 1, retry_after_s=retry_after)
+        if deadline is not None and time.monotonic() + sleep >= deadline:
+            raise error  # a retry could never finish in time
+        time.sleep(sleep)
 
 
 def predict(server: str, model: str, instances, *, classify: bool = False,
-            timeout: float = 10.0) -> dict:
+            timeout: float = 10.0, deadline_ms: float | None = None,
+            retry: RetryPolicy | None = None) -> dict:
     verb = "classify" if classify else "predict"
-    req = urllib.request.Request(
-        f"http://{server}/model/{model}:{verb}",
-        data=json.dumps({"instances": instances}).encode(),
-        headers={"Content-Type": "application/json"},
-        method="POST",
-    )
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return json.loads(resp.read())
+    return post_json(f"http://{server}/model/{model}:{verb}",
+                     {"instances": instances}, timeout=timeout,
+                     deadline_ms=deadline_ms, retry=retry)
 
 
 def grpc_web_predict(server: str, model: str, inputs: dict, *,
@@ -153,7 +216,18 @@ def main(argv=None) -> int:
                         help="dial the native gRPC port instead of REST")
     parser.add_argument("--input_name", default="inputs",
                         help="tensor name for --grpc requests")
+    parser.add_argument("--deadline_ms", type=float, default=None,
+                        help="end-to-end deadline budget; sent as the "
+                             "X-Deadline-Ms header so the server sheds "
+                             "instead of serving an abandoned request")
+    parser.add_argument("--retries", type=int, default=3,
+                        help="total attempts for retriable REST "
+                             "failures (429/502/503/transport); 1 = "
+                             "no retries; backoff is exponential with "
+                             "jitter, never past the deadline")
     args = parser.parse_args(argv)
+    if args.retries < 1:
+        parser.error("--retries must be >= 1 (1 = a single attempt)")
     if args.json_path:
         instances = json.load(open(args.json_path))["instances"]
     elif args.input_path:
@@ -175,7 +249,9 @@ def main(argv=None) -> int:
             result = {k: v.tolist() for k, v in outputs.items()}
     else:
         result = predict(args.server, args.model, instances,
-                         classify=args.classify)
+                         classify=args.classify,
+                         deadline_ms=args.deadline_ms,
+                         retry=RetryPolicy(max_attempts=args.retries))
     json.dump(result, sys.stdout, indent=2)
     print()
     return 0

@@ -37,12 +37,18 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 from kubeflow_tpu.serving import wire
 from kubeflow_tpu.serving.manager import ModelManager
+from kubeflow_tpu.serving.overload import (
+    DeadlineExceededError,
+    OverloadedError,
+    clamp_wait_s,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -51,28 +57,46 @@ SERVICE_NAME = "tensorflow.serving.PredictionService"
 
 def _abort_for(context, exc) -> None:
     """Map Python-side failures onto canonical gRPC status codes
-    (mirrors the gRPC-Web handler's mapping, serving/server.py)."""
+    (mirrors the gRPC-Web handler's mapping, serving/server.py).
+    Overload subclasses go BEFORE the RuntimeError catch-all:
+    DEADLINE_EXCEEDED tells the client its budget is gone (do not
+    retry), RESOURCE_EXHAUSTED says shed (retry with backoff)."""
     import grpc
 
     if isinstance(exc, KeyError):
         context.abort(grpc.StatusCode.NOT_FOUND, str(exc.args[0]))
     if isinstance(exc, ValueError):
         context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
-    if isinstance(exc, concurrent.futures.TimeoutError):
+    if isinstance(exc, (concurrent.futures.TimeoutError,
+                        DeadlineExceededError)):
         context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
-                      "predict timed out")
+                      str(exc) or "predict timed out")
+    if isinstance(exc, OverloadedError):
+        context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
     if isinstance(exc, RuntimeError):
         context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
     logger.exception("unhandled error in gRPC handler")
     context.abort(grpc.StatusCode.INTERNAL, type(exc).__name__)
 
 
-def start_predict(manager: ModelManager, request_bytes: bytes):
+def _context_deadline(context) -> Optional[float]:
+    """Absolute monotonic deadline from the client's grpc-timeout
+    metadata (grpcio surfaces it as time_remaining(); None when the
+    client set no deadline)."""
+    remaining = context.time_remaining()
+    if remaining is None:
+        return None
+    return time.monotonic() + remaining
+
+
+def start_predict(manager: ModelManager, request_bytes: bytes,
+                  deadline: Optional[float] = None):
     """Shared Predict front half for both transports (native gRPC here,
     gRPC-Web in serving/server.py): decode → validate against the
-    signature → submit to the micro-batcher. Returns
-    (spec, loaded, future, output_filter); the caller awaits the
-    future in its own concurrency style."""
+    signature → submit to the micro-batcher. ``deadline`` (absolute
+    monotonic) rides into the queue entry for admission control and
+    eviction. Returns (spec, loaded, future, output_filter); the
+    caller awaits the future in its own concurrency style."""
     spec, inputs, output_filter = wire.decode_predict_request(
         request_bytes)
     model = manager.get_model(spec["name"])
@@ -96,7 +120,8 @@ def start_predict(manager: ModelManager, request_bytes: bytes):
     # requests, so both transports share batch buckets.
     future = model.submit({input_name: inputs[input_name]},
                           spec["signature_name"] or None,
-                          sig.method, spec["version"])
+                          sig.method, spec["version"],
+                          deadline=deadline)
     return spec, loaded, future, output_filter
 
 
@@ -113,7 +138,8 @@ def finish_predict(spec, loaded, outputs, output_filter) -> bytes:
         outputs, spec["name"], loaded.version)
 
 
-def start_classify(manager: ModelManager, request_bytes: bytes):
+def start_classify(manager: ModelManager, request_bytes: bytes,
+                   deadline: Optional[float] = None):
     """Shared Classify front half: decode tf.Examples → dense batch →
     submit. Returns (spec, loaded, future)."""
     spec, examples = wire.decode_classification_request(request_bytes)
@@ -127,7 +153,8 @@ def start_classify(manager: ModelManager, request_bytes: bytes):
                                tuple(input_spec.shape[1:]))
     future = model.submit({input_name: batch},
                           spec["signature_name"] or None,
-                          "classify", spec["version"])
+                          "classify", spec["version"],
+                          deadline=deadline)
     return spec, loaded, future
 
 
@@ -174,9 +201,10 @@ class PredictionService:
 
     def Predict(self, request: bytes, context) -> bytes:
         try:
+            deadline = _context_deadline(context)
             spec, loaded, future, output_filter = start_predict(
-                self._manager, request)
-            outputs = future.result(self._timeout_s)
+                self._manager, request, deadline=deadline)
+            outputs = future.result(self._wait_s(deadline))
             return finish_predict(spec, loaded, outputs, output_filter)
         except Exception as e:  # noqa: BLE001 — mapped to grpc status
             _abort_for(context, e)
@@ -185,11 +213,18 @@ class PredictionService:
 
     def Classify(self, request: bytes, context) -> bytes:
         try:
-            spec, loaded, future = start_classify(self._manager, request)
-            outputs = future.result(self._timeout_s)
+            deadline = _context_deadline(context)
+            spec, loaded, future = start_classify(self._manager, request,
+                                                  deadline=deadline)
+            outputs = future.result(self._wait_s(deadline))
             return finish_classify(spec, loaded, outputs)
         except Exception as e:  # noqa: BLE001
             _abort_for(context, e)
+
+    def _wait_s(self, deadline: Optional[float]) -> float:
+        """Future-wait budget: the client's remaining deadline when it
+        set one (never wait past it), else the server default."""
+        return clamp_wait_s(deadline, self._timeout_s)
 
     # -- GetModelMetadata --------------------------------------------------
 

@@ -37,6 +37,16 @@ the measured winner: PERF.md's serving section, binary TensorProto vs
 JSON). The REST/JSON hop remains as fallback for verb/signature-method
 mismatches (the gRPC Predict executes the signature's method) and for
 environments without grpcio.
+
+Overload behavior (serving/overload.py): the proxy reads the client's
+``X-Deadline-Ms`` budget, spends its own time from it, and forwards
+the REMAINDER (same header on the REST hop, native grpc-timeout on
+the binary hop) — so the backend's admission control judges the true
+budget, not the proxy's configured timeout. Each upstream has a
+consecutive-failure circuit breaker: a dead backend costs one connect
+timeout per reset period instead of one per request, everything else
+fast-fails with 503 + Retry-After in microseconds. Backend timeouts
+map to 504 (the request's time is gone), connection failures to 502.
 """
 
 from __future__ import annotations
@@ -45,6 +55,7 @@ import argparse
 import base64
 import json
 import logging
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -52,7 +63,37 @@ import tornado.httpclient
 import tornado.ioloop
 import tornado.web
 
+from kubeflow_tpu.serving import overload
+
 logger = logging.getLogger(__name__)
+
+
+class CircuitOpenError(Exception):
+    """Upstream circuit breaker is open: fail fast, retry later."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            f"backend circuit breaker open; retry in {retry_after_s:.1f}s")
+        self.retry_after_s = retry_after_s
+
+
+class BackendTimeoutError(Exception):
+    """The backend accepted the connection but outlived the timeout."""
+
+
+class BackendDownError(Exception):
+    """Connection-level failure (refused/reset/unresolvable)."""
+
+
+#: A hang-timeout counts against the circuit breaker when the burn was
+#: at least this long (or the full rpc_timeout, whichever is smaller).
+#: A healthy backend answers in milliseconds, so a 1s+ hang is real
+#: evidence of a wedged pod even when the request's own deadline cut
+#: the wait short of rpc_timeout — without this, a fleet whose
+#: deadlines are all shorter than rpc_timeout could never trip the
+#: breaker against a hung backend. Sub-second budgets expiring still
+#: prove nothing and don't count.
+BREAKER_TIMEOUT_FLOOR_S = 1.0
 
 
 def decode_b64_if_needed(value: Any) -> Any:
@@ -87,17 +128,85 @@ class ProxyHandler(tornado.web.RequestHandler):
     def _metadata_cache(self) -> Dict[str, Any]:
         return self.application.settings["metadata_cache"]
 
+    @property
+    def rest_breaker(self) -> overload.CircuitBreaker:
+        return self.application.settings["rest_breaker"]
+
+    @property
+    def grpc_breaker(self) -> overload.CircuitBreaker:
+        return self.application.settings["grpc_breaker"]
+
+    async def _rest_fetch(self, url: str,
+                          deadline: Optional[float] = None,
+                          **kwargs) -> tornado.httpclient.HTTPResponse:
+        """One REST-upstream fetch through the circuit breaker, with
+        the request's remaining deadline capping the timeout. App-level
+        responses (any HTTP code) count as breaker successes — a 404
+        proves the backend is alive; only transport failures (connect
+        refused, timeout) count against it. Raises CircuitOpenError /
+        BackendTimeoutError / BackendDownError."""
+        breaker = self.rest_breaker
+        if not breaker.allow():
+            raise CircuitOpenError(breaker.retry_after_s())
+        timeout = self.rpc_timeout
+        remaining = overload.remaining_s(deadline)
+        if remaining is not None:
+            timeout = min(timeout, max(0.001, remaining))
+        client = tornado.httpclient.AsyncHTTPClient()
+        try:
+            response = await client.fetch(url, request_timeout=timeout,
+                                          raise_error=False, **kwargs)
+            # 599 = tornado's transport-failure code (never sent by a
+            # server); transport failures can ALSO surface as raised
+            # exceptions depending on tornado version/failure mode —
+            # both routes classify below.
+            failure = response.error if response.code == 599 else None
+        except Exception as e:  # noqa: BLE001 — transport-level failure
+            response, failure = None, e
+        if failure is None:
+            breaker.record_success()
+            return response
+        timed_out = "timeout" in str(failure).lower()
+        # Connection failures always count; a hang-timeout counts when
+        # the burn was substantial (BREAKER_TIMEOUT_FLOOR_S) — a tight
+        # request budget expiring proves nothing about the backend.
+        if not timed_out or timeout >= min(self.rpc_timeout,
+                                           BREAKER_TIMEOUT_FLOOR_S):
+            breaker.record_failure()
+        if timed_out:
+            raise BackendTimeoutError(
+                f"model server timed out after {timeout:.1f}s")
+        raise BackendDownError(str(failure))
+
+    def write_backend_error(self, e: Exception) -> None:
+        """Uniform JSON mapping for the three upstream failure shapes
+        (same body shape as every other proxy error path)."""
+        if isinstance(e, CircuitOpenError):
+            self.set_header("Retry-After",
+                            overload.retry_after_header(e.retry_after_s))
+            self.write_json({"error": str(e),
+                             "code": "RESOURCE_EXHAUSTED"}, 503)
+        elif isinstance(e, BackendTimeoutError):
+            self.write_json({"error": str(e),
+                             "code": "DEADLINE_EXCEEDED"}, 504)
+        else:
+            self.write_json({"error": f"model server unreachable: {e}"},
+                            502)
+
     async def get_signature_map(self, name: str, *,
-                                refresh: bool = False) -> Dict[str, Any]:
+                                refresh: bool = False,
+                                deadline: Optional[float] = None
+                                ) -> Dict[str, Any]:
         """Cached signature map, keyed by model and invalidated on
         version change (the reference cached forever, server.py:202-203
         — safe there because its server never hot-swapped signatures;
         this one does, via the export CLI + version watcher)."""
         if refresh or name not in self._metadata_cache:
-            client = tornado.httpclient.AsyncHTTPClient()
             url = f"{self.rpc_address}/v1/models/{name}/metadata"
-            response = await client.fetch(url,
-                                          request_timeout=self.rpc_timeout)
+            response = await self._rest_fetch(url, deadline=deadline)
+            if response.code != 200:
+                raise tornado.httpclient.HTTPClientError(
+                    response.code, response=response)
             payload = json.loads(response.body)
             self._metadata_cache[name] = {
                 "version": payload.get("model_spec", {}).get("version"),
@@ -140,14 +249,21 @@ class InferProxyHandler(ProxyHandler):
         return channel
 
     async def _grpc_infer(self, name: str, version: Optional[str],
-                          verb: str, instances, body, metadata) -> bool:
+                          verb: str, instances, body, metadata,
+                          deadline: Optional[float] = None) -> bool:
         """Try the binary Predict upstream. Returns True when the
         response was written (success or mapped gRPC error); False when
         this request can't ride the binary wire (no channel, unknown
-        signature, or URL verb != signature method — gRPC Predict runs
-        the signature's own method) and the REST hop should run."""
+        signature, URL verb != signature method — gRPC Predict runs
+        the signature's own method, or this upstream's circuit breaker
+        is open) and the REST hop should run."""
         channel = self._grpc_channel()
         if channel is None:
+            return False
+        if not self.grpc_breaker.allow():
+            # Open circuit on the binary wire only: the REST hop (its
+            # own breaker) may still be healthy — fall through rather
+            # than failing traffic a live REST backend would serve.
             return False
         from kubeflow_tpu.serving import wire
 
@@ -181,29 +297,59 @@ class InferProxyHandler(ProxyHandler):
             "/tensorflow.serving.PredictionService/Predict")
         import grpc
 
+        timeout = self.rpc_timeout
+        remaining = overload.remaining_s(deadline)
+        if remaining is not None:
+            # Forward the REMAINING budget as the gRPC deadline:
+            # grpcio encodes it as grpc-timeout metadata, the server's
+            # context.time_remaining() rebuilds it — end-to-end
+            # propagation with no shared clock.
+            timeout = min(timeout, max(0.001, remaining))
         try:
-            response = await call(request, timeout=self.rpc_timeout)
+            response = await call(request, timeout=timeout)
         except grpc.aio.AioRpcError as e:
             if e.code() == grpc.StatusCode.UNAVAILABLE:
                 # :9000 unreachable (older server image, firewalled
-                # port, or genuine overload): fall back to the REST hop
+                # port, or genuine overload): count it against this
+                # upstream's breaker and fall back to the REST hop
                 # rather than 503-ing traffic a REST-only backend would
                 # serve fine. If the server is truly down, the REST hop
                 # reports its own 502/503 with the accurate story.
+                self.grpc_breaker.record_failure()
                 logger.warning(
                     "gRPC upstream unavailable (%s); falling back to "
                     "REST for this request", e.details())
                 return False
+            if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                # A substantial hang indicts the backend; a tight
+                # request budget expiring says nothing about it (same
+                # floor as the REST upstream).
+                if timeout >= min(self.rpc_timeout,
+                                  BREAKER_TIMEOUT_FLOOR_S):
+                    self.grpc_breaker.record_failure()
+            else:  # an application-level status proves it's alive
+                self.grpc_breaker.record_success()
             code = {
                 grpc.StatusCode.NOT_FOUND: 404,
                 grpc.StatusCode.INVALID_ARGUMENT: 400,
                 grpc.StatusCode.DEADLINE_EXCEEDED: 504,
+                grpc.StatusCode.RESOURCE_EXHAUSTED: 503,
             }.get(e.code(), 502)
             # Stale signature cache may be the real culprit (hot
             # reload): drop it so the next request reconverts fresh.
             self._metadata_cache.pop(name, None)
-            self.write_json({"error": e.details() or e.code().name}, code)
+            payload: Dict[str, Any] = {"error": e.details()
+                                       or e.code().name}
+            if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                payload["code"] = "DEADLINE_EXCEEDED"
+            elif e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                # Backend shed the request: pass its story through
+                # with a retry hint so clients back off, not hammer.
+                payload["code"] = "RESOURCE_EXHAUSTED"
+                self.set_header("Retry-After", "1")
+            self.write_json(payload, code)
             return True
+        self.grpc_breaker.record_success()
         spec_out, outputs = wire.decode_predict_response(response)
         if not version:
             served = spec_out.get("version")
@@ -229,7 +375,24 @@ class InferProxyHandler(ProxyHandler):
             return self.write_json(
                 {"error": "request body needs 'instances'"}, 400)
         try:
-            metadata = await self.get_signature_map(name)
+            deadline = overload.request_deadline(self.request.headers,
+                                                 body)
+        except ValueError as e:
+            return self.write_json(
+                {"error": f"malformed deadline: {e}"}, 400)
+        if deadline is not None and deadline <= time.monotonic():
+            # The budget is already gone: answer in microseconds
+            # instead of burning an upstream round trip on a response
+            # nobody is waiting for.
+            return self.write_json(
+                {"error": "deadline expired before proxying",
+                 "code": "DEADLINE_EXCEEDED"}, 504)
+        try:
+            metadata = await self.get_signature_map(name,
+                                                    deadline=deadline)
+        except (CircuitOpenError, BackendTimeoutError,
+                BackendDownError) as e:
+            return self.write_backend_error(e)
         except tornado.httpclient.HTTPClientError as e:
             return self.write_json(
                 {"error": f"model metadata fetch failed: {e}"},
@@ -245,29 +408,40 @@ class InferProxyHandler(ProxyHandler):
                 {"error": f"payload does not match signature: {e}"}, 400)
         # Binary upstream first (measured winner, PERF.md serving
         # section); falls through to the REST hop when the request
-        # can't ride it (verb/method mismatch, no grpcio, multi-input).
+        # can't ride it (verb/method mismatch, no grpcio, multi-input,
+        # open breaker).
         if await self._grpc_infer(name, version, verb, instances, body,
-                                  metadata):
+                                  metadata, deadline=deadline):
             return
         path = f"/v1/models/{name}"
         if version:
             path += f"/versions/{version}"
         path += f":{verb}"
-        client = tornado.httpclient.AsyncHTTPClient()
+        upstream_body: Dict[str, Any] = {
+            "instances": instances,
+            "signature_name": body.get("signature_name"),
+        }
+        headers = {}
+        remaining = overload.remaining_s(deadline)
+        if remaining is not None:
+            # Forward the REMAINING budget (this hop's time already
+            # spent) so the server's admission control judges what the
+            # client actually has left.
+            headers[overload.DEADLINE_HEADER] = str(
+                max(1, int(remaining * 1000)))
         try:
-            response = await client.fetch(
-                f"{self.rpc_address}{path}", method="POST",
-                body=json.dumps({
-                    "instances": instances,
-                    "signature_name": body.get("signature_name"),
-                }),
-                request_timeout=self.rpc_timeout,
-                raise_error=False)
-        except Exception as e:  # noqa: BLE001 — connection-level failure
-            return self.write_json({"error": f"model server unreachable: {e}"},
-                                   502)
+            response = await self._rest_fetch(
+                f"{self.rpc_address}{path}", deadline=deadline,
+                method="POST", headers=headers,
+                body=json.dumps(upstream_body))
+        except (CircuitOpenError, BackendTimeoutError,
+                BackendDownError) as e:
+            return self.write_backend_error(e)
         payload = json.loads(response.body or b"{}")
         if response.code != 200:
+            retry_after = response.headers.get("Retry-After")
+            if retry_after:  # keep the backend's backoff hint intact
+                self.set_header("Retry-After", retry_after)
             # The failure may itself be caused by stale cached
             # metadata (hot reload changed the input signature → the
             # converted payload no longer matches): drop the entry so
@@ -294,6 +468,9 @@ class MetadataProxyHandler(ProxyHandler):
             # refresh the cache the infer path uses): a user asking
             # for metadata after an export wants the new signature.
             metadata = await self.get_signature_map(name, refresh=True)
+        except (CircuitOpenError, BackendTimeoutError,
+                BackendDownError) as e:
+            return self.write_backend_error(e)
         except tornado.httpclient.HTTPClientError as e:
             return self.write_json({"error": str(e)},
                                    e.code if e.code else 502)
@@ -328,15 +505,22 @@ def _bytes_to_arrays(instances: Any, metadata: Dict[str, Any]) -> Any:
 
 
 def make_app(rpc_address: str, rpc_timeout: float = 10.0,
-             grpc_address: Optional[str] = None
-             ) -> tornado.web.Application:
+             grpc_address: Optional[str] = None,
+             breaker_failures: int = 5,
+             breaker_reset_s: float = 5.0) -> tornado.web.Application:
     return tornado.web.Application([
         # Reference route grammar (server.py:270-283).
         (r"/model/([^/:]+)(?:/version/(\d+))?:(predict|classify|generate)",
          InferProxyHandler),
         (r"/model/([^/:]+)", MetadataProxyHandler),
     ], rpc_address=rpc_address, rpc_timeout=rpc_timeout,
-       grpc_address=grpc_address, metadata_cache={})
+       grpc_address=grpc_address, metadata_cache={},
+       # One breaker per upstream: the binary :9000 wire and the REST
+       # port fail independently (firewalled port vs dead pod).
+       rest_breaker=overload.CircuitBreaker(breaker_failures,
+                                            breaker_reset_s),
+       grpc_breaker=overload.CircuitBreaker(breaker_failures,
+                                            breaker_reset_s))
 
 
 def main(argv=None) -> int:
@@ -351,6 +535,12 @@ def main(argv=None) -> int:
     parser.add_argument("--grpc_port", type=int, default=9000,
                         help="model server's native gRPC port; 0 "
                              "disables the binary upstream")
+    parser.add_argument("--breaker_failures", type=int, default=5,
+                        help="consecutive transport failures that trip "
+                             "an upstream's circuit breaker open")
+    parser.add_argument("--breaker_reset", type=float, default=5.0,
+                        help="seconds an open circuit waits before the "
+                             "half-open recovery probe")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     # --rpc_address accepts bare host (reference --rpc_port style,
@@ -364,7 +554,9 @@ def main(argv=None) -> int:
     if "://" not in addr and ":" not in addr.rsplit("]", 1)[-1]:
         addr = f"{addr}:{args.rpc_port}"
     grpc_address = f"{host}:{args.grpc_port}" if args.grpc_port else None
-    app = make_app(addr, args.rpc_timeout, grpc_address=grpc_address)
+    app = make_app(addr, args.rpc_timeout, grpc_address=grpc_address,
+                   breaker_failures=args.breaker_failures,
+                   breaker_reset_s=args.breaker_reset)
     app.listen(args.port)
     logger.info("http proxy on :%d → REST :%d, gRPC %s", args.port,
                 args.rpc_port, grpc_address or "disabled")

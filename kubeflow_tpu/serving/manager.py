@@ -29,6 +29,14 @@ same queue: concurrent decodes coalesce into ONE KV-cache dispatch
 (mixed-length prompts left-pad to a bucket; per-request rng keys keep
 each request's tokens equal to its sequential B=1 run) — decode is
 HBM-bound, so the extra rows are near-free throughput.
+
+Overload control (serving/overload.py): queue entries carry the
+request's deadline; admission control sheds at enqueue when the
+estimated queue wait (batch-latency EWMA × queued batches) exceeds
+the remaining budget, and the batcher evicts already-expired entries
+before each dispatch so abandoned requests never reach XLA. Under
+offered load beyond capacity this is the difference between goodput ≈
+capacity and congestion collapse (PERF.md overload section).
 """
 
 from __future__ import annotations
@@ -36,6 +44,7 @@ from __future__ import annotations
 import itertools
 import logging
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
@@ -43,6 +52,11 @@ import numpy as np
 
 from kubeflow_tpu.serving import _native, remote
 from kubeflow_tpu.serving.model import LoadedModel, load_version
+from kubeflow_tpu.serving.overload import (
+    DeadlineExceededError,
+    LatencyEstimator,
+    OverloadedError,
+)
 from kubeflow_tpu.serving.version_policy import parse_version_policy
 
 __all__ = ["LOAD_ON_DEMAND_WAIT_S", "ModelManager", "ServedModel",
@@ -54,6 +68,13 @@ logger = logging.getLogger(__name__)
 #: the same version before giving up (load = read + device put + bucket
 #: warmup compiles; seconds on CPU, tens of seconds on a cold chip).
 LOAD_ON_DEMAND_WAIT_S = 300.0
+
+#: Admission safety factor: admit only when the estimated queue wait
+#: fits inside this fraction of the remaining budget. Admitting to
+#: exactly the boundary turns every scheduling hiccup into a batch of
+#: requests that are dispatched AND miss their deadline — all cost, no
+#: goodput; the headroom absorbs the jitter instead.
+ADMISSION_SAFETY = 0.8
 
 
 def _local_versions(base_path: str) -> List[int]:
@@ -73,7 +94,8 @@ class ServedModel:
 
     def __init__(self, name: str, base_path: str, *, max_batch: int = 64,
                  batch_window_s: float = 0.002,
-                 version_policy: str = "latest"):
+                 version_policy: str = "latest",
+                 queue_capacity: int = 4096):
         self.name = name
         self.base_path = base_path
         self.max_batch = max_batch
@@ -84,7 +106,11 @@ class ServedModel:
         self._latest: Optional[int] = None
         self._loading: Dict[int, threading.Event] = {}
         self._lock = threading.Lock()
-        self._queue = _native.RequestQueue()
+        # queue_capacity bounds the worst-case queue WAIT, not just
+        # memory: a deadline-free client's request can sit behind at
+        # most capacity/max_batch dispatches. Size it so that wait is
+        # tolerable (capacity × batch latency / max_batch).
+        self._queue = _native.RequestQueue(queue_capacity)
         # _pending is touched by every request thread and the batcher;
         # GIL-atomicity of single dict ops is not a contract worth
         # betting on (submit's push-fail cleanup + a concurrent pop of
@@ -100,10 +126,20 @@ class ServedModel:
         self._closed = False
         # Batch-fill accounting (PERF/benchmark instrumentation): how
         # many XLA executions the batcher issued and how many request
-        # rows they carried. Written only by the batcher thread;
-        # readers get snapshot-grade values (ints, GIL-atomic).
+        # rows they carried.
         self._stat_batches = 0
         self._stat_rows = 0
+        # Overload accounting: shed (rejected at enqueue — queue full
+        # or admission control) and expired (deadline lapsed while
+        # queued, evicted before dispatch). Incremented from request
+        # threads AND the batcher, so writes go through _pending_lock
+        # (int += is read-modify-write, not GIL-atomic).
+        self._stat_shed = 0
+        self._stat_expired = 0
+        # Rolling batch-dispatch latency: the admission controller's
+        # queue-wait estimate. Seeded from warmup timing at model load
+        # (see _seed_latency) so the very first burst is judged too.
+        self._latency = LatencyEstimator()
 
     # -- version lifecycle ------------------------------------------------
 
@@ -131,8 +167,15 @@ class ServedModel:
                     self.name, version, self.base_path)
         # warmup=True: every batch bucket compiles during load (health
         # stays 503), so no request ever hits a cold-compile cliff.
-        return load_version(self._version_dir(version),
-                            max_batch=self.max_batch, warmup=True)
+        loaded = load_version(self._version_dir(version),
+                              max_batch=self.max_batch, warmup=True)
+        # Warmup timed one post-compile full-bucket execution: install
+        # it as the admission controller's latency prior, so the first
+        # overload burst after a cold start is shed correctly instead
+        # of admitted unjudged.
+        if loaded.warmup_batch_seconds is not None:
+            self._latency.seed(loaded.warmup_batch_seconds)
+        return loaded
 
     def poll_versions(self) -> bool:
         """Scan base_path; (re)load whatever the version policy admits.
@@ -197,6 +240,21 @@ class ServedModel:
         if remote.is_remote(self.base_path):
             remote.prune_cache(self.base_path, resident)
         return loaded_any
+
+    def get_resident(self, version: Optional[int] = None
+                     ) -> Optional[LoadedModel]:
+        """The loaded model if (and only if) it is already resident —
+        a lock-guarded dict lookup, never a load. The HTTP handlers'
+        hot path: under overload, routing every request through a
+        pool-thread get() turns the executor into a second queue in
+        front of the real one; the fast path keeps admission control
+        the first thing a request meets. None → fall back to get()
+        on a pool thread (load-on-demand may take minutes)."""
+        with self._lock:
+            v = self._latest if version is None else version
+            if v is None:
+                return None
+            return self._versions.get(v)
 
     def get(self, version: Optional[int] = None) -> LoadedModel:
         with self._lock:
@@ -292,35 +350,78 @@ class ServedModel:
         with self._pending_lock:
             leftovers = list(self._pending.values())
             self._pending.clear()
-        for *_, future in leftovers:
-            future.set_exception(RuntimeError("server shutting down"))
+        for req in leftovers:
+            req[4].set_exception(RuntimeError("server shutting down"))
+
+    def queue_depth(self) -> int:
+        """Requests enqueued but not yet popped by the batcher."""
+        return self._queue.size()
+
+    def estimated_wait_s(self) -> float:
+        """Expected queue wait for a request admitted NOW: the rolling
+        batch-latency estimate × batches ahead of it (everything
+        queued, at max_batch per dispatch, plus its own batch)."""
+        depth = self._queue.size()
+        return self._latency.estimate_s() * (depth / self.max_batch + 1.0)
 
     def submit(self, inputs: Dict[str, np.ndarray],
                signature_name: Optional[str],
                method: Optional[str],
-               version: Optional[int]) -> Future:
+               version: Optional[int], *,
+               deadline: Optional[float] = None) -> Future:
         """Enqueue one request for micro-batching; resolves to the
-        output dict for exactly this request's rows."""
+        output dict for exactly this request's rows.
+
+        ``deadline`` is an absolute ``time.monotonic()`` timestamp.
+        Admission control runs here: a request whose remaining budget
+        is already smaller than the estimated queue wait is shed NOW
+        (future carries OverloadedError with a Retry-After hint)
+        rather than queued to expire; an already-expired request gets
+        DeadlineExceededError without touching the queue."""
         self.start_batcher()
         future: Future = Future()
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                with self._pending_lock:
+                    self._stat_expired += 1
+                future.set_exception(DeadlineExceededError(
+                    "deadline expired before enqueue"))
+                return future
+            est_wait = self.estimated_wait_s()
+            if est_wait > remaining * ADMISSION_SAFETY:
+                with self._pending_lock:
+                    self._stat_shed += 1
+                future.set_exception(OverloadedError(
+                    f"server overloaded: estimated queue wait "
+                    f"{est_wait * 1e3:.0f}ms exceeds remaining deadline "
+                    f"budget {remaining * 1e3:.0f}ms",
+                    retry_after_s=est_wait))
+                return future
         request_id = next(self._ids)
         with self._pending_lock:
             self._pending[request_id] = (inputs, signature_name, method,
-                                         version, future)
+                                         version, future, deadline)
         try:
             pushed = self._queue.push(request_id)
-            error = "server overloaded: request queue full"
+            error: Optional[Exception] = None
         except RuntimeError:  # queue closed mid-flight (shutdown race)
             pushed = False
-            error = "server shutting down"
+            error = RuntimeError("server shutting down")
         if not pushed:
+            if error is None:  # built lazily — never on the hot path
+                error = OverloadedError(
+                    "server overloaded: request queue full",
+                    retry_after_s=self.estimated_wait_s())
             # Ownership protocol: whoever pops the _pending entry (this
             # thread, the batcher, or stop()'s drain) is the only one
             # allowed to resolve the future — no set_exception races.
             with self._pending_lock:
                 owned = self._pending.pop(request_id, None) is not None
+                if owned and isinstance(error, OverloadedError):
+                    self._stat_shed += 1
             if owned:
-                future.set_exception(RuntimeError(error))
+                future.set_exception(error)
         return future
 
     def _batch_loop(self) -> None:
@@ -339,6 +440,32 @@ class ServedModel:
                             if r is not None]
             if not requests:
                 continue
+            # Deadline eviction: entries whose deadline lapsed while
+            # queued are failed HERE, before grouping — an abandoned
+            # request must never burn an XLA dispatch. This is the
+            # hard guarantee the overload bench asserts via
+            # batch_stats (expired + dispatched rows == admitted).
+            # The cutoff includes half an estimated execution: a
+            # request dispatched with less remaining budget than the
+            # dispatch itself takes completes just after its caller
+            # hung up — all cost, no goodput.
+            cutoff = time.monotonic() + 0.5 * self._latency.estimate_s()
+            live: List[Any] = []
+            expired: List[Any] = []
+            for req in requests:  # single pass: tuples hold ndarrays,
+                # so membership tests (==) are not an option here
+                (expired if req[5] is not None and req[5] <= cutoff
+                 else live).append(req)
+            if expired:
+                requests = live
+                with self._pending_lock:
+                    self._stat_expired += len(expired)
+                for req in expired:
+                    req[4].set_exception(DeadlineExceededError(
+                        "deadline expired while queued; request was "
+                        "never dispatched"))
+                if not requests:
+                    continue
             # Group by (signature, method, version): only same-signature
             # requests can share an XLA execution.
             groups: Dict[Any, List[Any]] = {}
@@ -350,17 +477,28 @@ class ServedModel:
 
     def batch_stats(self, reset: bool = False) -> Dict[str, float]:
         """Batcher fill statistics since start (or last reset): number
-        of XLA executions, total rows, mean rows per execution. Reset
-        is only safe while traffic is quiescent (benchmark phases)."""
-        batches, rows = self._stat_batches, self._stat_rows
-        if reset:
-            self._stat_batches = 0
-            self._stat_rows = 0
+        of XLA executions, total rows, mean rows per execution, plus
+        the overload counters (shed at admission, expired in queue)
+        and the rolling batch-latency estimate. Reset is only safe
+        while traffic is quiescent (benchmark phases)."""
+        with self._pending_lock:
+            batches, rows = self._stat_batches, self._stat_rows
+            shed, expired = self._stat_shed, self._stat_expired
+            if reset:
+                self._stat_batches = 0
+                self._stat_rows = 0
+                self._stat_shed = 0
+                self._stat_expired = 0
         return {"batches": batches, "rows": rows,
-                "mean_fill": round(rows / batches, 3) if batches else 0.0}
+                "mean_fill": round(rows / batches, 3) if batches else 0.0,
+                "shed": shed, "expired": expired,
+                "queue_depth": self._queue.size(),
+                "est_batch_latency_ms": round(
+                    self._latency.estimate_s() * 1e3, 3)}
 
     def _run_group(self, sig_name, method, version, group) -> None:
         futures = [g[4] for g in group]
+        t0 = time.monotonic()
         try:
             model = self.get(version)
             sig = model.signature(sig_name)
@@ -370,16 +508,25 @@ class ServedModel:
             if (method or getattr(sig, "method", None)) == "generate":
                 out = self._run_generate_group(model, sig_name, method,
                                                input_name, arrays, counts)
+                rows = sum(counts)
             else:
                 batch = (np.concatenate(arrays) if len(arrays) > 1
                          else arrays[0])
-                self._count_executions(int(batch.shape[0]))
+                rows = int(batch.shape[0])
+                self._count_executions(rows)
                 out = model.run({input_name: batch}, sig_name, method)
+            # Feed the admission controller: per-EXECUTION latency
+            # (a group whose rows exceed max_batch ran several XLA
+            # executions inside model.run — dividing keeps the
+            # queue-wait arithmetic in estimated_wait_s consistent).
+            self._latency.observe((time.monotonic() - t0)
+                                  / max(1, -(-rows // self.max_batch)))
             offset = 0
             for future, count in zip(futures, counts):
                 sliced = {k: v[offset:offset + count] for k, v in out.items()}
                 offset += count
-                future.set_result(sliced)
+                if not future.done():  # caller may have abandoned it
+                    future.set_result(sliced)
         except BaseException as e:  # noqa: BLE001 — fan the error out
             for future in futures:
                 if not future.done():
@@ -416,9 +563,12 @@ class ServedModel:
         total past it, and model.run() then splits into
         ceil(rows/max_batch) separate XLA executions — count those,
         not 1, or mean_fill could report an impossible > max_batch
-        and the coalescing contract (< N dispatches) would overstate."""
-        self._stat_batches += -(-rows // self.max_batch)
-        self._stat_rows += rows
+        and the coalescing contract (< N dispatches) would overstate.
+        Under _pending_lock like the shed/expired counters: batch_stats
+        readers and reset share these fields across threads."""
+        with self._pending_lock:
+            self._stat_batches += -(-rows // self.max_batch)
+            self._stat_rows += rows
 
 
 class ModelManager:
@@ -433,12 +583,14 @@ class ModelManager:
     def add_model(self, name: str, base_path: str, *,
                   max_batch: int = 64,
                   version_policy: str = "latest",
+                  queue_capacity: int = 4096,
                   initial_poll: bool = True) -> ServedModel:
         """Register a model. With ``initial_poll=False`` the (slow)
         first version load is deferred to the poll thread so a server
         can open its port immediately and report 503-until-loaded."""
         model = ServedModel(name, base_path, max_batch=max_batch,
-                            version_policy=version_policy)
+                            version_policy=version_policy,
+                            queue_capacity=queue_capacity)
         if initial_poll and not model.poll_versions():
             logger.warning("model %s: no versions found yet under %s",
                            name, base_path)

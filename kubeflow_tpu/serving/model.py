@@ -73,6 +73,10 @@ class LoadedModel:
         self._predict_cache: Dict[Tuple[str, int], Any] = {}
         self._gen_counter = 0  # per-request rng fold for sampling
         self._gen_lock = threading.Lock()
+        # Post-compile execution time of one full max_batch bucket,
+        # measured by warmup(); ServedModel seeds its admission-control
+        # latency estimate from it. None until warmup runs.
+        self.warmup_batch_seconds: Optional[float] = None
 
     def signature(self, name: Optional[str] = None) -> Signature:
         name = name or ModelMetadata.DEFAULT_SIGNATURE
@@ -309,6 +313,19 @@ class LoadedModel:
             if bucket >= self.max_batch:
                 break
             bucket = min(bucket * 2, self.max_batch)
+        # One extra TIMED execution of the full max_batch bucket, now
+        # that its program is compiled: the first run above included
+        # compilation (a 20-40 s number on TPU that would poison any
+        # latency estimate). This is the admission controller's
+        # batch-latency prior — ServedModel seeds its EWMA from it.
+        import time
+
+        x = np.zeros((bucket, lengths[-1]) if sig.method == "generate"
+                     else (bucket, *spec.shape[1:]),
+                     dtype=_NP_DTYPES[spec.dtype])
+        t0 = time.monotonic()
+        self.run({name: x}, method=methods[0])
+        self.warmup_batch_seconds = time.monotonic() - t0
 
 
 def load_version(version_dir: str, *, max_batch: int = 64,

@@ -43,6 +43,7 @@ HTTP endpoints:
 from __future__ import annotations
 
 import argparse
+import concurrent.futures
 import json
 import logging
 from typing import Any, Dict, Optional
@@ -51,6 +52,7 @@ import numpy as np
 import tornado.ioloop
 import tornado.web
 
+from kubeflow_tpu.serving import overload
 from kubeflow_tpu.serving.manager import ModelManager
 
 logger = logging.getLogger(__name__)
@@ -88,13 +90,21 @@ class BaseHandler(tornado.web.RequestHandler):
 
 class HealthHandler(BaseHandler):
     """Readiness: 200 only once every model has a loaded version, so
-    k8s doesn't route traffic during the (slow) first model load."""
+    k8s doesn't route traffic during the (slow) first model load.
+
+    The ready payload also carries per-model saturation signals —
+    queue depth, shed/expired counters, the rolling batch-latency
+    estimate — so kubelet probes and the dashboard see overload
+    building BEFORE requests start failing (a pod at 90% queue is the
+    one the autoscaler should act on, not the one already 503ing)."""
 
     def get(self):
-        if self.manager.ready():
-            self.write_json({"status": "ok"})
-        else:
-            self.write_json({"status": "loading"}, 503)
+        if not self.manager.ready():
+            return self.write_json({"status": "loading"}, 503)
+        self.write_json({"status": "ok", "models": {
+            name: model.batch_stats()
+            for name, model in self.manager.models.items()
+        }})
 
 
 class LiveHandler(BaseHandler):
@@ -132,6 +142,31 @@ class MetadataHandler(BaseHandler):
         })
 
 
+#: Batcher-await ceiling for deadline-free requests (requests WITH a
+#: deadline wait exactly their remaining budget, never this default).
+DEFAULT_INFER_WAIT_S = 30.0
+
+
+async def _await_future(future, wait_s: float):
+    """Await a batcher Future ON THE IO LOOP (no pool thread held per
+    in-flight request — under overload, a thread-per-wait design turns
+    the executor into a hidden second queue whose depth is the pool
+    size). asyncio.shield keeps the underlying future un-cancelled on
+    timeout: the batcher may still resolve it for a caller that
+    already gave up, which is harmless — eviction is the manager's
+    job."""
+    import asyncio
+
+    try:
+        return await asyncio.wait_for(
+            asyncio.shield(asyncio.wrap_future(future)), wait_s)
+    except asyncio.TimeoutError:
+        # Normalize to the concurrent.futures flavor the handlers map
+        # to 504/DEADLINE_EXCEEDED (distinct classes until py3.11).
+        raise concurrent.futures.TimeoutError(
+            "request timed out awaiting the batcher") from None
+
+
 class InferHandler(BaseHandler):
     async def post(self, name: str, version: Optional[str], verb: str):
         try:
@@ -141,20 +176,42 @@ class InferHandler(BaseHandler):
             if instances is None:
                 return self.write_json(
                     {"error": "request body needs 'instances'"}, 400)
-            # get() may load a pinned version on demand (seconds to
-            # minutes of device put + warmup compiles): run it on a
-            # pool thread, never the IO loop.
-            loaded = await tornado.ioloop.IOLoop.current().run_in_executor(
-                None, model.get, int(version) if version else None)
+            deadline = overload.request_deadline(self.request.headers,
+                                                 body)
+            want = int(version) if version else None
+            # Resident fast path: a dict lookup on the IO loop. Only a
+            # cold pinned version goes to a pool thread — get() may
+            # load on demand (seconds to minutes of device put +
+            # warmup compiles), and under overload an executor hop per
+            # request would queue AHEAD of admission control. The
+            # deadline bounds even the load wait: a caller with 500ms
+            # left gets its 504 at 500ms, not when a 5-minute load
+            # finishes (the load itself continues for later callers).
+            loaded = model.get_resident(want)
+            if loaded is None:
+                import asyncio
+
+                load = tornado.ioloop.IOLoop.current().run_in_executor(
+                    None, model.get, want)
+                try:
+                    loaded = await asyncio.wait_for(
+                        asyncio.shield(load),
+                        overload.clamp_wait_s(deadline,
+                                              DEFAULT_INFER_WAIT_S))
+                except asyncio.TimeoutError:
+                    raise overload.DeadlineExceededError(
+                        "model version load did not finish within the "
+                        "request budget") from None
             sig_name = body.get("signature_name")
             sig = loaded.signature(sig_name)
             input_name = next(iter(sig.inputs))
             batch = _instances_to_batch(instances, input_name)
             future = model.submit({input_name: batch}, sig_name, verb,
-                                  int(version) if version else None)
-            # Block a pool thread, not the IO loop, while the batcher runs.
-            result = await tornado.ioloop.IOLoop.current().run_in_executor(
-                None, future.result, 30.0)
+                                  want, deadline=deadline)
+            # Never hold the connection past the budget.
+            result = await _await_future(
+                future, overload.clamp_wait_s(deadline,
+                                              DEFAULT_INFER_WAIT_S))
             self.write_json({"model_spec": {"name": name,
                                             "version": str(loaded.version)},
                              "predictions": _batch_to_instances(result)})
@@ -162,10 +219,31 @@ class InferHandler(BaseHandler):
             self.write_json({"error": e.args[0]}, 404)
         except ValueError as e:
             self.write_json({"error": str(e)}, 400)
+        except overload.DeadlineExceededError as e:
+            # The request's own budget lapsed: 504, and the structured
+            # code tells retrying gateways NOT to (the deadline is
+            # gone whoever retries).
+            self.write_json({"error": str(e),
+                             "code": "DEADLINE_EXCEEDED"}, 504)
+        except overload.OverloadedError as e:
+            # Shed by admission control / queue cap: 503 with the
+            # server's estimate of when capacity frees up.
+            self.set_header("Retry-After",
+                            overload.retry_after_header(e.retry_after_s))
+            self.write_json({"error": str(e),
+                             "code": "RESOURCE_EXHAUSTED"}, 503)
+        except (TimeoutError, concurrent.futures.TimeoutError) as e:
+            # future.result() outwaited the budget while the request
+            # was dispatched (or the 30 s default for deadline-free
+            # clients): the work may still complete, but this caller
+            # is gone — 504 either way. (Both classes: they are only
+            # unified from Python 3.11.)
+            self.write_json({"error": str(e) or "request timed out",
+                             "code": "DEADLINE_EXCEEDED"}, 504)
         except RuntimeError as e:
-            # Overload (queue full) / shutdown races are server-side
-            # and transient: 503 so clients and the gateway retry with
-            # backoff instead of treating it as a bad request.
+            # Shutdown races and other server-side transients: 503 so
+            # clients and the gateway retry with backoff instead of
+            # treating it as a bad request.
             self.write_json({"error": str(e)}, 503)
 
 
@@ -232,18 +310,29 @@ class GrpcWebPredictHandler(BaseHandler):
             data = [m for flags, m in frames if not flags & 0x80]
             if len(data) != 1:
                 raise ValueError(f"expected 1 message frame, got {len(data)}")
+            # gRPC-Web carries the client deadline as a plain
+            # grpc-timeout header (Envoy's grpc_web filter forwards it
+            # verbatim); decode it into the same absolute deadline the
+            # native listener derives from context.time_remaining().
+            deadline = None
+            timeout_header = self.request.headers.get("Grpc-Timeout")
+            if timeout_header:
+                deadline = overload.deadline_after(
+                    wire.parse_grpc_timeout(timeout_header))
             loop = tornado.ioloop.IOLoop.current()
             # start_* resolve the model version, which may load a
             # pinned version on demand — pool thread, not the IO loop.
             if method == "Predict":
                 spec, loaded, future, output_filter = (
                     await loop.run_in_executor(
-                        None, svc.start_predict, self.manager, data[0]))
+                        None, svc.start_predict, self.manager, data[0],
+                        deadline))
                 finish = lambda out: svc.finish_predict(  # noqa: E731
                     spec, loaded, out, output_filter)
             elif method == "Classify":
                 spec, loaded, future = await loop.run_in_executor(
-                    None, svc.start_classify, self.manager, data[0])
+                    None, svc.start_classify, self.manager, data[0],
+                    deadline)
                 finish = lambda out: svc.finish_classify(  # noqa: E731
                     spec, loaded, out)
             else:  # GetModelMetadata (route regex restricts the set)
@@ -251,8 +340,9 @@ class GrpcWebPredictHandler(BaseHandler):
                 body = await loop.run_in_executor(
                     None, svc.get_model_metadata, self.manager, data[0])
             if future is not None:
-                outputs = await loop.run_in_executor(
-                    None, future.result, GRPC_WEB_TIMEOUT_S)
+                outputs = await _await_future(
+                    future, overload.clamp_wait_s(deadline,
+                                                  GRPC_WEB_TIMEOUT_S))
                 body = finish(outputs)
             self._grpc_reply(wire.frame_message(body)
                              + wire.trailers_frame(0))
@@ -260,8 +350,11 @@ class GrpcWebPredictHandler(BaseHandler):
             self._grpc_error(5, str(e))  # NOT_FOUND
         except ValueError as e:
             self._grpc_error(3, str(e))  # INVALID_ARGUMENT
-        except concurrent.futures.TimeoutError:
-            self._grpc_error(4, "predict timed out")  # DEADLINE_EXCEEDED
+        except (concurrent.futures.TimeoutError,
+                overload.DeadlineExceededError) as e:
+            self._grpc_error(4, str(e) or "predict timed out")  # DEADLINE
+        except overload.OverloadedError as e:
+            self._grpc_error(8, str(e))  # RESOURCE_EXHAUSTED
         except RuntimeError as e:
             self._grpc_error(14, str(e))  # UNAVAILABLE
         except Exception as e:  # malformed frames etc. must not 500:

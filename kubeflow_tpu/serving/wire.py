@@ -651,6 +651,45 @@ def decode_get_model_metadata_response(buf: bytes):
 DT_TO_STR = {v: k for k, v in _DT_FROM_STR.items()}
 
 
+# --- gRPC timeout header codec ---------------------------------------------
+#
+# gRPC carries the request deadline on the wire as the ``grpc-timeout``
+# header/metadata: ASCII digits (max 8) plus a single unit letter
+# (H hours, M minutes, S seconds, m milli, u micro, n nano). grpcio
+# encodes/decodes it natively for the :9000 listener; the gRPC-Web
+# bridge sees it as a plain HTTP header and needs this codec.
+
+_GRPC_TIMEOUT_UNITS = {"H": 3600.0, "M": 60.0, "S": 1.0,
+                       "m": 1e-3, "u": 1e-6, "n": 1e-9}
+
+
+def parse_grpc_timeout(value: str) -> float:
+    """``grpc-timeout`` header value → seconds. Raises ValueError on
+    anything that isn't digits+unit (a deadline the server can't read
+    must be rejected, not silently served unbounded)."""
+    value = value.strip()
+    if len(value) < 2 or value[-1] not in _GRPC_TIMEOUT_UNITS:
+        raise ValueError(f"malformed grpc-timeout {value!r}")
+    digits = value[:-1]
+    if not digits.isdigit() or len(digits) > 8:
+        raise ValueError(f"malformed grpc-timeout {value!r}")
+    return int(digits) * _GRPC_TIMEOUT_UNITS[value[-1]]
+
+
+def format_grpc_timeout(seconds: float) -> str:
+    """Seconds → ``grpc-timeout`` value, finest unit that fits the
+    8-digit budget (sub-millisecond budgets round up to 1m: a 0 would
+    mean 'already expired' at the receiver, which is the sender's
+    call, not a formatting artifact)."""
+    if seconds <= 0:
+        return "0m"
+    for unit, scale in (("m", 1e-3), ("S", 1.0), ("M", 60.0), ("H", 3600.0)):
+        count = max(1, int(-(-seconds // scale)))  # ceil
+        if count < 10 ** 8:
+            return f"{count}{unit}"
+    raise ValueError(f"timeout {seconds}s too large for grpc-timeout")
+
+
 # --- gRPC / gRPC-Web framing -----------------------------------------------
 
 GRPC_WEB_CONTENT_TYPES = (

@@ -248,6 +248,66 @@ def check_operator_wait_discipline() -> list:
     return errors
 
 
+def check_serving_timeout_discipline() -> list:
+    """Every network wait in the serving data plane must be bounded
+    (ISSUE 3 — the mirror of the operator wait-discipline rule): under
+    ``kubeflow_tpu/serving/`` forbid
+
+    (a) ``urlopen(...)`` without a ``timeout=`` argument,
+    (b) tornado ``.fetch(...)`` without ``request_timeout=``,
+    (c) invoking a gRPC callable (a name bound from
+        ``<channel>.unary_unary(...)``) without ``timeout=``,
+    (d) ``.result()`` on a future with neither positional nor keyword
+        timeout (an unbounded wait on the batcher).
+
+    An unbounded call is exactly how one dead backend wedges every
+    proxy worker; the deadline layer only works if every hop's wait
+    is finite."""
+    errors = []
+    serving_dir = REPO / "kubeflow_tpu" / "serving"
+    for f in sorted(serving_dir.glob("*.py")):
+        tree = ast.parse(f.read_text(), str(f))
+        grpc_callables = set()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr == "unary_unary"):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        grpc_callables.add(target.id)
+
+        def flag(node, what: str) -> None:
+            errors.append(
+                f"serving-timeout: {f.relative_to(REPO)}:{node.lineno}: "
+                f"{what} — every network wait under serving/ must "
+                f"carry an explicit timeout")
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kwargs = {k.arg for k in node.keywords}
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else None)
+            if name == "urlopen":
+                # urlopen(url, data, timeout): keyword or 3rd positional.
+                if "timeout" not in kwargs and len(node.args) < 3:
+                    flag(node, "urlopen without timeout=")
+            elif name == "fetch" and isinstance(func, ast.Attribute):
+                if "request_timeout" not in kwargs:
+                    flag(node, ".fetch without request_timeout=")
+            elif (isinstance(func, ast.Name)
+                  and func.id in grpc_callables):
+                if "timeout" not in kwargs:
+                    flag(node, f"gRPC call {func.id}(...) without "
+                               f"timeout=")
+            elif (name == "result" and isinstance(func, ast.Attribute)
+                  and not node.args and "timeout" not in kwargs):
+                flag(node, ".result() without a timeout")
+    return errors
+
+
 def check_unused_imports() -> list:
     errors = []
     for f in iter_py_files():
@@ -309,6 +369,7 @@ def main() -> int:
     errors = []
     for check in (check_syntax, check_imports_all_modules, check_cli_boots,
                   check_unused_imports, check_operator_wait_discipline,
+                  check_serving_timeout_discipline,
                   check_boilerplate, check_license_file):
         found = check()
         print(f"{check.__name__}: {'ok' if not found else f'{len(found)} errors'}")

@@ -1,0 +1,599 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Overload control end to end: deadline propagation, admission
+control, expiry eviction, circuit breaker, retry budget — the
+goodput-under-overload layer (serving/overload.py + the serving
+request path)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+import tornado.httpserver
+import tornado.testing
+import tornado.web
+
+from kubeflow_tpu.serving import overload, wire
+from kubeflow_tpu.serving.manager import ModelManager, ServedModel
+from kubeflow_tpu.serving.overload import (
+    CircuitBreaker,
+    DeadlineExceededError,
+    LatencyEstimator,
+    OverloadedError,
+    RetryPolicy,
+)
+
+# -- wire: deadline codecs ---------------------------------------------------
+
+
+def test_parse_deadline_ms():
+    assert overload.parse_deadline_ms(None) is None
+    assert overload.parse_deadline_ms("") is None
+    assert overload.parse_deadline_ms("250") == 0.25
+    assert overload.parse_deadline_ms(1500) == 1.5
+    with pytest.raises(ValueError):
+        overload.parse_deadline_ms("soon")
+
+
+def test_grpc_timeout_codec():
+    assert wire.parse_grpc_timeout("100m") == pytest.approx(0.1)
+    assert wire.parse_grpc_timeout("2S") == 2.0
+    assert wire.parse_grpc_timeout("1M") == 60.0
+    assert wire.parse_grpc_timeout("500u") == pytest.approx(5e-4)
+    for bad in ("", "m", "12", "12x", "1.5S", "123456789m"):
+        with pytest.raises(ValueError):
+            wire.parse_grpc_timeout(bad)
+    # format→parse round trips to >= the original (ceil — a deadline
+    # must never silently shrink on the wire).
+    for seconds in (0.001, 0.25, 3.0, 90.0, 7200.0):
+        assert wire.parse_grpc_timeout(
+            wire.format_grpc_timeout(seconds)) >= seconds - 1e-9
+    assert wire.format_grpc_timeout(0) == "0m"
+
+
+def test_latency_estimator_seed_and_ewma():
+    est = LatencyEstimator(alpha=0.5, prior_s=0.01)
+    assert est.estimate_s() == 0.01  # prior until any signal
+    est.seed(1.0)
+    assert est.estimate_s() == 1.0
+    est.seed(9.0)  # second seed ignored
+    assert est.estimate_s() == 1.0
+    est.observe(0.1)  # first live observation REPLACES the seed
+    assert est.estimate_s() == pytest.approx(0.1)
+    est.observe(0.3)  # then EWMA
+    assert est.estimate_s() == pytest.approx(0.2)
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_trips_after_consecutive_failures():
+    clock = _Clock()
+    b = CircuitBreaker(failure_threshold=3, reset_timeout_s=5.0,
+                       clock=clock)
+    b.record_failure()
+    b.record_failure()
+    b.record_success()  # consecutive counter resets
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed" and b.allow()
+    b.record_failure()  # third consecutive
+    assert b.state == "open"
+    assert not b.allow()
+    assert 0 < b.retry_after_s() <= 5.0
+
+
+def test_breaker_half_open_single_probe_and_recovery():
+    clock = _Clock()
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                       clock=clock)
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    clock.t += 5.1
+    assert b.allow()  # the half-open probe
+    assert not b.allow()  # exactly ONE probe at a time
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+    # Failed probe re-opens for a fresh timeout.
+    b.record_failure()
+    clock.t += 5.1
+    assert b.allow()
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    clock.t += 4.0
+    assert not b.allow()  # still inside the fresh timeout
+
+
+def test_breaker_open_fast_fails_in_microseconds():
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=60.0)
+    b.record_failure()
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        assert not b.allow()
+    per_call = (time.perf_counter() - t0) / 10_000
+    assert per_call < 1e-3  # the <1ms fast-fail contract, with slack
+
+
+# -- retry policy ------------------------------------------------------------
+
+
+def test_retry_policy_codes_and_backoff():
+    p = RetryPolicy(max_attempts=4, base_backoff_s=0.1, max_backoff_s=1.0)
+    assert p.retriable(None)  # transport failure
+    assert p.retriable(503) and p.retriable(429) and p.retriable(502)
+    assert not p.retriable(400) and not p.retriable(404)
+    assert not p.retriable(504)  # budget already gone — never retry
+    for attempt in range(6):
+        s = p.backoff_s(attempt)
+        assert 0.0 <= s <= min(0.1 * 2 ** attempt, 1.0)
+    # Retry-After floors the jittered value.
+    assert p.backoff_s(0, retry_after_s=0.7) >= 0.7
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+
+
+# -- manager: admission control + expiry eviction ----------------------------
+
+
+class _StubLoaded:
+    version = 1
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+        self.calls = 0
+        self.seen = []
+        self.started = threading.Event()
+
+    def signature(self, name=None):
+        class Sig:
+            method = "predict"
+            inputs = {"x": None}
+        return Sig()
+
+    def run(self, inputs, sig_name=None, method=None):
+        self.started.set()
+        self.calls += 1
+        self.seen.extend(np.asarray(inputs["x"])[:, 0].tolist())
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return {"y": np.asarray(inputs["x"]) * 2.0}
+
+
+def _make_model(delay_s: float = 0.0, max_batch: int = 8, **kwargs):
+    m = ServedModel("stub", "/nonexistent", max_batch=max_batch,
+                    batch_window_s=0.001, **kwargs)
+    stub = _StubLoaded(delay_s)
+    m._versions[1] = stub
+    m._latest = 1
+    return m, stub
+
+
+def test_admission_control_sheds_before_enqueue():
+    m, stub = _make_model()
+    try:
+        m._latency.seed(5.0)  # one batch "costs" 5s
+        fut = m.submit({"x": np.ones((1, 2), np.float32)}, None, None,
+                       None, deadline=overload.deadline_after(0.1))
+        with pytest.raises(OverloadedError) as ei:
+            fut.result(1)
+        assert ei.value.retry_after_s > 0
+        assert stub.calls == 0  # never reached the model
+        stats = m.batch_stats()
+        assert stats["shed"] == 1 and stats["expired"] == 0
+        assert stats["est_batch_latency_ms"] == pytest.approx(5000.0)
+    finally:
+        m.stop()
+
+
+def test_expired_at_enqueue_is_deadline_exceeded():
+    m, stub = _make_model()
+    try:
+        fut = m.submit({"x": np.ones((1, 2), np.float32)}, None, None,
+                       None, deadline=time.monotonic() - 1.0)
+        with pytest.raises(DeadlineExceededError):
+            fut.result(1)
+        assert stub.calls == 0
+        assert m.batch_stats()["expired"] == 1
+    finally:
+        m.stop()
+
+
+def test_expired_in_queue_evicted_before_dispatch():
+    """A request whose deadline lapses while queued behind a slow
+    dispatch is failed by the batcher WITHOUT reaching the model."""
+    m, stub = _make_model(delay_s=0.3)
+    try:
+        a = m.submit({"x": np.full((1, 2), 1.0, np.float32)},
+                     None, None, None)
+        assert stub.started.wait(5)  # A is now INSIDE the dispatch
+        # B: 120ms budget — above the 50ms admission prior (admitted),
+        # below A's 300ms dispatch (expires while queued behind it).
+        b = m.submit({"x": np.full((1, 2), 2.0, np.float32)},
+                     None, None, None,
+                     deadline=overload.deadline_after(0.12))
+        with pytest.raises(DeadlineExceededError):
+            b.result(5)
+        assert a.result(5)["y"][0][0] == 2.0
+        assert 2.0 not in stub.seen  # B's payload never dispatched
+        stats = m.batch_stats()
+        assert stats["expired"] == 1
+        assert stats["rows"] == 1  # only A consumed an execution
+    finally:
+        m.stop()
+
+
+def test_generous_deadline_completes_normally():
+    m, _ = _make_model()
+    try:
+        fut = m.submit({"x": np.full((1, 2), 3.0, np.float32)},
+                       None, None, None,
+                       deadline=overload.deadline_after(30.0))
+        np.testing.assert_array_equal(fut.result(5)["y"],
+                                      np.full((1, 2), 6.0))
+        stats = m.batch_stats()
+        assert stats["shed"] == 0 and stats["expired"] == 0
+    finally:
+        m.stop()
+
+
+def test_queue_full_is_overloaded_with_retry_after():
+    m, stub = _make_model(delay_s=0.2, max_batch=1, queue_capacity=1)
+    try:
+        first = m.submit({"x": np.ones((1, 2), np.float32)},
+                         None, None, None)
+        assert stub.started.wait(5)
+        filler = m.submit({"x": np.ones((1, 2), np.float32)},
+                          None, None, None)  # occupies the 1-slot queue
+        shed = m.submit({"x": np.ones((1, 2), np.float32)},
+                        None, None, None)
+        with pytest.raises(OverloadedError) as ei:
+            shed.result(1)
+        assert "queue full" in str(ei.value)
+        assert ei.value.retry_after_s > 0
+        assert m.batch_stats()["shed"] == 1
+        first.result(5)
+        filler.result(5)
+    finally:
+        m.stop()
+
+
+# -- HTTP server surface -----------------------------------------------------
+
+
+def _stub_manager(**kwargs):
+    manager = ModelManager()
+    model, stub = _make_model(**kwargs)
+    manager._models["stub"] = model
+    return manager, model, stub
+
+
+class OverloadHTTPSurface(tornado.testing.AsyncHTTPTestCase):
+    """Deadline header → 504/503 mapping + saturation-aware healthz."""
+
+    def get_app(self):
+        from kubeflow_tpu.serving.server import make_app
+
+        self.manager, self.model, self.stub = _stub_manager()
+        return make_app(self.manager)
+
+    def tearDown(self):
+        self.model.stop()
+        super().tearDown()
+
+    def _predict(self, body=None, headers=None):
+        payload = {"instances": [[1.0, 2.0]]}
+        payload.update(body or {})
+        return self.fetch("/v1/models/stub:predict", method="POST",
+                          body=json.dumps(payload), headers=headers)
+
+    def test_ok_with_generous_deadline(self):
+        resp = self._predict(headers={overload.DEADLINE_HEADER: "30000"})
+        assert resp.code == 200, resp.body
+        assert json.loads(resp.body)["predictions"][0]["y"] == [2.0, 4.0]
+
+    def test_expired_deadline_maps_504(self):
+        resp = self._predict(body={"deadline_ms": 0.001})
+        assert resp.code == 504, resp.body
+        body = json.loads(resp.body)
+        assert body["code"] == "DEADLINE_EXCEEDED"
+        assert "error" in body
+
+    def test_shed_maps_503_with_retry_after(self):
+        self.model._latency.seed(10.0)
+        resp = self._predict(headers={overload.DEADLINE_HEADER: "200"})
+        assert resp.code == 503, resp.body
+        body = json.loads(resp.body)
+        assert body["code"] == "RESOURCE_EXHAUSTED"
+        assert int(resp.headers["Retry-After"]) >= 10
+        assert self.stub.calls == 0
+
+    def test_malformed_deadline_maps_400(self):
+        resp = self._predict(headers={overload.DEADLINE_HEADER: "soon"})
+        assert resp.code == 400, resp.body
+
+    def test_healthz_reports_saturation_signals(self):
+        self.model._latency.seed(0.025)
+        resp = self.fetch("/healthz")
+        assert resp.code == 200
+        stats = json.loads(resp.body)["models"]["stub"]
+        for key in ("queue_depth", "shed", "expired",
+                    "est_batch_latency_ms", "batches", "rows"):
+            assert key in stats, stats
+        assert stats["est_batch_latency_ms"] == pytest.approx(25.0)
+
+    def test_grpc_web_deadline_via_grpc_timeout_header(self):
+        self.model._latency.seed(10.0)
+        body = wire.frame_message(wire.encode_predict_request(
+            "stub", {"x": np.ones((1, 2), np.float32)}))
+        resp = self.fetch(
+            "/tensorflow.serving.PredictionService/Predict",
+            method="POST", body=body,
+            headers={"Content-Type": "application/grpc-web+proto",
+                     "Grpc-Timeout": "100m"})
+        assert resp.code == 200  # status rides the trailers
+        trailer = wire.unframe_messages(resp.body)[0][1]
+        assert b"grpc-status:8" in trailer  # RESOURCE_EXHAUSTED
+        # Without the header the same request succeeds.
+        resp = self.fetch(
+            "/tensorflow.serving.PredictionService/Predict",
+            method="POST", body=body,
+            headers={"Content-Type": "application/grpc-web+proto"})
+        frames = wire.unframe_messages(resp.body)
+        assert any(b"grpc-status:0" in m for f, m in frames if f & 0x80)
+
+
+def test_native_grpc_deadline_sheds_resource_exhausted():
+    """The native :9000 wire: the client's grpc-timeout becomes the
+    admission-control budget via context.time_remaining()."""
+    import grpc
+
+    from kubeflow_tpu.serving.grpc_server import make_server
+
+    manager, model, _ = _stub_manager()
+    server, port = make_server(manager, 0)
+    server.start()
+    try:
+        request = wire.encode_predict_request(
+            "stub", {"x": np.ones((1, 2), np.float32)})
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
+            call = channel.unary_unary(
+                "/tensorflow.serving.PredictionService/Predict")
+            _, outputs = wire.decode_predict_response(
+                call(request, timeout=10))
+            assert outputs["y"].shape == (1, 2)
+            # Fresh estimator (the call above fed the live EWMA a
+            # sub-ms observation): pretend one batch costs 10s.
+            model._latency = LatencyEstimator()
+            model._latency.seed(10.0)
+            with pytest.raises(grpc.RpcError) as ei:
+                call(request, timeout=0.2)
+            assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+    finally:
+        server.stop(grace=None)
+        model.stop()
+
+
+# -- proxy: circuit breaker + timeout mapping --------------------------------
+
+
+class _MetaBackendHandler(tornado.web.RequestHandler):
+    def get(self, name):
+        self.write({"model_spec": {"name": name, "version": "1"},
+                    "metadata": {"signatures": {}}})
+
+
+class ProxyDeadBackend(tornado.testing.AsyncHTTPTestCase):
+    """Consecutive transport failures trip the REST breaker; while
+    open, requests fast-fail with 503 + Retry-After instead of dialing
+    the corpse."""
+
+    def get_app(self):
+        from kubeflow_tpu.serving.http_proxy import make_app
+
+        sock, port = tornado.testing.bind_unused_port()
+        sock.close()  # nothing listens: connection refused
+        self.proxy_app = make_app(f"127.0.0.1:{port}", rpc_timeout=1.0,
+                                  breaker_failures=2, breaker_reset_s=60.0)
+        return self.proxy_app
+
+    def test_breaker_opens_then_fast_fails(self):
+        breaker = self.proxy_app.settings["rest_breaker"]
+        for _ in range(2):
+            resp = self.fetch("/model/m")
+            assert resp.code == 502, resp.body
+        assert breaker.state == "open"
+        t0 = time.perf_counter()
+        resp = self.fetch("/model/m")
+        elapsed = time.perf_counter() - t0
+        assert resp.code == 503
+        assert json.loads(resp.body)["code"] == "RESOURCE_EXHAUSTED"
+        assert int(resp.headers["Retry-After"]) >= 1
+        assert elapsed < 0.5  # no dial, no timeout burn
+
+    def test_expired_deadline_fast_504_without_upstream(self):
+        breaker = self.proxy_app.settings["rest_breaker"]
+        resp = self.fetch("/model/m:predict", method="POST",
+                          body=json.dumps({"instances": [[1.0]]}),
+                          headers={overload.DEADLINE_HEADER: "0"})
+        assert resp.code == 504, resp.body
+        assert json.loads(resp.body)["code"] == "DEADLINE_EXCEEDED"
+        assert breaker.state == "closed"  # the backend was never dialed
+
+
+class ProxyBreakerRecovery(tornado.testing.AsyncHTTPTestCase):
+    """Open → (reset timeout) → half-open probe → closed, end to end:
+    the backend comes back and ONE probe request heals the proxy."""
+
+    def get_app(self):
+        from kubeflow_tpu.serving.http_proxy import make_app
+
+        sock, port = tornado.testing.bind_unused_port()
+        sock.close()
+        self.backend_port = port
+        self.proxy_app = make_app(f"127.0.0.1:{port}", rpc_timeout=1.0,
+                                  breaker_failures=1, breaker_reset_s=0.2)
+        return self.proxy_app
+
+    def test_half_open_probe_recovers(self):
+        breaker = self.proxy_app.settings["rest_breaker"]
+        assert self.fetch("/model/m").code == 502  # trips open
+        assert breaker.state == "open"
+        assert self.fetch("/model/m").code == 503  # fast-fail while open
+        # Backend resurrects on the same port.
+        backend = tornado.web.Application(
+            [(r"/v1/models/([^/]+)/metadata", _MetaBackendHandler)])
+        server = tornado.httpserver.HTTPServer(backend)
+        server.listen(self.backend_port, address="127.0.0.1")
+        try:
+            time.sleep(0.25)  # let the reset timeout elapse
+            resp = self.fetch("/model/m")  # the half-open probe
+            assert resp.code == 200, resp.body
+            assert breaker.state == "closed"
+        finally:
+            server.stop()
+
+
+class ProxyBackendTimeout(tornado.testing.AsyncHTTPTestCase):
+    """Backend accepts but never answers inside rpc_timeout → 504 with
+    the standard JSON error shape (was a generic 500)."""
+
+    def get_app(self):
+        from kubeflow_tpu.serving.http_proxy import make_app
+
+        class Slow(tornado.web.RequestHandler):
+            async def get(self, *args):
+                import asyncio
+
+                await asyncio.sleep(5.0)
+                self.write("{}")
+
+        sock, port = tornado.testing.bind_unused_port()
+        backend = tornado.web.Application([(r"/.*", Slow)])
+        self.backend_server = tornado.httpserver.HTTPServer(backend)
+        self.backend_server.add_sockets([sock])
+        self.proxy_app = make_app(f"127.0.0.1:{port}", rpc_timeout=0.3,
+                                  breaker_failures=100)
+        return self.proxy_app
+
+    def tearDown(self):
+        self.backend_server.stop()
+        super().tearDown()
+
+    def test_backend_timeout_maps_504(self):
+        resp = self.fetch("/model/m")
+        assert resp.code == 504, resp.body
+        body = json.loads(resp.body)
+        assert body["code"] == "DEADLINE_EXCEEDED"
+        assert "error" in body
+
+
+# -- client retry budget -----------------------------------------------------
+
+
+def _scripted_http_server(responses):
+    """Stdlib HTTP server answering POSTs from a script of
+    (code, retry_after) tuples, then 200. Returns (server, hits) where
+    hits records each request's X-Deadline-Ms header."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    hits = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            hits.append(self.headers.get(overload.DEADLINE_HEADER))
+            if responses:
+                code, retry_after = responses.pop(0)
+                body = json.dumps({"error": "scripted"}).encode()
+                self.send_response(code)
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(retry_after))
+            else:
+                body = json.dumps({"predictions": []}).encode()
+                self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, hits
+
+
+def test_client_retries_retriable_codes_then_succeeds():
+    from kubeflow_tpu.serving.client import post_json
+
+    server, hits = _scripted_http_server([(503, 0.02), (502, None)])
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}/x"
+        result = post_json(url, {"instances": []}, timeout=5,
+                           retry=RetryPolicy(max_attempts=4,
+                                             base_backoff_s=0.01))
+        assert result == {"predictions": []}
+        assert len(hits) == 3  # 503, 502, then 200
+    finally:
+        server.shutdown()
+
+
+def test_client_does_not_retry_non_retriable():
+    import urllib.error
+
+    from kubeflow_tpu.serving.client import post_json
+
+    server, hits = _scripted_http_server([(404, None), (404, None)])
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}/x"
+        with pytest.raises(urllib.error.HTTPError):
+            post_json(url, {}, timeout=5,
+                      retry=RetryPolicy(max_attempts=4,
+                                        base_backoff_s=0.01))
+        assert len(hits) == 1
+    finally:
+        server.shutdown()
+
+
+def test_client_never_retries_past_deadline():
+    import urllib.error
+
+    from kubeflow_tpu.serving.client import post_json
+
+    # Retry-After of 5s can never fit a 300ms budget: exactly one
+    # attempt, and the failure surfaces well before 5s.
+    server, hits = _scripted_http_server([(503, 5.0), (503, 5.0)])
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}/x"
+        t0 = time.perf_counter()
+        with pytest.raises(urllib.error.HTTPError):
+            post_json(url, {}, timeout=5, deadline_ms=300,
+                      retry=RetryPolicy(max_attempts=4))
+        assert time.perf_counter() - t0 < 2.0
+        assert len(hits) == 1
+        assert hits[0] is not None  # deadline header was forwarded
+        assert 0 < int(hits[0]) <= 300
+    finally:
+        server.shutdown()
