@@ -120,11 +120,47 @@ def serving_overload_main() -> int:
     return 0
 
 
+def obs_overhead_main() -> int:
+    """`python bench.py --obs-overhead`: serving-throughput cost of
+    leaving metrics + tracing ON (ISSUE 4 acceptance: <2%). Drives
+    the micro-batcher directly with interleaved obs-off/obs-on phases
+    (socket jitter would drown a 2% effect); prints ONE JSON line
+    shaped like the headline bench."""
+    from kubeflow_tpu.utils.platform import sync_platform_from_env
+
+    sync_platform_from_env()
+
+    from kubeflow_tpu.serving.benchmark import (
+        ObsOverheadConfig,
+        run_obs_overhead_benchmark,
+    )
+
+    result = run_obs_overhead_benchmark(ObsOverheadConfig())
+    print(json.dumps({
+        "metric": "serving_obs_overhead_pct",
+        "value": result["overhead_pct"],
+        "unit": (f"% of per-request service CPU spent on "
+                 f"metrics+tracing ({result['model']}, "
+                 f"{result['concurrency']} clients; component cost / "
+                 f"median service cost — see ObsOverheadConfig)"),
+        "vs_baseline": None,  # the reference had no metrics at all
+        "extra": {k: result[k] for k in
+                  ("obs_cost_per_request_us", "obs_cost_breakdown_us",
+                   "request_cpu_us", "rps_obs_off", "rps_obs_on",
+                   "rps_off_rounds", "rps_on_rounds",
+                   "ab_wall_overhead_pct", "under_2pct",
+                   "requests_per_phase")},
+    }))
+    return 0 if result["under_2pct"] else 1
+
+
 def main() -> int:
     if "--controller" in sys.argv:
         return controller_main()
     if "--serving-overload" in sys.argv:
         return serving_overload_main()
+    if "--obs-overhead" in sys.argv:
+        return obs_overhead_main()
     from kubeflow_tpu.utils.platform import sync_platform_from_env
 
     # Honor JAX_PLATFORMS from the caller (the session preset pins the

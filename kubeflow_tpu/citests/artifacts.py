@@ -12,13 +12,20 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""CI artifact plumbing: PR symlink + junit/log upload.
+"""CI artifact plumbing: PR symlink + junit/log/observability upload.
 
 Reference: the create-pr-symlink and copy-artifacts steps
 (``testing/workflows/components/workflows.libsonnet:163-175,218-225``)
 that fed junit XML to gubernator via GCS. ``copy`` shells out to
 gsutil when present and otherwise copies to a local dir (minikube-
 style runs).
+
+Observability trail: ``collect_obs`` sweeps the metrics JSONL and
+span JSONL files a CI run's processes wrote under ``$KFT_OBS_DIR``
+(plus a live dump of THIS process's registry/tracer) into the
+artifacts dir, next to the junit XML — so every CI run leaves its
+metrics and traces, not just pass/fail. ``copy`` calls it
+automatically before upload.
 """
 
 from __future__ import annotations
@@ -38,6 +45,46 @@ def artifacts_dir() -> Path:
     return Path(os.environ.get("KFT_ARTIFACTS_DIR", "artifacts"))
 
 
+def obs_dir() -> Path:
+    """Where this run's processes drop metrics/span JSONL for CI to
+    pick up (the drop-box contract: docs/observability.md)."""
+    return Path(os.environ.get("KFT_OBS_DIR", "/tmp/kft-obs"))
+
+
+def collect_obs() -> list:
+    """Copy every metrics/span JSONL under $KFT_OBS_DIR into
+    ``<artifacts>/obs/``, and dump THIS process's live registry and
+    span buffer alongside. Returns the copied/created paths.
+    Best-effort: a missing drop-box dir means an empty (but present)
+    observability trail, never a failed CI step."""
+    from kubeflow_tpu.obs import metrics as obs_metrics
+    from kubeflow_tpu.obs import tracing as obs_tracing
+
+    out = artifacts_dir() / "obs"
+    out.mkdir(parents=True, exist_ok=True)
+    copied = []
+    src = obs_dir()
+    if src.is_dir():
+        for f in sorted(src.rglob("*.jsonl")):
+            # Flatten the relative path INTO the name: two processes
+            # dropping server/spans.jsonl and proxy/spans.jsonl must
+            # both survive the sweep, not clobber each other.
+            dest = out / "__".join(f.relative_to(src).parts)
+            shutil.copyfile(f, dest)
+            copied.append(dest)
+    # Live dumps of THIS process under their own names — never the
+    # sweep's namespace.
+    metrics_path = out / "live_metrics.jsonl"
+    obs_metrics.dump_jsonl(str(metrics_path))
+    copied.append(metrics_path)
+    spans_path = out / "live_spans.jsonl"
+    obs_tracing.TRACER.dump_jsonl(str(spans_path))
+    copied.append(spans_path)
+    logger.info("observability trail: %d file(s) under %s",
+                len(copied), out)
+    return copied
+
+
 def create_pr_symlink() -> Path:
     """Record the PR→artifacts association gubernator expects: a
     metadata file naming the job run (symlinks don't survive GCS, the
@@ -55,6 +102,7 @@ def create_pr_symlink() -> Path:
 
 def copy(bucket: str) -> None:
     src = artifacts_dir()
+    collect_obs()  # the junit XML never travels without its obs trail
     if shutil.which("gsutil"):
         subprocess.check_call(
             ["gsutil", "-m", "cp", "-r", str(src),
@@ -68,12 +116,15 @@ def copy(bucket: str) -> None:
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kft-ci-artifacts")
-    parser.add_argument("command", choices=["create-pr-symlink", "copy"])
+    parser.add_argument("command", choices=["create-pr-symlink", "copy",
+                                            "collect-obs"])
     parser.add_argument("--bucket", default="kubeflow-tpu-ci-results")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     if args.command == "create-pr-symlink":
         create_pr_symlink()
+    elif args.command == "collect-obs":
+        collect_obs()
     else:
         copy(args.bucket)
     return 0

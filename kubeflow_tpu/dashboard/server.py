@@ -61,9 +61,21 @@ import tornado.ioloop
 import tornado.web
 
 from kubeflow_tpu.manifests.tpujob import KIND
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs.exposition import (
+    ChromeTraceHandler,
+    MetricsHandler,
+    TraceContextHandlerMixin,
+    access_log_function,
+)
 from kubeflow_tpu.operator.reconciler import JOB_LABEL
 
 logger = logging.getLogger(__name__)
+
+_D_REQUESTS = obs_metrics.Counter(
+    "kft_dashboard_requests_total",
+    "Dashboard HTTP requests by handler and status class",
+    ("handler", "code"))
 
 
 #: Non-phase conditions the operator raises for jobs needing operator
@@ -145,10 +157,21 @@ def pod_summary(pod: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
-class BaseHandler(tornado.web.RequestHandler):
+class BaseHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
+    # Context adoption + the per-request span come from the shared
+    # mixin; health probes opt out (they would evict real handler
+    # spans from the ring buffer).
+    _obs_span = "dashboard_request"
+    _obs_cat = "dashboard"
+
     @property
     def api(self):
         return self.application.settings["api"]
+
+    def on_finish(self) -> None:
+        _D_REQUESTS.labels(type(self).__name__,
+                           f"{self.get_status() // 100}xx").inc()
+        super().on_finish()
 
     def write_json(self, payload: Any, status: int = 200) -> None:
         self.set_status(status)
@@ -157,6 +180,8 @@ class BaseHandler(tornado.web.RequestHandler):
 
 
 class HealthHandler(BaseHandler):
+    _obs_span = None  # kubelet probes must not churn the span buffer
+
     def get(self):
         self.write_json({"status": "ok"})
 
@@ -465,6 +490,15 @@ _PAGE = """<!doctype html>
 JSON: <a href="/tpujobs/api/traces">/tpujobs/api/traces</a> &middot;
 open with <code>tensorboard --logdir &lt;trace dir&gt;</code>
 (docs/profiling.md)</p>
+<h2>Request spans</h2>
+<p>Host-side request spans (Chrome trace-event JSON — open in
+<a href="https://ui.perfetto.dev">Perfetto</a>):
+<a href="/tpujobs/api/spans">/tpujobs/api/spans</a> for this
+dashboard's own handlers; serving pods expose theirs at
+<code>/tracez</code> (proxy and model server). Prometheus metrics:
+<a href="/metrics">/metrics</a> here, plus <code>/metrics</code> on
+the proxy, model server, and the operator's metrics port
+(docs/observability.md).</p>
 <h2>Create TPUJob</h2>
 <form method="post" action="/tpujobs/ui/create">
  <label>Name <input name="name" required pattern="[a-z0-9-]+"></label>
@@ -715,17 +749,20 @@ def make_app(api, trace_root: str = DEFAULT_TRACE_ROOT
              ) -> tornado.web.Application:
     return tornado.web.Application([
         (r"/healthz", HealthHandler),
+        (r"/metrics", MetricsHandler),
         (r"/tpujobs/api/tpujob", JobListHandler),
         (r"/tpujobs/api/tpujob/([^/]+)/([^/]+)", JobDetailHandler),
         (r"/tpujobs/api/tpujob/([^/]+)/([^/]+)/logs/([^/]+)",
          PodLogsHandler),
         (r"/tpujobs/api/traces", TraceListHandler),
+        (r"/tpujobs/api/spans", ChromeTraceHandler),
         (r"/tpujobs/api/operator", OperatorMetricsHandler),
         (r"/tpujobs/ui/?", UIHandler),
         (r"/tpujobs/ui/job/([^/]+)/([^/]+)", UIJobDetailHandler),
         (r"/tpujobs/ui/create", UICreateHandler),
         (r"/", tornado.web.RedirectHandler, {"url": "/tpujobs/ui/"}),
-    ], api=api, trace_root=trace_root)
+    ], api=api, trace_root=trace_root,
+       log_function=access_log_function("dashboard"))
 
 
 def main(argv=None) -> int:

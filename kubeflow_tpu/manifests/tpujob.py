@@ -294,6 +294,7 @@ def operator_deployment(namespace: str, image: str) -> Dict[str, Any]:
         env=[
             k8s.env_var("KFT_NAMESPACE", field_path="metadata.namespace"),
         ],
+        ports=[k8s.port(9400, "metrics")],
         volume_mounts=[k8s.volume_mount("config-volume", "/etc/config")],
     )
     return k8s.deployment(
@@ -304,6 +305,12 @@ def operator_deployment(namespace: str, image: str) -> Dict[str, Any]:
                                 config_map_name="tpujob-operator-config")],
             service_account="tpujob-operator",
         ),
+        # Annotation-driven discovery (the classic prometheus.io
+        # contract): the operator's stdlib exposition thread serves
+        # /metrics on :9400 (docs/observability.md).
+        pod_annotations={"prometheus.io/scrape": "true",
+                         "prometheus.io/port": "9400",
+                         "prometheus.io/path": "/metrics"},
     )
 
 

@@ -1,0 +1,206 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Scrape surfaces + structured access logs.
+
+Three delivery mechanisms for the same registry/tracer:
+
+- :class:`MetricsHandler` / :class:`ChromeTraceHandler` — tornado
+  routes for the processes that already run tornado (serving server,
+  HTTP proxy, dashboard): ``/metrics`` (Prometheus text) and
+  ``/tracez`` (Chrome trace JSON).
+- :func:`start_exposition_server` — a stdlib ``http.server`` thread
+  for the operator (no tornado in its control loop): same two paths
+  plus ``/healthz``.
+- :func:`access_log_function` — tornado's ``log_function`` hook
+  emitting ONE JSON line per request on the ``kft.access`` logger
+  (request_id, method, path, status, latency_ms, model, outcome)
+  instead of tornado's unstructured access noise. The logger has no
+  handler of its own: production mains configure logging and see the
+  lines; pytest (which configures nothing) stays quiet.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs import tracing as obs_tracing
+
+# The tornado handlers are optional: the operator image runs no
+# tornado — its scrape surface is the stdlib thread below, and this
+# module must import cleanly there (controller.py main imports it).
+try:
+    import tornado.web as _tornado_web
+except ImportError:  # pragma: no cover — serving images ship tornado
+    _tornado_web = None
+
+__all__ = [
+    "ACCESS_LOGGER",
+    "ChromeTraceHandler",
+    "MetricsHandler",
+    "TraceContextHandlerMixin",
+    "access_log_function",
+    "start_exposition_server",
+]
+
+#: The structured access-log channel. One JSON object per line.
+ACCESS_LOGGER = "kft.access"
+
+
+class TraceContextHandlerMixin:
+    """The shared per-request observability behavior of every tornado
+    surface (serving server, proxy, dashboard) — mix in BEFORE
+    RequestHandler. ``prepare`` adopts/mints the trace context and
+    echoes ``X-Request-Id``; ``on_finish`` records one server-side
+    span when the subclass opts in via ``_obs_span``. Plain class (no
+    tornado dependency): it only touches handler attributes, so it
+    imports fine in tornado-less processes too."""
+
+    #: Span name recorded per request; None keeps a handler out of
+    #: the ring buffer (health/metrics polls every few seconds would
+    #: evict the real request spans).
+    _obs_span: Optional[str] = None
+    #: Chrome-trace category for this surface's spans.
+    _obs_cat = "app"
+
+    def prepare(self) -> None:
+        self._obs_ctx = obs_tracing.ensure_context(self.request.headers)
+        self._obs_request_id = self._obs_ctx.request_id
+        self.set_header(obs_tracing.REQUEST_ID_HEADER,
+                        self._obs_ctx.request_id)
+
+    def on_finish(self) -> None:
+        if self._obs_span and obs_tracing.TRACER.enabled:
+            dur = self.request.request_time()
+            obs_tracing.TRACER.record(
+                self._obs_span, self._obs_cat,
+                time.monotonic() - dur, dur,
+                {"request_id": self._obs_ctx.request_id,
+                 "trace_id": self._obs_ctx.trace_id,
+                 "path": self.request.path,
+                 "status": self.get_status(),
+                 "outcome": getattr(self, "_obs_outcome", None)
+                 or ("ok" if self.get_status() < 400 else "error")})
+
+
+if _tornado_web is not None:
+    class MetricsHandler(_tornado_web.RequestHandler):
+        """GET /metrics — Prometheus text exposition of the default
+        registry (or a ``metrics_registry`` app setting override)."""
+
+        def get(self):
+            registry = self.application.settings.get("metrics_registry")
+            self.set_header("Content-Type", obs_metrics.CONTENT_TYPE)
+            self.finish(obs_metrics.render(registry))
+
+    class ChromeTraceHandler(_tornado_web.RequestHandler):
+        """GET /tracez — the span ring buffer as Chrome trace-event
+        JSON (open in Perfetto / chrome://tracing;
+        docs/observability.md)."""
+
+        def get(self):
+            tracer = (self.application.settings.get("tracer")
+                      or obs_tracing.TRACER)
+            self.set_header("Content-Type", "application/json")
+            self.finish(json.dumps(tracer.export_chrome()))
+else:  # pragma: no cover — tornado-less images use the stdlib server
+    MetricsHandler = ChromeTraceHandler = None
+
+
+def access_log_function(component: str):
+    """Build tornado's ``log_function`` for one component: called once
+    per finished request, emits the structured line. Handlers may stash
+    ``_obs_request_id`` / ``_obs_model`` / ``_obs_outcome`` attributes
+    on themselves to enrich the record."""
+    logger = logging.getLogger(ACCESS_LOGGER)
+
+    def log(handler) -> None:
+        try:
+            record: Dict[str, Any] = {
+                "component": component,
+                "method": handler.request.method,
+                "path": handler.request.uri,
+                "status": handler.get_status(),
+                "latency_ms": round(
+                    handler.request.request_time() * 1e3, 3),
+            }
+            request_id = getattr(handler, "_obs_request_id", None)
+            if request_id:
+                record["request_id"] = request_id
+            model = getattr(handler, "_obs_model", None)
+            if model:
+                record["model"] = model
+            outcome = getattr(handler, "_obs_outcome", None)
+            if outcome:
+                record["outcome"] = outcome
+            logger.info("%s", json.dumps(record, sort_keys=True))
+        except Exception:  # noqa: BLE001 — logging must never 500
+            logger.debug("access log failed", exc_info=True)
+
+    return log
+
+
+class _ExpositionHandler(BaseHTTPRequestHandler):
+    """stdlib handler: /metrics, /tracez, /healthz. Server attributes
+    carry the registry/tracer (set by start_exposition_server)."""
+
+    def do_GET(self):  # noqa: N802 — stdlib contract
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = obs_metrics.render(
+                getattr(self.server, "registry", None)).encode()
+            ctype = obs_metrics.CONTENT_TYPE
+        elif path == "/tracez":
+            tracer = (getattr(self.server, "tracer", None)
+                      or obs_tracing.TRACER)
+            body = json.dumps(tracer.export_chrome()).encode()
+            ctype = "application/json"
+        elif path == "/healthz":
+            body = b'{"status": "ok"}'
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, format, *args):  # noqa: A002 — stdlib sig
+        pass  # scrapes every few seconds must not spam stderr
+
+
+def start_exposition_server(port: int = 0, *,
+                            registry: Optional[Any] = None,
+                            tracer: Optional[Any] = None,
+                            host: str = "0.0.0.0"):
+    """Serve /metrics + /tracez + /healthz from a daemon thread (the
+    operator's scrape surface — it runs no tornado). Returns the
+    ``ThreadingHTTPServer``; ``server.server_address[1]`` is the bound
+    port (useful with port=0), ``server.shutdown()`` stops it."""
+    server = ThreadingHTTPServer((host, port), _ExpositionHandler)
+    server.daemon_threads = True
+    server.registry = registry
+    server.tracer = tracer
+    thread = threading.Thread(target=server.serve_forever,
+                              name="obs-exposition", daemon=True)
+    thread.start()
+    return server

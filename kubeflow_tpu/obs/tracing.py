@@ -1,0 +1,302 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Request tracing: propagated context + in-process span ring buffer.
+
+One request, one ``request_id``: minted at the edge (the HTTP proxy —
+or accepted from the client when it already carries one), carried over
+REST as ``X-Request-Id`` + W3C ``traceparent`` headers and over gRPC
+as binary-safe ASCII metadata, and attached to every span the request
+produces on its way through proxy → server → manager → XLA dispatch.
+That is what turns "p99 regressed" into "THIS request waited 412 ms in
+the queue behind THAT batch" — the host-side half of the host+device
+profiling story ("Exploring the limits of Concurrency in ML Training
+on Google TPUs", PAPERS.md; the device half is the XPlane traces in
+docs/profiling.md).
+
+Spans land in a bounded ring buffer (:class:`Tracer`) — oldest spans
+fall off, memory is O(capacity), and recording is an O(1) deque append
+under one lock, cheap enough to leave on (bench.py --obs-overhead).
+The export shape is Chrome trace-event JSON, so ``/tracez`` (serving,
+dashboard) opens directly in Perfetto (ui.perfetto.dev) or
+``chrome://tracing`` with zero conversion — recipe in
+docs/observability.md.
+
+Span linkage contract: request-scoped spans (``queue_wait``,
+``batch_assembly``, ``execute``) carry ``args.request_id`` /
+``args.trace_id`` and — once coalesced — ``args.batch``; the one
+``batch_execute`` span per XLA dispatch carries the same ``args.batch``
+id, which is how N request timelines join the single device dispatch
+they shared.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "REQUEST_ID_HEADER",
+    "TRACEPARENT_HEADER",
+    "TRACER",
+    "TraceContext",
+    "Tracer",
+    "ensure_context",
+    "from_grpc_metadata",
+    "from_headers",
+    "new_context",
+    "parse_traceparent",
+]
+
+REQUEST_ID_HEADER = "X-Request-Id"
+TRACEPARENT_HEADER = "traceparent"
+
+_HEX = "0123456789abcdef"
+
+# Id generation is on the per-request hot path: uuid.uuid4() costs an
+# os.urandom syscall per call (~45µs on an old kernel — measured
+# 135µs per context, most of the obs overhead budget). Trace ids need
+# collision resistance, not cryptographic strength: a Mersenne
+# twister seeded once from urandom gives ~2µs ids. getrandbits is a
+# single C call, so it's GIL-atomic across request threads.
+_rng = random.Random(int.from_bytes(os.urandom(16), "big"))
+
+
+def _hex128() -> str:
+    return f"{_rng.getrandbits(128):032x}"
+
+
+def _hex64() -> str:
+    return f"{_rng.getrandbits(64):016x}"
+
+
+def _is_hex(s: str, length: int) -> bool:
+    return len(s) == length and all(c in _HEX for c in s.lower())
+
+
+class TraceContext:
+    """Immutable-ish propagation context: W3C trace/span ids plus the
+    human-greppable request id (the access-log join key)."""
+
+    __slots__ = ("trace_id", "span_id", "request_id")
+
+    def __init__(self, trace_id: str, span_id: str, request_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.request_id = request_id
+
+    def child(self) -> "TraceContext":
+        """Same trace/request, fresh span id — what each hop sends
+        downstream so parentage is reconstructible."""
+        return TraceContext(self.trace_id, _hex64(), self.request_id)
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def headers(self) -> Dict[str, str]:
+        return {REQUEST_ID_HEADER: self.request_id,
+                TRACEPARENT_HEADER: self.traceparent()}
+
+    def grpc_metadata(self) -> Tuple[Tuple[str, str], ...]:
+        """gRPC metadata keys must be lowercase ASCII."""
+        return (("x-request-id", self.request_id),
+                ("traceparent", self.traceparent()))
+
+    def __repr__(self) -> str:
+        return (f"TraceContext(request_id={self.request_id!r}, "
+                f"trace_id={self.trace_id!r})")
+
+
+def new_context(request_id: Optional[str] = None) -> TraceContext:
+    trace_id = _hex128()
+    return TraceContext(trace_id, _hex64(),
+                        request_id or trace_id[:16])
+
+
+def parse_traceparent(value: str) -> Optional[Tuple[str, str]]:
+    """``00-<32 hex>-<16 hex>-<2 hex>`` → (trace_id, span_id), or None
+    on anything malformed (a bad header must never 500 a request)."""
+    parts = value.strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if not (_is_hex(version, 2) and _is_hex(trace_id, 32)
+            and _is_hex(span_id, 16) and _is_hex(flags, 2)):
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id.lower(), span_id.lower()
+
+
+def from_headers(headers) -> Optional[TraceContext]:
+    """Context from an HTTP request's headers (any Mapping-with-get,
+    e.g. tornado's HTTPHeaders), or None when the request carries
+    neither header. Client-supplied ids are capped at 128 chars: the
+    id is echoed in response headers, copied into every span's args
+    (ring-buffer memory is O(capacity × id size)) and written to each
+    access-log line — an unbounded header must not ride that far."""
+    request_id = headers.get(REQUEST_ID_HEADER)
+    if request_id:
+        request_id = str(request_id)[:128]
+    parent = headers.get(TRACEPARENT_HEADER)
+    parsed = parse_traceparent(parent) if parent else None
+    if parsed:
+        trace_id, span_id = parsed
+        return TraceContext(trace_id, span_id,
+                            request_id or trace_id[:16])
+    if request_id:
+        return new_context(request_id=request_id)
+    return None
+
+
+def ensure_context(headers) -> TraceContext:
+    """The edge rule (proxy): adopt the caller's context when present,
+    mint a fresh one otherwise — every request downstream of here HAS
+    an id."""
+    return from_headers(headers) or new_context()
+
+
+def from_grpc_metadata(metadata: Optional[Iterable]
+                       ) -> Optional[TraceContext]:
+    """Context from gRPC invocation metadata: an iterable of (key,
+    value) pairs (grpcio's context.invocation_metadata())."""
+    if metadata is None:
+        return None
+    found = {}
+    for item in metadata:
+        key, value = item[0], item[1]
+        if key.lower() in ("x-request-id", "traceparent"):
+            found[key.lower()] = value
+    if not found:
+        return None
+
+    class _MD:
+        def get(self, name, default=None):
+            return found.get(name.lower(), default)
+
+    return from_headers(_MD())
+
+
+class Tracer:
+    """Bounded in-process span recorder.
+
+    ``record()`` appends one finished span (a plain dict, Chrome
+    trace-event "X" shape) to a deque with maxlen — O(1), no
+    allocation churn beyond the dict itself, oldest spans evicted.
+    ``enabled=False`` makes record() a no-op (one attribute read);
+    the obs-overhead bench flips exactly this switch.
+    """
+
+    def __init__(self, capacity: int = 4096, component: str = ""):
+        self.enabled = True
+        self.component = component or os.environ.get(
+            "KFT_OBS_COMPONENT", "")
+        self._pid = os.getpid()
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=int(capacity))
+        self._batch_ids = itertools.count(1)
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self._spans = deque(self._spans, maxlen=int(capacity))
+
+    def next_batch_id(self) -> str:
+        return f"batch-{self._pid}-{next(self._batch_ids)}"
+
+    def record(self, name: str, cat: str, start_s: float, dur_s: float,
+               args: Optional[Dict[str, Any]] = None,
+               tid: Optional[int] = None) -> None:
+        """Record one completed span. ``start_s`` is a
+        ``time.monotonic()`` timestamp; durations in seconds. Hot
+        path: one dict + one locked deque append, no formatting —
+        rounding/pretty-printing happens at export time."""
+        if not self.enabled:
+            return
+        event = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": start_s * 1e6,               # µs, Chrome contract
+            "dur": dur_s * 1e6 if dur_s > 0.0 else 0.0,
+            "pid": self._pid,
+            "tid": (tid if tid is not None
+                    else threading.get_ident() & 0x7FFFFFFF),
+        }
+        if args:
+            event["args"] = args
+        with self._lock:
+            self._spans.append(event)
+
+    class _SpanCtx:
+        __slots__ = ("_tracer", "_name", "_cat", "_args", "_t0")
+
+        def __init__(self, tracer, name, cat, args):
+            self._tracer = tracer
+            self._name = name
+            self._cat = cat
+            self._args = args
+
+        def __enter__(self):
+            self._t0 = time.monotonic()
+            return self
+
+        def __exit__(self, exc_type, exc, tb):
+            if exc_type is not None:
+                args = dict(self._args or ())
+                args["outcome"] = "error"
+                self._args = args
+            self._tracer.record(self._name, self._cat, self._t0,
+                                time.monotonic() - self._t0, self._args)
+            return False
+
+    def span(self, name: str, cat: str = "app",
+             args: Optional[Dict[str, Any]] = None) -> "Tracer._SpanCtx":
+        """Context manager recording one span around a block."""
+        return Tracer._SpanCtx(self, name, cat, args)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def export_chrome(self) -> Dict[str, Any]:
+        """The Perfetto-openable document: trace events plus a process
+        metadata record naming the component."""
+        events: List[Dict[str, Any]] = []
+        if self.component:
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": os.getpid(),
+                           "args": {"name": self.component}})
+        events.extend(self.snapshot())
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def dump_jsonl(self, path: str) -> None:
+        """One span per line (the CI artifact shape —
+        citests/artifacts.py copies these next to the junit XML)."""
+        with open(path, "w") as f:
+            for span in self.snapshot():
+                f.write(json.dumps(span) + "\n")
+
+
+#: The process-wide tracer every module records against.
+TRACER = Tracer()

@@ -51,6 +51,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.manifests.tpujob import KIND, PLURAL, GROUP
+from kubeflow_tpu.obs import metrics as obs_metrics
 from kubeflow_tpu.operator.fake import Conflict, Gone, NotFound
 from kubeflow_tpu.operator.reconciler import JOB_LABEL, Reconciler
 from kubeflow_tpu.operator.workqueue import (
@@ -66,6 +67,42 @@ logger = logging.getLogger(__name__)
 #: and the load benchmark read the same numbers).
 METRICS_CONFIGMAP = "tpujob-operator-metrics"
 METRICS_KEY = "metrics.json"
+
+# Prometheus families for the control loop — the ConfigMap snapshot
+# above stays (the dashboard reads it through the apiserver), but the
+# same numbers are now scrapeable live at --metrics-port via a stdlib
+# exposition thread (no tornado in the operator image). Workqueue
+# gauges/counters are render-time callbacks into WorkQueue.counts();
+# reconcile latency is a real histogram observed per pass.
+_O_RECONCILES = obs_metrics.Counter(
+    "kft_operator_reconciles_total", "Successful reconcile passes")
+_O_FAILURES = obs_metrics.Counter(
+    "kft_operator_reconcile_failures_total",
+    "Reconcile passes that raised (scheduled for backoff retry)")
+_O_LATENCY = obs_metrics.Histogram(
+    "kft_operator_reconcile_seconds",
+    "Wall time of one reconcile pass (get + reconcile)")
+_O_WATCH_ERRORS = obs_metrics.Counter(
+    "kft_operator_watch_errors_total",
+    "Watch transport failures (relist + backoff)")
+_O_WATCH_GONE = obs_metrics.Counter(
+    "kft_operator_watch_gone_total",
+    "410 Gone watch compactions (immediate relist, not an error)")
+_O_WQ_DEPTH = obs_metrics.Gauge(
+    "kft_workqueue_depth", "Keys ready for a worker")
+_O_WQ_DELAYED = obs_metrics.Gauge(
+    "kft_workqueue_delayed", "Keys waiting out a backoff timer")
+_O_WQ_PROCESSING = obs_metrics.Gauge(
+    "kft_workqueue_processing", "Keys currently held by workers")
+_O_WQ_QUARANTINED = obs_metrics.Gauge(
+    "kft_workqueue_quarantined",
+    "Poison keys parked at the backoff cap")
+_O_WQ_ADDS = obs_metrics.Counter(
+    "kft_workqueue_adds_total", "Enqueue attempts (deduplicated)")
+_O_WQ_GETS = obs_metrics.Counter(
+    "kft_workqueue_gets_total", "Keys handed to workers")
+_O_WQ_RETRIES = obs_metrics.Counter(
+    "kft_workqueue_retries_total", "Failure-scheduled retries")
 
 
 class KubectlClient:
@@ -182,6 +219,24 @@ class WatchController:
         self.watch_gone: Dict[str, int] = {}
         self.watch_errors: Dict[str, int] = {}
         self._watch_backoff = ExponentialBackoff(base=0.2, cap=30.0)
+        # Live /metrics bindings (render-time callbacks — tests build
+        # many controllers; the newest instance wins the binding).
+        queue = self.queue
+        for gauge, key in ((_O_WQ_DEPTH, "depth"),
+                           (_O_WQ_DELAYED, "delayed"),
+                           (_O_WQ_PROCESSING, "processing"),
+                           (_O_WQ_QUARANTINED, "quarantined"),
+                           (_O_WQ_ADDS, "adds"),
+                           (_O_WQ_GETS, "gets"),
+                           (_O_WQ_RETRIES, "retries")):
+            gauge.set_function(lambda q=queue, k=key: q.counts()[k])
+        _O_WATCH_ERRORS.set_function(
+            lambda c=self: sum(c.watch_errors.values()))
+        _O_WATCH_GONE.set_function(
+            lambda c=self: sum(c.watch_gone.values()))
+        _O_RECONCILES.set_function(lambda c=self: c._reconciles)
+        _O_FAILURES.set_function(
+            lambda c=self: c._reconcile_failures)
 
     # -- queue ------------------------------------------------------------
 
@@ -287,6 +342,14 @@ class WatchController:
 
     def _reconcile_one(self, key: Tuple[str, str], ns: str,
                        name: str) -> None:
+        t0 = time.monotonic()
+        try:
+            self._reconcile_one_inner(key, ns, name)
+        finally:
+            _O_LATENCY.observe(time.monotonic() - t0)
+
+    def _reconcile_one_inner(self, key: Tuple[str, str], ns: str,
+                             name: str) -> None:
         try:
             job = self.api.get(KIND, ns, name)
         except NotFound:
@@ -519,6 +582,10 @@ def main(argv=None) -> int:
         "--no-leader-election", action="store_true",
         help="watch mode without a coordination.k8s.io lease (single-"
              "replica deployments / clusters without the RBAC rule)")
+    parser.add_argument(
+        "--metrics-port", type=int, default=9400,
+        help="Prometheus /metrics (+ /tracez, /healthz) exposition "
+             "port, served from a stdlib thread; 0 disables")
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -531,6 +598,12 @@ def main(argv=None) -> int:
     if mode == "auto":
         mode = ("watch" if os.environ.get("KUBERNETES_SERVICE_HOST")
                 else "poll")
+    if args.metrics_port:
+        from kubeflow_tpu.obs.exposition import start_exposition_server
+
+        server = start_exposition_server(args.metrics_port)
+        logger.info("metrics exposition on :%d (/metrics, /tracez)",
+                    server.server_address[1])
     if mode == "watch":
         from kubeflow_tpu.operator.http_client import HttpApiClient
         from kubeflow_tpu.operator.leader import LeaderElector

@@ -350,6 +350,24 @@ class WorkQueue:
 
     # -- introspection ----------------------------------------------------
 
+    def counts(self) -> Dict[str, float]:
+        """Scalar snapshot for the metrics surface — the numeric subset
+        of :meth:`stats` without the per-key string maps (a /metrics
+        scrape every few seconds must not build a dict per failing
+        key)."""
+        with self._cond:
+            quarantined = sum(1 for v in self._failures.values()
+                              if v >= self.quarantine_after)
+            return {
+                "depth": len(self._ready),
+                "delayed": len(self._delayed_due),
+                "processing": len(self._processing),
+                "adds": self._adds,
+                "gets": self._gets,
+                "retries": self._retries,
+                "quarantined": quarantined,
+            }
+
     def latencies(self) -> List[float]:
         """Recent enqueue→dequeue latency samples (seconds)."""
         with self._cond:

@@ -755,6 +755,204 @@ def run_overload_benchmark(config: OverloadBenchConfig) -> Dict[str, Any]:
         shutil.rmtree(pathlib.Path(base).parent, ignore_errors=True)
 
 
+@dataclasses.dataclass
+class ObsOverheadConfig:
+    """`bench.py --obs-overhead`: what does leaving metrics + tracing
+    ON cost the serving hot path?
+
+    Two measurements compose the answer:
+
+    1. **Component cost** (primary, deterministic): a tight loop over
+       the EXACT obs operations one dispatched request performs —
+       context minting, the 5 span records (request trio + its share
+       of the batch span + the server http span), and the per-request
+       metric updates. Tight loops average 20k iterations, so this is
+       stable to a few percent even on a throttled box.
+    2. **Per-request service cost** (the denominator): a closed-loop
+       drive of the real micro-batcher + XLA model with obs ON,
+       per-request CPU seconds, median over rounds.
+
+    ``overhead_pct = component_cost / service_cost``. A raw off/on
+    wall-clock A/B is reported alongside (``ab_wall_overhead_pct``)
+    but NOT asserted on: on 2 shared, cgroup-throttled CPUs phase
+    throughput swings ±30-40% at every phase length we tried (50ms
+    throttle quanta + neighbor drift), which no pairing/median scheme
+    resolves to 2%; on a quiet box the two numbers agree."""
+
+    model: str = "resnet-test"
+    image_hw: int = 32
+    max_batch: int = 8
+    requests_per_phase: int = 480
+    concurrency: int = 4
+    rounds: int = 6  # paired off/on rounds for the secondary A/B
+    micro_iters: int = 20000
+    model_dtype: str = "float32"
+
+
+def _measure_obs_component_cost_us(iters: int) -> Dict[str, float]:
+    """Tight-loop cost of the obs work ONE dispatched request adds:
+    ctx mint + 5 span records + per-request metric updates (two
+    counters, two histogram observes). Deterministic to a few percent
+    — no XLA, no threads, no sockets."""
+    from kubeflow_tpu.obs import metrics as obs_metrics
+    from kubeflow_tpu.obs import tracing as obs_tracing
+
+    registry = obs_metrics.Registry()
+    counter_a = obs_metrics.Counter("kft_obsbench_a_total", "x",
+                                    ("model",), registry=registry)
+    counter_b = obs_metrics.Counter("kft_obsbench_b_total", "x",
+                                    ("model",), registry=registry)
+    hist_a = obs_metrics.Histogram("kft_obsbench_a_seconds", "x",
+                                   ("model",), registry=registry)
+    hist_b = obs_metrics.Histogram("kft_obsbench_b_seconds", "x",
+                                   ("model",), registry=registry)
+    ca, cb = counter_a.labels("m"), counter_b.labels("m")
+    ha, hb = hist_a.labels("m"), hist_b.labels("m")
+    tracer = obs_tracing.Tracer(capacity=4096)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        obs_tracing.new_context()
+    ctx_us = (time.perf_counter() - t0) / iters * 1e6
+
+    args = {"model": "m", "outcome": "ok", "request_id": "r",
+            "trace_id": "t" * 32, "batch": "batch-1-1"}
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        for name in ("queue_wait", "batch_assembly", "execute",
+                     "batch_execute", "http_request"):
+            tracer.record(name, "serving", 1.0, 0.001, args)
+    spans_us = (time.perf_counter() - t0) / iters * 1e6
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ca.inc()
+        cb.inc()
+        ha.observe(0.003)
+        hb.observe(0.003)
+    metrics_us = (time.perf_counter() - t0) / iters * 1e6
+    total = ctx_us + spans_us + metrics_us
+    return {"ctx_us": round(ctx_us, 2), "spans_us": round(spans_us, 2),
+            "metrics_us": round(metrics_us, 2),
+            "total_us": round(total, 2)}
+
+
+def run_obs_overhead_benchmark(
+        config: Optional[ObsOverheadConfig] = None) -> Dict[str, Any]:
+    from kubeflow_tpu.obs import metrics as obs_metrics
+    from kubeflow_tpu.obs import tracing as obs_tracing
+    from kubeflow_tpu.serving.manager import ServedModel
+
+    config = config or ObsOverheadConfig()
+    component = _measure_obs_component_cost_us(config.micro_iters)
+    base = _export(ServingBenchConfig(
+        model=config.model, image_hw=config.image_hw,
+        max_batch=config.max_batch, model_dtype=config.model_dtype))
+    model = ServedModel("obs-bench", base, max_batch=config.max_batch,
+                        batch_window_s=0.001)
+    model.poll_versions()
+    row = np.zeros((1, config.image_hw, config.image_hw, 3),
+                   np.float32)
+    per_thread = max(1, config.requests_per_phase // config.concurrency)
+
+    def drive(obs_on: bool):
+        """One closed-loop phase; returns (requests/sec wall,
+        CPU-seconds/request). CPU time (process-wide, all threads) is
+        the PRIMARY signal: the obs cost is pure CPU work, and
+        process_time is immune to the cgroup-throttle stalls that make
+        wall clock on a shared box swing ±30% (PERF.md)."""
+        errors: List[BaseException] = []
+
+        def worker():
+            try:
+                for _ in range(per_thread):
+                    ctx = (obs_tracing.new_context() if obs_on
+                           else None)
+                    model.submit({"images": row}, None, None, None,
+                                 obs_ctx=ctx).result(60)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(config.concurrency)]
+        n = per_thread * config.concurrency
+        c0 = time.process_time()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        cpu = time.process_time() - c0
+        if errors:
+            raise errors[0]
+        return n / wall, cpu / n
+
+    def set_obs(on: bool) -> None:
+        obs_metrics.set_enabled(on)
+        obs_tracing.TRACER.enabled = on
+
+    def phase(on: bool):
+        set_obs(on)
+        return drive(on)
+
+    was_metrics = obs_metrics.enabled()
+    was_tracing = obs_tracing.TRACER.enabled
+    rps_off: List[float] = []
+    rps_on: List[float] = []
+    cpu_on_us: List[float] = []
+    wall_ratios: List[float] = []
+    try:
+        drive(True)  # warmup: compile + page-in, discarded
+        for i in range(config.rounds):
+            # Alternate which mode runs first inside the pair.
+            if i % 2 == 0:
+                (off, _), (on, cpu_on) = phase(False), phase(True)
+            else:
+                (on, cpu_on), (off, _) = phase(True), phase(False)
+            rps_off.append(off)
+            rps_on.append(on)
+            cpu_on_us.append(cpu_on * 1e6)
+            wall_ratios.append(on / off)
+    finally:
+        obs_metrics.set_enabled(was_metrics)
+        obs_tracing.TRACER.enabled = was_tracing
+        model.stop()
+        import shutil
+
+        shutil.rmtree(pathlib.Path(base).parent, ignore_errors=True)
+
+    def median(xs: List[float]) -> float:
+        s = sorted(xs)
+        mid = len(s) // 2
+        return (s[mid] if len(s) % 2
+                else (s[mid - 1] + s[mid]) / 2.0)
+
+    # PRIMARY: deterministic per-request obs cost over the measured
+    # per-request service CPU. The raw A/B (wall) rides along for
+    # quiet boxes; see ObsOverheadConfig for why it is not the
+    # assertion basis on shared CI hardware.
+    request_cpu_us = median(cpu_on_us)
+    overhead_pct = component["total_us"] / request_cpu_us * 100.0
+    ab_wall_overhead_pct = (1.0 - median(wall_ratios)) * 100.0
+    return {
+        "model": config.model,
+        "requests_per_phase": per_thread * config.concurrency,
+        "concurrency": config.concurrency,
+        "rounds": config.rounds,
+        "obs_cost_per_request_us": component["total_us"],
+        "obs_cost_breakdown_us": component,
+        "request_cpu_us": round(request_cpu_us, 1),
+        "rps_obs_off": round(median(rps_off), 1),
+        "rps_obs_on": round(median(rps_on), 1),
+        "rps_off_rounds": [round(x, 1) for x in rps_off],
+        "rps_on_rounds": [round(x, 1) for x in rps_on],
+        "overhead_pct": round(overhead_pct, 2),
+        "ab_wall_overhead_pct": round(ab_wall_overhead_pct, 2),
+        "under_2pct": overhead_pct < 2.0,
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 

@@ -39,6 +39,7 @@ import time
 import urllib.error
 import urllib.request
 
+from kubeflow_tpu.obs.tracing import REQUEST_ID_HEADER
 from kubeflow_tpu.serving.overload import (
     DEADLINE_HEADER,
     RetryPolicy,
@@ -57,15 +58,21 @@ def _parse_retry_after(value) -> float | None:
 
 def post_json(url: str, payload: dict, *, timeout: float = 10.0,
               deadline_ms: float | None = None,
-              retry: RetryPolicy | None = None) -> dict:
+              retry: RetryPolicy | None = None,
+              request_id: str | None = None) -> dict:
     """POST JSON with the retry budget. Raises the last error when the
-    budget (attempts or deadline) is exhausted."""
+    budget (attempts or deadline) is exhausted. ``request_id`` rides
+    the ``X-Request-Id`` header (same id across retries — the access
+    logs then show every attempt as one request's story); omitted, the
+    proxy mints one and echoes it back in the response headers."""
     policy = retry or RetryPolicy()
     deadline = deadline_after(deadline_ms / 1000.0) if deadline_ms else None
     body = dict(payload)
     attempt = 0
     while True:
         headers = {"Content-Type": "application/json"}
+        if request_id:
+            headers[REQUEST_ID_HEADER] = request_id
         per_request_timeout = timeout
         if deadline is not None:
             remaining = deadline - time.monotonic()
@@ -99,11 +106,13 @@ def post_json(url: str, payload: dict, *, timeout: float = 10.0,
 
 def predict(server: str, model: str, instances, *, classify: bool = False,
             timeout: float = 10.0, deadline_ms: float | None = None,
-            retry: RetryPolicy | None = None) -> dict:
+            retry: RetryPolicy | None = None,
+            request_id: str | None = None) -> dict:
     verb = "classify" if classify else "predict"
     return post_json(f"http://{server}/model/{model}:{verb}",
                      {"instances": instances}, timeout=timeout,
-                     deadline_ms=deadline_ms, retry=retry)
+                     deadline_ms=deadline_ms, retry=retry,
+                     request_id=request_id)
 
 
 def grpc_web_predict(server: str, model: str, inputs: dict, *,
@@ -225,6 +234,10 @@ def main(argv=None) -> int:
                              "failures (429/502/503/transport); 1 = "
                              "no retries; backoff is exponential with "
                              "jitter, never past the deadline")
+    parser.add_argument("--request_id", default=None,
+                        help="X-Request-Id to tag the request with "
+                             "(grep it in access logs and /tracez "
+                             "spans; omitted, the proxy mints one)")
     args = parser.parse_args(argv)
     if args.retries < 1:
         parser.error("--retries must be >= 1 (1 = a single attempt)")
@@ -251,7 +264,8 @@ def main(argv=None) -> int:
         result = predict(args.server, args.model, instances,
                          classify=args.classify,
                          deadline_ms=args.deadline_ms,
-                         retry=RetryPolicy(max_attempts=args.retries))
+                         retry=RetryPolicy(max_attempts=args.retries),
+                         request_id=args.request_id)
     json.dump(result, sys.stdout, indent=2)
     print()
     return 0

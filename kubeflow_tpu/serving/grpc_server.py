@@ -42,6 +42,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from kubeflow_tpu.obs import tracing as obs_tracing
 from kubeflow_tpu.serving import wire
 from kubeflow_tpu.serving.manager import ModelManager
 from kubeflow_tpu.serving.overload import (
@@ -90,13 +91,16 @@ def _context_deadline(context) -> Optional[float]:
 
 
 def start_predict(manager: ModelManager, request_bytes: bytes,
-                  deadline: Optional[float] = None):
+                  deadline: Optional[float] = None,
+                  obs_ctx: Optional[obs_tracing.TraceContext] = None):
     """Shared Predict front half for both transports (native gRPC here,
     gRPC-Web in serving/server.py): decode → validate against the
     signature → submit to the micro-batcher. ``deadline`` (absolute
     monotonic) rides into the queue entry for admission control and
-    eviction. Returns (spec, loaded, future, output_filter); the
-    caller awaits the future in its own concurrency style."""
+    eviction; ``obs_ctx`` (from gRPC metadata / HTTP headers) tags the
+    manager's per-request spans. Returns (spec, loaded, future,
+    output_filter); the caller awaits the future in its own
+    concurrency style."""
     spec, inputs, output_filter = wire.decode_predict_request(
         request_bytes)
     model = manager.get_model(spec["name"])
@@ -121,7 +125,7 @@ def start_predict(manager: ModelManager, request_bytes: bytes,
     future = model.submit({input_name: inputs[input_name]},
                           spec["signature_name"] or None,
                           sig.method, spec["version"],
-                          deadline=deadline)
+                          deadline=deadline, obs_ctx=obs_ctx)
     return spec, loaded, future, output_filter
 
 
@@ -139,7 +143,8 @@ def finish_predict(spec, loaded, outputs, output_filter) -> bytes:
 
 
 def start_classify(manager: ModelManager, request_bytes: bytes,
-                   deadline: Optional[float] = None):
+                   deadline: Optional[float] = None,
+                   obs_ctx: Optional[obs_tracing.TraceContext] = None):
     """Shared Classify front half: decode tf.Examples → dense batch →
     submit. Returns (spec, loaded, future)."""
     spec, examples = wire.decode_classification_request(request_bytes)
@@ -154,7 +159,7 @@ def start_classify(manager: ModelManager, request_bytes: bytes,
     future = model.submit({input_name: batch},
                           spec["signature_name"] or None,
                           "classify", spec["version"],
-                          deadline=deadline)
+                          deadline=deadline, obs_ctx=obs_ctx)
     return spec, loaded, future
 
 
@@ -202,8 +207,14 @@ class PredictionService:
     def Predict(self, request: bytes, context) -> bytes:
         try:
             deadline = _context_deadline(context)
+            # The trace context rides gRPC invocation metadata
+            # (x-request-id / traceparent) — the proxy's binary hop
+            # and any instrumented native client send it.
+            obs_ctx = obs_tracing.from_grpc_metadata(
+                context.invocation_metadata())
             spec, loaded, future, output_filter = start_predict(
-                self._manager, request, deadline=deadline)
+                self._manager, request, deadline=deadline,
+                obs_ctx=obs_ctx)
             outputs = future.result(self._wait_s(deadline))
             return finish_predict(spec, loaded, outputs, output_filter)
         except Exception as e:  # noqa: BLE001 — mapped to grpc status
@@ -214,8 +225,11 @@ class PredictionService:
     def Classify(self, request: bytes, context) -> bytes:
         try:
             deadline = _context_deadline(context)
+            obs_ctx = obs_tracing.from_grpc_metadata(
+                context.invocation_metadata())
             spec, loaded, future = start_classify(self._manager, request,
-                                                  deadline=deadline)
+                                                  deadline=deadline,
+                                                  obs_ctx=obs_ctx)
             outputs = future.result(self._wait_s(deadline))
             return finish_classify(spec, loaded, outputs)
         except Exception as e:  # noqa: BLE001

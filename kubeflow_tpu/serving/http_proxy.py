@@ -63,9 +63,38 @@ import tornado.httpclient
 import tornado.ioloop
 import tornado.web
 
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs.exposition import (
+    ChromeTraceHandler,
+    MetricsHandler,
+    TraceContextHandlerMixin,
+    access_log_function,
+)
 from kubeflow_tpu.serving import overload
 
 logger = logging.getLogger(__name__)
+
+# The proxy's scrape surface (/metrics): per-upstream circuit-breaker
+# state + attempt/failure counters, and how often the binary hop fell
+# back to REST (a rising fallback rate means :9000 is flapping).
+_BREAKER_STATE_NUM = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+_P_BREAKER_STATE = obs_metrics.Gauge(
+    "kft_proxy_breaker_state",
+    "Circuit breaker state per upstream (0=closed, 1=half_open, "
+    "2=open)", ("upstream",))
+_P_UPSTREAM_REQUESTS = obs_metrics.Counter(
+    "kft_proxy_upstream_requests_total",
+    "Upstream attempts placed through each breaker", ("upstream",))
+_P_UPSTREAM_FAILURES = obs_metrics.Counter(
+    "kft_proxy_upstream_failures_total",
+    "Transport-level upstream failures (connect refused / hang "
+    "timeout)", ("upstream",))
+_P_FALLBACKS = obs_metrics.Counter(
+    "kft_proxy_grpc_fallback_total",
+    "Requests that fell back from the binary gRPC upstream to REST")
+_P_RETRY_AFTER = obs_metrics.Counter(
+    "kft_proxy_fast_fail_total",
+    "Requests fast-failed by an open circuit breaker", ("upstream",))
 
 
 class CircuitOpenError(Exception):
@@ -108,7 +137,16 @@ def decode_b64_if_needed(value: Any) -> Any:
     return value
 
 
-class ProxyHandler(tornado.web.RequestHandler):
+class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
+    # The proxy is the tracing EDGE: the mixin's prepare adopts the
+    # client's context (X-Request-Id and/or traceparent) or mints a
+    # fresh one, and echoes the id back; _rest_fetch/_grpc_infer then
+    # forward it on every upstream hop (REST headers, gRPC metadata)
+    # so one grep for the id walks proxy access log → server span →
+    # manager batch span. No proxy-side span (_obs_span None): the
+    # access log already carries the proxy's latency, and the
+    # interesting spans live where the work happens.
+
     @property
     def rpc_address(self) -> str:
         addr = self.application.settings["rpc_address"]
@@ -147,15 +185,24 @@ class ProxyHandler(tornado.web.RequestHandler):
         BackendTimeoutError / BackendDownError."""
         breaker = self.rest_breaker
         if not breaker.allow():
+            _P_RETRY_AFTER.labels("rest").inc()
             raise CircuitOpenError(breaker.retry_after_s())
         timeout = self.rpc_timeout
         remaining = overload.remaining_s(deadline)
         if remaining is not None:
             timeout = min(timeout, max(0.001, remaining))
+        # Trace propagation on every REST hop (infer AND metadata):
+        # the backend's spans must join this request's id.
+        headers = dict(kwargs.pop("headers", None) or {})
+        ctx = getattr(self, "_obs_ctx", None)
+        if ctx is not None:
+            headers.update(ctx.headers())
+        _P_UPSTREAM_REQUESTS.labels("rest").inc()
         client = tornado.httpclient.AsyncHTTPClient()
         try:
             response = await client.fetch(url, request_timeout=timeout,
-                                          raise_error=False, **kwargs)
+                                          raise_error=False,
+                                          headers=headers, **kwargs)
             # 599 = tornado's transport-failure code (never sent by a
             # server); transport failures can ALSO surface as raised
             # exceptions depending on tornado version/failure mode —
@@ -173,6 +220,7 @@ class ProxyHandler(tornado.web.RequestHandler):
         if not timed_out or timeout >= min(self.rpc_timeout,
                                            BREAKER_TIMEOUT_FLOOR_S):
             breaker.record_failure()
+            _P_UPSTREAM_FAILURES.labels("rest").inc()
         if timed_out:
             raise BackendTimeoutError(
                 f"model server timed out after {timeout:.1f}s")
@@ -182,14 +230,17 @@ class ProxyHandler(tornado.web.RequestHandler):
         """Uniform JSON mapping for the three upstream failure shapes
         (same body shape as every other proxy error path)."""
         if isinstance(e, CircuitOpenError):
+            self._obs_outcome = "breaker_open"
             self.set_header("Retry-After",
                             overload.retry_after_header(e.retry_after_s))
             self.write_json({"error": str(e),
                              "code": "RESOURCE_EXHAUSTED"}, 503)
         elif isinstance(e, BackendTimeoutError):
+            self._obs_outcome = "expired"
             self.write_json({"error": str(e),
                              "code": "DEADLINE_EXCEEDED"}, 504)
         else:
+            self._obs_outcome = "backend_down"
             self.write_json({"error": f"model server unreachable: {e}"},
                             502)
 
@@ -264,6 +315,9 @@ class InferProxyHandler(ProxyHandler):
             # Open circuit on the binary wire only: the REST hop (its
             # own breaker) may still be healthy — fall through rather
             # than failing traffic a live REST backend would serve.
+            # This is a FALLBACK, not a fast-fail: the client still
+            # gets served, so only the fallback counter moves.
+            _P_FALLBACKS.inc()
             return False
         from kubeflow_tpu.serving import wire
 
@@ -305,8 +359,11 @@ class InferProxyHandler(ProxyHandler):
             # context.time_remaining() rebuilds it — end-to-end
             # propagation with no shared clock.
             timeout = min(timeout, max(0.001, remaining))
+        _P_UPSTREAM_REQUESTS.labels("grpc").inc()
         try:
-            response = await call(request, timeout=timeout)
+            response = await call(
+                request, timeout=timeout,
+                metadata=self._obs_ctx.grpc_metadata())
         except grpc.aio.AioRpcError as e:
             if e.code() == grpc.StatusCode.UNAVAILABLE:
                 # :9000 unreachable (older server image, firewalled
@@ -316,6 +373,8 @@ class InferProxyHandler(ProxyHandler):
                 # serve fine. If the server is truly down, the REST hop
                 # reports its own 502/503 with the accurate story.
                 self.grpc_breaker.record_failure()
+                _P_UPSTREAM_FAILURES.labels("grpc").inc()
+                _P_FALLBACKS.inc()
                 logger.warning(
                     "gRPC upstream unavailable (%s); falling back to "
                     "REST for this request", e.details())
@@ -327,6 +386,7 @@ class InferProxyHandler(ProxyHandler):
                 if timeout >= min(self.rpc_timeout,
                                   BREAKER_TIMEOUT_FLOOR_S):
                     self.grpc_breaker.record_failure()
+                    _P_UPSTREAM_FAILURES.labels("grpc").inc()
             else:  # an application-level status proves it's alive
                 self.grpc_breaker.record_success()
             code = {
@@ -366,6 +426,7 @@ class InferProxyHandler(ProxyHandler):
 
     async def _infer(self, name: str, version: Optional[str],
                      verb: str) -> None:
+        self._obs_model = name
         try:
             body = json.loads(self.request.body or b"{}")
         except json.JSONDecodeError:
@@ -384,6 +445,7 @@ class InferProxyHandler(ProxyHandler):
             # The budget is already gone: answer in microseconds
             # instead of burning an upstream round trip on a response
             # nobody is waiting for.
+            self._obs_outcome = "expired"
             return self.write_json(
                 {"error": "deadline expired before proxying",
                  "code": "DEADLINE_EXCEEDED"}, 504)
@@ -461,6 +523,28 @@ class InferProxyHandler(ProxyHandler):
         await self._infer(name, version, verb)
 
 
+class ProxyHealthHandler(ProxyHandler):
+    """Proxy /healthz — the SAME schema as the model server's
+    (serving/server.py HealthHandler): ``status`` + ``saturation`` +
+    ``breakers``. The proxy has no batcher, so saturation is empty;
+    what it DOES know is each upstream's circuit-breaker state — a
+    dead :9000 or REST port shows up here before clients see 503s."""
+
+    def get(self):
+        breakers = {}
+        for upstream, breaker in (("rest", self.rest_breaker),
+                                  ("grpc", self.grpc_breaker)):
+            breakers[upstream] = {
+                "state": breaker.state,
+                "retry_after_s": round(breaker.retry_after_s(), 3),
+            }
+        status = ("ok" if all(b["state"] != "open"
+                              for b in breakers.values())
+                  else "degraded")
+        self.write_json({"status": status, "saturation": {},
+                         "breakers": breakers})
+
+
 class MetadataProxyHandler(ProxyHandler):
     async def get(self, name: str):
         try:
@@ -508,19 +592,31 @@ def make_app(rpc_address: str, rpc_timeout: float = 10.0,
              grpc_address: Optional[str] = None,
              breaker_failures: int = 5,
              breaker_reset_s: float = 5.0) -> tornado.web.Application:
+    # One breaker per upstream: the binary :9000 wire and the REST
+    # port fail independently (firewalled port vs dead pod).
+    rest_breaker = overload.CircuitBreaker(breaker_failures,
+                                           breaker_reset_s)
+    grpc_breaker = overload.CircuitBreaker(breaker_failures,
+                                           breaker_reset_s)
+    # Live breaker state on /metrics (render-time callback — no write
+    # per transition; two make_app calls rebind to the newest app).
+    for upstream, breaker in (("rest", rest_breaker),
+                              ("grpc", grpc_breaker)):
+        _P_BREAKER_STATE.labels(upstream).set_function(
+            lambda b=breaker: _BREAKER_STATE_NUM.get(b.state, -1.0))
     return tornado.web.Application([
         # Reference route grammar (server.py:270-283).
         (r"/model/([^/:]+)(?:/version/(\d+))?:(predict|classify|generate)",
          InferProxyHandler),
+        (r"/healthz", ProxyHealthHandler),
+        (r"/metrics", MetricsHandler),
+        (r"/tracez", ChromeTraceHandler),
         (r"/model/([^/:]+)", MetadataProxyHandler),
     ], rpc_address=rpc_address, rpc_timeout=rpc_timeout,
        grpc_address=grpc_address, metadata_cache={},
-       # One breaker per upstream: the binary :9000 wire and the REST
-       # port fail independently (firewalled port vs dead pod).
-       rest_breaker=overload.CircuitBreaker(breaker_failures,
-                                            breaker_reset_s),
-       grpc_breaker=overload.CircuitBreaker(breaker_failures,
-                                            breaker_reset_s))
+       log_function=access_log_function("http-proxy"),
+       rest_breaker=rest_breaker,
+       grpc_breaker=grpc_breaker)
 
 
 def main(argv=None) -> int:

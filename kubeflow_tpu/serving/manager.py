@@ -50,6 +50,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs.tracing import TRACER
 from kubeflow_tpu.serving import _native, remote
 from kubeflow_tpu.serving.model import LoadedModel, load_version
 from kubeflow_tpu.serving.overload import (
@@ -75,6 +77,40 @@ LOAD_ON_DEMAND_WAIT_S = 300.0
 #: requests that are dispatched AND miss their deadline — all cost, no
 #: goodput; the headroom absorbs the jitter instead.
 ADMISSION_SAFETY = 0.8
+
+# Prometheus families for the batcher's overload/throughput signals —
+# the same numbers batch_stats() reports, now scrapeable at /metrics
+# (serving/server.py). One family per signal, labeled by model; the
+# per-model children are bound once in ServedModel.__init__ so the
+# request path pays a float-add, not a dict lookup.
+_M_SHED = obs_metrics.Counter(
+    "kft_serving_shed_total",
+    "Requests shed at admission (queue full or estimated wait over "
+    "the remaining deadline budget)", ("model",))
+_M_EXPIRED = obs_metrics.Counter(
+    "kft_serving_expired_total",
+    "Requests whose deadline lapsed before dispatch (never executed)",
+    ("model",))
+_M_BATCHES = obs_metrics.Counter(
+    "kft_serving_batches_total",
+    "XLA executions issued by the micro-batcher", ("model",))
+_M_ROWS = obs_metrics.Counter(
+    "kft_serving_batch_rows_total",
+    "Request rows carried by micro-batcher executions", ("model",))
+_M_QUEUE_DEPTH = obs_metrics.Gauge(
+    "kft_serving_queue_depth",
+    "Requests enqueued and not yet popped by the batcher", ("model",))
+_M_EST_LATENCY = obs_metrics.Gauge(
+    "kft_serving_est_batch_latency_seconds",
+    "Rolling batch-dispatch latency estimate (admission control's "
+    "queue-wait crystal ball)", ("model",))
+_M_QUEUE_WAIT = obs_metrics.Histogram(
+    "kft_serving_queue_wait_seconds",
+    "Time a dispatched request spent queued (enqueue to batcher pop)",
+    ("model",))
+_M_DISPATCH = obs_metrics.Histogram(
+    "kft_serving_dispatch_seconds",
+    "Wall time of one batched model execution group", ("model",))
 
 
 def _local_versions(base_path: str) -> List[int]:
@@ -140,6 +176,19 @@ class ServedModel:
         # queue-wait estimate. Seeded from warmup timing at model load
         # (see _seed_latency) so the very first burst is judged too.
         self._latency = LatencyEstimator()
+        # Bound metric children (kft_serving_* families above). Two
+        # ServedModels with one name (tests) share children — last
+        # set_function wins, which is the live instance.
+        self._m_shed = _M_SHED.labels(name)
+        self._m_expired = _M_EXPIRED.labels(name)
+        self._m_batches = _M_BATCHES.labels(name)
+        self._m_rows = _M_ROWS.labels(name)
+        self._m_queue_wait = _M_QUEUE_WAIT.labels(name)
+        self._m_dispatch = _M_DISPATCH.labels(name)
+        self._g_depth = _M_QUEUE_DEPTH.labels(name)
+        self._g_depth.set_function(self._queue.size)
+        self._g_est = _M_EST_LATENCY.labels(name)
+        self._g_est.set_function(self._latency.estimate_s)
 
     # -- version lifecycle ------------------------------------------------
 
@@ -342,6 +391,12 @@ class ServedModel:
         with self._worker_lock:
             self._closed = True
             worker, self._worker = self._worker, None
+        # Unbind the registry-lifetime gauge callbacks: they hold this
+        # instance (and its loaded device buffers) otherwise. The
+        # owner check means a stopped instance never clobbers a newer
+        # same-named model's live binding.
+        self._g_depth.clear_function(self._queue)
+        self._g_est.clear_function(self._latency)
         self._queue.close()
         if worker is not None:
             worker.join(timeout=5)
@@ -364,11 +419,19 @@ class ServedModel:
         depth = self._queue.size()
         return self._latency.estimate_s() * (depth / self.max_batch + 1.0)
 
+    def _span_args(self, obs_ctx, outcome: str, **extra):
+        args = {"model": self.name, "outcome": outcome, **extra}
+        if obs_ctx is not None:
+            args["request_id"] = obs_ctx.request_id
+            args["trace_id"] = obs_ctx.trace_id
+        return args
+
     def submit(self, inputs: Dict[str, np.ndarray],
                signature_name: Optional[str],
                method: Optional[str],
                version: Optional[int], *,
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None,
+               obs_ctx=None) -> Future:
         """Enqueue one request for micro-batching; resolves to the
         output dict for exactly this request's rows.
 
@@ -377,14 +440,24 @@ class ServedModel:
         is already smaller than the estimated queue wait is shed NOW
         (future carries OverloadedError with a Retry-After hint)
         rather than queued to expire; an already-expired request gets
-        DeadlineExceededError without touching the queue."""
+        DeadlineExceededError without touching the queue.
+
+        ``obs_ctx`` is the request's :class:`TraceContext` (from the
+        transport's headers/metadata): its ids tag the per-request
+        spans so a request_id greps from proxy access log to the XLA
+        dispatch that served it."""
         self.start_batcher()
         future: Future = Future()
+        t_enqueue = time.monotonic()
         if deadline is not None:
-            remaining = deadline - time.monotonic()
+            remaining = deadline - t_enqueue
             if remaining <= 0:
                 with self._pending_lock:
                     self._stat_expired += 1
+                self._m_expired.inc()
+                if TRACER.enabled:
+                    TRACER.record("request", "serving", t_enqueue, 0.0,
+                                  self._span_args(obs_ctx, "expired"))
                 future.set_exception(DeadlineExceededError(
                     "deadline expired before enqueue"))
                 return future
@@ -392,6 +465,10 @@ class ServedModel:
             if est_wait > remaining * ADMISSION_SAFETY:
                 with self._pending_lock:
                     self._stat_shed += 1
+                self._m_shed.inc()
+                if TRACER.enabled:
+                    TRACER.record("request", "serving", t_enqueue, 0.0,
+                                  self._span_args(obs_ctx, "shed"))
                 future.set_exception(OverloadedError(
                     f"server overloaded: estimated queue wait "
                     f"{est_wait * 1e3:.0f}ms exceeds remaining deadline "
@@ -401,7 +478,8 @@ class ServedModel:
         request_id = next(self._ids)
         with self._pending_lock:
             self._pending[request_id] = (inputs, signature_name, method,
-                                         version, future, deadline)
+                                         version, future, deadline,
+                                         (obs_ctx, t_enqueue))
         try:
             pushed = self._queue.push(request_id)
             error: Optional[Exception] = None
@@ -421,6 +499,13 @@ class ServedModel:
                 if owned and isinstance(error, OverloadedError):
                     self._stat_shed += 1
             if owned:
+                if isinstance(error, OverloadedError):
+                    self._m_shed.inc()
+                    if TRACER.enabled:
+                        TRACER.record(
+                            "request", "serving", t_enqueue,
+                            time.monotonic() - t_enqueue,
+                            self._span_args(obs_ctx, "shed"))
                 future.set_exception(error)
         return future
 
@@ -440,6 +525,7 @@ class ServedModel:
                             if r is not None]
             if not requests:
                 continue
+            t_pop = time.monotonic()
             # Deadline eviction: entries whose deadline lapsed while
             # queued are failed HERE, before grouping — an abandoned
             # request must never burn an XLA dispatch. This is the
@@ -449,7 +535,7 @@ class ServedModel:
             # request dispatched with less remaining budget than the
             # dispatch itself takes completes just after its caller
             # hung up — all cost, no goodput.
-            cutoff = time.monotonic() + 0.5 * self._latency.estimate_s()
+            cutoff = t_pop + 0.5 * self._latency.estimate_s()
             live: List[Any] = []
             expired: List[Any] = []
             for req in requests:  # single pass: tuples hold ndarrays,
@@ -460,7 +546,14 @@ class ServedModel:
                 requests = live
                 with self._pending_lock:
                     self._stat_expired += len(expired)
+                self._m_expired.inc(len(expired))
                 for req in expired:
+                    if TRACER.enabled:
+                        ctx, t_enq = req[6]
+                        TRACER.record(
+                            "queue_wait", "serving", t_enq,
+                            t_pop - t_enq,
+                            self._span_args(ctx, "expired"))
                     req[4].set_exception(DeadlineExceededError(
                         "deadline expired while queued; request was "
                         "never dispatched"))
@@ -473,7 +566,7 @@ class ServedModel:
                 key = (req[1], req[2], req[3])
                 groups.setdefault(key, []).append(req)
             for (sig_name, method, version), group in groups.items():
-                self._run_group(sig_name, method, version, group)
+                self._run_group(sig_name, method, version, group, t_pop)
 
     def batch_stats(self, reset: bool = False) -> Dict[str, float]:
         """Batcher fill statistics since start (or last reset): number
@@ -496,15 +589,18 @@ class ServedModel:
                 "est_batch_latency_ms": round(
                     self._latency.estimate_s() * 1e3, 3)}
 
-    def _run_group(self, sig_name, method, version, group) -> None:
+    def _run_group(self, sig_name, method, version, group,
+                   t_pop: Optional[float] = None) -> None:
         futures = [g[4] for g in group]
         t0 = time.monotonic()
+        t_pop = t0 if t_pop is None else t_pop
         try:
             model = self.get(version)
             sig = model.signature(sig_name)
             input_name = next(iter(sig.inputs))
             arrays = [np.asarray(g[0][input_name]) for g in group]
             counts = [a.shape[0] for a in arrays]
+            t_exec = time.monotonic()
             if (method or getattr(sig, "method", None)) == "generate":
                 out = self._run_generate_group(model, sig_name, method,
                                                input_name, arrays, counts)
@@ -515,12 +611,15 @@ class ServedModel:
                 rows = int(batch.shape[0])
                 self._count_executions(rows)
                 out = model.run({input_name: batch}, sig_name, method)
+            t_end = time.monotonic()
             # Feed the admission controller: per-EXECUTION latency
             # (a group whose rows exceed max_batch ran several XLA
             # executions inside model.run — dividing keeps the
             # queue-wait arithmetic in estimated_wait_s consistent).
-            self._latency.observe((time.monotonic() - t0)
+            self._latency.observe((t_end - t0)
                                   / max(1, -(-rows // self.max_batch)))
+            self._m_dispatch.observe(t_end - t_exec)
+            self._record_group_spans(group, t_pop, t_exec, t_end, rows)
             offset = 0
             for future, count in zip(futures, counts):
                 sliced = {k: v[offset:offset + count] for k, v in out.items()}
@@ -528,9 +627,39 @@ class ServedModel:
                 if not future.done():  # caller may have abandoned it
                     future.set_result(sliced)
         except BaseException as e:  # noqa: BLE001 — fan the error out
+            if TRACER.enabled:
+                for g in group:
+                    ctx, t_enq = g[6]
+                    TRACER.record("request", "serving", t_enq,
+                                  time.monotonic() - t_enq,
+                                  self._span_args(ctx, "error"))
             for future in futures:
                 if not future.done():
                     future.set_exception(e)
+
+    def _record_group_spans(self, group, t_pop: float, t_exec: float,
+                            t_end: float, rows: int) -> None:
+        """The per-request span trio (queue_wait → batch_assembly →
+        execute) + the ONE coalesced batch_execute span they all link
+        to via ``args.batch``. Queue-wait histogram samples ride along
+        (same timestamps, always on — histograms are cheap)."""
+        for g in group:
+            self._m_queue_wait.observe(max(0.0, t_pop - g[6][1]))
+        if not TRACER.enabled:
+            return
+        batch = TRACER.next_batch_id()
+        TRACER.record("batch_execute", "serving", t_exec, t_end - t_exec,
+                      {"model": self.name, "batch": batch, "rows": rows,
+                       "requests": len(group)})
+        for g in group:
+            ctx, t_enq = g[6]
+            args = self._span_args(ctx, "ok", batch=batch)
+            TRACER.record("queue_wait", "serving", t_enq,
+                          t_pop - t_enq, args)
+            TRACER.record("batch_assembly", "serving", t_pop,
+                          t_exec - t_pop, args)
+            TRACER.record("execute", "serving", t_exec,
+                          t_end - t_exec, args)
 
     def _run_generate_group(self, model, sig_name, method, input_name,
                             arrays, counts):
@@ -569,6 +698,8 @@ class ServedModel:
         with self._pending_lock:
             self._stat_batches += -(-rows // self.max_batch)
             self._stat_rows += rows
+        self._m_batches.inc(-(-rows // self.max_batch))
+        self._m_rows.inc(rows)
 
 
 class ModelManager:

@@ -52,6 +52,12 @@ import numpy as np
 import tornado.ioloop
 import tornado.web
 
+from kubeflow_tpu.obs.exposition import (
+    ChromeTraceHandler,
+    MetricsHandler,
+    TraceContextHandlerMixin,
+    access_log_function,
+)
 from kubeflow_tpu.serving import overload
 from kubeflow_tpu.serving.manager import ModelManager
 
@@ -72,7 +78,12 @@ def _json_default(obj: Any):
     raise TypeError(f"not JSON serializable: {type(obj)}")
 
 
-class BaseHandler(tornado.web.RequestHandler):
+class BaseHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
+    # Context adoption/echo + the opt-in per-request span live in the
+    # shared mixin (obs/exposition.py); infer-style handlers set
+    # _obs_span, health/metrics polls stay out of the ring buffer.
+    _obs_cat = "serving"
+
     @property
     def manager(self) -> ModelManager:
         return self.application.settings["manager"]
@@ -96,15 +107,23 @@ class HealthHandler(BaseHandler):
     queue depth, shed/expired counters, the rolling batch-latency
     estimate — so kubelet probes and the dashboard see overload
     building BEFORE requests start failing (a pod at 90% queue is the
-    one the autoscaler should act on, not the one already 503ing)."""
+    one the autoscaler should act on, not the one already 503ing).
+
+    Schema contract (shared with the proxy's /healthz): ``status``,
+    ``saturation`` (per-model batcher signals; empty on the proxy) and
+    ``breakers`` (per-upstream circuit-breaker state; empty here — the
+    server has no upstreams). ``models`` is kept as a legacy alias of
+    ``saturation``."""
 
     def get(self):
         if not self.manager.ready():
-            return self.write_json({"status": "loading"}, 503)
-        self.write_json({"status": "ok", "models": {
-            name: model.batch_stats()
-            for name, model in self.manager.models.items()
-        }})
+            return self.write_json(
+                {"status": "loading", "saturation": {}, "breakers": {}},
+                503)
+        saturation = {name: model.batch_stats()
+                      for name, model in self.manager.models.items()}
+        self.write_json({"status": "ok", "saturation": saturation,
+                         "breakers": {}, "models": saturation})
 
 
 class LiveHandler(BaseHandler):
@@ -168,7 +187,10 @@ async def _await_future(future, wait_s: float):
 
 
 class InferHandler(BaseHandler):
+    _obs_span = "http_request"
+
     async def post(self, name: str, version: Optional[str], verb: str):
+        self._obs_model = name
         try:
             model = self.manager.get_model(name)
             body = json.loads(self.request.body or b"{}")
@@ -207,7 +229,8 @@ class InferHandler(BaseHandler):
             input_name = next(iter(sig.inputs))
             batch = _instances_to_batch(instances, input_name)
             future = model.submit({input_name: batch}, sig_name, verb,
-                                  want, deadline=deadline)
+                                  want, deadline=deadline,
+                                  obs_ctx=self._obs_ctx)
             # Never hold the connection past the budget.
             result = await _await_future(
                 future, overload.clamp_wait_s(deadline,
@@ -223,11 +246,13 @@ class InferHandler(BaseHandler):
             # The request's own budget lapsed: 504, and the structured
             # code tells retrying gateways NOT to (the deadline is
             # gone whoever retries).
+            self._obs_outcome = "expired"
             self.write_json({"error": str(e),
                              "code": "DEADLINE_EXCEEDED"}, 504)
         except overload.OverloadedError as e:
             # Shed by admission control / queue cap: 503 with the
             # server's estimate of when capacity frees up.
+            self._obs_outcome = "shed"
             self.set_header("Retry-After",
                             overload.retry_after_header(e.retry_after_s))
             self.write_json({"error": str(e),
@@ -238,6 +263,7 @@ class InferHandler(BaseHandler):
             # clients): the work may still complete, but this caller
             # is gone — 504 either way. (Both classes: they are only
             # unified from Python 3.11.)
+            self._obs_outcome = "expired"
             self.write_json({"error": str(e) or "request timed out",
                              "code": "DEADLINE_EXCEEDED"}, 504)
         except RuntimeError as e:
@@ -287,6 +313,8 @@ class GrpcWebPredictHandler(BaseHandler):
     transport (serving/grpc_server.py); only the await style differs.
     """
 
+    _obs_span = "grpc_web_request"
+
     async def post(self, method: str):
         import base64
         import concurrent.futures
@@ -326,13 +354,13 @@ class GrpcWebPredictHandler(BaseHandler):
                 spec, loaded, future, output_filter = (
                     await loop.run_in_executor(
                         None, svc.start_predict, self.manager, data[0],
-                        deadline))
+                        deadline, self._obs_ctx))
                 finish = lambda out: svc.finish_predict(  # noqa: E731
                     spec, loaded, out, output_filter)
             elif method == "Classify":
                 spec, loaded, future = await loop.run_in_executor(
                     None, svc.start_classify, self.manager, data[0],
-                    deadline)
+                    deadline, self._obs_ctx)
                 finish = lambda out: svc.finish_classify(  # noqa: E731
                     spec, loaded, out)
             else:  # GetModelMetadata (route regex restricts the set)
@@ -384,6 +412,8 @@ def make_app(manager: ModelManager) -> tornado.web.Application:
     return tornado.web.Application([
         (r"/healthz", HealthHandler),
         (r"/livez", LiveHandler),
+        (r"/metrics", MetricsHandler),
+        (r"/tracez", ChromeTraceHandler),
         (r"/v1/models/([^/:]+)", StatusHandler),
         (r"/v1/models/([^/:]+)/metadata", MetadataHandler),
         (r"/v1/models/([^/:]+)(?:/versions/(\d+))?:(predict|classify|generate)",
@@ -391,7 +421,8 @@ def make_app(manager: ModelManager) -> tornado.web.Application:
         (r"/tensorflow\.serving\.PredictionService/"
          r"(Predict|Classify|GetModelMetadata)",
          GrpcWebPredictHandler),
-    ], manager=manager)
+    ], manager=manager,
+       log_function=access_log_function("model-server"))
 
 
 def load_model_config(path: str):

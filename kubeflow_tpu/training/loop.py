@@ -43,11 +43,27 @@ from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import jax
 
+from kubeflow_tpu.obs import metrics as obs_metrics
 from kubeflow_tpu.training.checkpoint import CheckpointConfig, Checkpointer
 from kubeflow_tpu.training.launcher import DRAIN_EXIT_CODE  # noqa: F401
 from kubeflow_tpu.utils.metrics import MetricsLogger
 
 logger = logging.getLogger(__name__)
+
+# Scrapeable training signals alongside the JSONL MetricsLogger: the
+# same step-time/throughput numbers the log line carries, but live on
+# /metrics (trainers that embed a serving surface, or a sidecar
+# running obs.exposition.start_exposition_server). Observed once per
+# log window — the step itself stays untimed (JAX dispatch is async;
+# per-step wall clocks would fence the device).
+_T_STEP_SECONDS = obs_metrics.Histogram(
+    "kft_training_step_seconds",
+    "Mean per-step wall time over each log window")
+_T_STEPS_PER_SEC = obs_metrics.Gauge(
+    "kft_training_steps_per_sec",
+    "Training throughput over the last log window")
+_T_STEPS = obs_metrics.Counter(
+    "kft_training_steps_total", "Optimizer steps completed")
 
 
 class DrainInterrupt(Exception):
@@ -188,6 +204,9 @@ def fit(
                 host_metrics = {k: float(v) for k, v in metrics.items()}
                 elapsed = time.perf_counter() - window_start
                 host_metrics["steps_per_sec"] = window_steps / max(elapsed, 1e-9)
+                _T_STEP_SECONDS.observe(elapsed / max(window_steps, 1))
+                _T_STEPS_PER_SEC.set(host_metrics["steps_per_sec"])
+                _T_STEPS.inc(window_steps)
                 metrics_logger.log(next_step, host_metrics)
                 logger.info("step %d: %s", next_step, host_metrics)
                 for hook in hooks or ():

@@ -20,6 +20,11 @@ The reference's metrics surface was statsd sidecars flushing every 1s
 (``launcher.py:58-62``). Kept both shapes: a dependency-free statsd
 client for the gateway/serving path and a JSONL writer for training
 metrics (the artifact CI copies next to junit XML).
+
+Scrapeable metrics live in :mod:`kubeflow_tpu.obs.metrics` (r9): the
+training loop publishes its step time/throughput there too, so this
+module is the durable-artifact path (JSONL files, statsd forwarding)
+while ``/metrics`` endpoints serve the live Prometheus view.
 """
 
 from __future__ import annotations

@@ -308,6 +308,95 @@ def check_serving_timeout_discipline() -> list:
     return errors
 
 
+def check_service_print_discipline() -> list:
+    """Services speak structured channels, not stdout (ISSUE 4): under
+    ``kubeflow_tpu/{serving,operator}/`` forbid ``print(`` except in
+    benchmark modules, ``if __name__ == "__main__"`` blocks, and CLI
+    ``main()`` entrypoints. A stray print in the request path is
+    invisible to every collector (no level, no logger name, no JSON)
+    and blocks the event loop on a full stdout pipe; the sanctioned
+    channels are ``logging``, the access log (obs/exposition.py) and
+    metrics/spans (obs/)."""
+    errors = []
+    for sub in ("serving", "operator"):
+        for f in sorted((REPO / "kubeflow_tpu" / sub).glob("*.py")):
+            if f.name == "benchmark.py":
+                continue
+            tree = ast.parse(f.read_text(), str(f))
+            allowed_spans = []
+            for node in ast.walk(tree):
+                # `if __name__ == "__main__":` blocks.
+                if (isinstance(node, ast.If)
+                        and isinstance(node.test, ast.Compare)
+                        and isinstance(node.test.left, ast.Name)
+                        and node.test.left.id == "__name__"):
+                    allowed_spans.append((node.lineno, node.end_lineno))
+                # CLI entrypoint bodies (`def main(...)`).
+                elif (isinstance(node, ast.FunctionDef)
+                      and node.name == "main"):
+                    allowed_spans.append((node.lineno, node.end_lineno))
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "print"
+                        and not any(lo <= node.lineno <= hi
+                                    for lo, hi in allowed_spans)):
+                    errors.append(
+                        f"service-print: {f.relative_to(REPO)}:"
+                        f"{node.lineno}: print() in a service module — "
+                        f"use logging / the structured access log "
+                        f"(kubeflow_tpu/obs/)")
+    return errors
+
+
+# Metric constructor names whose labelnames argument the cardinality
+# check inspects, and label names that imply one time series per
+# request/object — the classic TSDB cardinality explosion. Kept in
+# sync with kubeflow_tpu/obs/metrics.py FORBIDDEN_LABELS (which
+# enforces the same at runtime).
+METRIC_CONSTRUCTORS = {"Counter", "Gauge", "Histogram"}
+FORBIDDEN_METRIC_LABELS = {"request_id", "trace_id", "span_id",
+                           "batch_id", "pod_uid", "uid"}
+
+
+def check_metric_label_discipline() -> list:
+    """No per-request label values on metrics (ISSUE 4): scan every
+    metric construction (Counter/Gauge/Histogram) for forbidden
+    high-cardinality label names, and every ``.labels(...)`` call for
+    forbidden keyword labels. High-cardinality request data belongs in
+    spans and access logs; a label value per request id is one time
+    series per request."""
+    errors = []
+    for f in iter_py_files():
+        tree = ast.parse(f.read_text(), str(f))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (func.id if isinstance(func, ast.Name)
+                    else func.attr if isinstance(func, ast.Attribute)
+                    else None)
+            bad = set()
+            if name in METRIC_CONSTRUCTORS:
+                for arg in list(node.args) + [
+                        k.value for k in node.keywords
+                        if k.arg == "labelnames"]:
+                    if isinstance(arg, (ast.Tuple, ast.List)):
+                        bad |= {e.value for e in arg.elts
+                                if isinstance(e, ast.Constant)
+                                and e.value in FORBIDDEN_METRIC_LABELS}
+            elif name == "labels":
+                bad |= {k.arg for k in node.keywords
+                        if k.arg in FORBIDDEN_METRIC_LABELS}
+            for label in sorted(bad):
+                errors.append(
+                    f"metric-label: {f.relative_to(REPO)}:"
+                    f"{node.lineno}: label {label!r} is per-request "
+                    f"cardinality — record it in a span or access "
+                    f"log, never a metric label")
+    return errors
+
+
 def check_unused_imports() -> list:
     errors = []
     for f in iter_py_files():
@@ -370,6 +459,8 @@ def main() -> int:
     for check in (check_syntax, check_imports_all_modules, check_cli_boots,
                   check_unused_imports, check_operator_wait_discipline,
                   check_serving_timeout_discipline,
+                  check_service_print_discipline,
+                  check_metric_label_discipline,
                   check_boilerplate, check_license_file):
         found = check()
         print(f"{check.__name__}: {'ok' if not found else f'{len(found)} errors'}")

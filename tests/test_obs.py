@@ -1,0 +1,346 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Observability primitives: exposition edge cases (label escaping,
+histogram cumulative-bucket semantics, concurrent updates, registry
+reset), trace-context codecs, and the span ring buffer."""
+
+import json
+import threading
+
+import pytest
+
+from kubeflow_tpu.obs import metrics as obs
+from kubeflow_tpu.obs import tracing
+
+
+@pytest.fixture()
+def registry():
+    return obs.Registry()
+
+
+# -- exposition format -------------------------------------------------------
+
+
+def test_counter_render_and_parse(registry):
+    c = obs.Counter("kft_t_requests_total", "Requests", ("model",),
+                    registry=registry)
+    c.labels(model="resnet").inc()
+    c.labels(model="resnet").inc(2)
+    text = registry.render()
+    assert "# HELP kft_t_requests_total Requests" in text
+    assert "# TYPE kft_t_requests_total counter" in text
+    fams = obs.parse_exposition(text)
+    assert fams["kft_t_requests_total"]["samples"] == [
+        ("kft_t_requests_total", {"model": "resnet"}, 3.0)]
+
+
+def test_label_value_escaping_round_trips(registry):
+    g = obs.Gauge("kft_t_gauge", "G", ("path",), registry=registry)
+    nasty = 'a"b\\c\nd'
+    g.labels(path=nasty).set(1.5)
+    text = registry.render()
+    # The raw exposition must contain the escaped form, single line.
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    sample_lines = [ln for ln in text.splitlines()
+                    if ln.startswith("kft_t_gauge{")]
+    assert len(sample_lines) == 1
+    fams = obs.parse_exposition(text)
+    (_, labels, value), = fams["kft_t_gauge"]["samples"]
+    assert labels["path"] == nasty  # parse inverts render exactly
+    assert value == 1.5
+
+
+def test_help_escaping(registry):
+    obs.Counter("kft_t_help", "multi\nline \\help", registry=registry)
+    text = registry.render()
+    assert "# HELP kft_t_help multi\\nline \\\\help" in text
+    obs.parse_exposition(text)
+
+
+def test_histogram_buckets_cumulative_and_inf(registry):
+    h = obs.Histogram("kft_t_lat_seconds", "L", buckets=(0.1, 1.0, 10.0),
+                      registry=registry)
+    for v in (0.05, 0.1, 0.5, 5.0, 50.0):  # 0.1 lands in le=0.1 (≤)
+        h.observe(v)
+    text = registry.render()
+    fams = obs.parse_exposition(text)  # validates monotonic + +Inf
+    samples = {name + json.dumps(labels, sort_keys=True): value
+               for name, labels, value
+               in fams["kft_t_lat_seconds"]["samples"]}
+    assert samples['kft_t_lat_seconds_bucket{"le": "0.1"}'] == 2
+    assert samples['kft_t_lat_seconds_bucket{"le": "1"}'] == 3
+    assert samples['kft_t_lat_seconds_bucket{"le": "10"}'] == 4
+    assert samples['kft_t_lat_seconds_bucket{"le": "+Inf"}'] == 5
+    assert samples['kft_t_lat_seconds_count{}'] == 5
+    assert samples['kft_t_lat_seconds_sum{}'] == pytest.approx(55.65)
+
+
+def test_histogram_bucket_validation():
+    with pytest.raises(ValueError, match="increase"):
+        obs.Histogram("kft_t_bad", "B", buckets=(1.0, 1.0), registry=None)
+    with pytest.raises(ValueError, match="bucket"):
+        obs.Histogram("kft_t_bad2", "B", buckets=(), registry=None)
+
+
+def test_parser_rejects_malformed():
+    with pytest.raises(ValueError, match="precedes"):
+        obs.parse_exposition("kft_orphan 1\n")
+    with pytest.raises(ValueError, match="bad value"):
+        obs.parse_exposition(
+            "# HELP m h\n# TYPE m counter\nm notafloat\n")
+    with pytest.raises(ValueError, match="cumulative|\\+Inf"):
+        obs.parse_exposition(
+            "# HELP h x\n# TYPE h histogram\n"
+            'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 3\nh_count 3\n')
+
+
+def test_metric_name_and_label_validation():
+    with pytest.raises(ValueError, match="invalid metric name"):
+        obs.Counter("kft bad name", "x", registry=None)
+    with pytest.raises(ValueError, match="invalid label"):
+        obs.Counter("kft_ok", "x", ("bad-label",), registry=None)
+
+
+def test_forbidden_high_cardinality_labels_rejected():
+    # Construct the label name dynamically so the static lint check
+    # (scripts/lint.py check_metric_label_discipline) doesn't flag
+    # this file — the point HERE is the runtime rejection.
+    for label in ("request" + "_id", "trace" + "_id"):
+        with pytest.raises(ValueError, match="cardinality"):
+            obs.Counter("kft_t_cardinality", "x", (label,),
+                        registry=None)
+
+
+def test_duplicate_registration_rejected(registry):
+    obs.Counter("kft_t_dup", "x", registry=registry)
+    with pytest.raises(ValueError, match="already registered"):
+        obs.Gauge("kft_t_dup", "y", registry=registry)
+
+
+def test_counter_cannot_decrease(registry):
+    c = obs.Counter("kft_t_mono", "x", registry=registry)
+    c.inc(5)
+    with pytest.raises(ValueError, match="decrease"):
+        c.inc(-1)
+
+
+def test_gauge_callback_and_set(registry):
+    g = obs.Gauge("kft_t_cb", "x", registry=registry)
+    g.set(3)
+    state = {"v": 41}
+    g.set_function(lambda: state["v"] + 1)
+    fams = obs.parse_exposition(registry.render())
+    assert fams["kft_t_cb"]["samples"][0][2] == 42
+    # A raising callback renders 0, never fails the scrape.
+    g.set_function(lambda: 1 / 0)
+    fams = obs.parse_exposition(registry.render())
+    assert fams["kft_t_cb"]["samples"][0][2] == 0
+
+
+def test_concurrent_updates_from_threads(registry):
+    c = obs.Counter("kft_t_conc_total", "x", ("worker",),
+                    registry=registry)
+    h = obs.Histogram("kft_t_conc_seconds", "x", buckets=(0.5,),
+                      registry=registry)
+    n_threads, n_iter = 8, 1000
+
+    def worker(i):
+        child = c.labels(worker=str(i % 2))
+        for _ in range(n_iter):
+            child.inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    fams = obs.parse_exposition(registry.render())
+    total = sum(v for _, _, v in fams["kft_t_conc_total"]["samples"])
+    assert total == n_threads * n_iter  # no lost increments
+    count = [v for name, _, v in fams["kft_t_conc_seconds"]["samples"]
+             if name.endswith("_count")]
+    assert count == [n_threads * n_iter]
+
+
+def test_registry_reset_between_tests(registry):
+    c = obs.Counter("kft_t_reset_total", "x", ("m",), registry=registry)
+    h = obs.Histogram("kft_t_reset_seconds", "x", buckets=(1.0,),
+                      registry=registry)
+    child = c.labels(m="a")  # hot paths CACHE children at construction
+    child.inc(7)
+    h.observe(0.5)
+    registry.reset()
+    fams = obs.parse_exposition(registry.render())
+    # Values zeroed IN PLACE; children/family kept — the cached child
+    # must keep rendering (dropping it would orphan instrumented
+    # modules that bound it once).
+    assert fams["kft_t_reset_total"]["samples"] == [
+        ("kft_t_reset_total", {"m": "a"}, 0.0)]
+    counts = [v for name, _, v
+              in fams["kft_t_reset_seconds"]["samples"]
+              if name.endswith("_count")]
+    assert counts == [0]
+    child.inc()  # the pre-reset cached child still feeds the render
+    fams = obs.parse_exposition(registry.render())
+    assert fams["kft_t_reset_total"]["samples"] == [
+        ("kft_t_reset_total", {"m": "a"}, 1.0)]
+
+
+def test_disabled_updates_are_noops(registry):
+    c = obs.Counter("kft_t_off_total", "x", registry=registry)
+    obs.set_enabled(False)
+    try:
+        c.inc(100)
+    finally:
+        obs.set_enabled(True)
+    c.inc()
+    fams = obs.parse_exposition(registry.render())
+    assert fams["kft_t_off_total"]["samples"][0][2] == 1
+
+
+def test_dump_jsonl(registry, tmp_path):
+    c = obs.Counter("kft_t_dump_total", "x", ("m",), registry=registry)
+    c.labels(m="a").inc(2)
+    path = tmp_path / "metrics.jsonl"
+    obs.dump_jsonl(str(path), registry)
+    rows = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert {"name": "kft_t_dump_total", "labels": {"m": "a"},
+            "value": 2.0, "type": "counter"} in rows
+
+
+# -- tracing -----------------------------------------------------------------
+
+
+def test_traceparent_round_trip():
+    ctx = tracing.new_context()
+    parsed = tracing.parse_traceparent(ctx.traceparent())
+    assert parsed == (ctx.trace_id, ctx.span_id)
+    for bad in ("", "00-zz-bb-01", "00-" + "0" * 32 + "-" + "a" * 16
+                + "-01", "garbage", "00-abc-def-01-extra"):
+        assert tracing.parse_traceparent(bad) is None
+
+
+def test_from_headers_adopts_and_mints():
+    ctx = tracing.new_context(request_id="req-42")
+    headers = ctx.headers()
+    got = tracing.from_headers(headers)
+    assert got.request_id == "req-42"
+    assert got.trace_id == ctx.trace_id
+    # Request id alone still yields a full context.
+    got = tracing.from_headers({"X-Request-Id": "solo"})
+    assert got.request_id == "solo" and len(got.trace_id) == 32
+    # Nothing → None; ensure_context mints.
+    assert tracing.from_headers({}) is None
+    minted = tracing.ensure_context({})
+    assert minted.request_id and minted.trace_id
+
+
+def test_from_grpc_metadata():
+    ctx = tracing.new_context(request_id="grpc-7")
+    got = tracing.from_grpc_metadata(ctx.grpc_metadata())
+    assert got.request_id == "grpc-7"
+    assert got.trace_id == ctx.trace_id
+    assert tracing.from_grpc_metadata([("other", "x")]) is None
+    assert tracing.from_grpc_metadata(None) is None
+
+
+def test_request_id_truncated_on_both_header_paths():
+    # The id rides into every span and log line: a multi-megabyte
+    # header must be capped whether or not a traceparent came along.
+    huge = "x" * 10_000
+    got = tracing.from_headers({"X-Request-Id": huge})
+    assert len(got.request_id) == 128
+    ctx = tracing.new_context()
+    got = tracing.from_headers({"X-Request-Id": huge,
+                                "traceparent": ctx.traceparent()})
+    assert len(got.request_id) == 128
+    assert got.trace_id == ctx.trace_id
+
+
+def test_gauge_clear_function_with_owner(registry):
+    class Box:
+        def value(self):
+            return 5.0
+
+    g = obs.Gauge("kft_t_clear", "x", registry=registry)
+    child = g.labels()
+    a, b = Box(), Box()
+    child.set_function(a.value)
+    child.clear_function(owner=b)  # wrong owner: binding survives
+    assert child.get() == 5.0
+    child.clear_function(owner=a)  # right owner: unbound, renders 0
+    assert child.get() == 0.0
+    child.set_function(lambda: 7.0)
+    child.clear_function()  # no owner: unconditional
+    assert child.get() == 0.0
+
+
+def test_child_keeps_trace_changes_span():
+    ctx = tracing.new_context()
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.request_id == ctx.request_id
+    assert child.span_id != ctx.span_id
+
+
+def test_tracer_ring_buffer_bounded_and_chrome_export():
+    tr = tracing.Tracer(capacity=4, component="test-proc")
+    for i in range(10):
+        tr.record(f"span{i}", "cat", 1.0 + i, 0.5,
+                  args={"request_id": f"r{i}"})
+    spans = tr.snapshot()
+    assert len(spans) == 4  # bounded: oldest evicted
+    assert spans[0]["name"] == "span6"
+    doc = tr.export_chrome()
+    json.dumps(doc)  # valid JSON document
+    assert doc["displayTimeUnit"] == "ms"
+    events = doc["traceEvents"]
+    assert events[0] == {"name": "process_name", "ph": "M",
+                         "pid": events[0]["pid"],
+                         "args": {"name": "test-proc"}}
+    for e in events[1:]:
+        assert e["ph"] == "X" and e["dur"] >= 0 and "ts" in e
+
+
+def test_tracer_disabled_records_nothing():
+    tr = tracing.Tracer(capacity=8)
+    tr.enabled = False
+    tr.record("x", "c", 0.0, 1.0)
+    with tr.span("y"):
+        pass
+    assert tr.snapshot() == []
+
+
+def test_tracer_span_context_manager_tags_errors():
+    tr = tracing.Tracer(capacity=8)
+    with pytest.raises(RuntimeError):
+        with tr.span("boom", args={"k": "v"}):
+            raise RuntimeError("x")
+    span, = tr.snapshot()
+    assert span["name"] == "boom"
+    assert span["args"]["outcome"] == "error"
+    assert span["args"]["k"] == "v"
+
+
+def test_tracer_dump_jsonl(tmp_path):
+    tr = tracing.Tracer(capacity=8)
+    tr.record("a", "c", 1.0, 0.25, args={"request_id": "r1"})
+    path = tmp_path / "spans.jsonl"
+    tr.dump_jsonl(str(path))
+    rows = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert rows[0]["name"] == "a"
+    assert rows[0]["args"]["request_id"] == "r1"

@@ -1,0 +1,477 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""End-to-end observability: /metrics parses on every scrape surface
+(serving server, proxy, dashboard, operator exposition thread), one
+request_id flows proxy access log → server span → manager batch span,
+/healthz schemas align, and the CI artifact sweep leaves the trail."""
+
+import json
+import logging
+import urllib.request
+
+import numpy as np
+import pytest
+import tornado.httpserver
+import tornado.testing
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs import tracing as obs_tracing
+from kubeflow_tpu.obs.exposition import ACCESS_LOGGER
+from kubeflow_tpu.serving.manager import ModelManager, ServedModel
+
+
+class _StubLoaded:
+    version = 1
+
+    def signature(self, name=None):
+        class Sig:
+            method = "predict"
+            inputs = {"x": None}
+        return Sig()
+
+    def run(self, inputs, sig_name=None, method=None):
+        return {"y": np.asarray(inputs["x"]) * 2.0}
+
+
+def _stub_manager(name: str = "stub"):
+    manager = ModelManager()
+    model = ServedModel(name, "/nonexistent", max_batch=8,
+                        batch_window_s=0.001)
+    model._versions[1] = _StubLoaded()
+    model._latest = 1
+    manager._models[name] = model
+    return manager, model
+
+
+class _LogCapture(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.lines = []
+
+    def emit(self, record):
+        self.lines.append(record.getMessage())
+
+
+@pytest.fixture()
+def access_log():
+    logger = logging.getLogger(ACCESS_LOGGER)
+    capture = _LogCapture()
+    old_level = logger.level
+    logger.addHandler(capture)
+    logger.setLevel(logging.INFO)
+    try:
+        yield capture
+    finally:
+        logger.removeHandler(capture)
+        logger.setLevel(old_level)
+
+
+# -- /metrics parses on every surface ----------------------------------------
+
+
+class ServerMetricsSurface(tornado.testing.AsyncHTTPTestCase):
+    def get_app(self):
+        from kubeflow_tpu.serving.server import make_app
+
+        self.manager, self.model = _stub_manager()
+        return make_app(self.manager)
+
+    def tearDown(self):
+        self.manager.stop()
+        super().tearDown()
+
+    def test_metrics_parse_and_carry_serving_families(self):
+        # Drive one request so the serving counters have children.
+        resp = self.fetch("/v1/models/stub:predict", method="POST",
+                          body=json.dumps({"instances": [[1.0, 2.0]]}))
+        assert resp.code == 200, resp.body
+        resp = self.fetch("/metrics")
+        assert resp.code == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        fams = obs_metrics.parse_exposition(resp.body.decode())
+        for family in ("kft_serving_queue_depth",
+                       "kft_serving_shed_total",
+                       "kft_serving_expired_total",
+                       "kft_serving_est_batch_latency_seconds",
+                       "kft_serving_batches_total",
+                       "kft_serving_queue_wait_seconds",
+                       "kft_serving_dispatch_seconds"):
+            assert family in fams, family
+        rows = {labels.get("model"): v for _, labels, v
+                in fams["kft_serving_batch_rows_total"]["samples"]}
+        assert rows.get("stub", 0) >= 1
+
+    def test_tracez_is_valid_chrome_trace(self):
+        resp = self.fetch("/tracez")
+        assert resp.code == 200
+        doc = json.loads(resp.body)
+        assert "traceEvents" in doc
+
+    def test_healthz_schema(self):
+        body = json.loads(self.fetch("/healthz").body)
+        assert body["status"] == "ok"
+        assert set(body) >= {"status", "saturation", "breakers"}
+        assert "queue_depth" in body["saturation"]["stub"]
+        assert body["breakers"] == {}  # the server has no upstreams
+
+
+class ProxyMetricsSurface(tornado.testing.AsyncHTTPTestCase):
+    def get_app(self):
+        from kubeflow_tpu.serving.http_proxy import make_app
+
+        return make_app("http://127.0.0.1:1")  # upstream never dialed
+
+    def test_metrics_parse_and_carry_breaker_state(self):
+        fams = obs_metrics.parse_exposition(
+            self.fetch("/metrics").body.decode())
+        states = {labels["upstream"]: v for _, labels, v
+                  in fams["kft_proxy_breaker_state"]["samples"]}
+        assert states == {"rest": 0.0, "grpc": 0.0}  # both closed
+
+    def test_healthz_schema_includes_per_upstream_breakers(self):
+        body = json.loads(self.fetch("/healthz").body)
+        assert set(body) >= {"status", "saturation", "breakers"}
+        assert body["status"] == "ok"
+        assert body["saturation"] == {}  # no batcher at the proxy
+        for upstream in ("rest", "grpc"):
+            assert body["breakers"][upstream]["state"] == "closed"
+            assert "retry_after_s" in body["breakers"][upstream]
+
+
+class DashboardMetricsSurface(tornado.testing.AsyncHTTPTestCase):
+    def get_app(self):
+        from kubeflow_tpu.dashboard.server import make_app
+        from kubeflow_tpu.operator.fake import FakeApiServer
+
+        return make_app(FakeApiServer())
+
+    def test_metrics_and_spans_endpoints(self):
+        obs_tracing.TRACER.clear()
+        assert self.fetch("/healthz").code == 200  # counted, unspanned
+        assert self.fetch("/tpujobs/api/tpujob").code == 200
+        fams = obs_metrics.parse_exposition(
+            self.fetch("/metrics").body.decode())
+        handlers = {labels["handler"] for _, labels, _
+                    in fams["kft_dashboard_requests_total"]["samples"]}
+        assert {"HealthHandler", "JobListHandler"} <= handlers
+        doc = json.loads(self.fetch("/tpujobs/api/spans").body)
+        spanned = {e.get("args", {}).get("path")
+                   for e in doc["traceEvents"]
+                   if e.get("name") == "dashboard_request"}
+        assert "/tpujobs/api/tpujob" in spanned
+        # Health probes are counted in metrics but kept OUT of the
+        # span ring buffer (they would evict real handler spans).
+        assert "/healthz" not in spanned
+
+
+def test_operator_exposition_thread_serves_metrics():
+    from kubeflow_tpu.obs.exposition import start_exposition_server
+    from kubeflow_tpu.operator.controller import WatchController
+    from kubeflow_tpu.operator.fake import FakeApiServer
+
+    # Constructing the controller binds the workqueue/reconcile
+    # callback gauges the operator's scrape serves.
+    WatchController(FakeApiServer())
+    server = start_exposition_server(0, host="127.0.0.1")
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            fams = obs_metrics.parse_exposition(resp.read().decode())
+        for family in ("kft_workqueue_depth", "kft_workqueue_adds_total",
+                       "kft_operator_reconciles_total",
+                       "kft_operator_reconcile_seconds"):
+            assert family in fams, family
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/tracez", timeout=10) as resp:
+            assert "traceEvents" in json.loads(resp.read())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as resp:
+            assert json.loads(resp.read())["status"] == "ok"
+    finally:
+        server.shutdown()
+
+
+def test_operator_reconcile_metrics_flow():
+    """A reconciled job shows up in the reconcile counter + latency
+    histogram (the live /metrics view of the ConfigMap snapshot)."""
+    from kubeflow_tpu.manifests.tpujob import replica_spec, tpu_job
+    from kubeflow_tpu.operator.controller import WatchController
+    from kubeflow_tpu.operator.fake import FakeApiServer
+
+    api = FakeApiServer()
+    api.create(tpu_job("obs-job", "default",
+                       [replica_spec("TPU_WORKER", 1,
+                                     image="trainer:test",
+                                     tpu_accelerator="tpu-v5-lite-podslice",
+                                     tpu_topology="2x4")]))
+    controller = WatchController(api)
+    controller._reconcile_one(("default", "obs-job"), "default",
+                              "obs-job")
+    fams = obs_metrics.parse_exposition(obs_metrics.render())
+    reconciles = fams["kft_operator_reconciles_total"]["samples"][0][2]
+    assert reconciles >= 1
+    count = [v for name, _, v
+             in fams["kft_operator_reconcile_seconds"]["samples"]
+             if name.endswith("_count")]
+    assert count[0] >= 1
+
+
+# -- one request_id across proxy access log, server span, batch span ---------
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    from kubeflow_tpu.models.resnet import resnet18ish
+    from kubeflow_tpu.serving.export import export_model
+    from kubeflow_tpu.serving.signature import (
+        ModelMetadata,
+        Signature,
+        TensorSpec,
+    )
+
+    base = tmp_path_factory.mktemp("obs-models") / "testnet"
+    model = resnet18ish(num_classes=10)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3), jnp.bfloat16),
+                           train=False)
+    metadata = ModelMetadata(
+        model_name="testnet",
+        registry_name="resnet-test",
+        model_kwargs={"num_classes": 10},
+        signatures={"serving_default": Signature(
+            method="predict",
+            inputs={"images": TensorSpec("float32", (-1, 32, 32, 3))},
+            outputs={"logits": TensorSpec("float32", (-1, 10))},
+        )},
+    )
+    export_model(str(base), 1, metadata, variables)
+    return base
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _attach_base_path(model_dir):
+    RequestIdEndToEnd.base_path = model_dir
+
+
+class RequestIdEndToEnd(tornado.testing.AsyncHTTPTestCase):
+    """Client → proxy → server → manager with one X-Request-Id: the
+    id must appear in the proxy AND server access logs, the server's
+    http_request span, and the manager's request spans — which link
+    (via args.batch) to the coalesced batch_execute span."""
+
+    def get_app(self):
+        from kubeflow_tpu.serving.http_proxy import make_app as proxy_app
+        from kubeflow_tpu.serving.server import make_app as server_app
+
+        self.manager = ModelManager()
+        self.manager.add_model("testnet", str(type(self).base_path),
+                               max_batch=8)
+        backend = server_app(self.manager)
+        sock, port = tornado.testing.bind_unused_port()
+        self.backend_server = tornado.httpserver.HTTPServer(backend)
+        self.backend_server.add_sockets([sock])
+        return proxy_app(f"http://127.0.0.1:{port}")
+
+    def tearDown(self):
+        self.manager.stop()
+        self.backend_server.stop()
+        super().tearDown()
+
+    def _drive(self, access_log, request_id="e2e-req-0017"):
+        obs_tracing.TRACER.clear()
+        rows = np.zeros((1, 32, 32, 3)).tolist()
+        resp = self.fetch(
+            "/model/testnet:predict", method="POST",
+            body=json.dumps({"instances": rows}),
+            headers={obs_tracing.REQUEST_ID_HEADER: request_id})
+        assert resp.code == 200, resp.body
+        return resp
+
+    def test_request_id_in_logs_and_spans(self):
+        logger = logging.getLogger(ACCESS_LOGGER)
+        capture = _LogCapture()
+        logger.addHandler(capture)
+        logger.setLevel(logging.INFO)
+        try:
+            resp = self._drive(capture)
+        finally:
+            logger.removeHandler(capture)
+            logger.setLevel(logging.NOTSET)
+        request_id = "e2e-req-0017"
+        # 1. The id is echoed to the client.
+        assert resp.headers[obs_tracing.REQUEST_ID_HEADER] == request_id
+        # 2. Proxy AND server access logs each carry ONE structured
+        # line for it (the proxy's metadata hop may add more lines;
+        # the infer lines are the ones tagged with the model).
+        records = [json.loads(line) for line in capture.lines]
+        infer = [r for r in records if r.get("model") == "testnet"
+                 and ":predict" in r["path"]]
+        components = {r["component"] for r in infer}
+        assert components == {"http-proxy", "model-server"}, records
+        for r in infer:
+            assert r["request_id"] == request_id
+            assert r["status"] == 200
+            assert r["latency_ms"] >= 0
+            assert r["method"] == "POST"
+        # 3. The server-side http_request span carries the id.
+        spans = obs_tracing.TRACER.snapshot()
+        server_spans = [s for s in spans
+                        if s["name"] == "http_request"
+                        and s["args"]["request_id"] == request_id]
+        assert server_spans, spans
+        # 4. The manager's request spans carry the id AND link to the
+        # coalesced batch span through args.batch.
+        request_spans = {s["name"]: s for s in spans
+                         if s.get("args", {}).get("request_id")
+                         == request_id and s["cat"] == "serving"
+                         and "batch" in s.get("args", {})}
+        assert {"queue_wait", "batch_assembly",
+                "execute"} <= set(request_spans)
+        batch_id = request_spans["execute"]["args"]["batch"]
+        batch_spans = [s for s in spans if s["name"] == "batch_execute"
+                       and s["args"]["batch"] == batch_id]
+        assert len(batch_spans) == 1
+        assert batch_spans[0]["args"]["model"] == "testnet"
+        assert batch_spans[0]["args"]["rows"] >= 1
+        # 5. Outcomes tagged ok on the dispatched path.
+        assert request_spans["execute"]["args"]["outcome"] == "ok"
+
+    def test_proxy_mints_id_when_client_sends_none(self):
+        obs_tracing.TRACER.clear()
+        rows = np.zeros((1, 32, 32, 3)).tolist()
+        resp = self.fetch("/model/testnet:predict", method="POST",
+                          body=json.dumps({"instances": rows}))
+        assert resp.code == 200, resp.body
+        minted = resp.headers.get(obs_tracing.REQUEST_ID_HEADER)
+        assert minted  # the edge always assigns an id
+        spans = obs_tracing.TRACER.snapshot()
+        assert any(s.get("args", {}).get("request_id") == minted
+                   for s in spans if s["name"] == "execute")
+
+
+def test_grpc_metadata_carries_request_id():
+    """The native :9000 listener reads x-request-id/traceparent off
+    gRPC invocation metadata into the manager's spans."""
+    grpc = pytest.importorskip("grpc")
+    from kubeflow_tpu.serving import wire
+    from kubeflow_tpu.serving.grpc_server import make_server
+
+    manager, model = _stub_manager("gstub")
+    server, port = make_server(manager, 0)
+    server.start()
+    try:
+        obs_tracing.TRACER.clear()
+        ctx = obs_tracing.new_context(request_id="grpc-e2e-9")
+        request = wire.encode_predict_request(
+            "gstub", {"x": np.ones((1, 2), np.float32)})
+        with grpc.insecure_channel(f"localhost:{port}") as channel:
+            call = channel.unary_unary(
+                "/tensorflow.serving.PredictionService/Predict")
+            call(request, timeout=10, metadata=ctx.grpc_metadata())
+        spans = obs_tracing.TRACER.snapshot()
+        assert any(s.get("args", {}).get("request_id") == "grpc-e2e-9"
+                   for s in spans if s["name"] == "execute"), spans
+    finally:
+        server.stop(grace=None)
+        manager.stop()
+
+
+# -- shed/expired outcomes tagged in spans -----------------------------------
+
+
+def test_shed_and_expired_outcomes_tagged():
+    import time
+
+    from kubeflow_tpu.serving import overload
+
+    manager, model = _stub_manager("outcomes")
+    try:
+        obs_tracing.TRACER.clear()
+        ctx = obs_tracing.new_context(request_id="will-shed")
+        model._latency.seed(10.0)  # one batch "costs" 10s
+        fut = model.submit({"x": np.ones((1, 2), np.float32)}, None,
+                           None, None,
+                           deadline=overload.deadline_after(0.2),
+                           obs_ctx=ctx)
+        with pytest.raises(overload.OverloadedError):
+            fut.result(1)
+        ctx2 = obs_tracing.new_context(request_id="already-dead")
+        fut = model.submit({"x": np.ones((1, 2), np.float32)}, None,
+                           None, None,
+                           deadline=time.monotonic() - 1.0,
+                           obs_ctx=ctx2)
+        with pytest.raises(overload.DeadlineExceededError):
+            fut.result(1)
+        outcomes = {s["args"]["request_id"]: s["args"]["outcome"]
+                    for s in obs_tracing.TRACER.snapshot()
+                    if "request_id" in s.get("args", {})}
+        assert outcomes["will-shed"] == "shed"
+        assert outcomes["already-dead"] == "expired"
+    finally:
+        manager.stop()
+
+
+# -- CI observability trail --------------------------------------------------
+
+
+def test_artifacts_collect_obs(tmp_path, monkeypatch):
+    from kubeflow_tpu.citests import artifacts
+
+    monkeypatch.setenv("KFT_ARTIFACTS_DIR", str(tmp_path / "artifacts"))
+    monkeypatch.setenv("KFT_OBS_DIR", str(tmp_path / "drop"))
+    drop = tmp_path / "drop"
+    (drop / "server").mkdir(parents=True)
+    (drop / "proxy").mkdir()
+    (drop / "train_metrics.jsonl").write_text(
+        '{"step": 1, "loss": 0.5}\n')
+    # Same basename from two processes: both must survive the sweep.
+    (drop / "server" / "spans.jsonl").write_text('{"name": "srv"}\n')
+    (drop / "proxy" / "spans.jsonl").write_text('{"name": "prx"}\n')
+    obs_tracing.TRACER.record("ci_span", "test", 0.0, 0.1,
+                              args={"request_id": "ci-1"})
+    copied = artifacts.collect_obs()
+    names = {p.name for p in copied}
+    assert {"train_metrics.jsonl", "server__spans.jsonl",
+            "proxy__spans.jsonl", "live_metrics.jsonl",
+            "live_spans.jsonl"} <= names
+    out = tmp_path / "artifacts" / "obs"
+    assert (out / "train_metrics.jsonl").read_text().startswith(
+        '{"step": 1')
+    assert json.loads(
+        (out / "server__spans.jsonl").read_text())["name"] == "srv"
+    # The live dumps are themselves JSONL.
+    for line in (out / "live_metrics.jsonl").read_text().splitlines():
+        json.loads(line)
+    spans = [json.loads(line) for line in
+             (out / "live_spans.jsonl").read_text().splitlines()]
+    assert any(s["name"] == "ci_span" for s in spans)
+
+
+def test_tracer_overhead_guard():
+    """Recording must stay O(tens of µs) per span — 10k spans in
+    under a second even on a contended CI box (the <2% serving bench,
+    bench.py --obs-overhead, is the precise measurement)."""
+    import time
+
+    tr = obs_tracing.Tracer(capacity=1024)
+    t0 = time.perf_counter()
+    for i in range(10_000):
+        tr.record("s", "c", 0.0, 0.001, args={"request_id": "r"})
+    assert time.perf_counter() - t0 < 1.0
