@@ -120,6 +120,46 @@ def serving_overload_main() -> int:
     return 0
 
 
+def router_main() -> int:
+    """`python bench.py --router`: pooled-proxy scaling sweep over
+    1→3 in-process stub backends + a mid-load backend kill (ISSUE 5
+    acceptance: ≥2.5× aggregate throughput at 3 replicas, no
+    in-deadline request lost on failover). Sleep-based service times,
+    so the scaling ratio survives this box's CPU throttling (see
+    kubeflow_tpu/scaling/benchmark.py + PERF.md r10); prints ONE JSON
+    line shaped like the headline bench."""
+    from kubeflow_tpu.scaling.benchmark import (
+        RouterBenchConfig,
+        run_router_benchmark,
+    )
+
+    result = run_router_benchmark(RouterBenchConfig())
+    rows = {r["replicas"]: r for r in result["rows"]}
+    failover = result.get("failover", {})
+    scaling = result.get("throughput_scaling", 0.0)
+    print(json.dumps({
+        "metric": "router_throughput_scaling",
+        "value": scaling,
+        "unit": (f"aggregate rps at {result.get('top_replicas')} "
+                 f"replicas vs 1, pooled proxy "
+                 f"({result['config']['balancer']}, "
+                 f"{result['config']['clients']} closed-loop clients, "
+                 f"{result['config']['service_time_s'] * 1e3:.0f} ms "
+                 f"simulated service)"),
+        "vs_baseline": None,  # the reference never measured its fleet
+        "extra": {
+            **{f"r{n}_{k}": row[k]
+               for n, row in sorted(rows.items())
+               for k in ("rps", "p50_ms", "p99_ms", "errors",
+                         "utilization", "router_overhead_p50_ms",
+                         "speedup_vs_1")
+               if k in row},
+            **{f"failover_{k}": v for k, v in failover.items()},
+        },
+    }))
+    return 0 if scaling >= 2.5 else 1
+
+
 def obs_overhead_main() -> int:
     """`python bench.py --obs-overhead`: serving-throughput cost of
     leaving metrics + tracing ON (ISSUE 4 acceptance: <2%). Drives
@@ -161,6 +201,8 @@ def main() -> int:
         return serving_overload_main()
     if "--obs-overhead" in sys.argv:
         return obs_overhead_main()
+    if "--router" in sys.argv:
+        return router_main()
     from kubeflow_tpu.utils.platform import sync_platform_from_env
 
     # Honor JAX_PLATFORMS from the caller (the session preset pins the

@@ -41,6 +41,12 @@ and DELETE jobs, not just list them. This is its TPUJob equivalent:
                                          metrics (read from the
                                          ConfigMap the operator
                                          publishes; ?namespace=)
+  GET    /tpujobs/api/fleet             serving-fleet membership,
+                                         health/saturation and the
+                                         last autoscaler decision
+                                         (from the ConfigMap the
+                                         autoscaler loop publishes;
+                                         ?namespace=)
   GET    /healthz
 
 against either a real apiserver (kubectl shim) or the in-repo fake
@@ -444,6 +450,58 @@ class OperatorMetricsHandler(BaseHandler):
                          "metrics": metrics})
 
 
+class FleetHandler(BaseHandler):
+    """Serving-fleet state: replica membership, health/saturation and
+    the last autoscaler decision, read from the ConfigMap the
+    autoscaler loop publishes (scaling/autoscaler.py AutoscalerLoop
+    .publish) — the same operator-metrics pattern as
+    /tpujobs/api/operator: the dashboard and the fleet bench read the
+    SAME numbers the controller acted on."""
+
+    async def get(self):
+        from kubeflow_tpu.operator.fake import NotFound
+        from kubeflow_tpu.scaling.autoscaler import (
+            FLEET_CONFIGMAP,
+            FLEET_KEY,
+        )
+
+        namespace = self.get_query_argument("namespace", "default")
+        loop = tornado.ioloop.IOLoop.current()
+        try:
+            cm = await loop.run_in_executor(
+                None, self.api.get, "ConfigMap", namespace,
+                FLEET_CONFIGMAP)
+        except NotFound:
+            return self.write_json(
+                {"available": False,
+                 "error": f"ConfigMap {namespace}/{FLEET_CONFIGMAP} "
+                          f"not found (autoscaler not publishing?)"},
+                404)
+        except Exception as e:  # noqa: BLE001 — apiserver-side
+            return self.write_json({"available": False,
+                                    "error": str(e)}, 502)
+        try:
+            fleet = json.loads(cm.get("data", {}).get(FLEET_KEY, "{}"))
+        except json.JSONDecodeError:
+            return self.write_json(
+                {"available": False,
+                 "error": "fleet ConfigMap holds invalid JSON"}, 502)
+        self.write_json({"available": True, "namespace": namespace,
+                         "fleet": fleet})
+
+
+def _fetch_fleet(api, namespace: str = "default"):
+    """Best-effort fleet snapshot for the HTML view (None when the
+    autoscaler is not publishing)."""
+    from kubeflow_tpu.scaling.autoscaler import FLEET_CONFIGMAP, FLEET_KEY
+
+    try:
+        cm = api.get("ConfigMap", namespace, FLEET_CONFIGMAP)
+        return json.loads(cm.get("data", {}).get(FLEET_KEY, "{}"))
+    except Exception:  # noqa: BLE001 — section simply absent
+        return None
+
+
 class TraceListHandler(BaseHandler):
     """Profiler traces under the shared trace root (written by
     trainer ``--profile_dir`` / ``LoopConfig.profile_dir``; recipe for
@@ -490,6 +548,8 @@ _PAGE = """<!doctype html>
 JSON: <a href="/tpujobs/api/traces">/tpujobs/api/traces</a> &middot;
 open with <code>tensorboard --logdir &lt;trace dir&gt;</code>
 (docs/profiling.md)</p>
+<h2>Serving fleet</h2>
+{fleet_section}
 <h2>Request spans</h2>
 <p>Host-side request spans (Chrome trace-event JSON — open in
 <a href="https://ui.perfetto.dev">Perfetto</a>):
@@ -658,8 +718,75 @@ class UIJobDetailHandler(BaseHandler):
         ))
 
 
+_HEALTH_COLORS = {"healthy": "#1a7f37", "unknown": "#9a6700",
+                  "unhealthy": "#cf222e", "draining": "#bc4c00"}
+
+
+def _fleet_section_html(fleet) -> str:
+    """The "Serving fleet" block: replica membership/health/
+    saturation rows + the last autoscaler decision, or a pointer at
+    the publishing contract when the autoscaler isn't running. A
+    malformed ConfigMap (version skew, a hand edit — humans CAN
+    patch it) degrades to a note, never a 500 for the whole page."""
+    try:
+        return _fleet_section_html_unsafe(fleet)
+    except Exception:  # noqa: BLE001 — render is best-effort
+        logger.warning("fleet ConfigMap malformed; omitting section",
+                       exc_info=True)
+        return ("<p>Fleet ConfigMap unreadable (malformed "
+                "<code>serving-fleet-metrics</code>?). JSON: "
+                "<a href=\"/tpujobs/api/fleet\">/tpujobs/api/fleet"
+                "</a></p>")
+
+
+def _fleet_section_html_unsafe(fleet) -> str:
+    if not fleet or not fleet.get("replicas"):
+        return ("<p>No fleet published (the serving autoscaler "
+                "writes the <code>serving-fleet-metrics</code> "
+                "ConfigMap). JSON: "
+                "<a href=\"/tpujobs/api/fleet\">/tpujobs/api/fleet"
+                "</a></p>")
+    rows = []
+    for r in fleet.get("replicas", []):
+        reachable = r.get("reachable")
+        health = "healthy" if reachable else "unhealthy"
+        color = _HEALTH_COLORS.get(health, "#57606a")
+        models = ", ".join(r.get("resident_models", [])) or "-"
+        wait = (f"{r.get('queue_wait_ms', 0.0):.0f} ms"
+                if reachable else "-")
+        shed = (f"{r.get('shed_rate', 0.0):.2f}/s"
+                if reachable else "-")
+        rows.append(
+            "<tr>"
+            f"<td><code>{html.escape(str(r.get('address', '')))}"
+            f"</code></td>"
+            f"<td class=\"phase\" style=\"color:{color}\">"
+            f"{'reachable' if reachable else 'unreachable'}</td>"
+            f"<td>{wait}</td><td>{shed}</td>"
+            f"<td>{html.escape(models)}</td>"
+            "</tr>")
+    d = fleet.get("decision", {}) or {}
+    decision = (
+        f"<p>Last autoscaler decision: <strong>"
+        f"{html.escape(str(d.get('action', '-')))}</strong> "
+        f"({html.escape(str(d.get('reason', '')))}) — "
+        f"{int(d.get('current', 0))} → {int(d.get('desired', 0))} "
+        f"replicas, mean queue wait "
+        f"{float(d.get('mean_queue_wait_ms', 0.0)):.0f} ms vs target "
+        f"{float(d.get('target_queue_wait_ms', 0.0)):.0f} ms, "
+        f"{float(d.get('age_s', 0.0)):.0f}s ago.</p>")
+    return (
+        "<table>\n<tr><th>Replica</th><th>Health</th>"
+        "<th>Queue wait</th><th>Shed</th><th>Models</th></tr>\n"
+        + "\n".join(rows) + "\n</table>\n" + decision
+        + "<p>JSON: <a href=\"/tpujobs/api/fleet\">"
+          "/tpujobs/api/fleet</a></p>")
+
+
 class UIHandler(BaseHandler):
     async def get(self):
+        import asyncio
+
         from kubeflow_tpu.utils.traces import list_traces
 
         loop = tornado.ioloop.IOLoop.current()
@@ -683,7 +810,9 @@ class UIHandler(BaseHandler):
                 f"<td>{replicas}</td>"
                 "</tr>")
         trace_root = self.application.settings["trace_root"]
-        traces = await loop.run_in_executor(None, list_traces, trace_root)
+        traces, fleet = await asyncio.gather(
+            loop.run_in_executor(None, list_traces, trace_root),
+            loop.run_in_executor(None, _fetch_fleet, self.api))
         trace_rows = []
         for t in traces:
             files = ", ".join(f["name"] for f in t["files"])
@@ -698,7 +827,8 @@ class UIHandler(BaseHandler):
         self.finish(_PAGE.format(
             rows="\n".join(rows), count=len(jobs),
             trace_rows="\n".join(trace_rows), trace_count=len(traces),
-            trace_root=html.escape(trace_root)))
+            trace_root=html.escape(trace_root),
+            fleet_section=_fleet_section_html(fleet)))
 
 
 class UICreateHandler(BaseHandler):
@@ -757,6 +887,7 @@ def make_app(api, trace_root: str = DEFAULT_TRACE_ROOT
         (r"/tpujobs/api/traces", TraceListHandler),
         (r"/tpujobs/api/spans", ChromeTraceHandler),
         (r"/tpujobs/api/operator", OperatorMetricsHandler),
+        (r"/tpujobs/api/fleet", FleetHandler),
         (r"/tpujobs/ui/?", UIHandler),
         (r"/tpujobs/ui/job/([^/]+)/([^/]+)", UIJobDetailHandler),
         (r"/tpujobs/ui/create", UICreateHandler),

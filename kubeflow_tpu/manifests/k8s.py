@@ -187,7 +187,7 @@ def deployment(
     namespace: str,
     spec: Obj,
     *,
-    replicas: int = 1,
+    replicas: Optional[int] = 1,
     labels: Optional[Dict[str, str]] = None,
     pod_labels: Optional[Dict[str, str]] = None,
     pod_annotations: Optional[Dict[str, str]] = None,

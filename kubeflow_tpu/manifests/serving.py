@@ -112,8 +112,126 @@ def deployment(p: Dict[str, Any]) -> Dict[str, Any]:
     )
     # Non-root (parity ``:173-202`` runAsUser/fsGroup 1000).
     spec["securityContext"] = {"runAsUser": 1000, "fsGroup": 1000}
+    # With the router (autoscaler) enabled the scale subresource owns
+    # spec.replicas; pinning it here would make every manifest
+    # re-apply stomp the autoscaler's writes back to the static param
+    # (the documented HPA-vs-manifest conflict — omit replicas so the
+    # field stays with whoever scaled it last; the apiserver defaults
+    # a brand-new Deployment to 1).
     return k8s.deployment(p["name"], p["namespace"], spec,
+                          replicas=(None if p["router"]
+                                    else int(p["replicas"])),
                           labels={"app": p["name"]})
+
+
+def router_proxy_container(p: Dict[str, Any]) -> Dict[str, Any]:
+    """The fleet-level pooled proxy: routes requests across the
+    serving Deployment's replicas (balancer + per-replica breakers +
+    failover, serving/http_proxy.py) from the endpoints file the
+    autoscaler sidecar maintains in the shared volume."""
+    return k8s.container(
+        f"{p['name']}-router", p["http_proxy_image"],
+        command=["python", "-m", "kubeflow_tpu.serving.http_proxy"],
+        args=["--port=8000",
+              "--endpoints_file=/fleet/endpoints.json",
+              f"--balancer={p['balancer']}",
+              "--probe_interval=1.0",
+              "--rpc_timeout=10.0"],
+        ports=[k8s.port(8000, "http")],
+        readiness_probe=k8s.http_get_probe("/healthz", 8000,
+                                           initial_delay=2, period=5),
+        volume_mounts=[k8s.volume_mount("fleet", "/fleet",
+                                        read_only=True)],
+        resources=k8s.resources(cpu_request="500m",
+                                memory_request="500Mi",
+                                cpu_limit="1", memory_limit="1Gi"),
+    )
+
+
+def autoscaler_container(p: Dict[str, Any]) -> Dict[str, Any]:
+    """Autoscaler sidecar (scaling/autoscaler.py): discovers replica
+    pods by the serving Deployment's app label, scrapes their
+    /healthz saturation, actuates spec.replicas through the scale
+    subresource, publishes the fleet ConfigMap for the dashboard, and
+    rewrites the router's endpoints file (atomic rename; the proxy
+    hot-reloads it)."""
+    return k8s.container(
+        f"{p['name']}-autoscaler", p["http_proxy_image"],
+        command=["python", "-m", "kubeflow_tpu.scaling.autoscaler"],
+        args=[f"--deployment={p['name']}",
+              f"--namespace={p['namespace']}",
+              f"--selector=app={p['name']}",
+              f"--min_replicas={p['min_replicas']}",
+              f"--max_replicas={p['max_replicas']}",
+              f"--target_queue_wait_ms={p['target_queue_wait_ms']}",
+              f"--scale_up_cooldown={p['scale_up_cooldown_s']}",
+              f"--scale_down_cooldown={p['scale_down_cooldown_s']}",
+              "--write_endpoints=/fleet/endpoints.json",
+              "--metrics_port=9401"],
+        ports=[k8s.port(9401, "metrics")],
+        volume_mounts=[k8s.volume_mount("fleet", "/fleet")],
+        resources=k8s.resources(cpu_request="100m",
+                                memory_request="128Mi",
+                                cpu_limit="500m",
+                                memory_limit="256Mi"),
+    )
+
+
+def router_deployment(p: Dict[str, Any]) -> Dict[str, Any]:
+    """One-replica router pod in front of the serving fleet: the
+    pooled proxy + the autoscaler sidecar, wired through a shared
+    emptyDir endpoints file (the reference fronted its fleet with
+    Ambassador and never closed the loop; this pod does both halves)."""
+    name = f"{p['name']}-router"
+    spec = k8s.pod_spec([router_proxy_container(p),
+                         autoscaler_container(p)])
+    spec["securityContext"] = {"runAsUser": 1000, "fsGroup": 1000}
+    spec["volumes"] = [{"name": "fleet", "emptyDir": {}}]
+    spec["serviceAccountName"] = f"{p['name']}-autoscaler"
+    dep = k8s.deployment(name, p["namespace"], spec,
+                         labels={"app": name})
+    dep["spec"]["template"]["metadata"].setdefault(
+        "annotations", {}).update({
+            "prometheus.io/scrape": "true",
+            "prometheus.io/port": "9401",
+        })
+    return dep
+
+
+def router_service(p: Dict[str, Any]) -> Dict[str, Any]:
+    name = f"{p['name']}-router"
+    return k8s.service(
+        name, p["namespace"], {"app": name},
+        [k8s.service_port(8000, name="http")],
+        service_type=p["service_type"])
+
+
+def autoscaler_rbac(p: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """SA + namespaced Role + Binding for the autoscaler sidecar —
+    exactly the verbs its loop uses and nothing wider: replica-pod
+    discovery (list), the serving Deployment's scale subresource
+    (get/update — NOT the Deployment itself: no pod-template access),
+    and the fleet-metrics ConfigMap publish (the operator_rbac
+    pattern, tpujob.py, scoped to a Role since everything is
+    namespace-local)."""
+    name = f"{p['name']}-autoscaler"
+    namespace = p["namespace"]
+    labels = {"app": f"{p['name']}-router"}
+    rules = [
+        k8s.policy_rule([""], ["pods"], ["get", "list", "watch"]),
+        k8s.policy_rule(["apps"], ["deployments/scale"],
+                        ["get", "update", "patch"]),
+        k8s.policy_rule([""], ["configmaps"],
+                        ["get", "create", "update", "patch"]),
+    ]
+    return [
+        k8s.service_account(name, namespace, labels=labels),
+        k8s.role(name, namespace, rules, labels=labels),
+        k8s.role_binding(
+            name, namespace, name,
+            [k8s.subject("ServiceAccount", name, namespace)],
+            labels=labels),
+    ]
 
 
 def service(p: Dict[str, Any]) -> Dict[str, Any]:
@@ -194,7 +312,11 @@ def all_objects(p: Dict[str, Any]) -> List[Dict[str, Any]]:
         containers[0].setdefault("volumeMounts", []).append(gcp["mount"])
         dep["spec"]["template"]["spec"].setdefault("volumes", []).append(
             gcp["volume"])
-    return [dep, service(p)]
+    objects = [dep, service(p)]
+    if p["router"]:
+        objects += [router_deployment(p), router_service(p)]
+        objects += autoscaler_rbac(p)
+    return objects
 
 
 SERVING_PARAMS = [
@@ -210,6 +332,24 @@ SERVING_PARAMS = [
     Param("http_proxy", "true", "bool", "Deploy the REST proxy sidecar."),
     Param("http_proxy_image", DEFAULT_PROXY_IMAGE, "string"),
     Param("service_type", "ClusterIP", "string"),
+    Param("replicas", 1, "int", "Model-server replica count. Ignored "
+          "with `router true`: the autoscaler then owns spec.replicas "
+          "via the scale subresource (the manifest omits the field so "
+          "re-applies don't stomp it) — size the fleet with "
+          "min_replicas/max_replicas instead."),
+    # Fleet router + autoscaler (kubeflow_tpu/scaling/; docs/scaling.md).
+    Param("router", "false", "bool",
+          "Deploy the fleet router pod: pooled proxy + autoscaler "
+          "sidecar in front of the serving replicas."),
+    Param("balancer", "least_saturation", "string",
+          "Router policy: round_robin | least_saturation | affinity."),
+    Param("min_replicas", 1, "int"),
+    Param("max_replicas", 5, "int"),
+    Param("target_queue_wait_ms", 100, "int",
+          "Autoscaler saturation target: mean per-replica estimated "
+          "queue wait (ms)."),
+    Param("scale_up_cooldown_s", 15, "int"),
+    Param("scale_down_cooldown_s", 60, "int"),
     Param("tpu_chips", 0, "int", "TPU chips per server pod (0 = CPU)."),
     Param("tpu_accelerator", "tpu-v5-lite-device", "string"),
     Param("tpu_topology", "", "string"),

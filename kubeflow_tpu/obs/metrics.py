@@ -321,6 +321,16 @@ class _Metric:
         for child in children:
             child.reset()
 
+    def remove_labels(self, *labelvalues: str) -> None:
+        """Drop one labeled child (and everything its callbacks pin).
+        For metrics labeled by a CHURNING identity — e.g. per-replica
+        pod IPs — the series must leave /metrics when the member
+        leaves the fleet, or cardinality and the closure-pinned
+        objects grow for process lifetime. No-op when absent."""
+        with self._children_lock:
+            self._children.pop(tuple(str(v) for v in labelvalues),
+                               None)
+
     def _iter_children(self):
         with self._children_lock:
             return list(self._children.items())

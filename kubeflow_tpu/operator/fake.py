@@ -361,6 +361,76 @@ class FakeApiServer:
             self._record("MODIFIED", new)
             return copy.deepcopy(new)
 
+    # -- scale subresource -------------------------------------------------
+
+    @staticmethod
+    def _scale_shape(obj: Dict[str, Any]) -> Dict[str, Any]:
+        meta = obj.get("metadata", {})
+        return {
+            "kind": "Scale",
+            "apiVersion": "autoscaling/v1",
+            "metadata": {"name": meta.get("name"),
+                         "namespace": meta.get("namespace", "default"),
+                         "resourceVersion": meta.get("resourceVersion")},
+            "spec": {"replicas": int(
+                obj.get("spec", {}).get("replicas", 0) or 0)},
+            "status": {"replicas": int(
+                obj.get("status", {}).get("replicas",
+                                          obj.get("spec", {})
+                                          .get("replicas", 0)) or 0)},
+        }
+
+    def get_scale(self, kind: str, namespace: str,
+                  name: str) -> Dict[str, Any]:
+        """GET the scale subresource (autoscaling/v1 Scale shape) of a
+        replica-bearing object — what `kubectl scale` reads and the
+        serving autoscaler's DeploymentScaler consumes."""
+        self._admit("get_scale", kind, namespace, name)
+        with self._lock:
+            try:
+                obj = self._objects[(kind, namespace, name)]
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name}") from None
+            return self._scale_shape(obj)
+
+    def update_scale(self, kind: str, namespace: str, name: str,
+                     replicas: int,
+                     resource_version: Optional[str] = None
+                     ) -> Dict[str, Any]:
+        """PUT the scale subresource: sets spec.replicas WITHOUT
+        touching the rest of the object — the narrow write the
+        autoscaler's RBAC story depends on (no pod-template access).
+        A carried ``resource_version`` that no longer matches raises
+        Conflict (the apiserver's optimistic-concurrency contract:
+        a read-modify-PUT loses races loudly, never last-write-wins).
+        Emits MODIFIED like any spec change; a no-op count neither
+        bumps resourceVersion nor wakes watchers (same suppression as
+        patch)."""
+        self._admit("update_scale", kind, namespace, name)
+        replicas = int(replicas)
+        if replicas < 0:
+            raise Conflict(f"{kind} {namespace}/{name}: negative "
+                           f"replicas {replicas}")
+        with self._lock:
+            key = (kind, namespace, name)
+            if key not in self._objects:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            obj = self._objects[key]
+            current_rv = obj.get("metadata", {}).get("resourceVersion")
+            if (resource_version is not None
+                    and resource_version != current_rv):
+                raise Conflict(
+                    f"{kind} {namespace}/{name}: scale "
+                    f"resourceVersion {resource_version} is stale "
+                    f"(now {current_rv})")
+            spec = obj.setdefault("spec", {})
+            if spec.get("replicas") != replicas:
+                spec["replicas"] = replicas
+                self._revision += 1
+                obj["metadata"]["resourceVersion"] = str(self._revision)
+                self._record("MODIFIED", obj)
+            return self._scale_shape(obj)
+
     def delete(self, kind: str, namespace: str, name: str) -> None:
         self._admit("delete", kind, namespace, name)
         with self._lock:

@@ -61,6 +61,7 @@ SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 _RESOURCES: Dict[str, Tuple[str, str, str]] = {
     KIND: ("apis", f"{GROUP}/{VERSION}", PLURAL),
     "Pod": ("api", "v1", "pods"),
+    "Deployment": ("apis", "apps/v1", "deployments"),
     "Service": ("api", "v1", "services"),
     "PodDisruptionBudget": ("apis", "policy/v1", "poddisruptionbudgets"),
     "Event": ("api", "v1", "events"),
@@ -221,6 +222,29 @@ class HttpApiClient:
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         self._json("DELETE", self._path(kind, namespace, name))
+
+    # -- scale subresource -------------------------------------------------
+
+    def get_scale(self, kind: str, namespace: str,
+                  name: str) -> Dict[str, Any]:
+        """GET the scale subresource (autoscaling/v1 Scale) — the
+        serving autoscaler's read path."""
+        return self._json(
+            "GET", self._path(kind, namespace, name,
+                              subresource="scale"))
+
+    def update_scale(self, kind: str, namespace: str, name: str,
+                     replicas: int) -> Dict[str, Any]:
+        """PUT the scale subresource with the desired replica count —
+        the narrowest write that resizes a Deployment (what `kubectl
+        scale` does; no pod-template RBAC needed). Read-modify-PUT so
+        the carried resourceVersion turns a concurrent writer into a
+        Conflict, like patch()."""
+        scale = self.get_scale(kind, namespace, name)
+        scale.setdefault("spec", {})["replicas"] = int(replicas)
+        return self._json(
+            "PUT", self._path(kind, namespace, name,
+                              subresource="scale"), scale)
 
     def pod_logs(self, namespace: str, name: str, *,
                  tail: int = 100) -> str:

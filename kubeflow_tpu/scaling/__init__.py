@@ -1,0 +1,30 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Horizontal scaling for the serving fleet (ISSUE 5): endpoint
+registry + health probing (:mod:`endpoints`), routing policies
+(:mod:`balancer`), and the metrics-driven autoscaler
+(:mod:`autoscaler`). docs/scaling.md is the operator guide."""
+
+from kubeflow_tpu.scaling.balancer import (  # noqa: F401
+    eligible_endpoints,
+    make_balancer,
+)
+from kubeflow_tpu.scaling.endpoints import (  # noqa: F401
+    Endpoint,
+    EndpointPool,
+    FileEndpointSource,
+    HealthProber,
+    StaticEndpointSource,
+)

@@ -1,0 +1,601 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Metrics-driven autoscaler for the serving fleet.
+
+Closes the loop the ROADMAP's "heavy traffic from millions of users"
+north star needs: observed per-replica saturation → desired replica
+count → ``spec.replicas`` on the serving Deployment. The control
+pattern is the one the K8s GenAI-inference evaluation (PAPERS: arxiv
+2602.04900) and the TPU-pod concurrency study (arxiv 2011.03641) both
+converge on: keep accelerators busy but queues short, and move
+capacity — not deadlines — when saturation drifts.
+
+Control law (:class:`Autoscaler.evaluate`), deliberately HPA-shaped
+so its failure modes are the well-studied ones:
+
+- The per-replica signal is **estimated queue wait** in ms
+  (``queue_depth × est_batch_latency_ms`` summed over the replica's
+  models — the same numbers ``/healthz`` ``saturation`` and
+  ``batch_stats`` report). ``ratio = mean / target``.
+- **Shedding overrides the queue math**: any nonzero shed/expired
+  rate forces at least a scale-up-triggering ratio. A replica that is
+  turning work away is undersized whatever its queue says (admission
+  control keeps queues short exactly when overloaded — the queue
+  signal alone would read "healthy").
+- **Hysteresis band**: no action while ratio sits inside
+  ``[1-hysteresis, 1+hysteresis]`` — the deadband that keeps a
+  converged fleet from hunting.
+- **Cooldowns**: scale-ups are rate-limited by ``scale_up_cooldown_s``
+  (let the new replica load models and take traffic before judging
+  again); scale-downs additionally require ``scale_down_cooldown_s``
+  of quiet since ANY action (an up immediately followed by a down is
+  oscillation, not control).
+- **Clamps**: desired ∈ [min_replicas, max_replicas]; one decision
+  may at most double the fleet going up (cold replicas take minutes
+  to load — overshooting past double buys nothing but bill) and at
+  most halve it going down (one transiently-empty sample must not
+  collapse the fleet).
+
+Actuation goes through the :class:`Scaler` interface; the production
+implementation patches the Deployment's **scale subresource** via
+``operator/http_client.py`` (exercised hermetically against
+``FakeApiServer``). The loop also publishes the fleet snapshot + last
+decision to the ``serving-fleet-metrics`` ConfigMap (the PR 2
+operator-metrics pattern) for the dashboard's ``/tpujobs/api/fleet``,
+and optionally rewrites the proxy's endpoints file (atomic rename;
+``FileEndpointSource`` hot-reloads it).
+
+Wait discipline: ``Event.wait(interval)`` paces the loop (bounded,
+interruptible), all timing is ``time.monotonic`` — scripts/lint.py
+enforces both here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import logging
+import math
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs.tracing import TRACER
+from kubeflow_tpu.scaling.endpoints import (
+    scrape_healthz,
+    write_endpoints_file,
+)
+
+logger = logging.getLogger(__name__)
+
+#: ConfigMap the loop publishes fleet membership/health/decisions to —
+#: the dashboard's /tpujobs/api/fleet reads this exact object (the
+#: PR 2 tpujob-operator-metrics pattern).
+FLEET_CONFIGMAP = "serving-fleet-metrics"
+FLEET_KEY = "fleet.json"
+
+_G_DESIRED = obs_metrics.Gauge(
+    "kft_autoscaler_desired_replicas",
+    "Replica count the last autoscaler decision asked for")
+_G_QUEUE_WAIT = obs_metrics.Gauge(
+    "kft_autoscaler_mean_queue_wait_ms",
+    "Fleet mean estimated queue wait driving the autoscaler")
+_C_DECISIONS = obs_metrics.Counter(
+    "kft_autoscaler_decisions_total",
+    "Autoscaler evaluations by resulting action", ("action",))
+
+
+@dataclass
+class AutoscalerConfig:
+    """Tuning knobs (runbook: docs/scaling.md)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 5
+    #: The saturation target: mean per-replica estimated queue wait
+    #: (ms) the controller steers toward. Rule of thumb: a small
+    #: multiple of one batch latency — deep enough to keep batches
+    #: full, shallow enough that queue wait never dominates the
+    #: deadline budget.
+    target_queue_wait_ms: float = 100.0
+    #: Deadband half-width around ratio 1.0 (0.2 → no action while
+    #: the mean sits within ±20% of target).
+    hysteresis: float = 0.2
+    scale_up_cooldown_s: float = 15.0
+    scale_down_cooldown_s: float = 60.0
+
+    def validate(self) -> None:
+        if not (1 <= self.min_replicas <= self.max_replicas):
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{self.min_replicas}..{self.max_replicas}")
+        if self.target_queue_wait_ms <= 0:
+            raise ValueError("target_queue_wait_ms must be > 0")
+        if not (0 < self.hysteresis < 1):
+            raise ValueError("hysteresis must be in (0, 1)")
+
+
+class Scaler:
+    """Actuation interface: read and write the fleet's replica count."""
+
+    def get_replicas(self) -> int:
+        raise NotImplementedError
+
+    def set_replicas(self, replicas: int) -> None:
+        raise NotImplementedError
+
+
+class DeploymentScaler(Scaler):
+    """Scale a Deployment via its ``scale`` subresource — the
+    narrowest write the autoscaler's RBAC needs (no permission to
+    rewrite pod templates), and the same surface ``kubectl scale``
+    uses. Works against FakeApiServer and HttpApiClient alike (both
+    implement get_scale/update_scale)."""
+
+    def __init__(self, api: Any, namespace: str, name: str):
+        self.api = api
+        self.namespace = namespace
+        self.name = name
+
+    def get_replicas(self) -> int:
+        scale = self.api.get_scale("Deployment", self.namespace,
+                                   self.name)
+        return int(scale.get("spec", {}).get("replicas", 0))
+
+    def set_replicas(self, replicas: int) -> None:
+        self.api.update_scale("Deployment", self.namespace, self.name,
+                              int(replicas))
+
+
+class Autoscaler:
+    """The pure decision core: per-replica metrics in, one decision
+    dict out (and the Scaler actuated when the decision says act).
+    Injectable clock so hysteresis/cooldown behavior is simulated in
+    tests over a scripted trace, no sleeping."""
+
+    def __init__(self, config: AutoscalerConfig, scaler: Scaler, *,
+                 clock: Callable[[], float] = time.monotonic):
+        config.validate()
+        self.config = config
+        self.scaler = scaler
+        self._clock = clock
+        self._last_up_at: Optional[float] = None
+        self._last_action_at: Optional[float] = None
+        self.last_decision: Optional[Dict[str, Any]] = None
+
+    def evaluate(self, replica_metrics: Sequence[Dict[str, Any]],
+                 now: Optional[float] = None, *,
+                 unreachable: int = 0) -> Dict[str, Any]:
+        """One control step.
+
+        ``replica_metrics``: one dict per *reporting* replica with
+        ``queue_wait_ms`` (queue_depth × est_batch_latency_ms) and
+        ``shed_rate`` / ``expired_rate`` (per second, computed by the
+        caller from the cumulative healthz counters). ``unreachable``
+        counts discovered-but-unscrapeable replicas: blind spots may
+        be saturated (or dead — capacity already lost), so while any
+        exist scale-UP still acts on the survivors' signal but
+        scale-DOWN holds (HPA's rule: missing metrics read as 100%
+        utilization for shrink decisions), and the controller holds
+        entirely when it sees nothing (scaling on blindness is how
+        outages get bigger).
+        """
+        cfg = self.config
+        now = self._clock() if now is None else now
+        current = self.scaler.get_replicas()
+        t0 = now
+
+        def decide(action: str, desired: int, reason: str,
+                   mean_wait: float, ratio: float) -> Dict[str, Any]:
+            decision = {
+                "at_monotonic": now,
+                "current": current,
+                "desired": desired,
+                "action": action,
+                "reason": reason,
+                "mean_queue_wait_ms": round(mean_wait, 3),
+                "target_queue_wait_ms": cfg.target_queue_wait_ms,
+                "ratio": round(ratio, 4),
+                "replicas_reporting": len(replica_metrics),
+                "replicas_unreachable": unreachable,
+            }
+            _C_DECISIONS.labels(action).inc()
+            _G_DESIRED.set(float(desired))
+            _G_QUEUE_WAIT.set(mean_wait)
+            TRACER.record("autoscaler_decide", "autoscaler", t0,
+                          self._clock() - t0, decision)
+            self.last_decision = decision
+            return decision
+
+        if replica_metrics:
+            mean_wait = sum(float(m.get("queue_wait_ms", 0.0))
+                            for m in replica_metrics) \
+                / len(replica_metrics)
+            shed_rate = sum(float(m.get("shed_rate", 0.0))
+                            + float(m.get("expired_rate", 0.0))
+                            for m in replica_metrics)
+            ratio = mean_wait / cfg.target_queue_wait_ms
+        else:
+            mean_wait = shed_rate = ratio = 0.0
+        # min/max are hard clamps on the FLEET, not just on decisions:
+        # enforce them before (and regardless of) any load math —
+        # even blind, and without cooldown gating. The load branches
+        # below never move a fleet that is already outside its bounds
+        # back inside them (scale-down holds at `desired >= current`),
+        # and with `router true` the manifest omits spec.replicas, so
+        # a brand-new Deployment legitimately starts at the apiserver
+        # default of 1 and must climb to min_replicas on the first
+        # cycle.
+        if current < cfg.min_replicas:
+            self.scaler.set_replicas(cfg.min_replicas)
+            self._last_up_at = self._last_action_at = now
+            return decide("scale_up", cfg.min_replicas,
+                          "below_min_replicas", mean_wait, ratio)
+        if current > cfg.max_replicas:
+            self.scaler.set_replicas(cfg.max_replicas)
+            self._last_action_at = now
+            return decide("scale_down", cfg.max_replicas,
+                          "above_max_replicas", mean_wait, ratio)
+        if not replica_metrics:
+            return decide("hold", current, "no_replica_metrics", 0.0, 0.0)
+        reason = "queue_wait"
+        if shed_rate > 0:
+            # A shedding fleet is undersized regardless of queue math
+            # (admission control keeps queues short precisely when
+            # overloaded). Escalate to at least one step up.
+            ratio = max(ratio, 1.0 + cfg.hysteresis + 0.01)
+            reason = "shedding"
+
+        if ratio > 1.0 + cfg.hysteresis:
+            desired = math.ceil(current * ratio)
+            desired = min(desired, current * 2, cfg.max_replicas)
+            desired = max(desired, min(current + 1, cfg.max_replicas))
+            if desired <= current:
+                return decide("hold", current, "at_max_replicas",
+                              mean_wait, ratio)
+            if (self._last_up_at is not None
+                    and now - self._last_up_at
+                    < cfg.scale_up_cooldown_s):
+                return decide("hold", current, "scale_up_cooldown",
+                              mean_wait, ratio)
+            self.scaler.set_replicas(desired)
+            self._last_up_at = self._last_action_at = now
+            return decide("scale_up", desired, reason, mean_wait, ratio)
+
+        if ratio < 1.0 - cfg.hysteresis:
+            if unreachable > 0:
+                # A partial outage looks idle from the survivors'
+                # queues precisely because the fleet already lost
+                # capacity; shrinking spec.replicas now could delete
+                # LIVE pods and compound it.
+                return decide("hold", current, "unreachable_replicas",
+                              mean_wait, ratio)
+            desired = max(math.ceil(current * ratio), cfg.min_replicas)
+            # Symmetric step clamp: one decision may at most HALVE
+            # the fleet, as scale-up may at most double it. A single
+            # zero-queue sample (a scrape landing between dispatches)
+            # must not collapse max→min in one write when cold
+            # replicas take minutes to come back.
+            desired = max(desired, math.ceil(current / 2))
+            if desired >= current:
+                return decide("hold", current, "at_min_replicas",
+                              mean_wait, ratio)
+            # Downscale needs quiet since ANY action: an up followed
+            # promptly by a down is oscillation, not control.
+            if (self._last_action_at is not None
+                    and now - self._last_action_at
+                    < cfg.scale_down_cooldown_s):
+                return decide("hold", current, "scale_down_cooldown",
+                              mean_wait, ratio)
+            self.scaler.set_replicas(desired)
+            self._last_action_at = now
+            return decide("scale_down", desired, reason, mean_wait,
+                          ratio)
+
+        return decide("hold", current, "within_hysteresis_band",
+                      mean_wait, ratio)
+
+
+def discover_pod_endpoints(api: Any, namespace: str,
+                           label_selector: Dict[str, Optional[str]],
+                           *, rest_port: int = 8500,
+                           grpc_port: Optional[int] = 9000
+                           ) -> List[Tuple[str, Optional[str]]]:
+    """Fleet membership from the apiserver: Running pods matching the
+    serving Deployment's label selector, addressed by pod IP. Pods
+    without an IP yet (scheduling, image pull) are simply not members
+    — the prober/balancer never has to learn about them failing."""
+    specs: List[Tuple[str, Optional[str]]] = []
+    for pod in api.list("Pod", namespace, label_selector=label_selector):
+        status = pod.get("status", {})
+        ip = status.get("podIP")
+        if not ip or status.get("phase") != "Running":
+            continue
+        specs.append((f"{ip}:{rest_port}",
+                      f"{ip}:{grpc_port}" if grpc_port else None))
+    return specs
+
+
+class AutoscalerLoop:
+    """The sidecar control loop: discover → scrape → decide → actuate
+    → publish, every ``interval_s`` (Event-paced, monotonic-timed).
+
+    Per-replica shed/expired arrive as *cumulative* counters in the
+    healthz saturation schema; the loop differentiates them per
+    address across ticks to hand the decision core rates. A replica
+    restart (counter reset) clamps the delta at zero rather than
+    reading as a giant negative rate.
+    """
+
+    def __init__(self, autoscaler: Autoscaler, *,
+                 discover: Callable[[], Sequence[Tuple[str,
+                                                       Optional[str]]]],
+                 interval_s: float = 2.0,
+                 scrape: Optional[Callable[[str], Dict[str, Any]]] = None,
+                 scrape_timeout_s: float = 2.0,
+                 api: Optional[Any] = None,
+                 namespace: str = "default",
+                 write_endpoints_path: Optional[str] = None):
+        self.autoscaler = autoscaler
+        self.discover = discover
+        self.interval_s = interval_s
+        self._scrape = scrape or (
+            lambda addr: scrape_healthz(addr, scrape_timeout_s))
+        self.api = api
+        self.namespace = namespace
+        self.write_endpoints_path = write_endpoints_path
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._scrapers: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
+        # address → (cumulative shed+expired, monotonic at sample).
+        self._counters: Dict[str, Tuple[float, float, float]] = {}
+        self.last_fleet: List[Dict[str, Any]] = []
+
+    def _replica_sample(self, address: str,
+                        payload: Optional[Dict[str, Any]],
+                        now: float) -> Dict[str, Any]:
+        """Fold one scrape into (metrics row, fleet-snapshot row)."""
+        if payload is None:
+            self._counters.pop(address, None)
+            return {"address": address, "reachable": False}
+        queue_wait = 0.0
+        shed = expired = 0.0
+        for stats in (payload.get("saturation") or {}).values():
+            queue_wait += (float(stats.get("queue_depth", 0.0))
+                           * float(stats.get("est_batch_latency_ms",
+                                             0.0)))
+            shed += float(stats.get("shed", 0.0))
+            expired += float(stats.get("expired", 0.0))
+        prev = self._counters.get(address)
+        shed_rate = expired_rate = 0.0
+        if prev is not None:
+            prev_shed, prev_expired, prev_at = prev
+            dt = max(1e-3, now - prev_at)
+            # max(0, ...): a restarted replica resets its counters.
+            shed_rate = max(0.0, shed - prev_shed) / dt
+            expired_rate = max(0.0, expired - prev_expired) / dt
+        self._counters[address] = (shed, expired, now)
+        return {
+            "address": address,
+            "reachable": True,
+            "status": payload.get("status", ""),
+            "queue_wait_ms": round(queue_wait, 3),
+            "shed_rate": round(shed_rate, 4),
+            "expired_rate": round(expired_rate, 4),
+            "resident_models": sorted(payload.get("saturation") or {}),
+        }
+
+    def _scrape_one(self, address: str
+                    ) -> Tuple[Optional[Dict[str, Any]], float]:
+        try:
+            payload: Optional[Dict[str, Any]] = self._scrape(address)
+        except Exception:  # noqa: BLE001 — unreachable replica
+            payload = None
+        # Timestamp at scrape RETURN, per replica: a timed-out scrape
+        # lands ~scrape_timeout_s after the quick ones, and the rate
+        # denominators (now - prev_at) must price each counter delta
+        # over ITS actual sample spacing.
+        return payload, time.monotonic()
+
+    def tick(self) -> Dict[str, Any]:
+        """One discover→scrape→decide→publish cycle (tests call this
+        directly; run() paces it)."""
+        specs = list(self.discover())
+        if self.write_endpoints_path:
+            try:
+                write_endpoints_file(self.write_endpoints_path, specs)
+            except OSError:
+                logger.warning("could not write endpoints file %s",
+                               self.write_endpoints_path, exc_info=True)
+        fleet: List[Dict[str, Any]] = []
+        metrics: List[Dict[str, Any]] = []
+        addresses = [address for address, _grpc in specs]
+        live = set(addresses)
+        # Concurrent scrapes (the HealthProber pattern): N dead
+        # replicas cost the cycle ONE scrape timeout, not N — a
+        # half-down fleet is exactly when scale-up decisions must not
+        # arrive several intervals late. Each scrape is itself bounded
+        # by scrape_timeout_s, so the map drains within one timeout.
+        # One executor for the loop's lifetime (stop() shuts it
+        # down), not one per tick.
+        results: List[Tuple[Optional[Dict[str, Any]], float]] = []
+        if addresses:
+            if self._scrapers is None:
+                self._scrapers = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="scrape")
+            results = list(self._scrapers.map(self._scrape_one,
+                                              addresses))
+        for address, (payload, sampled_at) in zip(addresses, results):
+            row = self._replica_sample(address, payload, sampled_at)
+            fleet.append(row)
+            if row.get("reachable"):
+                metrics.append(row)
+        for address in list(self._counters):
+            if address not in live:  # departed replicas drop history
+                del self._counters[address]
+        decision = self.autoscaler.evaluate(
+            metrics, now=time.monotonic(),
+            unreachable=len(fleet) - len(metrics))
+        self.last_fleet = fleet
+        self.publish(fleet, decision)
+        return decision
+
+    def publish(self, fleet: List[Dict[str, Any]],
+                decision: Dict[str, Any]) -> None:
+        """Best-effort fleet ConfigMap write (the operator
+        publish_metrics pattern: identical snapshots are no-op writes
+        on the fake/apiserver side, so a quiet fleet publishes
+        nothing)."""
+        if self.api is None:
+            return
+        decision = dict(decision)
+        # Monotonic timestamps mean nothing to other processes; ship
+        # the decision's age instead (readers render "Ns ago").
+        decision["age_s"] = round(
+            time.monotonic() - decision.pop("at_monotonic"), 1)
+        payload = json.dumps({"replicas": fleet, "decision": decision},
+                             sort_keys=True)
+        try:
+            from kubeflow_tpu.operator.fake import NotFound
+
+            try:
+                self.api.patch(
+                    "ConfigMap", self.namespace, FLEET_CONFIGMAP,
+                    lambda o: o.setdefault("data", {}).update(
+                        {FLEET_KEY: payload}))
+            except NotFound:
+                self.api.create({
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {"name": FLEET_CONFIGMAP,
+                                 "namespace": self.namespace},
+                    "data": {FLEET_KEY: payload},
+                })
+        except Exception:  # noqa: BLE001 — publishing must never wedge
+            logger.debug("fleet publish failed", exc_info=True)
+
+    def run(self, *, max_cycles: Optional[int] = None) -> None:
+        cycles = 0
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                logger.exception("autoscaler tick failed")
+            cycles += 1
+            if max_cycles is not None and cycles >= max_cycles:
+                return
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self.run,
+                                        name="autoscaler", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._scrapers is not None:
+            self._scrapers.shutdown(wait=False)
+            self._scrapers = None
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kft-autoscaler")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--deployment", required=True,
+                        help="serving Deployment whose scale "
+                             "subresource is actuated")
+    parser.add_argument("--selector", default=None,
+                        help="pod label selector for replica "
+                             "discovery (key=value[,k=v]); default "
+                             "app=<deployment>")
+    parser.add_argument("--rest_port", type=int, default=8500)
+    parser.add_argument("--grpc_port", type=int, default=9000,
+                        help="0 = fleet members have no binary "
+                             "upstream")
+    parser.add_argument("--min_replicas", type=int, default=1)
+    parser.add_argument("--max_replicas", type=int, default=5)
+    parser.add_argument("--target_queue_wait_ms", type=float,
+                        default=100.0)
+    parser.add_argument("--hysteresis", type=float, default=0.2)
+    parser.add_argument("--scale_up_cooldown", type=float, default=15.0)
+    parser.add_argument("--scale_down_cooldown", type=float,
+                        default=60.0)
+    parser.add_argument("--interval", type=float, default=2.0)
+    parser.add_argument("--write_endpoints", default=None,
+                        help="atomically rewrite this JSON file with "
+                             "the discovered membership each cycle "
+                             "(the pooled proxy hot-reloads it)")
+    parser.add_argument("--apiserver", default=None,
+                        help="apiserver base URL (dev); default: "
+                             "in-cluster ServiceAccount")
+    parser.add_argument("--metrics_port", type=int, default=0,
+                        help="Prometheus /metrics (+ /tracez) "
+                             "exposition port; 0 disables")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from kubeflow_tpu.operator.http_client import HttpApiClient
+
+    api = (HttpApiClient(args.apiserver) if args.apiserver
+           else HttpApiClient.in_cluster())
+    selector: Dict[str, Optional[str]] = {"app": args.deployment}
+    if args.selector:
+        selector = {}
+        for pair in args.selector.split(","):
+            key, eq, value = pair.partition("=")
+            selector[key] = value if eq else None
+    config = AutoscalerConfig(
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+        target_queue_wait_ms=args.target_queue_wait_ms,
+        hysteresis=args.hysteresis,
+        scale_up_cooldown_s=args.scale_up_cooldown,
+        scale_down_cooldown_s=args.scale_down_cooldown)
+    autoscaler = Autoscaler(
+        config, DeploymentScaler(api, args.namespace, args.deployment))
+    loop = AutoscalerLoop(
+        autoscaler,
+        discover=lambda: discover_pod_endpoints(
+            api, args.namespace, selector, rest_port=args.rest_port,
+            grpc_port=args.grpc_port or None),
+        interval_s=args.interval, api=api, namespace=args.namespace,
+        write_endpoints_path=args.write_endpoints)
+    if args.metrics_port:
+        from kubeflow_tpu.obs.exposition import start_exposition_server
+
+        start_exposition_server(args.metrics_port)
+        logger.info("autoscaler metrics on :%d", args.metrics_port)
+    logger.info(
+        "autoscaler: deployment %s/%s, replicas %d..%d, target queue "
+        "wait %.0f ms", args.namespace, args.deployment,
+        config.min_replicas, config.max_replicas,
+        config.target_queue_wait_ms)
+    try:
+        loop.run()
+    except KeyboardInterrupt:
+        loop.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

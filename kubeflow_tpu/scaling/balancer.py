@@ -1,0 +1,173 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Routing policies over the endpoint pool.
+
+Three policies (ISSUE 5), all stateless over the pool except a pick
+counter, so they are swappable per proxy flag:
+
+- **round_robin** — equal-weight rotation; the baseline, and the tie
+  breaker inside the smarter policies (a pure ``min()`` would send
+  every tied pick to the same replica).
+- **least_saturation** — join-shortest-queue on the healthz
+  ``saturation`` signal (estimated queue wait, ms) plus this proxy's
+  live in-flight count (the between-probes correction; see
+  ``Endpoint.saturation_score``). This is the control signal the TPU
+  concurrency study (PAPERS: arxiv 2011.03641) uses to keep chips
+  busy: route to the replica that will start the work soonest.
+- **affinity** — resident-model affinity: prefer replicas where the
+  target model is already loaded (healthz saturation keys =
+  resident set; the server's ``get_resident`` fast path makes those
+  requests a dict lookup, while a non-resident replica may block
+  minutes on a cold load). Falls back to least-saturation over the
+  whole pool when every resident replica is overloaded (queue wait
+  past ``overload_ms``) or the model is resident nowhere —
+  affinity is a latency optimization, never a availability
+  constraint.
+
+Eligibility (``eligible_endpoints``) is shared by every policy and by
+the proxy's failover loop: skip ejected/draining members and members
+whose REST breaker is open-and-not-yet-due, but degrade gracefully —
+when the filter empties the candidate set, fall back to the least-bad
+tier rather than refusing to route (a fleet that is all-ejected must
+still place probe traffic, or nothing ever readmits without the
+prober)."""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+from kubeflow_tpu.scaling.endpoints import Endpoint, EndpointPool
+
+__all__ = [
+    "Balancer",
+    "LeastSaturationBalancer",
+    "ResidentAffinityBalancer",
+    "RoundRobinBalancer",
+    "eligible_endpoints",
+    "make_balancer",
+]
+
+#: A breaker-open endpoint re-enters the candidate set this close to
+#: (or past) its half-open due time — the pick that lands on it IS the
+#: recovery probe. Without this, a pool with any healthy member would
+#: never probe an open breaker and a revived replica could only rejoin
+#: via the prober.
+_PROBE_DUE_S = 0.05
+
+
+def eligible_endpoints(pool: EndpointPool,
+                       exclude: Sequence[Endpoint] = ()
+                       ) -> List[Endpoint]:
+    """Candidates for one routing attempt, best tier first that is
+    non-empty: routable members with non-open (or probe-due) REST
+    breakers → routable members → any non-excluded member. Excluded
+    members (already tried this request) never return."""
+    excluded = set(id(ep) for ep in exclude)
+    members = [ep for ep in pool.endpoints() if id(ep) not in excluded]
+    routable = [ep for ep in members if ep.routable()]
+    tier = routable or members
+    closed = [ep for ep in tier
+              if ep.rest_breaker.state != "open"
+              or ep.rest_breaker.retry_after_s() <= _PROBE_DUE_S]
+    return closed or tier
+
+
+class Balancer:
+    """Base policy: pick one endpoint from a candidate list. The
+    candidate list comes from ``eligible_endpoints`` (the proxy calls
+    it per attempt so failover can exclude already-tried members)."""
+
+    name = "base"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._picks = 0
+
+    def _next_index(self, n: int) -> int:
+        with self._lock:
+            i = self._picks
+            self._picks += 1
+        return i % n
+
+    def pick(self, candidates: Sequence[Endpoint],
+             model: Optional[str] = None) -> Optional[Endpoint]:
+        raise NotImplementedError
+
+
+class RoundRobinBalancer(Balancer):
+    name = "round_robin"
+
+    def pick(self, candidates: Sequence[Endpoint],
+             model: Optional[str] = None) -> Optional[Endpoint]:
+        if not candidates:
+            return None
+        return candidates[self._next_index(len(candidates))]
+
+
+class LeastSaturationBalancer(Balancer):
+    name = "least_saturation"
+
+    def pick(self, candidates: Sequence[Endpoint],
+             model: Optional[str] = None) -> Optional[Endpoint]:
+        if not candidates:
+            return None
+        offset = self._next_index(len(candidates))  # rotating tiebreak
+        return min(
+            (candidates[(offset + i) % len(candidates)]
+             for i in range(len(candidates))),
+            key=lambda ep: ep.saturation_score())
+
+
+class ResidentAffinityBalancer(Balancer):
+    """Prefer replicas where the model is already resident; overflow
+    to the whole pool when they are saturated past ``overload_ms`` of
+    estimated queue wait (the fallback-on-overload contract: affinity
+    buys cache hits, not hotspots)."""
+
+    name = "affinity"
+
+    def __init__(self, overload_ms: float = 500.0):
+        super().__init__()
+        self.overload_ms = overload_ms
+        self._fallback = LeastSaturationBalancer()
+
+    def pick(self, candidates: Sequence[Endpoint],
+             model: Optional[str] = None) -> Optional[Endpoint]:
+        if not candidates:
+            return None
+        if model:
+            resident = [ep for ep in candidates
+                        if model in ep.saturation
+                        and ep.saturation_score() < self.overload_ms]
+            if resident:
+                return self._fallback.pick(resident, model)
+        return self._fallback.pick(candidates, model)
+
+
+_POLICIES = {
+    cls.name: cls for cls in (RoundRobinBalancer, LeastSaturationBalancer,
+                              ResidentAffinityBalancer)
+}
+
+
+def make_balancer(name: str) -> Balancer:
+    """Policy factory for the --balancer flag."""
+    try:
+        return _POLICIES[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown balancer {name!r}; one of {sorted(_POLICIES)}"
+        ) from None

@@ -1,0 +1,445 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Router scaling benchmark: throughput across 1→N replicas + failover.
+
+`python bench.py --router` drives the POOLED proxy
+(serving/http_proxy.py over kubeflow_tpu/scaling/) in front of 1, 2,
+then 3 in-process stub backends, with a closed-loop client fleet, and
+reports (a) aggregate throughput per replica count — the ISSUE 5
+acceptance is ≥2.5× at 3 replicas — and (b) failover behavior when
+one of three backends is killed mid-load: breaker-eject latency and
+whether any in-deadline request was lost.
+
+Measurement method (PERF.md r9 note: this box's cgroup throttling
+swings wall-clock phase throughput ±30-40%, so wall A/B cannot carry
+an assertion): each stub backend models a SERIAL accelerator — an
+asyncio lock around an `asyncio.sleep(service_time_s)` — so the
+per-request service time is a scheduler sleep, not CPU, and the
+replica-scaling signal (completed requests per second against a known
+20-ish ms service floor) is dominated by a quantity throttling cannot
+shrink. The asserted number is the throughput RATIO between replica
+counts of the same run (same harness overhead in numerator and
+denominator); per-request component timings (client-observed p50
+minus the known service time = the router's added cost) ride along.
+
+The stub fleet (:class:`StubBackendFleet`) is importable by tests —
+tests/test_serving_stress.py runs the kill-one-of-three e2e on it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+MODEL = "routed"
+
+
+def _metadata_payload() -> Dict[str, Any]:
+    return {
+        "model_spec": {"name": MODEL, "version": "1"},
+        "metadata": {"signatures": {"serving_default": {
+            "method": "predict",
+            "inputs": {"x": {"dtype": "float32", "shape": [-1, 1]}},
+            "outputs": {"y": {"dtype": "float32", "shape": [-1, 1]}},
+        }}},
+    }
+
+
+class StubBackendFleet:
+    """N in-process model-server stand-ins + (optionally) the pooled
+    proxy, all on ONE IOLoop in a dedicated thread.
+
+    Each backend serves the REST surface the proxy speaks — metadata,
+    ``:predict``, ``/healthz`` with the PR 3/4 saturation schema —
+    and models a serial accelerator: one ``asyncio.Lock`` around an
+    ``asyncio.sleep(service_time_s)``, so a backend's capacity is
+    exactly ``1/service_time_s`` rps and fleet throughput should
+    scale ~linearly with members. ``kill(i)``/``revive(i)`` stop and
+    restart a backend's listener mid-load (connection-refused, the
+    way a deleted pod fails).
+    """
+
+    def __init__(self, n: int, *, service_time_s: float = 0.04,
+                 proxy_kwargs: Optional[Dict[str, Any]] = None):
+        self.n = n
+        self.service_time_s = service_time_s
+        self.proxy_kwargs = proxy_kwargs
+        self.ports: List[int] = []
+        self.proxy_port: int = 0
+        self.proxy_app: Any = None
+        self.completed = [0] * n
+        self.busy_s = [0.0] * n
+        self._locks: List[Any] = []
+        self._servers: List[Any] = []
+        self._sockets: List[Any] = []
+        self.loop: Any = None
+        self._started = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- backend app -------------------------------------------------------
+
+    def _backend_app(self, index: int):
+        import tornado.web
+
+        fleet = self
+
+        class Meta(tornado.web.RequestHandler):
+            def get(self, name):
+                self.write(_metadata_payload())
+
+        class Predict(tornado.web.RequestHandler):
+            async def post(self, name, version, verb):
+                body = json.loads(self.request.body or b"{}")
+                rows = body.get("instances") or []
+                lock = fleet._locks[index]
+                async with lock:
+                    t0 = time.monotonic()
+                    await asyncio.sleep(fleet.service_time_s)
+                    fleet.busy_s[index] += time.monotonic() - t0
+                fleet.completed[index] += 1
+                self.write({"model_spec": {"name": name,
+                                           "version": "1"},
+                            "predictions": [[float(index)]
+                                            for _ in rows]})
+
+        class Health(tornado.web.RequestHandler):
+            def get(self):
+                lock = fleet._locks[index]
+                queue_depth = len(getattr(lock, "_waiters", None) or ())
+                self.write({"status": "ok", "breakers": {},
+                            "saturation": {MODEL: {
+                                "queue_depth": queue_depth,
+                                "est_batch_latency_ms":
+                                    fleet.service_time_s * 1e3,
+                                "shed": 0, "expired": 0,
+                                "batches": fleet.completed[index],
+                                "rows": fleet.completed[index],
+                            }}})
+
+        return tornado.web.Application([
+            (r"/v1/models/([^/:]+)/metadata", Meta),
+            (r"/v1/models/([^/:]+)(?:/versions/(\d+))?:(\w+)", Predict),
+            (r"/healthz", Health),
+        ])
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _run(self) -> None:
+        import tornado.httpserver
+        import tornado.ioloop
+        import tornado.testing
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self.loop = tornado.ioloop.IOLoop.current()
+        self._locks = [asyncio.Lock() for _ in range(self.n)]
+        for i in range(self.n):
+            sock, port = tornado.testing.bind_unused_port()
+            server = tornado.httpserver.HTTPServer(self._backend_app(i))
+            server.add_sockets([sock])
+            self.ports.append(port)
+            self._servers.append(server)
+            self._sockets.append(sock)
+        if self.proxy_kwargs is not None:
+            from kubeflow_tpu.serving.http_proxy import make_app
+
+            sock, self.proxy_port = tornado.testing.bind_unused_port()
+            self.proxy_app = make_app(
+                [f"127.0.0.1:{p}" for p in self.ports],
+                **self.proxy_kwargs)
+            proxy_server = tornado.httpserver.HTTPServer(self.proxy_app)
+            proxy_server.add_sockets([sock])
+            self._servers.append(proxy_server)
+            self.proxy_app.settings["prober"].start()
+        self._started.set()
+        self.loop.start()
+
+    def start(self) -> "StubBackendFleet":
+        self._thread = threading.Thread(target=self._run,
+                                        name="stub-fleet", daemon=True)
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("stub fleet failed to start")
+        return self
+
+    def kill(self, index: int) -> None:
+        """Stop backend ``index``'s listener (connection refused from
+        now on — a deleted pod)."""
+        done = threading.Event()
+
+        def _stop():
+            self._servers[index].stop()
+            done.set()
+
+        self.loop.add_callback(_stop)
+        done.wait(5)
+
+    def revive(self, index: int) -> None:
+        """Restart backend ``index`` on its ORIGINAL port (the
+        readmission path needs the address to stay stable)."""
+        import socket
+
+        import tornado.httpserver
+
+        done = threading.Event()
+
+        def _start():
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind(("127.0.0.1", self.ports[index]))
+            sock.listen(128)
+            # Non-blocking is load-bearing: tornado's accept handler
+            # calls accept() until BlockingIOError; a blocking socket
+            # wedges the shared IOLoop after the first accept.
+            sock.setblocking(False)
+            server = tornado.httpserver.HTTPServer(
+                self._backend_app(index))
+            server.add_sockets([sock])
+            self._servers[index] = server
+            done.set()
+
+        self.loop.add_callback(_start)
+        done.wait(5)
+
+    def stop(self) -> None:
+        if self.loop is not None:
+            self.loop.add_callback(self.loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+@dataclass
+class RouterBenchConfig:
+    replicas: Tuple[int, ...] = (1, 2, 3)
+    #: Simulated serial service time per request (sleep-based — see
+    #: module docstring; CPU throttling cannot shrink it).
+    service_time_s: float = 0.04
+    clients: int = 6
+    measure_s: float = 3.0
+    warmup_requests: int = 8
+    deadline_ms: int = 5000
+    balancer: str = "least_saturation"
+    #: Failover phase (run at max(replicas)): kill one backend
+    #: mid-load, then revive it.
+    failover: bool = True
+    breaker_failures: int = 1
+    breaker_reset_s: float = 0.5
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+def _post_infer(port: int, deadline_ms: int,
+                timeout_s: float = 10.0) -> float:
+    payload = json.dumps({"instances": [[1.0]]}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/model/{MODEL}:predict", data=payload,
+        headers={"Content-Type": "application/json",
+                 "X-Deadline-Ms": str(deadline_ms)})
+    t0 = time.monotonic()
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        json.load(resp)
+    return time.monotonic() - t0
+
+
+def _drive(port: int, config: RouterBenchConfig, measure_s: float
+           ) -> Tuple[List[float], List[str]]:
+    """Closed-loop client fleet against the proxy; returns (per-
+    request latencies within the window, error strings)."""
+    latencies: List[float] = []
+    errors: List[str] = []
+    lock = threading.Lock()
+    t_end = time.monotonic() + measure_s
+
+    def client():
+        while time.monotonic() < t_end:
+            try:
+                dt = _post_infer(port, config.deadline_ms)
+            except urllib.error.HTTPError as e:
+                with lock:
+                    errors.append(f"HTTP {e.code}")
+                continue
+            except Exception as e:  # noqa: BLE001 — transport error
+                with lock:
+                    errors.append(type(e).__name__)
+                continue
+            with lock:
+                latencies.append(dt)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(config.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(measure_s + 30)
+    return latencies, errors
+
+
+def _pct(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def run_router_benchmark(config: Optional[RouterBenchConfig] = None
+                         ) -> Dict[str, Any]:
+    config = config or RouterBenchConfig()
+    proxy_kwargs = {"balancer": config.balancer,
+                    "breaker_failures": config.breaker_failures,
+                    "breaker_reset_s": config.breaker_reset_s,
+                    "probe_interval_s": 0.2}
+    rows: List[Dict[str, Any]] = []
+    for n in config.replicas:
+        fleet = StubBackendFleet(
+            n, service_time_s=config.service_time_s,
+            proxy_kwargs=proxy_kwargs).start()
+        try:
+            for _ in range(config.warmup_requests):
+                _post_infer(fleet.proxy_port, config.deadline_ms)
+            base_completed = sum(fleet.completed)
+            base_busy = sum(fleet.busy_s)
+            t0 = time.monotonic()
+            latencies, errors = _drive(fleet.proxy_port, config,
+                                       config.measure_s)
+            elapsed = time.monotonic() - t0
+            completed = sum(fleet.completed) - base_completed
+            busy = sum(fleet.busy_s) - base_busy
+            rows.append({
+                "replicas": n,
+                "rps": round(completed / elapsed, 1),
+                "completed": completed,
+                "errors": len(errors),
+                "p50_ms": round(_pct(latencies, 0.50) * 1e3, 1),
+                "p99_ms": round(_pct(latencies, 0.99) * 1e3, 1),
+                # Component timings: the router's added cost per
+                # request over the KNOWN sleep-based service time,
+                # and how busy the simulated accelerators actually
+                # were (utilization ≈ 1.0 = backend-bound, the regime
+                # where the scaling ratio is meaningful).
+                "router_overhead_p50_ms": round(
+                    (_pct(latencies, 0.50) - config.service_time_s)
+                    * 1e3, 1),
+                "utilization": round(busy / (elapsed * n), 3),
+                "service_ceiling_rps": round(n / config.service_time_s,
+                                             1),
+            })
+        finally:
+            fleet.stop()
+
+    result: Dict[str, Any] = {
+        "config": {
+            "service_time_s": config.service_time_s,
+            "clients": config.clients,
+            "measure_s": config.measure_s,
+            "balancer": config.balancer,
+        },
+        "rows": rows,
+    }
+    by_n = {r["replicas"]: r for r in rows}
+    if 1 in by_n:
+        for n, row in by_n.items():
+            row["speedup_vs_1"] = round(
+                row["rps"] / max(1e-9, by_n[1]["rps"]), 2)
+        top = max(by_n)
+        result["throughput_scaling"] = by_n[top]["speedup_vs_1"]
+        result["top_replicas"] = top
+
+    if config.failover:
+        result["failover"] = _run_failover_phase(config, proxy_kwargs)
+    return result
+
+
+def _run_failover_phase(config: RouterBenchConfig,
+                        proxy_kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    """Kill one of max-N backends mid-load: no in-deadline request may
+    fail (the router retries on another replica), the victim's breaker
+    must eject it fast, and the revived backend must rejoin."""
+    n = max(config.replicas)
+    fleet = StubBackendFleet(
+        n, service_time_s=config.service_time_s,
+        proxy_kwargs=proxy_kwargs).start()
+    try:
+        for _ in range(config.warmup_requests):
+            _post_infer(fleet.proxy_port, config.deadline_ms)
+        pool = fleet.proxy_app.settings["pool"]
+        victim_address = f"127.0.0.1:{fleet.ports[0]}"
+        victim = pool.get(victim_address)
+        result_box: Dict[str, Any] = {}
+
+        def wait_until(cond, timeout_s: float) -> None:
+            deadline = time.monotonic() + timeout_s
+            while not cond() and time.monotonic() < deadline:
+                time.sleep(0.002)  # poll; cheap next to the 40ms svc
+
+        def chaos():
+            # Let load establish, then kill backend 0 and time the
+            # router's reaction: first transport failure → breaker
+            # open (sub-second acceptance), prober eject, then revive
+            # → readmission.
+            time.sleep(0.8)
+            fleet.kill(0)
+            t_kill = time.monotonic()
+            wait_until(lambda: victim.rest_breaker.state == "open", 5.0)
+            result_box["breaker_open_ms"] = round(
+                (time.monotonic() - t_kill) * 1e3, 1)
+            wait_until(lambda: victim.health == "unhealthy", 5.0)
+            result_box["prober_eject_ms"] = round(
+                (time.monotonic() - t_kill) * 1e3, 1)
+            completed_before = fleet.completed[0]
+            fleet.revive(0)
+            t_revive = time.monotonic()
+            wait_until(
+                lambda: fleet.completed[0] > completed_before, 10.0)
+            result_box["rejoin_ms"] = round(
+                (time.monotonic() - t_revive) * 1e3, 1)
+
+        chaos_thread = threading.Thread(target=chaos, daemon=True)
+        chaos_thread.start()
+        latencies, errors = _drive(fleet.proxy_port, config,
+                                   config.measure_s + 2.0)
+        chaos_thread.join(30)
+        result_box.update({
+            "requests_ok": len(latencies),
+            "requests_failed": len(errors),
+            "failed_detail": sorted(set(errors)),
+            "p99_ms": round(_pct(latencies, 0.99) * 1e3, 1),
+            "max_ms": round(max(latencies, default=0.0) * 1e3, 1),
+            "victim_readmitted": victim.health == "healthy",
+        })
+        return result_box
+    finally:
+        fleet.stop()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="kft-router-bench")
+    parser.add_argument("--measure", type=float, default=3.0)
+    parser.add_argument("--clients", type=int, default=6)
+    args = parser.parse_args(argv)
+    result = run_router_benchmark(RouterBenchConfig(
+        measure_s=args.measure, clients=args.clients))
+    print(json.dumps(result, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,577 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Replica registry for the serving fleet: who exists, who is healthy.
+
+The reference stack fronted N TF-Serving replicas with a Deployment
+behind Ambassador (``tf-serving.libsonnet`` pins ``replicas``) and let
+kube-proxy pick a pod per connection — no health signal richer than
+the readiness probe, no saturation signal at all. This module is the
+registry half of the replacement control plane (ISSUE 5):
+
+- :class:`Endpoint` — one replica plus ALL of the proxy's per-replica
+  state: REST/gRPC circuit breakers, the metadata/signature cache
+  (keyed per upstream so one replica's hot reload never poisons
+  another's cache), the lazily-dialed gRPC channel, live in-flight
+  count, and the last ``/healthz`` snapshot (status + per-model
+  ``saturation`` — the PR 3/4 schema: queue_depth, shed/expired,
+  est_batch_latency_ms; the saturation keys double as the replica's
+  resident-model set for affinity routing).
+- :class:`EndpointPool` — thread-safe membership with drain-aware
+  removal: a replica being scaled away stops receiving new picks but
+  keeps its state until in-flight requests drain.
+- :class:`StaticEndpointSource` / :class:`FileEndpointSource` —
+  discovery. The file source is ConfigMap-shaped (a mounted JSON
+  file, rewritten by the autoscaler sidecar or a ConfigMap update)
+  and hot-reloads on content change, so membership follows the fleet
+  without a proxy restart.
+- :class:`HealthProber` — scrapes each replica's ``/healthz``,
+  ejects members after ``eject_after`` consecutive probe failures and
+  readmits them on the first success. Probe transitions are recorded
+  as router spans so an ejection is findable in /tracez.
+
+Wait discipline (scripts/lint.py check_operator_wait_discipline, now
+covering ``kubeflow_tpu/scaling/``): no ``time.sleep``, every wait
+bounded, monotonic clocks only.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs.tracing import TRACER
+
+logger = logging.getLogger(__name__)
+
+#: Endpoint health states. UNKNOWN (never probed) is routable — a
+#: fresh member must be able to take traffic before the first probe
+#: lands; its breaker protects the requests that find it dead.
+HEALTHY, UNHEALTHY, UNKNOWN, DRAINING = (
+    "healthy", "unhealthy", "unknown", "draining")
+
+_G_ENDPOINT_HEALTH = obs_metrics.Gauge(
+    "kft_router_endpoint_health",
+    "Per-replica router health (1=routable, 0=ejected/draining)",
+    ("endpoint",))
+_C_PROBE_FAILURES = obs_metrics.Counter(
+    "kft_router_probe_failures_total",
+    "Failed health probes per replica", ("endpoint",))
+_C_TRANSITIONS = obs_metrics.Counter(
+    "kft_router_health_transitions_total",
+    "Endpoint eject/readmit transitions", ("change",))
+
+
+def _strip_scheme(address: str) -> str:
+    return address.split("://", 1)[1] if "://" in address else address
+
+
+def _close_grpc_channel(channel: Any) -> None:
+    if channel is None:
+        return
+    try:
+        import asyncio
+
+        closer = channel.close()
+        if asyncio.iscoroutine(closer):
+            # grpc.aio: close() is a coroutine — it must be SCHEDULED
+            # to actually shut the channel down (calling .close() on
+            # the coroutine object would only cancel the coroutine,
+            # leaking the TCP connections until GC).
+            try:
+                asyncio.get_running_loop().create_task(closer)
+            except RuntimeError:
+                # No loop in this thread (sync callers): discard the
+                # coroutine; GC reclaims the channel.
+                closer.close()
+    except Exception:  # noqa: BLE001 — already-gone channel
+        pass
+
+
+class Endpoint:
+    """One serving replica and the proxy's per-replica state.
+
+    Mutable fields are written from the IOLoop (routing, breakers)
+    and the prober/autoscaler threads (health, saturation); each is a
+    single reference/int store (GIL-atomic), and compound transitions
+    go through the small ``_lock``.
+    """
+
+    def __init__(self, address: str, grpc_address: Optional[str] = None,
+                 *, breaker_failures: int = 5,
+                 breaker_reset_s: float = 5.0,
+                 register_metrics: bool = True):
+        from kubeflow_tpu.serving import overload
+
+        #: host:port of the replica's REST surface (scheme optional).
+        self.address = address
+        #: host:port of the replica's native gRPC surface (None =
+        #: binary upstream disabled for this replica).
+        self.grpc_address = grpc_address
+        self.rest_breaker = overload.CircuitBreaker(
+            breaker_failures, breaker_reset_s)
+        self.grpc_breaker = overload.CircuitBreaker(
+            breaker_failures, breaker_reset_s)
+        #: Per-UPSTREAM signature cache (ISSUE 5 satellite: with a
+        #: pool, version invalidation from one replica must not poison
+        #: another's cache — each replica may be mid-rollout on a
+        #: different resident version).
+        self.metadata_cache: Dict[str, Any] = {}
+        #: Lazily-dialed grpc.aio channel (the proxy owns dialing).
+        self.grpc_channel: Any = None
+        self.health = UNKNOWN
+        #: model name → batch_stats dict from the last /healthz scrape.
+        self.saturation: Dict[str, Dict[str, float]] = {}
+        #: Requests this proxy currently has in flight against the
+        #: replica — the live JSQ signal between (1 s-cadence) probes;
+        #: without it, every pick between two probes lands on whichever
+        #: replica looked emptiest at the LAST scrape (herd stampede).
+        self.inflight = 0
+        self.probe_failures = 0
+        self.last_probe_at: Optional[float] = None  # monotonic
+        self._lock = threading.Lock()
+        # register_metrics=False is for placeholder endpoints that
+        # never join a pool (make_app's empty-pool back-compat
+        # aliases): a permanent health=1 gauge for a replica that
+        # doesn't exist would skew fleet dashboards.
+        if register_metrics:
+            _G_ENDPOINT_HEALTH.labels(self.address).set_function(
+                lambda ep=self: 1.0 if ep.routable() else 0.0)
+
+    @property
+    def url(self) -> str:
+        """REST base URL (scheme added when the address is bare)."""
+        addr = self.address
+        return addr if "://" in addr else f"http://{addr}"
+
+    def routable(self) -> bool:
+        """May the balancer hand this replica new work? Unknown is
+        routable (see module docstring); draining and ejected are
+        not."""
+        return self.health in (HEALTHY, UNKNOWN)
+
+    def resident_models(self) -> List[str]:
+        """Models resident on the replica per its last healthz (the
+        ``saturation`` keys ARE the resident set — the server reports
+        one batcher per loaded model)."""
+        return list(self.saturation)
+
+    def saturation_score(self) -> float:
+        """Estimated queue wait in milliseconds if one more request
+        were routed here: the healthz-reported per-model estimate
+        (queue_depth × est_batch_latency_ms, summed — one accelerator
+        serializes all models) plus this proxy's own in-flight count
+        priced at one batch latency each. Lower = emptier."""
+        probe_ms = 0.0
+        latency_ms = 1.0
+        for stats in self.saturation.values():
+            batch_ms = float(stats.get("est_batch_latency_ms", 0.0))
+            latency_ms = max(latency_ms, batch_ms)
+            probe_ms += float(stats.get("queue_depth", 0.0)) * batch_ms
+        return probe_ms + self.inflight * latency_ms
+
+    def mark_probe_success(self, payload: Dict[str, Any],
+                           now: Optional[float] = None) -> bool:
+        """Record a 200 /healthz: store the saturation snapshot,
+        readmit if ejected, and heal a non-closed REST breaker (the
+        probe IS a successful REST round trip — a revived replica
+        must not wait out a stale open circuit to rejoin rotation).
+        A CLOSED breaker is deliberately left alone: its consecutive-
+        failure count is evidence from the infer path, and a replica
+        whose /healthz answers while its infers hang must still be
+        able to trip it. Returns True on an eject→readmit
+        transition."""
+        with self._lock:
+            readmitted = self.health == UNHEALTHY
+            self.probe_failures = 0
+            if self.health != DRAINING:
+                self.health = HEALTHY
+            self.saturation = dict(payload.get("saturation") or {})
+            self.last_probe_at = time.monotonic() if now is None else now
+        if self.rest_breaker.state != "closed":
+            self.rest_breaker.record_success()
+        if readmitted:
+            _C_TRANSITIONS.labels("readmit").inc()
+        return readmitted
+
+    def mark_probe_failure(self, eject_after: int,
+                           now: Optional[float] = None) -> bool:
+        """Record a failed probe; eject after ``eject_after``
+        consecutive failures. Returns True on the ejecting
+        transition."""
+        _C_PROBE_FAILURES.labels(self.address).inc()
+        with self._lock:
+            self.probe_failures += 1
+            ejected = (self.health not in (UNHEALTHY, DRAINING)
+                       and self.probe_failures >= eject_after)
+            if ejected:
+                self.health = UNHEALTHY
+                self.saturation = {}
+            self.last_probe_at = time.monotonic() if now is None else now
+        if ejected:
+            _C_TRANSITIONS.labels("eject").inc()
+        return ejected
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-shaped state for /healthz, the fleet ConfigMap, and
+        the dashboard."""
+        with self._lock:
+            return {
+                "address": self.address,
+                "grpc_address": self.grpc_address,
+                "health": self.health,
+                "inflight": self.inflight,
+                "probe_failures": self.probe_failures,
+                "saturation_score_ms": round(self.saturation_score(), 3),
+                "resident_models": sorted(self.saturation),
+                "breakers": {
+                    "rest": {"state": self.rest_breaker.state},
+                    "grpc": {"state": self.grpc_breaker.state},
+                },
+            }
+
+
+class EndpointPool:
+    """Thread-safe replica membership with drain-aware removal."""
+
+    def __init__(self, endpoints: Optional[Sequence[Endpoint]] = None, *,
+                 breaker_failures: int = 5, breaker_reset_s: float = 5.0):
+        self._breaker_failures = breaker_failures
+        self._breaker_reset_s = breaker_reset_s
+        self._lock = threading.Lock()
+        self._endpoints: Dict[str, Endpoint] = {}
+        #: Called with the address of every member that fully drops —
+        #: the hook for layers above to release THEIR per-address
+        #: state (the proxy unregisters its per-endpoint metric
+        #: children here; see make_app).
+        self.on_drop: Optional[Callable[[str], None]] = None
+        for ep in endpoints or ():
+            self._endpoints[ep.address] = ep
+
+    @classmethod
+    def from_addresses(cls, addresses: Sequence[str],
+                       grpc_addresses: Optional[Sequence[Optional[str]]]
+                       = None, *, breaker_failures: int = 5,
+                       breaker_reset_s: float = 5.0) -> "EndpointPool":
+        grpc_addresses = grpc_addresses or [None] * len(addresses)
+        return cls([Endpoint(a, g, breaker_failures=breaker_failures,
+                             breaker_reset_s=breaker_reset_s)
+                    for a, g in zip(addresses, grpc_addresses)],
+                   breaker_failures=breaker_failures,
+                   breaker_reset_s=breaker_reset_s)
+
+    def endpoints(self) -> List[Endpoint]:
+        """All members (insertion order — the round-robin basis)."""
+        with self._lock:
+            return list(self._endpoints.values())
+
+    def routable(self) -> List[Endpoint]:
+        return [ep for ep in self.endpoints() if ep.routable()]
+
+    def get(self, address: str) -> Optional[Endpoint]:
+        with self._lock:
+            return self._endpoints.get(address)
+
+    def add(self, address: str, grpc_address: Optional[str] = None
+            ) -> Endpoint:
+        with self._lock:
+            ep = self._endpoints.get(address)
+            if ep is None:
+                ep = Endpoint(address, grpc_address,
+                              breaker_failures=self._breaker_failures,
+                              breaker_reset_s=self._breaker_reset_s)
+                self._endpoints[address] = ep
+            elif ep.health == DRAINING:
+                # Re-added while draining (scale-down reverted before
+                # the drain finished): rejoin with state intact.
+                ep.health = UNKNOWN
+            return ep
+
+    def remove(self, address: str) -> None:
+        """Drain-aware removal: with requests in flight the member
+        only stops being pickable (DRAINING); the next sync() drops it
+        once the in-flight count reaches zero. An idle member drops
+        immediately (its breakers, caches and channel go with it)."""
+        with self._lock:
+            ep = self._endpoints.get(address)
+            if ep is None:
+                return
+            if ep.inflight > 0:
+                ep.health = DRAINING
+            else:
+                self._drop(address, ep)
+
+    def _retarget_grpc(self, ep: Endpoint,
+                       grpc_address: Optional[str]) -> None:
+        """A membership update may change a RETAINED member's binary
+        address (gRPC enabled after the fact, port moved, disabled):
+        swap the address, close the stale channel, and zero the
+        binary breaker — its consecutive-failure evidence concerns
+        the OLD wire. REST-side state (breaker, signature cache,
+        health) is untouched; the replica itself didn't change."""
+        if ep.grpc_address == grpc_address:
+            return
+        logger.info("endpoint %s binary upstream: %s -> %s",
+                    ep.address, ep.grpc_address, grpc_address)
+        channel, ep.grpc_channel = ep.grpc_channel, None
+        ep.grpc_address = grpc_address
+        ep.grpc_breaker.record_success()
+        _close_grpc_channel(channel)
+
+    def _drop(self, address: str, ep: Endpoint) -> None:
+        del self._endpoints[address]
+        # Unregister the per-address metric children: the health
+        # gauge's callback closure pins the whole Endpoint (breakers,
+        # caches) and pod-IP churn would otherwise grow /metrics and
+        # memory without bound.
+        _G_ENDPOINT_HEALTH.remove_labels(address)
+        _C_PROBE_FAILURES.remove_labels(address)
+        if self.on_drop is not None:
+            try:
+                self.on_drop(address)
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                logger.debug("on_drop(%s) failed", address,
+                             exc_info=True)
+        channel, ep.grpc_channel = ep.grpc_channel, None
+        _close_grpc_channel(channel)
+
+    def sync(self, specs: Sequence[Tuple[str, Optional[str]]]
+             ) -> Tuple[List[str], List[str]]:
+        """Reconcile membership to ``specs`` [(address, grpc)] —
+        additions join as UNKNOWN, absentees leave drain-aware, and
+        already-drained members finally drop. Returns (added,
+        removed) addresses for logging."""
+        want = {a: g for a, g in specs}
+        added, removed = [], []
+        with self._lock:
+            current = list(self._endpoints.items())
+        for address, ep in current:
+            if address in want:
+                self._retarget_grpc(ep, want[address])
+                if ep.health == DRAINING:
+                    self.add(address, want[address])  # un-drain
+                continue
+            if ep.health != DRAINING:
+                removed.append(address)
+            # remove() drops an idle member outright and keeps a busy
+            # one DRAINING; a draining member whose in-flight count
+            # reached zero since the last sync drops here.
+            self.remove(address)
+        for address, grpc in want.items():
+            if self.get(address) is None:
+                self.add(address, grpc)
+                added.append(address)
+        if added or removed:
+            logger.info("endpoint pool sync: +%s -%s", added, removed)
+        return added, removed
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        return [ep.snapshot() for ep in self.endpoints()]
+
+
+class StaticEndpointSource:
+    """A fixed membership list (the --rpc_address a,b,c form)."""
+
+    def __init__(self, specs: Sequence[Tuple[str, Optional[str]]]):
+        self._specs = [(a, g) for a, g in specs]
+
+    def specs(self) -> List[Tuple[str, Optional[str]]]:
+        return list(self._specs)
+
+
+class FileEndpointSource:
+    """ConfigMap-shaped discovery: a JSON file of fleet members,
+    re-read on every call (the file is tiny; content comparison —
+    not mtime — detects change, so same-second rewrites and
+    ConfigMap symlink swaps both take effect). Accepted shapes::
+
+        ["host:8500", "host2:8500"]
+        {"endpoints": [{"address": "host:8500",
+                        "grpc_address": "host:9000"}, ...]}
+
+    A missing or malformed file keeps the LAST GOOD membership — a
+    half-written update must not empty the fleet (the autoscaler
+    sidecar writes atomically via rename, but a human edit may not).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._last_good: List[Tuple[str, Optional[str]]] = []
+        self._last_raw: Optional[str] = None
+
+    def specs(self) -> List[Tuple[str, Optional[str]]]:
+        try:
+            with open(self.path) as f:
+                raw = f.read()
+        except OSError:
+            return list(self._last_good)
+        if raw == self._last_raw:
+            return list(self._last_good)
+        try:
+            doc = json.loads(raw)
+            entries = doc["endpoints"] if isinstance(doc, dict) else doc
+            specs = []
+            for entry in entries:
+                if isinstance(entry, str):
+                    specs.append((entry, None))
+                else:
+                    specs.append((entry["address"],
+                                  entry.get("grpc_address")))
+        except (ValueError, KeyError, TypeError) as e:
+            logger.warning("endpoints file %s malformed (%s); keeping "
+                           "last good membership", self.path, e)
+            return list(self._last_good)
+        self._last_raw, self._last_good = raw, specs
+        return list(specs)
+
+
+def write_endpoints_file(path: str,
+                         specs: Sequence[Tuple[str, Optional[str]]]
+                         ) -> None:
+    """Atomically (write + rename) publish a membership list in the
+    FileEndpointSource shape — the autoscaler sidecar's half of the
+    hot-reload contract: readers never observe a torn file."""
+    import os
+
+    payload = json.dumps({"endpoints": [
+        {"address": a, **({"grpc_address": g} if g else {})}
+        for a, g in specs]}, indent=1, sort_keys=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+
+
+def scrape_healthz(address: str, timeout_s: float = 2.0
+                   ) -> Dict[str, Any]:
+    """One bounded, synchronous /healthz scrape (the prober's async
+    path uses tornado; the autoscaler thread uses this). Raises on
+    transport failure or non-200; returns the parsed schema dict."""
+    url = address if "://" in address else f"http://{address}"
+    with urllib.request.urlopen(f"{url}/healthz",
+                                timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+class HealthProber:
+    """Scrapes every member's ``/healthz``, ejecting after
+    ``eject_after`` consecutive failures and readmitting on the first
+    success (plus syncing membership from an optional source each
+    cycle — the hot-reload hook).
+
+    Core transition logic is synchronous and fetch-injectable
+    (``observe`` / ``probe_all_sync``) so policy tests never open a
+    socket; ``start()`` attaches the async scrape loop to the current
+    tornado IOLoop for the in-proxy deployment.
+    """
+
+    def __init__(self, pool: EndpointPool, *, interval_s: float = 1.0,
+                 timeout_s: float = 2.0, eject_after: int = 3,
+                 source: Optional[Any] = None,
+                 fetch: Optional[Callable[[Endpoint],
+                                          Dict[str, Any]]] = None):
+        self.pool = pool
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.eject_after = eject_after
+        self.source = source
+        self._fetch = fetch
+        self._callback: Any = None
+
+    def observe(self, ep: Endpoint,
+                payload: Optional[Dict[str, Any]]) -> None:
+        """Apply one probe outcome (None = failure) and record the
+        eject/readmit transition as a router span."""
+        t0 = time.monotonic()
+        if payload is not None and payload.get("status") in ("ok",
+                                                             "degraded"):
+            if ep.mark_probe_success(payload, now=t0):
+                logger.info("endpoint %s readmitted", ep.address)
+                TRACER.record("endpoint_readmit", "router", t0, 0.0,
+                              {"endpoint": ep.address})
+        else:
+            if ep.mark_probe_failure(self.eject_after, now=t0):
+                logger.warning("endpoint %s ejected after %d failed "
+                               "probes", ep.address, ep.probe_failures)
+                TRACER.record("endpoint_eject", "router", t0, 0.0,
+                              {"endpoint": ep.address,
+                               "failures": ep.probe_failures})
+
+    def sync_membership(self) -> None:
+        if self.source is not None:
+            self.pool.sync(self.source.specs())
+
+    def probe_all_sync(self) -> None:
+        """One full probe cycle over injected/sync fetch — tests and
+        the autoscaler thread. The default fetch is the bounded
+        urllib scrape."""
+        self.sync_membership()
+        fetch = self._fetch or (
+            lambda ep: scrape_healthz(ep.address, self.timeout_s))
+        for ep in self.pool.endpoints():
+            try:
+                payload: Optional[Dict[str, Any]] = fetch(ep)
+            except Exception:  # noqa: BLE001 — any failure = bad probe
+                payload = None
+            self.observe(ep, payload)
+
+    async def probe_all(self) -> None:
+        """One probe cycle on the IOLoop: all members CONCURRENTLY
+        (tornado AsyncHTTPClient, per-probe timeout), so a cycle
+        costs one bounded fetch regardless of how many replicas are
+        unreachable — sequential probing would stretch the cycle by
+        timeout_s per dead member and delay every ejection and
+        readmission behind it."""
+        import asyncio
+
+        import tornado.httpclient
+
+        self.sync_membership()
+        client = tornado.httpclient.AsyncHTTPClient()
+
+        async def probe_one(ep: Endpoint) -> None:
+            payload: Optional[Dict[str, Any]] = None
+            try:
+                resp = await client.fetch(
+                    f"{ep.url}/healthz",
+                    request_timeout=self.timeout_s, raise_error=False)
+                if resp.code == 200:
+                    payload = json.loads(resp.body)
+            except Exception:  # noqa: BLE001 — transport failure
+                payload = None
+            self.observe(ep, payload)
+
+        members = self.pool.endpoints()
+        if members:
+            await asyncio.gather(*(probe_one(ep) for ep in members))
+
+    def start(self) -> None:
+        """Attach the periodic probe loop to the CURRENT IOLoop."""
+        import tornado.ioloop
+
+        if self._callback is not None:
+            return
+        self._callback = tornado.ioloop.PeriodicCallback(
+            self.probe_all, self.interval_s * 1000.0)
+        self._callback.start()
+
+    def stop(self) -> None:
+        if self._callback is not None:
+            self._callback.stop()
+            self._callback = None

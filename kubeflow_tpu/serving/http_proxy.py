@@ -21,32 +21,40 @@ Route grammar and behaviors are parity with the reference proxy
   ``/version/<v>`` (reference ``:270-283``).
 - Payload ``{"instances": [...]}``; ``{"b64": "..."}`` leaves are
   base64-decoded before tensor conversion (reference ``:110-119``).
-- The model's signature map is cached per model and invalidated when
-  a response reveals a new served version (the reference cached
-  forever, ``:121-160,202-203`` — its server never hot-swapped
-  signatures; this one does).
+- The model's signature map is cached per (upstream, model) and
+  invalidated when a response reveals a new served version (the
+  reference cached forever, ``:121-160,202-203`` — its server never
+  hot-swapped signatures; this one does).
 - Responses zip output tensors into ``{"predictions": [{...}]}``
   (reference ``:233-236``).
 
 Async end-to-end on tornado, like the original (``:83-106``).
 
-Upstream wire: binary gRPC Predict against the model server's :9000
-(the reference proxy's own upstream design — it built PredictRequest /
-ClassificationRequest protos over a gRPC channel, ``:219-236`` — and
-the measured winner: PERF.md's serving section, binary TensorProto vs
-JSON). The REST/JSON hop remains as fallback for verb/signature-method
-mismatches (the gRPC Predict executes the signature's method) and for
-environments without grpcio.
+FLEET routing (ISSUE 5): the reference pinned N TF-Serving replicas
+into a Deployment and let kube-proxy spray connections; this proxy
+routes REQUESTS across an explicit endpoint pool
+(``kubeflow_tpu/scaling/``): a pluggable balancer (round-robin /
+least-saturation on the healthz signal / resident-model affinity)
+picks the replica per request, every replica carries its OWN circuit
+breakers, signature cache and gRPC channel, and a transport-level
+failure fails over to another replica while the request's deadline
+budget still affords the retry (infer verbs here are idempotent: the
+models are pure functions of their inputs). A health prober ejects
+dead members and readmits them; membership hot-reloads from a
+ConfigMap-shaped endpoints file so the autoscaler can grow/shrink the
+fleet under a running proxy.
+
+Upstream wire per replica: binary gRPC Predict against :9000 (the
+measured winner: PERF.md's serving section), REST as fallback for
+verb/signature-method mismatches and grpcio-free environments.
 
 Overload behavior (serving/overload.py): the proxy reads the client's
 ``X-Deadline-Ms`` budget, spends its own time from it, and forwards
-the REMAINDER (same header on the REST hop, native grpc-timeout on
-the binary hop) — so the backend's admission control judges the true
-budget, not the proxy's configured timeout. Each upstream has a
-consecutive-failure circuit breaker: a dead backend costs one connect
-timeout per reset period instead of one per request, everything else
-fast-fails with 503 + Retry-After in microseconds. Backend timeouts
-map to 504 (the request's time is gone), connection failures to 502.
+the REMAINDER — so the backend's admission control judges the true
+budget. A dead backend costs one connect timeout per reset period
+(per-replica breaker) and everything else fast-fails 503 +
+Retry-After in microseconds — but with a pool, the fast-fail is the
+LAST resort: the router first fails over to a live replica.
 """
 
 from __future__ import annotations
@@ -56,7 +64,7 @@ import base64
 import json
 import logging
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 import tornado.httpclient
@@ -70,18 +78,32 @@ from kubeflow_tpu.obs.exposition import (
     TraceContextHandlerMixin,
     access_log_function,
 )
+from kubeflow_tpu.obs.tracing import TRACER
+from kubeflow_tpu.scaling.balancer import (
+    Balancer,
+    eligible_endpoints,
+    make_balancer,
+)
+from kubeflow_tpu.scaling.endpoints import (
+    Endpoint,
+    EndpointPool,
+    FileEndpointSource,
+    HealthProber,
+)
 from kubeflow_tpu.serving import overload
 
 logger = logging.getLogger(__name__)
 
-# The proxy's scrape surface (/metrics): per-upstream circuit-breaker
-# state + attempt/failure counters, and how often the binary hop fell
-# back to REST (a rising fallback rate means :9000 is flapping).
+# The proxy's scrape surface (/metrics): per-wire circuit-breaker
+# state + attempt/failure counters (aggregated across the pool — the
+# per-REPLICA detail lives on /healthz and the router counters below),
+# and how often the binary hop fell back to REST (a rising fallback
+# rate means :9000 is flapping).
 _BREAKER_STATE_NUM = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
 _P_BREAKER_STATE = obs_metrics.Gauge(
     "kft_proxy_breaker_state",
-    "Circuit breaker state per upstream (0=closed, 1=half_open, "
-    "2=open)", ("upstream",))
+    "Worst circuit breaker state across the pool per upstream wire "
+    "(0=closed, 1=half_open, 2=open)", ("upstream",))
 _P_UPSTREAM_REQUESTS = obs_metrics.Counter(
     "kft_proxy_upstream_requests_total",
     "Upstream attempts placed through each breaker", ("upstream",))
@@ -95,6 +117,18 @@ _P_FALLBACKS = obs_metrics.Counter(
 _P_RETRY_AFTER = obs_metrics.Counter(
     "kft_proxy_fast_fail_total",
     "Requests fast-failed by an open circuit breaker", ("upstream",))
+# Router surface: where picks land and how often a request had to
+# move replicas mid-flight (failovers > 0 with a healthy fleet means
+# a replica is flapping faster than the prober ejects it).
+_P_ROUTER_PICKS = obs_metrics.Counter(
+    "kft_router_picks_total",
+    "Routing decisions per replica endpoint", ("endpoint",))
+_P_ROUTER_FAILOVERS = obs_metrics.Counter(
+    "kft_router_failovers_total",
+    "Requests retried on another replica after a transport failure")
+_P_ROUTER_NO_BACKEND = obs_metrics.Counter(
+    "kft_router_no_backend_total",
+    "Requests that found no routable replica at all")
 
 
 class CircuitOpenError(Exception):
@@ -114,6 +148,10 @@ class BackendDownError(Exception):
     """Connection-level failure (refused/reset/unresolvable)."""
 
 
+class NoBackendError(Exception):
+    """The pool has no replica left to try for this request."""
+
+
 #: A hang-timeout counts against the circuit breaker when the burn was
 #: at least this long (or the full rpc_timeout, whichever is smaller).
 #: A healthy backend answers in milliseconds, so a 1s+ hang is real
@@ -123,6 +161,12 @@ class BackendDownError(Exception):
 #: breaker against a hung backend. Sub-second budgets expiring still
 #: prove nothing and don't count.
 BREAKER_TIMEOUT_FLOOR_S = 1.0
+
+#: Don't fail over to another replica with less remaining budget than
+#: this — the retry would only manufacture a guaranteed 504 plus one
+#: more doomed upstream dial (the budget-aware half of the
+#: retry-on-another-replica contract).
+RETRY_BUDGET_FLOOR_S = 0.02
 
 
 def decode_b64_if_needed(value: Any) -> Any:
@@ -148,42 +192,43 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
     # interesting spans live where the work happens.
 
     @property
-    def rpc_address(self) -> str:
-        addr = self.application.settings["rpc_address"]
-        # Accept bare host:port (the manifest wires the sidecar as
-        # --rpc_port=8500 → the server's REST port; flag name is
-        # parity with the reference's --rpc_port,
-        # tf-serving.libsonnet:152).
-        if "://" not in addr:
-            addr = f"http://{addr}"
-        return addr
+    def pool(self) -> EndpointPool:
+        return self.application.settings["pool"]
+
+    @property
+    def balancer(self) -> Balancer:
+        return self.application.settings["balancer_obj"]
 
     @property
     def rpc_timeout(self) -> float:
         return self.application.settings["rpc_timeout"]
 
     @property
-    def _metadata_cache(self) -> Dict[str, Any]:
-        return self.application.settings["metadata_cache"]
+    def retry_attempts(self) -> int:
+        return self.application.settings["retry_attempts"]
 
-    @property
-    def rest_breaker(self) -> overload.CircuitBreaker:
-        return self.application.settings["rest_breaker"]
+    def pick_endpoint(self, tried: Sequence[Endpoint],
+                      model: Optional[str] = None) -> Optional[Endpoint]:
+        """One routing decision: balancer policy over the eligible
+        (not-yet-tried, not-ejected, breaker-admitting) members."""
+        candidates = eligible_endpoints(self.pool, exclude=tried)
+        if not candidates:
+            return None
+        ep = self.balancer.pick(candidates, model=model)
+        if ep is not None:
+            _P_ROUTER_PICKS.labels(ep.address).inc()
+        return ep
 
-    @property
-    def grpc_breaker(self) -> overload.CircuitBreaker:
-        return self.application.settings["grpc_breaker"]
-
-    async def _rest_fetch(self, url: str,
+    async def _rest_fetch(self, ep: Endpoint, path: str,
                           deadline: Optional[float] = None,
                           **kwargs) -> tornado.httpclient.HTTPResponse:
-        """One REST-upstream fetch through the circuit breaker, with
-        the request's remaining deadline capping the timeout. App-level
-        responses (any HTTP code) count as breaker successes — a 404
-        proves the backend is alive; only transport failures (connect
-        refused, timeout) count against it. Raises CircuitOpenError /
-        BackendTimeoutError / BackendDownError."""
-        breaker = self.rest_breaker
+        """One REST fetch against ``ep`` through ITS circuit breaker,
+        with the request's remaining deadline capping the timeout.
+        App-level responses (any HTTP code) count as breaker successes
+        — a 404 proves the backend is alive; only transport failures
+        (connect refused, timeout) count against it. Raises
+        CircuitOpenError / BackendTimeoutError / BackendDownError."""
+        breaker = ep.rest_breaker
         if not breaker.allow():
             _P_RETRY_AFTER.labels("rest").inc()
             raise CircuitOpenError(breaker.retry_after_s())
@@ -200,7 +245,8 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
         _P_UPSTREAM_REQUESTS.labels("rest").inc()
         client = tornado.httpclient.AsyncHTTPClient()
         try:
-            response = await client.fetch(url, request_timeout=timeout,
+            response = await client.fetch(f"{ep.url}{path}",
+                                          request_timeout=timeout,
                                           raise_error=False,
                                           headers=headers, **kwargs)
             # 599 = tornado's transport-failure code (never sent by a
@@ -227,8 +273,8 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
         raise BackendDownError(str(failure))
 
     def write_backend_error(self, e: Exception) -> None:
-        """Uniform JSON mapping for the three upstream failure shapes
-        (same body shape as every other proxy error path)."""
+        """Uniform JSON mapping for the upstream failure shapes (same
+        body shape as every other proxy error path)."""
         if isinstance(e, CircuitOpenError):
             self._obs_outcome = "breaker_open"
             self.set_header("Retry-After",
@@ -239,79 +285,151 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
             self._obs_outcome = "expired"
             self.write_json({"error": str(e),
                              "code": "DEADLINE_EXCEEDED"}, 504)
+        elif isinstance(e, NoBackendError):
+            self._obs_outcome = "no_backend"
+            self.set_header("Retry-After", "1")
+            self.write_json({"error": "no serving backend replica "
+                                      "available",
+                             "code": "RESOURCE_EXHAUSTED"}, 503)
         else:
             self._obs_outcome = "backend_down"
             self.write_json({"error": f"model server unreachable: {e}"},
                             502)
 
-    async def get_signature_map(self, name: str, *,
+    async def get_signature_map(self, ep: Endpoint, name: str, *,
                                 refresh: bool = False,
                                 deadline: Optional[float] = None
                                 ) -> Dict[str, Any]:
-        """Cached signature map, keyed by model and invalidated on
-        version change (the reference cached forever, server.py:202-203
-        — safe there because its server never hot-swapped signatures;
-        this one does, via the export CLI + version watcher)."""
-        if refresh or name not in self._metadata_cache:
-            url = f"{self.rpc_address}/v1/models/{name}/metadata"
-            response = await self._rest_fetch(url, deadline=deadline)
+        """Cached signature map, keyed by (UPSTREAM, model): each
+        replica owns its cache entry so a hot reload observed on one
+        replica — mid-rollout fleets legally serve different versions
+        — never invalidates (or poisons) another replica's entry."""
+        if refresh or name not in ep.metadata_cache:
+            response = await self._rest_fetch(
+                ep, f"/v1/models/{name}/metadata", deadline=deadline)
             if response.code != 200:
                 raise tornado.httpclient.HTTPClientError(
                     response.code, response=response)
             payload = json.loads(response.body)
-            self._metadata_cache[name] = {
+            ep.metadata_cache[name] = {
                 "version": payload.get("model_spec", {}).get("version"),
                 "payload": payload,
             }
-        return self._metadata_cache[name]["payload"]
+        return ep.metadata_cache[name]["payload"]
 
-    def invalidate_if_version_changed(self, name: str,
+    def invalidate_if_version_changed(self, ep: Endpoint, name: str,
                                       served_version: Any) -> None:
-        """Drop the cached signature map when an upstream response
-        reveals a different served version (hot reload happened)."""
-        entry = self._metadata_cache.get(name)
+        """Drop ``ep``'s cached signature map when one of ITS
+        responses reveals a different served version (hot reload
+        happened on that replica)."""
+        entry = ep.metadata_cache.get(name)
         if (entry is not None and served_version is not None
                 and entry["version"] != served_version):
-            del self._metadata_cache[name]
+            del ep.metadata_cache[name]
 
     def write_json(self, payload: Dict[str, Any], status: int = 200) -> None:
         self.set_status(status)
         self.set_header("Content-Type", "application/json")
         self.finish(json.dumps(payload))
 
+    async def route_with_failover(self, model: Optional[str],
+                                  attempt, deadline=None) -> None:
+        """THE routing contract, shared by every proxied verb: pick a
+        replica, run ``attempt(ep)`` (which raises _Handled once the
+        client response is written), and on a transport-level failure
+        fail over to another replica — never the same one twice, at
+        most 1 + retry_attempts placements, never with less than
+        RETRY_BUDGET_FLOOR_S of deadline budget left. When every
+        placement fails (or none exists) the transport error maps to
+        the client via write_backend_error."""
+        tried: List[Endpoint] = []
+        last_exc: Optional[Exception] = None
+        max_extra = max(0, self.retry_attempts)
+        for attempt_i in range(1 + max_extra):
+            ep = self.pick_endpoint(tried, model=model)
+            if ep is None:
+                break
+            ep.inflight += 1
+            try:
+                await attempt(ep)
+            except _Handled:
+                return
+            except (CircuitOpenError, BackendTimeoutError,
+                    BackendDownError) as e:
+                last_exc = e
+                tried.append(ep)
+                if (isinstance(e, BackendTimeoutError)
+                        and deadline is None):
+                    # A timed-out placement may STILL be executing on
+                    # that replica (unlike connect-refused/open-
+                    # breaker, where no work started). Without a
+                    # deadline there is no budget to bound the
+                    # re-dispatch amplification — an overloaded fleet
+                    # would run each slow request on every replica in
+                    # turn — so a deadline-less timeout keeps the
+                    # pre-pool contract: one placement, one 504.
+                    break
+                remaining = overload.remaining_s(deadline)
+                if (remaining is not None
+                        and remaining <= RETRY_BUDGET_FLOOR_S):
+                    break  # no budget left to try anyone else
+                # Count a failover only when a retry actually
+                # follows: another attempt is permitted AND a
+                # candidate exists.
+                if (attempt_i < max_extra
+                        and eligible_endpoints(self.pool,
+                                               exclude=tried)):
+                    _P_ROUTER_FAILOVERS.inc()
+                    TRACER.record(
+                        "router_failover", "router", time.monotonic(),
+                        0.0, {"from": ep.address, "model": model or "",
+                              "error": type(e).__name__})
+            finally:
+                ep.inflight -= 1
+        if last_exc is None:
+            _P_ROUTER_NO_BACKEND.inc()
+            last_exc = NoBackendError()
+        self.write_backend_error(last_exc)
+
+
+class _Handled(Exception):
+    """Internal: the attempt wrote the client response (success OR
+    app-level error) — stop the failover loop without retrying."""
+
 
 class InferProxyHandler(ProxyHandler):
-    def _grpc_channel(self):
-        """Lazily-dialed persistent grpc.aio channel to :9000 (the
-        reference dialed once per process, server.py:41-43). Returns
-        None when the binary upstream is disabled or grpcio is absent."""
-        addr = self.application.settings.get("grpc_address")
-        if not addr:
+    def _grpc_channel(self, ep: Endpoint):
+        """Lazily-dialed persistent grpc.aio channel to the replica's
+        :9000 (the reference dialed once per process, server.py:41-43;
+        here once per replica). Returns None when the binary upstream
+        is disabled or grpcio is absent."""
+        if not ep.grpc_address:
             return None
-        channel = self.application.settings.get("_grpc_channel")
-        if channel is None:
+        if self.application.settings.get("_grpc_disabled"):
+            return None
+        if ep.grpc_channel is None:
             try:
                 import grpc
             except ImportError:
-                self.application.settings["grpc_address"] = None
+                self.application.settings["_grpc_disabled"] = True
                 return None
-            channel = grpc.aio.insecure_channel(addr)
-            self.application.settings["_grpc_channel"] = channel
-        return channel
+            ep.grpc_channel = grpc.aio.insecure_channel(ep.grpc_address)
+        return ep.grpc_channel
 
-    async def _grpc_infer(self, name: str, version: Optional[str],
+    async def _grpc_infer(self, ep: Endpoint, name: str,
+                          version: Optional[str],
                           verb: str, instances, body, metadata,
                           deadline: Optional[float] = None) -> bool:
-        """Try the binary Predict upstream. Returns True when the
-        response was written (success or mapped gRPC error); False when
-        this request can't ride the binary wire (no channel, unknown
-        signature, URL verb != signature method — gRPC Predict runs
-        the signature's own method, or this upstream's circuit breaker
-        is open) and the REST hop should run."""
-        channel = self._grpc_channel()
+        """Try the binary Predict upstream on ``ep``. Returns True
+        when the response was written (success or mapped gRPC error);
+        False when this request can't ride the binary wire (no
+        channel, unknown signature, URL verb != signature method —
+        gRPC Predict runs the signature's own method, or this
+        replica's binary breaker is open) and the REST hop should run."""
+        channel = self._grpc_channel(ep)
         if channel is None:
             return False
-        if not self.grpc_breaker.allow():
+        if not ep.grpc_breaker.allow():
             # Open circuit on the binary wire only: the REST hop (its
             # own breaker) may still be healthy — fall through rather
             # than failing traffic a live REST backend would serve.
@@ -339,7 +457,7 @@ class InferProxyHandler(ProxyHandler):
         try:
             batch = np.asarray(rows, dtype=dtype)
         except (ValueError, TypeError) as e:
-            self._metadata_cache.pop(name, None)
+            ep.metadata_cache.pop(name, None)
             self.write_json(
                 {"error": f"payload does not match signature: {e}"}, 400)
             return True
@@ -368,16 +486,17 @@ class InferProxyHandler(ProxyHandler):
             if e.code() == grpc.StatusCode.UNAVAILABLE:
                 # :9000 unreachable (older server image, firewalled
                 # port, or genuine overload): count it against this
-                # upstream's breaker and fall back to the REST hop
-                # rather than 503-ing traffic a REST-only backend would
-                # serve fine. If the server is truly down, the REST hop
-                # reports its own 502/503 with the accurate story.
-                self.grpc_breaker.record_failure()
+                # replica's binary breaker and fall back to ITS REST
+                # hop rather than 503-ing traffic a REST-only backend
+                # would serve fine. If the replica is truly down, the
+                # REST hop raises the transport error that triggers
+                # the router's replica failover.
+                ep.grpc_breaker.record_failure()
                 _P_UPSTREAM_FAILURES.labels("grpc").inc()
                 _P_FALLBACKS.inc()
                 logger.warning(
-                    "gRPC upstream unavailable (%s); falling back to "
-                    "REST for this request", e.details())
+                    "gRPC upstream %s unavailable (%s); falling back "
+                    "to REST for this request", ep.address, e.details())
                 return False
             if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
                 # A substantial hang indicts the backend; a tight
@@ -385,10 +504,10 @@ class InferProxyHandler(ProxyHandler):
                 # floor as the REST upstream).
                 if timeout >= min(self.rpc_timeout,
                                   BREAKER_TIMEOUT_FLOOR_S):
-                    self.grpc_breaker.record_failure()
+                    ep.grpc_breaker.record_failure()
                     _P_UPSTREAM_FAILURES.labels("grpc").inc()
             else:  # an application-level status proves it's alive
-                self.grpc_breaker.record_success()
+                ep.grpc_breaker.record_success()
             code = {
                 grpc.StatusCode.NOT_FOUND: 404,
                 grpc.StatusCode.INVALID_ARGUMENT: 400,
@@ -397,7 +516,7 @@ class InferProxyHandler(ProxyHandler):
             }.get(e.code(), 502)
             # Stale signature cache may be the real culprit (hot
             # reload): drop it so the next request reconverts fresh.
-            self._metadata_cache.pop(name, None)
+            ep.metadata_cache.pop(name, None)
             payload: Dict[str, Any] = {"error": e.details()
                                        or e.code().name}
             if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
@@ -409,20 +528,95 @@ class InferProxyHandler(ProxyHandler):
                 self.set_header("Retry-After", "1")
             self.write_json(payload, code)
             return True
-        self.grpc_breaker.record_success()
+        ep.grpc_breaker.record_success()
         spec_out, outputs = wire.decode_predict_response(response)
         if not version:
             served = spec_out.get("version")
             # Cache stores the REST metadata's string version; the wire
             # decodes an int — normalize or every request invalidates.
             self.invalidate_if_version_changed(
-                name, str(served) if served is not None else None)
+                ep, name, str(served) if served is not None else None)
         keys = sorted(outputs)
         n = len(outputs[keys[0]]) if keys else 0
         self.write_json({"predictions": [
             {k: np.asarray(outputs[k][i]).tolist() for k in keys}
             for i in range(n)]})
         return True
+
+    async def _attempt(self, ep: Endpoint, name: str,
+                       version: Optional[str], verb: str,
+                       instances: Any, body: Dict[str, Any],
+                       deadline: Optional[float]) -> None:
+        """One full infer attempt against one replica. Raises
+        _Handled once the client response is written; transport-level
+        failures (CircuitOpen/BackendTimeout/BackendDown) propagate so
+        the router can fail over."""
+        try:
+            metadata = await self.get_signature_map(ep, name,
+                                                    deadline=deadline)
+        except tornado.httpclient.HTTPClientError as e:
+            self.write_json(
+                {"error": f"model metadata fetch failed: {e}"},
+                e.code if e.code else 502)
+            raise _Handled()
+        try:
+            instances = _bytes_to_arrays(instances, metadata)
+        except ValueError as e:
+            # Possibly converting against a stale signature (hot
+            # reload): drop this replica's cache so the next attempt
+            # is fresh.
+            ep.metadata_cache.pop(name, None)
+            self.write_json(
+                {"error": f"payload does not match signature: {e}"}, 400)
+            raise _Handled()
+        # Binary upstream first (measured winner, PERF.md serving
+        # section); falls through to the REST hop when the request
+        # can't ride it (verb/method mismatch, no grpcio, multi-input,
+        # open binary breaker).
+        if await self._grpc_infer(ep, name, version, verb, instances,
+                                  body, metadata, deadline=deadline):
+            raise _Handled()
+        path = f"/v1/models/{name}"
+        if version:
+            path += f"/versions/{version}"
+        path += f":{verb}"
+        upstream_body: Dict[str, Any] = {
+            "instances": instances,
+            "signature_name": body.get("signature_name"),
+        }
+        headers = {}
+        remaining = overload.remaining_s(deadline)
+        if remaining is not None:
+            # Forward the REMAINING budget (this hop's time already
+            # spent) so the server's admission control judges what the
+            # client actually has left.
+            headers[overload.DEADLINE_HEADER] = str(
+                max(1, int(remaining * 1000)))
+        response = await self._rest_fetch(
+            ep, path, deadline=deadline,
+            method="POST", headers=headers,
+            body=json.dumps(upstream_body))
+        payload = json.loads(response.body or b"{}")
+        if response.code != 200:
+            retry_after = response.headers.get("Retry-After")
+            if retry_after:  # keep the backend's backoff hint intact
+                self.set_header("Retry-After", retry_after)
+            # The failure may itself be caused by stale cached
+            # metadata (hot reload changed the input signature → the
+            # converted payload no longer matches): drop the entry so
+            # the next request reconverts against fresh metadata
+            # instead of failing forever.
+            ep.metadata_cache.pop(name, None)
+            self.write_json(payload, response.code)
+            raise _Handled()
+        # A hot reload shows up as a changed served version in the
+        # response's model_spec; drop the stale signature cache so the
+        # NEXT request converts against the new signature.
+        if not version:  # pinned-version requests say nothing re latest
+            self.invalidate_if_version_changed(
+                ep, name, payload.get("model_spec", {}).get("version"))
+        self.write_json({"predictions": payload.get("predictions", [])})
+        raise _Handled()
 
     async def _infer(self, name: str, version: Optional[str],
                      verb: str) -> None:
@@ -449,116 +643,82 @@ class InferProxyHandler(ProxyHandler):
             return self.write_json(
                 {"error": "deadline expired before proxying",
                  "code": "DEADLINE_EXCEEDED"}, 504)
-        try:
-            metadata = await self.get_signature_map(name,
-                                                    deadline=deadline)
-        except (CircuitOpenError, BackendTimeoutError,
-                BackendDownError) as e:
-            return self.write_backend_error(e)
-        except tornado.httpclient.HTTPClientError as e:
-            return self.write_json(
-                {"error": f"model metadata fetch failed: {e}"},
-                e.code if e.code else 502)
         instances = decode_b64_if_needed(instances)
-        try:
-            instances = _bytes_to_arrays(instances, metadata)
-        except ValueError as e:
-            # Possibly converting against a stale signature (hot
-            # reload): drop the cache so the next attempt is fresh.
-            self._metadata_cache.pop(name, None)
-            return self.write_json(
-                {"error": f"payload does not match signature: {e}"}, 400)
-        # Binary upstream first (measured winner, PERF.md serving
-        # section); falls through to the REST hop when the request
-        # can't ride it (verb/method mismatch, no grpcio, multi-input,
-        # open breaker).
-        if await self._grpc_infer(name, version, verb, instances, body,
-                                  metadata, deadline=deadline):
-            return
-        path = f"/v1/models/{name}"
-        if version:
-            path += f"/versions/{version}"
-        path += f":{verb}"
-        upstream_body: Dict[str, Any] = {
-            "instances": instances,
-            "signature_name": body.get("signature_name"),
-        }
-        headers = {}
-        remaining = overload.remaining_s(deadline)
-        if remaining is not None:
-            # Forward the REMAINING budget (this hop's time already
-            # spent) so the server's admission control judges what the
-            # client actually has left.
-            headers[overload.DEADLINE_HEADER] = str(
-                max(1, int(remaining * 1000)))
-        try:
-            response = await self._rest_fetch(
-                f"{self.rpc_address}{path}", deadline=deadline,
-                method="POST", headers=headers,
-                body=json.dumps(upstream_body))
-        except (CircuitOpenError, BackendTimeoutError,
-                BackendDownError) as e:
-            return self.write_backend_error(e)
-        payload = json.loads(response.body or b"{}")
-        if response.code != 200:
-            retry_after = response.headers.get("Retry-After")
-            if retry_after:  # keep the backend's backoff hint intact
-                self.set_header("Retry-After", retry_after)
-            # The failure may itself be caused by stale cached
-            # metadata (hot reload changed the input signature → the
-            # converted payload no longer matches): drop the entry so
-            # the next request reconverts against fresh metadata
-            # instead of failing forever.
-            self._metadata_cache.pop(name, None)
-            return self.write_json(payload, response.code)
-        # A hot reload shows up as a changed served version in the
-        # response's model_spec; drop the stale signature cache so the
-        # NEXT request converts against the new signature.
-        if not version:  # pinned-version requests say nothing re latest
-            self.invalidate_if_version_changed(
-                name, payload.get("model_spec", {}).get("version"))
-        self.write_json({"predictions": payload.get("predictions", [])})
+        # Infer verbs are idempotent (pure functions of their
+        # inputs), so the shared failover loop may retry a transport
+        # failure on another replica.
+        await self.route_with_failover(
+            name,
+            lambda ep: self._attempt(ep, name, version, verb,
+                                     instances, body, deadline),
+            deadline=deadline)
 
     async def post(self, name: str, version: Optional[str], verb: str):
         await self._infer(name, version, verb)
 
 
 class ProxyHealthHandler(ProxyHandler):
-    """Proxy /healthz — the SAME schema as the model server's
-    (serving/server.py HealthHandler): ``status`` + ``saturation`` +
-    ``breakers``. The proxy has no batcher, so saturation is empty;
-    what it DOES know is each upstream's circuit-breaker state — a
-    dead :9000 or REST port shows up here before clients see 503s."""
+    """Proxy /healthz — the SAME top-level schema as the model
+    server's (serving/server.py HealthHandler): ``status`` +
+    ``saturation`` + ``breakers``, plus the router's per-replica
+    detail under ``endpoints``. The proxy has no batcher, so
+    saturation is empty; what it DOES know is each replica's health
+    and breaker state — a dead replica shows up here before clients
+    see 503s. With a single-member pool the ``breakers`` keys stay
+    the classic ``rest``/``grpc``; with a fleet they are
+    ``<address>/<wire>``."""
 
     def get(self):
+        endpoints = self.pool.endpoints()
         breakers = {}
-        for upstream, breaker in (("rest", self.rest_breaker),
-                                  ("grpc", self.grpc_breaker)):
-            breakers[upstream] = {
-                "state": breaker.state,
-                "retry_after_s": round(breaker.retry_after_s(), 3),
-            }
-        status = ("ok" if all(b["state"] != "open"
-                              for b in breakers.values())
-                  else "degraded")
-        self.write_json({"status": status, "saturation": {},
-                         "breakers": breakers})
+        for ep in endpoints:
+            prefix = "" if len(endpoints) == 1 else f"{ep.address}/"
+            for wire, breaker in (("rest", ep.rest_breaker),
+                                  ("grpc", ep.grpc_breaker)):
+                breakers[f"{prefix}{wire}"] = {
+                    "state": breaker.state,
+                    "retry_after_s": round(breaker.retry_after_s(), 3),
+                }
+        routable = [ep for ep in endpoints
+                    if ep.routable()
+                    and ep.rest_breaker.state != "open"]
+        # The pre-pool contract (and docs/observability.md schema):
+        # ANY open breaker — including a dead :9000 binary wire whose
+        # requests silently fall back to REST — reads "degraded", so
+        # alerts keyed on status fire before clients notice.
+        any_open = any(
+            breaker.state == "open"
+            for ep in endpoints
+            for breaker in (ep.rest_breaker, ep.grpc_breaker))
+        status = "ok" if routable and not any_open else "degraded"
+        self.write_json({
+            "status": status, "saturation": {}, "breakers": breakers,
+            "endpoints": {ep.address: ep.snapshot()
+                          for ep in endpoints},
+        })
 
 
 class MetadataProxyHandler(ProxyHandler):
     async def get(self, name: str):
-        try:
-            # Direct metadata GETs always revalidate upstream (and
-            # refresh the cache the infer path uses): a user asking
-            # for metadata after an export wants the new signature.
-            metadata = await self.get_signature_map(name, refresh=True)
-        except (CircuitOpenError, BackendTimeoutError,
-                BackendDownError) as e:
-            return self.write_backend_error(e)
-        except tornado.httpclient.HTTPClientError as e:
-            return self.write_json({"error": str(e)},
-                                   e.code if e.code else 502)
-        self.write_json(metadata)
+        # Direct metadata GETs always revalidate upstream (and refresh
+        # the picked replica's cache): a user asking for metadata
+        # after an export wants the new signature. The GET is
+        # idempotent, so the shared failover loop may retry transport
+        # failures on another replica.
+        async def attempt(ep: Endpoint) -> None:
+            try:
+                metadata = await self.get_signature_map(ep, name,
+                                                        refresh=True)
+            except tornado.httpclient.HTTPClientError as e:
+                # Upstream answered (4xx/5xx app error): that's a
+                # response, not a transport failure — relay it.
+                self.write_json({"error": str(e)},
+                                e.code if e.code else 502)
+                raise _Handled()
+            self.write_json(metadata)
+            raise _Handled()
+
+        await self.route_with_failover(name, attempt)
 
 
 def _bytes_to_arrays(instances: Any, metadata: Dict[str, Any]) -> Any:
@@ -588,22 +748,96 @@ def _bytes_to_arrays(instances: Any, metadata: Dict[str, Any]) -> Any:
     return [convert(r) for r in instances]
 
 
-def make_app(rpc_address: str, rpc_timeout: float = 10.0,
-             grpc_address: Optional[str] = None,
+def _worst_breaker_state(pool: EndpointPool, wire: str) -> float:
+    states = [
+        _BREAKER_STATE_NUM.get(
+            getattr(ep, f"{wire}_breaker").state, -1.0)
+        for ep in pool.endpoints()
+    ]
+    return max(states, default=-1.0)
+
+
+def make_app(rpc_address: Union[str, Sequence[str], None] = None,
+             rpc_timeout: float = 10.0,
+             grpc_address: Union[str, Sequence[Optional[str]],
+                                 None] = None,
              breaker_failures: int = 5,
-             breaker_reset_s: float = 5.0) -> tornado.web.Application:
-    # One breaker per upstream: the binary :9000 wire and the REST
-    # port fail independently (firewalled port vs dead pod).
-    rest_breaker = overload.CircuitBreaker(breaker_failures,
-                                           breaker_reset_s)
-    grpc_breaker = overload.CircuitBreaker(breaker_failures,
-                                           breaker_reset_s)
-    # Live breaker state on /metrics (render-time callback — no write
-    # per transition; two make_app calls rebind to the newest app).
-    for upstream, breaker in (("rest", rest_breaker),
-                              ("grpc", grpc_breaker)):
-        _P_BREAKER_STATE.labels(upstream).set_function(
-            lambda b=breaker: _BREAKER_STATE_NUM.get(b.state, -1.0))
+             breaker_reset_s: float = 5.0, *,
+             pool: Optional[EndpointPool] = None,
+             endpoints_source: Optional[Any] = None,
+             balancer: Union[str, Balancer] = "least_saturation",
+             retry_attempts: int = 2,
+             probe_interval_s: float = 1.0) -> tornado.web.Application:
+    """Build the pooled proxy app.
+
+    ``rpc_address`` accepts the classic single address, a
+    comma-separated string, or a list — each becomes one pool member
+    with its OWN pair of circuit breakers (the binary :9000 wire and
+    the REST port fail independently per replica) and its own
+    signature cache. ``endpoints_source`` (File/StaticEndpointSource)
+    overrides/extends membership and is re-synced by the prober for
+    hot reload. ``pool`` injects a pre-built registry (tests)."""
+    if pool is None:
+        if isinstance(rpc_address, str):
+            addresses = [a.strip() for a in rpc_address.split(",")
+                         if a.strip()]
+        else:
+            addresses = list(rpc_address or ())
+        if isinstance(grpc_address, str) or grpc_address is None:
+            if grpc_address is not None and len(addresses) > 1:
+                # Fanning one binary address onto only the FIRST of N
+                # replicas would silently leave the rest REST-only
+                # (and bind the wire to an arbitrary member) — the
+                # list form already refuses a length mismatch, so the
+                # string form must not be a quieter trap.
+                raise ValueError(
+                    "a single grpc_address string is ambiguous for a "
+                    "multi-replica rpc_address; pass a list with one "
+                    "entry per replica (None to disable a member's "
+                    "binary upstream)")
+            grpc_addresses: List[Optional[str]] = [grpc_address] + \
+                [None] * (len(addresses) - 1) if addresses else []
+        else:
+            grpc_addresses = list(grpc_address)
+            if len(grpc_addresses) != len(addresses):
+                raise ValueError(
+                    "grpc_address list must match rpc_address list")
+        pool = EndpointPool.from_addresses(
+            addresses, grpc_addresses,
+            breaker_failures=breaker_failures,
+            breaker_reset_s=breaker_reset_s)
+    if endpoints_source is not None:
+        specs = endpoints_source.specs()
+        if specs:
+            pool.sync(specs)
+    if not pool.endpoints() and endpoints_source is None:
+        # An empty pool is only legal under hot-reload discovery (the
+        # autoscaler may not have written the endpoints file yet; the
+        # prober syncs members in as they appear). A static config
+        # with zero upstreams is a misconfiguration.
+        raise ValueError("proxy needs at least one upstream (pass "
+                         "rpc_address, pool, or an endpoints_source)")
+    balancer_obj = (balancer if isinstance(balancer, Balancer)
+                    else make_balancer(balancer))
+    prober = HealthProber(pool, interval_s=probe_interval_s,
+                          source=endpoints_source)
+    # Live breaker state on /metrics: per WIRE, the worst state across
+    # the pool (render-time callback — no write per transition; two
+    # make_app calls rebind to the newest app). Per-replica states
+    # live on /healthz.
+    for wire in ("rest", "grpc"):
+        _P_BREAKER_STATE.labels(wire).set_function(
+            lambda p=pool, w=wire: _worst_breaker_state(p, w))
+    # Per-address picks-counter children die with their endpoint
+    # (pod-IP churn must not grow /metrics without bound; the pool
+    # already unregisters its own health/probe children in _drop).
+    pool.on_drop = _P_ROUTER_PICKS.remove_labels
+    members = pool.endpoints()
+    # The empty-pool placeholder never joins the pool or takes
+    # traffic; registering its health gauge would advertise a phantom
+    # routable replica ("pending:0" = 1) to fleet dashboards forever.
+    first = (members[0] if members
+             else Endpoint("pending:0", register_metrics=False))
     return tornado.web.Application([
         # Reference route grammar (server.py:270-283).
         (r"/model/([^/:]+)(?:/version/(\d+))?:(predict|classify|generate)",
@@ -612,11 +846,66 @@ def make_app(rpc_address: str, rpc_timeout: float = 10.0,
         (r"/metrics", MetricsHandler),
         (r"/tracez", ChromeTraceHandler),
         (r"/model/([^/:]+)", MetadataProxyHandler),
-    ], rpc_address=rpc_address, rpc_timeout=rpc_timeout,
-       grpc_address=grpc_address, metadata_cache={},
+    ], pool=pool, balancer_obj=balancer_obj, prober=prober,
+       rpc_timeout=rpc_timeout, retry_attempts=retry_attempts,
        log_function=access_log_function("http-proxy"),
-       rest_breaker=rest_breaker,
-       grpc_breaker=grpc_breaker)
+       # Single-upstream back-compat aliases (pre-pool callers and
+       # tests reach the breakers/cache through settings; with a
+       # fleet these are the FIRST member's).
+       rest_breaker=first.rest_breaker,
+       grpc_breaker=first.grpc_breaker,
+       metadata_cache=first.metadata_cache)
+
+
+def _normalize_address(addr: str, default_port: int) -> str:
+    """Bare host → host:default_port (flag parity with the
+    reference's --rpc_port, tf-serving.libsonnet:152)."""
+    if "://" in addr or ":" in addr.rsplit("]", 1)[-1]:
+        return addr
+    return f"{addr}:{default_port}"
+
+
+def _host_of(addr: str) -> str:
+    host = addr.split("://", 1)[1] if "://" in addr else addr
+    if ":" in host.rsplit("]", 1)[-1]:
+        host = host.rsplit(":", 1)[0]
+    return host
+
+
+def _grpc_for(addr: str, grpc_port: int) -> Optional[str]:
+    """Per-replica binary address: same host, the gRPC port."""
+    if not grpc_port:
+        return None
+    return f"{_host_of(addr)}:{grpc_port}"
+
+
+def _grpc_addresses(addresses: List[str],
+                    grpc_port: int) -> List[Optional[str]]:
+    """Binary addresses for a --rpc_address fleet. A host appearing
+    more than once (several replicas on one machine, distinguished by
+    REST port) makes the single --grpc_port ambiguous — deriving it
+    would silently collapse every such replica onto ONE gRPC channel,
+    misattributing traffic, breaker state and cache invalidation.
+    Those replicas get REST-only upstreams; per-replica gRPC for
+    same-host fleets needs the endpoints file (explicit
+    grpc_address per member)."""
+    counts: Dict[str, int] = {}
+    for a in addresses:
+        counts[_host_of(a)] = counts.get(_host_of(a), 0) + 1
+    out: List[Optional[str]] = []
+    for a in addresses:
+        if counts[_host_of(a)] > 1:
+            if grpc_port:
+                logger.warning(
+                    "host %s appears %d× in --rpc_address; one "
+                    "--grpc_port cannot address its replicas — "
+                    "binary upstream disabled for them (use "
+                    "--endpoints_file for per-replica grpc_address)",
+                    _host_of(a), counts[_host_of(a)])
+            out.append(None)
+        else:
+            out.append(_grpc_for(a, grpc_port))
+    return out
 
 
 def main(argv=None) -> int:
@@ -626,36 +915,73 @@ def main(argv=None) -> int:
     # metadata fetch and the fallback infer hop; the primary infer hop
     # is binary gRPC to --grpc_port (9000, the reference's contract).
     parser.add_argument("--rpc_port", type=int, default=8500)
-    parser.add_argument("--rpc_address", default="localhost")
+    parser.add_argument("--rpc_address", default="localhost",
+                        help="backend replica address(es); "
+                             "comma-separated for a static fleet")
     parser.add_argument("--rpc_timeout", type=float, default=10.0)
     parser.add_argument("--grpc_port", type=int, default=9000,
-                        help="model server's native gRPC port; 0 "
-                             "disables the binary upstream")
+                        help="model server's native gRPC port (per "
+                             "replica); 0 disables the binary upstream")
     parser.add_argument("--breaker_failures", type=int, default=5,
                         help="consecutive transport failures that trip "
-                             "an upstream's circuit breaker open")
+                             "a replica upstream's circuit breaker")
     parser.add_argument("--breaker_reset", type=float, default=5.0,
                         help="seconds an open circuit waits before the "
                              "half-open recovery probe")
+    parser.add_argument("--endpoints_file", default=None,
+                        help="JSON fleet membership file (ConfigMap-"
+                             "shaped; hot-reloaded — the autoscaler "
+                             "sidecar rewrites it). Overrides "
+                             "--rpc_address when present")
+    parser.add_argument("--balancer", default="least_saturation",
+                        choices=("round_robin", "least_saturation",
+                                 "affinity"),
+                        help="routing policy over the replica pool")
+    parser.add_argument("--retries", type=int, default=2,
+                        help="max additional replicas to try after a "
+                             "transport failure (budget-aware)")
+    parser.add_argument("--probe_interval", type=float, default=1.0,
+                        help="seconds between /healthz probes of each "
+                             "replica; 0 disables the prober")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    # --rpc_address accepts bare host (reference --rpc_port style,
-    # tf-serving.libsonnet:152), host:port, or a full URL; the handler
-    # property adds the scheme when missing.
-    addr = args.rpc_address
-    host = args.rpc_address
-    if "://" in host:  # strip scheme/port for the gRPC dial target
-        host = host.split("://", 1)[1]
-    host = host.rsplit(":", 1)[0] if (":" in host.rsplit("]", 1)[-1]) else host
-    if "://" not in addr and ":" not in addr.rsplit("]", 1)[-1]:
-        addr = f"{addr}:{args.rpc_port}"
-    grpc_address = f"{host}:{args.grpc_port}" if args.grpc_port else None
-    app = make_app(addr, args.rpc_timeout, grpc_address=grpc_address,
-                   breaker_failures=args.breaker_failures,
-                   breaker_reset_s=args.breaker_reset)
+    source = None
+    if args.endpoints_file:
+        if not args.probe_interval:
+            # make_app permits an empty pool under file discovery
+            # only because the prober syncs members in as they
+            # appear; without the prober a pool that starts empty
+            # (router up before the autoscaler's first write) would
+            # 503 forever with no warning.
+            parser.error("--endpoints_file requires the prober for "
+                         "hot reload: --probe_interval must be > 0")
+        source = FileEndpointSource(args.endpoints_file)
+        # ONE read: specs() re-reads the (hot-reloaded) file, and two
+        # reads racing the autoscaler's rewrite could zip together
+        # REST addresses from one membership version with gRPC
+        # addresses from the next.
+        specs = source.specs()
+        addresses: List[str] = [a for a, _ in specs]
+        grpc_addresses: List[Optional[str]] = [g for _, g in specs]
+    else:
+        addresses = [
+            _normalize_address(a.strip(), args.rpc_port)
+            for a in args.rpc_address.split(",") if a.strip()]
+        grpc_addresses = _grpc_addresses(addresses, args.grpc_port)
+    pool = EndpointPool.from_addresses(
+        addresses, grpc_addresses,
+        breaker_failures=args.breaker_failures,
+        breaker_reset_s=args.breaker_reset)
+    app = make_app(rpc_timeout=args.rpc_timeout, pool=pool,
+                   endpoints_source=source, balancer=args.balancer,
+                   retry_attempts=args.retries,
+                   probe_interval_s=args.probe_interval or 1.0)
     app.listen(args.port)
-    logger.info("http proxy on :%d → REST :%d, gRPC %s", args.port,
-                args.rpc_port, grpc_address or "disabled")
+    if args.probe_interval:
+        app.settings["prober"].start()
+    logger.info("http proxy on :%d → %d replica(s) %s, balancer=%s",
+                args.port, len(pool.endpoints()),
+                [ep.address for ep in pool.endpoints()], args.balancer)
     tornado.ioloop.IOLoop.current().start()
     return 0
 

@@ -199,52 +199,78 @@ def check_license_file() -> list:
 
 
 def check_operator_wait_discipline() -> list:
-    """The workqueue is the operator's ONLY sanctioned wait path
-    (ISSUE 2): under ``kubeflow_tpu/operator/`` — excluding
-    workqueue.py itself — forbid (a) any ``time.sleep`` call and
-    (b) any ``.wait(...)`` call lexically inside an ``except``
-    handler. Both are the flat-retry hot-loop shape the rate-limited
-    workqueue replaced; failure handling must route delays through
-    ExponentialBackoff/WorkQueue so they are capped, jittered, and
-    observable in the metrics surface."""
-    # Exempt: the sanctioned wait path itself; the fault injector
+    """Control loops wait on sanctioned, bounded paths only.
+
+    Operator half (ISSUE 2): under ``kubeflow_tpu/operator/`` —
+    excluding workqueue.py itself — forbid (a) any ``time.sleep``
+    call and (b) any ``.wait(...)`` call lexically inside an
+    ``except`` handler. Both are the flat-retry hot-loop shape the
+    rate-limited workqueue replaced.
+
+    Scaling half (ISSUE 5): the same rules under
+    ``kubeflow_tpu/scaling/`` (the prober and autoscaler loop), PLUS
+    (c) ``.wait()`` with no timeout — an unbounded wait wedges the
+    control loop forever on one lost wakeup — and (d) any
+    ``time.time()`` call: control timing must ride monotonic clocks
+    (an NTP step must never fire a cooldown early or freeze a probe
+    schedule)."""
+    # Exempt: the operator's sanctioned wait path; the fault injector
     # (whose time.sleep IS the injected apiserver latency); and the
-    # load-bench driver (its sleeps pace the measurement harness, not
-    # the control loop under test).
-    exempt = {"workqueue.py", "fake.py", "benchmark.py"}
+    # load-bench drivers (their sleeps pace the measurement harness,
+    # not the control loop under test).
+    dirs = [
+        ("operator", {"workqueue.py", "fake.py", "benchmark.py"}, False),
+        ("scaling", {"benchmark.py"}, True),
+    ]
     errors = []
-    operator_dir = REPO / "kubeflow_tpu" / "operator"
-    for f in sorted(operator_dir.glob("*.py")):
-        if f.name in exempt:
-            continue
-        tree = ast.parse(f.read_text(), str(f))
-        except_spans = []
-        for node in ast.walk(tree):
-            if isinstance(node, ast.ExceptHandler):
-                except_spans.append((node.lineno, node.end_lineno))
-
-        def in_except(lineno: int) -> bool:
-            return any(lo <= lineno <= hi for lo, hi in except_spans)
-
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
+    for sub, exempt, strict in dirs:
+        for f in sorted((REPO / "kubeflow_tpu" / sub).glob("*.py")):
+            if f.name in exempt:
                 continue
-            func = node.func
-            if not isinstance(func, ast.Attribute):
-                continue
-            if (func.attr == "sleep"
-                    and isinstance(func.value, ast.Name)
-                    and func.value.id == "time"):
-                errors.append(
-                    f"operator-wait: {f.relative_to(REPO)}:"
-                    f"{node.lineno}: time.sleep — route waits through "
-                    f"the workqueue (operator/workqueue.py)")
-            elif func.attr == "wait" and in_except(node.lineno):
-                errors.append(
-                    f"operator-wait: {f.relative_to(REPO)}:"
-                    f"{node.lineno}: .wait() inside an except handler "
-                    f"is a flat retry loop — use "
-                    f"ExponentialBackoff/WorkQueue instead")
+            tree = ast.parse(f.read_text(), str(f))
+            except_spans = []
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ExceptHandler):
+                    except_spans.append((node.lineno, node.end_lineno))
+
+            def in_except(lineno: int) -> bool:
+                return any(lo <= lineno <= hi
+                           for lo, hi in except_spans)
+
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                is_time_attr = (isinstance(func.value, ast.Name)
+                                and func.value.id == "time")
+                if func.attr == "sleep" and is_time_attr:
+                    errors.append(
+                        f"operator-wait: {f.relative_to(REPO)}:"
+                        f"{node.lineno}: time.sleep — pace waits with "
+                        f"a bounded Event.wait/workqueue, never a "
+                        f"blind sleep")
+                elif func.attr == "wait" and in_except(node.lineno):
+                    errors.append(
+                        f"operator-wait: {f.relative_to(REPO)}:"
+                        f"{node.lineno}: .wait() inside an except "
+                        f"handler is a flat retry loop — use "
+                        f"ExponentialBackoff/WorkQueue instead")
+                elif (strict and func.attr == "wait"
+                      and not node.args
+                      and not any(k.arg == "timeout"
+                                  for k in node.keywords)):
+                    errors.append(
+                        f"operator-wait: {f.relative_to(REPO)}:"
+                        f"{node.lineno}: unbounded .wait() — every "
+                        f"scaling-loop wait must carry a timeout")
+                elif strict and func.attr == "time" and is_time_attr:
+                    errors.append(
+                        f"operator-wait: {f.relative_to(REPO)}:"
+                        f"{node.lineno}: time.time() — scaling "
+                        f"control timing is monotonic-only "
+                        f"(time.monotonic)")
     return errors
 
 

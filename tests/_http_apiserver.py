@@ -47,6 +47,7 @@ _PLURAL_TO_KIND = {
     "events": "Event",
     "configmaps": "ConfigMap",
     "leases": "Lease",
+    "deployments": "Deployment",
 }
 
 
@@ -156,6 +157,12 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(payload)
             return
+        if name is not None and subresource == "scale":
+            try:
+                return self._send(200,
+                                  self.fake.get_scale(kind, ns, name))
+            except NotFound as err:
+                return self._error(404, str(err))
         if name is not None:
             try:
                 return self._send(200, self.fake.get(kind, ns, name))
@@ -221,6 +228,28 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._authorized():
             return self._error(401, "bad bearer token")
         kind, ns, name, subresource, _ = self._parse()
+        if subresource == "scale":
+            # The scale subresource PUT carries an autoscaling/v1
+            # Scale object; spec.replicas is honored plus the
+            # optimistic-concurrency resourceVersion (apiserver
+            # contract: a stale carried version is a 409).
+            try:
+                body = self._body()
+                replicas = int(
+                    body.get("spec", {}).get("replicas", 0))
+                rv = body.get("metadata", {}).get("resourceVersion")
+                return self._send(
+                    200, self.fake.update_scale(
+                        kind, ns, name, replicas,
+                        resource_version=rv))
+            except NotFound as err:
+                return self._error(404, str(err))
+            except Conflict as err:
+                return self._error(409, str(err))
+            except TooManyRequests as err:
+                return self._error(429, str(err))
+            except ServerError as err:
+                return self._error(500, str(err))
         if subresource not in (None, "status"):
             # Only the declared status subresource exists (the CRD
             # declares subresources.status; anything else 404s on a

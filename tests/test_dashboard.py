@@ -459,6 +459,64 @@ class DashboardTest(tornado.testing.AsyncHTTPTestCase):
         assert payload["available"] is True
         assert payload["metrics"] == metrics
 
+    def test_fleet_endpoint_and_ui_section(self):
+        """GET /tpujobs/api/fleet serves the ConfigMap the serving
+        autoscaler publishes (same pattern as /tpujobs/api/operator),
+        and the HTML view renders the fleet section from it."""
+        from kubeflow_tpu.scaling.autoscaler import (
+            FLEET_CONFIGMAP,
+            FLEET_KEY,
+        )
+
+        resp = self.fetch("/tpujobs/api/fleet")
+        assert resp.code == 404  # autoscaler not publishing yet
+        assert json.loads(resp.body)["available"] is False
+        page = self.fetch("/tpujobs/ui").body.decode()
+        assert "Serving fleet" in page
+        assert "No fleet published" in page
+
+        fleet = {
+            "replicas": [
+                {"address": "10.0.0.1:8500", "reachable": True,
+                 "status": "ok", "queue_wait_ms": 80.0,
+                 "shed_rate": 0.0, "expired_rate": 0.0,
+                 "resident_models": ["llama"]},
+                {"address": "10.0.0.2:8500", "reachable": False},
+            ],
+            "decision": {"action": "scale_up", "reason": "queue_wait",
+                         "current": 2, "desired": 3,
+                         "mean_queue_wait_ms": 180.0,
+                         "target_queue_wait_ms": 100.0,
+                         "ratio": 1.8, "replicas_reporting": 1,
+                         "age_s": 2.5},
+        }
+        self.api.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": FLEET_CONFIGMAP,
+                         "namespace": "default"},
+            "data": {FLEET_KEY: json.dumps(fleet)},
+        })
+        resp = self.fetch("/tpujobs/api/fleet")
+        assert resp.code == 200
+        payload = json.loads(resp.body)
+        assert payload["available"] is True
+        assert payload["fleet"] == fleet
+        page = self.fetch("/tpujobs/ui").body.decode()
+        assert "10.0.0.1:8500" in page
+        assert "unreachable" in page  # the dead replica is visible
+        assert "scale_up" in page and "2 → 3" in page
+
+        # A malformed ConfigMap (version skew, hand edit — the RBAC
+        # grants patch) must degrade the SECTION, not 500 the page.
+        fleet["decision"]["current"] = None
+        self.api.patch(
+            "ConfigMap", "default", FLEET_CONFIGMAP,
+            lambda o: o["data"].update({FLEET_KEY: json.dumps(fleet)}))
+        resp = self.fetch("/tpujobs/ui")
+        assert resp.code == 200
+        assert "Fleet ConfigMap unreadable" in resp.body.decode()
+
+
 class TraceTabTest(tornado.testing.AsyncHTTPTestCase):
     """Profiler traces surfaced through the dashboard (SURVEY §5's
     stated rebuild target; VERDICT-r3 missing #3)."""

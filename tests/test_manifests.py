@@ -164,6 +164,67 @@ def test_serving_mixins_and_routes():
     assert "cloud.google.com/gke-tpu-accelerator" in tpl["nodeSelector"]
 
 
+def test_serving_router_and_replicas():
+    """`router true` adds the fleet router pod (pooled proxy +
+    autoscaler sidecar over a shared endpoints file) and `replicas`
+    pins the serving Deployment's fleet size (docs/scaling.md)."""
+    proto = get_prototype("tpu-serving")
+    base = {"name": "llama", "model_path": "gs://b/m"}
+
+    dep, _svc = proto.build({**base, "replicas": "3"})
+    assert dep["spec"]["replicas"] == 3
+
+    objects = proto.build({**base, "router": "true",
+                           "max_replicas": "4",
+                           "balancer": "affinity"})
+    # dep, svc, router dep, router svc, autoscaler SA + Role + Binding
+    assert len(objects) == 7
+    # With the autoscaler owning the scale subresource, the serving
+    # Deployment must NOT pin spec.replicas — a manifest re-apply
+    # would stomp the autoscaler's writes back to the static param.
+    assert "replicas" not in objects[0]["spec"]
+    router_dep, router_svc = objects[2], objects[3]
+    tpl = router_dep["spec"]["template"]["spec"]
+    names = [c["name"] for c in tpl["containers"]]
+    assert names == ["llama-router", "llama-autoscaler"]
+    proxy_args = " ".join(tpl["containers"][0]["args"])
+    scaler_args = " ".join(tpl["containers"][1]["args"])
+    # Both halves of the hot-reload contract point at the SAME file
+    # on the shared emptyDir volume.
+    assert "--endpoints_file=/fleet/endpoints.json" in proxy_args
+    assert "--write_endpoints=/fleet/endpoints.json" in scaler_args
+    assert "--balancer=affinity" in proxy_args
+    assert "--max_replicas=4" in scaler_args
+    assert "--deployment=llama" in scaler_args
+    assert any(v.get("emptyDir") is not None and v["name"] == "fleet"
+               for v in tpl["volumes"])
+    assert all("/fleet" in m["mountPath"]
+               for c in tpl["containers"]
+               for m in c["volumeMounts"])
+    # The autoscaler writes the scale subresource: its own SA, and
+    # the SA actually ships with a Role granting exactly its verbs
+    # (pods read, deployments/scale write, configmaps publish) plus
+    # the Binding — a router pod must come up without hand-made RBAC.
+    assert tpl["serviceAccountName"] == "llama-autoscaler"
+    sa, role, binding = objects[4], objects[5], objects[6]
+    assert (sa["kind"], role["kind"], binding["kind"]) == \
+        ("ServiceAccount", "Role", "RoleBinding")
+    assert sa["metadata"]["name"] == "llama-autoscaler"
+    granted = {(g, r): rule["verbs"]
+               for rule in role["rules"]
+               for g in rule["apiGroups"]
+               for r in rule["resources"]}
+    assert "list" in granted[("", "pods")]
+    assert "update" in granted[("apps", "deployments/scale")]
+    assert ("apps", "deployments") not in granted  # scale ONLY
+    assert "create" in granted[("", "configmaps")]
+    assert binding["roleRef"]["name"] == "llama-autoscaler"
+    assert binding["subjects"][0]["name"] == "llama-autoscaler"
+    assert router_svc["spec"]["ports"][0]["port"] == 8000
+    # Default build stays two objects — no router/RBAC tax when off.
+    assert len(proto.build(base)) == 2
+
+
 def test_envoy_config_valid_and_routed():
     from kubeflow_tpu.manifests.iap import envoy_config
 

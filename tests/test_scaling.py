@@ -1,0 +1,920 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""kubeflow_tpu/scaling/: registry, balancer policies, autoscaler.
+
+Everything here is hermetic and clock-injected: the prober tests use
+an injected fetch (no sockets), the autoscaler hysteresis/cooldown
+tests run a scripted metrics trace against a simulated clock (no
+sleeping), and actuation goes through FakeApiServer's scale
+subresource (plus the HTTP facade once, to cover the wire shape).
+The live-socket fleet e2e lives in tests/test_serving_stress.py.
+"""
+
+import json
+import threading
+
+import pytest
+
+from kubeflow_tpu.operator.fake import FakeApiServer
+from kubeflow_tpu.scaling.autoscaler import (
+    FLEET_CONFIGMAP,
+    FLEET_KEY,
+    Autoscaler,
+    AutoscalerConfig,
+    AutoscalerLoop,
+    DeploymentScaler,
+    Scaler,
+    discover_pod_endpoints,
+)
+from kubeflow_tpu.scaling.balancer import (
+    LeastSaturationBalancer,
+    ResidentAffinityBalancer,
+    RoundRobinBalancer,
+    eligible_endpoints,
+    make_balancer,
+)
+from kubeflow_tpu.scaling.endpoints import (
+    DRAINING,
+    HEALTHY,
+    UNHEALTHY,
+    UNKNOWN,
+    Endpoint,
+    EndpointPool,
+    FileEndpointSource,
+    HealthProber,
+    StaticEndpointSource,
+    write_endpoints_file,
+)
+
+
+def _healthz(saturation=None, status="ok"):
+    return {"status": status, "breakers": {},
+            "saturation": saturation or {}}
+
+
+def _stats(queue_depth=0.0, latency_ms=10.0, shed=0, expired=0):
+    return {"queue_depth": queue_depth,
+            "est_batch_latency_ms": latency_ms,
+            "shed": shed, "expired": expired}
+
+
+# ---------------------------------------------------------------------------
+# Endpoint / EndpointPool
+
+
+def test_endpoint_starts_unknown_and_routable():
+    ep = Endpoint("a:1")
+    assert ep.health == UNKNOWN
+    # A fresh member takes traffic before its first probe lands.
+    assert ep.routable()
+
+
+def test_saturation_score_prices_queue_and_inflight():
+    ep = Endpoint("a:1")
+    ep.saturation = {"m1": _stats(queue_depth=3, latency_ms=20.0),
+                     "m2": _stats(queue_depth=1, latency_ms=40.0)}
+    # 3*20 + 1*40 = 100 queue wait; inflight priced at the max batch
+    # latency (one accelerator serializes all models).
+    assert ep.saturation_score() == pytest.approx(100.0)
+    ep.inflight = 2
+    assert ep.saturation_score() == pytest.approx(100.0 + 2 * 40.0)
+
+
+def test_probe_success_readmits_and_closes_rest_breaker():
+    ep = Endpoint("a:1", breaker_failures=1, breaker_reset_s=60.0)
+    for _ in range(3):
+        ep.mark_probe_failure(eject_after=3)
+    assert ep.health == UNHEALTHY and not ep.routable()
+    ep.rest_breaker.record_failure()
+    assert ep.rest_breaker.state == "open"
+    readmitted = ep.mark_probe_success(
+        _healthz({"m": _stats(queue_depth=2)}))
+    assert readmitted and ep.health == HEALTHY
+    assert ep.resident_models() == ["m"]
+    # The probe IS a successful REST round trip: a revived replica
+    # must not wait out a stale open circuit to rejoin rotation.
+    assert ep.rest_breaker.state == "closed"
+
+
+def test_probe_success_leaves_closed_breaker_evidence_alone():
+    """A replica whose /healthz answers while its INFER path hangs
+    must still trip its breaker: probes heal open circuits but never
+    reset a closed breaker's consecutive-failure count."""
+    ep = Endpoint("a:1", breaker_failures=2, breaker_reset_s=60.0)
+    ep.rest_breaker.record_failure()  # one infer transport failure
+    ep.mark_probe_success(_healthz())  # healthz still 200
+    ep.rest_breaker.record_failure()  # second consecutive failure
+    assert ep.rest_breaker.state == "open"  # probe didn't erase #1
+
+
+def test_dropped_endpoint_unregisters_metric_children():
+    from kubeflow_tpu.scaling.endpoints import _G_ENDPOINT_HEALTH
+
+    pool = EndpointPool.from_addresses(["leak-test:1"])
+    assert ("leak-test:1",) in _G_ENDPOINT_HEALTH._children
+    pool.remove("leak-test:1")
+    # Pod-IP churn must not pin dead Endpoints (the gauge callback
+    # closes over the object) nor grow /metrics forever.
+    assert ("leak-test:1",) not in _G_ENDPOINT_HEALTH._children
+
+
+def test_probe_failure_ejects_only_after_threshold():
+    ep = Endpoint("a:1")
+    assert not ep.mark_probe_failure(eject_after=3)
+    assert not ep.mark_probe_failure(eject_after=3)
+    assert ep.routable()  # two strikes: still in rotation
+    assert ep.mark_probe_failure(eject_after=3)  # the ejecting one
+    assert ep.health == UNHEALTHY
+    # Further failures don't re-report the transition.
+    assert not ep.mark_probe_failure(eject_after=3)
+
+
+def test_pool_remove_is_drain_aware():
+    pool = EndpointPool.from_addresses(["a:1", "b:1"])
+    busy = pool.get("a:1")
+    busy.inflight = 1
+    pool.remove("a:1")
+    assert busy.health == DRAINING and not busy.routable()
+    assert pool.get("a:1") is not None  # kept until drained
+    pool.remove("b:1")  # idle: drops immediately
+    assert pool.get("b:1") is None
+    # Drain finishes → the next sync drops the member.
+    busy.inflight = 0
+    pool.sync([])
+    assert pool.get("a:1") is None
+
+
+def test_pool_sync_readds_draining_member_with_state_intact():
+    pool = EndpointPool.from_addresses(["a:1"])
+    ep = pool.get("a:1")
+    ep.metadata_cache["m"] = {"version": "7", "payload": {}}
+    ep.inflight = 1
+    pool.remove("a:1")
+    assert ep.health == DRAINING
+    # Scale-down reverted before the drain finished: same object
+    # rejoins (breakers and caches intact), no new Endpoint.
+    pool.sync([("a:1", None)])
+    assert pool.get("a:1") is ep
+    assert ep.health == UNKNOWN and ep.routable()
+    assert ep.metadata_cache["m"]["version"] == "7"
+
+
+def test_pool_sync_retargets_grpc_on_retained_member():
+    # Membership updates may change a RETAINED replica's binary
+    # address (gRPC enabled later, port moved, disabled): the pool
+    # must swap it — and zero the binary breaker, whose evidence
+    # concerns the old wire — instead of silently keeping the stale
+    # address/channel forever. REST-side state survives untouched.
+    pool = EndpointPool.from_addresses(["a:1"], [None])
+    ep = pool.get("a:1")
+    ep.metadata_cache["m"] = {"version": "1", "payload": {}}
+    for _ in range(ep.grpc_breaker.failure_threshold):
+        ep.grpc_breaker.record_failure()
+    assert ep.grpc_breaker.state == "open"
+    sentinel = object()
+    ep.grpc_channel = sentinel  # stale dialed channel must be dropped
+    pool.sync([("a:1", "a:9000")])
+    assert ep is pool.get("a:1")  # retained, not recreated
+    assert ep.grpc_address == "a:9000"
+    assert ep.grpc_channel is None
+    assert ep.grpc_breaker.state == "closed"
+    assert ep.metadata_cache["m"]["version"] == "1"
+    pool.sync([("a:1", None)])  # ...and disabling works too
+    assert ep.grpc_address is None
+
+
+def test_pool_sync_adds_and_removes():
+    pool = EndpointPool.from_addresses(["a:1", "b:1"])
+    added, removed = pool.sync([("b:1", None), ("c:1", "c:9")])
+    assert added == ["c:1"] and removed == ["a:1"]
+    assert [ep.address for ep in pool.endpoints()] == ["b:1", "c:1"]
+    assert pool.get("c:1").grpc_address == "c:9"
+
+
+# ---------------------------------------------------------------------------
+# Discovery sources
+
+
+def test_file_source_hot_reloads_on_content_change(tmp_path):
+    path = tmp_path / "endpoints.json"
+    write_endpoints_file(str(path), [("a:1", "a:9"), ("b:1", None)])
+    source = FileEndpointSource(str(path))
+    assert source.specs() == [("a:1", "a:9"), ("b:1", None)]
+    write_endpoints_file(str(path), [("b:1", None), ("c:1", None)])
+    assert source.specs() == [("b:1", None), ("c:1", None)]
+    # The writer's temp file never survives (atomic rename).
+    assert [p.name for p in tmp_path.iterdir()] == ["endpoints.json"]
+
+
+def test_file_source_keeps_last_good_on_damage(tmp_path):
+    path = tmp_path / "endpoints.json"
+    path.write_text(json.dumps(["a:1"]))
+    source = FileEndpointSource(str(path))
+    assert source.specs() == [("a:1", None)]
+    path.write_text("{not json")  # half-written human edit
+    assert source.specs() == [("a:1", None)]
+    path.unlink()  # missing file: same story
+    assert source.specs() == [("a:1", None)]
+    path.write_text(json.dumps(["b:1"]))  # recovers on good content
+    assert source.specs() == [("b:1", None)]
+
+
+def test_file_source_accepts_bare_list_and_dict_shapes(tmp_path):
+    path = tmp_path / "e.json"
+    path.write_text(json.dumps(
+        {"endpoints": [{"address": "a:1", "grpc_address": "a:9"},
+                       {"address": "b:1"}]}))
+    assert FileEndpointSource(str(path)).specs() == [
+        ("a:1", "a:9"), ("b:1", None)]
+
+
+# ---------------------------------------------------------------------------
+# HealthProber
+
+
+def _prober(pool, responses, **kwargs):
+    """Prober whose fetch is a dict: address → payload | Exception."""
+
+    def fetch(ep):
+        value = responses[ep.address]
+        if isinstance(value, Exception):
+            raise value
+        return value
+
+    return HealthProber(pool, fetch=fetch, **kwargs)
+
+
+def test_prober_ejects_and_readmits():
+    pool = EndpointPool.from_addresses(["a:1", "b:1"])
+    responses = {"a:1": _healthz(), "b:1": ConnectionError("down")}
+    prober = _prober(pool, responses, eject_after=3)
+    for _ in range(2):
+        prober.probe_all_sync()
+    assert pool.get("b:1").routable()  # not yet: 2 of 3 strikes
+    prober.probe_all_sync()
+    assert not pool.get("b:1").routable()
+    assert pool.get("a:1").health == HEALTHY
+    # One good probe readmits.
+    responses["b:1"] = _healthz({"m": _stats()})
+    prober.probe_all_sync()
+    assert pool.get("b:1").health == HEALTHY
+    assert pool.get("b:1").resident_models() == ["m"]
+
+
+def test_prober_nonready_status_counts_as_failure():
+    pool = EndpointPool.from_addresses(["a:1"])
+    prober = _prober(pool, {"a:1": {"status": "loading"}},
+                     eject_after=1)
+    prober.probe_all_sync()
+    assert pool.get("a:1").health == UNHEALTHY
+    # "degraded" (some breakers open, still serving) stays routable.
+    prober2 = _prober(pool, {"a:1": _healthz(status="degraded")},
+                      eject_after=1)
+    prober2.probe_all_sync()
+    assert pool.get("a:1").health == HEALTHY
+
+
+def test_prober_syncs_membership_from_source_each_cycle(tmp_path):
+    path = tmp_path / "endpoints.json"
+    write_endpoints_file(str(path), [("a:1", None)])
+    pool = EndpointPool()
+    responses = {"a:1": _healthz(), "b:1": _healthz()}
+    prober = _prober(pool, responses,
+                     source=FileEndpointSource(str(path)))
+    prober.probe_all_sync()
+    assert [ep.address for ep in pool.endpoints()] == ["a:1"]
+    # The autoscaler scales up: rewrite the file, next cycle follows.
+    write_endpoints_file(str(path), [("a:1", None), ("b:1", None)])
+    prober.probe_all_sync()
+    assert [ep.address for ep in pool.endpoints()] == ["a:1", "b:1"]
+    assert pool.get("b:1").health == HEALTHY
+
+
+# ---------------------------------------------------------------------------
+# eligible_endpoints + balancer policies
+
+
+def test_eligible_prefers_closed_breakers_but_degrades():
+    pool = EndpointPool.from_addresses(["a:1", "b:1"],
+                                       breaker_reset_s=60.0)
+    a, b = pool.endpoints()
+    for _ in range(5):
+        a.rest_breaker.record_failure()
+    assert a.rest_breaker.state == "open"
+    assert eligible_endpoints(pool) == [b]
+    # Both open → the tier collapses rather than refusing to route.
+    for _ in range(5):
+        b.rest_breaker.record_failure()
+    assert eligible_endpoints(pool) == [a, b]
+    # Excluded (already tried this request) never come back.
+    assert eligible_endpoints(pool, exclude=[a]) == [b]
+    assert eligible_endpoints(pool, exclude=[a, b]) == []
+
+
+def test_eligible_skips_ejected_until_nothing_else():
+    pool = EndpointPool.from_addresses(["a:1", "b:1"])
+    a, b = pool.endpoints()
+    for _ in range(3):
+        a.mark_probe_failure(eject_after=3)
+    assert eligible_endpoints(pool) == [b]
+    for _ in range(3):
+        b.mark_probe_failure(eject_after=3)
+    # All ejected: still route (probe traffic is how a prober-less
+    # pool ever recovers).
+    assert eligible_endpoints(pool) == [a, b]
+
+
+def test_round_robin_rotates_evenly():
+    pool = EndpointPool.from_addresses(["a:1", "b:1", "c:1"])
+    rr = RoundRobinBalancer()
+    picks = [rr.pick(pool.endpoints()).address for _ in range(9)]
+    assert picks == ["a:1", "b:1", "c:1"] * 3
+    assert rr.pick([]) is None
+
+
+def test_least_saturation_picks_emptiest():
+    pool = EndpointPool.from_addresses(["a:1", "b:1", "c:1"])
+    a, b, c = pool.endpoints()
+    a.saturation = {"m": _stats(queue_depth=5, latency_ms=10)}
+    b.saturation = {"m": _stats(queue_depth=1, latency_ms=10)}
+    c.saturation = {"m": _stats(queue_depth=3, latency_ms=10)}
+    ls = LeastSaturationBalancer()
+    assert ls.pick(pool.endpoints()) is b
+    # The proxy's own in-flight count corrects between probes.
+    b.inflight = 10
+    assert ls.pick(pool.endpoints()) is c
+
+
+def test_least_saturation_breaks_ties_by_rotation():
+    pool = EndpointPool.from_addresses(["a:1", "b:1", "c:1"])
+    ls = LeastSaturationBalancer()
+    picks = {ls.pick(pool.endpoints()).address for _ in range(6)}
+    # All scores equal (0): a pure min() would pin one replica.
+    assert picks == {"a:1", "b:1", "c:1"}
+
+
+def test_affinity_prefers_resident_replica():
+    pool = EndpointPool.from_addresses(["a:1", "b:1", "c:1"])
+    a, b, c = pool.endpoints()
+    b.saturation = {"llama": _stats(queue_depth=1, latency_ms=10)}
+    c.saturation = {"llama": _stats(queue_depth=4, latency_ms=10)}
+    af = ResidentAffinityBalancer(overload_ms=500.0)
+    # Resident on b and c; b is emptier. a (cold) never picked.
+    for _ in range(4):
+        assert af.pick(pool.endpoints(), model="llama") in (b, c)
+    assert af.pick(pool.endpoints(), model="llama") is b
+
+
+def test_affinity_falls_back_on_overload_and_nonresidence():
+    pool = EndpointPool.from_addresses(["a:1", "b:1"])
+    a, b = pool.endpoints()
+    b.saturation = {"llama": _stats(queue_depth=100, latency_ms=10)}
+    af = ResidentAffinityBalancer(overload_ms=500.0)
+    # The only resident replica is past the overload bar (1000 ms):
+    # overflow to pool-wide least-saturation (a, empty) rather than
+    # hotspotting b — affinity is not an availability constraint.
+    assert af.pick(pool.endpoints(), model="llama") is a
+    # Model resident nowhere → plain least-saturation.
+    assert af.pick(pool.endpoints(), model="gemma") is a
+    # No model hint (metadata GETs) → plain least-saturation.
+    assert af.pick(pool.endpoints()) is a
+
+
+def test_make_balancer():
+    assert make_balancer("round_robin").name == "round_robin"
+    assert make_balancer("least_saturation").name == "least_saturation"
+    assert make_balancer("affinity").name == "affinity"
+    with pytest.raises(ValueError, match="unknown balancer"):
+        make_balancer("random")
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler decision core
+
+
+class FakeScaler(Scaler):
+    def __init__(self, replicas=1):
+        self.replicas = replicas
+        self.writes = []
+
+    def get_replicas(self):
+        return self.replicas
+
+    def set_replicas(self, replicas):
+        self.replicas = replicas
+        self.writes.append(replicas)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _autoscaler(scaler, clock, **overrides):
+    defaults = dict(min_replicas=1, max_replicas=8,
+                    target_queue_wait_ms=100.0, hysteresis=0.2,
+                    scale_up_cooldown_s=10.0,
+                    scale_down_cooldown_s=30.0)
+    defaults.update(overrides)
+    return Autoscaler(AutoscalerConfig(**defaults), scaler,
+                      clock=clock)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        AutoscalerConfig(min_replicas=3, max_replicas=2).validate()
+    with pytest.raises(ValueError):
+        AutoscalerConfig(target_queue_wait_ms=0).validate()
+    with pytest.raises(ValueError):
+        AutoscalerConfig(hysteresis=1.5).validate()
+
+
+def test_holds_inside_hysteresis_band():
+    scaler, clock = FakeScaler(2), FakeClock()
+    asc = _autoscaler(scaler, clock)
+    for wait in (81.0, 100.0, 119.0):  # within ±20% of 100
+        d = asc.evaluate([{"queue_wait_ms": wait}])
+        assert d["action"] == "hold"
+        assert d["reason"] == "within_hysteresis_band"
+    assert scaler.writes == []
+
+
+def test_scales_up_proportionally_with_double_cap():
+    scaler, clock = FakeScaler(2), FakeClock()
+    asc = _autoscaler(scaler, clock)
+    # ratio 6 wants 12; one decision may at most double the fleet.
+    d = asc.evaluate([{"queue_wait_ms": 600.0}])
+    assert d["action"] == "scale_up" and d["desired"] == 4
+    assert scaler.replicas == 4
+
+
+def test_scale_up_cooldown_blocks_consecutive_ups():
+    scaler, clock = FakeScaler(1), FakeClock()
+    asc = _autoscaler(scaler, clock)
+    assert asc.evaluate([{"queue_wait_ms": 300.0}])["action"] == "scale_up"
+    clock.t = 5.0  # inside the 10 s up-cooldown
+    d = asc.evaluate([{"queue_wait_ms": 300.0}])
+    assert d["action"] == "hold" and d["reason"] == "scale_up_cooldown"
+    clock.t = 11.0
+    assert asc.evaluate([{"queue_wait_ms": 300.0}])["action"] == "scale_up"
+
+
+def test_scale_down_requires_quiet_since_any_action():
+    scaler, clock = FakeScaler(1), FakeClock()
+    asc = _autoscaler(scaler, clock)
+    asc.evaluate([{"queue_wait_ms": 400.0}])
+    assert scaler.replicas == 2
+    # Load vanishes right after the up: the down must wait out the
+    # down-cooldown from the UP (up-then-down is oscillation).
+    clock.t = 15.0
+    d = asc.evaluate([{"queue_wait_ms": 10.0}])
+    assert d["action"] == "hold" and d["reason"] == "scale_down_cooldown"
+    clock.t = 31.0
+    d = asc.evaluate([{"queue_wait_ms": 10.0}])
+    assert d["action"] == "scale_down" and scaler.replicas == 1
+
+
+def test_shedding_forces_scale_up_despite_short_queue():
+    scaler, clock = FakeScaler(2), FakeClock()
+    asc = _autoscaler(scaler, clock)
+    # Admission control keeps the queue short exactly when overloaded:
+    # wait says "healthy", shed rate says undersized.
+    d = asc.evaluate([{"queue_wait_ms": 20.0, "shed_rate": 3.0},
+                      {"queue_wait_ms": 30.0, "expired_rate": 0.5}])
+    assert d["action"] == "scale_up" and d["reason"] == "shedding"
+    assert scaler.replicas == 3
+
+
+def test_scale_down_halves_at_most_per_decision():
+    scaler, clock = FakeScaler(8), FakeClock()
+    asc = _autoscaler(scaler, clock)
+    # One transiently-empty sample (scrape between dispatches) wants
+    # ratio≈0 → min; the symmetric step clamp allows at most a halve.
+    d = asc.evaluate([{"queue_wait_ms": 0.5}])
+    assert d["action"] == "scale_down" and d["desired"] == 4
+    assert scaler.replicas == 4
+
+
+def test_clamps_at_min_and_max():
+    scaler, clock = FakeScaler(8), FakeClock()
+    asc = _autoscaler(scaler, clock)
+    d = asc.evaluate([{"queue_wait_ms": 900.0}])
+    assert d["action"] == "hold" and d["reason"] == "at_max_replicas"
+    scaler.replicas = 1
+    d = asc.evaluate([{"queue_wait_ms": 1.0}])
+    assert d["action"] == "hold" and d["reason"] == "at_min_replicas"
+    assert scaler.writes == []
+
+
+def test_holds_on_blindness():
+    scaler, clock = FakeScaler(3), FakeClock()
+    asc = _autoscaler(scaler, clock)
+    d = asc.evaluate([])
+    assert d["action"] == "hold" and d["reason"] == "no_replica_metrics"
+
+
+def test_bounds_enforced_as_fleet_invariants():
+    # With `router true` the manifest omits spec.replicas, so a new
+    # Deployment starts at the apiserver default of 1; min_replicas
+    # must be a FLOOR the controller climbs to — immediately, even
+    # before the first scrape lands (blind), not a mere decision
+    # clamp the hold branches never reach.
+    scaler, clock = FakeScaler(1), FakeClock()
+    asc = _autoscaler(scaler, clock, min_replicas=3)
+    d = asc.evaluate([])  # bootstrap: nothing scraped yet
+    assert d["action"] == "scale_up"
+    assert d["reason"] == "below_min_replicas"
+    assert scaler.replicas == 3
+    # Idle at the floor: at_min_replicas hold, no further writes.
+    clock.t = 100.0
+    d = asc.evaluate([{"queue_wait_ms": 1.0}])
+    assert d["action"] == "hold" and scaler.replicas == 3
+    # Symmetric ceiling: an operator lowering max_replicas below the
+    # current fleet must see the fleet follow.
+    scaler2, clock2 = FakeScaler(8), FakeClock()
+    asc2 = _autoscaler(scaler2, clock2, max_replicas=4)
+    d = asc2.evaluate([{"queue_wait_ms": 100.0}])
+    assert d["action"] == "scale_down"
+    assert d["reason"] == "above_max_replicas"
+    assert scaler2.replicas == 4
+
+
+def test_scale_down_holds_while_any_replica_unreachable():
+    # 3 of 6 replicas wedge: the survivors look idle BECAUSE the
+    # fleet already lost half its capacity. Shrinking on that signal
+    # would delete live pods mid-outage (HPA: missing metrics read as
+    # 100% utilization for shrink decisions).
+    scaler, clock = FakeScaler(6), FakeClock()
+    asc = _autoscaler(scaler, clock)
+    idle = [{"queue_wait_ms": 1.0}] * 3
+    d = asc.evaluate(idle, unreachable=3)
+    assert d["action"] == "hold"
+    assert d["reason"] == "unreachable_replicas"
+    assert d["replicas_unreachable"] == 3
+    assert scaler.writes == []
+    # Scale-UP still acts on the survivors' signal: blind spots never
+    # suppress adding capacity.
+    d = asc.evaluate([{"queue_wait_ms": 500.0}] * 3, unreachable=3)
+    assert d["action"] == "scale_up"
+    # Fully observable again (and past the down-cooldown): the same
+    # idle fleet may now shrink.
+    clock.t = 100.0
+    d = asc.evaluate(idle, unreachable=0)
+    assert d["action"] == "scale_down"
+
+
+def test_scripted_load_step_converges_without_oscillation():
+    """ISSUE 5 acceptance: a load step up then down converges to the
+    target band with no hunting. The plant: per-replica queue wait =
+    offered_load / n (linear law — more replicas, shorter queues)."""
+    scaler, clock = FakeScaler(1), FakeClock()
+    asc = _autoscaler(scaler, clock)
+    actions = []
+    #       (seconds, offered load in queue-wait-at-1-replica ms)
+    trace = [(t, 100.0) for t in range(0, 60, 5)]       # idle @ target
+    trace += [(t, 600.0) for t in range(60, 240, 5)]    # step UP 6x
+    trace += [(t, 100.0) for t in range(240, 480, 5)]   # step DOWN
+    for t, load in trace:
+        clock.t = float(t)
+        d = asc.evaluate([{"queue_wait_ms": load / scaler.replicas}])
+        actions.append((t, d["action"], scaler.replicas))
+    # Phase 1 (load 100, 1 replica): wait == target, all holds.
+    assert all(a == "hold" for t, a, n in actions if t < 60)
+    # Phase 2: converges upward to 600/n within [80,120] → n in
+    # {5,6,7}; plateau is flat (no further actions once in band).
+    up_plateau = [n for t, a, n in actions if 180 <= t < 240]
+    assert len(set(up_plateau)) == 1 and up_plateau[0] in (5, 6, 7)
+    assert all(a == "hold" for t, a, n in actions if 180 <= t < 240)
+    # Phase 3: converges back down (100/n in band → n == 1).
+    down_plateau = [n for t, a, n in actions if t >= 420]
+    assert set(down_plateau) == {1}
+    assert all(a == "hold" for t, a, n in actions if t >= 420)
+    # No oscillation anywhere: the replica trajectory is unimodal
+    # (never rises again after its first decrease).
+    series = [n for _, _, n in actions]
+    peak = series.index(max(series))
+    assert series[:peak + 1] == sorted(series[:peak + 1])
+    assert series[peak:] == sorted(series[peak:], reverse=True)
+    # And the control effort is small: a handful of writes, not one
+    # per tick.
+    assert len(scaler.writes) <= 8
+
+
+# ---------------------------------------------------------------------------
+# Actuation: scale subresource (FakeApiServer + HTTP facade)
+
+
+def _serving_deployment(fake, name="kft-serving", replicas=2):
+    fake.create({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"replicas": replicas,
+                 "template": {"spec": {"containers": []}}},
+    })
+
+
+def test_deployment_scaler_against_fake():
+    fake = FakeApiServer()
+    _serving_deployment(fake, replicas=2)
+    scaler = DeploymentScaler(fake, "default", "kft-serving")
+    assert scaler.get_replicas() == 2
+    scaler.set_replicas(5)
+    assert scaler.get_replicas() == 5
+    # The narrow write: spec.replicas moved, the template did not.
+    obj = fake.get("Deployment", "default", "kft-serving")
+    assert obj["spec"]["replicas"] == 5
+    assert obj["spec"]["template"] == {"spec": {"containers": []}}
+
+
+def test_update_scale_noop_does_not_bump_resource_version():
+    fake = FakeApiServer()
+    _serving_deployment(fake, replicas=3)
+    rv = fake.get("Deployment", "default",
+                  "kft-serving")["metadata"]["resourceVersion"]
+    fake.update_scale("Deployment", "default", "kft-serving", 3)
+    assert fake.get("Deployment", "default",
+                    "kft-serving")["metadata"]["resourceVersion"] == rv
+
+
+def test_update_scale_stale_resource_version_conflicts():
+    """The scale PUT carries optimistic concurrency: a writer racing
+    another autoscaler (or `kubectl scale`) loses loudly with a 409,
+    never last-write-wins."""
+    from kubeflow_tpu.operator.fake import Conflict
+
+    fake = FakeApiServer()
+    _serving_deployment(fake, replicas=1)
+    scale = fake.get_scale("Deployment", "default", "kft-serving")
+    rv = scale["metadata"]["resourceVersion"]
+    # A concurrent writer lands first (bumps resourceVersion)...
+    fake.update_scale("Deployment", "default", "kft-serving", 3)
+    # ...so our read-modify-PUT with the stale version must 409.
+    with pytest.raises(Conflict):
+        fake.update_scale("Deployment", "default", "kft-serving", 2,
+                          resource_version=rv)
+    assert fake.get_scale("Deployment", "default",
+                          "kft-serving")["spec"]["replicas"] == 3
+
+
+def test_grpc_addresses_refuse_ambiguous_same_host_fleet():
+    """One --grpc_port cannot address two replicas on one host: the
+    derived binary upstream is disabled for them (REST-only) instead
+    of silently collapsing both onto a single gRPC channel."""
+    from kubeflow_tpu.serving.http_proxy import _grpc_addresses
+
+    assert _grpc_addresses(["h1:8500", "h2:8500"], 9000) == \
+        ["h1:9000", "h2:9000"]
+    assert _grpc_addresses(["h1:8501", "h1:8502", "h2:8500"],
+                           9000) == [None, None, "h2:9000"]
+    assert _grpc_addresses(["h1:8501", "h1:8502"], 0) == [None, None]
+
+
+def test_make_app_refuses_single_grpc_string_for_fleet():
+    """make_app's string back-compat form must not silently bind the
+    binary wire to only the FIRST of N replicas — the list form
+    raises on a length mismatch, so the string form raises too."""
+    from kubeflow_tpu.serving.http_proxy import make_app
+
+    with pytest.raises(ValueError, match="ambiguous"):
+        make_app("h1:8500,h2:8500", grpc_address="h1:9000")
+    # Single upstream keeps the classic form...
+    app = make_app("h1:8500", grpc_address="h1:9000")
+    assert app.settings["pool"].get("h1:8500").grpc_address == "h1:9000"
+    # ...and a matching list still works for fleets.
+    app = make_app(["h1:8500", "h2:8500"],
+                   grpc_address=["h1:9000", None])
+    assert app.settings["pool"].get("h2:8500").grpc_address is None
+
+
+def test_scale_subresource_over_http_facade():
+    from kubeflow_tpu.operator.http_client import HttpApiClient
+    from tests._http_apiserver import HttpFakeApiServer
+
+    fake = FakeApiServer()
+    _serving_deployment(fake, replicas=1)
+    with HttpFakeApiServer(fake=fake) as srv:
+        api = HttpApiClient(srv.url)
+        scaler = DeploymentScaler(api, "default", "kft-serving")
+        assert scaler.get_replicas() == 1
+        scaler.set_replicas(4)
+        assert scaler.get_replicas() == 4
+    assert fake.get("Deployment", "default",
+                    "kft-serving")["spec"]["replicas"] == 4
+
+
+def test_discover_pod_endpoints_filters_unready_pods():
+    fake = FakeApiServer()
+    for name, ip, phase in (("p0", "10.0.0.1", "Running"),
+                            ("p1", None, "Running"),       # no IP yet
+                            ("p2", "10.0.0.3", "Pending"),  # scheduling
+                            ("p3", "10.0.0.4", "Running")):
+        fake.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default",
+                         "labels": {"app": "kft-serving"}},
+            "status": {"phase": phase,
+                       **({"podIP": ip} if ip else {})},
+        })
+    fake.create({  # different app: never a fleet member
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "other", "namespace": "default",
+                     "labels": {"app": "other"}},
+        "status": {"phase": "Running", "podIP": "10.0.0.9"},
+    })
+    specs = discover_pod_endpoints(fake, "default",
+                                   {"app": "kft-serving"},
+                                   rest_port=8500, grpc_port=9000)
+    assert sorted(specs) == [("10.0.0.1:8500", "10.0.0.1:9000"),
+                             ("10.0.0.4:8500", "10.0.0.4:9000")]
+    specs = discover_pod_endpoints(fake, "default",
+                                   {"app": "kft-serving"},
+                                   rest_port=8500, grpc_port=None)
+    assert sorted(specs) == [("10.0.0.1:8500", None),
+                             ("10.0.0.4:8500", None)]
+
+
+# ---------------------------------------------------------------------------
+# AutoscalerLoop: scrape → rates → decide → publish
+
+
+def _loop_fixture(tmp_path=None, replicas=1, **config_overrides):
+    fake = FakeApiServer()
+    _serving_deployment(fake, replicas=replicas)
+    scaler = DeploymentScaler(fake, "default", "kft-serving")
+    clock = FakeClock()
+    asc = _autoscaler(scaler, clock, **config_overrides)
+    scrapes = {}
+
+    def scrape(addr):
+        value = scrapes[addr]
+        if isinstance(value, Exception):
+            raise value
+        return value
+
+    loop = AutoscalerLoop(
+        asc,
+        discover=lambda: [(addr, None) for addr in sorted(scrapes)],
+        scrape=scrape,
+        api=fake, namespace="default",
+        write_endpoints_path=(str(tmp_path / "endpoints.json")
+                              if tmp_path else None))
+    return fake, scaler, clock, scrapes, loop
+
+
+def test_loop_tick_publishes_fleet_and_decision():
+    fake, scaler, clock, scrapes, loop = _loop_fixture()
+    scrapes["a:8500"] = _healthz(
+        {"m": _stats(queue_depth=2, latency_ms=50.0)})
+    scrapes["b:8500"] = ConnectionError("down")
+    decision = loop.tick()
+    # Only the reachable replica reports; mean wait 100 → in band.
+    assert decision["action"] == "hold"
+    assert decision["replicas_reporting"] == 1
+    cm = fake.get("ConfigMap", "default", FLEET_CONFIGMAP)
+    fleet = json.loads(cm["data"][FLEET_KEY])
+    rows = {r["address"]: r for r in fleet["replicas"]}
+    assert rows["a:8500"]["reachable"]
+    assert rows["a:8500"]["queue_wait_ms"] == pytest.approx(100.0)
+    assert rows["a:8500"]["resident_models"] == ["m"]
+    assert not rows["b:8500"]["reachable"]
+    assert fleet["decision"]["action"] == "hold"
+    assert "age_s" in fleet["decision"]  # monotonic time never ships
+
+
+def test_loop_differentiates_cumulative_shed_counters():
+    fake, scaler, clock, scrapes, loop = _loop_fixture()
+
+    def scrape_with(shed):
+        scrapes["a:8500"] = _healthz(
+            {"m": _stats(queue_depth=0, latency_ms=10.0, shed=shed)})
+
+    scrape_with(5)
+    loop.tick()  # first sight: no previous sample, rate 0 → hold
+    assert loop.autoscaler.last_decision["action"] == "hold"
+    scrape_with(5)
+    loop.tick()  # counter flat: still not shedding
+    assert loop.autoscaler.last_decision["action"] == "hold"
+    scrape_with(9)
+    decision = loop.tick()  # delta 4 → nonzero rate → undersized
+    assert decision["action"] == "scale_up"
+    assert decision["reason"] == "shedding"
+    # Counter RESET (replica restart) must clamp at zero, not read as
+    # a huge negative (or positive) rate.
+    scrape_with(0)
+    clock.t = 100.0  # clear the up-cooldown so only the rate matters
+    decision = loop.tick()
+    assert decision["action"] != "scale_up" or \
+        decision["reason"] != "shedding"
+
+
+def test_loop_writes_endpoints_file_for_proxy(tmp_path):
+    fake, scaler, clock, scrapes, loop = _loop_fixture(tmp_path)
+    scrapes["a:8500"] = _healthz()
+    loop.tick()
+    source = FileEndpointSource(str(tmp_path / "endpoints.json"))
+    assert source.specs() == [("a:8500", None)]
+    # Membership change lands in the next tick's file.
+    scrapes["b:8500"] = _healthz()
+    loop.tick()
+    assert source.specs() == [("a:8500", None), ("b:8500", None)]
+
+
+def test_loop_closes_the_loop_against_fake_scale(tmp_path):
+    """End-to-end control loop: saturated healthz → scale_up actuated
+    through the Deployment scale subresource → the fleet file keeps
+    the proxy's membership in step."""
+    fake, scaler, clock, scrapes, loop = _loop_fixture(
+        tmp_path, replicas=1)
+    scrapes["a:8500"] = _healthz(
+        {"m": _stats(queue_depth=30, latency_ms=20.0)})  # 600 ms wait
+    decision = loop.tick()
+    assert decision["action"] == "scale_up"
+    assert fake.get("Deployment", "default",
+                    "kft-serving")["spec"]["replicas"] == 2
+    # The autoscaler's own thread loop is Event-paced; run() honors
+    # max_cycles so tests never depend on wall time.
+    loop.run(max_cycles=1)
+
+
+def test_loop_survives_scrape_and_publish_chaos():
+    fake, scaler, clock, scrapes, loop = _loop_fixture()
+    scrapes["a:8500"] = RuntimeError("scrape exploded")
+    decision = loop.tick()  # everything unreachable → hold, no raise
+    assert decision["action"] == "hold"
+    assert decision["reason"] == "no_replica_metrics"
+
+
+# ---------------------------------------------------------------------------
+# Static source sanity (the --rpc_address a,b,c form)
+
+
+def test_static_source_round_trip():
+    source = StaticEndpointSource([("a:1", "a:9"), ("b:1", None)])
+    assert source.specs() == [("a:1", "a:9"), ("b:1", None)]
+    pool = EndpointPool()
+    pool.sync(source.specs())
+    assert [ep.address for ep in pool.endpoints()] == ["a:1", "b:1"]
+
+
+def test_endpoint_snapshot_shape():
+    ep = Endpoint("a:1", "a:9")
+    ep.saturation = {"m": _stats(queue_depth=1, latency_ms=10.0)}
+    snap = ep.snapshot()
+    assert snap["address"] == "a:1"
+    assert snap["grpc_address"] == "a:9"
+    assert snap["health"] == UNKNOWN
+    assert snap["resident_models"] == ["m"]
+    assert snap["breakers"]["rest"]["state"] == "closed"
+    json.dumps(snap)  # JSON-shaped end to end
+
+
+def test_pool_concurrent_sync_and_reads():
+    """Membership churn under concurrent readers must never raise
+    (the prober syncs while the IOLoop routes)."""
+    pool = EndpointPool.from_addresses(["a:1", "b:1"])
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                pool.sync([(f"m{i % 7}:1", None), ("a:1", None)])
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    def read():
+        while not stop.is_set():
+            try:
+                for ep in eligible_endpoints(pool):
+                    ep.saturation_score()
+                pool.snapshot()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    threads = [threading.Thread(target=f)
+               for f in (churn, read, read)]
+    for t in threads:
+        t.start()
+    stop_at = threading.Event()
+    stop_at.wait(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert errors == []

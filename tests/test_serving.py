@@ -496,7 +496,9 @@ class ProxyGrpcUpstream(tornado.testing.AsyncHTTPTestCase):
         assert len(preds) == 2 and len(preds[0]["logits"]) == 10
         # The binary path dialed the channel (proves the verb matched
         # the signature method and the gRPC hop wrote this response).
-        assert self._app.settings.get("_grpc_channel") is not None
+        # The channel lives on the pool member since the fleet rewire.
+        endpoint, = self._app.settings["pool"].endpoints()
+        assert endpoint.grpc_channel is not None
         # Numerically identical to the direct model execution.
         direct = self.manager.get_model("testnet").get().run(
             {"images": np.asarray(rows, np.float32)})

@@ -250,3 +250,157 @@ def test_overload_expired_and_shed_never_dispatch():
     # The drive genuinely overloaded the server (30-90ms budgets vs
     # 100ms slow batches): some requests were turned away early.
     assert stats["shed"] + stats["expired"] > 0, stats
+
+
+def test_fleet_failover_reroutes_in_deadline_requests():
+    """ISSUE 5 e2e acceptance: with 3 live backends behind the pooled
+    proxy, killing one mid-load sheds NO in-deadline request — the
+    router fails the transport attempt over to a live replica, the
+    victim's breaker opens sub-second and the prober ejects it; after
+    revival the prober readmits it and it takes new work again."""
+    import urllib.error
+
+    from kubeflow_tpu.scaling.benchmark import (
+        StubBackendFleet,
+        _post_infer,
+    )
+
+    fleet = StubBackendFleet(3, service_time_s=0.02, proxy_kwargs={
+        "balancer": "least_saturation", "breaker_failures": 1,
+        "breaker_reset_s": 0.5, "probe_interval_s": 0.1}).start()
+    try:
+        for _ in range(6):  # warm the signature caches on all paths
+            _post_infer(fleet.proxy_port, deadline_ms=5000)
+        pool = fleet.proxy_app.settings["pool"]
+        victim = pool.get(f"127.0.0.1:{fleet.ports[0]}")
+
+        stop = threading.Event()
+        errors, ok = [], []
+        lock = threading.Lock()
+
+        def client():
+            while not stop.is_set():
+                try:
+                    dt = _post_infer(fleet.proxy_port,
+                                     deadline_ms=5000)
+                except urllib.error.HTTPError as e:
+                    with lock:
+                        errors.append(f"HTTP {e.code}")
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(repr(e))
+                else:
+                    with lock:
+                        ok.append(dt)
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+
+        def wait_until(cond, timeout_s):
+            deadline = time.monotonic() + timeout_s
+            while not cond() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            return cond()
+
+        # Load established → kill backend 0 (listener gone:
+        # connection-refused, the way a deleted pod fails).
+        wait_until(lambda: len(ok) >= 20, 10.0)
+        fleet.kill(0)
+        t_kill = time.monotonic()
+        # The first transport failure trips the victim's breaker —
+        # sub-second, so at most one request per client eats a
+        # connect attempt (and retries elsewhere inside its budget).
+        assert wait_until(
+            lambda: victim.rest_breaker.state == "open", 1.0), \
+            victim.rest_breaker.state
+        assert time.monotonic() - t_kill < 1.0
+        # The prober ejects it from rotation shortly after.
+        assert wait_until(lambda: not victim.routable(), 2.5), \
+            victim.snapshot()
+        # Keep hammering through the degraded window, then revive.
+        before_revive = fleet.completed[0]
+        stop.wait(0.3)
+        fleet.revive(0)
+        # Readmission: one good probe brings it back...
+        assert wait_until(lambda: victim.health == "healthy", 2.5), \
+            victim.snapshot()
+        # ...and it actually takes traffic again (rejoins rotation).
+        assert wait_until(
+            lambda: fleet.completed[0] > before_revive, 10.0), \
+            fleet.completed
+        stop.set()
+        for t in threads:
+            t.join(15)
+        assert not any(t.is_alive() for t in threads)
+        # The headline invariant: every in-deadline request succeeded
+        # across kill, degraded window, and readmission.
+        assert errors == [], errors[:5]
+        assert len(ok) > 40, len(ok)
+    finally:
+        fleet.stop()
+
+
+def test_deadline_less_timeout_is_one_placement_no_failover():
+    """A timed-out placement may still be executing on its replica;
+    with no deadline budget to bound re-dispatch, the router must NOT
+    replay the request on other replicas (retry amplification is
+    worst exactly when the fleet is slow). One placement, one 504 —
+    the pre-pool contract."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from kubeflow_tpu.scaling.benchmark import MODEL, StubBackendFleet
+
+    fleet = StubBackendFleet(2, service_time_s=1.0, proxy_kwargs={
+        "rpc_timeout": 0.25, "retry_attempts": 2,
+        "probe_interval_s": 5.0}).start()
+    try:
+        payload = _json.dumps({"instances": [[1.0]]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fleet.proxy_port}/model/{MODEL}:predict",
+            data=payload,
+            headers={"Content-Type": "application/json"})  # NO deadline
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=10.0)
+        assert exc_info.value.code == 504
+        # Both backends eventually finish whatever was placed on them;
+        # only ONE may have been.
+        time.sleep(1.5)
+        assert sum(fleet.completed) == 1, fleet.completed
+    finally:
+        fleet.stop()
+
+
+def test_proxy_healthz_degrades_on_any_open_breaker():
+    """The pre-pool /healthz contract (docs/observability.md): ANY
+    open breaker — including a dead binary wire whose requests
+    silently fall back to REST — reads "degraded", so alerts keyed on
+    status fire before clients notice."""
+    import json as _json
+    import urllib.request
+
+    from kubeflow_tpu.scaling.benchmark import StubBackendFleet
+
+    fleet = StubBackendFleet(1, service_time_s=0.01, proxy_kwargs={
+        "probe_interval_s": 5.0}).start()
+    try:
+        def healthz():
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{fleet.proxy_port}/healthz",
+                    timeout=5.0) as resp:
+                return _json.load(resp)
+
+        assert healthz()["status"] == "ok"
+        ep = fleet.proxy_app.settings["pool"].endpoints()[0]
+        for _ in range(ep.grpc_breaker.failure_threshold):
+            ep.grpc_breaker.record_failure()
+        assert ep.grpc_breaker.state == "open"
+        assert healthz()["status"] == "degraded"  # still routable, though
+        assert ep.routable()
+        ep.grpc_breaker.record_success()
+        assert healthz()["status"] == "ok"
+    finally:
+        fleet.stop()
