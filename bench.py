@@ -194,6 +194,57 @@ def obs_overhead_main() -> int:
     return 0 if result["under_2pct"] else 1
 
 
+def continuous_main() -> int:
+    """`python bench.py --continuous`: mixed-length open-loop sweep,
+    r6 static coalescer vs the continuous-batching engine at the same
+    offered load (ISSUE 6 acceptance: the engine wins goodput AND p50,
+    streamed rows bitwise-equal to B=1 greedy+sampled, and a short
+    request's time-to-first-token mid-decode is well under its long
+    neighbor's full decode). Back-to-back phases + component numbers
+    per the box-throttle policy (PERF.md r9); prints ONE JSON line
+    shaped like the headline bench."""
+    from kubeflow_tpu.utils.platform import sync_platform_from_env
+
+    sync_platform_from_env()
+
+    from kubeflow_tpu.serving.benchmark import (
+        ContinuousBenchConfig,
+        run_continuous_benchmark,
+    )
+
+    result = run_continuous_benchmark(ContinuousBenchConfig())
+    print(json.dumps({
+        "metric": "continuous_batching_goodput_vs_static",
+        "value": result["goodput_ratio_at_top"],
+        "unit": (f"requested-tokens/s ratio at "
+                 f"{max(result['config']['rates_x'])}x static "
+                 f"capacity ({result['config']['short_tokens']}/"
+                 f"{result['config']['long_tokens']}-token mixed "
+                 f"open-loop, {result['config']['slots']} slots)"),
+        "vs_baseline": None,  # the r6 coalescer IS the baseline here
+        "extra": {
+            "static_capacity_rps": result["static_capacity_rps"],
+            "static_batch_decode_ms": result["static_batch_decode_ms"],
+            "p50_ratio_at_top": result["p50_ratio_at_top"],
+            "ttft_short_ms": result["ttft_short_ms"],
+            "long_decode_ms": result["long_decode_ms"],
+            "ttft_vs_long_decode": result["ttft_vs_long_decode"],
+            "bitwise_greedy_ok": result["bitwise_greedy_ok"],
+            "bitwise_sampled_ok": result["bitwise_sampled_ok"],
+            **{f"x{r['offered_x']}_{stack}_{k}": r[stack][k]
+               for r in result["rows"]
+               for stack in ("static", "continuous")
+               for k in ("goodput_tokens_per_s", "p50_ms",
+                         "short_p50_ms", "p99_ms")
+               if k in r[stack]},
+            **{f"x{r['offered_x']}_{k}": r[k]
+               for r in result["rows"]
+               for k in ("goodput_ratio", "p50_ratio")},
+        },
+    }))
+    return 0 if result["continuous_wins"] else 1
+
+
 def main() -> int:
     if "--controller" in sys.argv:
         return controller_main()
@@ -203,6 +254,8 @@ def main() -> int:
         return obs_overhead_main()
     if "--router" in sys.argv:
         return router_main()
+    if "--continuous" in sys.argv:
+        return continuous_main()
     from kubeflow_tpu.utils.platform import sync_platform_from_env
 
     # Honor JAX_PLATFORMS from the caller (the session preset pins the

@@ -1,0 +1,279 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Paged KV cache: a shared page pool + per-slot page tables.
+
+The r6 batched decode rebuilt one left-padded cache per coalesced
+batch and every row held its full-width slice until the LONGEST row
+finished. Here the cache is persistent and page-granular:
+
+- **Physical storage** per layer is ``[num_pages, page_size, kv_heads,
+  head_dim]`` — one shared pool, page 0 reserved as the *null page*
+  (unallocated page-table entries point at it; its contents are only
+  ever read through masked attention positions and written by retired
+  or overshooting slots, so it just has to stay finite).
+- **Page tables** map each slot's logical time axis onto pool pages
+  (``tables[slot, j]`` backs logical positions ``[j·P, (j+1)·P)``).
+  The decode slice gathers a slot-batch logical view ``[N, C', ...]``
+  (``C' = pages_per_slot × page_size``), runs the model on it, and
+  scatters only the newly written token range back — so a slice costs
+  one gather + one scatter, not a per-step rebuild.
+- **Allocation** is reservation-based (:class:`PageAllocator`): a
+  request reserves its worst case ``ceil((prompt_bucket +
+  max_new_tokens)/P)`` pages at admission (no mid-decode OOM, no
+  preemption machinery), allocates lazily as its sequence crosses page
+  boundaries, and frees everything at retire — an early-EOS row hands
+  its unused reservation straight back to the admission gate.
+
+All shapes stay static (TPU rule): paging is index arithmetic, the
+gather/scatter are ``jnp.take``-family ops, and the per-(bucket,
+page-count) helper jits compile once each.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PageAllocator:
+    """Host-side free list + reservation accounting for the pool.
+
+    Only the engine thread mutates it; readers (metrics callbacks,
+    admission estimates) see GIL-consistent ints. Page 0 is the null
+    page and is never handed out.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(f"need >= 2 pages (1 null + 1 usable), "
+                             f"got {num_pages}")
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._reserved = 0
+
+    @property
+    def free_pages(self) -> int:
+        """Pages physically free (some may be spoken for)."""
+        return len(self._free)
+
+    @property
+    def reserved_pages(self) -> int:
+        return self._reserved
+
+    def available(self) -> int:
+        """Pages neither allocated nor reserved — the admission gate's
+        number."""
+        return len(self._free) - self._reserved
+
+    def reserve(self, n: int) -> bool:
+        """Promise ``n`` pages to a slot (allocated later, lazily).
+        False = pool can't cover it; the caller must not admit."""
+        if self.available() < n:
+            return False
+        self._reserved += n
+        return True
+
+    def unreserve(self, n: int) -> None:
+        if n > self._reserved:
+            raise ValueError(
+                f"unreserve({n}) exceeds outstanding reservation "
+                f"{self._reserved}")
+        self._reserved -= n
+
+    def alloc(self, n: int) -> List[int]:
+        """Convert ``n`` pages of reservation into concrete page ids.
+        The reservation invariant makes this infallible for reserved
+        callers; misuse raises rather than corrupting the pool."""
+        if n > self._reserved:
+            raise ValueError(
+                f"alloc({n}) without reservation (reserved="
+                f"{self._reserved})")
+        if n > len(self._free):
+            raise RuntimeError(
+                f"pool corrupted: {n} pages reserved but only "
+                f"{len(self._free)} free")
+        self._reserved -= n
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if p == 0:
+                raise ValueError("page 0 is the null page")
+            self._free.append(int(p))
+
+
+def _is_kv(leaf: jax.Array) -> bool:
+    """KV leaves are [*, time, heads, dim]; the per-layer scalar
+    ``index`` cache variables are 0-d."""
+    return getattr(leaf, "ndim", 0) == 4
+
+
+@jax.jit
+def _gather_logical(physical: Any, tables: jax.Array) -> Any:
+    """Page-table gather: physical pools → the slot-batch logical
+    cache collection the model decodes over ([N, C', heads, dim] per
+    layer; scalar index leaves ride along as zeros — the per-row
+    decode path never reads them)."""
+    n, mpp = tables.shape
+
+    def g(leaf):
+        if not _is_kv(leaf):
+            return jnp.zeros_like(leaf)
+        _, p, h, d = leaf.shape
+        return leaf[tables.reshape(-1)].reshape(n, mpp * p, h, d)
+
+    return jax.tree.map(g, physical)
+
+
+@functools.partial(jax.jit, static_argnames=("num_steps",))
+def _scatter_token_range(physical: Any, logical: Any,
+                         tables: jax.Array, start_pos: jax.Array, *,
+                         num_steps: int) -> Any:
+    """Write the slice's freshly decoded token range ``[start_pos_i,
+    start_pos_i + num_steps)`` of every slot back into the pool.
+    Positions beyond a slot's allocated pages resolve to the null page
+    (table entries are 0 there), so retired/overshooting rows scribble
+    harmlessly instead of needing per-row masks."""
+    pos = start_pos[:, None] + jnp.arange(num_steps)[None, :]  # [N, K]
+
+    def s(ph, lg):
+        if not _is_kv(ph):
+            return ph
+        _, p, _, _ = ph.shape
+        page_idx = jnp.take_along_axis(
+            tables, jnp.clip(pos // p, 0, tables.shape[1] - 1), axis=1)
+        offset = pos % p
+        vals = jnp.take_along_axis(lg, pos[..., None, None], axis=1)
+        return ph.at[page_idx, offset].set(vals)
+
+    return jax.tree.map(s, physical, logical)
+
+
+@functools.partial(jax.jit, static_argnames=("n_pages",))
+def _adopt_prefill(physical: Any, prefill_cache: Any,
+                   page_ids: jax.Array, *, n_pages: int) -> Any:
+    """Copy a B=1 prefill cache's first ``n_pages`` pages worth of
+    slots into the pool pages just allocated to the admitting slot."""
+
+    def a(ph, pc):
+        if not _is_kv(ph):
+            return ph
+        _, p, h, d = ph.shape
+        need = n_pages * p
+        row = pc[0]
+        if row.shape[0] < need:  # cache_size not a page multiple
+            row = jnp.pad(row, ((0, need - row.shape[0]),
+                                (0, 0), (0, 0)))
+        return ph.at[page_ids].set(row[:need].reshape(n_pages, p, h, d))
+
+    return jax.tree.map(a, physical, prefill_cache)
+
+
+class PagedKVCache:
+    """The pool arrays + table bookkeeping for one decode engine.
+
+    ``physical`` mirrors the model's cache-collection pytree with
+    every KV leaf re-shaped to pages; gather/scatter/adopt are the
+    jitted helpers above. Host-side ``tables`` is the source of truth
+    (numpy); ``device_tables()`` snapshots it for a slice dispatch.
+    """
+
+    def __init__(self, cache_template: Any, *, num_slots: int,
+                 page_size: int, cache_size: int,
+                 num_pages: Optional[int] = None):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.page_size = page_size
+        self.cache_size = cache_size
+        self.num_slots = num_slots
+        self.pages_per_slot = -(-cache_size // page_size)
+        self.logical_len = self.pages_per_slot * page_size
+        if num_pages is None:
+            # Default: every slot can hold a full-length sequence,
+            # plus the null page. Sizing it smaller is the memory
+            # lever (admission then gates on reservations).
+            num_pages = num_slots * self.pages_per_slot + 1
+        self.allocator = PageAllocator(num_pages)
+
+        def to_pages(leaf):
+            if not _is_kv(leaf):
+                return jnp.zeros(leaf.shape, leaf.dtype)
+            _, _, h, d = leaf.shape
+            return jnp.zeros((num_pages, page_size, h, d), leaf.dtype)
+
+        self.physical = jax.tree.map(to_pages, cache_template)
+        self.tables = np.zeros((num_slots, self.pages_per_slot),
+                               np.int32)
+
+    # -- accounting ------------------------------------------------------
+
+    def pages_for(self, length: int) -> int:
+        """Pages needed to back ``length`` cache slots."""
+        return -(-length // self.page_size)
+
+    def device_tables(self) -> jax.Array:
+        return jnp.asarray(self.tables)
+
+    # -- slot operations (engine thread only) ----------------------------
+
+    def extend_slot(self, slot_index: int, allocated: int,
+                    upto_position: int, budget_pages: int) -> int:
+        """Allocate pages so slot ``slot_index`` can write through
+        cache position ``upto_position`` (exclusive), never past its
+        ``budget_pages`` reservation. Returns the new allocated count;
+        page ids land in the host table (push with device_tables)."""
+        need = min(self.pages_for(upto_position), budget_pages)
+        if need <= allocated:
+            return allocated
+        new_pages = self.allocator.alloc(need - allocated)
+        self.tables[slot_index, allocated:need] = new_pages
+        return need
+
+    def adopt(self, slot_index: int, prefill_cache: Any,
+              prompt_width: int, budget_pages: int) -> int:
+        """Admission: allocate the prompt's pages for ``slot_index``
+        and copy the B=1 prefill cache into them. Returns the
+        allocated page count."""
+        n_pages = min(self.pages_for(prompt_width), budget_pages)
+        pages = self.allocator.alloc(n_pages)
+        self.tables[slot_index, :n_pages] = pages
+        self.physical = _adopt_prefill(
+            self.physical, prefill_cache,
+            jnp.asarray(np.asarray(pages, np.int32)), n_pages=n_pages)
+        return n_pages
+
+    def release_slot(self, slot_index: int, allocated: int,
+                     unreserved_remainder: int) -> None:
+        """Retire: free the slot's pages, drop its remaining
+        reservation, null its table row."""
+        if allocated:
+            self.allocator.free(
+                self.tables[slot_index, :allocated].tolist())
+        if unreserved_remainder:
+            self.allocator.unreserve(unreserved_remainder)
+        self.tables[slot_index, :] = 0
+
+    def gather(self, tables: jax.Array) -> Any:
+        return _gather_logical(self.physical, tables)
+
+    def scatter(self, logical: Any, tables: jax.Array,
+                start_pos: jax.Array, num_steps: int) -> None:
+        self.physical = _scatter_token_range(
+            self.physical, logical, tables, start_pos,
+            num_steps=num_steps)

@@ -1,0 +1,192 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Slot bookkeeping for the continuous-batching engine.
+
+Pure host-side state machine (no jax): N decode slots, a FIFO
+admission queue, and reservation-aware admit/retire transitions. The
+engine thread is the only mutator; :class:`SlotScheduler` exists
+separately from the engine so the scheduling policy is unit-testable
+without compiling a model.
+
+Slot lifecycle::
+
+    FREE --admit(prefill+adopt)--> ACTIVE --retire--> FREE
+                                     |  (eos / token budget /
+                                     |   deadline / cancel / error)
+
+A slot's cache positions: ``[0, pad_len)`` left-pad garbage (masked),
+``[pad_len, prompt_width)`` the prompt, ``[prompt_width, write_pos)``
+decoded tokens; ``write_pos`` is where the NEXT token's K/V lands.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Deque, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Slot:
+    """One decode slot's host state."""
+
+    index: int
+    active: bool = False
+    request: Any = None  # the engine's _Request
+    write_pos: int = 0  # cache index the next token is written at
+    pad_len: int = 0  # left-pad slots before the prompt
+    prompt_width: int = 0  # prompt bucket width (pads + prompt)
+    last_token: int = 0  # feeds the next decode step
+    steps_done: int = 0  # step-rng indices consumed (incl. prefill's)
+    emitted: int = 0  # tokens handed to the stream
+    done: bool = False  # EOS latched
+    allocated_pages: int = 0
+    budget_pages: int = 0  # reservation ceiling (pages)
+    deadline: Optional[float] = None
+
+    @property
+    def max_new_tokens(self) -> int:
+        return self.request.max_new_tokens
+
+    @property
+    def remaining(self) -> int:
+        """Decode steps still owed (the prefill produced token 0)."""
+        return max(0, self.max_new_tokens - self.steps_done)
+
+
+class SlotScheduler:
+    """Owns the N slots + the admission FIFO.
+
+    Admission is strictly FIFO (no head-of-line jumping: a large
+    request that can't reserve pages yet blocks later arrivals, which
+    keeps tail fairness — the alternative starves big prompts
+    forever). The page-pool reservation check lives here; the actual
+    prefill/adopt device work stays in the engine.
+    """
+
+    def __init__(self, num_slots: int, allocator):
+        self.slots: List[Slot] = [Slot(i) for i in range(num_slots)]
+        self._free: Deque[int] = deque(range(num_slots))
+        self._allocator = allocator
+        self.pending: Deque[Any] = deque()
+        # Monotonic counters for stats()/metrics.
+        self.admitted = 0
+        self.retired = 0
+        self.retired_by: dict = {}
+
+    # -- queries ---------------------------------------------------------
+
+    def active_slots(self) -> List[Slot]:
+        return [s for s in self.slots if s.active]
+
+    def occupancy(self) -> int:
+        return len(self.slots) - len(self._free)
+
+    def queue_depth(self) -> int:
+        return len(self.pending)
+
+    def has_capacity_for(self, budget_pages: int) -> bool:
+        return bool(self._free) and self._allocator.available() >= \
+            budget_pages
+
+    # -- transitions (engine thread only) --------------------------------
+
+    def next_admittable(self, budget_pages_of) -> Optional[Any]:
+        """Pop the FIFO head iff a slot AND its reservation fit;
+        ``budget_pages_of(request)`` prices the worst case. None =
+        nothing admittable right now (empty queue, no slot, or the
+        head's reservation doesn't fit yet — FIFO holds the line)."""
+        if not self.pending or not self._free:
+            return None
+        head = self.pending[0]
+        if not self._allocator.reserve(budget_pages_of(head)):
+            return None
+        return self.pending.popleft()
+
+    def bind(self, request: Any, *, prompt_width: int, pad_len: int,
+             first_token: int, done: bool, budget_pages: int,
+             deadline: Optional[float]) -> Slot:
+        """Attach an admitted (already prefilled) request to a free
+        slot. The caller has already reserved ``budget_pages``."""
+        slot = self.slots[self._free.popleft()]
+        assert not slot.active, f"slot {slot.index} double-bound"
+        slot.active = True
+        slot.request = request
+        slot.write_pos = prompt_width
+        slot.pad_len = pad_len
+        slot.prompt_width = prompt_width
+        slot.last_token = int(first_token)
+        slot.steps_done = 1  # the prefill consumed step key 0
+        slot.emitted = 0
+        slot.done = bool(done)
+        slot.allocated_pages = 0
+        slot.budget_pages = budget_pages
+        slot.deadline = deadline
+        self.admitted += 1
+        return slot
+
+    def retire(self, slot: Slot, reason: str) -> None:
+        """Return the slot to the free pool. Page release is the
+        engine's job (it owns the PagedKVCache); this only flips the
+        host state so the pages/reservation numbers the engine reads
+        off the slot are still intact when it releases them."""
+        assert slot.active, f"slot {slot.index} retired twice"
+        slot.active = False
+        slot.request = None
+        self._free.append(slot.index)
+        self.retired += 1
+        self.retired_by[reason] = self.retired_by.get(reason, 0) + 1
+
+    # -- expiry ----------------------------------------------------------
+
+    def expired_slots(self, now: Optional[float] = None) -> List[Slot]:
+        now = time.monotonic() if now is None else now
+        return [s for s in self.active_slots()
+                if s.deadline is not None and s.deadline <= now]
+
+    def expired_pending(self, now: Optional[float] = None) -> List[Any]:
+        """Drop (and return) queued requests whose deadline lapsed
+        before a slot ever freed up — they must never burn a prefill.
+        Caller must hold the engine's submit lock: this SWAPS the
+        pending deque, and an unlocked swap would drop a concurrently
+        appended request on the floor."""
+        now = time.monotonic() if now is None else now
+        expired = []
+        keep: Deque[Any] = deque()
+        while self.pending:
+            req = self.pending.popleft()
+            if req.deadline is not None and req.deadline <= now:
+                expired.append(req)
+            else:
+                keep.append(req)
+        self.pending = keep
+        return expired
+
+    # -- step-key helper -------------------------------------------------
+
+    @staticmethod
+    def slice_keys(slot: Slot, num_steps: int) -> np.ndarray:
+        """The slot's per-step sampling keys for the next
+        ``num_steps`` decode steps ([K, 2]); indices past the
+        request's schedule clamp to the last key (those steps are
+        overshoot — computed, discarded)."""
+        keys = slot.request.step_keys
+        idx = np.minimum(
+            np.arange(slot.steps_done, slot.steps_done + num_steps),
+            len(keys) - 1)
+        return keys[idx]

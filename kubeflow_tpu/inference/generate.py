@@ -40,10 +40,28 @@ only); TPU-first shape discipline throughout:
 from __future__ import annotations
 
 import functools
-from typing import Any, Optional, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+
+def prompt_bucket(n: int, max_len: int,
+                  buckets: Optional[Sequence[int]] = None) -> int:
+    """THE prompt-length bucketing policy, shared by the serving
+    prepare path (LoadedModel) and the decode engine so the widths
+    they prefill-compile can never drift apart: the export's explicit
+    ``buckets`` list when present, else the smallest power of two
+    ≥ ``n`` — either way capped at ``max_len``."""
+    if buckets:
+        for b in sorted(int(v) for v in buckets):
+            if b >= n:
+                return min(b, max_len)
+        return max_len
+    b = 1
+    while b < n and b < max_len:
+        b *= 2
+    return min(b, max_len)
 
 
 def init_cache(model: Any, params: Any, batch: int) -> Any:

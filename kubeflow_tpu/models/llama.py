@@ -94,7 +94,8 @@ class LlamaAttention(nn.Module):
     lora_alpha: float = 16.0
 
     @nn.compact
-    def __call__(self, x, positions, pad_lengths=None):
+    def __call__(self, x, positions, pad_lengths=None,
+                 decode_positions=None):
         b, l, d_model = x.shape
         q = _dense(self.num_heads * self.head_dim, ("embed", "heads"),
                    self.dtype, "q_proj", self.lora_rank, self.lora_alpha)(x)
@@ -114,6 +115,11 @@ class LlamaAttention(nn.Module):
                     "the decode path always uses dense attention over the "
                     "cache, which would silently replace a sequence-"
                     "parallel attention_fn")
+        elif decode_positions is not None:
+            raise ValueError(
+                "decode_positions requires a cache_size model (the "
+                "slot-based decode engine writes each row's K/V into "
+                "its own cache slot)")
         elif pad_lengths is not None:
             # Left-padding is a decode-path concept (batched generation
             # coalesces mixed-length prompts); the training/full-forward
@@ -124,8 +130,12 @@ class LlamaAttention(nn.Module):
                 "generation left-pads into the KV cache)")
         if self.cache_size:
             # Decode path: append this call's K/V into the static-size
-            # cache at the running index, attend over the valid prefix.
-            # All shapes static (TPU rule); validity is arithmetic.
+            # cache, attend over the valid prefix. All shapes static
+            # (TPU rule); validity is arithmetic. The cache's TIME axis
+            # is sized by whatever array rides the "cache" collection —
+            # the classic path passes [b, cache_size, ...] buffers, the
+            # slot engine passes page-gathered views whose padded tail
+            # is masked, so both share one compiled program shape rule.
             cached_k = self.variable(
                 "cache", "k", jnp.zeros,
                 (b, self.cache_size, self.num_kv_heads, self.head_dim),
@@ -136,26 +146,65 @@ class LlamaAttention(nn.Module):
                 self.dtype)
             index = self.variable(
                 "cache", "index", lambda: jnp.zeros((), jnp.int32))
-            start = index.value
-            cached_k.value = jax.lax.dynamic_update_slice(
-                cached_k.value, k.astype(self.dtype), (0, start, 0, 0))
-            cached_v.value = jax.lax.dynamic_update_slice(
-                cached_v.value, v.astype(self.dtype), (0, start, 0, 0))
-            index.value = start + l
-            valid = (jnp.arange(self.cache_size)[None, :]
-                     < (start + l)).astype(jnp.int32)
-            valid = jnp.broadcast_to(valid, (b, self.cache_size))
-            if pad_lengths is not None:
-                # Batched mixed-length prompts are LEFT-padded: row i's
-                # first pad_lengths[i] cache slots hold pad-token K/V
-                # that must never receive attention mass. Slot order
-                # still equals time order per row (pads are "earliest"),
-                # so the scalar causal q_offset stays correct.
-                valid = valid * (jnp.arange(self.cache_size)[None, :]
-                                 >= pad_lengths[:, None]).astype(jnp.int32)
-            out = dense_attention(
-                q, cached_k.value, cached_v.value, causal=True,
-                q_offset=start, kv_offset=0, kv_segment_valid=valid)
+            slots = cached_k.value.shape[1]
+            if decode_positions is not None:
+                # Slot-engine decode (inference/engine/): every row
+                # sits at its OWN cache position — rows joined the
+                # persistent batch at different times — so the write
+                # index is per-row ([B] int32), not the shared scalar.
+                # Single-token steps only: multi-token appends at
+                # per-row offsets would need per-row causal masks that
+                # the single-token case gets for free (the newest token
+                # may attend to every valid slot, so validity alone IS
+                # causality and the masked scores match the scalar
+                # path's causal+valid composition bitwise).
+                if l != 1:
+                    raise ValueError(
+                        f"decode_positions is a one-token decode "
+                        f"contract, got {l} tokens")
+                start = decode_positions  # [B] int32
+                cached_k.value = jax.vmap(
+                    lambda c, u, s: jax.lax.dynamic_update_slice(
+                        c, u, (s, 0, 0)))(
+                    cached_k.value, k.astype(self.dtype), start)
+                cached_v.value = jax.vmap(
+                    lambda c, u, s: jax.lax.dynamic_update_slice(
+                        c, u, (s, 0, 0)))(
+                    cached_v.value, v.astype(self.dtype), start)
+                # The scalar index is meaningless across slots; leave
+                # it untouched (the engine carries per-slot positions).
+                valid = (jnp.arange(slots)[None, :]
+                         <= start[:, None]).astype(jnp.int32)
+                if pad_lengths is not None:
+                    valid = valid * (jnp.arange(slots)[None, :]
+                                     >= pad_lengths[:, None]
+                                     ).astype(jnp.int32)
+                out = dense_attention(
+                    q, cached_k.value, cached_v.value, causal=False,
+                    kv_segment_valid=valid)
+            else:
+                start = index.value
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, k.astype(self.dtype), (0, start, 0, 0))
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, v.astype(self.dtype), (0, start, 0, 0))
+                index.value = start + l
+                valid = (jnp.arange(slots)[None, :]
+                         < (start + l)).astype(jnp.int32)
+                valid = jnp.broadcast_to(valid, (b, slots))
+                if pad_lengths is not None:
+                    # Batched mixed-length prompts are LEFT-padded: row
+                    # i's first pad_lengths[i] cache slots hold
+                    # pad-token K/V that must never receive attention
+                    # mass. Slot order still equals time order per row
+                    # (pads are "earliest"), so the scalar causal
+                    # q_offset stays correct.
+                    valid = valid * (jnp.arange(slots)[None, :]
+                                     >= pad_lengths[:, None]
+                                     ).astype(jnp.int32)
+                out = dense_attention(
+                    q, cached_k.value, cached_v.value, causal=True,
+                    q_offset=start, kv_offset=0, kv_segment_valid=valid)
         elif self.attention_fn is not None:
             out = self.attention_fn(q, k, v)
         else:
@@ -183,14 +232,15 @@ class LlamaBlock(nn.Module):
     lora_alpha: float = 16.0
 
     @nn.compact
-    def __call__(self, x, positions, pad_lengths=None):
+    def __call__(self, x, positions, pad_lengths=None,
+                 decode_positions=None):
         h = RMSNorm(dtype=self.dtype, name="attn_norm")(x)
         x = x + LlamaAttention(
             self.num_heads, self.num_kv_heads, self.head_dim,
             self.rope_theta, self.dtype, self.attention_fn,
             self.cache_size, self.lora_rank, self.lora_alpha,
             name="attention",
-        )(h, positions, pad_lengths)
+        )(h, positions, pad_lengths, decode_positions)
         h = RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
         if self.num_experts > 0:
             return x + MoE(
@@ -227,11 +277,17 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, train=True,
-                 pad_lengths=None):
+                 pad_lengths=None, decode_positions=None):
         """``pad_lengths`` (optional, [B] int32, cache models only):
         per-row count of LEFT-pad slots in a batched mixed-length
         decode — those cache slots are masked out of attention
-        (inference/generate.py owns the matching position offsets)."""
+        (inference/generate.py owns the matching position offsets).
+
+        ``decode_positions`` (optional, [B] int32, cache models only):
+        per-row cache write index for slot-based one-token decode —
+        the continuous-batching engine (inference/engine/) keeps each
+        slot at its own position instead of sharing the scalar cache
+        index, so rows can join and retire mid-decode."""
         del train
         b, l = input_ids.shape
         if positions is None:
@@ -256,7 +312,7 @@ class Llama(nn.Module):
                 self.num_experts, self.num_selected, self.cache_size,
                 self.lora_rank, self.lora_alpha,
                 name=f"layer_{i}",
-            )(x, positions, pad_lengths)
+            )(x, positions, pad_lengths, decode_positions)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)
         logits = _dense(self.vocab_size, ("embed", "vocab"), jnp.float32,
                         "lm_head")(x.astype(jnp.float32))

@@ -464,6 +464,7 @@ class FakeApiServer:
               stop: Optional[threading.Event] = None,
               timeout: Optional[float] = None,
               label_selector: Optional[Dict[str, Optional[str]]] = None,
+              allow_bookmarks: bool = False,
               ) -> Iterator[Tuple[str, Dict[str, Any]]]:
         """Stream (event_type, object) for ``kind`` after
         ``resource_version``, blocking for new events until ``stop``
@@ -474,10 +475,20 @@ class FakeApiServer:
         (None values = key existence). An injected
         ``faults.watch_max_events`` ends the stream early after that
         many yields — a dropped connection the client must resume
-        from its last seen resourceVersion."""
+        from its last seen resourceVersion.
+
+        ``allow_bookmarks`` (the ``allowWatchBookmarks=true`` query,
+        which HttpApiClient always sends): before a server-side watch
+        timeout ends the stream, emit one BOOKMARK event — an object
+        of the watched kind whose ONLY payload is the current
+        resourceVersion — so an idle watcher's resume point tracks
+        the store head instead of aging into a 410. This is exactly
+        the real apiserver's contract, and what finally exercises the
+        controller's BOOKMARK special-case under test."""
         self._admit("watch", kind, namespace)
         last = resource_version
         yielded = 0
+        head = None  # set = server-side watch timeout at this revision
         while stop is None or not stop.is_set():
             with self._cond:
                 if (self._events
@@ -488,7 +499,8 @@ class FakeApiServer:
                 if not pending:
                     if not self._cond.wait(timeout=timeout or 0.5):
                         if timeout is not None:
-                            return  # server-side watch timeout
+                            head = self._revision
+                            break  # server-side watch timeout
                     continue
             for rev, event_type, obj in pending:
                 last = rev
@@ -504,6 +516,13 @@ class FakeApiServer:
                 drop_after = self.faults.watch_max_events
                 if drop_after is not None and yielded >= drop_after:
                     return  # injected connection drop
+        if head is not None and allow_bookmarks:
+            # Outside the lock: the consumer's socket write must never
+            # block every other store user mid-frame.
+            yield ("BOOKMARK", {
+                "kind": kind,
+                "metadata": {"resourceVersion": str(head)},
+            })
 
     def pod_logs(self, namespace: str, name: str, *,
                  tail: int = 100) -> str:

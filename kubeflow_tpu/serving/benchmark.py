@@ -953,6 +953,280 @@ def run_obs_overhead_benchmark(
     }
 
 
+@dataclasses.dataclass
+class ContinuousBenchConfig:
+    """Mixed-length open-loop sweep: the r6 static coalescer vs the
+    continuous-batching engine at the SAME offered load (ISSUE 6
+    acceptance). The workload alternates short (``short_tokens``) and
+    long (``long_tokens``) requests; the static stack has no
+    per-request budget knob, so a short request rides the full
+    ``long_tokens`` decode — exactly the head-of-line cost the slot
+    engine removes by retiring rows early and admitting between
+    slices.
+
+    Both phases drive ServedModel directly (queue/coalescer/engine +
+    real XLA model, no socket hop — same rationale as the overload
+    bench), back to back with an identical arrival schedule. Box
+    policy (PERF.md r9): ratios of back-to-back phases plus the
+    engine's component estimates are reported; single-phase wall
+    numbers are not the assertion basis on throttled hardware."""
+
+    prompt_len: int = 16
+    short_tokens: int = 4
+    long_tokens: int = 24
+    num_requests: int = 36
+    slots: int = 4  # engine slots AND the static max_batch
+    page_size: int = 8
+    slice_tokens: int = 4
+    batch_window_s: float = 0.002  # the r6 coalescer's default
+    #: offered loads as multiples of the measured static capacity
+    #: (full-batch decode throughput).
+    rates_x: Sequence[float] = (0.75, 1.25)
+    #: rows for the in-bench bitwise checks (greedy rides the serving
+    #: engine mid-churn; sampled rides a dedicated engine instance).
+    equality_rows: int = 3
+    model_dtype: str = "float32"
+
+
+def _continuous_phase(submit_one, n: int, rate_rps: float,
+                      budgets: Sequence[int]) -> Dict[str, Any]:
+    """Open-loop drive: request k is fired at ``k/rate`` regardless of
+    how the server keeps up; latency is measured from the SCHEDULED
+    arrival (queueing delay from a slow server counts — that is what
+    an open-loop client experiences)."""
+    done = [None] * n
+    lock = threading.Lock()
+    start = time.perf_counter()
+    interval = 1.0 / rate_rps
+
+    def worker(i: int, stripe: int):
+        for k in range(i, n, stripe):
+            scheduled = start + k * interval
+            delay = scheduled - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            latency, ttft = submit_one(k, budgets[k], scheduled)
+            with lock:
+                done[k] = (latency, ttft, budgets[k])
+
+    stripe = min(n, 12)
+    threads = [threading.Thread(target=worker, args=(i, stripe),
+                                daemon=True)
+               for i in range(stripe)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    finished = [d for d in done if d is not None]
+    lats = np.asarray([d[0] for d in finished]) * 1e3
+    short = np.asarray([d[0] for d in finished
+                        if d[2] == min(budgets)]) * 1e3
+    ttfts = np.asarray([d[1] for d in finished
+                        if d[1] is not None]) * 1e3
+    makespan = time.perf_counter() - start
+    requested_tokens = sum(d[2] for d in finished)
+    row: Dict[str, Any] = {
+        "offered_rps": round(rate_rps, 1),
+        "completed": len(finished),
+        "makespan_s": round(makespan, 3),
+        "goodput_tokens_per_s": round(requested_tokens / makespan, 1),
+        "p50_ms": round(float(np.percentile(lats, 50)), 1),
+        "p99_ms": round(float(np.percentile(lats, 99)), 1),
+        "short_p50_ms": round(float(np.percentile(short, 50)), 1),
+    }
+    if ttfts.size:
+        row["ttft_p50_ms"] = round(float(np.percentile(ttfts, 50)), 1)
+    return row
+
+
+def run_continuous_benchmark(config: ContinuousBenchConfig
+                             ) -> Dict[str, Any]:
+    """The ISSUE 6 acceptance sweep. Returns per-rate static vs
+    continuous rows, the mid-decode-join TTFT probe, and the bitwise
+    equality verdicts."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.inference.engine import DecodeEngine, EngineConfig
+    from kubeflow_tpu.inference.generate import generate
+    from kubeflow_tpu.serving.manager import ModelManager
+
+    base = _export(ServingBenchConfig(
+        model="llama-test", prompt_len=config.prompt_len,
+        new_tokens=config.long_tokens, max_batch=config.slots,
+        model_dtype=config.model_dtype))
+    # One artifact, two serving stacks. The engine capacity knobs ride
+    # the export's generate_config (docs/streaming.md), so patch them
+    # into the artifact before either stack loads it.
+    meta_path = pathlib.Path(base) / "1" / "signature.json"
+    meta = json.loads(meta_path.read_text())
+    meta["generate_config"].update({
+        "engine_slots": config.slots,
+        "engine_page_size": config.page_size,
+        "engine_slice_tokens": config.slice_tokens,
+    })
+    meta_path.write_text(json.dumps(meta))
+
+    manager = ModelManager(poll_interval_s=3600)
+    static_model = manager.add_model(
+        "bench-static", base, max_batch=config.slots)
+    cont_model = manager.add_model(
+        "bench-cont", base, max_batch=config.slots,
+        continuous_batching=True)
+    try:
+        rng = np.random.RandomState(7)
+        prompts = rng.randint(
+            0, 512, (config.num_requests, config.prompt_len)
+        ).astype(np.int32)
+        budgets = [config.short_tokens if i % 2 == 0
+                   else config.long_tokens
+                   for i in range(config.num_requests)]
+
+        # Calibrate static capacity: one full coalesced batch, timed
+        # (requests/s the r6 stack can sustain with every slot full).
+        t0 = time.perf_counter()
+        futs = [static_model.submit(
+            {"input_ids": prompts[i][None]}, None, "generate", None)
+            for i in range(config.slots)]
+        for f in futs:
+            f.result(300)
+        batch_s = time.perf_counter() - t0
+        static_capacity_rps = config.slots / batch_s
+
+        def submit_static(k, budget, scheduled):
+            fut = static_model.submit(
+                {"input_ids": prompts[k][None]}, None, "generate",
+                None)
+            fut.result(300)
+            return time.perf_counter() - scheduled, None
+
+        def submit_cont(k, budget, scheduled):
+            _, (stream,) = cont_model.submit_stream(
+                {"input_ids": prompts[k][None]}, None, None,
+                max_new_tokens=budget)
+            first = None
+            for ev in stream.events(timeout_per_event=300):
+                if first is None and not ev.final:
+                    first = time.perf_counter() - scheduled
+                if ev.final:
+                    break
+            stream.result(5)
+            return time.perf_counter() - scheduled, first
+
+        rows = []
+        for x in config.rates_x:
+            rate = static_capacity_rps * x
+            static_row = _continuous_phase(
+                submit_static, config.num_requests, rate, budgets)
+            cont_row = _continuous_phase(
+                submit_cont, config.num_requests, rate, budgets)
+            rows.append({
+                "offered_x": x,
+                "static": static_row,
+                "continuous": cont_row,
+                "goodput_ratio": round(
+                    cont_row["goodput_tokens_per_s"]
+                    / max(static_row["goodput_tokens_per_s"], 1e-9),
+                    3),
+                "p50_ratio": round(
+                    static_row["p50_ms"]
+                    / max(cont_row["p50_ms"], 1e-9), 3),
+            })
+
+        loaded = cont_model.get_resident()
+        engine = loaded.engine
+
+        # TTFT probe: a short request admitted while a long neighbor
+        # decodes must see first-token well under the neighbor's full
+        # decode (the static stack's floor for a late arrival).
+        long_t0 = time.perf_counter()
+        long_stream = engine.submit(prompts[1], max_new_tokens=config.
+                                    long_tokens)
+        assert long_stream.next_event(timeout=300) is not None
+        short_t0 = time.perf_counter()
+        short_stream = engine.submit(prompts[0],
+                                     max_new_tokens=config.short_tokens)
+        first_ev = short_stream.next_event(timeout=300)
+        ttft_short_s = time.perf_counter() - short_t0
+        short_stream.result(300)
+        long_stream.result(300)
+        long_decode_s = time.perf_counter() - long_t0
+        assert first_ev is not None
+
+        # Bitwise checks on live traffic. Greedy: explicit keys
+        # through the SERVING engine while background rows churn.
+        module, params = loaded._module, loaded.variables["params"]
+        churn = [engine.submit(prompts[10 + i],
+                               max_new_tokens=config.long_tokens)
+                 for i in range(2)]
+        greedy_ok = True
+        for i in range(config.equality_rows):
+            key = np.asarray(jax.random.PRNGKey(4000 + i))
+            got = engine.submit(
+                prompts[20 + i], rng=key,
+                max_new_tokens=config.long_tokens).result(300)
+            want, _ = generate(
+                module, params, jnp.asarray(prompts[20 + i])[None, :],
+                max_new_tokens=config.long_tokens,
+                rng=jnp.asarray(key)[None, :],
+                prompt_lengths=jnp.asarray([config.prompt_len]))
+            greedy_ok &= bool(np.array_equal(got, np.asarray(want)[0]))
+        for s in churn:
+            s.result(300)
+
+        # Sampled: a dedicated engine instance (the export is greedy).
+        sampled = dict(temperature=0.8, top_k=50)
+        s_engine = DecodeEngine(module, params, EngineConfig(
+            max_new_tokens=config.long_tokens,
+            max_prompt_len=config.prompt_len, num_slots=2,
+            page_size=config.page_size,
+            slice_tokens=config.slice_tokens, **sampled),
+            name="bench-sampled")
+        sampled_ok = True
+        try:
+            streams, keys = [], []
+            for i in range(config.equality_rows):
+                keys.append(np.asarray(jax.random.PRNGKey(5000 + i)))
+                streams.append(s_engine.submit(
+                    prompts[24 + i], rng=keys[i]))
+            for i, s in enumerate(streams):
+                want, _ = generate(
+                    module, params,
+                    jnp.asarray(prompts[24 + i])[None, :],
+                    max_new_tokens=config.long_tokens,
+                    rng=jnp.asarray(keys[i])[None, :],
+                    prompt_lengths=jnp.asarray([config.prompt_len]),
+                    **sampled)
+                sampled_ok &= bool(np.array_equal(
+                    s.result(300), np.asarray(want)[0]))
+        finally:
+            s_engine.stop()
+
+        worst = max(config.rates_x)
+        top = next(r for r in rows if r["offered_x"] == worst)
+        return {
+            "config": dataclasses.asdict(config),
+            "static_capacity_rps": round(static_capacity_rps, 1),
+            "static_batch_decode_ms": round(batch_s * 1e3, 1),
+            "rows": rows,
+            "ttft_short_ms": round(ttft_short_s * 1e3, 1),
+            "long_decode_ms": round(long_decode_s * 1e3, 1),
+            "ttft_vs_long_decode": round(
+                ttft_short_s / max(long_decode_s, 1e-9), 3),
+            "engine_stats": engine.stats(),
+            "bitwise_greedy_ok": greedy_ok,
+            "bitwise_sampled_ok": sampled_ok,
+            "goodput_ratio_at_top": top["goodput_ratio"],
+            "p50_ratio_at_top": top["p50_ratio"],
+            "continuous_wins": bool(
+                top["goodput_ratio"] > 1.0 and top["p50_ratio"] > 1.0
+                and greedy_ok and sampled_ok
+                and ttft_short_s < 0.5 * long_decode_s),
+        }
+    finally:
+        manager.stop()
+
+
 def main(argv=None) -> int:
     import argparse
 

@@ -115,6 +115,75 @@ def predict(server: str, model: str, instances, *, classify: bool = False,
                      request_id=request_id)
 
 
+def stream_generate(server: str, model: str, instances, *,
+                    timeout: float = 60.0,
+                    deadline_ms: float | None = None,
+                    max_new_tokens: int | None = None,
+                    request_id: str | None = None):
+    """Consume a streaming ``:generate`` over SSE (the proxy or the
+    model server's REST port — same wire either way). Yields
+    ``(event, data)`` pairs as they arrive: ``token`` events
+    ({row, index, token}), per-row ``error`` events, and the terminal
+    ``done`` ({tokens}); returns after ``done``. ``timeout`` bounds
+    each read, not the whole stream (tokens keep the connection
+    demonstrably alive)."""
+    from kubeflow_tpu.serving import wire
+
+    body: dict = {"instances": instances, "stream": True}
+    if max_new_tokens is not None:
+        body["max_new_tokens"] = int(max_new_tokens)
+    headers = {"Content-Type": "application/json",
+               "Accept": wire.SSE_CONTENT_TYPE}
+    if request_id:
+        headers[REQUEST_ID_HEADER] = request_id
+    if deadline_ms:
+        headers[DEADLINE_HEADER] = str(max(1, int(deadline_ms)))
+    req = urllib.request.Request(
+        f"http://{server}/model/{model}:generate",
+        data=json.dumps(body).encode(), headers=headers,
+        method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        ctype = resp.headers.get("Content-Type", "")
+        if not ctype.startswith(wire.SSE_CONTENT_TYPE):
+            # Error answered as plain JSON before streaming started.
+            raise RuntimeError(
+                f"server did not stream (Content-Type {ctype!r}): "
+                f"{resp.read(4096).decode(errors='replace')}")
+        for event, data in wire.iter_sse_events(resp):
+            yield event, data
+            if event == "done":
+                return
+    raise RuntimeError("stream ended without a 'done' event")
+
+
+def grpc_generate_stream(server: str, model: str, inputs: dict, *,
+                         signature_name: str = "", version=None,
+                         timeout: float = 60.0):
+    """Consume the native server-streaming GenerateStream RPC: yields
+    ``("token", {row, index, token})`` per streamed message and a
+    final ``("done", {tokens})`` decoded from the terminal frame."""
+    import grpc
+    import numpy as np
+
+    from kubeflow_tpu.serving import wire
+
+    request = wire.encode_predict_request(
+        model, {k: np.asarray(v) for k, v in inputs.items()},
+        signature_name=signature_name, version=version)
+    with grpc.insecure_channel(server) as channel:
+        call = channel.unary_stream(
+            "/tensorflow.serving.PredictionService/GenerateStream")
+        for message in call(request, timeout=timeout):
+            _, outputs = wire.decode_predict_response(message)
+            if "tokens" in outputs:
+                yield "done", {"tokens": outputs["tokens"].tolist()}
+                return
+            yield "token", {"row": int(outputs["row"][0]),
+                            "index": int(outputs["index"][0]),
+                            "token": int(outputs["token"][0])}
+    raise RuntimeError("stream ended without a terminal tokens frame")
+
+
 def grpc_web_predict(server: str, model: str, inputs: dict, *,
                      signature_name: str = "", version=None,
                      timeout: float = 10.0) -> dict:
@@ -238,7 +307,17 @@ def main(argv=None) -> int:
                         help="X-Request-Id to tag the request with "
                              "(grep it in access logs and /tracez "
                              "spans; omitted, the proxy mints one)")
+    parser.add_argument("--stream", action="store_true",
+                        help="streaming :generate over SSE (server "
+                             "must run --continuous_batching): tokens "
+                             "print incrementally as they decode")
+    parser.add_argument("--max_new_tokens", type=int, default=None,
+                        help="streaming only: per-request token "
+                             "budget (<= the export's; the decode "
+                             "slot retires early)")
     args = parser.parse_args(argv)
+    if args.max_new_tokens is not None and not args.stream:
+        parser.error("--max_new_tokens requires --stream")
     if args.retries < 1:
         parser.error("--retries must be >= 1 (1 = a single attempt)")
     if args.json_path:
@@ -248,6 +327,43 @@ def main(argv=None) -> int:
         instances = [{"b64": base64.b64encode(data).decode()}]
     else:
         parser.error("need --input_path or --json_path")
+    if args.stream:
+        if args.classify:
+            parser.error("--stream applies to generate models only")
+        if args.grpc:
+            if args.max_new_tokens is not None:
+                parser.error(
+                    "--max_new_tokens rides the REST streaming body; "
+                    "the GenerateStream wire has no budget field — "
+                    "drop --grpc or --max_new_tokens")
+            timeout = (args.deadline_ms / 1e3 if args.deadline_ms
+                       else 60.0)
+            events = grpc_generate_stream(
+                args.server, args.model,
+                {args.input_name: instances}, timeout=timeout)
+        else:
+            events = stream_generate(
+                args.server, args.model, instances,
+                deadline_ms=args.deadline_ms,
+                max_new_tokens=args.max_new_tokens,
+                request_id=args.request_id)
+        result = {}
+        for event, data in events:
+            if event == "token":
+                # The incremental surface: one token id per line the
+                # moment it decodes (time-to-first-token is visible to
+                # the naked eye on long decodes).
+                print(f"row {data['row']} token[{data['index']}] = "
+                      f"{data['token']}", flush=True)
+            elif event == "error":
+                print(f"stream error: {data}", file=sys.stderr,
+                      flush=True)
+                result.setdefault("errors", []).append(data)
+            else:  # done
+                result.update(data)
+        json.dump(result, sys.stdout, indent=2)
+        print()
+        return 0
     if args.grpc:
         if args.input_path:
             parser.error("--grpc takes --json_path (dense tensors)")

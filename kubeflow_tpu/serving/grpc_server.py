@@ -240,6 +240,82 @@ class PredictionService:
         set one (never wait past it), else the server default."""
         return clamp_wait_s(deadline, self._timeout_s)
 
+    # -- GenerateStream (server streaming) ---------------------------------
+
+    def GenerateStream(self, request: bytes, context):
+        """Server-streaming generate over the continuous-batching
+        engine: the request is an ordinary PredictRequest against a
+        generate signature; each streamed message is a
+        PredictResponse carrying ONE sampled token (outputs ``row`` /
+        ``index`` / ``token``), and the terminal message carries the
+        full ``tokens`` [rows, T] array — so unary clients' decode of
+        the final frame equals the unary Predict response. Runs on the
+        gRPC worker thread (grpc's thread-per-RPC model: blocking
+        bounded waits are the natural style here)."""
+        try:
+            deadline = _context_deadline(context)
+            obs_ctx = obs_tracing.from_grpc_metadata(
+                context.invocation_metadata())
+            spec, inputs, _ = wire.decode_predict_request(request)
+            model = self._manager.get_model(spec["name"])
+            sig_name = spec["signature_name"] or None
+            _, streams = model.submit_stream(
+                inputs, sig_name, spec["version"], deadline=deadline,
+                obs_ctx=obs_ctx)
+        except Exception as e:  # noqa: BLE001 — mapped to grpc status
+            _abort_for(context, e)
+            return
+        try:
+            yield from self._drain_streams(spec, streams, deadline,
+                                           context)
+        except Exception as e:  # noqa: BLE001
+            for s in streams:
+                s.cancel()
+            _abort_for(context, e)
+
+    def _drain_streams(self, spec, streams, deadline, context):
+        import threading
+
+        signal = threading.Event()
+        for s in streams:
+            s.set_notify(signal.set)
+        finished = [False] * len(streams)
+        results: List[Optional[np.ndarray]] = [None] * len(streams)
+        first_error: Optional[BaseException] = None
+        while not all(finished):
+            if not context.is_active():
+                for s in streams:  # client hung up mid-stream
+                    s.cancel()
+                return
+            signal.clear()
+            progressed = False
+            for r, s in enumerate(streams):
+                for ev in s.drain():
+                    progressed = True
+                    if ev.final:
+                        finished[r] = True
+                        if ev.error is not None:
+                            first_error = first_error or ev.error
+                        else:
+                            results[r] = s.result(timeout=1.0)
+                    else:
+                        yield wire.encode_predict_response(
+                            {"row": np.asarray([r], np.int32),
+                             "index": np.asarray([ev.index], np.int32),
+                             "token": np.asarray([ev.token],
+                                                 np.int32)},
+                            spec["name"])
+            if all(finished):
+                break
+            if not progressed and not signal.wait(
+                    clamp_wait_s(deadline, self._timeout_s)):
+                raise concurrent.futures.TimeoutError(
+                    "stream timed out awaiting the engine")
+        if first_error is not None:
+            raise first_error
+        yield wire.encode_predict_response(
+            {"tokens": np.stack(results)}, spec["name"])
+
     # -- GetModelMetadata --------------------------------------------------
 
     def GetModelMetadata(self, request: bytes, context) -> bytes:
@@ -317,6 +393,10 @@ def make_server(manager: ModelManager, port: int, *,
                                ("GetModelMetadata",
                                 service.GetModelMetadata))
     }
+    # Server-streaming generate (continuous batching, ISSUE 6): same
+    # generic raw-bytes style, streaming arity.
+    handlers["GenerateStream"] = grpc.unary_stream_rpc_method_handler(
+        service.GenerateStream)
     server = grpc.server(
         concurrent.futures.ThreadPoolExecutor(
             max_workers=max_workers,

@@ -69,6 +69,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 import tornado.httpclient
 import tornado.ioloop
+import tornado.iostream
 import tornado.web
 
 from kubeflow_tpu.obs import metrics as obs_metrics
@@ -167,6 +168,22 @@ BREAKER_TIMEOUT_FLOOR_S = 1.0
 #: more doomed upstream dial (the budget-aware half of the
 #: retry-on-another-replica contract).
 RETRY_BUDGET_FLOOR_S = 0.02
+
+#: Total-wall ceiling for a deadline-free proxied token stream (SSE).
+#: Streams legitimately outlive rpc_timeout (that knob bounds unary
+#: round trips); deadline-carrying streams are bounded by their own
+#: budget instead.
+STREAM_TIMEOUT_S = 300.0
+
+#: Un-acked downstream write backlog at which a proxied stream gives
+#: up on its (slow or gone) client instead of buffering the decode —
+#: token frames are ~50 bytes, so this is thousands of tokens of
+#: slack, yet bounds per-connection proxy memory.
+STREAM_BACKLOG_LIMIT = 256 * 1024
+
+
+class _ClientStalledError(Exception):
+    """Downstream SSE client fell too far behind the relay."""
 
 
 def decode_b64_if_needed(value: Any) -> Any:
@@ -618,6 +635,135 @@ class InferProxyHandler(ProxyHandler):
         self.write_json({"predictions": payload.get("predictions", [])})
         raise _Handled()
 
+    async def _attempt_stream(self, ep: Endpoint, name: str,
+                              version: Optional[str], instances: Any,
+                              body: Dict[str, Any],
+                              deadline: Optional[float]) -> None:
+        """One streaming :generate attempt: relay the upstream SSE
+        response CHUNK BY CHUNK (write+flush per chunk, never a
+        full-body buffer) so time-to-first-token survives the router
+        hop. Failover stays available until the first upstream byte;
+        after that the stream is committed to this replica — a
+        mid-stream failure is reported in-band as an SSE error event,
+        because the tokens already relayed cannot be unsent."""
+        breaker = ep.rest_breaker
+        if not breaker.allow():
+            _P_RETRY_AFTER.labels("rest").inc()
+            raise CircuitOpenError(breaker.retry_after_s())
+        path = f"/v1/models/{name}"
+        if version:
+            path += f"/versions/{version}"
+        path += ":generate"
+        upstream_body: Dict[str, Any] = {
+            "instances": instances, "stream": True,
+            "signature_name": body.get("signature_name"),
+        }
+        if body.get("max_new_tokens") is not None:
+            upstream_body["max_new_tokens"] = body["max_new_tokens"]
+        headers = dict(self._obs_ctx.headers()) \
+            if getattr(self, "_obs_ctx", None) is not None else {}
+        timeout = STREAM_TIMEOUT_S
+        remaining = overload.remaining_s(deadline)
+        if remaining is not None:
+            headers[overload.DEADLINE_HEADER] = str(
+                max(1, int(remaining * 1000)))
+            timeout = min(timeout, max(0.001, remaining))
+        state = {"status": None, "ctype": None, "streamed": False,
+                 "client_gone": False, "backlog": 0}
+
+        def on_header(line: str) -> None:
+            line = line.strip()
+            if line.startswith("HTTP/"):
+                parts = line.split()
+                if len(parts) >= 2 and parts[1].isdigit():
+                    state["status"] = int(parts[1])
+            elif line.lower().startswith("content-type:"):
+                state["ctype"] = line.split(":", 1)[1].strip()
+
+        def on_chunk(chunk: bytes) -> None:
+            if not state["streamed"]:
+                state["streamed"] = True
+                self.set_status(state["status"] or 200)
+                self.set_header("Content-Type", state["ctype"]
+                                or "text/event-stream")
+                self.set_header("Cache-Control", "no-cache")
+            try:
+                # streaming_callback is sync, so flush() can't be
+                # awaited — bound the un-acked write backlog instead:
+                # past the cap the CLIENT is the slow party, and the
+                # relay aborts rather than buffering the whole decode
+                # (many long streams × unbounded buffers = proxy OOM).
+                state["backlog"] += len(chunk)
+                if state["backlog"] > STREAM_BACKLOG_LIMIT:
+                    raise _ClientStalledError(
+                        f"client {state['backlog']} bytes behind")
+                self.write(chunk)
+                fut = self.flush()
+                fut.add_done_callback(
+                    lambda _f, n=len(chunk): state.__setitem__(
+                        "backlog", state["backlog"] - n))
+            except (tornado.iostream.StreamClosedError,
+                    _ClientStalledError):
+                # The DOWNSTREAM side died/stalled — the upstream
+                # replica did nothing wrong, so this must not count
+                # against its breaker. Raising kills the fetch.
+                state["client_gone"] = True
+                raise
+
+        _P_UPSTREAM_REQUESTS.labels("rest").inc()
+        client = tornado.httpclient.AsyncHTTPClient()
+        try:
+            response = await client.fetch(
+                f"{ep.url}{path}", method="POST",
+                body=json.dumps(upstream_body), headers=headers,
+                request_timeout=timeout, raise_error=False,
+                streaming_callback=on_chunk, header_callback=on_header)
+            failure = response.error if response.code == 599 else None
+        except Exception as e:  # noqa: BLE001 — transport failure
+            response, failure = None, e
+        if state["client_gone"]:
+            # Client hung up / stalled mid-relay: nothing to answer,
+            # and the upstream stays healthy (no breaker hit).
+            self._obs_outcome = "client_gone"
+            try:
+                self.finish()
+            except Exception:  # noqa: BLE001 — already closed
+                pass
+            raise _Handled()
+        if failure is None:
+            breaker.record_success()
+            if not state["streamed"]:
+                # Headerless empty body (shouldn't happen; keep the
+                # client out of limbo with a structured error).
+                self.write_json(
+                    {"error": "upstream stream carried no data"}, 502)
+            else:
+                self.finish()
+            raise _Handled()
+        timed_out = "timeout" in str(failure).lower()
+        if not timed_out or timeout >= min(self.rpc_timeout,
+                                           BREAKER_TIMEOUT_FLOOR_S):
+            breaker.record_failure()
+            _P_UPSTREAM_FAILURES.labels("rest").inc()
+        if state["streamed"]:
+            # Bytes already relayed: committed — close in-band.
+            from kubeflow_tpu.serving import wire
+
+            self._obs_outcome = "stream_broken"
+            try:
+                self.write(wire.format_sse_event(
+                    {"error": f"upstream disconnected mid-stream: "
+                              f"{failure}",
+                     "code": "UNAVAILABLE"}, event="error"))
+                self.finish()
+            except Exception:  # noqa: BLE001 — client also gone
+                pass
+            raise _Handled()
+        if timed_out:
+            raise BackendTimeoutError(
+                f"model server timed out after {timeout:.1f}s")
+        raise BackendDownError(str(failure))
+
     async def _infer(self, name: str, version: Optional[str],
                      verb: str) -> None:
         self._obs_model = name
@@ -644,6 +790,20 @@ class InferProxyHandler(ProxyHandler):
                 {"error": "deadline expired before proxying",
                  "code": "DEADLINE_EXCEEDED"}, 504)
         instances = decode_b64_if_needed(instances)
+        wants_stream = bool(body.get("stream")) or (
+            "text/event-stream"
+            in self.request.headers.get("Accept", ""))
+        if wants_stream and verb == "generate":
+            # Streaming rides the REST upstream directly (prompts are
+            # dense int rows — no signature-map conversion needed);
+            # failover applies until the first relayed byte.
+            await self.route_with_failover(
+                name,
+                lambda ep: self._attempt_stream(ep, name, version,
+                                                instances, body,
+                                                deadline),
+                deadline=deadline)
+            return
         # Infer verbs are idempotent (pure functions of their
         # inputs), so the shared failover loop may retry a transport
         # failure on another replica.

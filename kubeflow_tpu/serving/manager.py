@@ -113,6 +113,49 @@ _M_DISPATCH = obs_metrics.Histogram(
     "Wall time of one batched model execution group", ("model",))
 
 
+def _combine_streams(streams, future: Future) -> None:
+    """Resolve ``future`` with {"tokens": [n, T]} once every engine
+    stream finishes (first error wins and cancels the rest). Runs on
+    the engine thread via each stream's notify hook — no waiter
+    thread per request."""
+    import threading as _threading
+
+    lock = _threading.Lock()
+    state = {"left": len(streams)}
+    counted = [False] * len(streams)
+
+    def finalize() -> None:
+        try:
+            rows = [s.result(timeout=1.0) for s in streams]
+        except BaseException as e:  # noqa: BLE001 — fan out
+            for s in streams:
+                s.cancel()
+            if not future.done():
+                future.set_exception(e)
+            return
+        if not future.done():
+            future.set_result({"tokens": np.stack(rows)})
+
+    def make_cb(i: int, stream):
+        def cb() -> None:
+            if not stream.done:
+                return
+            with lock:
+                if counted[i]:
+                    return
+                counted[i] = True
+                state["left"] -= 1
+                last = state["left"] == 0
+            if last:
+                finalize()
+        return cb
+
+    for i, stream in enumerate(streams):
+        cb = make_cb(i, stream)
+        stream.set_notify(cb)
+        cb()  # already-finished stream (raced the set_notify)
+
+
 def _local_versions(base_path: str) -> List[int]:
     """All numeric version dirs under a POSIX base path, ascending."""
     import os
@@ -131,11 +174,19 @@ class ServedModel:
     def __init__(self, name: str, base_path: str, *, max_batch: int = 64,
                  batch_window_s: float = 0.002,
                  version_policy: str = "latest",
-                 queue_capacity: int = 4096):
+                 queue_capacity: int = 4096,
+                 continuous_batching: bool = False):
         self.name = name
         self.base_path = base_path
         self.max_batch = max_batch
         self.batch_window_s = batch_window_s
+        self.queue_capacity = queue_capacity
+        # Continuous batching (ISSUE 6): generate requests ride the
+        # slot-based decode engine (inference/engine/) instead of the
+        # admit-at-dispatch coalescer — rows join/retire mid-decode
+        # and tokens stream incrementally. predict/classify traffic
+        # keeps the micro-batcher either way.
+        self.continuous_batching = continuous_batching
         self.version_policy, self._pinned = parse_version_policy(
             version_policy)
         self._versions: Dict[int, LoadedModel] = {}
@@ -224,7 +275,44 @@ class ServedModel:
         # of admitted unjudged.
         if loaded.warmup_batch_seconds is not None:
             self._latency.seed(loaded.warmup_batch_seconds)
+        if (self.continuous_batching
+                and loaded.signature().method == "generate"):
+            # Build + warm the decode engine during load (still 503):
+            # the first prefill/slice compile is the same cold-compile
+            # cliff the bucket warmup exists for.
+            self._warm_engine(loaded.ensure_engine(
+                self.name, queue_capacity=self.queue_capacity))
         return loaded
+
+    def _warm_engine(self, engine) -> None:
+        """Compile the engine's prefill buckets and slice programs
+        with one throwaway request per prompt bucket (fixed key — a
+        warmup must not perturb deterministic exports' rng streams)."""
+        import jax
+
+        cfg = engine.config
+        buckets = sorted({int(v) for v in (cfg.prompt_buckets or ())}
+                         | {cfg.max_prompt_len})
+        key = np.asarray(jax.random.PRNGKey(0))
+        # One request per prompt bucket compiles its prefill (and the
+        # full-K slice, reached from every bucket).
+        tokens = min(cfg.max_new_tokens, cfg.slice_tokens + 1)
+        for width in buckets:
+            prompt = np.zeros((min(width, cfg.max_prompt_len),),
+                              np.int32)
+            engine.submit(prompt, rng=key,
+                          max_new_tokens=tokens).result(timeout=600)
+        # Tail slices: a request retiring mid-slice shrinks K, and
+        # each distinct K is its own compile — warm K=1..slice-1 too
+        # (sequential solo requests with budget b run one (b-1)-step
+        # slice), or the first short request pays seconds of compile
+        # mid-traffic.
+        prompt = np.zeros((min(buckets[0], cfg.max_prompt_len),),
+                          np.int32)
+        for budget in range(2, min(cfg.slice_tokens + 1,
+                                   cfg.max_new_tokens + 1)):
+            engine.submit(prompt, rng=key,
+                          max_new_tokens=budget).result(timeout=600)
 
     def poll_versions(self) -> bool:
         """Scan base_path; (re)load whatever the version policy admits.
@@ -282,10 +370,17 @@ class ServedModel:
                 keep = set(target)
             else:
                 keep = set(self._versions)
-            for v in list(self._versions):
-                if v not in keep:
-                    del self._versions[v]
+            evicted = [self._versions.pop(v)
+                       for v in list(self._versions) if v not in keep]
             resident = sorted(self._versions)
+        # Close OUTSIDE the lock: engine.stop() joins the decode
+        # thread (up to 10s mid-compile), and holding _lock for that
+        # long blocks get_resident() — i.e. all admission — for the
+        # whole model during a routine version rollout.
+        for loaded in evicted:
+            close = getattr(loaded, "close", None)
+            if close is not None:
+                close()
         if remote.is_remote(self.base_path):
             remote.prune_cache(self.base_path, resident)
         return loaded_any
@@ -407,6 +502,13 @@ class ServedModel:
             self._pending.clear()
         for req in leftovers:
             req[4].set_exception(RuntimeError("server shutting down"))
+        with self._lock:
+            resident = list(self._versions.values())
+        for loaded in resident:
+            # Duck-typed: tests stub LoadedModel with bare objects.
+            close = getattr(loaded, "close", None)
+            if close is not None:
+                close()  # decode-engine threads + page pools
 
     def queue_depth(self) -> int:
         """Requests enqueued but not yet popped by the batcher."""
@@ -447,6 +549,19 @@ class ServedModel:
         spans so a request_id greps from proxy access log to the XLA
         dispatch that served it."""
         self.start_batcher()
+        if self.continuous_batching:
+            # Generate rides the slot engine when the target version
+            # is already resident (a version still loading keeps the
+            # classic queue path — the batcher thread owns the slow
+            # load). predict/classify always ride the micro-batcher.
+            loaded = self.get_resident(version)
+            if loaded is not None:
+                sig = loaded.signature(signature_name)
+                if (method or sig.method) == "generate" \
+                        and sig.method == "generate":
+                    return self._submit_engine(
+                        loaded, inputs, signature_name,
+                        deadline=deadline, obs_ctx=obs_ctx)
         future: Future = Future()
         t_enqueue = time.monotonic()
         if deadline is not None:
@@ -507,6 +622,93 @@ class ServedModel:
                             time.monotonic() - t_enqueue,
                             self._span_args(obs_ctx, "shed"))
                 future.set_exception(error)
+        return future
+
+    def submit_stream(self, inputs: Dict[str, np.ndarray],
+                      signature_name: Optional[str],
+                      version: Optional[int], *,
+                      deadline: Optional[float] = None,
+                      obs_ctx=None,
+                      max_new_tokens: Optional[int] = None):
+        """Streaming generate: submit every request row to the decode
+        engine and return ``(loaded, [GenerateStream per row])`` — the
+        transports (SSE on REST, gRPC server streaming) drain the
+        streams incrementally. ``max_new_tokens`` optionally lowers
+        this request's token budget below the export's (the slot
+        retires early — the per-request knob static batching can't
+        offer). Raises OverloadedError / DeadlineExceededError
+        synchronously when the engine sheds."""
+        if not self.continuous_batching:
+            raise ValueError(
+                f"model {self.name!r} is not served with continuous "
+                f"batching; token streaming requires it "
+                f"(--continuous_batching)")
+        loaded = self.get(version)
+        sig = loaded.signature(signature_name)
+        if sig.method != "generate":
+            raise ValueError(
+                f"streaming requires a generate signature; "
+                f"{signature_name or 'serving_default'!r} is "
+                f"{sig.method!r}")
+        x, n = loaded._prepare(sig, inputs, variable_length=True)
+        if n == 0:
+            raise ValueError("empty batch")
+        engine = loaded.ensure_engine(
+            self.name, queue_capacity=self.queue_capacity)
+        rngs = loaded.request_rngs(n)
+        streams = []
+        try:
+            for i in range(n):
+                streams.append(engine.submit(
+                    x[i], rng=rngs[i], deadline=deadline,
+                    obs_ctx=obs_ctx, max_new_tokens=max_new_tokens))
+        except BaseException:
+            for s in streams:  # free the slots already taken
+                s.cancel()
+            raise
+        return loaded, streams
+
+    def _submit_engine(self, loaded, inputs: Dict[str, np.ndarray],
+                       signature_name: Optional[str], *,
+                       deadline: Optional[float],
+                       obs_ctx) -> Future:
+        """Non-streaming generate over the engine: the classic
+        future-of-{"tokens": [n, T]} contract, built by combining the
+        per-row streams (so REST/gRPC unary clients transparently gain
+        slot-level batching)."""
+        future: Future = Future()
+        sig = loaded.signature(signature_name)
+        try:
+            x, n = loaded._prepare(sig, inputs, variable_length=True)
+            if n == 0:
+                raise ValueError("empty batch")
+            engine = loaded.ensure_engine(
+            self.name, queue_capacity=self.queue_capacity)
+            rngs = loaded.request_rngs(n)
+            streams = []
+            try:
+                for i in range(n):
+                    streams.append(engine.submit(
+                        x[i], rng=rngs[i], deadline=deadline,
+                        obs_ctx=obs_ctx))
+            except BaseException:
+                for s in streams:
+                    s.cancel()
+                raise
+        except (DeadlineExceededError, OverloadedError) as e:
+            with self._pending_lock:
+                if isinstance(e, OverloadedError):
+                    self._stat_shed += 1
+                else:
+                    self._stat_expired += 1
+            (self._m_shed if isinstance(e, OverloadedError)
+             else self._m_expired).inc()
+            future.set_exception(e)
+            return future
+        except Exception as e:  # noqa: BLE001 — validation errors
+            future.set_exception(e)
+            return future
+        _combine_streams(streams, future)
         return future
 
     def _batch_loop(self) -> None:
@@ -582,12 +784,21 @@ class ServedModel:
                 self._stat_rows = 0
                 self._stat_shed = 0
                 self._stat_expired = 0
-        return {"batches": batches, "rows": rows,
-                "mean_fill": round(rows / batches, 3) if batches else 0.0,
-                "shed": shed, "expired": expired,
-                "queue_depth": self._queue.size(),
-                "est_batch_latency_ms": round(
-                    self._latency.estimate_s() * 1e3, 3)}
+        stats = {"batches": batches, "rows": rows,
+                 "mean_fill": round(rows / batches, 3) if batches else 0.0,
+                 "shed": shed, "expired": expired,
+                 "queue_depth": self._queue.size(),
+                 "est_batch_latency_ms": round(
+                     self._latency.estimate_s() * 1e3, 3)}
+        if self.continuous_batching:
+            # Slot-engine saturation signals ride the same healthz
+            # payload (slot occupancy is the autoscaler-facing number
+            # for decode-bound fleets).
+            default = self.get_resident()
+            engine = default.engine if default is not None else None
+            if engine is not None:
+                stats["engine"] = engine.stats()
+        return stats
 
     def _run_group(self, sig_name, method, version, group,
                    t_pop: Optional[float] = None) -> None:
@@ -715,13 +926,15 @@ class ModelManager:
                   max_batch: int = 64,
                   version_policy: str = "latest",
                   queue_capacity: int = 4096,
+                  continuous_batching: bool = False,
                   initial_poll: bool = True) -> ServedModel:
         """Register a model. With ``initial_poll=False`` the (slow)
         first version load is deferred to the poll thread so a server
         can open its port immediately and report 503-until-loaded."""
         model = ServedModel(name, base_path, max_batch=max_batch,
                             version_policy=version_policy,
-                            queue_capacity=queue_capacity)
+                            queue_capacity=queue_capacity,
+                            continuous_batching=continuous_batching)
         if initial_poll and not model.poll_versions():
             logger.warning("model %s: no versions found yet under %s",
                            name, base_path)

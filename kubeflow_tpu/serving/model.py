@@ -73,6 +73,11 @@ class LoadedModel:
         self._predict_cache: Dict[Tuple[str, int], Any] = {}
         self._gen_counter = 0  # per-request rng fold for sampling
         self._gen_lock = threading.Lock()
+        # Continuous-batching decode engine (inference/engine/): built
+        # on demand by ensure_engine() for generate-method models
+        # served with continuous batching; None otherwise.
+        self._engine = None
+        self._engine_lock = threading.Lock()
         # Post-compile execution time of one full max_batch bucket,
         # measured by warmup(); ServedModel seeds its admission-control
         # latency estimate from it. None until warmup runs.
@@ -165,14 +170,14 @@ class LoadedModel:
         """Prompt-length bucket: the export's ``prompt_buckets`` list
         when present, else powers of two — either way capped at the
         signature max, so the compile count stays bounded however many
-        distinct prompt lengths traffic brings."""
-        buckets = self.metadata.generate_config.get("prompt_buckets")
-        if buckets:
-            for b in sorted(int(v) for v in buckets):
-                if b >= n:
-                    return min(b, max_len)
-            return max_len
-        return _bucket(n, max_len)  # same pow-2-capped policy as rows
+        distinct prompt lengths traffic brings. One shared policy
+        (``generate.prompt_bucket``) with the decode engine, so the
+        widths they compile can never drift apart."""
+        from kubeflow_tpu.inference.generate import prompt_bucket
+
+        return prompt_bucket(
+            n, max_len,
+            self.metadata.generate_config.get("prompt_buckets"))
 
     def request_rngs(self, n: int) -> np.ndarray:
         """Per-row sampling keys ``[n, 2]`` for one request's rows:
@@ -191,6 +196,53 @@ class LoadedModel:
             base = jax.random.fold_in(base, counter)
         return np.asarray(
             jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(n)))
+
+    def ensure_engine(self, name: Optional[str] = None,
+                      queue_capacity: Optional[int] = None):
+        """The version's continuous-batching decode engine
+        (inference/engine/ — slot-based decode loop + paged KV cache),
+        built once per LoadedModel. Generate-method signatures only:
+        the engine IS a decode loop, there is nothing for it to run
+        for predict/classify exports. Capacity knobs ride the export's
+        ``generate_config`` (``engine_slots`` / ``engine_page_size`` /
+        ``engine_slice_tokens`` / ``engine_num_pages`` — see
+        docs/streaming.md)."""
+        with self._engine_lock:
+            if self._engine is not None:
+                return self._engine
+            sig = self.signature()
+            if sig.method != "generate":
+                raise ValueError(
+                    f"model {self.metadata.model_name!r} has a "
+                    f"{sig.method!r} signature; the decode engine "
+                    f"serves generate-method exports only")
+            from kubeflow_tpu.inference.engine import (
+                DecodeEngine,
+                EngineConfig,
+            )
+
+            (_, spec), = sig.inputs.items()
+            config = EngineConfig.from_generate_config(
+                self.metadata.generate_config, spec.shape[1],
+                queue_capacity=queue_capacity)
+            self._engine = DecodeEngine(
+                self._module, self.variables["params"], config,
+                name=name or self.metadata.model_name)
+            return self._engine
+
+    @property
+    def engine(self):
+        """The built engine or None (never builds)."""
+        return self._engine
+
+    def close(self) -> None:
+        """Release background resources (the decode engine's thread
+        and page pool). Idempotent; called on version eviction and
+        server shutdown."""
+        with self._engine_lock:
+            engine, self._engine = self._engine, None
+        if engine is not None:
+            engine.stop()
 
     def run(self, inputs: Dict[str, np.ndarray],
             signature_name: Optional[str] = None,

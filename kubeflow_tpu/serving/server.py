@@ -50,6 +50,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 import tornado.ioloop
+import tornado.iostream
 import tornado.web
 
 from kubeflow_tpu.obs.exposition import (
@@ -189,6 +190,16 @@ async def _await_future(future, wait_s: float):
 class InferHandler(BaseHandler):
     _obs_span = "http_request"
 
+    def initialize(self):
+        self._live_streams = []
+
+    def on_connection_close(self):
+        # A streaming client hung up mid-decode: cancel so the engine
+        # retires the slot(s) at the next slice boundary instead of
+        # decoding into a dead socket until the token budget runs out.
+        for stream in self._live_streams:
+            stream.cancel()
+
     async def post(self, name: str, version: Optional[str], verb: str):
         self._obs_model = name
         try:
@@ -198,6 +209,13 @@ class InferHandler(BaseHandler):
             if instances is None:
                 return self.write_json(
                     {"error": "request body needs 'instances'"}, 400)
+            wants_stream = bool(body.get("stream")) or (
+                "text/event-stream"
+                in self.request.headers.get("Accept", ""))
+            if wants_stream and verb != "generate":
+                return self.write_json(
+                    {"error": f"streaming applies to :generate only, "
+                              f"not :{verb}"}, 400)
             deadline = overload.request_deadline(self.request.headers,
                                                  body)
             want = int(version) if version else None
@@ -228,6 +246,10 @@ class InferHandler(BaseHandler):
             sig = loaded.signature(sig_name)
             input_name = next(iter(sig.inputs))
             batch = _instances_to_batch(instances, input_name)
+            if wants_stream:
+                return await self._stream_generate(
+                    name, model, loaded, {input_name: batch},
+                    sig_name, want, body, deadline)
             future = model.submit({input_name: batch}, sig_name, verb,
                                   want, deadline=deadline,
                                   obs_ctx=self._obs_ctx)
@@ -271,6 +293,100 @@ class InferHandler(BaseHandler):
             # clients and the gateway retry with backoff instead of
             # treating it as a bad request.
             self.write_json({"error": str(e)}, 503)
+
+    async def _stream_generate(self, name, model, loaded, inputs,
+                               sig_name, version, body, deadline):
+        """SSE token streaming over the continuous-batching engine.
+
+        Wire (serving/wire.py SSE codec; docs/streaming.md):
+        ``token`` events as each token is sampled ({row, index,
+        token}), ``error`` per failed row ({row, error, code}), one
+        terminal ``done`` ({model_spec, tokens: [per-row array or
+        null]}). Events flush per engine slice, so time-to-first-token
+        is prefill + one slice, not the whole decode. The engine's
+        notify hook schedules drains on the IOLoop; awaiting flush()
+        keeps slow clients back-pressured instead of buffered."""
+        import asyncio
+
+        from kubeflow_tpu.serving import wire
+
+        max_new = body.get("max_new_tokens")
+        if max_new is not None:
+            max_new = int(max_new)
+        _, streams = model.submit_stream(
+            inputs, sig_name, version, deadline=deadline,
+            obs_ctx=self._obs_ctx, max_new_tokens=max_new)
+        self._live_streams = streams
+        self.set_header("Content-Type", wire.SSE_CONTENT_TYPE)
+        self.set_header("Cache-Control", "no-cache")
+        self.set_header("X-Accel-Buffering", "no")  # proxies: no buffer
+        loop = tornado.ioloop.IOLoop.current()
+        signal = asyncio.Event()
+
+        def notify():  # engine thread → IOLoop
+            loop.add_callback(signal.set)
+
+        for s in streams:
+            s.set_notify(notify)
+        finished = [False] * len(streams)
+        results: list = [None] * len(streams)
+        try:
+            while not all(finished):
+                signal.clear()
+                wrote = False
+                for r, s in enumerate(streams):
+                    for ev in s.drain():
+                        wrote = True
+                        if ev.final:
+                            finished[r] = True
+                            if ev.error is not None:
+                                self.write(wire.format_sse_event(
+                                    {"row": r, "error": str(ev.error),
+                                     "code": _stream_error_code(
+                                         ev.error)},
+                                    event="error"))
+                            else:
+                                results[r] = s.result(
+                                    timeout=1.0).tolist()
+                        else:
+                            self.write(wire.format_sse_event(
+                                {"row": r, "index": ev.index,
+                                 "token": ev.token}, event="token"))
+                if wrote:
+                    await self.flush()
+                if all(finished):
+                    break
+                try:
+                    await asyncio.wait_for(
+                        signal.wait(),
+                        overload.clamp_wait_s(deadline,
+                                              DEFAULT_INFER_WAIT_S))
+                except asyncio.TimeoutError:
+                    for s in streams:
+                        s.cancel()
+                    self._obs_outcome = "expired"
+                    self.write(wire.format_sse_event(
+                        {"error": "stream timed out awaiting the "
+                                  "engine",
+                         "code": "DEADLINE_EXCEEDED"}, event="error"))
+                    break
+            self.write(wire.format_sse_event(
+                {"model_spec": {"name": name,
+                                "version": str(loaded.version)},
+                 "tokens": results}, event="done"))
+            await self.flush()
+            self.finish()
+        except tornado.iostream.StreamClosedError:
+            for s in streams:
+                s.cancel()
+
+
+def _stream_error_code(error: BaseException) -> str:
+    if isinstance(error, overload.DeadlineExceededError):
+        return "DEADLINE_EXCEEDED"
+    if isinstance(error, overload.OverloadedError):
+        return "RESOURCE_EXHAUSTED"
+    return "INTERNAL"
 
 
 def _instances_to_batch(instances: Any, input_name: str) -> np.ndarray:
@@ -443,7 +559,8 @@ def load_model_config(path: str):
             raise ValueError(
                 f"model config entry {i} missing {sorted(missing)}")
         unknown = set(entry) - {"name", "base_path", "max_batch",
-                                "version_policy"}
+                                "version_policy",
+                                "continuous_batching"}
         if unknown:
             raise ValueError(
                 f"model config entry {i} has unknown keys "
@@ -468,6 +585,13 @@ def main(argv=None) -> int:
                              " — multi-model serving (TF-Serving's "
                              "--model_config_file role)")
     parser.add_argument("--max_batch", type=int, default=64)
+    parser.add_argument("--continuous_batching", action="store_true",
+                        help="serve generate-method models through "
+                             "the slot-based decode engine "
+                             "(inference/engine/): requests join and "
+                             "retire mid-decode, and ?stream/SSE + "
+                             "gRPC GenerateStream token streaming "
+                             "become available (docs/streaming.md)")
     parser.add_argument("--version_policy", default="latest",
                         help="latest | all | specific:<v>[,<v>...] — "
                              "which version dirs to serve (TF-Serving "
@@ -511,6 +635,9 @@ def main(argv=None) -> int:
                                                   args.max_batch)),
                           version_policy=entry.get("version_policy",
                                                    args.version_policy),
+                          continuous_batching=bool(entry.get(
+                              "continuous_batching",
+                              args.continuous_batching)),
                           initial_poll=False)
     from kubeflow_tpu.serving.grpc_server import make_server
 

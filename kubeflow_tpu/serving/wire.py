@@ -723,3 +723,66 @@ def trailers_frame(status: int = 0, message: str = "") -> bytes:
     if message:
         text += f"grpc-message:{message}\r\n"
     return frame_message(text.encode(), trailers=True)
+
+
+# --- Server-sent events (SSE) -----------------------------------------------
+#
+# The REST streaming-generate wire (WHATWG EventSource framing): each
+# event is an optional ``event:`` line, one ``data:`` line of JSON,
+# and a blank terminator. Used by serving/server.py (producer),
+# http_proxy.py (chunk passthrough) and serving/client.py --stream
+# (consumer); tests/test_streaming_wire.py pins the framing.
+
+SSE_CONTENT_TYPE = "text/event-stream"
+
+#: Streaming-generate event names: ``token`` (one sampled token),
+#: ``error`` (a row failed mid-stream; carries ``code``), ``done``
+#: (terminal; carries the per-row token arrays).
+SSE_EVENTS = ("token", "error", "done")
+
+
+def format_sse_event(data, event: Optional[str] = None) -> bytes:
+    """One SSE frame. ``data`` is JSON-encoded onto a single ``data:``
+    line (json.dumps never emits raw newlines, which would otherwise
+    split the frame)."""
+    import json
+
+    out = b""
+    if event:
+        if any(c in event for c in "\r\n"):
+            raise ValueError(f"SSE event name {event!r} contains a "
+                             f"newline")
+        out += f"event: {event}\n".encode()
+    out += b"data: " + json.dumps(data).encode() + b"\n\n"
+    return out
+
+
+def iter_sse_events(line_iter) -> Iterator[Tuple[str, dict]]:
+    """Parse an SSE byte-line stream → (event_name, data) pairs.
+    ``line_iter`` yields ``bytes`` lines (an ``http.client``
+    response works directly); event name defaults to ``message`` per
+    the EventSource spec. Multi-``data:``-line events are joined with
+    newlines before JSON decoding."""
+    import json
+
+    event = None
+    data_lines: List[str] = []
+    for raw in line_iter:
+        line = raw.decode("utf-8", errors="replace").rstrip("\r\n")
+        if line.startswith(":"):
+            continue  # comment / keep-alive
+        if line == "":
+            if data_lines:
+                yield (event or "message",
+                       json.loads("\n".join(data_lines)))
+            event = None
+            data_lines = []
+            continue
+        key, _, value = line.partition(":")
+        value = value[1:] if value.startswith(" ") else value
+        if key == "event":
+            event = value
+        elif key == "data":
+            data_lines.append(value)
+    if data_lines:  # stream ended without the trailing blank line
+        yield (event or "message", json.loads("\n".join(data_lines)))

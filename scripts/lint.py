@@ -209,11 +209,17 @@ def check_operator_wait_discipline() -> list:
 
     Scaling half (ISSUE 5): the same rules under
     ``kubeflow_tpu/scaling/`` (the prober and autoscaler loop), PLUS
-    (c) ``.wait()`` with no timeout — an unbounded wait wedges the
-    control loop forever on one lost wakeup — and (d) any
-    ``time.time()`` call: control timing must ride monotonic clocks
-    (an NTP step must never fire a cooldown early or freeze a probe
-    schedule)."""
+    (c) ``.wait()``/``.wait_for()`` with no timeout — an unbounded
+    wait wedges the control loop forever on one lost wakeup — and (d)
+    any ``time.time()`` call: control timing must ride monotonic
+    clocks (an NTP step must never fire a cooldown early or freeze a
+    probe schedule).
+
+    Engine half (ISSUE 6): the strict rules again under
+    ``kubeflow_tpu/inference/engine/`` — the decode loop IS a control
+    loop (slice cadence, deadline expiry, stream notify), and a
+    single unbounded condition wait there stalls every streaming
+    client at once."""
     # Exempt: the operator's sanctioned wait path; the fault injector
     # (whose time.sleep IS the injected apiserver latency); and the
     # load-bench drivers (their sleeps pace the measurement harness,
@@ -221,6 +227,7 @@ def check_operator_wait_discipline() -> list:
     dirs = [
         ("operator", {"workqueue.py", "fake.py", "benchmark.py"}, False),
         ("scaling", {"benchmark.py"}, True),
+        ("inference/engine", set(), True),
     ]
     errors = []
     for sub, exempt, strict in dirs:
@@ -257,14 +264,19 @@ def check_operator_wait_discipline() -> list:
                         f"{node.lineno}: .wait() inside an except "
                         f"handler is a flat retry loop — use "
                         f"ExponentialBackoff/WorkQueue instead")
-                elif (strict and func.attr == "wait"
-                      and not node.args
+                elif (strict and func.attr in ("wait", "wait_for")
+                      # wait(timeout) / wait_for(pred, timeout): bound
+                      # may ride the last positional slot instead of
+                      # the keyword.
+                      and len(node.args) < (
+                          2 if func.attr == "wait_for" else 1)
                       and not any(k.arg == "timeout"
                                   for k in node.keywords)):
                     errors.append(
                         f"operator-wait: {f.relative_to(REPO)}:"
-                        f"{node.lineno}: unbounded .wait() — every "
-                        f"scaling-loop wait must carry a timeout")
+                        f"{node.lineno}: unbounded .{func.attr}() — "
+                        f"every control-loop wait must carry a "
+                        f"timeout")
                 elif strict and func.attr == "time" and is_time_attr:
                     errors.append(
                         f"operator-wait: {f.relative_to(REPO)}:"

@@ -181,6 +181,8 @@ class _Handler(BaseHTTPRequestHandler):
     def _watch(self, kind, ns, query):
         version = int(query.get("resourceVersion", ["0"])[0] or 0)
         timeout = float(query.get("timeoutSeconds", ["5"])[0])
+        bookmarks = query.get("allowWatchBookmarks",
+                              ["false"])[0] in ("1", "true")
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.end_headers()
@@ -192,12 +194,18 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             for event_type, obj in self.fake.watch(
                     kind, ns, resource_version=version, timeout=timeout,
-                    label_selector=_parse_selector(query)):
+                    label_selector=_parse_selector(query),
+                    allow_bookmarks=bookmarks):
                 emit({"type": event_type, "object": obj})
         except Gone as err:
+            # Byte-for-byte the real apiserver's expired-watch frame: a
+            # v1 Status with status/reason/code, NOT a bare code — the
+            # controller's resume-point taxonomy keys off this shape.
             emit({"type": "ERROR",
-                  "object": {"kind": "Status", "code": 410,
-                             "message": str(err)}})
+                  "object": {"kind": "Status", "apiVersion": "v1",
+                             "metadata": {}, "status": "Failure",
+                             "message": str(err), "reason": "Expired",
+                             "code": 410}})
         except TooManyRequests as err:
             # Injected throttle mid-stream: headers are already out,
             # so the 429 rides the stream as an ERROR event (the

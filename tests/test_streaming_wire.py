@@ -1,0 +1,520 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Token-streaming wire conformance (docs/streaming.md).
+
+Three layers: the SSE codec itself (framing pinned byte-for-byte),
+the router hop (chunk-by-chunk relay proven with a GATED upstream —
+a buffering proxy deadlocks the test instead of passing it), and the
+full stack over a real model (SSE grammar, REST/gRPC stream payloads
+equal to the unary response, per-request budgets, client helpers).
+"""
+
+import asyncio
+import http.client
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving import wire
+
+# -- SSE codec ------------------------------------------------------------
+
+
+def test_sse_event_framing_is_pinned():
+    assert wire.format_sse_event({"a": 1}) == b'data: {"a": 1}\n\n'
+    assert wire.format_sse_event({"t": 5}, event="token") == \
+        b'event: token\ndata: {"t": 5}\n\n'
+    with pytest.raises(ValueError, match="newline"):
+        wire.format_sse_event({}, event="to\nken")
+
+
+def test_sse_json_newlines_stay_on_one_data_line():
+    """json.dumps escapes raw newlines, so any payload stays a single
+    data: line — a split frame would desync every consumer."""
+    frame = wire.format_sse_event({"s": "a\nb\r\nc"})
+    assert frame.count(b"\n") == 2  # data line + terminator
+    ((_, data),) = wire.iter_sse_events(frame.splitlines(True))
+    assert data["s"] == "a\nb\r\nc"
+
+
+def test_sse_parser_roundtrip_and_spec_corners():
+    lines = [
+        b": keep-alive comment\n",
+        b"event: token\n",
+        b'data: {"token": 3}\n',
+        b"\n",
+        b'data: {"plain": true}\n',
+        b"\n",
+        b"event: done\n",
+        b'data: {"tokens": [[1]]}\n',  # no trailing blank: EOF flush
+    ]
+    events = list(wire.iter_sse_events(iter(lines)))
+    assert events == [("token", {"token": 3}),
+                      ("message", {"plain": True}),  # default name
+                      ("done", {"tokens": [[1]]})]
+
+
+def test_sse_parser_joins_multi_data_lines():
+    lines = [b"data: [1,\n", b"data: 2]\n", b"\n"]
+    assert list(wire.iter_sse_events(iter(lines))) == \
+        [("message", [1, 2])]
+
+
+def test_sse_event_names_catalog():
+    assert wire.SSE_EVENTS == ("token", "error", "done")
+    assert wire.SSE_CONTENT_TYPE == "text/event-stream"
+
+
+# -- the router hop: chunk-by-chunk relay, proven with a gated upstream ---
+
+
+class _GatedUpstream:
+    """A fake model-server REST upstream whose SSE body is emitted in
+    test-controlled phases: event 0 flushes immediately; the rest only
+    after the test calls release(). A proxy that buffers the full
+    response can never hand the first event to the client before
+    release() — and the test reads the first event BEFORE releasing,
+    so buffering means deadlock-until-timeout, not a silent pass."""
+
+    def __init__(self, fail_after_first: bool = False):
+        import tornado.web
+
+        self.released = asyncio.Event()
+        self.fail_after_first = fail_after_first
+        self.started = threading.Event()
+        self.port = None
+        self.loop = None
+        outer = self
+
+        class Handler(tornado.web.RequestHandler):
+            async def post(self, name):
+                self.set_header("Content-Type",
+                                wire.SSE_CONTENT_TYPE)
+                self.write(wire.format_sse_event(
+                    {"row": 0, "index": 0, "token": 41},
+                    event="token"))
+                await self.flush()
+                if outer.fail_after_first:
+                    # Abort mid-chunked-stream: the relay must report
+                    # the break in-band, not hang or 500 after bytes
+                    # already reached the client.
+                    self.request.connection.stream.close()
+                    return
+                await outer.released.wait()
+                self.write(wire.format_sse_event(
+                    {"row": 0, "index": 1, "token": 42},
+                    event="token"))
+                self.write(wire.format_sse_event(
+                    {"model_spec": {"name": name, "version": "1"},
+                     "tokens": [[41, 42]]}, event="done"))
+                await self.flush()
+                self.finish()
+
+        self._handler = Handler
+
+    def __enter__(self):
+        import tornado.ioloop
+        import tornado.web
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            app = tornado.web.Application([
+                (r"/v1/models/([^/:]+):generate", self._handler),
+            ])
+            server = app.listen(0)
+            self.port = next(iter(
+                server._sockets.values())).getsockname()[1]
+            self.loop = tornado.ioloop.IOLoop.current()
+            self.started.set()
+            self.loop.start()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        assert self.started.wait(15)
+        return self
+
+    def release(self):
+        self.loop.add_callback(self.released.set)
+
+    def __exit__(self, *exc):
+        self.loop.add_callback(self.loop.stop)
+        self._thread.join(timeout=10)
+
+
+def _start_proxy(upstream_port):
+    from kubeflow_tpu.serving.http_proxy import make_app
+
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        import tornado.ioloop
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        app = make_app(rpc_address=f"127.0.0.1:{upstream_port}")
+        server = app.listen(0)
+        holder["port"] = next(iter(
+            server._sockets.values())).getsockname()[1]
+        holder["loop"] = tornado.ioloop.IOLoop.current()
+        started.set()
+        holder["loop"].start()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(15)
+    holder["thread"] = t
+    return holder
+
+
+def _stop_proxy(holder):
+    holder["loop"].add_callback(holder["loop"].stop)
+    holder["thread"].join(timeout=10)
+
+
+def _open_stream(port, model="fake", timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    conn.request("POST", f"/model/{model}:generate",
+                 body=json.dumps({"instances": [[1, 2]],
+                                  "stream": True}),
+                 headers={"Content-Type": "application/json"})
+    return conn, conn.getresponse()
+
+
+def _read_one_event(resp):
+    """Read exactly one SSE frame off the live socket (blocking reads
+    bounded by the socket timeout)."""
+    lines = []
+    while True:
+        line = resp.readline()
+        if not line:
+            raise AssertionError("stream closed mid-frame")
+        lines.append(line)
+        if line in (b"\n", b"\r\n"):
+            return next(wire.iter_sse_events(iter(lines)))
+
+
+def test_proxy_relays_stream_chunk_by_chunk_not_buffered():
+    with _GatedUpstream() as upstream:
+        proxy = _start_proxy(upstream.port)
+        try:
+            conn, resp = _open_stream(proxy["port"])
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                wire.SSE_CONTENT_TYPE)
+            # First token crosses the hop while the upstream response
+            # is still OPEN — time-to-first-token survives the router.
+            event, data = _read_one_event(resp)
+            assert (event, data["token"]) == ("token", 41)
+            upstream.release()  # only now may the rest exist at all
+            rest = list(wire.iter_sse_events(resp))
+            conn.close()
+            assert [e for e, _ in rest] == ["token", "done"]
+            assert rest[-1][1]["tokens"] == [[41, 42]]
+        finally:
+            _stop_proxy(proxy)
+
+
+def test_proxy_reports_mid_stream_upstream_failure_in_band():
+    """Once bytes have been relayed the proxy cannot unsend them: an
+    upstream that dies mid-stream must surface as a terminal SSE
+    ``error`` event (code UNAVAILABLE) on the SAME stream, never as a
+    hang or a late status rewrite."""
+    with _GatedUpstream(fail_after_first=True) as upstream:
+        proxy = _start_proxy(upstream.port)
+        try:
+            conn, resp = _open_stream(proxy["port"])
+            events = list(wire.iter_sse_events(resp))
+            conn.close()
+            assert events[0] == ("token",
+                                 {"row": 0, "index": 0, "token": 41})
+            assert events[-1][0] == "error"
+            assert events[-1][1]["code"] == "UNAVAILABLE"
+        finally:
+            _stop_proxy(proxy)
+
+
+# -- full stack over a real model -----------------------------------------
+
+PROMPT_LEN = 8
+NEW_TOKENS = 6
+CACHE = 32
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    """Export a tiny generate model and stand up the whole transport
+    chain: ModelManager (continuous batching) + REST server + gRPC
+    server + pooled proxy, each on a real socket."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.llama import llama_test
+    from kubeflow_tpu.serving.export import export_model
+    from kubeflow_tpu.serving.grpc_server import make_server
+    from kubeflow_tpu.serving.manager import ModelManager
+    from kubeflow_tpu.serving.signature import (
+        ModelMetadata,
+        Signature,
+        TensorSpec,
+    )
+
+    base = tmp_path_factory.mktemp("stream") / "m"
+    model = llama_test(dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, PROMPT_LEN), jnp.int32))
+    meta = ModelMetadata(
+        model_name="m", registry_name="llama-test",
+        model_kwargs={"dtype": "float32", "cache_size": CACHE},
+        signatures={"serving_default": Signature(
+            method="generate",
+            inputs={"input_ids": TensorSpec("int32",
+                                            (-1, PROMPT_LEN))},
+            outputs={"tokens": TensorSpec("int32",
+                                          (-1, NEW_TOKENS))})},
+        generate_config={"max_new_tokens": NEW_TOKENS,
+                         "temperature": 0.0,
+                         "engine_slots": 2, "engine_page_size": 8,
+                         "engine_slice_tokens": 2})
+    export_model(str(base), 1, meta, {"params": variables["params"]})
+
+    mgr = ModelManager(poll_interval_s=3600)
+    mgr.add_model("m", str(base), max_batch=8,
+                  continuous_batching=True)
+
+    def serve(app_factory, holder, started):
+        import tornado.ioloop
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        server = app_factory().listen(0)
+        holder["port"] = next(iter(
+            server._sockets.values())).getsockname()[1]
+        holder["loop"] = tornado.ioloop.IOLoop.current()
+        started.set()
+        holder["loop"].start()
+
+    from kubeflow_tpu.serving.server import make_app as rest_app
+
+    rest, rest_started = {}, threading.Event()
+    threading.Thread(target=serve, args=(lambda: rest_app(mgr), rest,
+                                         rest_started),
+                     daemon=True).start()
+    assert rest_started.wait(60)
+
+    gsrv, gport = make_server(mgr, 0)
+    gsrv.start()
+
+    from kubeflow_tpu.serving.http_proxy import make_app as proxy_app
+
+    proxy, proxy_started = {}, threading.Event()
+    threading.Thread(
+        target=serve,
+        args=(lambda: proxy_app(
+            rpc_address=f"127.0.0.1:{rest['port']}",
+            grpc_address=f"127.0.0.1:{gport}"), proxy, proxy_started),
+        daemon=True).start()
+    assert proxy_started.wait(60)
+
+    yield {"rest": rest["port"], "grpc": gport,
+           "proxy": proxy["port"], "manager": mgr}
+
+    proxy["loop"].add_callback(proxy["loop"].stop)
+    rest["loop"].add_callback(rest["loop"].stop)
+    gsrv.stop(grace=1)
+    mgr.stop()
+
+
+def _unary_tokens(port, prompt_rows):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/m:generate",
+        data=json.dumps({"instances": prompt_rows}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        body = json.load(r)
+    return [p["tokens"] for p in body["predictions"]]
+
+
+def _prompt_rows(n, seed=1):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 512, (n, PROMPT_LEN)).tolist()
+
+
+def test_sse_stream_grammar_and_unary_equality(stack):
+    """Wire conformance against the live engine: the event stream is
+    token* error* done (one terminal done, token indexes strictly
+    sequential per row), and the streamed tokens reassemble into
+    exactly the unary :generate answer."""
+    rows = _prompt_rows(2)
+    ref = _unary_tokens(stack["rest"], rows)
+
+    conn = http.client.HTTPConnection("127.0.0.1", stack["rest"],
+                                      timeout=120)
+    conn.request("POST", "/v1/models/m:generate",
+                 body=json.dumps({"instances": rows, "stream": True}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.headers["Content-Type"].startswith(
+        wire.SSE_CONTENT_TYPE)
+    events = list(wire.iter_sse_events(resp))
+    conn.close()
+
+    assert [e for e, _ in events if e == "done"] == ["done"]
+    assert events[-1][0] == "done", "done must terminate the stream"
+    per_row = {0: [], 1: []}
+    for event, data in events[:-1]:
+        assert event == "token", f"unexpected event {event}"
+        assert data["index"] == len(per_row[data["row"]]), \
+            "token indexes must be per-row sequential"
+        per_row[data["row"]].append(data["token"])
+    done = events[-1][1]
+    assert done["model_spec"]["name"] == "m"
+    for r in (0, 1):
+        assert per_row[r] == ref[r], \
+            f"row {r}: streamed tokens != unary response"
+        assert done["tokens"][r] == ref[r]
+
+
+def test_streaming_requires_generate_verb(stack):
+    conn = http.client.HTTPConnection("127.0.0.1", stack["rest"],
+                                      timeout=30)
+    conn.request("POST", "/v1/models/m:predict",
+                 body=json.dumps({"instances": _prompt_rows(1),
+                                  "stream": True}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 400
+    assert ":generate" in body["error"]
+
+
+def test_accept_header_negotiates_streaming(stack):
+    """Accept: text/event-stream alone (no body flag) selects SSE —
+    the EventSource-style client contract."""
+    conn = http.client.HTTPConnection("127.0.0.1", stack["rest"],
+                                      timeout=120)
+    conn.request("POST", "/v1/models/m:generate",
+                 body=json.dumps({"instances": _prompt_rows(1)}),
+                 headers={"Content-Type": "application/json",
+                          "Accept": wire.SSE_CONTENT_TYPE})
+    resp = conn.getresponse()
+    assert resp.headers["Content-Type"].startswith(
+        wire.SSE_CONTENT_TYPE)
+    events = list(wire.iter_sse_events(resp))
+    conn.close()
+    assert events[-1][0] == "done"
+
+
+def test_client_helper_streams_through_proxy(stack):
+    """serving.client.stream_generate through the pooled proxy: the
+    public consumer sees the same tokens the backend decoded, and the
+    done frame carries the full arrays."""
+    from kubeflow_tpu.serving import client as kclient
+
+    rows = _prompt_rows(1, seed=5)
+    ref = _unary_tokens(stack["rest"], rows)
+    got, done = [], None
+    for event, data in kclient.stream_generate(
+            f"127.0.0.1:{stack['proxy']}", "m", rows):
+        if event == "token":
+            got.append(data["token"])
+        elif event == "done":
+            done = data
+    assert got == ref[0]
+    assert done["tokens"][0] == ref[0]
+
+
+def test_per_request_max_new_tokens_truncates_stream(stack):
+    from kubeflow_tpu.serving import client as kclient
+
+    rows = _prompt_rows(1, seed=9)
+    ref = _unary_tokens(stack["rest"], rows)
+    got = []
+    for event, data in kclient.stream_generate(
+            f"127.0.0.1:{stack['proxy']}", "m", rows,
+            max_new_tokens=3):
+        if event == "token":
+            got.append(data["token"])
+        elif event == "done":
+            assert data["tokens"][0] == ref[0][:3]
+    assert got == ref[0][:3], \
+        "a 3-token budget must retire the slot after 3 tokens"
+
+
+def test_grpc_generate_stream_matches_unary(stack):
+    from kubeflow_tpu.serving import client as kclient
+
+    rows = _prompt_rows(2, seed=13)
+    ref = _unary_tokens(stack["rest"], rows)
+    per_row = {0: [], 1: []}
+    final = None
+    for event, data in kclient.grpc_generate_stream(
+            f"127.0.0.1:{stack['grpc']}", "m", {"input_ids": rows},
+            timeout=120):
+        if event == "token":
+            assert data["index"] == len(per_row[data["row"]])
+            per_row[data["row"]].append(data["token"])
+        else:
+            final = data
+    for r in (0, 1):
+        assert per_row[r] == ref[r]
+        assert final["tokens"][r] == ref[r]
+
+
+def test_tokens_arrive_incrementally_not_at_once(stack):
+    """The slice cadence is observable on the wire: with
+    engine_slice_tokens=2 and 6 tokens, the frames cannot all arrive
+    in one flush — there must be at least two distinct socket reads'
+    worth of data (the buffered alternative delivers everything with
+    the done frame)."""
+    rows = _prompt_rows(1, seed=17)
+    conn = http.client.HTTPConnection("127.0.0.1", stack["rest"],
+                                      timeout=120)
+    conn.request("POST", "/v1/models/m:generate",
+                 body=json.dumps({"instances": rows, "stream": True}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    arrivals = []
+    events = []
+    while True:
+        lines = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            lines.append(line)
+            if line in (b"\n", b"\r\n"):
+                break
+        if not lines:
+            break
+        arrivals.append(time.monotonic())
+        got = list(wire.iter_sse_events(iter(lines)))
+        events.extend(got)
+        if got and got[-1][0] == "done":
+            break
+    conn.close()
+    tokens = [d["token"] for e, d in events if e == "token"]
+    assert len(tokens) == NEW_TOKENS
+    # First token must land strictly before the last frame: streaming,
+    # not one terminal buffer flush. (Time-based but generous: the
+    # engine decodes 3 slices; a buffered path has zero gap.)
+    assert arrivals[-1] - arrivals[0] > 0.0005, \
+        "all frames arrived in one flush — stream was buffered"

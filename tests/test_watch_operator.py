@@ -291,8 +291,9 @@ def test_http_client_watch_stream_and_gone():
         client = HttpApiClient(srv.url)
         client.create(make_job(name="wjob", workers=1))
         events = list(client.watch(KIND, "default", timeout=1))
-        assert [(t, o["metadata"]["name"]) for t, o in events] == \
-            [("ADDED", "wjob")]
+        # The idle-timeout BOOKMARK rides last (resume-point refresh).
+        assert [(t, o["metadata"]["name"]) for t, o in events
+                if t != "BOOKMARK"] == [("ADDED", "wjob")]
         # Compacted resume point → Gone surfaced from the ERROR event.
         srv.fake.EVENT_WINDOW = 1
         for i in range(4):
@@ -301,6 +302,116 @@ def test_http_client_watch_stream_and_gone():
         with pytest.raises(Gone):
             list(client.watch("Pod", "default", resource_version=1,
                               timeout=1))
+
+
+def test_http_watch_emits_bookmark_frames():
+    """An idle watch with allowWatchBookmarks (which HttpApiClient
+    always sends) must end with a BOOKMARK frame whose only payload is
+    the store-head resourceVersion — the resume-point refresh that
+    keeps a quiet watcher from aging into a 410."""
+    with HttpFakeApiServer() as srv:
+        client = HttpApiClient(srv.url)
+        client.create(make_job(name="bmk", workers=1))
+        events = list(client.watch(KIND, "default", timeout=1))
+        assert events, "expected at least the ADDED event"
+        assert events[0][0] == "ADDED"
+        event_type, obj = events[-1]
+        assert event_type == "BOOKMARK"
+        assert int(obj["metadata"]["resourceVersion"]) == \
+            srv.fake.current_revision()
+        # Only a resume point rides a bookmark — no object payload.
+        assert "name" not in obj["metadata"]
+        assert "spec" not in obj
+
+
+def test_http_watch_410_error_object_is_real_shaped():
+    """The expired-watch ERROR frame must carry a real v1 Status
+    (status/reason/code), byte-compatible with what a genuine
+    apiserver emits — not a bare {code: 410} stub."""
+    with HttpFakeApiServer() as srv:
+        srv.fake.EVENT_WINDOW = 1
+        for i in range(4):
+            srv.fake.create({"kind": "Pod", "metadata": {
+                "name": f"p{i}", "namespace": "default"}})
+        client = HttpApiClient(srv.url)
+        url = (client._path("Pod", "default")
+               + "?watch=1&resourceVersion=1&timeoutSeconds=1"
+               + "&allowWatchBookmarks=true")
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            frame = json.loads(resp.readline())
+        assert frame["type"] == "ERROR"
+        status = frame["object"]
+        assert status["kind"] == "Status"
+        assert status["apiVersion"] == "v1"
+        assert status["status"] == "Failure"
+        assert status["reason"] == "Expired"
+        assert status["code"] == 410
+        assert "compacted" in status["message"]
+        # And the client maps that frame back onto the Gone taxonomy.
+        with pytest.raises(Gone):
+            list(client.watch("Pod", "default", resource_version=1,
+                              timeout=1))
+
+
+def test_watch_controller_bookmarks_refresh_resume_point(
+        controller_on):
+    """The controller's BOOKMARK special-case, finally executed end to
+    end: unrelated churn compacts the event window while the
+    controller's watches idle, and the bookmark-refreshed resume point
+    keeps every re-watch inside the window — zero 410s, zero relists
+    from Gone. (Contrast: the direct-fake test below runs the same
+    churn without bookmarks and MUST go Gone.)"""
+    with HttpFakeApiServer() as srv:
+        srv.fake.EVENT_WINDOW = 4
+        client = HttpApiClient(srv.url)
+        ctl = controller_on(client, relist_seconds=1.0)
+        submit(client, make_job(name="bmjob", workers=1))
+        assert _wait_for(lambda: len(srv.fake.list(
+            "Pod", "default", {JOB_LABEL: "bmjob"})) == 1, 5.0)
+        # Churn a foreign namespace in sub-window bursts: the live
+        # watches skip every event (kind/ns filtered, nothing
+        # yielded), so only bookmarks can keep the resume point ahead
+        # of the compaction horizon.
+        for burst in range(15):
+            for j in range(2):
+                with srv.fake.as_kubelet():
+                    srv.fake.create({"kind": "Pod", "metadata": {
+                        "name": f"churn-{burst}-{j}",
+                        "namespace": "elsewhere"}})
+            time.sleep(0.03)
+        time.sleep(2.5)  # >= 2 idle watch timeouts + re-watches
+        assert ctl.watch_gone == {}, \
+            f"bookmark resume point went stale: {ctl.watch_gone}"
+        assert ctl.watch_errors == {}
+        # Liveness after all that: a fresh job still reconciles.
+        submit(client, make_job(name="bmjob2", workers=1))
+        assert _wait_for(lambda: len(srv.fake.list(
+            "Pod", "default", {JOB_LABEL: "bmjob2"})) == 1, 5.0)
+
+
+def test_watch_controller_goes_gone_without_bookmarks(controller_on):
+    """The contrast case: the direct in-process FakeApiServer watch
+    defaults to no bookmarks, so the same foreign churn ages the
+    controller's resume point past the window and the next re-watch
+    410s — proving the bookmark test above exercises a path that
+    actually matters (and the Gone recovery path still converges)."""
+    api = FakeApiServer()
+    api.EVENT_WINDOW = 4
+    ctl = controller_on(api, relist_seconds=0.5)
+    submit(api, make_job(name="gjob", workers=1))
+    assert _wait_for(lambda: len(api.list(
+        "Pod", "default", {JOB_LABEL: "gjob"})) == 1, 5.0)
+    for i in range(30):
+        with api.as_kubelet():
+            api.create({"kind": "Pod", "metadata": {
+                "name": f"gchurn-{i}", "namespace": "elsewhere"}})
+    assert _wait_for(
+        lambda: sum(ctl.watch_gone.values()) >= 1, 8.0), \
+        "stale resume point never went Gone without bookmarks"
+    assert ctl.watch_errors == {}  # Gone is not a transport error
+    submit(api, make_job(name="gjob2", workers=1))
+    assert _wait_for(lambda: len(api.list(
+        "Pod", "default", {JOB_LABEL: "gjob2"})) == 1, 5.0)
 
 
 def test_watch_controller_end_to_end_over_http(controller_on):
@@ -396,7 +507,8 @@ def test_pod_watch_is_label_bounded():
         events = list(client.watch(
             "Pod", "default", timeout=0.5,
             label_selector={JOB_LABEL: None}))
-        assert [o["metadata"]["name"] for _, o in events] == ["ours"]
+        assert [o["metadata"]["name"] for t, o in events
+                if t != "BOOKMARK"] == ["ours"]
 
 
 def test_reconciler_fuzz_through_http_client():
