@@ -41,33 +41,82 @@ REFERENCE_GPU_IMAGES_PER_SEC = 219.0
 
 def controller_main() -> int:
     """`python bench.py --controller`: the operator control-plane
-    load benchmark (no accelerator — pure fake-apiserver chaos; see
-    kubeflow_tpu/operator/benchmark.py). Prints ONE JSON line shaped
-    like the headline bench; requeue-latency percentiles and
-    steady-state QPS per worker count live in "extra"."""
-    from kubeflow_tpu.operator.benchmark import run_controller_load_bench
+    scale benchmark (no accelerator — pure fake-apiserver; see
+    kubeflow_tpu/operator/benchmark.py). 500 jobs with spot churn and
+    a poison-job storm, informer reads at two fleet sizes plus the
+    direct-read contrast. Asserts the r12 acceptance: churn-phase p99
+    event→reconcile latency bounded, and steady-state apiserver
+    requests/reconcile FLAT in job count (the informer win). Prints
+    ONE JSON line shaped like the headline bench."""
+    from kubeflow_tpu.operator.benchmark import run_controller_scale_bench
 
-    result = run_controller_load_bench()
-    rows = {row["workers"]: row for row in result["rows"]}
-    best = max(
-        (row for row in result["rows"] if row["converged"]),
-        key=lambda r: r["reconciles_per_sec"],
-        default=result["rows"][0])
+    jobs = 500
+    full = run_controller_scale_bench(
+        jobs=jobs, workers=4, churn_kills=50, poison_jobs=5,
+        informer_modes=(True, False))
+    half = run_controller_scale_bench(
+        jobs=jobs // 2, workers=4, churn_kills=25, poison_jobs=5,
+        informer_modes=(True,))
+    inf_full = next(r for r in full["rows"] if r["informer"])
+    inf_half = half["rows"][0]
+    direct = next(r for r in full["rows"] if not r["informer"])
+
+    for row in (inf_full, inf_half, direct):
+        assert row["converged"], row
+        assert row["churn"]["reconverged"], row
+    # Poison-storm quarantine + the p99 claim hold on the INFORMER
+    # rows. The direct row is the contrast, not the contract: at 500
+    # jobs × ~5 reads × 2 ms RTT the 4 workers cannot drain a relist
+    # period's enqueues, the queue never empties, and even the poison
+    # keys' capped retries starve — the saturation the informer
+    # rebuild removes (its latency column records the collapse).
+    for row in (inf_full, inf_half):
+        assert row["poison_quarantined"] >= 1, row
+    # p99 event→reconcile under churn at 500 jobs: the operational
+    # reaction-latency claim. Latency samples are EVENT-path only
+    # (relist sweeps excluded by the workqueue), so this measures
+    # reaction to the kill wave; the 3 s bound leaves room for this
+    # box's cgroup throttle while sitting an order of magnitude under
+    # the direct-read row's saturated tail.
+    p99 = inf_full["churn"]["event_to_reconcile_ms"]["p99"]
+    assert p99 < 3000.0, f"churn p99 event->reconcile {p99}ms"
+    # QPS flatness: requests/reconcile must NOT grow with job count
+    # under informer reads, and must undercut direct reads by a wide
+    # margin (direct pays ~4-5 reads+writes per pass).
+    rpr_full = inf_full["steady"]["requests_per_reconcile"]
+    rpr_half = inf_half["steady"]["requests_per_reconcile"]
+    rpr_direct = direct["steady"]["requests_per_reconcile"]
+    assert rpr_full < 1.0 and rpr_half < 1.0, (rpr_half, rpr_full)
+    assert rpr_full <= rpr_half + 0.5, (rpr_half, rpr_full)
+    assert rpr_direct >= 2.0, rpr_direct
+
     print(json.dumps({
-        "metric": "controller_reconciles_per_sec",
-        "value": best["reconciles_per_sec"],
-        "unit": f"reconciles/sec ({best['jobs']} jobs, "
-                f"{best['workers']} workers, chaos faults on)",
+        "metric": "controller_churn_p99_event_to_reconcile_ms",
+        "value": p99,
+        "unit": f"ms p99 at {jobs} jobs + 50-pod drain wave "
+                f"(informer reads, 4 workers)",
         "vs_baseline": None,  # the reference never measured its operator
         "extra": {
-            "fault_rates": result["fault_rates"],
-            **{f"w{w}_{k}": row[k]
-               for w, row in sorted(rows.items())
-               for k in ("converged", "converge_seconds",
-                         "reconciles_per_sec", "steady_state_qps")},
-            **{f"w{w}_requeue_{p}_ms": row["requeue_latency_ms"][p]
-               for w, row in sorted(rows.items())
-               for p in ("p50", "p90", "p99")},
+            "informer_500": {
+                "converge_s": inf_full["converge_seconds"],
+                "churn_reconverge_s":
+                    inf_full["churn"]["reconverge_seconds"],
+                "steady_requests_per_reconcile": rpr_full,
+                "steady_qps": inf_full["steady"]["qps"],
+            },
+            "informer_250": {
+                "converge_s": inf_half["converge_seconds"],
+                "steady_requests_per_reconcile": rpr_half,
+                "steady_qps": inf_half["steady"]["qps"],
+            },
+            "direct_500": {
+                "converge_s": direct["converge_seconds"],
+                "churn_p99_ms":
+                    direct["churn"]["event_to_reconcile_ms"]["p99"],
+                "steady_requests_per_reconcile": rpr_direct,
+                "steady_qps": direct["steady"]["qps"],
+            },
+            "poison_quarantined": inf_full["poison_quarantined"],
         },
     }))
     return 0

@@ -74,7 +74,13 @@ from kubeflow_tpu.obs.exposition import (
     TraceContextHandlerMixin,
     access_log_function,
 )
-from kubeflow_tpu.operator.reconciler import JOB_LABEL
+from kubeflow_tpu.operator.reconciler import (
+    DEADLINE_CONDITION,
+    JOB_LABEL,
+    PREEMPTED_CONDITION,
+    PREEMPTOR_CONDITION,
+    STALLED_CONDITION,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -85,9 +91,16 @@ _D_REQUESTS = obs_metrics.Counter(
 
 
 #: Non-phase conditions the operator raises for jobs needing operator
-#: (human) attention: quarantined poison jobs and gangs that blew
-#: their scheduling deadline. Surfaced as warnings in the job views.
-_WARNING_CONDITIONS = ("ReconcileStalled", "DeadlineExceeded")
+#: (human) attention: quarantined poison jobs, gangs that blew their
+#: scheduling deadline, and gangs evicted by a higher-priority job.
+#: Surfaced as warnings in the job views. The names are the
+#: reconciler's own constants — the banner must track what the
+#: operator actually writes.
+_WARNING_CONDITIONS = (STALLED_CONDITION, DEADLINE_CONDITION,
+                       PREEMPTED_CONDITION)
+#: Informational (non-warning) conditions: the preemptor's record of
+#: having evicted a victim — the other half of the preemption story.
+_INFO_CONDITIONS = (PREEMPTOR_CONDITION,)
 
 
 def job_warnings(job: Dict[str, Any]) -> list:
@@ -95,6 +108,21 @@ def job_warnings(job: Dict[str, Any]) -> list:
     out = []
     for cond in job.get("status", {}).get("conditions", []):
         if (cond.get("type") in _WARNING_CONDITIONS
+                and cond.get("status") == "True"):
+            out.append({
+                "type": cond.get("type"),
+                "reason": cond.get("reason") or "",
+                "since": cond.get("lastTransitionTime") or "",
+            })
+    return out
+
+
+def job_notices(job: Dict[str, Any]) -> list:
+    """Active informational conditions (PreemptedVictim), same shape
+    as :func:`job_warnings` — rendered as a note, not an alert."""
+    out = []
+    for cond in job.get("status", {}).get("conditions", []):
+        if (cond.get("type") in _INFO_CONDITIONS
                 and cond.get("status") == "True"):
             out.append({
                 "type": cond.get("type"),
@@ -113,10 +141,13 @@ def job_summary(job: Dict[str, Any]) -> Dict[str, Any]:
     }
     # The active condition's transition is "when did the job last
     # change state" — the reference UI's per-job timeline anchor.
-    # Warning conditions (also True) must not steal the anchor.
+    # Warning/info conditions (also True) must not steal the anchor.
     active = next((c for c in status.get("conditions", [])
                    if c.get("status") == "True"
-                   and c.get("type") not in _WARNING_CONDITIONS), {})
+                   and c.get("type") not in _WARNING_CONDITIONS
+                   and c.get("type") not in _INFO_CONDITIONS), {})
+    from kubeflow_tpu.operator.reconciler import job_priority
+
     return {
         "name": meta.get("name", ""),
         "namespace": meta.get("namespace", ""),
@@ -124,10 +155,14 @@ def job_summary(job: Dict[str, Any]) -> Dict[str, Any]:
         "restartCount": status.get("restartCount", 0),
         "replicas": replicas,
         "numSlices": int(job.get("spec", {}).get("numSlices", 1) or 1),
+        # The operator's own coercion — the badge must show what the
+        # preemption logic will actually act on.
+        "priority": job_priority(job),
         "lastTransitionTime": active.get("lastTransitionTime", ""),
         "reason": status.get("reason", ""),
         "creationTimestamp": meta.get("creationTimestamp", ""),
         "warnings": job_warnings(job),
+        "notices": job_notices(job),
     }
 
 
@@ -338,6 +373,7 @@ class JobDetailHandler(BaseHandler):
                          "conditions": job.get("status", {}).get(
                              "conditions", []),
                          "warnings": job_warnings(job),
+                         "notices": job_notices(job),
                          "pods": [pod_summary(p) for p in raw_pods],
                          "events": events})
 
@@ -416,7 +452,9 @@ class OperatorMetricsHandler(BaseHandler):
     ConfigMap it publishes (operator/controller.py publish_metrics) —
     the dashboard and the load benchmark read the SAME numbers:
     queue depth, per-key retry counts and backoff state, quarantined
-    jobs, reconcile totals, watch health."""
+    jobs, reconcile totals, watch health, informer-cache counters
+    (per-kind objects/events/relists/Gone) and preemption counters
+    (eligible/granted/rateLimited/noVictim)."""
 
     async def get(self):
         from kubeflow_tpu.operator.controller import (
@@ -641,8 +679,10 @@ class UIJobDetailHandler(BaseHandler):
                 None, _job_events, self.api, namespace, name, job))
         pods = [pod_summary(p) for p in raw_pods]
         # Operator-attention banner: quarantined reconcile (the
-        # controller is failing to act on this job) or a blown
-        # scheduling deadline (gang torn down, slices released).
+        # controller is failing to act on this job), a blown
+        # scheduling deadline (gang torn down, slices released), or a
+        # preemption eviction. PreemptedVictim (this job evicted
+        # someone) rides below as an informational note, not an alert.
         warning_rows = [
             f"<p style=\"background:#fff1f0;border:1px solid #cf222e;"
             f"padding:.5rem .9rem\"><strong>"
@@ -650,6 +690,13 @@ class UIJobDetailHandler(BaseHandler):
             f"{html.escape(w['since'][:19] or '-')}: "
             f"{html.escape(w['reason'])}</p>"
             for w in job_warnings(job)]
+        warning_rows += [
+            f"<p style=\"background:#ddf4ff;border:1px solid #0969da;"
+            f"padding:.5rem .9rem\"><strong>"
+            f"{html.escape(n['type'])}</strong> since "
+            f"{html.escape(n['since'][:19] or '-')}: "
+            f"{html.escape(n['reason'])}</p>"
+            for n in job_notices(job)]
 
         def _num(s: str) -> int:
             return int(s) if s.isdigit() else 0

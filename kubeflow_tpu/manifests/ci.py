@@ -125,6 +125,16 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
         "sanitizer-test": [
             "make", "-C", f"{src}/native", "check-sanitizers",
         ],
+        # Leader-failover-mid-restart (the last open VERDICT-r5
+        # item): kill the lease holder between gang teardown and
+        # recreation; the standby must resync its informers and
+        # finish the restart without duplicate pods. Hermetic — the
+        # crash is simulated, no cluster involved.
+        "leader-failover-test": [
+            py, "-m", "kubeflow_tpu.citests.leader_failover", "--fake",
+            "--junit_path",
+            f"{params['artifacts_dir']}/junit_leader_failover.xml",
+        ],
         "deploy-test": [
             py, "-m", "kubeflow_tpu.citests.deploy", "setup",
             "--namespace", params["test_namespace"],
@@ -176,6 +186,7 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             _dag_task("lint-test", ["checkout"]),
             _dag_task("unit-test", ["checkout"]),
             _dag_task("sanitizer-test", ["checkout"]),
+            _dag_task("leader-failover-test", ["checkout"]),
             _dag_task("deploy-test", ["checkout"]),
             _dag_task("deploy-serving", ["deploy-test"]),
             _dag_task("tpujob-test", ["deploy-test"]),

@@ -156,6 +156,7 @@ def tpu_job(
     recovery: str = "restart-slice",
     num_slices: int = 1,
     scheduling_deadline_seconds: Optional[int] = None,
+    priority: int = 0,
 ) -> Dict[str, Any]:
     """A TPUJob CR (parity: ``tfJob``, reference
     ``tf-job.libsonnet:44-56``). ``recovery`` is new: TPU slices fail
@@ -179,6 +180,10 @@ def tpu_job(
         raise ValueError(
             f"scheduling_deadline_seconds must be >= 1 (omit for no "
             f"deadline), got {scheduling_deadline_seconds}")
+    if priority < 0:
+        raise ValueError(
+            f"priority must be >= 0 (0 = the default, preemptible "
+            f"class), got {priority}")
     return {
         "apiVersion": f"{GROUP}/{VERSION}",
         "kind": KIND,
@@ -198,6 +203,13 @@ def tpu_job(
                 # down, releasing the TPU slices (operator/reconciler
                 # enforces it). Absent = wait forever.
                 "schedulingDeadlineSeconds": scheduling_deadline_seconds,
+                # Priority class (r12): a Pending gang with priority
+                # > 0 approaching its scheduling deadline may preempt
+                # the lowest-priority RUNNING gang (strictly lower
+                # class only, globally rate-limited — see
+                # docs/operator.md). 0 (the default) never preempts
+                # and stays schema-identical to pre-r12 manifests.
+                "priority": priority if priority else None,
             }
         ),
     }
@@ -236,6 +248,7 @@ def crd() -> Dict[str, Any]:
                     "schedulingDeadlineSeconds": {
                         "type": "integer", "minimum": 1,
                     },
+                    "priority": {"type": "integer", "minimum": 0},
                 },
             },
             "status": {
@@ -439,7 +452,8 @@ def _generic_job_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
                     termination=termination_policy(chief),
                     num_slices=p["num_slices"],
                     scheduling_deadline_seconds=(
-                        p["scheduling_deadline_seconds"] or None))]
+                        p["scheduling_deadline_seconds"] or None),
+                    priority=p["priority"])]
 
 
 register(
@@ -466,6 +480,13 @@ register(
               "if it is still Pending after this many seconds; 0 = "
               "wait forever. See docs/operator.md for picking a "
               "value on spot-heavy pools."),
+        Param("priority", 0, "int",
+              "Priority class: a Pending job with priority > 0 "
+              "approaching its scheduling deadline may preempt the "
+              "lowest-priority running gang (strictly lower class "
+              "only, rate-limited; needs "
+              "scheduling_deadline_seconds). 0 = default, "
+              "preemptible."),
     ],
     package="tpu-job",
 )(_generic_job_builder)

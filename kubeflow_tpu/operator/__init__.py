@@ -36,10 +36,27 @@ parameter-server controller (external Go tf-operator, reference
   (kubeflow_tpu.operator.workqueue) — per-key exponential backoff
   with jitter, a global token bucket, N workers with per-key dedup,
   and poison-job quarantine surfaced as a ReconcileStalled condition.
+- **Informer cache** (kubeflow_tpu.operator.informer): list+watch-fed
+  indexed local stores for every hot-path kind; reconciles read
+  locally and steady-state apiserver QPS stays flat as the fleet
+  grows (the reference's client-go informer pattern, SURVEY §4).
+- **Priority & gang preemption**: ``spec.priority`` + the scheduling
+  deadline machinery let a starving high-priority gang evict the
+  lowest-priority running gang — one victim per decision, globally
+  rate-limited, Preempted/PreemptedVictim conditions + Events on
+  both sides (docs/operator.md).
 """
 
-from kubeflow_tpu.operator.reconciler import Reconciler  # noqa: F401
+from kubeflow_tpu.operator.reconciler import (  # noqa: F401
+    PreemptionPolicy,
+    Reconciler,
+)
 from kubeflow_tpu.operator.fake import FakeApiServer  # noqa: F401
+from kubeflow_tpu.operator.informer import (  # noqa: F401
+    CachedApiClient,
+    Informer,
+    Store,
+)
 from kubeflow_tpu.operator.workqueue import (  # noqa: F401
     ExponentialBackoff,
     TokenBucket,

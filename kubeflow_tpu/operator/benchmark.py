@@ -12,7 +12,7 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""Controller load benchmark: M jobs × injected fault rates.
+"""Controller load benchmarks: chaos (r7) and cluster scale (r12).
 
 The control plane had never been measured under load (VERDICT r5):
 this drives the REAL WatchController — watchers, workqueue, worker
@@ -29,6 +29,19 @@ and reports, per worker count:
 Run via ``python bench.py --controller`` (PERF.md records the
 numbers) or pytest's smoke test (tests/test_controller_chaos.py).
 No jax, no accelerator — this is a pure control-plane benchmark.
+
+The r12 scale bench (:func:`run_controller_scale_bench`) is the
+informer acceptance harness: 500–1000 jobs with spot churn (drained
+pod kills mid-run) and a poison-job storm, run once with informer
+reads and once direct, reporting per mode:
+
+- p99 event→reconcile latency (workqueue enqueue→dequeue),
+- steady-state apiserver requests PER RECONCILE (the informer win:
+  reads come from the cache and no-op status writes are suppressed,
+  so a converged fleet's request rate is flat in job count),
+- churn reaction (re-convergence seconds after the kill wave),
+- fairness: the poison storm must not keep healthy jobs from
+  converging, and quarantine must hold all poison keys.
 """
 
 from __future__ import annotations
@@ -143,6 +156,210 @@ def _quiet_operator_logs():
     finally:
         for t, level in zip(targets, levels):
             t.setLevel(level)
+
+
+def run_controller_scale_bench(
+        *, jobs: int = 500,
+        workers: int = 4,
+        churn_kills: int = 50,
+        poison_jobs: int = 5,
+        informer_modes: Sequence[bool] = (True, False),
+        relist_seconds: float = 5.0,
+        latency: float = 0.002,
+        converge_timeout: float = 180.0,
+        churn_timeout: float = 120.0,
+        steady_window: float = 6.0,
+        qps: float = 2000.0) -> Dict[str, Any]:
+    """The r12 informer/preemption-era scale bench; see the module
+    docstring. ``latency`` is per-apiserver-request RTT — the knob
+    that makes read-path traffic COST something, so the informer
+    contrast measures architecture, not GIL luck. Spot churn kills
+    ``churn_kills`` running pods with the DRAIN exit code (the spot
+    preemption signature: restart without burning budget).
+    ``steady_window`` should cover at least one ``relist_seconds``
+    sweep — direct-read traffic is bursty at the relist cadence, and
+    a window that misses the sweep understates the contrast."""
+    with _quiet_operator_logs():
+        rows = [_run_scale_row(
+                    jobs=jobs, workers=workers, churn_kills=churn_kills,
+                    poison_jobs=poison_jobs, informer=mode,
+                    relist_seconds=relist_seconds, latency=latency,
+                    converge_timeout=converge_timeout,
+                    churn_timeout=churn_timeout,
+                    steady_window=steady_window, qps=qps)
+                for mode in informer_modes]
+    return {
+        "bench": "controller_scale",
+        "jobs": jobs,
+        "workers": workers,
+        "churn_kills": churn_kills,
+        "poison_jobs": poison_jobs,
+        "latency_ms": round(latency * 1e3, 2),
+        "rows": rows,
+    }
+
+
+def _run_scale_row(*, jobs, workers, churn_kills, poison_jobs,
+                   informer, relist_seconds, latency, converge_timeout,
+                   churn_timeout, steady_window, qps) -> Dict[str, Any]:
+    import random
+
+    from kubeflow_tpu.training.launcher import DRAIN_EXIT_CODE
+
+    api = FakeApiServer()
+    api.faults.latency = latency
+    # The poison storm: these jobs' pod creates always 500 — they
+    # must quarantine while every healthy job converges regardless.
+    if poison_jobs:
+        api.faults.add_rule(
+            lambda: ServerError("poison storm: pod create down"),
+            verbs=("create",), kind="Pod", name="^poison")
+
+    names = [f"load-{i:04d}" for i in range(jobs)]
+    poison_names = [f"poison{i:02d}" for i in range(poison_jobs)]
+    with api.as_kubelet():
+        for name in names + poison_names:
+            api.create(_bench_job(name))
+
+    ctl = WatchController(
+        api, relist_seconds=relist_seconds, workers=workers,
+        backoff=ExponentialBackoff(base=0.025, cap=2.0),
+        limiter=TokenBucket(qps=qps, burst=int(qps)),
+        quarantine_after=3, informer_reads=informer)
+    thread = threading.Thread(target=ctl.run, daemon=True)
+
+    # A background "kubelet/scheduler": any created healthy pod goes
+    # Running shortly after (bypasses fault latency + the request
+    # log, like a real kubelet writing through its own channel).
+    kubelet_stop = threading.Event()
+
+    def kubelet_loop():
+        while not kubelet_stop.is_set():
+            with api.as_kubelet():
+                for pod in api._list("Pod", "default",
+                                     {JOB_LABEL: None}):
+                    pname = pod["metadata"]["name"]
+                    if pname.startswith("poison"):
+                        continue  # scarce world for the storm jobs
+                    if pod.get("status", {}).get("phase") in (
+                            None, "Pending"):
+                        api.set_pod_phase("default", pname, "Running")
+            kubelet_stop.wait(0.02)
+
+    kubelet = threading.Thread(target=kubelet_loop, daemon=True)
+
+    def healthy_running() -> int:
+        with api.as_kubelet():
+            return sum(
+                1 for n in names
+                if api.get(KIND, "default", n)
+                .get("status", {}).get("phase") == "Running")
+
+    def wait_converged(timeout: float) -> Optional[float]:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if healthy_running() == jobs:
+                return time.monotonic() - t0
+            time.sleep(0.05)
+        return None
+
+    t0 = time.monotonic()
+    thread.start()
+    kubelet.start()
+    try:
+        converge_seconds = wait_converged(converge_timeout)
+        converge_latency = ctl.queue.latency_percentiles()
+
+        # Steady state: a converged fleet vs the apiserver, measured
+        # per RECONCILE (the flatness claim) and per second.
+        mark = api.mark()
+        stats0 = ctl.stats()
+        time.sleep(steady_window)
+        counts = api.request_counts(mark)
+        stats1 = ctl.stats()
+        reconciles = max(1, stats1["reconciles"] - stats0["reconciles"])
+        steady = {
+            "window_s": steady_window,
+            "requests": counts["total"],
+            "reconciles": reconciles,
+            "requests_per_reconcile": round(
+                counts["total"] / reconciles, 3),
+            "qps": round(counts["total"] / steady_window, 2),
+            "verbs": {k: v for k, v in sorted(counts.items())
+                      if k != "total"},
+        }
+
+        # Spot churn: a kill wave of drained pods (SIGTERM → finish
+        # step → checkpoint → exit 77). Slice restarts must ride the
+        # event path and not burn restart budget.
+        rng = random.Random(0)
+        with api.as_kubelet():
+            running = [p["metadata"]["name"]
+                       for p in api._list("Pod", "default",
+                                          {JOB_LABEL: None})
+                       if not p["metadata"]["name"].startswith("poison")
+                       and p.get("status", {}).get("phase") == "Running"]
+        victims = rng.sample(running, min(churn_kills, len(running)))
+        # Segment the latency window: churn percentiles must cover
+        # ONLY churn-phase samples (a wrapped deque would otherwise
+        # fall back to converge-backlog contamination).
+        ctl.queue.drain_latencies()
+        churn_t0 = time.monotonic()
+        for victim in victims:
+            api.set_pod_terminated("default", victim, DRAIN_EXIT_CODE)
+
+        # Re-convergence is POD truth, not job phase: a drained gang's
+        # phase barely leaves Running (Restarting → recreate →
+        # display-Running), so the only honest signal is every healthy
+        # gang's pod existing AND Running again — which requires the
+        # full teardown/recreate/reschedule cycle to complete.
+        def pods_reconverged() -> bool:
+            with api.as_kubelet():
+                healthy = [
+                    p for p in api._list("Pod", "default",
+                                         {JOB_LABEL: None})
+                    if not p["metadata"]["name"].startswith("poison")]
+                return (len(healthy) == jobs and all(
+                    p.get("status", {}).get("phase") == "Running"
+                    for p in healthy))
+
+        churn_seconds = None
+        churn_deadline = time.monotonic() + churn_timeout
+        while time.monotonic() < churn_deadline:
+            if pods_reconverged():
+                churn_seconds = time.monotonic() - churn_t0
+                break
+            time.sleep(0.05)
+        fresh = ctl.queue.latencies()
+        churn_latency = {
+            p: round(_percentile(fresh, pct) * 1e3, 2)
+            for p, pct in (("p50", 50), ("p90", 90), ("p99", 99))}
+
+        final = ctl.stats()
+        return {
+            "informer": informer,
+            "jobs": jobs,
+            "workers": workers,
+            "converged": converge_seconds is not None,
+            "converge_seconds": round(converge_seconds or -1.0, 2),
+            "event_to_reconcile_ms": converge_latency,
+            "steady": steady,
+            "churn": {
+                "kills": len(victims),
+                "reconverged": churn_seconds is not None,
+                "reconverge_seconds": round(churn_seconds or -1.0, 2),
+                "event_to_reconcile_ms": churn_latency,
+            },
+            "poison_quarantined": len(final["queue"]["quarantined"]),
+            "reconciles": final["reconciles"],
+            "reconcile_failures": final["reconcileFailures"],
+            "informer_stats": final["informers"],
+        }
+    finally:
+        kubelet_stop.set()
+        ctl.stop.set()
+        thread.join(timeout=15)
+        kubelet.join(timeout=5)
 
 
 def _run(*, jobs, workers_list, conflict_rate, throttle_rate,

@@ -37,6 +37,17 @@ token-bucket limiter, and poison-job quarantine: after
 ``quarantine_after`` consecutive failures the key parks at the
 backoff cap and the job carries a ``ReconcileStalled`` condition +
 Event until a reconcile succeeds again.
+
+Read path (r12): the per-pass GET/LIST traffic moved into an
+informer-style shared cache (:mod:`kubeflow_tpu.operator.informer`) —
+one list+watch-fed, indexed local store per hot-path kind. Workers
+and the reconciler read from the store; writes go through the api
+client and their results are absorbed immediately, so steady-state
+apiserver QPS stays flat as the fleet grows (the r7 design re-read
+every job ~5× per relist period). On top of the cache sits priority +
+gang preemption: a high-priority gang burning through its scheduling
+deadline evicts the lowest-priority running gang, globally
+rate-limited (reconciler.PreemptionPolicy).
 """
 
 from __future__ import annotations
@@ -52,8 +63,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.manifests.tpujob import KIND, PLURAL, GROUP
 from kubeflow_tpu.obs import metrics as obs_metrics
-from kubeflow_tpu.operator.fake import Conflict, Gone, NotFound
-from kubeflow_tpu.operator.reconciler import JOB_LABEL, Reconciler
+from kubeflow_tpu.operator.fake import Conflict, NotFound
+from kubeflow_tpu.operator.informer import CachedApiClient, Informer
+from kubeflow_tpu.operator.reconciler import (
+    JOB_LABEL,
+    PreemptionPolicy,
+    Reconciler,
+)
 from kubeflow_tpu.operator.workqueue import (
     ExponentialBackoff,
     TokenBucket,
@@ -103,6 +119,20 @@ _O_WQ_GETS = obs_metrics.Counter(
     "kft_workqueue_gets_total", "Keys handed to workers")
 _O_WQ_RETRIES = obs_metrics.Counter(
     "kft_workqueue_retries_total", "Failure-scheduled retries")
+_O_INFORMER_OBJECTS = obs_metrics.Gauge(
+    "kft_informer_objects_total",
+    "Objects resident across the informer caches")
+_O_PREEMPTIONS = obs_metrics.Counter(
+    "kft_operator_preemptions_total",
+    "Gang preemptions granted (victim gangs torn down)")
+_O_PREEMPTIONS_LIMITED = obs_metrics.Counter(
+    "kft_operator_preemptions_rate_limited_total",
+    "Preemption decisions refused by the global rate limiter")
+
+#: Kinds the controller keeps informer caches for — everything the
+#: reconcile hot path reads. Pods/Services/PDBs are gang-owned and
+#: carry JOB_LABEL, so their watches stay bounded by gang count.
+INFORMED_KINDS = (KIND, "Pod", "Service", "PodDisruptionBudget")
 
 
 class KubectlClient:
@@ -188,11 +218,17 @@ class WatchController:
                  backoff: Optional[ExponentialBackoff] = None,
                  limiter: Optional[TokenBucket] = None,
                  quarantine_after: int = 6,
-                 metrics_namespace: Optional[str] = None):
+                 metrics_namespace: Optional[str] = None,
+                 informer_reads: bool = True,
+                 resync_seconds: float = 300.0,
+                 preemption: Optional[PreemptionPolicy] = None):
         self.api = api
         self.namespace = namespace
         self.relist_seconds = relist_seconds
-        self.reconciler = reconciler or Reconciler(api)
+        self.reconciler = reconciler or Reconciler(
+            api, preemption=preemption)
+        if reconciler is not None and preemption is not None:
+            self.reconciler.preemption = preemption
         # Optional LeaderElector (operator/leader.py): watchers run
         # regardless (warm cache), reconciles only while leading.
         self.elector = elector
@@ -207,18 +243,38 @@ class WatchController:
         self.metrics_namespace = (metrics_namespace or namespace
                                   or "default")
         self._watchers: List[threading.Thread] = []
+        # Bounded WaitForCacheSync window; armed by run().
+        self._sync_deadline: Optional[float] = None
+        self._sync_timeout_logged = False
         # Keys whose ReconcileStalled condition has been written (so
         # quarantined retries don't re-patch it every cap interval).
         self._stalled: set = set()
         self._counters_lock = threading.Lock()
         self._reconciles = 0
         self._reconcile_failures = 0
-        # Watch-loop health: transport errors back off exponentially;
-        # a 410 Gone is NOT an error — the server compacted our resume
-        # point and the contract is an immediate relist.
-        self.watch_gone: Dict[str, int] = {}
-        self.watch_errors: Dict[str, int] = {}
-        self._watch_backoff = ExponentialBackoff(base=0.2, cap=30.0)
+        # The informer layer (r12 tentpole): one list+watch-fed local
+        # store per hot-path kind. The informers are ALWAYS the event
+        # source; `informer_reads` additionally routes the reconcile
+        # read path through the shared cache (False = the r7
+        # direct-read behavior, kept for the benchmark's QPS-contrast
+        # and as an escape hatch).
+        self.informer_reads = informer_reads
+        self.informers: Dict[str, Informer] = {}
+        for kind in INFORMED_KINDS:
+            selector = {JOB_LABEL: None} if kind != KIND else None
+            self.informers[kind] = Informer(
+                api, kind, namespace=namespace,
+                label_selector=selector,
+                index_label=JOB_LABEL if kind == "Pod" else None,
+                handler=self._on_informer_event,
+                watch_timeout=relist_seconds,
+                resync_seconds=resync_seconds)
+        if informer_reads:
+            stores = {k: inf.store for k, inf in self.informers.items()}
+            self.reader = CachedApiClient(api, stores)
+            self.reconciler.attach_cache(self.reader)
+        else:
+            self.reader = api
         # Live /metrics bindings (render-time callbacks — tests build
         # many controllers; the newest instance wins the binding).
         queue = self.queue
@@ -237,6 +293,27 @@ class WatchController:
         _O_RECONCILES.set_function(lambda c=self: c._reconciles)
         _O_FAILURES.set_function(
             lambda c=self: c._reconcile_failures)
+        _O_INFORMER_OBJECTS.set_function(
+            lambda c=self: sum(len(i.store)
+                               for i in c.informers.values()))
+        _O_PREEMPTIONS.set_function(
+            lambda c=self: c.reconciler.preemption.granted)
+        _O_PREEMPTIONS_LIMITED.set_function(
+            lambda c=self: c.reconciler.preemption.rate_limited)
+
+    # Watch-loop health, aggregated from the informers. A 410 Gone is
+    # NOT an error — the server compacted our resume point and the
+    # contract is an immediate relist (see Informer.run).
+
+    @property
+    def watch_gone(self) -> Dict[str, int]:
+        return {k: inf.gone for k, inf in self.informers.items()
+                if inf.gone}
+
+    @property
+    def watch_errors(self) -> Dict[str, int]:
+        return {k: inf.errors for k, inf in self.informers.items()
+                if inf.errors}
 
     # -- queue ------------------------------------------------------------
 
@@ -263,66 +340,50 @@ class WatchController:
         label = meta.get("labels", {}).get(JOB_LABEL)
         return (ns, label) if label else None
 
-    def _watch_loop(self, kind: str) -> None:
-        """One resumable watch: list for the horizon revision, then
-        stream events, re-watching from the last seen version on
-        stream end and relisting on Gone (the compacted-version 410).
-        The Pod watch is bounded by a JOB_LABEL-existence selector —
-        the operator must scale with gang count, not with whatever
-        else runs on the cluster."""
-        selector = {JOB_LABEL: None} if kind == "Pod" else None
-        version = 0
-        consecutive_errors = 0
-        while not self.stop.is_set():
-            delay = 0.0
-            try:
-                if version == 0:
-                    # Fresh horizon: everything current is (re)queued
-                    # so no event preceding the watch can be missed.
-                    items, version = self.api.list_with_version(
-                        kind, self.namespace, selector)
-                    for obj in items:
-                        key = self._job_key_of(kind, obj)
-                        if key:
-                            self.enqueue_relisted(*key)
-                for event_type, obj in self.api.watch(
-                        kind, self.namespace, resource_version=version,
-                        stop=self.stop, timeout=self.relist_seconds,
-                        label_selector=selector):
-                    version = int(obj.get("metadata", {})
-                                  .get("resourceVersion", version))
-                    consecutive_errors = 0
-                    if event_type == "BOOKMARK":
-                        continue  # payload IS the fresh resume point
-                    key = self._job_key_of(kind, obj)
-                    if key:
-                        self.enqueue(*key)
-                # Server-side watch timeout: re-watch from `version`.
-                consecutive_errors = 0
-            except Gone:
-                # 410: our resourceVersion fell out of the server's
-                # watch window. Not a transport fault — the sanctioned
-                # reaction is an immediate relist-and-resume, with the
-                # error counter untouched (counting it toward backoff
-                # would punish the controller for the server's
-                # compaction cadence).
-                logger.info("%s watch compacted (410); relisting", kind)
-                self.watch_gone[kind] = self.watch_gone.get(kind, 0) + 1
-                version = 0
-            except Exception:  # noqa: BLE001
-                logger.exception("%s watch failed; relisting", kind)
-                self.watch_errors[kind] = (
-                    self.watch_errors.get(kind, 0) + 1)
-                consecutive_errors += 1
-                version = 0
-                delay = self._watch_backoff.delay(consecutive_errors)
-            if delay:
-                self.stop.wait(delay)
+    def _on_informer_event(self, kind: str, event_type: str,
+                           obj: Dict[str, Any], relisted: bool) -> None:
+        """Informer dispatch: the store already reflects the event
+        (Informer.run applies before dispatching), so a worker woken
+        by this enqueue reads a cache at least as new as the event.
+        Relist deliveries carry no new information — backing-off keys
+        keep their timers (quarantine survives resyncs)."""
+        key = self._job_key_of(kind, obj)
+        if key is None:
+            return
+        if relisted:
+            self.enqueue_relisted(*key)
+        else:
+            self.enqueue(*key)
 
     # -- workers ----------------------------------------------------------
 
     def _reconcile_allowed(self) -> bool:
         return self.elector is None or self.elector.is_leader()
+
+    def _caches_ready(self) -> bool:
+        """All informer stores synced, OR the bounded sync window has
+        expired. The normal case resolves in one list round trip; the
+        timeout covers a kind whose LIST persistently fails (RBAC
+        drift, disabled API group) — reconciling against a partially
+        cold cache costs Conflict-tolerated wasted passes, while
+        waiting forever would silently halt the whole fleet with no
+        condition surfaced anywhere (a worse outage than the pre-r12
+        direct-read behavior)."""
+        if all(inf.synced.is_set() for inf in self.informers.values()):
+            return True
+        if self._sync_deadline is None:
+            return False  # run() not started yet (tests drive workers)
+        if time.monotonic() < self._sync_deadline:
+            return False
+        if not self._sync_timeout_logged:
+            self._sync_timeout_logged = True
+            cold = [k for k, inf in self.informers.items()
+                    if not inf.synced.is_set()]
+            logger.error(
+                "informer caches %s never synced within the startup "
+                "window; reconciling with partial caches (check LIST "
+                "RBAC for those kinds)", cold)
+        return True
 
     def _worker_loop(self) -> None:
         while not self.stop.is_set():
@@ -330,6 +391,17 @@ class WatchController:
                 # Follower: keep the queue (events accumulate for the
                 # takeover), reconcile nothing.
                 self.stop.wait(0.05)
+                continue
+            if (self.informer_reads and not self._caches_ready()):
+                # WaitForCacheSync — ALL stores, not just TPUJob: a
+                # cold job store would mistake a live job for deleted
+                # and drop its key; a cold Pod store would read a
+                # Running gang as all-MISSING and fire a spurious
+                # CREATE_MISSING + Running→Pending flap. Idle until
+                # every cache holds an authoritative snapshot — but
+                # BOUNDED (see _caches_ready): one kind's persistent
+                # list failure must degrade, never halt the fleet.
+                self.stop.wait(0.02)
                 continue
             key = self.queue.get(timeout=0.2, stop=self.stop)
             if key is None:
@@ -351,7 +423,7 @@ class WatchController:
     def _reconcile_one_inner(self, key: Tuple[str, str], ns: str,
                              name: str) -> None:
         try:
-            job = self.api.get(KIND, ns, name)
+            job = self.reader.get(KIND, ns, name)
         except NotFound:
             # Deleted; GC is ownerReference-driven. Nothing left to
             # retry against either.
@@ -418,10 +490,15 @@ class WatchController:
             failures = self._reconcile_failures
         return {
             "workers": self.workers,
+            "informerReads": self.informer_reads,
             "reconciles": reconciles,
             "reconcileFailures": failures,
             "watchGone": dict(self.watch_gone),
             "watchErrors": dict(self.watch_errors),
+            "informers": {kind: inf.stats()
+                          for kind, inf in self.informers.items()},
+            "preemption": self.reconciler.preemption.stats(),
+            "requeueLatencyMs": self.queue.latency_percentiles(),
             "queue": self.queue.stats(),
         }
 
@@ -453,9 +530,11 @@ class WatchController:
     # -- main loop --------------------------------------------------------
 
     def run(self, *, max_seconds: Optional[float] = None) -> None:
-        for kind in (KIND, "Pod"):
-            t = threading.Thread(target=self._watch_loop, args=(kind,),
-                                 name=f"watch-{kind}", daemon=True)
+        self._sync_deadline = (time.monotonic()
+                               + max(5.0, 2.0 * self.relist_seconds))
+        for kind, informer in self.informers.items():
+            t = threading.Thread(target=informer.run, args=(self.stop,),
+                                 name=f"informer-{kind}", daemon=True)
             t.start()
             self._watchers.append(t)
         if self.elector is not None:
@@ -493,23 +572,40 @@ class WatchController:
                         self.stop.wait(0.05)
                         continue
                     if not was_leader:
-                        # Fresh leadership: force an immediate relist —
+                        # Fresh leadership: force an immediate relist
+                        # AND an informer resync from the server —
                         # anything the previous leader half-finished
-                        # must be re-observed now, not a relist period
-                        # from now.
+                        # must be re-observed now (and not trusted to
+                        # a cache that may predate its last writes).
+                        # The resync lands within one watch timeout
+                        # (= relist_seconds): a quiet in-flight watch
+                        # can't be interrupted mid-stream, only told
+                        # to relist at its next turn.
                         was_leader = True
                         last_relist = float("-inf")
+                        for informer in self.informers.values():
+                            informer.request_resync()
                 now = time.monotonic()
                 if now - last_relist >= self.relist_seconds:
                     # Level-triggered safety net: a dropped event can
-                    # delay a job at most one relist period.
+                    # delay a job at most one relist period. With
+                    # informer reads the sweep comes from the LOCAL
+                    # store — zero apiserver requests, so steady-state
+                    # QPS stays flat as the fleet grows (the informer's
+                    # own resync period bounds cache staleness).
                     last_relist = now
                     try:
-                        for job in self.api.list(KIND, self.namespace):
-                            meta = job["metadata"]
-                            self.enqueue_relisted(
-                                meta.get("namespace", "default"),
-                                meta["name"])
+                        if self.informer_reads:
+                            for ns, name in (
+                                    self.informers[KIND].store.keys()):
+                                self.enqueue_relisted(ns, name)
+                        else:
+                            for job in self.api.list(KIND,
+                                                     self.namespace):
+                                meta = job["metadata"]
+                                self.enqueue_relisted(
+                                    meta.get("namespace", "default"),
+                                    meta["name"])
                     except Exception:  # noqa: BLE001
                         logger.exception("relist failed")
                     self.publish_metrics()
@@ -583,6 +679,20 @@ def main(argv=None) -> int:
         help="watch mode without a coordination.k8s.io lease (single-"
              "replica deployments / clusters without the RBAC rule)")
     parser.add_argument(
+        "--no-informer-reads", action="store_true",
+        help="bypass the informer cache on the reconcile read path "
+             "(every pass re-reads the apiserver — the pre-r12 "
+             "behavior; steady-state QPS grows with fleet size)")
+    parser.add_argument(
+        "--preemption-interval", type=float, default=30.0,
+        help="global minimum seconds between gang preemptions (the "
+             "priority-storm rate limit; see docs/operator.md)")
+    parser.add_argument(
+        "--preemption-fraction", type=float, default=0.5,
+        help="fraction of a Pending priority job's scheduling "
+             "deadline after which it may preempt a lower-priority "
+             "running gang")
+    parser.add_argument(
         "--metrics-port", type=int, default=9400,
         help="Prometheus /metrics (+ /tracez, /healthz) exposition "
              "port, served from a stdlib thread; 0 disables")
@@ -624,10 +734,16 @@ def main(argv=None) -> int:
                         elector.identity)
         logger.info("watch mode: in-cluster HTTP client, relist %.0fs",
                     args.relist_seconds)
-        WatchController(client, namespace=args.namespace,
-                        relist_seconds=args.relist_seconds,
-                        workers=args.workers,
-                        elector=elector).run()
+        WatchController(
+            client, namespace=args.namespace,
+            relist_seconds=args.relist_seconds,
+            workers=args.workers,
+            elector=elector,
+            informer_reads=not args.no_informer_reads,
+            preemption=PreemptionPolicy(
+                deadline_fraction=args.preemption_fraction,
+                min_interval_seconds=args.preemption_interval),
+        ).run()
     else:
         logger.info("poll mode: kubectl client, resync %.1fs",
                     args.resync_seconds)

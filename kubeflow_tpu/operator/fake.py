@@ -225,6 +225,35 @@ class FakeApiServer:
     def request_log(self) -> List[Dict[str, Any]]:
         return list(self._request_log)
 
+    def mark(self) -> int:
+        """Position marker into the request log; pass to
+        :meth:`request_counts` to count only the traffic between two
+        marks. This is how tests assert informer QPS-flatness
+        ("reconciles in this window issued N apiserver requests")
+        without scraping timestamps."""
+        return len(self._request_log)
+
+    def request_counts(self, since_mark: int = 0,
+                       until_mark: Optional[int] = None, *,
+                       kind: Optional[str] = None,
+                       name: Optional[str] = None
+                       ) -> Dict[str, int]:
+        """Per-verb request counts between two :meth:`mark` positions
+        (``name`` is a substring match like :meth:`request_count`).
+        The special key ``"total"`` sums every verb — the single
+        number most flatness assertions want."""
+        counts: Dict[str, int] = {"total": 0}
+        log = self._request_log
+        until = len(log) if until_mark is None else until_mark
+        for entry in list(log[since_mark:until]):
+            if kind is not None and entry["kind"] != kind:
+                continue
+            if name is not None and name not in (entry["name"] or ""):
+                continue
+            counts[entry["verb"]] = counts.get(entry["verb"], 0) + 1
+            counts["total"] += 1
+        return counts
+
     def request_count(self, *, verb: Optional[str] = None,
                       kind: Optional[str] = None,
                       name: Optional[str] = None,

@@ -212,9 +212,15 @@ class HttpApiClient:
         """Read-modify-PUT with optimistic concurrency: the PUT
         carries the read's resourceVersion, so a concurrent writer
         surfaces as Conflict (the taxonomy the reconciler already
-        handles) instead of a lost update."""
+        handles) instead of a lost update. A mutation that changes
+        nothing skips the PUT entirely (the apiserver would suppress
+        the no-change write anyway — skipping it client-side saves
+        the round trip, half of a steady-state pass's traffic)."""
         obj = self.get(kind, namespace, name)
+        before = json.loads(json.dumps(obj))
         mutate(obj)
+        if obj == before:
+            return obj
         sub = "status" if kind == KIND else None
         return self._json(
             "PUT", self._path(kind, namespace, name, subresource=sub),

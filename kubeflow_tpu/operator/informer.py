@@ -1,0 +1,453 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Informer-style shared cache: list+watch → indexed local store.
+
+The r7 controller was event-DRIVEN but read-HEAVY: every reconcile
+pass issued a job GET, a pod LIST, and Service/PDB GETs against the
+apiserver, so steady-state QPS grew linearly with fleet size (each
+relist period re-read every job ~5 times over). The reference
+tf-operator was built on client-go informers for exactly this reason
+(SURVEY §4); this module is that machinery, client-agnostic (fake,
+HTTP, kubectl-shaped):
+
+- :class:`Store` — a thread-safe, per-kind object cache keyed by
+  (namespace, name), resourceVersion-tracked (updates apply
+  forward-only, so a stale watch echo can never roll back a newer
+  optimistic write), with an optional label index for O(1) gang-pod
+  lookups at 1000-job scale.
+- :class:`Informer` — one resumable list+watch loop feeding a Store:
+  initial list at a revision horizon, watch from there, BOOKMARK
+  frames advance the resume point without touching the store, 410
+  Gone triggers an immediate relist-and-resync (never counted as an
+  error), transport errors back off exponentially, and a periodic
+  full resync bounds the damage of any silently-dropped event.
+  Handlers run AFTER the store reflects the event — a consumer woken
+  by an event always reads a cache at least as new as that event.
+- :class:`CachedApiClient` — the read/write splitter handed to the
+  reconciler: reads of informed kinds come from the local stores
+  (zero apiserver requests), reads of everything else and ALL writes
+  pass through to the real api client, and write RESULTS are absorbed
+  into the stores immediately (forward-only), so a pass can see its
+  own writes without waiting for the watch echo.
+
+Staleness contract: reads may trail the apiserver by the watch
+delivery latency (bounded by the informer resync period in the worst
+case of a wedged stream). The controller is level-triggered, so a
+stale read costs at most one wasted-then-corrected pass — writes are
+never based on blind state (status writes go through optimistic
+concurrency; creates tolerate Conflict).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubeflow_tpu.operator.fake import (
+    Gone,
+    NotFound,
+    _fields_match,
+    _labels_match,
+)
+from kubeflow_tpu.operator.workqueue import ExponentialBackoff
+
+logger = logging.getLogger(__name__)
+
+StoreKey = Tuple[str, str]  # (namespace, name)
+
+#: handler(kind, event_type, obj, relisted) — relisted=True marks
+#: deliveries that carry no new information (initial sync / resync
+#: replays), so consumers can apply relist (non-backoff-resetting)
+#: enqueue semantics.
+Handler = Callable[[str, str, Dict[str, Any], bool], None]
+
+
+def _rv(obj: Dict[str, Any]) -> int:
+    """Numeric resourceVersion, 0 when absent/opaque. k8s declares rv
+    opaque but every apiserver (and the fake) emits monotone integers;
+    an unparseable value reads as 0 = always-apply."""
+    try:
+        return int(obj.get("metadata", {}).get("resourceVersion", 0) or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+class Store:
+    """Thread-safe object cache for ONE kind, forward-only by
+    resourceVersion, optionally label-indexed."""
+
+    def __init__(self, kind: str, *, index_label: Optional[str] = None):
+        self.kind = kind
+        self.index_label = index_label
+        self._objects: Dict[StoreKey, Dict[str, Any]] = {}
+        # label value → set of keys (only when index_label is set).
+        self._index: Dict[str, set] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(obj: Dict[str, Any]) -> StoreKey:
+        meta = obj.get("metadata", {})
+        return (meta.get("namespace", "default"), meta.get("name", ""))
+
+    def _index_value(self, obj: Dict[str, Any]) -> Optional[str]:
+        if self.index_label is None:
+            return None
+        return obj.get("metadata", {}).get("labels", {}).get(
+            self.index_label)
+
+    def _unindex_locked(self, key: StoreKey,
+                        obj: Dict[str, Any]) -> None:
+        value = self._index_value(obj)
+        if value is not None:
+            bucket = self._index.get(value)
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._index[value]
+
+    def _set_locked(self, key: StoreKey, obj: Dict[str, Any]) -> None:
+        old = self._objects.get(key)
+        if old is not None:
+            self._unindex_locked(key, old)
+        self._objects[key] = obj
+        value = self._index_value(obj)
+        if value is not None:
+            self._index.setdefault(value, set()).add(key)
+
+    def _delete_locked(self, key: StoreKey) -> None:
+        old = self._objects.pop(key, None)
+        if old is not None:
+            self._unindex_locked(key, old)
+
+    # -- mutation (informer loop + write-result absorption) ---------------
+
+    def upsert(self, obj: Dict[str, Any]) -> bool:
+        """Forward-only insert/update; returns whether applied. An
+        object older than (or as old as) the stored copy is a stale
+        echo of a write already absorbed — skipped."""
+        key = self._key(obj)
+        with self._lock:
+            held = self._objects.get(key)
+            if held is not None and _rv(obj) <= _rv(held):
+                return False
+            self._set_locked(key, copy.deepcopy(obj))
+            return True
+
+    def remove(self, obj: Dict[str, Any]) -> bool:
+        """Apply a deletion; returns whether a stored object was
+        removed. Guarded forward-only: a DELETED echo older than the
+        stored copy means the object was deleted AND recreated since —
+        the newer incarnation must survive the late echo."""
+        key = self._key(obj)
+        with self._lock:
+            held = self._objects.get(key)
+            if held is None:
+                return False
+            if _rv(held) > _rv(obj) > 0:
+                return False  # late echo of a previous incarnation
+            self._delete_locked(key)
+            return True
+
+    def discard(self, namespace: str, name: str) -> None:
+        """Unconditional removal (our OWN delete succeeded — the
+        server state is authoritative regardless of versions)."""
+        with self._lock:
+            self._delete_locked((namespace, name))
+
+    def replace(self, items: List[Dict[str, Any]], list_version: int
+                ) -> List[Dict[str, Any]]:
+        """Resync from an authoritative list at revision
+        ``list_version``; returns the objects DROPPED (deleted while
+        the watch was down — the informer dispatches those as DELETED).
+        A stored object newer than the list horizon (an optimistic
+        absorb racing the list) is retained."""
+        listed = {self._key(obj): obj for obj in items}
+        dropped: List[Dict[str, Any]] = []
+        with self._lock:
+            for key in list(self._objects):
+                if key in listed:
+                    continue
+                held = self._objects[key]
+                if _rv(held) > list_version:
+                    continue  # newer than the list snapshot: keep
+                dropped.append(held)
+                self._delete_locked(key)
+            for obj in listed.values():
+                held = self._objects.get(self._key(obj))
+                if held is not None and _rv(obj) <= _rv(held):
+                    continue
+                self._set_locked(self._key(obj), copy.deepcopy(obj))
+        return dropped
+
+    # -- reads ------------------------------------------------------------
+
+    def get(self, namespace: str, name: str) -> Dict[str, Any]:
+        with self._lock:
+            try:
+                return copy.deepcopy(self._objects[(namespace, name)])
+            except KeyError:
+                raise NotFound(
+                    f"{self.kind} {namespace}/{name} (cache)") from None
+
+    def list(self, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, Optional[str]]] = None,
+             field_selector: Optional[Dict[str, str]] = None
+             ) -> List[Dict[str, Any]]:
+        with self._lock:
+            # Fast path: a single-key equality selector on the index
+            # label — the reconciler's per-gang pod list. O(gang), not
+            # O(fleet).
+            if (self.index_label is not None and label_selector
+                    and list(label_selector) == [self.index_label]
+                    and label_selector[self.index_label] is not None):
+                keys = sorted(self._index.get(
+                    label_selector[self.index_label], ()))
+                out = [self._objects[k] for k in keys
+                       if namespace is None or k[0] == namespace]
+            else:
+                out = [obj for key, obj in sorted(self._objects.items())
+                       if (namespace is None or key[0] == namespace)
+                       and _labels_match(obj, label_selector)]
+            if field_selector:
+                out = [o for o in out
+                       if _fields_match(o, field_selector)]
+            return [copy.deepcopy(o) for o in out]
+
+    def keys(self) -> List[StoreKey]:
+        with self._lock:
+            return sorted(self._objects)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+
+class Informer:
+    """One list+watch loop feeding a :class:`Store` and a handler.
+
+    The loop mirrors the r7 controller's watch semantics exactly
+    (tests monkeypatch ``api.watch`` and rely on them): a clean
+    server-side watch timeout re-watches from the last seen version;
+    BOOKMARK frames advance the version without a store write; 410
+    Gone relists immediately (counted in ``gone``, never ``errors``,
+    never backoff-delayed); transport errors count + back off. A
+    periodic full resync (``resync_seconds``) bounds the staleness of
+    any silently-lost event; :meth:`request_resync` forces one at the
+    next loop turn (leadership takeovers)."""
+
+    def __init__(self, api, kind: str, *,
+                 namespace: Optional[str] = None,
+                 label_selector: Optional[Dict[str, Optional[str]]] = None,
+                 index_label: Optional[str] = None,
+                 handler: Optional[Handler] = None,
+                 watch_timeout: float = 30.0,
+                 resync_seconds: float = 300.0,
+                 backoff: Optional[ExponentialBackoff] = None,
+                 clock=time.monotonic):
+        self.api = api
+        self.kind = kind
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.store = Store(kind, index_label=index_label)
+        self.handler = handler
+        self.watch_timeout = watch_timeout
+        self.resync_seconds = resync_seconds
+        self._backoff = backoff or ExponentialBackoff(base=0.2, cap=30.0)
+        self._clock = clock
+        self._resync_requested = threading.Event()
+        # Health counters (the controller aggregates these into its
+        # watchGone/watchErrors surfaces and the metrics ConfigMap).
+        self.gone = 0
+        self.errors = 0
+        self.relists = 0
+        self.bookmarks = 0
+        self.events = 0
+        self.synced = threading.Event()
+
+    def request_resync(self) -> None:
+        """Force a full relist at the next loop turn (e.g. fresh
+        leadership: anything a previous leader half-finished must be
+        re-observed from the server, not trusted to the cache)."""
+        self._resync_requested.set()
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "objects": len(self.store),
+            "events": self.events,
+            "bookmarks": self.bookmarks,
+            "relists": self.relists,
+            "gone": self.gone,
+            "errors": self.errors,
+        }
+
+    def _dispatch(self, event_type: str, obj: Dict[str, Any],
+                  relisted: bool) -> None:
+        if self.handler is None:
+            return
+        try:
+            self.handler(self.kind, event_type, obj, relisted)
+        except Exception:  # noqa: BLE001 — a handler bug must not
+            # kill the sync loop (the cache would silently freeze).
+            logger.exception("%s informer handler failed", self.kind)
+
+    def _relist(self) -> int:
+        """Authoritative list → store resync; dispatches relisted
+        upserts + DELETED for objects dropped while the watch was
+        down. Returns the watch resume version."""
+        items, version = self.api.list_with_version(
+            self.kind, self.namespace, self.label_selector)
+        dropped = self.store.replace(items, version)
+        self.relists += 1
+        for obj in dropped:
+            self._dispatch("DELETED", obj, True)
+        for obj in items:
+            self._dispatch("SYNC", obj, True)
+        self.synced.set()
+        return version
+
+    def run(self, stop: threading.Event) -> None:
+        version = 0
+        consecutive_errors = 0
+        last_list = float("-inf")
+        while not stop.is_set():
+            delay = 0.0
+            try:
+                if (version == 0 or self._resync_requested.is_set()
+                        or self._clock() - last_list
+                        >= self.resync_seconds):
+                    self._resync_requested.clear()
+                    version = self._relist()
+                    last_list = self._clock()
+                for event_type, obj in self.api.watch(
+                        self.kind, self.namespace,
+                        resource_version=version, stop=stop,
+                        timeout=self.watch_timeout,
+                        label_selector=self.label_selector):
+                    version = max(version, _rv(obj))
+                    consecutive_errors = 0
+                    if event_type == "BOOKMARK":
+                        # The payload IS the fresh resume point; no
+                        # object rides a bookmark.
+                        self.bookmarks += 1
+                        continue
+                    self.events += 1
+                    if event_type == "DELETED":
+                        self.store.remove(obj)
+                    else:
+                        self.store.upsert(obj)
+                    self._dispatch(event_type, obj, False)
+                    if self._resync_requested.is_set():
+                        break  # tear the stream down for the resync
+                consecutive_errors = 0
+            except Gone:
+                # 410: our resume point fell out of the server's watch
+                # window. The sanctioned reaction is an immediate
+                # relist — not an error, never backoff-delayed
+                # (backing off would punish the controller for the
+                # server's compaction cadence).
+                logger.info("%s informer compacted (410); relisting",
+                            self.kind)
+                self.gone += 1
+                version = 0
+            except Exception:  # noqa: BLE001 — watch transport
+                logger.exception("%s informer watch failed; relisting",
+                                 self.kind)
+                self.errors += 1
+                consecutive_errors += 1
+                version = 0
+                delay = self._backoff.delay(consecutive_errors)
+            if delay:
+                stop.wait(delay)
+
+
+class CachedApiClient:
+    """Same store surface as the api clients, reads served from
+    informer stores for informed kinds.
+
+    Writes always go through the underlying client; their RESULTS are
+    absorbed into the stores immediately (forward-only), so the watch
+    echo of our own write is a no-op by the time it arrives and a
+    reconcile pass can read-back what it just wrote. Reads of kinds
+    with no informer (Event, ConfigMap, Lease, ...) pass through."""
+
+    def __init__(self, api, stores: Dict[str, Store]):
+        self.api = api
+        self._stores = stores
+
+    # -- reads (store-backed for informed kinds) --------------------------
+
+    def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
+        store = self._stores.get(kind)
+        if store is not None:
+            return store.get(namespace, name)
+        return self.api.get(kind, namespace, name)
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, Optional[str]]] = None,
+             field_selector: Optional[Dict[str, str]] = None
+             ) -> List[Dict[str, Any]]:
+        store = self._stores.get(kind)
+        if store is not None:
+            return store.list(namespace, label_selector, field_selector)
+        return self.api.list(kind, namespace, label_selector,
+                             field_selector)
+
+    # -- writes (pass through + absorb the echo) --------------------------
+
+    def _absorb(self, obj: Optional[Dict[str, Any]]) -> None:
+        if not isinstance(obj, dict):
+            return
+        store = self._stores.get(obj.get("kind", ""))
+        if store is not None:
+            store.upsert(obj)
+
+    def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        created = self.api.create(obj)
+        self._absorb(created)
+        return created
+
+    def patch(self, kind: str, namespace: str, name: str,
+              mutate: Callable[[Dict[str, Any]], None]) -> Dict[str, Any]:
+        updated = self.api.patch(kind, namespace, name, mutate)
+        if isinstance(updated, dict):
+            updated.setdefault("kind", kind)
+        self._absorb(updated)
+        return updated
+
+    def replace(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        updated = self.api.replace(obj)
+        self._absorb(updated)
+        return updated
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        store = self._stores.get(kind)
+        try:
+            self.api.delete(kind, namespace, name)
+        except NotFound:
+            # The server is authoritative: it has no such object, so
+            # neither should the cache.
+            if store is not None:
+                store.discard(namespace, name)
+            raise
+        if store is not None:
+            store.discard(namespace, name)
+
+    # -- everything else (watch, scale, logs, ...) ------------------------
+
+    def __getattr__(self, name: str):
+        return getattr(self.api, name)

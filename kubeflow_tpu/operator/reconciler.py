@@ -41,15 +41,22 @@ operator's cluster-spec assembly
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import datetime
 import logging
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.manifests import k8s
 from kubeflow_tpu.manifests.tpujob import GROUP, KIND, VERSION
-from kubeflow_tpu.operator.fake import Conflict, NotFound
+from kubeflow_tpu.operator.fake import (
+    Conflict,
+    NotFound,
+    ServerError,
+    TooManyRequests,
+)
 from kubeflow_tpu.operator.gang import Decision, PodPhase, decide
 from kubeflow_tpu.training.launcher import (
     DRAIN_EXIT_CODE,
@@ -80,6 +87,10 @@ SLICE_INDEX_LABEL = "kubeflow.org/slice-index"
 # flipped by the phase machinery in _update_conditions.
 STALLED_CONDITION = "ReconcileStalled"
 DEADLINE_CONDITION = "DeadlineExceeded"
+# Gang preemption (r12): the victim wears Preempted (cleared when it
+# reschedules back to Running); the preemptor records PreemptedVictim.
+PREEMPTED_CONDITION = "Preempted"
+PREEMPTOR_CONDITION = "PreemptedVictim"
 
 
 def pod_drained(pod: Optional[Dict[str, Any]]) -> bool:
@@ -152,6 +163,15 @@ def _set_extra_condition(status: Dict[str, Any], cond_type: str,
     return True
 
 
+class _StateMoved(Exception):
+    """Raised inside a status mutation when the freshly-read object
+    no longer satisfies the decision's precondition (e.g. a
+    preemption victim that Succeeded between the cache read and the
+    write). Raising BEFORE any mutation aborts the write cleanly on
+    every client — the read-modify-write TOCTOU guard, same pattern
+    as leader._LostRace."""
+
+
 def _parse_k8s_time(value: Optional[str]
                     ) -> Optional[datetime.datetime]:
     if not value:
@@ -208,6 +228,96 @@ def _scheduling_deadline(job: Dict[str, Any]) -> Optional[float]:
     return deadline if deadline > 0 else None
 
 
+def job_priority(job: Dict[str, Any]) -> int:
+    """spec.priority as an int, 0 (the default class) on absent or
+    garbage — a bad value must neither preempt anyone nor make the
+    job preemptible below its intended class."""
+    raw = job.get("spec", {}).get("priority")
+    if raw is None:
+        return 0
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return 0
+
+
+class PreemptionPolicy:
+    """Gang-preemption knobs + the GLOBAL rate limiter.
+
+    ``deadline_fraction``: a Pending job with ``spec.priority`` > 0
+    and a scheduling deadline becomes eligible to preempt once its
+    time-in-Pending reaches this fraction of the deadline (the r7
+    deadline machinery is the trigger — a job without a deadline never
+    preempts; it has declared it is willing to wait forever).
+    ``min_interval_seconds``: at most one preemption decision fires
+    per interval ACROSS THE FLEET — a priority storm (N high-priority
+    jobs submitted at once) evicts at a bounded, non-thrashing rate
+    instead of flattening every low-priority gang in one pass."""
+
+    def __init__(self, *, deadline_fraction: float = 0.5,
+                 min_interval_seconds: float = 30.0,
+                 clock=time.monotonic):
+        if not 0.0 < deadline_fraction <= 1.0:
+            raise ValueError(
+                f"deadline_fraction must be in (0, 1], got "
+                f"{deadline_fraction}")
+        if min_interval_seconds < 0:
+            raise ValueError("min_interval_seconds must be >= 0")
+        self.deadline_fraction = deadline_fraction
+        self.min_interval_seconds = min_interval_seconds
+        self._clock = clock
+        self._last: Optional[float] = None
+        self._lock = threading.Lock()
+        # Counters for the stats/metrics surface.
+        self.eligible = 0
+        self.granted = 0
+        self.rate_limited = 0
+        self.no_victim = 0
+
+    def try_acquire(self) -> Optional[float]:
+        """Claim the global preemption interval if it has elapsed;
+        returns the grant token (truthy) or None when rate-limited.
+        The ``granted`` counter moves only at :meth:`commit` —
+        AFTER the eviction's first write lands — so the Prometheus
+        counter bound to it stays monotone (a decrementing Counter
+        reads as a reset and corrupts rate())."""
+        with self._lock:
+            now = self._clock()
+            if (self._last is not None
+                    and now - self._last < self.min_interval_seconds):
+                self.rate_limited += 1
+                return None
+            self._prev_last = self._last
+            self._last = now
+            return now
+
+    def commit(self) -> None:
+        """The eviction's victim record landed: count it."""
+        with self._lock:
+            self.granted += 1
+
+    def rollback(self, token: float) -> None:
+        """Release a claim: the eviction aborted before ANY cluster
+        state changed (victim status write lost its race), so the
+        fleet must not serve the interval for it. The clock is
+        restored only if OUR claim is still the latest — an eviction
+        attempt that outlived the interval must not erase a newer
+        claim's cooldown."""
+        with self._lock:
+            if self._last == token:
+                self._last = self._prev_last
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "deadlineFraction": self.deadline_fraction,
+            "minIntervalSeconds": self.min_interval_seconds,
+            "eligible": self.eligible,
+            "granted": self.granted,
+            "rateLimited": self.rate_limited,
+            "noVictim": self.no_victim,
+        }
+
+
 def expected_members(job: Dict[str, Any]) -> List[ReplicaMember]:
     """Every expected pod, slice-major (slice 0's replicas first) —
     the order that makes the global TPU_WORKER process ids put the
@@ -240,17 +350,35 @@ def chief_member_index(job: Dict[str, Any],
 
 
 class Reconciler:
-    def __init__(self, api, *, max_restarts: int = DEFAULT_MAX_RESTARTS,
+    def __init__(self, api, *, reader=None,
+                 max_restarts: int = DEFAULT_MAX_RESTARTS,
                  completion_grace_passes: int =
-                 DEFAULT_COMPLETION_GRACE_PASSES):
+                 DEFAULT_COMPLETION_GRACE_PASSES,
+                 preemption: Optional[PreemptionPolicy] = None):
         self.api = api
+        # The READ path of the reconcile hot loop: an informer-backed
+        # CachedApiClient under the watch controller (zero apiserver
+        # requests per pass), or the api itself in direct/poll mode.
+        # scripts/lint.py check_operator_read_discipline enforces that
+        # hot-path reads go through self.reader, so the cache split
+        # can't silently erode.
+        self.reader = reader if reader is not None else api
         self.max_restarts = max_restarts
         self.completion_grace_passes = completion_grace_passes
+        self.preemption = preemption or PreemptionPolicy()
         # Per-pass, PER-THREAD (N controller workers share one
         # Reconciler): seconds after which this job wants another
         # look even with no events (a pending schedulingDeadline).
         # The watch controller turns it into a workqueue timer.
         self._pass_state = threading.local()
+
+    def attach_cache(self, cached) -> None:
+        """Rebind both paths onto an informer-backed CachedApiClient
+        (reads from the store, writes through-and-absorbed). Called by
+        the watch controller; the underlying api client is unchanged —
+        the cache wraps it."""
+        self.api = cached
+        self.reader = cached
 
     @property
     def requeue_after(self) -> Optional[float]:
@@ -433,7 +561,7 @@ class Reconciler:
                            ("PodDisruptionBudget",
                             lambda: self._gang_pdb(job, len(members)))):
             try:
-                existing = self.api.get(kind, ns, name)
+                existing = self.reader.get(kind, ns, name)
                 if (kind == "PodDisruptionBudget"
                         and existing["spec"].get("minAvailable")
                         != len(members)):
@@ -462,7 +590,7 @@ class Reconciler:
                     pass
 
         pods = {p["metadata"]["name"]: p
-                for p in self.api.list("Pod", ns, {JOB_LABEL: name})}
+                for p in self.reader.list("Pod", ns, {JOB_LABEL: name})}
         restarts = int(status.get("restartCount", 0))
 
         if phase == "Restarting":
@@ -520,13 +648,42 @@ class Reconciler:
                         f"Pending {age:.0f}s >= deadline "
                         f"{int(deadline)}s"),
                     event_reason=DEADLINE_CONDITION)
+            # Gang preemption: a high-priority gang burning through
+            # its scheduling deadline means chips are scarce — evict
+            # the lowest-priority running gang to make room, at a
+            # globally rate-limited cadence. Driven by the same
+            # live-pod predicate as the deadline itself: only a gang
+            # with a genuine scheduling attempt outstanding preempts.
+            # ONE victim per Pending episode (the PreemptedVictim
+            # condition is the latch, cleared when the job runs): a
+            # gang that still cannot place after its victim's chips
+            # freed is doomed anyway — its deadline fails it instead
+            # of it cascading down the priority ladder.
+            priority = job_priority(job)
+            already_made_room = any(
+                c.get("type") == PREEMPTOR_CONDITION
+                and c.get("status") == "True"
+                for c in status.get("conditions", []))
+            if (priority > 0 and awaiting_schedule
+                    and not already_made_room and age is not None
+                    and age >= deadline
+                    * self.preemption.deadline_fraction):
+                self._maybe_preempt(job, priority)
             if age is not None and all(
                     p in (PodPhase.PENDING, PodPhase.MISSING)
                     for p in phases):
                 # Ask to be re-observed right when the deadline lands
                 # (events are quiescent for a stuck-Pending gang; the
-                # relist period alone could overshoot by a resync).
-                self.requeue_after = max(0.0, deadline - age)
+                # relist period alone could overshoot by a resync) —
+                # and, for a priority job, also at the earlier
+                # preemption-eligibility instant.
+                wake = max(0.0, deadline - age)
+                if priority > 0:
+                    trigger = (deadline * self.preemption.deadline_fraction
+                               - age)
+                    if trigger > 0:
+                        wake = min(wake, trigger)
+                self.requeue_after = wake
 
         allow_restart = job["spec"].get("recoveryPolicy",
                                         "restart-slice") == "restart-slice"
@@ -618,10 +775,11 @@ class Reconciler:
         # status — the same dashboard regression as the CREATE_MISSING
         # branch (exposed by the r5 event-emission test: the flap
         # emitted spurious Pending/Running event pairs every restart).
-        running = (any(p == PodPhase.RUNNING for p in phases)
-                   or phase == "Running")
+        pods_running = any(p == PodPhase.RUNNING for p in phases)
+        running = pods_running or phase == "Running"
         return self._set_status(job, "Running" if running else "Pending",
-                                restart_count=restarts)
+                                restart_count=restarts,
+                                pods_running=pods_running)
 
     def _pending_age(self, job: Dict[str, Any]) -> Optional[float]:
         """Seconds this job has been Pending, anchored on the Pending
@@ -639,6 +797,145 @@ class Reconciler:
                 if anchor is not None:
                     return (now - anchor).total_seconds()
         return None
+
+    # -- gang preemption --------------------------------------------------
+
+    def _select_victim(self, job: Dict[str, Any],
+                       priority: int) -> Optional[Dict[str, Any]]:
+        """THE lowest-priority chip-holding gang strictly below
+        ``priority`` — never an equal-or-higher class, never more
+        than one per decision. Candidacy is POD truth, not the
+        display phase: a gang recreated after a restart/preemption
+        reads phase Running while its pods sit Pending, and evicting
+        it would burn the fleet's rate-limit interval to free zero
+        chips. Ties break youngest-first (the gang that has had the
+        least time to make progress loses, k8s-style), then name for
+        determinism."""
+        me = (job["metadata"].get("namespace", "default"),
+              job["metadata"]["name"])
+
+        def holds_chips(other: Dict[str, Any]) -> bool:
+            ons = other["metadata"].get("namespace", "default")
+            oname = other["metadata"]["name"]
+            return any(
+                p.get("status", {}).get("phase") == "Running"
+                for p in self.reader.list("Pod", ons,
+                                          {JOB_LABEL: oname}))
+
+        def prefer(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
+            """a is the better victim than b."""
+            pa, pb = job_priority(a), job_priority(b)
+            if pa != pb:
+                return pa < pb
+            ca = a["metadata"].get("creationTimestamp", "")
+            cb = b["metadata"].get("creationTimestamp", "")
+            if ca != cb:
+                return ca > cb  # youngest loses its slot first
+            return a["metadata"]["name"] < b["metadata"]["name"]
+
+        best = None
+        for other in self.reader.list(KIND):
+            meta = other.get("metadata", {})
+            if (meta.get("namespace", "default"), meta.get("name")) == me:
+                continue
+            if other.get("status", {}).get("phase") != "Running":
+                continue
+            if job_priority(other) >= priority:
+                continue  # the invariant: never equal-or-higher
+            if not holds_chips(other):
+                continue  # display-Running, chip-less: nothing to free
+            if best is None or prefer(other, best):
+                best = other
+        return best
+
+    def _maybe_preempt(self, job: Dict[str, Any],
+                       priority: int) -> bool:
+        """One preemption decision for a deadline-pressured
+        high-priority Pending gang: pick the single victim, consume
+        the global rate-limit token, tear the victim's gang down
+        cleanly (Preempted condition + Warning Event, no restart
+        budget burned — the platform evicted it, it didn't crash) and
+        record PreemptedVictim on the preemptor."""
+        name = job["metadata"]["name"]
+        ns = job["metadata"].get("namespace", "default")
+        self.preemption.eligible += 1
+        victim = self._select_victim(job, priority)
+        if victim is None:
+            self.preemption.no_victim += 1
+            return False
+        token = self.preemption.try_acquire()
+        if token is None:
+            return False  # rate-limited: re-observed at requeue/relist
+        vmeta = victim["metadata"]
+        vns = vmeta.get("namespace", "default")
+        vname = vmeta["name"]
+        vpriority = job_priority(victim)
+        logger.warning(
+            "preempting %s/%s (priority %d) for %s/%s (priority %d)",
+            vns, vname, vpriority, ns, name, priority)
+        restarts = int(victim.get("status", {}).get("restartCount", 0))
+        detail = (f"preempted by higher-priority {ns}/{name} "
+                  f"(priority {vpriority} < {priority})")
+        # Status BEFORE teardown, preconditioned on the victim still
+        # being the gang we decided to evict: the cache read may
+        # trail the server, and a victim that meanwhile Succeeded (or
+        # Failed, or was itself preempted) must NOT be flipped back
+        # to Restarting and rerun. A lost optimistic-concurrency race
+        # or a moved phase aborts the whole decision — never delete a
+        # gang the record doesn't mark Preempted.
+        try:
+            self._set_status(
+                victim, "Restarting", restart_count=restarts,
+                reason=f"{detail}; gang torn down, restart budget "
+                       f"{restarts}/{self.max_restarts} unchanged",
+                extra_condition=(PREEMPTED_CONDITION, detail),
+                event_reason=PREEMPTED_CONDITION,
+                require_phase="Running")
+        except (Conflict, _StateMoved) as err:
+            # Nothing was evicted: hand the interval token back so
+            # the retry (or another starving gang) isn't refused for
+            # a preemption that never happened.
+            self.preemption.rollback(token)
+            logger.info("preemption of %s/%s aborted (%s); "
+                        "will re-evaluate", vns, vname,
+                        type(err).__name__)
+            return False
+        self.preemption.commit()
+        for m in expected_members(victim):
+            try:
+                self.api.delete("Pod", vns, m.pod_name(vname))
+            except NotFound:
+                pass
+        # The preemptor's side of the record, written DURABLY before
+        # the pass continues: the PreemptedVictim latch is what
+        # enforces one-victim-per-Pending-episode, so it must land
+        # even if the pass's own final status write later loses a
+        # race (a lost latch would evict a second victim on retry).
+        # Conflict-retried — read-modify-write converges.
+        record = (f"preempted {vns}/{vname} "
+                  f"(priority {vpriority} < {priority})")
+        for attempt in range(3):
+            try:
+                self.api.patch(
+                    KIND, ns, name,
+                    lambda o: _set_extra_condition(
+                        o.setdefault("status", {}),
+                        PREEMPTOR_CONDITION, "True", record))
+                break
+            except Conflict:
+                if attempt == 2:
+                    logger.warning(
+                        "PreemptedVictim latch for %s/%s kept "
+                        "losing races; the episode may preempt "
+                        "again after the rate-limit interval",
+                        ns, name)
+            except NotFound:
+                break  # preemptor deleted mid-pass
+        self._record_event(job, f"{name}.preemptedvictim",
+                           PREEMPTOR_CONDITION,
+                           f"TPUJob {record} to make room for this "
+                           f"gang", "Normal")
+        return True
 
     # -- quarantine surface (driven by the watch controller) --------------
 
@@ -660,9 +957,16 @@ class Reconciler:
             lambda o: _set_extra_condition(
                 o.setdefault("status", {}), STALLED_CONDITION,
                 "True", reason))
+        # best_effort=False: a transient 429/500 on the Event create
+        # propagates, so the caller's not-yet-latched bookkeeping
+        # retries BOTH writes at the next capped attempt (the
+        # condition patch is a no-op by then) — otherwise the Warning
+        # Event is silently lost forever the one time the apiserver
+        # sheds it.
         self._record_event(
             job, f"{name}.reconcilestalled", STALLED_CONDITION,
-            f"TPUJob reconcile stalled: {reason}", "Warning")
+            f"TPUJob reconcile stalled: {reason}", "Warning",
+            best_effort=False)
 
     def clear_stalled(self, namespace: str, name: str) -> None:
         """Reconcile succeeded again: flip ReconcileStalled to False
@@ -682,11 +986,14 @@ class Reconciler:
 
     def _record_event(self, job: Dict[str, Any], event_name: str,
                       reason: str, message: str,
-                      event_type: str) -> None:
-        """Create-or-aggregate one k8s Event. Best-effort: an event
-        that can't be written must never fail the reconcile pass.
-        The deterministic name makes retries of the same transition
-        dedupe via Conflict instead of piling up."""
+                      event_type: str, *,
+                      best_effort: bool = True) -> None:
+        """Create-or-aggregate one k8s Event. Best-effort by default:
+        an event that can't be written must never fail the reconcile
+        pass. ``best_effort=False`` re-raises TRANSIENT failures
+        (429/5xx) so a caller with retry machinery can re-attempt
+        delivery. The deterministic name makes retries of the same
+        transition dedupe via Conflict instead of piling up."""
         name = job["metadata"]["name"]
         ns = job["metadata"].get("namespace", "default")
         now = datetime.datetime.now(
@@ -738,6 +1045,10 @@ class Reconciler:
                     self.api.create(event)
             except Exception:  # noqa: BLE001
                 pass
+        except (TooManyRequests, ServerError):
+            if not best_effort:
+                raise
+            logger.exception("event emission failed for %s/%s", ns, name)
         except Exception:  # noqa: BLE001 — events are best-effort
             logger.exception("event emission failed for %s/%s", ns, name)
 
@@ -762,13 +1073,26 @@ class Reconciler:
                     completion_skew: int = 0,
                     reason: Optional[str] = None,
                     extra_condition: Optional[Tuple[str, str]] = None,
-                    event_reason: Optional[str] = None) -> str:
+                    event_reason: Optional[str] = None,
+                    pods_running: bool = False,
+                    require_phase: Optional[str] = None) -> str:
         name = job["metadata"]["name"]
         ns = job["metadata"].get("namespace", "default")
         previous_phase = job.get("status", {}).get("phase")
 
         def mutate(obj):
             status = obj.setdefault("status", {})
+            if (require_phase is not None
+                    and status.get("phase", "Pending")
+                    != require_phase):
+                # Precondition check BEFORE any mutation: the write
+                # was decided against a (possibly stale) read; if the
+                # server object has moved on, abort cleanly on every
+                # client (cross-job writes like preemption must never
+                # stomp an advanced state).
+                raise _StateMoved(
+                    f"{ns}/{name} is {status.get('phase')!r}, "
+                    f"decision required {require_phase!r}")
             status["phase"] = phase
             status["restartCount"] = restart_count
             # Any non-hold decision resets the skew counter (writes 0).
@@ -794,6 +1118,40 @@ class Reconciler:
                    for c in status.get("conditions", [])):
                 _set_extra_condition(status, STALLED_CONDITION,
                                      "False", "reconcile recovered")
+            # A preempted gang whose pods ACTUALLY run again has
+            # rescheduled: lift the Preempted banner (it is an alert,
+            # not a biography). Pod truth, not the phase — a
+            # recreated-but-unschedulable gang reads phase Running by
+            # the post-restart display convention while its pods sit
+            # Pending, and ITS banner must stay up. Same for the
+            # preemptor's PreemptedVictim latch — clearing it re-arms
+            # preemption for a future Pending episode; the Events
+            # keep history.
+            if pods_running:
+                for cond_type, note in (
+                        (PREEMPTED_CONDITION,
+                         "rescheduled after preemption"),
+                        (PREEMPTOR_CONDITION,
+                         "scheduled; victim record retired")):
+                    if any(c.get("type") == cond_type
+                           and c.get("status") == "True"
+                           for c in status.get("conditions", [])):
+                        _set_extra_condition(status, cond_type,
+                                             "False", note)
+
+        # Steady-state suppression: if the mutation would change
+        # nothing, skip the apiserver round trip entirely. The fake
+        # already suppressed no-change PUTs server-side; doing it
+        # client-side keeps a converged fleet's write QPS at ZERO
+        # (with informer reads, a steady-state reconcile then touches
+        # the apiserver not at all). Bounded-staleness caveat: `job`
+        # may trail the server by the watch latency — a skipped write
+        # is re-evaluated on the next event/relist, which is exactly
+        # the level-triggered contract.
+        probe = copy.deepcopy(job)
+        mutate(probe)
+        if probe == job:
+            return phase
 
         try:
             self.api.patch(KIND, ns, name, mutate)

@@ -199,7 +199,7 @@ class WorkQueue:
         with self._cond:
             self._add_locked(key)
 
-    def _add_locked(self, key: Hashable) -> None:
+    def _add_locked(self, key: Hashable, track: bool = True) -> None:
         self._adds += 1
         if key in self._processing:
             self._dirty.add(key)
@@ -211,7 +211,12 @@ class WorkQueue:
         self._delayed_due.pop(key, None)
         self._ready.append(key)
         self._ready_set.add(key)
-        self._enqueued_at.setdefault(key, self._clock())
+        if track:
+            # Latency sampling is EVENT-path only (track=False on
+            # relist sweeps): "event→reconcile latency" must measure
+            # reaction to new information, not the amortized drain of
+            # a level-triggered sweep that enqueues the whole fleet.
+            self._enqueued_at.setdefault(key, self._clock())
         self._cond.notify()
 
     def add_unless_delayed(self, key: Hashable) -> None:
@@ -229,7 +234,7 @@ class WorkQueue:
                 return
             if key in self._processing and self._failures.get(key, 0):
                 return  # its own retry/forget will decide what's next
-            self._add_locked(key)
+            self._add_locked(key, track=False)
 
     def add_after(self, key: Hashable, delay: float) -> None:
         if delay <= 0:
@@ -372,6 +377,31 @@ class WorkQueue:
         """Recent enqueue→dequeue latency samples (seconds)."""
         with self._cond:
             return list(self._latencies)
+
+    def drain_latencies(self) -> List[float]:
+        """Return AND clear the sample window — phase-segmented
+        measurement (the scale bench drains before a churn wave so
+        the churn percentiles can never be contaminated by converge
+        backlog, wrapped window or not)."""
+        with self._cond:
+            out = list(self._latencies)
+            self._latencies.clear()
+            return out
+
+    def latency_percentiles(self) -> Dict[str, float]:
+        """p50/p90/p99 of the recent enqueue→dequeue window, in
+        milliseconds — the event→reconcile latency the scale bench
+        and the metrics ConfigMap report."""
+        samples = sorted(self.latencies())
+        if not samples:
+            return {"p50": 0.0, "p90": 0.0, "p99": 0.0}
+
+        def pct(p: float) -> float:
+            idx = min(len(samples) - 1,
+                      max(0, round(p / 100.0 * (len(samples) - 1))))
+            return round(samples[idx] * 1e3, 2)
+
+        return {"p50": pct(50), "p90": pct(90), "p99": pct(99)}
 
     def stats(self) -> Dict[str, Any]:
         """Snapshot for the metrics surface: depth, in-flight, per-key

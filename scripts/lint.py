@@ -286,6 +286,63 @@ def check_operator_wait_discipline() -> list:
     return errors
 
 
+# Reconciler methods allowed to read through self.api: the write
+# path's read-modify-write bookkeeping (quarantine surfacing, event
+# aggregation) — NOT the reconcile hot loop.
+_READ_DISCIPLINE_ALLOWLIST = {
+    "reconciler.py": {"mark_stalled", "clear_stalled", "_record_event",
+                      "_emit_event", "_set_status", "__init__",
+                      "attach_cache"},
+    # "run" holds the direct-mode relist fallback (informer_reads=
+    # False, the benchmark's QPS-contrast path) — gated, not hot.
+    "controller.py": {"publish_metrics", "__init__", "run"},
+}
+
+
+def check_operator_read_discipline() -> list:
+    """The reconcile hot path reads via the informer store (ISSUE 7):
+    inside ``Reconciler``'s reconcile-path methods (and the
+    controller's worker path) forbid ``self.api.get(...)`` /
+    ``self.api.list(...)`` — reads must ride ``self.reader`` (the
+    informer-backed CachedApiClient under the watch controller), or
+    steady-state apiserver QPS silently grows with fleet size again.
+    The allowlist covers write-path read-modify-write bookkeeping
+    (mark_stalled & co.), where a direct read is the point."""
+    errors = []
+    for fname, allowed in sorted(_READ_DISCIPLINE_ALLOWLIST.items()):
+        path = REPO / "kubeflow_tpu" / "operator" / fname
+        tree = ast.parse(path.read_text(), str(path))
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef) or cls.name not in (
+                    "Reconciler", "WatchController"):
+                continue
+            for method in cls.body:
+                if not isinstance(method, ast.FunctionDef):
+                    continue
+                if method.name in allowed:
+                    continue
+                for node in ast.walk(method):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    func = node.func
+                    if not (isinstance(func, ast.Attribute)
+                            and func.attr in ("get", "list",
+                                              "list_with_version")):
+                        continue
+                    base = func.value
+                    if (isinstance(base, ast.Attribute)
+                            and base.attr == "api"
+                            and isinstance(base.value, ast.Name)
+                            and base.value.id == "self"):
+                        errors.append(
+                            f"operator-read: {path.relative_to(REPO)}:"
+                            f"{node.lineno}: self.api.{func.attr} in "
+                            f"{cls.name}.{method.name} — hot-path "
+                            f"reads go through self.reader (the "
+                            f"informer cache), not the apiserver")
+    return errors
+
+
 def check_serving_timeout_discipline() -> list:
     """Every network wait in the serving data plane must be bounded
     (ISSUE 3 — the mirror of the operator wait-discipline rule): under
@@ -496,6 +553,7 @@ def main() -> int:
     errors = []
     for check in (check_syntax, check_imports_all_modules, check_cli_boots,
                   check_unused_imports, check_operator_wait_discipline,
+                  check_operator_read_discipline,
                   check_serving_timeout_discipline,
                   check_service_print_discipline,
                   check_metric_label_discipline,

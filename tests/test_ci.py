@@ -53,13 +53,20 @@ def test_e2e_workflow_manifest():
     assert wf["spec"]["onExit"] == "exit-handler"
     names = {t["name"] for t in wf["spec"]["templates"]}
     for step in ("checkout", "unit-test", "deploy-test", "tpujob-test",
-                 "serving-test", "teardown", "copy-artifacts", "e2e"):
+                 "serving-test", "leader-failover-test", "teardown",
+                 "copy-artifacts", "e2e"):
         assert step in names, step
     dag = next(t for t in wf["spec"]["templates"] if t["name"] == "e2e")
     deps = {t["name"]: t.get("dependencies", [])
             for t in dag["dag"]["tasks"]}
     assert deps["tpujob-test"] == ["deploy-test"]
     assert deps["deploy-test"] == ["checkout"]
+    # Hermetic citests ride the checkout alone (no cluster deploy).
+    assert deps["leader-failover-test"] == ["checkout"]
+    failover = next(t for t in wf["spec"]["templates"]
+                    if t["name"] == "leader-failover-test")
+    assert "kubeflow_tpu.citests.leader_failover" in \
+        failover["container"]["command"]
 
 
 def test_release_workflow_manifest():
@@ -90,6 +97,18 @@ def test_deploy_and_tpujob_fake_e2e(tmp_path):
                          "--junit_path", str(junit_job)])
     assert rc == 0
     root = ET.parse(junit_job).getroot()
+    assert root.get("failures") == "0" and root.get("errors") == "0"
+
+
+def test_leader_failover_fake_e2e(tmp_path):
+    """The leader-failover-mid-restart citest green in the CI DAG
+    (r12 acceptance): the same driver the DAG step runs, end to end."""
+    from kubeflow_tpu.citests import leader_failover as ci_failover
+
+    junit_path = tmp_path / "junit_leader_failover.xml"
+    rc = ci_failover.main(["--fake", "--junit_path", str(junit_path)])
+    assert rc == 0
+    root = ET.parse(junit_path).getroot()
     assert root.get("failures") == "0" and root.get("errors") == "0"
 
 

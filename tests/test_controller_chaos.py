@@ -71,10 +71,11 @@ def _controller(api, **kwargs):
 def test_50_jobs_converge_under_chaos_and_poison_job_quarantines():
     """Acceptance: conflict storms + 429 bursts + 500s + dropped
     watches; 50 jobs converge to Running with zero hot-looping, and a
-    poison job (its Service GET always 500s) quarantines — request
-    rate ≤ 1 reconcile attempt per backoff-cap interval, verified by
-    the apiserver's request log — then recovers once the fault
-    lifts."""
+    poison job (its pod CREATE always 500s — a write, so the fault
+    still bites through the informer cache: reads never leave the
+    process in r12) quarantines — request rate ≤ 1 reconcile attempt
+    per backoff-cap interval, verified by the apiserver's request log
+    — then recovers once the fault lifts."""
     api = FakeApiServer()
     writes = ("create", "patch", "replace", "delete")
     api.faults.add_rule(lambda: Conflict("injected conflict storm"),
@@ -84,11 +85,11 @@ def test_50_jobs_converge_under_chaos_and_poison_job_quarantines():
     api.faults.add_rule(lambda: ServerError("injected 500"),
                         rate=0.03)
     api.faults.watch_max_events = 25  # recurring watch drops
-    # The poison job: every reconcile pass dies on its Service GET —
+    # The poison job: every reconcile pass dies creating its gang —
     # upstream of any status write, so quarantine surfacing works.
     poison_rule = api.faults.add_rule(
-        lambda: ServerError("poison: service GET down"),
-        verbs=("get",), kind="Service", name="^poison$")
+        lambda: ServerError("poison: pod create down"),
+        verbs=("create",), kind="Pod", name="^poison-")
 
     names = [f"cj{i:02d}" for i in range(50)]
     with api.as_kubelet():
@@ -125,8 +126,8 @@ def test_50_jobs_converge_under_chaos_and_poison_job_quarantines():
         # retries are the correct behavior.)
         api.faults.clear()
         poison_rule = api.faults.add_rule(
-            lambda: ServerError("poison: service GET down"),
-            verbs=("get",), kind="Service", name="^poison$")
+            lambda: ServerError("poison: pod create down"),
+            verbs=("create",), kind="Pod", name="^poison-")
 
         # Poison job quarantined: condition + Event surfaced.
         def stalled():
@@ -152,15 +153,16 @@ def test_50_jobs_converge_under_chaos_and_poison_job_quarantines():
 
         # Zero hot-looping: over a window of several cap intervals,
         # the quarantined job sees at most one reconcile attempt per
-        # cap interval (each attempt = one worker TPUJob GET; the
-        # quarantine path's own bookkeeping GET at most doubles it),
-        # plus slack for the window boundary. Relists must NOT reset
-        # the parking.
+        # cap interval (each attempt = one failing pod CREATE; the
+        # quarantine path's bookkeeping at most doubles it), plus
+        # slack for the window boundary. Relists must NOT reset the
+        # parking. (Reads no longer reach the apiserver at all — the
+        # request log shows writes only.)
         cap = ctl.queue.backoff.cap
         window = 4 * cap
         t0 = time.monotonic()
         time.sleep(window)
-        attempts = api.request_count(verb="get", kind=KIND,
+        attempts = api.request_count(verb="create", kind="Pod",
                                      name="poison", since=t0)
         assert attempts <= 2 * (window / cap) + 2, \
             f"poison job hot-looped: {attempts} attempts in {window}s"
@@ -319,13 +321,44 @@ def test_controller_load_bench_smoke():
     assert result["rows"][1]["workers"] == 2
 
 
+def test_controller_scale_bench_smoke():
+    """The r12 scale bench harness (wired as `bench.py --controller`)
+    at test size: both modes converge through churn + poison storm,
+    and the informer row's steady-state requests/reconcile undercuts
+    the direct row's (the QPS-flatness contrast at full size lives in
+    PERF.md r12)."""
+    from kubeflow_tpu.operator.benchmark import (
+        run_controller_scale_bench,
+    )
+
+    result = run_controller_scale_bench(
+        jobs=16, workers=4, churn_kills=4, poison_jobs=1,
+        relist_seconds=0.5, converge_timeout=30.0, churn_timeout=30.0,
+        steady_window=1.5)
+    rows = {row["informer"]: row for row in result["rows"]}
+    assert set(rows) == {True, False}
+    for row in rows.values():
+        assert row["converged"], row
+        assert row["churn"]["reconverged"], row
+        assert row["poison_quarantined"] >= 1, row
+        assert set(row["event_to_reconcile_ms"]) == {"p50", "p90",
+                                                     "p99"}
+    informer, direct = rows[True], rows[False]
+    assert informer["steady"]["requests_per_reconcile"] < \
+        direct["steady"]["requests_per_reconcile"], (informer, direct)
+    assert informer["informer_stats"]["Pod"]["objects"] == 16
+
+
 def test_reconcile_get_failures_also_backoff():
     """A job whose GET itself fails (not just reconcile internals)
-    still routes through retry/backoff, not a hot loop."""
+    still routes through retry/backoff, not a hot loop. Direct-read
+    mode: with informer reads the per-pass GET doesn't exist (the
+    cache serves it), but the path survives for poll mode and the
+    benchmark's QPS contrast, and must keep its backoff discipline."""
     api = FakeApiServer()
     api.faults.add_rule(lambda: ServerError("get down"),
                         verbs=("get",), kind=KIND, name="^gone$")
-    ctl, t = _controller(api, workers=1)
+    ctl, t = _controller(api, workers=1, informer_reads=False)
     try:
         with api.as_kubelet():
             api.create(make_job(name="gone", workers=1))

@@ -432,6 +432,61 @@ class DashboardTest(tornado.testing.AsyncHTTPTestCase):
         assert any(e["reason"] == DEADLINE_CONDITION
                    for e in detail["events"]), detail["events"]
 
+    def test_preemption_conditions_surface_in_detail_and_ui(self):
+        """Preempted rides the warning banner on the victim;
+        PreemptedVictim rides the detail `notices` + an info banner on
+        the preemptor — both from the reconciler's own preemption
+        writes (r12), and both Events in the events table."""
+        from kubeflow_tpu.operator import PreemptionPolicy, Reconciler
+        from kubeflow_tpu.operator.reconciler import (
+            PREEMPTED_CONDITION,
+            PREEMPTOR_CONDITION,
+        )
+
+        from tests.test_preemption import _age_pending, make_pjob
+
+        r = Reconciler(self.api, preemption=PreemptionPolicy(
+            min_interval_seconds=0.0))
+        with self.api.as_kubelet():
+            # Youngest-loses tie-break: a fresh creationTimestamp
+            # makes THIS job the deterministic victim (the fixture's
+            # Running "mnist" job carries none).
+            self.api.create(make_pjob("victim", priority=0,
+                                      created="2026-08-01T00:00:00Z"))
+        r.reconcile(self.api.get(KIND, "default", "victim"))
+        with self.api.as_kubelet():
+            self.api.set_all_pod_phases("default", "Running",
+                                        {JOB_LABEL: "victim"})
+        r.reconcile(self.api.get(KIND, "default", "victim"))
+        with self.api.as_kubelet():
+            self.api.create(make_pjob("vip", priority=5, deadline=100))
+        r.reconcile(self.api.get(KIND, "default", "vip"))
+        _age_pending(self.api, "vip", seconds=60)
+        r.reconcile(self.api.get(KIND, "default", "vip"))
+
+        detail = json.loads(
+            self.fetch("/tpujobs/api/tpujob/default/victim").body)
+        assert [w["type"] for w in detail["warnings"]] == \
+            [PREEMPTED_CONDITION]
+        assert "vip" in detail["warnings"][0]["reason"]
+        assert any(e["reason"] == PREEMPTED_CONDITION
+                   for e in detail["events"]), detail["events"]
+        page = self.fetch(
+            "/tpujobs/ui/job/default/victim").body.decode()
+        assert PREEMPTED_CONDITION in page
+
+        detail = json.loads(
+            self.fetch("/tpujobs/api/tpujob/default/vip").body)
+        assert detail["warnings"] == []  # evicting is not an alert
+        assert [n["type"] for n in detail["notices"]] == \
+            [PREEMPTOR_CONDITION]
+        assert "victim" in detail["notices"][0]["reason"]
+        assert detail["summary"]["priority"] == 5
+        assert any(e["reason"] == PREEMPTOR_CONDITION
+                   for e in detail["events"]), detail["events"]
+        page = self.fetch("/tpujobs/ui/job/default/vip").body.decode()
+        assert PREEMPTOR_CONDITION in page
+
     def test_operator_metrics_endpoint(self):
         """GET /tpujobs/api/operator serves the metrics ConfigMap the
         controller publishes — the dashboard and the load bench read

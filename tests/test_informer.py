@@ -1,0 +1,394 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Informer cache (ISSUE 7 tentpole): store semantics (forward-only
+resourceVersion, label index, resync diffing), the list+watch loop
+(Gone resync, bookmark-advanced resume over HTTP), write-echo
+absorption, and the headline property — steady-state apiserver
+requests per reconcile stay FLAT as the fleet grows, measured from
+the fake apiserver's request log."""
+
+import threading
+import time
+
+from kubeflow_tpu.manifests.tpujob import KIND
+from kubeflow_tpu.operator import FakeApiServer
+from kubeflow_tpu.operator.controller import WatchController
+from kubeflow_tpu.operator.fake import NotFound
+from kubeflow_tpu.operator.http_client import HttpApiClient
+from kubeflow_tpu.operator.informer import (
+    CachedApiClient,
+    Informer,
+    Store,
+)
+from kubeflow_tpu.operator.reconciler import JOB_LABEL
+from kubeflow_tpu.operator.workqueue import ExponentialBackoff, TokenBucket
+
+import pytest
+
+from tests._http_apiserver import HttpFakeApiServer
+from tests.test_operator import make_job
+
+
+def _pod(name, ns="default", rv="1", job=None):
+    labels = {JOB_LABEL: job} if job else {}
+    return {"kind": "Pod", "metadata": {
+        "name": name, "namespace": ns, "resourceVersion": rv,
+        "labels": labels}}
+
+
+def _wait_for(predicate, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# -- Store ----------------------------------------------------------------
+
+
+def test_store_forward_only_and_get():
+    s = Store("Pod")
+    assert s.upsert(_pod("a", rv="5"))
+    assert not s.upsert(_pod("a", rv="4")), "stale echo applied"
+    assert not s.upsert(_pod("a", rv="5")), "same-version echo applied"
+    assert s.upsert(_pod("a", rv="6"))
+    assert s.get("default", "a")["metadata"]["resourceVersion"] == "6"
+    with pytest.raises(NotFound):
+        s.get("default", "missing")
+
+
+def test_store_delete_guards_recreated_object():
+    """A late DELETED echo of a PREVIOUS incarnation must not remove
+    the newer object created since (the optimistic-absorb race)."""
+    s = Store("Pod")
+    s.upsert(_pod("a", rv="3"))
+    s.discard("default", "a")        # our own delete succeeded
+    s.upsert(_pod("a", rv="9"))      # recreated (absorbed)
+    assert not s.remove(_pod("a", rv="3")), "late echo killed the heir"
+    assert s.get("default", "a")["metadata"]["resourceVersion"] == "9"
+    assert s.remove(_pod("a", rv="9"))
+    with pytest.raises(NotFound):
+        s.get("default", "a")
+
+
+def test_store_label_index_and_list():
+    s = Store("Pod", index_label=JOB_LABEL)
+    s.upsert(_pod("a-0", rv="1", job="a"))
+    s.upsert(_pod("a-1", rv="2", job="a"))
+    s.upsert(_pod("b-0", rv="3", job="b"))
+    assert [p["metadata"]["name"]
+            for p in s.list("default", {JOB_LABEL: "a"})] == \
+        ["a-0", "a-1"]
+    # Existence selector falls back to the scan path.
+    assert len(s.list("default", {JOB_LABEL: None})) == 3
+    # Relabel moves the index entry.
+    s.upsert(_pod("a-1", rv="4", job="b"))
+    assert [p["metadata"]["name"]
+            for p in s.list("default", {JOB_LABEL: "b"})] == \
+        ["a-1", "b-0"]
+
+
+def test_store_replace_diffs_deletions_and_keeps_newer():
+    s = Store("Pod")
+    s.upsert(_pod("old", rv="2"))
+    s.upsert(_pod("fresh", rv="9"))  # optimistic absorb past horizon
+    dropped = s.replace([_pod("listed", rv="4")], list_version=5)
+    assert [d["metadata"]["name"] for d in dropped] == ["old"]
+    assert {k[1] for k in s.keys()} == {"fresh", "listed"}
+
+
+# -- Informer loop --------------------------------------------------------
+
+
+def test_informer_syncs_and_dispatches_after_store():
+    api = FakeApiServer()
+    api.create(make_job(name="i1", workers=1))
+    seen = []
+
+    def handler(kind, event_type, obj, relisted):
+        # The contract: by dispatch time the store reflects the event.
+        if event_type != "DELETED":
+            assert inf.store.get(
+                obj["metadata"].get("namespace", "default"),
+                obj["metadata"]["name"])
+        seen.append((event_type, obj["metadata"]["name"], relisted))
+
+    inf = Informer(api, KIND, handler=handler, watch_timeout=0.5)
+    stop = threading.Event()
+    t = threading.Thread(target=inf.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        assert _wait_for(lambda: ("SYNC", "i1", True) in seen)
+        api.create(make_job(name="i2", workers=1))
+        assert _wait_for(lambda: ("ADDED", "i2", False) in seen)
+        api.delete(KIND, "default", "i2")
+        assert _wait_for(lambda: ("DELETED", "i2", False) in seen)
+        with pytest.raises(NotFound):
+            inf.store.get("default", "i2")
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_informer_resyncs_on_gone_and_counts_it():
+    api = FakeApiServer()
+    api.EVENT_WINDOW = 3
+    api.create(make_job(name="g1", workers=1))
+    inf = Informer(api, KIND, watch_timeout=0.3)
+    stop = threading.Event()
+    t = threading.Thread(target=inf.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        assert _wait_for(lambda: inf.relists >= 1)
+        # Foreign churn compacts the window while the watch idles; the
+        # direct fake emits no bookmarks, so the re-watch goes Gone
+        # and the informer must relist (never count an error).
+        for i in range(10):
+            with api.as_kubelet():
+                api.create({"kind": "Pod", "metadata": {
+                    "name": f"churn-{i}", "namespace": "elsewhere"}})
+        assert _wait_for(lambda: inf.gone >= 1, 5.0)
+        assert inf.errors == 0
+        # Post-Gone liveness: new objects still arrive.
+        api.create(make_job(name="g2", workers=1))
+        assert _wait_for(
+            lambda: ("default", "g2") in inf.store.keys(), 5.0)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_informer_bookmarks_advance_resume_over_http():
+    """Over the HTTP facade the production client always requests
+    bookmarks: idle watches must ride them (bookmark count grows, no
+    Gone) even while foreign churn compacts the window."""
+    fake = FakeApiServer()
+    fake.EVENT_WINDOW = 4
+    with HttpFakeApiServer(fake=fake) as srv:
+        client = HttpApiClient(srv.url)
+        inf = Informer(client, KIND, watch_timeout=0.3)
+        stop = threading.Event()
+        t = threading.Thread(target=inf.run, args=(stop,), daemon=True)
+        t.start()
+        try:
+            assert _wait_for(lambda: inf.relists >= 1)
+            for burst in range(12):
+                with fake.as_kubelet():
+                    fake.create({"kind": "Pod", "metadata": {
+                        "name": f"churn-{burst}",
+                        "namespace": "elsewhere"}})
+                time.sleep(0.05)
+            assert _wait_for(lambda: inf.bookmarks >= 1, 5.0)
+            assert inf.gone == 0, "bookmarked watch still went Gone"
+            assert inf.errors == 0
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+
+def test_informer_request_resync_forces_relist():
+    api = FakeApiServer()
+    inf = Informer(api, KIND, watch_timeout=0.2)
+    stop = threading.Event()
+    t = threading.Thread(target=inf.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        assert _wait_for(lambda: inf.relists >= 1)
+        before = inf.relists
+        # Mutate the store behind the informer's back (a stale cache a
+        # fresh leader must not trust), then demand a resync.
+        api.create(make_job(name="sneak", workers=1))
+        assert _wait_for(
+            lambda: ("default", "sneak") in inf.store.keys())
+        inf.store.discard("default", "sneak")
+        inf.request_resync()
+        assert _wait_for(lambda: inf.relists > before, 5.0)
+        assert _wait_for(
+            lambda: ("default", "sneak") in inf.store.keys(), 5.0)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+# -- CachedApiClient ------------------------------------------------------
+
+
+def test_cached_client_reads_store_and_absorbs_writes():
+    api = FakeApiServer()
+    store = Store("Pod", index_label=JOB_LABEL)
+    cached = CachedApiClient(api, {"Pod": store})
+
+    created = cached.create(_pod("p0", rv=None, job="j"))
+    # Immediately visible from the cache — no watch echo needed.
+    assert cached.get("Pod", "default", "p0")["metadata"]["name"] == \
+        "p0"
+    assert [p["metadata"]["name"]
+            for p in cached.list("Pod", "default", {JOB_LABEL: "j"})] \
+        == ["p0"]
+    # Patch result absorbed too.
+    cached.patch("Pod", "default", "p0",
+                 lambda o: o.setdefault("status", {}).update(
+                     {"phase": "Running"}))
+    assert cached.get("Pod", "default", "p0")["status"]["phase"] == \
+        "Running"
+    # Delete removes from both sides.
+    cached.delete("Pod", "default", "p0")
+    with pytest.raises(NotFound):
+        cached.get("Pod", "default", "p0")
+    with pytest.raises(NotFound):
+        api.get("Pod", "default", "p0")
+    assert created["metadata"]["resourceVersion"]
+
+
+def test_cached_client_passthrough_for_uninformed_kinds():
+    api = FakeApiServer()
+    cached = CachedApiClient(api, {"Pod": Store("Pod")})
+    api.create({"kind": "ConfigMap", "metadata": {
+        "name": "cm", "namespace": "default"}, "data": {}})
+    # ConfigMap has no store → the read goes to the apiserver.
+    mark = api.mark()
+    assert cached.get("ConfigMap", "default", "cm")["metadata"][
+        "name"] == "cm"
+    assert api.request_counts(mark)["get"] == 1
+    # And watch/list_with_version delegate transparently.
+    items, version = cached.list_with_version("ConfigMap", "default")
+    assert len(items) == 1 and version > 0
+
+
+# -- the headline: QPS flatness -------------------------------------------
+
+
+def _converge_fleet(api, ctl, names, timeout=30.0):
+    def all_running():
+        with api.as_kubelet():
+            for pod in api._list("Pod", "default", {JOB_LABEL: None}):
+                if pod.get("status", {}).get("phase") != "Running":
+                    api.set_pod_phase("default",
+                                      pod["metadata"]["name"],
+                                      "Running")
+            return all(
+                api.get(KIND, "default", n)
+                .get("status", {}).get("phase") == "Running"
+                for n in names)
+
+    assert _wait_for(all_running, timeout, interval=0.05), \
+        "fleet never converged"
+
+
+def _steady_requests_per_reconcile(informer_reads, jobs,
+                                   window=1.2):
+    api = FakeApiServer()
+    ctl = WatchController(
+        api, relist_seconds=0.3, workers=4,
+        backoff=ExponentialBackoff(base=0.02, cap=0.5),
+        limiter=TokenBucket(qps=2000.0, burst=2000),
+        informer_reads=informer_reads)
+    t = threading.Thread(target=ctl.run, daemon=True)
+    t.start()
+    try:
+        names = [f"flat-{i:03d}" for i in range(jobs)]
+        with api.as_kubelet():
+            for name in names:
+                api.create(make_job(name=name, workers=1))
+        _converge_fleet(api, ctl, names)
+        time.sleep(0.3)  # let the last recovery writes land
+        mark = api.mark()
+        r0 = ctl.stats()["reconciles"]
+        time.sleep(window)
+        counts = api.request_counts(mark)
+        reconciles = max(1, ctl.stats()["reconciles"] - r0)
+        return counts["total"] / reconciles, counts
+    finally:
+        ctl.stop.set()
+        t.join(timeout=10)
+
+
+def test_steady_state_requests_per_reconcile_flat_with_informer():
+    """The tentpole acceptance at test scale: informer reads keep the
+    converged fleet's apiserver requests/reconcile near ZERO at both
+    fleet sizes (reads come from the cache, no-op status writes are
+    suppressed), while direct reads pay several requests per pass —
+    i.e. QPS that scales with fleet size."""
+    small, small_counts = _steady_requests_per_reconcile(True, 8)
+    large, large_counts = _steady_requests_per_reconcile(True, 24)
+    direct, direct_counts = _steady_requests_per_reconcile(False, 24)
+    # Informer: the residual steady-state traffic is watch
+    # re-connections + the metrics publish — CONSTANT in fleet size,
+    # so per-reconcile cost can only fall as the fleet grows.
+    assert small < 1.0, (small, small_counts)
+    assert large < 0.5, (large, large_counts)
+    assert large <= small + 0.25, (small, large)
+    assert large_counts["total"] <= small_counts["total"] * 2 + 4, \
+        (small_counts, large_counts)
+    # Contrast: the pre-r12 read path pays GET job + LIST pods +
+    # Service/PDB reads (+ status PATCH) per pass.
+    assert direct >= 2.0, (direct, direct_counts)
+    # And the informer's steady state issues no reads AT ALL.
+    assert large_counts.get("get", 0) == 0, large_counts
+    assert large_counts.get("list", 0) == 0, large_counts
+
+
+def test_informer_controller_sees_no_read_amplification_on_events():
+    """Event reaction reads from the cache: a pod-failure restart at
+    steady state costs writes (pod delete/create, status) but ZERO
+    apiserver reads."""
+    api = FakeApiServer()
+    ctl = WatchController(
+        api, relist_seconds=30.0, workers=2,
+        backoff=ExponentialBackoff(base=0.02, cap=0.5))
+    t = threading.Thread(target=ctl.run, daemon=True)
+    t.start()
+    try:
+        names = ["evt-0"]
+        with api.as_kubelet():
+            api.create(make_job(name="evt-0", workers=2))
+        _converge_fleet(api, ctl, names)
+        mark = api.mark()
+        with api.as_kubelet():
+            api.set_pod_phase("default", "evt-0-tpu-worker-1",
+                              "Failed")
+
+        def restarted():
+            with api.as_kubelet():  # observer read, not controller
+                return api.get(KIND, "default", "evt-0").get(
+                    "status", {}).get("restartCount", 0) == 1
+
+        assert _wait_for(restarted, 5.0)
+
+        def recovered():
+            with api.as_kubelet():
+                for pod in api._list("Pod", "default",
+                                     {JOB_LABEL: "evt-0"}):
+                    if pod.get("status", {}).get("phase") != "Running":
+                        api.set_pod_phase(
+                            "default", pod["metadata"]["name"],
+                            "Running")
+                return (api.get(KIND, "default", "evt-0")
+                        .get("status", {}).get("phase") == "Running"
+                        and len(api._list(
+                            "Pod", "default",
+                            {JOB_LABEL: "evt-0"})) == 2)
+
+        assert _wait_for(recovered, 5.0, interval=0.05)
+        counts = api.request_counts(mark)
+        assert counts.get("get", 0) == 0, counts
+        assert counts.get("list", 0) == 0, counts
+        assert counts.get("delete", 0) >= 2, counts  # the teardown
+        assert counts.get("create", 0) >= 2, counts  # the recreation
+    finally:
+        ctl.stop.set()
+        t.join(timeout=10)
