@@ -243,6 +243,60 @@ def obs_overhead_main() -> int:
     return 0 if result["under_2pct"] else 1
 
 
+def slo_main() -> int:
+    """`python bench.py --slo`: the r8 overload sweep with the fleet
+    telemetry pipeline attached (ISSUE 9 acceptance): the collector
+    scrapes the serving registry every 250 ms, the deadline SLO's
+    compressed fast-burn window fires during the 2× phase and
+    resolves after recovery (Event + kft-alerts ConfigMap published),
+    and the collector's component-timed cycle cost stays ≤2% (the r9
+    obs budget). Prints ONE JSON line shaped like the headline
+    bench."""
+    from kubeflow_tpu.utils.platform import sync_platform_from_env
+
+    sync_platform_from_env()
+
+    from kubeflow_tpu.serving.benchmark import (
+        SloBenchConfig,
+        run_slo_benchmark,
+    )
+
+    result = run_slo_benchmark(SloBenchConfig())
+    ok = (result["alert_fired_during_overload"]
+          and result["alert_resolved_after"]
+          and result["alerts_configmap_published"]
+          and result["under_2pct"])
+    print(json.dumps({
+        "metric": "slo_collector_overhead_pct",
+        "value": result["collector_overhead_pct"],
+        "unit": (f"% of one core at a "
+                 f"{result['collector_interval_ms']:.0f} ms scrape "
+                 f"interval (cycle {result['collector_cycle_ms']} ms: "
+                 f"fetch + strict parse + ingest + burn-rate "
+                 f"evaluation)"),
+        "vs_baseline": None,  # the reference had no alerting at all
+        "extra": {
+            "alert_fired_during_overload":
+                result["alert_fired_during_overload"],
+            "alert_resolved_after": result["alert_resolved_after"],
+            "alert_events": result["alert_events"],
+            "alerts_configmap_published":
+                result["alerts_configmap_published"],
+            "alert_timeline": [
+                {k: h[k] for k in ("to", "window")}
+                for h in result["alert_timeline"]],
+            "capacity_rps": result["capacity_rps"],
+            "store_series": result["store_series"],
+            "scrape_cycles": result["scrape_cycles"],
+            "under_2pct": result["under_2pct"],
+            **{f"{r['phase']}_{k}": r[k] for r in result["phases"]
+               for k in ("goodput_rps", "shed", "expired", "ok")
+               if k in r},
+        },
+    }))
+    return 0 if ok else 1
+
+
 def continuous_main() -> int:
     """`python bench.py --continuous`: mixed-length open-loop sweep,
     r6 static coalescer vs the continuous-batching engine at the same
@@ -305,6 +359,8 @@ def main() -> int:
         return router_main()
     if "--continuous" in sys.argv:
         return continuous_main()
+    if "--slo" in sys.argv:
+        return slo_main()
     from kubeflow_tpu.utils.platform import sync_platform_from_env
 
     # Honor JAX_PLATFORMS from the caller (the session preset pins the

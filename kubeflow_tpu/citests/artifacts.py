@@ -52,26 +52,31 @@ def obs_dir() -> Path:
 
 
 def collect_obs() -> list:
-    """Copy every metrics/span JSONL under $KFT_OBS_DIR into
-    ``<artifacts>/obs/``, and dump THIS process's live registry and
-    span buffer alongside. Returns the copied/created paths.
-    Best-effort: a missing drop-box dir means an empty (but present)
-    observability trail, never a failed CI step."""
+    """Copy every metrics/span JSONL (and collector/alert JSON
+    snapshot) under $KFT_OBS_DIR into ``<artifacts>/obs/``, and dump
+    THIS process's live registry, span buffer, and any live telemetry
+    collectors (store stats + SLO alert history) alongside. Returns
+    the copied/created paths. Best-effort: a missing drop-box dir
+    means an empty (but present) observability trail, never a failed
+    CI step."""
     from kubeflow_tpu.obs import metrics as obs_metrics
     from kubeflow_tpu.obs import tracing as obs_tracing
+    from kubeflow_tpu.obs.collector import live_collectors
 
     out = artifacts_dir() / "obs"
     out.mkdir(parents=True, exist_ok=True)
     copied = []
     src = obs_dir()
     if src.is_dir():
-        for f in sorted(src.rglob("*.jsonl")):
-            # Flatten the relative path INTO the name: two processes
-            # dropping server/spans.jsonl and proxy/spans.jsonl must
-            # both survive the sweep, not clobber each other.
-            dest = out / "__".join(f.relative_to(src).parts)
-            shutil.copyfile(f, dest)
-            copied.append(dest)
+        for pattern in ("*.jsonl", "*.json"):
+            for f in sorted(src.rglob(pattern)):
+                # Flatten the relative path INTO the name: two
+                # processes dropping server/spans.jsonl and
+                # proxy/spans.jsonl must both survive the sweep, not
+                # clobber each other.
+                dest = out / "__".join(f.relative_to(src).parts)
+                shutil.copyfile(f, dest)
+                copied.append(dest)
     # Live dumps of THIS process under their own names — never the
     # sweep's namespace.
     metrics_path = out / "live_metrics.jsonl"
@@ -80,6 +85,21 @@ def collect_obs() -> list:
     spans_path = out / "live_spans.jsonl"
     obs_tracing.TRACER.dump_jsonl(str(spans_path))
     copied.append(spans_path)
+    # Live telemetry collectors: scrape-target status + store stats,
+    # plus every attached alert evaluator's state and transition
+    # history (the alert trail a failed SLO assertion needs).
+    for i, collector in enumerate(live_collectors()):
+        state = collector.state()
+        evaluators = [hook.__self__.state()
+                      for hook in collector.on_cycle
+                      if hasattr(hook, "__self__")
+                      and hasattr(hook.__self__, "state")]
+        if evaluators:
+            state["alerts"] = evaluators
+        path = out / f"collector_state_{i}.json"
+        path.write_text(json.dumps(state, indent=1, sort_keys=True,
+                                   default=str))
+        copied.append(path)
     logger.info("observability trail: %d file(s) under %s",
                 len(copied), out)
     return copied

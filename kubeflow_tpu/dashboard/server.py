@@ -47,6 +47,18 @@ and DELETE jobs, not just list them. This is its TPUJob equivalent:
                                          (from the ConfigMap the
                                          autoscaler loop publishes;
                                          ?namespace=)
+  GET    /tpujobs/api/slo               fleet telemetry: collector
+                                         target status, SLO burn
+                                         rates, alert states +
+                                         transition history (the
+                                         in-process collector; falls
+                                         back to the kft-alerts
+                                         ConfigMap a sidecar
+                                         collector publishes)
+  GET    /tpujobs/ui/health             HTML "Fleet health" page: SLO
+                                         status, burn rates, firing
+                                         alerts, exemplar → /tracez
+                                         links
   GET    /healthz
 
 against either a real apiserver (kubectl shim) or the in-repo fake
@@ -540,6 +552,51 @@ def _fetch_fleet(api, namespace: str = "default"):
         return None
 
 
+def _telemetry_payload(settings, api, namespace: str) -> Dict[str, Any]:
+    """The /tpujobs/api/slo document: from the IN-PROCESS collector +
+    alert manager when the dashboard runs them, else from the
+    ``kft-alerts`` ConfigMap a sidecar collector publishes, else
+    unavailable (with the wiring hint)."""
+    collector = settings.get("collector")
+    alerts = settings.get("alerts")
+    if collector is not None or alerts is not None:
+        payload: Dict[str, Any] = {"available": True,
+                                   "source": "in-process"}
+        if collector is not None:
+            payload["collector"] = collector.state()
+            payload["exemplars"] = collector.store.exemplars()[:32]
+        if alerts is not None:
+            payload.update(alerts.state())
+        return payload
+    from kubeflow_tpu.obs.slo import ALERTS_CONFIGMAP, ALERTS_KEY
+
+    try:
+        cm = api.get("ConfigMap", namespace, ALERTS_CONFIGMAP)
+        doc = json.loads(cm.get("data", {}).get(ALERTS_KEY, "{}"))
+        return {"available": True, "source": "configmap", **doc}
+    except Exception:  # noqa: BLE001 — collector simply not running
+        return {"available": False,
+                "error": "no in-process collector and no "
+                         f"{ALERTS_CONFIGMAP} ConfigMap (start the "
+                         "dashboard with --collect_endpoints/"
+                         "--collect_static, or run the collector "
+                         "sidecar)"}
+
+
+class SloHandler(BaseHandler):
+    """Fleet telemetry JSON: collector targets, SLO burn rates, alert
+    states and the transition history (docs/observability.md "Fleet
+    telemetry & SLOs")."""
+
+    async def get(self):
+        namespace = self.get_query_argument("namespace", "default")
+        settings = self.application.settings
+        payload = await tornado.ioloop.IOLoop.current().run_in_executor(
+            None, _telemetry_payload, settings, self.api, namespace)
+        self.write_json(payload,
+                        200 if payload.get("available") else 404)
+
+
 class TraceListHandler(BaseHandler):
     """Profiler traces under the shared trace root (written by
     trainer ``--profile_dir`` / ``LoopConfig.profile_dir``; recipe for
@@ -587,6 +644,9 @@ JSON: <a href="/tpujobs/api/traces">/tpujobs/api/traces</a> &middot;
 open with <code>tensorboard --logdir &lt;trace dir&gt;</code>
 (docs/profiling.md)</p>
 <h2>Serving fleet</h2>
+<p><a href="/tpujobs/ui/health">Fleet health</a> — SLO status, burn
+rates, firing alerts, exemplar trace links
+(<a href="/tpujobs/api/slo">JSON</a>).</p>
 {fleet_section}
 <h2>Request spans</h2>
 <p>Host-side request spans (Chrome trace-event JSON — open in
@@ -830,6 +890,173 @@ def _fleet_section_html_unsafe(fleet) -> str:
           "/tpujobs/api/fleet</a></p>")
 
 
+_ALERT_COLORS = {"firing": "#cf222e", "pending": "#9a6700",
+                 "inactive": "#1a7f37", "resolved": "#1a7f37"}
+
+_HEALTH_PAGE = """<!doctype html>
+<html><head><title>Fleet health</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem; }}
+ table {{ border-collapse: collapse; min-width: 48rem;
+          margin-bottom: 1.5rem; }}
+ th, td {{ text-align: left; padding: .4rem .9rem;
+          border-bottom: 1px solid #d0d7de; }}
+ th {{ background: #f6f8fa; }}
+ .state {{ font-weight: 600; }}
+</style></head>
+<body>
+<p><a href="/tpujobs/ui/">&larr; all jobs</a></p>
+<h1>Fleet health</h1>
+{alert_banner}
+<h2>SLOs</h2>
+<table>
+<tr><th>SLO</th><th>Objective</th><th>State</th>
+<th>Window</th><th>Burn (long / short)</th><th>Threshold</th>
+<th>Fired</th></tr>
+{slo_rows}
+</table>
+<p>Burn rate = error rate &divide; error budget; an alert needs BOTH
+windows over the threshold (Google-SRE multi-window multi-burn-rate;
+docs/observability.md).</p>
+<h2>Collector targets</h2>
+<table>
+<tr><th>Target</th><th>Job</th><th>Status</th><th>Last scrape</th>
+<th>Duration</th><th>Samples</th></tr>
+{target_rows}
+</table>
+<p>{store_line}</p>
+<h2>Exemplars</h2>
+<table>
+<tr><th>Histogram</th><th>le</th><th>Instance</th><th>Value</th>
+<th>Trace</th></tr>
+{exemplar_rows}
+</table>
+<p>Exemplar workflow: a latency bucket grew &rarr; its exemplar
+carries the trace id of one request that landed there &rarr;
+<code>/tracez?trace_id=&lt;id&gt;</code> on the instance returns the
+retained (tail-sampled) spans. JSON:
+<a href="/tpujobs/api/slo">/tpujobs/api/slo</a></p>
+</body></html>
+"""
+
+
+def _health_page_html(payload: Dict[str, Any]) -> str:
+    """Render the Fleet health page from the /tpujobs/api/slo payload
+    (best-effort: a malformed payload degrades per section, never a
+    500 for the page)."""
+    firing = [w for s in payload.get("slos", ())
+              for w in s.get("windows", ())
+              if w.get("state") == "firing"]
+    if firing:
+        items = "; ".join(
+            f"{html.escape(str(s.get('slo', '?')))}"
+            for s in payload.get("slos", ())
+            if any(w.get("state") == "firing"
+                   for w in s.get("windows", ())))
+        alert_banner = (
+            f"<p style=\"background:#fff1f0;border:1px solid #cf222e;"
+            f"padding:.5rem .9rem\"><strong>{len(firing)} alert(s) "
+            f"FIRING</strong>: {items}</p>")
+    else:
+        alert_banner = ("<p style=\"background:#dafbe1;border:1px "
+                        "solid #1a7f37;padding:.5rem .9rem\">"
+                        "No firing alerts.</p>")
+    slo_rows = []
+    for s in payload.get("slos", ()):
+        windows = s.get("windows", ()) or [{}]
+        for i, w in enumerate(windows):
+            state = str(w.get("state", "inactive"))
+            color = _ALERT_COLORS.get(state, "#57606a")
+            burn = (f"{w.get('long_burn', '-')} / "
+                    f"{w.get('short_burn', '-')}")
+            first = (f"<td rowspan={len(windows)}>"
+                     f"{html.escape(str(s.get('slo', '')))}</td>"
+                     f"<td rowspan={len(windows)}>"
+                     f"{float(s.get('objective', 0)):.2%}</td>"
+                     f"<td rowspan={len(windows)} class=\"state\" "
+                     f"style=\"color:"
+                     f"{_ALERT_COLORS.get(str(s.get('state', '')), '#57606a')}\">"
+                     f"{html.escape(str(s.get('state', '')))}</td>"
+                     if i == 0 else "")
+            slo_rows.append(
+                "<tr>" + first +
+                f"<td>{html.escape(str(w.get('window', '')))} "
+                f"({html.escape(str(w.get('severity', '')))})</td>"
+                f"<td class=\"state\" style=\"color:{color}\">{burn}"
+                f"</td>"
+                f"<td>&gt;{w.get('factor', '-')}&times;</td>"
+                f"<td>{int(w.get('fire_count', 0) or 0)}</td></tr>")
+    target_rows = []
+    collector = payload.get("collector") or {}
+    for address, st in (collector.get("targets") or {}).items():
+        ok = st.get("ok")
+        color = "#1a7f37" if ok else "#cf222e"
+        target_rows.append(
+            "<tr>"
+            f"<td><code>{html.escape(str(address))}</code></td>"
+            f"<td>{html.escape(str(st.get('job', '')))}</td>"
+            f"<td class=\"state\" style=\"color:{color}\">"
+            f"{'ok' if ok else html.escape(str(st.get('error', 'down'))[:60])}"
+            f"</td>"
+            f"<td>{float(st.get('age_s', 0)):.0f}s ago</td>"
+            f"<td>{float(st.get('duration_ms', 0)):.1f} ms</td>"
+            f"<td>{int(st.get('samples', 0))}</td></tr>")
+    store = collector.get("store") or {}
+    store_line = (
+        f"Store: {int(store.get('series', 0))} series "
+        f"(cap {int(store.get('max_series', 0))}, "
+        f"{int(store.get('dropped_series', 0))} dropped), "
+        f"{int(store.get('exemplars', 0))} exemplars."
+        if store else "No in-process collector "
+                      "(showing ConfigMap-published alerts).")
+    exemplar_rows = []
+    for e in (payload.get("exemplars") or ())[:16]:
+        labels = e.get("labels", {})
+        instance = str(labels.get("instance", ""))
+        trace_id = str(e.get("trace_id", ""))
+        tracez = (f"http://{instance}/tracez?trace_id={trace_id}"
+                  if instance else f"/tracez?trace_id={trace_id}")
+        metric = str(e.get("metric", "")).replace("_bucket", "")
+        exemplar_rows.append(
+            "<tr>"
+            f"<td>{html.escape(metric)}</td>"
+            f"<td>{html.escape(str(labels.get('le', '')))}</td>"
+            f"<td><code>{html.escape(instance)}</code></td>"
+            f"<td>{float(e.get('value', 0)):.4f}</td>"
+            f"<td><a href=\"{html.escape(tracez)}\"><code>"
+            f"{html.escape(trace_id[:16])}</code></a></td></tr>")
+    return _HEALTH_PAGE.format(
+        alert_banner=alert_banner,
+        slo_rows="\n".join(slo_rows)
+        or "<tr><td colspan=7>no SLOs configured</td></tr>",
+        target_rows="\n".join(target_rows)
+        or "<tr><td colspan=6>none</td></tr>",
+        store_line=store_line,
+        exemplar_rows="\n".join(exemplar_rows)
+        or "<tr><td colspan=5>none yet</td></tr>")
+
+
+class FleetHealthUIHandler(BaseHandler):
+    """HTML "Fleet health" page: the operator's one-look view — SLO
+    states and burn rates, firing alerts, collector target health,
+    and exemplar links into /tracez."""
+
+    async def get(self):
+        namespace = self.get_query_argument("namespace", "default")
+        settings = self.application.settings
+        payload = await tornado.ioloop.IOLoop.current().run_in_executor(
+            None, _telemetry_payload, settings, self.api, namespace)
+        self.set_header("Content-Type", "text/html; charset=utf-8")
+        try:
+            body = _health_page_html(payload)
+        except Exception:  # noqa: BLE001 — render is best-effort
+            logger.warning("fleet health render failed", exc_info=True)
+            body = ("<p>Fleet health payload unreadable. JSON: "
+                    "<a href=\"/tpujobs/api/slo\">/tpujobs/api/slo"
+                    "</a></p>")
+        self.finish(body)
+
+
 class UIHandler(BaseHandler):
     async def get(self):
         import asyncio
@@ -922,8 +1149,13 @@ class UICreateHandler(BaseHandler):
 DEFAULT_TRACE_ROOT = "/tmp/kft-profile"
 
 
-def make_app(api, trace_root: str = DEFAULT_TRACE_ROOT
-             ) -> tornado.web.Application:
+def make_app(api, trace_root: str = DEFAULT_TRACE_ROOT,
+             collector=None, alerts=None) -> tornado.web.Application:
+    """``collector``/``alerts`` (obs/collector.Collector +
+    obs/slo.AlertManager) enable the in-process telemetry pipeline:
+    /tpujobs/api/slo and the Fleet health page read them live; without
+    them the handlers fall back to the ConfigMap a sidecar collector
+    publishes. The caller owns the collector thread's lifecycle."""
     return tornado.web.Application([
         (r"/healthz", HealthHandler),
         (r"/metrics", MetricsHandler),
@@ -935,12 +1167,39 @@ def make_app(api, trace_root: str = DEFAULT_TRACE_ROOT
         (r"/tpujobs/api/spans", ChromeTraceHandler),
         (r"/tpujobs/api/operator", OperatorMetricsHandler),
         (r"/tpujobs/api/fleet", FleetHandler),
+        (r"/tpujobs/api/slo", SloHandler),
         (r"/tpujobs/ui/?", UIHandler),
+        (r"/tpujobs/ui/health", FleetHealthUIHandler),
         (r"/tpujobs/ui/job/([^/]+)/([^/]+)", UIJobDetailHandler),
         (r"/tpujobs/ui/create", UICreateHandler),
         (r"/", tornado.web.RedirectHandler, {"url": "/tpujobs/ui/"}),
-    ], api=api, trace_root=trace_root,
-       log_function=access_log_function("dashboard"))
+    ], api=api, trace_root=trace_root, collector=collector,
+       alerts=alerts, log_function=access_log_function("dashboard"))
+
+
+def _build_telemetry(args, api):
+    """Dashboard-resident collector + SLO evaluator from the
+    --collect_* flags (None, None when no targets were asked for)."""
+    if not (args.collect_endpoints or args.collect_static):
+        return None, None
+    from kubeflow_tpu.obs.collector import (
+        Collector,
+        parse_static_targets,
+    )
+    from kubeflow_tpu.obs.slo import AlertManager, default_slos
+
+    source = None
+    if args.collect_endpoints:
+        from kubeflow_tpu.scaling.endpoints import FileEndpointSource
+
+        source = FileEndpointSource(args.collect_endpoints)
+    static = parse_static_targets(args.collect_static or "")
+    collector = Collector(source=source, static_targets=static,
+                          interval_s=args.collect_interval)
+    alerts = AlertManager(collector.store, default_slos(),
+                          api=api, namespace=args.namespace)
+    collector.on_cycle.append(alerts.evaluate)
+    return collector, alerts
 
 
 def main(argv=None) -> int:
@@ -952,6 +1211,17 @@ def main(argv=None) -> int:
                         help="shared dir (volume-mounted in-cluster) "
                              "where trainer --profile_dir traces land; "
                              "listed at /tpujobs/api/traces")
+    parser.add_argument("--namespace", default="default",
+                        help="namespace alert Events/ConfigMap land in")
+    parser.add_argument("--collect_endpoints", default=None,
+                        help="serving-fleet endpoints JSON to scrape "
+                             "(the autoscaler-maintained file); "
+                             "enables the in-process collector")
+    parser.add_argument("--collect_static", default=None,
+                        help="static scrape targets "
+                             "addr[=job][,addr[=job]...] (router, "
+                             "operator metrics port, ...)")
+    parser.add_argument("--collect_interval", type=float, default=5.0)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     if args.fake:
@@ -962,10 +1232,20 @@ def main(argv=None) -> int:
         from kubeflow_tpu.operator.controller import KubectlClient
 
         api = KubectlClient()
-    app = make_app(api, trace_root=args.trace_root)
+    collector, alerts = _build_telemetry(args, api)
+    if collector is not None:
+        collector.start()
+        logger.info("fleet telemetry collector started (interval "
+                    "%.1fs)", collector.interval_s)
+    app = make_app(api, trace_root=args.trace_root,
+                   collector=collector, alerts=alerts)
     app.listen(args.port)
     logger.info("tpujob-dashboard listening on :%d", args.port)
-    tornado.ioloop.IOLoop.current().start()
+    try:
+        tornado.ioloop.IOLoop.current().start()
+    finally:
+        if collector is not None:
+            collector.stop()
     return 0
 
 

@@ -116,7 +116,7 @@ _M_SHED = obs_metrics.Counter(
 _M_TTFT = obs_metrics.Histogram(
     "kft_serving_ttft_seconds",
     "Submit to first streamed token (queue wait + prefill)",
-    ("model",))
+    ("model",), exemplars=True)
 _M_INTER = obs_metrics.Histogram(
     "kft_serving_inter_token_seconds",
     "Per-token decode pacing (slice wall time / slice tokens)",
@@ -680,7 +680,9 @@ class DecodeEngine:
         t1 = time.monotonic()
         self._prefill_est.observe(t1 - t0)
         self._m_admitted.inc()
-        self._m_ttft.observe(t1 - req.submitted_at)
+        ctx = req.stream.obs_ctx
+        self._m_ttft.observe(t1 - req.submitted_at,
+                             trace_id=ctx.trace_id if ctx else None)
         if TRACER.enabled:
             TRACER.record("engine_prefill", "engine", t0, t1 - t0,
                           self._span_args(req, slot=slot.index,

@@ -177,14 +177,43 @@ def autoscaler_container(p: Dict[str, Any]) -> Dict[str, Any]:
     )
 
 
+def collector_container(p: Dict[str, Any]) -> Dict[str, Any]:
+    """Fleet telemetry collector sidecar (obs/collector.py): scrapes
+    every replica's /metrics via the shared endpoints file plus the
+    router's own exposition, aggregates cross-replica rates, and —
+    with --alerts — evaluates the default SLO set, publishing burn-
+    rate alerts as Events + the kft-alerts ConfigMap the dashboard's
+    Fleet health page reads."""
+    return k8s.container(
+        f"{p['name']}-collector", p["http_proxy_image"],
+        command=["python", "-m", "kubeflow_tpu.obs.collector"],
+        args=["--endpoints_file=/fleet/endpoints.json",
+              "--static=localhost:8000=router",
+              f"--interval={p['collector_interval_s']}",
+              f"--namespace={p['namespace']}",
+              "--alerts",
+              "--metrics_port=9402"],
+        ports=[k8s.port(9402, "collector")],
+        volume_mounts=[k8s.volume_mount("fleet", "/fleet",
+                                        read_only=True)],
+        resources=k8s.resources(cpu_request="100m",
+                                memory_request="128Mi",
+                                cpu_limit="500m",
+                                memory_limit="512Mi"),
+    )
+
+
 def router_deployment(p: Dict[str, Any]) -> Dict[str, Any]:
     """One-replica router pod in front of the serving fleet: the
-    pooled proxy + the autoscaler sidecar, wired through a shared
-    emptyDir endpoints file (the reference fronted its fleet with
-    Ambassador and never closed the loop; this pod does both halves)."""
+    pooled proxy + the autoscaler sidecar (+ the telemetry collector
+    with ``collector true``), wired through a shared emptyDir
+    endpoints file (the reference fronted its fleet with Ambassador
+    and never closed the loop; this pod does both halves)."""
     name = f"{p['name']}-router"
-    spec = k8s.pod_spec([router_proxy_container(p),
-                         autoscaler_container(p)])
+    containers = [router_proxy_container(p), autoscaler_container(p)]
+    if p.get("collector"):
+        containers.append(collector_container(p))
+    spec = k8s.pod_spec(containers)
     spec["securityContext"] = {"runAsUser": 1000, "fsGroup": 1000}
     spec["volumes"] = [{"name": "fleet", "emptyDir": {}}]
     spec["serviceAccountName"] = f"{p['name']}-autoscaler"
@@ -224,6 +253,12 @@ def autoscaler_rbac(p: Dict[str, Any]) -> List[Dict[str, Any]]:
         k8s.policy_rule([""], ["configmaps"],
                         ["get", "create", "update", "patch"]),
     ]
+    if p.get("collector"):
+        # The collector sidecar shares the pod's ServiceAccount and
+        # additionally publishes alert Events (kft-alerts ConfigMap
+        # writes are covered by the configmaps rule above).
+        rules.append(k8s.policy_rule(
+            [""], ["events"], ["get", "create", "patch"]))
     return [
         k8s.service_account(name, namespace, labels=labels),
         k8s.role(name, namespace, rules, labels=labels),
@@ -341,6 +376,12 @@ SERVING_PARAMS = [
     Param("router", "false", "bool",
           "Deploy the fleet router pod: pooled proxy + autoscaler "
           "sidecar in front of the serving replicas."),
+    Param("collector", "false", "bool",
+          "Add the fleet telemetry collector sidecar to the router "
+          "pod (scrapes replica /metrics, aggregates fleet rates, "
+          "publishes SLO burn-rate alerts; needs `router true`)."),
+    Param("collector_interval_s", 5, "int",
+          "Collector scrape interval (seconds)."),
     Param("balancer", "least_saturation", "string",
           "Router policy: round_robin | least_saturation | affinity."),
     Param("min_replicas", 1, "int"),

@@ -27,12 +27,22 @@ is the rebuild's first-class replacement, stdlib-only:
   gRPC metadata, plus an in-process bounded span ring buffer exported
   as Chrome-trace-event JSON (openable in Perfetto).
 - :mod:`kubeflow_tpu.obs.exposition` — ``/metrics`` + ``/tracez``
-  tornado handlers, a stdlib exposition thread for processes without
-  tornado (the operator), and the structured JSON access-log hook.
+  tornado handlers (OpenMetrics content negotiation, span query
+  filters), a stdlib exposition thread for processes without tornado
+  (the operator), and the structured JSON access-log hook.
+- :mod:`kubeflow_tpu.obs.collector` — the fleet telemetry collector:
+  a scrape loop over the serving fleet + static targets feeding a
+  windowed in-memory time-series store (counter-reset-aware rates,
+  histogram quantiles, cross-replica aggregation, cardinality cap).
+- :mod:`kubeflow_tpu.obs.slo` — declarative SLOs evaluated with
+  Google-SRE multi-window burn rates; the alert state machine
+  publishes Events, the ``kft-alerts`` ConfigMap and
+  ``kft_alert_state`` gauges.
 
 Everything here must be cheap enough to leave on in production:
 ``bench.py --obs-overhead`` asserts <2% serving-throughput cost with
-metrics AND tracing enabled (PERF.md).
+metrics AND tracing enabled, and ``bench.py --slo`` asserts ≤2%
+collector cost (PERF.md).
 """
 
 from kubeflow_tpu.obs import metrics, tracing  # noqa: F401

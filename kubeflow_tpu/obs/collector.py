@@ -1,0 +1,765 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Fleet telemetry collector: scrape loop + windowed time-series store.
+
+r9 gave every process a ``/metrics``; at fleet scale (N serving
+replicas + router + autoscaler + operator) each endpoint is an island
+— nothing aggregates cross-replica rates and nothing can evaluate an
+SLO that spans the fleet. This module is the aggregation half of the
+telemetry pipeline (obs/slo.py is the alerting half), dependency-free
+like the rest of ``obs/``:
+
+- :class:`TimeSeriesStore` — a windowed in-memory store: per series a
+  ring of ``(monotonic_ts, value)`` samples, counter-reset-aware
+  ``rate()`` (one shared :func:`metrics.counter_increase` with the
+  autoscaler's shed differencing), histogram-quantile estimation from
+  ``_bucket`` rates, and cross-replica sum/avg/max aggregation. A
+  STRICT series-cardinality cap bounds memory: past the cap new
+  series are counted and dropped, never stored — one label-churning
+  replica cannot OOM the collector.
+- :class:`Collector` — the scrape loop: targets come from the scaling
+  control plane's endpoints file / pool (the serving fleet) plus
+  static targets (operator, proxy, dashboard); each cycle fetches
+  every target's ``/metrics`` concurrently (bounded per-scrape
+  timeout, OpenMetrics ``Accept`` so exemplars ride along), runs the
+  strict :func:`metrics.parse_exposition`, and ingests every sample
+  with ``instance``/``job`` labels stamped on. Runs as a thread in
+  the dashboard or as a sidecar (``python -m
+  kubeflow_tpu.obs.collector``).
+
+Wait discipline: the loop is Event-paced (bounded, interruptible) and
+all control timing is monotonic; every fetch carries an explicit
+timeout (scripts/lint.py check_serving_timeout_discipline covers this
+file).
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import logging
+import threading
+import time
+import urllib.request
+import weakref
+from collections import deque
+from dataclasses import dataclass
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+from kubeflow_tpu.obs import metrics as obs_metrics
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "Collector",
+    "ScrapeTarget",
+    "TimeSeriesStore",
+    "fleet_replica_rows",
+    "live_collectors",
+    "parse_static_targets",
+    "quantile_from_buckets",
+    "scrape_metrics",
+]
+
+_C_SCRAPES = obs_metrics.Counter(
+    "kft_collector_scrapes_total",
+    "Collector scrape attempts by target and outcome",
+    ("instance", "outcome"))
+_H_SCRAPE = obs_metrics.Histogram(
+    "kft_collector_scrape_seconds",
+    "Wall time of one target scrape (fetch + parse + ingest)")
+_G_SERIES = obs_metrics.Gauge(
+    "kft_collector_series",
+    "Time series currently held by the collector store")
+_C_DROPPED = obs_metrics.Counter(
+    "kft_collector_dropped_series_total",
+    "New series rejected by the cardinality cap")
+
+#: Every live Collector in this process (weak — a stopped/forgotten
+#: collector leaves no trace). citests/artifacts.py collect-obs dumps
+#: each one's state next to the junit XML.
+_LIVE: "weakref.WeakSet[Collector]" = weakref.WeakSet()
+
+
+def live_collectors() -> List["Collector"]:
+    return list(_LIVE)
+
+
+_LabelsKey = Tuple[Tuple[str, str], ...]
+
+
+def _labels_key(labels: Dict[str, str]) -> _LabelsKey:
+    return tuple(sorted(labels.items()))
+
+
+def _matches(labels: Dict[str, str],
+             label_filter: Optional[Dict[str, str]]) -> bool:
+    if not label_filter:
+        return True
+    return all(labels.get(k) == v for k, v in label_filter.items())
+
+
+def quantile_from_buckets(q: float,
+                          buckets: Dict[float, float]
+                          ) -> Optional[float]:
+    """``histogram_quantile``: interpolate the q-quantile from per-le
+    bucket RATES (cumulative, +Inf included). Returns None with no
+    observations; the highest finite bound when the quantile falls in
+    +Inf (Prometheus's convention — the estimate saturates rather
+    than invents a value beyond the instrumented range)."""
+    if not buckets:
+        return None
+    bounds = sorted(buckets)
+    total = buckets.get(float("inf"))
+    if total is None:
+        total = buckets[bounds[-1]]
+    if total <= 0.0:
+        return None
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound in bounds:
+        cum = buckets[bound]
+        if cum >= rank:
+            if bound == float("inf"):
+                finite = [b for b in bounds if b != float("inf")]
+                return finite[-1] if finite else None
+            if cum <= prev_cum:
+                return bound
+            lower = prev_bound if prev_bound < bound else 0.0
+            return lower + (bound - lower) * (rank - prev_cum) \
+                / (cum - prev_cum)
+        prev_bound, prev_cum = bound, cum
+    finite = [b for b in bounds if b != float("inf")]
+    return finite[-1] if finite else None
+
+
+class TimeSeriesStore:
+    """Windowed in-memory multi-series store with a hard cardinality
+    cap. Timestamps are caller-supplied monotonic seconds (injectable
+    in tests; the collector passes ``time.monotonic()``)."""
+
+    def __init__(self, *, max_samples_per_series: int = 1024,
+                 max_series: int = 8192):
+        self.max_samples_per_series = int(max_samples_per_series)
+        self.max_series = int(max_series)
+        self._lock = threading.Lock()
+        # name → labels_key → deque[(ts, value)]
+        self._series: Dict[str, Dict[_LabelsKey, deque]] = {}
+        self._kinds: Dict[str, str] = {}
+        # (name, labels_key) → (trace_id, value, ts) — latest exemplar
+        # per bucket series (bounded by series count, itself capped).
+        self._exemplars: Dict[Tuple[str, _LabelsKey],
+                              Tuple[str, float, float]] = {}
+        self._count = 0
+        self._dropped = 0
+
+    # -- ingest ---------------------------------------------------------
+
+    def ingest(self, name: str, labels: Dict[str, str], value: float,
+               ts: float, kind: str = "untyped") -> bool:
+        """Append one sample; False when the cardinality cap rejected
+        a NEW series (existing series always accept)."""
+        key = _labels_key(labels)
+        with self._lock:
+            by_labels = self._series.setdefault(name, {})
+            ring = by_labels.get(key)
+            if ring is None:
+                if self._count >= self.max_series:
+                    self._dropped += 1
+                    if not by_labels:
+                        del self._series[name]
+                    return False
+                ring = deque(maxlen=self.max_samples_per_series)
+                by_labels[key] = ring
+                self._count += 1
+                self._kinds.setdefault(name, kind)
+            ring.append((float(ts), float(value)))
+        return True
+
+    def ingest_exposition(self, families: Dict[str, Dict[str, Any]],
+                          ts: float,
+                          extra_labels: Optional[Dict[str, str]] = None
+                          ) -> Tuple[int, int]:
+        """Ingest one parsed scrape (``parse_exposition`` output),
+        stamping ``extra_labels`` (instance/job) onto every series.
+        Returns (ingested, dropped) sample counts."""
+        extra = extra_labels or {}
+        ingested = dropped = 0
+        for fam_name, fam in families.items():
+            kind = fam.get("type") or "untyped"
+            accepted = set()
+            for sample_name, labels, value in fam.get("samples", ()):
+                merged = {**labels, **extra}
+                key = _labels_key(merged)
+                if self.ingest(sample_name, merged, value, ts,
+                               kind=kind):
+                    ingested += 1
+                    accepted.add((sample_name, key))
+                else:
+                    dropped += 1
+            for (sample_name, labels, ex_labels, ex_value,
+                 ex_ts) in fam.get("exemplars", ()):
+                trace_id = ex_labels.get("trace_id")
+                if not trace_id:
+                    continue
+                key = (sample_name, _labels_key({**labels, **extra}))
+                # Only series the cap ADMITTED may carry exemplars —
+                # otherwise a label-churning histogram would grow the
+                # exemplar map without bound, bypassing the very cap
+                # that bounds the store.
+                if key not in accepted:
+                    continue
+                with self._lock:
+                    self._exemplars[key] = (trace_id, ex_value, ts)
+        return ingested, dropped
+
+    # -- introspection --------------------------------------------------
+
+    def series_count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def dropped_series(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def kind(self, name: str) -> str:
+        with self._lock:
+            return self._kinds.get(name, "untyped")
+
+    def _snapshot_series(self, name: str
+                         ) -> List[Tuple[_LabelsKey, List[Tuple[float,
+                                                                float]]]]:
+        with self._lock:
+            by_labels = self._series.get(name)
+            if not by_labels:
+                return []
+            return [(key, list(ring))
+                    for key, ring in by_labels.items()]
+
+    # -- queries --------------------------------------------------------
+
+    def latest(self, name: str,
+               label_filter: Optional[Dict[str, str]] = None,
+               staleness_s: Optional[float] = None,
+               now: Optional[float] = None
+               ) -> List[Tuple[Dict[str, str], float, float]]:
+        """Per matching series: (labels, ts, value) of the newest
+        sample, optionally dropping series staler than
+        ``staleness_s``."""
+        out = []
+        for key, samples in self._snapshot_series(name):
+            labels = dict(key)
+            if not _matches(labels, label_filter) or not samples:
+                continue
+            ts, value = samples[-1]
+            if (staleness_s is not None and now is not None
+                    and now - ts > staleness_s):
+                continue
+            out.append((labels, ts, value))
+        return out
+
+    def aggregate_latest(self, name: str, agg: str = "sum",
+                         label_filter: Optional[Dict[str, str]] = None,
+                         staleness_s: Optional[float] = None,
+                         now: Optional[float] = None
+                         ) -> Optional[float]:
+        """Cross-series aggregation of the latest values: the
+        fleet-wide view of a per-replica gauge (sum of queue depths,
+        max of breaker states, mean saturation)."""
+        values = [v for _, _, v in self.latest(
+            name, label_filter, staleness_s=staleness_s, now=now)]
+        if not values:
+            return None
+        if agg == "sum":
+            return float(sum(values))
+        if agg == "avg":
+            return float(sum(values) / len(values))
+        if agg == "max":
+            return float(max(values))
+        if agg == "min":
+            return float(min(values))
+        raise ValueError(f"unknown aggregation {agg!r}")
+
+    def rate(self, name: str, window_s: float, now: float,
+             label_filter: Optional[Dict[str, str]] = None
+             ) -> Dict[_LabelsKey, float]:
+        """Per-series per-second increase over the trailing window,
+        counter-reset-aware: deltas between consecutive samples ride
+        :func:`metrics.counter_increase`, so a replica restart (the
+        cumulative counter drops) clamps instead of going negative.
+        Series with fewer than two in-window samples are omitted."""
+        cutoff = now - window_s
+        out: Dict[_LabelsKey, float] = {}
+        for key, samples in self._snapshot_series(name):
+            if not _matches(dict(key), label_filter):
+                continue
+            in_window = [(ts, v) for ts, v in samples if ts >= cutoff]
+            if len(in_window) < 2:
+                continue
+            increase = 0.0
+            for (_, prev), (_, cur) in zip(in_window, in_window[1:]):
+                increase += obs_metrics.counter_increase(prev, cur)
+            elapsed = in_window[-1][0] - in_window[0][0]
+            if elapsed <= 0:
+                continue
+            out[key] = increase / elapsed
+        return out
+
+    def sum_rate(self, name: str, window_s: float, now: float,
+                 label_filter: Optional[Dict[str, str]] = None
+                 ) -> Optional[float]:
+        """Fleet-wide rate: the per-series rates summed (the
+        cross-replica aggregation SLOs evaluate against). None when NO
+        series had enough samples — "no data" and "zero rate" are
+        different answers to a burn-rate question."""
+        rates = self.rate(name, window_s, now, label_filter)
+        if not rates:
+            return None
+        return float(sum(rates.values()))
+
+    def bucket_rates(self, name: str, window_s: float, now: float,
+                     label_filter: Optional[Dict[str, str]] = None
+                     ) -> Dict[float, float]:
+        """Per-``le`` bucket rates of histogram ``name`` summed across
+        every matching series (instances, models): the input shape
+        :func:`quantile_from_buckets` wants. ``le`` label excluded
+        from matching."""
+        rates = self.rate(f"{name}_bucket", window_s, now)
+        out: Dict[float, float] = {}
+        for key, value in rates.items():
+            labels = dict(key)
+            le = labels.pop("le", None)
+            if le is None or not _matches(labels, label_filter):
+                continue
+            bound = float("inf") if le == "+Inf" else float(le)
+            out[bound] = out.get(bound, 0.0) + value
+        return out
+
+    def histogram_quantile(self, name: str, q: float, window_s: float,
+                           now: float,
+                           label_filter: Optional[Dict[str, str]] = None
+                           ) -> Optional[float]:
+        return quantile_from_buckets(
+            q, self.bucket_rates(name, window_s, now, label_filter))
+
+    def exemplars(self, name: Optional[str] = None,
+                  label_filter: Optional[Dict[str, str]] = None
+                  ) -> List[Dict[str, Any]]:
+        """Latest bucket exemplars, newest first: the trace ids the
+        fleet-health page links at ``/tracez?trace_id=``."""
+        with self._lock:
+            items = list(self._exemplars.items())
+        out = []
+        for (sample_name, key), (trace_id, value, ts) in items:
+            labels = dict(key)
+            if name is not None and sample_name != f"{name}_bucket":
+                continue
+            if not _matches(labels, label_filter):
+                continue
+            out.append({"metric": sample_name, "labels": labels,
+                        "trace_id": trace_id, "value": value,
+                        "ts": ts})
+        out.sort(key=lambda e: -e["ts"])
+        return out
+
+    def state(self) -> Dict[str, Any]:
+        """Store stats for the dashboard/CI artifact."""
+        with self._lock:
+            per_name = {name: len(by_labels)
+                        for name, by_labels in self._series.items()}
+            return {"series": self._count,
+                    "dropped_series": self._dropped,
+                    "max_series": self.max_series,
+                    "families": len(per_name),
+                    "exemplars": len(self._exemplars),
+                    "series_by_name": dict(sorted(
+                        per_name.items(), key=lambda kv: -kv[1])[:20])}
+
+
+@dataclass(frozen=True)
+class ScrapeTarget:
+    """One /metrics endpoint: ``address`` becomes the ``instance``
+    label, ``job`` names the plane (serving | router | operator |
+    dashboard | ...)."""
+
+    address: str
+    job: str = "serving"
+
+    @property
+    def url(self) -> str:
+        base = (self.address if "://" in self.address
+                else f"http://{self.address}")
+        return f"{base}/metrics"
+
+
+def parse_static_targets(spec: str, default_job: str = "static"
+                         ) -> List[ScrapeTarget]:
+    """The shared ``addr[=job][,addr[=job]...]`` grammar of every
+    --static / --collect_static flag (sidecar CLI and dashboard alike
+    — one parser, one syntax)."""
+    targets = []
+    for item in filter(None, (spec or "").split(",")):
+        address, _, job = item.partition("=")
+        targets.append(ScrapeTarget(address.strip(),
+                                    job.strip() or default_job))
+    return targets
+
+
+def scrape_metrics(target: ScrapeTarget, timeout_s: float = 2.0) -> str:
+    """One bounded /metrics fetch. Sends the OpenMetrics ``Accept``
+    (falling back to 0.0.4 — the server negotiates) so exemplars ride
+    along when the endpoint supports them; the per-scrape timeout is
+    the no-unbounded-fetch contract (one dead replica must cost the
+    cycle one timeout, not wedge it)."""
+    request = urllib.request.Request(target.url, headers={
+        "Accept": ("application/openmetrics-text; version=1.0.0, "
+                   "text/plain;version=0.0.4;q=0.5"),
+    })
+    with urllib.request.urlopen(request, timeout=timeout_s) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
+@dataclass
+class _TargetStatus:
+    ok: bool = False
+    error: str = ""
+    at: float = 0.0            # monotonic, scrape completion
+    duration_ms: float = 0.0
+    samples: int = 0
+    dropped: int = 0
+    job: str = ""
+
+    def snapshot(self, now: float) -> Dict[str, Any]:
+        return {"ok": self.ok, "error": self.error, "job": self.job,
+                "age_s": round(max(0.0, now - self.at), 1),
+                "duration_ms": round(self.duration_ms, 2),
+                "samples": self.samples, "dropped": self.dropped}
+
+
+class Collector:
+    """The fleet scrape loop: discover targets, fetch every
+    ``/metrics`` concurrently with a per-scrape deadline, parse
+    strictly, ingest into the store, then run the ``on_cycle`` hooks
+    (the SLO evaluator registers here so alerting runs on fresh data,
+    same thread, no second timer)."""
+
+    def __init__(self, store: Optional[TimeSeriesStore] = None, *,
+                 source: Optional[Any] = None,
+                 pool: Optional[Any] = None,
+                 static_targets: Sequence[Any] = (),
+                 interval_s: float = 5.0,
+                 timeout_s: float = 2.0,
+                 fetch: Optional[Callable[[ScrapeTarget], str]] = None,
+                 max_workers: int = 8):
+        self.store = store or TimeSeriesStore()
+        self.source = source          # specs() → [(address, grpc)]
+        self.pool = pool              # EndpointPool → endpoints()
+        self.static_targets = [self._coerce_target(t)
+                               for t in static_targets]
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self._fetch = fetch
+        self.on_cycle: List[Callable[[float], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._executor: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
+        self._max_workers = int(max_workers)
+        self._status: Dict[str, _TargetStatus] = {}
+        self._status_lock = threading.Lock()
+        self.cycles = 0
+        _G_SERIES.set_function(self.store.series_count)
+        _C_DROPPED.set_function(self.store.dropped_series)
+        _LIVE.add(self)
+
+    @staticmethod
+    def _coerce_target(t: Any) -> ScrapeTarget:
+        if isinstance(t, ScrapeTarget):
+            return t
+        if isinstance(t, str):
+            return ScrapeTarget(t)
+        address, job = t
+        return ScrapeTarget(address, job)
+
+    def targets(self) -> List[ScrapeTarget]:
+        """Static targets + the serving fleet as discovered RIGHT NOW
+        (endpoints file hot-reloads; the pool follows scale events) —
+        membership churn needs no collector restart."""
+        out: Dict[str, ScrapeTarget] = {}
+        for t in self.static_targets:
+            out.setdefault(t.address, t)
+        if self.source is not None:
+            for address, _grpc in self.source.specs():
+                out.setdefault(address, ScrapeTarget(address, "serving"))
+        if self.pool is not None:
+            for ep in self.pool.endpoints():
+                out.setdefault(ep.address,
+                               ScrapeTarget(ep.address, "serving"))
+        return list(out.values())
+
+    def _scrape_one(self, target: ScrapeTarget
+                    ) -> Tuple[ScrapeTarget, Optional[str], str,
+                               float, float]:
+        t0 = time.monotonic()
+        fetch = self._fetch or (
+            lambda t: scrape_metrics(t, self.timeout_s))
+        try:
+            text: Optional[str] = fetch(target)
+            error = ""
+        except Exception as e:  # noqa: BLE001 — unreachable target
+            text, error = None, f"{type(e).__name__}: {e}"
+        done_at = time.monotonic()
+        # Per-target completion time rides back with the result: the
+        # fan-out's map() drains only when the SLOWEST fetch (a dead
+        # replica's full timeout) returns, and a fast target's
+        # samples must carry the moment ITS scrape finished, not the
+        # cycle-drain time — short-window rate denominators feel a
+        # 2 s skew.
+        return target, text, error, done_at - t0, done_at
+
+    def scrape_once(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """One full cycle (tests call this directly; run() paces it).
+        All targets scrape concurrently so N dead replicas cost ONE
+        timeout, not N."""
+        targets = self.targets()
+        results = []
+        if targets:
+            if self._executor is None:
+                self._executor = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=self._max_workers,
+                    thread_name_prefix="kft-scrape")
+            results = list(self._executor.map(self._scrape_one,
+                                              targets))
+        ok = failed = 0
+        for target, text, error, duration_s, done_at in results:
+            at = done_at if now is None else now
+            status = _TargetStatus(at=at, job=target.job,
+                                   duration_ms=duration_s * 1e3)
+            if text is not None:
+                try:
+                    families = obs_metrics.parse_exposition(text)
+                    ingested, dropped = self.store.ingest_exposition(
+                        families, at,
+                        {"instance": target.address,
+                         "job": target.job})
+                    status.ok = True
+                    status.samples = ingested
+                    status.dropped = dropped
+                except ValueError as e:
+                    error = f"parse: {e}"
+            if status.ok:
+                ok += 1
+            else:
+                failed += 1
+                status.error = error[:200]
+            _C_SCRAPES.labels(target.address,
+                              "ok" if status.ok else "error").inc()
+            _H_SCRAPE.observe(duration_s)
+            with self._status_lock:
+                self._status[target.address] = status
+        with self._status_lock:
+            live = {t.address for t in targets}
+            for address in list(self._status):
+                if address not in live:
+                    del self._status[address]
+                    # Pod-IP churn must not grow the collector's own
+                    # /metrics without bound (the r10 per-address
+                    # metric-children rule).
+                    _C_SCRAPES.remove_labels(address, "ok")
+                    _C_SCRAPES.remove_labels(address, "error")
+        self.cycles += 1
+        cycle_now = time.monotonic() if now is None else now
+        for hook in list(self.on_cycle):
+            try:
+                hook(cycle_now)
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                logger.exception("collector on_cycle hook failed")
+        return {"targets": len(targets), "ok": ok, "failed": failed}
+
+    def target_status(self, now: Optional[float] = None
+                      ) -> Dict[str, Dict[str, Any]]:
+        now = time.monotonic() if now is None else now
+        with self._status_lock:
+            return {address: status.snapshot(now)
+                    for address, status in sorted(self._status.items())}
+
+    def state(self) -> Dict[str, Any]:
+        """Collector + store snapshot (dashboard /tpujobs/api/slo and
+        the CI artifact trail)."""
+        return {"cycles": self.cycles,
+                "interval_s": self.interval_s,
+                "targets": self.target_status(),
+                "store": self.store.state()}
+
+    def run(self, *, max_cycles: Optional[int] = None) -> None:
+        cycles = 0
+        while not self._stop.is_set():
+            try:
+                self.scrape_once()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                logger.exception("collector cycle failed")
+            cycles += 1
+            if max_cycles is not None and cycles >= max_cycles:
+                return
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self.run,
+                                        name="kft-collector",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+            self._executor = None
+
+
+def fleet_replica_rows(collector: Collector,
+                       specs: Sequence[Tuple[str, Optional[str]]],
+                       now: Optional[float] = None,
+                       window_s: Optional[float] = None
+                       ) -> List[Dict[str, Any]]:
+    """Per-replica autoscaler rows from the collector's store instead
+    of a second scrape sweep: one fleet, one scraper. Shapes match
+    ``AutoscalerLoop._replica_sample`` — queue wait from the serving
+    gauges (depth × est latency, per model, summed), shed/expired as
+    store rates (counter-reset-aware), reachability from the last
+    scrape status."""
+    now = time.monotonic() if now is None else now
+    window_s = window_s or max(4 * collector.interval_s, 10.0)
+    status = collector.target_status(now)
+    store = collector.store
+    rows: List[Dict[str, Any]] = []
+    for address, _grpc in specs:
+        st = status.get(address)
+        if st is None or not st.get("ok"):
+            rows.append({"address": address, "reachable": False})
+            continue
+        flt = {"instance": address}
+        depth_by_model = {
+            labels.get("model", ""): value
+            for labels, _, value in store.latest(
+                "kft_serving_queue_depth", flt,
+                staleness_s=window_s, now=now)}
+        latency_by_model = {
+            labels.get("model", ""): value
+            for labels, _, value in store.latest(
+                "kft_serving_est_batch_latency_seconds", flt,
+                staleness_s=window_s, now=now)}
+        queue_wait_ms = sum(
+            depth * latency_by_model.get(model, 0.0) * 1e3
+            for model, depth in depth_by_model.items())
+        shed_rate = store.sum_rate("kft_serving_shed_total",
+                                   window_s, now, flt) or 0.0
+        expired_rate = store.sum_rate("kft_serving_expired_total",
+                                      window_s, now, flt) or 0.0
+        rows.append({
+            "address": address,
+            "reachable": True,
+            "status": "ok",
+            "queue_wait_ms": round(queue_wait_ms, 3),
+            "shed_rate": round(shed_rate, 4),
+            "expired_rate": round(expired_rate, 4),
+            "resident_models": sorted(m for m in depth_by_model if m),
+        })
+    return rows
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kft-collector")
+    parser.add_argument("--static", default="",
+                        help="static scrape targets: "
+                             "addr[=job][,addr[=job]...]")
+    parser.add_argument("--endpoints_file", default=None,
+                        help="serving-fleet membership JSON (the "
+                             "autoscaler-maintained file; hot-reloads)")
+    parser.add_argument("--interval", type=float, default=5.0)
+    parser.add_argument("--timeout", type=float, default=2.0,
+                        help="per-scrape deadline (seconds)")
+    parser.add_argument("--max_series", type=int, default=8192,
+                        help="series-cardinality cap")
+    parser.add_argument("--metrics_port", type=int, default=0,
+                        help="expose the collector's OWN /metrics "
+                             "(+ /tracez); 0 disables")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--alerts", action="store_true",
+                        help="evaluate the default serving SLOs and "
+                             "publish alerts (Event + kft-alerts "
+                             "ConfigMap); needs apiserver access")
+    parser.add_argument("--apiserver", default=None,
+                        help="apiserver base URL (dev); default: "
+                             "in-cluster ServiceAccount")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    source = None
+    if args.endpoints_file:
+        from kubeflow_tpu.scaling.endpoints import FileEndpointSource
+
+        source = FileEndpointSource(args.endpoints_file)
+    static = parse_static_targets(args.static)
+    store = TimeSeriesStore(max_series=args.max_series)
+    collector = Collector(store, source=source, static_targets=static,
+                          interval_s=args.interval,
+                          timeout_s=args.timeout)
+    if args.alerts:
+        from kubeflow_tpu.obs.slo import AlertManager, default_slos
+        from kubeflow_tpu.operator.http_client import HttpApiClient
+
+        api = (HttpApiClient(args.apiserver) if args.apiserver
+               else HttpApiClient.in_cluster())
+        alerts = AlertManager(store, default_slos(),
+                              api=api, namespace=args.namespace)
+        collector.on_cycle.append(alerts.evaluate)
+    if args.metrics_port:
+        from kubeflow_tpu.obs.exposition import start_exposition_server
+
+        start_exposition_server(args.metrics_port)
+        logger.info("collector metrics on :%d", args.metrics_port)
+    logger.info("collector: %d static target(s)%s, interval %.1fs",
+                len(static),
+                f" + endpoints file {args.endpoints_file}"
+                if args.endpoints_file else "",
+                args.interval)
+    try:
+        collector.run()
+    except KeyboardInterrupt:
+        collector.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -100,26 +100,67 @@ class TraceContextHandlerMixin:
                  or ("ok" if self.get_status() < 400 else "error")})
 
 
+def _tracez_filters(get_arg) -> Dict[str, Any]:
+    """Parse the shared /tracez query grammar (?trace_id= / ?status= /
+    ?min_duration_ms= / ?limit=) from any ``get_arg(name) ->
+    Optional[str]``. Raises ValueError on a non-numeric number — the
+    handlers answer 400, never 500."""
+    filters: Dict[str, Any] = {
+        "trace_id": get_arg("trace_id") or None,
+        "status": get_arg("status") or None,
+        "min_duration_ms": None,
+        "limit": None,
+    }
+    raw = get_arg("min_duration_ms")
+    if raw:
+        filters["min_duration_ms"] = float(raw)
+    raw = get_arg("limit")
+    if raw:
+        filters["limit"] = int(raw)
+    return filters
+
+
+def _tracez_body(tracer, filters: Dict[str, Any]) -> str:
+    spans = obs_tracing.filter_spans(tracer.snapshot(), **filters)
+    return json.dumps(tracer.export_chrome(spans=spans))
+
+
 if _tornado_web is not None:
     class MetricsHandler(_tornado_web.RequestHandler):
         """GET /metrics — Prometheus text exposition of the default
-        registry (or a ``metrics_registry`` app setting override)."""
+        registry (or a ``metrics_registry`` app setting override).
+        Content negotiation: OpenMetrics (with exemplars) when the
+        scraper's Accept asks for it, text 0.0.4 otherwise."""
 
         def get(self):
             registry = self.application.settings.get("metrics_registry")
-            self.set_header("Content-Type", obs_metrics.CONTENT_TYPE)
-            self.finish(obs_metrics.render(registry))
+            ctype = obs_metrics.negotiate_content_type(
+                self.request.headers.get("Accept"))
+            self.set_header("Content-Type", ctype)
+            self.finish(obs_metrics.render(
+                registry,
+                openmetrics=ctype is obs_metrics
+                .CONTENT_TYPE_OPENMETRICS))
 
     class ChromeTraceHandler(_tornado_web.RequestHandler):
         """GET /tracez — the span ring buffer as Chrome trace-event
         JSON (open in Perfetto / chrome://tracing;
-        docs/observability.md)."""
+        docs/observability.md). Query filters ?trace_id= / ?status= /
+        ?min_duration_ms= / ?limit= narrow the dump (a full ring is
+        megabytes of JSON; the exemplar workflow lands here with a
+        trace id in hand)."""
 
         def get(self):
             tracer = (self.application.settings.get("tracer")
                       or obs_tracing.TRACER)
+            try:
+                filters = _tracez_filters(
+                    lambda name: self.get_query_argument(name, ""))
+            except ValueError as e:
+                self.set_status(400)
+                return self.finish({"error": str(e)})
             self.set_header("Content-Type", "application/json")
-            self.finish(json.dumps(tracer.export_chrome()))
+            self.finish(_tracez_body(tracer, filters))
 else:  # pragma: no cover — tornado-less images use the stdlib server
     MetricsHandler = ChromeTraceHandler = None
 
@@ -162,15 +203,27 @@ class _ExpositionHandler(BaseHTTPRequestHandler):
     carry the registry/tracer (set by start_exposition_server)."""
 
     def do_GET(self):  # noqa: N802 — stdlib contract
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
+            ctype = obs_metrics.negotiate_content_type(
+                self.headers.get("Accept"))
             body = obs_metrics.render(
-                getattr(self.server, "registry", None)).encode()
-            ctype = obs_metrics.CONTENT_TYPE
+                getattr(self.server, "registry", None),
+                openmetrics=ctype is obs_metrics.CONTENT_TYPE_OPENMETRICS
+            ).encode()
         elif path == "/tracez":
+            from urllib.parse import parse_qs
+
             tracer = (getattr(self.server, "tracer", None)
                       or obs_tracing.TRACER)
-            body = json.dumps(tracer.export_chrome()).encode()
+            params = parse_qs(query)
+            try:
+                filters = _tracez_filters(
+                    lambda name: (params.get(name) or [""])[0])
+            except ValueError as e:
+                self.send_error(400, str(e))
+                return
+            body = _tracez_body(tracer, filters).encode()
             ctype = "application/json"
         elif path == "/healthz":
             body = b'{"status": "ok"}'

@@ -44,18 +44,22 @@ import bisect
 import json
 import re
 import threading
+import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 __all__ = [
     "CONTENT_TYPE",
+    "CONTENT_TYPE_OPENMETRICS",
     "FORBIDDEN_LABELS",
     "Counter",
     "Gauge",
     "Histogram",
     "REGISTRY",
     "Registry",
+    "counter_increase",
     "dump_jsonl",
     "enabled",
+    "negotiate_content_type",
     "parse_exposition",
     "render",
     "set_enabled",
@@ -63,6 +67,37 @@ __all__ = [
 
 #: The Prometheus text exposition content type (format version 0.0.4).
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: The OpenMetrics text content type — the format that carries
+#: exemplars. Served only when the scraper ASKS for it via Accept
+#: (see :func:`negotiate_content_type`); everything else gets 0.0.4.
+CONTENT_TYPE_OPENMETRICS = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8")
+
+
+def negotiate_content_type(accept: Optional[str]) -> str:
+    """Scrape-handler content negotiation: OpenMetrics when the
+    client's ``Accept`` names it, Prometheus text 0.0.4 otherwise —
+    the fallback ladder real Prometheus servers use. Exemplars only
+    ride the OpenMetrics form (the 0.0.4 grammar has no ``#`` exemplar
+    clause, and a strict 0.0.4 parser would reject it)."""
+    if accept and "application/openmetrics-text" in accept:
+        return CONTENT_TYPE_OPENMETRICS
+    return CONTENT_TYPE
+
+
+def counter_increase(prev: float, cur: float) -> float:
+    """Increase of a cumulative counter between two samples, aware of
+    process restarts: a counter that DROPPED was reset to zero (the
+    replica restarted) and has climbed back to ``cur`` — the increase
+    since the previous sample is at least ``cur``, never the negative
+    delta. One shared helper for every rate() computed from scraped
+    counters (the collector's store and the autoscaler's shed-rate
+    differencing both ride this; a naive subtraction turns one
+    replica restart into a huge negative rate)."""
+    if cur >= prev:
+        return cur - prev
+    return max(0.0, cur)
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -153,12 +188,16 @@ class Registry:
         with self._lock:
             return sorted(self._metrics.values(), key=lambda m: m.name)
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
         out: List[str] = []
         for metric in self.collect():
             out.append(f"# HELP {metric.name} {escape_help(metric.help)}")
             out.append(f"# TYPE {metric.name} {metric.type}")
-            out.extend(metric._samples())
+            out.extend(metric._samples(openmetrics=openmetrics))
+        if openmetrics:
+            # The OpenMetrics terminator: a scraper that sees no EOF
+            # treats the scrape as truncated.
+            out.append("# EOF")
         return "\n".join(out) + "\n" if out else ""
 
 
@@ -166,8 +205,9 @@ class Registry:
 REGISTRY = Registry()
 
 
-def render(registry: Optional[Registry] = None) -> str:
-    return (registry or REGISTRY).render()
+def render(registry: Optional[Registry] = None,
+           openmetrics: bool = False) -> str:
+    return (registry or REGISTRY).render(openmetrics=openmetrics)
 
 
 def _format_value(value: float) -> str:
@@ -335,7 +375,7 @@ class _Metric:
         with self._children_lock:
             return list(self._children.items())
 
-    def _samples(self) -> List[str]:
+    def _samples(self, openmetrics: bool = False) -> List[str]:
         out = []
         for values, child in sorted(self._iter_children()):
             out.append(f"{self.name}"
@@ -394,22 +434,33 @@ class Gauge(_Metric):
 
 
 class _HistogramChild:
-    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count")
+    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count",
+                 "_exemplars")
 
-    def __init__(self, buckets: Tuple[float, ...]):
+    def __init__(self, buckets: Tuple[float, ...],
+                 exemplars: bool = False):
         self._lock = threading.Lock()
         self._buckets = buckets
         self._counts = [0] * len(buckets)
         self._sum = 0.0
         self._count = 0
+        # One latest exemplar per bucket (index len(buckets) = +Inf):
+        # (trace_id, value, unix_ts). Bounded by bucket count, so
+        # exemplar memory can never grow with traffic.
+        self._exemplars: Optional[List[Optional[Tuple[str, float,
+                                                      float]]]] = (
+            [None] * (len(buckets) + 1) if exemplars else None)
 
     def reset(self) -> None:
         with self._lock:
             self._counts = [0] * len(self._buckets)
             self._sum = 0.0
             self._count = 0
+            if self._exemplars is not None:
+                self._exemplars = [None] * (len(self._buckets) + 1)
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                trace_id: Optional[str] = None) -> None:
         if not _enabled:
             return
         value = float(value)
@@ -421,23 +472,40 @@ class _HistogramChild:
             i = bisect.bisect_left(self._buckets, value)
             if i < len(self._buckets):
                 self._counts[i] += 1
+            if trace_id and self._exemplars is not None:
+                # The OpenMetrics exemplar: the trace that landed in
+                # THIS bucket, latest wins — the join key from "the
+                # p99 bucket grew" to the one slow request's spans.
+                self._exemplars[i] = (str(trace_id)[:128], value,
+                                      time.time())
 
     def snapshot(self):
         with self._lock:
-            return list(self._counts), self._sum, self._count
+            exemplars = (list(self._exemplars)
+                         if self._exemplars is not None else None)
+            return list(self._counts), self._sum, self._count, exemplars
 
 
 class Histogram(_Metric):
     """Observations bucketed by upper bound. Exposition emits
     CUMULATIVE ``_bucket{le=...}`` samples (``+Inf`` == ``_count``),
-    plus ``_sum`` and ``_count`` — the histogram_quantile contract."""
+    plus ``_sum`` and ``_count`` — the histogram_quantile contract.
+
+    With ``exemplars=True``, ``observe(value, trace_id=...)`` pins the
+    trace id to the bucket the observation lands in; the OpenMetrics
+    render (``render(openmetrics=True)``) emits it as a bucket
+    exemplar, which is how a dashboard jumps from "the deadline bucket
+    grew" straight to one retained trace in ``/tracez?trace_id=``.
+    The classic 0.0.4 render never carries exemplars (its grammar has
+    none), so plain scrapers are unaffected."""
 
     type = "histogram"
 
     def __init__(self, name: str, help: str,  # noqa: A002
                  labelnames: Iterable[str] = (),
                  buckets: Iterable[float] = DEFAULT_BUCKETS,
-                 registry: Optional[Registry] = REGISTRY):
+                 registry: Optional[Registry] = REGISTRY,
+                 exemplars: bool = False):
         buckets = tuple(sorted(float(b) for b in buckets))
         if not buckets:
             raise ValueError("histogram needs at least one bucket")
@@ -446,28 +514,43 @@ class Histogram(_Metric):
         if buckets and buckets[-1] == float("inf"):
             buckets = buckets[:-1]  # +Inf is implicit
         self.buckets = buckets
+        self.exemplars = bool(exemplars)
         super().__init__(name, help, labelnames, registry)
 
     def _make_child(self):
-        return _HistogramChild(self.buckets)
+        return _HistogramChild(self.buckets, exemplars=self.exemplars)
 
-    def observe(self, value: float) -> None:
-        self._default_child().observe(value)
+    def observe(self, value: float,
+                trace_id: Optional[str] = None) -> None:
+        self._default_child().observe(value, trace_id=trace_id)
 
-    def _samples(self) -> List[str]:
+    @staticmethod
+    def _exemplar_str(exemplar: Tuple[str, float, float]) -> str:
+        trace_id, value, ts = exemplar
+        return (f' # {{trace_id="{escape_label_value(trace_id)}"}} '
+                f"{_format_value(value)} {ts:.3f}")
+
+    def _samples(self, openmetrics: bool = False) -> List[str]:
         out = []
         for values, child in sorted(self._iter_children()):
-            counts, total, count = child.snapshot()
+            counts, total, count, exemplars = child.snapshot()
+            if not openmetrics:
+                exemplars = None
             cumulative = 0
-            for bound, n in zip(self.buckets, counts):
+            for i, (bound, n) in enumerate(zip(self.buckets, counts)):
                 cumulative += n
                 labels = _label_str(
                     self.labelnames + ("le",),
                     values + (_format_value(bound),))
-                out.append(f"{self.name}_bucket{labels} {cumulative}")
+                suffix = (self._exemplar_str(exemplars[i])
+                          if exemplars and exemplars[i] else "")
+                out.append(
+                    f"{self.name}_bucket{labels} {cumulative}{suffix}")
             labels = _label_str(self.labelnames + ("le",),
                                 values + ("+Inf",))
-            out.append(f"{self.name}_bucket{labels} {count}")
+            suffix = (self._exemplar_str(exemplars[-1])
+                      if exemplars and exemplars[-1] else "")
+            out.append(f"{self.name}_bucket{labels} {count}{suffix}")
             base = _label_str(self.labelnames, values)
             out.append(f"{self.name}_sum{base} {_format_value(total)}")
             out.append(f"{self.name}_count{base} {count}")
@@ -525,15 +608,37 @@ def _parse_labels(text: str) -> Dict[str, str]:
     return labels
 
 
+def _parse_exemplar(blob: str, lineno: int) -> Tuple[Dict[str, str],
+                                                     float,
+                                                     Optional[float]]:
+    """Parse the OpenMetrics exemplar clause ``{labels} value [ts]``
+    (the part after the sample's `` # `` separator)."""
+    m = re.match(r"^\{(.*)\}\s+(\S+)(?:\s+(\S+))?$", blob.strip())
+    if not m:
+        raise ValueError(f"line {lineno}: malformed exemplar {blob!r}")
+    label_blob, value_text, ts_text = m.groups()
+    labels = _parse_labels(label_blob) if label_blob else {}
+    try:
+        value = float(value_text)
+        ts = float(ts_text) if ts_text is not None else None
+    except ValueError:
+        raise ValueError(
+            f"line {lineno}: bad exemplar value in {blob!r}") from None
+    return labels, value, ts
+
+
 def parse_exposition(text: str) -> Dict[str, Dict[str, Any]]:
-    """Strictly parse Prometheus text exposition. Returns
-    ``{family: {"help", "type", "samples": [(name, labels, value)]}}``.
+    """Strictly parse Prometheus text exposition (0.0.4 and the
+    OpenMetrics text extensions: bucket exemplars, ``# EOF``). Returns
+    ``{family: {"help", "type", "samples": [(name, labels, value)],
+    "exemplars": [(name, labels, ex_labels, ex_value, ex_ts)]}}``.
 
     Raises ValueError on: samples before their family's TYPE line,
     malformed label quoting/escapes, non-float values, histogram
     bucket counts that are not monotonically non-decreasing in
     ``le``-order, or ``+Inf`` != ``_count``. This is the validator
-    the endpoint tests run every scrape surface through.
+    the endpoint tests run every scrape surface through, and the
+    collector's ingest front end.
     """
     families: Dict[str, Dict[str, Any]] = {}
     for lineno, line in enumerate(text.splitlines(), 1):
@@ -543,7 +648,7 @@ def parse_exposition(text: str) -> Dict[str, Dict[str, Any]]:
             _, _, rest = line.partition("# HELP ")
             name, _, help_text = rest.partition(" ")
             families.setdefault(name, {"help": None, "type": None,
-                                       "samples": []})
+                                       "samples": [], "exemplars": []})
             families[name]["help"] = help_text
             continue
         if line.startswith("# TYPE "):
@@ -553,24 +658,52 @@ def parse_exposition(text: str) -> Dict[str, Dict[str, Any]]:
                             "untyped"):
                 raise ValueError(f"line {lineno}: unknown type {mtype!r}")
             families.setdefault(name, {"help": None, "type": None,
-                                       "samples": []})
+                                       "samples": [], "exemplars": []})
             families[name]["type"] = mtype
             continue
         if line.startswith("#"):
-            continue  # comment
-        # Sample line: name[{labels}] value
-        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$",
-                     line)
-        if not m:
-            raise ValueError(f"line {lineno}: malformed sample {line!r}")
-        sample_name, label_blob, value_text = m.groups()
-        labels = _parse_labels(label_blob[1:-1]) if label_blob else {}
-        try:
-            value = float(value_text.replace("+Inf", "inf")
-                          .replace("-Inf", "-inf"))
-        except ValueError:
-            raise ValueError(
-                f"line {lineno}: bad value {value_text!r}") from None
+            continue  # comment (includes the OpenMetrics "# EOF")
+
+        def try_sample(candidate: str):
+            # Sample line: name[{labels}] value. Returns the parsed
+            # triple, or an error string when the candidate doesn't
+            # parse as one (kept so the final diagnostic can name the
+            # real problem, e.g. "bad value").
+            m = re.match(
+                r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$",
+                candidate)
+            if not m:
+                return None, f"malformed sample {candidate!r}"
+            name, label_blob, value_text = m.groups()
+            try:
+                labels = (_parse_labels(label_blob[1:-1])
+                          if label_blob else {})
+            except ValueError as e:
+                return None, str(e)
+            try:
+                value = float(value_text.replace("+Inf", "inf")
+                              .replace("-Inf", "-inf"))
+            except ValueError:
+                return None, f"bad value {value_text!r}"
+            return (name, labels, value), None
+
+        # OpenMetrics exemplar clause rides after " # " on a sample
+        # line — but a LABEL VALUE may legally contain " # " too, so
+        # try the whole line as a plain sample first, then each split
+        # point left to right (the first left side that parses as a
+        # sample wins; anything right of it is the exemplar).
+        exemplar_blob = None
+        parsed, error = try_sample(line)
+        if parsed is None:
+            idx = line.find(" # ")
+            while idx != -1 and parsed is None:
+                parsed, _ = try_sample(line[:idx])
+                if parsed is not None:
+                    exemplar_blob = line[idx + 3:]
+                idx = line.find(" # ", idx + 1)
+        if parsed is None:
+            raise ValueError(f"line {lineno}: {error}")
+        sample_name, labels, value = parsed
         family = sample_name
         for suffix in ("_bucket", "_sum", "_count"):
             base = sample_name[:-len(suffix)] \
@@ -583,6 +716,11 @@ def parse_exposition(text: str) -> Dict[str, Dict[str, Any]]:
                 f"line {lineno}: sample {sample_name} precedes its "
                 f"# TYPE line")
         families[family]["samples"].append((sample_name, labels, value))
+        if exemplar_blob is not None:
+            ex_labels, ex_value, ex_ts = _parse_exemplar(
+                exemplar_blob, lineno)
+            families[family]["exemplars"].append(
+                (sample_name, labels, ex_labels, ex_value, ex_ts))
     _validate_histograms(families)
     return families
 

@@ -1,0 +1,517 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Declarative SLOs + Google-SRE multi-window burn-rate alerting.
+
+The alerting half of the fleet telemetry pipeline (obs/collector.py
+holds the store this evaluates against). Two SLO shapes cover the
+tree's service promises:
+
+- **ratio** — "99% of requests meet their deadline": ``bad_metrics``
+  (shed + expired counters) over ``total_metrics``, both as
+  cross-replica summed rates.
+- **latency** — "TTFT p95 < X ms" / "reconcile p99 < Y ms": the
+  fraction of histogram observations ABOVE the threshold bucket is
+  the error ratio (p95 < X ⟺ ≤5% of observations exceed X), so one
+  burn-rate pipeline serves both shapes.
+
+Burn rate = error ratio ÷ error budget (1 − objective): burn 1 spends
+exactly the budget over the SLO period; burn 14.4 exhausts a 30-day
+budget in 2 days. The SRE-workbook rule needs BOTH a long and a short
+window above the factor — the long window proves significance, the
+short window proves the problem is STILL happening (so a resolved
+incident stops paging while the long window is still digesting it):
+
+- fast page: 5 m AND 1 h over 14.4× — budget-threatening, page now.
+- slow ticket: 6 h AND 3 d over 1× — steady leak, file a ticket.
+
+:class:`AlertManager` runs the state machine per (SLO, window):
+``inactive → pending → firing → resolved``, with a ``for`` duration
+before firing and a clear-hold before resolving (flap damping — a
+burn rate oscillating around the threshold neither fires per blip nor
+resolves per dip). Firing/resolved transitions publish a Kubernetes
+Event + the ``kft-alerts`` ConfigMap (the operator-metrics pattern:
+the dashboard reads the same object the alerter wrote) and every
+state is exported as the ``kft_alert_state`` gauge.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs.collector import TimeSeriesStore
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ALERTS_CONFIGMAP",
+    "ALERTS_KEY",
+    "AlertManager",
+    "BurnWindow",
+    "FAST_PAGE",
+    "SLO",
+    "SLOW_TICKET",
+    "default_slos",
+]
+
+#: ConfigMap firing alerts are published to (dashboard + kubectl read
+#: the same object; also the Events' involvedObject).
+ALERTS_CONFIGMAP = "kft-alerts"
+ALERTS_KEY = "alerts.json"
+
+#: Alert states as the ``kft_alert_state`` gauge encodes them.
+STATE_VALUES = {"inactive": 0.0, "pending": 1.0, "firing": 2.0,
+                "resolved": 0.0}
+
+_G_ALERT_STATE = obs_metrics.Gauge(
+    "kft_alert_state",
+    "SLO alert state (0=inactive/resolved, 1=pending, 2=firing)",
+    ("slo", "severity"))
+_C_TRANSITIONS = obs_metrics.Counter(
+    "kft_alert_transitions_total",
+    "Alert state-machine transitions", ("slo", "to"))
+
+
+@dataclass(frozen=True)
+class BurnWindow:
+    """One multi-window burn-rate rule: alert when the error budget
+    burns faster than ``factor``× over BOTH windows."""
+
+    name: str
+    long_s: float
+    short_s: float
+    factor: float
+    severity: str  # "page" | "ticket"
+
+
+#: The Google SRE workbook pair (§ alerting on SLOs): page on a fast
+#: burn, ticket on a slow leak.
+FAST_PAGE = BurnWindow("fast", long_s=3600.0, short_s=300.0,
+                       factor=14.4, severity="page")
+SLOW_TICKET = BurnWindow("slow", long_s=3 * 86400.0, short_s=6 * 3600.0,
+                         factor=1.0, severity="ticket")
+
+
+@dataclass
+class SLO:
+    """One service-level objective over the collector's store.
+
+    Ratio form: ``bad_metrics`` / ``total_metrics`` (counter names,
+    rates summed across every matching series). Latency form:
+    ``histogram`` + ``threshold_s`` — the error ratio is the fraction
+    of observations above the threshold's bucket.
+    """
+
+    name: str
+    objective: float
+    description: str = ""
+    bad_metrics: Tuple[str, ...] = ()
+    total_metrics: Tuple[str, ...] = ()
+    histogram: Optional[str] = None
+    threshold_s: Optional[float] = None
+    label_filter: Optional[Dict[str, str]] = None
+    windows: Tuple[BurnWindow, ...] = (FAST_PAGE, SLOW_TICKET)
+
+    def __post_init__(self):
+        if not (0.0 < self.objective < 1.0):
+            raise ValueError(f"objective must be in (0, 1), got "
+                             f"{self.objective}")
+        ratio = bool(self.bad_metrics or self.total_metrics)
+        latency = self.histogram is not None
+        if ratio == latency:
+            raise ValueError(
+                f"SLO {self.name!r}: define exactly one of "
+                f"bad/total_metrics (ratio) or histogram+threshold_s "
+                f"(latency)")
+        if latency and self.threshold_s is None:
+            raise ValueError(f"SLO {self.name!r}: latency form needs "
+                             f"threshold_s")
+
+    @property
+    def error_budget(self) -> float:
+        return 1.0 - self.objective
+
+    def _sum_rates(self, store: TimeSeriesStore, names: Sequence[str],
+                   window_s: float, now: float) -> Optional[float]:
+        total = None
+        for name in names:
+            rate = store.sum_rate(name, window_s, now,
+                                  self.label_filter)
+            if rate is not None:
+                total = (total or 0.0) + rate
+        return total
+
+    def error_ratio(self, store: TimeSeriesStore, window_s: float,
+                    now: float) -> Optional[float]:
+        """Fraction of events violating the objective over the
+        window; None when the store has no data (no data is NOT a
+        zero error rate — alerting on blindness both ways is wrong,
+        so the state machine simply holds)."""
+        if self.histogram is not None:
+            buckets = store.bucket_rates(self.histogram, window_s, now,
+                                         self.label_filter)
+            if not buckets:
+                return None
+            total = buckets.get(float("inf"),
+                                max(buckets.values(), default=0.0))
+            if total <= 0.0:
+                return 0.0
+            # Cumulative rate at the threshold's bucket = the GOOD
+            # fraction. A threshold between bounds uses the LARGEST
+            # bound ≤ threshold — genuinely conservative at the
+            # bucket grid's resolution: observations between that
+            # bound and the threshold count as violations (slight
+            # over-alerting), never the reverse (a mid-bucket
+            # threshold that can silently never fire).
+            finite = sorted(b for b in buckets if b != float("inf"))
+            good = 0.0
+            for bound in finite:
+                if bound <= self.threshold_s:
+                    good = buckets[bound]
+                else:
+                    break
+            return max(0.0, min(1.0, (total - good) / total))
+        bad = self._sum_rates(store, self.bad_metrics, window_s, now)
+        total = self._sum_rates(store, self.total_metrics, window_s,
+                                now)
+        if total is None:
+            return None
+        if total <= 0.0:
+            return 0.0
+        return max(0.0, min(1.0, (bad or 0.0) / total))
+
+    def burn_rate(self, store: TimeSeriesStore, window_s: float,
+                  now: float) -> Optional[float]:
+        ratio = self.error_ratio(store, window_s, now)
+        if ratio is None:
+            return None
+        return ratio / self.error_budget
+
+
+def default_slos(*, deadline_objective: float = 0.99,
+                 ttft_p95_s: Optional[float] = None,
+                 reconcile_p99_s: Optional[float] = None,
+                 windows: Optional[Tuple[BurnWindow, ...]] = None
+                 ) -> List[SLO]:
+    """The stock fleet SLO set: requests-meet-deadline (always), TTFT
+    p95 and operator reconcile p99 (when given thresholds). The
+    deadline SLO counts shed AND expired as violations — a request
+    turned away at admission missed its deadline as surely as one
+    that lapsed in queue."""
+    kw: Dict[str, Any] = {}
+    if windows is not None:
+        kw["windows"] = windows
+    slos = [SLO(
+        name="serving-deadline",
+        objective=deadline_objective,
+        description=f"{deadline_objective:.0%} of requests dispatch "
+                    f"within their deadline (not shed, not expired)",
+        bad_metrics=("kft_serving_shed_total",
+                     "kft_serving_expired_total"),
+        total_metrics=("kft_serving_batch_rows_total",
+                       "kft_serving_shed_total",
+                       "kft_serving_expired_total"),
+        **kw)]
+    if ttft_p95_s is not None:
+        slos.append(SLO(
+            name="serving-ttft-p95",
+            objective=0.95,
+            description=f"95% of streamed generates reach first "
+                        f"token within {ttft_p95_s * 1e3:.0f} ms",
+            histogram="kft_serving_ttft_seconds",
+            threshold_s=ttft_p95_s, **kw))
+    if reconcile_p99_s is not None:
+        slos.append(SLO(
+            name="operator-reconcile-p99",
+            objective=0.99,
+            description=f"99% of reconciles complete within "
+                        f"{reconcile_p99_s * 1e3:.0f} ms",
+            histogram="kft_operator_reconcile_seconds",
+            threshold_s=reconcile_p99_s, **kw))
+    return slos
+
+
+@dataclass
+class _AlertRecord:
+    """Mutable per-(SLO, window) state-machine cell."""
+
+    state: str = "inactive"
+    pending_since: Optional[float] = None
+    clear_since: Optional[float] = None
+    fired_at: Optional[float] = None
+    fire_count: int = 0
+
+
+class AlertManager:
+    """Evaluates every SLO's burn-rate windows against the store and
+    drives the per-(SLO, window) alert state machine; registered as a
+    collector ``on_cycle`` hook so evaluation rides each scrape.
+
+    ``for_s`` is the classic alerting ``for:`` clause (the condition
+    must hold this long before an alert fires); ``resolve_s`` is the
+    flap damper on the way down (the condition must stay clear this
+    long before a firing alert resolves). Publishing is best-effort:
+    a broken apiserver must never wedge the telemetry loop.
+    """
+
+    def __init__(self, store: TimeSeriesStore, slos: Sequence[SLO], *,
+                 api: Optional[Any] = None, namespace: str = "default",
+                 for_s: float = 30.0, resolve_s: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 history_size: int = 256):
+        self.store = store
+        self.slos = list(slos)
+        self.api = api
+        self.namespace = namespace
+        self.for_s = float(for_s)
+        self.resolve_s = float(resolve_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._records: Dict[Tuple[str, str], _AlertRecord] = {}
+        #: Transition history (bounded): the CI artifact + dashboard
+        #: timeline. Entries: {slo, window, severity, to, at (wall
+        #: ISO, stamped at the transition), at_monotonic}.
+        self.history: deque = deque(maxlen=int(history_size))
+        self.last_evaluation: List[Dict[str, Any]] = []
+        self._published_sig: Optional[Tuple] = None
+
+    # -- state machine ---------------------------------------------------
+
+    def _transition(self, slo: SLO, window: BurnWindow,
+                    record: _AlertRecord, to: str, now: float,
+                    burn: Dict[str, Any]) -> None:
+        record.state = to
+        _C_TRANSITIONS.labels(slo.name, to).inc()
+        self.history.append({"slo": slo.name, "window": window.name,
+                             "severity": window.severity, "to": to,
+                             "at": datetime.datetime.now(
+                                 datetime.timezone.utc).isoformat(),
+                             "at_monotonic": round(now, 3),
+                             "burn": burn})
+        if to == "firing":
+            record.fired_at = now
+            record.fire_count += 1
+            self._publish_event(slo, window, "AlertFiring", "Warning",
+                                record, burn)
+        elif to == "resolved":
+            self._publish_event(slo, window, "AlertResolved", "Normal",
+                                record, burn)
+
+    def _step(self, slo: SLO, window: BurnWindow, now: float,
+              long_burn: Optional[float], short_burn: Optional[float]
+              ) -> _AlertRecord:
+        key = (slo.name, window.name)
+        record = self._records.setdefault(key, _AlertRecord())
+        burn = {"long": None if long_burn is None
+                else round(long_burn, 3),
+                "short": None if short_burn is None
+                else round(short_burn, 3),
+                "factor": window.factor}
+        if long_burn is None or short_burn is None:
+            return record  # blind: hold whatever state we're in
+        condition = (long_burn > window.factor
+                     and short_burn > window.factor)
+        if record.state in ("inactive", "resolved"):
+            if condition:
+                record.pending_since = now
+                self._transition(slo, window, record, "pending", now,
+                                 burn)
+                if self.for_s <= 0.0:
+                    self._transition(slo, window, record, "firing",
+                                     now, burn)
+            elif record.state == "resolved":
+                record.state = "inactive"
+        elif record.state == "pending":
+            if not condition:
+                record.pending_since = None
+                self._transition(slo, window, record, "inactive", now,
+                                 burn)
+            elif now - (record.pending_since or now) >= self.for_s:
+                self._transition(slo, window, record, "firing", now,
+                                 burn)
+        elif record.state == "firing":
+            if condition:
+                record.clear_since = None  # flap: stays firing
+            else:
+                if record.clear_since is None:
+                    record.clear_since = now
+                if now - record.clear_since >= self.resolve_s:
+                    record.clear_since = None
+                    self._transition(slo, window, record, "resolved",
+                                     now, burn)
+        return record
+
+    def evaluate(self, now: Optional[float] = None
+                 ) -> List[Dict[str, Any]]:
+        """One evaluation pass over every SLO × window; returns (and
+        retains) the full status rows the dashboard renders."""
+        now = self._clock() if now is None else now
+        rows: List[Dict[str, Any]] = []
+        with self._lock:
+            for slo in self.slos:
+                row: Dict[str, Any] = {
+                    "slo": slo.name,
+                    "objective": slo.objective,
+                    "description": slo.description,
+                    "windows": [],
+                }
+                worst = "inactive"
+                for window in slo.windows:
+                    long_burn = slo.burn_rate(self.store, window.long_s,
+                                              now)
+                    short_burn = slo.burn_rate(self.store,
+                                               window.short_s, now)
+                    record = self._step(slo, window, now, long_burn,
+                                        short_burn)
+                    if (STATE_VALUES[record.state]
+                            > STATE_VALUES[worst]):
+                        worst = record.state
+                    row["windows"].append({
+                        "window": window.name,
+                        "severity": window.severity,
+                        "factor": window.factor,
+                        "long_s": window.long_s,
+                        "short_s": window.short_s,
+                        "long_burn": None if long_burn is None
+                        else round(long_burn, 3),
+                        "short_burn": None if short_burn is None
+                        else round(short_burn, 3),
+                        "state": record.state,
+                        "fire_count": record.fire_count,
+                    })
+                    _G_ALERT_STATE.labels(
+                        slo.name, window.severity).set(
+                        STATE_VALUES[record.state])
+                row["state"] = worst
+                rows.append(row)
+            self.last_evaluation = rows
+            # Publish only when the state-machine picture CHANGED: a
+            # quiet fleet must not write the apiserver every scrape
+            # cycle (burn rates jitter per cycle; states don't).
+            sig = tuple(
+                (key, record.state, record.fire_count)
+                for key, record in sorted(self._records.items()))
+            publish = sig != self._published_sig
+        if publish:
+            self._publish_configmap(rows)
+            with self._lock:
+                self._published_sig = sig
+        return rows
+
+    def firing(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [
+                {"slo": slo_name, "window": window_name}
+                for (slo_name, window_name), record
+                in self._records.items() if record.state == "firing"]
+
+    def state(self) -> Dict[str, Any]:
+        """Evaluator snapshot (dashboard + CI artifact): last
+        evaluation rows + transition history."""
+        with self._lock:
+            return {"slos": list(self.last_evaluation),
+                    "history": list(self.history),
+                    "for_s": self.for_s,
+                    "resolve_s": self.resolve_s}
+
+    # -- publishing ------------------------------------------------------
+
+    def _publish_event(self, slo: SLO, window: BurnWindow,
+                       reason: str, event_type: str,
+                       record: _AlertRecord,
+                       burn: Dict[str, Any]) -> None:
+        """One k8s Event per firing/resolved transition (the operator
+        lifecycle-event pattern; ``kubectl get events`` is the zero-
+        dashboard alert surface). Deterministic name per episode so
+        retried publishes dedupe via Conflict."""
+        if self.api is None:
+            return
+        wall = datetime.datetime.now(
+            datetime.timezone.utc).isoformat()
+        message = (f"SLO {slo.name} ({window.severity}/{window.name} "
+                   f"window): burn long={burn['long']} "
+                   f"short={burn['short']} vs factor "
+                   f"{window.factor} — {reason}")
+        event = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"kft-alert.{slo.name}.{window.name}"
+                        f".{record.fire_count}.{reason.lower()}",
+                "namespace": self.namespace,
+            },
+            "involvedObject": {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "name": ALERTS_CONFIGMAP,
+                "namespace": self.namespace,
+            },
+            "reason": reason,
+            "message": message,
+            "type": event_type,
+            "source": {"component": "kft-collector"},
+            "firstTimestamp": wall,
+            "lastTimestamp": wall,
+            "count": 1,
+        }
+        try:
+            self.api.create(event)
+        except Exception:  # noqa: BLE001 — alerting must not wedge
+            logger.warning("alert event publish failed",
+                           exc_info=True)
+
+    def _publish_configmap(self, rows: List[Dict[str, Any]]) -> None:
+        """Best-effort ``kft-alerts`` ConfigMap publish — only called
+        on a state change (evaluate gates it), so a steady fleet costs
+        the apiserver nothing. History ships the wall time stamped at
+        each transition, never per-cycle recomputed fields (monotonic
+        stamps mean nothing to other processes and a churning payload
+        would defeat the no-op-write suppression)."""
+        if self.api is None:
+            return
+        with self._lock:
+            history = []
+            for h in self.history:
+                h = dict(h)
+                h.pop("at_monotonic", None)
+                history.append(h)
+        payload = json.dumps({"slos": rows, "history": history[-50:]},
+                             sort_keys=True)
+        try:
+            from kubeflow_tpu.operator.fake import NotFound
+
+            try:
+                self.api.patch(
+                    "ConfigMap", self.namespace, ALERTS_CONFIGMAP,
+                    lambda o: o.setdefault("data", {}).update(
+                        {ALERTS_KEY: payload}))
+            except NotFound:
+                self.api.create({
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {"name": ALERTS_CONFIGMAP,
+                                 "namespace": self.namespace},
+                    "data": {ALERTS_KEY: payload},
+                })
+        except Exception:  # noqa: BLE001 — publishing must not wedge
+            logger.debug("alerts ConfigMap publish failed",
+                         exc_info=True)

@@ -58,11 +58,15 @@ __all__ = [
     "TRACER",
     "TraceContext",
     "Tracer",
+    "current_context",
+    "current_trace_id",
     "ensure_context",
+    "filter_spans",
     "from_grpc_metadata",
     "from_headers",
     "new_context",
     "parse_traceparent",
+    "use_context",
 ]
 
 REQUEST_ID_HEADER = "X-Request-Id"
@@ -173,6 +177,44 @@ def ensure_context(headers) -> TraceContext:
     return from_headers(headers) or new_context()
 
 
+# Per-thread active context — the exemplar hook: a Histogram deep in
+# a library can stamp "the current request's trace id" onto the bucket
+# it observes without the id being threaded through every call
+# signature. Explicit obs_ctx plumbing (manager, engine) stays the
+# primary path; this is the fallback for code that has no ctx param.
+_ACTIVE = threading.local()
+
+
+class _UseCtx:
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx: Optional[TraceContext]):
+        self._ctx = ctx
+
+    def __enter__(self):
+        self._prev = getattr(_ACTIVE, "ctx", None)
+        _ACTIVE.ctx = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _ACTIVE.ctx = self._prev
+        return False
+
+
+def use_context(ctx: Optional[TraceContext]) -> _UseCtx:
+    """Make ``ctx`` the thread's current context for the block."""
+    return _UseCtx(ctx)
+
+
+def current_context() -> Optional[TraceContext]:
+    return getattr(_ACTIVE, "ctx", None)
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = getattr(_ACTIVE, "ctx", None)
+    return ctx.trace_id if ctx is not None else None
+
+
 def from_grpc_metadata(metadata: Optional[Iterable]
                        ) -> Optional[TraceContext]:
     """Context from gRPC invocation metadata: an iterable of (key,
@@ -194,6 +236,13 @@ def from_grpc_metadata(metadata: Optional[Iterable]
     return from_headers(_MD())
 
 
+#: Span outcomes ALWAYS retained under tail sampling: errors and the
+#: deadline/overload family — exactly the spans an SLO alert sends an
+#: operator looking for.
+RETAIN_OUTCOMES = frozenset({"error", "expired", "deadline_exceeded",
+                             "shed"})
+
+
 class Tracer:
     """Bounded in-process span recorder.
 
@@ -202,6 +251,17 @@ class Tracer:
     allocation churn beyond the dict itself, oldest spans evicted.
     ``enabled=False`` makes record() a no-op (one attribute read);
     the obs-overhead bench flips exactly this switch.
+
+    **Tail sampling** (:meth:`set_tail_sampling`): at fleet load the
+    happy path produces thousands of identical spans per second and
+    the ring holds seconds of history — the one slow request an
+    exemplar points at is long evicted. With tail sampling on, spans
+    are kept by what they turned out to be (hence *tail*-based):
+    error/deadline/shed outcomes and the slowest decile per span name
+    always land in a separate retained buffer; happy-path spans are
+    kept with probability ``keep_prob``. ``/tracez`` stays bounded
+    (both buffers have maxlen) but the interesting traces survive
+    minutes, not milliseconds.
     """
 
     def __init__(self, capacity: int = 4096, component: str = ""):
@@ -212,13 +272,75 @@ class Tracer:
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=int(capacity))
         self._batch_ids = itertools.count(1)
+        # Tail-sampling state (None = off, the default: record() then
+        # costs exactly what it did before the feature existed).
+        self._tail_keep_prob: Optional[float] = None
+        self._retained: deque = deque(maxlen=int(capacity))
+        self._slow_quantile = 0.9
+        self._durations: Dict[str, deque] = {}
+        self._dur_seen: Dict[str, int] = {}
+        self._slow_thr: Dict[str, float] = {}
 
     def set_capacity(self, capacity: int) -> None:
         with self._lock:
             self._spans = deque(self._spans, maxlen=int(capacity))
 
+    def set_tail_sampling(self, keep_prob: Optional[float], *,
+                          retained_capacity: Optional[int] = None,
+                          slow_quantile: float = 0.9) -> None:
+        """Enable tail-based retention (``keep_prob`` = probability a
+        happy-path span is kept; errors and the slowest
+        ``1-slow_quantile`` fraction per span name are always kept in
+        a separate bounded buffer). ``None`` turns it off."""
+        if keep_prob is not None and not (0.0 <= keep_prob <= 1.0):
+            raise ValueError("keep_prob must be in [0, 1]")
+        if not (0.0 < slow_quantile < 1.0):
+            raise ValueError("slow_quantile must be in (0, 1)")
+        with self._lock:
+            self._tail_keep_prob = keep_prob
+            self._slow_quantile = slow_quantile
+            if retained_capacity is not None:
+                self._retained = deque(self._retained,
+                                       maxlen=int(retained_capacity))
+            if keep_prob is None:
+                self._durations.clear()
+                self._slow_thr.clear()
+
     def next_batch_id(self) -> str:
         return f"batch-{self._pid}-{next(self._batch_ids)}"
+
+    def _classify_locked(self, name: str, dur_s: float,
+                         args: Optional[Dict[str, Any]]) -> Optional[str]:
+        """Tail-sampling verdict: "error" / "slow" (→ retained
+        buffer), None (→ ring, subject to keep_prob). Caller holds
+        the lock. The slow threshold is the per-name duration decile
+        over a sliding window of recent spans, recomputed every 32
+        observations (sorting 128 floats amortized — not per span)."""
+        outcome = (args or {}).get("outcome")
+        if outcome in RETAIN_OUTCOMES:
+            return "error"
+        window = self._durations.get(name)
+        if window is None:
+            window = deque(maxlen=128)
+            self._durations[name] = window
+        window.append(dur_s)
+        # Recompute the decile every 32 observations (a lifetime
+        # counter, NOT len(window) — once the window is full its
+        # length pins at maxlen and a len-based trigger would sort on
+        # every record).
+        seen = self._dur_seen.get(name, 0) + 1
+        self._dur_seen[name] = seen
+        if seen >= 16 and seen % 32 == 0:
+            ranked = sorted(window)
+            self._slow_thr[name] = ranked[
+                min(len(ranked) - 1,
+                    int(self._slow_quantile * len(ranked)))]
+        thr = self._slow_thr.get(name)
+        # Strictly above the decile: a workload whose durations are
+        # all identical has no tail, and >= would retain every span.
+        if thr is not None and dur_s > thr:
+            return "slow"
+        return None
 
     def record(self, name: str, cat: str, start_s: float, dur_s: float,
                args: Optional[Dict[str, Any]] = None,
@@ -242,7 +364,18 @@ class Tracer:
         if args:
             event["args"] = args
         with self._lock:
-            self._spans.append(event)
+            if self._tail_keep_prob is None:
+                self._spans.append(event)
+                return
+            verdict = self._classify_locked(name, dur_s, args)
+            if verdict is not None:
+                args = dict(args or ())
+                args["retain"] = verdict
+                event["args"] = args
+                self._retained.append(event)
+            elif (self._tail_keep_prob >= 1.0
+                  or _rng.random() < self._tail_keep_prob):
+                self._spans.append(event)
 
     class _SpanCtx:
         __slots__ = ("_tracer", "_name", "_cat", "_args", "_t0")
@@ -272,22 +405,34 @@ class Tracer:
         return Tracer._SpanCtx(self, name, cat, args)
 
     def snapshot(self) -> List[Dict[str, Any]]:
+        """All live spans (ring + tail-retained), timestamp-ordered —
+        one merged timeline whichever buffer a span survived in."""
         with self._lock:
-            return list(self._spans)
+            if not self._retained:
+                return list(self._spans)
+            spans = list(self._spans) + list(self._retained)
+        spans.sort(key=lambda s: s.get("ts", 0.0))
+        return spans
 
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._retained.clear()
+            self._durations.clear()
+            self._dur_seen.clear()
+            self._slow_thr.clear()
 
-    def export_chrome(self) -> Dict[str, Any]:
+    def export_chrome(self, spans: Optional[List[Dict[str, Any]]] = None
+                      ) -> Dict[str, Any]:
         """The Perfetto-openable document: trace events plus a process
-        metadata record naming the component."""
+        metadata record naming the component. ``spans`` overrides the
+        live snapshot (the /tracez handlers pass a filtered list)."""
         events: List[Dict[str, Any]] = []
         if self.component:
             events.append({"name": "process_name", "ph": "M",
                            "pid": os.getpid(),
                            "args": {"name": self.component}})
-        events.extend(self.snapshot())
+        events.extend(self.snapshot() if spans is None else spans)
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def dump_jsonl(self, path: str) -> None:
@@ -296,6 +441,50 @@ class Tracer:
         with open(path, "w") as f:
             for span in self.snapshot():
                 f.write(json.dumps(span) + "\n")
+
+
+def filter_spans(spans: Iterable[Dict[str, Any]], *,
+                 trace_id: Optional[str] = None,
+                 status: Optional[str] = None,
+                 min_duration_ms: Optional[float] = None,
+                 limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """The ``/tracez`` query filters, shared by the tornado and stdlib
+    exposition handlers: a full 4096-span ring serialized per request
+    is megabytes of JSON nobody reads — these narrow it to the trace,
+    status or latency band the caller is hunting.
+
+    - ``trace_id`` — spans whose args carry this trace (or request) id
+      (the exemplar workflow: histogram bucket → exemplar trace id →
+      ``/tracez?trace_id=``).
+    - ``status`` — ``error`` matches every non-ok outcome (the
+      :data:`RETAIN_OUTCOMES` family); any other value matches that
+      outcome exactly.
+    - ``min_duration_ms`` — spans at least this long.
+    - ``limit`` — keep only the NEWEST n after the other filters.
+    """
+    out = []
+    for span in spans:
+        args = span.get("args") or {}
+        if trace_id is not None:
+            if trace_id not in (args.get("trace_id"),
+                                args.get("request_id")):
+                continue
+        if status is not None:
+            outcome = args.get("outcome")
+            if status == "error":
+                if outcome not in RETAIN_OUTCOMES:
+                    continue
+            elif outcome != status:
+                continue
+        if min_duration_ms is not None:
+            if span.get("dur", 0.0) < min_duration_ms * 1e3:
+                continue
+        out.append(span)
+    if limit is not None and len(out) > max(0, limit):
+        # limit=0 must mean "none": out[-0:] would slice the WHOLE
+        # list — the exact unbounded dump the filter exists to stop.
+        out = out[-limit:] if limit > 0 else []
+    return out
 
 
 #: The process-wide tracer every module records against.

@@ -696,6 +696,11 @@ def main(argv=None) -> int:
         "--metrics-port", type=int, default=9400,
         help="Prometheus /metrics (+ /tracez, /healthz) exposition "
              "port, served from a stdlib thread; 0 disables")
+    parser.add_argument(
+        "--trace-tail-keep", type=float, default=None,
+        help="enable tail-based span sampling: keep this fraction of "
+             "happy-path reconcile spans (error outcomes and the "
+             "slowest decile always retained)")
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -708,6 +713,10 @@ def main(argv=None) -> int:
     if mode == "auto":
         mode = ("watch" if os.environ.get("KUBERNETES_SERVICE_HOST")
                 else "poll")
+    if args.trace_tail_keep is not None:
+        from kubeflow_tpu.obs.tracing import TRACER
+
+        TRACER.set_tail_sampling(args.trace_tail_keep)
     if args.metrics_port:
         from kubeflow_tpu.obs.exposition import start_exposition_server
 

@@ -349,10 +349,16 @@ class AutoscalerLoop:
                  scrape_timeout_s: float = 2.0,
                  api: Optional[Any] = None,
                  namespace: str = "default",
-                 write_endpoints_path: Optional[str] = None):
+                 write_endpoints_path: Optional[str] = None,
+                 collector: Optional[Any] = None):
         self.autoscaler = autoscaler
         self.discover = discover
         self.interval_s = interval_s
+        #: When a fleet telemetry collector (obs/collector.py) is
+        #: already scraping these replicas' /metrics, the loop reads
+        #: ITS aggregated queue-wait/shed-rate store instead of
+        #: running a second healthz sweep — one fleet, one scraper.
+        self.collector = collector
         self._scrape = scrape or (
             lambda addr: scrape_healthz(addr, scrape_timeout_s))
         self.api = api
@@ -386,9 +392,14 @@ class AutoscalerLoop:
         if prev is not None:
             prev_shed, prev_expired, prev_at = prev
             dt = max(1e-3, now - prev_at)
-            # max(0, ...): a restarted replica resets its counters.
-            shed_rate = max(0.0, shed - prev_shed) / dt
-            expired_rate = max(0.0, expired - prev_expired) / dt
+            # counter_increase: a restarted replica resets its
+            # counters — the shared restart-clamp helper (the
+            # collector store's rate() rides the same one) never
+            # yields a negative delta.
+            shed_rate = obs_metrics.counter_increase(prev_shed,
+                                                     shed) / dt
+            expired_rate = obs_metrics.counter_increase(
+                prev_expired, expired) / dt
         self._counters[address] = (shed, expired, now)
         return {
             "address": address,
@@ -422,6 +433,8 @@ class AutoscalerLoop:
             except OSError:
                 logger.warning("could not write endpoints file %s",
                                self.write_endpoints_path, exc_info=True)
+        if self.collector is not None:
+            return self._tick_from_collector(specs)
         fleet: List[Dict[str, Any]] = []
         metrics: List[Dict[str, Any]] = []
         addresses = [address for address, _grpc in specs]
@@ -448,6 +461,22 @@ class AutoscalerLoop:
         for address in list(self._counters):
             if address not in live:  # departed replicas drop history
                 del self._counters[address]
+        decision = self.autoscaler.evaluate(
+            metrics, now=time.monotonic(),
+            unreachable=len(fleet) - len(metrics))
+        self.last_fleet = fleet
+        self.publish(fleet, decision)
+        return decision
+
+    def _tick_from_collector(self, specs) -> Dict[str, Any]:
+        """Decide from the collector's store: per-replica queue-wait
+        and restart-clamped shed/expired rates come pre-aggregated
+        from the fleet's /metrics scrapes (same row shape as the
+        healthz path — the decision core can't tell the difference)."""
+        from kubeflow_tpu.obs.collector import fleet_replica_rows
+
+        fleet = fleet_replica_rows(self.collector, specs)
+        metrics = [row for row in fleet if row.get("reachable")]
         decision = self.autoscaler.evaluate(
             metrics, now=time.monotonic(),
             unreachable=len(fleet) - len(metrics))

@@ -1103,8 +1103,15 @@ def main(argv=None) -> int:
     parser.add_argument("--probe_interval", type=float, default=1.0,
                         help="seconds between /healthz probes of each "
                              "replica; 0 disables the prober")
+    parser.add_argument("--trace_tail_keep", type=float, default=None,
+                        help="enable tail-based span sampling: keep "
+                             "this fraction of happy-path spans "
+                             "(error/deadline/failover spans and the "
+                             "slowest decile always retained)")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    if args.trace_tail_keep is not None:
+        TRACER.set_tail_sampling(args.trace_tail_keep)
     source = None
     if args.endpoints_file:
         if not args.probe_interval:

@@ -107,10 +107,11 @@ _M_EST_LATENCY = obs_metrics.Gauge(
 _M_QUEUE_WAIT = obs_metrics.Histogram(
     "kft_serving_queue_wait_seconds",
     "Time a dispatched request spent queued (enqueue to batcher pop)",
-    ("model",))
+    ("model",), exemplars=True)
 _M_DISPATCH = obs_metrics.Histogram(
     "kft_serving_dispatch_seconds",
-    "Wall time of one batched model execution group", ("model",))
+    "Wall time of one batched model execution group", ("model",),
+    exemplars=True)
 
 
 def _combine_streams(streams, future: Future) -> None:
@@ -829,7 +830,12 @@ class ServedModel:
             # queue-wait arithmetic in estimated_wait_s consistent).
             self._latency.observe((t_end - t0)
                                   / max(1, -(-rows // self.max_batch)))
-            self._m_dispatch.observe(t_end - t_exec)
+            # Exemplar: any one trace that rode this dispatch (the
+            # bucket links to a batch; the batch span links the rest).
+            self._m_dispatch.observe(
+                t_end - t_exec,
+                trace_id=next((g[6][0].trace_id for g in group
+                               if g[6][0] is not None), None))
             self._record_group_spans(group, t_pop, t_exec, t_end, rows)
             offset = 0
             for future, count in zip(futures, counts):
@@ -853,9 +859,13 @@ class ServedModel:
         """The per-request span trio (queue_wait → batch_assembly →
         execute) + the ONE coalesced batch_execute span they all link
         to via ``args.batch``. Queue-wait histogram samples ride along
-        (same timestamps, always on — histograms are cheap)."""
+        (same timestamps, always on — histograms are cheap), each
+        stamping its request's trace id as the bucket exemplar."""
         for g in group:
-            self._m_queue_wait.observe(max(0.0, t_pop - g[6][1]))
+            ctx = g[6][0]
+            self._m_queue_wait.observe(
+                max(0.0, t_pop - g[6][1]),
+                trace_id=ctx.trace_id if ctx is not None else None)
         if not TRACER.enabled:
             return
         batch = TRACER.next_batch_id()

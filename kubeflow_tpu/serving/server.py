@@ -598,6 +598,13 @@ def main(argv=None) -> int:
                              "ServableVersionPolicy role; rollback = "
                              "specific:<old>)")
     parser.add_argument("--poll_interval", type=float, default=5.0)
+    parser.add_argument("--trace_tail_keep", type=float, default=None,
+                        help="enable tail-based span sampling: keep "
+                             "this fraction of happy-path spans "
+                             "(errors/deadline outcomes and the "
+                             "slowest decile are always retained — "
+                             "the /tracez?trace_id= exemplar "
+                             "workflow; docs/observability.md)")
     args = parser.parse_args(argv)
     single = bool(args.model_name or args.model_base_path)
     if bool(args.model_config_file) == single:
@@ -619,6 +626,10 @@ def main(argv=None) -> int:
     from kubeflow_tpu.utils.platform import sync_platform_from_env
 
     sync_platform_from_env()
+    if args.trace_tail_keep is not None:
+        from kubeflow_tpu.obs.tracing import TRACER
+
+        TRACER.set_tail_sampling(args.trace_tail_keep)
     manager = ModelManager(poll_interval_s=args.poll_interval)
     # Defer the (slow) first model loads to the poll thread: the ports
     # open immediately and /healthz answers 503 until loaded, so

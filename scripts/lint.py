@@ -357,10 +357,15 @@ def check_serving_timeout_discipline() -> list:
 
     An unbounded call is exactly how one dead backend wedges every
     proxy worker; the deadline layer only works if every hop's wait
-    is finite."""
+    is finite. The telemetry collector (``obs/collector.py``) is held
+    to the same rule: its scrape loop fans out over the whole fleet
+    every cycle, and one timeout-less fetch against a dead replica
+    would stall fleet-wide alerting (ISSUE 9)."""
     errors = []
     serving_dir = REPO / "kubeflow_tpu" / "serving"
-    for f in sorted(serving_dir.glob("*.py")):
+    files = sorted(serving_dir.glob("*.py"))
+    files.append(REPO / "kubeflow_tpu" / "obs" / "collector.py")
+    for f in files:
         tree = ast.parse(f.read_text(), str(f))
         grpc_callables = set()
         for node in ast.walk(tree):

@@ -1,0 +1,408 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Fleet telemetry collector units: the windowed store (counter-reset
+rates, histogram quantiles, aggregation, the series-cardinality cap),
+the scrape cycle over injected fetches, and the shared restart-clamp
+helper at BOTH its call sites (store.rate and the autoscaler's shed
+differencing)."""
+
+import random
+
+import pytest
+
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs.collector import (
+    Collector,
+    ScrapeTarget,
+    TimeSeriesStore,
+    fleet_replica_rows,
+    quantile_from_buckets,
+)
+
+
+# -- counter_increase: one helper, both call sites ---------------------------
+
+
+def test_counter_increase_restart_clamp():
+    assert obs_metrics.counter_increase(5.0, 9.0) == 4.0
+    assert obs_metrics.counter_increase(5.0, 5.0) == 0.0
+    # Reset to zero: the increase is what the restarted process has
+    # counted since (here: nothing) — NEVER negative.
+    assert obs_metrics.counter_increase(9.0, 0.0) == 0.0
+    # Reset then climbed: the post-restart count IS the increase.
+    assert obs_metrics.counter_increase(9.0, 2.0) == 2.0
+
+
+def test_store_rate_clamps_over_counter_reset():
+    store = TimeSeriesStore()
+    # A replica counting 0,10,20 then RESTARTING (0) then 5.
+    for ts, value in [(0, 0), (10, 10), (20, 20), (30, 0), (40, 5)]:
+        store.ingest("c_total", {"instance": "a"}, value, ts,
+                     kind="counter")
+    rate = store.sum_rate("c_total", window_s=100, now=40)
+    # Increases: 10 + 10 + 0 (reset clamp) + 5 over 40s — positive,
+    # never the naive (5-0... 0-20)<0 collapse.
+    assert rate == pytest.approx(25.0 / 40.0)
+    assert rate > 0
+
+
+def test_autoscaler_replica_sample_uses_shared_clamp():
+    """The other call site: the autoscaler differencing a restarting
+    replica's cumulative shed counter through the same helper."""
+    from kubeflow_tpu.scaling.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        AutoscalerLoop,
+        Scaler,
+    )
+
+    class _S(Scaler):
+        def get_replicas(self):
+            return 1
+
+        def set_replicas(self, n):
+            pass
+
+    loop = AutoscalerLoop(
+        Autoscaler(AutoscalerConfig(), _S()),
+        discover=lambda: [])
+
+    def payload(shed):
+        return {"saturation": {"m": {"queue_depth": 0,
+                                     "est_batch_latency_ms": 1.0,
+                                     "shed": shed, "expired": 0}}}
+
+    loop._replica_sample("a", payload(9.0), now=0.0)
+    row = loop._replica_sample("a", payload(2.0), now=1.0)  # restart
+    assert row["shed_rate"] == pytest.approx(2.0)  # clamped: not <0
+
+
+# -- the store ---------------------------------------------------------------
+
+
+def test_store_latest_and_aggregations():
+    store = TimeSeriesStore()
+    for i, value in enumerate((3.0, 5.0, 4.0)):
+        store.ingest("g", {"instance": f"r{i}"}, 0.0, ts=0)
+        store.ingest("g", {"instance": f"r{i}"}, value, ts=1)
+    assert store.aggregate_latest("g", "sum") == 12.0
+    assert store.aggregate_latest("g", "avg") == pytest.approx(4.0)
+    assert store.aggregate_latest("g", "max") == 5.0
+    assert store.aggregate_latest("g", "min") == 3.0
+    assert store.aggregate_latest(
+        "g", "sum", label_filter={"instance": "r1"}) == 5.0
+    assert store.aggregate_latest("missing", "sum") is None
+    with pytest.raises(ValueError):
+        store.aggregate_latest("g", "median")
+
+
+def test_store_staleness_filter():
+    store = TimeSeriesStore()
+    store.ingest("g", {"instance": "old"}, 1.0, ts=0)
+    store.ingest("g", {"instance": "new"}, 2.0, ts=100)
+    live = store.latest("g", staleness_s=10, now=101)
+    assert [labels["instance"] for labels, _, _ in live] == ["new"]
+
+
+def test_store_rate_requires_two_in_window_samples():
+    store = TimeSeriesStore()
+    store.ingest("c_total", {}, 100.0, ts=0)
+    assert store.sum_rate("c_total", window_s=10, now=5) is None
+    store.ingest("c_total", {}, 110.0, ts=5)
+    assert store.sum_rate("c_total", window_s=10, now=5) \
+        == pytest.approx(2.0)
+    # Both samples aged out of the window → no data again.
+    assert store.sum_rate("c_total", window_s=10, now=100) is None
+
+
+def test_store_rate_sums_across_instances():
+    store = TimeSeriesStore()
+    for instance, per_s in (("a", 2.0), ("b", 3.0)):
+        for ts in range(0, 11):
+            store.ingest("c_total", {"instance": instance},
+                         per_s * ts, ts)
+    assert store.sum_rate("c_total", window_s=20, now=10) \
+        == pytest.approx(5.0)
+
+
+def test_histogram_quantile_interpolation():
+    # Cumulative bucket rates: 50/s ≤0.1, 90/s ≤1.0, 100/s total.
+    buckets = {0.1: 50.0, 1.0: 90.0, float("inf"): 100.0}
+    assert quantile_from_buckets(0.5, buckets) == pytest.approx(0.1)
+    # p90 sits exactly at the 1.0 bound.
+    assert quantile_from_buckets(0.9, buckets) == pytest.approx(1.0)
+    # p99 falls in +Inf → saturates at the highest finite bound.
+    assert quantile_from_buckets(0.99, buckets) == pytest.approx(1.0)
+    # p70: interpolated inside (0.1, 1.0].
+    est = quantile_from_buckets(0.7, buckets)
+    assert 0.1 < est < 1.0
+    assert quantile_from_buckets(0.5, {}) is None
+    assert quantile_from_buckets(0.5, {0.1: 0.0,
+                                       float("inf"): 0.0}) is None
+
+
+def test_store_histogram_quantile_from_scraped_buckets():
+    store = TimeSeriesStore()
+    reg = obs_metrics.Registry()
+    h = obs_metrics.Histogram("lat_seconds", "L",
+                              buckets=(0.01, 0.1, 1.0), registry=reg)
+    for ts in range(0, 5):
+        h.observe(0.05)
+        h.observe(0.5)
+        store.ingest_exposition(
+            obs_metrics.parse_exposition(reg.render()), ts,
+            {"instance": "a"})
+    p50 = store.histogram_quantile("lat_seconds", 0.5, window_s=10,
+                                   now=4)
+    assert p50 is not None and 0.01 < p50 <= 0.1
+    p99 = store.histogram_quantile("lat_seconds", 0.99, window_s=10,
+                                   now=4)
+    assert p99 is not None and p99 > 0.1
+
+
+def test_cardinality_cap_under_label_churn_fuzz():
+    """A replica churning label values (the classic cardinality
+    explosion) must saturate at the cap — series count bounded,
+    overflow counted, existing series still ingesting."""
+    store = TimeSeriesStore(max_series=50)
+    rng = random.Random(42)
+    store.ingest("stable", {"instance": "a"}, 1.0, ts=0)
+    for ts in range(400):
+        accepted = store.ingest(
+            "churn", {"victim": f"v{rng.randrange(10_000)}"},
+            1.0, ts)
+        assert store.series_count() <= 50
+        del accepted
+    assert store.series_count() == 50
+    assert store.dropped_series() > 300
+    # Established series keep accepting after the cap hit.
+    assert store.ingest("stable", {"instance": "a"}, 2.0, ts=500)
+    assert store.aggregate_latest("stable", "sum") == 2.0
+
+
+# -- the scrape cycle --------------------------------------------------------
+
+
+def _fleet_registry():
+    reg = obs_metrics.Registry()
+    shed = obs_metrics.Counter("kft_serving_shed_total", "s",
+                               ("model",), registry=reg)
+    shed.labels("m").inc(3)
+    return reg
+
+
+def test_collector_scrape_stamps_instance_and_job_labels():
+    regs = {"r0:8500": _fleet_registry(), "r1:8500": _fleet_registry()}
+    collector = Collector(
+        TimeSeriesStore(),
+        static_targets=[("r0:8500", "serving"), ("r1:8500", "serving")],
+        fetch=lambda t: regs[t.address].render())
+    summary = collector.scrape_once(now=1.0)
+    assert summary == {"targets": 2, "ok": 2, "failed": 0}
+    rows = collector.store.latest("kft_serving_shed_total")
+    assert sorted(labels["instance"] for labels, _, _ in rows) \
+        == ["r0:8500", "r1:8500"]
+    assert all(labels["job"] == "serving" for labels, _, _ in rows)
+    assert all(labels["model"] == "m" for labels, _, _ in rows)
+
+
+def test_collector_records_failures_and_parse_errors():
+    def fetch(target):
+        if target.address == "dead:1":
+            raise OSError("connection refused")
+        return "kft_bogus{ 1"  # malformed → strict parser rejects
+
+    collector = Collector(
+        TimeSeriesStore(),
+        static_targets=[("dead:1", "serving"), ("bad:2", "serving")],
+        fetch=fetch)
+    summary = collector.scrape_once(now=1.0)
+    assert summary["ok"] == 0 and summary["failed"] == 2
+    status = collector.target_status(now=1.0)
+    assert "OSError" in status["dead:1"]["error"]
+    assert status["bad:2"]["error"].startswith("parse:")
+    # Self-metrics counted the outcomes.
+    fams = obs_metrics.parse_exposition(obs_metrics.render())
+    outcomes = {labels["instance"]: v for _, labels, v
+                in fams["kft_collector_scrapes_total"]["samples"]
+                if labels["outcome"] == "error"}
+    assert outcomes.get("dead:1", 0) >= 1
+
+
+def test_collector_discovers_targets_from_source_and_statics():
+    class _Source:
+        def specs(self):
+            return [("pod-a:8500", None), ("pod-b:8500", "pod-b:9000")]
+
+    collector = Collector(
+        TimeSeriesStore(), source=_Source(),
+        static_targets=[ScrapeTarget("op:9400", "operator")],
+        fetch=lambda t: "")
+    targets = {t.address: t.job for t in collector.targets()}
+    assert targets == {"op:9400": "operator", "pod-a:8500": "serving",
+                       "pod-b:8500": "serving"}
+
+
+def test_collector_drops_status_of_departed_targets():
+    members = [("a:1", "serving"), ("b:2", "serving")]
+
+    class _Source:
+        def specs(self):
+            return [(a, None) for a, _ in members]
+
+    collector = Collector(TimeSeriesStore(), source=_Source(),
+                          fetch=lambda t: "")
+    collector.scrape_once(now=1.0)
+    assert set(collector.target_status(now=1.0)) == {"a:1", "b:2"}
+    members.pop()  # b leaves the fleet
+    collector.scrape_once(now=2.0)
+    assert set(collector.target_status(now=2.0)) == {"a:1"}
+
+
+def test_collector_ingests_exemplars_from_openmetrics():
+    reg = obs_metrics.Registry()
+    h = obs_metrics.Histogram("wait_seconds", "w", buckets=(0.1, 1.0),
+                              registry=reg, exemplars=True)
+    h.observe(5.0, trace_id="feedface")
+    collector = Collector(
+        TimeSeriesStore(), static_targets=["r0:8500"],
+        fetch=lambda t: reg.render(openmetrics=True))
+    collector.scrape_once(now=1.0)
+    (exemplar,) = collector.store.exemplars("wait_seconds")
+    assert exemplar["trace_id"] == "feedface"
+    assert exemplar["labels"]["instance"] == "r0:8500"
+    assert exemplar["labels"]["le"] == "+Inf"
+
+
+def test_fleet_replica_rows_shape_for_autoscaler():
+    reg = obs_metrics.Registry()
+    qd = obs_metrics.Gauge("kft_serving_queue_depth", "d", ("model",),
+                           registry=reg)
+    lat = obs_metrics.Gauge("kft_serving_est_batch_latency_seconds",
+                            "l", ("model",), registry=reg)
+    shed = obs_metrics.Counter("kft_serving_shed_total", "s",
+                               ("model",), registry=reg)
+    qd.labels("m").set(10)
+    lat.labels("m").set(0.02)
+    shed.labels("m").inc(0)  # materialize the series pre-scrape
+    collector = Collector(TimeSeriesStore(),
+                          static_targets=["a:8500"],
+                          interval_s=1.0,
+                          fetch=lambda t: reg.render())
+    collector.scrape_once(now=0.0)
+    shed.labels("m").inc(4)
+    collector.scrape_once(now=2.0)
+    rows = fleet_replica_rows(collector,
+                              [("a:8500", None), ("gone:1", None)],
+                              now=2.0)
+    by_addr = {r["address"]: r for r in rows}
+    row = by_addr["a:8500"]
+    assert row["reachable"]
+    assert row["queue_wait_ms"] == pytest.approx(200.0)  # 10×20ms
+    assert row["shed_rate"] == pytest.approx(2.0)        # 4 over 2s
+    assert row["resident_models"] == ["m"]
+    assert by_addr["gone:1"] == {"address": "gone:1",
+                                 "reachable": False}
+
+
+def test_autoscaler_loop_reads_collector_instead_of_scraping():
+    """AutoscalerLoop(collector=...) decides from the collector's
+    store — no healthz scrape of its own — and still sees saturation
+    (scale_up) and blind spots (unreachable → scale-down hold)."""
+    from kubeflow_tpu.scaling.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        AutoscalerLoop,
+        Scaler,
+    )
+
+    class _S(Scaler):
+        def __init__(self):
+            self.replicas = 2
+
+        def get_replicas(self):
+            return self.replicas
+
+        def set_replicas(self, n):
+            self.replicas = n
+
+    reg = obs_metrics.Registry()
+    qd = obs_metrics.Gauge("kft_serving_queue_depth", "d", ("model",),
+                           registry=reg)
+    lat = obs_metrics.Gauge("kft_serving_est_batch_latency_seconds",
+                            "l", ("model",), registry=reg)
+    qd.labels("m").set(30)
+    lat.labels("m").set(0.02)  # 600 ms est wait ≫ the 100 ms target
+
+    def fetch(target):
+        if target.address == "dead:8500":
+            raise OSError("down")
+        return reg.render()
+
+    collector = Collector(TimeSeriesStore(),
+                          static_targets=["a:8500", "b:8500"],
+                          interval_s=1.0, fetch=fetch)
+    collector.scrape_once()  # real monotonic ts: the loop's clock
+    scaler = _S()
+    members = [("a:8500", None), ("b:8500", None)]
+    loop = AutoscalerLoop(
+        Autoscaler(AutoscalerConfig(max_replicas=4), scaler),
+        discover=lambda: list(members), collector=collector)
+    scraped = []
+    loop._scrape = lambda addr: scraped.append(addr)  # must stay idle
+    decision = loop.tick()
+    assert decision["action"] == "scale_up"
+    assert scaler.replicas > 2
+    assert scraped == []  # the loop never ran its own sweep
+    # A discovered-but-unscrapeable replica shows up as unreachable
+    # (the HPA missing-metrics rule keeps scale-down held).
+    members.append(("dead:8500", None))
+    collector.static_targets.append(ScrapeTarget("dead:8500"))
+    collector.scrape_once()
+    decision = loop.tick()
+    assert decision["replicas_unreachable"] == 1
+
+
+def test_collector_on_cycle_hook_failure_does_not_break_loop():
+    calls = []
+
+    def bad_hook(now):
+        calls.append(now)
+        raise RuntimeError("boom")
+
+    collector = Collector(TimeSeriesStore(), static_targets=["a:1"],
+                          fetch=lambda t: "")
+    collector.on_cycle.append(bad_hook)
+    collector.scrape_once(now=1.0)
+    collector.scrape_once(now=2.0)
+    assert calls == [1.0, 2.0]
+
+
+def test_exemplars_bounded_by_cardinality_cap():
+    """Exemplars only attach to series the cap ADMITTED — a churning
+    exemplar-enabled histogram can't grow the exemplar map past it."""
+    store = TimeSeriesStore(max_series=20)
+    reg = obs_metrics.Registry()
+    h = obs_metrics.Histogram("churn_seconds", "c", ("victim",),
+                              buckets=(1.0,), registry=reg,
+                              exemplars=True)
+    for i in range(50):
+        h.labels(f"v{i}").observe(0.5, trace_id=f"t{i}")
+        store.ingest_exposition(
+            obs_metrics.parse_exposition(reg.render(openmetrics=True)),
+            float(i), {"instance": "a"})
+    assert store.series_count() <= 20
+    assert len(store.exemplars()) <= 20
+    assert store.dropped_series() > 0

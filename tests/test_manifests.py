@@ -225,6 +225,47 @@ def test_serving_router_and_replicas():
     assert len(proto.build(base)) == 2
 
 
+def test_serving_collector_sidecar():
+    """`collector true` adds the fleet telemetry collector to the
+    router pod: scrapes the shared endpoints file's replicas, runs
+    SLO alerting (so the Role additionally grants Events), and stays
+    OFF the default router build."""
+    proto = get_prototype("tpu-serving")
+    base = {"name": "llama", "model_path": "gs://b/m",
+            "router": "true"}
+
+    objects = proto.build({**base, "collector": "true",
+                           "collector_interval_s": "3"})
+    router_dep = objects[2]
+    tpl = router_dep["spec"]["template"]["spec"]
+    names = [c["name"] for c in tpl["containers"]]
+    assert names == ["llama-router", "llama-autoscaler",
+                     "llama-collector"]
+    collector = tpl["containers"][2]
+    args = " ".join(collector["args"])
+    # The collector reads the SAME endpoints file the autoscaler
+    # maintains — one fleet membership, three consumers.
+    assert "--endpoints_file=/fleet/endpoints.json" in args
+    assert "--interval=3" in args
+    assert "--alerts" in args
+    assert any(m["mountPath"] == "/fleet"
+               for m in collector["volumeMounts"])
+    role = next(o for o in objects if o.get("kind") == "Role")
+    granted = {(g, r): rule["verbs"]
+               for rule in role["rules"]
+               for g in rule["apiGroups"]
+               for r in rule["resources"]}
+    assert "create" in granted[("", "events")]
+    # Without the collector: two sidecars, no events grant.
+    objects = proto.build(base)
+    tpl = objects[2]["spec"]["template"]["spec"]
+    assert [c["name"] for c in tpl["containers"]] \
+        == ["llama-router", "llama-autoscaler"]
+    role = next(o for o in objects if o.get("kind") == "Role")
+    assert not any("events" in rule["resources"]
+                   for rule in role["rules"])
+
+
 def test_envoy_config_valid_and_routed():
     from kubeflow_tpu.manifests.iap import envoy_config
 

@@ -344,3 +344,133 @@ def test_tracer_dump_jsonl(tmp_path):
     rows = [json.loads(ln) for ln in path.read_text().splitlines()]
     assert rows[0]["name"] == "a"
     assert rows[0]["args"]["request_id"] == "r1"
+
+
+# -- exemplars + OpenMetrics (r13) -------------------------------------------
+
+
+def test_histogram_exemplars_render_only_in_openmetrics(registry):
+    h = obs.Histogram("kft_t_ex_seconds", "E", buckets=(0.1, 1.0),
+                      registry=registry, exemplars=True)
+    h.observe(0.05, trace_id="abc")
+    h.observe(0.5)            # no trace: bucket has no exemplar
+    h.observe(7.0, trace_id="tail")
+    classic = registry.render()
+    assert " # {" not in classic
+    obs.parse_exposition(classic)
+    om = registry.render(openmetrics=True)
+    assert om.rstrip().endswith("# EOF")
+    fams = obs.parse_exposition(om)
+    exemplars = {labels["le"]: ex_labels["trace_id"]
+                 for _, labels, ex_labels, _, _
+                 in fams["kft_t_ex_seconds"]["exemplars"]}
+    assert exemplars == {"0.1": "abc", "+Inf": "tail"}
+    # Bucket counts parse identically with the exemplar clause on.
+    samples = {labels.get("le"): v for name, labels, v
+               in fams["kft_t_ex_seconds"]["samples"]
+               if name.endswith("_bucket")}
+    assert samples == {"0.1": 1.0, "1": 2.0, "+Inf": 3.0}
+
+
+def test_exemplars_latest_wins_and_reset(registry):
+    h = obs.Histogram("kft_t_ex2_seconds", "E", buckets=(1.0,),
+                      registry=registry, exemplars=True)
+    h.observe(0.5, trace_id="first")
+    h.observe(0.6, trace_id="second")
+    om = registry.render(openmetrics=True)
+    fams = obs.parse_exposition(om)
+    (_, _, ex_labels, value, _), = fams["kft_t_ex2_seconds"]["exemplars"]
+    assert ex_labels["trace_id"] == "second" and value == 0.6
+    registry.reset()
+    assert not obs.parse_exposition(
+        registry.render(openmetrics=True))["kft_t_ex2_seconds"]["exemplars"]
+
+
+def test_content_type_negotiation():
+    assert obs.negotiate_content_type(None) is obs.CONTENT_TYPE
+    assert obs.negotiate_content_type("text/plain") is obs.CONTENT_TYPE
+    assert obs.negotiate_content_type(
+        "application/openmetrics-text; version=1.0.0, text/plain"
+    ) is obs.CONTENT_TYPE_OPENMETRICS
+
+
+def test_counter_increase_helper():
+    assert obs.counter_increase(3.0, 10.0) == 7.0
+    assert obs.counter_increase(10.0, 4.0) == 4.0   # reset, re-climbed
+    assert obs.counter_increase(10.0, 0.0) == 0.0   # reset, fresh
+
+
+# -- tail sampling -----------------------------------------------------------
+
+
+def test_tail_sampling_retains_errors_drops_happy_path():
+    tr = tracing.Tracer(capacity=32)
+    tr.set_tail_sampling(0.0, retained_capacity=16)
+    for i in range(200):
+        tr.record("req", "c", float(i), 0.01,
+                  {"outcome": "ok"})
+    tr.record("req", "c", 300.0, 0.01, {"outcome": "expired"})
+    tr.record("req", "c", 301.0, 0.01, {"outcome": "error"})
+    spans = tr.snapshot()
+    assert [s["args"]["outcome"] for s in spans] == ["expired", "error"]
+    assert all(s["args"]["retain"] == "error" for s in spans)
+
+
+def test_tail_sampling_keeps_slowest_decile():
+    tr = tracing.Tracer(capacity=32)
+    tr.set_tail_sampling(0.0, retained_capacity=16)
+    for i in range(64):
+        tr.record("req", "c", float(i), 0.010 + (i % 10) * 1e-5)
+    tr.record("req", "c", 100.0, 5.0)  # way past the decile
+    slow = [s for s in tr.snapshot()
+            if s.get("args", {}).get("retain") == "slow"]
+    assert any(s["dur"] == 5.0 * 1e6 for s in slow)
+
+
+def test_tail_sampling_off_by_default_and_reversible():
+    tr = tracing.Tracer(capacity=8)
+    for i in range(4):
+        tr.record("req", "c", float(i), 0.01, {"outcome": "error"})
+    assert len(tr.snapshot()) == 4  # plain ring, no classification
+    tr.set_tail_sampling(1.0)
+    tr.record("req", "c", 10.0, 0.01)
+    tr.set_tail_sampling(None)
+    tr.record("req", "c", 11.0, 0.01)
+    assert len(tr.snapshot()) == 6
+    with pytest.raises(ValueError):
+        tr.set_tail_sampling(2.0)
+
+
+def test_filter_spans():
+    spans = [
+        {"ts": 1.0, "dur": 10_000.0,
+         "args": {"trace_id": "t1", "outcome": "ok"}},
+        {"ts": 2.0, "dur": 900_000.0,
+         "args": {"trace_id": "t2", "outcome": "expired"}},
+        {"ts": 3.0, "dur": 50.0, "args": {"request_id": "r3"}},
+    ]
+    assert len(tracing.filter_spans(spans, trace_id="t2")) == 1
+    # request_id matches too (the access-log join key).
+    assert len(tracing.filter_spans(spans, trace_id="r3")) == 1
+    assert [s["args"]["outcome"]
+            for s in tracing.filter_spans(spans, status="error")] \
+        == ["expired"]
+    assert len(tracing.filter_spans(spans, status="ok")) == 1
+    assert len(tracing.filter_spans(spans, min_duration_ms=500.0)) == 1
+    assert [s["ts"] for s in tracing.filter_spans(spans, limit=2)] \
+        == [2.0, 3.0]
+    # limit=0 means NONE (out[-0:] would be the whole list — the
+    # unbounded dump the filter exists to prevent).
+    assert tracing.filter_spans(spans, limit=0) == []
+
+
+def test_thread_local_context():
+    assert tracing.current_context() is None
+    ctx = tracing.new_context()
+    with tracing.use_context(ctx):
+        assert tracing.current_trace_id() == ctx.trace_id
+        inner = tracing.new_context()
+        with tracing.use_context(inner):
+            assert tracing.current_context() is inner
+        assert tracing.current_context() is ctx
+    assert tracing.current_trace_id() is None

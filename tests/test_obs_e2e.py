@@ -122,6 +122,28 @@ class ServerMetricsSurface(tornado.testing.AsyncHTTPTestCase):
         doc = json.loads(resp.body)
         assert "traceEvents" in doc
 
+    def test_queue_wait_exemplar_carries_request_trace(self):
+        """The r13 exemplar wiring: a request's trace id lands on the
+        queue-wait bucket its wait fell in, visible to an OpenMetrics
+        scrape (and only to one — classic scrapes stay 0.0.4)."""
+        trace_id = "c0ffee" * 5 + "42"  # 32 hex chars
+        resp = self.fetch(
+            "/v1/models/stub:predict", method="POST",
+            body=json.dumps({"instances": [[1.0]]}),
+            headers={"traceparent":
+                     f"00-{trace_id}-00f067aa0ba902b7-01"})
+        assert resp.code == 200, resp.body
+        resp = self.fetch("/metrics", headers={
+            "Accept": "application/openmetrics-text; version=1.0.0"})
+        assert resp.headers["Content-Type"].startswith(
+            "application/openmetrics-text")
+        fams = obs_metrics.parse_exposition(resp.body.decode())
+        exemplar_ids = [
+            ex_labels["trace_id"] for _, labels, ex_labels, _, _
+            in fams["kft_serving_queue_wait_seconds"]["exemplars"]
+            if labels.get("model") == "stub"]
+        assert trace_id in exemplar_ids
+
     def test_healthz_schema(self):
         body = json.loads(self.fetch("/healthz").body)
         assert body["status"] == "ok"

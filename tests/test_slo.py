@@ -1,0 +1,341 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""SLO burn-rate alerting: error-ratio math (ratio + latency forms),
+the multi-window condition, and the alert state machine (for-duration,
+flap damping, resolve hold, Event/ConfigMap/gauge publishing)."""
+
+import pytest
+
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs.collector import TimeSeriesStore
+from kubeflow_tpu.obs.slo import (
+    ALERTS_CONFIGMAP,
+    ALERTS_KEY,
+    FAST_PAGE,
+    SLO,
+    SLOW_TICKET,
+    AlertManager,
+    BurnWindow,
+    default_slos,
+)
+from kubeflow_tpu.operator.fake import FakeApiServer
+
+
+def _ratio_slo(windows=None, objective=0.99):
+    kw = {"windows": windows} if windows else {}
+    return SLO(name="deadline", objective=objective,
+               bad_metrics=("bad_total",),
+               total_metrics=("good_total", "bad_total"), **kw)
+
+
+def _feed(store, ts, good, bad):
+    store.ingest("good_total", {"instance": "a"}, good, ts,
+                 kind="counter")
+    store.ingest("bad_total", {"instance": "a"}, bad, ts,
+                 kind="counter")
+
+
+# -- SLO definition + ratio math ---------------------------------------------
+
+
+def test_slo_validation():
+    with pytest.raises(ValueError):
+        SLO(name="x", objective=1.5, bad_metrics=("b",),
+            total_metrics=("t",))
+    with pytest.raises(ValueError):
+        SLO(name="x", objective=0.99)  # neither form
+    with pytest.raises(ValueError):
+        SLO(name="x", objective=0.99, bad_metrics=("b",),
+            total_metrics=("t",), histogram="h",
+            threshold_s=0.1)  # both forms
+    with pytest.raises(ValueError):
+        SLO(name="x", objective=0.99, histogram="h")  # no threshold
+
+
+def test_ratio_error_and_burn():
+    store = TimeSeriesStore()
+    # 100 good + 2 bad per second: error ratio ~2/102.
+    for ts in range(0, 11):
+        _feed(store, ts, good=100.0 * ts, bad=2.0 * ts)
+    slo = _ratio_slo()
+    ratio = slo.error_ratio(store, window_s=20, now=10)
+    assert ratio == pytest.approx(2.0 / 102.0)
+    # burn = ratio / budget (budget 1%).
+    assert slo.burn_rate(store, 20, 10) == pytest.approx(ratio / 0.01)
+
+
+def test_no_data_is_none_not_zero():
+    store = TimeSeriesStore()
+    slo = _ratio_slo()
+    assert slo.error_ratio(store, 20, 10) is None
+    assert slo.burn_rate(store, 20, 10) is None
+    # Total present but flat-zero traffic → 0 errors, not None.
+    for ts in range(3):
+        _feed(store, ts, good=0.0, bad=0.0)
+    assert slo.error_ratio(store, 20, 2) == 0.0
+
+
+def test_latency_form_fraction_over_threshold():
+    store = TimeSeriesStore()
+    reg = obs_metrics.Registry()
+    h = obs_metrics.Histogram("ttft_seconds", "t",
+                              buckets=(0.05, 0.2, 1.0), registry=reg)
+    for ts in range(0, 6):
+        # 9 fast + 1 slow per tick → 10% above the 0.2 s threshold.
+        for _ in range(9):
+            h.observe(0.01)
+        h.observe(0.5)
+        store.ingest_exposition(
+            obs_metrics.parse_exposition(reg.render()), ts,
+            {"instance": "a"})
+    slo = SLO(name="ttft", objective=0.95, histogram="ttft_seconds",
+              threshold_s=0.2)
+    assert slo.error_ratio(store, window_s=10, now=5) \
+        == pytest.approx(0.1)
+    # p95 > 0.2s: 10% violations vs a 5% budget → burn 2.
+    assert slo.burn_rate(store, 10, 5) == pytest.approx(2.0)
+
+
+def test_default_slos_shapes():
+    slos = default_slos(ttft_p95_s=0.5, reconcile_p99_s=1.0)
+    names = [s.name for s in slos]
+    assert names == ["serving-deadline", "serving-ttft-p95",
+                     "operator-reconcile-p99"]
+    assert slos[0].windows == (FAST_PAGE, SLOW_TICKET)
+    assert FAST_PAGE.long_s > FAST_PAGE.short_s
+    assert SLOW_TICKET.long_s > SLOW_TICKET.short_s
+    # default: only the deadline SLO.
+    assert [s.name for s in default_slos()] == ["serving-deadline"]
+
+
+# -- the state machine -------------------------------------------------------
+
+
+_WIN = BurnWindow("fast", long_s=60.0, short_s=10.0, factor=10.0,
+                  severity="page")
+
+
+def _manager(store, api=None, for_s=2.0, resolve_s=5.0):
+    return AlertManager(store, [_ratio_slo(windows=(_WIN,))],
+                        api=api, for_s=for_s, resolve_s=resolve_s,
+                        clock=lambda: 0.0)
+
+
+def _run_phases(store, manager, *, t0, steps, bad_per_s,
+                good_per_s=100.0, start_good=None, start_bad=None):
+    """Feed counters + evaluate once per second; returns last rows."""
+    g = start_good if start_good is not None else t0 * good_per_s
+    b = start_bad if start_bad is not None else 0.0
+    rows = []
+    for step in range(steps):
+        ts = t0 + step
+        g += good_per_s
+        b += bad_per_s
+        _feed(store, ts, g, b)
+        rows = manager.evaluate(now=ts)
+    return rows, g, b
+
+
+def test_alert_lifecycle_pending_firing_resolved():
+    store = TimeSeriesStore()
+    fake = FakeApiServer()
+    manager = _manager(store, api=fake)
+    # Healthy minute: inactive.
+    rows, g, b = _run_phases(store, manager, t0=0, steps=30,
+                             bad_per_s=0.0)
+    assert rows[0]["state"] == "inactive"
+    # Burst: 50% errors ≫ 10× the 1% budget. First over-threshold
+    # evaluation → pending; after for_s → firing.
+    rows, g, b = _run_phases(store, manager, t0=30, steps=10,
+                             bad_per_s=100.0, start_good=g,
+                             start_bad=b)
+    assert rows[0]["state"] == "firing"
+    transitions = [h["to"] for h in manager.history]
+    assert transitions[:2] == ["pending", "firing"]
+    # Firing published: Event + ConfigMap + gauge.
+    events = fake.list("Event", "default")
+    assert any(e["reason"] == "AlertFiring" and e["type"] == "Warning"
+               for e in events)
+    cm = fake.get("ConfigMap", "default", ALERTS_CONFIGMAP)
+    assert ALERTS_KEY in cm["data"]
+    fams = obs_metrics.parse_exposition(obs_metrics.render())
+    states = {(labels["slo"], labels["severity"]): v for _, labels, v
+              in fams["kft_alert_state"]["samples"]}
+    assert states[("deadline", "page")] == 2.0
+    # Recovery: errors stop; short window clears, then the long one;
+    # after resolve_s of clear → resolved (Event Normal), then
+    # inactive.
+    rows, g, b = _run_phases(store, manager, t0=40, steps=80,
+                             bad_per_s=0.0, start_good=g, start_bad=b)
+    transitions = [h["to"] for h in manager.history]
+    assert transitions == ["pending", "firing", "resolved"]
+    assert rows[0]["state"] == "inactive"
+    events = fake.list("Event", "default")
+    assert any(e["reason"] == "AlertResolved"
+               and e["type"] == "Normal" for e in events)
+    fams = obs_metrics.parse_exposition(obs_metrics.render())
+    states = {(labels["slo"], labels["severity"]): v for _, labels, v
+              in fams["kft_alert_state"]["samples"]}
+    assert states[("deadline", "page")] == 0.0
+
+
+def test_pending_blip_never_fires():
+    """A burst shorter than for_s drops back to inactive without an
+    Event — the for-duration is the first flap damper."""
+    store = TimeSeriesStore()
+    fake = FakeApiServer()
+    # The short window retains a one-tick blip for its 10 s span;
+    # for_s beyond that means only a SUSTAINED burn can fire.
+    manager = _manager(store, api=fake, for_s=15.0)
+    _run_phases(store, manager, t0=0, steps=30, bad_per_s=0.0)
+    g, b = 30 * 100.0, 0.0
+    _feed(store, 30, g + 100, b + 5000)
+    manager.evaluate(now=30)
+    rows, _, _ = _run_phases(store, manager, t0=31, steps=30,
+                             bad_per_s=0.0, start_good=g + 100,
+                             start_bad=b + 5000)
+    transitions = [h["to"] for h in manager.history]
+    assert "firing" not in transitions
+    assert rows[0]["state"] == "inactive"
+    assert not any(e["reason"] == "AlertFiring"
+                   for e in fake.list("Event", "default"))
+
+
+def test_firing_holds_through_flapping_condition():
+    """Condition oscillating around the threshold must not resolve
+    per dip: the resolve hold (resolve_s) keeps the alert firing
+    until the burn stays clear."""
+    store = TimeSeriesStore()
+    manager = _manager(store, for_s=0.0, resolve_s=20.0)
+    _run_phases(store, manager, t0=0, steps=5, bad_per_s=0.0)
+    rows, g, b = _run_phases(store, manager, t0=5, steps=10,
+                             bad_per_s=100.0, start_good=5 * 100.0,
+                             start_bad=0.0)
+    assert rows[0]["state"] == "firing"
+    # Alternate 3 quiet / 3 hot seconds: dips shorter than resolve_s.
+    for chunk in range(4):
+        bad = 0.0 if chunk % 2 == 0 else 100.0
+        rows, g, b = _run_phases(store, manager, t0=15 + chunk * 3,
+                                 steps=3, bad_per_s=bad,
+                                 start_good=g, start_bad=b)
+        assert rows[0]["windows"][0]["state"] == "firing", chunk
+    assert [h["to"] for h in manager.history].count("resolved") == 0
+
+
+def test_blind_store_holds_state():
+    """No data (all series aged out / scrapes down) holds the current
+    state: alerting on blindness — either direction — is wrong."""
+    store = TimeSeriesStore()
+    manager = _manager(store, for_s=0.0)
+    rows, g, b = _run_phases(store, manager, t0=0, steps=10,
+                             bad_per_s=100.0)
+    assert rows[0]["state"] == "firing"
+    # Far future: every sample outside both windows → burns are None.
+    rows = manager.evaluate(now=10_000)
+    assert rows[0]["windows"][0]["long_burn"] is None
+    assert rows[0]["state"] == "firing"  # held, not resolved
+
+
+def test_multi_window_requires_both():
+    """Long window hot from an old burst but short window clear must
+    NOT alert (the SRE rule: the short window proves the problem is
+    still happening)."""
+    store = TimeSeriesStore()
+    manager = _manager(store, for_s=0.0)
+    # 30s burst, then quiet; at t=45 the 60s-long window still sees
+    # the burst, the 10s-short window does not.
+    rows, g, b = _run_phases(store, manager, t0=0, steps=30,
+                             bad_per_s=100.0)
+    rows, _, _ = _run_phases(store, manager, t0=30, steps=15,
+                             bad_per_s=0.0, start_good=g, start_bad=b)
+    w = rows[0]["windows"][0]
+    assert w["long_burn"] > _WIN.factor
+    assert w["short_burn"] < _WIN.factor
+
+
+def test_publish_survives_broken_api():
+    class _Boom:
+        def create(self, *a, **k):
+            raise RuntimeError("apiserver down")
+
+        def patch(self, *a, **k):
+            raise RuntimeError("apiserver down")
+
+    store = TimeSeriesStore()
+    manager = _manager(store, api=_Boom(), for_s=0.0)
+    rows, _, _ = _run_phases(store, manager, t0=0, steps=10,
+                             bad_per_s=100.0)
+    assert rows[0]["state"] == "firing"  # evaluation kept going
+
+
+def test_state_snapshot_for_artifacts():
+    store = TimeSeriesStore()
+    manager = _manager(store, for_s=0.0)
+    _run_phases(store, manager, t0=0, steps=10, bad_per_s=100.0)
+    snap = manager.state()
+    assert snap["slos"][0]["slo"] == "deadline"
+    assert [h["to"] for h in snap["history"]] == ["pending", "firing"]
+    assert {"for_s", "resolve_s"} <= set(snap)
+
+
+def test_configmap_published_only_on_state_change():
+    """A quiet fleet must not write the apiserver every evaluation:
+    the kft-alerts ConfigMap is published on state-machine changes
+    only (and its history carries transition-stamped wall times, no
+    per-cycle-recomputed fields)."""
+
+    class _CountingApi:
+        def __init__(self):
+            self.fake = FakeApiServer()
+            self.writes = 0
+
+        def create(self, obj):
+            self.writes += 1
+            return self.fake.create(obj)
+
+        def patch(self, *a, **k):
+            self.writes += 1
+            return self.fake.patch(*a, **k)
+
+        def get(self, *a, **k):
+            return self.fake.get(*a, **k)
+
+        def list(self, *a, **k):
+            return self.fake.list(*a, **k)
+
+    api = _CountingApi()
+    store = TimeSeriesStore()
+    manager = _manager(store, api=api, for_s=0.0)
+    # The very first evaluation creates the ConfigMap (the sidecar
+    # surface must exist even with zero alerts)...
+    _run_phases(store, manager, t0=0, steps=1, bad_per_s=0.0)
+    baseline_writes = api.writes
+    # ...then a quiet fleet writes NOTHING per cycle.
+    _run_phases(store, manager, t0=1, steps=29, bad_per_s=0.0,
+                start_good=100.0)
+    assert api.writes == baseline_writes
+    _run_phases(store, manager, t0=30, steps=5, bad_per_s=100.0,
+                start_good=3000.0, start_bad=0.0)
+    fired_writes = api.writes  # pending + firing: CM + Event writes
+    assert fired_writes > 0
+    # Steady firing: no further writes per cycle.
+    _run_phases(store, manager, t0=35, steps=20, bad_per_s=100.0,
+                start_good=3500.0, start_bad=500.0)
+    assert api.writes == fired_writes
+    import json as _json
+
+    cm = api.fake.get("ConfigMap", "default", ALERTS_CONFIGMAP)
+    doc = _json.loads(cm["data"][ALERTS_KEY])
+    assert all("at" in h and "age_s" not in h for h in doc["history"])
