@@ -179,6 +179,7 @@ def router_main() -> int:
     line shaped like the headline bench."""
     from kubeflow_tpu.scaling.benchmark import (
         RouterBenchConfig,
+        run_role_split_benchmark,
         run_router_benchmark,
     )
 
@@ -186,6 +187,11 @@ def router_main() -> int:
     rows = {r["replicas"]: r for r in result["rows"]}
     failover = result.get("failover", {})
     scaling = result.get("throughput_scaling", 0.0)
+    # Mixed prompt/decode load over a specialized fleet (ISSUE 10):
+    # role-split routing must beat role-blind on goodput at the SAME
+    # offered load. Sleep-based service rates, so the ratio survives
+    # this box's CPU throttling like the scaling phase does.
+    role = run_role_split_benchmark()
     print(json.dumps({
         "metric": "router_throughput_scaling",
         "value": scaling,
@@ -204,9 +210,15 @@ def router_main() -> int:
                          "speedup_vs_1")
                if k in row},
             **{f"failover_{k}": v for k, v in failover.items()},
+            "role_split_goodput_rps":
+                role["phases"]["role_split"]["goodput_rps"],
+            "role_blind_goodput_rps":
+                role["phases"]["role_blind"]["goodput_rps"],
+            "role_goodput_ratio": role["goodput_ratio"],
+            "role_offered_rps": role["config"]["offered_rps"],
         },
     }))
-    return 0 if scaling >= 2.5 else 1
+    return 0 if scaling >= 2.5 and role["role_split_wins"] else 1
 
 
 def obs_overhead_main() -> int:

@@ -863,27 +863,64 @@ def _fleet_section_html_unsafe(fleet) -> str:
                 if reachable else "-")
         shed = (f"{r.get('shed_rate', 0.0):.2f}/s"
                 if reachable else "-")
+        # Role + shard topology (ISSUE 10): values come from healthz
+        # payloads and the endpoints file — malformed ones degrade to
+        # the role-less/single-shard rendering, never a 500. The role
+        # vocabulary (and its degrade rule) is single-sourced from
+        # the endpoint registry so the dashboard can never disagree
+        # with the router about which roles exist.
+        from kubeflow_tpu.scaling.endpoints import normalize_role
+
+        role = normalize_role(r.get("role"))
+        try:
+            shards = max(1, int(r.get("shards", 1)))
+        except (TypeError, ValueError):
+            shards = 1
+        occupancy = r.get("slot_occupancy")
+        try:
+            role_cell = (f"{role} ({float(occupancy) * 100:.0f}% "
+                         f"slots)" if occupancy is not None
+                         and role == "decode" else role)
+        except (TypeError, ValueError):
+            role_cell = role
         rows.append(
             "<tr>"
             f"<td><code>{html.escape(str(r.get('address', '')))}"
             f"</code></td>"
             f"<td class=\"phase\" style=\"color:{color}\">"
             f"{'reachable' if reachable else 'unreachable'}</td>"
+            f"<td>{html.escape(role_cell)}</td>"
+            f"<td>{shards if shards > 1 else '-'}</td>"
             f"<td>{wait}</td><td>{shed}</td>"
             f"<td>{html.escape(models)}</td>"
             "</tr>")
-    d = fleet.get("decision", {}) or {}
-    decision = (
-        f"<p>Last autoscaler decision: <strong>"
-        f"{html.escape(str(d.get('action', '-')))}</strong> "
-        f"({html.escape(str(d.get('reason', '')))}) — "
-        f"{int(d.get('current', 0))} → {int(d.get('desired', 0))} "
-        f"replicas, mean queue wait "
-        f"{float(d.get('mean_queue_wait_ms', 0.0)):.0f} ms vs target "
-        f"{float(d.get('target_queue_wait_ms', 0.0)):.0f} ms, "
-        f"{float(d.get('age_s', 0.0)):.0f}s ago.</p>")
+
+    def render_decision(d, label=""):
+        prefix = (f"Last autoscaler decision ({html.escape(label)})"
+                  if label else "Last autoscaler decision")
+        signal = str(d.get("signal", "queue_wait"))
+        return (
+            f"<p>{prefix}: <strong>"
+            f"{html.escape(str(d.get('action', '-')))}</strong> "
+            f"({html.escape(str(d.get('reason', '')))}) — "
+            f"{int(d.get('current', 0))} → {int(d.get('desired', 0))} "
+            f"replicas, signal {html.escape(signal)}, mean queue wait "
+            f"{float(d.get('mean_queue_wait_ms', 0.0)):.0f} ms vs "
+            f"target "
+            f"{float(d.get('target_queue_wait_ms', 0.0)):.0f} ms, "
+            f"{float(d.get('age_s', 0.0)):.0f}s ago.</p>")
+
+    decisions = fleet.get("decisions")
+    if isinstance(decisions, dict) and decisions:
+        # Role-split fleets: one decision per pool.
+        decision = "".join(
+            render_decision(d, label=role)
+            for role, d in sorted(decisions.items()))
+    else:
+        decision = render_decision(fleet.get("decision", {}) or {})
     return (
-        "<table>\n<tr><th>Replica</th><th>Health</th>"
+        "<table>\n<tr><th>Replica</th><th>Health</th><th>Role</th>"
+        "<th>Shards</th>"
         "<th>Queue wait</th><th>Shed</th><th>Models</th></tr>\n"
         + "\n".join(rows) + "\n</table>\n" + decision
         + "<p>JSON: <a href=\"/tpujobs/api/fleet\">"

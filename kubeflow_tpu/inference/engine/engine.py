@@ -252,6 +252,26 @@ class GenerateStream:
 
 
 @dataclasses.dataclass
+class PrefillHandoff:
+    """The page-adopt seam's transferable half: everything a decode
+    engine needs to resume a request whose prefill ran ELSEWHERE —
+    on a prefill-role replica (role-split routing, serving/wire.py
+    carries it between processes) or simply on another engine in
+    this process. ``step_keys`` travels whole because
+    ``jax.random.split(key, n)`` depends on n: re-deriving on the
+    decode side with a different budget would silently fork the
+    sampled sequence away from the single-replica path."""
+
+    cache: Any  # B=1 prefill cache pytree ([1, C, h, d] KV leaves)
+    first_token: int
+    done: bool
+    prompt_len: int  # true prompt token count
+    prompt_width: int  # prefill bucket width (pad + prompt)
+    max_new_tokens: int
+    step_keys: np.ndarray  # [max_new_tokens, 2] uint32
+
+
+@dataclasses.dataclass
 class _Request:
     prompt: np.ndarray  # [L] int32
     step_keys: np.ndarray  # [max_new_tokens, 2] uint32 sampling keys
@@ -260,6 +280,10 @@ class _Request:
     stream: GenerateStream
     submitted_at: float
     request_id: str = ""
+    #: Adopt-don't-prefill: the request arrives WITH its prefilled
+    #: cache (role-split KV handoff); admission copies the pages in
+    #: and decode starts at the first slice.
+    handoff: Optional[PrefillHandoff] = None
 
 
 @dataclasses.dataclass
@@ -361,7 +385,7 @@ class DecodeEngine:
     """
 
     def __init__(self, model: Any, params: Any, config: EngineConfig,
-                 *, name: str = "engine"):
+                 *, name: str = "engine", mesh: Any = None):
         if model.cache_size < config.max_prompt_len + \
                 config.max_new_tokens:
             raise ValueError(
@@ -372,6 +396,10 @@ class DecodeEngine:
         self._params = params
         self.config = config
         self.name = name
+        #: tp/fsdp serving mesh (serving/sharding.py) the params live
+        #: on; the page pool shards its kv_heads dim along the same
+        #: tensor axis. None = classic single-device serving.
+        self.mesh = mesh
         template = init_cache(model, params, 1)
         # Reused for every admission's B=1 prefill: init_cache runs a
         # full abstract model trace (~150ms even for a toy model —
@@ -382,7 +410,7 @@ class DecodeEngine:
         self.kv = PagedKVCache(
             template, num_slots=config.num_slots,
             page_size=config.page_size, cache_size=model.cache_size,
-            num_pages=config.num_pages)
+            num_pages=config.num_pages, mesh=mesh)
         self.scheduler = SlotScheduler(config.num_slots,
                                        self.kv.allocator)
         self._cv = threading.Condition()
@@ -432,22 +460,17 @@ class DecodeEngine:
         return (queued + 1) * prefill + slice_s * (
             1.0 + queued / max(1, self.config.num_slots))
 
-    def submit(self, prompt: np.ndarray, *,
-               rng: Optional[np.ndarray] = None,
-               max_new_tokens: Optional[int] = None,
-               deadline: Optional[float] = None,
-               obs_ctx: Any = None,
-               request_id: str = "") -> GenerateStream:
-        """Queue one request; tokens stream on the returned handle.
-
-        ``max_new_tokens`` may be LESS than the engine's configured
-        budget (a short request retires early and frees its slot —
-        the per-request knob the fixed-shape coalescer could never
-        offer); ``rng`` is the request's sampling key ([2] — the same
-        key reproduces the same tokens at B=1 through generate()).
-        Raises :class:`OverloadedError` /
-        :class:`DeadlineExceededError` synchronously when admission
-        control sheds the request."""
+    def run_prefill(self, prompt: np.ndarray, *,
+                    rng: Optional[np.ndarray] = None,
+                    max_new_tokens: Optional[int] = None
+                    ) -> PrefillHandoff:
+        """Run the B=1 prefill WITHOUT binding a slot: the prefill-
+        role half of KV handoff. Purely functional over engine state
+        (no slot, no reservation, no estimator writes), so any
+        request thread may call it concurrently with the decode loop;
+        the returned handoff feeds ``submit(handoff=...)`` on this or
+        ANY engine serving the same export — the adopt path makes the
+        resumed decode bitwise equal to a local one."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if not 1 <= prompt.shape[0] <= self.config.max_prompt_len:
             raise ValueError(
@@ -459,18 +482,99 @@ class DecodeEngine:
             raise ValueError(
                 f"max_new_tokens {budget} outside "
                 f"[1, {self.config.max_new_tokens}]")
+        key = self._next_key() if rng is None else np.asarray(rng)
+        step_keys = np.asarray(jax.random.split(
+            jnp.asarray(key, jnp.uint32), budget))
+        width = self._bucket(prompt.shape[0])
+        pad = width - prompt.shape[0]
+        padded = np.zeros((1, width), np.int32)
+        padded[0, pad:] = prompt
+        carry, _ = _prefill_jit(
+            self._model, self._params, jnp.asarray(padded),
+            jnp.asarray(step_keys[0:1]), self._prefill_template,
+            jnp.asarray([pad], jnp.int32),
+            temperature=self.config.temperature,
+            eos_id=self.config.eos_id, top_k=self.config.top_k,
+            top_p=self.config.top_p)
+        prefill_cache, first, _, done = carry
+        return PrefillHandoff(
+            cache=jax.tree.map(np.asarray, prefill_cache),
+            first_token=int(np.asarray(first)[0]),
+            done=bool(np.asarray(done)[0]),
+            prompt_len=int(prompt.shape[0]), prompt_width=width,
+            max_new_tokens=budget, step_keys=step_keys)
+
+    def submit(self, prompt: Optional[np.ndarray] = None, *,
+               rng: Optional[np.ndarray] = None,
+               max_new_tokens: Optional[int] = None,
+               deadline: Optional[float] = None,
+               obs_ctx: Any = None,
+               request_id: str = "",
+               handoff: Optional[PrefillHandoff] = None
+               ) -> GenerateStream:
+        """Queue one request; tokens stream on the returned handle.
+
+        ``max_new_tokens`` may be LESS than the engine's configured
+        budget (a short request retires early and frees its slot —
+        the per-request knob the fixed-shape coalescer could never
+        offer); ``rng`` is the request's sampling key ([2] — the same
+        key reproduces the same tokens at B=1 through generate()).
+        With ``handoff`` (KV handoff, role-split routing) the prompt's
+        prefill already ran elsewhere: admission adopts the carried
+        cache pages instead of prefilling, and ``prompt``/``rng``/
+        ``max_new_tokens`` are taken FROM the handoff (a divergent
+        caller budget would fork the rng schedule — rejected).
+
+        Raises :class:`OverloadedError` /
+        :class:`DeadlineExceededError` synchronously when admission
+        control sheds the request."""
+        if handoff is not None:
+            if (max_new_tokens is not None
+                    and int(max_new_tokens) != handoff.max_new_tokens):
+                raise ValueError(
+                    f"max_new_tokens {max_new_tokens} != handoff's "
+                    f"{handoff.max_new_tokens} — the step-key "
+                    f"schedule was derived at prefill time")
+            max_bucket = self._bucket(self.config.max_prompt_len)
+            if not 1 <= handoff.prompt_width <= max_bucket:
+                raise ValueError(
+                    f"handoff prompt_width {handoff.prompt_width} "
+                    f"outside [1, {max_bucket}]")
+            if not 1 <= handoff.prompt_len <= handoff.prompt_width:
+                raise ValueError(
+                    f"handoff prompt_len {handoff.prompt_len} outside "
+                    f"[1, width {handoff.prompt_width}]")
+            budget = int(handoff.max_new_tokens)
+            if len(np.asarray(handoff.step_keys)) != budget:
+                raise ValueError(
+                    f"handoff carries {len(handoff.step_keys)} step "
+                    f"keys for a {budget}-token budget")
+            prompt = np.zeros((handoff.prompt_len,), np.int32)
+        else:
+            prompt = np.asarray(prompt, np.int32).reshape(-1)
+            if not 1 <= prompt.shape[0] <= self.config.max_prompt_len:
+                raise ValueError(
+                    f"prompt length {prompt.shape[0]} outside "
+                    f"[1, {self.config.max_prompt_len}]")
+            budget = (self.config.max_new_tokens
+                      if max_new_tokens is None else int(max_new_tokens))
+        if not 1 <= budget <= self.config.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens {budget} outside "
+                f"[1, {self.config.max_new_tokens}]")
         if self._closed:
             raise RuntimeError("engine is stopped")
         # A worst-case reservation that can NEVER fit the pool would
         # sit at the FIFO head forever (admission holds the line for
         # the head) — fail it at submit, not by hanging the queue.
-        need = self.kv.pages_for(
-            self._bucket(prompt.shape[0]) + budget)
+        width = (handoff.prompt_width if handoff is not None
+                 else self._bucket(prompt.shape[0]))
+        need = self.kv.pages_for(width + budget)
         usable = self.kv.allocator.num_pages - 1
         if need > usable:
             raise ValueError(
                 f"request needs {need} pages worst-case "
-                f"(prompt bucket {self._bucket(prompt.shape[0])} + "
+                f"(prompt bucket {width} + "
                 f"{budget} new tokens at page_size "
                 f"{self.kv.page_size}) but the pool has only "
                 f"{usable} — raise engine_num_pages or lower the "
@@ -488,6 +592,13 @@ class DecodeEngine:
                 raise DeadlineExceededError(
                     "deadline expired before submit")
             est = self.estimated_ttft_s()
+            if handoff is not None:
+                # A page-adopt admission skips ITS OWN prefill (the
+                # expensive term); pricing it anyway would shed
+                # adoptable requests and force the proxy to redo the
+                # whole prefill on the classic path — strictly worse
+                # than admitting.
+                est = max(0.0, est - self._prefill_est.estimate_s())
             if est > remaining * ADMISSION_SAFETY:
                 self._m_shed.inc()
                 raise OverloadedError(
@@ -495,14 +606,17 @@ class DecodeEngine:
                     f"token {est * 1e3:.0f}ms exceeds remaining "
                     f"budget {remaining * 1e3:.0f}ms",
                     retry_after_s=est)
-        key = self._next_key() if rng is None else np.asarray(rng)
-        step_keys = np.asarray(jax.random.split(
-            jnp.asarray(key, jnp.uint32), budget))
+        if handoff is not None:
+            step_keys = np.asarray(handoff.step_keys)
+        else:
+            key = self._next_key() if rng is None else np.asarray(rng)
+            step_keys = np.asarray(jax.random.split(
+                jnp.asarray(key, jnp.uint32), budget))
         stream = GenerateStream(budget, obs_ctx=obs_ctx)
         req = _Request(prompt=prompt, step_keys=step_keys,
                        max_new_tokens=budget, deadline=deadline,
                        stream=stream, submitted_at=now,
-                       request_id=request_id)
+                       request_id=request_id, handoff=handoff)
         with self._cv:
             if self._closed:
                 raise RuntimeError("engine is stopped")
@@ -601,7 +715,8 @@ class DecodeEngine:
                              self.config.prompt_buckets)
 
     def _budget_pages(self, req: _Request) -> int:
-        width = self._bucket(len(req.prompt))
+        width = (req.handoff.prompt_width if req.handoff is not None
+                 else self._bucket(len(req.prompt)))
         return self.kv.pages_for(width + req.max_new_tokens)
 
     def _expire(self) -> None:
@@ -643,22 +758,34 @@ class DecodeEngine:
     def _prefill_and_bind(self, req: _Request) -> None:
         t0 = time.monotonic()
         length = len(req.prompt)
-        width = self._bucket(length)
-        pad = width - length
+        if req.handoff is not None:
+            # KV handoff: the prefill ran on another replica — adopt
+            # its cache pages instead of recomputing them. The carried
+            # cache/step-keys make the resumed decode bitwise equal to
+            # a local run (tests/test_role_routing.py pins it).
+            width = req.handoff.prompt_width
+            pad = width - req.handoff.prompt_len
+            prefill_cache = req.handoff.cache
+            first = int(req.handoff.first_token)
+            done = bool(req.handoff.done)
+        else:
+            width = self._bucket(length)
+            pad = width - length
         prompt = np.zeros((1, width), np.int32)
         prompt[0, pad:] = req.prompt
         cache = self._prefill_template
         try:
-            carry, _ = _prefill_jit(
-                self._model, self._params, jnp.asarray(prompt),
-                jnp.asarray(req.step_keys[0:1]), cache,
-                jnp.asarray([pad], jnp.int32),
-                temperature=self.config.temperature,
-                eos_id=self.config.eos_id, top_k=self.config.top_k,
-                top_p=self.config.top_p)
-            prefill_cache, first, _, done = carry
-            first = int(np.asarray(first)[0])
-            done = bool(np.asarray(done)[0])
+            if req.handoff is None:
+                carry, _ = _prefill_jit(
+                    self._model, self._params, jnp.asarray(prompt),
+                    jnp.asarray(req.step_keys[0:1]), cache,
+                    jnp.asarray([pad], jnp.int32),
+                    temperature=self.config.temperature,
+                    eos_id=self.config.eos_id, top_k=self.config.top_k,
+                    top_p=self.config.top_p)
+                prefill_cache, first, _, done = carry
+                first = int(np.asarray(first)[0])
+                done = bool(np.asarray(done)[0])
         except Exception as e:  # noqa: BLE001 — XLA OOM / compile
             # The request was popped WITH a reservation
             # (next_admittable); letting this propagate to _loop's
@@ -678,7 +805,15 @@ class DecodeEngine:
         slot.allocated_pages = self.kv.adopt(
             slot.index, prefill_cache, width, budget_pages)
         t1 = time.monotonic()
-        self._prefill_est.observe(t1 - t0)
+        if req.handoff is None:
+            # Only REAL prefills feed the estimator: adopt times are
+            # sub-millisecond, and letting them in would collapse the
+            # TTFT estimate on decode-role replicas — admission would
+            # stop shedding direct requests that can't meet their
+            # deadlines, and the autoscaler's engine queue pricing
+            # (queue_depth × est_ttft_ms) would read a saturated
+            # queue as nearly free.
+            self._prefill_est.observe(t1 - t0)
         self._m_admitted.inc()
         ctx = req.stream.obs_ctx
         self._m_ttft.observe(t1 - req.submitted_at,

@@ -196,12 +196,13 @@ class PagedKVCache:
 
     def __init__(self, cache_template: Any, *, num_slots: int,
                  page_size: int, cache_size: int,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None, mesh: Any = None):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         self.page_size = page_size
         self.cache_size = cache_size
         self.num_slots = num_slots
+        self.mesh = mesh
         self.pages_per_slot = -(-cache_size // page_size)
         self.logical_len = self.pages_per_slot * page_size
         if num_pages is None:
@@ -210,12 +211,27 @@ class PagedKVCache:
             # lever (admission then gates on reservations).
             num_pages = num_slots * self.pages_per_slot + 1
         self.allocator = PageAllocator(num_pages)
+        kv_sharding = None
+        if mesh is not None and mesh.shape.get("tensor", 1) > 1:
+            # Serving-mesh placement (serving/sharding.py): the pool
+            # shards along kv_heads on the SAME tensor axis the params
+            # ride, so per-chip KV memory shrinks with the model and
+            # the decode step's attention reads stay chip-local (no
+            # cross-chip KV gather). Head counts not divisible by the
+            # axis replicate — correctness first, memory second.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            kv_sharding = NamedSharding(mesh, P(None, None, "tensor"))
 
         def to_pages(leaf):
             if not _is_kv(leaf):
                 return jnp.zeros(leaf.shape, leaf.dtype)
             _, _, h, d = leaf.shape
-            return jnp.zeros((num_pages, page_size, h, d), leaf.dtype)
+            pool = jnp.zeros((num_pages, page_size, h, d), leaf.dtype)
+            if kv_sharding is not None and \
+                    h % mesh.shape["tensor"] == 0:
+                pool = jax.device_put(pool, kv_sharding)
+            return pool
 
         self.physical = jax.tree.map(to_pages, cache_template)
         self.tables = np.zeros((num_slots, self.pages_per_slot),

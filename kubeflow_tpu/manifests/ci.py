@@ -135,6 +135,17 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             "--junit_path",
             f"{params['artifacts_dir']}/junit_leader_failover.xml",
         ],
+        # Serving-mesh dryrun (ISSUE 10): the MULTICHIP-style gate
+        # for the sharded export/load path — a CPU child pinned to a
+        # virtual 2-device platform proves placement + bitwise
+        # serving equality (and fails on XLA SPMD quality warnings)
+        # before any TPU is involved. Hermetic — no cluster.
+        "serving-mesh-dryrun": [
+            py, f"{src}/scripts/dryrun_serving_mesh.py",
+            "--devices", "2",
+            "--junit_path",
+            f"{params['artifacts_dir']}/junit_serving_mesh.xml",
+        ],
         "deploy-test": [
             py, "-m", "kubeflow_tpu.citests.deploy", "setup",
             "--namespace", params["test_namespace"],
@@ -187,6 +198,7 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             _dag_task("unit-test", ["checkout"]),
             _dag_task("sanitizer-test", ["checkout"]),
             _dag_task("leader-failover-test", ["checkout"]),
+            _dag_task("serving-mesh-dryrun", ["checkout"]),
             _dag_task("deploy-test", ["checkout"]),
             _dag_task("deploy-serving", ["deploy-test"]),
             _dag_task("tpujob-test", ["deploy-test"]),

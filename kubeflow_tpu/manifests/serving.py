@@ -48,16 +48,24 @@ def server_container(p: Dict[str, Any]) -> Dict[str, Any]:
     """Model-server container (parity ``tf-serving.libsonnet:102-128``:
     ``tensorflow_model_server --port=9000 --model_name=...
     --model_base_path=...``)."""
+    args = [
+        "--port=9000",        # native gRPC PredictionService
+        "--rest_port=8500",   # REST + gRPC-Web
+        f"--model_name={p['model_name']}",
+        f"--model_base_path={p['model_path']}",
+        f"--version_policy={p['version_policy']}",
+    ]
+    if p.get("role") and p["role"] != "any":
+        # Prefill/decode pool splitting (docs/scaling.md): the role
+        # rides /healthz so the router and autoscaler see it even
+        # before the endpoints file carries it.
+        args.append(f"--role={p['role']}")
+    if p.get("continuous_batching"):
+        args.append("--continuous_batching")
     container = k8s.container(
         p["name"], p["model_server_image"],
         command=["python", "-m", "kubeflow_tpu.serving.server"],
-        args=[
-            "--port=9000",        # native gRPC PredictionService
-            "--rest_port=8500",   # REST + gRPC-Web
-            f"--model_name={p['model_name']}",
-            f"--model_base_path={p['model_path']}",
-            f"--version_policy={p['version_policy']}",
-        ],
+        args=args,
         ports=[k8s.port(9000, "grpc"), k8s.port(8500, "rest")],
         # Model load + first XLA compile takes tens of seconds to
         # minutes. The server opens its ports immediately and /healthz
@@ -118,10 +126,13 @@ def deployment(p: Dict[str, Any]) -> Dict[str, Any]:
     # (the documented HPA-vs-manifest conflict — omit replicas so the
     # field stays with whoever scaled it last; the apiserver defaults
     # a brand-new Deployment to 1).
+    labels = {"app": p["name"]}
+    if p.get("role") and p["role"] != "any":
+        labels["kft-role"] = p["role"]
     return k8s.deployment(p["name"], p["namespace"], spec,
                           replicas=(None if p["router"]
                                     else int(p["replicas"])),
-                          labels={"app": p["name"]})
+                          labels=labels)
 
 
 def router_proxy_container(p: Dict[str, Any]) -> Dict[str, Any]:
@@ -155,12 +166,19 @@ def autoscaler_container(p: Dict[str, Any]) -> Dict[str, Any]:
     subresource, publishes the fleet ConfigMap for the dashboard, and
     rewrites the router's endpoints file (atomic rename; the proxy
     hot-reloads it)."""
+    if p.get("role_deployments"):
+        # Role-split fleet: one Deployment per role pool, each scaled
+        # on its own signal; membership merges into ONE role-carrying
+        # endpoints file (scaling/autoscaler.py RoleSplitAutoscalerLoop).
+        target = [f"--role_deployments={p['role_deployments']}"]
+    else:
+        target = [f"--deployment={p['name']}",
+                  f"--selector=app={p['name']}"]
     return k8s.container(
         f"{p['name']}-autoscaler", p["http_proxy_image"],
         command=["python", "-m", "kubeflow_tpu.scaling.autoscaler"],
-        args=[f"--deployment={p['name']}",
-              f"--namespace={p['namespace']}",
-              f"--selector=app={p['name']}",
+        args=target +
+             [f"--namespace={p['namespace']}",
               f"--min_replicas={p['min_replicas']}",
               f"--max_replicas={p['max_replicas']}",
               f"--target_queue_wait_ms={p['target_queue_wait_ms']}",
@@ -383,7 +401,22 @@ SERVING_PARAMS = [
     Param("collector_interval_s", 5, "int",
           "Collector scrape interval (seconds)."),
     Param("balancer", "least_saturation", "string",
-          "Router policy: round_robin | least_saturation | affinity."),
+          "Router policy: round_robin | least_saturation | affinity "
+          "| role (prefill/decode pool splitting)."),
+    Param("role", "any", "string",
+          "Replica role for prefill/decode pool splitting: prefill | "
+          "decode | any. Apply the prototype once per pool (e.g. "
+          "name llm-prefill role prefill, name llm-decode role "
+          "decode) and point role_deployments at both."),
+    Param("continuous_batching", "false", "bool",
+          "Serve generate models through the slot-based decode "
+          "engine (required for KV handoff / role-split serving)."),
+    Param("role_deployments", "", "string",
+          "Role-split autoscaling: 'prefill=<dep>,decode=<dep>' — "
+          "the router's autoscaler then scales each pool on its own "
+          "signal and merges membership into one role-carrying "
+          "endpoints file. Empty = single-pool autoscaling of this "
+          "Deployment."),
     Param("min_replicas", 1, "int"),
     Param("max_replicas", 5, "int"),
     Param("target_queue_wait_ms", 100, "int",

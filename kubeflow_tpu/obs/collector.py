@@ -514,8 +514,10 @@ class Collector:
         for t in self.static_targets:
             out.setdefault(t.address, t)
         if self.source is not None:
-            for address, _grpc in self.source.specs():
-                out.setdefault(address, ScrapeTarget(address, "serving"))
+            for spec in self.source.specs():
+                # 2- or 3-tuple (role-carrying schema v2) — the
+                # collector scrapes every role alike.
+                out.setdefault(spec[0], ScrapeTarget(spec[0], "serving"))
         if self.pool is not None:
             for ep in self.pool.endpoints():
                 out.setdefault(ep.address,
@@ -663,7 +665,7 @@ def fleet_replica_rows(collector: Collector,
     status = collector.target_status(now)
     store = collector.store
     rows: List[Dict[str, Any]] = []
-    for address, _grpc in specs:
+    for address, *_rest in specs:  # 2- or 3-tuple (role schema v2)
         st = status.get(address)
         if st is None or not st.get("ok"):
             rows.append({"address": address, "reachable": False})

@@ -77,6 +77,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from kubeflow_tpu.obs import metrics as obs_metrics
 from kubeflow_tpu.obs.tracing import TRACER
 from kubeflow_tpu.scaling.endpoints import (
+    normalize_spec,
     scrape_healthz,
     write_endpoints_file,
 )
@@ -117,6 +118,14 @@ class AutoscalerConfig:
     hysteresis: float = 0.2
     scale_up_cooldown_s: float = 15.0
     scale_down_cooldown_s: float = 60.0
+    #: Which per-replica signal drives the ratio: ``queue_wait`` (the
+    #: classic estimated-queue-wait law — prefill/any pools) or
+    #: ``slot_occupancy`` (decode pools: fraction of engine slots
+    #: live, the capacity number for HBM-bound token streaming —
+    #: role-split fleets scale each pool on ITS signal, ISSUE 10).
+    signal: str = "queue_wait"
+    #: Target mean slot occupancy when ``signal="slot_occupancy"``.
+    target_slot_occupancy: float = 0.8
 
     def validate(self) -> None:
         if not (1 <= self.min_replicas <= self.max_replicas):
@@ -127,6 +136,12 @@ class AutoscalerConfig:
             raise ValueError("target_queue_wait_ms must be > 0")
         if not (0 < self.hysteresis < 1):
             raise ValueError("hysteresis must be in (0, 1)")
+        if self.signal not in ("queue_wait", "slot_occupancy"):
+            raise ValueError(
+                f"unknown autoscaler signal {self.signal!r}")
+        if not (0 < self.target_slot_occupancy <= 1):
+            raise ValueError(
+                "target_slot_occupancy must be in (0, 1]")
 
 
 class Scaler:
@@ -207,6 +222,7 @@ class Autoscaler:
                 "desired": desired,
                 "action": action,
                 "reason": reason,
+                "signal": cfg.signal,
                 "mean_queue_wait_ms": round(mean_wait, 3),
                 "target_queue_wait_ms": cfg.target_queue_wait_ms,
                 "ratio": round(ratio, 4),
@@ -228,7 +244,16 @@ class Autoscaler:
             shed_rate = sum(float(m.get("shed_rate", 0.0))
                             + float(m.get("expired_rate", 0.0))
                             for m in replica_metrics)
-            ratio = mean_wait / cfg.target_queue_wait_ms
+            if cfg.signal == "slot_occupancy":
+                # Decode pools: scale on engine slot occupancy (a
+                # replica without engine stats reads fully occupied —
+                # blind capacity is never counted as headroom).
+                occupancy = sum(
+                    float(m.get("slot_occupancy", 1.0))
+                    for m in replica_metrics) / len(replica_metrics)
+                ratio = occupancy / cfg.target_slot_occupancy
+            else:
+                ratio = mean_wait / cfg.target_queue_wait_ms
         else:
             mean_wait = shed_rate = ratio = 0.0
         # min/max are hard clamps on the FLEET, not just on decisions:
@@ -359,6 +384,17 @@ class AutoscalerLoop:
         #: ITS aggregated queue-wait/shed-rate store instead of
         #: running a second healthz sweep — one fleet, one scraper.
         self.collector = collector
+        if (collector is not None
+                and autoscaler.config.signal == "slot_occupancy"):
+            # fleet_replica_rows carries no slot-occupancy series, so
+            # every replica would read fully occupied (the blind-
+            # capacity default) and the pool would ride to
+            # max_replicas forever. Refuse the combination loudly;
+            # decode pools use the healthz sweep.
+            raise ValueError(
+                "signal='slot_occupancy' requires the healthz scrape "
+                "path; the collector store carries no engine-slot "
+                "rows (drop collector= or use signal='queue_wait')")
         self._scrape = scrape or (
             lambda addr: scrape_healthz(addr, scrape_timeout_s))
         self.api = api
@@ -381,12 +417,30 @@ class AutoscalerLoop:
             return {"address": address, "reachable": False}
         queue_wait = 0.0
         shed = expired = 0.0
+        slots = active_slots = 0.0
+        shards = 1
         for stats in (payload.get("saturation") or {}).values():
             queue_wait += (float(stats.get("queue_depth", 0.0))
                            * float(stats.get("est_batch_latency_ms",
                                              0.0)))
             shed += float(stats.get("shed", 0.0))
             expired += float(stats.get("expired", 0.0))
+            engine = stats.get("engine") or {}
+            try:
+                slots += float(engine.get("slots", 0.0))
+                active_slots += float(engine.get("active_slots", 0.0))
+                # The engine's queued-but-unslotted requests are queue
+                # pressure too; price them at a slice of latency so a
+                # saturated decode pool doesn't read as idle.
+                queue_wait += (float(engine.get("queue_depth", 0.0))
+                               * float(engine.get("est_ttft_ms", 0.0)))
+            except (TypeError, ValueError):
+                pass  # malformed engine stats degrade, never raise
+            try:
+                topo = stats.get("sharding") or {}
+                shards = max(shards, int(topo.get("num_shards", 1)))
+            except (TypeError, ValueError, AttributeError):
+                pass
         prev = self._counters.get(address)
         shed_rate = expired_rate = 0.0
         if prev is not None:
@@ -401,7 +455,7 @@ class AutoscalerLoop:
             expired_rate = obs_metrics.counter_increase(
                 prev_expired, expired) / dt
         self._counters[address] = (shed, expired, now)
-        return {
+        row = {
             "address": address,
             "reachable": True,
             "status": payload.get("status", ""),
@@ -409,7 +463,17 @@ class AutoscalerLoop:
             "shed_rate": round(shed_rate, 4),
             "expired_rate": round(expired_rate, 4),
             "resident_models": sorted(payload.get("saturation") or {}),
+            "shards": shards,
         }
+        role = payload.get("role")
+        if isinstance(role, str) and role != "any":
+            row["role"] = role
+        if slots > 0:
+            # Decode-pool saturation signal: slot occupancy is the
+            # HBM-bound pool's capacity number (a decode replica with
+            # empty slots is idle whatever its queue math says).
+            row["slot_occupancy"] = round(active_slots / slots, 4)
+        return row
 
     def _scrape_one(self, address: str
                     ) -> Tuple[Optional[Dict[str, Any]], float]:
@@ -423,10 +487,16 @@ class AutoscalerLoop:
         # over ITS actual sample spacing.
         return payload, time.monotonic()
 
-    def tick(self) -> Dict[str, Any]:
+    def tick(self, specs: Optional[Sequence[Sequence[Any]]] = None,
+             *, publish: bool = True) -> Dict[str, Any]:
         """One discover→scrape→decide→publish cycle (tests call this
-        directly; run() paces it)."""
-        specs = list(self.discover())
+        directly; run() paces it). ``specs`` overrides discovery and
+        ``publish=False`` suppresses the ConfigMap write — the seams
+        the role-split coordinator drives per-pool cycles through."""
+        if specs is None:
+            specs = list(self.discover())
+        else:
+            specs = list(specs)
         if self.write_endpoints_path:
             try:
                 write_endpoints_file(self.write_endpoints_path, specs)
@@ -434,10 +504,12 @@ class AutoscalerLoop:
                 logger.warning("could not write endpoints file %s",
                                self.write_endpoints_path, exc_info=True)
         if self.collector is not None:
-            return self._tick_from_collector(specs)
+            return self._tick_from_collector(specs, publish=publish)
         fleet: List[Dict[str, Any]] = []
         metrics: List[Dict[str, Any]] = []
-        addresses = [address for address, _grpc in specs]
+        normalized = [normalize_spec(s) for s in specs]
+        addresses = [address for address, _grpc, _role in normalized]
+        roles = {address: role for address, _grpc, role in normalized}
         live = set(addresses)
         # Concurrent scrapes (the HealthProber pattern): N dead
         # replicas cost the cycle ONE scrape timeout, not N — a
@@ -455,6 +527,8 @@ class AutoscalerLoop:
                                               addresses))
         for address, (payload, sampled_at) in zip(addresses, results):
             row = self._replica_sample(address, payload, sampled_at)
+            if roles.get(address, "any") != "any":
+                row.setdefault("role", roles[address])
             fleet.append(row)
             if row.get("reachable"):
                 metrics.append(row)
@@ -465,10 +539,12 @@ class AutoscalerLoop:
             metrics, now=time.monotonic(),
             unreachable=len(fleet) - len(metrics))
         self.last_fleet = fleet
-        self.publish(fleet, decision)
+        if publish:
+            self.publish(fleet, decision)
         return decision
 
-    def _tick_from_collector(self, specs) -> Dict[str, Any]:
+    def _tick_from_collector(self, specs, *,
+                             publish: bool = True) -> Dict[str, Any]:
         """Decide from the collector's store: per-replica queue-wait
         and restart-clamped shed/expired rates come pre-aggregated
         from the fleet's /metrics scrapes (same row shape as the
@@ -481,7 +557,8 @@ class AutoscalerLoop:
             metrics, now=time.monotonic(),
             unreachable=len(fleet) - len(metrics))
         self.last_fleet = fleet
-        self.publish(fleet, decision)
+        if publish:
+            self.publish(fleet, decision)
         return decision
 
     def publish(self, fleet: List[Dict[str, Any]],
@@ -547,12 +624,166 @@ class AutoscalerLoop:
             self._scrapers = None
 
 
+class RoleSplitAutoscalerLoop:
+    """One control loop, N role pools (ISSUE 10): the prefill pool
+    scales on queue wait (compute-bound prompt passes queue), the
+    decode pool on engine slot occupancy (HBM-bound token streaming
+    fills slots), and every cycle merges both discoveries into ONE
+    role-carrying endpoints file — the router's balancer reads the
+    role dimension from the same hot-reload contract as membership.
+
+    ``pools`` maps role → an :class:`AutoscalerLoop` configured with
+    NO write path and NO api (the coordinator owns the file write and
+    the ConfigMap publish, so the pools can never interleave torn
+    views of the fleet).
+    """
+
+    def __init__(self, pools: Dict[str, AutoscalerLoop], *,
+                 interval_s: float = 2.0,
+                 api: Optional[Any] = None,
+                 namespace: str = "default",
+                 write_endpoints_path: Optional[str] = None):
+        for role, loop in pools.items():
+            if loop.write_endpoints_path or loop.api is not None:
+                raise ValueError(
+                    f"pool {role!r}: per-pool loops must not write "
+                    f"the endpoints file or publish (the coordinator "
+                    f"owns both)")
+        self.pools = dict(pools)
+        self.interval_s = interval_s
+        self.api = api
+        self.namespace = namespace
+        self.write_endpoints_path = write_endpoints_path
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_fleet: List[Dict[str, Any]] = []
+        self.last_decisions: Dict[str, Dict[str, Any]] = {}
+
+    def tick(self) -> Dict[str, Dict[str, Any]]:
+        merged: List[Tuple[str, Optional[str], str]] = []
+        fleet: List[Dict[str, Any]] = []
+        decisions: Dict[str, Dict[str, Any]] = {}
+        per_pool: Dict[str, List] = {}
+        for role, loop in self.pools.items():
+            specs = [(a, g, role) for a, g, _r in
+                     map(normalize_spec, loop.discover())]
+            per_pool[role] = specs
+            merged.extend(specs)
+        # ONE atomic write of the whole fleet BEFORE the (slow) scrape
+        # sweeps: the router learns about new pods as early as the
+        # single-pool loop would have told it.
+        if self.write_endpoints_path:
+            try:
+                write_endpoints_file(self.write_endpoints_path, merged)
+            except OSError:
+                logger.warning("could not write endpoints file %s",
+                               self.write_endpoints_path, exc_info=True)
+        for role, loop in self.pools.items():
+            decision = loop.tick(per_pool[role], publish=False)
+            decisions[role] = decision
+            for row in loop.last_fleet:
+                row = dict(row)
+                row["role"] = role
+                fleet.append(row)
+        self.last_fleet = fleet
+        self.last_decisions = decisions
+        self._publish(fleet, decisions)
+        return decisions
+
+    def _publish(self, fleet: List[Dict[str, Any]],
+                 decisions: Dict[str, Dict[str, Any]]) -> None:
+        """Same ConfigMap/key as the single-pool loop. ``decision``
+        stays populated (the most urgent pool's — scale_up beats
+        scale_down beats hold) so pre-role dashboards keep rendering;
+        ``decisions`` carries the per-role detail new ones read."""
+        if self.api is None:
+            return
+        urgency = {"scale_up": 0, "scale_down": 1, "hold": 2}
+        primary = min(
+            decisions.values(),
+            key=lambda d: urgency.get(d.get("action", "hold"), 3),
+            default=None)
+        doc: Dict[str, Any] = {"replicas": fleet}
+        now = time.monotonic()
+
+        def age(decision: Dict[str, Any]) -> Dict[str, Any]:
+            decision = dict(decision)
+            decision["age_s"] = round(
+                now - decision.pop("at_monotonic", now), 1)
+            return decision
+
+        if primary is not None:
+            doc["decision"] = age(primary)
+        doc["decisions"] = {role: age(d) for role, d in
+                            decisions.items()}
+        payload = json.dumps(doc, sort_keys=True)
+        try:
+            from kubeflow_tpu.operator.fake import NotFound
+
+            try:
+                self.api.patch(
+                    "ConfigMap", self.namespace, FLEET_CONFIGMAP,
+                    lambda o: o.setdefault("data", {}).update(
+                        {FLEET_KEY: payload}))
+            except NotFound:
+                self.api.create({
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {"name": FLEET_CONFIGMAP,
+                                 "namespace": self.namespace},
+                    "data": {FLEET_KEY: payload},
+                })
+        except Exception:  # noqa: BLE001 — publishing must never wedge
+            logger.debug("fleet publish failed", exc_info=True)
+
+    def run(self, *, max_cycles: Optional[int] = None) -> None:
+        cycles = 0
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                logger.exception("role-split autoscaler tick failed")
+            cycles += 1
+            if max_cycles is not None and cycles >= max_cycles:
+                return
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self.run,
+                                        name="role-autoscaler",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        for loop in self.pools.values():
+            loop.stop()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kft-autoscaler")
     parser.add_argument("--namespace", default="default")
-    parser.add_argument("--deployment", required=True,
+    parser.add_argument("--deployment", default=None,
                         help="serving Deployment whose scale "
                              "subresource is actuated")
+    parser.add_argument("--role_deployments", default=None,
+                        help="role-split fleets: 'prefill=<dep>,"
+                             "decode=<dep>' — one Deployment per "
+                             "role pool, each scaled on its own "
+                             "signal (prefill: queue wait; decode: "
+                             "engine slot occupancy) and merged into "
+                             "one role-carrying endpoints file "
+                             "(docs/scaling.md). Mutually exclusive "
+                             "with --deployment")
+    parser.add_argument("--target_slot_occupancy", type=float,
+                        default=0.8,
+                        help="decode-pool saturation target (fraction "
+                             "of engine slots live)")
     parser.add_argument("--selector", default=None,
                         help="pod label selector for replica "
                              "discovery (key=value[,k=v]); default "
@@ -582,43 +813,90 @@ def main(argv=None) -> int:
                              "exposition port; 0 disables")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    if bool(args.deployment) == bool(args.role_deployments):
+        parser.error("exactly one of --deployment or "
+                     "--role_deployments is required")
+    if args.role_deployments and args.selector:
+        # Silently dropping the selector would leave each pool
+        # discovering by app=<deployment> while the operator believes
+        # their filter applies — an empty-fleet autoscaler with
+        # nothing pointing at the ignored flag.
+        parser.error("--selector applies to single-pool mode only; "
+                     "role pools discover by app=<deployment>")
 
     from kubeflow_tpu.operator.http_client import HttpApiClient
 
     api = (HttpApiClient(args.apiserver) if args.apiserver
            else HttpApiClient.in_cluster())
-    selector: Dict[str, Optional[str]] = {"app": args.deployment}
-    if args.selector:
-        selector = {}
-        for pair in args.selector.split(","):
-            key, eq, value = pair.partition("=")
-            selector[key] = value if eq else None
-    config = AutoscalerConfig(
-        min_replicas=args.min_replicas,
-        max_replicas=args.max_replicas,
-        target_queue_wait_ms=args.target_queue_wait_ms,
-        hysteresis=args.hysteresis,
-        scale_up_cooldown_s=args.scale_up_cooldown,
-        scale_down_cooldown_s=args.scale_down_cooldown)
-    autoscaler = Autoscaler(
-        config, DeploymentScaler(api, args.namespace, args.deployment))
-    loop = AutoscalerLoop(
-        autoscaler,
-        discover=lambda: discover_pod_endpoints(
+
+    def make_config(signal: str) -> AutoscalerConfig:
+        return AutoscalerConfig(
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            target_queue_wait_ms=args.target_queue_wait_ms,
+            hysteresis=args.hysteresis,
+            scale_up_cooldown_s=args.scale_up_cooldown,
+            scale_down_cooldown_s=args.scale_down_cooldown,
+            signal=signal,
+            target_slot_occupancy=args.target_slot_occupancy)
+
+    def make_discover(deployment: str):
+        selector: Dict[str, Optional[str]] = {"app": deployment}
+        if args.selector and not args.role_deployments:
+            selector = {}
+            for pair in args.selector.split(","):
+                key, eq, value = pair.partition("=")
+                selector[key] = value if eq else None
+        return lambda: discover_pod_endpoints(
             api, args.namespace, selector, rest_port=args.rest_port,
-            grpc_port=args.grpc_port or None),
-        interval_s=args.interval, api=api, namespace=args.namespace,
-        write_endpoints_path=args.write_endpoints)
+            grpc_port=args.grpc_port or None)
+
+    loop: Any
+    if args.role_deployments:
+        pools: Dict[str, AutoscalerLoop] = {}
+        for pair in args.role_deployments.split(","):
+            role, eq, deployment = pair.partition("=")
+            role = role.strip()
+            if not eq or role not in ("prefill", "decode", "any"):
+                parser.error(f"bad --role_deployments entry {pair!r}; "
+                             f"want role=deployment with role one of "
+                             f"prefill|decode|any")
+            signal = ("slot_occupancy" if role == "decode"
+                      else "queue_wait")
+            pools[role] = AutoscalerLoop(
+                Autoscaler(make_config(signal),
+                           DeploymentScaler(api, args.namespace,
+                                            deployment.strip())),
+                discover=make_discover(deployment.strip()),
+                interval_s=args.interval)
+        loop = RoleSplitAutoscalerLoop(
+            pools, interval_s=args.interval, api=api,
+            namespace=args.namespace,
+            write_endpoints_path=args.write_endpoints)
+        logger.info("role-split autoscaler: pools %s, replicas "
+                    "%d..%d each", sorted(pools), args.min_replicas,
+                    args.max_replicas)
+    else:
+        config = make_config("queue_wait")
+        autoscaler = Autoscaler(
+            config,
+            DeploymentScaler(api, args.namespace, args.deployment))
+        loop = AutoscalerLoop(
+            autoscaler,
+            discover=make_discover(args.deployment),
+            interval_s=args.interval, api=api,
+            namespace=args.namespace,
+            write_endpoints_path=args.write_endpoints)
+        logger.info(
+            "autoscaler: deployment %s/%s, replicas %d..%d, target "
+            "queue wait %.0f ms", args.namespace, args.deployment,
+            config.min_replicas, config.max_replicas,
+            config.target_queue_wait_ms)
     if args.metrics_port:
         from kubeflow_tpu.obs.exposition import start_exposition_server
 
         start_exposition_server(args.metrics_port)
         logger.info("autoscaler metrics on :%d", args.metrics_port)
-    logger.info(
-        "autoscaler: deployment %s/%s, replicas %d..%d, target queue "
-        "wait %.0f ms", args.namespace, args.deployment,
-        config.min_replicas, config.max_replicas,
-        config.target_queue_wait_ms)
     try:
         loop.run()
     except KeyboardInterrupt:

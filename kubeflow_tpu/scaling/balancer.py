@@ -55,6 +55,7 @@ __all__ = [
     "Balancer",
     "LeastSaturationBalancer",
     "ResidentAffinityBalancer",
+    "RoleAwareBalancer",
     "RoundRobinBalancer",
     "eligible_endpoints",
     "make_balancer",
@@ -103,7 +104,11 @@ class Balancer:
         return i % n
 
     def pick(self, candidates: Sequence[Endpoint],
-             model: Optional[str] = None) -> Optional[Endpoint]:
+             model: Optional[str] = None,
+             phase: Optional[str] = None) -> Optional[Endpoint]:
+        """``phase`` is the request's dominant serving phase
+        (``prefill`` | ``decode`` | None) — only role-aware policies
+        read it; the rest route phase-blind."""
         raise NotImplementedError
 
 
@@ -111,7 +116,8 @@ class RoundRobinBalancer(Balancer):
     name = "round_robin"
 
     def pick(self, candidates: Sequence[Endpoint],
-             model: Optional[str] = None) -> Optional[Endpoint]:
+             model: Optional[str] = None,
+             phase: Optional[str] = None) -> Optional[Endpoint]:
         if not candidates:
             return None
         return candidates[self._next_index(len(candidates))]
@@ -121,7 +127,8 @@ class LeastSaturationBalancer(Balancer):
     name = "least_saturation"
 
     def pick(self, candidates: Sequence[Endpoint],
-             model: Optional[str] = None) -> Optional[Endpoint]:
+             model: Optional[str] = None,
+             phase: Optional[str] = None) -> Optional[Endpoint]:
         if not candidates:
             return None
         offset = self._next_index(len(candidates))  # rotating tiebreak
@@ -145,7 +152,8 @@ class ResidentAffinityBalancer(Balancer):
         self._fallback = LeastSaturationBalancer()
 
     def pick(self, candidates: Sequence[Endpoint],
-             model: Optional[str] = None) -> Optional[Endpoint]:
+             model: Optional[str] = None,
+             phase: Optional[str] = None) -> Optional[Endpoint]:
         if not candidates:
             return None
         if model:
@@ -157,9 +165,51 @@ class ResidentAffinityBalancer(Balancer):
         return self._fallback.pick(candidates, model)
 
 
+class RoleAwareBalancer(Balancer):
+    """Role-split routing (ISSUE 10): long-prompt prefill work goes
+    to compute-bound ``prefill``-role replicas, token decoding to
+    HBM-bound ``decode``-role replicas; ``any``-role members serve
+    both. Inside the matching pool the pick is least-saturation.
+
+    Specialization never beats availability: when the matching pool
+    is empty (no replica of that role discovered yet, all ejected) or
+    every matching member is overloaded past ``overload_ms`` of
+    estimated queue wait, the pick falls back to the WHOLE candidate
+    set — the same contract affinity routing keeps for residency."""
+
+    name = "role"
+
+    def __init__(self, overload_ms: float = 500.0):
+        super().__init__()
+        self.overload_ms = overload_ms
+        self._fallback = LeastSaturationBalancer()
+
+    def pick(self, candidates: Sequence[Endpoint],
+             model: Optional[str] = None,
+             phase: Optional[str] = None) -> Optional[Endpoint]:
+        if not candidates:
+            return None
+        if phase:
+            matching = [ep for ep in candidates
+                        if ep.serves_phase(phase)]
+            healthy = [ep for ep in matching
+                       if ep.saturation_score() < self.overload_ms]
+            if healthy:
+                return self._fallback.pick(healthy, model)
+            if matching:
+                # Whole pool overloaded: still prefer the role pool
+                # unless the rest of the fleet has headroom.
+                rest = [ep for ep in candidates
+                        if ep.saturation_score() < self.overload_ms]
+                pool = rest or matching
+                return self._fallback.pick(pool, model)
+        return self._fallback.pick(candidates, model)
+
+
 _POLICIES = {
     cls.name: cls for cls in (RoundRobinBalancer, LeastSaturationBalancer,
-                              ResidentAffinityBalancer)
+                              ResidentAffinityBalancer,
+                              RoleAwareBalancer)
 }
 
 

@@ -77,11 +77,31 @@ class StubBackendFleet:
     way a deleted pod fails).
     """
 
+    #: Role-specialized service rates (ms per prompt token, ms per
+    #: new token) — the asymmetry role-split routing exploits: a
+    #: compute-bound (tp-sharded) prefill replica runs the prompt
+    #: pass fast but decodes slowly; an HBM-bound decode replica with
+    #: deep slot batching amortizes weight streaming per token but
+    #: has no spare FLOPs for long prompts; an ``any`` replica is the
+    #: middling generalist. The numbers are sleep milliseconds, so
+    #: the measured ratios survive CPU throttling (module docstring).
+    ROLE_RATES = {"prefill": (0.2, 2.0), "decode": (1.0, 0.5),
+                  "any": (0.5, 1.0)}
+
     def __init__(self, n: int, *, service_time_s: float = 0.04,
-                 proxy_kwargs: Optional[Dict[str, Any]] = None):
+                 proxy_kwargs: Optional[Dict[str, Any]] = None,
+                 roles: Optional[List[str]] = None):
         self.n = n
         self.service_time_s = service_time_s
         self.proxy_kwargs = proxy_kwargs
+        #: Per-backend role (None = classic role-less fleet). With
+        #: roles set, ``:generate`` requests cost
+        #: ``prefill_ms×prompt_tokens + decode_ms×max_new_tokens``
+        #: per the backend's ROLE_RATES row, and /healthz reports the
+        #: role (the prober backfills it onto the Endpoint).
+        self.roles = list(roles) if roles else None
+        if self.roles is not None and len(self.roles) != n:
+            raise ValueError(f"{len(self.roles)} roles for {n} backends")
         self.ports: List[int] = []
         self.proxy_port: int = 0
         self.proxy_app: Any = None
@@ -109,10 +129,21 @@ class StubBackendFleet:
             async def post(self, name, version, verb):
                 body = json.loads(self.request.body or b"{}")
                 rows = body.get("instances") or []
+                service_s = fleet.service_time_s
+                if fleet.roles is not None and verb == "generate":
+                    # Role-specialized generate cost: per-token sleep
+                    # rates by this backend's role (ROLE_RATES).
+                    p_ms, d_ms = fleet.ROLE_RATES[fleet.roles[index]]
+                    prompt_tokens = max(
+                        (len(r) if hasattr(r, "__len__") else 1)
+                        for r in rows) if rows else 1
+                    new_tokens = int(body.get("max_new_tokens", 16))
+                    service_s = (p_ms * prompt_tokens
+                                 + d_ms * new_tokens) / 1e3
                 lock = fleet._locks[index]
                 async with lock:
                     t0 = time.monotonic()
-                    await asyncio.sleep(fleet.service_time_s)
+                    await asyncio.sleep(service_s)
                     fleet.busy_s[index] += time.monotonic() - t0
                 fleet.completed[index] += 1
                 self.write({"model_spec": {"name": name,
@@ -124,15 +155,18 @@ class StubBackendFleet:
             def get(self):
                 lock = fleet._locks[index]
                 queue_depth = len(getattr(lock, "_waiters", None) or ())
-                self.write({"status": "ok", "breakers": {},
-                            "saturation": {MODEL: {
-                                "queue_depth": queue_depth,
-                                "est_batch_latency_ms":
-                                    fleet.service_time_s * 1e3,
-                                "shed": 0, "expired": 0,
-                                "batches": fleet.completed[index],
-                                "rows": fleet.completed[index],
-                            }}})
+                payload = {"status": "ok", "breakers": {},
+                           "saturation": {MODEL: {
+                               "queue_depth": queue_depth,
+                               "est_batch_latency_ms":
+                                   fleet.service_time_s * 1e3,
+                               "shed": 0, "expired": 0,
+                               "batches": fleet.completed[index],
+                               "rows": fleet.completed[index],
+                           }}}
+                if fleet.roles is not None:
+                    payload["role"] = fleet.roles[index]
+                self.write(payload)
 
         return tornado.web.Application([
             (r"/v1/models/([^/:]+)/metadata", Meta),
@@ -162,9 +196,22 @@ class StubBackendFleet:
             from kubeflow_tpu.serving.http_proxy import make_app
 
             sock, self.proxy_port = tornado.testing.bind_unused_port()
-            self.proxy_app = make_app(
-                [f"127.0.0.1:{p}" for p in self.ports],
-                **self.proxy_kwargs)
+            if self.roles is not None:
+                # Role-carrying pool (the endpoints-file v2 shape);
+                # healthz-reported roles cover the backfill path too.
+                from kubeflow_tpu.scaling.endpoints import EndpointPool
+
+                kwargs = dict(self.proxy_kwargs)
+                pool = EndpointPool(
+                    breaker_failures=kwargs.pop("breaker_failures", 5),
+                    breaker_reset_s=kwargs.pop("breaker_reset_s", 5.0))
+                for port, role in zip(self.ports, self.roles):
+                    pool.add(f"127.0.0.1:{port}", None, role)
+                self.proxy_app = make_app(pool=pool, **kwargs)
+            else:
+                self.proxy_app = make_app(
+                    [f"127.0.0.1:{p}" for p in self.ports],
+                    **self.proxy_kwargs)
             proxy_server = tornado.httpserver.HTTPServer(self.proxy_app)
             proxy_server.add_sockets([sock])
             self._servers.append(proxy_server)
@@ -426,6 +473,150 @@ def _run_failover_phase(config: RouterBenchConfig,
         return result_box
     finally:
         fleet.stop()
+
+
+@dataclass
+class RoleSplitBenchConfig:
+    """Mixed prompt/decode load over a specialized fleet: role-split
+    routing vs role-blind, same offered load (ISSUE 10 acceptance)."""
+
+    #: Fleet shape: two compute-bound prefill replicas + two
+    #: HBM-bound decode replicas (ROLE_RATES models the asymmetry).
+    roles: Tuple[str, ...] = ("prefill", "prefill", "decode", "decode")
+    #: The two request classes, 50/50: long-prompt/short-completion
+    #: (prefill-bound) and short-prompt/long-completion (decode-
+    #: bound). Costs: prefill-heavy = 48 ms on a prefill replica but
+    #: 164 ms on a decode one; decode-heavy = 40 ms vs 129.6 ms —
+    #: the interference role-blind spraying pays for.
+    prefill_heavy: Tuple[int, int] = (160, 8)  # (prompt, new) tokens
+    decode_heavy: Tuple[int, int] = (8, 64)
+    #: Offered load sits BETWEEN the two capacities: the matched
+    #: fleet (≈ 2/0.048 + 2/0.040 ≈ 92 rps) rides it out, the blind
+    #: fleet (JSQ mixes classes onto the slow pool; measured
+    #: effective capacity ≈ 59 rps) builds backlog and misses
+    #: deadlines — the interference cost the role dimension removes.
+    offered_rps: float = 68.0
+    duration_s: float = 5.0
+    deadline_ms: int = 600
+    warmup_requests: int = 8
+
+
+def _post_generate(port: int, prompt_tokens: int, new_tokens: int,
+                   deadline_ms: int, timeout_s: float = 10.0) -> float:
+    payload = json.dumps({
+        "instances": [[1] * prompt_tokens],
+        "max_new_tokens": new_tokens,
+    }).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/model/{MODEL}:generate", data=payload,
+        headers={"Content-Type": "application/json",
+                 "X-Deadline-Ms": str(deadline_ms)})
+    t0 = time.monotonic()
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        json.load(resp)
+    return time.monotonic() - t0
+
+
+def _drive_open_loop_mixed(port: int, config: RoleSplitBenchConfig
+                           ) -> Dict[str, Any]:
+    """Open-loop mixed-class arrivals (arrivals do NOT slow when the
+    fleet does — overload only exists in open-loop traffic); goodput
+    = in-deadline completions / wall. Striped worker pool, the r8
+    overload-bench pattern."""
+    n = max(1, int(config.offered_rps * config.duration_s))
+    interval = 1.0 / config.offered_rps
+    budget_s = config.deadline_ms / 1e3
+    results: List[Tuple[str, float]] = []
+    lock = threading.Lock()
+
+    def one(k: int) -> None:
+        prompt, new = (config.prefill_heavy if k % 2 == 0
+                       else config.decode_heavy)
+        try:
+            dt = _post_generate(port, prompt, new, config.deadline_ms,
+                                timeout_s=budget_s + 2.0)
+            outcome = "ok" if dt <= budget_s else "late"
+        except urllib.error.HTTPError as e:
+            outcome = f"HTTP {e.code}"
+        except Exception:  # noqa: BLE001 — transport/timeout
+            outcome = "client_timeout"
+        with lock:
+            results.append((outcome, k))
+
+    pool = min(n, max(8, int(config.offered_rps * budget_s * 2) + 1))
+    start = time.monotonic()
+
+    def worker(i: int) -> None:
+        for k in range(i, n, pool):
+            delay = start + k * interval - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            one(k)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(pool)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(config.duration_s + budget_s + 30)
+    counts: Dict[str, int] = {}
+    for outcome, _ in results:
+        counts[outcome] = counts.get(outcome, 0) + 1
+    ok = counts.get("ok", 0)
+    return {
+        "sent": n,
+        "offered_rps": round(config.offered_rps, 1),
+        "ok": ok,
+        "goodput_rps": round(ok / config.duration_s, 1),
+        "outcomes": counts,
+    }
+
+
+def run_role_split_benchmark(
+        config: Optional[RoleSplitBenchConfig] = None) -> Dict[str, Any]:
+    """The role-dimension acceptance run: the SAME specialized fleet
+    under the SAME mixed offered load, routed role-aware
+    (``--balancer role``: prefill-bound requests → prefill replicas,
+    decode-bound → decode replicas) vs role-blind
+    (``least_saturation``: queue math only — it cannot see which
+    CLASS a request is, so half the work lands on the slow pool).
+    Reports goodput for both and the ratio."""
+    config = config or RoleSplitBenchConfig()
+    phases: Dict[str, Dict[str, Any]] = {}
+    for label, balancer in (("role_split", "role"),
+                            ("role_blind", "least_saturation")):
+        fleet = StubBackendFleet(
+            len(config.roles), roles=list(config.roles),
+            proxy_kwargs={"balancer": balancer,
+                          # The stub fleet speaks no KV handoff; the
+                          # measured contrast is pure ROUTING.
+                          "split_generate": False,
+                          "probe_interval_s": 0.2}).start()
+        try:
+            for k in range(config.warmup_requests):
+                prompt, new = (config.prefill_heavy if k % 2 == 0
+                               else config.decode_heavy)
+                _post_generate(fleet.proxy_port, prompt, new,
+                               config.deadline_ms)
+            phases[label] = _drive_open_loop_mixed(fleet.proxy_port,
+                                                   config)
+        finally:
+            fleet.stop()
+    ratio = (phases["role_split"]["goodput_rps"]
+             / max(1e-9, phases["role_blind"]["goodput_rps"]))
+    return {
+        "config": {
+            "roles": list(config.roles),
+            "prefill_heavy": list(config.prefill_heavy),
+            "decode_heavy": list(config.decode_heavy),
+            "offered_rps": config.offered_rps,
+            "deadline_ms": config.deadline_ms,
+            "role_rates_ms_per_token": StubBackendFleet.ROLE_RATES,
+        },
+        "phases": phases,
+        "goodput_ratio": round(ratio, 2),
+        "role_split_wins": ratio > 1.0,
+    }
 
 
 def main(argv=None) -> int:

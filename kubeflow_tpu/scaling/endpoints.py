@@ -66,6 +66,39 @@ logger = logging.getLogger(__name__)
 HEALTHY, UNHEALTHY, UNKNOWN, DRAINING = (
     "healthy", "unhealthy", "unknown", "draining")
 
+#: Replica roles (ISSUE 10 role-split routing): ``prefill`` replicas
+#: serve the compute-bound prompt pass, ``decode`` replicas adopt the
+#: handed-off KV cache and stream tokens, ``any`` does both. An
+#: unrecognized role string DEGRADES to ``any`` — a mid-rollout
+#: router reading a newer autoscaler's endpoints file must keep
+#: routing, never crash or drop the member.
+ROLE_PREFILL, ROLE_DECODE, ROLE_ANY = "prefill", "decode", "any"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_ANY)
+
+#: Endpoints-file schema version this build writes. v1 files (no
+#: ``version`` key, no ``role`` fields) read exactly as before —
+#: role absent ⇒ ``any`` — and v1 readers ignore the new keys, so
+#: either side of a rolling upgrade parses the other's file.
+ENDPOINTS_FILE_VERSION = 2
+
+
+def normalize_role(role: Optional[str]) -> str:
+    """Unknown/absent roles degrade to ``any`` (never raise: the
+    value may come from a newer writer's file mid-rollout)."""
+    return role if role in ROLES else ROLE_ANY
+
+
+def normalize_spec(spec: Sequence[Any]) -> Tuple[str, Optional[str],
+                                                 str]:
+    """One membership entry → ``(address, grpc_address, role)``.
+    Accepts the classic 2-tuple (role ⇒ ``any``) and the role-carrying
+    3-tuple, so every pre-role call site keeps working unmodified."""
+    if len(spec) == 2:
+        address, grpc = spec
+        return address, grpc, ROLE_ANY
+    address, grpc, role = spec
+    return address, grpc, normalize_role(role)
+
 _G_ENDPOINT_HEALTH = obs_metrics.Gauge(
     "kft_router_endpoint_health",
     "Per-replica router health (1=routable, 0=ejected/draining)",
@@ -116,7 +149,8 @@ class Endpoint:
     def __init__(self, address: str, grpc_address: Optional[str] = None,
                  *, breaker_failures: int = 5,
                  breaker_reset_s: float = 5.0,
-                 register_metrics: bool = True):
+                 register_metrics: bool = True,
+                 role: str = ROLE_ANY):
         from kubeflow_tpu.serving import overload
 
         #: host:port of the replica's REST surface (scheme optional).
@@ -124,6 +158,12 @@ class Endpoint:
         #: host:port of the replica's native gRPC surface (None =
         #: binary upstream disabled for this replica).
         self.grpc_address = grpc_address
+        #: Role from DISCOVERY (endpoints file / manifests); the
+        #: replica's own /healthz-reported role backfills it when
+        #: discovery says ``any`` (see :meth:`effective_role`).
+        self.role = normalize_role(role)
+        #: Role the replica itself reported on its last /healthz.
+        self.reported_role: Optional[str] = None
         self.rest_breaker = overload.CircuitBreaker(
             breaker_failures, breaker_reset_s)
         self.grpc_breaker = overload.CircuitBreaker(
@@ -172,6 +212,37 @@ class Endpoint:
         one batcher per loaded model)."""
         return list(self.saturation)
 
+    def effective_role(self) -> str:
+        """The role the balancer routes by: discovery wins when it
+        names one; a discovery-``any`` member adopts the replica's own
+        healthz-reported role (fleets without an endpoints-file
+        rollout still get role routing from the probe signal)."""
+        if self.role != ROLE_ANY:
+            return self.role
+        return normalize_role(self.reported_role)
+
+    def serves_phase(self, phase: Optional[str]) -> bool:
+        """May this replica take a ``phase`` (prefill/decode) request?
+        ``any``-role members serve everything; phase-less requests
+        route anywhere."""
+        if phase is None:
+            return True
+        role = self.effective_role()
+        return role == ROLE_ANY or role == phase
+
+    def shard_count(self) -> int:
+        """Max shard count across resident models (healthz saturation
+        carries each model's layout summary; malformed values read as
+        1 — the surface degrades, never raises)."""
+        count = 1
+        for stats in self.saturation.values():
+            try:
+                topo = stats.get("sharding") or {}
+                count = max(count, int(topo.get("num_shards", 1)))
+            except (TypeError, ValueError, AttributeError):
+                continue
+        return count
+
     def saturation_score(self) -> float:
         """Estimated queue wait in milliseconds if one more request
         were routed here: the healthz-reported per-model estimate
@@ -203,6 +274,9 @@ class Endpoint:
             if self.health != DRAINING:
                 self.health = HEALTHY
             self.saturation = dict(payload.get("saturation") or {})
+            reported = payload.get("role")
+            self.reported_role = (normalize_role(reported)
+                                  if isinstance(reported, str) else None)
             self.last_probe_at = time.monotonic() if now is None else now
         if self.rest_breaker.state != "closed":
             self.rest_breaker.record_success()
@@ -235,6 +309,8 @@ class Endpoint:
             return {
                 "address": self.address,
                 "grpc_address": self.grpc_address,
+                "role": self.effective_role(),
+                "shard_count": self.shard_count(),
                 "health": self.health,
                 "inflight": self.inflight,
                 "probe_failures": self.probe_failures,
@@ -288,14 +364,15 @@ class EndpointPool:
         with self._lock:
             return self._endpoints.get(address)
 
-    def add(self, address: str, grpc_address: Optional[str] = None
-            ) -> Endpoint:
+    def add(self, address: str, grpc_address: Optional[str] = None,
+            role: str = ROLE_ANY) -> Endpoint:
         with self._lock:
             ep = self._endpoints.get(address)
             if ep is None:
                 ep = Endpoint(address, grpc_address,
                               breaker_failures=self._breaker_failures,
-                              breaker_reset_s=self._breaker_reset_s)
+                              breaker_reset_s=self._breaker_reset_s,
+                              role=role)
                 self._endpoints[address] = ep
             elif ep.health == DRAINING:
                 # Re-added while draining (scale-down reverted before
@@ -351,21 +428,29 @@ class EndpointPool:
         channel, ep.grpc_channel = ep.grpc_channel, None
         _close_grpc_channel(channel)
 
-    def sync(self, specs: Sequence[Tuple[str, Optional[str]]]
+    def sync(self, specs: Sequence[Sequence[Any]]
              ) -> Tuple[List[str], List[str]]:
-        """Reconcile membership to ``specs`` [(address, grpc)] —
-        additions join as UNKNOWN, absentees leave drain-aware, and
-        already-drained members finally drop. Returns (added,
-        removed) addresses for logging."""
-        want = {a: g for a, g in specs}
+        """Reconcile membership to ``specs`` — entries are (address,
+        grpc) 2-tuples or (address, grpc, role) 3-tuples (role absent
+        ⇒ ``any``, the schema-v1 compat rule). Additions join as
+        UNKNOWN, absentees leave drain-aware, already-drained members
+        finally drop, and a retained member whose role changed in the
+        file retargets in place. Returns (added, removed) addresses
+        for logging."""
+        want = {a: (g, r) for a, g, r in map(normalize_spec, specs)}
         added, removed = [], []
         with self._lock:
             current = list(self._endpoints.items())
         for address, ep in current:
             if address in want:
-                self._retarget_grpc(ep, want[address])
+                grpc, role = want[address]
+                self._retarget_grpc(ep, grpc)
+                if ep.role != role:
+                    logger.info("endpoint %s role: %s -> %s",
+                                address, ep.role, role)
+                    ep.role = role
                 if ep.health == DRAINING:
-                    self.add(address, want[address])  # un-drain
+                    self.add(address, grpc)  # un-drain
                 continue
             if ep.health != DRAINING:
                 removed.append(address)
@@ -373,9 +458,9 @@ class EndpointPool:
             # one DRAINING; a draining member whose in-flight count
             # reached zero since the last sync drops here.
             self.remove(address)
-        for address, grpc in want.items():
+        for address, (grpc, role) in want.items():
             if self.get(address) is None:
-                self.add(address, grpc)
+                self.add(address, grpc, role)
                 added.append(address)
         if added or removed:
             logger.info("endpoint pool sync: +%s -%s", added, removed)
@@ -386,12 +471,14 @@ class EndpointPool:
 
 
 class StaticEndpointSource:
-    """A fixed membership list (the --rpc_address a,b,c form)."""
+    """A fixed membership list (the --rpc_address a,b,c form).
+    Entries may be 2- or 3-tuples (role); the given shape is
+    preserved."""
 
-    def __init__(self, specs: Sequence[Tuple[str, Optional[str]]]):
-        self._specs = [(a, g) for a, g in specs]
+    def __init__(self, specs: Sequence[Sequence[Any]]):
+        self._specs = [tuple(s) for s in specs]
 
-    def specs(self) -> List[Tuple[str, Optional[str]]]:
+    def specs(self) -> List[Sequence[Any]]:
         return list(self._specs)
 
 
@@ -405,6 +492,13 @@ class FileEndpointSource:
         {"endpoints": [{"address": "host:8500",
                         "grpc_address": "host:9000"}, ...]}
 
+    Schema v2 entries additionally carry ``role`` (prefill | decode |
+    any); a v1 file (no ``version`` key, no roles) reads exactly as
+    before with every member ``any``, and an UNKNOWN role value (a
+    newer writer mid-rollout) degrades to ``any`` rather than failing
+    the entry — an autoscaler and router on different builds must
+    never mis-parse each other's file.
+
     A missing or malformed file keeps the LAST GOOD membership — a
     half-written update must not empty the fleet (the autoscaler
     sidecar writes atomically via rename, but a human edit may not).
@@ -412,10 +506,10 @@ class FileEndpointSource:
 
     def __init__(self, path: str):
         self.path = path
-        self._last_good: List[Tuple[str, Optional[str]]] = []
+        self._last_good: List[Sequence[Any]] = []
         self._last_raw: Optional[str] = None
 
-    def specs(self) -> List[Tuple[str, Optional[str]]]:
+    def specs(self) -> List[Sequence[Any]]:
         try:
             with open(self.path) as f:
                 raw = f.read()
@@ -426,14 +520,25 @@ class FileEndpointSource:
         try:
             doc = json.loads(raw)
             entries = doc["endpoints"] if isinstance(doc, dict) else doc
-            specs = []
+            specs: List[Sequence[Any]] = []
             for entry in entries:
                 if isinstance(entry, str):
                     specs.append((entry, None))
-                else:
+                    continue
+                role = normalize_role(entry.get("role"))
+                if role == ROLE_ANY:
+                    # Classic 2-tuple for role-less members: every
+                    # pre-role consumer (and test) sees the shape it
+                    # always saw.
                     specs.append((entry["address"],
                                   entry.get("grpc_address")))
-        except (ValueError, KeyError, TypeError) as e:
+                else:
+                    specs.append((entry["address"],
+                                  entry.get("grpc_address"), role))
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
+            # AttributeError included: a non-dict entry (a bare int in
+            # a hand-edited file) fails .get("role") before it fails
+            # ["address"] — either way, keep the last good membership.
             logger.warning("endpoints file %s malformed (%s); keeping "
                            "last good membership", self.path, e)
             return list(self._last_good)
@@ -442,16 +547,24 @@ class FileEndpointSource:
 
 
 def write_endpoints_file(path: str,
-                         specs: Sequence[Tuple[str, Optional[str]]]
-                         ) -> None:
+                         specs: Sequence[Sequence[Any]]) -> None:
     """Atomically (write + rename) publish a membership list in the
-    FileEndpointSource shape — the autoscaler sidecar's half of the
-    hot-reload contract: readers never observe a torn file."""
+    FileEndpointSource shape (schema v2) — the autoscaler sidecar's
+    half of the hot-reload contract: readers never observe a torn
+    file. Accepts 2-tuples (role ``any``) and 3-tuples; the role key
+    is written only when it routes, so a role-less fleet's file stays
+    byte-compatible with v1 readers' expectations."""
     import os
 
-    payload = json.dumps({"endpoints": [
-        {"address": a, **({"grpc_address": g} if g else {})}
-        for a, g in specs]}, indent=1, sort_keys=True)
+    entries = []
+    for spec in specs:
+        a, g, r = normalize_spec(spec)
+        entries.append({"address": a,
+                        **({"grpc_address": g} if g else {}),
+                        **({"role": r} if r != ROLE_ANY else {})})
+    payload = json.dumps({"version": ENDPOINTS_FILE_VERSION,
+                          "endpoints": entries},
+                         indent=1, sort_keys=True)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         f.write(payload)

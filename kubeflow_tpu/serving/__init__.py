@@ -22,6 +22,9 @@ Layering (parity with reference ``kubeflow/tf-serving`` +
   serialized params (the SavedModel role).
 - :mod:`model` — loads one version onto TPU and builds the jitted,
   batch-bucketed predict function (XLA compile once per bucket).
+- :mod:`sharding` — multi-chip exports: per-shard variable files +
+  a manifest in the signature, loaded onto a tp/fsdp serving mesh
+  (parallel/mesh.py axes; docs/sharded_serving.md).
 - :mod:`manager` — version watcher (hot reload of new ``<N>/`` dirs;
   POSIX via the native C++ scanner, gs://-style object stores via
   :mod:`remote`'s fsspec scanner + download cache) and the native

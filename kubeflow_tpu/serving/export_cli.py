@@ -56,6 +56,13 @@ _GENERATE_CONFIG_COERCERS = {
     "seed": int,
     "deterministic": bool,
     "decode_chunk_tokens": int,
+    # Continuous-batching engine capacity knobs (inference/engine/,
+    # docs/streaming.md) — serving-side, but they ride the export's
+    # generate_config so a version dir fully describes how it serves.
+    "engine_slots": int,
+    "engine_page_size": int,
+    "engine_slice_tokens": int,
+    "engine_num_pages": int,
 }
 
 
@@ -117,6 +124,10 @@ def validate_generate_config(config: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError(
             f"decode_chunk_tokens must be >= 1; got "
             f"{out['decode_chunk_tokens']}")
+    for key in ("engine_slots", "engine_page_size",
+                "engine_slice_tokens", "engine_num_pages"):
+        if key in out and out[key] < 1:
+            raise ValueError(f"{key} must be >= 1; got {out[key]}")
     if "temperature" in out and out["temperature"] < 0.0:
         raise ValueError(
             f"temperature must be >= 0; got {out['temperature']}")
@@ -178,6 +189,7 @@ def export_from_checkpoint(
     generate_config: Optional[Dict[str, Any]] = None,
     model_kwargs: Optional[Dict[str, Any]] = None,
     seed: int = 0,
+    shard_spec: Optional[Any] = None,
 ) -> str:
     """Export one serving version; returns the version dir path.
 
@@ -313,7 +325,18 @@ def export_from_checkpoint(
     metadata = _build_metadata(
         model_name or registry_name, registry_name, entry, seq_len,
         signature_kind, generate_config, model_kwargs)
-    path = export_model(out, version, metadata, export_vars)
+    if shard_spec is not None and shard_spec.num_shards > 1:
+        # Multi-chip layout (serving/sharding.py): per-shard variable
+        # files + manifest. This is THE export form for merged-LoRA
+        # (or any) models bigger than one chip's HBM — merge_lora
+        # above already folded the adapters, so the shards carry the
+        # serving-ready weights.
+        from kubeflow_tpu.serving.sharding import export_model_sharded
+
+        path = export_model_sharded(out, version, metadata,
+                                    export_vars, shard_spec)
+    else:
+        path = export_model(out, version, metadata, export_vars)
     return str(path)
 
 
@@ -347,10 +370,21 @@ def main(argv=None) -> int:
                              '"temperature": 0.8}\'')
     parser.add_argument("--model_kwargs", default=None,
                         help="JSON kwargs for the model constructor")
+    parser.add_argument("--shards", default=None,
+                        help="sharded export for multi-chip serving: "
+                             "'tensor=T,fsdp=F' or a bare tensor "
+                             "count (docs/sharded_serving.md). "
+                             "Omitted/1 = the classic monolithic "
+                             "layout")
     args = parser.parse_args(argv)
     from kubeflow_tpu.utils.platform import sync_platform_from_env
 
     sync_platform_from_env()
+    shard_spec = None
+    if args.shards:
+        from kubeflow_tpu.serving.sharding import parse_shard_spec
+
+        shard_spec = parse_shard_spec(args.shards)
     path = export_from_checkpoint(
         registry_name=args.model,
         out=args.out,
@@ -366,6 +400,7 @@ def main(argv=None) -> int:
         generate_config=json.loads(args.generate) if args.generate else None,
         model_kwargs=(json.loads(args.model_kwargs)
                       if args.model_kwargs else None),
+        shard_spec=shard_spec,
     )
     print(path)
     return 0

@@ -130,6 +130,10 @@ _P_ROUTER_FAILOVERS = obs_metrics.Counter(
 _P_ROUTER_NO_BACKEND = obs_metrics.Counter(
     "kft_router_no_backend_total",
     "Requests that found no routable replica at all")
+_P_SPLIT_GENERATE = obs_metrics.Counter(
+    "kft_router_split_generate_total",
+    "Generate requests served by the prefill→decode KV-handoff "
+    "path, by outcome (split | fallback)", ("outcome",))
 
 
 class CircuitOpenError(Exception):
@@ -186,6 +190,37 @@ class _ClientStalledError(Exception):
     """Downstream SSE client fell too far behind the relay."""
 
 
+class _SplitHopError(Exception):
+    """The decode hop of a split stream answered non-200 before any
+    byte reached the client — abort the relay so the caller can fall
+    back to the classic path (the upstream is alive; no breaker
+    penalty)."""
+
+
+def classify_generate_phase(instances: Any,
+                            max_new_tokens: Optional[int],
+                            default_new_tokens: int = 32) -> str:
+    """Which phase dominates a :generate request's cost: ``prefill``
+    (compute-bound — long prompt, short completion) or ``decode``
+    (HBM-bound — the token loop dominates). The heuristic is the
+    arithmetic the two pools are sized by: prefill cost scales with
+    prompt tokens in ONE saturated pass, decode cost with one
+    weight-streaming step per new token — so the larger token count
+    names the bound side. Malformed instances read as decode (the
+    safer pool for unknown work: it also serves short prompts)."""
+    try:
+        prompt_tokens = max(
+            (len(row) if hasattr(row, "__len__") else 1)
+            for row in instances)
+        budget = (default_new_tokens if max_new_tokens is None
+                  else int(max_new_tokens))
+    except (TypeError, ValueError):
+        # Malformed body — classification must never 500 the proxy;
+        # the backend owns rejecting the request with a 400.
+        return "decode"
+    return "prefill" if prompt_tokens >= budget else "decode"
+
+
 def decode_b64_if_needed(value: Any) -> Any:
     """Recursively decode {"b64": ...} leaves (parity reference
     ``:110-119``, incl. idempotence on already-decoded data)."""
@@ -225,13 +260,16 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
         return self.application.settings["retry_attempts"]
 
     def pick_endpoint(self, tried: Sequence[Endpoint],
-                      model: Optional[str] = None) -> Optional[Endpoint]:
+                      model: Optional[str] = None,
+                      phase: Optional[str] = None) -> Optional[Endpoint]:
         """One routing decision: balancer policy over the eligible
-        (not-yet-tried, not-ejected, breaker-admitting) members."""
+        (not-yet-tried, not-ejected, breaker-admitting) members.
+        ``phase`` is the request's dominant serving phase — only
+        role-aware policies act on it."""
         candidates = eligible_endpoints(self.pool, exclude=tried)
         if not candidates:
             return None
-        ep = self.balancer.pick(candidates, model=model)
+        ep = self.balancer.pick(candidates, model=model, phase=phase)
         if ep is not None:
             _P_ROUTER_PICKS.labels(ep.address).inc()
         return ep
@@ -350,7 +388,8 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
         self.finish(json.dumps(payload))
 
     async def route_with_failover(self, model: Optional[str],
-                                  attempt, deadline=None) -> None:
+                                  attempt, deadline=None,
+                                  phase=None) -> None:
         """THE routing contract, shared by every proxied verb: pick a
         replica, run ``attempt(ep)`` (which raises _Handled once the
         client response is written), and on a transport-level failure
@@ -363,7 +402,7 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
         last_exc: Optional[Exception] = None
         max_extra = max(0, self.retry_attempts)
         for attempt_i in range(1 + max_extra):
-            ep = self.pick_endpoint(tried, model=model)
+            ep = self.pick_endpoint(tried, model=model, phase=phase)
             if ep is None:
                 break
             ep.inflight += 1
@@ -638,7 +677,10 @@ class InferProxyHandler(ProxyHandler):
     async def _attempt_stream(self, ep: Endpoint, name: str,
                               version: Optional[str], instances: Any,
                               body: Dict[str, Any],
-                              deadline: Optional[float]) -> None:
+                              deadline: Optional[float],
+                              upstream_body: Optional[Dict[str, Any]]
+                              = None,
+                              split_fallback: bool = False) -> None:
         """One streaming :generate attempt: relay the upstream SSE
         response CHUNK BY CHUNK (write+flush per chunk, never a
         full-body buffer) so time-to-first-token survives the router
@@ -654,12 +696,13 @@ class InferProxyHandler(ProxyHandler):
         if version:
             path += f"/versions/{version}"
         path += ":generate"
-        upstream_body: Dict[str, Any] = {
-            "instances": instances, "stream": True,
-            "signature_name": body.get("signature_name"),
-        }
-        if body.get("max_new_tokens") is not None:
-            upstream_body["max_new_tokens"] = body["max_new_tokens"]
+        if upstream_body is None:
+            upstream_body = {
+                "instances": instances, "stream": True,
+                "signature_name": body.get("signature_name"),
+            }
+            if body.get("max_new_tokens") is not None:
+                upstream_body["max_new_tokens"] = body["max_new_tokens"]
         headers = dict(self._obs_ctx.headers()) \
             if getattr(self, "_obs_ctx", None) is not None else {}
         timeout = STREAM_TIMEOUT_S
@@ -681,6 +724,16 @@ class InferProxyHandler(ProxyHandler):
                 state["ctype"] = line.split(":", 1)[1].strip()
 
         def on_chunk(chunk: bytes) -> None:
+            if (split_fallback and not state["streamed"]
+                    and (state["status"] or 200) != 200):
+                # Split hop 2 rejected the handoff (version skew, a
+                # replica mid-rollout): nothing reached the client
+                # yet, so the classic path can still serve this
+                # request — abort the relay instead of committing
+                # the error to the stream.
+                state["split_abort"] = True
+                raise _SplitHopError(
+                    f"decode hop answered {state['status']}")
             if not state["streamed"]:
                 state["streamed"] = True
                 self.set_status(state["status"] or 200)
@@ -721,6 +774,10 @@ class InferProxyHandler(ProxyHandler):
             failure = response.error if response.code == 599 else None
         except Exception as e:  # noqa: BLE001 — transport failure
             response, failure = None, e
+        if state.get("split_abort"):
+            # Our own abort, not the upstream's fault: no breaker
+            # penalty, no client write — the caller falls back.
+            raise _SplitHopError(str(failure))
         if state["client_gone"]:
             # Client hung up / stalled mid-relay: nothing to answer,
             # and the upstream stays healthy (no breaker hit).
@@ -764,6 +821,147 @@ class InferProxyHandler(ProxyHandler):
                 f"model server timed out after {timeout:.1f}s")
         raise BackendDownError(str(failure))
 
+    def _role_pools_ready(self) -> bool:
+        """True when the fleet actually has BOTH specialized pools
+        routable — the precondition for the two-hop handoff path."""
+        roles = {ep.effective_role() for ep in self.pool.endpoints()
+                 if ep.routable()}
+        return "prefill" in roles and "decode" in roles
+
+    async def _split_generate(self, name: str, version: Optional[str],
+                              instances: Any, body: Dict[str, Any],
+                              deadline: Optional[float],
+                              wants_stream: bool) -> bool:
+        """The role-split KV-handoff path: hop 1 runs the prompt
+        prefill on a prefill-role replica (``prefill_only``), hop 2
+        ships the returned handoff blobs to a decode-role replica
+        whose engine adopts the pages and decodes (unary or SSE).
+        Returns True once the client response is written; False means
+        NOTHING was written and the caller must run the classic
+        single-replica path — specialization never costs
+        availability. Models that don't speak the handoff contract
+        (no engine, old build) are remembered so later requests skip
+        the doomed hop."""
+        unsupported = self.application.settings.setdefault(
+            "_split_unsupported", set())
+        if name in unsupported or not self._role_pools_ready():
+            return False
+        path = f"/v1/models/{name}"
+        if version:
+            path += f"/versions/{version}"
+        path += ":generate"
+
+        def budget_headers() -> Dict[str, str]:
+            headers = {}
+            remaining = overload.remaining_s(deadline)
+            if remaining is not None:
+                headers[overload.DEADLINE_HEADER] = str(
+                    max(1, int(remaining * 1000)))
+            return headers
+
+        hop1: Dict[str, Any] = {
+            "instances": instances, "prefill_only": True,
+            "signature_name": body.get("signature_name"),
+        }
+        if body.get("max_new_tokens") is not None:
+            hop1["max_new_tokens"] = body["max_new_tokens"]
+        prefill_ep = self.pick_endpoint([], model=name, phase="prefill")
+        if prefill_ep is None:
+            return False
+        prefill_ep.inflight += 1
+        try:
+            response = await self._rest_fetch(
+                prefill_ep, path, deadline=deadline, method="POST",
+                headers=budget_headers(), body=json.dumps(hop1))
+        except (CircuitOpenError, BackendTimeoutError,
+                BackendDownError):
+            return False
+        finally:
+            prefill_ep.inflight -= 1
+        try:
+            payload = json.loads(response.body or b"{}")
+        except json.JSONDecodeError:
+            return False
+        handoffs = payload.get("handoffs")
+        if response.code != 200 or not handoffs:
+            if (response.code == 400
+                    and payload.get("code") == "UNIMPLEMENTED") or (
+                    response.code == 200 and not handoffs):
+                # The model/build doesn't speak prefill_only (the
+                # structured code, or an old server that answered the
+                # request as a plain generate): stop burning a hop
+                # per request. A PLAIN 400 is this request's own
+                # input problem — the classic path will surface it,
+                # and the next request still gets the split.
+                unsupported.add(name)
+            _P_SPLIT_GENERATE.labels("fallback").inc()
+            return False
+        # Pin hop 2 to the version hop 1 actually resolved: during a
+        # rolling update the two pools may serve different versions,
+        # and an unpinned decode hop would reject the handoff
+        # (version mismatch) instead of resuming it.
+        served = payload.get("model_spec", {}).get("version")
+        if not version and served is not None:
+            path = f"/v1/models/{name}/versions/{served}:generate"
+        hop2: Dict[str, Any] = {
+            "handoffs": handoffs,
+            "signature_name": body.get("signature_name"),
+        }
+        decode_ep = self.pick_endpoint([prefill_ep], model=name,
+                                       phase="decode")
+        if decode_ep is None:
+            _P_SPLIT_GENERATE.labels("fallback").inc()
+            return False
+        if TRACER.enabled:
+            TRACER.record(
+                "router_kv_handoff", "router", time.monotonic(), 0.0,
+                {"model": name, "prefill": prefill_ep.address,
+                 "decode": decode_ep.address, "rows": len(handoffs)})
+        if wants_stream:
+            hop2["stream"] = True
+            decode_ep.inflight += 1
+            try:
+                await self._attempt_stream(
+                    decode_ep, name,
+                    version or (str(served) if served is not None
+                                else None),
+                    None, body, deadline, upstream_body=hop2,
+                    split_fallback=True)
+            except _Handled:
+                _P_SPLIT_GENERATE.labels("split").inc()
+                return True
+            except (CircuitOpenError, BackendTimeoutError,
+                    BackendDownError, _SplitHopError):
+                # The prefill work is lost, but nothing reached the
+                # client: the classic path can still serve it.
+                _P_SPLIT_GENERATE.labels("fallback").inc()
+                return False
+            finally:
+                decode_ep.inflight -= 1
+            return True
+        decode_ep.inflight += 1
+        try:
+            response = await self._rest_fetch(
+                decode_ep, path, deadline=deadline, method="POST",
+                headers=budget_headers(), body=json.dumps(hop2))
+        except (CircuitOpenError, BackendTimeoutError,
+                BackendDownError):
+            _P_SPLIT_GENERATE.labels("fallback").inc()
+            return False
+        finally:
+            decode_ep.inflight -= 1
+        try:
+            payload = json.loads(response.body or b"{}")
+        except json.JSONDecodeError:
+            return False
+        if response.code != 200:
+            _P_SPLIT_GENERATE.labels("fallback").inc()
+            return False
+        _P_SPLIT_GENERATE.labels("split").inc()
+        self.write_json(
+            {"predictions": payload.get("predictions", [])})
+        return True
+
     async def _infer(self, name: str, version: Optional[str],
                      verb: str) -> None:
         self._obs_model = name
@@ -793,6 +991,19 @@ class InferProxyHandler(ProxyHandler):
         wants_stream = bool(body.get("stream")) or (
             "text/event-stream"
             in self.request.headers.get("Accept", ""))
+        phase = None
+        if verb == "generate":
+            # Role dimension (docs/scaling.md "Role-split routing"):
+            # token streaming is decode-bound by construction; unary
+            # generates route by their dominant phase.
+            phase = ("decode" if wants_stream else
+                     classify_generate_phase(
+                         instances, body.get("max_new_tokens")))
+            if (self.application.settings.get("split_generate")
+                    and await self._split_generate(
+                        name, version, instances, body, deadline,
+                        wants_stream)):
+                return
         if wants_stream and verb == "generate":
             # Streaming rides the REST upstream directly (prompts are
             # dense int rows — no signature-map conversion needed);
@@ -802,7 +1013,7 @@ class InferProxyHandler(ProxyHandler):
                 lambda ep: self._attempt_stream(ep, name, version,
                                                 instances, body,
                                                 deadline),
-                deadline=deadline)
+                deadline=deadline, phase=phase)
             return
         # Infer verbs are idempotent (pure functions of their
         # inputs), so the shared failover loop may retry a transport
@@ -811,7 +1022,7 @@ class InferProxyHandler(ProxyHandler):
             name,
             lambda ep: self._attempt(ep, name, version, verb,
                                      instances, body, deadline),
-            deadline=deadline)
+            deadline=deadline, phase=phase)
 
     async def post(self, name: str, version: Optional[str], verb: str):
         await self._infer(name, version, verb)
@@ -927,7 +1138,9 @@ def make_app(rpc_address: Union[str, Sequence[str], None] = None,
              endpoints_source: Optional[Any] = None,
              balancer: Union[str, Balancer] = "least_saturation",
              retry_attempts: int = 2,
-             probe_interval_s: float = 1.0) -> tornado.web.Application:
+             probe_interval_s: float = 1.0,
+             split_generate: Optional[bool] = None
+             ) -> tornado.web.Application:
     """Build the pooled proxy app.
 
     ``rpc_address`` accepts the classic single address, a
@@ -979,6 +1192,11 @@ def make_app(rpc_address: Union[str, Sequence[str], None] = None,
                          "rpc_address, pool, or an endpoints_source)")
     balancer_obj = (balancer if isinstance(balancer, Balancer)
                     else make_balancer(balancer))
+    if split_generate is None:
+        # Auto: the two-hop KV-handoff path only makes sense when the
+        # policy routes by role at all (and it additionally gates
+        # itself per request on both pools being routable).
+        split_generate = getattr(balancer_obj, "name", "") == "role"
     prober = HealthProber(pool, interval_s=probe_interval_s,
                           source=endpoints_source)
     # Live breaker state on /metrics: per WIRE, the worst state across
@@ -1007,6 +1225,7 @@ def make_app(rpc_address: Union[str, Sequence[str], None] = None,
         (r"/tracez", ChromeTraceHandler),
         (r"/model/([^/:]+)", MetadataProxyHandler),
     ], pool=pool, balancer_obj=balancer_obj, prober=prober,
+       split_generate=split_generate,
        rpc_timeout=rpc_timeout, retry_attempts=retry_attempts,
        log_function=access_log_function("http-proxy"),
        # Single-upstream back-compat aliases (pre-pool callers and
@@ -1095,8 +1314,15 @@ def main(argv=None) -> int:
                              "--rpc_address when present")
     parser.add_argument("--balancer", default="least_saturation",
                         choices=("round_robin", "least_saturation",
-                                 "affinity"),
-                        help="routing policy over the replica pool")
+                                 "affinity", "role"),
+                        help="routing policy over the replica pool "
+                             "(role = prefill/decode pool splitting, "
+                             "docs/scaling.md)")
+    parser.add_argument("--role_split", default="auto",
+                        choices=("auto", "on", "off"),
+                        help="two-hop prefill→decode KV-handoff for "
+                             ":generate (auto = with --balancer role "
+                             "when both pools are routable)")
     parser.add_argument("--retries", type=int, default=2,
                         help="max additional replicas to try after a "
                              "transport failure (budget-aware)")
@@ -1126,23 +1352,30 @@ def main(argv=None) -> int:
         # ONE read: specs() re-reads the (hot-reloaded) file, and two
         # reads racing the autoscaler's rewrite could zip together
         # REST addresses from one membership version with gRPC
-        # addresses from the next.
-        specs = source.specs()
-        addresses: List[str] = [a for a, _ in specs]
-        grpc_addresses: List[Optional[str]] = [g for _, g in specs]
+        # addresses from the next. Entries may carry roles (schema
+        # v2) — sync() keeps them on the members.
+        from kubeflow_tpu.scaling.endpoints import normalize_spec
+
+        pool = EndpointPool(
+            breaker_failures=args.breaker_failures,
+            breaker_reset_s=args.breaker_reset)
+        for address, grpc, role in map(normalize_spec, source.specs()):
+            pool.add(address, grpc, role)
     else:
         addresses = [
             _normalize_address(a.strip(), args.rpc_port)
             for a in args.rpc_address.split(",") if a.strip()]
         grpc_addresses = _grpc_addresses(addresses, args.grpc_port)
-    pool = EndpointPool.from_addresses(
-        addresses, grpc_addresses,
-        breaker_failures=args.breaker_failures,
-        breaker_reset_s=args.breaker_reset)
+        pool = EndpointPool.from_addresses(
+            addresses, grpc_addresses,
+            breaker_failures=args.breaker_failures,
+            breaker_reset_s=args.breaker_reset)
     app = make_app(rpc_timeout=args.rpc_timeout, pool=pool,
                    endpoints_source=source, balancer=args.balancer,
                    retry_attempts=args.retries,
-                   probe_interval_s=args.probe_interval or 1.0)
+                   probe_interval_s=args.probe_interval or 1.0,
+                   split_generate={"auto": None, "on": True,
+                                   "off": False}[args.role_split])
     app.listen(args.port)
     if args.probe_interval:
         app.settings["prober"].start()

@@ -669,6 +669,70 @@ class ServedModel:
             raise
         return loaded, streams
 
+    def prefill_handoff(self, inputs: Dict[str, np.ndarray],
+                        signature_name: Optional[str],
+                        version: Optional[int], *,
+                        deadline: Optional[float] = None,
+                        max_new_tokens: Optional[int] = None):
+        """Prefill-only execution (role-split routing's first hop):
+        run each request row's prompt prefill and return ``(loaded,
+        [PrefillHandoff per row])`` WITHOUT taking a decode slot —
+        the caller ships the handoffs to a decode-role replica whose
+        engine adopts the pages (:meth:`submit_handoff`). Engine
+        (continuous-batching) models only: the page-adopt seam IS the
+        handoff mechanism."""
+        if not self.continuous_batching:
+            raise ValueError(
+                f"model {self.name!r} is not served with continuous "
+                f"batching; KV handoff rides the decode engine's "
+                f"page-adopt seam (--continuous_batching)")
+        loaded = self.get(version)
+        sig = loaded.signature(signature_name)
+        if sig.method != "generate":
+            raise ValueError(
+                f"prefill handoff requires a generate signature; "
+                f"got {sig.method!r}")
+        x, n = loaded._prepare(sig, inputs, variable_length=True)
+        if n == 0:
+            raise ValueError("empty batch")
+        if deadline is not None and deadline <= time.monotonic():
+            raise DeadlineExceededError(
+                "deadline expired before prefill")
+        engine = loaded.ensure_engine(
+            self.name, queue_capacity=self.queue_capacity)
+        rngs = loaded.request_rngs(n)
+        return loaded, [
+            engine.run_prefill(x[i], rng=rngs[i],
+                               max_new_tokens=max_new_tokens)
+            for i in range(n)]
+
+    def submit_handoff(self, handoffs, version: Optional[int], *,
+                       deadline: Optional[float] = None,
+                       obs_ctx=None):
+        """Resume decodes whose prefills ran elsewhere: adopt each
+        handoff's pages into this replica's engine. Returns
+        ``(loaded, [GenerateStream per handoff])`` — the same handle
+        shape as :meth:`submit_stream`, so both the unary combiner
+        and the SSE/gRPC streaming transports drain it unchanged."""
+        if not self.continuous_batching:
+            raise ValueError(
+                f"model {self.name!r} is not served with continuous "
+                f"batching; KV handoff rides the decode engine's "
+                f"page-adopt seam (--continuous_batching)")
+        loaded = self.get(version)
+        engine = loaded.ensure_engine(
+            self.name, queue_capacity=self.queue_capacity)
+        streams = []
+        try:
+            for h in handoffs:
+                streams.append(engine.submit(
+                    handoff=h, deadline=deadline, obs_ctx=obs_ctx))
+        except BaseException:
+            for s in streams:  # free the slots already taken
+                s.cancel()
+            raise
+        return loaded, streams
+
     def _submit_engine(self, loaded, inputs: Dict[str, np.ndarray],
                        signature_name: Optional[str], *,
                        deadline: Optional[float],

@@ -64,6 +64,11 @@ class LoadedModel:
     variables: Any
     max_batch: int = 64
     top_k: int = 5
+    #: The tp/fsdp serving Mesh the params were materialized onto
+    #: (serving/sharding.py), or None for the classic single-device
+    #: placement. Execution needs no special casing: jit propagates
+    #: the params' NamedShardings and GSPMD inserts the collectives.
+    mesh: Any = None
 
     def __post_init__(self):
         import threading
@@ -227,13 +232,23 @@ class LoadedModel:
                 queue_capacity=queue_capacity)
             self._engine = DecodeEngine(
                 self._module, self.variables["params"], config,
-                name=name or self.metadata.model_name)
+                name=name or self.metadata.model_name,
+                mesh=self.mesh)
             return self._engine
 
     @property
     def engine(self):
         """The built engine or None (never builds)."""
         return self._engine
+
+    def shard_topology(self) -> Dict[str, Any]:
+        """Healthz-facing layout summary ({"num_shards": 1} for
+        monolithic loads; mesh axes for sharded ones)."""
+        from kubeflow_tpu.serving.sharding import shard_topology
+
+        topo = shard_topology(self.metadata)
+        topo["on_mesh"] = self.mesh is not None
+        return topo
 
     def close(self) -> None:
         """Release background resources (the decode engine's thread
@@ -381,7 +396,19 @@ class LoadedModel:
 
 
 def load_version(version_dir: str, *, max_batch: int = 64,
-                 top_k: int = 5, warmup: bool = False) -> LoadedModel:
+                 top_k: int = 5, warmup: bool = False,
+                 mesh: Any = None) -> LoadedModel:
+    """Load one version dir.
+
+    Monolithic exports load exactly as before. Exports carrying a
+    shard manifest (``metadata.sharding``, serving/sharding.py) take
+    the sharded path: with ``mesh`` given (or enough local devices to
+    build the manifest's tp/fsdp mesh automatically) the params
+    materialize directly onto the serving mesh, each device receiving
+    only its shard; otherwise they reassemble on host — a sharded
+    export stays servable on one device that fits it (the n=1
+    fallback the round-trip tests pin against the monolithic path).
+    """
     metadata = read_metadata(version_dir)
     entry = get_model(metadata.registry_name)
     module = entry.make(**metadata.model_kwargs)
@@ -393,14 +420,37 @@ def load_version(version_dir: str, *, max_batch: int = 64,
     template = jax.jit(
         functools.partial(module.init, train=False))(
             jax.random.PRNGKey(0), sample)
-    variables = read_variables(version_dir, template)
-    variables = jax.device_put(variables)
+    sharded = bool(metadata.sharding
+                   and int(metadata.sharding.get("num_shards", 1)) > 1)
+    if sharded:
+        from kubeflow_tpu.serving.sharding import (
+            ShardSpec,
+            load_sharded_variables,
+            read_sharded_variables,
+            serving_mesh,
+        )
+
+        shard_spec = ShardSpec.from_json(metadata.sharding["mesh"])
+        if mesh is None and len(jax.devices()) >= shard_spec.num_shards:
+            mesh = serving_mesh(shard_spec)
+        file_template = {k: v for k, v in template.items()
+                         if k != "cache"}
+        if mesh is not None:
+            variables = load_sharded_variables(
+                version_dir, file_template, metadata, mesh)
+        else:
+            variables = jax.device_put(read_sharded_variables(
+                version_dir, file_template, metadata))
+    else:
+        variables = read_variables(version_dir, template)
+        variables = jax.device_put(variables)
+        mesh = None
     import os
 
     version = int(os.path.basename(os.path.normpath(version_dir)))
     loaded = LoadedModel(metadata=metadata, version=version,
                          variables=variables, max_batch=max_batch,
-                         top_k=top_k)
+                         top_k=top_k, mesh=mesh)
     if warmup:
         loaded.warmup()
     return loaded

@@ -117,14 +117,29 @@ class HealthHandler(BaseHandler):
     ``saturation``."""
 
     def get(self):
+        role = self.application.settings.get("role") or "any"
         if not self.manager.ready():
             return self.write_json(
-                {"status": "loading", "saturation": {}, "breakers": {}},
-                503)
-        saturation = {name: model.batch_stats()
-                      for name, model in self.manager.models.items()}
+                {"status": "loading", "saturation": {}, "breakers": {},
+                 "role": role}, 503)
+        saturation = {}
+        for name, model in self.manager.models.items():
+            stats = model.batch_stats()
+            # Shard topology rides the saturation snapshot (the
+            # router/autoscaler/dashboard read it per replica);
+            # malformed manifests degrade inside shard_topology, a
+            # stub LoadedModel without the method degrades here —
+            # /healthz never 500s over a layout summary.
+            try:
+                default = model.get_resident()
+                if default is not None:
+                    stats["sharding"] = default.shard_topology()
+            except Exception:  # noqa: BLE001 — summary is best-effort
+                pass
+            saturation[name] = stats
         self.write_json({"status": "ok", "saturation": saturation,
-                         "breakers": {}, "models": saturation})
+                         "breakers": {}, "models": saturation,
+                         "role": role})
 
 
 class LiveHandler(BaseHandler):
@@ -206,7 +221,32 @@ class InferHandler(BaseHandler):
             model = self.manager.get_model(name)
             body = json.loads(self.request.body or b"{}")
             instances = body.get("instances")
-            if instances is None:
+            handoffs_b64 = body.get("handoffs")
+            prefill_only = bool(body.get("prefill_only"))
+            if (prefill_only or handoffs_b64 is not None) \
+                    and verb != "generate":
+                return self.write_json(
+                    {"error": f"KV handoff applies to :generate "
+                              f"only, not :{verb}"}, 400)
+            if (prefill_only or handoffs_b64 is not None) \
+                    and not getattr(model, "continuous_batching",
+                                    False):
+                # Structured code: the proxy must distinguish "this
+                # model/build does not speak the handoff contract"
+                # (stop trying — remember it) from a per-request 400
+                # (fall back THIS request only). A plain 400 here
+                # would poison split routing for the model forever
+                # on one client's bad input.
+                return self.write_json(
+                    {"error": f"model {name!r} is not served with "
+                              f"continuous batching; KV handoff "
+                              f"rides the decode engine",
+                     "code": "UNIMPLEMENTED"}, 400)
+            if prefill_only and handoffs_b64 is not None:
+                return self.write_json(
+                    {"error": "prefill_only and handoffs are "
+                              "mutually exclusive"}, 400)
+            if instances is None and handoffs_b64 is None:
                 return self.write_json(
                     {"error": "request body needs 'instances'"}, 400)
             wants_stream = bool(body.get("stream")) or (
@@ -216,6 +256,10 @@ class InferHandler(BaseHandler):
                 return self.write_json(
                     {"error": f"streaming applies to :generate only, "
                               f"not :{verb}"}, 400)
+            if wants_stream and prefill_only:
+                return self.write_json(
+                    {"error": "prefill_only responses are unary (the "
+                              "decode replica streams)"}, 400)
             deadline = overload.request_deadline(self.request.headers,
                                                  body)
             want = int(version) if version else None
@@ -243,9 +287,17 @@ class InferHandler(BaseHandler):
                         "model version load did not finish within the "
                         "request budget") from None
             sig_name = body.get("signature_name")
+            if handoffs_b64 is not None:
+                return await self._resume_handoffs(
+                    name, model, loaded, handoffs_b64, body, deadline,
+                    wants_stream, want)
             sig = loaded.signature(sig_name)
             input_name = next(iter(sig.inputs))
             batch = _instances_to_batch(instances, input_name)
+            if prefill_only:
+                return await self._prefill_only(
+                    name, model, loaded, {input_name: batch},
+                    sig_name, body, deadline, want)
             if wants_stream:
                 return await self._stream_generate(
                     name, model, loaded, {input_name: batch},
@@ -294,8 +346,88 @@ class InferHandler(BaseHandler):
             # treating it as a bad request.
             self.write_json({"error": str(e)}, 503)
 
+    async def _prefill_only(self, name, model, loaded, inputs,
+                            sig_name, body, deadline, version=None):
+        """The prefill-role half of KV handoff: run the prompt
+        prefill(s) and answer with opaque handoff blobs the caller
+        relays to a decode-role replica. The device work runs on a
+        pool thread (prefill is a real XLA dispatch), bounded by the
+        request budget like every other wait."""
+        import asyncio
+        import base64
+
+        from kubeflow_tpu.serving import wire
+
+        max_new = body.get("max_new_tokens")
+        if max_new is not None:
+            max_new = int(max_new)
+        loop = tornado.ioloop.IOLoop.current()
+        work = loop.run_in_executor(
+            None, lambda: model.prefill_handoff(
+                inputs, sig_name, version, deadline=deadline,
+                max_new_tokens=max_new))
+        try:
+            loaded, handoffs = await asyncio.wait_for(
+                asyncio.shield(work),
+                overload.clamp_wait_s(deadline, DEFAULT_INFER_WAIT_S))
+        except asyncio.TimeoutError:
+            raise overload.DeadlineExceededError(
+                "prefill did not finish within the request "
+                "budget") from None
+        self.write_json({
+            "model_spec": {"name": name,
+                           "version": str(loaded.version)},
+            "handoffs": [
+                base64.b64encode(wire.encode_kv_handoff(
+                    name, loaded.version, h)).decode("ascii")
+                for h in handoffs],
+        })
+
+    async def _resume_handoffs(self, name, model, loaded,
+                               handoffs_b64, body, deadline,
+                               wants_stream, version=None):
+        """The decode-role half: adopt relayed prefill caches into
+        this replica's engine and decode (unary or streamed). A blob
+        from another model/version fails 400 — pages from a different
+        export would be read as garbage K/V."""
+        import base64
+
+        from kubeflow_tpu.serving import wire
+
+        if not isinstance(handoffs_b64, list) or not handoffs_b64:
+            return self.write_json(
+                {"error": "'handoffs' must be a non-empty list of "
+                          "base64 blobs"}, 400)
+        try:
+            handoffs = [
+                wire.decode_kv_handoff(
+                    base64.b64decode(blob), model=name,
+                    version=loaded.version)
+                for blob in handoffs_b64]
+        except (ValueError, TypeError) as e:
+            return self.write_json(
+                {"error": f"bad KV handoff: {e}"}, 400)
+        loaded, streams = model.submit_handoff(
+            handoffs, version, deadline=deadline,
+            obs_ctx=self._obs_ctx)
+        if wants_stream:
+            return await self._stream_generate(
+                name, model, loaded, None, None, None, body,
+                deadline, streams=streams)
+        from kubeflow_tpu.serving.manager import _combine_streams
+
+        future = concurrent.futures.Future()
+        _combine_streams(streams, future)
+        result = await _await_future(
+            future, overload.clamp_wait_s(deadline,
+                                          DEFAULT_INFER_WAIT_S))
+        self.write_json({"model_spec": {"name": name,
+                                        "version": str(loaded.version)},
+                         "predictions": _batch_to_instances(result)})
+
     async def _stream_generate(self, name, model, loaded, inputs,
-                               sig_name, version, body, deadline):
+                               sig_name, version, body, deadline,
+                               streams=None):
         """SSE token streaming over the continuous-batching engine.
 
         Wire (serving/wire.py SSE codec; docs/streaming.md):
@@ -310,12 +442,13 @@ class InferHandler(BaseHandler):
 
         from kubeflow_tpu.serving import wire
 
-        max_new = body.get("max_new_tokens")
-        if max_new is not None:
-            max_new = int(max_new)
-        _, streams = model.submit_stream(
-            inputs, sig_name, version, deadline=deadline,
-            obs_ctx=self._obs_ctx, max_new_tokens=max_new)
+        if streams is None:
+            max_new = body.get("max_new_tokens")
+            if max_new is not None:
+                max_new = int(max_new)
+            _, streams = model.submit_stream(
+                inputs, sig_name, version, deadline=deadline,
+                obs_ctx=self._obs_ctx, max_new_tokens=max_new)
         self._live_streams = streams
         self.set_header("Content-Type", wire.SSE_CONTENT_TYPE)
         self.set_header("Cache-Control", "no-cache")
@@ -524,7 +657,23 @@ class GrpcWebPredictHandler(BaseHandler):
             status, message.replace("\n", " ")))
 
 
-def make_app(manager: ModelManager) -> tornado.web.Application:
+def _roles():
+    """Single-sourced role vocabulary (+ degrade rule) — the endpoint
+    registry owns it; the server merely speaks it."""
+    from kubeflow_tpu.scaling.endpoints import ROLES, normalize_role
+
+    return ROLES, normalize_role
+
+
+def make_app(manager: ModelManager,
+             role: str = "any") -> tornado.web.Application:
+    roles, normalize_role = _roles()
+    if role not in roles:
+        # Tolerate-but-normalize: a mid-rollout flag typo must not
+        # take the replica down; it just serves as role-less.
+        logger.warning("unknown serving role %r; serving as %r",
+                       role, normalize_role(role))
+        role = normalize_role(role)
     return tornado.web.Application([
         (r"/healthz", HealthHandler),
         (r"/livez", LiveHandler),
@@ -537,7 +686,7 @@ def make_app(manager: ModelManager) -> tornado.web.Application:
         (r"/tensorflow\.serving\.PredictionService/"
          r"(Predict|Classify|GetModelMetadata)",
          GrpcWebPredictHandler),
-    ], manager=manager,
+    ], manager=manager, role=role,
        log_function=access_log_function("model-server"))
 
 
@@ -592,6 +741,14 @@ def main(argv=None) -> int:
                              "retire mid-decode, and ?stream/SSE + "
                              "gRPC GenerateStream token streaming "
                              "become available (docs/streaming.md)")
+    parser.add_argument("--role", default="any",
+                        choices=_roles()[0],
+                        help="replica role for prefill/decode pool "
+                             "splitting: prefill replicas serve the "
+                             "compute-bound prompt pass and hand the "
+                             "KV cache off; decode replicas adopt it "
+                             "and stream tokens; any does both "
+                             "(docs/scaling.md)")
     parser.add_argument("--version_policy", default="latest",
                         help="latest | all | specific:<v>[,<v>...] — "
                              "which version dirs to serve (TF-Serving "
@@ -654,11 +811,11 @@ def main(argv=None) -> int:
 
     grpc_srv, _ = make_server(manager, args.port)
     grpc_srv.start()
-    app = make_app(manager)
+    app = make_app(manager, role=args.role)
     app.listen(args.rest_port)
-    logger.info("model server: gRPC on :%d, REST on :%d (models=%s)",
-                args.port, args.rest_port,
-                [m["name"] for m in models])
+    logger.info("model server: gRPC on :%d, REST on :%d (models=%s, "
+                "role=%s)", args.port, args.rest_port,
+                [m["name"] for m in models], args.role)
     manager.start()
 
     # k8s sends SIGTERM then waits terminationGracePeriodSeconds:

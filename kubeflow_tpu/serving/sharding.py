@@ -1,0 +1,521 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Sharded serving checkpoints + the tp/fsdp serving mesh.
+
+The monolithic export (serving/export.py) writes ONE params.msgpack;
+``merge_lora`` therefore produces a serving model no single chip with
+less HBM than the whole parameter set can host — the wall ROADMAP #3
+names. This module is the multi-chip half of the export/load contract:
+
+- **Export**: :func:`export_model_sharded` splits the variable pytree
+  into N per-shard files (``params.shard-00000-of-0000N.msgpack``)
+  along the SAME logical-axis rule table training uses
+  (parallel/tensor_parallel.py: ``mlp``/``heads``/``vocab`` → tensor,
+  ``embed`` → fsdp), and records a shard manifest in
+  ``ModelMetadata.sharding`` (per-leaf split dim + mesh axis). Leaves
+  with no shardable annotated dim replicate — they are stored once,
+  in shard 0, never duplicated N times.
+- **Load**: :func:`load_sharded_variables` materializes the params
+  onto a tp/fsdp *serving mesh* (:func:`serving_mesh`, reusing
+  parallel/mesh.build_mesh — ``tensor`` innermost so TP collectives
+  ride the fastest ICI links) via
+  ``jax.make_array_from_single_device_arrays``: each device receives
+  only ITS slice, so no host or device ever holds the full tensor —
+  the property that lets a 2×16 GB topology serve a >16 GB model.
+  :func:`read_sharded_variables` is the n=1 fallback (reassemble on
+  host; a sharded export stays servable on one chip that fits it).
+- **Dryrun gate**: like training's MULTICHIP gate, the serving mesh
+  is CPU-dryrunnable (``scripts/dryrun_serving_mesh.py`` re-execs a
+  child with ``--xla_force_host_platform_device_count=n``): n=2
+  proves placement and that the served token outputs are bitwise
+  equal to the single-chip path before any TPU is involved; on-chip
+  validation runs the same entry with ``KFT_DRYRUN_NATIVE=1``.
+
+Wire format notes: shard files are flax-msgpack dicts keyed by
+flattened ``"/"``-joined paths (``params/layer_0/q_proj/kernel``),
+values exact byte-preserving arrays (bf16 included) — concatenating a
+leaf's shard slices along its recorded dim reproduces the monolithic
+bytes bit-for-bit (the round-trip equality tests pin this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import tempfile
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from kubeflow_tpu.serving.signature import ModelMetadata
+
+__all__ = [
+    "ShardSpec",
+    "build_shard_plan",
+    "export_model_sharded",
+    "load_sharded_variables",
+    "read_sharded_variables",
+    "serving_mesh",
+    "shard_topology",
+]
+
+SHARD_FILE_FMT = "params.shard-{i:05d}-of-{n:05d}.msgpack"
+MANIFEST_FORMAT = 1
+
+#: Serving meshes use exactly these two axes: ``tensor`` (megatron
+#: tp — mlp/heads/vocab dims) and ``fsdp`` (embed/storage sharding).
+#: dp/seq/pipeline/expert are training-only concerns; a serving
+#: replica IS the data-parallel unit, the r10 fleet its dp axis.
+SERVING_AXES = ("fsdp", "tensor")
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardSpec:
+    """Serving-mesh sizes. ``num_shards = tensor × fsdp`` — one shard
+    file per mesh position, so a loading device reads exactly one
+    file's worth of bytes."""
+
+    tensor: int = 1
+    fsdp: int = 1
+
+    def __post_init__(self):
+        if self.tensor < 1 or self.fsdp < 1:
+            raise ValueError(
+                f"shard axes must be >= 1, got tensor={self.tensor} "
+                f"fsdp={self.fsdp}")
+
+    @property
+    def num_shards(self) -> int:
+        return self.tensor * self.fsdp
+
+    def to_json(self) -> Dict[str, int]:
+        return {"tensor": self.tensor, "fsdp": self.fsdp}
+
+    @staticmethod
+    def from_json(obj: Dict[str, Any]) -> "ShardSpec":
+        return ShardSpec(tensor=int(obj.get("tensor", 1)),
+                         fsdp=int(obj.get("fsdp", 1)))
+
+
+def serving_mesh(spec: ShardSpec,
+                 devices: Optional[Sequence[Any]] = None):
+    """Build the serving Mesh (parallel/mesh.py axis order — tensor
+    innermost so TP all-reduces ride the closest ICI neighbors)."""
+    from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < spec.num_shards:
+        raise ValueError(
+            f"serving mesh {spec.to_json()} needs {spec.num_shards} "
+            f"devices, have {len(devices)}")
+    return build_mesh(MeshSpec(tensor=spec.tensor, fsdp=spec.fsdp),
+                      devices[:spec.num_shards])
+
+
+def _flatten(tree: Any) -> Dict[str, np.ndarray]:
+    """Unboxed flat view: ``"/"``-joined path → host array. Flax
+    ``Partitioned`` boxes (and any AxisMetadata) unwrap to their
+    values — the shard files carry plain tensors; the partitioning
+    story lives in the manifest."""
+    import flax.linen as nn
+    from flax import serialization
+
+    state = serialization.to_state_dict(nn.meta.unbox(tree))
+    flat: Dict[str, np.ndarray] = {}
+
+    def walk(prefix: str, node: Any) -> None:
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}/{k}" if prefix else str(k), v)
+        else:
+            flat[prefix] = np.asarray(node)
+
+    walk("", state)
+    return flat
+
+
+def _unflatten(flat: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, value in flat.items():
+        node = out
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return out
+
+
+def _logical_axes_flat(variables: Any) -> Dict[str, Tuple[Optional[str],
+                                                          ...]]:
+    """Flat key → logical axis names (from ``nn.get_partition_spec``
+    on the boxed tree); keys without partitioning metadata are
+    absent."""
+    import flax.linen as nn
+    from jax.sharding import PartitionSpec
+
+    logical = nn.get_partition_spec(variables)
+    flat: Dict[str, Tuple[Optional[str], ...]] = {}
+
+    def walk(prefix: str, node: Any) -> None:
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}/{k}" if prefix else str(k), v)
+        elif isinstance(node, PartitionSpec) and len(node) > 0:
+            flat[prefix] = tuple(node)
+
+    walk("", logical)
+    return flat
+
+
+def build_shard_plan(variables: Any, spec: ShardSpec,
+                     *, min_shard_size: int = 1024
+                     ) -> Dict[str, Dict[str, Any]]:
+    """Decide, per leaf, which dim splits onto which serving axis.
+
+    The decision rides the model's OWN logical-axis annotations (the
+    same ``nn.with_partitioning`` names training shards by): the
+    first dim whose logical name maps to ``tensor`` under the
+    tensor_parallel rule table splits over tensor; else the first
+    ``fsdp``-mapped dim over fsdp; an axis of size 1 never claims a
+    dim. Unannotated or indivisible leaves replicate (absent from the
+    plan). ``min_shard_size`` keeps tiny leaves (norms, scales) whole
+    — splitting a 64-float scale saves nothing and costs a gather.
+    """
+    from kubeflow_tpu.parallel.tensor_parallel import DEFAULT_RULES
+
+    def axis_for(name: Optional[str]) -> Optional[str]:
+        mapped = DEFAULT_RULES.get(name) if name else None
+        if isinstance(mapped, tuple):
+            mapped = next((a for a in mapped if a in SERVING_AXES), None)
+        return mapped if mapped in SERVING_AXES else None
+
+    flat = _flatten(variables)
+    axes = _logical_axes_flat(variables)
+    plan: Dict[str, Dict[str, Any]] = {}
+    for key, value in flat.items():
+        names = axes.get(key)
+        if names is None or value.size < min_shard_size:
+            continue
+        best: Optional[Tuple[int, str, int]] = None
+        for dim, name in enumerate(names):
+            mesh_axis = axis_for(name)
+            if mesh_axis is None:
+                continue
+            parts = getattr(spec, mesh_axis)
+            if parts <= 1 or dim >= value.ndim \
+                    or value.shape[dim] % parts:
+                continue
+            rank = 0 if mesh_axis == "tensor" else 1  # tp first
+            if best is None or rank < best[0]:
+                best = (rank, mesh_axis, dim)
+        if best is not None:
+            _, mesh_axis, dim = best
+            plan[key] = {"dim": dim, "axis": mesh_axis}
+    return plan
+
+
+def _axis_index(spec: ShardSpec, shard: int, axis: str) -> int:
+    """Which slice of ``axis`` shard file ``shard`` holds. Shard ids
+    enumerate mesh positions with tensor fastest-varying (matching
+    the mesh's device order: fsdp outer, tensor inner)."""
+    if axis == "tensor":
+        return shard % spec.tensor
+    return shard // spec.tensor
+
+
+def export_model_sharded(
+    base_path: str,
+    version: int,
+    metadata: ModelMetadata,
+    variables: Dict[str, Any],
+    spec: ShardSpec,
+    *,
+    plan: Optional[Dict[str, Dict[str, Any]]] = None,
+) -> Path:
+    """Write one version dir in the sharded layout (atomic: temp dir
+    then rename, same watcher contract as the monolithic export).
+
+    With ``spec.num_shards == 1`` this intentionally degrades to the
+    classic monolithic layout — an n=1 "sharded" export is byte-
+    compatible with every pre-sharding server.
+    """
+    from flax import serialization
+
+    from kubeflow_tpu.serving.export import (
+        PARAMS_FILE,
+        SIGNATURE_FILE,
+        export_model,
+    )
+
+    if spec.num_shards == 1:
+        return export_model(base_path, version, metadata, variables)
+    if plan is None:
+        plan = build_shard_plan(variables, spec)
+    flat = _flatten(variables)
+    n = spec.num_shards
+    shard_files = [SHARD_FILE_FMT.format(i=i, n=n) for i in range(n)]
+    manifest = {
+        "format": MANIFEST_FORMAT,
+        "num_shards": n,
+        "mesh": spec.to_json(),
+        "shards": shard_files,
+        "plan": plan,
+    }
+    metadata = dataclasses.replace(metadata, sharding=manifest)
+
+    base = Path(base_path)
+    base.mkdir(parents=True, exist_ok=True)
+    final = base / str(version)
+    if final.exists():
+        raise FileExistsError(f"version dir {final} already exists")
+    tmp = Path(tempfile.mkdtemp(dir=base, prefix=f".tmp-{version}-"))
+    try:
+        (tmp / SIGNATURE_FILE).write_text(metadata.dumps())
+        for shard in range(n):
+            part: Dict[str, np.ndarray] = {}
+            for key, value in flat.items():
+                entry = plan.get(key)
+                if entry is None:
+                    if shard == 0:  # replicated: stored exactly once
+                        part[key] = value
+                    continue
+                dim, axis = entry["dim"], entry["axis"]
+                parts = getattr(spec, axis)
+                width = value.shape[dim] // parts
+                idx = _axis_index(spec, shard, axis)
+                sl = [slice(None)] * value.ndim
+                sl[dim] = slice(idx * width, (idx + 1) * width)
+                part[key] = np.ascontiguousarray(value[tuple(sl)])
+            (tmp / shard_files[shard]).write_bytes(
+                serialization.msgpack_serialize(part))
+        # Belt-and-braces: the monolithic file is deliberately ABSENT
+        # from a sharded dir, so an old server that ignores the
+        # manifest fails loudly at load (missing params.msgpack)
+        # instead of serving shard 0 as if it were the whole model.
+        assert not (tmp / PARAMS_FILE).exists()
+        os.rename(tmp, final)
+    except BaseException:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return final
+
+
+def _read_shard(version_dir: str, filename: str) -> Dict[str, np.ndarray]:
+    from flax import serialization
+
+    data = (Path(version_dir) / filename).read_bytes()
+    restored = serialization.msgpack_restore(data)
+    if not isinstance(restored, dict):
+        raise ValueError(
+            f"shard file {filename} does not hold a dict")
+    return restored
+
+
+def _manifest_of(metadata: ModelMetadata) -> Dict[str, Any]:
+    manifest = metadata.sharding
+    if not manifest:
+        raise ValueError("metadata carries no shard manifest")
+    fmt = int(manifest.get("format", 0))
+    if fmt != MANIFEST_FORMAT:
+        raise ValueError(
+            f"unsupported shard manifest format {fmt} (this build "
+            f"reads format {MANIFEST_FORMAT}); re-export or upgrade "
+            f"the server")
+    n = int(manifest["num_shards"])
+    if len(manifest["shards"]) != n:
+        raise ValueError(
+            f"manifest lists {len(manifest['shards'])} shard files "
+            f"for num_shards={n}")
+    return manifest
+
+
+def _restore_tree(template: Dict[str, Any],
+                  flat: Dict[str, Any]) -> Dict[str, Any]:
+    """``from_state_dict`` against the template, restricted to
+    collections present in the files — the same missing-collection
+    policy as the monolithic read_variables. Shard files store PLAIN
+    tensors (flat keys, no ``Partitioned`` nesting), so the restore
+    runs against the UNBOXED template and the boxes are re-applied
+    after — load_version's init template carries ``nn.Partitioned``
+    metadata the rest of the stack expects to survive the load."""
+    import flax.linen as nn
+    from flax import serialization
+
+    stored = _unflatten(flat)
+    if isinstance(template, dict) and isinstance(stored, dict):
+        missing = set(template) - set(stored) - {"cache"}
+        if missing:
+            raise ValueError(
+                f"sharded export lacks collections {sorted(missing)}; "
+                f"stored: {sorted(stored)}")
+        template = {k: v for k, v in template.items() if k in stored}
+    restored = serialization.from_state_dict(
+        nn.meta.unbox(template), stored)
+    return jax.tree.map(
+        lambda box, value: (box.replace_boxed(value)
+                            if isinstance(box, nn.meta.AxisMetadata)
+                            else value),
+        template, restored,
+        is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata))
+
+
+def read_sharded_variables(version_dir: str, template: Dict[str, Any],
+                           metadata: ModelMetadata) -> Dict[str, Any]:
+    """Reassemble the FULL variable tree on host — the n=1 fallback
+    (serve a sharded export on a single device that fits it) and the
+    round-trip-equality oracle. Concatenation along each leaf's
+    recorded dim is exact: the shard slices are contiguous ranges of
+    the original array."""
+    manifest = _manifest_of(metadata)
+    spec = ShardSpec.from_json(manifest["mesh"])
+    plan: Dict[str, Dict[str, Any]] = manifest["plan"]
+    shards = [_read_shard(version_dir, f) for f in manifest["shards"]]
+    flat: Dict[str, np.ndarray] = {}
+    for key, value in shards[0].items():
+        entry = plan.get(key)
+        if entry is None:
+            flat[key] = np.asarray(value)
+            continue
+        dim, axis = int(entry["dim"]), entry["axis"]
+        parts = getattr(spec, axis)
+        # One representative slice per axis index (slices along the
+        # OTHER serving axis are identical copies; take its index 0).
+        pieces = []
+        for idx in range(parts):
+            shard_id = (idx if axis == "tensor"
+                        else idx * spec.tensor)
+            pieces.append(np.asarray(shards[shard_id][key]))
+        flat[key] = np.concatenate(pieces, axis=dim)
+    for shard_id, shard in enumerate(shards[1:], start=1):
+        for key in shard:
+            if key not in flat:
+                raise ValueError(
+                    f"shard {shard_id} carries unplanned leaf {key!r} "
+                    f"absent from shard 0")
+    return _restore_tree(template, flat)
+
+
+def _leaf_sharding(mesh, entry: Optional[Dict[str, Any]], ndim: int):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if entry is None:
+        return NamedSharding(mesh, P())
+    dims: List[Optional[str]] = [None] * ndim
+    dims[int(entry["dim"])] = entry["axis"]
+    return NamedSharding(mesh, P(*dims))
+
+
+def load_sharded_variables(version_dir: str, template: Dict[str, Any],
+                           metadata: ModelMetadata, mesh
+                           ) -> Dict[str, Any]:
+    """Materialize params directly ONTO the serving mesh: every
+    device gets exactly its slice via
+    ``jax.make_array_from_single_device_arrays`` — no host-side full
+    concatenation for sharded leaves, which is the whole point when
+    the model does not fit one device. Replicated leaves device_put
+    with a replicated NamedSharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    manifest = _manifest_of(metadata)
+    spec = ShardSpec.from_json(manifest["mesh"])
+    if math.prod(mesh.devices.shape) != spec.num_shards:
+        raise ValueError(
+            f"mesh has {math.prod(mesh.devices.shape)} devices but "
+            f"the manifest wants {spec.num_shards} shards")
+    for axis in SERVING_AXES:
+        if mesh.shape.get(axis, 1) != getattr(spec, axis):
+            raise ValueError(
+                f"mesh axis {axis}={mesh.shape.get(axis, 1)} != "
+                f"manifest {axis}={getattr(spec, axis)} — the load "
+                f"mesh must match the export topology")
+    plan: Dict[str, Dict[str, Any]] = manifest["plan"]
+    shards = [_read_shard(version_dir, f) for f in manifest["shards"]]
+    flat: Dict[str, Any] = {}
+    for key, value in shards[0].items():
+        entry = plan.get(key)
+        if entry is None:
+            flat[key] = jax.device_put(
+                np.asarray(value), NamedSharding(mesh, P()))
+            continue
+        dim, axis = int(entry["dim"]), entry["axis"]
+        parts = getattr(spec, axis)
+        piece0 = np.asarray(value)
+        shape = list(piece0.shape)
+        shape[dim] = piece0.shape[dim] * parts
+        sharding = _leaf_sharding(mesh, entry, piece0.ndim)
+        pieces = {idx: (piece0 if idx == 0 else None)
+                  for idx in range(parts)}
+        arrays = []
+        # addressable_devices_indices_map hands each device its index
+        # tuple into the GLOBAL shape; the slice along `dim` names
+        # which shard file backs that device.
+        width = piece0.shape[dim]
+        for device, index in sorted(
+                sharding.addressable_devices_indices_map(
+                    tuple(shape)).items(), key=lambda kv: kv[0].id):
+            start = index[dim].start or 0
+            idx = start // width
+            if pieces.get(idx) is None:
+                shard_id = (idx if axis == "tensor"
+                            else idx * spec.tensor)
+                pieces[idx] = np.asarray(shards[shard_id][key])
+            arrays.append(jax.device_put(pieces[idx], device))
+        flat[key] = jax.make_array_from_single_device_arrays(
+            tuple(shape), sharding, arrays)
+    return _restore_tree(template, flat)
+
+
+def shard_topology(metadata: ModelMetadata) -> Dict[str, Any]:
+    """The healthz/dashboard-facing summary of a version's layout
+    ({"num_shards": 1} for monolithic exports)."""
+    manifest = metadata.sharding
+    if not manifest:
+        return {"num_shards": 1}
+    try:
+        return {"num_shards": int(manifest.get("num_shards", 1)),
+                "mesh": dict(manifest.get("mesh") or {})}
+    except (TypeError, ValueError):
+        # Malformed manifests degrade (the healthz contract), they
+        # never take the status endpoint down.
+        return {"num_shards": 1, "malformed": True}
+
+
+def parse_shard_spec(raw: Optional[str]) -> ShardSpec:
+    """CLI form: ``"tensor=2,fsdp=1"`` or a bare int (→ tensor=N)."""
+    if not raw:
+        return ShardSpec()
+    raw = raw.strip()
+    if raw.isdigit():
+        return ShardSpec(tensor=int(raw))
+    sizes = {"tensor": 1, "fsdp": 1}
+    for pair in raw.split(","):
+        key, eq, value = pair.partition("=")
+        key = key.strip()
+        if not eq or key not in sizes:
+            raise ValueError(
+                f"bad shard spec {raw!r}; want 'tensor=T,fsdp=F' "
+                f"or a bare tensor count")
+        sizes[key] = int(value)
+    return ShardSpec(**sizes)
+
+
+def dumps_manifest(manifest: Dict[str, Any]) -> str:
+    return json.dumps(manifest, indent=1, sort_keys=True)

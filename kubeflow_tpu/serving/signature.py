@@ -88,11 +88,18 @@ class ModelMetadata:
     # top_p, eos_id, seed. Fixed at export time so serving shapes and
     # compiled programs are static (no per-request recompiles).
     generate_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Shard manifest for multi-chip exports (serving/sharding.py):
+    # {"format": 1, "num_shards": N, "mesh": {"tensor": t, "fsdp": f},
+    #  "shards": [filenames...], "plan": {flat_key: {"dim", "axis"}}}.
+    # None = the classic monolithic params.msgpack layout; readers
+    # that predate the field (or a num_shards == 1 manifest) keep
+    # loading the monolithic file unchanged.
+    sharding: Optional[Dict[str, Any]] = None
 
     DEFAULT_SIGNATURE = "serving_default"
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "model_name": self.model_name,
             "registry_name": self.registry_name,
             "signatures": {k: s.to_json() for k, s in self.signatures.items()},
@@ -100,6 +107,12 @@ class ModelMetadata:
             "classes": self.classes,
             "generate_config": self.generate_config,
         }
+        if self.sharding is not None:
+            # Written only when present, so monolithic signature.json
+            # files are byte-identical to the pre-sharding layout
+            # (old readers never see an unknown key).
+            out["sharding"] = self.sharding
+        return out
 
     @staticmethod
     def from_json(obj: Dict[str, Any]) -> "ModelMetadata":
@@ -111,6 +124,7 @@ class ModelMetadata:
             model_kwargs=obj.get("model_kwargs", {}),
             classes=obj.get("classes"),
             generate_config=obj.get("generate_config", {}),
+            sharding=obj.get("sharding"),
         )
 
     def dumps(self) -> str:

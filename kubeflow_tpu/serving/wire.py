@@ -786,3 +786,83 @@ def iter_sse_events(line_iter) -> Iterator[Tuple[str, dict]]:
             data_lines.append(value)
     if data_lines:  # stream ended without the trailing blank line
         yield (event or "message", json.loads("\n".join(data_lines)))
+
+
+# --- KV handoff (role-split routing) ---------------------------------------
+
+#: Version tag of the handoff blob. Prefill and decode replicas may
+#: be mid-rollout on different builds; an unknown version must fail
+#: the request with a clear 400, never mis-adopt pages.
+KV_HANDOFF_FORMAT = 1
+
+
+def encode_kv_handoff(model: str, version: int, handoff) -> bytes:
+    """Serialize an engine :class:`~kubeflow_tpu.inference.engine.
+    engine.PrefillHandoff` for the proxy's prefill→decode hop.
+    flax-msgpack carries the cache leaves byte-exact (bf16 included),
+    which is what keeps the resumed decode bitwise equal to a local
+    one. ``model``/``version`` pin the export the cache came from —
+    adopting pages into a different model would read garbage K/V."""
+    from flax import serialization
+
+    # One tree codec for shard files AND handoff blobs: the
+    # "/"-joined-path flattening lives in serving/sharding.py — a
+    # format tweak there (key escaping, new node kinds) must not be
+    # able to diverge from this blob's layout.
+    from kubeflow_tpu.serving.sharding import _flatten
+
+    return serialization.msgpack_serialize({
+        "format": np.int32(KV_HANDOFF_FORMAT),
+        "model": model,
+        "version": np.int32(version),
+        "first_token": np.int32(handoff.first_token),
+        "done": np.int32(1 if handoff.done else 0),
+        "prompt_len": np.int32(handoff.prompt_len),
+        "prompt_width": np.int32(handoff.prompt_width),
+        "max_new_tokens": np.int32(handoff.max_new_tokens),
+        "step_keys": np.asarray(handoff.step_keys),
+        "cache": _flatten(handoff.cache),
+    })
+
+
+def decode_kv_handoff(data: bytes, *, model: str,
+                      version: Optional[int] = None):
+    """Parse + validate a handoff blob against the adopting replica's
+    (model, version). Returns the engine PrefillHandoff. Raises
+    ValueError on any mismatch or malformed payload — the server maps
+    that to a 400, and the proxy falls back to the classic
+    single-replica path."""
+    from flax import serialization
+
+    from kubeflow_tpu.inference.engine.engine import PrefillHandoff
+
+    try:
+        doc = serialization.msgpack_restore(data)
+        fmt = int(doc["format"])
+    except Exception as e:  # noqa: BLE001 — malformed blob = 400
+        raise ValueError(f"malformed KV handoff blob: {e}") from None
+    if fmt != KV_HANDOFF_FORMAT:
+        raise ValueError(
+            f"KV handoff format {fmt} unsupported (this replica "
+            f"speaks {KV_HANDOFF_FORMAT}); prefill/decode replicas "
+            f"are mid-rollout on incompatible builds")
+    if doc["model"] != model:
+        raise ValueError(
+            f"KV handoff is for model {doc['model']!r}, not {model!r}")
+    if version is not None and int(doc["version"]) != int(version):
+        raise ValueError(
+            f"KV handoff came from version {int(doc['version'])} but "
+            f"this replica serves version {version} — cache layout "
+            f"may differ; retry (the prefill pool will re-resolve)")
+    from kubeflow_tpu.serving.sharding import _unflatten
+
+    cache = _unflatten({k: np.asarray(v)
+                        for k, v in doc["cache"].items()})
+    return PrefillHandoff(
+        cache=cache,
+        first_token=int(doc["first_token"]),
+        done=bool(int(doc["done"])),
+        prompt_len=int(doc["prompt_len"]),
+        prompt_width=int(doc["prompt_width"]),
+        max_new_tokens=int(doc["max_new_tokens"]),
+        step_keys=np.asarray(doc["step_keys"]))

@@ -1,0 +1,232 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Serving-mesh dryrun gate — the MULTICHIP gate for INFERENCE.
+
+Training's multichip layouts are CPU-dryrun-gated in
+``__graft_entry__.py``; this is the same idea for the serving
+sharding subsystem (serving/sharding.py, ISSUE 10): re-exec a child
+pinned to a virtual n-device CPU platform
+(``--xla_force_host_platform_device_count``) and prove, before any
+TPU is involved:
+
+1. **Round trip** — sharded export → sharded load reassembles the
+   monolithic bytes bit-for-bit (host path) AND materializes onto the
+   tp serving mesh with every planned leaf actually sharded
+   (placement check: the sharded leaves' shardings span n devices).
+2. **Execution equality** — greedy AND sampled :generate outputs of
+   the mesh-loaded model are bitwise equal to the monolithic
+   single-device path, through ``LoadedModel.run`` and through the
+   continuous-batching engine (whose paged KV pool is sharded along
+   the same tensor axis).
+3. **SPMD quality** — like the training gate, the child's stderr is
+   scanned for XLA's involuntary-rematerialization/all-gather
+   warnings: a sharding that silently degrades to replication
+   compiles fine on the virtual mesh but is a real perf bug on ICI.
+
+Usage (CI runs it as the ``serving-mesh-dryrun`` step)::
+
+    python scripts/dryrun_serving_mesh.py --devices 2 \
+        [--junit_path out.xml]
+
+``KFT_DRYRUN_NATIVE=1`` runs the checks in-process on the real
+platform instead (on-chip validation when a TPU runner is attached).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+_SPMD_QUALITY_PATTERNS = (
+    "Involuntary full rematerialization",
+    "Involuntary all-gather",
+)
+
+
+def _run_child(n_devices: int) -> None:
+    env = dict(os.environ)
+    env["KFT_SERVING_DRYRUN_CHILD"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    flags += f" --xla_force_host_platform_device_count={n_devices}"
+    env["XLA_FLAGS"] = flags.strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--devices",
+         str(n_devices)],
+        env=env, capture_output=True, text=True)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"serving-mesh dryrun child (n={n_devices}) failed "
+            f"rc={proc.returncode}")
+    bad = [line for line in proc.stderr.splitlines()
+           if any(p in line for p in _SPMD_QUALITY_PATTERNS)]
+    if bad:
+        raise RuntimeError(
+            f"serving-mesh dryrun (n={n_devices}) compiled with XLA "
+            f"SPMD quality warnings — a serving sharding degraded to "
+            f"replication; fix the plan/rules:\n" + "\n".join(bad[:4]))
+    print(f"dryrun_serving_mesh n={n_devices}: all checks ok, "
+          f"no SPMD quality warnings")
+
+
+def dryrun_serving_mesh(n_devices: int) -> None:
+    """Export→load→serve equality over an n-device serving mesh."""
+    import functools
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import flax.linen as nn
+
+    from kubeflow_tpu.models.llama import llama_test
+    from kubeflow_tpu.serving import sharding as sh
+    from kubeflow_tpu.serving.export import export_model
+    from kubeflow_tpu.serving.model import load_version
+    from kubeflow_tpu.serving.signature import (
+        ModelMetadata,
+        Signature,
+        TensorSpec,
+    )
+
+    devices = jax.devices()
+    assert len(devices) >= n_devices, (
+        f"need {n_devices} devices, have {len(devices)}")
+    prompt_len, new_tokens, cache = 8, 6, 32
+    model = llama_test(dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, prompt_len), jnp.int32))
+    metadata = ModelMetadata(
+        model_name="dryrun", registry_name="llama-test",
+        model_kwargs={"dtype": "float32", "cache_size": cache},
+        signatures={"serving_default": Signature(
+            "generate",
+            {"input_ids": TensorSpec("int32", (-1, prompt_len))},
+            {"tokens": TensorSpec("int32", (-1, new_tokens))})},
+        generate_config={"max_new_tokens": new_tokens,
+                         "temperature": 0.8, "seed": 3,
+                         "deterministic": True})
+    base = tempfile.mkdtemp(prefix="kft-serving-dryrun-")
+    export_model(f"{base}/mono", 1, metadata,
+                 {"params": variables["params"]})
+    spec = sh.ShardSpec(tensor=n_devices)
+    sh.export_model_sharded(f"{base}/sharded", 1, metadata,
+                            {"params": variables["params"]}, spec)
+
+    # 1) Round trip: host reassembly is bitwise vs the monolith.
+    template = jax.jit(functools.partial(model.init, train=False))(
+        jax.random.PRNGKey(0), jnp.zeros((1, prompt_len), jnp.int32))
+    from kubeflow_tpu.serving.export import (
+        read_metadata,
+        read_variables,
+    )
+
+    mono_vars = read_variables(f"{base}/mono/1",
+                               {"params": template["params"]})
+    meta2 = read_metadata(f"{base}/sharded/1")
+    host_vars = sh.read_sharded_variables(
+        f"{base}/sharded/1", {"params": template["params"]}, meta2)
+    mono_flat = jax.tree_util.tree_flatten_with_path(
+        nn.meta.unbox(mono_vars))[0]
+    host_leaves = jax.tree.leaves(nn.meta.unbox(host_vars))
+    mismatch = [
+        jax.tree_util.keystr(path)
+        for (path, a), b in zip(mono_flat, host_leaves)
+        if not np.array_equal(np.asarray(a), np.asarray(b))]
+    assert not mismatch, f"round-trip mismatch at {mismatch[:3]}"
+    print(f"dryrun_serving_mesh round-trip ok: "
+          f"{len(jax.tree.leaves(host_vars))} leaves bitwise equal, "
+          f"{meta2.sharding['num_shards']} shards")
+
+    # 2) Placement + execution equality through the REAL load path.
+    mono = load_version(f"{base}/mono/1", max_batch=4)
+    mesh_loaded = load_version(f"{base}/sharded/1", max_batch=4)
+    assert mesh_loaded.mesh is not None, "sharded load skipped the mesh"
+    plan = meta2.sharding["plan"]
+    n_sharded = 0
+    for leaf in jax.tree.leaves(nn.meta.unbox(mesh_loaded.variables)):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and len(sharding.device_set) == \
+                n_devices and not sharding.is_fully_replicated:
+            n_sharded += 1
+    assert n_sharded >= len(plan), (
+        f"only {n_sharded} leaves actually sharded; plan says "
+        f"{len(plan)}")
+    prompt = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(7), (2, prompt_len), 0, 512))
+    out_mono = mono.run({"input_ids": prompt})
+    out_mesh = mesh_loaded.run({"input_ids": prompt})
+    assert np.array_equal(out_mono["tokens"], out_mesh["tokens"]), (
+        "sampled serving outputs differ between mesh and single-chip")
+    print(f"dryrun_serving_mesh placement ok: {n_sharded} sharded "
+          f"leaves on {n_devices} devices, sampled tokens bitwise "
+          f"equal")
+
+    # 3) Engine path: paged KV pool sharded on the same axis.
+    eng_mono = mono.ensure_engine("dryrun-mono")
+    eng_mesh = mesh_loaded.ensure_engine("dryrun-mesh")
+    key = np.asarray(jax.random.PRNGKey(11))
+    t_mono = eng_mono.submit(prompt[0], rng=key).result(timeout=300)
+    t_mesh = eng_mesh.submit(prompt[0], rng=key).result(timeout=300)
+    assert np.array_equal(t_mono, t_mesh), (
+        "engine decode differs between mesh and single-chip")
+    kv_shardings = {
+        str(getattr(leaf, "sharding", None))
+        for leaf in jax.tree.leaves(eng_mesh.kv.physical)
+        if getattr(leaf, "ndim", 0) == 4}
+    print(f"dryrun_serving_mesh engine ok: tokens bitwise equal, "
+          f"kv pool shardings={sorted(kv_shardings)}")
+    eng_mono.stop()
+    eng_mesh.stop()
+    mono.close()
+    mesh_loaded.close()
+
+
+def main(argv=None) -> int:
+    # Runnable from anywhere: python puts scripts/ (not the repo
+    # root) on sys.path when invoked by file path.
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    parser = argparse.ArgumentParser(prog="dryrun-serving-mesh")
+    parser.add_argument("--devices", type=int, default=2)
+    parser.add_argument("--junit_path", default=None)
+    args = parser.parse_args(argv)
+    if (os.environ.get("KFT_SERVING_DRYRUN_CHILD") == "1"
+            or os.environ.get("KFT_DRYRUN_NATIVE") == "1"):
+        from kubeflow_tpu.utils.platform import sync_platform_from_env
+
+        sync_platform_from_env()
+        dryrun_serving_mesh(args.devices)
+        return 0
+    from kubeflow_tpu.utils import junit
+
+    case = junit.run_case(
+        f"serving-mesh-dryrun-n{args.devices}",
+        lambda: _run_child(args.devices))
+    if args.junit_path:
+        junit.write_report(args.junit_path, "serving-mesh-dryrun",
+                           [case])
+    return 0 if case.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

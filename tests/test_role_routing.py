@@ -1,0 +1,670 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Role-split routing (ISSUE 10): the role dimension on the r10
+fleet — endpoints-file schema v2, role-aware balancing, the engine's
+KV-handoff seam, prefill→decode orchestration through the pooled
+proxy (bitwise equal to the single-replica path), and per-pool
+autoscaling signals."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.inference.engine import DecodeEngine, EngineConfig
+from kubeflow_tpu.models.llama import llama_test
+from kubeflow_tpu.scaling.balancer import (
+    RoleAwareBalancer,
+    make_balancer,
+)
+from kubeflow_tpu.scaling.endpoints import (
+    Endpoint,
+    EndpointPool,
+    FileEndpointSource,
+    normalize_spec,
+    write_endpoints_file,
+)
+from kubeflow_tpu.serving import wire
+
+PROMPT_LEN = 8
+NEW_TOKENS = 6
+CACHE = 32
+
+
+def _ep(address, role="any", score=0.0):
+    ep = Endpoint(address, register_metrics=False, role=role)
+    if score:
+        ep.saturation = {"m": {"queue_depth": score,
+                               "est_batch_latency_ms": 1.0}}
+    return ep
+
+
+# --- endpoints-file schema v2 ---------------------------------------------
+
+def test_endpoints_file_v2_roundtrips_roles(tmp_path):
+    path = tmp_path / "endpoints.json"
+    write_endpoints_file(str(path), [
+        ("a:8500", "a:9000", "prefill"),
+        ("b:8500", None, "decode"),
+        ("c:8500", None),  # role-less stays the classic 2-tuple
+    ])
+    doc = json.loads(path.read_text())
+    assert doc["version"] == 2
+    source = FileEndpointSource(str(path))
+    assert source.specs() == [("a:8500", "a:9000", "prefill"),
+                              ("b:8500", None, "decode"),
+                              ("c:8500", None)]
+
+
+def test_v1_file_reads_role_any(tmp_path):
+    # A pre-role writer's file: no version key, no roles.
+    path = tmp_path / "v1.json"
+    path.write_text(json.dumps({"endpoints": [
+        {"address": "a:8500", "grpc_address": "a:9000"}]}))
+    specs = FileEndpointSource(str(path)).specs()
+    assert specs == [("a:8500", "a:9000")]
+    assert normalize_spec(specs[0]) == ("a:8500", "a:9000", "any")
+
+
+def test_unknown_role_degrades_to_any(tmp_path):
+    # A NEWER writer's role vocabulary must not break this reader.
+    path = tmp_path / "future.json"
+    path.write_text(json.dumps({"version": 3, "endpoints": [
+        {"address": "a:8500", "role": "embedding"}]}))
+    specs = FileEndpointSource(str(path)).specs()
+    assert normalize_spec(specs[0])[2] == "any"
+
+
+def test_pool_sync_applies_role_changes():
+    pool = EndpointPool()
+    pool.sync([("a:1", None, "prefill")])
+    assert pool.get("a:1").role == "prefill"
+    pool.sync([("a:1", None, "decode")])  # mid-rollout retag
+    assert pool.get("a:1").role == "decode"
+    pool.sync([("a:1", None)])  # role dropped → any
+    assert pool.get("a:1").role == "any"
+
+
+def test_effective_role_backfills_from_healthz():
+    ep = _ep("a:1")  # discovery says nothing
+    ep.mark_probe_success({"status": "ok", "role": "decode",
+                           "saturation": {}})
+    assert ep.effective_role() == "decode"
+    assert ep.serves_phase("decode") and not ep.serves_phase("prefill")
+    # Discovery wins over the reported role once it names one.
+    ep.role = "prefill"
+    assert ep.effective_role() == "prefill"
+    # Malformed healthz role degrades.
+    ep2 = _ep("b:1")
+    ep2.mark_probe_success({"status": "ok", "role": 42,
+                            "saturation": {}})
+    assert ep2.effective_role() == "any"
+
+
+def test_snapshot_carries_role_and_shards():
+    ep = _ep("a:1", role="decode")
+    ep.saturation = {"m": {"sharding": {"num_shards": 2}},
+                     "n": {"sharding": "garbage"}}  # degrades
+    snap = ep.snapshot()
+    assert snap["role"] == "decode"
+    assert snap["shard_count"] == 2
+
+
+# --- role-aware balancer ---------------------------------------------------
+
+def test_role_balancer_routes_by_phase():
+    b = make_balancer("role")
+    assert isinstance(b, RoleAwareBalancer)
+    pre, dec, anyr = (_ep("p:1", "prefill"), _ep("d:1", "decode"),
+                      _ep("x:1", "any"))
+    cands = [pre, dec, anyr]
+    for _ in range(4):
+        assert b.pick(cands, phase="prefill") in (pre, anyr)
+        assert b.pick(cands, phase="decode") in (dec, anyr)
+    # Phase-less requests may land anywhere.
+    assert b.pick(cands) in cands
+
+
+def test_role_balancer_falls_back_when_pool_missing():
+    b = RoleAwareBalancer()
+    dec = _ep("d:1", "decode")
+    # No prefill replica discovered: availability beats specialization.
+    assert b.pick([dec], phase="prefill") is dec
+
+
+def test_role_balancer_overflows_on_overload():
+    b = RoleAwareBalancer(overload_ms=10.0)
+    pre = _ep("p:1", "prefill", score=1000.0)  # saturated
+    dec = _ep("d:1", "decode", score=0.0)
+    assert b.pick([pre, dec], phase="prefill") is dec
+    # Everyone overloaded: still prefer the matching pool.
+    dec.saturation = {"m": {"queue_depth": 1000,
+                            "est_batch_latency_ms": 1.0}}
+    assert b.pick([pre, dec], phase="prefill") is pre
+
+
+def test_classify_generate_phase():
+    from kubeflow_tpu.serving.http_proxy import classify_generate_phase
+
+    assert classify_generate_phase([[1] * 160], 8) == "prefill"
+    assert classify_generate_phase([[1] * 8], 64) == "decode"
+    assert classify_generate_phase([[1] * 32], None) == "prefill"
+    assert classify_generate_phase("garbage", 8) == "decode"
+    # A malformed budget must classify (→ 400 from the backend),
+    # never raise out of the proxy (→ 500).
+    assert classify_generate_phase([[1] * 8], "abc") == "decode"
+    assert classify_generate_phase([[1] * 8], [3]) == "decode"
+
+
+def test_endpoints_file_non_dict_entry_keeps_last_good(tmp_path):
+    path = tmp_path / "endpoints.json"
+    write_endpoints_file(str(path), [("a:8500", None)])
+    source = FileEndpointSource(str(path))
+    assert source.specs() == [("a:8500", None)]
+    # Hand-edited garbage entry (a bare int): the reader must keep
+    # the last good membership, not raise AttributeError on .get.
+    path.write_text(json.dumps({"endpoints": [
+        {"address": "b:8500"}, 42]}))
+    assert source.specs() == [("a:8500", None)]
+
+
+def test_collector_plus_slot_occupancy_refused():
+    from kubeflow_tpu.scaling.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        AutoscalerLoop,
+    )
+
+    with pytest.raises(ValueError, match="slot_occupancy"):
+        AutoscalerLoop(
+            Autoscaler(AutoscalerConfig(signal="slot_occupancy"),
+                       _FakeScaler()),
+            discover=lambda: [], collector=object())
+
+
+# --- the engine handoff seam ----------------------------------------------
+
+@pytest.fixture(scope="module")
+def toy():
+    model = llama_test(dtype=jnp.float32, cache_size=CACHE)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, PROMPT_LEN), jnp.int32))
+    return model, variables["params"]
+
+
+def _engine(toy, name, temperature=0.8):
+    model, params = toy
+    return DecodeEngine(model, params, EngineConfig(
+        max_new_tokens=NEW_TOKENS, max_prompt_len=PROMPT_LEN,
+        temperature=temperature, num_slots=2, page_size=4,
+        slice_tokens=2, seed=0), name=name)
+
+
+def test_handoff_resumes_bitwise_across_engines(toy):
+    eng_a, eng_b = _engine(toy, "a"), _engine(toy, "b")
+    try:
+        prompt = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(3), (PROMPT_LEN,), 0, 512))
+        key = np.asarray(jax.random.PRNGKey(7))
+        local = eng_a.submit(prompt, rng=key).result(timeout=120)
+        handoff = eng_a.run_prefill(prompt, rng=key)
+        blob = wire.encode_kv_handoff("m", 1, handoff)
+        resumed = eng_b.submit(
+            handoff=wire.decode_kv_handoff(blob, model="m",
+                                           version=1)
+        ).result(timeout=120)
+        np.testing.assert_array_equal(local, resumed)
+    finally:
+        eng_a.stop()
+        eng_b.stop()
+
+
+def test_handoff_greedy_and_short_budget(toy):
+    eng = _engine(toy, "g", temperature=0.0)
+    try:
+        prompt = np.asarray([5, 6, 7], np.int32)
+        local = eng.submit(prompt, max_new_tokens=3).result(timeout=120)
+        handoff = eng.run_prefill(prompt, max_new_tokens=3)
+        resumed = eng.submit(handoff=handoff).result(timeout=120)
+        np.testing.assert_array_equal(local, resumed)
+        # A caller budget that disagrees with the handoff's schedule
+        # is rejected (it would fork the rng stream).
+        with pytest.raises(ValueError, match="step-key"):
+            eng.submit(handoff=handoff, max_new_tokens=5)
+    finally:
+        eng.stop()
+
+
+def test_handoff_blob_validation(toy):
+    eng = _engine(toy, "v")
+    try:
+        handoff = eng.run_prefill(np.asarray([5, 6, 7], np.int32))
+        blob = wire.encode_kv_handoff("m", 3, handoff)
+        with pytest.raises(ValueError, match="model"):
+            wire.decode_kv_handoff(blob, model="other")
+        with pytest.raises(ValueError, match="version 3"):
+            wire.decode_kv_handoff(blob, model="m", version=4)
+        with pytest.raises(ValueError, match="malformed"):
+            wire.decode_kv_handoff(b"junk", model="m")
+    finally:
+        eng.stop()
+
+
+# --- proxy orchestration e2e ----------------------------------------------
+
+@pytest.fixture(scope="module")
+def role_stack(tmp_path_factory):
+    """Two REAL servers over one export — a prefill-role and a
+    decode-role replica — plus the pooled proxy with the role
+    balancer and KV-handoff splitting enabled."""
+    import asyncio
+
+    from kubeflow_tpu.serving.export import export_model
+    from kubeflow_tpu.serving.manager import ModelManager
+    from kubeflow_tpu.serving.signature import (
+        ModelMetadata,
+        Signature,
+        TensorSpec,
+    )
+
+    base = tmp_path_factory.mktemp("role") / "m"
+    model = llama_test(dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, PROMPT_LEN), jnp.int32))
+    meta = ModelMetadata(
+        model_name="m", registry_name="llama-test",
+        model_kwargs={"dtype": "float32", "cache_size": CACHE},
+        signatures={"serving_default": Signature(
+            "generate",
+            {"input_ids": TensorSpec("int32", (-1, PROMPT_LEN))},
+            {"tokens": TensorSpec("int32", (-1, NEW_TOKENS))})},
+        generate_config={"max_new_tokens": NEW_TOKENS,
+                         "temperature": 0.8, "seed": 11,
+                         "deterministic": True,
+                         "engine_slots": 2, "engine_page_size": 8,
+                         "engine_slice_tokens": 2})
+    export_model(str(base), 1, meta, {"params": variables["params"]})
+
+    from kubeflow_tpu.serving.http_proxy import make_app as proxy_app
+    from kubeflow_tpu.serving.server import make_app as rest_app
+
+    managers, holders = [], []
+
+    def serve(factory, holder, started):
+        import tornado.ioloop
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        server = factory().listen(0)
+        holder["port"] = next(iter(
+            server._sockets.values())).getsockname()[1]
+        holder["loop"] = tornado.ioloop.IOLoop.current()
+        started.set()
+        holder["loop"].start()
+
+    for role in ("prefill", "decode"):
+        mgr = ModelManager(poll_interval_s=3600)
+        mgr.add_model("m", str(base), max_batch=4,
+                      continuous_batching=True)
+        managers.append(mgr)
+        holder, started = {"role": role}, threading.Event()
+        threading.Thread(
+            target=serve,
+            args=(lambda m=mgr, r=role: rest_app(m, role=r), holder,
+                  started),
+            daemon=True).start()
+        assert started.wait(60)
+        holders.append(holder)
+
+    pool = EndpointPool()
+    for holder in holders:
+        pool.add(f"127.0.0.1:{holder['port']}", None, holder["role"])
+    proxy, started = {}, threading.Event()
+    threading.Thread(
+        target=serve,
+        args=(lambda: proxy_app(pool=pool, balancer="role",
+                                probe_interval_s=3600.0), proxy,
+              started),
+        daemon=True).start()
+    assert started.wait(60)
+    yield {"base": base, "proxy": proxy, "holders": holders,
+           "managers": managers, "pool": pool}
+    for holder in holders + [proxy]:
+        holder["loop"].add_callback(holder["loop"].stop)
+    for mgr in managers:
+        mgr.stop()
+
+
+def _proxy_generate(stack, instances, timeout=60):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{stack['proxy']['port']}/model/m:generate",
+        data=json.dumps({"instances": instances}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def test_split_generate_bitwise_and_actually_split(role_stack):
+    """The acceptance wiring: a :generate through the role proxy runs
+    prefill on the prefill replica, hands the KV off, decodes on the
+    decode replica — and the sampled tokens are bitwise equal to a
+    single-replica run."""
+    from kubeflow_tpu.serving.model import load_version
+
+    pre_mgr, dec_mgr = role_stack["managers"]
+    pre_engine = pre_mgr.get_model("m").get_resident().engine
+    dec_engine = dec_mgr.get_model("m").get_resident().engine
+    # Warmup traffic at load admitted slots on both; the REQUEST's
+    # footprint is the delta.
+    pre_before = pre_engine.stats()["admitted"]
+    dec_before = dec_engine.stats()["admitted"]
+    prompt = [[7] * PROMPT_LEN]
+    out = _proxy_generate(role_stack, prompt)
+    single = load_version(str(role_stack["base"] / "1"), max_batch=4)
+    expect = single.run({"input_ids": np.asarray(prompt)})["tokens"]
+    np.testing.assert_array_equal(
+        np.asarray(out["predictions"][0]["tokens"]), expect[0])
+    single.close()
+    # White-box: the decode replica admitted the slot; the prefill
+    # replica ran prefill-only (no slot taken).
+    assert dec_engine.stats()["admitted"] == dec_before + 1
+    assert pre_engine.stats()["admitted"] == pre_before
+
+
+def test_split_survives_short_prompt_and_more_rows(role_stack):
+    from kubeflow_tpu.serving.model import load_version
+
+    single = load_version(str(role_stack["base"] / "1"), max_batch=4)
+    for instances in ([[3, 4, 5]], [[9] * PROMPT_LEN, [1] * PROMPT_LEN]):
+        out = _proxy_generate(role_stack, instances)
+        expect = single.run(
+            {"input_ids": np.asarray(instances)})["tokens"]
+        got = np.asarray([row["tokens"] for row in out["predictions"]])
+        np.testing.assert_array_equal(got, expect)
+    single.close()
+
+
+def test_split_streaming_tokens_bitwise(role_stack):
+    """SSE streaming through the role proxy: prefill hop on the
+    prefill replica, token stream relayed from the decode replica —
+    same tokens as the single-replica path."""
+    import http.client
+
+    from kubeflow_tpu.serving.model import load_version
+
+    prompt = [[2, 3, 4, 5]]
+    conn = http.client.HTTPConnection(
+        "127.0.0.1", role_stack["proxy"]["port"], timeout=60)
+    conn.request(
+        "POST", "/model/m:generate",
+        body=json.dumps({"instances": prompt, "stream": True}),
+        headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.headers["Content-Type"].startswith("text/event-stream")
+    tokens, done = [], None
+    for event, data in wire.iter_sse_events(resp):
+        if event == "token":
+            tokens.append(data["token"])
+        elif event == "done":
+            done = data
+    conn.close()
+    assert done is not None, "stream ended without the done event"
+    single = load_version(str(role_stack["base"] / "1"), max_batch=4)
+    expect = single.run({"input_ids": np.asarray(prompt)})["tokens"]
+    np.testing.assert_array_equal(np.asarray(done["tokens"][0]),
+                                  expect[0])
+    np.testing.assert_array_equal(
+        np.asarray(tokens), expect[0][:len(tokens)])
+    single.close()
+
+
+def test_prefill_only_without_engine_is_unimplemented(tmp_path):
+    """A model NOT served with continuous batching answers the
+    handoff verbs with the structured UNIMPLEMENTED code — the signal
+    the proxy uses to remember 'skip the split', distinct from a
+    per-request 400 (which must NOT poison split routing)."""
+    import tornado.testing
+
+    from kubeflow_tpu.serving.export import export_model
+    from kubeflow_tpu.serving.manager import ModelManager
+    from kubeflow_tpu.serving.server import make_app
+    from kubeflow_tpu.serving.signature import (
+        ModelMetadata,
+        Signature,
+        TensorSpec,
+    )
+
+    base = tmp_path / "plain"
+    model = llama_test(dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, PROMPT_LEN), jnp.int32))
+    meta = ModelMetadata(
+        model_name="plain", registry_name="llama-test",
+        model_kwargs={"dtype": "float32", "cache_size": CACHE},
+        signatures={"serving_default": Signature(
+            "generate",
+            {"input_ids": TensorSpec("int32", (-1, PROMPT_LEN))},
+            {"tokens": TensorSpec("int32", (-1, NEW_TOKENS))})},
+        generate_config={"max_new_tokens": NEW_TOKENS,
+                         "temperature": 0.0})
+    export_model(str(base), 1, meta, {"params": variables["params"]})
+
+    class _Case(tornado.testing.AsyncHTTPTestCase):
+        def get_app(self):
+            mgr = ModelManager(poll_interval_s=3600)
+            mgr.add_model("plain", str(base), max_batch=4)
+            self.mgr = mgr
+            return make_app(mgr)
+
+        def runTest(self):
+            resp = self.fetch(
+                "/v1/models/plain:generate", method="POST",
+                body=json.dumps({"instances": [[1, 2]],
+                                 "prefill_only": True}))
+            assert resp.code == 400
+            assert json.loads(resp.body)["code"] == "UNIMPLEMENTED"
+            resp = self.fetch(
+                "/v1/models/plain:generate", method="POST",
+                body=json.dumps({"handoffs": ["AAAA"]}))
+            assert resp.code == 400
+            assert json.loads(resp.body)["code"] == "UNIMPLEMENTED"
+            self.mgr.stop()
+
+    case = _Case()
+    case.setUp()
+    try:
+        case.runTest()
+    finally:
+        case.tearDown()
+
+
+def test_proxy_healthz_lists_roles(role_stack):
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{role_stack['proxy']['port']}/healthz",
+            timeout=10) as resp:
+        payload = json.loads(resp.read())
+    roles = sorted(ep["role"] for ep in payload["endpoints"].values())
+    assert roles == ["decode", "prefill"]
+
+
+# --- per-pool autoscaling ---------------------------------------------------
+
+class _FakeScaler:
+    def __init__(self, replicas=2):
+        self.replicas = replicas
+        self.sets = []
+
+    def get_replicas(self):
+        return self.replicas
+
+    def set_replicas(self, n):
+        self.sets.append(n)
+        self.replicas = n
+
+
+def test_autoscaler_slot_occupancy_signal():
+    from kubeflow_tpu.scaling.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+    )
+
+    scaler = _FakeScaler(replicas=2)
+    clock = [0.0]
+    autoscaler = Autoscaler(
+        AutoscalerConfig(min_replicas=1, max_replicas=8,
+                         signal="slot_occupancy",
+                         target_slot_occupancy=0.8,
+                         scale_up_cooldown_s=0.0),
+        scaler, clock=lambda: clock[0])
+    # Full slots → occupancy 1.0 / 0.8 = 1.25 > 1.2 → scale up.
+    decision = autoscaler.evaluate(
+        [{"slot_occupancy": 1.0, "queue_wait_ms": 0.0},
+         {"slot_occupancy": 1.0, "queue_wait_ms": 0.0}])
+    assert decision["action"] == "scale_up"
+    assert decision["signal"] == "slot_occupancy"
+    # A replica WITHOUT engine stats reads fully occupied (blind
+    # capacity is never counted as headroom).
+    clock[0] += 100.0
+    decision = autoscaler.evaluate(
+        [{"queue_wait_ms": 0.0}, {"queue_wait_ms": 0.0}])
+    assert decision["action"] in ("scale_up", "hold")
+    assert decision["ratio"] >= 1.0
+
+
+def test_replica_sample_extracts_engine_signals():
+    from kubeflow_tpu.scaling.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        AutoscalerLoop,
+    )
+
+    loop = AutoscalerLoop(
+        Autoscaler(AutoscalerConfig(), _FakeScaler()),
+        discover=lambda: [])
+    row = loop._replica_sample("a:1", {
+        "status": "ok", "role": "decode",
+        "saturation": {"m": {
+            "queue_depth": 0, "est_batch_latency_ms": 5.0,
+            "shed": 0, "expired": 0,
+            "engine": {"slots": 4, "active_slots": 3,
+                       "queue_depth": 2, "est_ttft_ms": 10.0},
+            "sharding": {"num_shards": 2},
+        }}}, now=1.0)
+    assert row["slot_occupancy"] == 0.75
+    assert row["role"] == "decode"
+    assert row["shards"] == 2
+    assert row["queue_wait_ms"] == 20.0  # engine queue priced in
+    # Malformed engine stats degrade, never raise.
+    row2 = loop._replica_sample("b:1", {
+        "status": "ok",
+        "saturation": {"m": {"engine": {"slots": "x"}}}}, now=2.0)
+    assert row2["reachable"]
+
+
+def test_role_split_loop_merges_endpoints_and_decisions(tmp_path):
+    from kubeflow_tpu.scaling.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        AutoscalerLoop,
+        RoleSplitAutoscalerLoop,
+    )
+
+    def loop_for(role, payload):
+        return AutoscalerLoop(
+            Autoscaler(AutoscalerConfig(
+                signal=("slot_occupancy" if role == "decode"
+                        else "queue_wait")), _FakeScaler()),
+            discover=lambda r=role: [(f"{r}:8500", None)],
+            scrape=lambda addr, p=payload: p)
+
+    pools = {
+        "prefill": loop_for("prefill", {
+            "status": "ok",
+            "saturation": {"m": {"queue_depth": 1,
+                                 "est_batch_latency_ms": 10.0}}}),
+        "decode": loop_for("decode", {
+            "status": "ok",
+            "saturation": {"m": {
+                "engine": {"slots": 4, "active_slots": 2}}}}),
+    }
+    path = tmp_path / "endpoints.json"
+    coordinator = RoleSplitAutoscalerLoop(
+        pools, write_endpoints_path=str(path))
+    decisions = coordinator.tick()
+    assert set(decisions) == {"prefill", "decode"}
+    assert decisions["decode"]["signal"] == "slot_occupancy"
+    specs = FileEndpointSource(str(path)).specs()
+    assert sorted(normalize_spec(s) for s in specs) == [
+        ("decode:8500", None, "decode"),
+        ("prefill:8500", None, "prefill")]
+    roles = {row["role"] for row in coordinator.last_fleet}
+    assert roles == {"prefill", "decode"}
+    coordinator.stop()
+
+
+def test_role_split_loop_refuses_publishing_pools(tmp_path):
+    from kubeflow_tpu.scaling.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        AutoscalerLoop,
+        RoleSplitAutoscalerLoop,
+    )
+
+    bad = AutoscalerLoop(
+        Autoscaler(AutoscalerConfig(), _FakeScaler()),
+        discover=lambda: [],
+        write_endpoints_path=str(tmp_path / "x.json"))
+    with pytest.raises(ValueError, match="coordinator owns"):
+        RoleSplitAutoscalerLoop({"prefill": bad})
+
+
+# --- dashboard degrade ------------------------------------------------------
+
+def test_dashboard_fleet_section_renders_roles_and_degrades():
+    from kubeflow_tpu.dashboard.server import _fleet_section_html
+
+    html = _fleet_section_html({
+        "replicas": [
+            {"address": "a:8500", "reachable": True, "role": "decode",
+             "slot_occupancy": 0.5, "shards": 2,
+             "queue_wait_ms": 1.0, "shed_rate": 0.0,
+             "resident_models": ["m"]},
+            {"address": "b:8500", "reachable": True,
+             "role": "mystery-role", "shards": "garbage",
+             "queue_wait_ms": 1.0, "shed_rate": 0.0,
+             "resident_models": []},
+        ],
+        "decisions": {
+            "decode": {"action": "hold", "reason": "within",
+                       "signal": "slot_occupancy", "current": 2,
+                       "desired": 2, "mean_queue_wait_ms": 0.0,
+                       "target_queue_wait_ms": 100.0, "age_s": 1.0},
+        },
+    })
+    assert "decode (50% slots)" in html
+    assert "mystery-role" not in html  # degraded to any
+    assert "slot_occupancy" in html
+    # Malformed fleet never raises out of the renderer.
+    assert "unreadable" in _fleet_section_html(
+        {"replicas": object()})

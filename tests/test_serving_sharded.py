@@ -1,0 +1,323 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Sharded serving (ISSUE 10): export → load round-trip bitwise
+equality vs the monolithic path on a CPU n=2 mesh, greedy + sampled,
+through the real server and the pooled proxy; plus the n=1-manifest
+and backward-compat contracts."""
+
+import functools
+import json
+
+import numpy as np
+import pytest
+import tornado.testing
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from kubeflow_tpu.models.llama import llama_test
+from kubeflow_tpu.serving import sharding as sh
+from kubeflow_tpu.serving.export import (
+    PARAMS_FILE,
+    export_model,
+    read_metadata,
+    read_variables,
+)
+from kubeflow_tpu.serving.manager import ModelManager
+from kubeflow_tpu.serving.model import load_version
+from kubeflow_tpu.serving.signature import (
+    ModelMetadata,
+    Signature,
+    TensorSpec,
+)
+
+PROMPT_LEN = 8
+NEW_TOKENS = 6
+CACHE = 32
+
+
+def _metadata(temperature: float = 0.8) -> ModelMetadata:
+    return ModelMetadata(
+        model_name="sharded", registry_name="llama-test",
+        model_kwargs={"dtype": "float32", "cache_size": CACHE},
+        signatures={"serving_default": Signature(
+            "generate",
+            {"input_ids": TensorSpec("int32", (-1, PROMPT_LEN))},
+            {"tokens": TensorSpec("int32", (-1, NEW_TOKENS))})},
+        # deterministic: both the monolithic and the sharded server
+        # mint the SAME per-request keys, so sampled outputs are
+        # directly comparable across processes/servers.
+        generate_config={"max_new_tokens": NEW_TOKENS,
+                         "temperature": temperature, "seed": 5,
+                         "deterministic": True,
+                         "engine_slots": 2, "engine_page_size": 8,
+                         "engine_slice_tokens": 2})
+
+
+@pytest.fixture(scope="module")
+def exports(tmp_path_factory):
+    """One weight set, two layouts: monolithic and tensor=2 shards."""
+    base = tmp_path_factory.mktemp("sharded")
+    model = llama_test(dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, PROMPT_LEN), jnp.int32))
+    meta = _metadata()
+    export_model(str(base / "mono"), 1, meta,
+                 {"params": variables["params"]})
+    sh.export_model_sharded(str(base / "sharded"), 1, meta,
+                            {"params": variables["params"]},
+                            sh.ShardSpec(tensor=2))
+    return base, variables
+
+
+def _template():
+    model = llama_test(dtype=jnp.float32)
+    return jax.jit(functools.partial(model.init, train=False))(
+        jax.random.PRNGKey(0), jnp.zeros((1, PROMPT_LEN), jnp.int32))
+
+
+def _assert_tree_equal(a, b):
+    a_flat = jax.tree_util.tree_flatten_with_path(nn.meta.unbox(a))[0]
+    b_leaves = jax.tree.leaves(nn.meta.unbox(b))
+    assert len(a_flat) == len(b_leaves)
+    for (path, x), y in zip(a_flat, b_leaves):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), \
+            jax.tree_util.keystr(path)
+
+
+def test_roundtrip_host_bitwise_vs_monolithic(exports):
+    base, _ = exports
+    template = {"params": _template()["params"]}
+    mono = read_variables(str(base / "mono" / "1"), template)
+    meta = read_metadata(str(base / "sharded" / "1"))
+    assert meta.sharding["num_shards"] == 2
+    back = sh.read_sharded_variables(str(base / "sharded" / "1"),
+                                     template, meta)
+    _assert_tree_equal(mono, back)
+
+
+def test_monolithic_file_absent_from_sharded_dir(exports):
+    # An old (pre-sharding) server must fail LOUDLY on a sharded dir,
+    # not silently serve shard 0 as the whole model.
+    base, _ = exports
+    assert not (base / "sharded" / "1" / PARAMS_FILE).exists()
+
+
+def test_load_version_places_onto_mesh(exports):
+    base, _ = exports
+    loaded = load_version(str(base / "sharded" / "1"), max_batch=4)
+    assert loaded.mesh is not None
+    assert loaded.mesh.shape["tensor"] == 2
+    plan = loaded.metadata.sharding["plan"]
+    sharded_leaves = [
+        leaf for leaf in jax.tree.leaves(
+            nn.meta.unbox(loaded.variables))
+        if getattr(leaf, "sharding", None) is not None
+        and len(leaf.sharding.device_set) == 2
+        and not leaf.sharding.is_fully_replicated]
+    assert len(sharded_leaves) >= len(plan) > 0
+    topo = loaded.shard_topology()
+    assert topo["num_shards"] == 2 and topo["on_mesh"]
+    loaded.close()
+
+
+def test_sharded_serving_equals_monolithic_run(exports):
+    """Greedy AND sampled outputs through LoadedModel.run are
+    bitwise equal between the mesh-loaded and single-device model."""
+    base, _ = exports
+    mono = load_version(str(base / "mono" / "1"), max_batch=4)
+    mesh = load_version(str(base / "sharded" / "1"), max_batch=4)
+    prompt = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(3), (2, PROMPT_LEN), 0, 512))
+    out_mono = mono.run({"input_ids": prompt})  # sampled (temp 0.8)
+    out_mesh = mesh.run({"input_ids": prompt})
+    np.testing.assert_array_equal(out_mono["tokens"],
+                                  out_mesh["tokens"])
+    mono.close()
+    mesh.close()
+
+
+def test_n1_shard_spec_writes_monolithic_layout(tmp_path, exports):
+    """num_shards == 1 degrades to the classic layout: no manifest,
+    params.msgpack present, loads through the untouched path."""
+    _, variables = exports
+    path = sh.export_model_sharded(
+        str(tmp_path / "n1"), 1, _metadata(),
+        {"params": variables["params"]}, sh.ShardSpec())
+    assert (tmp_path / "n1" / "1" / PARAMS_FILE).exists()
+    meta = read_metadata(str(path))
+    assert meta.sharding is None
+    loaded = load_version(str(path), max_batch=4)
+    assert loaded.mesh is None
+    assert loaded.shard_topology() == {"num_shards": 1,
+                                       "on_mesh": False}
+    loaded.close()
+
+
+def test_signature_json_backcompat_without_sharding_key(exports):
+    # Monolithic signature.json must not carry the new key at all —
+    # and a file WITH an unknown-format manifest fails loudly.
+    base, _ = exports
+    doc = json.loads(
+        (base / "mono" / "1" / "signature.json").read_text())
+    assert "sharding" not in doc
+    meta = read_metadata(str(base / "sharded" / "1"))
+    import dataclasses
+
+    bad = dataclasses.replace(
+        meta, sharding={**meta.sharding, "format": 99})
+    with pytest.raises(ValueError, match="format 99"):
+        sh.read_sharded_variables(
+            str(base / "sharded" / "1"),
+            {"params": _template()["params"]}, bad)
+
+
+def test_shard_topology_degrades_on_malformed_manifest():
+    meta = _metadata()
+    import dataclasses
+
+    malformed = dataclasses.replace(
+        meta, sharding={"num_shards": "lots", "mesh": None})
+    topo = sh.shard_topology(malformed)
+    assert topo["num_shards"] == 1 and topo.get("malformed")
+
+
+def test_parse_shard_spec_forms():
+    assert sh.parse_shard_spec(None) == sh.ShardSpec()
+    assert sh.parse_shard_spec("2") == sh.ShardSpec(tensor=2)
+    assert sh.parse_shard_spec("tensor=2,fsdp=2") == sh.ShardSpec(
+        tensor=2, fsdp=2)
+    with pytest.raises(ValueError):
+        sh.parse_shard_spec("bogus=3")
+
+
+def test_mesh_mismatch_rejected(exports):
+    base, _ = exports
+    meta = read_metadata(str(base / "sharded" / "1"))
+    mesh = sh.serving_mesh(sh.ShardSpec(fsdp=2), jax.devices()[:2])
+    with pytest.raises(ValueError, match="must match the export"):
+        sh.load_sharded_variables(
+            str(base / "sharded" / "1"),
+            {"params": _template()["params"]}, meta, mesh)
+
+
+def test_export_cli_shards_flag(tmp_path):
+    from kubeflow_tpu.serving.export_cli import export_from_checkpoint
+
+    path = export_from_checkpoint(
+        registry_name="llama-test", out=str(tmp_path / "cli"),
+        version=1, seq_len=PROMPT_LEN,
+        generate_config={"max_new_tokens": NEW_TOKENS},
+        model_kwargs={"dtype": "float32"},
+        shard_spec=sh.parse_shard_spec("tensor=2"))
+    meta = read_metadata(path)
+    assert meta.sharding["num_shards"] == 2
+    loaded = load_version(path, max_batch=4)
+    assert loaded.mesh is not None
+    loaded.close()
+
+
+class ShardedServerEndToEnd(tornado.testing.AsyncHTTPTestCase):
+    """The acceptance path: a 2-chip-sharded toy model serves
+    :generate through the REAL server with outputs bitwise equal to
+    the single-chip server's (sampled — the stronger equality)."""
+
+    @pytest.fixture(autouse=True)
+    def _dir(self, exports):
+        type(self).base = exports[0]
+
+    def get_app(self):
+        from kubeflow_tpu.serving.server import make_app
+
+        manager = ModelManager()
+        self.manager = manager
+        manager.add_model("sharded", str(type(self).base / "sharded"),
+                          max_batch=4)
+        return make_app(manager)
+
+    def _post(self, body):
+        return self.fetch("/v1/models/sharded:generate",
+                          method="POST", body=json.dumps(body))
+
+    def test_sharded_server_matches_monolithic(self):
+        loaded = self.manager.get_model("sharded").get()
+        assert loaded.mesh is not None  # really serving off the mesh
+        mono = load_version(str(type(self).base / "mono" / "1"),
+                            max_batch=4)
+        # Full-width and short-prompt (length-bucket path) requests,
+        # each bitwise vs the single-chip model.
+        for prompt in ([[7] * PROMPT_LEN], [[11, 12, 13]]):
+            response = self._post({"instances": prompt})
+            assert response.code == 200, response.body
+            served = json.loads(response.body)["predictions"]
+            expect = mono.run(
+                {"input_ids": np.asarray(prompt)})["tokens"]
+            np.testing.assert_array_equal(
+                np.asarray(served[0]["tokens"]), expect[0])
+        mono.close()
+
+    def test_healthz_reports_shard_topology(self):
+        # Force a load first (healthz is 503 until then).
+        self._post({"instances": [[1] * PROMPT_LEN]})
+        response = self.fetch("/healthz")
+        assert response.code == 200
+        payload = json.loads(response.body)
+        topo = payload["saturation"]["sharded"]["sharding"]
+        assert topo["num_shards"] == 2
+        assert topo["mesh"] == {"tensor": 2, "fsdp": 1}
+        assert payload["role"] == "any"
+
+
+class ShardedThroughPooledProxy(tornado.testing.AsyncHTTPTestCase):
+    """Sharded backend behind the POOLED proxy (the r10 router):
+    the full acceptance wiring, outputs bitwise equal to the
+    single-chip path."""
+
+    @pytest.fixture(autouse=True)
+    def _dir(self, exports):
+        type(self).base = exports[0]
+
+    def get_app(self):
+        import tornado.httpserver
+        import tornado.testing as tt
+
+        from kubeflow_tpu.serving.http_proxy import make_app as proxy
+        from kubeflow_tpu.serving.server import make_app as server
+
+        manager = ModelManager()
+        self.manager = manager
+        manager.add_model("sharded", str(type(self).base / "sharded"),
+                          max_batch=4)
+        sock, port = tt.bind_unused_port()
+        backend = tornado.httpserver.HTTPServer(server(manager))
+        backend.add_sockets([sock])
+        self.backend_port = port
+        return proxy(rpc_address=f"127.0.0.1:{port}", grpc_address=None)
+
+    def test_generate_through_proxy_bitwise(self):
+        response = self.fetch(
+            "/model/sharded:generate", method="POST",
+            body=json.dumps({"instances": [[7] * PROMPT_LEN]}))
+        assert response.code == 200, response.body
+        served = json.loads(response.body)["predictions"]
+        mono = load_version(str(type(self).base / "mono" / "1"),
+                            max_batch=4)
+        expect = mono.run({"input_ids": np.asarray(
+            [[7] * PROMPT_LEN])})["tokens"]
+        np.testing.assert_array_equal(
+            np.asarray(served[0]["tokens"]), expect[0])
+        mono.close()
