@@ -360,6 +360,49 @@ def continuous_main() -> int:
     return 0 if result["continuous_wins"] else 1
 
 
+def prefix_main() -> int:
+    """`python bench.py --prefix`: open-loop chat replay with a
+    shared system prompt, r14 cold-prefill baseline vs the prefix-
+    cache engine at the same offered load (ISSUE 11 acceptance: ≥70%
+    hit rate cuts mean TTFT ≥3×, bitwise greedy+sampled). Prints ONE
+    JSON line shaped like the headline bench."""
+    from kubeflow_tpu.utils.platform import sync_platform_from_env
+
+    sync_platform_from_env()
+
+    from kubeflow_tpu.serving.benchmark import (
+        PrefixBenchConfig,
+        run_prefix_benchmark,
+    )
+
+    result = run_prefix_benchmark(PrefixBenchConfig())
+    cfg = result["config"]
+    print(json.dumps({
+        "metric": "prefix_cache_mean_ttft_ratio",
+        "value": result["mean_ttft_ratio"],
+        "unit": (f"cold/warm mean TTFT at {result['offered_rps']} "
+                 f"rps open-loop ({cfg['system_prompt_len']}-token "
+                 f"shared prefix + {cfg['suffix_len']}-token "
+                 f"suffixes, {cfg['num_prefixes']} conversations x "
+                 f"{cfg['num_requests']} requests)"),
+        "vs_baseline": None,  # the cold-prefill engine IS the baseline
+        "extra": {
+            "hit_rate": result["hit_rate"],
+            "cold_mean_ttft_ms": result["cold"]["mean_ttft_ms"],
+            "warm_mean_ttft_ms": result["warm"]["mean_ttft_ms"],
+            "cold_p99_ttft_ms": result["cold"]["p99_ttft_ms"],
+            "warm_p99_ttft_ms": result["warm"]["p99_ttft_ms"],
+            "cold_request_ms": result["cold_request_ms"],
+            "saved_prefill_tokens":
+                result["prefix_stats"]["saved_prefill_tokens"],
+            "evicted_pages": result["prefix_stats"]["evicted_pages"],
+            "bitwise_greedy_ok": result["bitwise_greedy_ok"],
+            "bitwise_sampled_ok": result["bitwise_sampled_ok"],
+        },
+    }))
+    return 0 if result["prefix_wins"] else 1
+
+
 def main() -> int:
     if "--controller" in sys.argv:
         return controller_main()
@@ -371,6 +414,8 @@ def main() -> int:
         return router_main()
     if "--continuous" in sys.argv:
         return continuous_main()
+    if "--prefix" in sys.argv:
+        return prefix_main()
     if "--slo" in sys.argv:
         return slo_main()
     from kubeflow_tpu.utils.platform import sync_platform_from_env

@@ -883,6 +883,25 @@ def _fleet_section_html_unsafe(fleet) -> str:
                          and role == "decode" else role)
         except (TypeError, ValueError):
             role_cell = role
+        # Page pressure + prefix-cache hit rate (ISSUE 11): the KV
+        # page pool can be the binding constraint while slots look
+        # free. Absent/malformed values degrade to "-", never 500.
+        pages_cell = "-"
+        try:
+            page_occ = r.get("page_occupancy")
+            if page_occ is not None:
+                pages_cell = f"{float(page_occ) * 100:.0f}%"
+        except (TypeError, ValueError):
+            pages_cell = "-"
+        try:
+            hit_rate = r.get("prefix_hit_rate")
+            if pages_cell != "-" and hit_rate is not None:
+                # Per-value degrade: a malformed hit rate drops only
+                # its own suffix, never the valid occupancy number.
+                pages_cell += (f" ({float(hit_rate) * 100:.0f}% "
+                               f"prefix hits)")
+        except (TypeError, ValueError):
+            pass
         rows.append(
             "<tr>"
             f"<td><code>{html.escape(str(r.get('address', '')))}"
@@ -892,6 +911,7 @@ def _fleet_section_html_unsafe(fleet) -> str:
             f"<td>{html.escape(role_cell)}</td>"
             f"<td>{shards if shards > 1 else '-'}</td>"
             f"<td>{wait}</td><td>{shed}</td>"
+            f"<td>{html.escape(pages_cell)}</td>"
             f"<td>{html.escape(models)}</td>"
             "</tr>")
 
@@ -921,7 +941,8 @@ def _fleet_section_html_unsafe(fleet) -> str:
     return (
         "<table>\n<tr><th>Replica</th><th>Health</th><th>Role</th>"
         "<th>Shards</th>"
-        "<th>Queue wait</th><th>Shed</th><th>Models</th></tr>\n"
+        "<th>Queue wait</th><th>Shed</th><th>Pages</th>"
+        "<th>Models</th></tr>\n"
         + "\n".join(rows) + "\n</table>\n" + decision
         + "<p>JSON: <a href=\"/tpujobs/api/fleet\">"
           "/tpujobs/api/fleet</a></p>")

@@ -20,6 +20,12 @@ queued requests admit *between* K-token slices (prefill into a free
 slot — no full-batch recompile), with the KV cache page-managed
 (:mod:`paged_kv`) instead of rebuilt per batch, and tokens streamed
 back incrementally as they are sampled.
+
+With ``EngineConfig.prefix_cache`` (ISSUE 11), a content-addressed
+radix index over the page pool (:mod:`prefix_cache`) shares common
+prompt prefixes copy-on-write across requests: admission matches the
+longest cached prefix, ref-counts the shared pages, and prefills only
+the tail — bitwise equal to cold prefill.
 """
 
 from kubeflow_tpu.inference.engine.engine import (  # noqa: F401
@@ -31,6 +37,10 @@ from kubeflow_tpu.inference.engine.engine import (  # noqa: F401
 from kubeflow_tpu.inference.engine.paged_kv import (  # noqa: F401
     PageAllocator,
     PagedKVCache,
+)
+from kubeflow_tpu.inference.engine.prefix_cache import (  # noqa: F401
+    PrefixCache,
+    PrefixMatch,
 )
 from kubeflow_tpu.inference.engine.slots import (  # noqa: F401
     Slot,

@@ -63,6 +63,7 @@ from kubeflow_tpu.inference.engine.paged_kv import (
     _gather_logical,
     _scatter_token_range,
 )
+from kubeflow_tpu.inference.engine.prefix_cache import PrefixMatch
 from kubeflow_tpu.inference.engine.slots import Slot, SlotScheduler
 from kubeflow_tpu.inference.generate import (
     _prefill_jit,
@@ -121,6 +122,32 @@ _M_INTER = obs_metrics.Histogram(
     "kft_serving_inter_token_seconds",
     "Per-token decode pacing (slice wall time / slice tokens)",
     ("model",))
+# Prefix-cache families (ISSUE 11): hit/miss/evict counters plus the
+# saved-prefill-tokens histogram the TTFT win is made of. Evicted and
+# cached-pages ride render-time callbacks off the live cache (one
+# source of truth, owner-checked clears at stop()).
+_M_PREFIX_HITS = obs_metrics.Counter(
+    "kft_engine_prefix_hits_total",
+    "Admissions that matched a cached prompt prefix", ("model",))
+_M_PREFIX_MISSES = obs_metrics.Counter(
+    "kft_engine_prefix_misses_total",
+    "Admissions with no cached prefix match", ("model",))
+_M_PREFIX_EVICTED = obs_metrics.Counter(
+    "kft_engine_prefix_evicted_pages_total",
+    "Cached prefix pages evicted under page pressure (LRU over "
+    "zero-ref pages)", ("model",))
+_M_PREFIX_SAVED = obs_metrics.Histogram(
+    "kft_engine_prefix_saved_tokens",
+    "Prefill tokens skipped per prefix-cache hit",
+    ("model",),
+    buckets=(1.0, 4.0, 16.0, 64.0, 256.0, 1024.0, 4096.0))
+_M_PREFIX_PAGES = obs_metrics.Gauge(
+    "kft_engine_prefix_cached_pages",
+    "Resident pages indexed by the prefix cache", ("model",))
+_M_PAGE_OCC = obs_metrics.Gauge(
+    "kft_engine_page_occupancy",
+    "Fraction of the KV page pool allocated or reserved "
+    "(cached-idle pages count as headroom)", ("model",))
 
 
 @dataclasses.dataclass
@@ -269,9 +296,23 @@ class PrefillHandoff:
     prompt_width: int  # prefill bucket width (pad + prompt)
     max_new_tokens: int
     step_keys: np.ndarray  # [max_new_tokens, 2] uint32
+    #: Cache layout the prefill ran in: ``left`` (classic left-padded
+    #: prompt at ``[width-len, width)``) or ``right`` (prefix-cache
+    #: pad-0 — prompt at ``[0, len)``, ``prompt_width == prompt_len``).
+    #: An engine only adopts its own layout; the server maps the
+    #: mismatch to a 400 and the proxy falls back to classic routing.
+    layout: str = "left"
+    #: The prompt ids themselves (``right`` layout): the adopting
+    #: engine indexes the carried pages in ITS prefix cache, which is
+    #: what turns the r14 handoff blob into a fleet-wide warm
+    #: transfer — prefill once, adopt (and cache) everywhere.
+    prompt_tokens: Optional[np.ndarray] = None
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)  # identity equality: the queued-
+# cancel sweep removes by instance, and the generated field-wise eq
+# compares numpy prompts (ambiguous broadcast ValueError between two
+# different-length queued requests)
 class _Request:
     prompt: np.ndarray  # [L] int32
     step_keys: np.ndarray  # [max_new_tokens, 2] uint32 sampling keys
@@ -315,6 +356,13 @@ class EngineConfig:
     #: it a flood of deadline-free requests grows pending without
     #: limit while the deadline gate never fires).
     queue_capacity: int = 4096
+    #: cross-request prefix KV cache (ISSUE 11): admissions switch to
+    #: the pad-0 (right-padded) prompt layout so prompt token i always
+    #: lands at cache position i, prompt pages are content-indexed by
+    #: a radix of token-block hashes, and a matching prefix is shared
+    #: copy-on-write instead of re-prefilled. Output stays bitwise
+    #: equal to cold prefill (greedy + sampled).
+    prefix_cache: bool = False
 
     @staticmethod
     def from_generate_config(cfg: dict, max_prompt_len: int,
@@ -337,7 +385,44 @@ class EngineConfig:
             num_pages=cfg.get("engine_num_pages"),
             queue_capacity=(4096 if queue_capacity is None
                             else int(queue_capacity)),
+            prefix_cache=bool(cfg.get("engine_prefix_cache", False)),
         )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "temperature", "eos_id", "top_k",
+                     "top_p"))
+def _prefill_ctx_jit(model, params, token_block, cache, start,
+                     last_col, first_rng, *, temperature, eos_id,
+                     top_k, top_p):
+    """Pad-0 (right-padded) prompt pass for prefix-cache mode, cold
+    AND continuation in one program: ``token_block`` [1, W] holds
+    prompt tokens ``[start, start + real)`` right-padded to a static
+    bucket width, and ``cache`` carries the already-resident prefix
+    at positions ``[0, start)`` with its scalar ``index`` leaves at
+    ``start`` (the zero template with index 0 for a cold prefill).
+    The model's scalar append path writes the block at ``[start,
+    start + W)`` and attends causally from ``q_offset = start``, so
+    the right-pad garbage never reaches a real token's attention
+    (causality IS the mask — same argument as the slice path's
+    validity==causality contract) and garbage K/V lands only at
+    positions the decode overwrites or masks. Next-token logits are
+    read at the LAST REAL column ``last_col``; ``start``/``last_col``
+    are traced, so prefix hits of every length share one compile per
+    block width."""
+    b, width = token_block.shape
+    positions = start + jnp.broadcast_to(
+        jnp.arange(width, dtype=jnp.int32)[None, :], (b, width))
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, token_block, positions,
+        mutable=["cache"])
+    last_logits = jnp.take(logits, last_col, axis=1)  # [B, V]
+    first = _sample_logits(last_logits, first_rng, temperature,
+                           top_k, top_p)
+    done = (first == eos_id) if eos_id is not None else \
+        jnp.zeros((b,), bool)
+    return mutated["cache"], first, done
 
 
 def _decode_slice(model, params, physical, tables, write_pos,
@@ -413,6 +498,17 @@ class DecodeEngine:
             num_pages=config.num_pages, mesh=mesh)
         self.scheduler = SlotScheduler(config.num_slots,
                                        self.kv.allocator)
+        #: Cross-request prefix cache (prefix_cache.py) or None. Built
+        #: here so the allocator's retained-page custody is wired
+        #: before the first admission.
+        self.prefix = None
+        if config.prefix_cache:
+            from kubeflow_tpu.inference.engine.prefix_cache import (
+                PrefixCache,
+            )
+
+            self.prefix = PrefixCache(config.page_size,
+                                      self.kv.allocator)
         self._cv = threading.Condition()
         self._thread: Optional[threading.Thread] = None
         self._closed = False
@@ -439,6 +535,18 @@ class DecodeEngine:
         self._g_queue.set_function(self.scheduler.queue_depth)
         self._g_pages = _M_FREE_PAGES.labels(name)
         self._g_pages.set_function(self.kv.allocator.available)
+        self._g_occupancy = _M_PAGE_OCC.labels(name)
+        self._g_occupancy.set_function(self.page_occupancy)
+        if self.prefix is not None:
+            self._m_prefix_hits = _M_PREFIX_HITS.labels(name)
+            self._m_prefix_misses = _M_PREFIX_MISSES.labels(name)
+            self._m_prefix_saved = _M_PREFIX_SAVED.labels(name)
+            self._m_prefix_evicted = _M_PREFIX_EVICTED.labels(name)
+            self._m_prefix_evicted.set_function(
+                self._prefix_evicted_total)
+            self._g_prefix_pages = _M_PREFIX_PAGES.labels(name)
+            self._g_prefix_pages.set_function(
+                self.prefix.resident_pages)
 
     # -- submit side -----------------------------------------------------
 
@@ -460,6 +568,28 @@ class DecodeEngine:
         return (queued + 1) * prefill + slice_s * (
             1.0 + queued / max(1, self.config.num_slots))
 
+    def page_occupancy(self) -> float:
+        """Fraction of the pool allocated to live slots or spoken for
+        by reservations — the page-pressure number /healthz and the
+        autoscaler read. Cached-idle pages count as headroom (they
+        reclaim on demand), matching the admission gate's own
+        arithmetic."""
+        alloc = self.kv.allocator
+        total = alloc.num_pages - 1
+        return (total - alloc.available()) / total if total else 1.0
+
+    def _prefix_evicted_total(self) -> float:
+        return float(self.prefix.evicted_pages) if self.prefix \
+            else 0.0
+
+    def clear_prefix_cache(self) -> int:
+        """Drop every cached prefix (idle pages return to the free
+        list immediately; pinned ones when their slot retires).
+        QUIESCED callers only — warmup teardown and tests: the index
+        is engine-thread state, and this must not race a live
+        admission."""
+        return self.prefix.clear() if self.prefix is not None else 0
+
     def run_prefill(self, prompt: np.ndarray, *,
                     rng: Optional[np.ndarray] = None,
                     max_new_tokens: Optional[int] = None
@@ -470,7 +600,13 @@ class DecodeEngine:
         request thread may call it concurrently with the decode loop;
         the returned handoff feeds ``submit(handoff=...)`` on this or
         ANY engine serving the same export — the adopt path makes the
-        resumed decode bitwise equal to a local one."""
+        resumed decode bitwise equal to a local one. Deliberately
+        does NOT consult the prefix cache even when one is enabled:
+        the index is engine-thread-owned state and this method's
+        contract is request-thread callability, so a prefill-role
+        replica re-pays the full prefill (documented limitation,
+        docs/streaming.md "Prefix caching"; prefill-side reuse rides
+        the chunked-prefill work, ROADMAP #1)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if not 1 <= prompt.shape[0] <= self.config.max_prompt_len:
             raise ValueError(
@@ -485,8 +621,33 @@ class DecodeEngine:
         key = self._next_key() if rng is None else np.asarray(rng)
         step_keys = np.asarray(jax.random.split(
             jnp.asarray(key, jnp.uint32), budget))
-        width = self._bucket(prompt.shape[0])
-        pad = width - prompt.shape[0]
+        length = int(prompt.shape[0])
+        width = self._bucket(length)
+        if self.prefix is not None:
+            # Prefix-cache engines prefill in the pad-0 layout (prompt
+            # at [0, L), garbage right-pad masked by causality) so the
+            # blob's pages adopt straight into the shared-page layout
+            # AND carry the prompt ids for the adopter's index — the
+            # warm-transfer half of the seam.
+            block = np.zeros((1, width), np.int32)
+            block[0, :length] = prompt
+            cache, first, done = _prefill_ctx_jit(
+                self._model, self._params, jnp.asarray(block),
+                self._prefill_template,
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(length - 1, jnp.int32),
+                jnp.asarray(step_keys[0:1]),
+                temperature=self.config.temperature,
+                eos_id=self.config.eos_id, top_k=self.config.top_k,
+                top_p=self.config.top_p)
+            return PrefillHandoff(
+                cache=jax.tree.map(np.asarray, cache),
+                first_token=int(np.asarray(first)[0]),
+                done=bool(np.asarray(done)[0]),
+                prompt_len=length, prompt_width=length,
+                max_new_tokens=budget, step_keys=step_keys,
+                layout="right", prompt_tokens=prompt.copy())
+        pad = width - length
         padded = np.zeros((1, width), np.int32)
         padded[0, pad:] = prompt
         carry, _ = _prefill_jit(
@@ -501,7 +662,7 @@ class DecodeEngine:
             cache=jax.tree.map(np.asarray, prefill_cache),
             first_token=int(np.asarray(first)[0]),
             done=bool(np.asarray(done)[0]),
-            prompt_len=int(prompt.shape[0]), prompt_width=width,
+            prompt_len=length, prompt_width=width,
             max_new_tokens=budget, step_keys=step_keys)
 
     def submit(self, prompt: Optional[np.ndarray] = None, *,
@@ -535,6 +696,18 @@ class DecodeEngine:
                     f"max_new_tokens {max_new_tokens} != handoff's "
                     f"{handoff.max_new_tokens} — the step-key "
                     f"schedule was derived at prefill time")
+            layout = getattr(handoff, "layout", "left") or "left"
+            expected = "right" if self.prefix is not None else "left"
+            if layout != expected:
+                # Adopting a left-padded cache into the pad-0 shared
+                # layout (or vice versa) would place the prompt at the
+                # wrong cache positions — reject with a clear error
+                # (the server maps it to a 400; the proxy falls back
+                # to the classic path during a mixed rollout).
+                raise ValueError(
+                    f"handoff layout {layout!r} incompatible with "
+                    f"this engine's {expected!r} layout (prefix "
+                    f"caching {'on' if expected == 'right' else 'off'})")
             max_bucket = self._bucket(self.config.max_prompt_len)
             if not 1 <= handoff.prompt_width <= max_bucket:
                 raise ValueError(
@@ -549,7 +722,16 @@ class DecodeEngine:
                 raise ValueError(
                     f"handoff carries {len(handoff.step_keys)} step "
                     f"keys for a {budget}-token budget")
-            prompt = np.zeros((handoff.prompt_len,), np.int32)
+            if handoff.prompt_tokens is not None:
+                prompt = np.asarray(handoff.prompt_tokens,
+                                    np.int32).reshape(-1)
+                if prompt.shape[0] != handoff.prompt_len:
+                    raise ValueError(
+                        f"handoff carries {prompt.shape[0]} prompt "
+                        f"tokens but claims prompt_len "
+                        f"{handoff.prompt_len}")
+            else:
+                prompt = np.zeros((handoff.prompt_len,), np.int32)
         else:
             prompt = np.asarray(prompt, np.int32).reshape(-1)
             if not 1 <= prompt.shape[0] <= self.config.max_prompt_len:
@@ -567,8 +749,13 @@ class DecodeEngine:
         # A worst-case reservation that can NEVER fit the pool would
         # sit at the FIFO head forever (admission holds the line for
         # the head) — fail it at submit, not by hanging the queue.
-        width = (handoff.prompt_width if handoff is not None
-                 else self._bucket(prompt.shape[0]))
+        # (The worst case assumes NO prefix hit: a matched prefix can
+        # be evicted between submit and admission.)
+        if self.prefix is not None:
+            width = int(prompt.shape[0])  # pad-0 layout: true length
+        else:
+            width = (handoff.prompt_width if handoff is not None
+                     else self._bucket(prompt.shape[0]))
         need = self.kv.pages_for(width + budget)
         usable = self.kv.allocator.num_pages - 1
         if need > usable:
@@ -661,13 +848,27 @@ class DecodeEngine:
         for req in list(self.scheduler.pending):
             req.stream._fail(err)
         self.scheduler.pending.clear()
+        if self.prefix is not None:
+            if not still_running:
+                # Drain the cache so the pool releases cleanly (the
+                # acceptance invariant: a stopped engine holds zero
+                # resident pages). A busy thread still owns the
+                # allocator — leave custody to die with the object.
+                self.prefix.clear()
+            # Callback clears are pure registry ops — run them even
+            # when the thread is busy, or the registry-lifetime
+            # gauges pin the dead engine (params + page pool) and
+            # keep exporting its stale stats.
+            self._m_prefix_evicted.clear_function(self)
+            self._g_prefix_pages.clear_function(self.prefix)
         self._g_slots.clear_function(self.scheduler)
         self._g_queue.clear_function(self.scheduler)
         self._g_pages.clear_function(self.kv.allocator)
+        self._g_occupancy.clear_function(self)
 
     def stats(self) -> dict:
         alloc = self.kv.allocator
-        return {
+        out = {
             "slots": self.config.num_slots,
             "active_slots": self.scheduler.occupancy(),
             "queue_depth": self.scheduler.queue_depth(),
@@ -675,10 +876,15 @@ class DecodeEngine:
             "retired": dict(self.scheduler.retired_by),
             "free_pages": alloc.free_pages,
             "reserved_pages": alloc.reserved_pages,
+            "retained_pages": alloc.retained_pages,
             "total_pages": alloc.num_pages - 1,
             "page_size": self.kv.page_size,
+            "page_occupancy": round(self.page_occupancy(), 4),
             "est_ttft_ms": round(self.estimated_ttft_s() * 1e3, 3),
         }
+        if self.prefix is not None:
+            out["prefix_cache"] = self.prefix.stats()
+        return out
 
     # -- engine thread ---------------------------------------------------
 
@@ -715,9 +921,26 @@ class DecodeEngine:
                              self.config.prompt_buckets)
 
     def _budget_pages(self, req: _Request) -> int:
-        width = (req.handoff.prompt_width if req.handoff is not None
-                 else self._bucket(len(req.prompt)))
+        if self.prefix is not None:
+            width = len(req.prompt)  # pad-0 layout: true length
+        elif req.handoff is not None:
+            width = req.handoff.prompt_width
+        else:
+            width = self._bucket(len(req.prompt))
         return self.kv.pages_for(width + req.max_new_tokens)
+
+    def _tail_width(self, length: int, start: int) -> int:
+        """Static block width for the continuation prefill of prompt
+        tokens ``[start, length)``: the shared prompt-bucket policy,
+        except never past the model's cache end — the scalar append's
+        ``dynamic_update_slice`` would CLAMP an overhanging write
+        backwards over the shared prefix. An overshooting bucket
+        falls back to the exact tail length (one extra compile in a
+        rare corner; the bucketed widths cover steady state)."""
+        width = self._bucket(length - start)
+        if start + width > self._model.cache_size:
+            width = length - start
+        return width
 
     def _expire(self) -> None:
         # Under _cv: expired_pending() swaps the pending deque for a
@@ -750,10 +973,49 @@ class DecodeEngine:
 
     def _admit(self) -> None:
         while True:
-            req = self.scheduler.next_admittable(self._budget_pages)
-            if req is None:
+            if self.prefix is not None:
+                admitted = self._admit_one_prefix()
+            else:
+                req = self.scheduler.next_admittable(
+                    self._budget_pages)
+                admitted = req is not None
+                if admitted:
+                    self._prefill_and_bind(req)
+            if not admitted:
                 return
-            self._prefill_and_bind(req)
+
+    def _admit_one_prefix(self) -> bool:
+        """One admission attempt in prefix-cache mode: match the FIFO
+        head's prompt, pin the matched resident pages, and reserve
+        only the private remainder. A failed reservation UNPINS
+        before holding the line — the head never deadlocks the FIFO
+        against its own pins (every page it waits for is then either
+        free, evictable, or held by a live slot that will retire)."""
+        sched = self.scheduler
+        if not sched.pending or not sched.has_free_slot():
+            return False
+        head = sched.pending[0]
+        total = self._budget_pages(head)
+        match = self.prefix.match(head.prompt)
+        if head.handoff is not None:
+            # A handoff arrives with its whole prefill — full-block
+            # sharing still saves pages, but a boundary fork has
+            # nothing to copy that the carried cache doesn't already
+            # hold, and a placeholder prompt (no tokens in the blob)
+            # must not "match" zeros.
+            entries = (match.entries
+                       if head.handoff.prompt_tokens is not None
+                       else [])
+            match = PrefixMatch(
+                entries=entries, fork=None, fork_len=0,
+                matched=len(entries) * self.kv.page_size)
+        match = self.prefix.pin(match)
+        if not self.kv.allocator.reserve(total - len(match.entries)):
+            self.prefix.unpin(match)
+            return False  # FIFO holds; nothing stays pinned
+        sched.pending.popleft()
+        self._prefill_and_bind_prefix(head, match)
+        return True
 
     def _prefill_and_bind(self, req: _Request) -> None:
         t0 = time.monotonic()
@@ -822,6 +1084,115 @@ class DecodeEngine:
             TRACER.record("engine_prefill", "engine", t0, t1 - t0,
                           self._span_args(req, slot=slot.index,
                                           prompt_len=length))
+        self._emit_token(slot, first)
+        if slot.done or slot.remaining == 0:
+            self._retire(slot, "eos" if slot.done else "budget")
+
+    def _prefill_and_bind_prefix(self, req: _Request,
+                                 match: "PrefixMatch") -> None:
+        """Prefix-mode admission: the caller (``_admit_one_prefix``)
+        already pinned ``match``'s pages and reserved the private
+        remainder. Shared full blocks enter the slot's page table
+        as-is; a partially matched boundary page is forked
+        copy-on-write (its common head rows ride the gathered B=1
+        cache into a PRIVATE page, because the tail prefill and the
+        decode will write past them); only the unmatched tail is
+        prefilled. Bitwise equal to a cold prefill: same tokens at
+        the same positions with the same step-key schedule, and the
+        K/V at position i is a pure function of tokens [0, i]."""
+        t0 = time.monotonic()
+        length = len(req.prompt)
+        budget_pages = self._budget_pages(req)
+        shared = match.shared_pages
+        m = match.matched
+        fork_pinned = match.fork is not None
+        try:
+            if req.handoff is not None:
+                prefill_cache = req.handoff.cache
+                first = int(req.handoff.first_token)
+                done = bool(req.handoff.done)
+            else:
+                if m > 0:
+                    page_row = list(shared)
+                    if match.fork is not None:
+                        page_row.append(match.fork.page)
+                    cache = self.kv.gather_prefix_cache(
+                        page_row, self._prefill_template, m)
+                    if fork_pinned:
+                        # The fork copy is dispatched (device ops
+                        # serialize in thread order); the donor page
+                        # is not this slot's to keep.
+                        self.prefix.unpin_fork(match)
+                        fork_pinned = False
+                else:
+                    cache = self._prefill_template
+                width = self._tail_width(length, m)
+                block = np.zeros((1, width), np.int32)
+                block[0, :length - m] = req.prompt[m:]
+                cache, first_a, done_a = _prefill_ctx_jit(
+                    self._model, self._params, jnp.asarray(block),
+                    cache, jnp.asarray(m, jnp.int32),
+                    jnp.asarray(length - m - 1, jnp.int32),
+                    jnp.asarray(req.step_keys[0:1]),
+                    temperature=self.config.temperature,
+                    eos_id=self.config.eos_id,
+                    top_k=self.config.top_k,
+                    top_p=self.config.top_p)
+                prefill_cache = cache
+                first = int(np.asarray(first_a)[0])
+                done = bool(np.asarray(done_a)[0])
+        except Exception as e:  # noqa: BLE001 — XLA OOM / compile
+            # Same contract as the classic path: the popped request
+            # holds a reservation AND pins — leak neither, fail only
+            # its own stream.
+            logger.exception("prefix prefill failed; shedding the "
+                             "request")
+            self.kv.allocator.unreserve(budget_pages - len(shared))
+            self.prefix.unpin(match, include_fork=fork_pinned)
+            _M_RETIRED.labels(self.name, "error").inc()
+            req.stream._fail(e)
+            return
+        slot = self.scheduler.bind(
+            req, prompt_width=length, pad_len=0, first_token=first,
+            done=done, budget_pages=budget_pages,
+            deadline=req.deadline)
+        slot.allocated_pages = self.kv.adopt(
+            slot.index, prefill_cache, length, budget_pages,
+            shared_pages=shared)
+        # Index this prompt's resident pages (new private blocks, plus
+        # the boundary partial). A handoff registration is the warm
+        # transfer landing: the pages this replica never prefilled
+        # become matchable for the next request.
+        if req.handoff is None or req.handoff.prompt_tokens is not None:
+            self.prefix.register(
+                req.prompt,
+                self.kv.tables[slot.index,
+                               :slot.allocated_pages].tolist())
+        t1 = time.monotonic()
+        if req.handoff is None:
+            if m > 0:
+                self.prefix.hits += 1
+                self.prefix.saved_tokens_total += m
+                self._m_prefix_hits.inc()
+                self._m_prefix_saved.observe(float(m))
+            else:
+                self.prefix.misses += 1
+                self._m_prefix_misses.inc()
+                # Only full (cold) prefills feed the estimator — a
+                # tail prefill's cost scales with the UNMATCHED length
+                # and would read a warm cache as a fast prefill for
+                # cold requests (same reasoning as the adopt-time
+                # exclusion below).
+                self._prefill_est.observe(t1 - t0)
+        self._m_admitted.inc()
+        ctx = req.stream.obs_ctx
+        self._m_ttft.observe(t1 - req.submitted_at,
+                             trace_id=ctx.trace_id if ctx else None)
+        if TRACER.enabled:
+            TRACER.record("engine_prefill", "engine", t0, t1 - t0,
+                          self._span_args(req, slot=slot.index,
+                                          prompt_len=length,
+                                          prefix_matched=m))
         self._emit_token(slot, first)
         if slot.done or slot.remaining == 0:
             self._retire(slot, "eos" if slot.done else "budget")

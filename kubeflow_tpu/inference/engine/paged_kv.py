@@ -52,11 +52,26 @@ import numpy as np
 
 
 class PageAllocator:
-    """Host-side free list + reservation accounting for the pool.
+    """Host-side free list + reservation + ref-count accounting.
 
     Only the engine thread mutates it; readers (metrics callbacks,
     admission estimates) see GIL-consistent ints. Page 0 is the null
     page and is never handed out.
+
+    Ref counts (prefix sharing, ISSUE 11): every allocated page
+    carries a count of the slots using it. ``alloc`` hands pages out
+    at ref 1; a slot adopting another request's resident prefix pages
+    ``ref``\\ s them instead of allocating copies, and ``unref`` at
+    retire is the ONLY decrementer. A page whose count reaches zero
+    either returns to the free list or — when the attached prefix
+    cache still indexes it — moves to *retained* custody: resident,
+    evictable, counted as headroom by ``available()`` and reclaimed
+    LRU-first when ``alloc`` outruns the free list. The FIFO
+    no-deadlock rule lives in two guards here: ``reserve`` admits
+    against free+retained (retained pages are always reclaimable, so
+    a reservation can never wait on a page only a live slot can
+    release), and ``ref`` refuses to pin a retained page when that
+    would eat a page an outstanding reservation was promised.
     """
 
     def __init__(self, num_pages: int):
@@ -66,6 +81,14 @@ class PageAllocator:
         self.num_pages = num_pages
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
         self._reserved = 0
+        self._refs: dict = {}  # page id -> live slot count (>= 1)
+        self._retained: set = set()  # zero-ref pages the cache holds
+        self._cache = None  # prefix cache (holds/on_idle/on_pinned/
+        #                     reclaim/reclaimable protocol) or None
+
+    def set_cache(self, cache) -> None:
+        """Attach the prefix cache that may retain zero-ref pages."""
+        self._cache = cache
 
     @property
     def free_pages(self) -> int:
@@ -76,10 +99,25 @@ class PageAllocator:
     def reserved_pages(self) -> int:
         return self._reserved
 
+    @property
+    def retained_pages(self) -> int:
+        """Zero-ref pages kept resident by the prefix cache
+        (evictable on demand — headroom, not pressure)."""
+        return len(self._retained)
+
+    @property
+    def inuse_pages(self) -> int:
+        """Pages referenced by at least one live slot."""
+        return len(self._refs)
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(int(page), 0)
+
     def available(self) -> int:
         """Pages neither allocated nor reserved — the admission gate's
-        number."""
-        return len(self._free) - self._reserved
+        number. Retained (zero-ref cached) pages count: they reclaim
+        on demand inside ``alloc``."""
+        return len(self._free) + len(self._retained) - self._reserved
 
     def reserve(self, n: int) -> bool:
         """Promise ``n`` pages to a slot (allocated later, lazily).
@@ -97,25 +135,118 @@ class PageAllocator:
         self._reserved -= n
 
     def alloc(self, n: int) -> List[int]:
-        """Convert ``n`` pages of reservation into concrete page ids.
-        The reservation invariant makes this infallible for reserved
-        callers; misuse raises rather than corrupting the pool."""
+        """Convert ``n`` pages of reservation into concrete page ids
+        (each at ref count 1), evicting LRU retained pages when the
+        free list alone can't cover it. The reservation invariant
+        makes this infallible for reserved callers; misuse raises
+        rather than corrupting the pool."""
         if n > self._reserved:
             raise ValueError(
                 f"alloc({n}) without reservation (reserved="
                 f"{self._reserved})")
+        if n > len(self._free) and self._cache is not None:
+            for p in self._cache.reclaim(n - len(self._free)):
+                self._retained.discard(int(p))
+                self._free.append(int(p))
         if n > len(self._free):
             raise RuntimeError(
                 f"pool corrupted: {n} pages reserved but only "
-                f"{len(self._free)} free")
+                f"{len(self._free)} free + {len(self._retained)} "
+                f"retained")
         self._reserved -= n
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._refs[p] = 1
+        return out
+
+    def ref(self, page: int) -> bool:
+        """Pin one more user onto a resident page. Pinning a RETAINED
+        page consumes a unit of availability, so it fails (False) when
+        outstanding reservations have already spoken for every
+        reclaimable page — the caller must treat the page as a miss,
+        never hold the admission line on it (FIFO no-deadlock rule)."""
+        page = int(page)
+        if page in self._refs:
+            self._refs[page] += 1
+            return True
+        if page in self._retained:
+            if self.available() < 1:
+                return False
+            self._retained.discard(page)
+            self._refs[page] = 1
+            if self._cache is not None:
+                self._cache.on_pinned(page)
+            return True
+        raise ValueError(f"ref({page}): page is neither allocated "
+                         f"nor retained")
+
+    def unref(self, page: int) -> None:
+        """Drop one user. At zero the page returns to the free list,
+        or to retained custody when the prefix cache still indexes
+        it."""
+        page = int(page)
+        count = self._refs.get(page)
+        if count is None:
+            raise ValueError(f"unref({page}): page has no refs")
+        if count > 1:
+            self._refs[page] = count - 1
+            return
+        del self._refs[page]
+        if self._cache is not None and self._cache.holds(page):
+            self._retained.add(page)
+            self._cache.on_idle(page)
+        else:
+            self._free.append(page)
+
+    def discard_retained(self, page: int) -> None:
+        """The prefix cache dropped its entry for an idle page —
+        return it to the free list."""
+        page = int(page)
+        if page not in self._retained:
+            raise ValueError(f"discard_retained({page}): not retained")
+        self._retained.discard(page)
+        self._free.append(page)
 
     def free(self, pages: Sequence[int]) -> None:
+        """Force-return pages to the free list regardless of count
+        (legacy single-owner paths and tests; shared pages must go
+        through ``unref``)."""
         for p in pages:
             if p == 0:
                 raise ValueError("page 0 is the null page")
+            self._refs.pop(int(p), None)
             self._free.append(int(p))
+
+    def check_invariants(self) -> None:
+        """Raise AssertionError on any accounting violation — the
+        eviction-fuzz harness calls this after every step."""
+        usable = self.num_pages - 1
+        free = set(self._free)
+        assert len(free) == len(self._free), \
+            f"duplicate pages on the free list: {sorted(self._free)}"
+        inuse = set(self._refs)
+        assert not (free & inuse), f"free∩inuse: {free & inuse}"
+        assert not (free & self._retained), \
+            f"free∩retained: {free & self._retained}"
+        assert not (inuse & self._retained), \
+            f"inuse∩retained: {inuse & self._retained}"
+        total = len(free) + len(inuse) + len(self._retained)
+        assert total == usable, \
+            f"page leak: {len(free)} free + {len(inuse)} inuse + " \
+            f"{len(self._retained)} retained != {usable} usable"
+        assert all(c >= 1 for c in self._refs.values()), \
+            f"non-positive refcount: {self._refs}"
+        assert 0 not in free | inuse | self._retained, \
+            "null page escaped into circulation"
+        assert self._reserved >= 0, f"negative reservation " \
+            f"{self._reserved}"
+        assert self._reserved <= len(free) + len(self._retained), \
+            f"reservation {self._reserved} exceeds reclaimable " \
+            f"{len(free)} free + {len(self._retained)} retained"
+        if self._cache is not None:
+            assert self._retained == set(self._cache.idle_pages()), \
+                "allocator retained set drifted from the cache's " \
+                "idle set"
 
 
 def _is_kv(leaf: jax.Array) -> bool:
@@ -167,9 +298,16 @@ def _scatter_token_range(physical: Any, logical: Any,
 
 @functools.partial(jax.jit, static_argnames=("n_pages",))
 def _adopt_prefill(physical: Any, prefill_cache: Any,
-                   page_ids: jax.Array, *, n_pages: int) -> Any:
-    """Copy a B=1 prefill cache's first ``n_pages`` pages worth of
-    slots into the pool pages just allocated to the admitting slot."""
+                   page_ids: jax.Array, first_page: jax.Array, *,
+                   n_pages: int) -> Any:
+    """Copy ``n_pages`` pages of a B=1 prefill cache, starting at
+    logical page ``first_page`` (traced — no recompile per prefix
+    length), into the pool pages just allocated to the admitting
+    slot. ``first_page`` is 0 for a cold adoption; a prefix-cache hit
+    skips the shared pages and adopts only the privately prefilled
+    tail — including the copy-on-write fork of a partially-matched
+    boundary page, whose shared head rows were gathered into the
+    prefill cache before the tail prefill wrote past them."""
 
     def a(ph, pc):
         if not _is_kv(ph):
@@ -177,12 +315,35 @@ def _adopt_prefill(physical: Any, prefill_cache: Any,
         _, p, h, d = ph.shape
         need = n_pages * p
         row = pc[0]
-        if row.shape[0] < need:  # cache_size not a page multiple
-            row = jnp.pad(row, ((0, need - row.shape[0]),
-                                (0, 0), (0, 0)))
-        return ph.at[page_ids].set(row[:need].reshape(n_pages, p, h, d))
+        pad = need  # worst-case start overhang, clamped by the slice
+        row = jnp.pad(row, ((0, pad), (0, 0), (0, 0)))
+        seg = jax.lax.dynamic_slice(
+            row, (first_page * p, 0, 0), (need, row.shape[1],
+                                          row.shape[2]))
+        return ph.at[page_ids].set(seg.reshape(n_pages, p, h, d))
 
     return jax.tree.map(a, physical, prefill_cache)
+
+
+@jax.jit
+def _gather_pages_to_cache(physical: Any, page_ids: jax.Array,
+                           template: Any, fill_len: jax.Array) -> Any:
+    """Materialize a slot-shaped page list as a contiguous B=1 cache:
+    page ``j`` of ``page_ids`` lands at cache rows ``[j·P, (j+1)·P)``
+    (null-page entries contribute zeros), and the scalar ``index``
+    leaves are set to ``fill_len`` so the model's scalar append path
+    continues the sequence at position ``fill_len`` — the
+    continuation-prefill half of a prefix-cache hit. One compile:
+    ``page_ids`` is always the full ``pages_per_slot`` row."""
+
+    def g(ph, t):
+        if not _is_kv(ph):
+            return jnp.full(t.shape, fill_len, t.dtype)
+        _, _, h, d = ph.shape
+        rows = ph[page_ids].reshape(-1, h, d)
+        return rows[: t.shape[1]][None, ...]
+
+    return jax.tree.map(g, physical, template)
 
 
 class PagedKVCache:
@@ -262,25 +423,49 @@ class PagedKVCache:
         return need
 
     def adopt(self, slot_index: int, prefill_cache: Any,
-              prompt_width: int, budget_pages: int) -> int:
-        """Admission: allocate the prompt's pages for ``slot_index``
-        and copy the B=1 prefill cache into them. Returns the
-        allocated page count."""
+              prompt_width: int, budget_pages: int,
+              shared_pages: Sequence[int] = ()) -> int:
+        """Admission: point the slot's leading table rows at the
+        already-resident ``shared_pages`` (the caller ref-counted
+        them), allocate private pages for the rest of the prompt, and
+        copy that tail range of the B=1 prefill cache into them.
+        Returns the total table rows filled (shared + private)."""
+        shared = len(shared_pages)
         n_pages = min(self.pages_for(prompt_width), budget_pages)
-        pages = self.allocator.alloc(n_pages)
-        self.tables[slot_index, :n_pages] = pages
-        self.physical = _adopt_prefill(
-            self.physical, prefill_cache,
-            jnp.asarray(np.asarray(pages, np.int32)), n_pages=n_pages)
+        if shared:
+            self.tables[slot_index, :shared] = list(shared_pages)
+        n_priv = n_pages - shared
+        if n_priv > 0:
+            pages = self.allocator.alloc(n_priv)
+            self.tables[slot_index, shared:n_pages] = pages
+            self.physical = _adopt_prefill(
+                self.physical, prefill_cache,
+                jnp.asarray(np.asarray(pages, np.int32)),
+                jnp.asarray(shared, jnp.int32), n_pages=n_priv)
         return n_pages
+
+    def gather_prefix_cache(self, page_ids: Sequence[int],
+                            template: Any, fill_len: int) -> Any:
+        """Shared prefix pages (padded with the null page to the full
+        slot row) → a contiguous B=1 cache with ``index = fill_len``,
+        ready for a continuation prefill of the unmatched tail."""
+        row = np.zeros((self.pages_per_slot,), np.int32)
+        row[: len(page_ids)] = list(page_ids)
+        return _gather_pages_to_cache(
+            self.physical, jnp.asarray(row), template,
+            jnp.asarray(fill_len, jnp.int32))
 
     def release_slot(self, slot_index: int, allocated: int,
                      unreserved_remainder: int) -> None:
-        """Retire: free the slot's pages, drop its remaining
-        reservation, null its table row."""
-        if allocated:
-            self.allocator.free(
-                self.tables[slot_index, :allocated].tolist())
+        """Retire: drop the slot's reference on every table row
+        (shared prefix pages survive under their other users or the
+        prefix cache's custody; single-owner pages free), drop its
+        remaining reservation, null its table row. Rows unref in
+        REVERSE so deeper prefix blocks go idle — and therefore evict
+        — before their parents (an orphaned child is unreachable for
+        matching but still occupies a page)."""
+        for p in reversed(self.tables[slot_index, :allocated].tolist()):
+            self.allocator.unref(int(p))
         if unreserved_remainder:
             self.allocator.unreserve(unreserved_remainder)
         self.tables[slot_index, :] = 0

@@ -100,6 +100,9 @@ class SlotScheduler:
     def queue_depth(self) -> int:
         return len(self.pending)
 
+    def has_free_slot(self) -> bool:
+        return bool(self._free)
+
     def has_capacity_for(self, budget_pages: int) -> bool:
         return bool(self._free) and self._allocator.available() >= \
             budget_pages

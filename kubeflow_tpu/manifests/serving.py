@@ -402,7 +402,8 @@ SERVING_PARAMS = [
           "Collector scrape interval (seconds)."),
     Param("balancer", "least_saturation", "string",
           "Router policy: round_robin | least_saturation | affinity "
-          "| role (prefill/decode pool splitting)."),
+          "| role (prefill/decode pool splitting) | prefix "
+          "(prompt-prefix affinity for prefix-cache fleets)."),
     Param("role", "any", "string",
           "Replica role for prefill/decode pool splitting: prefill | "
           "decode | any. Apply the prototype once per pool (e.g. "

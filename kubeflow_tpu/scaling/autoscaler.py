@@ -418,6 +418,8 @@ class AutoscalerLoop:
         queue_wait = 0.0
         shed = expired = 0.0
         slots = active_slots = 0.0
+        page_occupancy = None
+        prefix_hits = prefix_misses = 0.0
         shards = 1
         for stats in (payload.get("saturation") or {}).values():
             queue_wait += (float(stats.get("queue_depth", 0.0))
@@ -434,6 +436,18 @@ class AutoscalerLoop:
                 # saturated decode pool doesn't read as idle.
                 queue_wait += (float(engine.get("queue_depth", 0.0))
                                * float(engine.get("est_ttft_ms", 0.0)))
+                # Page pressure (ISSUE 11): slots can be free while
+                # the PAGE pool is the binding constraint (long
+                # contexts, pinned shared prefixes) — report the
+                # worst engine's occupancy so decode-pool scaling and
+                # the fleet dashboard see it.
+                if "page_occupancy" in engine:
+                    occ = float(engine["page_occupancy"])
+                    page_occupancy = (occ if page_occupancy is None
+                                      else max(page_occupancy, occ))
+                prefix = engine.get("prefix_cache") or {}
+                prefix_hits += float(prefix.get("hits", 0.0))
+                prefix_misses += float(prefix.get("misses", 0.0))
             except (TypeError, ValueError):
                 pass  # malformed engine stats degrade, never raise
             try:
@@ -473,6 +487,11 @@ class AutoscalerLoop:
             # HBM-bound pool's capacity number (a decode replica with
             # empty slots is idle whatever its queue math says).
             row["slot_occupancy"] = round(active_slots / slots, 4)
+        if page_occupancy is not None:
+            row["page_occupancy"] = round(page_occupancy, 4)
+        if prefix_hits + prefix_misses > 0:
+            row["prefix_hit_rate"] = round(
+                prefix_hits / (prefix_hits + prefix_misses), 4)
         return row
 
     def _scrape_one(self, address: str

@@ -35,6 +35,11 @@ counter, so they are swappable per proxy flag:
   past ``overload_ms``) or the model is resident nowhere —
   affinity is a latency optimization, never a availability
   constraint.
+- **prefix** — TRUE prefix affinity (ISSUE 11): rendezvous-hash the
+  request's normalized prompt-prefix key onto the pool so
+  repeat-prefix traffic lands on the replica whose engine prefix
+  cache already holds those KV pages; same overload fallback
+  contract as resident affinity.
 
 Eligibility (``eligible_endpoints``) is shared by every policy and by
 the proxy's failover loop: skip ejected/draining members and members
@@ -46,20 +51,53 @@ prober)."""
 
 from __future__ import annotations
 
+import hashlib
 import threading
-from typing import List, Optional, Sequence
+from typing import Any, List, Optional, Sequence
 
 from kubeflow_tpu.scaling.endpoints import Endpoint, EndpointPool
 
 __all__ = [
     "Balancer",
     "LeastSaturationBalancer",
+    "PrefixAffinityBalancer",
     "ResidentAffinityBalancer",
     "RoleAwareBalancer",
     "RoundRobinBalancer",
     "eligible_endpoints",
     "make_balancer",
+    "normalize_prefix_key",
 ]
+
+#: Tokens of prompt prefix that name a request's affinity bucket.
+#: Long enough that distinct system prompts separate, short enough
+#: that the same system prompt + different user turns collide (the
+#: point: they share the cached prefix pages).
+PREFIX_KEY_TOKENS = 64
+
+
+def normalize_prefix_key(instances: Any,
+                         tokens: int = PREFIX_KEY_TOKENS
+                         ) -> Optional[str]:
+    """Normalized prompt-prefix hash for affinity routing (ISSUE 11):
+    the FIRST row's first ``tokens`` token ids, digested. Requests
+    sharing a system prompt / few-shot header map to one key whatever
+    their suffix, so the balancer can route them to the replica whose
+    prefix cache already holds those pages. Returns None for
+    malformed/empty instances (the caller routes phase/saturation-
+    wise — never 500 on user input)."""
+    try:
+        row = instances[0]
+        ids = [int(t) for t in list(row)[:tokens]]
+        if not ids:
+            return None
+        h = hashlib.blake2b(digest_size=8)
+        for t in ids:
+            h.update(t.to_bytes(8, "little", signed=True))
+        return h.hexdigest()
+    except (TypeError, ValueError, IndexError, KeyError,
+            OverflowError):
+        return None
 
 #: A breaker-open endpoint re-enters the candidate set this close to
 #: (or past) its half-open due time — the pick that lands on it IS the
@@ -105,10 +143,14 @@ class Balancer:
 
     def pick(self, candidates: Sequence[Endpoint],
              model: Optional[str] = None,
-             phase: Optional[str] = None) -> Optional[Endpoint]:
+             phase: Optional[str] = None,
+             prefix_key: Optional[str] = None) -> Optional[Endpoint]:
         """``phase`` is the request's dominant serving phase
         (``prefill`` | ``decode`` | None) — only role-aware policies
-        read it; the rest route phase-blind."""
+        read it. ``prefix_key`` is the request's normalized
+        prompt-prefix hash (``normalize_prefix_key``) — only
+        prefix-affinity policies read it; the rest route blind to
+        both."""
         raise NotImplementedError
 
 
@@ -117,7 +159,8 @@ class RoundRobinBalancer(Balancer):
 
     def pick(self, candidates: Sequence[Endpoint],
              model: Optional[str] = None,
-             phase: Optional[str] = None) -> Optional[Endpoint]:
+             phase: Optional[str] = None,
+             prefix_key: Optional[str] = None) -> Optional[Endpoint]:
         if not candidates:
             return None
         return candidates[self._next_index(len(candidates))]
@@ -128,7 +171,8 @@ class LeastSaturationBalancer(Balancer):
 
     def pick(self, candidates: Sequence[Endpoint],
              model: Optional[str] = None,
-             phase: Optional[str] = None) -> Optional[Endpoint]:
+             phase: Optional[str] = None,
+             prefix_key: Optional[str] = None) -> Optional[Endpoint]:
         if not candidates:
             return None
         offset = self._next_index(len(candidates))  # rotating tiebreak
@@ -153,7 +197,8 @@ class ResidentAffinityBalancer(Balancer):
 
     def pick(self, candidates: Sequence[Endpoint],
              model: Optional[str] = None,
-             phase: Optional[str] = None) -> Optional[Endpoint]:
+             phase: Optional[str] = None,
+             prefix_key: Optional[str] = None) -> Optional[Endpoint]:
         if not candidates:
             return None
         if model:
@@ -162,6 +207,52 @@ class ResidentAffinityBalancer(Balancer):
                         and ep.saturation_score() < self.overload_ms]
             if resident:
                 return self._fallback.pick(resident, model)
+        return self._fallback.pick(candidates, model)
+
+
+class PrefixAffinityBalancer(Balancer):
+    """TRUE prefix affinity (ISSUE 11): requests sharing a normalized
+    prompt-prefix hash route to the same replica, so repeat-prefix
+    traffic lands where its KV pages are already cached and the
+    engine's prefix cache turns the prefill into a page share.
+
+    The placement is rendezvous (highest-random-weight) hashing of
+    ``(prefix_key, replica address)`` — stateless (no table to cap or
+    age), stable under membership churn (only keys owned by a
+    departed replica move), and uniformly spread across the pool for
+    distinct prefixes. The shared fallback contract applies: a chosen
+    replica that is overloaded past ``overload_ms`` (or a request
+    with no usable key — non-generate verbs, malformed instances)
+    falls back to least-saturation over the whole candidate set.
+    Affinity buys cache hits, never hotspots or unavailability."""
+
+    name = "prefix"
+
+    def __init__(self, overload_ms: float = 500.0):
+        super().__init__()
+        self.overload_ms = overload_ms
+        self._fallback = LeastSaturationBalancer()
+
+    @staticmethod
+    def _weight(prefix_key: str, address: str) -> int:
+        h = hashlib.blake2b(digest_size=8)
+        h.update(prefix_key.encode("utf-8"))
+        h.update(b"\x00")
+        h.update(address.encode("utf-8"))
+        return int.from_bytes(h.digest(), "little")
+
+    def pick(self, candidates: Sequence[Endpoint],
+             model: Optional[str] = None,
+             phase: Optional[str] = None,
+             prefix_key: Optional[str] = None) -> Optional[Endpoint]:
+        if not candidates:
+            return None
+        if prefix_key:
+            home = max(candidates,
+                       key=lambda ep: self._weight(prefix_key,
+                                                   ep.address))
+            if home.saturation_score() < self.overload_ms:
+                return home
         return self._fallback.pick(candidates, model)
 
 
@@ -183,10 +274,12 @@ class RoleAwareBalancer(Balancer):
         super().__init__()
         self.overload_ms = overload_ms
         self._fallback = LeastSaturationBalancer()
+        self._prefix = PrefixAffinityBalancer(overload_ms)
 
     def pick(self, candidates: Sequence[Endpoint],
              model: Optional[str] = None,
-             phase: Optional[str] = None) -> Optional[Endpoint]:
+             phase: Optional[str] = None,
+             prefix_key: Optional[str] = None) -> Optional[Endpoint]:
         if not candidates:
             return None
         if phase:
@@ -195,7 +288,15 @@ class RoleAwareBalancer(Balancer):
             healthy = [ep for ep in matching
                        if ep.saturation_score() < self.overload_ms]
             if healthy:
-                return self._fallback.pick(healthy, model)
+                # Prefix affinity INSIDE the role pool (ISSUE 11):
+                # the decode hop carries the request's prefix key, and
+                # decode replicas are where adopted pages live —
+                # rendezvous-place within the healthy matching set so
+                # repeat-prefix traffic finds its cache (the inner
+                # policy degrades to least-saturation when keyless or
+                # when the home replica is overloaded).
+                return self._prefix.pick(healthy, model,
+                                         prefix_key=prefix_key)
             if matching:
                 # Whole pool overloaded: still prefer the role pool
                 # unless the rest of the fleet has headroom.
@@ -209,7 +310,8 @@ class RoleAwareBalancer(Balancer):
 _POLICIES = {
     cls.name: cls for cls in (RoundRobinBalancer, LeastSaturationBalancer,
                               ResidentAffinityBalancer,
-                              RoleAwareBalancer)
+                              RoleAwareBalancer,
+                              PrefixAffinityBalancer)
 }
 
 

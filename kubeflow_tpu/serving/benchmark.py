@@ -1228,6 +1228,214 @@ def run_continuous_benchmark(config: ContinuousBenchConfig
 
 
 @dataclasses.dataclass
+class PrefixBenchConfig:
+    """`bench.py --prefix`: open-loop chat-replay sweep with a shared
+    system prompt (ISSUE 11 acceptance). Every request is the same
+    long system prefix plus a short per-request user suffix — the
+    "millions of users" traffic shape — driven at the SAME open-loop
+    arrival schedule against two engines built from one model: the
+    r14 cold-prefill baseline (prefix cache off) and the prefix-cache
+    engine. The asserted numbers are the achieved hit rate (≥70%)
+    and the mean-TTFT ratio (≥3×): a hit prefills only the suffix
+    bucket instead of the full prompt bucket, so the ratio rides
+    prefill arithmetic this box's throttling cannot shrink (r10 box
+    policy — same-run A/B, not wall absolutes). Bitwise checks ride
+    along: warm outputs equal the cold engine's AND the monolithic
+    B=1 generate (greedy; sampled on a dedicated pair)."""
+
+    # The prefix is sized so prefill COMPUTE dominates TTFT (the
+    # production shape — a 7B's system prompt costs tens of ms of
+    # MXU time): on the CI model a 1024-bucket prefill is ~30 ms of
+    # real matmuls while the 8-token tail is ~1 ms, so the ratio
+    # reflects prefill arithmetic, not python overhead.
+    system_prompt_len: int = 1000  # cold prefill pays the 1k bucket
+    suffix_len: int = 8  # warm prefill pays the 8-token tail bucket
+    max_prompt_len: int = 1024
+    new_tokens: int = 8
+    num_requests: int = 32
+    num_prefixes: int = 3  # distinct "conversations" → ≥70% hit rate
+    slots: int = 4
+    page_size: int = 16
+    slice_tokens: int = 4
+    #: offered load as a fraction of the cold stack's prefill-bound
+    #: capacity (open loop: queueing from a slow server counts).
+    rate_x: float = 0.7
+    equality_rows: int = 3
+    model_dtype: str = "float32"
+
+
+def _prefix_phase(submit_one, n: int, rate_rps: float
+                  ) -> Dict[str, Any]:
+    """Open-loop drive measuring TTFT from the SCHEDULED arrival
+    (the open-loop client's experience — server-induced queueing
+    counts)."""
+    done: List[Any] = [None] * n
+    lock = threading.Lock()
+    start = time.perf_counter()
+    interval = 1.0 / rate_rps
+
+    def worker(i: int, stripe: int):
+        for k in range(i, n, stripe):
+            scheduled = start + k * interval
+            delay = scheduled - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            ttft, total = submit_one(k, scheduled)
+            with lock:
+                done[k] = (ttft, total)
+
+    stripe = min(n, 8)
+    threads = [threading.Thread(target=worker, args=(i, stripe),
+                                daemon=True) for i in range(stripe)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    finished = [d for d in done if d is not None]
+    ttfts = np.asarray([d[0] for d in finished]) * 1e3
+    makespan = time.perf_counter() - start
+    return {
+        "completed": len(finished),
+        "offered_rps": round(rate_rps, 2),
+        "makespan_s": round(makespan, 3),
+        "mean_ttft_ms": round(float(np.mean(ttfts)), 2),
+        "p50_ttft_ms": round(float(np.percentile(ttfts, 50)), 2),
+        "p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 2),
+    }
+
+
+def run_prefix_benchmark(config: PrefixBenchConfig) -> Dict[str, Any]:
+    """The ISSUE 11 acceptance sweep: chat replay with a shared
+    system prompt, cold-prefill baseline vs prefix-cache engine at
+    the same offered load. Returns the phase rows, achieved hit
+    rate, mean-TTFT ratio, and the bitwise verdicts."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.inference.engine import DecodeEngine, EngineConfig
+    from kubeflow_tpu.inference.generate import generate
+    from kubeflow_tpu.models.llama import llama_test
+
+    cache_size = config.max_prompt_len + config.new_tokens
+    model = llama_test(dtype=getattr(jnp, config.model_dtype),
+                       cache_size=cache_size)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    rng = np.random.RandomState(11)
+    prefixes = [rng.randint(0, 512, (config.system_prompt_len,))
+                .astype(np.int32) for _ in range(config.num_prefixes)]
+    prompts = []
+    for k in range(config.num_requests):
+        suffix = rng.randint(0, 512,
+                             (config.suffix_len,)).astype(np.int32)
+        prompts.append(np.concatenate(
+            [prefixes[k % config.num_prefixes], suffix]))
+
+    def build(prefix_on: bool, name: str, **sampling):
+        return DecodeEngine(model, params, EngineConfig(
+            max_new_tokens=config.new_tokens,
+            max_prompt_len=config.max_prompt_len,
+            num_slots=config.slots, page_size=config.page_size,
+            slice_tokens=config.slice_tokens,
+            prefix_cache=prefix_on, **sampling), name=name)
+
+    cold = build(False, "bench-prefix-cold")
+    warm = build(True, "bench-prefix-warm")
+    try:
+        # Warm BOTH engines' compile paths off the clock (cold: full
+        # bucket prefill + slices; warm: the cold-miss program AND
+        # the hit path — gather + tail prefill — which needs two
+        # SAME-conversation prompts), then reset the warm engine's
+        # index so the measured phase starts from an empty cache and
+        # PAYS its own misses.
+        key0 = np.asarray(jax.random.PRNGKey(1))
+        same_conv = prompts[config.num_prefixes]  # same prefix as [0]
+        for engine in (cold, warm):
+            engine.submit(prompts[0], rng=key0).result(300)
+            engine.submit(same_conv, rng=key0).result(300)
+        warm.clear_prefix_cache()
+
+        # Calibrate: the cold stack's prefill-bound service rate
+        # (one warmed full-bucket prefill, timed).
+        t0 = time.perf_counter()
+        cold.submit(prompts[0], rng=key0).result(300)
+        cold_request_s = time.perf_counter() - t0
+        rate = config.rate_x / max(cold_request_s, 1e-6)
+
+        def phase(engine):
+            def submit_one(k, scheduled):
+                stream = engine.submit(prompts[k])
+                first = None
+                for ev in stream.events(timeout_per_event=300):
+                    if first is None and not ev.final:
+                        first = time.perf_counter() - scheduled
+                    if ev.final:
+                        break
+                return first, time.perf_counter() - scheduled
+            return _prefix_phase(submit_one, config.num_requests,
+                                 rate)
+
+        cold_row = phase(cold)
+        warm_row = phase(warm)
+        prefix_stats = warm.stats()["prefix_cache"]
+        hit_rate = prefix_stats["hit_rate"]
+
+        # Bitwise: warm engine vs B=1 generate, greedy (the serving
+        # config) mid-churn on live shared pages.
+        greedy_ok = True
+        for i in range(config.equality_rows):
+            key = np.asarray(jax.random.PRNGKey(4000 + i))
+            got = warm.submit(prompts[i], rng=key).result(300)
+            want, _ = generate(
+                model, params, jnp.asarray(prompts[i])[None, :],
+                max_new_tokens=config.new_tokens,
+                rng=jnp.asarray(key)[None, :],
+                prompt_lengths=jnp.asarray([len(prompts[i])]))
+            greedy_ok &= bool(np.array_equal(got,
+                                             np.asarray(want)[0]))
+
+        # Sampled: dedicated engine pair (the bench config is greedy).
+        sampling = dict(temperature=0.8, top_k=50)
+        s_warm = build(True, "bench-prefix-sampled", **sampling)
+        sampled_ok = True
+        try:
+            for i in range(config.equality_rows):
+                key = np.asarray(jax.random.PRNGKey(5000 + i))
+                got = s_warm.submit(prompts[i], rng=key).result(300)
+                want, _ = generate(
+                    model, params, jnp.asarray(prompts[i])[None, :],
+                    max_new_tokens=config.new_tokens,
+                    rng=jnp.asarray(key)[None, :],
+                    prompt_lengths=jnp.asarray([len(prompts[i])]),
+                    **sampling)
+                sampled_ok &= bool(np.array_equal(
+                    got, np.asarray(want)[0]))
+        finally:
+            s_warm.stop()
+
+        ratio = cold_row["mean_ttft_ms"] / max(
+            warm_row["mean_ttft_ms"], 1e-9)
+        return {
+            "config": dataclasses.asdict(config),
+            "cold_request_ms": round(cold_request_s * 1e3, 2),
+            "offered_rps": round(rate, 2),
+            "cold": cold_row,
+            "warm": warm_row,
+            "prefix_stats": prefix_stats,
+            "hit_rate": hit_rate,
+            "mean_ttft_ratio": round(ratio, 2),
+            "bitwise_greedy_ok": greedy_ok,
+            "bitwise_sampled_ok": sampled_ok,
+            "prefix_wins": bool(hit_rate >= 0.7 and ratio >= 3.0
+                                and greedy_ok and sampled_ok),
+        }
+    finally:
+        cold.stop()
+        warm.stop()
+
+
+@dataclasses.dataclass
 class SloBenchConfig:
     """`bench.py --slo`: the r8 overload sweep with the fleet
     telemetry pipeline ATTACHED — the collector scrapes the serving

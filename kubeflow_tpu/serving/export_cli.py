@@ -63,6 +63,10 @@ _GENERATE_CONFIG_COERCERS = {
     "engine_page_size": int,
     "engine_slice_tokens": int,
     "engine_num_pages": int,
+    # Cross-request prefix KV cache (ISSUE 11, docs/streaming.md):
+    # admissions share cached prompt-prefix pages copy-on-write and
+    # prefill only the tail. Boolean — layout changes ride it.
+    "engine_prefix_cache": bool,
 }
 
 

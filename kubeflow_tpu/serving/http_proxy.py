@@ -84,6 +84,7 @@ from kubeflow_tpu.scaling.balancer import (
     Balancer,
     eligible_endpoints,
     make_balancer,
+    normalize_prefix_key,
 )
 from kubeflow_tpu.scaling.endpoints import (
     Endpoint,
@@ -261,15 +262,19 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
 
     def pick_endpoint(self, tried: Sequence[Endpoint],
                       model: Optional[str] = None,
-                      phase: Optional[str] = None) -> Optional[Endpoint]:
+                      phase: Optional[str] = None,
+                      prefix_key: Optional[str] = None
+                      ) -> Optional[Endpoint]:
         """One routing decision: balancer policy over the eligible
         (not-yet-tried, not-ejected, breaker-admitting) members.
         ``phase`` is the request's dominant serving phase — only
-        role-aware policies act on it."""
+        role-aware policies act on it; ``prefix_key`` the normalized
+        prompt-prefix hash — only prefix-affinity policies do."""
         candidates = eligible_endpoints(self.pool, exclude=tried)
         if not candidates:
             return None
-        ep = self.balancer.pick(candidates, model=model, phase=phase)
+        ep = self.balancer.pick(candidates, model=model, phase=phase,
+                                prefix_key=prefix_key)
         if ep is not None:
             _P_ROUTER_PICKS.labels(ep.address).inc()
         return ep
@@ -389,7 +394,7 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
 
     async def route_with_failover(self, model: Optional[str],
                                   attempt, deadline=None,
-                                  phase=None) -> None:
+                                  phase=None, prefix_key=None) -> None:
         """THE routing contract, shared by every proxied verb: pick a
         replica, run ``attempt(ep)`` (which raises _Handled once the
         client response is written), and on a transport-level failure
@@ -402,7 +407,8 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
         last_exc: Optional[Exception] = None
         max_extra = max(0, self.retry_attempts)
         for attempt_i in range(1 + max_extra):
-            ep = self.pick_endpoint(tried, model=model, phase=phase)
+            ep = self.pick_endpoint(tried, model=model, phase=phase,
+                                    prefix_key=prefix_key)
             if ep is None:
                 break
             ep.inflight += 1
@@ -831,7 +837,9 @@ class InferProxyHandler(ProxyHandler):
     async def _split_generate(self, name: str, version: Optional[str],
                               instances: Any, body: Dict[str, Any],
                               deadline: Optional[float],
-                              wants_stream: bool) -> bool:
+                              wants_stream: bool,
+                              prefix_key: Optional[str] = None
+                              ) -> bool:
         """The role-split KV-handoff path: hop 1 runs the prompt
         prefill on a prefill-role replica (``prefill_only``), hop 2
         ships the returned handoff blobs to a decode-role replica
@@ -907,8 +915,12 @@ class InferProxyHandler(ProxyHandler):
             "handoffs": handoffs,
             "signature_name": body.get("signature_name"),
         }
+        # The decode hop is where the adopted pages LIVE (and, with
+        # prefix caching, where they get indexed) — prefix affinity
+        # applies here so the next repeat-prefix request finds them.
         decode_ep = self.pick_endpoint([prefill_ep], model=name,
-                                       phase="decode")
+                                       phase="decode",
+                                       prefix_key=prefix_key)
         if decode_ep is None:
             _P_SPLIT_GENERATE.labels("fallback").inc()
             return False
@@ -992,6 +1004,7 @@ class InferProxyHandler(ProxyHandler):
             "text/event-stream"
             in self.request.headers.get("Accept", ""))
         phase = None
+        prefix_key = None
         if verb == "generate":
             # Role dimension (docs/scaling.md "Role-split routing"):
             # token streaming is decode-bound by construction; unary
@@ -999,10 +1012,15 @@ class InferProxyHandler(ProxyHandler):
             phase = ("decode" if wants_stream else
                      classify_generate_phase(
                          instances, body.get("max_new_tokens")))
+            # Prefix affinity (ISSUE 11): hash the normalized prompt
+            # prefix so repeat-prefix traffic lands where its cached
+            # KV pages live. None on malformed input — routing
+            # degrades to the policy's fallback, never 500s.
+            prefix_key = normalize_prefix_key(instances)
             if (self.application.settings.get("split_generate")
                     and await self._split_generate(
                         name, version, instances, body, deadline,
-                        wants_stream)):
+                        wants_stream, prefix_key=prefix_key)):
                 return
         if wants_stream and verb == "generate":
             # Streaming rides the REST upstream directly (prompts are
@@ -1013,7 +1031,7 @@ class InferProxyHandler(ProxyHandler):
                 lambda ep: self._attempt_stream(ep, name, version,
                                                 instances, body,
                                                 deadline),
-                deadline=deadline, phase=phase)
+                deadline=deadline, phase=phase, prefix_key=prefix_key)
             return
         # Infer verbs are idempotent (pure functions of their
         # inputs), so the shared failover loop may retry a transport
@@ -1022,7 +1040,7 @@ class InferProxyHandler(ProxyHandler):
             name,
             lambda ep: self._attempt(ep, name, version, verb,
                                      instances, body, deadline),
-            deadline=deadline, phase=phase)
+            deadline=deadline, phase=phase, prefix_key=prefix_key)
 
     async def post(self, name: str, version: Optional[str], verb: str):
         await self._infer(name, version, verb)
@@ -1314,10 +1332,11 @@ def main(argv=None) -> int:
                              "--rpc_address when present")
     parser.add_argument("--balancer", default="least_saturation",
                         choices=("round_robin", "least_saturation",
-                                 "affinity", "role"),
+                                 "affinity", "role", "prefix"),
                         help="routing policy over the replica pool "
                              "(role = prefill/decode pool splitting, "
-                             "docs/scaling.md)")
+                             "prefix = prompt-prefix affinity for "
+                             "prefix-cache fleets, docs/scaling.md)")
     parser.add_argument("--role_split", default="auto",
                         choices=("auto", "on", "off"),
                         help="two-hop prefill→decode KV-handoff for "

@@ -296,13 +296,26 @@ class ServedModel:
                          | {cfg.max_prompt_len})
         key = np.asarray(jax.random.PRNGKey(0))
         # One request per prompt bucket compiles its prefill (and the
-        # full-K slice, reached from every bucket).
+        # full-K slice, reached from every bucket). Prefix-cache
+        # engines run the bucket loop TWICE: the second pass hits the
+        # blocks the first registered, compiling the page-gather and
+        # the tail-prefill programs the warm path runs (residual tail
+        # widths compile lazily, like tail slices always have).
         tokens = min(cfg.max_new_tokens, cfg.slice_tokens + 1)
-        for width in buckets:
-            prompt = np.zeros((min(width, cfg.max_prompt_len),),
-                              np.int32)
-            engine.submit(prompt, rng=key,
-                          max_new_tokens=tokens).result(timeout=600)
+        for cold_pass in ((True, False) if engine.prefix is not None
+                          else (True,)):
+            for width in buckets:
+                prompt = np.zeros((min(width, cfg.max_prompt_len),),
+                                  np.int32)
+                engine.submit(prompt, rng=key,
+                              max_new_tokens=tokens).result(timeout=600)
+                if cold_pass and engine.prefix is not None:
+                    # Keep the first pass fully COLD: a smaller
+                    # bucket's registered zero blocks would otherwise
+                    # match a larger bucket's prompt and skip its
+                    # full-width prefill compile — the exact cliff
+                    # this warmup exists to prevent.
+                    engine.clear_prefix_cache()
         # Tail slices: a request retiring mid-slice shrinks K, and
         # each distinct K is its own compile — warm K=1..slice-1 too
         # (sequential solo requests with budget b run one (b-1)-step
@@ -314,6 +327,10 @@ class ServedModel:
                                    cfg.max_new_tokens + 1)):
             engine.submit(prompt, rng=key,
                           max_new_tokens=budget).result(timeout=600)
+        # Warmup prompts are zeros, not traffic — drop them from the
+        # prefix index so the pool starts traffic with a full free
+        # list and real prompts can't "hit" warmup garbage.
+        engine.clear_prefix_cache()
 
     def poll_versions(self) -> bool:
         """Scan base_path; (re)load whatever the version policy admits.

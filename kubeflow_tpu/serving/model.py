@@ -210,8 +210,9 @@ class LoadedModel:
         the engine IS a decode loop, there is nothing for it to run
         for predict/classify exports. Capacity knobs ride the export's
         ``generate_config`` (``engine_slots`` / ``engine_page_size`` /
-        ``engine_slice_tokens`` / ``engine_num_pages`` — see
-        docs/streaming.md)."""
+        ``engine_slice_tokens`` / ``engine_num_pages``, plus
+        ``engine_prefix_cache`` for the cross-request prefix KV cache
+        — see docs/streaming.md)."""
         with self._engine_lock:
             if self._engine is not None:
                 return self._engine

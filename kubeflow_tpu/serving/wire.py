@@ -811,7 +811,7 @@ def encode_kv_handoff(model: str, version: int, handoff) -> bytes:
     # able to diverge from this blob's layout.
     from kubeflow_tpu.serving.sharding import _flatten
 
-    return serialization.msgpack_serialize({
+    doc = {
         "format": np.int32(KV_HANDOFF_FORMAT),
         "model": model,
         "version": np.int32(version),
@@ -822,7 +822,22 @@ def encode_kv_handoff(model: str, version: int, handoff) -> bytes:
         "max_new_tokens": np.int32(handoff.max_new_tokens),
         "step_keys": np.asarray(handoff.step_keys),
         "cache": _flatten(handoff.cache),
-    })
+    }
+    # Prefix-cache additions (ISSUE 11), ADDITIVE within format 1:
+    # readers that predate them ignore unknown keys, and absent keys
+    # decode to the classic left-padded layout. ``layout`` names the
+    # cache geometry ("right" = pad-0 prefix-cache layout; an engine
+    # only adopts its own); ``prompt_tokens`` lets the adopting
+    # replica index the carried pages in its prefix cache — the blob
+    # doubles as the fleet's warm-transfer format (prefill once,
+    # adopt everywhere).
+    layout = getattr(handoff, "layout", "left") or "left"
+    if layout != "left":
+        doc["layout"] = layout
+    tokens = getattr(handoff, "prompt_tokens", None)
+    if tokens is not None:
+        doc["prompt_tokens"] = np.asarray(tokens, np.int32)
+    return serialization.msgpack_serialize(doc)
 
 
 def decode_kv_handoff(data: bytes, *, model: str,
@@ -858,6 +873,13 @@ def decode_kv_handoff(data: bytes, *, model: str,
 
     cache = _unflatten({k: np.asarray(v)
                         for k, v in doc["cache"].items()})
+    layout = doc.get("layout")
+    layout = str(layout) if layout is not None else "left"
+    if layout not in ("left", "right"):
+        raise ValueError(
+            f"KV handoff layout {layout!r} unknown (this replica "
+            f"speaks left/right)")
+    tokens = doc.get("prompt_tokens")
     return PrefillHandoff(
         cache=cache,
         first_token=int(doc["first_token"]),
@@ -865,4 +887,7 @@ def decode_kv_handoff(data: bytes, *, model: str,
         prompt_len=int(doc["prompt_len"]),
         prompt_width=int(doc["prompt_width"]),
         max_new_tokens=int(doc["max_new_tokens"]),
-        step_keys=np.asarray(doc["step_keys"]))
+        step_keys=np.asarray(doc["step_keys"]),
+        layout=layout,
+        prompt_tokens=(None if tokens is None
+                       else np.asarray(tokens, np.int32)))

@@ -219,7 +219,11 @@ def check_operator_wait_discipline() -> list:
     ``kubeflow_tpu/inference/engine/`` — the decode loop IS a control
     loop (slice cadence, deadline expiry, stream notify), and a
     single unbounded condition wait there stalls every streaming
-    client at once."""
+    client at once. The directory glob covers every engine module,
+    including prefix_cache.py (ISSUE 11): the prefix index runs ON
+    the decode loop's thread, where a stray sleep or wall-clock read
+    (LRU stamps must not ride NTP-steppable time) stalls or skews
+    every slot at once."""
     # Exempt: the operator's sanctioned wait path; the fault injector
     # (whose time.sleep IS the injected apiserver latency); and the
     # load-bench drivers (their sleeps pace the measurement harness,

@@ -1,0 +1,687 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Cross-request prefix KV cache (ISSUE 11).
+
+The contract under test: with ``EngineConfig.prefix_cache`` on, every
+request's output is BITWISE equal to the same request run alone
+through ``inference.generate.generate`` at B=1 — greedy and sampled,
+including mid-decode joins against shared pages, CoW forks at a
+partially matched boundary page, eviction under page pressure, and
+warm transfer through the wire handoff blob. Plus the host-side
+machinery (ref-counted allocator, radix index) unit-tested and
+fuzzed without a model: no FIFO deadlock, no ref-count leak, the
+pool drains to zero resident pages after quiesce.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.inference.engine import (
+    DecodeEngine,
+    EngineConfig,
+    PageAllocator,
+    PrefixCache,
+)
+from kubeflow_tpu.inference.generate import generate
+from kubeflow_tpu.models.llama import llama_test
+
+CACHE = 64
+MAX_PROMPT = 24
+PAGE = 4
+
+
+@pytest.fixture(scope="module")
+def model():
+    return llama_test(dtype=jnp.float32, cache_size=CACHE)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    ids = jnp.zeros((1, 8), jnp.int32)
+    return model.init(jax.random.PRNGKey(0), ids)["params"]
+
+
+def _reference(model, params, prompt, key, max_new_tokens, **sampling):
+    tokens, _ = generate(
+        model, params, jnp.asarray(prompt)[None, :],
+        max_new_tokens=max_new_tokens, rng=jnp.asarray(key)[None, :],
+        prompt_lengths=jnp.asarray([len(prompt)]), **sampling)
+    return np.asarray(tokens)[0]
+
+
+def _prefixed_prompts(prefix_len, suffix_lens, seed=0):
+    """Prompts sharing a common ``prefix_len``-token head (the shared
+    system prompt) with per-request random suffixes."""
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(0, 512, (prefix_len,)).astype(np.int32)
+    out = []
+    for i, n in enumerate(suffix_lens):
+        r = np.random.RandomState(1000 + seed * 100 + i)
+        suffix = r.randint(0, 512, (n,)).astype(np.int32)
+        out.append(np.concatenate([prefix, suffix]) if n else
+                   prefix.copy())
+    return out
+
+
+def _keys(n, base=100):
+    return [np.asarray(jax.random.PRNGKey(base + i)) for i in range(n)]
+
+
+def _assert_drained(engine):
+    """Quiesced engine: no slots, no queue, no reservations; cached
+    pages are the only residents and a clear() releases them all."""
+    st = engine.stats()
+    assert st["active_slots"] == 0 and st["queue_depth"] == 0, st
+    assert st["reserved_pages"] == 0, st
+    engine.kv.allocator.check_invariants()
+    if engine.prefix is not None:
+        engine.prefix.check_invariants()
+        assert st["free_pages"] + st["retained_pages"] == \
+            st["total_pages"], f"leaked pages: {st}"
+        engine.clear_prefix_cache()
+        st = engine.stats()
+    assert st["free_pages"] == st["total_pages"], f"leaked pages: {st}"
+    engine.kv.allocator.check_invariants()
+
+
+# -- engine: bitwise equality on shared pages ------------------------------
+
+
+def test_prefix_hits_bitwise_equal_greedy_including_cow_fork(
+        model, params):
+    """A non-page-aligned shared prefix (11 tokens over 4-token pages
+    = 2 full blocks + a partial boundary) exercised cold, then warm:
+    full-block sharing, the CoW fork of the boundary page, and the
+    full-prompt-cached case — every output bitwise equal to B=1."""
+    cfg = EngineConfig(max_new_tokens=9, max_prompt_len=MAX_PROMPT,
+                       temperature=0.0, num_slots=2, page_size=PAGE,
+                       slice_tokens=4, prefix_cache=True)
+    engine = DecodeEngine(model, params, cfg, name="px-greedy")
+    try:
+        prompts = _prefixed_prompts(11, [3, 5, 2, 0], seed=1)
+        keys = _keys(4)
+        cold = engine.submit(prompts[0], rng=keys[0])
+        assert cold.next_event(timeout=120.0) is not None
+        streams = [engine.submit(p, rng=k)
+                   for p, k in zip(prompts[1:], keys[1:])]
+        results = [cold.result(120.0)] + \
+            [s.result(120.0) for s in streams]
+        for i in range(4):
+            np.testing.assert_array_equal(
+                results[i],
+                _reference(model, params, prompts[i], keys[i], 9),
+                err_msg=f"prefix-shared row {i} diverged from B=1")
+        st = engine.stats()["prefix_cache"]
+        assert st["hits"] == 3 and st["misses"] == 1, st
+        assert st["saved_prefill_tokens"] > 0
+        _assert_drained(engine)
+    finally:
+        engine.stop()
+
+
+def test_prefix_hits_bitwise_equal_sampled_mid_decode_join(
+        model, params):
+    """Sampled (temperature + top_k + top_p) requests joining a LIVE
+    decode adopt shared pages without perturbing any rng stream —
+    bitwise, not statistically. The donor is still mid-decode when
+    the sharers pin its prompt pages (refcount > 1 while live)."""
+    sampling = dict(temperature=0.8, top_k=50, top_p=0.95)
+    cfg = EngineConfig(max_new_tokens=13, max_prompt_len=MAX_PROMPT,
+                       num_slots=2, page_size=PAGE, slice_tokens=3,
+                       prefix_cache=True, **sampling)
+    engine = DecodeEngine(model, params, cfg, name="px-sampled")
+    try:
+        prompts = _prefixed_prompts(9, [4, 6, 2], seed=5)
+        keys = _keys(3, base=500)
+        donor = engine.submit(prompts[0], rng=keys[0])
+        assert donor.next_event(timeout=120.0) is not None
+        joiners = [engine.submit(p, rng=k)
+                   for p, k in zip(prompts[1:], keys[1:])]
+        results = [donor.result(120.0)] + \
+            [s.result(120.0) for s in joiners]
+        for i in range(3):
+            np.testing.assert_array_equal(
+                results[i],
+                _reference(model, params, prompts[i], keys[i], 13,
+                           **sampling),
+                err_msg=f"sampled prefix-shared row {i} diverged")
+        assert engine.stats()["prefix_cache"]["hits"] >= 1
+        _assert_drained(engine)
+    finally:
+        engine.stop()
+
+
+def test_eviction_under_page_pressure_stays_correct(model, params):
+    """A pool too small to retain every prompt evicts LRU zero-ref
+    cached pages to admit new work: admissions never deadlock, later
+    DISTINCT-prefix requests still come out bitwise equal, and a
+    re-run of an evicted prefix re-registers it."""
+    # 9 usable pages; each request needs ceil((L+7)/4) pages — two
+    # distinct 10+2-token prompts (5 pages each) cannot both stay
+    # cached alongside a third's working set.
+    cfg = EngineConfig(max_new_tokens=7, max_prompt_len=MAX_PROMPT,
+                       temperature=0.0, num_slots=1, page_size=PAGE,
+                       slice_tokens=3, num_pages=10, prefix_cache=True)
+    engine = DecodeEngine(model, params, cfg, name="px-evict")
+    try:
+        groups = [_prefixed_prompts(10, [2, 1], seed=s)
+                  for s in (11, 12, 13)]
+        keys = _keys(6, base=900)
+        k = 0
+        for group in groups:
+            for prompt in group:
+                key = keys[k]
+                got = engine.submit(prompt, rng=key).result(180.0)
+                np.testing.assert_array_equal(
+                    got, _reference(model, params, prompt, key, 7),
+                    err_msg=f"request {k} diverged under eviction "
+                            f"pressure")
+                engine.kv.allocator.check_invariants()
+                engine.prefix.check_invariants()
+                k += 1
+        st = engine.stats()["prefix_cache"]
+        assert st["evicted_pages"] > 0, \
+            f"pool was sized to force evictions: {st}"
+        assert st["hits"] >= 1, st
+        _assert_drained(engine)
+    finally:
+        engine.stop()
+
+
+def test_cancel_storm_releases_pages_exactly_once(model, params):
+    """Stream-cancel satellite: consumers that disconnect while
+    QUEUED or MID-DECODE release reservations and ref-counted shared
+    pages exactly once — allocator accounting is clean after a storm
+    of interleaved submits/cancels, and every stream sees exactly one
+    terminal event."""
+    cfg = EngineConfig(max_new_tokens=9, max_prompt_len=MAX_PROMPT,
+                       temperature=0.0, num_slots=2, page_size=PAGE,
+                       slice_tokens=3, num_pages=12, prefix_cache=True)
+    engine = DecodeEngine(model, params, cfg, name="px-cancel")
+    try:
+        rng = np.random.RandomState(17)
+        prompts = _prefixed_prompts(9, [2, 3, 1, 4, 2, 3, 1, 2],
+                                    seed=23)
+        keys = _keys(len(prompts), base=1700)
+        for round_i in range(3):
+            streams = []
+            for i, (p, k) in enumerate(zip(prompts, keys)):
+                streams.append(engine.submit(p, rng=k))
+                roll = rng.rand()
+                if roll < 0.35:
+                    streams[-1].cancel()  # often still queued
+                elif roll < 0.55:
+                    streams[-1].next_event(timeout=120.0)
+                    streams[-1].cancel()  # mid-decode
+            for s in streams:
+                terminal = 0
+                try:
+                    s.result(timeout=180.0)
+                    terminal += 1
+                except Exception:  # noqa: BLE001 — cancelled is fine
+                    terminal += 1
+                assert terminal == 1
+                assert s.done
+            # Quiesce: the engine retires cancelled slots at slice
+            # boundaries — wait for the pool to settle.
+            deadline = time.monotonic() + 30.0
+            while (engine.scheduler.occupancy()
+                   or engine.scheduler.queue_depth()) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            engine.kv.allocator.check_invariants()
+            engine.prefix.check_invariants()
+            assert engine.kv.allocator.reserved_pages == 0
+        _assert_drained(engine)
+    finally:
+        engine.stop()
+
+
+def test_warm_transfer_roundtrip_registers_and_stays_bitwise(
+        model, params):
+    """Fleet-wide warm transfer: engine A prefills once, the wire
+    blob carries layout + prompt tokens, engine B adopts AND indexes
+    the pages — B's next same-prefix request is a local hit. Outputs
+    bitwise equal to B=1 on both hops; layout-mismatched blobs are
+    rejected (mixed-rollout contract)."""
+    from kubeflow_tpu.serving.wire import (
+        decode_kv_handoff,
+        encode_kv_handoff,
+    )
+
+    cfg = EngineConfig(max_new_tokens=9, max_prompt_len=MAX_PROMPT,
+                       temperature=0.0, num_slots=2, page_size=PAGE,
+                       slice_tokens=4, prefix_cache=True)
+    a = DecodeEngine(model, params, cfg, name="px-warm-a")
+    b = DecodeEngine(model, params, cfg, name="px-warm-b")
+    try:
+        prompts = _prefixed_prompts(10, [3, 2], seed=31)
+        keys = _keys(2, base=2500)
+        handoff = a.run_prefill(prompts[0], rng=keys[0])
+        assert handoff.layout == "right"
+        assert handoff.prompt_tokens is not None
+        blob = encode_kv_handoff("m", 1, handoff)
+        carried = decode_kv_handoff(blob, model="m", version=1)
+        assert carried.layout == "right"
+        np.testing.assert_array_equal(carried.prompt_tokens,
+                                      prompts[0])
+        got = b.submit(handoff=carried).result(120.0)
+        np.testing.assert_array_equal(
+            got, _reference(model, params, prompts[0], keys[0], 9),
+            err_msg="adopted decode diverged from B=1")
+        # The transfer WARMED b: a same-prefix local request hits.
+        before = b.stats()["prefix_cache"]["hits"]
+        got2 = b.submit(prompts[1], rng=keys[1]).result(120.0)
+        np.testing.assert_array_equal(
+            got2, _reference(model, params, prompts[1], keys[1], 9))
+        assert b.stats()["prefix_cache"]["hits"] == before + 1, \
+            "warm transfer did not register the carried prefix"
+        # Layout guard: a left-layout blob must not adopt here.
+        left = dict(vars(carried))
+        left["layout"] = "left"
+        left_handoff = type(carried)(**left)
+        with pytest.raises(ValueError, match="layout"):
+            b.submit(handoff=left_handoff)
+        _assert_drained(a)
+        _assert_drained(b)
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_prefix_metrics_exposed_and_strictly_parseable(model, params):
+    """The hit/miss/evict counters and saved-tokens histogram render
+    on the shared registry in strict OpenMetrics-compatible form —
+    the r13 collector ingests whatever parse_exposition accepts."""
+    from kubeflow_tpu.obs import metrics as obs_metrics
+
+    cfg = EngineConfig(max_new_tokens=5, max_prompt_len=MAX_PROMPT,
+                       temperature=0.0, num_slots=1, page_size=PAGE,
+                       slice_tokens=4, prefix_cache=True)
+    engine = DecodeEngine(model, params, cfg, name="px-metrics")
+    try:
+        prompts = _prefixed_prompts(9, [1, 2], seed=41)
+        keys = _keys(2, base=3100)
+        for p, k in zip(prompts, keys):
+            engine.submit(p, rng=k).result(120.0)
+        text = obs_metrics.render()
+        parsed = obs_metrics.parse_exposition(text)  # strict: raises
+        for family in ("kft_engine_prefix_hits_total",
+                       "kft_engine_prefix_misses_total",
+                       "kft_engine_prefix_evicted_pages_total",
+                       "kft_engine_prefix_saved_tokens",
+                       "kft_engine_prefix_cached_pages",
+                       "kft_engine_page_occupancy"):
+            assert any(family in name for name in parsed), \
+                f"{family} missing from /metrics"
+        stats = engine.stats()
+        assert 0.0 <= stats["page_occupancy"] <= 1.0
+        assert stats["prefix_cache"]["hits"] == 1
+        _assert_drained(engine)
+    finally:
+        engine.stop()
+
+
+# -- host-side machinery (no model, no jax dispatch) -----------------------
+
+
+def test_allocator_ref_retain_reclaim_cycle():
+    class _StubCache:
+        def __init__(self):
+            self.idle = []
+
+        def holds(self, page):
+            return True
+
+        def on_idle(self, page):
+            self.idle.append(page)
+
+        def on_pinned(self, page):
+            self.idle.remove(page)
+
+        def idle_pages(self):
+            return list(self.idle)
+
+        def reclaim(self, n):
+            out, self.idle = self.idle[:n], self.idle[n:]
+            return out
+
+        def reclaimable(self):
+            return len(self.idle)
+
+    alloc = PageAllocator(6)  # null + 5 usable
+    cache = _StubCache()
+    alloc.set_cache(cache)
+    assert alloc.reserve(3)
+    pages = alloc.alloc(3)
+    assert all(alloc.refcount(p) == 1 for p in pages)
+    alloc.ref(pages[0])
+    assert alloc.refcount(pages[0]) == 2
+    alloc.unref(pages[0])
+    alloc.unref(pages[0])  # 0 → retained (cache holds it)
+    assert alloc.refcount(pages[0]) == 0
+    assert alloc.retained_pages == 1 and cache.idle == [pages[0]]
+    assert alloc.available() == 3  # 2 free + 1 retained
+    alloc.check_invariants()
+    # Re-pin from retained custody.
+    assert alloc.ref(pages[0])
+    assert alloc.refcount(pages[0]) == 1 and alloc.retained_pages == 0
+    alloc.unref(pages[0])
+    # Reclaim feeds alloc when the free list runs dry.
+    assert alloc.reserve(3)
+    got = alloc.alloc(3)  # 2 free + 1 reclaimed
+    assert pages[0] in got
+    alloc.check_invariants()
+    for p in got + pages[1:]:
+        alloc.unref(p)
+    alloc.check_invariants()
+
+
+def test_allocator_pin_refuses_to_starve_reservations():
+    """The FIFO no-deadlock guard: pinning a retained page must fail
+    when outstanding reservations have spoken for every reclaimable
+    page — instead of silently invalidating a promised alloc."""
+    class _StubCache:
+        def __init__(self):
+            self.idle = []
+
+        def holds(self, page):
+            return True
+
+        def on_idle(self, page):
+            self.idle.append(page)
+
+        def on_pinned(self, page):
+            self.idle.remove(page)
+
+        def idle_pages(self):
+            return list(self.idle)
+
+        def reclaim(self, n):
+            out, self.idle = self.idle[:n], self.idle[n:]
+            return out
+
+        def reclaimable(self):
+            return len(self.idle)
+
+    alloc = PageAllocator(4)  # 3 usable
+    alloc.set_cache(_StubCache())
+    assert alloc.reserve(1)
+    pages = alloc.alloc(1)
+    alloc.unref(pages[0])  # retained
+    assert alloc.reserve(3)  # 2 free + 1 retained, all promised
+    assert alloc.available() == 0
+    assert alloc.ref(pages[0]) is False, \
+        "pin must fail rather than starve a reservation"
+    alloc.check_invariants()
+    got = alloc.alloc(3)
+    assert set(got) >= {pages[0]}
+    for p in got:
+        alloc.unref(p)
+    alloc.check_invariants()
+
+
+def test_radix_match_register_partial_and_collision_guard():
+    alloc = PageAllocator(12)
+    cache = PrefixCache(4, alloc)
+    prompt = list(range(1, 12))  # 11 tokens: 2 full blocks + 3 rest
+    assert alloc.reserve(3)
+    pages = alloc.alloc(3)
+    assert cache.register(prompt, pages) == 3
+    # Full match walks the chain; cap at len-1 keeps one token to
+    # prefill: matching the SAME 11 tokens covers 8 + 2 (not 3).
+    m = cache.match(prompt)
+    assert [e.page for e in m.entries] == pages[:2]
+    assert m.fork is not None and m.fork_len == 2 and m.matched == 10
+    # A diverging second block stops the walk at block 1.
+    other = prompt[:4] + [99, 98, 97, 96, 95]
+    m2 = cache.match(other)
+    assert [e.page for e in m2.entries] == pages[:1]
+    assert m2.fork is None and m2.matched == 4
+    # Longest-partial-wins: a shorter partial does not replace.
+    assert alloc.reserve(3)
+    pages2 = alloc.alloc(3)
+    short = prompt[:10]  # same 2 blocks + 2-token partial
+    added = cache.register(short, pages2)
+    assert added == 0, "shorter partial must not displace the longer"
+    cache.check_invariants()
+    alloc.check_invariants()
+    # Release: all pages retained (indexed), pool still accounts.
+    for p in reversed(pages):
+        alloc.unref(p)
+    for p in reversed(pages2):
+        alloc.unref(p)
+    alloc.check_invariants()
+    assert alloc.retained_pages == 3  # pages2's 3 went straight free
+    assert cache.clear() == 3
+    assert alloc.free_pages == 11
+    alloc.check_invariants()
+
+
+def test_eviction_fuzz_no_deadlock_no_leak():
+    """Random admit/retire/cancel interleavings × prefix overlap over
+    a deliberately tiny pool, allocator + index invariants checked
+    after EVERY step. 'Admit' mirrors the engine's sequence (match →
+    pin → reserve private remainder → alloc prompt pages → register);
+    a blocked admission must always unblock once actives retire (the
+    FIFO no-deadlock acceptance), and after quiesce + clear the pool
+    drains to zero resident pages."""
+    rng = np.random.RandomState(7)
+    P = 4
+    alloc = PageAllocator(14)  # 13 usable
+    cache = PrefixCache(P, alloc)
+    # A small universe of prompts with heavy prefix overlap.
+    bases = [list(rng.randint(0, 50, (10,))) for _ in range(3)]
+    prompts = []
+    for b in bases:
+        for s in range(4):
+            suffix = list(rng.randint(0, 50, (rng.randint(0, 5),)))
+            prompts.append(b + suffix)
+    live = []  # (pages, budget_pages, shared_count)
+    pending = []
+
+    def pages_for(n):
+        return -(-n // P)
+
+    def try_admit(prompt):
+        budget = pages_for(len(prompt) + 6)
+        match = cache.pin(cache.match(prompt))
+        if not alloc.reserve(budget - len(match.entries)):
+            cache.unpin(match)
+            return False
+        if match.fork is not None:
+            cache.unpin_fork(match)
+        n_prompt = pages_for(len(prompt))
+        priv = alloc.alloc(n_prompt - len(match.entries))
+        rows = match.shared_pages + priv
+        cache.register(prompt, rows)
+        live.append((rows, budget, len(match.entries)))
+        return True
+
+    def retire(i):
+        rows, budget, _shared = live.pop(i)
+        for p in reversed(rows):
+            alloc.unref(p)
+        alloc.unreserve(budget - len(rows))
+
+    steps = 0
+    for _ in range(600):
+        op = rng.rand()
+        if op < 0.5 and len(live) < 3:
+            prompt = prompts[rng.randint(len(prompts))]
+            if not try_admit(prompt):
+                pending.append(prompt)
+        elif op < 0.8 and live:
+            retire(rng.randint(len(live)))
+        elif pending:
+            # Drain the blocked queue FIFO: head first, stop at the
+            # first that still doesn't fit (strict FIFO).
+            while pending and try_admit(pending[0]):
+                pending.pop(0)
+        alloc.check_invariants()
+        cache.check_invariants()
+        steps += 1
+    # No deadlock: retire everything, then every blocked admission
+    # must admit (possibly evicting cached pages).
+    while live:
+        retire(0)
+        alloc.check_invariants()
+    attempts = 0
+    while pending:
+        assert try_admit(pending[0]), \
+            "FIFO head blocked with an empty engine — deadlock"
+        pending.pop(0)
+        while live:
+            retire(0)
+        attempts += 1
+        alloc.check_invariants()
+        cache.check_invariants()
+    # Quiesce: only cached pages remain, and clear() frees them all.
+    assert alloc.reserved_pages == 0
+    assert alloc.inuse_pages == 0
+    cache.clear()
+    assert alloc.free_pages == 13, \
+        f"pages leaked after drain: free={alloc.free_pages}"
+    alloc.check_invariants()
+
+
+# -- autoscaler + healthz: page pressure visibility ------------------------
+
+
+def test_replica_sample_reports_page_pressure_and_hit_rate():
+    """The decode-pool scaling path and the fleet dashboard see PAGE
+    pressure and the prefix hit rate, not just slot occupancy — and
+    malformed values degrade, never raise."""
+    from kubeflow_tpu.scaling.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        AutoscalerLoop,
+    )
+
+    class _FakeScaler:
+        def get_replicas(self):
+            return 1
+
+        def set_replicas(self, n):
+            pass
+
+    loop = AutoscalerLoop(
+        Autoscaler(AutoscalerConfig(), _FakeScaler()),
+        discover=lambda: [])
+    row = loop._replica_sample("a:1", {
+        "status": "ok", "role": "decode",
+        "saturation": {"m": {
+            "queue_depth": 0, "est_batch_latency_ms": 5.0,
+            "shed": 0, "expired": 0,
+            "engine": {"slots": 4, "active_slots": 1,
+                       "queue_depth": 0, "est_ttft_ms": 1.0,
+                       "page_occupancy": 0.625,
+                       "prefix_cache": {"hits": 30, "misses": 10}},
+        }}}, now=1.0)
+    assert row["page_occupancy"] == 0.625
+    assert row["prefix_hit_rate"] == 0.75
+    # No engine / no prefix cache → fields absent, row intact.
+    row2 = loop._replica_sample("b:1", {
+        "status": "ok", "saturation": {"m": {"queue_depth": 0}}},
+        now=2.0)
+    assert "page_occupancy" not in row2
+    assert "prefix_hit_rate" not in row2
+    # Malformed values degrade, never raise.
+    row3 = loop._replica_sample("c:1", {
+        "status": "ok",
+        "saturation": {"m": {"engine": {
+            "page_occupancy": "hot",
+            "prefix_cache": {"hits": "many"}}}}}, now=3.0)
+    assert row3["reachable"] and "page_occupancy" not in row3
+
+
+# -- balancer: prefix affinity ---------------------------------------------
+
+
+def test_normalize_prefix_key_stability_and_degrade():
+    from kubeflow_tpu.scaling.balancer import normalize_prefix_key
+
+    a = normalize_prefix_key([[1, 2, 3, 4] + [0] * 100])
+    b = normalize_prefix_key([[1, 2, 3, 4] + [0] * 100, [9, 9]])
+    assert a is not None and a == b  # first row, first 64 tokens
+    assert normalize_prefix_key([[1, 2, 3]]) != \
+        normalize_prefix_key([[1, 2, 4]])
+    assert normalize_prefix_key([]) is None
+    assert normalize_prefix_key("garbage") is None
+    assert normalize_prefix_key([["x", "y"]]) is None
+    assert normalize_prefix_key(None) is None
+
+
+def test_prefix_affinity_balancer_routes_home_and_falls_back():
+    from kubeflow_tpu.scaling.balancer import PrefixAffinityBalancer
+    from kubeflow_tpu.scaling.endpoints import Endpoint
+
+    eps = [Endpoint(f"replica-{i}:900{i}", register_metrics=False)
+           for i in range(3)]
+    bal = PrefixAffinityBalancer(overload_ms=100.0)
+    # Same key → same replica, every time.
+    picks = {bal.pick(eps, prefix_key="k1").address for _ in range(8)}
+    assert len(picks) == 1
+    # Distinct keys spread across the pool (rendezvous uniformity —
+    # with 40 keys over 3 replicas, all 3 should own some).
+    owners = {bal.pick(eps, prefix_key=f"key-{i}").address
+              for i in range(40)}
+    assert owners == {ep.address for ep in eps}
+    # Membership churn moves only the departed replica's keys.
+    home = bal.pick(eps, prefix_key="sticky").address
+    survivors = [ep for ep in eps if ep.address != home]
+    moved = bal.pick(survivors, prefix_key="sticky").address
+    assert moved != home
+    keep = [k for k in (f"key-{i}" for i in range(40))
+            if bal.pick(eps, prefix_key=k).address != home]
+    for k in keep:
+        assert bal.pick(survivors, prefix_key=k).address == \
+            bal.pick(eps, prefix_key=k).address, \
+            "HRW moved a key its replica still owns"
+    # Overloaded home falls back to least-saturation (never a
+    # hotspot), and a keyless pick degrades the same way.
+    target = bal.pick(eps, prefix_key="k1")
+    target.saturation = {"m": {"queue_depth": 10,
+                               "est_batch_latency_ms": 50.0}}
+    assert bal.pick(eps, prefix_key="k1").address != target.address
+    assert bal.pick(eps, prefix_key=None) is not None
+
+
+def test_role_balancer_applies_prefix_affinity_inside_the_pool():
+    """Role-split decode-hop affinity (ISSUE 11): within the healthy
+    phase-matching pool, the SAME prefix key picks the SAME decode
+    replica — and never a prefill-role one."""
+    from kubeflow_tpu.scaling.balancer import RoleAwareBalancer
+    from kubeflow_tpu.scaling.endpoints import Endpoint
+
+    decode = [Endpoint(f"decode-{i}:91{i}", register_metrics=False,
+                       role="decode") for i in range(3)]
+    prefill = [Endpoint("prefill-0:900", register_metrics=False,
+                        role="prefill")]
+    bal = RoleAwareBalancer(overload_ms=100.0)
+    picks = {bal.pick(decode + prefill, phase="decode",
+                      prefix_key="conv-1").address for _ in range(6)}
+    assert len(picks) == 1 and picks < {ep.address for ep in decode}
+    # Distinct keys spread across the decode pool.
+    owners = {bal.pick(decode + prefill, phase="decode",
+                       prefix_key=f"c{i}").address for i in range(40)}
+    assert owners == {ep.address for ep in decode}
+    # Keyless picks still route (least-saturation inside the pool).
+    assert bal.pick(decode + prefill, phase="decode") is not None
