@@ -48,7 +48,10 @@ def controller_main() -> int:
     event→reconcile latency bounded, and steady-state apiserver
     requests/reconcile FLAT in job count (the informer win). Prints
     ONE JSON line shaped like the headline bench."""
-    from kubeflow_tpu.operator.benchmark import run_controller_scale_bench
+    from kubeflow_tpu.operator.benchmark import (
+        run_controller_scale_bench,
+        run_elastic_churn_bench,
+    )
 
     jobs = 500
     full = run_controller_scale_bench(
@@ -90,6 +93,32 @@ def controller_main() -> int:
     assert rpr_full <= rpr_half + 0.5, (rpr_half, rpr_full)
     assert rpr_direct >= 2.0, rpr_direct
 
+    # Elastic churn row (r16 acceptance): under a spot storm that
+    # halves every gang's hosts, EVERY elastic job rides through —
+    # resized to the survivors, Running, zero restart budget, never
+    # even entering Restarting — while every rigid gang deadline-
+    # fails and releases its chips. Three runs (the PERF.md r16
+    # table records each).
+    elastic_runs = []
+    for _ in range(3):
+        row = run_elastic_churn_bench()
+        assert row["converged"], row
+        assert row["elastic_rode_through"] == row["elastic_jobs"], row
+        assert row["rigid_deadline_failed"] == row["rigid_jobs"], row
+        assert row["elastic_reconverge_seconds"] >= 0.0, row
+        # Elastic reconvergence beats the rigid deadline by
+        # construction: the resize is event-latency, the rigid
+        # failure waits out the full scheduling deadline.
+        assert (row["elastic_reconverge_seconds"]
+                < row["rigid_failed_seconds"]), row
+        elastic_runs.append({
+            "elastic_rode_through": row["elastic_rode_through"],
+            "elastic_reconverge_s": row["elastic_reconverge_seconds"],
+            "rigid_deadline_failed": row["rigid_deadline_failed"],
+            "rigid_failed_s": row["rigid_failed_seconds"],
+            "gang_resizes": row["gang_resizes"],
+        })
+
     print(json.dumps({
         "metric": "controller_churn_p99_event_to_reconcile_ms",
         "value": p99,
@@ -117,6 +146,7 @@ def controller_main() -> int:
                 "steady_qps": direct["steady"]["qps"],
             },
             "poison_quarantined": inf_full["poison_quarantined"],
+            "elastic_churn": elastic_runs,
         },
     }))
     return 0

@@ -1,0 +1,292 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Elastic-kill citest (r16): kill 1 of 4 gang hosts mid-run; the job
+must RESIZE instead of die and converge to the same seeded loss curve.
+
+Two halves, both hermetic:
+
+- **Control plane** (``elastic-resize``): a 4-worker elastic TPUJob
+  (minReplicas=2) on the fake apiserver loses one drained worker; the
+  reconciler must keep the job Running (no restart-budget burn, the
+  Restarting phase never materializes), roll the gang to 3 workers
+  with fresh env, and never create a duplicate pod (every pod CREATE
+  attempt lands exactly once — asserted from the apiserver request
+  log).
+
+- **Data plane** (``elastic-training``): a seeded llama-test causal-LM
+  run with continuous sharded checkpointing (4 emulated hosts, shard
+  write every step). The run is killed after step 5 — state discarded,
+  like a lost host — and resumed on a SMALLER 3-device dp mesh by
+  restoring + resharding from the continuous shards. The resumed run
+  must lose < 2 steps and converge to the uninterrupted reference loss
+  curve (same global batch ⇒ same math; cross-mesh reduce reassociation
+  bounded by a documented tolerance).
+
+Wired into the e2e CI DAG as the ``elastic-kill-test`` step
+(manifests/ci.py) and driven by tests/test_ci.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import tempfile
+
+from kubeflow_tpu.utils import junit
+
+logger = logging.getLogger(__name__)
+
+# The data-plane half shards a dp mesh over 4 virtual CPU devices;
+# must land before the first jax import in this process.
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+WORKERS = 4
+MIN_REPLICAS = 2
+KILL_AFTER_STEP = 5
+TOTAL_STEPS = 10
+GLOBAL_BATCH = 12
+SEQ_LEN = 16
+# Cross-mesh tolerance: restoring onto a different dp factorization
+# reassociates the gradient all-reduce, so the curves match to float32
+# reduction noise, not bitwise (same-mesh restores ARE bitwise — see
+# tests/test_checkpoint_sharded.py).
+LOSS_RTOL = 5e-4
+
+
+def control_plane_case() -> None:
+    from kubeflow_tpu.manifests.tpujob import (
+        replica_spec,
+        termination_policy,
+        tpu_job,
+    )
+    from kubeflow_tpu.operator.fake import FakeApiServer
+    from kubeflow_tpu.operator.reconciler import (
+        JOB_LABEL,
+        RESIZED_CONDITION,
+        Reconciler,
+    )
+    from kubeflow_tpu.training.launcher import DRAIN_EXIT_CODE
+
+    api = FakeApiServer()
+    spec = replica_spec(
+        "TPU_WORKER", WORKERS, image="citest:img",
+        tpu_accelerator="tpu-v5-lite-podslice", tpu_topology="1x1",
+        chips_per_worker=1)
+    job = tpu_job("elastic-kill", "default", [spec],
+                  termination=termination_policy("TPU_WORKER", 0),
+                  min_replicas=MIN_REPLICAS, max_replicas=WORKERS)
+    job["metadata"]["uid"] = "uid-elastic-kill"
+    api.create(job)
+
+    rec = Reconciler(api)
+
+    def reconcile():
+        return rec.reconcile(api.get("TPUJob", "default",
+                                     "elastic-kill"))
+
+    reconcile()
+    pods = api.list("Pod", "default", {JOB_LABEL: "elastic-kill"})
+    assert len(pods) == WORKERS, len(pods)
+    api.set_all_pod_phases("default", "Running")
+    assert reconcile() == "Running"
+
+    # Spot-kill one host mid-run (drain exit: finished its step,
+    # checkpointed, exited 77).
+    victim = sorted(p["metadata"]["name"] for p in pods)[2]
+    api.set_pod_terminated("default", victim, DRAIN_EXIT_CODE)
+
+    # The resize roll: begin (teardown) → hold → recreate → settle.
+    for _ in range(6):
+        phase = reconcile()
+        assert phase == "Running", f"job left Running: {phase!r}"
+        pods = api.list("Pod", "default", {JOB_LABEL: "elastic-kill"})
+        if len(pods) == WORKERS - 1:
+            api.set_all_pod_phases("default", "Running")
+    phase = reconcile()
+
+    status = api.get("TPUJob", "default", "elastic-kill")["status"]
+    conds = {c["type"]: c["status"] for c in status["conditions"]}
+    assert phase == "Running", phase
+    assert int(status.get("restartCount", 0)) == 0, status
+    assert int(status.get("currentReplicas", 0)) == WORKERS - 1, status
+    assert conds.get(RESIZED_CONDITION) == "True", conds
+    # The job never even ENTERED Restarting — the phase condition was
+    # never materialized.
+    assert "Restarting" not in conds, conds
+
+    pods = api.list("Pod", "default", {JOB_LABEL: "elastic-kill"})
+    names = sorted(p["metadata"]["name"] for p in pods)
+    assert len(names) == len(set(names)) == WORKERS - 1, names
+    # Zero duplicate pods across the whole episode: every pod CREATE
+    # the controller attempted landed exactly once (4 at birth +
+    # 3 on the resize roll; a duplicate attempt would show as an
+    # extra create in the request log, Conflict-swallowed or not).
+    creates = api.request_count(verb="create", kind="Pod")
+    assert creates == WORKERS + (WORKERS - 1), creates
+    # The rolled gang's env reflects the new world size.
+    for pod in pods:
+        env = {e["name"]: str(e.get("value"))
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["KFT_NUM_PROCESSES"] == str(WORKERS - 1), env
+
+
+def training_resume_case() -> None:
+    import jax
+    import optax
+
+    from kubeflow_tpu.models.llama import llama_test
+    from kubeflow_tpu.parallel.mesh import (
+        MeshSpec,
+        build_mesh,
+        respec_for_devices,
+    )
+    from kubeflow_tpu.training.checkpoint import (
+        ContinuousCheckpointConfig,
+        ShardedCheckpointer,
+    )
+    from kubeflow_tpu.training.data import synthetic_causal_lm
+    from kubeflow_tpu.training.lm import (
+        create_lm_state,
+        make_lm_train_step,
+        place_lm_batch,
+    )
+
+    devices = jax.devices()
+    assert len(devices) >= WORKERS, (
+        f"need >= {WORKERS} virtual devices, got {len(devices)} "
+        f"(XLA_FLAGS must land before jax imports)")
+
+    model = llama_test()
+    vocab = 512
+
+    def batches():
+        return synthetic_causal_lm(GLOBAL_BATCH, SEQ_LEN, vocab, seed=7)
+
+    def build(mesh):
+        gen = batches()
+        sample = next(gen)
+        state, shardings = create_lm_state(
+            model, optax.adamw(1e-3), jax.random.PRNGKey(3), sample,
+            mesh)
+        step_fn = make_lm_train_step(mesh, shardings,
+                                     objective="causal", donate=False)
+        return state, step_fn, gen, sample
+
+    def run(mesh, state, step_fn, gen, first_batch, start, stop,
+            checkpointers=()):
+        losses = {}
+        batch = first_batch
+        consumed = 0
+        # Deterministic stream: batch k feeds step k+1.
+        while consumed < start:
+            batch = next(gen)
+            consumed += 1
+        for step in range(start, stop):
+            placed = place_lm_batch(mesh, batch)
+            state, metrics = step_fn(state, placed)
+            losses[step + 1] = float(metrics["loss"])
+            for ckpt in checkpointers:
+                ckpt.save(step + 1, state, force=True)
+            if step + 1 < stop:
+                batch = next(gen)
+        return state, losses
+
+    # Reference: uninterrupted seeded run on the 4-device dp mesh.
+    mesh4 = build_mesh(MeshSpec(data=WORKERS), devices[:WORKERS])
+    state, step_fn, gen, sample = build(mesh4)
+    _, ref_losses = run(mesh4, state, step_fn, gen, sample, 0,
+                        TOTAL_STEPS)
+
+    # Elastic run: continuous sharded checkpoints from 4 emulated
+    # hosts (one checkpointer per host over one directory — the
+    # manifest commits only after every host's shard lands).
+    ckpt_dir = tempfile.mkdtemp(prefix="kft-elastic-")
+    checkpointers = [
+        ShardedCheckpointer(ContinuousCheckpointConfig(
+            directory=ckpt_dir, save_interval_steps=1,
+            num_hosts=WORKERS, host_id=h, min_shard_size=64,
+            mesh_shape={"data": WORKERS}))
+        for h in range(WORKERS)]
+    state, step_fn, gen, sample = build(mesh4)
+    _, pre_losses = run(mesh4, state, step_fn, gen, sample, 0,
+                        KILL_AFTER_STEP, checkpointers=checkpointers)
+    for ckpt in checkpointers:
+        assert ckpt.wait(30.0), "shard writes never became durable"
+        ckpt.close()
+    del state  # the "kill": host 3 is gone, in-memory state lost
+
+    # Resume on the SURVIVING 3 hosts: rebuild the mesh at the new
+    # device count, restore + reshard from the continuous shards.
+    new_spec = respec_for_devices(MeshSpec(data=WORKERS), WORKERS - 1)
+    mesh3 = build_mesh(new_spec, devices[:WORKERS - 1])
+    fresh, step_fn3, gen3, sample3 = build(mesh3)
+    reader = ShardedCheckpointer(ContinuousCheckpointConfig(
+        directory=ckpt_dir, num_hosts=1, host_id=0))
+    restored_step = reader.latest_step()
+    assert restored_step is not None
+    lost = KILL_AFTER_STEP - restored_step
+    assert 0 <= lost < 2, (
+        f"lost {lost} steps (kill at {KILL_AFTER_STEP}, restored "
+        f"{restored_step}) — acceptance is < 2")
+    resumed = reader.restore(fresh)
+    reader.close()
+    assert int(resumed.step) == restored_step
+
+    _, post_losses = run(mesh3, resumed, step_fn3, gen3, sample3,
+                         restored_step, TOTAL_STEPS)
+
+    # The resumed curve matches the uninterrupted reference within
+    # the documented cross-mesh tolerance.
+    for step in sorted(post_losses):
+        ref = ref_losses[step]
+        got = post_losses[step]
+        assert abs(got - ref) <= LOSS_RTOL * max(1.0, abs(ref)), (
+            f"step {step}: resumed loss {got} vs reference {ref}")
+    # And the pre-kill prefix was bitwise-identical (same mesh).
+    for step in sorted(pre_losses):
+        assert pre_losses[step] == ref_losses[step], (
+            step, pre_losses[step], ref_losses[step])
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kft-e2e-elastic")
+    parser.add_argument("--junit_path", default=None)
+    parser.add_argument("--fake", action="store_true",
+                        help="hermetic mode (the only mode: both "
+                             "halves are cluster-free by design)")
+    parser.add_argument("--skip_training", action="store_true",
+                        help="control-plane half only (no jax)")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    cases = [junit.run_case("elastic-resize", control_plane_case)]
+    if not args.skip_training:
+        cases.append(junit.run_case("elastic-training",
+                                    training_resume_case))
+    if args.junit_path:
+        junit.write_report(args.junit_path, "e2e-elastic", cases)
+    failed = [c for c in cases if not c.ok]
+    for case in failed:
+        print(case.failure or case.error, file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -91,6 +91,9 @@ from kubeflow_tpu.operator.reconciler import (
     JOB_LABEL,
     PREEMPTED_CONDITION,
     PREEMPTOR_CONDITION,
+    RESIZED_CONDITION,
+    RESIZING_CONDITION,
+    SHRUNK_CONDITION,
     STALLED_CONDITION,
 )
 
@@ -109,10 +112,15 @@ _D_REQUESTS = obs_metrics.Counter(
 #: reconciler's own constants — the banner must track what the
 #: operator actually writes.
 _WARNING_CONDITIONS = (STALLED_CONDITION, DEADLINE_CONDITION,
-                       PREEMPTED_CONDITION)
+                       PREEMPTED_CONDITION, SHRUNK_CONDITION)
 #: Informational (non-warning) conditions: the preemptor's record of
-#: having evicted a victim — the other half of the preemption story.
-_INFO_CONDITIONS = (PREEMPTOR_CONDITION,)
+#: having evicted a victim — the other half of the preemption story —
+#: and an elastic resize roll in flight.
+_INFO_CONDITIONS = (PREEMPTOR_CONDITION, RESIZING_CONDITION)
+#: Record conditions stay True as history (the last completed resize)
+#: — no banner, and they must not steal the per-job transition anchor
+#: from the phase conditions.
+_RECORD_CONDITIONS = (RESIZED_CONDITION,)
 
 
 def job_warnings(job: Dict[str, Any]) -> list:
@@ -157,15 +165,33 @@ def job_summary(job: Dict[str, Any]) -> Dict[str, Any]:
     active = next((c for c in status.get("conditions", [])
                    if c.get("status") == "True"
                    and c.get("type") not in _WARNING_CONDITIONS
-                   and c.get("type") not in _INFO_CONDITIONS), {})
-    from kubeflow_tpu.operator.reconciler import job_priority
+                   and c.get("type") not in _INFO_CONDITIONS
+                   and c.get("type") not in _RECORD_CONDITIONS), {})
+    from kubeflow_tpu.operator.reconciler import (
+        elastic_current_replicas,
+        job_elastic_bounds,
+        job_priority,
+    )
 
+    # Elastic view rides the RECONCILER's own coercion helpers:
+    # malformed min/max/current degrade to the rigid reading (None),
+    # never a 500 — the badge must show what the operator will
+    # actually do.
+    bounds = job_elastic_bounds(job)
+    elastic = None
+    if bounds is not None:
+        elastic = {
+            "current": elastic_current_replicas(job),
+            "min": bounds[0],
+            "max": bounds[1],
+        }
     return {
         "name": meta.get("name", ""),
         "namespace": meta.get("namespace", ""),
         "phase": status.get("phase", "Pending"),
         "restartCount": status.get("restartCount", 0),
         "replicas": replicas,
+        "elastic": elastic,
         "numSlices": int(job.get("spec", {}).get("numSlices", 1) or 1),
         # The operator's own coercion — the badge must show what the
         # preemption logic will actually act on.
@@ -690,7 +716,7 @@ _DETAIL_PAGE = """<!doctype html>
 <p><a href="/tpujobs/ui/">&larr; all jobs</a></p>
 <h1>{name} <small style="color:{phase_color}">{phase}</small></h1>
 <p>{namespace} &middot; restarts {restarts} &middot; slices {slices}
-&middot; last transition {transition} {reason}</p>
+{elastic_line}&middot; last transition {transition} {reason}</p>
 {warning_banner}
 <h2>Replicas</h2>
 <table>
@@ -803,6 +829,17 @@ class UIJobDetailHandler(BaseHandler):
                 f"<td>{html.escape(e['lastTimestamp'][:19])}</td>"
                 f"<td>{html.escape(e['message'])}</td>"
                 "</tr>")
+        # Elastic badge: current/min/max workers; rendered only for
+        # elastic jobs (job_summary already degraded any malformed
+        # bounds to the rigid reading).
+        elastic_line = ""
+        if summary.get("elastic"):
+            e = summary["elastic"]
+            elastic_line = (
+                f"&middot; workers "
+                f"{html.escape(str(e.get('current')))}"
+                f" (min {html.escape(str(e.get('min')))}"
+                f" / max {html.escape(str(e.get('max')))}) ")
         self.set_header("Content-Type", "text/html; charset=utf-8")
         self.finish(_DETAIL_PAGE.format(
             name=html.escape(name),
@@ -811,6 +848,7 @@ class UIJobDetailHandler(BaseHandler):
             phase_color=_PHASE_COLORS.get(summary["phase"], "#57606a"),
             restarts=int(summary["restartCount"]),
             slices=int(summary["numSlices"]),
+            elastic_line=elastic_line,
             transition=html.escape(summary["lastTransitionTime"] or "-"),
             reason=html.escape(
                 f"({summary['reason']})" if summary["reason"] else ""),

@@ -135,6 +135,18 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             "--junit_path",
             f"{params['artifacts_dir']}/junit_leader_failover.xml",
         ],
+        # Elastic-kill test (ISSUE 12): kill 1 of 4 gang hosts
+        # mid-run — the reconciler must RESIZE the gang (Running
+        # throughout, zero duplicate pods, no restart-budget burn)
+        # and the seeded training run must resume from the
+        # continuous sharded checkpoint on the surviving hosts with
+        # < 2 steps lost and the same loss curve. Hermetic — fake
+        # apiserver + virtual CPU devices.
+        "elastic-kill-test": [
+            py, "-m", "kubeflow_tpu.citests.elastic", "--fake",
+            "--junit_path",
+            f"{params['artifacts_dir']}/junit_elastic.xml",
+        ],
         # Serving-mesh dryrun (ISSUE 10): the MULTICHIP-style gate
         # for the sharded export/load path — a CPU child pinned to a
         # virtual 2-device platform proves placement + bitwise
@@ -198,6 +210,7 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             _dag_task("unit-test", ["checkout"]),
             _dag_task("sanitizer-test", ["checkout"]),
             _dag_task("leader-failover-test", ["checkout"]),
+            _dag_task("elastic-kill-test", ["checkout"]),
             _dag_task("serving-mesh-dryrun", ["checkout"]),
             _dag_task("deploy-test", ["checkout"]),
             _dag_task("deploy-serving", ["deploy-test"]),

@@ -157,6 +157,8 @@ def tpu_job(
     num_slices: int = 1,
     scheduling_deadline_seconds: Optional[int] = None,
     priority: int = 0,
+    min_replicas: Optional[int] = None,
+    max_replicas: Optional[int] = None,
 ) -> Dict[str, Any]:
     """A TPUJob CR (parity: ``tfJob``, reference
     ``tf-job.libsonnet:44-56``). ``recovery`` is new: TPU slices fail
@@ -184,6 +186,33 @@ def tpu_job(
         raise ValueError(
             f"priority must be >= 0 (0 = the default, preemptible "
             f"class), got {priority}")
+    # Elastic gangs (r16): minReplicas makes the job resize through
+    # worker loss instead of riding the restart budget — the operator
+    # keeps the gang Running in [minReplicas, maxReplicas] and the
+    # training loop reshards from its continuous checkpoint. Validated
+    # at generate time: an incoherent bound silently degrades to rigid
+    # inside the operator, which would surprise at the worst moment
+    # (mid-preemption).
+    if min_replicas is not None:
+        workers = [s for s in replica_specs
+                   if s.get("tpuReplicaType") == "TPU_WORKER"]
+        if len(workers) != 1:
+            raise ValueError(
+                "elastic jobs (min_replicas) need exactly one "
+                "TPU_WORKER replicaSpec")
+        if num_slices > 1:
+            raise ValueError(
+                "elastic jobs are single-slice (a megascale SPMD "
+                "program spanning slices recovers all-or-nothing)")
+        desired = int(workers[0].get("replicas", 1))
+        effective_max = desired if max_replicas is None else max_replicas
+        if not 1 <= min_replicas <= desired <= effective_max:
+            raise ValueError(
+                f"need 1 <= min_replicas ({min_replicas}) <= replicas "
+                f"({desired}) <= max_replicas ({effective_max})")
+    elif max_replicas is not None:
+        raise ValueError("max_replicas needs min_replicas (the "
+                         "elastic bounds travel together)")
     return {
         "apiVersion": f"{GROUP}/{VERSION}",
         "kind": KIND,
@@ -210,6 +239,11 @@ def tpu_job(
                 # docs/operator.md). 0 (the default) never preempts
                 # and stays schema-identical to pre-r12 manifests.
                 "priority": priority if priority else None,
+                # Elastic bounds (r16): absent = rigid, schema-
+                # identical to pre-r16 manifests.
+                "minReplicas": min_replicas,
+                "maxReplicas": (max_replicas
+                                if min_replicas is not None else None),
             }
         ),
     }
@@ -249,6 +283,12 @@ def crd() -> Dict[str, Any]:
                         "type": "integer", "minimum": 1,
                     },
                     "priority": {"type": "integer", "minimum": 0},
+                    # Elastic gang bounds (r16): with minReplicas set,
+                    # the operator resizes the TPU_WORKER gang through
+                    # member loss / preemption inside [min, max]
+                    # instead of restarting or dying.
+                    "minReplicas": {"type": "integer", "minimum": 1},
+                    "maxReplicas": {"type": "integer", "minimum": 1},
                 },
             },
             "status": {
@@ -453,7 +493,9 @@ def _generic_job_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
                     num_slices=p["num_slices"],
                     scheduling_deadline_seconds=(
                         p["scheduling_deadline_seconds"] or None),
-                    priority=p["priority"])]
+                    priority=p["priority"],
+                    min_replicas=p["min_replicas"] or None,
+                    max_replicas=p["max_replicas"] or None)]
 
 
 register(
@@ -487,6 +529,16 @@ register(
               "only, rate-limited; needs "
               "scheduling_deadline_seconds). 0 = default, "
               "preemptible."),
+        Param("min_replicas", 0, "int",
+              "Elastic gang floor: > 0 lets the operator RESIZE the "
+              "TPU_WORKER gang through worker loss / preemption "
+              "(down to this many workers) instead of restarting or "
+              "killing it; the trainer reshards from its continuous "
+              "checkpoint. 0 = rigid (the default). See "
+              "docs/operator.md."),
+        Param("max_replicas", 0, "int",
+              "Elastic gang ceiling (needs min_replicas; 0 = the "
+              "declared num_tpu_workers)."),
     ],
     package="tpu-job",
 )(_generic_job_builder)
@@ -740,6 +792,31 @@ def _lm_pretrain_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
         args.append(f"--data={p['data']}")
         if p["bin_dtype"] != "uint16":
             args.append(f"--bin_dtype={p['bin_dtype']}")
+    if p["min_replicas"]:
+        if num_slices > 1:
+            raise ValueError("elastic jobs (min_replicas) are "
+                             "single-slice")
+        if not p["checkpoint_dir"]:
+            # An elastic resize resumes from the continuous sharded
+            # checkpoint; without a checkpoint dir the resized gang
+            # would restart the run from step 0 — elasticity without
+            # the recovery half is a silent-data-loss trap.
+            raise ValueError("elastic jobs (min_replicas) need "
+                             "checkpoint_dir (the resize resumes "
+                             "from the continuous checkpoint)")
+        if p["mesh"] and any(f"{axis}=" in p["mesh"]
+                             for axis in ("tensor", "pipeline", "seq",
+                                          "expert")):
+            # Model-parallel axes are sized to the gang; a resize
+            # would need a different parameter factorization, which
+            # the restore path does not re-plan. Elastic = dp/fsdp.
+            raise ValueError("elastic jobs support data/fsdp meshes "
+                             "only (model-parallel axes cannot "
+                             "resize)")
+    if p["continuous_every"]:
+        if not p["checkpoint_dir"]:
+            raise ValueError("continuous_every needs checkpoint_dir")
+        args.append(f"--continuous_every={p['continuous_every']}")
     volumes = volume_mounts = None
     if p["checkpoint_dir"]:
         args.append(f"--checkpoint_dir={p['checkpoint_dir']}")
@@ -760,6 +837,8 @@ def _lm_pretrain_builder(p: Dict[str, Any]) -> List[Dict[str, Any]]:
         p["name"], p["namespace"], [spec],
         termination=termination_policy("TPU_WORKER", 0),
         num_slices=num_slices,
+        min_replicas=p["min_replicas"] or None,
+        max_replicas=p["max_replicas"] or None,
     )]
 
 
@@ -813,6 +892,21 @@ register(
               ">1 = multi-slice (megascale) job: one gang per slice, "
               "all-or-nothing recovery across the union; the mesh's "
               "dcn_data axis defaults to this count in-pod."),
+        Param("min_replicas", 0, "int",
+              "Elastic gang floor: > 0 keeps the job Running through "
+              "worker loss — the operator resizes the gang (never "
+              "below this) and the trainer reshards from the "
+              "continuous checkpoint. Needs checkpoint_dir; "
+              "data/fsdp meshes only. 0 = rigid."),
+        Param("max_replicas", 0, "int",
+              "Elastic gang ceiling (0 = num_tpu_workers)."),
+        Param("continuous_every", 0, "int",
+              "Continuous sharded checkpointing: per-host async "
+              "shard writes every N steps under "
+              "checkpoint_dir/continuous (manifest-last atomic "
+              "commit — a mid-write crash never yields a torn "
+              "restore). 0 = off. Elastic resizes restore from "
+              "these shards."),
     ],
     package="tpu-job",
 )(_lm_pretrain_builder)

@@ -362,6 +362,213 @@ def _run_scale_row(*, jobs, workers, churn_kills, poison_jobs,
         kubelet.join(timeout=5)
 
 
+def run_elastic_churn_bench(
+        *, elastic_jobs: int = 6,
+        rigid_jobs: int = 6,
+        workers_per_gang: int = 4,
+        min_replicas: int = 2,
+        survivors: int = 2,
+        deadline_seconds: float = 3.0,
+        relist_seconds: float = 0.3,
+        controller_workers: int = 4,
+        converge_timeout: float = 30.0,
+        storm_timeout: float = 45.0) -> Dict[str, Any]:
+    """The r16 elastic acceptance phase: a spot storm that halves
+    every gang's schedulable hosts. Elastic jobs (minReplicas) must
+    RIDE THROUGH — resize to the survivors, stay Running, burn zero
+    restart budget, never materialize a Restarting condition — while
+    rigid gangs restart into a pool that can no longer hold them and
+    deadline-fail (the post-restart scheduling-stall deadline),
+    releasing their chips. Real WatchController + informer reads +
+    workqueue settle timers; per-job capacity is enforced by a
+    kubelet stand-in that only schedules replica indices below the
+    job's surviving host count."""
+    from kubeflow_tpu.operator.reconciler import (
+        DEADLINE_CONDITION,
+        REPLICA_INDEX_LABEL,
+        RESIZED_CONDITION,
+    )
+    from kubeflow_tpu.training.launcher import DRAIN_EXIT_CODE
+
+    with _quiet_operator_logs():
+        api = FakeApiServer()
+        e_names = [f"elastic-{i:02d}" for i in range(elastic_jobs)]
+        r_names = [f"rigid-{i:02d}" for i in range(rigid_jobs)]
+
+        def make(name: str, elastic: bool) -> Dict[str, Any]:
+            spec = replica_spec(
+                "TPU_WORKER", workers_per_gang, image="bench:img",
+                tpu_accelerator="tpu-v5-lite-podslice",
+                tpu_topology="1x1", chips_per_worker=1)
+            job = tpu_job(
+                name, "default", [spec],
+                termination=termination_policy("TPU_WORKER", 0),
+                scheduling_deadline_seconds=max(
+                    1, int(deadline_seconds)),
+                min_replicas=min_replicas if elastic else None)
+            job["metadata"]["uid"] = f"uid-{name}"
+            return job
+
+        with api.as_kubelet():
+            for name in e_names:
+                api.create(make(name, True))
+            for name in r_names:
+                api.create(make(name, False))
+
+        # Per-job host capacity: the kubelet stand-in schedules only
+        # replica indices below it. The storm halves it.
+        capacity = {n: workers_per_gang for n in e_names + r_names}
+        capacity_lock = threading.Lock()
+        kubelet_stop = threading.Event()
+
+        def kubelet_loop():
+            while not kubelet_stop.is_set():
+                with api.as_kubelet():
+                    for pod in api._list("Pod", "default",
+                                         {JOB_LABEL: None}):
+                        if pod.get("status", {}).get("phase") not in (
+                                None, "Pending"):
+                            continue
+                        labels = pod["metadata"].get("labels", {})
+                        job_name = labels.get(JOB_LABEL, "")
+                        try:
+                            index = int(labels.get(
+                                REPLICA_INDEX_LABEL, "0"))
+                        except ValueError:
+                            index = 0
+                        with capacity_lock:
+                            cap = capacity.get(job_name, 0)
+                        if index < cap:
+                            api.set_pod_phase(
+                                "default", pod["metadata"]["name"],
+                                "Running")
+                kubelet_stop.wait(0.02)
+
+        ctl = WatchController(
+            api, relist_seconds=relist_seconds,
+            workers=controller_workers,
+            backoff=ExponentialBackoff(base=0.02, cap=0.5),
+            limiter=TokenBucket(qps=2000.0, burst=2000))
+        ctl_thread = threading.Thread(target=ctl.run, daemon=True)
+        kubelet = threading.Thread(target=kubelet_loop, daemon=True)
+        ctl_thread.start()
+        kubelet.start()
+        try:
+            def job_status(name):
+                with api.as_kubelet():
+                    return api.get(KIND, "default", name).get(
+                        "status", {})
+
+            def all_running(names, count):
+                for name in names:
+                    status = job_status(name)
+                    if status.get("phase") != "Running":
+                        return False
+                    with api.as_kubelet():
+                        pods = api._list("Pod", "default",
+                                         {JOB_LABEL: name})
+                    if len(pods) != count or any(
+                            p.get("status", {}).get("phase")
+                            != "Running" for p in pods):
+                        return False
+                return True
+
+            def wait_for(predicate, timeout):
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < timeout:
+                    if predicate():
+                        return time.monotonic() - t0
+                    time.sleep(0.03)
+                return None
+
+            converged = wait_for(
+                lambda: all_running(e_names + r_names,
+                                    workers_per_gang),
+                converge_timeout)
+
+            # The spot storm: every gang loses its top half — the
+            # lost hosts drain (exit 77) and NEVER come back (the
+            # pool shrank).
+            with capacity_lock:
+                for name in capacity:
+                    capacity[name] = survivors
+            storm_t0 = time.monotonic()
+            with api.as_kubelet():
+                for pod in api._list("Pod", "default",
+                                     {JOB_LABEL: None}):
+                    labels = pod["metadata"].get("labels", {})
+                    if int(labels.get(REPLICA_INDEX_LABEL,
+                                      "0")) >= survivors:
+                        api.set_pod_terminated(
+                            "default", pod["metadata"]["name"],
+                            DRAIN_EXIT_CODE)
+
+            elastic_at = wait_for(
+                lambda: all_running(e_names, survivors),
+                storm_timeout)
+
+            def rigid_failed():
+                for name in r_names:
+                    status = job_status(name)
+                    if status.get("phase") != "Failed":
+                        return False
+                    conds = {c.get("type"): c.get("status")
+                             for c in status.get("conditions", [])}
+                    if conds.get(DEADLINE_CONDITION) != "True":
+                        return False
+                return True
+
+            rigid_at = wait_for(rigid_failed, storm_timeout)
+
+            elastic_rows = []
+            for name in e_names:
+                status = job_status(name)
+                conds = {c.get("type"): c.get("status")
+                         for c in status.get("conditions", [])}
+                elastic_rows.append({
+                    "name": name,
+                    "phase": status.get("phase"),
+                    "currentReplicas": status.get("currentReplicas"),
+                    "restartCount": int(
+                        status.get("restartCount", 0)),
+                    "resized": conds.get(RESIZED_CONDITION) == "True",
+                    # Never even ENTERED Restarting: the condition
+                    # was never materialized.
+                    "never_restarting": "Restarting" not in conds,
+                })
+            stats = ctl.stats()
+            return {
+                "bench": "elastic_churn",
+                "elastic_jobs": elastic_jobs,
+                "rigid_jobs": rigid_jobs,
+                "workers_per_gang": workers_per_gang,
+                "min_replicas": min_replicas,
+                "survivors": survivors,
+                "deadline_seconds": deadline_seconds,
+                "converged": converged is not None,
+                "converge_seconds": round(converged or -1.0, 2),
+                "elastic_rode_through": sum(
+                    1 for r in elastic_rows
+                    if r["phase"] == "Running" and r["resized"]
+                    and r["restartCount"] == 0
+                    and r["never_restarting"]),
+                "elastic_reconverge_seconds": round(
+                    elastic_at if elastic_at is not None else -1.0, 2),
+                "rigid_deadline_failed": sum(
+                    1 for name in r_names
+                    if job_status(name).get("phase") == "Failed"),
+                "rigid_failed_seconds": round(
+                    rigid_at if rigid_at is not None else -1.0, 2),
+                "gang_resizes": stats["gangResizes"],
+                "elastic_rows": elastic_rows,
+            }
+        finally:
+            kubelet_stop.set()
+            ctl.stop.set()
+            ctl_thread.join(timeout=15)
+            kubelet.join(timeout=5)
+
+
 def _run(*, jobs, workers_list, conflict_rate, throttle_rate,
          error_rate, watch_drop_events, latency, converge_timeout,
          steady_window, relist_seconds, backoff, qps) -> Dict[str, Any]:

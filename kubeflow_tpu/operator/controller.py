@@ -128,6 +128,12 @@ _O_PREEMPTIONS = obs_metrics.Counter(
 _O_PREEMPTIONS_LIMITED = obs_metrics.Counter(
     "kft_operator_preemptions_rate_limited_total",
     "Preemption decisions refused by the global rate limiter")
+_O_GANG_RESIZES = obs_metrics.Counter(
+    "kft_operator_gang_resizes_total",
+    "Elastic gang resizes by direction (shrink = member loss / "
+    "admission pressure / preemptor shrink; grow = restart back "
+    "toward the desired size)",
+    ("direction",))
 
 #: Kinds the controller keeps informer caches for — everything the
 #: reconcile hot path reads. Pods/Services/PDBs are gang-owned and
@@ -300,6 +306,10 @@ class WatchController:
             lambda c=self: c.reconciler.preemption.granted)
         _O_PREEMPTIONS_LIMITED.set_function(
             lambda c=self: c.reconciler.preemption.rate_limited)
+        for direction in ("shrink", "grow"):
+            _O_GANG_RESIZES.labels(direction=direction).set_function(
+                lambda c=self, d=direction:
+                c.reconciler.resize_counts().get(d, 0))
 
     # Watch-loop health, aggregated from the informers. A 410 Gone is
     # NOT an error — the server compacted our resume point and the
@@ -498,6 +508,7 @@ class WatchController:
             "informers": {kind: inf.stats()
                           for kind, inf in self.informers.items()},
             "preemption": self.reconciler.preemption.stats(),
+            "gangResizes": self.reconciler.resize_counts(),
             "requeueLatencyMs": self.queue.latency_percentiles(),
             "queue": self.queue.stats(),
         }

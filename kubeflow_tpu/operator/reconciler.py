@@ -83,6 +83,12 @@ JOB_LABEL = "kubeflow.org/tpujob"
 REPLICA_TYPE_LABEL = "kubeflow.org/replica-type"
 REPLICA_INDEX_LABEL = "kubeflow.org/replica-index"
 SLICE_INDEX_LABEL = "kubeflow.org/slice-index"
+# Elastic resize roll bookkeeping (r16): every gang pod carries the
+# resize generation it was created under. A resize bumps
+# status.resizeGeneration, so the roll can tell a STALE pod (old
+# world size baked into its env — same name as its successor) from a
+# freshly-created member of the new gang.
+GANG_GENERATION_LABEL = "kubeflow.org/gang-generation"
 # Non-phase conditions: set alongside the phase conditions, never
 # flipped by the phase machinery in _update_conditions.
 STALLED_CONDITION = "ReconcileStalled"
@@ -91,6 +97,18 @@ DEADLINE_CONDITION = "DeadlineExceeded"
 # reschedules back to Running); the preemptor records PreemptedVictim.
 PREEMPTED_CONDITION = "Preempted"
 PREEMPTOR_CONDITION = "PreemptedVictim"
+# Elastic gangs (r16): Resizing is True while a coordinated resize
+# roll is in flight (old gang torn down, new gang not yet running);
+# Resized records the last completed resize. GangShrunk marks a gang
+# the preemptor (or admission pressure) shrank below its desired
+# size — cleared only when the gang runs at full size again.
+RESIZING_CONDITION = "Resizing"
+RESIZED_CONDITION = "Resized"
+SHRUNK_CONDITION = "GangShrunk"
+# Settle timer while a resize roll waits for old pods to terminate:
+# the workqueue re-observes at this cadence instead of waiting for
+# the relist period.
+RESIZE_SETTLE_SECONDS = 0.2
 
 
 def pod_drained(pod: Optional[Dict[str, Any]]) -> bool:
@@ -241,6 +259,80 @@ def job_priority(job: Dict[str, Any]) -> int:
         return 0
 
 
+def job_elastic_bounds(job: Dict[str, Any]
+                       ) -> Optional[Tuple[int, int]]:
+    """``(minReplicas, maxReplicas)`` for an elastic job, or None for
+    a rigid one. Elasticity applies to the TPU_WORKER replica count of
+    a single-slice job with exactly one TPU_WORKER replicaSpec; any
+    garbage/incoherent bound degrades to rigid — a bad value must
+    never make the operator resize (or refuse to restart) a gang that
+    never asked for elasticity."""
+    spec = job.get("spec", {})
+    raw_min = spec.get("minReplicas")
+    if raw_min is None:
+        return None
+    if job_num_slices(job) > 1:
+        return None  # megascale slices recover all-or-nothing
+    workers = [s for s in spec.get("replicaSpecs", [])
+               if s.get("tpuReplicaType") == "TPU_WORKER"]
+    if len(workers) != 1:
+        return None
+    try:
+        desired = int(workers[0].get("replicas", 1))
+        lo = int(raw_min)
+        hi = int(spec.get("maxReplicas", desired) or desired)
+    except (TypeError, ValueError):
+        return None
+    if not 1 <= lo <= desired <= hi:
+        return None
+    return (lo, hi)
+
+
+def elastic_current_replicas(job: Dict[str, Any]) -> Optional[int]:
+    """The elastic gang's CURRENT worker count (status.currentReplicas
+    clamped into [min, max]), or None for rigid jobs. Defaults to the
+    desired spec count; garbage in status degrades to desired."""
+    bounds = job_elastic_bounds(job)
+    if bounds is None:
+        return None
+    lo, hi = bounds
+    desired = _desired_workers(job)
+    raw = job.get("status", {}).get("currentReplicas")
+    try:
+        current = desired if raw is None else int(raw)
+    except (TypeError, ValueError):
+        current = desired
+    return max(lo, min(hi, current))
+
+
+def _desired_workers(job: Dict[str, Any]) -> int:
+    return sum(int(s.get("replicas", 1))
+               for s in job.get("spec", {}).get("replicaSpecs", [])
+               if s.get("tpuReplicaType") == "TPU_WORKER")
+
+
+def _condition_true(status: Dict[str, Any], cond_type: str) -> bool:
+    return any(c.get("type") == cond_type and c.get("status") == "True"
+               for c in status.get("conditions", []))
+
+
+def _resize_generation(status: Dict[str, Any]) -> int:
+    try:
+        return int(status.get("resizeGeneration", 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def _shrinkable(job: Dict[str, Any]) -> bool:
+    """Whether a preemption victim can absorb the eviction as an
+    elastic shrink (current size strictly above minReplicas)."""
+    bounds = job_elastic_bounds(job)
+    if bounds is None:
+        return False
+    current = elastic_current_replicas(job)
+    return current is not None and current > bounds[0]
+
+
 class PreemptionPolicy:
     """Gang-preemption knobs + the GLOBAL rate limiter.
 
@@ -268,11 +360,15 @@ class PreemptionPolicy:
         self._clock = clock
         self._last: Optional[float] = None
         self._lock = threading.Lock()
-        # Counters for the stats/metrics surface.
+        # Counters for the stats/metrics surface. ``shrunk`` counts
+        # the grants that resolved as an elastic shrink rather than a
+        # gang kill (r16 shrink-first rule) — both actions share the
+        # interval and the one-victim-per-episode latch.
         self.eligible = 0
         self.granted = 0
         self.rate_limited = 0
         self.no_victim = 0
+        self.shrunk = 0
 
     def try_acquire(self) -> Optional[float]:
         """Claim the global preemption interval if it has elapsed;
@@ -315,18 +411,30 @@ class PreemptionPolicy:
             "granted": self.granted,
             "rateLimited": self.rate_limited,
             "noVictim": self.no_victim,
+            "shrunk": self.shrunk,
         }
 
 
 def expected_members(job: Dict[str, Any]) -> List[ReplicaMember]:
     """Every expected pod, slice-major (slice 0's replicas first) —
     the order that makes the global TPU_WORKER process ids put the
-    ``dcn_data`` mesh axis exactly on slice boundaries."""
+    ``dcn_data`` mesh axis exactly on slice boundaries.
+
+    Elastic jobs (``spec.minReplicas``, r16): the TPU_WORKER count is
+    the CURRENT gang size (``status.currentReplicas``, clamped into
+    [min, max]) rather than the spec's desired count — the membership
+    view every consumer (pod creation, env injection, PDB sizing,
+    preemption teardown) must agree on after a resize."""
     num_slices = job_num_slices(job)
+    current = elastic_current_replicas(job)
     members: List[ReplicaMember] = []
     for slice_id in range(num_slices):
         for spec in job["spec"].get("replicaSpecs", []):
-            for index in range(int(spec.get("replicas", 1))):
+            n = int(spec.get("replicas", 1))
+            if (current is not None
+                    and spec.get("tpuReplicaType") == "TPU_WORKER"):
+                n = current
+            for index in range(n):
                 members.append(ReplicaMember(
                     replica_type=spec["tpuReplicaType"], index=index,
                     spec=spec, slice_id=slice_id, num_slices=num_slices))
@@ -366,6 +474,13 @@ class Reconciler:
         self.max_restarts = max_restarts
         self.completion_grace_passes = completion_grace_passes
         self.preemption = preemption or PreemptionPolicy()
+        # Elastic-gang resize ledger (kft_operator_gang_resizes_total
+        # {direction} rides these via the controller's render-time
+        # callbacks): shrink = member loss / admission pressure /
+        # preemptor shrink; grow = a slice restart resetting a shrunk
+        # gang back to its desired size.
+        self._resize_lock = threading.Lock()
+        self._resizes = {"shrink": 0, "grow": 0}
         # Per-pass, PER-THREAD (N controller workers share one
         # Reconciler): seconds after which this job wants another
         # look even with no events (a pending schedulingDeadline).
@@ -379,6 +494,14 @@ class Reconciler:
         the cache wraps it."""
         self.api = cached
         self.reader = cached
+
+    def resize_counts(self) -> Dict[str, int]:
+        with self._resize_lock:
+            return dict(self._resizes)
+
+    def _count_resize(self, direction: str) -> None:
+        with self._resize_lock:
+            self._resizes[direction] = self._resizes.get(direction, 0) + 1
 
     @property
     def requeue_after(self) -> Optional[float]:
@@ -519,6 +642,8 @@ class Reconciler:
                     REPLICA_TYPE_LABEL: member.replica_type,
                     REPLICA_INDEX_LABEL: str(member.index),
                     SLICE_INDEX_LABEL: str(member.slice_id),
+                    GANG_GENERATION_LABEL: str(_resize_generation(
+                        job.get("status", {}))),
                 },
                 "ownerReferences": [{
                     "apiVersion": f"{GROUP}/{VERSION}",
@@ -548,6 +673,12 @@ class Reconciler:
             return self._set_status(job, "Failed",
                                     reason="no replicaSpecs")
         chief = chief_member_index(job, members)
+        # Elastic gangs (r16): ``elastic`` carries (min, max) worker
+        # bounds (None = rigid); ``resizing`` is True while a
+        # coordinated resize roll is in flight (old gang torn down,
+        # new one not yet running).
+        elastic = job_elastic_bounds(job)
+        resizing = _condition_true(status, RESIZING_CONDITION)
 
         # Gang scheduling deadline bookkeeping happens after the pod
         # scan below — the verdict must come from LIVE pod state, not
@@ -602,6 +733,33 @@ class Reconciler:
             if any(m.pod_name(name) in pods for m in members):
                 return phase
 
+        if resizing:
+            # Coordinated resize roll in flight: the WHOLE old gang
+            # must terminate before the new one is created (every
+            # pod's KFT_NUM_PROCESSES / TPU_WORKER_HOSTNAMES env
+            # changes with the gang size, and an old high-index pod
+            # lingering past a shrink would be a zombie voter in the
+            # collective). Old and new pods share NAMES — the resize
+            # generation label is what tells them apart: pods from an
+            # older generation (or none) are stale and get swept,
+            # including stragglers whose indices fall outside the NEW
+            # membership. Settle timer instead of waiting for a
+            # relist. Pods of the CURRENT generation are the new gang
+            # — fall through to the normal flow so Resizing settles.
+            generation = str(_resize_generation(status))
+            stale = [
+                pod_name for pod_name, pod in pods.items()
+                if pod.get("metadata", {}).get("labels", {})
+                .get(GANG_GENERATION_LABEL) != generation]
+            if stale:
+                for pod_name in stale:
+                    try:
+                        self.api.delete("Pod", ns, pod_name)
+                    except NotFound:
+                        pass
+                self.requeue_after = RESIZE_SETTLE_SECONDS
+                return phase
+
         # MISSING means the pod OBJECT is absent. A pod that exists
         # but has no status.phase yet (the window between create and
         # the kubelet's first status write) is PENDING — reading it
@@ -614,6 +772,27 @@ class Reconciler:
             if m.pod_name(name) in pods else PodPhase.MISSING
             for m in members
         ]
+
+        # Elastic member loss (r16 tentpole): a Running elastic gang
+        # that lost TPU_WORKER members — spot preemption, eviction,
+        # crash — RESIZES to the survivor count (clamped to [min,
+        # max]) instead of riding the restart-budget path: one
+        # coordinated roll rewrites every survivor's gang env/world
+        # view and the training loop reshards from the continuous
+        # checkpoint. Below min the elastic contract is exhausted and
+        # the classic whole-slice machinery takes over.
+        if elastic is not None and not resizing and phase == "Running":
+            new_size = self._plan_member_loss_resize(
+                members, phases, elastic)
+            if new_size is not None:
+                current = elastic_current_replicas(job)
+                return self._begin_resize(
+                    job, phase, new_size, restarts=restarts, pods=pods,
+                    detail=f"member loss: resizing gang "
+                           f"{current} -> {new_size} workers "
+                           f"(minReplicas={elastic[0]}; restart "
+                           f"budget {restarts}/{self.max_restarts} "
+                           f"unchanged)")
 
         # Gang scheduling deadline: a gang that can never place sits
         # Pending forever — on TPUs that is held hardware. Enforced
@@ -631,6 +810,19 @@ class Reconciler:
                 any(p != PodPhase.MISSING for p in phases)
                 and all(p in (PodPhase.PENDING, PodPhase.MISSING)
                         for p in phases))
+            # Elastic admission shrink (r16): a Pending elastic gang
+            # burning through its scheduling deadline is asking for
+            # more chips than the pool has — shrink one worker toward
+            # minReplicas (paced at half the eligibility fraction)
+            # and retry, instead of holding out for the full size
+            # until the deadline kills it. At min the deadline
+            # applies unchanged.
+            if (elastic is not None and not resizing
+                    and awaiting_schedule and age is not None):
+                shrunk = self._maybe_admission_shrink(
+                    job, elastic, deadline, age, restarts, pods)
+                if shrunk is not None:
+                    return shrunk
             if (age is not None and age >= deadline
                     and awaiting_schedule):
                 for m in members:
@@ -683,6 +875,18 @@ class Reconciler:
                                - age)
                     if trigger > 0:
                         wake = min(wake, trigger)
+                if elastic is not None:
+                    # Also wake at the admission-shrink eligibility
+                    # instant (same fraction as preemption) so a
+                    # stuck elastic gang shrinks on time rather than
+                    # at the next relist.
+                    current = elastic_current_replicas(job)
+                    if current is not None and current > elastic[0]:
+                        trigger = (deadline
+                                   * self.preemption.deadline_fraction
+                                   - age)
+                        if trigger > 0:
+                            wake = min(wake, trigger)
                 self.requeue_after = wake
 
         allow_restart = job["spec"].get("recoveryPolicy",
@@ -733,6 +937,14 @@ class Reconciler:
             # restartCount at 0 by design, and a long-running job
             # regressing to Pending after a spot preemption would read
             # as never-started on every dashboard.
+            if resizing and phase in ("Running", "Pending"):
+                # A mid-resize recreate keeps the display phase: an
+                # elastic gang rolling to a new size never
+                # "restarted" — a Running gang stays Running through
+                # the membership change, an admission-shrinking gang
+                # stays Pending until it actually schedules.
+                return self._set_status(job, phase,
+                                        restart_count=restarts)
             recreating = restarts > 0 or phase == "Restarting"
             return self._set_status(
                 job, "Running" if recreating else "Pending",
@@ -743,14 +955,27 @@ class Reconciler:
                     self.api.delete("Pod", ns, m.pod_name(name))
                 except NotFound:
                     pass
+            # Elastic grow-back: a full slice restart is a fresh
+            # scheduling attempt — reset a shrunk gang to its desired
+            # size (admission shrink re-shrinks it if chips are still
+            # scarce). Counted as a grow resize.
+            grow_to: Optional[int] = None
+            if elastic is not None:
+                desired = _desired_workers(job)
+                current = elastic_current_replicas(job)
+                if current is not None and current < desired:
+                    grow_to = desired
+                    self._count_resize("grow")
             if drained_only:
                 return self._set_status(
                     job, "Restarting", restart_count=restarts,
+                    current_replicas=grow_to,
                     reason="preemption drain; restarting from drain "
                            f"checkpoint (budget {restarts}/"
                            f"{self.max_restarts} unchanged)")
             return self._set_status(
                 job, "Restarting", restart_count=restarts + 1,
+                current_replicas=grow_to,
                 reason=f"slice fault; restart {restarts + 1}/"
                        f"{self.max_restarts}")
         if decision == Decision.SUCCEED:
@@ -776,10 +1001,44 @@ class Reconciler:
         # branch (exposed by the r5 event-emission test: the flap
         # emitted spurious Pending/Running event pairs every restart).
         pods_running = any(p == PodPhase.RUNNING for p in phases)
+        incomplete = any(p == PodPhase.PENDING for p in phases)
+        gang_complete = bool(phases) and all(
+            p == PodPhase.RUNNING for p in phases)
         running = pods_running or phase == "Running"
-        return self._set_status(job, "Running" if running else "Pending",
-                                restart_count=restarts,
-                                pods_running=pods_running)
+        # Post-restart scheduling stall (r16): a display-Running gang
+        # whose pods never ALL schedule again (spot storm shrank the
+        # pool) holds its chips while making zero progress — the SPMD
+        # collective cannot form without every host. The scheduling
+        # deadline now covers this stall too, anchored on
+        # status.schedulingSince (set below while the gang is
+        # incomplete, cleared once it fully runs): an elastic gang
+        # shrinks to the workers that actually scheduled; a rigid one
+        # Fails with DeadlineExceeded and releases its slices.
+        if (deadline is not None and phase == "Running" and incomplete
+                and not resizing):
+            stalled = self._maybe_scheduling_stall(
+                job, deadline, members, phases, elastic, restarts,
+                pods)
+            if stalled is not None:
+                return stalled
+        result = self._set_status(job, "Running" if running else "Pending",
+                                  restart_count=restarts,
+                                  pods_running=pods_running,
+                                  gang_complete=gang_complete,
+                                  scheduling_pending=incomplete)
+        if resizing and gang_complete:
+            # The roll completed (EVERY member of the resized gang
+            # runs — one pod up is not a formed collective):
+            # _set_status just flipped Resizing → Resized inside the
+            # same write; the Event records the settle for kubectl
+            # describe (phase didn't change, so the phase-transition
+            # emitter stayed quiet).
+            size = elastic_current_replicas(job)
+            self._record_event(
+                job, f"{name}.resized.{size}", RESIZED_CONDITION,
+                f"TPUJob gang resized; running at {size} workers",
+                "Normal")
+        return result
 
     def _pending_age(self, job: Dict[str, Any]) -> Optional[float]:
         """Seconds this job has been Pending, anchored on the Pending
@@ -797,6 +1056,167 @@ class Reconciler:
                 if anchor is not None:
                     return (now - anchor).total_seconds()
         return None
+
+    # -- elastic resize ---------------------------------------------------
+
+    def _plan_member_loss_resize(self, members: List[ReplicaMember],
+                                 phases: List[PodPhase],
+                                 bounds: Tuple[int, int]
+                                 ) -> Optional[int]:
+        """The new gang size after member loss, or None when the loss
+        is not elastically recoverable (nothing lost; a non-worker
+        replica died; survivors fell below minReplicas — the classic
+        restart machinery owns those)."""
+        lo, hi = bounds
+        lost = [(m, p) for m, p in zip(members, phases)
+                if p in (PodPhase.FAILED, PodPhase.MISSING)]
+        if not lost:
+            return None
+        if any(m.replica_type != "TPU_WORKER" for m, _ in lost):
+            # A coordinator/CPU replica has no elastic dimension.
+            return None
+        survivors = sum(1 for m, p in zip(members, phases)
+                        if m.replica_type == "TPU_WORKER"
+                        and p in (PodPhase.RUNNING, PodPhase.PENDING))
+        if survivors < lo or survivors < 1:
+            return None
+        return max(lo, min(hi, survivors))
+
+    def _resize_cooldown_elapsed(self, job: Dict[str, Any],
+                                 cooldown: float) -> bool:
+        anchor = _parse_k8s_time(
+            job.get("status", {}).get("lastResizeTime"))
+        if anchor is None:
+            return True
+        now = datetime.datetime.now(datetime.timezone.utc)
+        return (now - anchor).total_seconds() >= cooldown
+
+    def _maybe_scheduling_stall(self, job: Dict[str, Any],
+                                deadline: float,
+                                members: List[ReplicaMember],
+                                phases: List[PodPhase],
+                                elastic: Optional[Tuple[int, int]],
+                                restarts: int,
+                                pods: Dict[str, Any]
+                                ) -> Optional[str]:
+        """Handle a display-Running gang stuck partially scheduled:
+        shrink an elastic gang to its RUNNING worker count (never
+        below min) at the eligibility fraction, fail a rigid one at
+        the full deadline. Returns the resulting phase, or None when
+        nothing fired yet (a requeue timer is armed instead)."""
+        name = job["metadata"]["name"]
+        ns = job["metadata"].get("namespace", "default")
+        since = _parse_k8s_time(
+            job.get("status", {}).get("schedulingSince"))
+        fraction = self.preemption.deadline_fraction
+        if since is None:
+            # Anchor lands in this pass's status write; re-observe at
+            # the first decision instant.
+            self.requeue_after = deadline * fraction
+            return None
+        now = datetime.datetime.now(datetime.timezone.utc)
+        stall = (now - since).total_seconds()
+        if elastic is not None:
+            current = elastic_current_replicas(job)
+            running_workers = sum(
+                1 for m, p in zip(members, phases)
+                if m.replica_type == "TPU_WORKER"
+                and p == PodPhase.RUNNING)
+            if (current is not None
+                    and running_workers >= elastic[0]
+                    and running_workers < current
+                    and stall >= deadline * fraction
+                    and self._resize_cooldown_elapsed(
+                        job, deadline * fraction / 2.0)):
+                return self._begin_resize(
+                    job, "Running", max(elastic[0], running_workers),
+                    restarts=restarts, pods=pods,
+                    detail=f"gang partially scheduled for "
+                           f"{stall:.0f}s ({running_workers}/{current}"
+                           f" workers running); shrinking to fit")
+        if stall >= deadline:
+            for m in members:
+                try:
+                    self.api.delete("Pod", ns, m.pod_name(name))
+                except NotFound:
+                    pass
+            return self._set_status(
+                job, "Failed", restart_count=restarts,
+                reason=f"gang incomplete for {stall:.0f}s >= "
+                       f"schedulingDeadlineSeconds={int(deadline)}; "
+                       f"gang torn down",
+                extra_condition=(
+                    DEADLINE_CONDITION,
+                    f"gang incomplete {stall:.0f}s >= deadline "
+                    f"{int(deadline)}s"),
+                event_reason=DEADLINE_CONDITION,
+                scheduling_pending=False)
+        wake = deadline - stall
+        if elastic is not None:
+            trigger = deadline * fraction - stall
+            if trigger > 0:
+                wake = min(wake, trigger)
+        self.requeue_after = max(0.05, wake)
+        return None
+
+    def _maybe_admission_shrink(self, job: Dict[str, Any],
+                                bounds: Tuple[int, int],
+                                deadline: float, age: float,
+                                restarts: int,
+                                pods: Dict[str, Any]
+                                ) -> Optional[str]:
+        """One admission-pressure shrink step, or None (not eligible
+        yet / already at min / still inside the pacing cooldown)."""
+        lo, _ = bounds
+        current = elastic_current_replicas(job)
+        if current is None or current <= lo:
+            return None
+        fraction = self.preemption.deadline_fraction
+        if age < deadline * fraction:
+            return None
+        # Pace at half the eligibility fraction so a 4→min descent
+        # can fit inside one deadline (docs/operator.md runbook).
+        if not self._resize_cooldown_elapsed(
+                job, deadline * fraction / 2.0):
+            return None
+        return self._begin_resize(
+            job, "Pending", current - 1, restarts=restarts, pods=pods,
+            detail=f"gang unscheduled for {age:.0f}s of "
+                   f"{int(deadline)}s deadline; shrinking "
+                   f"{current} -> {current - 1} toward "
+                   f"minReplicas={lo}")
+
+    def _begin_resize(self, job: Dict[str, Any], phase: str,
+                      new_size: int, *, restarts: int,
+                      pods: Dict[str, Any], detail: str) -> str:
+        """Start a coordinated resize roll: write the new size +
+        Resizing condition (one status write), tear the old gang down
+        (EVERY pod — the gang env is a function of the size, so
+        survivors must roll too), and arm the settle timer. The next
+        passes hold until the old pods are gone, recreate the gang at
+        the new size, and flip Resizing → Resized once pods run."""
+        name = job["metadata"]["name"]
+        ns = job["metadata"].get("namespace", "default")
+        current = elastic_current_replicas(job)
+        self._count_resize(
+            "grow" if current is not None and new_size > current
+            else "shrink")
+        result = self._set_status(
+            job, phase, restart_count=restarts, reason=detail,
+            extra_condition=(RESIZING_CONDITION, detail),
+            current_replicas=new_size, stamp_resize=True)
+        # Phase is unchanged by design, so the transition emitter
+        # stays quiet — record the resize explicitly.
+        self._record_event(job, f"{name}.resizing.{new_size}",
+                           RESIZING_CONDITION,
+                           f"TPUJob {detail}", "Normal")
+        for pod_name in list(pods):
+            try:
+                self.api.delete("Pod", ns, pod_name)
+            except NotFound:
+                pass
+        self.requeue_after = RESIZE_SETTLE_SECONDS
+        return result
 
     # -- gang preemption --------------------------------------------------
 
@@ -827,6 +1247,12 @@ class Reconciler:
             pa, pb = job_priority(a), job_priority(b)
             if pa != pb:
                 return pa < pb
+            # Shrink-first (r16): at equal priority, an elastic gang
+            # that can still shrink is the cheaper victim — it loses
+            # one worker and reshards, where a rigid gang dies whole.
+            sa, sb = _shrinkable(a), _shrinkable(b)
+            if sa != sb:
+                return sa
             ca = a["metadata"].get("creationTimestamp", "")
             cb = b["metadata"].get("creationTimestamp", "")
             if ca != cb:
@@ -851,11 +1277,15 @@ class Reconciler:
     def _maybe_preempt(self, job: Dict[str, Any],
                        priority: int) -> bool:
         """One preemption decision for a deadline-pressured
-        high-priority Pending gang: pick the single victim, consume
-        the global rate-limit token, tear the victim's gang down
-        cleanly (Preempted condition + Warning Event, no restart
-        budget burned — the platform evicted it, it didn't crash) and
-        record PreemptedVictim on the preemptor."""
+        high-priority Pending gang: pick the single victim and
+        consume the global rate-limit token. Shrink-first (r16): an
+        elastic victim above its minReplicas is SHRUNK one worker
+        (GangShrunk + Resizing conditions, Warning Event, gang rolled
+        to the smaller size — it keeps Running) instead of killed;
+        only rigid victims (or elastic ones already at min) get the
+        r12 teardown (Preempted condition + Warning Event, no restart
+        budget burned). Both actions share the rate limiter and the
+        PreemptedVictim one-per-episode latch."""
         name = job["metadata"]["name"]
         ns = job["metadata"].get("namespace", "default")
         self.preemption.eligible += 1
@@ -870,10 +1300,12 @@ class Reconciler:
         vns = vmeta.get("namespace", "default")
         vname = vmeta["name"]
         vpriority = job_priority(victim)
+        restarts = int(victim.get("status", {}).get("restartCount", 0))
+        if _shrinkable(victim):
+            return self._shrink_victim(job, victim, token, priority)
         logger.warning(
             "preempting %s/%s (priority %d) for %s/%s (priority %d)",
             vns, vname, vpriority, ns, name, priority)
-        restarts = int(victim.get("status", {}).get("restartCount", 0))
         detail = (f"preempted by higher-priority {ns}/{name} "
                   f"(priority {vpriority} < {priority})")
         # Status BEFORE teardown, preconditioned on the victim still
@@ -906,14 +1338,89 @@ class Reconciler:
                 self.api.delete("Pod", vns, m.pod_name(vname))
             except NotFound:
                 pass
-        # The preemptor's side of the record, written DURABLY before
-        # the pass continues: the PreemptedVictim latch is what
-        # enforces one-victim-per-Pending-episode, so it must land
-        # even if the pass's own final status write later loses a
-        # race (a lost latch would evict a second victim on retry).
-        # Conflict-retried — read-modify-write converges.
-        record = (f"preempted {vns}/{vname} "
-                  f"(priority {vpriority} < {priority})")
+        self._record_preemptor_latch(
+            job, f"preempted {vns}/{vname} "
+                 f"(priority {vpriority} < {priority})")
+        return True
+
+    def _shrink_victim(self, job: Dict[str, Any],
+                       victim: Dict[str, Any], token: float,
+                       priority: int) -> bool:
+        """The shrink-first action: take one worker off an elastic
+        victim (currentReplicas - 1, never below min) and roll its
+        gang to the smaller size — it keeps Running. Status lands
+        BEFORE teardown with the same phase precondition as the kill
+        path; an aborted write refunds the rate-limit token."""
+        name = job["metadata"]["name"]
+        ns = job["metadata"].get("namespace", "default")
+        vmeta = victim["metadata"]
+        vns = vmeta.get("namespace", "default")
+        vname = vmeta["name"]
+        vpriority = job_priority(victim)
+        current = elastic_current_replicas(victim)
+        bounds = job_elastic_bounds(victim)
+        assert current is not None and bounds is not None
+        new_size = max(bounds[0], current - 1)
+        vrestarts = int(victim.get("status", {}).get("restartCount", 0))
+        logger.warning(
+            "shrinking %s/%s (priority %d) %d -> %d for %s/%s "
+            "(priority %d)", vns, vname, vpriority, current, new_size,
+            ns, name, priority)
+        detail = (f"shrunk {current} -> {new_size} workers by "
+                  f"higher-priority {ns}/{name} "
+                  f"(priority {vpriority} < {priority}; "
+                  f"minReplicas={bounds[0]})")
+        try:
+            self._set_status(
+                victim, "Running", restart_count=vrestarts,
+                reason=f"{detail}; gang rolling to {new_size} workers",
+                extra_condition=[(SHRUNK_CONDITION, detail),
+                                 (RESIZING_CONDITION, detail)],
+                require_phase="Running",
+                current_replicas=new_size, stamp_resize=True)
+        except (Conflict, _StateMoved) as err:
+            self.preemption.rollback(token)
+            logger.info("shrink of %s/%s aborted (%s); will "
+                        "re-evaluate", vns, vname, type(err).__name__)
+            return False
+        self.preemption.commit()
+        self.preemption.shrunk += 1
+        self._count_resize("shrink")
+        # Warning Event on the victim (its phase stayed Running, so
+        # the transition emitter is quiet).
+        self._record_event(victim, f"{vname}.gangshrunk.{new_size}",
+                           SHRUNK_CONDITION, f"TPUJob {detail}",
+                           "Warning")
+        # Tear the WHOLE old gang down (every surviving worker's env
+        # must roll to the new size); the victim's own reconcile
+        # recreates new_size pods. List-based teardown:
+        # expected_members(victim) already reflects the NEW size and
+        # would strand the highest old index.
+        try:
+            old_pods = self.reader.list("Pod", vns,
+                                        {JOB_LABEL: vname})
+        except Exception:  # noqa: BLE001 — the victim's own resize
+            old_pods = []  # hold re-drives any missed teardown
+        for pod in old_pods:
+            try:
+                self.api.delete("Pod", vns, pod["metadata"]["name"])
+            except NotFound:
+                pass
+        self._record_preemptor_latch(
+            job, f"shrank {vns}/{vname} to {new_size} workers "
+                 f"(priority {vpriority} < {priority})")
+        return True
+
+    def _record_preemptor_latch(self, job: Dict[str, Any],
+                                record: str) -> None:
+        """The preemptor's side of the record, written DURABLY before
+        the pass continues: the PreemptedVictim latch is what
+        enforces one-victim-per-Pending-episode (kill AND shrink), so
+        it must land even if the pass's own final status write later
+        loses a race (a lost latch would evict a second victim on
+        retry). Conflict-retried — read-modify-write converges."""
+        name = job["metadata"]["name"]
+        ns = job["metadata"].get("namespace", "default")
         for attempt in range(3):
             try:
                 self.api.patch(
@@ -935,7 +1442,6 @@ class Reconciler:
                            PREEMPTOR_CONDITION,
                            f"TPUJob {record} to make room for this "
                            f"gang", "Normal")
-        return True
 
     # -- quarantine surface (driven by the watch controller) --------------
 
@@ -1072,13 +1578,29 @@ class Reconciler:
                     restart_count: int = 0,
                     completion_skew: int = 0,
                     reason: Optional[str] = None,
-                    extra_condition: Optional[Tuple[str, str]] = None,
+                    extra_condition: Optional[Any] = None,
                     event_reason: Optional[str] = None,
                     pods_running: bool = False,
-                    require_phase: Optional[str] = None) -> str:
+                    require_phase: Optional[str] = None,
+                    current_replicas: Optional[int] = None,
+                    stamp_resize: bool = False,
+                    gang_complete: bool = False,
+                    scheduling_pending: Optional[bool] = None) -> str:
+        """``extra_condition`` is one (type, reason) tuple or a list
+        of them (a preemptor shrink writes GangShrunk AND Resizing in
+        the same pass). ``current_replicas`` writes the elastic gang
+        size; ``stamp_resize`` stamps ``status.lastResizeTime`` (the
+        admission-shrink pacing anchor). ``scheduling_pending`` True
+        anchors ``status.schedulingSince`` (set-if-absent), False
+        clears it, None leaves it alone — the stall-deadline clock."""
         name = job["metadata"]["name"]
         ns = job["metadata"].get("namespace", "default")
         previous_phase = job.get("status", {}).get("phase")
+        extra_conditions = ([] if extra_condition is None
+                            else [extra_condition]
+                            if isinstance(extra_condition, tuple)
+                            else list(extra_condition))
+        desired_workers = _desired_workers(job)
 
         def mutate(obj):
             status = obj.setdefault("status", {})
@@ -1097,6 +1619,23 @@ class Reconciler:
             status["restartCount"] = restart_count
             # Any non-hold decision resets the skew counter (writes 0).
             status["completionSkewPasses"] = completion_skew
+            if current_replicas is not None:
+                status["currentReplicas"] = current_replicas
+            if stamp_resize:
+                status["lastResizeTime"] = datetime.datetime.now(
+                    datetime.timezone.utc).isoformat()
+                # New generation: pods created from here on belong to
+                # the resized gang; anything older is a stale roll
+                # target (see the reconcile resize hold).
+                status["resizeGeneration"] = (
+                    _resize_generation(status) + 1)
+            if scheduling_pending is True:
+                status.setdefault(
+                    "schedulingSince",
+                    datetime.datetime.now(
+                        datetime.timezone.utc).isoformat())
+            elif scheduling_pending is False:
+                status.pop("schedulingSince", None)
             if reason:
                 status["reason"] = reason
             else:
@@ -1104,9 +1643,9 @@ class Reconciler:
                 # must not carry a stale 'slice fault' into Succeeded.
                 status.pop("reason", None)
             _update_conditions(status, phase, reason)
-            if extra_condition is not None:
-                _set_extra_condition(status, extra_condition[0],
-                                     "True", extra_condition[1])
+            for cond_type, cond_reason in extra_conditions:
+                _set_extra_condition(status, cond_type,
+                                     "True", cond_reason)
             # Any completed pass IS recovery from a reconcile stall:
             # clear the condition from apiserver state here (not from
             # the controller's memory of having set it — that memory
@@ -1138,6 +1677,33 @@ class Reconciler:
                            for c in status.get("conditions", [])):
                         _set_extra_condition(status, cond_type,
                                              "False", note)
+                # Elastic resize settle: retire Resizing and record
+                # Resized only once the WHOLE rolled gang runs (one
+                # pod up is not a formed collective — and a partial
+                # gang must stay in the stall machinery's sights).
+                # GangShrunk stays up while the gang runs BELOW its
+                # desired size (the dashboard's shrink banner) and
+                # lifts only once a restart grew it back to full.
+                if (gang_complete
+                        and _condition_true(status, RESIZING_CONDITION)):
+                    size = status.get("currentReplicas")
+                    _set_extra_condition(
+                        status, RESIZING_CONDITION, "False",
+                        "resize complete")
+                    _set_extra_condition(
+                        status, RESIZED_CONDITION, "True",
+                        f"gang running at {size} workers")
+                if (gang_complete
+                        and _condition_true(status, SHRUNK_CONDITION)):
+                    try:
+                        size = int(status.get("currentReplicas",
+                                              desired_workers))
+                    except (TypeError, ValueError):
+                        size = desired_workers
+                    if size >= desired_workers:
+                        _set_extra_condition(
+                            status, SHRUNK_CONDITION, "False",
+                            "gang restored to desired size")
 
         # Steady-state suppression: if the mutation would change
         # nothing, skip the apiserver round trip entirely. The fake

@@ -176,6 +176,38 @@ def build_mesh(
     return Mesh(dev_array, AXIS_ORDER)
 
 
+def respec_for_devices(spec: MeshSpec, n_devices: int) -> MeshSpec:
+    """Refit a MeshSpec to a DIFFERENT device count by re-solving its
+    data-parallel axes — the elastic-gang resize move (r16): a lost
+    host shrinks the device pool, the model-parallel axes (tensor /
+    pipeline / seq / expert / dcn_data) must keep their sizes (the
+    parameter factorization is baked into the checkpoint shapes), so
+    only ``data × fsdp`` re-factorizes. ``fsdp`` keeps as much of its
+    size as still divides the remainder (gcd), the rest folds into
+    ``data``. Raises when the model axes alone don't divide
+    ``n_devices`` — that loss is not elastically recoverable."""
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    sizes = spec.sizes()
+    model_axes = {k: v for k, v in sizes.items()
+                  if k not in ("data", "fsdp")}
+    if any(v == -1 for v in model_axes.values()):
+        raise ValueError(
+            f"respec_for_devices needs concrete model axes, got "
+            f"{model_axes}")
+    fixed = math.prod(model_axes.values())
+    if n_devices % fixed:
+        raise ValueError(
+            f"model axes {model_axes} (product {fixed}) do not "
+            f"divide {n_devices} devices — not elastically "
+            f"recoverable")
+    remaining = n_devices // fixed
+    fsdp = sizes["fsdp"] if sizes["fsdp"] != -1 else remaining
+    fsdp = math.gcd(max(1, fsdp), remaining)
+    return MeshSpec(**{**model_axes,
+                       "fsdp": fsdp, "data": remaining // fsdp})
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 0) -> NamedSharding:
     """Sharding for a batch: leading axis split over
     (dcn_data, data, fsdp).

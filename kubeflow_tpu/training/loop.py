@@ -44,7 +44,12 @@ from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 import jax
 
 from kubeflow_tpu.obs import metrics as obs_metrics
-from kubeflow_tpu.training.checkpoint import CheckpointConfig, Checkpointer
+from kubeflow_tpu.training.checkpoint import (
+    CheckpointConfig,
+    Checkpointer,
+    ContinuousCheckpointConfig,
+    ShardedCheckpointer,
+)
 from kubeflow_tpu.training.launcher import DRAIN_EXIT_CODE  # noqa: F401
 from kubeflow_tpu.utils.metrics import MetricsLogger
 
@@ -84,6 +89,12 @@ class LoopConfig:
     total_steps: int
     log_every: int = 10
     checkpoint: Optional[CheckpointConfig] = None
+    # Continuous sharded tier (r16): per-host async shard writes every
+    # N steps with a manifest-last commit — the checkpoint an elastic
+    # resize restores (and reshards) from. Rides ALONGSIDE the
+    # periodic Orbax tier; on boot the loop restores whichever tier
+    # holds the freshest step.
+    continuous: Optional[ContinuousCheckpointConfig] = None
     metrics_path: Optional[str] = None
     # JAX profiler capture [start, stop) in *resumed* step numbers;
     # traces land under profile_dir (XPlane — TensorBoard-compatible).
@@ -121,11 +132,24 @@ def fit(
     every log interval (dashboards, early-stop probes, tests).
     """
     ckpt = Checkpointer(config.checkpoint) if config.checkpoint else None
+    cont = (ShardedCheckpointer(config.continuous)
+            if config.continuous else None)
     owns_logger = metrics_logger is None
     metrics_logger = metrics_logger or MetricsLogger(config.metrics_path)
 
-    if ckpt:
-        state = ckpt.restore(state)
+    # Restore the FRESHEST tier: the continuous shards typically lead
+    # the periodic Orbax step (they save every few steps), so an
+    # elastic resize / crash replays seconds, not a full interval.
+    # Restoring through the live ``state`` reshards onto whatever
+    # mesh this (possibly smaller) gang built.
+    if ckpt or cont:
+        orbax_step = ckpt.latest_step() if ckpt else None
+        cont_step = cont.latest_step() if cont else None
+        if cont_step is not None and (orbax_step is None
+                                      or cont_step >= orbax_step):
+            state = cont.restore(state)
+        elif ckpt:
+            state = ckpt.restore(state)
     start_step = int(state.step)
     if start_step >= config.total_steps:
         logger.info("checkpoint already at step %d >= total %d; done",
@@ -175,6 +199,9 @@ def fit(
                 drain_now = drain_requested.is_set()
             if drain_now:
                 drained_step = int(state.step)
+                if cont:
+                    cont.save(drained_step, state, force=True)
+                    cont.wait()
                 if ckpt:
                     # Safe collectively: every host reached this exact
                     # step with the same drain verdict.
@@ -182,8 +209,10 @@ def fit(
                     ckpt.wait()
                 logger.info("drained at step %d (checkpoint %s)",
                             drained_step,
-                            "saved" if ckpt else "not configured")
-                raise DrainInterrupt(drained_step, ckpt is not None)
+                            "saved" if ckpt or cont
+                            else "not configured")
+                raise DrainInterrupt(drained_step,
+                                     ckpt is not None or cont is not None)
             if config.profile_start is not None and step == config.profile_start:
                 jax.profiler.start_trace(config.profile_dir)
                 profiling = True
@@ -215,6 +244,14 @@ def fit(
                 window_steps = 0
             if ckpt:
                 ckpt.save(next_step, state)
+            if cont:
+                # Per-host async shard write: the step loop pays only
+                # the device→host snapshot; the disk write overlaps
+                # the next steps' compute.
+                cont.save(next_step, state)
+        if cont:
+            cont.save(int(state.step), state, force=True)
+            cont.wait()
         if ckpt:
             ckpt.save(int(state.step), state, force=True)
             ckpt.wait()
@@ -230,6 +267,8 @@ def fit(
                           else handler)
         if profiling:
             jax.profiler.stop_trace()
+        if cont:
+            cont.close()
         if ckpt:
             ckpt.close()
         if owns_logger:

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import json
+from pathlib import Path
 from typing import Dict, Optional
 
 from kubeflow_tpu.parallel.mesh import MeshSpec
@@ -75,6 +76,12 @@ def build_parser() -> argparse.ArgumentParser:
                         ".npy shards self-describe)")
     p.add_argument("--checkpoint_dir", default=None)
     p.add_argument("--save_every", type=int, default=200)
+    p.add_argument("--continuous_every", type=int, default=0,
+                   help="continuous sharded checkpointing: per-host "
+                        "async shard writes every N steps under "
+                        "<checkpoint_dir>/continuous (manifest-last "
+                        "commit; elastic resizes restore + reshard "
+                        "from these). 0 = off")
     p.add_argument("--metrics_path", default=None)
     p.add_argument("--remat", action="store_true",
                    help="rematerialize blocks (llama only)")
@@ -90,7 +97,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.continuous_every > 0 and not args.checkpoint_dir:
+        # Mirror the tpu-lm manifest builder: a continuous tier with
+        # nowhere durable to land is the silent-data-loss trap —
+        # an elastic resize would restart the run from step 0.
+        parser.error("--continuous_every needs --checkpoint_dir")
     from kubeflow_tpu.training.launcher import initialize_distributed
     from kubeflow_tpu.utils.platform import sync_platform_from_env
 
@@ -207,11 +220,25 @@ def main(argv=None) -> int:
         step_fn = make_lm_train_step(mesh, shardings, objective=objective)
 
     ckpt = None
+    continuous = None
     if args.checkpoint_dir:
         ckpt = CheckpointConfig(directory=args.checkpoint_dir,
                                 save_interval_steps=args.save_every)
+        if args.continuous_every > 0:
+            from kubeflow_tpu.training.checkpoint import (
+                ContinuousCheckpointConfig,
+            )
+
+            continuous = ContinuousCheckpointConfig(
+                directory=str(Path(args.checkpoint_dir) / "continuous"),
+                save_interval_steps=args.continuous_every,
+                num_hosts=jax.process_count(),
+                host_id=jax.process_index(),
+                mesh_shape={k: int(v) for k, v in mesh.shape.items()
+                            if int(v) > 1})
     config = LoopConfig(total_steps=args.steps, log_every=args.log_every,
-                        checkpoint=ckpt, metrics_path=args.metrics_path)
+                        checkpoint=ckpt, continuous=continuous,
+                        metrics_path=args.metrics_path)
     data = DevicePrefetcher(gen, mesh)
     try:
         state = fit(state, step_fn, data, config)

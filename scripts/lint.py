@@ -238,6 +238,13 @@ def check_operator_wait_discipline() -> list:
         # apply to it like to any serving control code. (The rest of
         # serving/ is covered by check_serving_timeout_discipline.)
         ("serving", set(), True, {"sharding.py"}),
+        # Continuous-checkpoint writer (ISSUE 12): checkpoint.py's
+        # background shard writer runs NEXT TO the training step loop
+        # — a stray time.sleep, wall-clock read, or unbounded wait
+        # there stalls or skews checkpoint cadence for the whole
+        # gang (and the commit barrier must never wedge on a lost
+        # peer). Strict rules, same as the engine's decode loop.
+        ("training", set(), True, {"checkpoint.py"}),
     ]
     errors = []
     for sub, exempt, strict, only in dirs:

@@ -1,0 +1,505 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Continuous sharded checkpoints (ISSUE 12): per-host shard writes,
+manifest-last crash safety, and the mesh-resharding restore math — a
+4-host checkpoint restored into 3- and 2-host dp/fsdp meshes (and
+back up to 4) bitwise-equal to the single-host reassembly reference,
+optimizer moments included.
+
+Cost discipline: exactly ONE test builds full LM train states (the
+resharding acceptance — it needs real params + adamw moments on real
+meshes); every other protocol property (commit ordering, torn writes,
+async overlap, pruning, fit() wiring) is proven on small plain
+pytrees, which the checkpointer treats identically."""
+
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import struct
+
+from kubeflow_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    respec_for_devices,
+)
+from kubeflow_tpu.training.checkpoint import (
+    MANIFEST_FILE,
+    CheckpointConfig,
+    Checkpointer,
+    ContinuousCheckpointConfig,
+    ShardedCheckpointer,
+    atomic_write_bytes,
+    flatten_state,
+)
+
+HOSTS = 4
+
+
+def _gang(tmp_path, num_hosts=HOSTS, **kw):
+    """An emulated num_hosts-host gang: one checkpointer per host over
+    one shared directory (exactly the multi-host protocol, minus the
+    network)."""
+    kw.setdefault("save_interval_steps", 1)
+    kw.setdefault("min_shard_size", 8)
+    kw.setdefault("commit_timeout_seconds", 10.0)
+    return [ShardedCheckpointer(ContinuousCheckpointConfig(
+        directory=str(tmp_path / "cont"), num_hosts=num_hosts,
+        host_id=h, **kw)) for h in range(num_hosts)]
+
+
+def _small_state(step=1, scale=1.0):
+    """A cheap stand-in train state: sharded-sized leaves (divisible
+    by 4 AND re-split-able to any host count after reassembly), a
+    replicated small leaf, and a scalar step."""
+    return {
+        "params": {"w": (jnp.arange(48, dtype=jnp.float32)
+                         .reshape(12, 4) * scale),
+                   "b": jnp.ones((3,)) * scale},
+        "opt": {"mu": jnp.full((8, 2), 0.25 * scale)},
+        "step": jnp.asarray(step),
+    }
+
+
+def _save_all(gang, step, state):
+    for ckpt in gang:
+        assert ckpt.save(step, state, force=True)
+    for ckpt in gang:
+        assert ckpt.wait(15.0)
+
+
+def _assert_states_equal(a, b):
+    flat_a, _ = flatten_state(a)
+    flat_b, _ = flatten_state(b)
+    assert set(flat_a) == set(flat_b)
+    for key in flat_a:
+        np.testing.assert_array_equal(
+            np.asarray(flat_a[key]), np.asarray(flat_b[key]),
+            err_msg=key)
+
+
+# -- the resharding acceptance (the one full-LM test) ---------------------
+
+
+def _adamw_train_state(mesh, *, updates=2):
+    """A REAL sharded adamw train state without the cost of a model
+    forward: fsdp-sharded params placed via the production sharding
+    rules (parallel/mesh.fsdp_params_sharding), adamw moments
+    mirrored onto the same layouts, a couple of deterministic
+    optimizer updates applied. Bitwise-deterministic for any mesh
+    (updates are elementwise — no cross-device reductions), so
+    cross-mesh restores can be compared EXACTLY. (The full llama
+    path, where gradients DO reduce across the mesh, rides the
+    slow-tier elastic citest with its documented tolerance.)"""
+    from kubeflow_tpu.parallel.mesh import (
+        fsdp_params_sharding,
+        mirror_param_shardings,
+        replicated,
+    )
+
+    params = {
+        "dense": {"w": jnp.arange(48 * 16, dtype=jnp.float32)
+                  .reshape(48, 16) / 97.0,
+                  "b": jnp.ones((8,))},
+        "scale": jnp.asarray(2.0),
+    }
+    shardings = fsdp_params_sharding(mesh, params, min_weight_size=64)
+    params = jax.tree.map(jax.device_put, params, shardings)
+    tx = optax.adamw(1e-2)
+    opt_state = tx.init(params)
+    opt_sh = mirror_param_shardings(opt_state, shardings,
+                                    replicated(mesh))
+    opt_state = jax.tree.map(
+        lambda leaf, sh: jax.device_put(leaf, sh)
+        if hasattr(leaf, "shape") else leaf, opt_state, opt_sh)
+    step = 0
+    for _ in range(updates):
+        grads = jax.tree.map(lambda p: p * 0.01 + 0.5, params)
+        upd, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, upd)
+        step += 1
+    return {"step": jnp.asarray(step), "params": params,
+            "opt_state": opt_state}
+
+
+def test_reshard_4_to_3_to_2_and_back_with_moments(tmp_path):
+    """The elastic acceptance math: a 4-host dp×fsdp checkpoint of a
+    real sharded adamw train state (params + first/second moments)
+    restores bitwise into 3- and 2-host meshes and back up to 4,
+    equal to the single-host reassembly reference."""
+    devices = jax.devices()
+    mesh4 = build_mesh(MeshSpec(data=2, fsdp=2), devices[:4])
+    state = _adamw_train_state(mesh4)
+    # The fsdp rule actually sharded the big weight (white-box: the
+    # test must exercise resharding, not replication).
+    w = state["params"]["dense"]["w"]
+    assert not w.sharding.is_fully_replicated
+
+    gang = _gang(tmp_path, min_shard_size=64,
+                 mesh_shape={"data": 2, "fsdp": 2})
+    _save_all(gang, 2, state)
+    for ckpt in gang:
+        ckpt.close()
+    reader = ShardedCheckpointer(ContinuousCheckpointConfig(
+        directory=str(tmp_path / "cont"), num_hosts=1, host_id=0))
+
+    # The manifest records the saving mesh factorization + host count.
+    step_dirs = sorted((tmp_path / "cont").glob("step-*"))
+    manifest = json.loads((step_dirs[-1] / MANIFEST_FILE).read_text())
+    assert manifest["mesh"] == {"data": 2, "fsdp": 2}
+    assert manifest["num_hosts"] == HOSTS
+
+    # Single-host reassembly reference: restore into a 1-device mesh.
+    mesh1 = build_mesh(MeshSpec(data=1), devices[:1])
+    reference = reader.restore(_adamw_train_state(mesh1, updates=0))
+    ref_flat, _ = flatten_state(reference)
+    live_flat, _ = flatten_state(state)
+    for key in live_flat:
+        np.testing.assert_array_equal(
+            np.asarray(ref_flat[key]), np.asarray(live_flat[key]),
+            err_msg=key)
+
+    # Mismatched dp/fsdp factorizations: 3 hosts (fsdp folds away),
+    # 2 hosts (a DIFFERENT fsdp split than the saver's 2×2), then
+    # back up to 4. Params AND moments land bitwise on each mesh,
+    # ON the mesh (live shardings, not host arrays) — and the
+    # optimizer keeps stepping identically from the restored moments.
+    for n_devices, spec in (
+            (3, respec_for_devices(MeshSpec(data=2, fsdp=2), 3)),
+            (2, MeshSpec(data=2, fsdp=1)),
+            (4, MeshSpec(data=2, fsdp=2))):
+        mesh = build_mesh(spec, devices[:n_devices])
+        target = _adamw_train_state(mesh, updates=0)
+        restored = reader.restore(target)
+        assert int(restored["step"]) == 2
+        got_flat, _ = flatten_state(restored)
+        for key in live_flat:
+            np.testing.assert_array_equal(
+                np.asarray(got_flat[key]), np.asarray(live_flat[key]),
+                err_msg=f"{key} on {n_devices} devices")
+        moment_leaves = [
+            leaf for leaf in jax.tree.leaves(restored["opt_state"])
+            if getattr(leaf, "shape", None) == (48, 16)]
+        assert moment_leaves  # adamw mu AND nu mirror the weight
+        assert all(getattr(leaf, "sharding", None) is not None
+                   for leaf in moment_leaves)
+        # Continuation equality: one more elementwise adamw update on
+        # the restored state matches the uninterrupted one bitwise.
+        cont_ref = _adamw_train_state(mesh4, updates=3)
+        tx = optax.adamw(1e-2)
+        grads = jax.tree.map(lambda p: p * 0.01 + 0.5,
+                             restored["params"])
+        upd, _ = tx.update(grads, restored["opt_state"],
+                           restored["params"])
+        cont = optax.apply_updates(restored["params"], upd)
+        np.testing.assert_array_equal(
+            np.asarray(cont["dense"]["w"]),
+            np.asarray(cont_ref["params"]["dense"]["w"]))
+    reader.close()
+
+
+# -- commit protocol (plain pytrees) --------------------------------------
+
+
+def test_manifest_commits_last_and_torn_write_is_invisible(tmp_path):
+    """Crash-safety: a writer killed mid-shard-write never yields a
+    restorable-but-wrong state. (a) White-box ordering — the manifest
+    is not on disk until EVERY host's shard is; (b) a step whose
+    writer died after 2 of 4 shards stays uncommitted and restore
+    falls back to the previous committed step; (c) even a COMMITTED
+    step whose bytes got truncated later (disk fault) is skipped."""
+    state1 = _small_state(step=1, scale=1.0)
+    state2 = _small_state(step=2, scale=2.0)
+    gang = _gang(tmp_path, async_save=False,
+                 commit_timeout_seconds=0.3)
+
+    # (a) host 0 saves FIRST (sync): with peers missing, its commit
+    # barrier times out and no manifest lands.
+    assert gang[0].save(1, state1, force=True)
+    step_dir = tmp_path / "cont" / "step-00000001"
+    assert step_dir.is_dir()
+    assert not (step_dir / MANIFEST_FILE).exists()
+    assert gang[0].all_steps() == []
+    # Peers arrive; the commit barrier completes the step.
+    for ckpt in gang[1:]:
+        ckpt.save(1, state1, force=True)
+    gang[0]._commit(1, gang[0]._plan(flatten_state(state1)[0]))
+    assert (step_dir / MANIFEST_FILE).exists()
+    assert gang[0].all_steps() == [1]
+
+    # (b) step 2: only hosts 0-1 write (the "kill"); the step stays
+    # invisible and restore lands on step 1.
+    for ckpt in gang[:2]:
+        ckpt.save(2, state2, force=True)
+    assert gang[0].all_steps() == [1]
+    restored = gang[0].restore(_small_state(step=0, scale=0.0))
+    assert int(restored["step"]) == 1
+    _assert_states_equal(restored, state1)
+
+    # (c) complete + commit step 2, then truncate one of its shards:
+    # restore must skip it with a warning and land on step 1 again.
+    for ckpt in gang[2:]:
+        ckpt.save(2, state2, force=True)
+    gang[0]._commit(2, gang[0]._plan(flatten_state(state2)[0]))
+    assert gang[0].all_steps() == [1, 2]
+    victim = sorted((tmp_path / "cont" / "step-00000002").glob(
+        "state.shard-*"))[1]
+    victim.write_bytes(victim.read_bytes()[:10])
+    restored = gang[0].restore(_small_state(step=0, scale=0.0))
+    assert int(restored["step"]) == 1
+    # An EXPLICIT step request for the torn step raises instead.
+    with pytest.raises(Exception):
+        gang[0].restore(_small_state(), step=2)
+    for ckpt in gang:
+        ckpt.close()
+
+
+def test_restore_reshards_plain_state_across_host_counts(tmp_path):
+    """Host-count independence on the wire format itself: 4 writer
+    shards reassemble identically regardless of the reader's own host
+    count, and leaves land per the live template."""
+    state = _small_state(step=7, scale=3.0)
+    gang = _gang(tmp_path)
+    _save_all(gang, 7, state)
+    for ckpt in gang:
+        ckpt.close()
+    for reader_hosts in (1, 2, 3):
+        reader = ShardedCheckpointer(ContinuousCheckpointConfig(
+            directory=str(tmp_path / "cont"),
+            num_hosts=reader_hosts, host_id=0))
+        restored = reader.restore(_small_state(step=0, scale=0.0))
+        _assert_states_equal(restored, state)
+        reader.close()
+    # Structure drift fails loudly, never a silent partial restore.
+    reader = ShardedCheckpointer(ContinuousCheckpointConfig(
+        directory=str(tmp_path / "cont")))
+    bad = _small_state()
+    bad["params"]["extra"] = jnp.zeros((2,))
+    with pytest.raises(ValueError):
+        reader.restore(bad)
+    reader.close()
+
+
+def test_async_writes_overlap_compute(tmp_path):
+    """save() returns before the shard bytes are durable (the step
+    loop pays only the device→host snapshot); wait() makes them so.
+    White-box: gate the writer and observe save() return while the
+    write is parked."""
+    ckpt = ShardedCheckpointer(ContinuousCheckpointConfig(
+        directory=str(tmp_path / "cont"), num_hosts=1, host_id=0,
+        save_interval_steps=1, min_shard_size=8))
+    gate = threading.Event()
+    original = ckpt._write_one
+
+    def gated(item):
+        gate.wait(timeout=10)
+        original(item)
+
+    ckpt._write_one = gated
+    assert ckpt.save(1, _small_state(), force=True)  # returns now
+    assert ckpt.latest_step() is None                # nothing durable
+    assert not ckpt.wait(timeout=0.2)                # writer parked
+    gate.set()
+    assert ckpt.wait(10.0)
+    assert ckpt.latest_step() == 1
+    ckpt.close()
+
+
+def test_interval_policy_dedupe_and_prune(tmp_path):
+    ckpt = ShardedCheckpointer(ContinuousCheckpointConfig(
+        directory=str(tmp_path / "cont"), num_hosts=1, host_id=0,
+        save_interval_steps=5, keep=2, min_shard_size=8))
+    state = _small_state()
+    assert not ckpt.save(3, state)                   # below interval
+    for step in (5, 10, 15, 20):
+        assert ckpt.save(step, state)                # on the interval
+        assert not ckpt.save(step, state, force=True)  # deduped
+        assert ckpt.wait(15.0)  # drain: the writer slot is
+        # newest-wins, so back-to-back saves would coalesce
+    assert ckpt.all_steps() == [15, 20]              # keep=2 pruned
+    ckpt.close()
+
+
+def test_writer_slot_coalesces_newest_wins(tmp_path):
+    """A writer that falls behind never queues snapshots without
+    bound: a save handed over while one is parked REPLACES it (only
+    the freshest step matters for restore)."""
+    ckpt = ShardedCheckpointer(ContinuousCheckpointConfig(
+        directory=str(tmp_path / "cont"), num_hosts=1, host_id=0,
+        save_interval_steps=1, min_shard_size=8))
+    gate = threading.Event()
+    original = ckpt._write_one
+
+    def gated(item):
+        gate.wait(timeout=10)
+        original(item)
+
+    ckpt._write_one = gated
+    assert ckpt.save(1, _small_state(step=1), force=True)
+    # Writer is parked on step 1's write... actually on nothing yet —
+    # park it by letting it pick step 1 up, then pile on 2 and 3.
+    for _ in range(100):
+        with ckpt._slot_lock:
+            if ckpt._writing:
+                break
+        import time as _t
+        _t.sleep(0.01)
+    assert ckpt.save(2, _small_state(step=2), force=True)
+    assert ckpt.save(3, _small_state(step=3), force=True)  # replaces 2
+    gate.set()
+    assert ckpt.wait(10.0)
+    steps = ckpt.all_steps()
+    assert 3 in steps and 2 not in steps, steps  # newest won
+    assert ckpt._dropped >= 1
+    ckpt.close()
+
+
+def test_atomic_write_never_leaves_truncation(tmp_path):
+    path = tmp_path / "blob.bin"
+    atomic_write_bytes(path, b"a" * 1024)
+    assert path.read_bytes() == b"a" * 1024
+    atomic_write_bytes(path, b"b" * 10)
+    assert path.read_bytes() == b"b" * 10
+    # No temp litter after a completed write.
+    assert [p.name for p in tmp_path.iterdir()] == ["blob.bin"]
+
+
+# -- fit() integration (cheap synthetic state) ----------------------------
+
+
+class _TinyState(struct.PyTreeNode):
+    step: jax.Array
+    w: jax.Array
+
+
+def test_fit_continuous_tier_saves_and_resumes(tmp_path):
+    """Loop integration: fit() with LoopConfig.continuous writes the
+    shard tier alongside steps, and a second fit() resumes from the
+    freshest continuous step (ahead of the coarser Orbax tier)."""
+    from kubeflow_tpu.training.loop import LoopConfig, fit
+
+    def step_fn(state, batch):
+        new = state.replace(step=state.step + 1,
+                            w=state.w + batch)
+        return new, {"loss": jnp.sum(new.w)}
+
+    def batches():
+        while True:
+            yield jnp.ones((16,))
+
+    config = LoopConfig(
+        total_steps=3, log_every=10,
+        checkpoint=CheckpointConfig(
+            directory=str(tmp_path / "mono"),
+            save_interval_steps=100, async_save=False),
+        continuous=ContinuousCheckpointConfig(
+            directory=str(tmp_path / "cont"),
+            save_interval_steps=1, min_shard_size=8),
+        drain_signals=())
+    state = _TinyState(step=jnp.asarray(0), w=jnp.zeros((16,)))
+    done = fit(state, step_fn, batches(), config)
+    assert int(done.step) == 3
+    reader = ShardedCheckpointer(ContinuousCheckpointConfig(
+        directory=str(tmp_path / "cont")))
+    assert reader.latest_step() == 3
+    reader.close()
+
+    # Resume for 2 more steps: picks up at 3, not 0 (the continuous
+    # tier is at least as fresh as Orbax's final force-save and wins
+    # the restore).
+    config2 = LoopConfig(
+        total_steps=5, log_every=10,
+        checkpoint=config.checkpoint, continuous=config.continuous,
+        drain_signals=())
+    fresh = _TinyState(step=jnp.asarray(0), w=jnp.zeros((16,)))
+    resumed = fit(fresh, step_fn, batches(), config2)
+    assert int(resumed.step) == 5
+    np.testing.assert_array_equal(np.asarray(resumed.w),
+                                  np.full((16,), 5.0))
+
+
+# -- monolithic (Orbax) hardening -----------------------------------------
+
+
+def test_monolithic_restore_skips_corrupt_latest_step(tmp_path):
+    """The r16 satellite: a truncated latest Orbax step — the
+    artifact of the crash being recovered from — falls back to the
+    previous step with a warning instead of raising mid-recovery."""
+    ckpt = Checkpointer(CheckpointConfig(
+        directory=str(tmp_path / "mono"), save_interval_steps=1,
+        async_save=False))
+    state1 = _small_state(step=1, scale=1.0)
+    state2 = _small_state(step=2, scale=2.0)
+    assert ckpt.save(1, state1, force=True)
+    assert ckpt.save(2, state2, force=True)
+    ckpt.wait()
+
+    # Truncate every sizeable file of step 2 (a torn disk artifact
+    # that slipped past the rename commit).
+    corrupted = 0
+    for root, _, files in os.walk(tmp_path / "mono" / "2"):
+        for fname in files:
+            path = os.path.join(root, fname)
+            if os.path.getsize(path) > 64:
+                with open(path, "r+b") as f:
+                    f.truncate(32)
+                corrupted += 1
+    assert corrupted > 0
+
+    ckpt2 = Checkpointer(CheckpointConfig(
+        directory=str(tmp_path / "mono"), save_interval_steps=1,
+        async_save=False))
+    restored = ckpt2.restore(_small_state(step=0, scale=0.0))
+    assert int(restored["step"]) == 1
+    _assert_states_equal(restored, state1)
+    # An EXPLICIT step request still raises — the caller asked for
+    # that exact artifact.
+    with pytest.raises(Exception):
+        ckpt2.restore(_small_state(), step=2)
+    ckpt.close()
+    ckpt2.close()
+
+
+# -- mesh respec math -----------------------------------------------------
+
+
+def test_respec_for_devices_math():
+    spec = MeshSpec(data=2, fsdp=2)
+    assert respec_for_devices(spec, 3).sizes()["data"] == 3
+    assert respec_for_devices(spec, 3).sizes()["fsdp"] == 1
+    out = respec_for_devices(spec, 2)
+    assert out.sizes()["data"] * out.sizes()["fsdp"] == 2
+    assert out.sizes()["fsdp"] == 2  # kept: still divides
+    assert respec_for_devices(spec, 4) == MeshSpec(data=2, fsdp=2)
+    # Model axes are pinned: tensor=2 cannot fit 3 devices.
+    with pytest.raises(ValueError):
+        respec_for_devices(MeshSpec(tensor=2, data=2), 3)
+    tp = respec_for_devices(MeshSpec(tensor=2, data=2), 6)
+    assert tp.sizes()["tensor"] == 2 and tp.sizes()["data"] == 3
+
+
+def test_flatten_state_keys_are_stable():
+    state = {"params": {"w": jnp.ones((4, 4))},
+             "step": jnp.asarray(0)}
+    flat, treedef = flatten_state(state)
+    assert set(flat) == {"params/w", "step"}
+    rebuilt = jax.tree_util.tree_unflatten(
+        treedef, [flat["params/w"], flat["step"]])
+    assert set(rebuilt) == {"params", "step"}

@@ -53,7 +53,8 @@ def test_e2e_workflow_manifest():
     assert wf["spec"]["onExit"] == "exit-handler"
     names = {t["name"] for t in wf["spec"]["templates"]}
     for step in ("checkout", "unit-test", "deploy-test", "tpujob-test",
-                 "serving-test", "leader-failover-test", "teardown",
+                 "serving-test", "leader-failover-test",
+                 "elastic-kill-test", "teardown",
                  "copy-artifacts", "e2e"):
         assert step in names, step
     dag = next(t for t in wf["spec"]["templates"] if t["name"] == "e2e")
@@ -63,10 +64,15 @@ def test_e2e_workflow_manifest():
     assert deps["deploy-test"] == ["checkout"]
     # Hermetic citests ride the checkout alone (no cluster deploy).
     assert deps["leader-failover-test"] == ["checkout"]
+    assert deps["elastic-kill-test"] == ["checkout"]
     failover = next(t for t in wf["spec"]["templates"]
                     if t["name"] == "leader-failover-test")
     assert "kubeflow_tpu.citests.leader_failover" in \
         failover["container"]["command"]
+    elastic = next(t for t in wf["spec"]["templates"]
+                   if t["name"] == "elastic-kill-test")
+    assert "kubeflow_tpu.citests.elastic" in \
+        elastic["container"]["command"]
 
 
 def test_release_workflow_manifest():
@@ -107,6 +113,34 @@ def test_leader_failover_fake_e2e(tmp_path):
 
     junit_path = tmp_path / "junit_leader_failover.xml"
     rc = ci_failover.main(["--fake", "--junit_path", str(junit_path)])
+    assert rc == 0
+    root = ET.parse(junit_path).getroot()
+    assert root.get("failures") == "0" and root.get("errors") == "0"
+
+
+def test_elastic_control_plane_fake_e2e(tmp_path):
+    """The elastic-kill citest's control-plane half (resize instead
+    of restart, zero duplicate pods) — fast, jax-free, tier-1."""
+    from kubeflow_tpu.citests import elastic as ci_elastic
+
+    junit_path = tmp_path / "junit_elastic_cp.xml"
+    rc = ci_elastic.main(["--fake", "--skip_training",
+                          "--junit_path", str(junit_path)])
+    assert rc == 0
+    root = ET.parse(junit_path).getroot()
+    assert root.get("failures") == "0" and root.get("errors") == "0"
+
+
+@pytest.mark.slow
+def test_elastic_kill_fake_e2e(tmp_path):
+    """The full elastic-kill citest green as the CI DAG runs it (r16
+    acceptance): kill 1 of 4 mid-run, resize, resume from the
+    continuous checkpoint on 3 hosts, same seeded loss curve with
+    < 2 steps lost."""
+    from kubeflow_tpu.citests import elastic as ci_elastic
+
+    junit_path = tmp_path / "junit_elastic.xml"
+    rc = ci_elastic.main(["--fake", "--junit_path", str(junit_path)])
     assert rc == 0
     root = ET.parse(junit_path).getroot()
     assert root.get("failures") == "0" and root.get("errors") == "0"

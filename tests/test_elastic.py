@@ -1,0 +1,632 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Elastic gang training (ISSUE 12): resize through member loss
+instead of dying. Schema/builders, the reconciler's coordinated
+resize roll (conditions, events, settle timers, zero budget burn,
+zero duplicate pods), admission + stall shrink, preemptor
+shrink-first, dashboard degradation, and the tier-1 fast e2e over the
+HTTP facade under the live watch controller."""
+
+import datetime
+import json
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.manifests.tpujob import (
+    KIND,
+    crd,
+    replica_spec,
+    termination_policy,
+    tpu_job,
+)
+from kubeflow_tpu.operator import FakeApiServer, Reconciler
+from kubeflow_tpu.operator.controller import WatchController
+from kubeflow_tpu.operator.http_client import HttpApiClient
+from kubeflow_tpu.operator.reconciler import (
+    DEADLINE_CONDITION,
+    GANG_GENERATION_LABEL,
+    JOB_LABEL,
+    PREEMPTED_CONDITION,
+    RESIZED_CONDITION,
+    RESIZING_CONDITION,
+    SHRUNK_CONDITION,
+    PreemptionPolicy,
+    elastic_current_replicas,
+    job_elastic_bounds,
+)
+from kubeflow_tpu.operator.workqueue import ExponentialBackoff
+from kubeflow_tpu.training.launcher import DRAIN_EXIT_CODE
+
+from tests._http_apiserver import HttpFakeApiServer
+
+
+def make_elastic(name, *, workers=4, min_replicas=2, max_replicas=None,
+                 deadline=None, priority=0):
+    spec = replica_spec(
+        "TPU_WORKER", workers, image="img:1",
+        tpu_accelerator="tpu-v5-lite-podslice", tpu_topology="1x1",
+        chips_per_worker=1)
+    job = tpu_job(name, "default", [spec],
+                  termination=termination_policy("TPU_WORKER", 0),
+                  scheduling_deadline_seconds=deadline,
+                  priority=priority,
+                  min_replicas=min_replicas,
+                  max_replicas=max_replicas)
+    job["metadata"]["uid"] = f"uid-{name}"
+    return job
+
+
+def _conds(api, name):
+    job = api.get(KIND, "default", name)
+    return {c["type"]: c for c in
+            job.get("status", {}).get("conditions", [])}
+
+
+def _run_all(api, name):
+    with api.as_kubelet():
+        for pod in api._list("Pod", "default", {JOB_LABEL: name}):
+            api.set_pod_phase("default", pod["metadata"]["name"],
+                              "Running")
+
+
+def _converge(api, rec, name, *, passes=8):
+    """Reconcile + kubelet until the gang settles."""
+    for _ in range(passes):
+        rec.reconcile(api.get(KIND, "default", name))
+        _run_all(api, name)
+    return rec.reconcile(api.get(KIND, "default", name))
+
+
+# -- schema / builders ----------------------------------------------------
+
+
+def test_crd_carries_elastic_bounds():
+    text = json.dumps(crd())
+    assert "minReplicas" in text and "maxReplicas" in text
+
+
+def test_builder_validates_elastic_bounds():
+    spec = replica_spec("TPU_WORKER", 4, image="i",
+                        tpu_accelerator="a", tpu_topology="2x4")
+    job = tpu_job("x", "d", [spec], min_replicas=2, max_replicas=4)
+    assert job["spec"]["minReplicas"] == 2
+    assert job["spec"]["maxReplicas"] == 4
+    rigid = tpu_job("x", "d", [spec])
+    assert "minReplicas" not in rigid["spec"]
+    with pytest.raises(ValueError):
+        tpu_job("x", "d", [spec], min_replicas=5)  # min > replicas
+    with pytest.raises(ValueError):
+        tpu_job("x", "d", [spec], min_replicas=0)
+    with pytest.raises(ValueError):
+        tpu_job("x", "d", [spec], max_replicas=4)  # max without min
+    with pytest.raises(ValueError):
+        tpu_job("x", "d", [spec], min_replicas=2, num_slices=2)
+
+
+def test_bounds_coercion_degrades_to_rigid():
+    job = make_elastic("c")
+    assert job_elastic_bounds(job) == (2, 4)
+    assert elastic_current_replicas(job) == 4
+    # Garbage min → rigid, never a crash or an accidental resize.
+    job["spec"]["minReplicas"] = "banana"
+    assert job_elastic_bounds(job) is None
+    assert elastic_current_replicas(job) is None
+    # Incoherent bounds (min > desired) → rigid.
+    job["spec"]["minReplicas"] = 9
+    assert job_elastic_bounds(job) is None
+    # Garbage status.currentReplicas → desired, clamped.
+    job["spec"]["minReplicas"] = 2
+    job["status"] = {"currentReplicas": "soup"}
+    assert elastic_current_replicas(job) == 4
+    job["status"] = {"currentReplicas": 99}
+    assert elastic_current_replicas(job) == 4  # clamped to max
+    job["status"] = {"currentReplicas": 0}
+    assert elastic_current_replicas(job) == 2  # clamped to min
+
+
+def test_prototype_exposes_elastic_params():
+    from kubeflow_tpu.params.registry import get_prototype
+
+    objs = get_prototype("tpu-job").build({
+        "name": "e", "num_tpu_workers": "4",
+        "min_replicas": "2", "max_replicas": "4"})
+    job = next(o for o in objs if o["kind"] == "TPUJob")
+    assert job["spec"]["minReplicas"] == 2
+    assert job["spec"]["maxReplicas"] == 4
+    # tpu-lm: elastic requires a checkpoint dir (the resize resumes
+    # from the continuous shards — elasticity without recovery is a
+    # data-loss trap).
+    with pytest.raises(ValueError):
+        get_prototype("tpu-lm").build({
+            "name": "e2", "num_tpu_workers": "4",
+            "min_replicas": "2"})
+    objs = get_prototype("tpu-lm").build({
+        "name": "e3", "num_tpu_workers": "4", "min_replicas": "2",
+        "checkpoint_dir": "/ckpt", "continuous_every": "10"})
+    job = next(o for o in objs if o["kind"] == "TPUJob")
+    args = job["spec"]["replicaSpecs"][0]["template"]["spec"][
+        "containers"][0]["args"]
+    assert "--continuous_every=10" in args
+
+
+# -- reconciler: member-loss resize ---------------------------------------
+
+
+def test_member_loss_resizes_instead_of_restarting():
+    api = FakeApiServer()
+    with api.as_kubelet():
+        api.create(make_elastic("el"))
+    rec = Reconciler(api)
+    assert _converge(api, rec, "el") == "Running"
+    pods = sorted(p["metadata"]["name"]
+                  for p in api.list("Pod", "default", {JOB_LABEL: "el"}))
+    api.set_pod_terminated("default", pods[1], DRAIN_EXIT_CODE)
+
+    phase = rec.reconcile(api.get(KIND, "default", "el"))
+    assert phase == "Running"
+    status = api.get(KIND, "default", "el")["status"]
+    assert status["currentReplicas"] == 3
+    assert status["restartCount"] == 0
+    conds = _conds(api, "el")
+    assert conds[RESIZING_CONDITION]["status"] == "True"
+    # The roll tore the whole old gang down (env must change on every
+    # survivor too).
+    assert api.list("Pod", "default", {JOB_LABEL: "el"}) == []
+    # The settle timer is armed — the workqueue re-observes without
+    # waiting for a relist.
+    assert rec.requeue_after is not None
+
+    assert _converge(api, rec, "el") == "Running"
+    status = api.get(KIND, "default", "el")["status"]
+    conds = _conds(api, "el")
+    assert status["restartCount"] == 0
+    assert conds[RESIZING_CONDITION]["status"] == "False"
+    assert conds[RESIZED_CONDITION]["status"] == "True"
+    assert "Restarting" not in conds
+    pods = api.list("Pod", "default", {JOB_LABEL: "el"})
+    assert len(pods) == 3
+    for pod in pods:
+        env = {e["name"]: str(e.get("value"))
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["KFT_NUM_PROCESSES"] == "3"
+        assert pod["metadata"]["labels"][GANG_GENERATION_LABEL] == "1"
+    # Resized Event landed.
+    reasons = {e["reason"] for e in api.list("Event", "default")}
+    assert RESIZING_CONDITION in reasons
+    assert RESIZED_CONDITION in reasons
+
+
+def test_loss_below_min_takes_the_restart_path():
+    """3 of 4 lost with min=2: survivors < min — the elastic contract
+    is exhausted, the classic restart machinery owns recovery (at the
+    DESIRED size: a restart is a fresh full-size attempt)."""
+    api = FakeApiServer()
+    with api.as_kubelet():
+        api.create(make_elastic("bm", min_replicas=2))
+    rec = Reconciler(api)
+    assert _converge(api, rec, "bm") == "Running"
+    pods = sorted(p["metadata"]["name"]
+                  for p in api.list("Pod", "default", {JOB_LABEL: "bm"}))
+    for name in pods[1:]:
+        api.set_pod_terminated("default", name, DRAIN_EXIT_CODE)
+    phase = rec.reconcile(api.get(KIND, "default", "bm"))
+    assert phase == "Restarting"
+    status = api.get(KIND, "default", "bm")["status"]
+    # Drained pods: budget unchanged (the r6 exemption still holds).
+    assert status["restartCount"] == 0
+    assert _converge(api, rec, "bm") == "Running"
+    assert len(api.list("Pod", "default", {JOB_LABEL: "bm"})) == 4
+
+
+def test_rigid_job_unaffected_by_member_loss_path():
+    api = FakeApiServer()
+    spec = replica_spec("TPU_WORKER", 4, image="i",
+                        tpu_accelerator="a", tpu_topology="1x1",
+                        chips_per_worker=1)
+    job = tpu_job("rg", "default", [spec],
+                  termination=termination_policy("TPU_WORKER", 0))
+    job["metadata"]["uid"] = "uid-rg"
+    with api.as_kubelet():
+        api.create(job)
+    rec = Reconciler(api)
+    assert _converge(api, rec, "rg") == "Running"
+    pods = sorted(p["metadata"]["name"]
+                  for p in api.list("Pod", "default", {JOB_LABEL: "rg"}))
+    api.set_pod_terminated("default", pods[0], 1)  # genuine crash
+    phase = rec.reconcile(api.get(KIND, "default", "rg"))
+    assert phase == "Restarting"
+    assert api.get(KIND, "default", "rg")["status"]["restartCount"] == 1
+
+
+def test_chief_loss_resizes_too():
+    """Worker 0 (the chief) dying is just another member loss for an
+    elastic gang — the roll recreates index 0 with a fresh
+    coordinator address."""
+    api = FakeApiServer()
+    with api.as_kubelet():
+        api.create(make_elastic("ch"))
+    rec = Reconciler(api)
+    assert _converge(api, rec, "ch") == "Running"
+    api.set_pod_terminated("default", "ch-tpu-worker-0",
+                           DRAIN_EXIT_CODE)
+    assert rec.reconcile(api.get(KIND, "default", "ch")) == "Running"
+    assert _converge(api, rec, "ch") == "Running"
+    status = api.get(KIND, "default", "ch")["status"]
+    assert status["currentReplicas"] == 3
+    assert status["restartCount"] == 0
+
+
+def test_deleted_pod_eviction_resizes():
+    """A pod OBJECT vanishing from a Running gang (node-level
+    eviction) is member loss, not birth: resize, don't re-create at
+    the old size."""
+    api = FakeApiServer()
+    with api.as_kubelet():
+        api.create(make_elastic("ev"))
+    rec = Reconciler(api)
+    assert _converge(api, rec, "ev") == "Running"
+    with api.as_kubelet():
+        api.delete("Pod", "default", "ev-tpu-worker-3")
+    rec.reconcile(api.get(KIND, "default", "ev"))
+    assert api.get(KIND, "default", "ev")["status"][
+        "currentReplicas"] == 3
+    assert _converge(api, rec, "ev") == "Running"
+    assert len(api.list("Pod", "default", {JOB_LABEL: "ev"})) == 3
+
+
+def test_restart_resets_shrunk_gang_to_desired():
+    """A full restart (crash, not drain) of a shrunk elastic gang is
+    a fresh scheduling attempt at the DESIRED size — counted as a
+    grow resize."""
+    api = FakeApiServer()
+    with api.as_kubelet():
+        api.create(make_elastic("gr"))
+    rec = Reconciler(api)
+    assert _converge(api, rec, "gr") == "Running"
+    api.set_pod_terminated("default", "gr-tpu-worker-3",
+                           DRAIN_EXIT_CODE)
+    rec.reconcile(api.get(KIND, "default", "gr"))  # resize to 3
+    assert _converge(api, rec, "gr") == "Running"
+    assert rec.resize_counts()["shrink"] == 1
+    # Now 2 of the 3 crash at once: survivors (1) < min (2) — the
+    # elastic contract is exhausted, the classic whole-slice restart
+    # takes over AND resets the gang to its DESIRED size (a restart
+    # is a fresh full-size scheduling attempt) — the grow direction.
+    api.set_pod_terminated("default", "gr-tpu-worker-1", 1)
+    api.set_pod_terminated("default", "gr-tpu-worker-2", 1)
+    phase = rec.reconcile(api.get(KIND, "default", "gr"))
+    assert phase == "Restarting"
+    status = api.get(KIND, "default", "gr")["status"]
+    assert status["currentReplicas"] == 4
+    assert rec.resize_counts()["grow"] == 1
+    assert _converge(api, rec, "gr") == "Running"
+    assert len(api.list("Pod", "default", {JOB_LABEL: "gr"})) == 4
+    # A genuine crash burns budget as ever; the resize path never did.
+    assert api.get(KIND, "default", "gr")["status"]["restartCount"] == 1
+
+
+# -- admission + stall shrink ---------------------------------------------
+
+
+def _age_pending(api, name, seconds):
+    past = (datetime.datetime.now(datetime.timezone.utc)
+            - datetime.timedelta(seconds=seconds)).isoformat()
+
+    def mutate(obj):
+        for cond in obj.get("status", {}).get("conditions", []):
+            if cond["type"] == "Pending":
+                cond["lastTransitionTime"] = past
+
+    with api.as_kubelet():
+        api.patch(KIND, "default", name, mutate)
+
+
+def test_admission_shrink_steps_toward_min():
+    """A Pending elastic gang burning its scheduling deadline shrinks
+    one worker at the eligibility fraction instead of holding out for
+    the full size until the deadline kills it."""
+    api = FakeApiServer()
+    with api.as_kubelet():
+        api.create(make_elastic("ad", deadline=100))
+    rec = Reconciler(api)
+    rec.reconcile(api.get(KIND, "default", "ad"))  # 4 pods Pending
+    rec.reconcile(api.get(KIND, "default", "ad"))
+    assert api.get(KIND, "default", "ad")["status"]["phase"] == "Pending"
+    _age_pending(api, "ad", 60)  # past fraction (50), before deadline
+    phase = rec.reconcile(api.get(KIND, "default", "ad"))
+    assert phase == "Pending"
+    status = api.get(KIND, "default", "ad")["status"]
+    assert status["currentReplicas"] == 3
+    assert _conds(api, "ad")[RESIZING_CONDITION]["status"] == "True"
+    # Paced: an immediate next pass must NOT shrink again.
+    rec.reconcile(api.get(KIND, "default", "ad"))  # roll holds/creates
+    rec.reconcile(api.get(KIND, "default", "ad"))
+    assert api.get(KIND, "default", "ad")["status"][
+        "currentReplicas"] == 3
+    # The smaller gang schedules: job runs at 3.
+    assert _converge(api, rec, "ad") == "Running"
+    assert api.get(KIND, "default", "ad")["status"]["restartCount"] == 0
+
+
+def test_admission_shrink_stops_at_min_then_deadline_applies():
+    api = FakeApiServer()
+    with api.as_kubelet():
+        api.create(make_elastic("am", workers=2, min_replicas=2,
+                                deadline=50))
+    rec = Reconciler(api)
+    rec.reconcile(api.get(KIND, "default", "am"))
+    rec.reconcile(api.get(KIND, "default", "am"))
+    _age_pending(api, "am", 60)  # past the whole deadline, at min
+    phase = rec.reconcile(api.get(KIND, "default", "am"))
+    assert phase == "Failed"
+    assert _conds(api, "am")[DEADLINE_CONDITION]["status"] == "True"
+
+
+def test_post_restart_stall_fails_rigid_and_shrinks_elastic():
+    """The spot-storm signature: after a restart the pool only holds
+    2 of 4 workers. A rigid gang deadline-fails (releasing chips); an
+    elastic one shrinks to the workers that actually scheduled."""
+    api = FakeApiServer()
+    with api.as_kubelet():
+        api.create(make_elastic("st-el", deadline=30))
+    spec = replica_spec("TPU_WORKER", 4, image="i",
+                        tpu_accelerator="a", tpu_topology="1x1",
+                        chips_per_worker=1)
+    rigid = tpu_job("st-rg", "default", [spec],
+                    termination=termination_policy("TPU_WORKER", 0),
+                    scheduling_deadline_seconds=30)
+    rigid["metadata"]["uid"] = "uid-st-rg"
+    with api.as_kubelet():
+        api.create(rigid)
+    rec = Reconciler(api)
+    for name in ("st-el", "st-rg"):
+        assert _converge(api, rec, name) == "Running"
+        # Drain the whole gang → restart; then only indices < 2 can
+        # schedule again.
+        for pod in api.list("Pod", "default", {JOB_LABEL: name}):
+            api.set_pod_terminated("default",
+                                   pod["metadata"]["name"],
+                                   DRAIN_EXIT_CODE)
+        rec.reconcile(api.get(KIND, "default", name))  # teardown
+        rec.reconcile(api.get(KIND, "default", name))  # recreate
+        with api.as_kubelet():
+            for pod in api._list("Pod", "default", {JOB_LABEL: name}):
+                idx = int(pod["metadata"]["labels"][
+                    "kubeflow.org/replica-index"])
+                if idx < 2:
+                    api.set_pod_phase(
+                        "default", pod["metadata"]["name"], "Running")
+        # Anchor the stall clock, then backdate it past the deadline.
+        rec.reconcile(api.get(KIND, "default", name))
+        past = (datetime.datetime.now(datetime.timezone.utc)
+                - datetime.timedelta(seconds=60)).isoformat()
+        with api.as_kubelet():
+            api.patch(KIND, "default", name,
+                      lambda o: o["status"].update(
+                          {"schedulingSince": past}))
+        rec.reconcile(api.get(KIND, "default", name))
+
+    # Elastic: shrank to the 2 running workers, still Running.
+    status = api.get(KIND, "default", "st-el")["status"]
+    assert status["phase"] == "Running", status
+    assert status["currentReplicas"] == 2
+    assert _converge(api, rec, "st-el") == "Running"
+    assert len(api.list("Pod", "default", {JOB_LABEL: "st-el"})) == 2
+    # Rigid: deadline-failed, chips released.
+    status = api.get(KIND, "default", "st-rg")["status"]
+    assert status["phase"] == "Failed", status
+    assert _conds(api, "st-rg")[DEADLINE_CONDITION]["status"] == "True"
+    assert api.list("Pod", "default", {JOB_LABEL: "st-rg"}) == []
+
+
+# -- preemptor shrink-first -----------------------------------------------
+
+
+def test_preemptor_shrinks_elastic_victim_never_below_min():
+    api = FakeApiServer()
+    rec = Reconciler(api, preemption=PreemptionPolicy(
+        min_interval_seconds=0.0))
+    with api.as_kubelet():
+        api.create(make_elastic("vic", workers=3, min_replicas=2,
+                                max_replicas=3))
+    assert _converge(api, rec, "vic") == "Running"
+    with api.as_kubelet():
+        api.create(make_elastic("hi", workers=1, min_replicas=1,
+                                deadline=100, priority=5))
+    rec.reconcile(api.get(KIND, "default", "hi"))
+    _age_pending(api, "hi", 60)
+    rec.reconcile(api.get(KIND, "default", "hi"))
+
+    status = api.get(KIND, "default", "vic")["status"]
+    conds = _conds(api, "vic")
+    assert status["phase"] == "Running"
+    assert status["currentReplicas"] == 2
+    assert conds[SHRUNK_CONDITION]["status"] == "True"
+    assert conds[RESIZING_CONDITION]["status"] == "True"
+    assert PREEMPTED_CONDITION not in conds
+    assert rec.preemption.shrunk == 1
+    # Victim reconverges at 2 — GangShrunk banner stays (below
+    # desired), Resized records the settle.
+    assert _converge(api, rec, "vic") == "Running"
+    conds = _conds(api, "vic")
+    assert conds[SHRUNK_CONDITION]["status"] == "True"
+    assert conds[RESIZED_CONDITION]["status"] == "True"
+
+    # Second episode: the victim is now AT min — the kill path takes
+    # over (never below min). The preemptor's episode latch must be
+    # cleared first (it ran once).
+    with api.as_kubelet():
+        api.create(make_elastic("hi2", workers=1, min_replicas=1,
+                                deadline=100, priority=5))
+    rec.reconcile(api.get(KIND, "default", "hi2"))
+    _age_pending(api, "hi2", 60)
+    rec.reconcile(api.get(KIND, "default", "hi2"))
+    status = api.get(KIND, "default", "vic")["status"]
+    conds = _conds(api, "vic")
+    assert status["currentReplicas"] == 2  # NEVER below min
+    assert conds[PREEMPTED_CONDITION]["status"] == "True"
+    assert status["phase"] == "Restarting"
+    assert status["restartCount"] == 0  # preemption burns no budget
+
+
+def test_shrink_shares_rate_limit_and_latch():
+    """One action per interval across the fleet — a shrink consumes
+    the same token a kill would; and the preemptor's episode latch
+    covers shrinks (no second action for the same Pending episode)."""
+    api = FakeApiServer()
+    rec = Reconciler(api, preemption=PreemptionPolicy(
+        min_interval_seconds=3600.0))
+    with api.as_kubelet():
+        api.create(make_elastic("v1", workers=3, min_replicas=2,
+                                max_replicas=3))
+        api.create(make_elastic("v2", workers=3, min_replicas=2,
+                                max_replicas=3))
+    assert _converge(api, rec, "v1") == "Running"
+    assert _converge(api, rec, "v2") == "Running"
+    with api.as_kubelet():
+        api.create(make_elastic("p1", workers=1, min_replicas=1,
+                                deadline=100, priority=5))
+        api.create(make_elastic("p2", workers=1, min_replicas=1,
+                                deadline=100, priority=5))
+    for name in ("p1", "p2"):
+        rec.reconcile(api.get(KIND, "default", name))
+        _age_pending(api, name, 60)
+    rec.reconcile(api.get(KIND, "default", "p1"))
+    # p1 shrank one victim and holds the latch; p2 is rate-limited.
+    rec.reconcile(api.get(KIND, "default", "p2"))
+    shrunk = [n for n in ("v1", "v2")
+              if _conds(api, n).get(SHRUNK_CONDITION, {})
+              .get("status") == "True"]
+    assert len(shrunk) == 1, shrunk
+    assert rec.preemption.shrunk == 1
+    assert rec.preemption.rate_limited >= 1
+    # p1's latch: another pass of p1 must not act again.
+    rec.reconcile(api.get(KIND, "default", "p1"))
+    assert rec.preemption.shrunk == 1
+
+
+# -- dashboard ------------------------------------------------------------
+
+
+def test_dashboard_summary_elastic_fields_and_degrade():
+    from kubeflow_tpu.dashboard.server import job_summary
+
+    job = make_elastic("dj")
+    job["status"] = {"phase": "Running", "currentReplicas": 3,
+                     "conditions": [
+                         {"type": SHRUNK_CONDITION, "status": "True",
+                          "reason": "shrunk 4 -> 3"}]}
+    summary = job_summary(job)
+    assert summary["elastic"] == {"current": 3, "min": 2, "max": 4}
+    assert any(w["type"] == SHRUNK_CONDITION
+               for w in summary["warnings"])
+    # Malformed bounds degrade to the rigid view — never a 500.
+    job["spec"]["minReplicas"] = {"nested": "garbage"}
+    summary = job_summary(job)
+    assert summary["elastic"] is None
+    # Rigid jobs carry no elastic block at all.
+    spec = replica_spec("TPU_WORKER", 2, image="i",
+                        tpu_accelerator="a", tpu_topology="1x1")
+    rigid = tpu_job("r", "d", [spec])
+    assert job_summary(rigid)["elastic"] is None
+
+
+# -- acceptance e2e over the HTTP facade (tier-1 fast variant) ------------
+
+
+def _wait_for(predicate, timeout, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_elastic_kill_e2e_over_http():
+    """minReplicas=2, maxReplicas=4: killing 1 of 4 hosts mid-run
+    keeps the TPUJob Running — no restart-budget burn, the gang rolls
+    to 3 with fresh env, Resized lands — through the production HTTP
+    client under the live watch controller (the citest's control
+    plane at wire level)."""
+    fake = FakeApiServer()
+    with HttpFakeApiServer(fake=fake, token="el") as srv:
+        client = HttpApiClient(srv.url, token="el")
+        ctl = WatchController(
+            client, relist_seconds=0.3, workers=2,
+            backoff=ExponentialBackoff(base=0.02, cap=0.5))
+        thread = threading.Thread(target=ctl.run, daemon=True)
+        thread.start()
+        try:
+            client.create(make_elastic("wire", workers=4,
+                                       min_replicas=2,
+                                       max_replicas=4))
+            assert _wait_for(lambda: len(fake._list(
+                "Pod", "default", {JOB_LABEL: "wire"})) == 4, 5.0)
+            with fake.as_kubelet():
+                for pod in fake._list("Pod", "default",
+                                      {JOB_LABEL: "wire"}):
+                    fake.set_pod_phase("default",
+                                       pod["metadata"]["name"],
+                                       "Running")
+            assert _wait_for(
+                lambda: fake.get(KIND, "default", "wire")
+                .get("status", {}).get("phase") == "Running", 5.0)
+
+            # Kill one host mid-run (spot drain).
+            fake.set_pod_terminated("default", "wire-tpu-worker-2",
+                                    DRAIN_EXIT_CODE)
+
+            # The gang must reconverge at 3 — the kubelet keeps
+            # admitting whatever the roll creates.
+            def settled():
+                with fake.as_kubelet():
+                    pods = fake._list("Pod", "default",
+                                      {JOB_LABEL: "wire"})
+                    for pod in pods:
+                        if pod.get("status", {}).get("phase") in (
+                                None, "Pending"):
+                            fake.set_pod_phase(
+                                "default", pod["metadata"]["name"],
+                                "Running")
+                    status = fake.get(KIND, "default", "wire").get(
+                        "status", {})
+                conds = {c.get("type"): c.get("status")
+                         for c in status.get("conditions", [])}
+                return (len(pods) == 3
+                        and all(p.get("status", {}).get("phase")
+                                == "Running" for p in pods)
+                        and status.get("phase") == "Running"
+                        and conds.get(RESIZED_CONDITION) == "True")
+
+            assert _wait_for(settled, 10.0), fake.get(
+                KIND, "default", "wire").get("status")
+            status = fake.get(KIND, "default", "wire")["status"]
+            conds = {c.get("type"): c.get("status")
+                     for c in status.get("conditions", [])}
+            assert int(status.get("restartCount", 0)) == 0
+            assert int(status.get("currentReplicas", 0)) == 3
+            # Never entered Restarting; pods unique.
+            assert "Restarting" not in conds
+            names = sorted(p["metadata"]["name"] for p in fake._list(
+                "Pod", "default", {JOB_LABEL: "wire"}))
+            assert len(names) == len(set(names)) == 3
+            # Controller surfaced the resize in its stats.
+            assert ctl.stats()["gangResizes"]["shrink"] >= 1
+        finally:
+            ctl.stop.set()
+            thread.join(timeout=10)

@@ -37,7 +37,10 @@ from kubeflow_tpu.operator import FakeApiServer, Reconciler
 from kubeflow_tpu.operator.reconciler import (
     JOB_LABEL,
     PREEMPTED_CONDITION,
+    SHRUNK_CONDITION,
     PreemptionPolicy,
+    elastic_current_replicas,
+    job_elastic_bounds,
     job_priority,
 )
 
@@ -141,7 +144,8 @@ def test_reconciler_fuzz_invariants_and_liveness():
 # -- preemption fuzz (r12) ------------------------------------------------
 
 
-def _preemption_job(name, priority, deadline):
+def _preemption_job(name, priority, deadline, *, workers=1,
+                    min_replicas=None):
     from kubeflow_tpu.manifests.tpujob import (
         replica_spec,
         termination_policy,
@@ -149,13 +153,14 @@ def _preemption_job(name, priority, deadline):
     )
 
     spec = replica_spec(
-        "TPU_WORKER", 1, image="img:1",
+        "TPU_WORKER", workers, image="img:1",
         tpu_accelerator="tpu-v5-lite-podslice", tpu_topology="1x1",
         chips_per_worker=1)
     job = tpu_job(name, "default", [spec],
                   termination=termination_policy("TPU_WORKER", 0),
                   scheduling_deadline_seconds=deadline,
-                  priority=priority)
+                  priority=priority,
+                  min_replicas=min_replicas)
     job["metadata"]["uid"] = f"uid-{name}"
     return job
 
@@ -286,3 +291,151 @@ def test_preemption_fuzz_invariants():
     # The mix must actually exercise preemption across seeds,
     # otherwise the invariants above were vacuous.
     assert saw_preemption >= 3, saw_preemption
+
+
+# -- elastic shrink-first fuzz (r16) ---------------------------------------
+
+
+def _shrunk_set(api, names):
+    out = set()
+    for name in names:
+        with api.as_kubelet():
+            job = api.get("TPUJob", "default", name)
+        for cond in job.get("status", {}).get("conditions", []):
+            if (cond.get("type") == SHRUNK_CONDITION
+                    and cond.get("status") == "True"):
+                out.add(name)
+    return out
+
+
+def _elastic_episode(seed: int):
+    """Random priorities × chip scarcity with ELASTIC victims in the
+    mix. Invariants per decision: at most ONE action (shrink OR kill)
+    fleet-wide; shrinks never touch equal-or-higher priority; a raw
+    status.currentReplicas below minReplicas is never written; an
+    elastic victim still above min is shrunk, never killed."""
+    rng = random.Random(seed)
+    api = FakeApiServer()
+    names, priorities, elastic_bounds = [], {}, {}
+    for i in range(rng.randint(3, 5)):
+        name = f"ez{i}"
+        names.append(name)
+        priorities[name] = rng.randint(0, 3)
+        workers = rng.randint(1, 3)
+        if workers > 1 and rng.random() < 0.6:
+            elastic_bounds[name] = (rng.randint(1, workers - 1),
+                                    workers)
+        with api.as_kubelet():
+            api.create(_preemption_job(
+                name, priorities[name], 50, workers=workers,
+                min_replicas=elastic_bounds.get(name,
+                                                (None,))[0]))
+    # At least one rigid high-priority aggressor: an elastic Pending
+    # aggressor SHRINKS ITSELF at the eligibility fraction before it
+    # ever preempts anyone (admission shrink runs first), so an
+    # all-elastic mix would exercise mostly self-shrinks.
+    names.append("ez-hi")
+    priorities["ez-hi"] = 4
+    with api.as_kubelet():
+        api.create(_preemption_job("ez-hi", 4, 50, workers=1))
+    r = Reconciler(api, preemption=PreemptionPolicy(
+        min_interval_seconds=0.0, deadline_fraction=0.5))
+    capacity = rng.randint(2, 4)
+    # Warm-up: give the pre-existing fleet a chance to actually hold
+    # chips (victims must be Running to be candidates).
+    for _ in range(3):
+        for name in names:
+            with api.as_kubelet():
+                job = api.get("TPUJob", "default", name)
+            r.reconcile(job)
+        _scarce_kubelet(api, capacity)
+
+    def check_bounds():
+        for name, (lo, _) in elastic_bounds.items():
+            with api.as_kubelet():
+                job = api.get("TPUJob", "default", name)
+            raw = job.get("status", {}).get("currentReplicas")
+            if raw is not None:
+                assert int(raw) >= lo, (seed, name, raw, lo)
+            assert job_elastic_bounds(job) == elastic_bounds[name]
+
+    acted = 0
+    for _ in range(rng.randint(25, 45)):
+        roll = rng.random()
+        target = rng.choice(names)
+        if roll < 0.6:
+            with api.as_kubelet():
+                job = api.get("TPUJob", "default", target)
+            if job.get("status", {}).get("phase") in TERMINAL:
+                continue
+            pre_kill = _preempted_set(api, names)
+            pre_shrunk = _shrunk_set(api, names)
+            pre_sizes = {
+                n: elastic_current_replicas(
+                    api.get("TPUJob", "default", n))
+                for n in elastic_bounds}
+            r.reconcile(job)
+            fresh_kill = _preempted_set(api, names) - pre_kill
+            fresh_shrunk = _shrunk_set(api, names) - pre_shrunk
+            # ≤ 1 action per decision, kill OR shrink.
+            assert len(fresh_kill) + len(fresh_shrunk) <= 1, (
+                seed, fresh_kill, fresh_shrunk)
+            for victim in fresh_kill | fresh_shrunk:
+                assert priorities[victim] < priorities[target], (
+                    seed, victim, target)
+                acted += 1
+            for victim in fresh_kill:
+                # Shrink-first: a killable elastic victim must have
+                # been AT min already when the decision fired.
+                if victim in elastic_bounds:
+                    assert (pre_sizes[victim]
+                            == elastic_bounds[victim][0]), (
+                        seed, victim, pre_sizes[victim])
+            check_bounds()
+        elif roll < 0.8:
+            # Time passes for EVERY Pending job (crossing the
+            # shrink/preemption eligibility fraction or the
+            # deadline) — per-target aging starves the aggressor.
+            age = rng.choice((10, 30, 60))
+            for name in names:
+                _backdate_pending(api, name, age)
+        else:
+            _scarce_kubelet(api, capacity)
+
+    # Wind-down: scarcity ends; every non-terminal job must settle
+    # (resize rolls complete, gangs run) with bounds still honored.
+    for _ in range(40):
+        _scarce_kubelet(api, capacity=10_000)
+        for name in names:
+            with api.as_kubelet():
+                job = api.get("TPUJob", "default", name)
+            if job.get("status", {}).get("phase") not in TERMINAL:
+                r.reconcile(job)
+        check_bounds()
+    for name in names:
+        with api.as_kubelet():
+            job = api.get("TPUJob", "default", name)
+        phase = job.get("status", {}).get("phase")
+        if phase == "Failed":
+            conds = {c["type"]: c["status"]
+                     for c in job["status"].get("conditions", [])}
+            assert conds.get("DeadlineExceeded") == "True", (
+                seed, name, job["status"])
+        elif phase != "Succeeded":
+            pods = api._list("Pod", "default", {JOB_LABEL: name})
+            assert pods, (seed, name, phase)
+            assert all(p.get("status", {}).get("phase") == "Running"
+                       for p in pods), (seed, name, phase)
+            bounds = elastic_bounds.get(name)
+            if bounds is not None:
+                assert bounds[0] <= len(pods) <= bounds[1], (
+                    seed, name, len(pods), bounds)
+    return acted
+
+
+def test_elastic_preemption_fuzz_invariants():
+    acted = 0
+    for seed in range(12):
+        acted += _elastic_episode(seed)
+    # The mix must actually exercise shrink/kill decisions.
+    assert acted >= 3, acted
