@@ -179,6 +179,13 @@ class GenerateStream:
         self._final = False
         self._notify: Optional[Callable[[], None]] = None
         self.cancelled = False
+        #: Mid-stream resume seam (ISSUE 13): the context a PEER
+        #: engine needs to reproduce this request's remaining tokens
+        #: bitwise if this replica dies mid-decode — {"prompt" (the
+        #: full context ids), "step_keys" (the remaining sampling
+        #: schedule), "max_new_tokens"}. None when unresumable (a
+        #: left-layout handoff carries no prompt ids).
+        self.resume_ctx: Optional[dict] = None
 
     # -- engine side -----------------------------------------------------
 
@@ -671,7 +678,8 @@ class DecodeEngine:
                deadline: Optional[float] = None,
                obs_ctx: Any = None,
                request_id: str = "",
-               handoff: Optional[PrefillHandoff] = None
+               handoff: Optional[PrefillHandoff] = None,
+               step_keys: Optional[np.ndarray] = None
                ) -> GenerateStream:
         """Queue one request; tokens stream on the returned handle.
 
@@ -686,9 +694,25 @@ class DecodeEngine:
         ``max_new_tokens`` are taken FROM the handoff (a divergent
         caller budget would fork the rng schedule — rejected).
 
+        With ``step_keys`` (mid-stream decode resume, ISSUE 13) the
+        caller supplies the EXPLICIT remaining sampling schedule
+        ([budget, 2] uint32) instead of an rng seed: ``prompt`` is the
+        full resume context (original prompt + tokens already emitted
+        on the dead replica), the budget is the schedule's length, and
+        the prefill over the context reproduces the next token
+        bitwise (K/V at position i is a pure function of tokens
+        [0, i]; the schedule picks the same sample). The context may
+        legally exceed ``max_prompt_len`` — the true bound is
+        ``cache_size - budget``, the same total the original request
+        fit in.
+
         Raises :class:`OverloadedError` /
         :class:`DeadlineExceededError` synchronously when admission
         control sheds the request."""
+        if handoff is not None and step_keys is not None:
+            raise ValueError("handoff and step_keys are mutually "
+                             "exclusive (the handoff carries its own "
+                             "key schedule)")
         if handoff is not None:
             if (max_new_tokens is not None
                     and int(max_new_tokens) != handoff.max_new_tokens):
@@ -732,6 +756,28 @@ class DecodeEngine:
                         f"{handoff.prompt_len}")
             else:
                 prompt = np.zeros((handoff.prompt_len,), np.int32)
+        elif step_keys is not None:
+            # Mid-stream resume continuation: the context is the
+            # original prompt + tokens already emitted elsewhere, and
+            # the remaining schedule IS the budget.
+            if rng is not None:
+                raise ValueError("step_keys and rng are mutually "
+                                 "exclusive (the schedule is explicit)")
+            prompt = np.asarray(prompt, np.int32).reshape(-1)
+            step_keys = np.ascontiguousarray(
+                np.asarray(step_keys, np.uint32).reshape(-1, 2))
+            budget = len(step_keys)
+            if (max_new_tokens is not None
+                    and int(max_new_tokens) != budget):
+                raise ValueError(
+                    f"max_new_tokens {max_new_tokens} != the "
+                    f"{budget}-key resume schedule")
+            limit = self._model.cache_size - budget
+            if not 1 <= prompt.shape[0] <= limit:
+                raise ValueError(
+                    f"resume context length {prompt.shape[0]} outside "
+                    f"[1, {limit}] (cache_size {self._model.cache_size}"
+                    f" - {budget} remaining tokens)")
         else:
             prompt = np.asarray(prompt, np.int32).reshape(-1)
             if not 1 <= prompt.shape[0] <= self.config.max_prompt_len:
@@ -751,11 +797,10 @@ class DecodeEngine:
         # the head) — fail it at submit, not by hanging the queue.
         # (The worst case assumes NO prefix hit: a matched prefix can
         # be evicted between submit and admission.)
-        if self.prefix is not None:
-            width = int(prompt.shape[0])  # pad-0 layout: true length
+        if handoff is not None and self.prefix is None:
+            width = handoff.prompt_width
         else:
-            width = (handoff.prompt_width if handoff is not None
-                     else self._bucket(prompt.shape[0]))
+            width = self._prompt_width(int(prompt.shape[0]))
         need = self.kv.pages_for(width + budget)
         usable = self.kv.allocator.num_pages - 1
         if need > usable:
@@ -795,11 +840,24 @@ class DecodeEngine:
                     retry_after_s=est)
         if handoff is not None:
             step_keys = np.asarray(handoff.step_keys)
-        else:
+        elif step_keys is None:
             key = self._next_key() if rng is None else np.asarray(rng)
             step_keys = np.asarray(jax.random.split(
                 jnp.asarray(key, jnp.uint32), budget))
         stream = GenerateStream(budget, obs_ctx=obs_ctx)
+        if handoff is None or handoff.prompt_tokens is not None:
+            # The peer-resume context (serving/server.py emits it as
+            # an SSE ``resume`` event when asked): a left-layout
+            # handoff's placeholder prompt is NOT resumable — zeros
+            # are not the context.
+            # Reference, not copy: the request's prompt array is
+            # never mutated (prefill writes into its own padded
+            # block), and _Request holds the same reference anyway.
+            stream.resume_ctx = {
+                "prompt": prompt,
+                "step_keys": np.asarray(step_keys),
+                "max_new_tokens": budget,
+            }
         req = _Request(prompt=prompt, step_keys=step_keys,
                        max_new_tokens=budget, deadline=deadline,
                        stream=stream, submitted_at=now,
@@ -920,13 +978,24 @@ class DecodeEngine:
         return prompt_bucket(n, self.config.max_prompt_len,
                              self.config.prompt_buckets)
 
-    def _budget_pages(self, req: _Request) -> int:
+    def _prompt_width(self, length: int) -> int:
+        """Prefill block width for a ``length``-token context: exact
+        in the pad-0 prefix layout, bucketed classically — except a
+        resume continuation longer than ``max_prompt_len`` (legal:
+        its true bound is the cache) takes its exact width, because
+        ``prompt_bucket`` CLAMPS to max_prompt_len and a clamped
+        width would truncate the context."""
         if self.prefix is not None:
-            width = len(req.prompt)  # pad-0 layout: true length
-        elif req.handoff is not None:
+            return length
+        if length > self.config.max_prompt_len:
+            return length
+        return self._bucket(length)
+
+    def _budget_pages(self, req: _Request) -> int:
+        if req.handoff is not None and self.prefix is None:
             width = req.handoff.prompt_width
         else:
-            width = self._bucket(len(req.prompt))
+            width = self._prompt_width(len(req.prompt))
         return self.kv.pages_for(width + req.max_new_tokens)
 
     def _tail_width(self, length: int, start: int) -> int:
@@ -936,7 +1005,12 @@ class DecodeEngine:
         ``dynamic_update_slice`` would CLAMP an overhanging write
         backwards over the shared prefix. An overshooting bucket
         falls back to the exact tail length (one extra compile in a
-        rare corner; the bucketed widths cover steady state)."""
+        rare corner; the bucketed widths cover steady state). A
+        resume-continuation tail longer than ``max_prompt_len`` takes
+        its exact width — ``prompt_bucket`` clamps and a clamped
+        block would truncate the context."""
+        if length - start > self.config.max_prompt_len:
+            return length - start
         width = self._bucket(length - start)
         if start + width > self._model.cache_size:
             width = length - start
@@ -1031,7 +1105,7 @@ class DecodeEngine:
             first = int(req.handoff.first_token)
             done = bool(req.handoff.done)
         else:
-            width = self._bucket(length)
+            width = self._prompt_width(length)
             pad = width - length
         prompt = np.zeros((1, width), np.int32)
         prompt[0, pad:] = req.prompt

@@ -158,6 +158,18 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             "--junit_path",
             f"{params['artifacts_dir']}/junit_serving_mesh.xml",
         ],
+        # Serving-chaos gate (ISSUE 13): the gray-failure resilience
+        # sweep — a 3-replica stub fleet behind the pooled proxy with
+        # one replica browned out to 10x latency (healthz stays
+        # green) and one severing token streams mid-flight. Brownout
+        # soft-eject must engage within 2 probe windows, gray-fleet
+        # goodput must hold >= 0.9x clean, p99-of-successes must stay
+        # within deadline, and every resumed stream must stitch a
+        # bitwise-exact token sequence. Hermetic — sleep-based stub
+        # replicas, no cluster, no accelerator.
+        "serving-chaos": [
+            py, f"{src}/bench.py", "--chaos",
+        ],
         "deploy-test": [
             py, "-m", "kubeflow_tpu.citests.deploy", "setup",
             "--namespace", params["test_namespace"],
@@ -212,6 +224,7 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             _dag_task("leader-failover-test", ["checkout"]),
             _dag_task("elastic-kill-test", ["checkout"]),
             _dag_task("serving-mesh-dryrun", ["checkout"]),
+            _dag_task("serving-chaos", ["checkout"]),
             _dag_task("deploy-test", ["checkout"]),
             _dag_task("deploy-serving", ["deploy-test"]),
             _dag_task("tpujob-test", ["deploy-test"]),

@@ -111,13 +111,20 @@ def eligible_endpoints(pool: EndpointPool,
                        exclude: Sequence[Endpoint] = ()
                        ) -> List[Endpoint]:
     """Candidates for one routing attempt, best tier first that is
-    non-empty: routable members with non-open (or probe-due) REST
-    breakers → routable members → any non-excluded member. Excluded
-    members (already tried this request) never return."""
+    non-empty: routable members that are not brownout-soft-ejected →
+    routable members → any non-excluded member; within the winning
+    tier, members with non-open (or probe-due) REST breakers are
+    preferred. Excluded members (already tried this request) never
+    return. Soft-ejected members (scaling/endpoints.py BrownoutPolicy)
+    are skipped while any bright candidate exists — their traffic is
+    the paced shadow trickle the proxy routes deliberately — but a
+    pool that is ALL soft-ejected still routes (graceful degradation:
+    slow beats down)."""
     excluded = set(id(ep) for ep in exclude)
     members = [ep for ep in pool.endpoints() if id(ep) not in excluded]
     routable = [ep for ep in members if ep.routable()]
-    tier = routable or members
+    bright = [ep for ep in routable if not ep.soft_ejected]
+    tier = bright or routable or members
     closed = [ep for ep in tier
               if ep.rest_breaker.state != "open"
               or ep.rest_breaker.retry_after_s() <= _PROBE_DUE_S]

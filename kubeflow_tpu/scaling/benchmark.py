@@ -52,6 +52,12 @@ from typing import Any, Dict, List, Optional, Tuple
 MODEL = "routed"
 
 
+def _sse(data: Dict[str, Any], event: str) -> bytes:
+    from kubeflow_tpu.serving import wire
+
+    return wire.format_sse_event(data, event=event)
+
+
 def _metadata_payload() -> Dict[str, Any]:
     return {
         "model_spec": {"name": MODEL, "version": "1"},
@@ -94,6 +100,15 @@ class StubBackendFleet:
         self.n = n
         self.service_time_s = service_time_s
         self.proxy_kwargs = proxy_kwargs
+        #: Gray-failure chaos knobs (ISSUE 13, bench --chaos). A
+        #: latency multiplier > 1 models a BROWNOUT replica (answers
+        #: /healthz fine, serves that much slower);
+        #: ``kill_stream_after[i] = N`` makes backend i sever every
+        #: first-leg token stream after N flushed events (resume legs
+        #: are spared — the peer carrying the stream on is healthy).
+        self.latency_multiplier = [1.0] * n
+        self.kill_stream_after: List[Optional[int]] = [None] * n
+        self.stream_kills = [0] * n
         #: Per-backend role (None = classic role-less fleet). With
         #: roles set, ``:generate`` requests cost
         #: ``prefill_ms×prompt_tokens + decode_ms×max_new_tokens``
@@ -128,8 +143,12 @@ class StubBackendFleet:
         class Predict(tornado.web.RequestHandler):
             async def post(self, name, version, verb):
                 body = json.loads(self.request.body or b"{}")
+                if verb == "generate" and (body.get("stream")
+                                           or body.get("resume")):
+                    return await self._stream_generate(name, body)
                 rows = body.get("instances") or []
-                service_s = fleet.service_time_s
+                service_s = (fleet.service_time_s
+                             * fleet.latency_multiplier[index])
                 if fleet.roles is not None and verb == "generate":
                     # Role-specialized generate cost: per-token sleep
                     # rates by this backend's role (ROLE_RATES).
@@ -150,6 +169,74 @@ class StubBackendFleet:
                                            "version": "1"},
                             "predictions": [[float(index)]
                                             for _ in rows]})
+
+            async def _stream_generate(self, name, body):
+                """Minimal engine-shaped SSE :generate with the
+                resume contract the proxy's relay speaks: per-row
+                ``resume`` blobs up front (a self-describing b64
+                payload — the proxy treats it as opaque), one
+                deterministic token event per sleep step, terminal
+                ``done`` with THIS LEG's arrays. A resume request
+                (``resume`` + ``resume_emitted``) continues each row
+                from the tokens already relayed — tokens are a pure
+                function of (row, index), so the stitched client
+                sequence must come out identical. The chaos knob
+                ``kill_stream_after`` severs first-leg streams after
+                N events, exactly how a crashed replica looks."""
+                import base64
+
+                resume_b64 = body.get("resume")
+                if resume_b64 is not None:
+                    starts, total = [], 16
+                    for blob, emitted in zip(
+                            resume_b64,
+                            body.get("resume_emitted") or []):
+                        doc = json.loads(base64.b64decode(blob))
+                        total = int(doc["total"])
+                        starts.append(int(doc["start"])
+                                      + len(emitted))
+                else:
+                    rows = body.get("instances") or [[0]]
+                    total = int(body.get("max_new_tokens", 16))
+                    starts = [0] * len(rows)
+                self.set_header("Content-Type", "text/event-stream")
+                if body.get("emit_resume"):
+                    for r, start in enumerate(starts):
+                        blob = base64.b64encode(json.dumps(
+                            {"row": r, "start": start,
+                             "total": total}).encode()).decode()
+                        self.write(_sse({"row": r, "version": "1",
+                                         "blob": blob}, "resume"))
+                    await self.flush()
+                step_s = (fleet.service_time_s / max(1, total)
+                          * fleet.latency_multiplier[index])
+                kill_after = (None if resume_b64 is not None
+                              else fleet.kill_stream_after[index])
+                events = 0
+                out = [[] for _ in starts]
+                for i in range(max(total - s for s in starts)):
+                    await asyncio.sleep(step_s)
+                    for r, start in enumerate(starts):
+                        if start + i >= total:
+                            continue
+                        if kill_after is not None \
+                                and events >= kill_after:
+                            fleet.stream_kills[index] += 1
+                            self.request.connection.stream.close()
+                            return
+                        events += 1
+                        token = (r * 1000) + start + i
+                        out[r].append(token)
+                        self.write(_sse(
+                            {"row": r, "index": i, "token": token},
+                            "token"))
+                    await self.flush()
+                fleet.completed[index] += 1
+                self.write(_sse({"model_spec": {"name": name,
+                                                "version": "1"},
+                                 "tokens": out}, "done"))
+                await self.flush()
+                self.finish()
 
         class Health(tornado.web.RequestHandler):
             def get(self):
@@ -616,6 +703,219 @@ def run_role_split_benchmark(
         "phases": phases,
         "goodput_ratio": round(ratio, 2),
         "role_split_wins": ratio > 1.0,
+    }
+
+
+@dataclass
+class ChaosBenchConfig:
+    """`bench.py --chaos` (ISSUE 13): open-loop mixed unary/stream
+    sweep over a 3-replica stub fleet, clean vs gray — one replica
+    browned out (``brownout_multiplier`` × service latency, /healthz
+    still green) and one severing every first-leg token stream after
+    ``kill_after_events`` events. Sleep-based service times like the
+    router bench, so the asserted ratio survives CPU throttle."""
+
+    replicas: int = 3
+    service_time_s: float = 0.02
+    #: Offered load as a fraction of the CLEAN fleet's aggregate
+    #: capacity (replicas / service_time_s).
+    offered_fraction: float = 0.65
+    stream_fraction: float = 0.2
+    stream_tokens: int = 16
+    deadline_ms: int = 1500
+    measure_s: float = 8.0
+    warmup_requests: int = 12
+    probe_interval_s: float = 1.0
+    brownout_multiplier: float = 10.0
+    kill_after_events: int = 5
+    brownout_backend: int = 0
+    kill_backend: int = 1
+
+
+def _chaos_request(port: int, kind: str,
+                   config: ChaosBenchConfig) -> Tuple[bool, float]:
+    """One open-loop request; returns (ok, latency_s). A stream is ok
+    only when its terminal ``done`` carries the full deterministic
+    sequence — a resumed stream must stitch BITWISE."""
+    t0 = time.monotonic()
+    if kind == "unary":
+        try:
+            _post_infer(port, config.deadline_ms,
+                        timeout_s=config.deadline_ms / 1e3 + 2)
+            ok = True
+        except Exception:  # noqa: BLE001 — shed/expired/transport
+            ok = False
+        return ok, time.monotonic() - t0
+    import http.client
+
+    from kubeflow_tpu.serving import wire
+
+    total = config.stream_tokens
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", port, timeout=config.deadline_ms / 1e3 + 30)
+        conn.request(
+            "POST", f"/model/{MODEL}:generate",
+            body=json.dumps({"instances": [[1, 2]], "stream": True,
+                             "max_new_tokens": total}),
+            headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            resp.read()
+            conn.close()
+            return False, time.monotonic() - t0
+        events = list(wire.iter_sse_events(resp))
+        conn.close()
+    except Exception:  # noqa: BLE001 — severed stream / timeout
+        return False, time.monotonic() - t0
+    dones = [d for e, d in events if e == "done"]
+    if len(dones) != 1 or [e for e, _ in events if e == "error"]:
+        return False, time.monotonic() - t0
+    expect = [[r * 1000 + i for i in range(total)] for r in range(1)]
+    return dones[0]["tokens"] == expect, time.monotonic() - t0
+
+
+def _drive_chaos_phase(fleet: StubBackendFleet,
+                       config: ChaosBenchConfig) -> Dict[str, Any]:
+    offered_rps = (config.offered_fraction * config.replicas
+                   / config.service_time_s)
+    interval = 1.0 / offered_rps
+    results: List[Tuple[str, bool, float]] = []
+    lock = threading.Lock()
+    threads: List[threading.Thread] = []
+
+    def one(kind: str) -> None:
+        ok, latency = _chaos_request(fleet.proxy_port, kind, config)
+        with lock:
+            results.append((kind, ok, latency))
+
+    t0 = time.monotonic()
+    i = 0
+    while time.monotonic() - t0 < config.measure_s:
+        kind = ("stream"
+                if (i % max(1, round(1 / config.stream_fraction))
+                    == 0) else "unary")
+        t = threading.Thread(target=one, args=(kind,), daemon=True)
+        t.start()
+        threads.append(t)
+        i += 1
+        next_at = t0 + i * interval
+        sleep = next_at - time.monotonic()
+        if sleep > 0:
+            time.sleep(sleep)  # open loop: arrivals never slow down
+    for t in threads:
+        t.join(timeout=config.deadline_ms / 1e3 + 35)
+    wall = time.monotonic() - t0
+    ok_lat = sorted(lat for _, ok, lat in results if ok)
+    ok_unary = sum(1 for k, ok, _ in results if ok and k == "unary")
+    ok_stream = sum(1 for k, ok, _ in results if ok and k == "stream")
+    return {
+        "offered_rps": round(offered_rps, 1),
+        "offered": len(results),
+        "ok": len(ok_lat),
+        "ok_unary": ok_unary,
+        "ok_stream": ok_stream,
+        "goodput_rps": round(len(ok_lat) / wall, 1),
+        "ok_p50_ms": round(_pct(ok_lat, 0.5) * 1e3, 1),
+        "ok_p99_ms": round(_pct(ok_lat, 0.99) * 1e3, 1),
+    }
+
+
+def run_chaos_benchmark(config: Optional[ChaosBenchConfig] = None
+                        ) -> Dict[str, Any]:
+    """Clean phase → gray phase over fresh fleets at the SAME offered
+    load. Gray adds one brownout replica + one stream-killer; the
+    proxy's brownout policy must soft-eject the slow member within 2
+    probe windows of the load starting, goodput must hold ≥0.9× the
+    clean phase, and the p99 of SUCCESSES must stay inside the
+    deadline (degradation bounded, not just nonzero throughput)."""
+    from kubeflow_tpu.scaling.endpoints import BrownoutPolicy
+
+    config = config or ChaosBenchConfig()
+    phases: Dict[str, Any] = {}
+    detection: Dict[str, Any] = {}
+    for label in ("clean", "gray"):
+        fleet = StubBackendFleet(
+            config.replicas, service_time_s=config.service_time_s,
+            proxy_kwargs={
+                "balancer": "least_saturation",
+                "probe_interval_s": config.probe_interval_s,
+                # min_samples=4: the brownout replica serves ~5 slow
+                # responses/s once browned out, and the detection
+                # contract is measured in PROBE windows — the policy
+                # must be able to judge at its first post-arm cycle.
+                "brownout": BrownoutPolicy(min_samples=4),
+            }).start()
+        try:
+            for _ in range(config.warmup_requests):
+                _post_infer(fleet.proxy_port, config.deadline_ms,
+                            timeout_s=5)
+            if label == "gray":
+                fleet.latency_multiplier[config.brownout_backend] = \
+                    config.brownout_multiplier
+                fleet.kill_stream_after[config.kill_backend] = \
+                    config.kill_after_events
+                pool = fleet.proxy_app.settings["pool"]
+                slow_addr = (
+                    f"127.0.0.1:{fleet.ports[config.brownout_backend]}")
+                eject_at: List[Optional[float]] = [None]
+                armed_at = time.monotonic()
+                stop = threading.Event()
+
+                def watch():
+                    while not stop.is_set():
+                        for ep in pool.endpoints():
+                            if (ep.address == slow_addr
+                                    and ep.soft_ejected
+                                    and eject_at[0] is None):
+                                eject_at[0] = time.monotonic()
+                                return
+                        time.sleep(0.05)
+
+                watcher = threading.Thread(target=watch, daemon=True)
+                watcher.start()
+            phases[label] = _drive_chaos_phase(fleet, config)
+            if label == "gray":
+                stop.set()
+                watcher.join(timeout=2)
+                windows = (None if eject_at[0] is None else
+                           (eject_at[0] - armed_at)
+                           / config.probe_interval_s)
+                detection = {
+                    "soft_ejected": eject_at[0] is not None,
+                    "eject_latency_s": (
+                        None if eject_at[0] is None
+                        else round(eject_at[0] - armed_at, 2)),
+                    "eject_probe_windows": (
+                        None if windows is None else round(windows, 2)),
+                    "stream_kills":
+                        fleet.stream_kills[config.kill_backend],
+                }
+        finally:
+            fleet.stop()
+    ratio = (phases["gray"]["goodput_rps"]
+             / max(1e-9, phases["clean"]["goodput_rps"]))
+    return {
+        "config": {
+            "replicas": config.replicas,
+            "service_time_ms": config.service_time_s * 1e3,
+            "offered_fraction": config.offered_fraction,
+            "stream_fraction": config.stream_fraction,
+            "deadline_ms": config.deadline_ms,
+            "probe_interval_s": config.probe_interval_s,
+            "brownout_multiplier": config.brownout_multiplier,
+            "kill_after_events": config.kill_after_events,
+        },
+        "clean": phases["clean"],
+        "gray": phases["gray"],
+        "detection": detection,
+        "goodput_ratio": round(ratio, 3),
+        "p99_within_deadline":
+            phases["gray"]["ok_p99_ms"] <= config.deadline_ms,
+        "chaos_holds": (
+            ratio >= 0.9
+            and detection.get("soft_ejected", False)
+            and phases["gray"]["ok_p99_ms"] <= config.deadline_ms),
     }
 
 

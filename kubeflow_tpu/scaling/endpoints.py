@@ -103,12 +103,17 @@ _G_ENDPOINT_HEALTH = obs_metrics.Gauge(
     "kft_router_endpoint_health",
     "Per-replica router health (1=routable, 0=ejected/draining)",
     ("endpoint",))
+_G_ENDPOINT_BROWNOUT = obs_metrics.Gauge(
+    "kft_router_endpoint_brownout",
+    "Per-replica brownout soft-eject state (1=soft-ejected: only "
+    "shadow picks route here)", ("endpoint",))
 _C_PROBE_FAILURES = obs_metrics.Counter(
     "kft_router_probe_failures_total",
     "Failed health probes per replica", ("endpoint",))
 _C_TRANSITIONS = obs_metrics.Counter(
     "kft_router_health_transitions_total",
-    "Endpoint eject/readmit transitions", ("change",))
+    "Endpoint eject/readmit/soft_eject/soft_readmit/veto transitions",
+    ("change",))
 
 
 def _strip_scheme(address: str) -> str:
@@ -185,6 +190,44 @@ class Endpoint:
         self.inflight = 0
         self.probe_failures = 0
         self.last_probe_at: Optional[float] = None  # monotonic
+        # -- brownout (gray-failure) signals, fed from the PROXY's own
+        # route path (ISSUE 13). /healthz can't see a replica that
+        # answers probes fine and serves 10× slow; the requests can.
+        from kubeflow_tpu.serving.overload import QuantileWindow
+
+        #: Rolling end-to-end latency of requests THIS proxy served
+        #: through the replica (seconds).
+        self.latency_window = QuantileWindow(maxlen=64)
+        #: Rolling inter-chunk gaps observed on proxied token streams
+        #: (seconds). Bounded above by the server's SSE keepalive
+        #: cadence on a healthy stream, which is what makes a large
+        #: gap evidence rather than "maybe a slow decode".
+        self.gap_window = QuantileWindow(maxlen=64)
+        #: Monotonic timestamps of recent stream-stall verdicts (the
+        #: relay abandoned a wedged stream on this replica).
+        self.stall_marks: List[float] = []
+        #: Soft-eject (brownout) state: a soft-ejected replica is
+        #: routable() but excluded from normal picks; it still gets a
+        #: paced trickle of shadow picks so recovery is observable.
+        self.soft_ejected = False
+        self.soft_ejected_at: Optional[float] = None
+        #: Why the conviction happened (set by BrownoutPolicy at
+        #: eject): a latency outlier recovers by latency evidence, a
+        #: stall-only conviction by stall silence — streaming-only
+        #: fleets produce no unary shadow samples at all, so a
+        #: stall conviction must never wait on them.
+        self.eject_was_slow = False
+        #: The pool threshold that convicted a latency outlier,
+        #: frozen at eject: the degraded recovery bar when the pool
+        #: can no longer derive one (the replica's own rolling window
+        #: converges to the recent samples and could never satisfy a
+        #: self-relative ratio).
+        self.eject_threshold_s: Optional[float] = None
+        #: Latency samples recorded since the soft-eject — the
+        #: recovery check reads only these (the pre-eject samples are
+        #: the evidence that convicted it).
+        self.samples_since_eject = 0
+        self._next_shadow_at = 0.0
         self._lock = threading.Lock()
         # register_metrics=False is for placeholder endpoints that
         # never join a pool (make_app's empty-pool back-compat
@@ -193,6 +236,8 @@ class Endpoint:
         if register_metrics:
             _G_ENDPOINT_HEALTH.labels(self.address).set_function(
                 lambda ep=self: 1.0 if ep.routable() else 0.0)
+            _G_ENDPOINT_BROWNOUT.labels(self.address).set_function(
+                lambda ep=self: 1.0 if ep.soft_ejected else 0.0)
 
     @property
     def url(self) -> str:
@@ -203,8 +248,89 @@ class Endpoint:
     def routable(self) -> bool:
         """May the balancer hand this replica new work? Unknown is
         routable (see module docstring); draining and ejected are
-        not."""
+        not. Soft-ejected (brownout) members stay routable — the
+        balancer tier logic excludes them while non-soft candidates
+        exist, and the shadow trickle deliberately routes there."""
         return self.health in (HEALTHY, UNKNOWN)
+
+    # -- brownout signals (fed by the proxy's route path) ---------------
+
+    def note_latency(self, seconds: float) -> None:
+        """One served request's end-to-end latency through this
+        replica (success OR app error — both prove how fast it
+        answers; transport failures are the breaker's evidence, not
+        latency)."""
+        self.latency_window.observe(seconds)
+        if self.soft_ejected:
+            with self._lock:
+                self.samples_since_eject += 1
+
+    def note_stream_gap(self, seconds: float) -> None:
+        self.gap_window.observe(seconds)
+
+    def note_stream_stall(self, now: Optional[float] = None) -> None:
+        """The proxy's relay abandoned a wedged stream on this
+        replica (inter-chunk gap past the stall threshold despite
+        server keepalives)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self.stall_marks.append(now)
+            del self.stall_marks[:-16]
+
+    def recent_stalls(self, window_s: float = 30.0,
+                      now: Optional[float] = None) -> int:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return sum(1 for t in self.stall_marks
+                       if now - t <= window_s)
+
+    def latency_p50(self, *, min_samples: int = 5,
+                    last: Optional[int] = None) -> Optional[float]:
+        """Median observed latency (None below ``min_samples`` — a
+        replica with no traffic yet must not read as an outlier in
+        either direction)."""
+        if len(self.latency_window) < min_samples:
+            return None
+        return self.latency_window.quantile(0.5, last=last)
+
+    def soft_eject(self, now: Optional[float] = None) -> bool:
+        """Brownout soft-eject: stop normal picks, keep the shadow
+        trickle. Returns True on the transition."""
+        with self._lock:
+            if self.soft_ejected:
+                return False
+            self.soft_ejected = True
+            self.soft_ejected_at = (time.monotonic() if now is None
+                                    else now)
+            self.samples_since_eject = 0
+            self._next_shadow_at = 0.0
+        _C_TRANSITIONS.labels("soft_eject").inc()
+        return True
+
+    def soft_readmit(self) -> bool:
+        with self._lock:
+            if not self.soft_ejected:
+                return False
+            self.soft_ejected = False
+            self.soft_ejected_at = None
+            self.stall_marks.clear()
+        _C_TRANSITIONS.labels("soft_readmit").inc()
+        return True
+
+    def shadow_due(self, interval_s: float,
+                   now: Optional[float] = None) -> bool:
+        """Paced shadow-pick gate: at most one shadow pick per
+        ``interval_s`` per replica. The pick that lands here is the
+        recovery probe — its latency sample is what can earn the
+        soft-readmit."""
+        if not self.soft_ejected:
+            return False
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if now < self._next_shadow_at:
+                return False
+            self._next_shadow_at = now + interval_s
+            return True
 
     def resident_models(self) -> List[str]:
         """Models resident on the replica per its last healthz (the
@@ -312,8 +438,12 @@ class Endpoint:
                 "role": self.effective_role(),
                 "shard_count": self.shard_count(),
                 "health": self.health,
+                "soft_ejected": self.soft_ejected,
                 "inflight": self.inflight,
                 "probe_failures": self.probe_failures,
+                "latency_p50_ms": (
+                    None if (p50 := self.latency_window.quantile(0.5))
+                    is None else round(p50 * 1e3, 3)),
                 "saturation_score_ms": round(self.saturation_score(), 3),
                 "resident_models": sorted(self.saturation),
                 "breakers": {
@@ -418,6 +548,7 @@ class EndpointPool:
         # caches) and pod-IP churn would otherwise grow /metrics and
         # memory without bound.
         _G_ENDPOINT_HEALTH.remove_labels(address)
+        _G_ENDPOINT_BROWNOUT.remove_labels(address)
         _C_PROBE_FAILURES.remove_labels(address)
         if self.on_drop is not None:
             try:
@@ -571,15 +702,203 @@ def write_endpoints_file(path: str,
     os.replace(tmp, path)
 
 
+class BrownoutPolicy:
+    """Gray-failure outlier detection over the pool (ISSUE 13).
+
+    The prober's liveness probes can't see a brownout: the replica
+    answers ``/healthz`` in microseconds and serves requests 10× slow
+    (or stalls streams mid-decode). This policy reads the signals the
+    proxy's own route path records on each :class:`Endpoint` — rolling
+    request latency and stream-stall verdicts — and SOFT-ejects a
+    replica whose p50 is a k-MAD outlier against the pool's, or that
+    has stalled streams recently. Distinct from the prober's hard
+    eject:
+
+    - a soft-ejected replica still receives a paced trickle of
+      **shadow picks** (``shadow_interval_s``), whose latency samples
+      are the recovery evidence — readmission needs
+      ``recover_samples`` post-eject samples whose median is back
+      inside ``recover_ratio`` × the eject threshold;
+    - ejection is **vetoed** when it would leave fewer than
+      ``min_pool_fraction`` of the routable pool taking normal picks
+      (degradation must stay graceful: a slow fleet beats a 503ing
+      one), counted in the transitions metric as ``soft_eject_veto``.
+
+    Evaluation is cheap (a handful of medians) and runs once per
+    prober cycle, so "soft-eject within 2 probe windows" is the
+    detection-latency contract.
+    """
+
+    def __init__(self, *, k: float = 4.0, min_samples: int = 5,
+                 mad_floor_s: float = 0.005, min_ratio: float = 2.0,
+                 min_pool_fraction: float = 0.5,
+                 shadow_interval_s: float = 2.0,
+                 stall_strikes: int = 2,
+                 recover_samples: int = 3,
+                 recover_ratio: float = 0.75,
+                 stall_quiet_s: float = 30.0):
+        self.k = k
+        self.min_samples = min_samples
+        self.mad_floor_s = mad_floor_s
+        self.min_ratio = min_ratio
+        self.min_pool_fraction = min_pool_fraction
+        self.shadow_interval_s = shadow_interval_s
+        self.stall_strikes = stall_strikes
+        self.recover_samples = recover_samples
+        self.recover_ratio = recover_ratio
+        #: Stall-only convictions readmit after this much stall-free
+        #: quiet since eject (matches the recent_stalls window) —
+        #: latency shadow samples can't prove a wedged stream healed,
+        #: and a streaming-only fleet never produces them anyway.
+        self.stall_quiet_s = stall_quiet_s
+
+    @staticmethod
+    def _median(values: List[float]) -> float:
+        values = sorted(values)
+        n = len(values)
+        mid = n // 2
+        return (values[mid] if n % 2
+                else (values[mid - 1] + values[mid]) / 2.0)
+
+    def threshold_s(self, pool: EndpointPool) -> Optional[float]:
+        """The pool-relative outlier bar: median(p50) + k × MAD
+        (MAD floored — a microsecond-uniform pool must not convict
+        nanosecond noise), and never below ``min_ratio`` × the pool
+        median (a replica twice as slow as an already-slow pool is
+        load skew, not a brownout)."""
+        p50s = [p for ep in pool.endpoints()
+                if ep.routable()
+                and (p := ep.latency_p50(
+                    min_samples=self.min_samples)) is not None]
+        if len(p50s) < 2:
+            return None
+        med = self._median(p50s)
+        mad = self._median([abs(p - med) for p in p50s])
+        return max(med + self.k * max(mad, self.mad_floor_s),
+                   med * self.min_ratio)
+
+    def evaluate(self, pool: EndpointPool) -> None:
+        """One sweep: convict new outliers (floor-vetoed), readmit
+        recovered ones. Called from the prober after each probe
+        cycle."""
+        members = [ep for ep in pool.endpoints() if ep.routable()]
+        if not members:
+            return
+        threshold = self.threshold_s(pool)
+        bright = sum(1 for ep in members if not ep.soft_ejected)
+        floor = max(1, int(-(-len(members) * self.min_pool_fraction
+                            // 1)))  # ceil
+        for ep in members:
+            if ep.soft_ejected:
+                self._maybe_readmit(ep, threshold)
+                continue
+            p50 = ep.latency_p50(min_samples=self.min_samples)
+            slow = (threshold is not None and p50 is not None
+                    and p50 > threshold)
+            stalled = ep.recent_stalls() >= self.stall_strikes
+            if not (slow or stalled):
+                continue
+            if bright - 1 < floor:
+                # Vetoed: ejecting would hollow out the pool below
+                # the graceful-degradation floor. Keep routing (the
+                # whole fleet is slow — that's capacity, not a gray
+                # replica) but record the verdict.
+                _C_TRANSITIONS.labels("soft_eject_veto").inc()
+                continue
+            if ep.soft_eject():
+                bright -= 1
+                # The conviction's reason and bar, frozen for the
+                # recovery check (see _maybe_readmit).
+                ep.eject_was_slow = slow
+                ep.eject_threshold_s = threshold if slow else None
+                logger.warning(
+                    "endpoint %s soft-ejected (brownout): p50=%s "
+                    "threshold=%s stalls=%d", ep.address,
+                    f"{p50 * 1e3:.1f}ms" if p50 else None,
+                    f"{threshold * 1e3:.1f}ms" if threshold else None,
+                    ep.recent_stalls())
+                TRACER.record(
+                    "endpoint_soft_eject", "router", time.monotonic(),
+                    0.0, {"endpoint": ep.address,
+                          "p50_ms": round((p50 or 0.0) * 1e3, 1),
+                          "stalls": ep.recent_stalls()})
+
+    def _maybe_readmit(self, ep: Endpoint,
+                       threshold: Optional[float]) -> None:
+        if ep.recent_stalls() > 0:
+            return  # stall evidence must fully decay before readmit
+        if not ep.eject_was_slow:
+            # Stall-only conviction: recovery is stall SILENCE, not a
+            # latency ratio — latency samples can't speak to wedged
+            # streams, and a streaming-only fleet never produces the
+            # unary shadow samples the latency check waits on (the
+            # replica would stay soft-ejected forever). A full stall
+            # window of quiet since eject readmits; if it still
+            # wedges streams, two fresh strikes re-convict it and any
+            # stalled stream resumes on a peer — the client impact of
+            # a wrong readmit is bounded by the resume machinery.
+            now = time.monotonic()
+            if (ep.soft_ejected_at is not None
+                    and now - ep.soft_ejected_at >= self.stall_quiet_s
+                    and ep.soft_readmit()):
+                logger.info("endpoint %s soft-readmitted (stall-free "
+                            "for %.0fs)", ep.address,
+                            now - (ep.soft_ejected_at or now))
+                TRACER.record(
+                    "endpoint_soft_readmit", "router", now, 0.0,
+                    {"endpoint": ep.address, "reason": "stall_quiet"})
+            return
+        if ep.samples_since_eject < self.recover_samples:
+            return
+        recent = ep.latency_p50(min_samples=self.recover_samples,
+                                last=ep.samples_since_eject)
+        if recent is None:
+            return
+        # With no pool threshold (pool too small/quiet to judge —
+        # the threshold needs 2 bright replicas, so a 2-member pool
+        # with one ejected can never re-derive it), judge against the
+        # bar that CONVICTED the replica, frozen at eject time. The
+        # replica's own rolling window is not a usable bar: it
+        # converges to the recent shadow samples, and recent <= own-
+        # p50 × ratio would become unsatisfiable once the window
+        # fills post-eject.
+        bar = threshold if threshold is not None else ep.eject_threshold_s
+        if bar is not None and recent <= bar * self.recover_ratio:
+            if ep.soft_readmit():
+                logger.info("endpoint %s soft-readmitted (recovered: "
+                            "recent p50 %.1fms)", ep.address,
+                            recent * 1e3)
+                TRACER.record(
+                    "endpoint_soft_readmit", "router",
+                    time.monotonic(), 0.0,
+                    {"endpoint": ep.address,
+                     "recent_p50_ms": round(recent * 1e3, 1)})
+
+
 def scrape_healthz(address: str, timeout_s: float = 2.0
                    ) -> Dict[str, Any]:
     """One bounded, synchronous /healthz scrape (the prober's async
     path uses tornado; the autoscaler thread uses this). Raises on
     transport failure or non-200; returns the parsed schema dict."""
     url = address if "://" in address else f"http://{address}"
+    # urllib's timeout is per-socket-op: a slow-drip /healthz (one
+    # byte per op) could stretch a single scrape far past timeout_s
+    # and pin its probe thread across cycles. Chunked read under a
+    # wall-clock deadline bounds the whole scrape.
+    deadline = time.monotonic() + 2.0 * timeout_s
     with urllib.request.urlopen(f"{url}/healthz",
                                 timeout=timeout_s) as resp:
-        return json.loads(resp.read())
+        chunks = []
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"healthz scrape of {address} dripped past "
+                    f"{2.0 * timeout_s:.1f}s")
+            chunk = resp.read(65536)
+            if not chunk:
+                break
+            chunks.append(chunk)
+        return json.loads(b"".join(chunks))
 
 
 class HealthProber:
@@ -598,13 +917,17 @@ class HealthProber:
                  timeout_s: float = 2.0, eject_after: int = 3,
                  source: Optional[Any] = None,
                  fetch: Optional[Callable[[Endpoint],
-                                          Dict[str, Any]]] = None):
+                                          Dict[str, Any]]] = None,
+                 brownout: Optional[BrownoutPolicy] = None):
         self.pool = pool
         self.interval_s = interval_s
         self.timeout_s = timeout_s
         self.eject_after = eject_after
         self.source = source
         self._fetch = fetch
+        #: Gray-failure policy evaluated after each probe cycle (the
+        #: cycle paces detection: "soft-eject within 2 windows").
+        self.brownout = brownout
         self._callback: Any = None
 
     def observe(self, ep: Endpoint,
@@ -633,16 +956,56 @@ class HealthProber:
     def probe_all_sync(self) -> None:
         """One full probe cycle over injected/sync fetch — tests and
         the autoscaler thread. The default fetch is the bounded
-        urllib scrape."""
+        urllib scrape.
+
+        Probes run CONCURRENTLY with a per-probe deadline (ISSUE 13
+        satellite): a hung-socket /healthz — the classic gray failure
+        that ACCEPTS and never answers — used to serialize the cycle
+        (each dead member cost timeout_s before the next probe even
+        started, delaying every ejection and readmission behind it)
+        and, because urllib's timeout is per-socket-op, a slow-drip
+        response could stretch one probe far past timeout_s. Now the
+        whole cycle costs one bounded window, and a probe that
+        outlives its deadline counts as a strike IMMEDIATELY."""
+        import concurrent.futures
+
         self.sync_membership()
         fetch = self._fetch or (
             lambda ep: scrape_healthz(ep.address, self.timeout_s))
-        for ep in self.pool.endpoints():
+        members = self.pool.endpoints()
+        if not members:
+            return
+        deadline = time.monotonic() + self.timeout_s
+        # One worker per member, and a FRESH executor per cycle: with
+        # a shared cycle deadline, a capped or reused pool would
+        # leave probes queued behind wedged workers to time out
+        # without ever starting — false strikes that could hard-eject
+        # the healthy rest of a large fleet. The per-cycle thread
+        # churn is the price of that isolation; scrape_healthz bounds
+        # each thread's lifetime to ~2× timeout_s (chunked read under
+        # a wall-clock deadline), so wedged threads can't stack
+        # across more than a couple of cycles.
+        executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=len(members),
+            thread_name_prefix="healthprobe")
+        futures = [(ep, executor.submit(fetch, ep)) for ep in members]
+        for ep, future in futures:
+            payload: Optional[Dict[str, Any]] = None
             try:
-                payload: Optional[Dict[str, Any]] = fetch(ep)
-            except Exception:  # noqa: BLE001 — any failure = bad probe
+                payload = future.result(timeout=max(
+                    0.001, deadline - time.monotonic()))
+            except Exception:  # noqa: BLE001 — timeout or probe
+                # failure: either way a strike, recorded NOW (the
+                # worker thread may still be stuck on its socket; it
+                # finishes in the background on its own socket
+                # timeout — wait=False below so a wedged probe can
+                # never re-serialize the cycle it was evicted from).
                 payload = None
+                future.cancel()
             self.observe(ep, payload)
+        executor.shutdown(wait=False)
+        if self.brownout is not None:
+            self.brownout.evaluate(self.pool)
 
     async def probe_all(self) -> None:
         """One probe cycle on the IOLoop: all members CONCURRENTLY
@@ -673,6 +1036,8 @@ class HealthProber:
         members = self.pool.endpoints()
         if members:
             await asyncio.gather(*(probe_one(ep) for ep in members))
+        if self.brownout is not None:
+            self.brownout.evaluate(self.pool)
 
     def start(self) -> None:
         """Attach the periodic probe loop to the CURRENT IOLoop."""

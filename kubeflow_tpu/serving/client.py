@@ -119,17 +119,27 @@ def stream_generate(server: str, model: str, instances, *,
                     timeout: float = 60.0,
                     deadline_ms: float | None = None,
                     max_new_tokens: int | None = None,
-                    request_id: str | None = None):
+                    request_id: str | None = None,
+                    emit_resume: bool = False):
     """Consume a streaming ``:generate`` over SSE (the proxy or the
     model server's REST port — same wire either way). Yields
     ``(event, data)`` pairs as they arrive: ``token`` events
     ({row, index, token}), per-row ``error`` events, and the terminal
     ``done`` ({tokens}); returns after ``done``. ``timeout`` bounds
-    each read, not the whole stream (tokens keep the connection
-    demonstrably alive)."""
+    each read, not the whole stream — and because the server (and the
+    pooled proxy's relay) emit ``: keepalive`` comment frames during
+    long inter-token gaps, a read timing out now means a WEDGED
+    stream, not a slow decode; pick ``timeout`` a few multiples of
+    the keepalive cadence (default 2 s), not of the decode time.
+    ``emit_resume=True`` additionally yields the engine's per-row
+    ``resume`` events ({row, version, blob}) — the mid-stream
+    decode-resume context the proxy normally consumes itself
+    (docs/resilience.md); useful for tooling that replays streams."""
     from kubeflow_tpu.serving import wire
 
     body: dict = {"instances": instances, "stream": True}
+    if emit_resume:
+        body["emit_resume"] = True
     if max_new_tokens is not None:
         body["max_new_tokens"] = int(max_new_tokens)
     headers = {"Content-Type": "application/json",

@@ -135,6 +135,19 @@ _P_SPLIT_GENERATE = obs_metrics.Counter(
     "kft_router_split_generate_total",
     "Generate requests served by the prefill→decode KV-handoff "
     "path, by outcome (split | fallback)", ("outcome",))
+# Gray-failure resilience surface (ISSUE 13): hedges, mid-stream
+# resumes, and the brownout shadow trickle.
+_P_HEDGES = obs_metrics.Counter(
+    "kft_router_hedges_total",
+    "Budget-aware hedged :generate attempts by outcome (fired | won "
+    "| lost | suppressed)", ("outcome",))
+_P_RESUMES = obs_metrics.Counter(
+    "kft_router_stream_resumes_total",
+    "Mid-stream decode resume attempts by outcome (resumed | failed "
+    "| unresumable)", ("outcome",))
+_P_SHADOW_PICKS = obs_metrics.Counter(
+    "kft_router_shadow_picks_total",
+    "Paced recovery picks routed to brownout-soft-ejected replicas")
 
 
 class CircuitOpenError(Exception):
@@ -185,6 +198,26 @@ STREAM_TIMEOUT_S = 300.0
 #: token frames are ~50 bytes, so this is thousands of tokens of
 #: slack, yet bounds per-connection proxy memory.
 STREAM_BACKLOG_LIMIT = 256 * 1024
+
+
+#: Inter-chunk gap past which a proxied token stream is judged WEDGED
+#: and the relay abandons the upstream (then resumes on a peer when it
+#: can). Meaningful because the server emits ``: keepalive`` comments
+#: every couple of seconds on healthy-but-slow decodes — a gap several
+#: keepalives long is a hung socket, not a slow model.
+STREAM_STALL_TIMEOUT_S = 15.0
+
+#: Budget-aware hedging (ISSUE 13): a unary :generate fires a hedge
+#: to a second replica only when the remaining deadline budget exceeds
+#: HEDGE_FACTOR × the rolling p95 latency (the hedge delay), so a
+#: hedge can always still finish; at least HEDGE_MIN_SAMPLES latency
+#: observations are required before hedging wakes up at all.
+HEDGE_FACTOR = 4.0
+HEDGE_MIN_SAMPLES = 5
+
+#: Pacing of shadow picks to brownout-soft-ejected replicas: at most
+#: one per replica per interval — the recovery-detection trickle.
+SHADOW_INTERVAL_S = 2.0
 
 
 class _ClientStalledError(Exception):
@@ -263,13 +296,41 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
     def pick_endpoint(self, tried: Sequence[Endpoint],
                       model: Optional[str] = None,
                       phase: Optional[str] = None,
-                      prefix_key: Optional[str] = None
+                      prefix_key: Optional[str] = None,
+                      allow_shadow: bool = False
                       ) -> Optional[Endpoint]:
         """One routing decision: balancer policy over the eligible
         (not-yet-tried, not-ejected, breaker-admitting) members.
         ``phase`` is the request's dominant serving phase — only
         role-aware policies act on it; ``prefix_key`` the normalized
-        prompt-prefix hash — only prefix-affinity policies do."""
+        prompt-prefix hash — only prefix-affinity policies do.
+        ``allow_shadow`` lets this pick land on a brownout-soft-
+        ejected replica when one's paced shadow slot is due (the
+        recovery probe; unary first placements only — a failover or a
+        committed stream must never walk into a known brownout)."""
+        if allow_shadow and not tried:
+            interval = self.application.settings.get(
+                "shadow_interval_s", SHADOW_INTERVAL_S)
+            for ep in self.pool.endpoints():
+                # The shadow fast path skips the balancer, so it must
+                # apply the suitability checks the balancer would
+                # have: role match, and (when the replica's healthz
+                # names its resident models) model residency — a
+                # recovery probe must never route a request to a
+                # replica that can't serve it. Suitability runs
+                # BEFORE shadow_due: that call consumes the paced
+                # slot, and an unsuitable request burning it would
+                # starve recovery detection under an unfavorable
+                # traffic mix.
+                if (ep.routable() and ep.soft_ejected
+                        and ep.rest_breaker.state != "open"
+                        and ep.serves_phase(phase)
+                        and (model is None or not ep.saturation
+                             or model in ep.saturation)
+                        and ep.shadow_due(interval)):
+                    _P_SHADOW_PICKS.inc()
+                    _P_ROUTER_PICKS.labels(ep.address).inc()
+                    return ep
         candidates = eligible_endpoints(self.pool, exclude=tried)
         if not candidates:
             return None
@@ -394,7 +455,11 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
 
     async def route_with_failover(self, model: Optional[str],
                                   attempt, deadline=None,
-                                  phase=None, prefix_key=None) -> None:
+                                  phase=None, prefix_key=None,
+                                  allow_shadow=False,
+                                  record_latency=True,
+                                  hedge_sample=False,
+                                  pre_tried=None) -> None:
         """THE routing contract, shared by every proxied verb: pick a
         replica, run ``attempt(ep)`` (which raises _Handled once the
         client response is written), and on a transport-level failure
@@ -402,19 +467,41 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
         most 1 + retry_attempts placements, never with less than
         RETRY_BUDGET_FLOOR_S of deadline budget left. When every
         placement fails (or none exists) the transport error maps to
-        the client via write_backend_error."""
-        tried: List[Endpoint] = []
+        the client via write_backend_error.
+
+        ``pre_tried`` carries replicas a caller (the hedger) already
+        observed failing at the transport level, so the first classic
+        placement never re-dials a replica known down milliseconds
+        ago. ``hedge_sample`` gates which latencies feed the hedge
+        p95 window: only :generate observations may, or the window's
+        p95 would be priced off unrelated fast verbs and the hedge
+        delay would fire on every generate."""
+        tried: List[Endpoint] = list(pre_tried or ())
         last_exc: Optional[Exception] = None
         max_extra = max(0, self.retry_attempts)
         for attempt_i in range(1 + max_extra):
             ep = self.pick_endpoint(tried, model=model, phase=phase,
-                                    prefix_key=prefix_key)
+                                    prefix_key=prefix_key,
+                                    allow_shadow=allow_shadow)
             if ep is None:
                 break
             ep.inflight += 1
+            t0 = time.monotonic()
             try:
                 await attempt(ep)
             except _Handled:
+                if record_latency:
+                    # A served response (success OR app error) is a
+                    # latency sample — the brownout policy's evidence.
+                    # Streams skip this (a long decode is not slow
+                    # service); they feed the gap tracker instead.
+                    latency = time.monotonic() - t0
+                    ep.note_latency(latency)
+                    if hedge_sample:
+                        window = self.application.settings.get(
+                            "hedge_latency")
+                        if window is not None:
+                            window.observe(latency)
                 return
             except (CircuitOpenError, BackendTimeoutError,
                     BackendDownError) as e:
@@ -457,6 +544,295 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
 class _Handled(Exception):
     """Internal: the attempt wrote the client response (success OR
     app-level error) — stop the failover loop without retrying."""
+
+
+class _SseParser:
+    """Incremental SSE frame splitter over raw upstream byte chunks.
+
+    ``feed(chunk)`` returns complete frames as ``(raw, event, data)``
+    tuples: ``raw`` the frame's exact bytes (so the fast path relays
+    verbatim), ``event`` the event name (``None`` for comment-only
+    frames — the server's ``: keepalive`` heartbeats), ``data`` the
+    JSON-decoded payload (``None`` for comments or non-JSON data).
+    Partial frames stay buffered until their terminating blank line
+    arrives; both ``\\n\\n`` and ``\\r\\n\\r\\n`` terminators are
+    accepted."""
+
+    def __init__(self) -> None:
+        self._buf = b""
+
+    def feed(self, chunk: bytes):
+        self._buf += chunk
+        frames = []
+        while True:
+            lf = self._buf.find(b"\n\n")
+            crlf = self._buf.find(b"\r\n\r\n")
+            if lf < 0 and crlf < 0:
+                break
+            if crlf >= 0 and (lf < 0 or crlf < lf):
+                end = crlf + 4
+            else:
+                end = lf + 2
+            raw, self._buf = self._buf[:end], self._buf[end:]
+            frames.append(self._parse(raw))
+        return frames
+
+    @staticmethod
+    def _parse(raw: bytes):
+        event = None
+        data_lines: List[str] = []
+        for line in raw.decode("utf-8", errors="replace").splitlines():
+            if not line or line.startswith(":"):
+                continue
+            key, _, value = line.partition(":")
+            value = value[1:] if value.startswith(" ") else value
+            if key == "event":
+                event = value
+            elif key == "data":
+                data_lines.append(value)
+        if not data_lines:
+            return raw, event, None
+        try:
+            data = json.loads("\n".join(data_lines))
+        except ValueError:
+            data = None
+        return raw, event or "message", data
+
+
+class _StreamRelay:
+    """The client-side half of a resumable SSE relay (ISSUE 13).
+
+    One relay spans every upstream leg of a proxied token stream. It
+    forwards frames as they arrive (bounded un-acked backlog, never a
+    full-body buffer), while tracking the per-row state that makes a
+    mid-stream death survivable:
+
+    - ``resume`` events (the engine's per-row resume blobs, emitted
+      because the proxy asked with ``emit_resume``) are STASHED, not
+      forwarded — unless the client itself asked for them;
+    - ``token`` events accumulate each row's emitted ids. On resumed
+      legs the peer's indices restart at 0, so frames are rewritten
+      to continue the client-visible numbering;
+    - the terminal ``done`` frame is STITCHED: each row's array
+      becomes tokens-relayed-in-earlier-legs + the final leg's own
+      array (which carries the continuation plus the engine's
+      latched-EOS padding), so the client's total sequence is
+      byte-identical to an uninterrupted decode;
+    - rows that already terminated (per-row ``error``) are dropped
+      from later legs' output — the peer replays every row to keep
+      numbering aligned, but the client never sees a row twice.
+    """
+
+    def __init__(self, handler: "ProxyHandler",
+                 rows: Optional[int] = None,
+                 client_resume: bool = False):
+        from kubeflow_tpu.serving import wire
+
+        self._wire = wire
+        self._handler = handler
+        self._client_resume = client_resume
+        self.started = False
+        self.client_gone = False
+        self.done_seen = False
+        self.error_status: Optional[int] = None
+        self.legs = 0
+        self._backlog = 0
+        self._last_write = time.monotonic()
+        self._rows: Dict[int, Dict[str, Any]] = {}
+        for r in range(rows or 0):
+            self._row(r)
+
+    def _row(self, r: int) -> Dict[str, Any]:
+        state = self._rows.get(r)
+        if state is None:
+            state = {"blob": None, "version": None, "since": [],
+                     "total": [], "prior": [], "finished": False}
+            self._rows[r] = state
+        return state
+
+    # -- downstream writes ------------------------------------------------
+
+    def _write(self, data: bytes) -> None:
+        handler = self._handler
+        if not self.started:
+            self.started = True
+            handler.set_status(200)
+            handler.set_header("Content-Type",
+                               self._wire.SSE_CONTENT_TYPE)
+            handler.set_header("Cache-Control", "no-cache")
+        # flush() can't be awaited from a streaming_callback — bound
+        # the un-acked write backlog instead: past the cap the CLIENT
+        # is the slow party and the relay aborts rather than buffering
+        # the whole decode.
+        self._backlog += len(data)
+        if self._backlog > STREAM_BACKLOG_LIMIT:
+            raise _ClientStalledError(
+                f"client {self._backlog} bytes behind")
+        handler.write(data)
+        fut = handler.flush()
+        fut.add_done_callback(
+            lambda _f, n=len(data): self._ack(n))
+        self._last_write = time.monotonic()
+
+    def _ack(self, n: int) -> None:
+        self._backlog -= n
+
+    def idle_s(self, now: Optional[float] = None) -> float:
+        return (time.monotonic() if now is None else now) \
+            - self._last_write
+
+    def write_keepalive(self) -> None:
+        """Proxy-minted ``: keepalive`` comment (ISSUE 13 satellite):
+        emitted by the relay's watchdog during long inter-token gaps
+        so the CLIENT's intermediaries can tell slow from wedged even
+        when the upstream (an old build, a wedged socket) is not
+        heartbeating itself."""
+        if not self.started or self.client_gone:
+            return
+        try:
+            self._write(self._wire.SSE_KEEPALIVE)
+        except (tornado.iostream.StreamClosedError,
+                _ClientStalledError):
+            self.client_gone = True
+
+    def passthrough_error(self, status: int, chunk: bytes) -> None:
+        """Relay a non-200 upstream response (leg 1 only) verbatim —
+        the upstream's own app-level error is the client's answer."""
+        handler = self._handler
+        if self.error_status is None:
+            self.error_status = status
+            self.started = True
+            handler.set_status(status)
+            handler.set_header("Content-Type", "application/json")
+        handler.write(chunk)
+
+    # -- frame handling ---------------------------------------------------
+
+    def handle_frame(self, raw: bytes, event: Optional[str],
+                     data: Any) -> None:
+        if event == "resume" and isinstance(data, dict) \
+                and "row" in data:
+            state = self._row(int(data["row"]))
+            state["blob"] = data.get("blob")
+            state["version"] = data.get("version")
+            state["since"] = []
+            if self._client_resume:
+                self._write(raw)
+            return
+        if event == "token" and isinstance(data, dict) \
+                and "row" in data:
+            r = int(data["row"])
+            state = self._row(r)
+            if state["finished"]:
+                return  # replayed row the client saw terminate
+            token = data.get("token")
+            index = len(state["total"])
+            state["since"].append(token)
+            state["total"].append(token)
+            if self.legs == 0 and data.get("index") == index:
+                self._write(raw)
+            else:
+                # Resumed leg: the peer numbers its continuation from
+                # 0; the client-visible index keeps counting.
+                self._write(self._wire.format_sse_event(
+                    {"row": r, "index": index, "token": token},
+                    event="token"))
+            return
+        if event == "error":
+            if isinstance(data, dict) and "row" in data:
+                state = self._row(int(data["row"]))
+                if state["finished"]:
+                    return
+                state["finished"] = True
+            self._write(raw)
+            return
+        if event == "done":
+            self.done_seen = True
+            if self.legs > 0 and isinstance(data, dict):
+                self._write(self._stitched_done(data))
+            else:
+                self._write(raw)
+            return
+        # Comments (upstream keepalives) and unknown events relay
+        # verbatim — the proxy is a relay, not a censor.
+        self._write(raw)
+
+    def _stitched_done(self, data: Dict[str, Any]) -> bytes:
+        tokens = data.get("tokens") or []
+        out = []
+        for r, leg in enumerate(tokens):
+            state = self._rows.get(r)
+            if state is None:
+                out.append(leg)
+            elif state["finished"] or leg is None:
+                # A row that terminated with an in-band error stays
+                # null, exactly as an uninterrupted stream reports it.
+                out.append(None)
+            else:
+                out.append(list(state["prior"]) + list(leg))
+        data = dict(data)
+        data["tokens"] = out
+        return self._wire.format_sse_event(data, event="done")
+
+    # -- resume bookkeeping -----------------------------------------------
+
+    def begin_leg(self) -> None:
+        """A resume leg is about to run: snapshot what the client has
+        already seen per row (the final ``done`` stitches the new
+        leg's arrays onto these)."""
+        self.legs += 1
+        for state in self._rows.values():
+            state["prior"] = list(state["total"])
+
+    def resumable(self) -> bool:
+        """Can a peer carry this stream on? Needs the full row set
+        known with a resume blob for every row (rows replay in
+        positional alignment), a live client, and no terminal frame
+        already delivered."""
+        if self.client_gone or self.done_seen or not self._rows:
+            return False
+        return all(state["blob"] is not None
+                   for state in self._rows.values())
+
+    def resume_body(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        rows = sorted(self._rows)
+        return {
+            "resume": [self._rows[r]["blob"] for r in rows],
+            "resume_emitted": [list(self._rows[r]["since"])
+                               for r in rows],
+            "stream": True, "emit_resume": True,
+            "signature_name": body.get("signature_name"),
+        }
+
+    def resume_path(self, name: str, version: Optional[str]) -> str:
+        v = version
+        if not v:
+            versions = {state["version"]
+                        for state in self._rows.values()
+                        if state["version"]}
+            if len(versions) == 1:
+                # Pin the peer to the version whose sampling schedule
+                # the blobs carry (rolling updates: the token is
+                # version-bound).
+                v = versions.pop()
+        path = f"/v1/models/{name}"
+        if v:
+            path += f"/versions/{v}"
+        return path + ":generate"
+
+    def total_emitted(self) -> int:
+        return sum(len(state["total"])
+                   for state in self._rows.values())
+
+    def finish(self) -> None:
+        try:
+            if not self.started:
+                self._handler.set_status(200)
+                self._handler.set_header(
+                    "Content-Type", self._wire.SSE_CONTENT_TYPE)
+            self._handler.finish()
+        except Exception:  # noqa: BLE001 — client already gone
+            pass
 
 
 class InferProxyHandler(ProxyHandler):
@@ -680,6 +1056,257 @@ class InferProxyHandler(ProxyHandler):
         self.write_json({"predictions": payload.get("predictions", [])})
         raise _Handled()
 
+    @staticmethod
+    def _addr_parts(ep: Endpoint):
+        host = _host_of(ep.address)
+        return host, int(ep.address.rsplit(":", 1)[-1])
+
+    async def _raw_unary_fetch(self, ep: Endpoint, path: str,
+                               payload: bytes,
+                               deadline: Optional[float],
+                               box: Dict[str, Any]):
+        """One unary POST over a raw, CLOSABLE connection
+        (tornado.tcpclient). AsyncHTTPClient gives no handle to abort
+        an in-flight request, and hedging is only honest if the LOSER
+        is provably cancelled — closing this socket fires the
+        server's connection-close handler, which cancels the engine
+        decode at the next slice boundary (white-box visible in
+        engine stats). Returns ``(status, headers, body)``; breaker
+        bookkeeping mirrors ``_rest_fetch``. ``box['stream']``
+        exposes the live socket so the hedge orchestrator can close
+        a loser mid-flight."""
+        import asyncio
+
+        from tornado.tcpclient import TCPClient
+
+        breaker = ep.rest_breaker
+        if not breaker.allow():
+            _P_RETRY_AFTER.labels("rest").inc()
+            raise CircuitOpenError(breaker.retry_after_s())
+        host, port = self._addr_parts(ep)
+        timeout = self.rpc_timeout
+        remaining = overload.remaining_s(deadline)
+        if remaining is not None:
+            timeout = min(timeout, max(0.001, remaining))
+        headers = {"Host": f"{host}:{port}",
+                   "Content-Type": "application/json",
+                   "Content-Length": str(len(payload)),
+                   "Connection": "close"}
+        if remaining is not None:
+            headers[overload.DEADLINE_HEADER] = str(
+                max(1, int(remaining * 1000)))
+        ctx = getattr(self, "_obs_ctx", None)
+        if ctx is not None:
+            headers.update(ctx.headers())
+        request = (f"POST {path} HTTP/1.1\r\n" + "".join(
+            f"{k}: {v}\r\n" for k, v in headers.items())
+            + "\r\n").encode("latin-1") + payload
+
+        async def talk():
+            stream = await TCPClient().connect(host, port)
+            box["stream"] = stream
+            await stream.write(request)
+            head = await stream.read_until(b"\r\n\r\n",
+                                           max_bytes=65536)
+            status_line, *header_lines = head.decode(
+                "latin-1").split("\r\n")
+            parts = status_line.split()
+            status = (int(parts[1]) if len(parts) >= 2
+                      and parts[1].isdigit() else 502)
+            resp_headers: Dict[str, str] = {}
+            for line in header_lines:
+                if ":" in line:
+                    k, v = line.split(":", 1)
+                    resp_headers[k.strip().lower()] = v.strip()
+            n = resp_headers.get("content-length")
+            if n is not None and n.isdigit():
+                data = await stream.read_bytes(int(n))
+            else:  # Connection: close bounds the read
+                data = await stream.read_until_close()
+            return status, resp_headers, data
+
+        _P_UPSTREAM_REQUESTS.labels("rest").inc()
+        try:
+            result = await asyncio.wait_for(talk(), timeout)
+        except asyncio.TimeoutError:
+            self._close_box(box)
+            # The same breaker floor as _rest_fetch: a substantial
+            # hang indicts the backend, a tight budget expiring
+            # proves nothing.
+            if timeout >= min(self.rpc_timeout,
+                              BREAKER_TIMEOUT_FLOOR_S):
+                breaker.record_failure()
+                _P_UPSTREAM_FAILURES.labels("rest").inc()
+            raise BackendTimeoutError(
+                f"model server timed out after {timeout:.1f}s") \
+                from None
+        except Exception as e:  # noqa: BLE001 — transport failure
+            self._close_box(box)
+            breaker.record_failure()
+            _P_UPSTREAM_FAILURES.labels("rest").inc()
+            raise BackendDownError(str(e)) from None
+        self._close_box(box)
+        breaker.record_success()
+        return result
+
+    @staticmethod
+    def _close_box(box: Dict[str, Any]) -> None:
+        stream = box.pop("stream", None)
+        if stream is not None:
+            try:
+                stream.close()
+            except Exception:  # noqa: BLE001 — already closed
+                pass
+
+    async def _hedged_generate(self, name: str,
+                               version: Optional[str],
+                               instances: Any, body: Dict[str, Any],
+                               deadline: Optional[float],
+                               phase: Optional[str],
+                               prefix_key: Optional[str],
+                               failed_out: Optional[
+                                   List[Endpoint]] = None) -> bool:
+        """Budget-aware hedging for unary ``:generate`` (ISSUE 13):
+        when the remaining deadline budget exceeds ``HEDGE_FACTOR`` ×
+        the rolling p95, the request is placed normally and — if the
+        primary hasn't answered within the p95 hedge delay — a twin
+        fires on a second replica, first response wins, the loser's
+        connection is CLOSED (the server's close handler cancels its
+        engine decode). The :class:`~..overload.HedgeThrottle` caps
+        fired hedges per offered request, so a fleet-wide slowdown
+        can never double its own load. Returns True once the client
+        response is written; False = run the classic path (nothing
+        was written)."""
+        import asyncio
+
+        settings = self.application.settings
+        throttle = settings.get("hedge_throttle")
+        window = settings.get("hedge_latency")
+        if throttle is None or window is None or deadline is None:
+            return False
+        throttle.note_request()
+        if len(window) < HEDGE_MIN_SAMPLES:
+            return False
+        p95 = window.quantile(0.95)
+        remaining = overload.remaining_s(deadline)
+        if p95 is None or remaining is None \
+                or remaining <= HEDGE_FACTOR * max(p95, 1e-4):
+            return False
+        primary = self.pick_endpoint([], model=name, phase=phase,
+                                     prefix_key=prefix_key,
+                                     allow_shadow=True)
+        if primary is None:
+            return False
+        path = f"/v1/models/{name}"
+        if version:
+            path += f"/versions/{version}"
+        path += ":generate"
+        upstream: Dict[str, Any] = {
+            "instances": instances,
+            "signature_name": body.get("signature_name"),
+        }
+        if body.get("max_new_tokens") is not None:
+            upstream["max_new_tokens"] = body["max_new_tokens"]
+        payload = json.dumps(upstream).encode()
+
+        legs: Dict[Any, Any] = {}  # task -> (ep, box, started_at)
+
+        def spawn(ep: Endpoint):
+            box: Dict[str, Any] = {}
+            task = asyncio.ensure_future(
+                self._raw_unary_fetch(ep, path, payload, deadline,
+                                      box))
+            legs[task] = (ep, box, time.monotonic())
+            ep.inflight += 1
+            return task
+
+        hedged = False
+        winner = None
+        try:
+            spawn(primary)
+            done, _ = await asyncio.wait(
+                set(legs), timeout=min(p95, remaining))
+            if not done:
+                remaining = overload.remaining_s(deadline) or 0.0
+                hedge_ep = (self.pick_endpoint([primary], model=name,
+                                               phase=phase)
+                            if remaining > RETRY_BUDGET_FLOOR_S
+                            else None)
+                if hedge_ep is not None and throttle.try_acquire():
+                    hedged = True
+                    _P_HEDGES.labels("fired").inc()
+                    if TRACER.enabled:
+                        TRACER.record(
+                            "router_hedge", "router",
+                            time.monotonic(), 0.0,
+                            {"model": name,
+                             "primary": primary.address,
+                             "hedge": hedge_ep.address,
+                             "delay_ms": round(p95 * 1e3, 1)})
+                    spawn(hedge_ep)
+                elif hedge_ep is not None:
+                    _P_HEDGES.labels("suppressed").inc()
+            pending = {t for t in legs if not t.done()}
+            winner = next((t for t in legs if t.done()
+                           and t.exception() is None), None)
+            while pending and winner is None:
+                remaining = overload.remaining_s(deadline)
+                if remaining is not None and remaining <= 0:
+                    break
+                done, pending = await asyncio.wait(
+                    pending, timeout=remaining,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if not done:
+                    break  # budget exhausted with nobody answering
+                winner = next((t for t in done
+                               if t.exception() is None), None)
+            if winner is None:
+                # Every leg failed at the transport level (or the
+                # budget ran out). Nothing was written — the classic
+                # failover path still owns the request, and the legs'
+                # breaker bookkeeping already happened in the fetch.
+                # Hand the observed-dead replicas back so the classic
+                # path's first placement skips them.
+                if failed_out is not None:
+                    failed_out.extend(
+                        ep for task, (ep, _b, _t) in legs.items()
+                        if task.done() and not task.cancelled()
+                        and task.exception() is not None)
+                return False
+            win_ep, _, win_t0 = legs[winner]
+            if hedged:
+                _P_HEDGES.labels(
+                    "won" if win_ep is not primary else "lost").inc()
+            # The task is already done; await returns instantly.
+            status, resp_headers, raw = await winner
+            latency = time.monotonic() - win_t0
+            win_ep.note_latency(latency)
+            window.observe(latency)
+            retry_after = resp_headers.get("retry-after")
+            if retry_after:
+                self.set_header("Retry-After", retry_after)
+            self.set_status(status)
+            self.set_header("Content-Type", resp_headers.get(
+                "content-type", "application/json"))
+            self.finish(raw)
+            return True
+        finally:
+            for task, (ep, box, _t0) in legs.items():
+                ep.inflight -= 1
+                if task is winner:
+                    continue
+                # Loser cancellation: close the socket (the server's
+                # on_connection_close cancels the decode) and reap
+                # the task without letting its exception go unseen.
+                self._close_box(box)
+                task.cancel()
+                task.add_done_callback(self._reap_leg)
+
+    @staticmethod
+    def _reap_leg(task) -> None:
+        if not task.cancelled():
+            task.exception()
+
     async def _attempt_stream(self, ep: Endpoint, name: str,
                               version: Optional[str], instances: Any,
                               body: Dict[str, Any],
@@ -687,21 +1314,32 @@ class InferProxyHandler(ProxyHandler):
                               upstream_body: Optional[Dict[str, Any]]
                               = None,
                               split_fallback: bool = False) -> None:
-        """One streaming :generate attempt: relay the upstream SSE
-        response CHUNK BY CHUNK (write+flush per chunk, never a
-        full-body buffer) so time-to-first-token survives the router
-        hop. Failover stays available until the first upstream byte;
-        after that the stream is committed to this replica — a
-        mid-stream failure is reported in-band as an SSE error event,
-        because the tokens already relayed cannot be unsent."""
-        breaker = ep.rest_breaker
-        if not breaker.allow():
-            _P_RETRY_AFTER.labels("rest").inc()
-            raise CircuitOpenError(breaker.retry_after_s())
-        path = f"/v1/models/{name}"
-        if version:
-            path += f"/versions/{version}"
-        path += ":generate"
+        """One streaming :generate attempt, SSE-aware (ISSUE 13): the
+        relay parses the upstream event stream frame by frame —
+        forwarding tokens as they arrive (write+flush per frame, never
+        a full-body buffer, so time-to-first-token survives the router
+        hop), stashing the per-row ``resume`` blobs the engine emits,
+        and tracking what each row has seen. Failover stays available
+        until the first event reaches the client; after that a
+        mid-stream death (or a stall past the inter-chunk watchdog) no
+        longer surfaces as an in-band error — the relay REPLAYS the
+        prompt + tokens-emitted-so-far to a peer replica as a
+        continuation (the r15 right-layout seam makes the replay a
+        cheap tail-prefill on a warm peer) and stitches the streams,
+        so the client sees one uninterrupted, bitwise-identical token
+        sequence. The in-band ``error`` event remains only as the
+        last resort (unresumable model, no peer, budget gone)."""
+        from kubeflow_tpu.serving import faults
+
+        settings = self.application.settings
+        if instances is not None:
+            rows = len(instances)
+        elif upstream_body is not None:
+            rows = len(upstream_body.get("handoffs") or ()) or None
+        else:
+            rows = None
+        relay = _StreamRelay(self, rows=rows,
+                             client_resume=bool(body.get("emit_resume")))
         if upstream_body is None:
             upstream_body = {
                 "instances": instances, "stream": True,
@@ -709,6 +1347,118 @@ class InferProxyHandler(ProxyHandler):
             }
             if body.get("max_new_tokens") is not None:
                 upstream_body["max_new_tokens"] = body["max_new_tokens"]
+        if settings.get("resume_streams", True):
+            upstream_body = dict(upstream_body)
+            upstream_body["emit_resume"] = True
+        path = f"/v1/models/{name}"
+        if version:
+            path += f"/versions/{version}"
+        path += ":generate"
+        outcome = await self._stream_leg(
+            ep, path, upstream_body, deadline, relay,
+            abort_non_200=split_fallback)
+        if outcome == "rejected":
+            # Split hop 2 rejected the handoff (version skew, a
+            # replica mid-rollout): nothing reached the client yet, so
+            # the classic path can still serve this request.
+            raise _SplitHopError("decode hop rejected the handoff")
+        tried: List[Endpoint] = [ep]
+        attempted_resume = False
+        max_legs = 1 + max(1, self.retry_attempts)
+        while (outcome == "dead" and not relay.done_seen
+               and len(tried) < max_legs):
+            remaining = overload.remaining_s(deadline)
+            if remaining is not None and remaining <= RETRY_BUDGET_FLOOR_S:
+                break
+            if not relay.resumable():
+                _P_RESUMES.labels("unresumable").inc()
+                break
+            peer = self.pick_endpoint(tried, model=name,
+                                      phase="decode")
+            if peer is None:
+                break
+            resume_body = relay.resume_body(body)
+            rule = faults.match_request(settings, route="generate",
+                                        model=name, phase="resume")
+            if rule is not None and rule.corrupt_blob:
+                resume_body["resume"] = [
+                    faults.corrupt_b64_blob(b)
+                    for b in resume_body["resume"]]
+            attempted_resume = True
+            relay.begin_leg()
+            if TRACER.enabled:
+                TRACER.record(
+                    "router_stream_resume", "router", time.monotonic(),
+                    0.0, {"model": name, "from": tried[-1].address,
+                          "to": peer.address,
+                          "emitted": relay.total_emitted()})
+            peer.inflight += 1
+            try:
+                outcome = await self._stream_leg(
+                    peer, relay.resume_path(name, version), resume_body,
+                    deadline, relay, abort_non_200=True)
+            except (CircuitOpenError, BackendTimeoutError,
+                    BackendDownError):
+                outcome = "dead"  # this peer was no good; try another
+            finally:
+                peer.inflight -= 1
+            tried.append(peer)
+            if outcome == "rejected":
+                outcome = "dead"  # peer refused the blob; next peer
+        if outcome == "done" or relay.done_seen:
+            # done_seen with a "dead" outcome = the upstream died
+            # AFTER flushing the terminal frame: the client has the
+            # whole stream; nothing to resume.
+            if attempted_resume:
+                _P_RESUMES.labels("resumed").inc()
+            relay.finish()
+            raise _Handled()
+        # Exhausted: the stream is committed and nobody could carry it
+        # on — close in-band (the pre-resume contract).
+        if attempted_resume:
+            _P_RESUMES.labels("failed").inc()
+        self._obs_outcome = "stream_broken"
+        if not relay.started:
+            # Nothing reached the client (the upstream died after only
+            # resume metadata): a structured JSON error beats a
+            # headerless SSE fragment.
+            self.write_json(
+                {"error": "upstream stream died before any token",
+                 "code": "UNAVAILABLE"}, 502)
+            raise _Handled()
+        from kubeflow_tpu.serving import wire
+
+        try:
+            self.write(wire.format_sse_event(
+                {"error": "upstream disconnected mid-stream and the "
+                          "stream could not be resumed on a peer",
+                 "code": "UNAVAILABLE"}, event="error"))
+            self.finish()
+        except Exception:  # noqa: BLE001 — client also gone
+            pass
+        raise _Handled()
+
+    async def _stream_leg(self, ep: Endpoint, path: str,
+                          upstream_body: Dict[str, Any],
+                          deadline: Optional[float],
+                          relay: "_StreamRelay",
+                          abort_non_200: bool = False) -> str:
+        """One upstream hop of a (possibly multi-leg) relayed stream.
+        Returns ``done`` (upstream completed; the caller finishes the
+        client stream), ``dead`` (mid-stream failure or stall after
+        the client stream is committed — the caller may resume on a
+        peer), or ``rejected`` (non-200 before any client byte with
+        ``abort_non_200`` — split/resume hops fall back without
+        poisoning the client stream). Raises the classic transport
+        errors only while NOTHING has been written to the client, so
+        the shared failover loop keeps its contract; raises _Handled
+        when the DOWNSTREAM client is gone."""
+        import asyncio
+
+        breaker = ep.rest_breaker
+        if not breaker.allow():
+            _P_RETRY_AFTER.labels("rest").inc()
+            raise CircuitOpenError(breaker.retry_after_s())
         headers = dict(self._obs_ctx.headers()) \
             if getattr(self, "_obs_ctx", None) is not None else {}
         timeout = STREAM_TIMEOUT_S
@@ -717,74 +1467,102 @@ class InferProxyHandler(ProxyHandler):
             headers[overload.DEADLINE_HEADER] = str(
                 max(1, int(remaining * 1000)))
             timeout = min(timeout, max(0.001, remaining))
-        state = {"status": None, "ctype": None, "streamed": False,
-                 "client_gone": False, "backlog": 0}
+        stall_timeout = self.application.settings.get(
+            "stream_stall_timeout_s", STREAM_STALL_TIMEOUT_S)
+        parser = _SseParser()
+        state = {"status": None, "got_chunk": False,
+                 "last_activity": time.monotonic(),
+                 "abandoned": False, "rejected": False}
 
         def on_header(line: str) -> None:
+            state["last_activity"] = time.monotonic()
             line = line.strip()
             if line.startswith("HTTP/"):
                 parts = line.split()
                 if len(parts) >= 2 and parts[1].isdigit():
                     state["status"] = int(parts[1])
-            elif line.lower().startswith("content-type:"):
-                state["ctype"] = line.split(":", 1)[1].strip()
 
         def on_chunk(chunk: bytes) -> None:
-            if (split_fallback and not state["streamed"]
-                    and (state["status"] or 200) != 200):
-                # Split hop 2 rejected the handoff (version skew, a
-                # replica mid-rollout): nothing reached the client
-                # yet, so the classic path can still serve this
-                # request — abort the relay instead of committing
-                # the error to the stream.
-                state["split_abort"] = True
-                raise _SplitHopError(
-                    f"decode hop answered {state['status']}")
-            if not state["streamed"]:
-                state["streamed"] = True
-                self.set_status(state["status"] or 200)
-                self.set_header("Content-Type", state["ctype"]
-                                or "text/event-stream")
-                self.set_header("Cache-Control", "no-cache")
+            now = time.monotonic()
+            if state["got_chunk"]:
+                ep.note_stream_gap(now - state["last_activity"])
+            state["got_chunk"] = True
+            state["last_activity"] = now
+            if state["abandoned"]:
+                # The watchdog already moved on: kill this zombie
+                # fetch the moment it shows signs of life.
+                raise _ClientStalledError("leg abandoned")
+            status = state["status"] or 200
+            if status != 200:
+                if abort_non_200:
+                    # A non-200 leg never contributed a client byte
+                    # (handle_frame only runs at 200), so swallowing
+                    # is safe even mid-relay: a resume peer's 400
+                    # must NOT be spliced into the committed SSE
+                    # stream — mark the leg rejected so the caller
+                    # tries the next peer.
+                    state["rejected"] = True
+                    return  # swallow the error body; caller falls back
+                # First leg: the upstream's own error response relays
+                # verbatim (status + body), exactly as before.
+                relay.passthrough_error(status, chunk)
+                return
             try:
-                # streaming_callback is sync, so flush() can't be
-                # awaited — bound the un-acked write backlog instead:
-                # past the cap the CLIENT is the slow party, and the
-                # relay aborts rather than buffering the whole decode
-                # (many long streams × unbounded buffers = proxy OOM).
-                state["backlog"] += len(chunk)
-                if state["backlog"] > STREAM_BACKLOG_LIMIT:
-                    raise _ClientStalledError(
-                        f"client {state['backlog']} bytes behind")
-                self.write(chunk)
-                fut = self.flush()
-                fut.add_done_callback(
-                    lambda _f, n=len(chunk): state.__setitem__(
-                        "backlog", state["backlog"] - n))
+                for raw, event, data in parser.feed(chunk):
+                    relay.handle_frame(raw, event, data)
             except (tornado.iostream.StreamClosedError,
                     _ClientStalledError):
-                # The DOWNSTREAM side died/stalled — the upstream
-                # replica did nothing wrong, so this must not count
-                # against its breaker. Raising kills the fetch.
-                state["client_gone"] = True
+                relay.client_gone = True
                 raise
 
         _P_UPSTREAM_REQUESTS.labels("rest").inc()
         client = tornado.httpclient.AsyncHTTPClient()
-        try:
-            response = await client.fetch(
-                f"{ep.url}{path}", method="POST",
-                body=json.dumps(upstream_body), headers=headers,
-                request_timeout=timeout, raise_error=False,
-                streaming_callback=on_chunk, header_callback=on_header)
-            failure = response.error if response.code == 599 else None
-        except Exception as e:  # noqa: BLE001 — transport failure
-            response, failure = None, e
-        if state.get("split_abort"):
-            # Our own abort, not the upstream's fault: no breaker
-            # penalty, no client write — the caller falls back.
-            raise _SplitHopError(str(failure))
-        if state["client_gone"]:
+        fut = asyncio.ensure_future(client.fetch(
+            f"{ep.url}{path}", method="POST",
+            body=json.dumps(upstream_body), headers=headers,
+            request_timeout=timeout, raise_error=False,
+            streaming_callback=on_chunk, header_callback=on_header))
+        response = None
+        failure: Optional[BaseException] = None
+        while True:
+            try:
+                response = await asyncio.wait_for(
+                    asyncio.shield(fut), 0.25)
+                failure = (response.error if response.code == 599
+                           else None)
+                break
+            except asyncio.TimeoutError:
+                now = time.monotonic()
+                idle = now - state["last_activity"]
+                if idle <= stall_timeout:
+                    # Long inter-token gap but not yet a stall: keep
+                    # the DOWNSTREAM side fed with proxy-minted
+                    # keepalives (the upstream's own heartbeats relay
+                    # through handle_frame; this covers upstreams
+                    # that don't emit them).
+                    if relay.started and relay.idle_s(now) >= \
+                            self.application.settings.get(
+                                "sse_keepalive_s", 2.0):
+                        relay.write_keepalive()
+                    continue
+                # Wedged leg: the server keepalives every couple of
+                # seconds on healthy slow decodes, so this silence is
+                # a hung socket. Abandon the fetch (it reaps itself
+                # on its own request_timeout) and record the stall as
+                # brownout evidence — NOT as a breaker failure: the
+                # TCP transport is fine, the service is gray.
+                state["abandoned"] = True
+                fut.add_done_callback(lambda f: f.exception())
+                ep.note_stream_stall()
+                if relay.started:
+                    return "dead"
+                raise BackendTimeoutError(
+                    f"stream stalled {idle:.1f}s before first "
+                    f"client byte")
+            except Exception as e:  # noqa: BLE001 — transport failure
+                failure = e
+                break
+        if relay.client_gone:
             # Client hung up / stalled mid-relay: nothing to answer,
             # and the upstream stays healthy (no breaker hit).
             self._obs_outcome = "client_gone"
@@ -795,33 +1573,29 @@ class InferProxyHandler(ProxyHandler):
             raise _Handled()
         if failure is None:
             breaker.record_success()
-            if not state["streamed"]:
+            if state["rejected"]:
+                return "rejected"
+            if relay.error_status is not None:
+                relay.finish()
+                raise _Handled()
+            if not relay.started and not state["got_chunk"]:
+                if abort_non_200:
+                    return "rejected"
                 # Headerless empty body (shouldn't happen; keep the
                 # client out of limbo with a structured error).
                 self.write_json(
                     {"error": "upstream stream carried no data"}, 502)
-            else:
-                self.finish()
-            raise _Handled()
+                raise _Handled()
+            return "done"
         timed_out = "timeout" in str(failure).lower()
         if not timed_out or timeout >= min(self.rpc_timeout,
                                            BREAKER_TIMEOUT_FLOOR_S):
             breaker.record_failure()
             _P_UPSTREAM_FAILURES.labels("rest").inc()
-        if state["streamed"]:
-            # Bytes already relayed: committed — close in-band.
-            from kubeflow_tpu.serving import wire
-
-            self._obs_outcome = "stream_broken"
-            try:
-                self.write(wire.format_sse_event(
-                    {"error": f"upstream disconnected mid-stream: "
-                              f"{failure}",
-                     "code": "UNAVAILABLE"}, event="error"))
-                self.finish()
-            except Exception:  # noqa: BLE001 — client also gone
-                pass
-            raise _Handled()
+        if relay.started:
+            return "dead"
+        if state["rejected"]:
+            return "rejected"
         if timed_out:
             raise BackendTimeoutError(
                 f"model server timed out after {timeout:.1f}s")
@@ -1025,22 +1799,36 @@ class InferProxyHandler(ProxyHandler):
         if wants_stream and verb == "generate":
             # Streaming rides the REST upstream directly (prompts are
             # dense int rows — no signature-map conversion needed);
-            # failover applies until the first relayed byte.
+            # failover applies until the first relayed byte. A whole
+            # decode's duration is not a latency sample (ISSUE 13):
+            # streams feed the inter-chunk gap tracker instead.
             await self.route_with_failover(
                 name,
                 lambda ep: self._attempt_stream(ep, name, version,
                                                 instances, body,
                                                 deadline),
-                deadline=deadline, phase=phase, prefix_key=prefix_key)
+                deadline=deadline, phase=phase, prefix_key=prefix_key,
+                record_latency=False)
+            return
+        hedge_failed: List[Endpoint] = []
+        if verb == "generate" and await self._hedged_generate(
+                name, version, instances, body, deadline, phase,
+                prefix_key, failed_out=hedge_failed):
             return
         # Infer verbs are idempotent (pure functions of their
         # inputs), so the shared failover loop may retry a transport
-        # failure on another replica.
+        # failure on another replica. Unary first placements may land
+        # on a soft-ejected replica's due shadow slot (the brownout
+        # recovery probe). Replicas the hedger just observed failing
+        # ride in as pre-tried so the classic path doesn't re-dial
+        # them.
         await self.route_with_failover(
             name,
             lambda ep: self._attempt(ep, name, version, verb,
                                      instances, body, deadline),
-            deadline=deadline, phase=phase, prefix_key=prefix_key)
+            deadline=deadline, phase=phase, prefix_key=prefix_key,
+            allow_shadow=not hedge_failed, pre_tried=hedge_failed,
+            hedge_sample=(verb == "generate"))
 
     async def post(self, name: str, version: Optional[str], verb: str):
         await self._infer(name, version, verb)
@@ -1157,7 +1945,11 @@ def make_app(rpc_address: Union[str, Sequence[str], None] = None,
              balancer: Union[str, Balancer] = "least_saturation",
              retry_attempts: int = 2,
              probe_interval_s: float = 1.0,
-             split_generate: Optional[bool] = None
+             split_generate: Optional[bool] = None,
+             hedge_rate: float = 0.0,
+             fault_plan: Optional[str] = None,
+             brownout: Union[bool, "BrownoutPolicy", None] = True,
+             stream_stall_timeout_s: float = STREAM_STALL_TIMEOUT_S
              ) -> tornado.web.Application:
     """Build the pooled proxy app.
 
@@ -1215,8 +2007,26 @@ def make_app(rpc_address: Union[str, Sequence[str], None] = None,
         # policy routes by role at all (and it additionally gates
         # itself per request on both pools being routable).
         split_generate = getattr(balancer_obj, "name", "") == "role"
+    from kubeflow_tpu.scaling.endpoints import BrownoutPolicy
+
+    if brownout is True:
+        brownout = BrownoutPolicy()
+    elif brownout is False:
+        brownout = None
     prober = HealthProber(pool, interval_s=probe_interval_s,
-                          source=endpoints_source)
+                          source=endpoints_source, brownout=brownout)
+    # Gray-failure resilience knobs (ISSUE 13, docs/resilience.md):
+    # budget-aware hedging is OFF until a rate cap is configured, and
+    # fault injection additionally refuses without KFT_ENABLE_FAULTS=1
+    # (FaultPlanSource raises at construction — a fault plan leaking
+    # into production must fail startup, not degrade the fleet).
+    fault_source = None
+    if fault_plan is not None:
+        from kubeflow_tpu.serving.faults import FaultPlanSource
+
+        fault_source = FaultPlanSource(fault_plan)
+    hedge_throttle = (overload.HedgeThrottle(hedge_rate)
+                      if hedge_rate > 0 else None)
     # Live breaker state on /metrics: per WIRE, the worst state across
     # the pool (render-time callback — no write per transition; two
     # make_app calls rebind to the newest app). Per-replica states
@@ -1245,6 +2055,16 @@ def make_app(rpc_address: Union[str, Sequence[str], None] = None,
     ], pool=pool, balancer_obj=balancer_obj, prober=prober,
        split_generate=split_generate,
        rpc_timeout=rpc_timeout, retry_attempts=retry_attempts,
+       hedge_throttle=hedge_throttle,
+       hedge_latency=overload.QuantileWindow(maxlen=256),
+       # The shadow-pick pacing honors the policy's own knob — the
+       # proxy reads the setting, and a BrownoutPolicy(shadow_
+       # interval_s=...) must not be silently ignored.
+       shadow_interval_s=(brownout.shadow_interval_s
+                          if brownout is not None
+                          else SHADOW_INTERVAL_S),
+       fault_source=fault_source,
+       stream_stall_timeout_s=stream_stall_timeout_s,
        log_function=access_log_function("http-proxy"),
        # Single-upstream back-compat aliases (pre-pool callers and
        # tests reach the breakers/cache through settings; with a
@@ -1353,7 +2173,28 @@ def main(argv=None) -> int:
                              "this fraction of happy-path spans "
                              "(error/deadline/failover spans and the "
                              "slowest decile always retained)")
+    parser.add_argument("--hedge_rate", type=float, default=0.0,
+                        help="budget-aware hedging for unary "
+                             ":generate: cap on fired hedges as a "
+                             "fraction of offered load (0 disables; "
+                             "docs/resilience.md)")
+    parser.add_argument("--fault_plan", default=None,
+                        help="JSON fault-injection plan file (hot-"
+                             "reloaded; REFUSED unless "
+                             "KFT_ENABLE_FAULTS=1 — chaos tests and "
+                             "bench only, never production)")
+    parser.add_argument("--no_brownout", action="store_true",
+                        help="disable gray-failure brownout "
+                             "detection (per-replica latency outlier "
+                             "soft-eject; docs/resilience.md)")
+    parser.add_argument("--stream_stall_timeout", type=float,
+                        default=STREAM_STALL_TIMEOUT_S,
+                        help="inter-chunk silence after which a "
+                             "proxied token stream is judged wedged "
+                             "and resumed on a peer")
     args = parser.parse_args(argv)
+    if not 0.0 <= args.hedge_rate <= 1.0:
+        parser.error("--hedge_rate must be in [0, 1]")
     logging.basicConfig(level=logging.INFO)
     if args.trace_tail_keep is not None:
         TRACER.set_tail_sampling(args.trace_tail_keep)
@@ -1394,7 +2235,11 @@ def main(argv=None) -> int:
                    retry_attempts=args.retries,
                    probe_interval_s=args.probe_interval or 1.0,
                    split_generate={"auto": None, "on": True,
-                                   "off": False}[args.role_split])
+                                   "off": False}[args.role_split],
+                   hedge_rate=args.hedge_rate,
+                   fault_plan=args.fault_plan,
+                   brownout=not args.no_brownout,
+                   stream_stall_timeout_s=args.stream_stall_timeout)
     app.listen(args.port)
     if args.probe_interval:
         app.settings["prober"].start()

@@ -551,7 +551,8 @@ class ServedModel:
                method: Optional[str],
                version: Optional[int], *,
                deadline: Optional[float] = None,
-               obs_ctx=None) -> Future:
+               obs_ctx=None,
+               on_streams=None) -> Future:
         """Enqueue one request for micro-batching; resolves to the
         output dict for exactly this request's rows.
 
@@ -579,7 +580,8 @@ class ServedModel:
                         and sig.method == "generate":
                     return self._submit_engine(
                         loaded, inputs, signature_name,
-                        deadline=deadline, obs_ctx=obs_ctx)
+                        deadline=deadline, obs_ctx=obs_ctx,
+                        on_streams=on_streams)
         future: Future = Future()
         t_enqueue = time.monotonic()
         if deadline is not None:
@@ -750,14 +752,86 @@ class ServedModel:
             raise
         return loaded, streams
 
+    def submit_resume(self, resumes, version: Optional[int], *,
+                      deadline: Optional[float] = None,
+                      obs_ctx=None):
+        """Mid-stream decode resume (ISSUE 13): continue streams whose
+        decode died on ANOTHER replica. ``resumes`` is a list of
+        ``(resume_token, emitted)`` pairs — the token dict is the
+        dead replica's serialized resume context (wire.py
+        ``decode_resume_token``: prompt ids + the full step-key
+        schedule + budget) and ``emitted`` the tokens the proxy
+        already relayed to the client. Each row re-enters the engine
+        as a continuation: context = prompt + emitted, schedule =
+        keys[len(emitted):], so the prefill over the context
+        reproduces the next token bitwise and decode picks up the
+        ORIGINAL sampling schedule. A row whose emitted tokens
+        already carry EOS (or whose budget is spent) finishes
+        synthetically with the reference's latched-EOS padding — the
+        engine is never burned on a completed stream. Returns
+        ``(loaded, [GenerateStream per row])``, the submit_stream
+        handle shape."""
+        if not self.continuous_batching:
+            raise ValueError(
+                f"model {self.name!r} is not served with continuous "
+                f"batching; decode resume rides the engine "
+                f"(--continuous_batching)")
+        from kubeflow_tpu.inference.engine.engine import GenerateStream
+
+        loaded = self.get(version)
+        engine = loaded.ensure_engine(
+            self.name, queue_capacity=self.queue_capacity)
+        eos = engine.config.eos_id
+        streams = []
+        try:
+            for token, emitted in resumes:
+                prompt = np.asarray(token["prompt_tokens"],
+                                    np.int32).reshape(-1)
+                keys = np.asarray(token["step_keys"],
+                                  np.uint32).reshape(-1, 2)
+                budget = int(token["max_new_tokens"])
+                if len(keys) != budget:
+                    raise ValueError(
+                        f"resume token carries {len(keys)} step keys "
+                        f"for a {budget}-token budget")
+                emitted = [int(t) for t in emitted]
+                n = len(emitted)
+                if n > budget:
+                    raise ValueError(
+                        f"{n} emitted tokens exceed the {budget}-token "
+                        f"budget")
+                if n >= budget or (eos is not None and eos in emitted):
+                    # Terminal before the resume: the remainder is the
+                    # latched-EOS padding of the reference shape.
+                    remaining = budget - n
+                    pad = ([] if eos is None
+                           else [eos] * remaining)
+                    s = GenerateStream(remaining, obs_ctx=obs_ctx)
+                    s._finish(np.asarray(pad, np.int32))
+                    streams.append(s)
+                    continue
+                context = np.concatenate(
+                    [prompt, np.asarray(emitted, np.int32)])
+                streams.append(engine.submit(
+                    context, step_keys=keys[n:], deadline=deadline,
+                    obs_ctx=obs_ctx))
+        except BaseException:
+            for s in streams:  # free the slots already taken
+                s.cancel()
+            raise
+        return loaded, streams
+
     def _submit_engine(self, loaded, inputs: Dict[str, np.ndarray],
                        signature_name: Optional[str], *,
                        deadline: Optional[float],
-                       obs_ctx) -> Future:
+                       obs_ctx, on_streams=None) -> Future:
         """Non-streaming generate over the engine: the classic
         future-of-{"tokens": [n, T]} contract, built by combining the
         per-row streams (so REST/gRPC unary clients transparently gain
-        slot-level batching)."""
+        slot-level batching). ``on_streams`` (ISSUE 13) hands the live
+        engine streams back to the transport so a client that hangs up
+        — or a hedged request whose twin already won — can CANCEL the
+        decode instead of burning slots into a dead socket."""
         future: Future = Future()
         sig = loaded.signature(signature_name)
         try:
@@ -790,6 +864,11 @@ class ServedModel:
         except Exception as e:  # noqa: BLE001 — validation errors
             future.set_exception(e)
             return future
+        if on_streams is not None:
+            try:
+                on_streams(streams)
+            except Exception:  # noqa: BLE001 — a transport hook bug
+                logger.exception("on_streams hook failed")
         _combine_streams(streams, future)
         return future
 

@@ -51,8 +51,10 @@ __all__ = [
     "DEADLINE_HEADER",
     "CircuitBreaker",
     "DeadlineExceededError",
+    "HedgeThrottle",
     "LatencyEstimator",
     "OverloadedError",
+    "QuantileWindow",
     "RetryPolicy",
     "clamp_wait_s",
     "deadline_after",
@@ -189,6 +191,82 @@ class LatencyEstimator:
     def estimate_s(self) -> float:
         with self._lock:
             return self._prior_s if self._value is None else self._value
+
+
+class QuantileWindow:
+    """Thread-safe bounded sample window with quantile reads — the
+    rolling-latency primitive behind brownout detection (per-replica
+    p50 vs the pool, scaling/endpoints.py) and budget-aware hedging
+    (the p95 hedge delay, http_proxy.py). A deque, not a sketch: the
+    windows are small (≤ a few hundred samples) and exact quantiles
+    keep the k-MAD outlier math honest."""
+
+    def __init__(self, maxlen: int = 64):
+        if maxlen < 1:
+            raise ValueError("maxlen must be >= 1")
+        from collections import deque
+
+        self._samples = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._samples.append(float(value))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._samples)
+
+    def quantile(self, q: float, *, last: Optional[int] = None
+                 ) -> Optional[float]:
+        """Exact quantile of the window (or of the most recent
+        ``last`` samples — the recovery check reads only samples taken
+        since the soft-eject). None when empty."""
+        with self._lock:
+            samples = list(self._samples)
+        if last is not None:
+            samples = samples[-last:]
+        if not samples:
+            return None
+        samples.sort()
+        idx = min(len(samples) - 1,
+                  max(0, int(round(q * (len(samples) - 1)))))
+        return samples[idx]
+
+
+class HedgeThrottle:
+    """Caps hedged requests at ``rate`` per offered request: every
+    real request deposits ``rate`` credits (bounded burst), every
+    fired hedge spends one — so over any window, hedges/requests ≤
+    rate, whatever the latency distribution does. Without the cap, a
+    fleet-wide slowdown makes EVERY request look hedge-worthy and the
+    hedger doubles offered load exactly when capacity is scarcest
+    (the retry-storm failure mode, re-invented)."""
+
+    def __init__(self, rate: float, *, burst: float = 2.0):
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError("hedge rate must be in [0, 1]")
+        self.rate = rate
+        self._burst = max(1.0, burst)
+        self._credits = 0.0
+        self._lock = threading.Lock()
+
+    def note_request(self) -> None:
+        """One offered (non-hedge) request arrived."""
+        with self._lock:
+            self._credits = min(self._burst, self._credits + self.rate)
+
+    def try_acquire(self) -> bool:
+        """May a hedge fire now? Consumes one credit on True."""
+        with self._lock:
+            if self._credits >= 1.0:
+                self._credits -= 1.0
+                return True
+            return False
 
 
 class CircuitBreaker:

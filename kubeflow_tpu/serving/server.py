@@ -181,6 +181,13 @@ class MetadataHandler(BaseHandler):
 #: deadline wait exactly their remaining budget, never this default).
 DEFAULT_INFER_WAIT_S = 30.0
 
+#: SSE keepalive cadence (ISSUE 13 satellite): during an inter-token
+#: gap longer than this, the stream emits ``: keepalive`` comment
+#: frames so intermediaries and clients can tell a slow decode from a
+#: wedged stream — and the proxy's inter-chunk-gap tracker gets a
+#: bounded healthy ceiling. Comments are invisible to SSE consumers.
+SSE_KEEPALIVE_INTERVAL_S = 2.0
+
 
 async def _await_future(future, wait_s: float):
     """Await a batcher Future ON THE IO LOOP (no pool thread held per
@@ -207,11 +214,30 @@ class InferHandler(BaseHandler):
 
     def initialize(self):
         self._live_streams = []
+        self._stream_fault = None
+
+    def _register_streams(self, streams) -> None:
+        self._live_streams = list(streams)
+        # The client may have hung up BEFORE the submit happened (a
+        # hedge loser closed during injected latency, a client that
+        # gave up in the queue): on_connection_close already fired
+        # with nothing registered, so check now — otherwise the
+        # decode burns slots into a dead socket.
+        conn = getattr(self.request, "connection", None)
+        stream = getattr(conn, "stream", None)
+        # Tornado nulls connection.stream once the close is handled,
+        # so None IS the closed signal here (a live connection always
+        # has its stream attached while the handler runs).
+        if stream is None or stream.closed():
+            for s in self._live_streams:
+                s.cancel()
 
     def on_connection_close(self):
-        # A streaming client hung up mid-decode: cancel so the engine
-        # retires the slot(s) at the next slice boundary instead of
-        # decoding into a dead socket until the token budget runs out.
+        # A client hung up mid-decode (streaming OR unary-engine —
+        # including a hedge loser whose twin already answered): cancel
+        # so the engine retires the slot(s) at the next slice boundary
+        # instead of decoding into a dead socket until the token
+        # budget runs out.
         for stream in self._live_streams:
             stream.cancel()
 
@@ -222,6 +248,7 @@ class InferHandler(BaseHandler):
             body = json.loads(self.request.body or b"{}")
             instances = body.get("instances")
             handoffs_b64 = body.get("handoffs")
+            resume_b64 = body.get("resume")
             prefill_only = bool(body.get("prefill_only"))
             if (prefill_only or handoffs_b64 is not None) \
                     and verb != "generate":
@@ -246,7 +273,25 @@ class InferHandler(BaseHandler):
                 return self.write_json(
                     {"error": "prefill_only and handoffs are "
                               "mutually exclusive"}, 400)
-            if instances is None and handoffs_b64 is None:
+            if resume_b64 is not None and (
+                    verb != "generate" or prefill_only
+                    or handoffs_b64 is not None):
+                return self.write_json(
+                    {"error": "decode resume applies to :generate "
+                              "alone (no prefill_only/handoffs)"}, 400)
+            if resume_b64 is not None \
+                    and not getattr(model, "continuous_batching",
+                                    False):
+                # Same structured code as the handoff contract: the
+                # proxy must distinguish "can't ever" from "bad
+                # request" when choosing whether to keep trying peers.
+                return self.write_json(
+                    {"error": f"model {name!r} is not served with "
+                              f"continuous batching; decode resume "
+                              f"rides the engine",
+                     "code": "UNIMPLEMENTED"}, 400)
+            if instances is None and handoffs_b64 is None \
+                    and resume_b64 is None:
                 return self.write_json(
                     {"error": "request body needs 'instances'"}, 400)
             wants_stream = bool(body.get("stream")) or (
@@ -260,8 +305,31 @@ class InferHandler(BaseHandler):
                 return self.write_json(
                     {"error": "prefill_only responses are unary (the "
                               "decode replica streams)"}, 400)
+            if resume_b64 is not None and not wants_stream:
+                return self.write_json(
+                    {"error": "decode resume is a streaming contract "
+                              "(set stream: true)"}, 400)
             deadline = overload.request_deadline(self.request.headers,
                                                  body)
+            # Fault injection (opt-in, KFT_ENABLE_FAULTS=1 — see
+            # serving/faults.py): the same middleware seam on every
+            # serving phase; inert (None rule) when unarmed.
+            from kubeflow_tpu.serving import faults
+
+            fault_phase = ("resume" if resume_b64 is not None
+                           else "handoff" if (prefill_only
+                                              or handoffs_b64
+                                              is not None)
+                           else "stream" if wants_stream else "unary")
+            fault_rule = faults.match_request(
+                self.application.settings, route=verb, model=name,
+                phase=fault_phase)
+            if fault_rule is not None and \
+                    await faults.inject_request_fault(self, fault_rule):
+                self._obs_outcome = "fault_injected"
+                return
+            self._stream_fault = faults.StreamFaultInjector(
+                fault_rule if wants_stream else None)
             want = int(version) if version else None
             # Resident fast path: a dict lookup on the IO loop. Only a
             # cold pinned version goes to a pool thread — get() may
@@ -287,6 +355,10 @@ class InferHandler(BaseHandler):
                         "model version load did not finish within the "
                         "request budget") from None
             sig_name = body.get("signature_name")
+            if resume_b64 is not None:
+                return await self._resume_streams(
+                    name, model, loaded, resume_b64, body, deadline,
+                    want)
             if handoffs_b64 is not None:
                 return await self._resume_handoffs(
                     name, model, loaded, handoffs_b64, body, deadline,
@@ -302,9 +374,15 @@ class InferHandler(BaseHandler):
                 return await self._stream_generate(
                     name, model, loaded, {input_name: batch},
                     sig_name, want, body, deadline)
+            # on_streams registers live engine streams so a client
+            # hang-up cancels the UNARY decode too (ISSUE 13: hedged
+            # requests' losers are cancelled by closing this
+            # connection; the engine retires the slots at the next
+            # slice boundary — white-box visible in its stats).
             future = model.submit({input_name: batch}, sig_name, verb,
                                   want, deadline=deadline,
-                                  obs_ctx=self._obs_ctx)
+                                  obs_ctx=self._obs_ctx,
+                                  on_streams=self._register_streams)
             # Never hold the connection past the budget.
             result = await _await_future(
                 future, overload.clamp_wait_s(deadline,
@@ -425,6 +503,44 @@ class InferHandler(BaseHandler):
                                         "version": str(loaded.version)},
                          "predictions": _batch_to_instances(result)})
 
+    async def _resume_streams(self, name, model, loaded, resume_b64,
+                              body, deadline, version=None):
+        """Mid-stream decode resume (ISSUE 13): each row's resume
+        token (minted by the dead replica, relayed by the proxy) plus
+        the tokens already emitted re-enter THIS replica's engine as
+        a continuation — prompt+emitted context, original remaining
+        step-key schedule — so the stitched stream is bitwise the
+        sequence the dead replica would have produced."""
+        import base64
+
+        from kubeflow_tpu.serving import wire
+
+        emitted_rows = body.get("resume_emitted")
+        if (not isinstance(resume_b64, list) or not resume_b64
+                or not isinstance(emitted_rows, list)
+                or len(emitted_rows) != len(resume_b64)):
+            return self.write_json(
+                {"error": "'resume' needs a non-empty blob list and a "
+                          "matching 'resume_emitted' row list"}, 400)
+        try:
+            resumes = []
+            for blob, emitted in zip(resume_b64, emitted_rows):
+                token = wire.decode_resume_token(
+                    base64.b64decode(blob), model=name,
+                    version=loaded.version)
+                if not isinstance(emitted, list):
+                    raise ValueError("resume_emitted rows must be "
+                                     "token lists")
+                resumes.append((token, emitted))
+        except (ValueError, TypeError) as e:
+            return self.write_json(
+                {"error": f"bad resume token: {e}"}, 400)
+        loaded, streams = model.submit_resume(
+            resumes, version, deadline=deadline, obs_ctx=self._obs_ctx)
+        return await self._stream_generate(
+            name, model, loaded, None, None, None, body, deadline,
+            streams=streams)
+
     async def _stream_generate(self, name, model, loaded, inputs,
                                sig_name, version, body, deadline,
                                streams=None):
@@ -453,6 +569,7 @@ class InferHandler(BaseHandler):
         self.set_header("Content-Type", wire.SSE_CONTENT_TYPE)
         self.set_header("Cache-Control", "no-cache")
         self.set_header("X-Accel-Buffering", "no")  # proxies: no buffer
+        injector = self._stream_fault
         loop = tornado.ioloop.IOLoop.current()
         signal = asyncio.Event()
 
@@ -463,12 +580,54 @@ class InferHandler(BaseHandler):
             s.set_notify(notify)
         finished = [False] * len(streams)
         results: list = [None] * len(streams)
+
+        async def kill_injected() -> None:
+            # Injected mid-stream death (faults.py): drop the
+            # connection raw — exactly how a crashed replica looks
+            # from the proxy — and cancel the decode like the real
+            # close handler would.
+            for s in streams:
+                s.cancel()
+            self._obs_outcome = "fault_killed"
+            self.request.connection.stream.close()
+
         try:
+            if body.get("emit_resume"):
+                # The proxy asked for resume context (ISSUE 13): one
+                # opaque blob per resumable row, minted BEFORE tokens
+                # flow so a death at any point is resumable. The
+                # proxy strips these; direct clients only see them if
+                # they asked.
+                import base64 as _b64
+
+                for r, s in enumerate(streams):
+                    ctx = getattr(s, "resume_ctx", None)
+                    if ctx is None:
+                        continue
+                    blob = wire.encode_resume_token(
+                        name, int(loaded.version), ctx["prompt"],
+                        ctx["step_keys"], ctx["max_new_tokens"])
+                    self.write(wire.format_sse_event(
+                        {"row": r, "version": str(loaded.version),
+                         "blob": _b64.b64encode(blob).decode("ascii")},
+                        event="resume"))
+                await self.flush()
             while not all(finished):
                 signal.clear()
                 wrote = False
                 for r, s in enumerate(streams):
                     for ev in s.drain():
+                        if injector is not None and injector.rule \
+                                is not None:
+                            if wrote:
+                                # Flush BEFORE the fault point so an
+                                # injected kill/stall severs the
+                                # stream exactly after the events the
+                                # client was shown — how a real crash
+                                # looks from the proxy.
+                                await self.flush()
+                            if await injector.before_event():
+                                return await kill_injected()
                         wrote = True
                         if ev.final:
                             finished[r] = True
@@ -489,12 +648,33 @@ class InferHandler(BaseHandler):
                     await self.flush()
                 if all(finished):
                     break
-                try:
-                    await asyncio.wait_for(
-                        signal.wait(),
-                        overload.clamp_wait_s(deadline,
-                                              DEFAULT_INFER_WAIT_S))
-                except asyncio.TimeoutError:
+                # Bounded wait with keepalive comments: the total
+                # stall ceiling is unchanged (remaining budget capped
+                # at DEFAULT_INFER_WAIT_S), but long inter-token gaps
+                # now carry ``: keepalive`` frames so downstream can
+                # tell slow from wedged (ISSUE 13 satellite).
+                budget = overload.clamp_wait_s(deadline,
+                                               DEFAULT_INFER_WAIT_S)
+                keepalive_s = self.application.settings.get(
+                    "sse_keepalive_s", SSE_KEEPALIVE_INTERVAL_S)
+                waited = 0.0
+                stalled = False
+                while True:
+                    step = min(keepalive_s, budget - waited)
+                    if step <= 0:
+                        stalled = True
+                        break
+                    try:
+                        await asyncio.wait_for(signal.wait(), step)
+                        break
+                    except asyncio.TimeoutError:
+                        waited += step
+                        if waited >= budget:
+                            stalled = True
+                            break
+                        self.write(wire.SSE_KEEPALIVE)
+                        await self.flush()
+                if stalled:
                     for s in streams:
                         s.cancel()
                     self._obs_outcome = "expired"
@@ -503,6 +683,8 @@ class InferHandler(BaseHandler):
                                   "engine",
                          "code": "DEADLINE_EXCEEDED"}, event="error"))
                     break
+            if injector is not None and await injector.before_event():
+                return await kill_injected()
             self.write(wire.format_sse_event(
                 {"model_spec": {"name": name,
                                 "version": str(loaded.version)},
@@ -666,7 +848,10 @@ def _roles():
 
 
 def make_app(manager: ModelManager,
-             role: str = "any") -> tornado.web.Application:
+             role: str = "any",
+             fault_plan: Optional[str] = None,
+             sse_keepalive_s: float = SSE_KEEPALIVE_INTERVAL_S
+             ) -> tornado.web.Application:
     roles, normalize_role = _roles()
     if role not in roles:
         # Tolerate-but-normalize: a mid-rollout flag typo must not
@@ -674,6 +859,14 @@ def make_app(manager: ModelManager,
         logger.warning("unknown serving role %r; serving as %r",
                        role, normalize_role(role))
         role = normalize_role(role)
+    # Fault injection (ISSUE 13, serving/faults.py): construction
+    # REFUSES without KFT_ENABLE_FAULTS=1 — a fault plan leaking into
+    # a production manifest fails the process at startup.
+    fault_source = None
+    if fault_plan is not None:
+        from kubeflow_tpu.serving.faults import FaultPlanSource
+
+        fault_source = FaultPlanSource(fault_plan)
     return tornado.web.Application([
         (r"/healthz", HealthHandler),
         (r"/livez", LiveHandler),
@@ -686,7 +879,8 @@ def make_app(manager: ModelManager,
         (r"/tensorflow\.serving\.PredictionService/"
          r"(Predict|Classify|GetModelMetadata)",
          GrpcWebPredictHandler),
-    ], manager=manager, role=role,
+    ], manager=manager, role=role, fault_source=fault_source,
+       sse_keepalive_s=sse_keepalive_s,
        log_function=access_log_function("model-server"))
 
 
@@ -755,6 +949,17 @@ def main(argv=None) -> int:
                              "ServableVersionPolicy role; rollback = "
                              "specific:<old>)")
     parser.add_argument("--poll_interval", type=float, default=5.0)
+    parser.add_argument("--fault_plan", default=None,
+                        help="JSON fault-injection plan file (hot-"
+                             "reloaded; REFUSED unless "
+                             "KFT_ENABLE_FAULTS=1 — chaos tests and "
+                             "bench only, never production; "
+                             "docs/resilience.md)")
+    parser.add_argument("--sse_keepalive", type=float,
+                        default=SSE_KEEPALIVE_INTERVAL_S,
+                        help="seconds between ': keepalive' SSE "
+                             "comment frames during inter-token "
+                             "gaps on streamed generates")
     parser.add_argument("--trace_tail_keep", type=float, default=None,
                         help="enable tail-based span sampling: keep "
                              "this fraction of happy-path spans "
@@ -811,7 +1016,9 @@ def main(argv=None) -> int:
 
     grpc_srv, _ = make_server(manager, args.port)
     grpc_srv.start()
-    app = make_app(manager, role=args.role)
+    app = make_app(manager, role=args.role,
+                   fault_plan=args.fault_plan,
+                   sse_keepalive_s=args.sse_keepalive)
     app.listen(args.rest_port)
     logger.info("model server: gRPC on :%d, REST on :%d (models=%s, "
                 "role=%s)", args.port, args.rest_port,

@@ -737,8 +737,19 @@ SSE_CONTENT_TYPE = "text/event-stream"
 
 #: Streaming-generate event names: ``token`` (one sampled token),
 #: ``error`` (a row failed mid-stream; carries ``code``), ``done``
-#: (terminal; carries the per-row token arrays).
+#: (terminal; carries the per-row token arrays). Engine streams asked
+#: for it (``emit_resume`` in the request body — the proxy asks, and
+#: strips the event before the client sees it) additionally lead with
+#: one ``resume`` event per row carrying the opaque resume blob.
 SSE_EVENTS = ("token", "error", "done")
+
+#: SSE comment frame emitted during long inter-token gaps (ISSUE 13
+#: satellite): comments are invisible to EventSource consumers
+#: (``iter_sse_events`` skips them) but keep intermediaries' idle
+#: timers fed and give the proxy's inter-chunk-gap tracker a bounded
+#: healthy ceiling — a gap well past the keepalive cadence now means
+#: a WEDGED stream, not a slow decode.
+SSE_KEEPALIVE = b": keepalive\n\n"
 
 
 def format_sse_event(data, event: Optional[str] = None) -> bytes:
@@ -838,6 +849,80 @@ def encode_kv_handoff(model: str, version: int, handoff) -> bytes:
     if tokens is not None:
         doc["prompt_tokens"] = np.asarray(tokens, np.int32)
     return serialization.msgpack_serialize(doc)
+
+
+#: Version tag of the mid-stream resume token (ISSUE 13). Like the
+#: handoff blob, both sides of a rolling update may differ — an
+#: unknown format fails the resume with a clear 400 and the proxy
+#: surfaces the classic in-band error instead of mis-resuming.
+RESUME_TOKEN_FORMAT = 1
+
+
+def encode_resume_token(model: str, version: int,
+                        prompt_tokens: np.ndarray,
+                        step_keys: np.ndarray,
+                        max_new_tokens: int) -> bytes:
+    """Serialize one stream row's resume context: everything a PEER
+    replica needs to continue the decode bitwise if this one dies
+    mid-stream — the full context ids plus the ORIGINAL per-token
+    sampling schedule (``step_keys`` travel whole for the same reason
+    the handoff blob's do: re-deriving them with a different budget
+    would fork the sampled sequence). Deliberately carries NO cache:
+    the replica that held the pages is the one that died; the peer
+    re-prefills the context (a cheap tail-prefill when its prefix
+    cache is warm)."""
+    from flax import serialization
+
+    return serialization.msgpack_serialize({
+        "format": np.int32(RESUME_TOKEN_FORMAT),
+        "kind": "resume",
+        "model": model,
+        "version": np.int32(version),
+        "prompt_tokens": np.asarray(prompt_tokens, np.int32),
+        "step_keys": np.asarray(step_keys, np.uint32),
+        "max_new_tokens": np.int32(max_new_tokens),
+    })
+
+
+def decode_resume_token(data: bytes, *, model: str,
+                        version: Optional[int] = None) -> Dict[str, object]:
+    """Parse + validate a resume token against the resuming replica's
+    (model, version). Returns the dict ``ServedModel.submit_resume``
+    consumes. Raises ValueError on any mismatch or malformed payload
+    (the server maps it to 400; the proxy tries another peer or
+    surfaces the in-band error)."""
+    from flax import serialization
+
+    try:
+        doc = serialization.msgpack_restore(data)
+        fmt = int(doc["format"])
+        kind = str(doc.get("kind"))
+    except Exception as e:  # noqa: BLE001 — malformed blob = 400
+        raise ValueError(f"malformed resume token: {e}") from None
+    if fmt != RESUME_TOKEN_FORMAT or kind != "resume":
+        raise ValueError(
+            f"resume token format {fmt}/{kind!r} unsupported (this "
+            f"replica speaks format {RESUME_TOKEN_FORMAT})")
+    if doc["model"] != model:
+        raise ValueError(
+            f"resume token is for model {doc['model']!r}, "
+            f"not {model!r}")
+    if version is not None and int(doc["version"]) != int(version):
+        raise ValueError(
+            f"resume token came from version {int(doc['version'])} "
+            f"but this replica serves version {version} — the "
+            f"sampling schedule is version-bound")
+    keys = np.asarray(doc["step_keys"], np.uint32)
+    if keys.ndim != 2 or keys.shape[1] != 2 or not keys.size:
+        raise ValueError(
+            f"resume token step_keys shape {keys.shape} != [N, 2]")
+    return {
+        "model": str(doc["model"]),
+        "version": int(doc["version"]),
+        "prompt_tokens": np.asarray(doc["prompt_tokens"], np.int32),
+        "step_keys": keys,
+        "max_new_tokens": int(doc["max_new_tokens"]),
+    }
 
 
 def decode_kv_handoff(data: bytes, *, model: str,

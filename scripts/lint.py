@@ -378,7 +378,17 @@ def check_serving_timeout_discipline() -> list:
     is finite. The telemetry collector (``obs/collector.py``) is held
     to the same rule: its scrape loop fans out over the whole fleet
     every cycle, and one timeout-less fetch against a dead replica
-    would stall fleet-wide alerting (ISSUE 9)."""
+    would stall fleet-wide alerting (ISSUE 9).
+
+    ISSUE 13 additions: the glob covers ``serving/faults.py`` (every
+    injected wait must itself be bounded — a fault plan makes a
+    replica slow, never the harness unbounded), and bare ``except:``
+    is forbidden everywhere under serving/ — the resume and hedge
+    paths classify failures to decide whether a peer retry is legal,
+    and a bare except that swallows ``CancelledError`` or
+    ``KeyboardInterrupt`` turns a cancelled hedge loser into a
+    zombie. Narrow ``except Exception`` (with a noqa rationale) is
+    the allowed catch-all."""
     errors = []
     serving_dir = REPO / "kubeflow_tpu" / "serving"
     files = sorted(serving_dir.glob("*.py"))
@@ -402,6 +412,15 @@ def check_serving_timeout_discipline() -> list:
                 f"carry an explicit timeout")
 
         for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) \
+                    and node.type is None:
+                errors.append(
+                    f"serving-timeout: {f.relative_to(REPO)}:"
+                    f"{node.lineno}: bare 'except:' — catch a named "
+                    f"exception type (a bare except swallows "
+                    f"CancelledError/KeyboardInterrupt and turns "
+                    f"cancelled resume/hedge legs into zombies)")
+                continue
             if not isinstance(node, ast.Call):
                 continue
             kwargs = {k.arg for k in node.keywords}

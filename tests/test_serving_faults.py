@@ -1,0 +1,1072 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Gray-failure resilience for the serving fleet (ISSUE 13).
+
+Four connected layers, each tested from the unit up to e2e through
+the pooled proxy against REAL engine-backed servers:
+
+- fault injection (serving/faults.py): rule matching, the
+  KFT_ENABLE_FAULTS=1 refusal, hot reload keeping the last good plan;
+- brownout soft-eject (scaling/endpoints.py BrownoutPolicy): k-MAD
+  outlier conviction, the pool-floor veto, paced shadow picks,
+  recovery readmission, and the balancer tier that skips soft-ejected
+  members;
+- budget-aware hedging (http_proxy.py): the HedgeThrottle rate cap
+  and an e2e proof that the LOSER's connection is closed and a closed
+  connection cancels the engine decode (stats white-box);
+- mid-stream decode resume: the engine's explicit step-key
+  continuation is bitwise (greedy AND sampled), and a stream killed
+  mid-flight through the proxy resumes on a peer with an identical
+  total token sequence and NO in-band error event.
+
+Plus the chaos fuzz the ISSUE requires: a random FaultPlan over a
+3-replica fleet must converge with zero non-structured errors and
+bitwise-correct streams.
+"""
+
+from __future__ import annotations
+
+import http.client
+import io
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from kubeflow_tpu.inference.engine.engine import (  # noqa: E402
+    DecodeEngine,
+    EngineConfig,
+    GenerateStream,
+    TokenEvent,
+)
+from kubeflow_tpu.models.llama import llama_test  # noqa: E402
+from kubeflow_tpu.scaling.balancer import eligible_endpoints  # noqa: E402
+from kubeflow_tpu.scaling.endpoints import (  # noqa: E402
+    BrownoutPolicy,
+    Endpoint,
+    EndpointPool,
+    HealthProber,
+)
+from kubeflow_tpu.serving import faults, wire  # noqa: E402
+from kubeflow_tpu.serving.overload import (  # noqa: E402
+    HedgeThrottle,
+    QuantileWindow,
+)
+
+PROMPT_LEN = 8
+NEW_TOKENS = 6
+CACHE = 32
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    monkeypatch.setenv(faults.ENABLE_ENV, "1")
+
+
+# --- fault plan units -----------------------------------------------------
+
+
+def test_fault_plan_refused_without_env(monkeypatch):
+    monkeypatch.delenv(faults.ENABLE_ENV, raising=False)
+    with pytest.raises(faults.FaultDisabledError):
+        faults.FaultPlan([])
+    with pytest.raises(faults.FaultDisabledError):
+        faults.FaultPlanSource("/tmp/nope.json")
+    # "true"/"0" are NOT the opt-in — only the literal "1".
+    monkeypatch.setenv(faults.ENABLE_ENV, "true")
+    with pytest.raises(faults.FaultDisabledError):
+        faults.FaultPlan([])
+
+
+def test_fault_rule_matching_and_counters(armed):
+    plan = faults.FaultPlan.from_dict({"rules": [{
+        "match": {"route": "generate", "phase": "unary",
+                  "after_n": 2, "every": 2, "max_fires": 2},
+        "action": {"error_code": 503},
+    }]})
+    fired = []
+    for _ in range(10):
+        rule = plan.decide(route="generate", model="m", phase="unary")
+        fired.append(rule is not None)
+    # First 2 matches pass clean, then every 2nd fires, capped at 2.
+    assert fired == [False, False, True, False, True,
+                     False, False, False, False, False]
+    # Phase/route mismatches never count against the rule.
+    assert plan.decide(route="generate", phase="stream") is None
+    assert plan.decide(route="predict", phase="unary") is None
+    stats = plan.stats()
+    assert stats[0]["fired"] == 2
+
+
+def test_fault_rule_unknown_keys_rejected(armed):
+    with pytest.raises(ValueError, match="unknown keys"):
+        faults.FaultRule.from_dict(
+            {"match": {"rout": "x"}, "action": {}})
+    with pytest.raises(ValueError, match="unknown keys"):
+        faults.FaultRule.from_dict(
+            {"action": {"latencyms": 5}})
+    with pytest.raises(ValueError, match="phase"):
+        faults.FaultRule.from_dict(
+            {"match": {"phase": "nope"}, "action": {}})
+
+
+def test_fault_plan_source_hot_reload_keeps_last_good(armed, tmp_path):
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(
+        {"rules": [{"action": {"error_code": 500}}]}))
+    source = faults.FaultPlanSource(str(path))
+    plan = source.plan()
+    assert plan is not None and len(plan.rules) == 1
+    # A half-written rewrite keeps the LAST GOOD plan armed.
+    path.write_text('{"rules": [')
+    assert source.plan() is plan
+    # A valid rewrite swaps in (fresh counters).
+    path.write_text(json.dumps(
+        {"rules": [{"action": {"latency_ms": 5}},
+                   {"action": {"reset": True}}]}))
+    assert len(source.plan().rules) == 2
+    # Missing file: still the last good plan.
+    path.unlink()
+    assert len(source.plan().rules) == 2
+
+
+def test_match_request_is_inert_when_unarmed_and_never_raises(armed):
+    assert faults.match_request({}, route="generate") is None
+
+    class _Broken:
+        def plan(self):
+            raise RuntimeError("boom")
+
+    assert faults.match_request({"fault_source": _Broken()},
+                                route="generate") is None
+
+
+def test_corrupt_blob_flips_one_byte():
+    import base64
+
+    blob = base64.b64encode(b"hello world blob").decode()
+    corrupted = faults.corrupt_b64_blob(blob)
+    assert corrupted != blob
+    a = base64.b64decode(blob)
+    b = base64.b64decode(corrupted)
+    assert len(a) == len(b) and sum(x != y for x, y in zip(a, b)) == 1
+
+
+# --- hedge/latency primitives ---------------------------------------------
+
+
+def test_quantile_window_exact_and_recent_slice():
+    w = QuantileWindow(maxlen=8)
+    assert w.quantile(0.5) is None
+    for v in (1.0, 2.0, 3.0, 4.0, 5.0):
+        w.observe(v)
+    assert w.quantile(0.0) == 1.0
+    assert w.quantile(0.5) == 3.0
+    assert w.quantile(1.0) == 5.0
+    # The recovery check reads only the newest samples.
+    assert w.quantile(0.5, last=2) == 4.5 or \
+        w.quantile(0.5, last=2) in (4.0, 5.0)
+    for v in (6.0, 7.0, 8.0, 9.0):  # rolls the window
+        w.observe(v)
+    assert len(w) == 8 and w.quantile(0.0) == 2.0
+
+
+def test_hedge_throttle_caps_fired_hedges():
+    throttle = HedgeThrottle(0.25, burst=1.0)
+    fired = 0
+    for _ in range(40):
+        throttle.note_request()
+        if throttle.try_acquire():
+            fired += 1
+    # ≤ rate × offered (+ burst), whatever the arrival pattern.
+    assert fired <= 0.25 * 40 + 1.0
+    assert fired >= 5  # and the cap is not a lockout
+    with pytest.raises(ValueError):
+        HedgeThrottle(1.5)
+
+
+# --- brownout policy ------------------------------------------------------
+
+
+def _ep(addr="a:1"):
+    return Endpoint(addr, register_metrics=False)
+
+
+def _feed(ep, latency_s, n=8):
+    for _ in range(n):
+        ep.note_latency(latency_s)
+
+
+def test_brownout_soft_ejects_latency_outlier():
+    pool = EndpointPool()
+    eps = [pool.add(f"h{i}:1") for i in range(3)]
+    _feed(eps[0], 0.010)
+    _feed(eps[1], 0.012)
+    _feed(eps[2], 0.200)  # the 10×-latency brownout replica
+    policy = BrownoutPolicy()
+    policy.evaluate(pool)
+    assert [ep.soft_ejected for ep in eps] == [False, False, True]
+    # Soft-ejected stays routable (graceful) but the balancer tier
+    # skips it while bright members exist.
+    assert eps[2].routable()
+    tier = eligible_endpoints(pool)
+    assert eps[2] not in tier and len(tier) == 2
+    assert eps[2].snapshot()["soft_ejected"] is True
+
+
+def test_brownout_eject_vetoed_at_pool_floor():
+    pool = EndpointPool()
+    eps = [pool.add(f"v{i}:1") for i in range(3)]
+    _feed(eps[0], 0.010)
+    _feed(eps[1], 0.200)
+    _feed(eps[2], 0.250)
+    policy = BrownoutPolicy(min_pool_fraction=0.5)
+    policy.evaluate(pool)
+    # Floor = ceil(3 × 0.5) = 2 bright members: only ONE of the two
+    # slow replicas may be ejected; the other is vetoed.
+    assert sum(ep.soft_ejected for ep in eps) <= 1
+
+
+def test_brownout_does_not_convict_quiet_or_uniform_pools():
+    pool = EndpointPool()
+    eps = [pool.add(f"u{i}:1") for i in range(3)]
+    policy = BrownoutPolicy()
+    policy.evaluate(pool)  # no samples at all: nothing to judge
+    assert not any(ep.soft_ejected for ep in eps)
+    for ep in eps:  # a uniformly slow pool is capacity, not gray
+        _feed(ep, 0.2)
+    policy.evaluate(pool)
+    assert not any(ep.soft_ejected for ep in eps)
+
+
+def test_brownout_stall_strikes_eject():
+    pool = EndpointPool()
+    eps = [pool.add(f"s{i}:1") for i in range(3)]
+    for _ in range(2):
+        eps[1].note_stream_stall()
+    BrownoutPolicy(stall_strikes=2).evaluate(pool)
+    assert eps[1].soft_ejected and not eps[0].soft_ejected
+
+
+def test_shadow_picks_are_paced():
+    ep = _ep()
+    assert not ep.shadow_due(1.0)  # not ejected: no shadow slot
+    ep.soft_eject()
+    now = time.monotonic()
+    assert ep.shadow_due(10.0, now=now)
+    assert not ep.shadow_due(10.0, now=now + 1.0)
+    assert ep.shadow_due(10.0, now=now + 11.0)
+
+
+def test_brownout_readmits_on_recovery():
+    pool = EndpointPool()
+    eps = [pool.add(f"r{i}:1") for i in range(3)]
+    _feed(eps[0], 0.010)
+    _feed(eps[1], 0.012)
+    _feed(eps[2], 0.200)
+    policy = BrownoutPolicy(recover_samples=3)
+    policy.evaluate(pool)
+    assert eps[2].soft_ejected
+    # Shadow picks come back fast: recovery evidence.
+    for _ in range(4):
+        eps[2].note_latency(0.010)
+    policy.evaluate(pool)
+    assert not eps[2].soft_ejected
+    # The all-soft-ejected degenerate pool still routes.
+    for ep in eps:
+        ep.soft_eject()
+    assert len(eligible_endpoints(pool)) == 3
+
+
+# --- prober concurrency satellite -----------------------------------------
+
+
+def test_prober_probes_concurrently_with_per_probe_deadline():
+    """A hung-socket /healthz (accepts, never answers) must cost the
+    CYCLE one bounded window, not timeout_s × hung members — and the
+    hung probe is a strike IMMEDIATELY while healthy members still
+    probe fine in the same cycle."""
+    pool = EndpointPool()
+    eps = [pool.add(f"p{i}:1") for i in range(4)]
+    hung = {eps[1].address, eps[2].address}
+
+    def fetch(ep):
+        if ep.address in hung:
+            time.sleep(5.0)  # the classic gray failure
+        return {"status": "ok", "saturation": {}}
+
+    prober = HealthProber(pool, timeout_s=0.4, eject_after=3,
+                          fetch=fetch)
+    t0 = time.monotonic()
+    prober.probe_all_sync()
+    elapsed = time.monotonic() - t0
+    # One bounded window — far under the 10 s the serial loop with a
+    # per-probe wait would burn on two hung members.
+    assert elapsed < 2.0, f"probe cycle took {elapsed:.1f}s"
+    assert eps[0].probe_failures == 0 and eps[3].probe_failures == 0
+    assert eps[1].probe_failures == 1 and eps[2].probe_failures == 1
+
+
+def test_prober_runs_brownout_after_cycle():
+    pool = EndpointPool()
+    eps = [pool.add(f"b{i}:1") for i in range(3)]
+    _feed(eps[0], 0.01)
+    _feed(eps[1], 0.01)
+    _feed(eps[2], 0.5)
+    prober = HealthProber(
+        pool, fetch=lambda ep: {"status": "ok", "saturation": {}},
+        brownout=BrownoutPolicy())
+    prober.probe_all_sync()
+    # Soft-eject engages within the probe cycle that saw the samples
+    # — the "2 probe-equivalent windows" detection-latency contract.
+    assert eps[2].soft_ejected
+
+
+# --- resume token codec ---------------------------------------------------
+
+
+def test_resume_token_roundtrip_and_validation():
+    prompt = np.arange(5, dtype=np.int32)
+    keys = np.arange(12, dtype=np.uint32).reshape(6, 2)
+    blob = wire.encode_resume_token("m", 3, prompt, keys, 6)
+    doc = wire.decode_resume_token(blob, model="m", version=3)
+    np.testing.assert_array_equal(doc["prompt_tokens"], prompt)
+    np.testing.assert_array_equal(doc["step_keys"], keys)
+    assert doc["max_new_tokens"] == 6
+    with pytest.raises(ValueError, match="model"):
+        wire.decode_resume_token(blob, model="other")
+    with pytest.raises(ValueError, match="version 3"):
+        wire.decode_resume_token(blob, model="m", version=4)
+    with pytest.raises(ValueError, match="malformed"):
+        wire.decode_resume_token(b"garbage", model="m")
+
+
+# --- engine resume continuation (bitwise) ---------------------------------
+
+
+@pytest.fixture(scope="module")
+def toy():
+    model = llama_test(dtype=jnp.float32, cache_size=CACHE)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, PROMPT_LEN), jnp.int32))
+    return model, variables["params"]
+
+
+def _engine(toy, name, temperature=0.8):
+    model, params = toy
+    return DecodeEngine(model, params, EngineConfig(
+        max_new_tokens=NEW_TOKENS, max_prompt_len=PROMPT_LEN,
+        temperature=temperature, num_slots=2, page_size=4,
+        slice_tokens=2, seed=0), name=name)
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.8],
+                         ids=["greedy", "sampled"])
+def test_engine_resume_continuation_bitwise(toy, temperature):
+    """Kill-at-any-point resume: prompt + emitted-so-far + the
+    REMAINING step-key schedule on a PEER engine reproduces exactly
+    the tokens the dead replica would have produced."""
+    eng_a = _engine(toy, f"ra{temperature}", temperature=temperature)
+    eng_b = _engine(toy, f"rb{temperature}", temperature=temperature)
+    try:
+        prompt = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(3), (PROMPT_LEN,), 0, 512))
+        stream = eng_a.submit(prompt, rng=np.asarray(
+            jax.random.PRNGKey(7)))
+        full = stream.result(timeout=120)
+        ctx = stream.resume_ctx
+        assert ctx is not None and len(ctx["step_keys"]) == NEW_TOKENS
+        np.testing.assert_array_equal(ctx["prompt"], prompt)
+        for kill_at in (1, 3, NEW_TOKENS - 1):
+            context = np.concatenate(
+                [prompt, np.asarray(full[:kill_at], np.int32)])
+            resumed = eng_b.submit(
+                context,
+                step_keys=ctx["step_keys"][kill_at:]).result(
+                    timeout=120)
+            np.testing.assert_array_equal(resumed, full[kill_at:])
+    finally:
+        eng_a.stop()
+        eng_b.stop()
+
+
+def test_engine_resume_validation(toy):
+    eng = _engine(toy, "rv")
+    try:
+        prompt = np.asarray([5, 6, 7], np.int32)
+        keys = np.zeros((4, 2), np.uint32)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            eng.submit(prompt, step_keys=keys,
+                       rng=np.zeros(2, np.uint32))
+        with pytest.raises(ValueError, match="resume schedule"):
+            eng.submit(prompt, step_keys=keys, max_new_tokens=9)
+        # The context bound is cache_size - budget, NOT
+        # max_prompt_len: a resume context longer than any legal
+        # prompt is legal as long as the original request fit.
+        long_ctx = np.arange(CACHE - 4 + 1, dtype=np.int32)
+        with pytest.raises(ValueError, match="outside"):
+            eng.submit(long_ctx, step_keys=keys)
+    finally:
+        eng.stop()
+
+
+def test_engine_resume_context_longer_than_max_prompt(toy):
+    """The continuation context (prompt + emitted) legally exceeds
+    max_prompt_len — the resume path prices and prefills it at its
+    exact width instead of clamping to a bucket."""
+    eng_a = _engine(toy, "rl")
+    eng_b = _engine(toy, "rl2")
+    try:
+        prompt = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(5), (PROMPT_LEN,), 0, 512))
+        stream = eng_a.submit(prompt, rng=np.asarray(
+            jax.random.PRNGKey(9)))
+        full = stream.result(timeout=120)
+        kill_at = 4  # context = 8 + 4 = 12 > max_prompt_len = 8
+        context = np.concatenate(
+            [prompt, np.asarray(full[:kill_at], np.int32)])
+        assert len(context) > PROMPT_LEN
+        resumed = eng_b.submit(
+            context,
+            step_keys=stream.resume_ctx["step_keys"][kill_at:]
+        ).result(timeout=120)
+        np.testing.assert_array_equal(resumed, full[kill_at:])
+    finally:
+        eng_a.stop()
+        eng_b.stop()
+
+
+# --- SSE keepalive satellite ----------------------------------------------
+
+
+def test_sse_keepalives_during_inter_token_gaps():
+    """Long engine gaps carry ``: keepalive`` comment frames (so
+    downstream can tell slow from wedged) that stay invisible to the
+    SSE event consumer."""
+    import tornado.testing
+    import tornado.web
+
+    from kubeflow_tpu.serving.server import InferHandler
+
+    stream = GenerateStream(2)
+
+    class _Loaded:
+        version = 1
+
+    class Handler(InferHandler):
+        async def post(self):
+            self._obs_model = "k"
+            await self._stream_generate(
+                "k", None, _Loaded(), None, None, None,
+                {"stream": True}, None, streams=[stream])
+
+    class Case(tornado.testing.AsyncHTTPTestCase):
+        def get_app(self):
+            return tornado.web.Application(
+                [(r"/s", Handler)], sse_keepalive_s=0.05)
+
+        def runTest(self):
+            def feed():
+                time.sleep(0.35)
+                stream._emit(TokenEvent(token=7, index=0))
+                time.sleep(0.35)
+                stream._emit(TokenEvent(token=8, index=1))
+                stream._finish(np.asarray([7, 8], np.int32))
+
+            threading.Thread(target=feed, daemon=True).start()
+            resp = self.fetch("/s", method="POST", body="{}",
+                              request_timeout=30)
+            body = resp.body.decode()
+            assert body.count(": keepalive") >= 2, body
+            events = list(wire.iter_sse_events(
+                io.BytesIO(resp.body)))
+            assert [e for e, _ in events] == ["token", "token",
+                                              "done"]
+            assert events[-1][1]["tokens"] == [[7, 8]]
+
+    case = Case("runTest")
+    result = case.run()
+    errors = (result.errors + result.failures) if result else []
+    assert not errors, errors
+
+
+# --- real-fleet e2e -------------------------------------------------------
+
+
+def _export_toy(base, temperature, seed):
+    from kubeflow_tpu.serving.export import export_model
+    from kubeflow_tpu.serving.manager import ModelManager  # noqa: F401
+    from kubeflow_tpu.serving.signature import (
+        ModelMetadata,
+        Signature,
+        TensorSpec,
+    )
+
+    model = llama_test(dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, PROMPT_LEN), jnp.int32))
+    meta = ModelMetadata(
+        model_name=base.name, registry_name="llama-test",
+        model_kwargs={"dtype": "float32", "cache_size": CACHE},
+        signatures={"serving_default": Signature(
+            "generate",
+            {"input_ids": TensorSpec("int32", (-1, PROMPT_LEN))},
+            {"tokens": TensorSpec("int32", (-1, NEW_TOKENS))})},
+        generate_config={"max_new_tokens": NEW_TOKENS,
+                         "temperature": temperature, "seed": seed,
+                         "deterministic": True,
+                         "engine_slots": 2, "engine_page_size": 8,
+                         "engine_slice_tokens": 2})
+    export_model(str(base), 1, meta, {"params": variables["params"]})
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    """Three REAL engine-backed servers (every one armed with the
+    same hot-reloaded fault plan file) + the pooled proxy. Serves two
+    models: ``m`` (sampled, temperature 0.8) and ``g`` (greedy)."""
+    import os
+
+    import tornado.ioloop
+
+    os.environ[faults.ENABLE_ENV] = "1"
+    root = tmp_path_factory.mktemp("faultfleet")
+    _export_toy(root / "m", 0.8, 11)
+    _export_toy(root / "g", 0.0, 11)
+    plan_path = root / "plan.json"
+    plan_path.write_text(json.dumps({"rules": []}))
+
+    from kubeflow_tpu.serving.http_proxy import make_app as proxy_app
+    from kubeflow_tpu.serving.manager import ModelManager
+    from kubeflow_tpu.serving.server import make_app as rest_app
+
+    def serve(factory, holder, started):
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        server = factory().listen(0)
+        holder["port"] = next(iter(
+            server._sockets.values())).getsockname()[1]
+        holder["loop"] = tornado.ioloop.IOLoop.current()
+        started.set()
+        holder["loop"].start()
+
+    managers, holders = [], []
+    for i in range(3):
+        mgr = ModelManager(poll_interval_s=3600)
+        mgr.add_model("m", str(root / "m"), max_batch=4,
+                      continuous_batching=True)
+        mgr.add_model("g", str(root / "g"), max_batch=4,
+                      continuous_batching=True)
+        managers.append(mgr)
+        holder, started = {}, threading.Event()
+        threading.Thread(
+            target=serve,
+            args=(lambda m=mgr: rest_app(
+                m, fault_plan=str(plan_path), sse_keepalive_s=0.5),
+                holder, started),
+            daemon=True).start()
+        assert started.wait(120)
+        holders.append(holder)
+
+    pool = EndpointPool()
+    for holder in holders:
+        pool.add(f"127.0.0.1:{holder['port']}")
+    proxy, started = {}, threading.Event()
+    threading.Thread(
+        target=serve,
+        args=(lambda: proxy_app(pool=pool, probe_interval_s=3600.0,
+                                stream_stall_timeout_s=1.5,
+                                brownout=False), proxy, started),
+        daemon=True).start()
+    assert started.wait(60)
+    yield {"proxy": proxy, "holders": holders, "managers": managers,
+           "pool": pool, "plan_path": plan_path, "nonce": [0]}
+    plan_path.write_text(json.dumps({"rules": []}))
+    for holder in holders + [proxy]:
+        holder["loop"].add_callback(holder["loop"].stop)
+    for mgr in managers:
+        mgr.stop()
+
+
+def _arm(fleet_, rules):
+    """Rewrite the shared plan file. The nonce seed changes the
+    content so every server hot-reloads a FRESH plan (counters
+    reset)."""
+    fleet_["nonce"][0] += 1
+    fleet_["plan_path"].write_text(json.dumps(
+        {"seed": fleet_["nonce"][0], "rules": rules}))
+
+
+def _prompt_rows(n, seed=1):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 512, (n, PROMPT_LEN)).tolist()
+
+
+def _unary_direct(port, model, rows, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/{model}:generate",
+        data=json.dumps({"instances": rows}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        body = json.load(r)
+    return [p["tokens"] for p in body["predictions"]]
+
+
+def _stream_events(port, model, rows, timeout=120, deadline_ms=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    headers = {"Content-Type": "application/json"}
+    if deadline_ms:
+        headers["X-Deadline-Ms"] = str(deadline_ms)
+    conn.request("POST", f"/model/{model}:generate",
+                 body=json.dumps({"instances": rows,
+                                  "stream": True}),
+                 headers=headers)
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.read()
+    events = list(wire.iter_sse_events(resp))
+    conn.close()
+    return events
+
+
+def _check_grammar(events):
+    """token* error* per row, exactly one terminal done; per-row
+    indexes strictly sequential with no duplicates."""
+    next_index = {}
+    assert [e for e, _ in events].count("done") == 1, events
+    assert events[-1][0] == "done", events
+    for event, data in events:
+        if event == "token":
+            r = data["row"]
+            assert data["index"] == next_index.get(r, 0), (
+                f"row {r} index {data['index']} != "
+                f"{next_index.get(r, 0)}")
+            next_index[r] = data["index"] + 1
+
+
+@pytest.mark.parametrize("model", ["m", "g"],
+                         ids=["sampled", "greedy"])
+def test_stream_killed_mid_flight_resumes_bitwise(fleet, model):
+    """THE resume acceptance: a decode stream killed after N events
+    resumes on a peer with a bitwise-identical total sequence and NO
+    in-band error event — verified through the pooled proxy against
+    real servers, greedy and sampled."""
+    from kubeflow_tpu.serving.http_proxy import _P_RESUMES
+
+    rows = _prompt_rows(2, seed=7)
+    _arm(fleet, [])
+    ref = _unary_direct(fleet["holders"][0]["port"], model, rows)
+    resumed_before = _P_RESUMES.labels("resumed").get()
+    _arm(fleet, [{"match": {"route": "generate", "phase": "stream"},
+                  "action": {"kill_after_events": 3}}])
+    events = _stream_events(fleet["proxy"]["port"], model, rows)
+    _check_grammar(events)
+    assert not [d for e, d in events if e == "error"], events
+    done = [d for e, d in events if e == "done"][0]
+    assert done["tokens"] == ref
+    # Token events stitch seamlessly too: per-row sequence ==
+    # done's arrays (no duplicates, no gap at the kill point).
+    for r in range(len(rows)):
+        toks = [d["token"] for e, d in events
+                if e == "token" and d["row"] == r]
+        assert toks == ref[r][:len(toks)]
+    assert _P_RESUMES.labels("resumed").get() > resumed_before
+
+
+def test_stream_stall_watchdog_resumes(fleet):
+    """Accept-then-hang mid-stream (slow-drip far past the keepalive
+    cadence): the relay's inter-chunk watchdog abandons the wedged
+    leg and resumes on a peer — same bitwise contract."""
+    rows = _prompt_rows(1, seed=9)
+    _arm(fleet, [])
+    ref = _unary_direct(fleet["holders"][0]["port"], "m", rows)
+    _arm(fleet, [{"match": {"route": "generate", "phase": "stream",
+                            "max_fires": 1},
+                  "action": {"stall_after_events": 2,
+                             "stall_ms": 30000}}])
+    t0 = time.monotonic()
+    events = _stream_events(fleet["proxy"]["port"], "m", rows)
+    elapsed = time.monotonic() - t0
+    _check_grammar(events)
+    assert not [d for e, d in events if e == "error"], events
+    done = [d for e, d in events if e == "done"][0]
+    assert done["tokens"] == ref
+    # The watchdog moved on at ~stream_stall_timeout (1.5 s), far
+    # before the injected 30 s wedge would have released an event.
+    assert elapsed < 20.0, f"stalled stream took {elapsed:.1f}s"
+
+
+def test_unary_fault_injection_and_failover(fleet):
+    """Connection-reset faults: the proxy fails over replica to
+    replica (every leg's reset is the shared plan fired once per
+    server), the exhausted request maps to a STRUCTURED error, and
+    once the fault budget is spent the fleet serves again."""
+    from kubeflow_tpu.serving.http_proxy import _P_ROUTER_FAILOVERS
+
+    rows = _prompt_rows(1, seed=3)
+    _arm(fleet, [])
+    ref = _unary_direct(fleet["holders"][0]["port"], "m", rows)
+    _arm(fleet, [{"match": {"route": "generate", "phase": "unary",
+                            "max_fires": 1},
+                  "action": {"reset": True}}])
+    failovers0 = _P_ROUTER_FAILOVERS.labels().get()
+
+    def post():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fleet['proxy']['port']}"
+            f"/model/m:generate",
+            data=json.dumps({"instances": rows}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Deadline-Ms": "60000"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.load(r)
+
+    try:
+        body = post()
+        # A leg survived (some server's rule already spent): clean.
+        assert [p["tokens"] for p in body["predictions"]] == ref
+    except urllib.error.HTTPError as e:
+        # Every replica reset once: structured 502, never a raw
+        # connection error to the CLIENT.
+        assert e.code == 502
+        assert "error" in json.loads(e.read())
+    # The router actually moved the request across replicas.
+    assert _P_ROUTER_FAILOVERS.labels().get() >= failovers0 + 2
+    # Fault budget spent: the next request is served clean.
+    assert [p["tokens"] for p in post()["predictions"]] == ref
+
+
+def test_connection_close_cancels_engine_decode(fleet):
+    """The hedge-loser cancellation contract, engine-stats white-box:
+    closing a unary :generate's connection mid-service cancels the
+    decode (the server's close handler + the on_streams registration
+    guard) instead of burning slots into a dead socket."""
+    from kubeflow_tpu.inference.engine.engine import _M_RETIRED
+
+    def cancelled_count():
+        # Either retire path proves the cancel: dropped at the
+        # queued-cancel sweep (never burned a prefill) or retired
+        # from a live slot at the next slice boundary.
+        return (_M_RETIRED.labels("m", "cancelled_queued").get()
+                + _M_RETIRED.labels("m", "cancelled").get())
+
+    _arm(fleet, [{"match": {"route": "generate", "phase": "unary",
+                            "max_fires": 1},
+                  "action": {"latency_ms": 600}}])
+    holder = fleet["holders"][0]
+    before = cancelled_count()
+    conn = http.client.HTTPConnection("127.0.0.1", holder["port"],
+                                      timeout=30)
+    conn.request(
+        "POST", "/v1/models/m:generate",
+        body=json.dumps({"instances": _prompt_rows(1, seed=4)}),
+        headers={"Content-Type": "application/json"})
+    time.sleep(0.15)  # the injected latency holds the request
+    conn.close()      # ... and the client walks away
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if cancelled_count() > before:
+            return
+        time.sleep(0.1)
+    pytest.fail(f"no cancelled retirement observed "
+                f"(count {cancelled_count()})")
+
+
+def test_chaos_fuzz_converges_with_structured_errors_only(fleet):
+    """The ISSUE's chaos fuzz: a random FaultPlan over the 3-replica
+    fleet — latency, flaky 5xx, resets, accept-then-hang, mid-stream
+    kills — must converge with ZERO non-structured errors (every
+    failure the client sees is a JSON body with error+code, every
+    stream keeps its grammar), bitwise-correct streams whenever no
+    in-band error was surfaced, and no breaker left flapped open by
+    stalls the fleet itself caused."""
+    rng = np.random.RandomState(1234)
+    rules = [
+        {"match": {"phase": "unary",
+                   "probability": round(float(rng.uniform(0.2, 0.4)),
+                                        2)},
+         "action": {"latency_ms": int(rng.randint(20, 80))}},
+        {"match": {"phase": "unary", "every": 6, "max_fires": 3},
+         "action": {"error_code": 503}},
+        {"match": {"phase": "unary", "every": 9, "max_fires": 2},
+         "action": {"reset": True}},
+        {"match": {"phase": "unary", "every": 11, "max_fires": 2},
+         "action": {"stall_ms": 250}},
+        {"match": {"phase": "stream", "every": 2, "max_fires": 4},
+         "action": {"kill_after_events": int(rng.randint(1, 5))}},
+    ]
+    _arm(fleet, [])
+    refs = {}
+    for model in ("m", "g"):
+        rows = _prompt_rows(2, seed=21)
+        refs[model] = (rows,
+                       _unary_direct(fleet["holders"][0]["port"],
+                                     model, rows))
+    _arm(fleet, rules)
+    port = fleet["proxy"]["port"]
+    unary_ok = unary_structured = streams_ok = 0
+    for i in range(30):
+        model = "m" if i % 2 == 0 else "g"
+        rows, ref = refs[model]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/model/{model}:generate",
+            data=json.dumps({"instances": rows}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Deadline-Ms": "60000"})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                body = json.load(r)
+            assert [p["tokens"] for p in body["predictions"]] == ref
+            unary_ok += 1
+        except urllib.error.HTTPError as e:
+            # A structured shed/unavailable is an acceptable outcome
+            # under chaos; anything unparseable is a test failure.
+            payload = json.loads(e.read())
+            assert "error" in payload, payload
+            assert e.code in (429, 502, 503, 504), e.code
+            unary_structured += 1
+        time.sleep(0.02)
+    for i in range(8):
+        model = "m" if i % 2 == 0 else "g"
+        rows, ref = refs[model]
+        events = _stream_events(port, model, rows,
+                                deadline_ms=60000)
+        _check_grammar(events)
+        if not [d for e, d in events if e == "error"]:
+            done = [d for e, d in events if e == "done"][0]
+            assert done["tokens"] == ref, f"stream {i} diverged"
+            streams_ok += 1
+    # Convergence: every request is accounted for — served clean or
+    # failed STRUCTURED (the zero-non-structured-errors bar) — the
+    # majority are served (resets/stalls fail over; kills resume),
+    # and streams that completed cleanly are bitwise right.
+    assert unary_ok + unary_structured == 30
+    assert unary_ok >= 18, (unary_ok, unary_structured)
+    assert streams_ok >= 6, streams_ok
+    # No breaker flaps: bounded fault fire-counts never tripped the
+    # consecutive-failure threshold, and downstream-caused stalls
+    # were never charged to upstream breakers.
+    for ep in fleet["pool"].endpoints():
+        assert ep.rest_breaker.state == "closed", (
+            ep.address, ep.rest_breaker.state)
+    _arm(fleet, [])
+
+
+# --- budget-aware hedging (proxy-level, deterministic stubs) --------------
+
+
+class _HedgeStubs:
+    """Two unary :generate upstreams on one IOLoop thread: A can be
+    made slow and RECORDS whether its in-flight request's connection
+    was closed under it (the loser-cancellation proof); B answers
+    fast with a distinguishable body."""
+
+    def __init__(self):
+        self.started = threading.Event()
+        self.ports = {}
+        self.loop = None
+        self.slow_s = {"a": 0.0}
+        self.closed = threading.Event()
+        self.hits = {"a": 0, "b": 0}
+
+    def _app(self, tag):
+        import tornado.web
+
+        outer = self
+
+        class Gen(tornado.web.RequestHandler):
+            async def post(self, name):
+                import asyncio
+
+                outer.hits[tag] += 1
+                delay = outer.slow_s.get(tag, 0.0)
+                waited = 0.0
+                while waited < delay:
+                    await asyncio.sleep(0.05)
+                    waited += 0.05
+                    stream = self.request.connection.stream
+                    if stream is None or stream.closed():
+                        outer.closed.set()
+                        return
+                self.write(json.dumps({"predictions": [
+                    {"tokens": [ord(tag)] * 3}]}))
+
+        class Meta(tornado.web.RequestHandler):
+            def get(self, name):
+                self.write({
+                    "model_spec": {"name": name, "version": "1"},
+                    "metadata": {"signatures": {"serving_default": {
+                        "method": "generate",
+                        "inputs": {"input_ids": {
+                            "dtype": "int32", "shape": [-1, 3]}},
+                        "outputs": {"tokens": {
+                            "dtype": "int32", "shape": [-1, 3]}},
+                    }}},
+                })
+
+        return tornado.web.Application([
+            (r"/v1/models/([^/:]+):generate", Gen),
+            (r"/v1/models/([^/:]+)/metadata", Meta),
+        ])
+
+    def __enter__(self):
+        import asyncio
+
+        import tornado.ioloop
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            for tag in ("a", "b"):
+                server = self._app(tag).listen(0)
+                self.ports[tag] = next(iter(
+                    server._sockets.values())).getsockname()[1]
+            self.loop = tornado.ioloop.IOLoop.current()
+            self.started.set()
+            self.loop.start()
+
+        threading.Thread(target=run, daemon=True).start()
+        assert self.started.wait(15)
+        return self
+
+    def __exit__(self, *exc):
+        self.loop.add_callback(self.loop.stop)
+
+
+def _hedge_proxy(stubs, hedge_rate):
+    import asyncio
+
+    import tornado.ioloop
+
+    from kubeflow_tpu.serving.http_proxy import make_app
+
+    pool = EndpointPool()
+    ep_a = pool.add(f"127.0.0.1:{stubs.ports['a']}")
+    ep_b = pool.add(f"127.0.0.1:{stubs.ports['b']}")
+    # Pin the primary pick: B advertises saturation, so
+    # least-saturation always places first on A.
+    ep_b.saturation = {"x": {"queue_depth": 50,
+                             "est_batch_latency_ms": 100.0}}
+    started = threading.Event()
+    holder = {"pool": pool}
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        app = make_app(pool=pool, probe_interval_s=3600.0,
+                       hedge_rate=hedge_rate, brownout=False)
+        server = app.listen(0)
+        holder["port"] = next(iter(
+            server._sockets.values())).getsockname()[1]
+        holder["loop"] = tornado.ioloop.IOLoop.current()
+        holder["app"] = app
+        started.set()
+        holder["loop"].start()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(15)
+    return holder
+
+
+def _hedge_post(port, deadline_ms=15000, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/model/x:generate",
+        data=json.dumps({"instances": [[1, 2, 3]]}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Deadline-Ms": str(deadline_ms)})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+def test_hedge_fires_first_response_wins_loser_closed():
+    from kubeflow_tpu.serving.http_proxy import _P_HEDGES
+
+    with _HedgeStubs() as stubs:
+        proxy = _hedge_proxy(stubs, hedge_rate=1.0)
+        try:
+            # Prime the p95 window past HEDGE_MIN_SAMPLES with fast
+            # round trips (hedging stays asleep meanwhile).
+            for _ in range(6):
+                _hedge_post(proxy["port"])
+            fired0 = _P_HEDGES.labels("fired").get()
+            won0 = _P_HEDGES.labels("won").get()
+            stubs.slow_s["a"] = 8.0  # brownout the primary
+            t0 = time.monotonic()
+            body = _hedge_post(proxy["port"])
+            elapsed = time.monotonic() - t0
+            # The hedge answered: B's body, long before A's 8 s.
+            assert body["predictions"][0]["tokens"] == [ord("b")] * 3
+            assert elapsed < 5.0, f"hedge took {elapsed:.1f}s"
+            assert _P_HEDGES.labels("fired").get() == fired0 + 1
+            assert _P_HEDGES.labels("won").get() == won0 + 1
+            # The loser's connection was CLOSED under it.
+            assert stubs.closed.wait(10), \
+                "loser connection never closed"
+        finally:
+            proxy["loop"].add_callback(proxy["loop"].stop)
+
+
+def test_hedge_rate_cap_holds_under_fleet_slowdown():
+    """When EVERY request looks hedge-worthy (the retry-storm trap),
+    fired hedges stay ≤ rate × offered + burst."""
+    from kubeflow_tpu.serving.http_proxy import _P_HEDGES
+
+    with _HedgeStubs() as stubs:
+        proxy = _hedge_proxy(stubs, hedge_rate=0.2)
+        try:
+            for _ in range(6):
+                _hedge_post(proxy["port"])
+            fired0 = _P_HEDGES.labels("fired").get()
+            stubs.slow_s["a"] = 0.6  # uniformly slow primary
+            offered = 10
+            for _ in range(offered):
+                _hedge_post(proxy["port"])
+            fired = _P_HEDGES.labels("fired").get() - fired0
+            assert fired <= 0.2 * offered + 2.0, fired
+            assert fired >= 1  # the cap throttles, not disables
+        finally:
+            proxy["loop"].add_callback(proxy["loop"].stop)
+
+
+def test_hedge_needs_ample_budget():
+    """A tight deadline (< HEDGE_FACTOR × p95) never hedges — the
+    twin could not finish in time anyway."""
+    from kubeflow_tpu.serving.http_proxy import _P_HEDGES
+
+    with _HedgeStubs() as stubs:
+        stubs.slow_s["a"] = 0.3
+        proxy = _hedge_proxy(stubs, hedge_rate=1.0)
+        try:
+            for _ in range(6):
+                _hedge_post(proxy["port"], deadline_ms=15000)
+            fired0 = _P_HEDGES.labels("fired").get()
+            # p95 ≈ 300 ms → needs > 1.2 s budget; give 50 ms less
+            # than nothing ample.
+            try:
+                _hedge_post(proxy["port"], deadline_ms=900)
+            except urllib.error.HTTPError:
+                pass  # the primary may legitimately 504 under it
+            assert _P_HEDGES.labels("fired").get() == fired0
+        finally:
+            proxy["loop"].add_callback(proxy["loop"].stop)
