@@ -591,6 +591,8 @@ def _telemetry_payload(settings, api, namespace: str) -> Dict[str, Any]:
         if collector is not None:
             payload["collector"] = collector.state()
             payload["exemplars"] = collector.store.exemplars()[:32]
+            payload["tenants"] = tenant_rows_from_store(
+                collector.store)
         if alerts is not None:
             payload.update(alerts.state())
         return payload
@@ -607,6 +609,68 @@ def _telemetry_payload(settings, api, namespace: str) -> Dict[str, Any]:
                          "dashboard with --collect_endpoints/"
                          "--collect_static, or run the collector "
                          "sidecar)"}
+
+
+def tenant_rows_from_store(store, now=None,
+                           window_s: float = 300.0):
+    """Per-tenant rate rows from the collector's store (ISSUE 14):
+    offered load, quota/overload sheds, expiries and delivered
+    decode tokens, summed across replicas (the ``kft_tenant_*``
+    families are cardinality-capped at the serving layer, so this
+    is bounded at top-K + 'other' rows per process). Malformed or
+    absent data degrades to an empty list — never a 500."""
+    import time as _time
+
+    now = _time.monotonic() if now is None else now
+    rows: Dict[str, Dict[str, Any]] = {}
+
+    def add(metric: str, field: str, reason=None) -> None:
+        for labels_key, rate in store.rate(metric, window_s,
+                                           now).items():
+            labels = dict(labels_key)
+            tenant = labels.get("tenant")
+            if tenant is None:
+                continue
+            if reason is not None and labels.get("reason") != reason:
+                continue
+            row = rows.setdefault(tenant, {"tenant": tenant})
+            row[field] = round(row.get(field, 0.0) + rate, 4)
+
+    try:
+        add("kft_tenant_requests_total", "requests_per_s")
+        add("kft_tenant_shed_total", "quota_shed_per_s", "quota")
+        add("kft_tenant_shed_total", "overload_shed_per_s",
+            "overload")
+        add("kft_tenant_expired_total", "expired_per_s")
+        add("kft_tenant_decode_tokens_total", "decode_tokens_per_s")
+    except Exception:  # noqa: BLE001 — a malformed store snapshot
+        # degrades to "no rows", same contract as the fleet page.
+        logger.warning("tenant rows computation failed",
+                       exc_info=True)
+        return []
+    return sorted(rows.values(),
+                  key=lambda r: -r.get("requests_per_s", 0.0))
+
+
+class TenantsHandler(BaseHandler):
+    """Per-tenant serving telemetry (ISSUE 14): shed/quota/usage
+    rates from the in-process collector store. Requires the dashboard
+    to run its collector (--collect_endpoints/--collect_static);
+    without one the endpoint answers 404 with the wiring hint —
+    malformed data degrades to empty rows, never a 500."""
+
+    async def get(self):
+        collector = self.application.settings.get("collector")
+        if collector is None:
+            return self.write_json(
+                {"available": False,
+                 "error": "no in-process collector (start the "
+                          "dashboard with --collect_endpoints/"
+                          "--collect_static to aggregate the "
+                          "kft_tenant_* families)"}, 404)
+        rows = await tornado.ioloop.IOLoop.current().run_in_executor(
+            None, tenant_rows_from_store, collector.store)
+        self.write_json({"available": True, "tenants": rows})
 
 
 class SloHandler(BaseHandler):
@@ -1021,6 +1085,16 @@ docs/observability.md).</p>
 {target_rows}
 </table>
 <p>{store_line}</p>
+<h2>Tenants</h2>
+<table>
+<tr><th>Tenant</th><th>Requests/s</th><th>Quota shed/s</th>
+<th>Overload shed/s</th><th>Expired/s</th><th>Tokens/s</th></tr>
+{tenant_rows}
+</table>
+<p>Per-tenant rates over the last 5 minutes (cardinality-capped at
+the serving layer: top-K tenants + an <code>other</code> overflow
+bucket — docs/tenancy.md). JSON:
+<a href="/tpujobs/api/tenants">/tpujobs/api/tenants</a></p>
 <h2>Exemplars</h2>
 <table>
 <tr><th>Histogram</th><th>le</th><th>Instance</th><th>Value</th>
@@ -1121,6 +1195,19 @@ def _health_page_html(payload: Dict[str, Any]) -> str:
             f"<td>{float(e.get('value', 0)):.4f}</td>"
             f"<td><a href=\"{html.escape(tracez)}\"><code>"
             f"{html.escape(trace_id[:16])}</code></a></td></tr>")
+    tenant_rows = []
+    for row in payload.get("tenants", ()):
+        tenant_rows.append(
+            "<tr>"
+            f"<td><code>{html.escape(str(row.get('tenant', '?')))}"
+            f"</code></td>"
+            f"<td>{float(row.get('requests_per_s', 0) or 0):.2f}</td>"
+            f"<td>{float(row.get('quota_shed_per_s', 0) or 0):.2f}</td>"
+            f"<td>{float(row.get('overload_shed_per_s', 0) or 0):.2f}"
+            f"</td>"
+            f"<td>{float(row.get('expired_per_s', 0) or 0):.2f}</td>"
+            f"<td>{float(row.get('decode_tokens_per_s', 0) or 0):.1f}"
+            f"</td></tr>")
     return _HEALTH_PAGE.format(
         alert_banner=alert_banner,
         slo_rows="\n".join(slo_rows)
@@ -1128,6 +1215,8 @@ def _health_page_html(payload: Dict[str, Any]) -> str:
         target_rows="\n".join(target_rows)
         or "<tr><td colspan=6>none</td></tr>",
         store_line=store_line,
+        tenant_rows="\n".join(tenant_rows)
+        or "<tr><td colspan=6>no tenant traffic observed</td></tr>",
         exemplar_rows="\n".join(exemplar_rows)
         or "<tr><td colspan=5>none yet</td></tr>")
 
@@ -1263,6 +1352,7 @@ def make_app(api, trace_root: str = DEFAULT_TRACE_ROOT,
         (r"/tpujobs/api/spans", ChromeTraceHandler),
         (r"/tpujobs/api/operator", OperatorMetricsHandler),
         (r"/tpujobs/api/fleet", FleetHandler),
+        (r"/tpujobs/api/tenants", TenantsHandler),
         (r"/tpujobs/api/slo", SloHandler),
         (r"/tpujobs/ui/?", UIHandler),
         (r"/tpujobs/ui/health", FleetHealthUIHandler),

@@ -73,6 +73,7 @@ from kubeflow_tpu.inference.generate import (
 )
 from kubeflow_tpu.obs import metrics as obs_metrics
 from kubeflow_tpu.obs.tracing import TRACER
+from kubeflow_tpu.serving import tenancy
 from kubeflow_tpu.serving.overload import (
     DeadlineExceededError,
     LatencyEstimator,
@@ -328,6 +329,11 @@ class _Request:
     stream: GenerateStream
     submitted_at: float
     request_id: str = ""
+    #: Tenant identity (ISSUE 14): names this request's weighted-fair
+    #: sub-queue and tags its TTFT/usage metrics. Empty = the default
+    #: tenant (single-tenant deployments — one sub-queue, bitwise the
+    #: old FIFO).
+    tenant: str = ""
     #: Adopt-don't-prefill: the request arrives WITH its prefilled
     #: cache (role-split KV handoff); admission copies the pages in
     #: and decode starts at the first slice.
@@ -505,6 +511,9 @@ class DecodeEngine:
             num_pages=config.num_pages, mesh=mesh)
         self.scheduler = SlotScheduler(config.num_slots,
                                        self.kv.allocator)
+        #: Tenant-quota weights for the fair admission queue (ISSUE
+        #: 14): ``set_tenant_weights`` installs the registry's
+        #: ``weight(tenant)`` lookup; unset, every tenant weighs 1.0.
         #: Cross-request prefix cache (prefix_cache.py) or None. Built
         #: here so the allocator's retained-page custody is wired
         #: before the first admission.
@@ -556,6 +565,14 @@ class DecodeEngine:
                 self.prefix.resident_pages)
 
     # -- submit side -----------------------------------------------------
+
+    def set_tenant_weights(self,
+                           weight_of: Optional[Callable[[str], float]]
+                           ) -> None:
+        """Install the tenant-quota weight lookup the fair admission
+        queue drains by (idempotent; safe while traffic flows — the
+        queue reads it per scheduling decision)."""
+        self.scheduler.pending.weight_of = weight_of
 
     def _next_key(self) -> np.ndarray:
         base = jax.random.PRNGKey(self.config.seed)
@@ -678,6 +695,7 @@ class DecodeEngine:
                deadline: Optional[float] = None,
                obs_ctx: Any = None,
                request_id: str = "",
+               tenant: str = "",
                handoff: Optional[PrefillHandoff] = None,
                step_keys: Optional[np.ndarray] = None
                ) -> GenerateStream:
@@ -811,11 +829,23 @@ class DecodeEngine:
                 f"{self.kv.page_size}) but the pool has only "
                 f"{usable} — raise engine_num_pages or lower the "
                 f"request budget")
+        tenant = tenant or tenancy.DEFAULT_TENANT
         if self.scheduler.queue_depth() >= self.config.queue_capacity:
+            # Attributable shed (ISSUE 14 satellite): global depth
+            # alone can't tell an operator WHOSE burst filled the
+            # queue — name the submitting tenant's own depth and the
+            # top queue holder so the 503 (and batch_stats) point at
+            # the noisy neighbor, not just at "full".
+            depths = self.scheduler.tenant_depths()
+            top = max(depths.items(), key=lambda kv: kv[1],
+                      default=(tenant, 0))
             self._m_shed.inc()
+            tenancy.note_shed(tenant, "overload")
             raise OverloadedError(
                 f"engine queue full "
-                f"({self.config.queue_capacity} pending)",
+                f"({self.config.queue_capacity} pending; tenant "
+                f"{tenant!r} holds {depths.get(tenant, 0)}, top "
+                f"holder {top[0]!r} with {top[1]})",
                 retry_after_s=self.estimated_ttft_s())
         now = time.monotonic()
         if deadline is not None:
@@ -833,6 +863,7 @@ class DecodeEngine:
                 est = max(0.0, est - self._prefill_est.estimate_s())
             if est > remaining * ADMISSION_SAFETY:
                 self._m_shed.inc()
+                tenancy.note_shed(tenant, "overload")
                 raise OverloadedError(
                     f"engine overloaded: estimated time-to-first-"
                     f"token {est * 1e3:.0f}ms exceeds remaining "
@@ -861,7 +892,8 @@ class DecodeEngine:
         req = _Request(prompt=prompt, step_keys=step_keys,
                        max_new_tokens=budget, deadline=deadline,
                        stream=stream, submitted_at=now,
-                       request_id=request_id, handoff=handoff)
+                       request_id=request_id, tenant=tenant,
+                       handoff=handoff)
         with self._cv:
             if self._closed:
                 raise RuntimeError("engine is stopped")
@@ -939,6 +971,11 @@ class DecodeEngine:
             "page_size": self.kv.page_size,
             "page_occupancy": round(self.page_occupancy(), 4),
             "est_ttft_ms": round(self.estimated_ttft_s() * 1e3, 3),
+            # Per-tenant queue depths (ISSUE 14): the attribution for
+            # queue-full sheds, rides healthz → dashboard/autoscaler
+            # (capped: top-K + 'other', like every reporting surface).
+            "tenant_queue_depths": tenancy.cap_depths(
+                self.scheduler.tenant_depths()),
         }
         if self.prefix is not None:
             out["prefix_cache"] = self.prefix.stats()
@@ -1022,14 +1059,13 @@ class DecodeEngine:
         # swap would silently drop a concurrently submitted request.
         with self._cv:
             expired = self.scheduler.expired_pending()
-            dead = [r for r in self.scheduler.pending
-                    if r.stream.cancelled]
-            for r in dead:
-                self.scheduler.pending.remove(r)
+            dead = self.scheduler.pending.remove_if(
+                lambda r: r.stream.cancelled)
         for req in expired:
             req.stream._fail(DeadlineExceededError(
                 "deadline expired while queued for a slot"))
             _M_RETIRED.labels(self.name, "expired_queued").inc()
+            tenancy.note_expired(req.tenant or tenancy.DEFAULT_TENANT)
         for req in dead:
             # Client hung up while still queued: never burn a prefill
             # or a slot on it.
@@ -1059,37 +1095,52 @@ class DecodeEngine:
                 return
 
     def _admit_one_prefix(self) -> bool:
-        """One admission attempt in prefix-cache mode: match the FIFO
-        head's prompt, pin the matched resident pages, and reserve
-        only the private remainder. A failed reservation UNPINS
-        before holding the line — the head never deadlocks the FIFO
-        against its own pins (every page it waits for is then either
-        free, evictable, or held by a live slot that will retire)."""
+        """One admission attempt in prefix-cache mode: in fair-
+        queueing order, match each tenant head's prompt, pin the
+        matched resident pages, and reserve only the private
+        remainder; the first head whose reservation fits admits. A
+        failed reservation UNPINS before moving on — a head never
+        deadlocks the queue against its own pins (every page it waits
+        for is then either free, evictable, or held by a live slot
+        that will retire), and it holds the line for ITS tenant only
+        (unchanged, no fair-share charge — it keeps first claim on
+        freed pages) while other tenants' heads still admit."""
         sched = self.scheduler
         if not sched.pending or not sched.has_free_slot():
             return False
-        head = sched.pending[0]
-        total = self._budget_pages(head)
-        match = self.prefix.match(head.prompt)
-        if head.handoff is not None:
-            # A handoff arrives with its whole prefill — full-block
-            # sharing still saves pages, but a boundary fork has
-            # nothing to copy that the carried cache doesn't already
-            # hold, and a placeholder prompt (no tokens in the blob)
-            # must not "match" zeros.
-            entries = (match.entries
-                       if head.handoff.prompt_tokens is not None
-                       else [])
-            match = PrefixMatch(
-                entries=entries, fork=None, fork_len=0,
-                matched=len(entries) * self.kv.page_size)
-        match = self.prefix.pin(match)
-        if not self.kv.allocator.reserve(total - len(match.entries)):
-            self.prefix.unpin(match)
-            return False  # FIFO holds; nothing stays pinned
-        sched.pending.popleft()
-        self._prefill_and_bind_prefix(head, match)
-        return True
+        for i, head in enumerate(sched.pending.heads()):
+            total = self._budget_pages(head)
+            match = self.prefix.match(head.prompt)
+            if head.handoff is not None:
+                # A handoff arrives with its whole prefill —
+                # full-block sharing still saves pages, but a
+                # boundary fork has nothing to copy that the carried
+                # cache doesn't already hold, and a placeholder
+                # prompt (no tokens in the blob) must not "match"
+                # zeros.
+                entries = (match.entries
+                           if head.handoff.prompt_tokens is not None
+                           else [])
+                match = PrefixMatch(
+                    entries=entries, fork=None, fork_len=0,
+                    matched=len(entries) * self.kv.page_size)
+            match = self.prefix.pin(match)
+            if not self.kv.allocator.reserve(
+                    total - len(match.entries)):
+                self.prefix.unpin(match)
+                if i == 0 and sched.head_blocked(head):
+                    # Starvation guard (see SlotScheduler): the same
+                    # fair-first head has now been skipped enough —
+                    # hold the whole line so freed pages accumulate
+                    # for it instead of leaking to smaller requests.
+                    return False
+                continue  # this tenant's line holds; try the next
+            if i == 0:
+                sched.head_unblocked()
+            sched.pending.pop_head(head)
+            self._prefill_and_bind_prefix(head, match)
+            return True
+        return False
 
     def _prefill_and_bind(self, req: _Request) -> None:
         t0 = time.monotonic()
@@ -1154,6 +1205,8 @@ class DecodeEngine:
         ctx = req.stream.obs_ctx
         self._m_ttft.observe(t1 - req.submitted_at,
                              trace_id=ctx.trace_id if ctx else None)
+        tenancy.observe_ttft(req.tenant or tenancy.DEFAULT_TENANT,
+                             t1 - req.submitted_at)
         if TRACER.enabled:
             TRACER.record("engine_prefill", "engine", t0, t1 - t0,
                           self._span_args(req, slot=slot.index,
@@ -1262,6 +1315,8 @@ class DecodeEngine:
         ctx = req.stream.obs_ctx
         self._m_ttft.observe(t1 - req.submitted_at,
                              trace_id=ctx.trace_id if ctx else None)
+        tenancy.observe_ttft(req.tenant or tenancy.DEFAULT_TENANT,
+                             t1 - req.submitted_at)
         if TRACER.enabled:
             TRACER.record("engine_prefill", "engine", t0, t1 - t0,
                           self._span_args(req, slot=slot.index,
@@ -1276,6 +1331,10 @@ class DecodeEngine:
         slot.request.stream._emit(
             TokenEvent(token=token, index=slot.emitted - 1))
         self._m_tokens.inc()
+        # Billing-grade per-tenant usage: tokens actually DELIVERED
+        # (capped label — spraying tenants can't grow /metrics).
+        tenancy.note_tokens(slot.request.tenant
+                            or tenancy.DEFAULT_TENANT)
         if self.config.eos_id is not None and \
                 token == self.config.eos_id:
             slot.done = True

@@ -14,11 +14,12 @@
 
 """Slot bookkeeping for the continuous-batching engine.
 
-Pure host-side state machine (no jax): N decode slots, a FIFO
-admission queue, and reservation-aware admit/retire transitions. The
-engine thread is the only mutator; :class:`SlotScheduler` exists
-separately from the engine so the scheduling policy is unit-testable
-without compiling a model.
+Pure host-side state machine (no jax): N decode slots, a
+weighted-fair admission queue (per-tenant sub-queues, ISSUE 14), and
+reservation-aware admit/retire transitions. The engine thread is the
+only mutator; :class:`SlotScheduler` exists separately from the
+engine so the scheduling policy is unit-testable without compiling a
+model.
 
 Slot lifecycle::
 
@@ -36,9 +37,11 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
-from typing import Any, Deque, List, Optional
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 import numpy as np
+
+from kubeflow_tpu.serving.tenancy import FairQueue
 
 
 @dataclasses.dataclass
@@ -70,20 +73,40 @@ class Slot:
 
 
 class SlotScheduler:
-    """Owns the N slots + the admission FIFO.
+    """Owns the N slots + the weighted-fair admission queue.
 
-    Admission is strictly FIFO (no head-of-line jumping: a large
-    request that can't reserve pages yet blocks later arrivals, which
-    keeps tail fairness — the alternative starves big prompts
-    forever). The page-pool reservation check lives here; the actual
+    Admission is strictly FIFO *within a tenant* (no head-of-line
+    jumping inside a sub-queue: a large request that can't reserve
+    pages yet blocks ITS tenant's later arrivals, which keeps tail
+    fairness — the alternative starves big prompts forever) and
+    weighted-fair *across* tenants (``pending`` is a
+    :class:`~kubeflow_tpu.serving.tenancy.FairQueue`: one tenant's
+    burst cannot park work in front of another tenant's head; with a
+    single tenant the drain order is bitwise the old global FIFO's).
+    The page-pool reservation check lives here; the actual
     prefill/adopt device work stays in the engine.
     """
 
-    def __init__(self, num_slots: int, allocator):
+    #: Consecutive failed reservations of the SAME fair-first head
+    #: after which admission holds the WHOLE line (no other tenant's
+    #: head admits) so freed pages can accumulate for it. Skipping a
+    #: blocked head avoids cross-tenant head-of-line blocking, but
+    #: unbounded skipping would let a stream of small requests from
+    #: OTHER tenants starve a large reservation forever — the exact
+    #: liveness property the old global FIFO bought by always holding.
+    #: This bounds the starvation window instead: ~threshold admission
+    #: attempts (one per engine lap), then the classic hold applies
+    #: until the head fits, expires, or cancels.
+    STARVATION_HOLD_ATTEMPTS = 32
+
+    def __init__(self, num_slots: int, allocator, *,
+                 weight_of: Optional[Callable[[str], float]] = None):
         self.slots: List[Slot] = [Slot(i) for i in range(num_slots)]
         self._free: Deque[int] = deque(range(num_slots))
         self._allocator = allocator
-        self.pending: Deque[Any] = deque()
+        self.pending: FairQueue = FairQueue(weight_of=weight_of)
+        self._blocked_head: Any = None
+        self._blocked_count = 0
         # Monotonic counters for stats()/metrics.
         self.admitted = 0
         self.retired = 0
@@ -100,6 +123,11 @@ class SlotScheduler:
     def queue_depth(self) -> int:
         return len(self.pending)
 
+    def tenant_depths(self) -> Dict[str, int]:
+        """Queued requests per tenant — the attribution a queue-full
+        shed carries so a 503 names the tenant that caused it."""
+        return self.pending.tenant_depths()
+
     def has_free_slot(self) -> bool:
         return bool(self._free)
 
@@ -109,17 +137,54 @@ class SlotScheduler:
 
     # -- transitions (engine thread only) --------------------------------
 
+    def head_blocked(self, head: Any) -> bool:
+        """Record one failed reservation for the FAIR-FIRST head.
+        Returns True once the same head has failed
+        ``STARVATION_HOLD_ATTEMPTS`` consecutive attempts — the
+        caller must then hold the whole line (admit nobody) so freed
+        pages accumulate for it instead of leaking to smaller
+        requests from other tenants forever."""
+        if self._blocked_head is head:
+            self._blocked_count += 1
+        else:
+            self._blocked_head = head
+            self._blocked_count = 1
+        return self._blocked_count >= self.STARVATION_HOLD_ATTEMPTS
+
+    def head_unblocked(self) -> None:
+        self._blocked_head = None
+        self._blocked_count = 0
+
+    def holding_for_head(self) -> bool:
+        """True while the starvation guard holds the line for a
+        blocked fair-first head (introspection for stats/fuzz)."""
+        return (self._blocked_head is not None
+                and self._blocked_count
+                >= self.STARVATION_HOLD_ATTEMPTS)
+
     def next_admittable(self, budget_pages_of) -> Optional[Any]:
-        """Pop the FIFO head iff a slot AND its reservation fit;
-        ``budget_pages_of(request)`` prices the worst case. None =
-        nothing admittable right now (empty queue, no slot, or the
-        head's reservation doesn't fit yet — FIFO holds the line)."""
+        """Pop the first admittable tenant head in fair-queueing
+        order iff a slot AND its reservation fit;
+        ``budget_pages_of(request)`` prices the worst case. A head
+        whose reservation doesn't fit holds the line for ITS tenant
+        only (and is not charged fair-share, so it keeps first claim
+        on freed pages); other tenants' heads still admit — no
+        cross-tenant head-of-line blocking, BOUNDED by the
+        starvation guard: once the same fair-first head has been
+        skipped ``STARVATION_HOLD_ATTEMPTS`` times, the whole line
+        holds (classic FIFO behavior) until it fits or leaves the
+        queue. None = nothing admittable right now."""
         if not self.pending or not self._free:
             return None
-        head = self.pending[0]
-        if not self._allocator.reserve(budget_pages_of(head)):
-            return None
-        return self.pending.popleft()
+        for i, head in enumerate(self.pending.heads()):
+            if self._allocator.reserve(budget_pages_of(head)):
+                if i == 0:
+                    self.head_unblocked()
+                self.pending.pop_head(head)
+                return head
+            if i == 0 and self.head_blocked(head):
+                return None  # hold the line for the starving head
+        return None
 
     def bind(self, request: Any, *, prompt_width: int, pad_len: int,
              first_token: int, done: bool, budget_pages: int,
@@ -165,20 +230,14 @@ class SlotScheduler:
     def expired_pending(self, now: Optional[float] = None) -> List[Any]:
         """Drop (and return) queued requests whose deadline lapsed
         before a slot ever freed up — they must never burn a prefill.
-        Caller must hold the engine's submit lock: this SWAPS the
-        pending deque, and an unlocked swap would drop a concurrently
-        appended request on the floor."""
+        ``FairQueue.remove_if`` rebuilds each sub-queue atomically
+        under its own lock (fairness state survives the sweep), so a
+        concurrently appended request can never be dropped — the r11
+        locked-swap contract, now per sub-queue."""
         now = time.monotonic() if now is None else now
-        expired = []
-        keep: Deque[Any] = deque()
-        while self.pending:
-            req = self.pending.popleft()
-            if req.deadline is not None and req.deadline <= now:
-                expired.append(req)
-            else:
-                keep.append(req)
-        self.pending = keep
-        return expired
+        return self.pending.remove_if(
+            lambda req: req.deadline is not None
+            and req.deadline <= now)
 
     # -- step-key helper -------------------------------------------------
 

@@ -170,6 +170,16 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
         "serving-chaos": [
             py, f"{src}/bench.py", "--chaos",
         ],
+        # Tenant-isolation gate (ISSUE 14): the noisy-neighbor sweep
+        # — one tenant at 4x its quota vs three compliant tenants at
+        # 0.8x, isolation off vs on. With isolation on, no compliant
+        # tenant's p99 may cross its deadline, compliant tenants see
+        # zero quota sheds, and the noisy excess must bounce as its
+        # own structured 429s. Hermetic — sleep-based stub model, no
+        # cluster, no accelerator (mirrors serving-chaos).
+        "serving-tenancy": [
+            py, f"{src}/bench.py", "--tenants",
+        ],
         "deploy-test": [
             py, "-m", "kubeflow_tpu.citests.deploy", "setup",
             "--namespace", params["test_namespace"],
@@ -225,6 +235,7 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             _dag_task("elastic-kill-test", ["checkout"]),
             _dag_task("serving-mesh-dryrun", ["checkout"]),
             _dag_task("serving-chaos", ["checkout"]),
+            _dag_task("serving-tenancy", ["checkout"]),
             _dag_task("deploy-test", ["checkout"]),
             _dag_task("deploy-serving", ["deploy-test"]),
             _dag_task("tpujob-test", ["deploy-test"]),

@@ -62,10 +62,21 @@ def server_container(p: Dict[str, Any]) -> Dict[str, Any]:
         args.append(f"--role={p['role']}")
     if p.get("continuous_batching"):
         args.append("--continuous_batching")
+    mounts = []
+    if p.get("tenant_policy"):
+        # Multi-tenant quotas/weights (docs/tenancy.md): the policy
+        # file rides a ConfigMap mount; the server hot-reloads it
+        # with last-good-on-malformed semantics, so editing the
+        # ConfigMap retunes quotas without a rollout.
+        args.append("--tenant_policy=/etc/kft-tenancy/policy.json")
+        mounts.append(k8s.volume_mount("tenant-policy",
+                                       "/etc/kft-tenancy",
+                                       read_only=True))
     container = k8s.container(
         p["name"], p["model_server_image"],
         command=["python", "-m", "kubeflow_tpu.serving.server"],
         args=args,
+        volume_mounts=mounts or None,
         ports=[k8s.port(9000, "grpc"), k8s.port(8500, "rest")],
         # Model load + first XLA compile takes tens of seconds to
         # minutes. The server opens its ports immediately and /healthz
@@ -118,6 +129,9 @@ def deployment(p: Dict[str, Any]) -> Dict[str, Any]:
         containers,
         node_selector=node_selector,
     )
+    if p.get("tenant_policy"):
+        spec.setdefault("volumes", []).append(k8s.volume(
+            "tenant-policy", config_map_name=p["tenant_policy"]))
     # Non-root (parity ``:173-202`` runAsUser/fsGroup 1000).
     spec["securityContext"] = {"runAsUser": 1000, "fsGroup": 1000}
     # With the router (autoscaler) enabled the scale subresource owns
@@ -409,6 +423,12 @@ SERVING_PARAMS = [
           "decode | any. Apply the prototype once per pool (e.g. "
           "name llm-prefill role prefill, name llm-decode role "
           "decode) and point role_deployments at both."),
+    Param("tenant_policy", "", "string",
+          "Name of a ConfigMap whose policy.json key holds the "
+          "tenant quota/weight policy (multi-tenant isolation: "
+          "per-tenant token buckets -> 429s, weighted-fair "
+          "queueing; hot-reloaded with last-good-on-malformed "
+          "semantics — docs/tenancy.md). Empty disables tenancy."),
     Param("continuous_batching", "false", "bool",
           "Serve generate models through the slot-based decode "
           "engine (required for KV handoff / role-split serving)."),

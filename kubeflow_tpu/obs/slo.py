@@ -210,13 +210,21 @@ class SLO:
 def default_slos(*, deadline_objective: float = 0.99,
                  ttft_p95_s: Optional[float] = None,
                  reconcile_p99_s: Optional[float] = None,
+                 tenants: Optional[Sequence[str]] = None,
+                 tenant_objective: float = 0.99,
                  windows: Optional[Tuple[BurnWindow, ...]] = None
                  ) -> List[SLO]:
     """The stock fleet SLO set: requests-meet-deadline (always), TTFT
-    p95 and operator reconcile p99 (when given thresholds). The
-    deadline SLO counts shed AND expired as violations — a request
+    p95 and operator reconcile p99 (when given thresholds), plus —
+    when ``tenants`` names them — a per-tenant deadline SLO over the
+    tenant-labeled families (ISSUE 14: one noisy neighbor burning the
+    FLEET SLO is exactly the blur tenancy exists to remove; the
+    per-tenant burn shows whose budget is actually on fire). The
+    deadline SLOs count shed AND expired as violations — a request
     turned away at admission missed its deadline as surely as one
-    that lapsed in queue."""
+    that lapsed in queue. Per-tenant series are cardinality-capped at
+    the source (serving/tenancy.py): name only tenants inside the
+    top-K cap, or their series read as ``other``'s."""
     kw: Dict[str, Any] = {}
     if windows is not None:
         kw["windows"] = windows
@@ -231,6 +239,19 @@ def default_slos(*, deadline_objective: float = 0.99,
                        "kft_serving_shed_total",
                        "kft_serving_expired_total"),
         **kw)]
+    for tenant in tenants or ():
+        slos.append(SLO(
+            name=f"tenant-{tenant}-deadline",
+            objective=tenant_objective,
+            description=f"{tenant_objective:.0%} of tenant "
+                        f"{tenant!r}'s requests are served (not "
+                        f"quota-shed, not overload-shed, not "
+                        f"expired)",
+            bad_metrics=("kft_tenant_shed_total",
+                         "kft_tenant_expired_total"),
+            total_metrics=("kft_tenant_requests_total",),
+            label_filter={"tenant": tenant},
+            **kw))
     if ttft_p95_s is not None:
         slos.append(SLO(
             name="serving-ttft-p95",

@@ -1687,3 +1687,234 @@ def main(argv=None) -> int:
 
 if __name__ == "__main__":
     raise SystemExit(main())
+
+
+@dataclasses.dataclass
+class TenantBenchConfig:
+    """`bench.py --tenants`: the noisy-neighbor isolation sweep
+    (ISSUE 14 acceptance, ROADMAP #6 criterion).
+
+    Four tenants share one ServedModel: ONE noisy tenant offers 4× its
+    quota while three compliant tenants each offer 0.8× of theirs.
+    Two phases over the same sleep-based stub model (sleep-priced
+    service so the ratios survive this box's CPU throttling — the r17
+    chaos-bench policy):
+
+    - **isolation off** (no tenancy registry — the r17 stack): every
+      request meets ONE global FIFO + ONE global admission controller,
+      so the noisy flood inflates the queue-wait estimate and the
+      global shed falls on everyone — compliant tenants eat 503s for
+      a burst they didn't send.
+    - **isolation on** (registry + per-tenant buckets + weighted-fair
+      queue): the noisy tenant's over-quota excess bounces as ITS own
+      structured 429s before touching the queue, admitted load stays
+      under capacity, and every compliant request is served with p99
+      inside its deadline.
+
+    The acceptance invariant asserted by the driver: with isolation
+    on, the noisy tenant cannot push any compliant tenant's p99 past
+    its deadline, and compliant tenants see ZERO quota sheds (never a
+    global shed for someone else's burst)."""
+
+    max_batch: int = 4
+    service_time_s: float = 0.02  # per dispatch ⇒ capacity ≈
+    # max_batch / service_time ≈ 200 rps on any box
+    deadline_ms: float = 250.0
+    phase_seconds: float = 4.0
+    noisy_x: float = 4.0      # noisy tenant's offered ÷ its quota
+    compliant_x: float = 0.8  # compliant tenants' offered ÷ quota
+    compliant_tenants: int = 3
+    queue_capacity: int = 4096
+
+
+class _SleepStub:
+    """Sleep-priced LoadedModel stand-in: one dispatch costs exactly
+    ``service_time_s`` whatever the box is doing — the measured
+    ratios are scheduling policy, not CPU weather."""
+
+    version = 1
+
+    def __init__(self, service_time_s: float):
+        self.service_time_s = service_time_s
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def signature(self, name=None):
+        class Sig:
+            method = "predict"
+            inputs = {"x": None}
+        return Sig()
+
+    def run(self, inputs, sig_name=None, method=None):
+        with self._lock:
+            self.calls += 1
+        time.sleep(self.service_time_s)
+        x = np.asarray(inputs["x"])
+        return {"y": x * 2.0}
+
+
+def _tenant_drive(model, tenants: Dict[str, float],
+                  duration_s: float, deadline_ms: float
+                  ) -> Dict[str, Dict[str, Any]]:
+    """Open-loop multi-tenant drive: each tenant fires at its own
+    fixed arrival rate with its tenant header equivalent (the
+    ``tenant=`` submit kwarg); outcomes are bucketed per tenant.
+    Open loop on purpose — a noisy neighbor does not slow down just
+    because the server does."""
+    import concurrent.futures
+
+    from kubeflow_tpu.serving import overload
+
+    budget_s = deadline_ms / 1e3
+    results: Dict[str, List[Any]] = {t: [] for t in tenants}
+    lock = threading.Lock()
+    inputs = {"x": np.ones((1, 2), np.float32)}
+
+    def one(tenant: str) -> None:
+        t0 = time.perf_counter()
+        deadline = overload.deadline_after(budget_s)
+        try:
+            future = model.submit(inputs, None, None, None,
+                                  deadline=deadline, tenant=tenant)
+            future.result(budget_s + 1.0)
+            outcome = "ok"
+        except overload.QuotaExceededError:
+            outcome = "quota"
+        except overload.OverloadedError:
+            outcome = "shed"
+        except overload.DeadlineExceededError:
+            outcome = "expired"
+        except concurrent.futures.TimeoutError:
+            outcome = "client_timeout"
+        with lock:
+            results[tenant].append(
+                (outcome, time.perf_counter() - t0))
+
+    threads = []
+    start = time.perf_counter()
+    for tenant, rate in tenants.items():
+        n = max(1, int(rate * duration_s))
+        interval = 1.0 / rate
+        pool = min(n, max(8, int(rate * budget_s * 1.5) + 1))
+
+        def worker(i: int, tenant=tenant, n=n, interval=interval,
+                   pool=pool) -> None:
+            for k in range(i, n, pool):
+                delay = start + k * interval - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                one(tenant)
+
+        threads.extend(
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(pool))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(duration_s + budget_s + 30)
+    out: Dict[str, Dict[str, Any]] = {}
+    for tenant, rows in results.items():
+        counts: Dict[str, int] = {}
+        for outcome, _ in rows:
+            counts[outcome] = counts.get(outcome, 0) + 1
+        ok_lat = np.asarray([lat for outcome, lat in rows
+                             if outcome == "ok"]) * 1e3
+        row: Dict[str, Any] = {
+            "sent": len(rows),
+            "ok": counts.get("ok", 0),
+            "quota": counts.get("quota", 0),
+            "shed": counts.get("shed", 0),
+            "expired": counts.get("expired", 0),
+            "client_timeout": counts.get("client_timeout", 0),
+        }
+        if ok_lat.size:
+            row["ok_p50_ms"] = round(float(np.percentile(ok_lat, 50)),
+                                     1)
+            row["ok_p99_ms"] = round(float(np.percentile(ok_lat, 99)),
+                                     1)
+        out[tenant] = row
+    return out
+
+
+def run_tenant_benchmark(config: TenantBenchConfig) -> Dict[str, Any]:
+    from kubeflow_tpu.serving import tenancy
+    from kubeflow_tpu.serving.manager import ServedModel
+
+    capacity = config.max_batch / config.service_time_s
+    fair_share = capacity / (1 + config.compliant_tenants)
+    compliant = [f"compliant-{i}"
+                 for i in range(config.compliant_tenants)]
+    rates = {"noisy": config.noisy_x * fair_share}
+    rates.update({t: config.compliant_x * fair_share
+                  for t in compliant})
+
+    def build(registry):
+        m = ServedModel("tenant-bench", "/nonexistent",
+                        max_batch=config.max_batch,
+                        batch_window_s=0.001,
+                        queue_capacity=config.queue_capacity,
+                        tenancy_registry=registry)
+        m._versions[1] = _SleepStub(config.service_time_s)
+        m._latest = 1
+        # Admission control needs a truthful latency prior from the
+        # first request on (the real server seeds it from warmup).
+        m._latency.seed(config.service_time_s)
+        return m
+
+    phases: Dict[str, Any] = {}
+    for mode in ("isolation_off", "isolation_on"):
+        registry = None
+        if mode == "isolation_on":
+            registry = tenancy.TenantRegistry(tenancy.TenantPolicy(
+                default=tenancy.TenantQuota(
+                    requests_per_s=fair_share,
+                    request_burst=max(4.0, fair_share / 2))))
+        model = build(registry)
+        try:
+            rows = _tenant_drive(model, rates,
+                                 config.phase_seconds,
+                                 config.deadline_ms)
+            stats = model.batch_stats()
+        finally:
+            model.stop()
+        phases[mode] = {"tenants": rows, "server": stats}
+
+    on = phases["isolation_on"]["tenants"]
+    off = phases["isolation_off"]["tenants"]
+
+    def worst_compliant(rows, field, default):
+        return max((rows[t].get(field, default) for t in compliant),
+                   default=default)
+
+    compliant_p99_on = worst_compliant(on, "ok_p99_ms", 0.0)
+    # The acceptance invariants (asserted by bench.py --tenants):
+    isolation_ok = (
+        # 1. no compliant p99 past the deadline,
+        compliant_p99_on <= config.deadline_ms
+        # 2. never a global shed for someone else's burst: compliant
+        #    tenants see no quota 429s and (near-)zero 503s,
+        and worst_compliant(on, "quota", 0) == 0
+        # 3. every compliant tenant is actually served,
+        and all(on[t]["ok"] >= 0.95 * on[t]["sent"]
+                for t in compliant)
+        # 4. and the noisy tenant's excess bounced as ITS OWN 429s.
+        and on["noisy"]["quota"] > 0)
+    compliant_failed_off = sum(
+        off[t]["sent"] - off[t]["ok"] for t in compliant)
+    compliant_failed_on = sum(
+        on[t]["sent"] - on[t]["ok"] for t in compliant)
+    return {
+        "config": dataclasses.asdict(config),
+        "capacity_rps": round(capacity, 1),
+        "fair_share_rps": round(fair_share, 1),
+        "offered_rates_rps": {t: round(r, 1)
+                              for t, r in rates.items()},
+        "phases": phases,
+        "compliant_p99_on_ms": compliant_p99_on,
+        "compliant_p99_off_ms": worst_compliant(off, "ok_p99_ms",
+                                                0.0),
+        "compliant_failed_off": compliant_failed_off,
+        "compliant_failed_on": compliant_failed_on,
+        "noisy_quota_sheds": on["noisy"]["quota"],
+        "isolation_ok": isolation_ok,
+    }

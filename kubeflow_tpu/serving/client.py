@@ -45,6 +45,27 @@ from kubeflow_tpu.serving.overload import (
     RetryPolicy,
     deadline_after,
 )
+from kubeflow_tpu.serving.tenancy import API_KEY_HEADER, TENANT_HEADER
+
+
+def _tenant_headers(tenant: str | None,
+                    api_key: str | None) -> dict:
+    """Identity headers (ISSUE 14): the tenant (or API key) rides
+    every REST request; the proxy forwards them verbatim and the
+    server charges the right quota buckets."""
+    headers = {}
+    if tenant:
+        headers[TENANT_HEADER] = tenant
+    if api_key:
+        headers[API_KEY_HEADER] = api_key
+    return headers
+
+
+def _tenant_metadata(tenant: str | None,
+                     api_key: str | None) -> list:
+    """The gRPC flavor: lowercase invocation-metadata pairs."""
+    return [(k.lower(), v)
+            for k, v in _tenant_headers(tenant, api_key).items()]
 
 
 def _parse_retry_after(value) -> float | None:
@@ -59,7 +80,9 @@ def _parse_retry_after(value) -> float | None:
 def post_json(url: str, payload: dict, *, timeout: float = 10.0,
               deadline_ms: float | None = None,
               retry: RetryPolicy | None = None,
-              request_id: str | None = None) -> dict:
+              request_id: str | None = None,
+              tenant: str | None = None,
+              api_key: str | None = None) -> dict:
     """POST JSON with the retry budget. Raises the last error when the
     budget (attempts or deadline) is exhausted. ``request_id`` rides
     the ``X-Request-Id`` header (same id across retries — the access
@@ -71,6 +94,7 @@ def post_json(url: str, payload: dict, *, timeout: float = 10.0,
     attempt = 0
     while True:
         headers = {"Content-Type": "application/json"}
+        headers.update(_tenant_headers(tenant, api_key))
         if request_id:
             headers[REQUEST_ID_HEADER] = request_id
         per_request_timeout = timeout
@@ -107,12 +131,15 @@ def post_json(url: str, payload: dict, *, timeout: float = 10.0,
 def predict(server: str, model: str, instances, *, classify: bool = False,
             timeout: float = 10.0, deadline_ms: float | None = None,
             retry: RetryPolicy | None = None,
-            request_id: str | None = None) -> dict:
+            request_id: str | None = None,
+            tenant: str | None = None,
+            api_key: str | None = None) -> dict:
     verb = "classify" if classify else "predict"
     return post_json(f"http://{server}/model/{model}:{verb}",
                      {"instances": instances}, timeout=timeout,
                      deadline_ms=deadline_ms, retry=retry,
-                     request_id=request_id)
+                     request_id=request_id, tenant=tenant,
+                     api_key=api_key)
 
 
 def stream_generate(server: str, model: str, instances, *,
@@ -120,6 +147,8 @@ def stream_generate(server: str, model: str, instances, *,
                     deadline_ms: float | None = None,
                     max_new_tokens: int | None = None,
                     request_id: str | None = None,
+                    tenant: str | None = None,
+                    api_key: str | None = None,
                     emit_resume: bool = False):
     """Consume a streaming ``:generate`` over SSE (the proxy or the
     model server's REST port — same wire either way). Yields
@@ -144,6 +173,7 @@ def stream_generate(server: str, model: str, instances, *,
         body["max_new_tokens"] = int(max_new_tokens)
     headers = {"Content-Type": "application/json",
                "Accept": wire.SSE_CONTENT_TYPE}
+    headers.update(_tenant_headers(tenant, api_key))
     if request_id:
         headers[REQUEST_ID_HEADER] = request_id
     if deadline_ms:
@@ -168,7 +198,9 @@ def stream_generate(server: str, model: str, instances, *,
 
 def grpc_generate_stream(server: str, model: str, inputs: dict, *,
                          signature_name: str = "", version=None,
-                         timeout: float = 60.0):
+                         timeout: float = 60.0,
+                         tenant: str | None = None,
+                         api_key: str | None = None):
     """Consume the native server-streaming GenerateStream RPC: yields
     ``("token", {row, index, token})`` per streamed message and a
     final ``("done", {tokens})`` decoded from the terminal frame."""
@@ -183,7 +215,9 @@ def grpc_generate_stream(server: str, model: str, inputs: dict, *,
     with grpc.insecure_channel(server) as channel:
         call = channel.unary_stream(
             "/tensorflow.serving.PredictionService/GenerateStream")
-        for message in call(request, timeout=timeout):
+        for message in call(request, timeout=timeout,
+                            metadata=_tenant_metadata(tenant,
+                                                      api_key)):
             _, outputs = wire.decode_predict_response(message)
             if "tokens" in outputs:
                 yield "done", {"tokens": outputs["tokens"].tolist()}
@@ -238,7 +272,7 @@ def grpc_web_predict(server: str, model: str, inputs: dict, *,
 
 
 def _grpc_call(server: str, method: str, request: bytes,
-               timeout: float) -> bytes:
+               timeout: float, metadata: list | None = None) -> bytes:
     """One raw-bytes unary call on an insecure channel. grpcio passes
     bytes through untouched when no serializers are given — the wire
     codec (serving/wire.py) is the (de)serializer."""
@@ -247,12 +281,14 @@ def _grpc_call(server: str, method: str, request: bytes,
     with grpc.insecure_channel(server) as channel:
         call = channel.unary_unary(
             f"/tensorflow.serving.PredictionService/{method}")
-        return call(request, timeout=timeout)
+        return call(request, timeout=timeout, metadata=metadata)
 
 
 def grpc_predict(server: str, model: str, inputs: dict, *,
                  signature_name: str = "", version=None,
-                 timeout: float = 10.0) -> dict:
+                 timeout: float = 10.0,
+                 tenant: str | None = None,
+                 api_key: str | None = None) -> dict:
     """Native-gRPC Predict — the reference client's exact flow
     (label.py:40-56: channel → PredictRequest → stub.Predict(req, 10))."""
     import numpy as np
@@ -263,20 +299,24 @@ def grpc_predict(server: str, model: str, inputs: dict, *,
         model, {k: np.asarray(v) for k, v in inputs.items()},
         signature_name=signature_name, version=version)
     _, outputs = wire.decode_predict_response(
-        _grpc_call(server, "Predict", request, timeout))
+        _grpc_call(server, "Predict", request, timeout,
+                   metadata=_tenant_metadata(tenant, api_key)))
     return outputs
 
 
 def grpc_classify(server: str, model: str, examples, *,
                   signature_name: str = "", version=None,
-                  timeout: float = 10.0):
+                  timeout: float = 10.0,
+                  tenant: str | None = None,
+                  api_key: str | None = None):
     """Native-gRPC Classify with tf.Example rows → [[(label, score)]]."""
     from kubeflow_tpu.serving import wire
 
     request = wire.encode_classification_request(
         model, examples, signature_name=signature_name, version=version)
     _, classifications = wire.decode_classification_response(
-        _grpc_call(server, "Classify", request, timeout))
+        _grpc_call(server, "Classify", request, timeout,
+                   metadata=_tenant_metadata(tenant, api_key)))
     return classifications
 
 
@@ -317,6 +357,16 @@ def main(argv=None) -> int:
                         help="X-Request-Id to tag the request with "
                              "(grep it in access logs and /tracez "
                              "spans; omitted, the proxy mints one)")
+    parser.add_argument("--tenant", default=None,
+                        help="tenant identity (X-KFT-Tenant header / "
+                             "gRPC metadata): names the quota "
+                             "buckets and fair sub-queue this "
+                             "request is charged to; omitted = the "
+                             "'default' tenant (docs/tenancy.md)")
+    parser.add_argument("--api_key", default=None,
+                        help="API key (X-KFT-Api-Key): the server "
+                             "maps it to a tenant via its policy "
+                             "file; --tenant wins when both are set")
     parser.add_argument("--stream", action="store_true",
                         help="streaming :generate over SSE (server "
                              "must run --continuous_batching): tokens "
@@ -350,13 +400,15 @@ def main(argv=None) -> int:
                        else 60.0)
             events = grpc_generate_stream(
                 args.server, args.model,
-                {args.input_name: instances}, timeout=timeout)
+                {args.input_name: instances}, timeout=timeout,
+                tenant=args.tenant, api_key=args.api_key)
         else:
             events = stream_generate(
                 args.server, args.model, instances,
                 deadline_ms=args.deadline_ms,
                 max_new_tokens=args.max_new_tokens,
-                request_id=args.request_id)
+                request_id=args.request_id,
+                tenant=args.tenant, api_key=args.api_key)
         result = {}
         for event, data in events:
             if event == "token":
@@ -381,17 +433,47 @@ def main(argv=None) -> int:
             examples = [{args.input_name: row} for row in instances]
             result = {"classifications": [
                 [{"label": label, "score": score} for label, score in row]
-                for row in grpc_classify(args.server, args.model, examples)]}
+                for row in grpc_classify(args.server, args.model, examples,
+                                         tenant=args.tenant,
+                                         api_key=args.api_key)]}
         else:
             outputs = grpc_predict(args.server, args.model,
-                                   {args.input_name: instances})
+                                   {args.input_name: instances},
+                                   tenant=args.tenant,
+                                   api_key=args.api_key)
             result = {k: v.tolist() for k, v in outputs.items()}
     else:
-        result = predict(args.server, args.model, instances,
-                         classify=args.classify,
-                         deadline_ms=args.deadline_ms,
-                         retry=RetryPolicy(max_attempts=args.retries),
-                         request_id=args.request_id)
+        try:
+            result = predict(args.server, args.model, instances,
+                             classify=args.classify,
+                             deadline_ms=args.deadline_ms,
+                             retry=RetryPolicy(max_attempts=args.retries),
+                             request_id=args.request_id,
+                             tenant=args.tenant, api_key=args.api_key)
+        except urllib.error.HTTPError as e:
+            # Surface the two shed flavors distinctly (ISSUE 14): a
+            # 429 is YOUR tenant's quota (slow down / raise quota),
+            # a 503 is fleet-wide overload (retry with backoff).
+            if e.code in (429, 503):
+                try:
+                    detail = json.loads(e.read() or b"{}")
+                except ValueError:
+                    detail = {}
+                if e.code == 429:
+                    print(f"quota exceeded for tenant "
+                          f"{detail.get('tenant') or args.tenant or 'default'}: "
+                          f"{detail.get('error', e.reason)} "
+                          f"(Retry-After: "
+                          f"{e.headers.get('Retry-After', '?')}s)",
+                          file=sys.stderr)
+                else:
+                    print(f"server overloaded: "
+                          f"{detail.get('error', e.reason)} "
+                          f"(Retry-After: "
+                          f"{e.headers.get('Retry-After', '?')}s)",
+                          file=sys.stderr)
+                return 1
+            raise
     json.dump(result, sys.stdout, indent=2)
     print()
     return 0

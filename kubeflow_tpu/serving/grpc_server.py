@@ -44,6 +44,7 @@ import numpy as np
 
 from kubeflow_tpu.obs import tracing as obs_tracing
 from kubeflow_tpu.serving import wire
+from kubeflow_tpu.serving.tenancy import tenant_from_metadata
 from kubeflow_tpu.serving.manager import ModelManager
 from kubeflow_tpu.serving.overload import (
     DeadlineExceededError,
@@ -73,6 +74,10 @@ def _abort_for(context, exc) -> None:
         context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
                       str(exc) or "predict timed out")
     if isinstance(exc, OverloadedError):
+        # QuotaExceededError (a subclass) lands here too: gRPC has no
+        # 429, so both shed flavors map to RESOURCE_EXHAUSTED and the
+        # message names the over-quota tenant (the REST surface keeps
+        # the distinct 429 + QUOTA_EXCEEDED code).
         context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
     if isinstance(exc, RuntimeError):
         context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
@@ -92,7 +97,8 @@ def _context_deadline(context) -> Optional[float]:
 
 def start_predict(manager: ModelManager, request_bytes: bytes,
                   deadline: Optional[float] = None,
-                  obs_ctx: Optional[obs_tracing.TraceContext] = None):
+                  obs_ctx: Optional[obs_tracing.TraceContext] = None,
+                  tenant: str = ""):
     """Shared Predict front half for both transports (native gRPC here,
     gRPC-Web in serving/server.py): decode → validate against the
     signature → submit to the micro-batcher. ``deadline`` (absolute
@@ -125,7 +131,8 @@ def start_predict(manager: ModelManager, request_bytes: bytes,
     future = model.submit({input_name: inputs[input_name]},
                           spec["signature_name"] or None,
                           sig.method, spec["version"],
-                          deadline=deadline, obs_ctx=obs_ctx)
+                          deadline=deadline, obs_ctx=obs_ctx,
+                          tenant=tenant)
     return spec, loaded, future, output_filter
 
 
@@ -144,7 +151,8 @@ def finish_predict(spec, loaded, outputs, output_filter) -> bytes:
 
 def start_classify(manager: ModelManager, request_bytes: bytes,
                    deadline: Optional[float] = None,
-                   obs_ctx: Optional[obs_tracing.TraceContext] = None):
+                   obs_ctx: Optional[obs_tracing.TraceContext] = None,
+                   tenant: str = ""):
     """Shared Classify front half: decode tf.Examples → dense batch →
     submit. Returns (spec, loaded, future)."""
     spec, examples = wire.decode_classification_request(request_bytes)
@@ -159,7 +167,8 @@ def start_classify(manager: ModelManager, request_bytes: bytes,
     future = model.submit({input_name: batch},
                           spec["signature_name"] or None,
                           "classify", spec["version"],
-                          deadline=deadline, obs_ctx=obs_ctx)
+                          deadline=deadline, obs_ctx=obs_ctx,
+                          tenant=tenant)
     return spec, loaded, future
 
 
@@ -212,9 +221,14 @@ class PredictionService:
             # and any instrumented native client send it.
             obs_ctx = obs_tracing.from_grpc_metadata(
                 context.invocation_metadata())
+            # Tenant identity rides invocation metadata, the gRPC
+            # half of the X-KFT-Tenant header contract (ISSUE 14).
+            tenant = tenant_from_metadata(
+                context.invocation_metadata(),
+                getattr(self._manager, "tenancy", None))
             spec, loaded, future, output_filter = start_predict(
                 self._manager, request, deadline=deadline,
-                obs_ctx=obs_ctx)
+                obs_ctx=obs_ctx, tenant=tenant)
             outputs = future.result(self._wait_s(deadline))
             return finish_predict(spec, loaded, outputs, output_filter)
         except Exception as e:  # noqa: BLE001 — mapped to grpc status
@@ -227,9 +241,13 @@ class PredictionService:
             deadline = _context_deadline(context)
             obs_ctx = obs_tracing.from_grpc_metadata(
                 context.invocation_metadata())
+            tenant = tenant_from_metadata(
+                context.invocation_metadata(),
+                getattr(self._manager, "tenancy", None))
             spec, loaded, future = start_classify(self._manager, request,
                                                   deadline=deadline,
-                                                  obs_ctx=obs_ctx)
+                                                  obs_ctx=obs_ctx,
+                                                  tenant=tenant)
             outputs = future.result(self._wait_s(deadline))
             return finish_classify(spec, loaded, outputs)
         except Exception as e:  # noqa: BLE001
@@ -256,12 +274,15 @@ class PredictionService:
             deadline = _context_deadline(context)
             obs_ctx = obs_tracing.from_grpc_metadata(
                 context.invocation_metadata())
+            tenant = tenant_from_metadata(
+                context.invocation_metadata(),
+                getattr(self._manager, "tenancy", None))
             spec, inputs, _ = wire.decode_predict_request(request)
             model = self._manager.get_model(spec["name"])
             sig_name = spec["signature_name"] or None
             _, streams = model.submit_stream(
                 inputs, sig_name, spec["version"], deadline=deadline,
-                obs_ctx=obs_ctx)
+                obs_ctx=obs_ctx, tenant=tenant)
         except Exception as e:  # noqa: BLE001 — mapped to grpc status
             _abort_for(context, e)
             return

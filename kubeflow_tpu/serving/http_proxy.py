@@ -92,7 +92,7 @@ from kubeflow_tpu.scaling.endpoints import (
     FileEndpointSource,
     HealthProber,
 )
-from kubeflow_tpu.serving import overload
+from kubeflow_tpu.serving import overload, tenancy
 
 logger = logging.getLogger(__name__)
 
@@ -293,6 +293,18 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
     def retry_attempts(self) -> int:
         return self.application.settings["retry_attempts"]
 
+    def tenant_headers(self) -> Dict[str, str]:
+        """The tenant-identity headers (ISSUE 14), forwarded
+        VERBATIM on every upstream hop — the model server owns the
+        queues, so IT is the quota/fairness enforcement point; the
+        proxy only relays who is asking."""
+        out: Dict[str, str] = {}
+        for header in (tenancy.TENANT_HEADER, tenancy.API_KEY_HEADER):
+            value = self.request.headers.get(header)
+            if value:
+                out[header] = value
+        return out
+
     def pick_endpoint(self, tried: Sequence[Endpoint],
                       model: Optional[str] = None,
                       phase: Optional[str] = None,
@@ -363,6 +375,7 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
         ctx = getattr(self, "_obs_ctx", None)
         if ctx is not None:
             headers.update(ctx.headers())
+        headers.update(self.tenant_headers())
         _P_UPSTREAM_REQUESTS.labels("rest").inc()
         client = tornado.httpclient.AsyncHTTPClient()
         try:
@@ -916,10 +929,12 @@ class InferProxyHandler(ProxyHandler):
             # propagation with no shared clock.
             timeout = min(timeout, max(0.001, remaining))
         _P_UPSTREAM_REQUESTS.labels("grpc").inc()
+        metadata = list(self._obs_ctx.grpc_metadata())
+        metadata.extend((k.lower(), v)
+                        for k, v in self.tenant_headers().items())
         try:
             response = await call(
-                request, timeout=timeout,
-                metadata=self._obs_ctx.grpc_metadata())
+                request, timeout=timeout, metadata=metadata)
         except grpc.aio.AioRpcError as e:
             if e.code() == grpc.StatusCode.UNAVAILABLE:
                 # :9000 unreachable (older server image, firewalled
@@ -960,9 +975,20 @@ class InferProxyHandler(ProxyHandler):
             if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
                 payload["code"] = "DEADLINE_EXCEEDED"
             elif e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
-                # Backend shed the request: pass its story through
-                # with a retry hint so clients back off, not hammer.
-                payload["code"] = "RESOURCE_EXHAUSTED"
+                if tenancy.is_quota_detail(e.details()):
+                    # The binary wire folded a tenant-quota shed into
+                    # RESOURCE_EXHAUSTED (gRPC has no 429); restore
+                    # the structured 429 here or every proxied unary
+                    # request would read its own quota as a global
+                    # overload (ISSUE 14: the two shed flavors demand
+                    # different client behavior).
+                    code = 429
+                    payload["code"] = "QUOTA_EXCEEDED"
+                else:
+                    # Backend shed the request: pass its story
+                    # through with a retry hint so clients back off,
+                    # not hammer.
+                    payload["code"] = "RESOURCE_EXHAUSTED"
                 self.set_header("Retry-After", "1")
             self.write_json(payload, code)
             return True
@@ -1098,6 +1124,7 @@ class InferProxyHandler(ProxyHandler):
         ctx = getattr(self, "_obs_ctx", None)
         if ctx is not None:
             headers.update(ctx.headers())
+        headers.update(self.tenant_headers())
         request = (f"POST {path} HTTP/1.1\r\n" + "".join(
             f"{k}: {v}\r\n" for k, v in headers.items())
             + "\r\n").encode("latin-1") + payload
@@ -1461,6 +1488,7 @@ class InferProxyHandler(ProxyHandler):
             raise CircuitOpenError(breaker.retry_after_s())
         headers = dict(self._obs_ctx.headers()) \
             if getattr(self, "_obs_ctx", None) is not None else {}
+        headers.update(self.tenant_headers())
         timeout = STREAM_TIMEOUT_S
         remaining = overload.remaining_s(deadline)
         if remaining is not None:

@@ -52,12 +52,13 @@ import numpy as np
 
 from kubeflow_tpu.obs import metrics as obs_metrics
 from kubeflow_tpu.obs.tracing import TRACER
-from kubeflow_tpu.serving import _native, remote
+from kubeflow_tpu.serving import _native, remote, tenancy
 from kubeflow_tpu.serving.model import LoadedModel, load_version
 from kubeflow_tpu.serving.overload import (
     DeadlineExceededError,
     LatencyEstimator,
     OverloadedError,
+    QuotaExceededError,
 )
 from kubeflow_tpu.serving.version_policy import parse_version_policy
 
@@ -176,7 +177,9 @@ class ServedModel:
                  batch_window_s: float = 0.002,
                  version_policy: str = "latest",
                  queue_capacity: int = 4096,
-                 continuous_batching: bool = False):
+                 continuous_batching: bool = False,
+                 tenancy_registry: Optional[
+                     tenancy.TenantRegistry] = None):
         self.name = name
         self.base_path = base_path
         self.max_batch = max_batch
@@ -188,6 +191,13 @@ class ServedModel:
         # and tokens stream incrementally. predict/classify traffic
         # keeps the micro-batcher either way.
         self.continuous_batching = continuous_batching
+        # Multi-tenant isolation (ISSUE 14, serving/tenancy.py): with
+        # a registry, submits are charged against per-tenant token
+        # buckets (over-quota = structured 429, never a global shed)
+        # and the request queue becomes per-tenant sub-queues drained
+        # weighted-fair by quota share. None = the classic
+        # single-FIFO path, bitwise unchanged.
+        self._tenancy = tenancy_registry
         self.version_policy, self._pinned = parse_version_policy(
             version_policy)
         self._versions: Dict[int, LoadedModel] = {}
@@ -198,7 +208,11 @@ class ServedModel:
         # memory: a deadline-free client's request can sit behind at
         # most capacity/max_batch dispatches. Size it so that wait is
         # tolerable (capacity × batch latency / max_batch).
-        self._queue = _native.RequestQueue(queue_capacity)
+        if tenancy_registry is not None:
+            self._queue: Any = tenancy.TenantRequestQueue(
+                queue_capacity, weight_of=tenancy_registry.weight)
+        else:
+            self._queue = _native.RequestQueue(queue_capacity)
         # _pending is touched by every request thread and the batcher;
         # GIL-atomicity of single dict ops is not a contract worth
         # betting on (submit's push-fail cleanup + a concurrent pop of
@@ -546,12 +560,43 @@ class ServedModel:
             args["trace_id"] = obs_ctx.trace_id
         return args
 
+    def _decode_cost(self, signature_name, method, version) -> int:
+        """Requested decode budget for the tenant token bucket: the
+        export's max_new_tokens for generate-method submissions, 0
+        otherwise (predict/classify cost rides the request bucket
+        alone). Best-effort — a still-loading version or a stub
+        charges 0 rather than failing the request over billing."""
+        loaded = self.get_resident(version)
+        if loaded is None:
+            return 0
+        try:
+            sig = loaded.signature(signature_name)
+            if (method or sig.method) != "generate":
+                return 0
+            cfg = getattr(loaded.metadata, "generate_config",
+                          None) or {}
+            return int(cfg.get("max_new_tokens", 0))
+        except Exception:  # noqa: BLE001 — cost estimate only; the
+            # submit path itself re-validates everything.
+            return 0
+
+    def _engine_for(self, loaded):
+        """``ensure_engine`` plus the tenancy hookup: the engine's
+        fair admission queue drains by the registry's quota-share
+        weights (idempotent per call; no registry = unweighted)."""
+        engine = loaded.ensure_engine(
+            self.name, queue_capacity=self.queue_capacity)
+        if self._tenancy is not None:
+            engine.set_tenant_weights(self._tenancy.weight)
+        return engine
+
     def submit(self, inputs: Dict[str, np.ndarray],
                signature_name: Optional[str],
                method: Optional[str],
                version: Optional[int], *,
                deadline: Optional[float] = None,
                obs_ctx=None,
+               tenant: str = "",
                on_streams=None) -> Future:
         """Enqueue one request for micro-batching; resolves to the
         output dict for exactly this request's rows.
@@ -566,8 +611,31 @@ class ServedModel:
         ``obs_ctx`` is the request's :class:`TraceContext` (from the
         transport's headers/metadata): its ids tag the per-request
         spans so a request_id greps from proxy access log to the XLA
-        dispatch that served it."""
+        dispatch that served it.
+
+        ``tenant`` (ISSUE 14) names the request's quota buckets and
+        weighted-fair sub-queue; with a tenancy registry, an
+        over-quota tenant's future carries
+        :class:`~.overload.QuotaExceededError` (→ 429 + Retry-After)
+        BEFORE any global admission state is touched — a tenant
+        spending its own budget is never a fleet-wide shed."""
         self.start_batcher()
+        tenant = tenant or tenancy.DEFAULT_TENANT
+        tenancy.note_request(tenant)  # billing-grade offered load
+        if self._tenancy is not None:
+            try:
+                self._tenancy.admit_request(
+                    tenant, decode_tokens=self._decode_cost(
+                        signature_name, method, version))
+            except QuotaExceededError as e:
+                quota_future: Future = Future()
+                if TRACER.enabled:
+                    TRACER.record(
+                        "request", "serving", time.monotonic(), 0.0,
+                        self._span_args(obs_ctx, "quota_shed",
+                                        tenant=tenant))
+                quota_future.set_exception(e)
+                return quota_future
         if self.continuous_batching:
             # Generate rides the slot engine when the target version
             # is already resident (a version still loading keeps the
@@ -581,7 +649,7 @@ class ServedModel:
                     return self._submit_engine(
                         loaded, inputs, signature_name,
                         deadline=deadline, obs_ctx=obs_ctx,
-                        on_streams=on_streams)
+                        tenant=tenant, on_streams=on_streams)
         future: Future = Future()
         t_enqueue = time.monotonic()
         if deadline is not None:
@@ -590,6 +658,7 @@ class ServedModel:
                 with self._pending_lock:
                     self._stat_expired += 1
                 self._m_expired.inc()
+                tenancy.note_expired(tenant)
                 if TRACER.enabled:
                     TRACER.record("request", "serving", t_enqueue, 0.0,
                                   self._span_args(obs_ctx, "expired"))
@@ -601,6 +670,7 @@ class ServedModel:
                 with self._pending_lock:
                     self._stat_shed += 1
                 self._m_shed.inc()
+                tenancy.note_shed(tenant, "overload")
                 if TRACER.enabled:
                     TRACER.record("request", "serving", t_enqueue, 0.0,
                                   self._span_args(obs_ctx, "shed"))
@@ -614,9 +684,14 @@ class ServedModel:
         with self._pending_lock:
             self._pending[request_id] = (inputs, signature_name, method,
                                          version, future, deadline,
-                                         (obs_ctx, t_enqueue))
+                                         (obs_ctx, t_enqueue), tenant)
         try:
-            pushed = self._queue.push(request_id)
+            if self._tenancy is not None:
+                # The tenant-aware queue: per-tenant sub-queues, the
+                # batcher's pop_batch drains them weighted-fair.
+                pushed = self._queue.push(request_id, tenant)
+            else:
+                pushed = self._queue.push(request_id)
             error: Optional[Exception] = None
         except RuntimeError:  # queue closed mid-flight (shutdown race)
             pushed = False
@@ -636,6 +711,7 @@ class ServedModel:
             if owned:
                 if isinstance(error, OverloadedError):
                     self._m_shed.inc()
+                    tenancy.note_shed(tenant, "overload")
                     if TRACER.enabled:
                         TRACER.record(
                             "request", "serving", t_enqueue,
@@ -649,6 +725,7 @@ class ServedModel:
                       version: Optional[int], *,
                       deadline: Optional[float] = None,
                       obs_ctx=None,
+                      tenant: str = "",
                       max_new_tokens: Optional[int] = None):
         """Streaming generate: submit every request row to the decode
         engine and return ``(loaded, [GenerateStream per row])`` — the
@@ -663,6 +740,15 @@ class ServedModel:
                 f"model {self.name!r} is not served with continuous "
                 f"batching; token streaming requires it "
                 f"(--continuous_batching)")
+        tenant = tenant or tenancy.DEFAULT_TENANT
+        tenancy.note_request(tenant)
+        if self._tenancy is not None:
+            cost = (int(max_new_tokens) if max_new_tokens
+                    else self._decode_cost(signature_name, "generate",
+                                           version))
+            # Raises QuotaExceededError synchronously, like the
+            # engine's own shed path — the transports map it to 429.
+            self._tenancy.admit_request(tenant, decode_tokens=cost)
         loaded = self.get(version)
         sig = loaded.signature(signature_name)
         if sig.method != "generate":
@@ -673,15 +759,15 @@ class ServedModel:
         x, n = loaded._prepare(sig, inputs, variable_length=True)
         if n == 0:
             raise ValueError("empty batch")
-        engine = loaded.ensure_engine(
-            self.name, queue_capacity=self.queue_capacity)
+        engine = self._engine_for(loaded)
         rngs = loaded.request_rngs(n)
         streams = []
         try:
             for i in range(n):
                 streams.append(engine.submit(
                     x[i], rng=rngs[i], deadline=deadline,
-                    obs_ctx=obs_ctx, max_new_tokens=max_new_tokens))
+                    obs_ctx=obs_ctx, tenant=tenant,
+                    max_new_tokens=max_new_tokens))
         except BaseException:
             for s in streams:  # free the slots already taken
                 s.cancel()
@@ -692,6 +778,7 @@ class ServedModel:
                         signature_name: Optional[str],
                         version: Optional[int], *,
                         deadline: Optional[float] = None,
+                        tenant: str = "",
                         max_new_tokens: Optional[int] = None):
         """Prefill-only execution (role-split routing's first hop):
         run each request row's prompt prefill and return ``(loaded,
@@ -711,14 +798,24 @@ class ServedModel:
             raise ValueError(
                 f"prefill handoff requires a generate signature; "
                 f"got {sig.method!r}")
+        tenant = tenant or tenancy.DEFAULT_TENANT
+        tenancy.note_request(tenant)
+        if self._tenancy is not None:
+            # The split path's quota point is hop 1: the prefill is
+            # where a request ENTERS the fleet; hop 2 adopts work
+            # already paid for (charging both hops would double-bill
+            # every split request).
+            cost = (int(max_new_tokens) if max_new_tokens
+                    else self._decode_cost(signature_name, "generate",
+                                           version))
+            self._tenancy.admit_request(tenant, decode_tokens=cost)
         x, n = loaded._prepare(sig, inputs, variable_length=True)
         if n == 0:
             raise ValueError("empty batch")
         if deadline is not None and deadline <= time.monotonic():
             raise DeadlineExceededError(
                 "deadline expired before prefill")
-        engine = loaded.ensure_engine(
-            self.name, queue_capacity=self.queue_capacity)
+        engine = self._engine_for(loaded)
         rngs = loaded.request_rngs(n)
         return loaded, [
             engine.run_prefill(x[i], rng=rngs[i],
@@ -727,7 +824,7 @@ class ServedModel:
 
     def submit_handoff(self, handoffs, version: Optional[int], *,
                        deadline: Optional[float] = None,
-                       obs_ctx=None):
+                       obs_ctx=None, tenant: str = ""):
         """Resume decodes whose prefills ran elsewhere: adopt each
         handoff's pages into this replica's engine. Returns
         ``(loaded, [GenerateStream per handoff])`` — the same handle
@@ -739,13 +836,16 @@ class ServedModel:
                 f"batching; KV handoff rides the decode engine's "
                 f"page-adopt seam (--continuous_batching)")
         loaded = self.get(version)
-        engine = loaded.ensure_engine(
-            self.name, queue_capacity=self.queue_capacity)
+        engine = self._engine_for(loaded)
+        # No quota charge here: the split path billed this request at
+        # its prefill hop; the tenant still names the fair sub-queue.
+        tenant = tenant or tenancy.DEFAULT_TENANT
         streams = []
         try:
             for h in handoffs:
                 streams.append(engine.submit(
-                    handoff=h, deadline=deadline, obs_ctx=obs_ctx))
+                    handoff=h, deadline=deadline, obs_ctx=obs_ctx,
+                    tenant=tenant))
         except BaseException:
             for s in streams:  # free the slots already taken
                 s.cancel()
@@ -754,7 +854,7 @@ class ServedModel:
 
     def submit_resume(self, resumes, version: Optional[int], *,
                       deadline: Optional[float] = None,
-                      obs_ctx=None):
+                      obs_ctx=None, tenant: str = ""):
         """Mid-stream decode resume (ISSUE 13): continue streams whose
         decode died on ANOTHER replica. ``resumes`` is a list of
         ``(resume_token, emitted)`` pairs — the token dict is the
@@ -779,8 +879,10 @@ class ServedModel:
         from kubeflow_tpu.inference.engine.engine import GenerateStream
 
         loaded = self.get(version)
-        engine = loaded.ensure_engine(
-            self.name, queue_capacity=self.queue_capacity)
+        engine = self._engine_for(loaded)
+        # A resume continues an already-billed stream; no fresh quota
+        # charge (the tenant still names its fair sub-queue).
+        tenant = tenant or tenancy.DEFAULT_TENANT
         eos = engine.config.eos_id
         streams = []
         try:
@@ -814,7 +916,7 @@ class ServedModel:
                     [prompt, np.asarray(emitted, np.int32)])
                 streams.append(engine.submit(
                     context, step_keys=keys[n:], deadline=deadline,
-                    obs_ctx=obs_ctx))
+                    obs_ctx=obs_ctx, tenant=tenant))
         except BaseException:
             for s in streams:  # free the slots already taken
                 s.cancel()
@@ -824,7 +926,8 @@ class ServedModel:
     def _submit_engine(self, loaded, inputs: Dict[str, np.ndarray],
                        signature_name: Optional[str], *,
                        deadline: Optional[float],
-                       obs_ctx, on_streams=None) -> Future:
+                       obs_ctx, tenant: str = "",
+                       on_streams=None) -> Future:
         """Non-streaming generate over the engine: the classic
         future-of-{"tokens": [n, T]} contract, built by combining the
         per-row streams (so REST/gRPC unary clients transparently gain
@@ -838,15 +941,14 @@ class ServedModel:
             x, n = loaded._prepare(sig, inputs, variable_length=True)
             if n == 0:
                 raise ValueError("empty batch")
-            engine = loaded.ensure_engine(
-            self.name, queue_capacity=self.queue_capacity)
+            engine = self._engine_for(loaded)
             rngs = loaded.request_rngs(n)
             streams = []
             try:
                 for i in range(n):
                     streams.append(engine.submit(
                         x[i], rng=rngs[i], deadline=deadline,
-                        obs_ctx=obs_ctx))
+                        obs_ctx=obs_ctx, tenant=tenant))
             except BaseException:
                 for s in streams:
                     s.cancel()
@@ -859,6 +961,8 @@ class ServedModel:
                     self._stat_expired += 1
             (self._m_shed if isinstance(e, OverloadedError)
              else self._m_expired).inc()
+            if isinstance(e, DeadlineExceededError):
+                tenancy.note_expired(tenant or tenancy.DEFAULT_TENANT)
             future.set_exception(e)
             return future
         except Exception as e:  # noqa: BLE001 — validation errors
@@ -911,6 +1015,7 @@ class ServedModel:
                     self._stat_expired += len(expired)
                 self._m_expired.inc(len(expired))
                 for req in expired:
+                    tenancy.note_expired(req[7])
                     if TRACER.enabled:
                         ctx, t_enq = req[6]
                         TRACER.record(
@@ -959,6 +1064,15 @@ class ServedModel:
             engine = default.engine if default is not None else None
             if engine is not None:
                 stats["engine"] = engine.stats()
+        if self._tenancy is not None:
+            # Per-tenant attribution (ISSUE 14): queue depths from
+            # the fair queue + the registry's quota/shed snapshot —
+            # healthz carries it to the dashboard and the bench.
+            stats["tenants"] = {
+                "queue_depths": tenancy.cap_depths(
+                    self._queue.tenant_depths()),
+                "registry": self._tenancy.stats(),
+            }
         return stats
 
     def _run_group(self, sig_name, method, version, group,
@@ -1026,6 +1140,10 @@ class ServedModel:
             self._m_queue_wait.observe(
                 max(0.0, t_pop - g[6][1]),
                 trace_id=ctx.trace_id if ctx is not None else None)
+            # Tenant-labeled twin (capped label): the noisy-neighbor
+            # dashboard number — a compliant tenant's queue wait must
+            # not follow a neighbor's burst.
+            tenancy.observe_queue_wait(g[7], t_pop - g[6][1])
         if not TRACER.enabled:
             return
         batch = TRACER.next_batch_id()
@@ -1086,9 +1204,14 @@ class ServedModel:
 class ModelManager:
     """All served models + the version-poll thread."""
 
-    def __init__(self, poll_interval_s: float = 5.0):
+    def __init__(self, poll_interval_s: float = 5.0,
+                 tenancy_registry: Optional[
+                     tenancy.TenantRegistry] = None):
         self._models: Dict[str, ServedModel] = {}
         self._poll_interval_s = poll_interval_s
+        #: One registry per PROCESS, shared by every model: quotas
+        #: are a tenant property, not a model property (ISSUE 14).
+        self.tenancy = tenancy_registry
         self._stop = threading.Event()
         self._poller: Optional[threading.Thread] = None
 
@@ -1104,7 +1227,8 @@ class ModelManager:
         model = ServedModel(name, base_path, max_batch=max_batch,
                             version_policy=version_policy,
                             queue_capacity=queue_capacity,
-                            continuous_batching=continuous_batching)
+                            continuous_batching=continuous_batching,
+                            tenancy_registry=self.tenancy)
         if initial_poll and not model.poll_versions():
             logger.warning("model %s: no versions found yet under %s",
                            name, base_path)

@@ -55,6 +55,7 @@ __all__ = [
     "LatencyEstimator",
     "OverloadedError",
     "QuantileWindow",
+    "QuotaExceededError",
     "RetryPolicy",
     "clamp_wait_s",
     "deadline_after",
@@ -92,6 +93,24 @@ class OverloadedError(RuntimeError):
     def __init__(self, message: str, *, retry_after_s: float = 1.0):
         super().__init__(message)
         self.retry_after_s = max(0.001, float(retry_after_s))
+
+
+class QuotaExceededError(OverloadedError):
+    """ONE tenant's token bucket ran dry (serving/tenancy.py) — the
+    server has capacity, this tenant spent its share.
+
+    Maps to HTTP 429 + ``Retry-After`` (distinct from the 503 global
+    shed: a 503 says "the server is full, anyone retrying makes it
+    worse"; a 429 says "YOU are over quota — everyone else is fine").
+    Subclasses :class:`OverloadedError` so layers without a dedicated
+    handler still degrade to the safe shed semantics
+    (RESOURCE_EXHAUSTED + backoff) instead of a 500.
+    """
+
+    def __init__(self, message: str, *, tenant: str,
+                 retry_after_s: float = 1.0):
+        super().__init__(message, retry_after_s=retry_after_s)
+        self.tenant = tenant
 
 
 # -- deadline arithmetic -----------------------------------------------------

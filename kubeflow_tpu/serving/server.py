@@ -59,7 +59,7 @@ from kubeflow_tpu.obs.exposition import (
     TraceContextHandlerMixin,
     access_log_function,
 )
-from kubeflow_tpu.serving import overload
+from kubeflow_tpu.serving import overload, tenancy
 from kubeflow_tpu.serving.manager import ModelManager
 
 logger = logging.getLogger(__name__)
@@ -245,6 +245,14 @@ class InferHandler(BaseHandler):
         self._obs_model = name
         try:
             model = self.manager.get_model(name)
+            # Tenant identity (ISSUE 14): explicit X-KFT-Tenant, else
+            # an X-KFT-Api-Key mapped through the policy, else
+            # 'default'. The proxy forwards both headers verbatim, so
+            # this server — the layer that owns the queues — is the
+            # enforcement point.
+            self._tenant = tenancy.tenant_from_headers(
+                self.request.headers,
+                getattr(self.manager, "tenancy", None))
             body = json.loads(self.request.body or b"{}")
             instances = body.get("instances")
             handoffs_b64 = body.get("handoffs")
@@ -382,6 +390,7 @@ class InferHandler(BaseHandler):
             future = model.submit({input_name: batch}, sig_name, verb,
                                   want, deadline=deadline,
                                   obs_ctx=self._obs_ctx,
+                                  tenant=self._tenant,
                                   on_streams=self._register_streams)
             # Never hold the connection past the budget.
             result = await _await_future(
@@ -401,6 +410,16 @@ class InferHandler(BaseHandler):
             self._obs_outcome = "expired"
             self.write_json({"error": str(e),
                              "code": "DEADLINE_EXCEEDED"}, 504)
+        except overload.QuotaExceededError as e:
+            # ONE tenant's bucket ran dry (ISSUE 14): structured 429,
+            # distinct from the global 503 shed — the server has
+            # capacity, this tenant spent its share. Retry-After is
+            # the bucket's own refill estimate.
+            self._obs_outcome = "quota_shed"
+            self.set_header("Retry-After",
+                            overload.retry_after_header(e.retry_after_s))
+            self.write_json({"error": str(e), "tenant": e.tenant,
+                             "code": "QUOTA_EXCEEDED"}, 429)
         except overload.OverloadedError as e:
             # Shed by admission control / queue cap: 503 with the
             # server's estimate of when capacity frees up.
@@ -443,7 +462,7 @@ class InferHandler(BaseHandler):
         work = loop.run_in_executor(
             None, lambda: model.prefill_handoff(
                 inputs, sig_name, version, deadline=deadline,
-                max_new_tokens=max_new))
+                tenant=self._tenant, max_new_tokens=max_new))
         try:
             loaded, handoffs = await asyncio.wait_for(
                 asyncio.shield(work),
@@ -487,7 +506,7 @@ class InferHandler(BaseHandler):
                 {"error": f"bad KV handoff: {e}"}, 400)
         loaded, streams = model.submit_handoff(
             handoffs, version, deadline=deadline,
-            obs_ctx=self._obs_ctx)
+            obs_ctx=self._obs_ctx, tenant=self._tenant)
         if wants_stream:
             return await self._stream_generate(
                 name, model, loaded, None, None, None, body,
@@ -536,7 +555,8 @@ class InferHandler(BaseHandler):
             return self.write_json(
                 {"error": f"bad resume token: {e}"}, 400)
         loaded, streams = model.submit_resume(
-            resumes, version, deadline=deadline, obs_ctx=self._obs_ctx)
+            resumes, version, deadline=deadline, obs_ctx=self._obs_ctx,
+            tenant=self._tenant)
         return await self._stream_generate(
             name, model, loaded, None, None, None, body, deadline,
             streams=streams)
@@ -564,7 +584,8 @@ class InferHandler(BaseHandler):
                 max_new = int(max_new)
             _, streams = model.submit_stream(
                 inputs, sig_name, version, deadline=deadline,
-                obs_ctx=self._obs_ctx, max_new_tokens=max_new)
+                obs_ctx=self._obs_ctx, tenant=self._tenant,
+                max_new_tokens=max_new)
         self._live_streams = streams
         self.set_header("Content-Type", wire.SSE_CONTENT_TYPE)
         self.set_header("Cache-Control", "no-cache")
@@ -699,6 +720,8 @@ class InferHandler(BaseHandler):
 def _stream_error_code(error: BaseException) -> str:
     if isinstance(error, overload.DeadlineExceededError):
         return "DEADLINE_EXCEEDED"
+    if isinstance(error, overload.QuotaExceededError):
+        return "QUOTA_EXCEEDED"
     if isinstance(error, overload.OverloadedError):
         return "RESOURCE_EXHAUSTED"
     return "INTERNAL"
@@ -778,6 +801,11 @@ class GrpcWebPredictHandler(BaseHandler):
             if timeout_header:
                 deadline = overload.deadline_after(
                     wire.parse_grpc_timeout(timeout_header))
+            # The tenant rides plain HTTP headers on the gRPC-Web
+            # bridge, exactly like the REST surface (ISSUE 14).
+            tenant = tenancy.tenant_from_headers(
+                self.request.headers,
+                getattr(self.manager, "tenancy", None))
             loop = tornado.ioloop.IOLoop.current()
             # start_* resolve the model version, which may load a
             # pinned version on demand — pool thread, not the IO loop.
@@ -785,13 +813,13 @@ class GrpcWebPredictHandler(BaseHandler):
                 spec, loaded, future, output_filter = (
                     await loop.run_in_executor(
                         None, svc.start_predict, self.manager, data[0],
-                        deadline, self._obs_ctx))
+                        deadline, self._obs_ctx, tenant))
                 finish = lambda out: svc.finish_predict(  # noqa: E731
                     spec, loaded, out, output_filter)
             elif method == "Classify":
                 spec, loaded, future = await loop.run_in_executor(
                     None, svc.start_classify, self.manager, data[0],
-                    deadline, self._obs_ctx)
+                    deadline, self._obs_ctx, tenant)
                 finish = lambda out: svc.finish_classify(  # noqa: E731
                     spec, loaded, out)
             else:  # GetModelMetadata (route regex restricts the set)
@@ -812,6 +840,10 @@ class GrpcWebPredictHandler(BaseHandler):
         except (concurrent.futures.TimeoutError,
                 overload.DeadlineExceededError) as e:
             self._grpc_error(4, str(e) or "predict timed out")  # DEADLINE
+        except overload.QuotaExceededError as e:
+            # gRPC has no 429: RESOURCE_EXHAUSTED with the tenant in
+            # the message (the REST surface keeps the distinct code).
+            self._grpc_error(8, str(e))
         except overload.OverloadedError as e:
             self._grpc_error(8, str(e))  # RESOURCE_EXHAUSTED
         except RuntimeError as e:
@@ -955,6 +987,13 @@ def main(argv=None) -> int:
                              "KFT_ENABLE_FAULTS=1 — chaos tests and "
                              "bench only, never production; "
                              "docs/resilience.md)")
+    parser.add_argument("--tenant_policy", default=None,
+                        help="JSON tenant quota/weight policy file "
+                             "(hot-reloaded, last-good-on-malformed; "
+                             "enables per-tenant token-bucket quotas "
+                             "— over-quota = 429 — and weighted-fair "
+                             "queueing across tenants; "
+                             "docs/tenancy.md)")
     parser.add_argument("--sse_keepalive", type=float,
                         default=SSE_KEEPALIVE_INTERVAL_S,
                         help="seconds between ': keepalive' SSE "
@@ -992,7 +1031,26 @@ def main(argv=None) -> int:
         from kubeflow_tpu.obs.tracing import TRACER
 
         TRACER.set_tail_sampling(args.trace_tail_keep)
-    manager = ModelManager(poll_interval_s=args.poll_interval)
+    registry = None
+    if args.tenant_policy:
+        from kubeflow_tpu.serving.tenancy import (
+            TenantPolicy,
+            TenantPolicySource,
+            TenantRegistry,
+        )
+
+        # Parse once at startup so a broken INITIAL policy fails the
+        # process loudly (the hot-reload path keeps last-good only
+        # for REwrites of a policy that once parsed).
+        try:
+            with open(args.tenant_policy) as f:
+                initial = TenantPolicy.from_json(f.read())
+        except (OSError, ValueError) as e:
+            parser.error(f"--tenant_policy {args.tenant_policy}: {e}")
+        registry = TenantRegistry(TenantPolicySource(
+            args.tenant_policy, initial=initial))
+    manager = ModelManager(poll_interval_s=args.poll_interval,
+                           tenancy_registry=registry)
     # Defer the (slow) first model loads to the poll thread: the ports
     # open immediately and /healthz answers 503 until loaded, so
     # kubelet probes see a live-but-not-ready pod instead of a dead one.

@@ -388,7 +388,13 @@ def check_serving_timeout_discipline() -> list:
     and a bare except that swallows ``CancelledError`` or
     ``KeyboardInterrupt`` turns a cancelled hedge loser into a
     zombie. Narrow ``except Exception`` (with a noqa rationale) is
-    the allowed catch-all."""
+    the allowed catch-all.
+
+    ISSUE 14: the glob covers ``serving/tenancy.py`` too (pinned
+    here because the quota/fair-queue code sits INSIDE the submit
+    hot path — a stray unbounded wait or bare except there stalls or
+    zombifies every tenant at once, the exact blast radius tenancy
+    exists to prevent)."""
     errors = []
     serving_dir = REPO / "kubeflow_tpu" / "serving"
     files = sorted(serving_dir.glob("*.py"))

@@ -54,7 +54,8 @@ def test_e2e_workflow_manifest():
     names = {t["name"] for t in wf["spec"]["templates"]}
     for step in ("checkout", "unit-test", "deploy-test", "tpujob-test",
                  "serving-test", "leader-failover-test",
-                 "elastic-kill-test", "serving-chaos", "teardown",
+                 "elastic-kill-test", "serving-chaos",
+                 "serving-tenancy", "teardown",
                  "copy-artifacts", "e2e"):
         assert step in names, step
     dag = next(t for t in wf["spec"]["templates"] if t["name"] == "e2e")

@@ -507,3 +507,28 @@ def test_tpu_lm_checkpoint_pvc_mounts():
     })
     pod = objs[0]["spec"]["replicaSpecs"][0]["template"]["spec"]
     assert "volumes" not in pod
+
+
+def test_serving_tenant_policy_mount():
+    """`tenant_policy <cm>` (ISSUE 14) mounts the ConfigMap-held
+    quota policy and arms the server's --tenant_policy flag; empty
+    leaves the pod untouched (tenancy off = the classic stack)."""
+    proto = get_prototype("tpu-serving")
+    base = {"name": "llama", "model_path": "gs://b/m"}
+    dep, _ = proto.build({**base, "tenant_policy": "llama-tenants"})
+    tpl = dep["spec"]["template"]["spec"]
+    server = tpl["containers"][0]
+    assert "--tenant_policy=/etc/kft-tenancy/policy.json" \
+        in server["args"]
+    assert any(m["name"] == "tenant-policy"
+               and m["mountPath"] == "/etc/kft-tenancy"
+               for m in server["volumeMounts"])
+    assert any(v.get("configMap", {}).get("name") == "llama-tenants"
+               for v in tpl["volumes"])
+    # Off by default: no mount, no flag, no volume.
+    dep_off, _ = proto.build(base)
+    tpl_off = dep_off["spec"]["template"]["spec"]
+    assert not any("tenant_policy" in a
+                   for a in tpl_off["containers"][0]["args"])
+    assert not any(v["name"] == "tenant-policy"
+                   for v in tpl_off.get("volumes") or ())

@@ -1,0 +1,1092 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Multi-tenant isolation (ISSUE 14, serving/tenancy.py).
+
+Covers: identity parsing (header / api-key / gRPC metadata), token
+buckets + policy hot reload (last-good-on-malformed), the weighted-
+fair queue (single-tenant FIFO bitwise guard, weighted drain, no
+cross-tenant head-of-line blocking), the scheduler fuzz (random
+tenant mixes × reservation sizes with allocator invariants per step),
+quota 429 semantics through the manager and the REAL HTTP server +
+pooled proxy (the noisy-neighbor integration test), metric-label
+cardinality capping against a 10k-tenant spray, and the dashboard's
+tenants surface.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+import tornado.httpserver
+import tornado.testing
+import tornado.web
+
+from kubeflow_tpu.inference.engine import PageAllocator, SlotScheduler
+from kubeflow_tpu.serving import overload, tenancy
+from kubeflow_tpu.serving.manager import ModelManager, ServedModel
+from kubeflow_tpu.serving.overload import QuotaExceededError
+from kubeflow_tpu.serving.tenancy import (
+    FairQueue,
+    TenantLabelCapper,
+    TenantPolicy,
+    TenantPolicySource,
+    TenantQuota,
+    TenantRegistry,
+    TenantRequestQueue,
+    TokenBucket,
+)
+
+
+# -- identity ----------------------------------------------------------------
+
+
+def test_normalize_tenant():
+    assert tenancy.normalize_tenant(None) == "default"
+    assert tenancy.normalize_tenant("") == "default"
+    assert tenancy.normalize_tenant(" team-a ") == "team-a"
+    assert tenancy.normalize_tenant("A.b_c-9") == "A.b_c-9"
+    # Malformed ids sanitize deterministically — they must NOT fold
+    # into 'default' (that would let a client escape its own quota by
+    # mangling its header).
+    assert tenancy.normalize_tenant("te nant!") == "tenant"
+    assert tenancy.normalize_tenant("x" * 200) == "x" * 64
+    garbage = tenancy.normalize_tenant("\x00\x01")
+    assert garbage.startswith("tenant-") and garbage != "default"
+    # Stable: same garbage, same bucket.
+    assert garbage == tenancy.normalize_tenant("\x00\x01")
+
+
+def test_tenant_from_headers_and_metadata():
+    registry = TenantRegistry(TenantPolicy(
+        api_keys={"sk-alpha": "alpha"}))
+    assert tenancy.tenant_from_headers({}, registry) == "default"
+    assert tenancy.tenant_from_headers(
+        {"X-KFT-Tenant": "beta"}, registry) == "beta"
+    # Explicit tenant wins over the api key.
+    assert tenancy.tenant_from_headers(
+        {"X-KFT-Tenant": "beta", "X-KFT-Api-Key": "sk-alpha"},
+        registry) == "beta"
+    assert tenancy.tenant_from_headers(
+        {"X-KFT-Api-Key": "sk-alpha"}, registry) == "alpha"
+    # Unknown keys become a stable anonymous per-key tenant (each key
+    # rate-limited individually — spraying keys can't pool into one
+    # bucket NOR escape the default quota).
+    anon = tenancy.tenant_from_headers(
+        {"X-KFT-Api-Key": "sk-unknown"}, registry)
+    assert anon.startswith("key-") and anon != "default"
+    assert anon == tenancy.tenant_from_headers(
+        {"X-KFT-Api-Key": "sk-unknown"}, registry)
+    # gRPC metadata flavor: lowercase pairs.
+    assert tenancy.tenant_from_metadata(
+        [("x-kft-tenant", "gamma")], registry) == "gamma"
+    assert tenancy.tenant_from_metadata(
+        [("x-kft-api-key", "sk-alpha")], registry) == "alpha"
+    assert tenancy.tenant_from_metadata([], registry) == "default"
+    assert tenancy.tenant_from_metadata(None, registry) == "default"
+
+
+# -- token bucket + policy ---------------------------------------------------
+
+
+def test_token_bucket_refill_and_retry_after():
+    clock = [0.0]
+    b = TokenBucket(10.0, 5.0, clock=lambda: clock[0])
+    for _ in range(5):
+        assert b.try_take(1.0)
+    assert not b.try_take(1.0)  # dry
+    assert b.retry_after_s(1.0) == pytest.approx(0.1, abs=0.02)
+    clock[0] = 0.3  # 3 tokens refilled
+    assert b.try_take(3.0)
+    assert not b.try_take(0.5)
+    # Unlimited bucket: always yes, retry-after 0.
+    free = TokenBucket(None, 1.0)
+    assert free.try_take(1e9) and free.retry_after_s() == 0.0
+    # A cost deeper than the bucket reports the full refill, bounded.
+    assert b.retry_after_s(100.0) <= 5.0 / 10.0 + 0.001
+
+
+def test_policy_parse_defaults_and_loud_unknown_keys():
+    policy = TenantPolicy.from_json(json.dumps({
+        "default": {"requests_per_s": 5},
+        "tenants": {"alpha": {"requests_per_s": 50, "weight": 4}},
+        "api_keys": {"sk-1": "alpha"},
+    }))
+    assert policy.quota("alpha").fair_weight() == 4
+    assert policy.quota("nobody").requests_per_s == 5
+    # weight defaults to the requests/s quota share.
+    assert policy.quota("nobody").fair_weight() == 5
+    assert policy.api_keys["sk-1"] == "alpha"
+    with pytest.raises(ValueError, match="unknown quota key"):
+        TenantPolicy.from_json(json.dumps(
+            {"tenants": {"a": {"request_per_s": 5}}}))  # typo'd knob
+    with pytest.raises(ValueError, match="unknown key"):
+        TenantPolicy.from_json(json.dumps({"tennants": {}}))
+    with pytest.raises(ValueError):
+        TenantPolicy.from_json(json.dumps(
+            {"default": {"requests_per_s": -1}}))
+
+
+def test_policy_source_hot_reload_keeps_last_good(tmp_path):
+    path = tmp_path / "policy.json"
+    path.write_text(json.dumps(
+        {"tenants": {"a": {"requests_per_s": 7}}}))
+    source = TenantPolicySource(str(path))
+    assert source.policy().quota("a").requests_per_s == 7
+    # Good rewrite applies.
+    path.write_text(json.dumps(
+        {"tenants": {"a": {"requests_per_s": 9}}}))
+    assert source.policy().quota("a").requests_per_s == 9
+    # Malformed rewrite keeps the LAST GOOD policy (the --fault_plan
+    # contract: a half-written file must not drop every quota).
+    path.write_text("{not json")
+    assert source.policy().quota("a").requests_per_s == 9
+    # Deleted file: same.
+    path.unlink()
+    assert source.policy().quota("a").requests_per_s == 9
+
+
+def test_registry_quota_429_semantics_and_hot_rearm():
+    registry = TenantRegistry(TenantPolicy(
+        default=TenantQuota(requests_per_s=1000),
+        tenants={"tiny": TenantQuota(requests_per_s=5,
+                                     request_burst=2)}))
+    registry.admit_request("tiny")
+    registry.admit_request("tiny")
+    with pytest.raises(QuotaExceededError) as ei:
+        registry.admit_request("tiny")
+    assert ei.value.tenant == "tiny"
+    assert ei.value.retry_after_s > 0
+    # The other tenant is untouched — never a global shed.
+    for _ in range(50):
+        registry.admit_request("big")
+    stats = registry.stats()
+    assert stats["tenants"]["tiny"]["shed_quota"] == 1
+    assert stats["tenants"]["big"]["shed_quota"] == 0
+    assert stats["tracked"] == 2 and stats["evicted"] == 0
+    # Decode-token bucket: a generate budget past the rate sheds too.
+    registry2 = TenantRegistry(TenantPolicy(
+        default=TenantQuota(decode_tokens_per_s=100,
+                            token_burst=64)))
+    registry2.admit_request("t", decode_tokens=64)
+    with pytest.raises(QuotaExceededError, match="decode-token"):
+        registry2.admit_request("t", decode_tokens=64)
+
+
+# -- fair queue --------------------------------------------------------------
+
+
+class _Item:
+    def __init__(self, tenant, seq):
+        self.tenant = tenant
+        self.seq = seq
+
+    def __repr__(self):
+        return f"{self.tenant}:{self.seq}"
+
+
+def test_fair_queue_single_tenant_is_bitwise_fifo():
+    """THE single-tenant guard: one tenant ⇒ the drain order is the
+    old global FIFO's, element for element."""
+    fq = FairQueue()
+    items = [_Item("only", i) for i in range(64)]
+    for it in items:
+        fq.append(it)
+    assert list(fq) == items
+    assert fq[0] is items[0]
+    assert [fq.popleft() for _ in range(64)] == items
+    assert not fq and len(fq) == 0
+
+
+def test_fair_queue_weighted_drain_share():
+    fq = FairQueue(weight_of=lambda t: {"a": 3.0, "b": 1.0}[t])
+    for i in range(120):
+        fq.append(_Item("a", i))
+        fq.append(_Item("b", i))
+    first = [fq.popleft().tenant for _ in range(80)]
+    share_a = first.count("a") / len(first)
+    # Start-time fair queueing: service share tracks weight share
+    # (3:1) over any backlogged window.
+    assert 0.70 <= share_a <= 0.80, share_a
+    # FIFO within each tenant throughout.
+    drained_a = [it.seq for it in
+                 ([i for i in map(lambda _: fq.popleft(),
+                                  range(len(fq)))])
+                 if it.tenant == "a"]
+    assert drained_a == sorted(drained_a)
+
+
+def test_fair_queue_no_cross_tenant_head_of_line_blocking():
+    """heads() exposes every tenant's head in fair order: a blocked
+    head (reservation doesn't fit) holds ITS sub-queue only; another
+    tenant's head still admits via pop_head, and the skipped head is
+    not charged (keeps first claim)."""
+    fq = FairQueue()
+    big = _Item("whale", 0)
+    small1, small2 = _Item("minnow", 0), _Item("minnow", 1)
+    fq.append(big)
+    fq.append(small1)
+    fq.append(small2)
+    heads = fq.heads()
+    assert heads == [big, small1]  # whale arrived first → fair head
+    # The whale's reservation "doesn't fit": admit the minnow instead.
+    fq.pop_head(small1)
+    # The whale is STILL the fair head (it was never charged).
+    assert fq.heads()[0] is big
+    assert fq[0] is big
+    # FIFO within minnow held: small2 is its head now.
+    assert fq.heads()[1] is small2
+    with pytest.raises(ValueError):
+        fq.pop_head(small2) if False else fq.pop_head(_Item("x", 0))
+
+
+def test_fair_queue_vnow_never_rewinds_after_skipped_head():
+    """Review fix: serving a long-skipped head must not REWIND global
+    virtual time — a tenant activating right after would inherit the
+    stale tag and its whole burst would drain ahead of continuously
+    backlogged tenants."""
+    fq = FairQueue()
+    whale = _Item("whale", 0)
+    fq.append(whale)
+    for i in range(10):
+        fq.append(_Item("minnow", i))
+    # The whale is skipped (never charged) while minnows advance.
+    for _ in range(8):
+        heads = fq.heads()
+        assert heads[0] is whale
+        fq.pop_head(heads[1])  # admit the minnow head instead
+    # The whale finally admits — vnow must stay monotone.
+    fq.pop_head(whale)
+    fq.append(_Item("fresh", 0))
+    fq.append(_Item("fresh", 1))
+    # With monotone vnow the newcomer INTERLEAVES with the backlogged
+    # minnow from the current virtual time; a rewound vnow would hand
+    # the newcomer's whole burst the floor first.
+    order = [fq.popleft().tenant for _ in range(3)]
+    assert order == ["fresh", "minnow", "fresh"], order
+
+
+def test_cap_depths_bounds_reporting_surfaces():
+    """Review fix: queue-depth maps on healthz/batch_stats/engine
+    stats are capped like every other tenant-keyed surface."""
+    depths = {f"t{i}": i + 1 for i in range(100)}
+    capped = tenancy.cap_depths(depths, limit=5)
+    assert len(capped) == 6  # top-5 + other
+    assert capped["other"] == sum(depths.values()) - sum(
+        v for k, v in capped.items() if k != "other")
+    assert capped["t99"] == 100  # deepest tenants survive by name
+    small = {"a": 1, "b": 2}
+    assert tenancy.cap_depths(small, limit=5) == small
+    # End to end: a spray of queued tenants leaves a bounded healthz
+    # block (unlimited default quota; slow stub keeps them queued).
+    registry = TenantRegistry(TenantPolicy())
+    m, _stub = _tenant_model(registry, delay_s=0.2, max_batch=1)
+    try:
+        x = {"x": np.ones((1, 2), np.float32)}
+        futs = [m.submit(x, None, None, None, tenant=f"spray-{i}")
+                for i in range(tenancy.TENANT_CARDINALITY_CAP + 20)]
+        depths = m.batch_stats()["tenants"]["queue_depths"]
+        assert len(depths) <= tenancy.TENANT_CARDINALITY_CAP + 1
+        for f in futs:
+            f.result(30)
+    finally:
+        m.stop()
+
+
+def test_fair_queue_remove_if_preserves_suborder():
+    fq = FairQueue()
+    items = [_Item("a", 0), _Item("b", 0), _Item("a", 1),
+             _Item("b", 1), _Item("a", 2)]
+    for it in items:
+        fq.append(it)
+    removed = fq.remove_if(lambda it: it.seq == 1)
+    assert {(r.tenant, r.seq) for r in removed} == {("a", 1), ("b", 1)}
+    assert [(i.tenant, i.seq) for i in fq] == [
+        ("a", 0), ("a", 2), ("b", 0)]
+    assert fq.tenant_depths() == {"a": 2, "b": 1}
+    fq.clear()
+    assert len(fq) == 0 and fq.tenant_depths() == {}
+
+
+def test_tenant_request_queue_fifo_and_weighted_pop():
+    q = TenantRequestQueue(8)
+    for i in range(4):
+        assert q.push(i, "solo")
+    assert q.pop_batch(10, timeout_s=0.1) == [0, 1, 2, 3]
+    # Weighted interleave across tenants.
+    q2 = TenantRequestQueue(
+        64, weight_of=lambda t: {"a": 2.0, "b": 1.0}[t])
+    for i in range(6):
+        q2.push(100 + i, "a")
+        q2.push(200 + i, "b")
+    batch = q2.pop_batch(12, timeout_s=0.1)
+    assert len(batch) == 12
+    a_ids = [i for i in batch if i < 200]
+    b_ids = [i for i in batch if i >= 200]
+    assert a_ids == sorted(a_ids) and b_ids == sorted(b_ids)
+    # 'a' outranks 'b' 2:1 in the early drain.
+    assert [i for i in batch[:6] if i < 200] == [100, 101, 102, 103]
+    # Capacity + close semantics match the native queue.
+    q3 = TenantRequestQueue(1)
+    assert q3.push(1, "t") and not q3.push(2, "t")
+    q3.close()
+    assert q3.pop_batch(1, timeout_s=0.01) == [1]
+    assert q3.pop_batch(1, timeout_s=0.01) is None
+    with pytest.raises(RuntimeError):
+        q3.push(3, "t")
+
+
+# -- scheduler fuzz ----------------------------------------------------------
+
+
+class _FuzzReq:
+    def __init__(self, tenant, seq, pages):
+        self.tenant = tenant
+        self.seq = seq
+        self.pages = pages
+        self.max_new_tokens = 4
+        self.deadline = None
+        self.step_keys = np.zeros((4, 2), np.uint32)
+
+
+def test_weighted_fair_scheduler_fuzz():
+    """ISSUE 14 satellite: random tenant mixes × reservation sizes
+    through SlotScheduler + PageAllocator. Invariants, checked every
+    step: (a) admissions are FIFO within each tenant; (b) no
+    cross-tenant head-of-line blocking — next_admittable returns None
+    with a free slot ONLY when no tenant's head fits the pool; (c)
+    the page allocator's accounting survives (check_invariants); (d)
+    no starvation — once arrivals stop, every backlogged tenant
+    drains to zero."""
+    rng = np.random.RandomState(1234)
+    for trial in range(8):
+        num_pages = int(rng.randint(6, 20))
+        num_slots = int(rng.randint(1, 5))
+        tenants = [f"t{i}" for i in range(int(rng.randint(1, 5)))]
+        weights = {t: float(rng.choice([0.5, 1.0, 2.0, 4.0]))
+                   for t in tenants}
+        alloc = PageAllocator(num_pages)
+        sched = SlotScheduler(num_slots, alloc,
+                              weight_of=lambda t, w=weights: w[t])
+        usable = num_pages - 1
+        next_seq = {t: 0 for t in tenants}
+        expect_seq = {t: 0 for t in tenants}
+        active = []  # (slot, req, allocated_list)
+        submitted = 0
+        drained = 0
+
+        def admit_once():
+            nonlocal drained
+            req = sched.next_admittable(lambda r: r.pages)
+            if req is None:
+                # (b) no cross-tenant HOL: with a free slot, None
+                # means NO head fits — or the bounded starvation
+                # guard is holding the line for a fair-first head
+                # that provably doesn't fit yet.
+                if sched.has_free_slot():
+                    heads = sched.pending.heads()
+                    if sched.holding_for_head():
+                        assert heads and \
+                            alloc.available() < heads[0].pages, \
+                            (trial, alloc.available())
+                    else:
+                        for head in heads:
+                            assert alloc.available() < head.pages, \
+                                (trial, head.tenant, head.pages,
+                                 alloc.available())
+                return False
+            # (a) per-tenant FIFO.
+            assert req.seq == expect_seq[req.tenant], \
+                (trial, req.tenant, req.seq, expect_seq)
+            expect_seq[req.tenant] += 1
+            # Emulate the engine: lazily alloc part of the budget.
+            k = int(rng.randint(0, req.pages + 1))
+            pages = alloc.alloc(k) if k else []
+            slot = sched.bind(req, prompt_width=4, pad_len=0,
+                              first_token=1, done=False,
+                              budget_pages=req.pages, deadline=None)
+            active.append((slot, req, pages))
+            drained += 1
+            return True
+
+        def retire_one():
+            idx = int(rng.randint(0, len(active)))
+            slot, req, pages = active.pop(idx)
+            if pages:
+                alloc.free(pages)
+            alloc.unreserve(req.pages - len(pages))
+            sched.retire(slot, "eos")
+
+        for step in range(300):
+            action = rng.rand()
+            if action < 0.45 and submitted < 150:
+                t = tenants[int(rng.randint(0, len(tenants)))]
+                req = _FuzzReq(t, next_seq[t],
+                               int(rng.randint(1, usable + 1)))
+                next_seq[t] += 1
+                sched.pending.append(req)
+                submitted += 1
+            elif action < 0.80:
+                admit_once()
+            elif active:
+                retire_one()
+            alloc.check_invariants()
+        # (d) drain: stop arrivals; admits + retires must empty the
+        # queue (no wedged head, no leaked reservation).
+        for _ in range(3000):
+            if not sched.pending and not active:
+                break
+            if not admit_once():
+                if active:
+                    retire_one()
+                elif sched.pending:
+                    pytest.fail(
+                        f"trial {trial}: backlog wedged with no "
+                        f"active slots: {sched.tenant_depths()}")
+            alloc.check_invariants()
+        assert not sched.pending and not active
+        assert alloc.available() == usable
+        assert drained == submitted
+
+
+# -- manager quota + WFQ -----------------------------------------------------
+
+
+class _StubLoaded:
+    version = 1
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def signature(self, name=None):
+        class Sig:
+            method = "predict"
+            inputs = {"x": None}
+        return Sig()
+
+    def run(self, inputs, sig_name=None, method=None):
+        self.calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return {"y": np.asarray(inputs["x"]) * 2.0}
+
+
+def _tenant_model(registry, **kwargs):
+    delay_s = kwargs.pop("delay_s", 0.0)
+    m = ServedModel("stub", "/nonexistent", batch_window_s=0.001,
+                    tenancy_registry=registry, **kwargs)
+    stub = _StubLoaded(delay_s)
+    m._versions[1] = stub
+    m._latest = 1
+    return m, stub
+
+
+def test_manager_quota_shed_is_429_never_global():
+    registry = TenantRegistry(TenantPolicy(
+        tenants={"tiny": TenantQuota(requests_per_s=1,
+                                     request_burst=1)}))
+    m, stub = _tenant_model(registry)
+    try:
+        x = {"x": np.ones((1, 2), np.float32)}
+        ok = m.submit(x, None, None, None, tenant="tiny")
+        assert ok.result(5)["y"][0][0] == 2.0
+        shed = m.submit(x, None, None, None, tenant="tiny")
+        with pytest.raises(QuotaExceededError) as ei:
+            shed.result(5)
+        assert ei.value.tenant == "tiny"
+        # NEVER a global shed: the model-level shed counter (the r8
+        # overload signal) is untouched; the per-tenant registry
+        # counter carries the event.
+        stats = m.batch_stats()
+        assert stats["shed"] == 0 and stats["expired"] == 0
+        assert stats["tenants"]["registry"]["tenants"][
+            "tiny"]["shed_quota"] == 1
+        # The other tenant sails through the same instant.
+        other = m.submit(x, None, None, None, tenant="other")
+        assert other.result(5)["y"][0][0] == 2.0
+        assert stub.calls == 2
+    finally:
+        m.stop()
+
+
+def test_manager_single_tenant_counts_identical_to_classic():
+    """Count-level bitwise guard at the manager: the same traffic
+    with and without a tenancy registry (one tenant) produces the
+    same dispatch/shed accounting."""
+    def drive(registry):
+        m, stub = _tenant_model(registry)
+        try:
+            x = {"x": np.ones((1, 2), np.float32)}
+            futs = [m.submit(x, None, None, None) for _ in range(12)]
+            for f in futs:
+                f.result(5)
+            stats = m.batch_stats()
+            return stats["rows"], stats["shed"], stats["expired"], \
+                stub.calls
+        finally:
+            m.stop()
+
+    unlimited = TenantRegistry(TenantPolicy())
+    assert drive(None) == drive(unlimited)
+
+
+def test_manager_batcher_drains_tenants_weighted_fair():
+    """With a slow model and two backlogged tenants, the batcher's
+    pop order follows quota share: the heavy-weight tenant's requests
+    dispatch ahead 2:1, FIFO inside each tenant."""
+    registry = TenantRegistry(TenantPolicy(tenants={
+        "gold": TenantQuota(requests_per_s=1000, weight=2.0),
+        "bronze": TenantQuota(requests_per_s=1000, weight=1.0)}))
+    m, stub = _tenant_model(registry, max_batch=1)
+    dispatch_order = []
+    orig_run = stub.run
+
+    def run(inputs, sig_name=None, method=None):
+        dispatch_order.append(float(np.asarray(inputs["x"])[0, 0]))
+        time.sleep(0.01)
+        return orig_run(inputs, sig_name, method)
+
+    stub.run = run
+    try:
+        # Block the batcher behind one slow request, then backlog.
+        first = m.submit({"x": np.full((1, 2), -1.0, np.float32)},
+                         None, None, None, tenant="gold")
+        time.sleep(0.05)
+        futs = []
+        for i in range(6):
+            futs.append(m.submit(
+                {"x": np.full((1, 2), 100.0 + i, np.float32)},
+                None, None, None, tenant="gold"))
+            futs.append(m.submit(
+                {"x": np.full((1, 2), 200.0 + i, np.float32)},
+                None, None, None, tenant="bronze"))
+        first.result(10)
+        for f in futs:
+            f.result(10)
+        order = [v for v in dispatch_order if v >= 0]
+        gold = [v for v in order if v < 200]
+        bronze = [v for v in order if v >= 200]
+        assert gold == sorted(gold) and bronze == sorted(bronze)
+        # Gold's 2.0 weight shows in the early drain: of the first 6
+        # dispatches, gold holds a strict majority.
+        first6 = order[:6]
+        assert sum(1 for v in first6 if v < 200) >= 4, order
+    finally:
+        m.stop()
+
+
+# -- engine queue-full attribution (satellite bugfix) ------------------------
+
+
+def test_engine_queue_full_names_tenant_depths(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.inference.engine import DecodeEngine, EngineConfig
+    from kubeflow_tpu.models.llama import llama_test
+
+    model = llama_test(dtype=jnp.float32, cache_size=48)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    cfg = EngineConfig(max_new_tokens=8, max_prompt_len=16,
+                       num_slots=1, page_size=8, slice_tokens=4,
+                       queue_capacity=2)
+    engine = DecodeEngine(model, params, cfg, name="tenant-full")
+    # Freeze admission: requests pile in pending deterministically.
+    monkeypatch.setattr(DecodeEngine, "_ensure_thread",
+                        lambda self: None)
+    try:
+        prompt = np.arange(4, dtype=np.int32)
+        engine.submit(prompt, tenant="noisy")
+        engine.submit(prompt, tenant="noisy")
+        with pytest.raises(overload.OverloadedError) as ei:
+            engine.submit(prompt, tenant="victim")
+        msg = str(ei.value)
+        # The satellite bugfix: a queue-full shed is ATTRIBUTABLE —
+        # the message names the submitting tenant's depth and the top
+        # queue holder, and stats carry the per-tenant depths.
+        assert "tenant 'victim' holds 0" in msg, msg
+        assert "top holder 'noisy' with 2" in msg, msg
+        assert engine.stats()["tenant_queue_depths"] == {"noisy": 2}
+    finally:
+        engine.stop()
+
+
+# -- cardinality cap ---------------------------------------------------------
+
+
+def test_tenant_label_capper_basics():
+    capper = TenantLabelCapper(cap=3)
+    assert capper.label("a") == "a"
+    assert capper.label("b") == "b"
+    assert capper.label("c") == "c"
+    assert capper.label("d") == "other"
+    # Stable on re-query, both sides of the cap.
+    assert capper.label("a") == "a"
+    assert capper.label("d") == "other"
+    with pytest.raises(ValueError):
+        TenantLabelCapper(cap=0)
+
+
+def test_registry_state_bounded_under_key_spray():
+    """Review fix: the registry's runtime state (not just the
+    metric labels) is bounded against an API-key sprayer — named
+    tenants keep their buckets, anonymous ones evict FIFO past the
+    cap, and stats() stays a bounded payload."""
+    registry = TenantRegistry(TenantPolicy(
+        tenants={"gold": TenantQuota(requests_per_s=1000)}))
+    registry.admit_request("gold")
+    for i in range(tenancy.MAX_TRACKED_TENANTS + 500):
+        registry.admit_request(f"key-spray-{i}")
+    stats = registry.stats()
+    assert stats["tracked"] <= tenancy.MAX_TRACKED_TENANTS
+    assert stats["evicted"] >= 500
+    # Named tenants never lose state; the payload stays bounded.
+    assert "gold" in stats["tenants"]
+    assert len(stats["tenants"]) <= 33
+
+
+def test_is_quota_detail_discriminates_shed_flavors():
+    """Review fix: the proxy's binary (gRPC) upstream hop restores
+    the 429 from RESOURCE_EXHAUSTED details — the message shape is a
+    contract between grpc_server._abort_for and the proxy."""
+    registry = TenantRegistry(TenantPolicy(
+        tenants={"t": TenantQuota(requests_per_s=1,
+                                  request_burst=1)}))
+    registry.admit_request("t")
+    with pytest.raises(QuotaExceededError) as ei:
+        registry.admit_request("t")
+    assert tenancy.is_quota_detail(str(ei.value))
+    reg2 = TenantRegistry(TenantPolicy(
+        default=TenantQuota(decode_tokens_per_s=1, token_burst=1)))
+    reg2.admit_request("u", decode_tokens=1)
+    with pytest.raises(QuotaExceededError) as ei2:
+        reg2.admit_request("u", decode_tokens=1)
+    assert tenancy.is_quota_detail(str(ei2.value))
+    # Global-shed shapes must NOT read as quota.
+    assert not tenancy.is_quota_detail(
+        "engine overloaded: estimated time-to-first-token 100ms "
+        "exceeds remaining budget 10ms")
+    assert not tenancy.is_quota_detail(
+        "server overloaded: request queue full")
+    assert not tenancy.is_quota_detail(None)
+    assert not tenancy.is_quota_detail("")
+
+
+def test_scheduler_starvation_guard_holds_line_for_big_head():
+    """Review fix: a large reservation skipped by the fair scan
+    cannot starve forever behind another tenant's stream of small
+    requests — after STARVATION_HOLD_ATTEMPTS consecutive skips of
+    the same fair-first head the whole line holds, pages accumulate,
+    and the whale admits."""
+    alloc = PageAllocator(12)  # 11 usable
+    sched = SlotScheduler(4, alloc)
+    whale = _FuzzReq("whale", 0, 10)
+    sched.pending.append(whale)
+    minnow_seq = [0]
+
+    def feed_minnow():
+        sched.pending.append(_FuzzReq("minnow", minnow_seq[0], 3))
+        minnow_seq[0] += 1
+
+    sizes = lambda r: r.pages  # noqa: E731
+    active = []
+    feed_minnow()
+    feed_minnow()
+    admitted_whale = False
+    # Adversarial loop: every retire is immediately chased by a new
+    # minnow, so without the guard free pages never reach 10.
+    for step in range(
+            SlotScheduler.STARVATION_HOLD_ATTEMPTS * 4 + 20):
+        req = sched.next_admittable(sizes)
+        if req is whale:
+            admitted_whale = True
+            break
+        if req is not None:
+            slot = sched.bind(req, prompt_width=4, pad_len=0,
+                              first_token=1, done=False,
+                              budget_pages=req.pages, deadline=None)
+            active.append((slot, req))
+            feed_minnow()
+        elif active:
+            slot, done_req = active.pop(0)
+            alloc.unreserve(done_req.pages)
+            sched.retire(slot, "eos")
+        alloc.check_invariants()
+    assert admitted_whale, (sched.holding_for_head(),
+                            alloc.available(),
+                            sched.tenant_depths())
+
+
+def test_tenant_metric_cardinality_capped_under_spray(monkeypatch):
+    """Acceptance: 10k distinct sprayed tenant ids leave ≤ top-K +
+    'other' tenant label values in /metrics AND in the r13 collector
+    store."""
+    from kubeflow_tpu.obs import metrics as obs_metrics
+    from kubeflow_tpu.obs.collector import TimeSeriesStore
+
+    def spray_labels():
+        families = obs_metrics.parse_exposition(obs_metrics.render())
+        fam = families.get("kft_tenant_requests_total",
+                           {"samples": []})
+        return {labels.get("tenant")
+                for _n, labels, _v in fam["samples"]}
+
+    before = spray_labels()
+    fresh = TenantLabelCapper()  # the production cap
+    monkeypatch.setattr(tenancy, "CAPPER", fresh)
+    for i in range(10_000):
+        tenancy.note_request(f"sprayed-{i}")
+        tenancy.note_shed(f"sprayed-{i}", "quota")
+        tenancy.observe_ttft(f"sprayed-{i}", 0.01)
+    after = spray_labels()
+    added = after - before
+    assert len(added) <= tenancy.TENANT_CARDINALITY_CAP + 1, added
+    assert "other" in after  # the overflow bucket absorbed the rest
+    # The collector store side: the whole capped family fits a small
+    # store without tripping ITS cardinality cap.
+    families = obs_metrics.parse_exposition(obs_metrics.render())
+    store = TimeSeriesStore(max_series=256)
+    for name in ("kft_tenant_requests_total", "kft_tenant_shed_total",
+                 "kft_tenant_expired_total"):
+        fam = families.get(name)
+        if fam is None:
+            continue
+        for sample_name, labels, value in fam["samples"]:
+            assert store.ingest(sample_name, labels, value,
+                                ts=time.monotonic())
+    assert store.dropped_series() == 0
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+
+def _stub_manager(registry, **kwargs):
+    manager = ModelManager(tenancy_registry=registry)
+    model, stub = _tenant_model(registry, **kwargs)
+    manager._models["stub"] = model
+    return manager, model, stub
+
+
+class TenantHTTPSurface(tornado.testing.AsyncHTTPTestCase):
+    """Header contract + structured 429 on the REAL server app."""
+
+    def get_app(self):
+        from kubeflow_tpu.serving.server import make_app
+
+        registry = TenantRegistry(TenantPolicy(
+            tenants={"tiny": TenantQuota(requests_per_s=1,
+                                         request_burst=1)},
+            api_keys={"sk-tiny": "tiny"}))
+        self.manager, self.model, self.stub = _stub_manager(registry)
+        return make_app(self.manager)
+
+    def tearDown(self):
+        self.model.stop()
+        super().tearDown()
+
+    def _predict(self, headers=None):
+        return self.fetch(
+            "/v1/models/stub:predict", method="POST",
+            body=json.dumps({"instances": [[1.0, 2.0]]}),
+            headers=headers or {})
+
+    def test_quota_maps_429_with_retry_after_and_tenant(self):
+        ok = self._predict({"X-KFT-Tenant": "tiny"})
+        assert ok.code == 200
+        shed = self._predict({"X-KFT-Tenant": "tiny"})
+        assert shed.code == 429
+        body = json.loads(shed.body)
+        assert body["code"] == "QUOTA_EXCEEDED"
+        assert body["tenant"] == "tiny"
+        assert int(shed.headers["Retry-After"]) >= 1
+        # Another tenant is served the same instant — never global.
+        other = self._predict({"X-KFT-Tenant": "other"})
+        assert other.code == 200
+
+    def test_api_key_maps_to_tenant(self):
+        ok = self._predict({"X-KFT-Api-Key": "sk-tiny"})
+        assert ok.code == 200
+        shed = self._predict({"X-KFT-Api-Key": "sk-tiny"})
+        assert shed.code == 429
+        assert json.loads(shed.body)["tenant"] == "tiny"
+
+    def test_absent_header_is_default_tenant(self):
+        assert self._predict().code == 200
+        stats = self.model.batch_stats()
+        assert stats["tenants"]["queue_depths"] == {}
+
+    def test_healthz_carries_tenant_stats(self):
+        self._predict({"X-KFT-Tenant": "tiny"})
+        resp = self.fetch("/healthz")
+        payload = json.loads(resp.body)
+        tenants = payload["saturation"]["stub"]["tenants"]
+        assert "registry" in tenants and "queue_depths" in tenants
+
+
+# -- real server + pooled proxy integration ----------------------------------
+
+
+class _RealStack:
+    """The REAL serving stack in-process: serving/server.py app over a
+    stub-model manager (with a tenancy registry) behind the pooled
+    http_proxy, both on one IOLoop thread — requests travel real
+    sockets, headers and all."""
+
+    def __init__(self, registry, *, max_batch=2, delay_s=0.02):
+        self.registry = registry
+        self.max_batch = max_batch
+        self.delay_s = delay_s
+        self.server_port = 0
+        self.proxy_port = 0
+        self._started = threading.Event()
+        self._thread = None
+        self.loop = None
+        self.model = None
+
+    def _run(self):
+        import asyncio
+
+        import tornado.ioloop
+
+        from kubeflow_tpu.serving.http_proxy import make_app as proxy_app
+        from kubeflow_tpu.serving.server import make_app as server_app
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self.loop = tornado.ioloop.IOLoop.current()
+        manager, self.model, self.stub = _stub_manager(
+            self.registry, max_batch=self.max_batch,
+            delay_s=self.delay_s)
+
+        class _Meta:
+            def to_json(self):
+                return {"signatures": {"serving_default": {
+                    "method": "predict",
+                    "inputs": {"x": {"dtype": "float32",
+                                     "shape": [-1, 2]}},
+                    "outputs": {"y": {"dtype": "float32",
+                                      "shape": [-1, 2]}},
+                }}}
+
+        self.model._versions[1].metadata = _Meta()
+        self.model._versions[1].delay_s = self.delay_s
+        sock, self.server_port = tornado.testing.bind_unused_port()
+        server = tornado.httpserver.HTTPServer(server_app(manager))
+        server.add_sockets([sock])
+        psock, self.proxy_port = tornado.testing.bind_unused_port()
+        proxy = tornado.httpserver.HTTPServer(proxy_app(
+            f"127.0.0.1:{self.server_port}", rpc_timeout=5.0))
+        proxy.add_sockets([psock])
+        self._started.set()
+        self.loop.start()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tenant-stack")
+        self._thread.start()
+        assert self._started.wait(10)
+        return self
+
+    def stop(self):
+        if self.model is not None:
+            self.model.stop()
+        if self.loop is not None:
+            self.loop.add_callback(self.loop.stop)
+        if self._thread is not None:
+            self._thread.join(10)
+
+
+def _post(port, tenant, deadline_ms, timeout_s=5.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/model/stub:predict",
+        data=json.dumps({"instances": [[1.0, 2.0]]}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-KFT-Tenant": tenant,
+                 "X-Deadline-Ms": str(int(deadline_ms))})
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        json.load(resp)
+    return time.perf_counter() - t0
+
+
+def test_noisy_tenant_cannot_break_compliant_p99_e2e():
+    """The acceptance stress test (ROADMAP #6 criterion) over the
+    REAL server + pooled proxy: one noisy tenant at 4× its quota
+    cannot push a compliant tenant's p99 past its deadline — the
+    noisy excess bounces as ITS OWN structured 429s (with
+    Retry-After, relayed verbatim by the proxy), compliant tenants
+    see zero quota sheds and their p99 stays inside the budget."""
+    delay_s, max_batch = 0.02, 2
+    capacity = max_batch / delay_s          # ≈100 rps
+    fair_share = capacity / 4               # 25 rps per tenant
+    # Generous deadline: the isolation property under test is that
+    # compliant latency tracks SERVICE time, not the neighbor's
+    # flood — the margin absorbs CI-box CPU contention without
+    # weakening the assertion (an unisolated queue behind a 4x flood
+    # sits at the deadline whatever its value; see bench.py
+    # --tenants for the tight-deadline contrast phases).
+    deadline_ms = 1500.0
+    registry = TenantRegistry(TenantPolicy(
+        default=TenantQuota(requests_per_s=fair_share,
+                            request_burst=max(4.0, fair_share / 2))))
+    stack = _RealStack(registry, max_batch=max_batch,
+                       delay_s=delay_s).start()
+    try:
+        # Seed the admission estimator like the real warmup would.
+        stack.model._latency.seed(delay_s)
+        _post(stack.proxy_port, "warm", 2000)
+        results = {}
+        lock = threading.Lock()
+        duration_s = 2.5
+        rates = {"noisy": 4.0 * fair_share,
+                 "compliant-0": 0.8 * fair_share,
+                 "compliant-1": 0.8 * fair_share,
+                 "compliant-2": 0.8 * fair_share}
+
+        def one(tenant):
+            try:
+                dt = _post(stack.proxy_port, tenant, deadline_ms)
+                outcome, value = "ok", dt
+            except urllib.error.HTTPError as e:
+                retry_after = e.headers.get("Retry-After")
+                try:
+                    code = json.loads(e.read() or b"{}").get("code")
+                except ValueError:
+                    code = None
+                outcome, value = f"http_{e.code}", (code, retry_after)
+            except Exception as e:  # noqa: BLE001 — fail the test
+                outcome, value = "error", repr(e)
+            with lock:
+                results.setdefault(tenant, []).append(
+                    (outcome, value))
+
+        threads = []
+        start = time.perf_counter()
+        for tenant, rate in rates.items():
+            n = int(rate * duration_s)
+            interval = 1.0 / rate
+            pool = min(n, 24)
+
+            def worker(i, tenant=tenant, n=n, interval=interval,
+                       pool=pool):
+                for k in range(i, n, pool):
+                    delay = start + k * interval - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    one(tenant)
+
+            threads.extend(
+                threading.Thread(target=worker, args=(i,),
+                                 daemon=True)
+                for i in range(pool))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(duration_s + 30)
+        assert not any(t.is_alive() for t in threads)
+
+        for tenant in ("compliant-0", "compliant-1", "compliant-2"):
+            rows = results[tenant]
+            lat = sorted(v for o, v in rows if o == "ok")
+            assert lat, rows[:5]
+            # ≥95% served, ZERO quota sheds, zero transport errors.
+            ok_frac = len(lat) / len(rows)
+            assert ok_frac >= 0.95, (tenant, rows[:10])
+            assert not any(o == "http_429" for o, _ in rows), tenant
+            assert not any(o == "error" for o, _ in rows), rows[:5]
+            # THE criterion: p99 inside the deadline.
+            p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+            assert p99 * 1e3 <= deadline_ms, (tenant, p99)
+        noisy = results["noisy"]
+        quota_sheds = [v for o, v in noisy if o == "http_429"]
+        assert quota_sheds, "noisy tenant never hit its quota"
+        # Structured 429 + Retry-After survive the proxy hop.
+        code, retry_after = quota_sheds[0]
+        assert code == "QUOTA_EXCEEDED"
+        assert retry_after is not None and int(retry_after) >= 1
+        # Per-tenant attribution landed server-side.
+        stats = stack.model.batch_stats()
+        reg = stats["tenants"]["registry"]["tenants"]
+        assert reg["noisy"]["shed_quota"] == len(quota_sheds)
+        for tenant in ("compliant-0", "compliant-1", "compliant-2"):
+            assert reg.get(tenant, {}).get("shed_quota", 0) == 0
+    finally:
+        stack.stop()
+
+
+# -- per-tenant SLOs + dashboard ---------------------------------------------
+
+
+def test_default_slos_grow_per_tenant_deadline():
+    from kubeflow_tpu.obs.slo import default_slos
+
+    slos = default_slos(tenants=("alpha", "beta"))
+    by_name = {s.name: s for s in slos}
+    assert "tenant-alpha-deadline" in by_name
+    slo = by_name["tenant-beta-deadline"]
+    assert slo.label_filter == {"tenant": "beta"}
+    assert "kft_tenant_shed_total" in slo.bad_metrics
+    assert slo.total_metrics == ("kft_tenant_requests_total",)
+
+
+def test_dashboard_tenant_rows_and_endpoint_degrade():
+    from kubeflow_tpu.dashboard.server import (
+        make_app,
+        tenant_rows_from_store,
+    )
+    from kubeflow_tpu.obs.collector import TimeSeriesStore
+
+    store = TimeSeriesStore()
+    now = 1000.0
+    for ts in (now - 60, now):
+        offset = ts - (now - 60)
+        store.ingest("kft_tenant_requests_total", {"tenant": "a"},
+                     100 + offset * 2, ts, "counter")
+        store.ingest("kft_tenant_shed_total",
+                     {"tenant": "a", "reason": "quota"},
+                     5 + offset, ts, "counter")
+    rows = tenant_rows_from_store(store, now=now)
+    assert rows and rows[0]["tenant"] == "a"
+    assert rows[0]["requests_per_s"] == pytest.approx(2.0, rel=0.01)
+    assert rows[0]["quota_shed_per_s"] == pytest.approx(1.0, rel=0.01)
+    # Malformed store degrades to [] (never raises).
+    class _Broken:
+        def rate(self, *a, **k):
+            raise RuntimeError("boom")
+    assert tenant_rows_from_store(_Broken()) == []
+
+    # No collector → 404 with the wiring hint, not a 500.
+    class TenantsEndpoint(tornado.testing.AsyncHTTPTestCase):
+        def get_app(self):
+            return make_app(api=object())
+
+        def runTest(self):
+            resp = self.fetch("/tpujobs/api/tenants")
+            assert resp.code == 404
+            body = json.loads(resp.body)
+            assert not body["available"]
+            assert "collector" in body["error"]
+
+    case = TenantsEndpoint()
+    case.setUp()
+    try:
+        case.runTest()
+    finally:
+        case.tearDown()
