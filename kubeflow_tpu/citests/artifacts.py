@@ -100,6 +100,29 @@ def collect_obs() -> list:
         path.write_text(json.dumps(state, indent=1, sort_keys=True,
                                    default=str))
         copied.append(path)
+        # Assembled traces + attribution reports (ISSUE 15): the
+        # waterfall trail next to the junit XML — a failed latency
+        # assertion ships the evidence of WHERE the time went.
+        span_store = getattr(collector, "span_store", None)
+        if span_store is None or not span_store.trace_count():
+            continue
+        from kubeflow_tpu.obs import trace as obs_trace
+
+        traces = {}
+        for row in span_store.trace_ids(limit=32):
+            spans = span_store.trace(row["trace_id"])
+            traces[row["trace_id"]] = {
+                "request_id": row["request_id"],
+                "attribution": obs_trace.attribution(spans),
+                "waterfall": obs_trace.waterfall_lines(
+                    obs_trace.assemble(spans)),
+                "spans": spans,
+            }
+        path = out / f"collector_traces_{i}.json"
+        path.write_text(json.dumps(
+            {"store": span_store.state(), "traces": traces},
+            indent=1, sort_keys=True, default=str))
+        copied.append(path)
     logger.info("observability trail: %d file(s) under %s",
                 len(copied), out)
     return copied

@@ -59,6 +59,15 @@ and DELETE jobs, not just list them. This is its TPUJob equivalent:
                                          status, burn rates, firing
                                          alerts, exemplar → /tracez
                                          links
+  GET    /tpujobs/api/trace             assembled-trace index (the
+                                         ids the collector's
+                                         SpanStore holds)
+  GET    /tpujobs/api/trace/<trace_id>  one request's fleet-wide
+                                         spans + latency attribution
+  GET    /tpujobs/ui/waterfall          HTML Waterfall page
+                                         (?trace_id=): per-trace span
+                                         tree + queue/prefill/decode/
+                                         relay/gap attribution bar
   GET    /healthz
 
 against either a real apiserver (kubectl shim) or the in-repo fake
@@ -673,6 +682,59 @@ class TenantsHandler(BaseHandler):
         self.write_json({"available": True, "tenants": rows})
 
 
+class TraceIndexHandler(BaseHandler):
+    """Assembled-trace index (ISSUE 15): the trace ids the in-process
+    collector's SpanStore holds, newest first. 404 with the wiring
+    hint when the dashboard runs no collector — same contract as
+    /tpujobs/api/tenants."""
+
+    def _span_store(self):
+        collector = self.application.settings.get("collector")
+        return getattr(collector, "span_store", None)
+
+    async def get(self):
+        store = self._span_store()
+        if store is None:
+            return self.write_json(
+                {"available": False,
+                 "error": "no in-process span collection (start the "
+                          "dashboard with --collect_endpoints/"
+                          "--collect_static; spans are scraped from "
+                          "each target's /tracez)"}, 404)
+        self.write_json({"available": True,
+                         "traces": store.trace_ids(),
+                         "store": store.state()})
+
+
+class TraceDetailHandler(TraceIndexHandler):
+    """One assembled trace: spans + tree + attribution report — the
+    JSON the Waterfall page and ``kft-trace`` render."""
+
+    async def get(self, trace_id: str):
+        from kubeflow_tpu.obs import trace as obs_trace
+
+        store = self._span_store()
+        if store is None:
+            return self.write_json(
+                {"available": False,
+                 "error": "no in-process span collection"}, 404)
+        spans = store.trace(trace_id)
+        if not spans:
+            return self.write_json(
+                {"available": False,
+                 "error": f"no spans for trace {trace_id!r} (evicted, "
+                          f"not yet scraped, or never traced)"}, 404)
+        assembled = await tornado.ioloop.IOLoop.current() \
+            .run_in_executor(None, obs_trace.assemble, spans)
+        self.write_json({
+            "available": True,
+            "trace_id": trace_id,
+            "spans": spans,
+            "attribution": obs_trace.attribution(spans),
+            "waterfall": obs_trace.waterfall_lines(assembled),
+        })
+
+
 class SloHandler(BaseHandler):
     """Fleet telemetry JSON: collector targets, SLO burn rates, alert
     states and the transition history (docs/observability.md "Fleet
@@ -1098,14 +1160,18 @@ bucket — docs/tenancy.md). JSON:
 <h2>Exemplars</h2>
 <table>
 <tr><th>Histogram</th><th>le</th><th>Instance</th><th>Value</th>
-<th>Trace</th></tr>
+<th>Trace</th><th>Waterfall</th></tr>
 {exemplar_rows}
 </table>
 <p>Exemplar workflow: a latency bucket grew &rarr; its exemplar
 carries the trace id of one request that landed there &rarr;
-<code>/tracez?trace_id=&lt;id&gt;</code> on the instance returns the
-retained (tail-sampled) spans. JSON:
-<a href="/tpujobs/api/slo">/tpujobs/api/slo</a></p>
+<code>/tracez?trace_id=&lt;id&gt;</code> on the instance returns that
+process's retained (tail-sampled) spans, and the
+<a href="/tpujobs/ui/waterfall">Waterfall</a> page shows the
+FLEET-assembled tree + latency attribution (queue / prefill / decode
+/ relay / gap). JSON:
+<a href="/tpujobs/api/slo">/tpujobs/api/slo</a> &middot;
+<a href="/tpujobs/api/trace">/tpujobs/api/trace</a></p>
 </body></html>
 """
 
@@ -1187,6 +1253,7 @@ def _health_page_html(payload: Dict[str, Any]) -> str:
         tracez = (f"http://{instance}/tracez?trace_id={trace_id}"
                   if instance else f"/tracez?trace_id={trace_id}")
         metric = str(e.get("metric", "")).replace("_bucket", "")
+        waterfall = f"/tpujobs/ui/waterfall?trace_id={trace_id}"
         exemplar_rows.append(
             "<tr>"
             f"<td>{html.escape(metric)}</td>"
@@ -1194,7 +1261,9 @@ def _health_page_html(payload: Dict[str, Any]) -> str:
             f"<td><code>{html.escape(instance)}</code></td>"
             f"<td>{float(e.get('value', 0)):.4f}</td>"
             f"<td><a href=\"{html.escape(tracez)}\"><code>"
-            f"{html.escape(trace_id[:16])}</code></a></td></tr>")
+            f"{html.escape(trace_id[:16])}</code></a></td>"
+            f"<td><a href=\"{html.escape(waterfall)}\">waterfall"
+            f"</a></td></tr>")
     tenant_rows = []
     for row in payload.get("tenants", ()):
         tenant_rows.append(
@@ -1218,7 +1287,192 @@ def _health_page_html(payload: Dict[str, Any]) -> str:
         tenant_rows="\n".join(tenant_rows)
         or "<tr><td colspan=6>no tenant traffic observed</td></tr>",
         exemplar_rows="\n".join(exemplar_rows)
-        or "<tr><td colspan=5>none yet</td></tr>")
+        or "<tr><td colspan=6>none yet</td></tr>")
+
+
+_WATERFALL_PAGE = """<!doctype html>
+<html><head><title>Waterfall {trace_id}</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem; }}
+ table {{ border-collapse: collapse; min-width: 56rem;
+          margin-bottom: 1.5rem; }}
+ th, td {{ text-align: left; padding: .3rem .7rem;
+          border-bottom: 1px solid #d0d7de; }}
+ th {{ background: #f6f8fa; }}
+ .bar {{ height: .8rem; display: inline-block; }}
+ .attr {{ display: flex; height: 1.4rem; min-width: 48rem;
+          border: 1px solid #d0d7de; }}
+ .attr div {{ overflow: hidden; font-size: .7rem; color: #fff;
+          padding-left: .2rem; white-space: nowrap; }}
+</style></head>
+<body>
+<p><a href="/tpujobs/ui/health">&larr; fleet health</a></p>
+<h1>Waterfall <code>{trace_id}</code></h1>
+<h2>Latency attribution</h2>
+<div class="attr">{attr_bar}</div>
+<p>{attr_line}</p>
+<h2>Spans ({span_count})</h2>
+<table>
+<tr><th>Span</th><th>Leg</th><th>Instance</th><th>Detail</th>
+<th>Duration</th><th></th></tr>
+{span_rows}
+</table>
+<p>Durations are per-process wall time; cross-process nesting comes
+from the span parent links (docs/observability.md, "Distributed
+tracing &amp; latency attribution"). JSON:
+<a href="{api}">{api}</a> &middot; CLI:
+<code>kft-trace {trace_id}</code></p>
+</body></html>
+"""
+
+_ATTR_COLORS = {"queue_ms": "#9a6700", "prefill_ms": "#0969da",
+                "decode_ms": "#1a7f37", "relay_ms": "#8250df",
+                "gap_ms": "#57606a"}
+
+_SPAN_BAR_COLORS = {"router": "#8250df", "serving": "#0969da",
+                    "engine": "#1a7f37"}
+
+_WATERFALL_INDEX = """<!doctype html>
+<html><head><title>Waterfalls</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem; }}
+ table {{ border-collapse: collapse; min-width: 40rem; }}
+ th, td {{ text-align: left; padding: .3rem .7rem;
+          border-bottom: 1px solid #d0d7de; }}
+ th {{ background: #f6f8fa; }}
+</style></head>
+<body>
+<p><a href="/tpujobs/ui/health">&larr; fleet health</a></p>
+<h1>Assembled traces</h1>
+<table>
+<tr><th>Trace</th><th>Request id</th><th>Spans</th></tr>
+{rows}
+</table>
+<p>{store_line}</p>
+</body></html>
+"""
+
+
+def _waterfall_html(trace_id: str, spans, assembled,
+                    report) -> str:
+    """Render one assembled trace: attribution bar + indented span
+    tree with duration bars (width ∝ share of the e2e wall)."""
+    total = max(report["total_ms"], 1e-9)
+    attr_parts = []
+    for key, ms in report["buckets"].items():
+        width = max(0.0, min(100.0, ms / total * 100.0))
+        if width <= 0.0:
+            continue
+        attr_parts.append(
+            f"<div style=\"width:{width:.1f}%;background:"
+            f"{_ATTR_COLORS.get(key, '#57606a')}\" title=\""
+            f"{html.escape(key)}: {ms:.2f} ms\">"
+            f"{html.escape(key.removesuffix('_ms'))}</div>")
+    attr_line = (f"e2e {report['total_ms']:.2f} ms — coverage "
+                 f"{report['coverage'] * 100:.1f}%" + "".join(
+                     f" &middot; {html.escape(k.removesuffix('_ms'))} "
+                     f"{ms:.2f} ms"
+                     for k, ms in report["buckets"].items()))
+    if report.get("missing"):
+        attr_line += (" &middot; missing: "
+                      + html.escape(", ".join(report["missing"])))
+    rows = []
+
+    def walk(node, depth):
+        span = node["span"]
+        args = span.get("args") or {}
+        dur_ms = float(span.get("dur", 0.0)) / 1e3
+        width = max(0.4, min(100.0, dur_ms / total * 100.0))
+        color = _SPAN_BAR_COLORS.get(span.get("cat", ""), "#57606a")
+        detail = " ".join(
+            f"{k}={args[k]}"
+            for k in ("model", "tenant", "outcome", "slot", "reason",
+                      "tokens", "program", "shapes", "rows")
+            if k in args)
+        indent = "&nbsp;" * (depth * 4)
+        rows.append(
+            "<tr>"
+            f"<td>{indent}<code>{html.escape(str(span.get('name', '?')))}"
+            f"</code></td>"
+            f"<td>{html.escape(str(args.get('leg', '')))}</td>"
+            f"<td><code>{html.escape(str(args.get('instance', '')))}"
+            f"</code></td>"
+            f"<td>{html.escape(detail)}</td>"
+            f"<td>{dur_ms:.2f} ms</td>"
+            f"<td><span class=\"bar\" style=\"width:{width:.1f}%;"
+            f"background:{color}\"></span></td>"
+            "</tr>")
+        for child in node["children"]:
+            walk(child, depth + 1)
+
+    for root in assembled["roots"]:
+        walk(root, 0)
+    return _WATERFALL_PAGE.format(
+        trace_id=html.escape(trace_id),
+        attr_bar="".join(attr_parts) or "<div>no data</div>",
+        attr_line=attr_line,
+        span_count=len(spans),
+        span_rows="\n".join(rows)
+        or "<tr><td colspan=6>no spans</td></tr>",
+        api=html.escape(f"/tpujobs/api/trace/{trace_id}"))
+
+
+class WaterfallUIHandler(BaseHandler):
+    """HTML Waterfall page (ISSUE 15): one request's assembled
+    fleet-wide trace as an indented span tree plus the latency
+    attribution bar; without ?trace_id=, an index of the traces the
+    collector holds. Linked from the Fleet health exemplar table —
+    the histogram-bucket → exemplar → waterfall workflow."""
+
+    async def get(self):
+        from kubeflow_tpu.obs import trace as obs_trace
+
+        collector = self.application.settings.get("collector")
+        store = getattr(collector, "span_store", None)
+        self.set_header("Content-Type", "text/html; charset=utf-8")
+        if store is None:
+            return self.finish(
+                "<p>No in-process span collection (start the "
+                "dashboard with <code>--collect_endpoints</code>/"
+                "<code>--collect_static</code>).</p>")
+        trace_id = self.get_query_argument("trace_id", "")
+        if not trace_id:
+            rows = "\n".join(
+                "<tr>"
+                f"<td><a href=\"/tpujobs/ui/waterfall?trace_id="
+                f"{html.escape(t['trace_id'])}\"><code>"
+                f"{html.escape(t['trace_id'][:24])}</code></a></td>"
+                f"<td><code>{html.escape(t['request_id'])}</code></td>"
+                f"<td>{int(t['spans'])}</td></tr>"
+                for t in store.trace_ids())
+            state = store.state()
+            return self.finish(_WATERFALL_INDEX.format(
+                rows=rows or "<tr><td colspan=3>none yet</td></tr>",
+                store_line=f"{state['traces']} trace(s), "
+                           f"{state['spans']} span(s) held "
+                           f"(caps {state['max_traces']} × "
+                           f"{state['max_spans_per_trace']}; "
+                           f"{state['dropped_spans']} dropped)."))
+        spans = store.trace(trace_id)
+        if not spans:
+            self.set_status(404)
+            return self.finish(
+                f"<p>No spans for trace "
+                f"<code>{html.escape(trace_id)}</code> (evicted, not "
+                f"yet scraped, or never traced).</p>")
+        loop = tornado.ioloop.IOLoop.current()
+        assembled = await loop.run_in_executor(
+            None, obs_trace.assemble, spans)
+        try:
+            body = _waterfall_html(trace_id, spans, assembled,
+                                   obs_trace.attribution(spans))
+        except Exception:  # noqa: BLE001 — render is best-effort
+            logger.warning("waterfall render failed", exc_info=True)
+            body = (f"<p>Waterfall render failed. JSON: <a href="
+                    f"\"/tpujobs/api/trace/{html.escape(trace_id)}\">"
+                    f"/tpujobs/api/trace/{html.escape(trace_id)}</a>"
+                    f"</p>")
+        self.finish(body)
 
 
 class FleetHealthUIHandler(BaseHandler):
@@ -1354,8 +1608,11 @@ def make_app(api, trace_root: str = DEFAULT_TRACE_ROOT,
         (r"/tpujobs/api/fleet", FleetHandler),
         (r"/tpujobs/api/tenants", TenantsHandler),
         (r"/tpujobs/api/slo", SloHandler),
+        (r"/tpujobs/api/trace", TraceIndexHandler),
+        (r"/tpujobs/api/trace/([^/]+)", TraceDetailHandler),
         (r"/tpujobs/ui/?", UIHandler),
         (r"/tpujobs/ui/health", FleetHealthUIHandler),
+        (r"/tpujobs/ui/waterfall", WaterfallUIHandler),
         (r"/tpujobs/ui/job/([^/]+)/([^/]+)", UIJobDetailHandler),
         (r"/tpujobs/ui/create", UICreateHandler),
         (r"/", tornado.web.RedirectHandler, {"url": "/tpujobs/ui/"}),
@@ -1370,6 +1627,7 @@ def _build_telemetry(args, api):
         return None, None
     from kubeflow_tpu.obs.collector import (
         Collector,
+        SpanStore,
         parse_static_targets,
     )
     from kubeflow_tpu.obs.slo import AlertManager, default_slos
@@ -1380,8 +1638,12 @@ def _build_telemetry(args, api):
 
         source = FileEndpointSource(args.collect_endpoints)
     static = parse_static_targets(args.collect_static or "")
+    # The dashboard-resident collector always assembles traces too
+    # (SpanStore is bounded; the Waterfall page reads it) — every
+    # cycle scrapes each target's /tracez next to its /metrics.
     collector = Collector(source=source, static_targets=static,
-                          interval_s=args.collect_interval)
+                          interval_s=args.collect_interval,
+                          span_store=SpanStore())
     alerts = AlertManager(collector.store, default_slos(),
                           api=api, namespace=args.namespace)
     collector.on_cycle.append(alerts.evaluate)

@@ -72,7 +72,7 @@ from kubeflow_tpu.inference.generate import (
     prompt_bucket,
 )
 from kubeflow_tpu.obs import metrics as obs_metrics
-from kubeflow_tpu.obs.tracing import TRACER
+from kubeflow_tpu.obs.tracing import TRACER, span_args
 from kubeflow_tpu.serving import tenancy
 from kubeflow_tpu.serving.overload import (
     DeadlineExceededError,
@@ -532,6 +532,16 @@ class DecodeEngine:
         # TTFT/pacing estimators feed the submit-side admission gate.
         self._prefill_est = LatencyEstimator(prior_s=0.05)
         self._token_est = LatencyEstimator(prior_s=0.01)
+        # Compile-event tracking (ISSUE 15): the first dispatch of a
+        # distinct (program, static-shape) key IS the jit trace +
+        # compile — later hits are cached. Recording that first call
+        # as an engine_compile span makes a recompile storm (bucket
+        # churn, slice-length churn) visible in the timeline instead
+        # of inferred from a throughput dip. Mutated from the engine
+        # thread AND run_prefill request threads — a lost check-then-
+        # add race records one duplicate span, never corrupts.
+        self._compile_seen: set = set()
+        self._slices = 0
         # The jitted slice closes over model + sampling config; one
         # compile per distinct slice length (K_eff shrinks near a
         # request's budget end — a handful of variants, cached).
@@ -616,7 +626,8 @@ class DecodeEngine:
 
     def run_prefill(self, prompt: np.ndarray, *,
                     rng: Optional[np.ndarray] = None,
-                    max_new_tokens: Optional[int] = None
+                    max_new_tokens: Optional[int] = None,
+                    obs_ctx: Any = None
                     ) -> PrefillHandoff:
         """Run the B=1 prefill WITHOUT binding a slot: the prefill-
         role half of KV handoff. Purely functional over engine state
@@ -647,6 +658,23 @@ class DecodeEngine:
             jnp.asarray(key, jnp.uint32), budget))
         length = int(prompt.shape[0])
         width = self._bucket(length)
+        t0 = time.monotonic()
+
+        def note_spans(program: str, block_width: int) -> None:
+            # The prefill-role hop's engine work must join the
+            # request's trace (ISSUE 15 satellite: before this, the
+            # split path's first hop was span-less and its prefill
+            # cost could only be inferred from the hop wall time).
+            dur = time.monotonic() - t0
+            self._note_compile(program, f"tokens[1,{block_width}]",
+                               t0, dur,
+                               link=span_args(obs_ctx))
+            if TRACER.enabled and obs_ctx is not None:
+                TRACER.record(
+                    "engine_prefill", "engine", t0, dur,
+                    span_args(obs_ctx, model=self.name,
+                              prompt_len=length, handoff=True))
+
         if self.prefix is not None:
             # Prefix-cache engines prefill in the pad-0 layout (prompt
             # at [0, L), garbage right-pad masked by causality) so the
@@ -664,13 +692,15 @@ class DecodeEngine:
                 temperature=self.config.temperature,
                 eos_id=self.config.eos_id, top_k=self.config.top_k,
                 top_p=self.config.top_p)
-            return PrefillHandoff(
+            handoff = PrefillHandoff(
                 cache=jax.tree.map(np.asarray, cache),
                 first_token=int(np.asarray(first)[0]),
                 done=bool(np.asarray(done)[0]),
                 prompt_len=length, prompt_width=length,
                 max_new_tokens=budget, step_keys=step_keys,
                 layout="right", prompt_tokens=prompt.copy())
+            note_spans("prefill_ctx", width)
+            return handoff
         pad = width - length
         padded = np.zeros((1, width), np.int32)
         padded[0, pad:] = prompt
@@ -682,12 +712,14 @@ class DecodeEngine:
             eos_id=self.config.eos_id, top_k=self.config.top_k,
             top_p=self.config.top_p)
         prefill_cache, first, _, done = carry
-        return PrefillHandoff(
+        handoff = PrefillHandoff(
             cache=jax.tree.map(np.asarray, prefill_cache),
             first_token=int(np.asarray(first)[0]),
             done=bool(np.asarray(done)[0]),
             prompt_len=length, prompt_width=width,
             max_new_tokens=budget, step_keys=step_keys)
+        note_spans("prefill", width)
+        return handoff
 
     def submit(self, prompt: Optional[np.ndarray] = None, *,
                rng: Optional[np.ndarray] = None,
@@ -971,6 +1003,12 @@ class DecodeEngine:
             "page_size": self.kv.page_size,
             "page_occupancy": round(self.page_occupancy(), 4),
             "est_ttft_ms": round(self.estimated_ttft_s() * 1e3, 3),
+            # Profiling hooks (ISSUE 15): decode slices run and
+            # distinct jit programs traced (white-box for the
+            # compile-event spans; a growing count at steady state IS
+            # a recompile storm).
+            "slices": self._slices,
+            "compiled_programs": len(self._compile_seen),
             # Per-tenant queue depths (ISSUE 14): the attribution for
             # queue-full sheds, rides healthz → dashboard/autoscaler
             # (capped: top-K + 'other', like every reporting surface).
@@ -1010,6 +1048,29 @@ class DecodeEngine:
                 logger.exception("engine slice failed")
                 for slot in self.scheduler.active_slots():
                     self._retire(slot, "error", error=e)
+
+    def _note_compile(self, program: str, shapes: str,
+                      start_s: float, dur_s: float,
+                      link: Optional[dict] = None) -> None:
+        """Record the engine_compile span for a first-seen program/
+        shape key. ``shapes`` doubles as the cache key's shape half —
+        it names the abstract shapes the trace specialized on.
+        ``link`` (a span_args dict) attributes a request-triggered
+        compile to THAT request's trace, so a cold-start waterfall
+        literally contains its compile events; slice compiles (no
+        single owner) stay documented roots."""
+        key = (program, shapes)
+        if key in self._compile_seen:
+            return
+        self._compile_seen.add(key)
+        if TRACER.enabled:
+            args = {"model": self.name, "program": program,
+                    "shapes": shapes}
+            for k in ("request_id", "trace_id", "parent_id", "leg"):
+                if link and k in link:
+                    args[k] = link[k]
+            TRACER.record("engine_compile", "engine", start_s, dur_s,
+                          args)
 
     def _bucket(self, n: int) -> int:
         return prompt_bucket(n, self.config.max_prompt_len,
@@ -1192,7 +1253,12 @@ class DecodeEngine:
         slot.allocated_pages = self.kv.adopt(
             slot.index, prefill_cache, width, budget_pages)
         t1 = time.monotonic()
+        slot.queue_s = max(0.0, t0 - req.submitted_at)
+        slot.prefill_s = t1 - t0
         if req.handoff is None:
+            self._note_compile("prefill", f"tokens[1,{width}]",
+                               t0, t1 - t0,
+                               link=self._span_args(req))
             # Only REAL prefills feed the estimator: adopt times are
             # sub-millisecond, and letting them in would collapse the
             # TTFT estimate on decode-role replicas — admission would
@@ -1296,7 +1362,12 @@ class DecodeEngine:
                 self.kv.tables[slot.index,
                                :slot.allocated_pages].tolist())
         t1 = time.monotonic()
+        slot.queue_s = max(0.0, t0 - req.submitted_at)
+        slot.prefill_s = t1 - t0
         if req.handoff is None:
+            self._note_compile("prefill_ctx", f"tokens[1,{width}]",
+                               t0, t1 - t0,
+                               link=self._span_args(req))
             if m > 0:
                 self.prefix.hits += 1
                 self.prefix.saved_tokens_total += m
@@ -1373,6 +1444,41 @@ class DecodeEngine:
         t_slice = time.monotonic() - t0
         self._token_est.observe(t_slice / num_steps)
         per_token = t_slice / num_steps
+        self._slices += 1
+        # First dispatch of a new slice length is its jit trace +
+        # compile (K_eff shrinks near budget ends — each variant is
+        # one program).
+        self._note_compile("decode_slice",
+                           f"steps={num_steps} slots={n}", t0, t_slice)
+        if TRACER.enabled:
+            # Per-slice structured profile record (ISSUE 15): the
+            # timeline's view of engine health — occupancy collapses
+            # and page pressure show up HERE, not as a throughput-dip
+            # inference. Documented root span (no single request owns
+            # a slice; requests join it via their own decode_ms).
+            alloc = self.kv.allocator
+            TRACER.record(
+                "engine_slice", "engine", t0, t_slice, {
+                    "model": self.name,
+                    "slice": self._slices,
+                    "slots": len(active),
+                    "steps": num_steps,
+                    "tokens": sum(min(num_steps, s.remaining)
+                                  for s in active),
+                    "free_pages": alloc.available(),
+                    "retained_pages": alloc.retained_pages,
+                    "occupancy": round(self.page_occupancy(), 4),
+                    "admitted": self.scheduler.admitted,
+                    "retired": self.scheduler.retired,
+                    "queue_depth": self.scheduler.queue_depth(),
+                    "prefix_hits": (self.prefix.hits
+                                    if self.prefix is not None
+                                    else 0),
+                })
+        for s in active:
+            # Every live slot waited this whole slice — that IS its
+            # decode share (per-request attribution, engine_request).
+            s.decode_s += t_slice
         for s in active:
             take = min(num_steps, s.remaining)
             for k in range(take):
@@ -1400,8 +1506,15 @@ class DecodeEngine:
             TRACER.record(
                 "engine_request", "engine", req.submitted_at,
                 time.monotonic() - req.submitted_at,
-                self._span_args(req, slot=slot.index, reason=reason,
-                                tokens=slot.emitted))
+                self._span_args(
+                    req, slot=slot.index, reason=reason,
+                    tokens=slot.emitted,
+                    # The per-request attribution triple the report
+                    # generator buckets e2e latency by (queue wait →
+                    # a slot, prefill, decode-slice share).
+                    queue_ms=round(slot.queue_s * 1e3, 3),
+                    prefill_ms=round(slot.prefill_s * 1e3, 3),
+                    decode_ms=round(slot.decode_s * 1e3, 3)))
         if error is not None:
             req.stream._fail(error)
             return
@@ -1415,11 +1528,13 @@ class DecodeEngine:
         req.stream._finish(np.asarray(tokens, np.int32))
 
     def _span_args(self, req: _Request, **extra) -> dict:
-        args = {"model": self.name, **extra}
+        # span_args adds trace linkage (trace id + parent_id = the
+        # transport hop's span id + leg) so engine spans hang under
+        # the right hop of the assembled fleet waterfall; the capped
+        # tenant label lets waterfalls filter by tenant.
+        args = span_args(req.stream.obs_ctx, model=self.name, **extra)
         if req.request_id:
             args["request_id"] = req.request_id
-        ctx = req.stream.obs_ctx
-        if ctx is not None:
-            args.setdefault("request_id", ctx.request_id)
-            args["trace_id"] = ctx.trace_id
+        if req.tenant and req.tenant != tenancy.DEFAULT_TENANT:
+            args.setdefault("tenant", tenancy.tenant_label(req.tenant))
         return args

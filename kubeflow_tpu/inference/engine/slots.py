@@ -61,6 +61,14 @@ class Slot:
     allocated_pages: int = 0
     budget_pages: int = 0  # reservation ceiling (pages)
     deadline: Optional[float] = None
+    # Latency attribution (ISSUE 15): where this request's wall time
+    # went — queue wait before the slot, its prefill, and its share
+    # of decode-slice wall (a slot waits the FULL slice whatever its
+    # neighbors do). The engine_request span reports them as
+    # queue_ms / prefill_ms / decode_ms.
+    queue_s: float = 0.0
+    prefill_s: float = 0.0
+    decode_s: float = 0.0
 
     @property
     def max_new_tokens(self) -> int:
@@ -205,6 +213,9 @@ class SlotScheduler:
         slot.allocated_pages = 0
         slot.budget_pages = budget_pages
         slot.deadline = deadline
+        slot.queue_s = 0.0  # slots are reused: attribution resets
+        slot.prefill_s = 0.0
+        slot.decode_s = 0.0
         self.admitted += 1
         return slot
 

@@ -180,6 +180,18 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
         "serving-tenancy": [
             py, f"{src}/bench.py", "--tenants",
         ],
+        # Trace-assembly gate (ISSUE 15): the distributed-tracing
+        # sweep — a real proxy + two role-split servers + a span-
+        # scraping collector; unary, SSE, role-split and hedged
+        # requests must each assemble into ONE trace whose
+        # queue/prefill/decode/relay/gap attribution covers >= 95% of
+        # the client-measured wall, and the SpanStore caps must hold
+        # under fuzz. Hermetic — in-process fleet, no cluster.
+        "trace-assembly": [
+            py, "-m", "pytest", f"{src}/tests/test_trace_assembly.py",
+            "-q", "--junitxml",
+            f"{params['artifacts_dir']}/junit_trace_assembly.xml",
+        ],
         "deploy-test": [
             py, "-m", "kubeflow_tpu.citests.deploy", "setup",
             "--namespace", params["test_namespace"],
@@ -236,6 +248,7 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             _dag_task("serving-mesh-dryrun", ["checkout"]),
             _dag_task("serving-chaos", ["checkout"]),
             _dag_task("serving-tenancy", ["checkout"]),
+            _dag_task("trace-assembly", ["checkout"]),
             _dag_task("deploy-test", ["checkout"]),
             _dag_task("deploy-serving", ["deploy-test"]),
             _dag_task("tpujob-test", ["deploy-test"]),

@@ -49,12 +49,13 @@ from __future__ import annotations
 
 import argparse
 import concurrent.futures
+import json
 import logging
 import threading
 import time
 import urllib.request
 import weakref
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import (
     Any,
@@ -73,12 +74,15 @@ logger = logging.getLogger(__name__)
 __all__ = [
     "Collector",
     "ScrapeTarget",
+    "SpanShipper",
+    "SpanStore",
     "TimeSeriesStore",
     "fleet_replica_rows",
     "live_collectors",
     "parse_static_targets",
     "quantile_from_buckets",
     "scrape_metrics",
+    "scrape_spans",
 ]
 
 _C_SCRAPES = obs_metrics.Counter(
@@ -94,6 +98,13 @@ _G_SERIES = obs_metrics.Gauge(
 _C_DROPPED = obs_metrics.Counter(
     "kft_collector_dropped_series_total",
     "New series rejected by the cardinality cap")
+_C_SPANS = obs_metrics.Counter(
+    "kft_collector_spans_total",
+    "Spans accepted into the trace store, by ingest path "
+    "(scrape | push)", ("path",))
+_C_SPANS_DROPPED = obs_metrics.Counter(
+    "kft_collector_dropped_spans_total",
+    "Spans rejected by the trace store's caps")
 
 #: Every live Collector in this process (weak — a stopped/forgotten
 #: collector leaves no trace). citests/artifacts.py collect-obs dumps
@@ -401,6 +412,138 @@ class TimeSeriesStore:
                         per_name.items(), key=lambda kv: -kv[1])[:20])}
 
 
+class SpanStore:
+    """Bounded fleet span store indexed by trace id (ISSUE 15).
+
+    The trace-assembly half of the collector: spans arrive from the
+    per-cycle ``/tracez`` scrape of every target AND from processes
+    pushing on span-buffer pressure (``POST /spans`` on the collector
+    exposition surface); both paths land here. Caps mirror the metric
+    store's cardinality discipline — ``max_traces`` LRU-evicts whole
+    traces (newest-touched survive), ``max_spans_per_trace`` bounds
+    one hot request, and everything past a cap is COUNTED and
+    dropped, never stored. Scrape overlap (the same ring dumped twice)
+    dedupes on the ``(pid, tid, ts, name)`` identity a span keeps for
+    its lifetime."""
+
+    def __init__(self, *, max_traces: int = 256,
+                 max_spans_per_trace: int = 512):
+        self.max_traces = int(max_traces)
+        self.max_spans_per_trace = int(max_spans_per_trace)
+        self._lock = threading.Lock()
+        # trace_id → {"spans": [event...], "keys": {identity...},
+        #             "request_id": str}
+        self._traces: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self.dropped_spans = 0
+        self.evicted_traces = 0
+        self.ingested = 0
+
+    @staticmethod
+    def _identity(span: Dict[str, Any]) -> Tuple:
+        return (span.get("pid"), span.get("tid"), span.get("ts"),
+                span.get("name"))
+
+    def ingest(self, spans: Sequence[Dict[str, Any]],
+               instance: Optional[str] = None,
+               path: str = "scrape") -> Tuple[int, int]:
+        """Ingest one batch of Chrome trace events; spans without a
+        ``args.trace_id`` (process metadata, unlinked internals) and
+        non-dict items (a malformed push batch) are skipped silently
+        — they can never join a waterfall. Returns (ingested,
+        dropped); both land in the ``kft_collector_spans_total``/
+        ``kft_collector_dropped_spans_total`` families, labeled by
+        ingest ``path`` (scrape | push). ``instance`` stamps where
+        the span came from (the waterfall's per-process column)."""
+        ingested = dropped = 0
+        with self._lock:
+            for span in spans:
+                if not isinstance(span, dict):
+                    continue
+                args = span.get("args") or {}
+                trace_id = args.get("trace_id")
+                if not trace_id or span.get("ph", "X") != "X":
+                    continue
+                trace_id = str(trace_id)
+                entry = self._traces.get(trace_id)
+                if entry is None:
+                    while len(self._traces) >= self.max_traces:
+                        self._traces.popitem(last=False)
+                        self.evicted_traces += 1
+                    entry = {"spans": [], "keys": set(),
+                             "request_id": args.get("request_id", "")}
+                    self._traces[trace_id] = entry
+                else:
+                    self._traces.move_to_end(trace_id)
+                key = self._identity(span)
+                if key in entry["keys"]:
+                    continue  # re-scraped ring overlap, not a drop
+                if len(entry["spans"]) >= self.max_spans_per_trace:
+                    # Count each over-cap span ONCE: remember its
+                    # identity (bounded at 4× the cap so a hot trace
+                    # can't grow the key set forever; past that
+                    # bound, rescrape overlap may re-count — the
+                    # counter stays an upper bound) — otherwise every
+                    # 5 s rescrape of the same ring would re-count
+                    # the same overflow and inflate the cap-
+                    # discipline signal into noise.
+                    if len(entry["keys"]) \
+                            < 4 * self.max_spans_per_trace:
+                        entry["keys"].add(key)
+                        dropped += 1
+                    continue
+                if instance and "instance" not in args:
+                    span = dict(span)
+                    span["args"] = {**args, "instance": instance}
+                entry["keys"].add(key)
+                entry["spans"].append(span)
+                ingested += 1
+            self.ingested += ingested
+            self.dropped_spans += dropped
+        if ingested:
+            _C_SPANS.labels(path).inc(ingested)
+        if dropped:
+            _C_SPANS_DROPPED.inc(dropped)
+        return ingested, dropped
+
+    def trace(self, trace_id: str) -> List[Dict[str, Any]]:
+        """One trace's spans (also matched by request id — the
+        access-log join key a human actually holds)."""
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            if entry is None:
+                for candidate in reversed(self._traces.values()):
+                    if candidate.get("request_id") == trace_id:
+                        entry = candidate
+                        break
+            if entry is None:
+                return []
+            return list(entry["spans"])
+
+    def trace_ids(self, limit: int = 64) -> List[Dict[str, Any]]:
+        """Newest-touched traces first: id, request id, span count."""
+        with self._lock:
+            rows = [{"trace_id": tid,
+                     "request_id": entry.get("request_id", ""),
+                     "spans": len(entry["spans"])}
+                    for tid, entry in self._traces.items()]
+        rows.reverse()
+        return rows[:max(0, limit)]
+
+    def trace_count(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+    def state(self) -> Dict[str, Any]:
+        with self._lock:
+            spans = sum(len(e["spans"]) for e in self._traces.values())
+            return {"traces": len(self._traces), "spans": spans,
+                    "max_traces": self.max_traces,
+                    "max_spans_per_trace": self.max_spans_per_trace,
+                    "ingested": self.ingested,
+                    "dropped_spans": self.dropped_spans,
+                    "evicted_traces": self.evicted_traces}
+
+
 @dataclass(frozen=True)
 class ScrapeTarget:
     """One /metrics endpoint: ``address`` becomes the ``instance``
@@ -415,6 +558,15 @@ class ScrapeTarget:
         base = (self.address if "://" in self.address
                 else f"http://{self.address}")
         return f"{base}/metrics"
+
+    @property
+    def tracez_url(self) -> str:
+        """The same process's span surface — every scrape plane
+        (server, proxy, dashboard, operator exposition thread) serves
+        /tracez next to /metrics."""
+        base = (self.address if "://" in self.address
+                else f"http://{self.address}")
+        return f"{base}/tracez"
 
 
 def parse_static_targets(spec: str, default_job: str = "static"
@@ -442,6 +594,19 @@ def scrape_metrics(target: ScrapeTarget, timeout_s: float = 2.0) -> str:
     })
     with urllib.request.urlopen(request, timeout=timeout_s) as resp:
         return resp.read().decode("utf-8", "replace")
+
+
+def scrape_spans(target: ScrapeTarget, timeout_s: float = 2.0,
+                 limit: int = 512) -> List[Dict[str, Any]]:
+    """One bounded /tracez fetch: the newest ``limit`` spans as Chrome
+    trace events (the shared ?limit= filter keeps a full 4096-span
+    ring from shipping megabytes per cycle). Same no-unbounded-fetch
+    contract as the metrics scrape."""
+    url = f"{target.tracez_url}?limit={int(limit)}"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        doc = json.loads(resp.read().decode("utf-8", "replace"))
+    return [e for e in doc.get("traceEvents", [])
+            if e.get("ph", "X") == "X"]
 
 
 @dataclass
@@ -475,8 +640,20 @@ class Collector:
                  interval_s: float = 5.0,
                  timeout_s: float = 2.0,
                  fetch: Optional[Callable[[ScrapeTarget], str]] = None,
-                 max_workers: int = 8):
+                 max_workers: int = 8,
+                 span_store: Optional[SpanStore] = None,
+                 span_fetch: Optional[
+                     Callable[[ScrapeTarget], List[Dict[str, Any]]]
+                 ] = None,
+                 span_limit: int = 512):
         self.store = store or TimeSeriesStore()
+        #: Trace-assembly store (ISSUE 15): when set, every cycle also
+        #: scrapes each target's /tracez and ingests the spans — the
+        #: pull half of span shipping (SpanShipper + POST /spans is
+        #: the push half). None keeps the r13 metrics-only collector.
+        self.span_store = span_store
+        self._span_fetch = span_fetch
+        self.span_limit = int(span_limit)
         self.source = source          # specs() → [(address, grpc)]
         self.pool = pool              # EndpointPool → endpoints()
         self.static_targets = [self._coerce_target(t)
@@ -535,6 +712,19 @@ class Collector:
             error = ""
         except Exception as e:  # noqa: BLE001 — unreachable target
             text, error = None, f"{type(e).__name__}: {e}"
+        # The span scrape rides the same fan-out slot (one target, one
+        # worker, one cycle): a dead target already burned its
+        # timeout above, so don't pay a second one.
+        spans: List[Dict[str, Any]] = []
+        if self.span_store is not None and text is not None:
+            span_fetch = self._span_fetch or (
+                lambda t: scrape_spans(t, self.timeout_s,
+                                       self.span_limit))
+            try:
+                spans = span_fetch(target)
+            except Exception:  # noqa: BLE001 — spanless target (old
+                # build, operator without /tracez): metrics still land.
+                spans = []
         done_at = time.monotonic()
         # Per-target completion time rides back with the result: the
         # fan-out's map() drains only when the SLOWEST fetch (a dead
@@ -542,7 +732,7 @@ class Collector:
         # samples must carry the moment ITS scrape finished, not the
         # cycle-drain time — short-window rate denominators feel a
         # 2 s skew.
-        return target, text, error, done_at - t0, done_at
+        return target, text, error, done_at - t0, done_at, spans
 
     def scrape_once(self, now: Optional[float] = None) -> Dict[str, Any]:
         """One full cycle (tests call this directly; run() paces it).
@@ -558,7 +748,7 @@ class Collector:
             results = list(self._executor.map(self._scrape_one,
                                               targets))
         ok = failed = 0
-        for target, text, error, duration_s, done_at in results:
+        for target, text, error, duration_s, done_at, spans in results:
             at = done_at if now is None else now
             status = _TargetStatus(at=at, job=target.job,
                                    duration_ms=duration_s * 1e3)
@@ -574,6 +764,9 @@ class Collector:
                     status.dropped = dropped
                 except ValueError as e:
                     error = f"parse: {e}"
+            if spans and self.span_store is not None:
+                self.span_store.ingest(spans,
+                                       instance=target.address)
             if status.ok:
                 ok += 1
             else:
@@ -613,10 +806,13 @@ class Collector:
     def state(self) -> Dict[str, Any]:
         """Collector + store snapshot (dashboard /tpujobs/api/slo and
         the CI artifact trail)."""
-        return {"cycles": self.cycles,
-                "interval_s": self.interval_s,
-                "targets": self.target_status(),
-                "store": self.store.state()}
+        state = {"cycles": self.cycles,
+                 "interval_s": self.interval_s,
+                 "targets": self.target_status(),
+                 "store": self.store.state()}
+        if self.span_store is not None:
+            state["spans"] = self.span_store.state()
+        return state
 
     def run(self, *, max_cycles: Optional[int] = None) -> None:
         cycles = 0
@@ -647,6 +843,120 @@ class Collector:
         if self._executor is not None:
             self._executor.shutdown(wait=False)
             self._executor = None
+
+
+class SpanShipper:
+    """Push half of span shipping: a paced thread draining one
+    tracer's export queue into a collector's ``POST /spans``.
+
+    The scrape (pull) path covers steady state; this covers the spans
+    a busy ring would evict between scrapes — the tracer's
+    ``on_export_pressure`` hook wakes the shipper early when the
+    export queue crosses half capacity, so buffer pressure ships spans
+    instead of losing them. Wait discipline: Event-paced bounded
+    waits, explicit POST timeout, failures counted and dropped (a
+    dead collector must cost this process one timeout per interval,
+    never memory or a wedge).
+
+    **Bounded by construction** (the collector's own ≤2%-of-a-core
+    discipline, PERF r13/r19): serializing a span costs ~5 µs of CPU,
+    so an UNCAPPED shipper's cost would scale with offered load —
+    ``max_spans_per_s`` rate-caps what ships (newest kept, overflow
+    counted in ``dropped_spans``), pinning the shipping budget to
+    cap × ~5 µs/s of a core whatever the fleet does. The scrape path
+    and tail sampling carry the rest."""
+
+    def __init__(self, tracer: Any, url: str, *,
+                 component: str = "",
+                 interval_s: float = 2.0,
+                 timeout_s: float = 2.0,
+                 max_spans_per_s: float = 500.0,
+                 post: Optional[Callable[[str, bytes], None]] = None):
+        base = url.rstrip("/")
+        if "://" not in base:
+            base = f"http://{base}"
+        self.url = f"{base}/spans"
+        self.tracer = tracer
+        self.component = component
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self.max_spans_per_s = float(max_spans_per_s)
+        self._post = post
+        self.shipped = 0
+        self.dropped_spans = 0
+        self.failed_posts = 0
+        self._last_ship_at: Optional[float] = None
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _default_post(self, url: str, body: bytes) -> None:
+        request = urllib.request.Request(
+            url, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(
+                request, timeout=self.timeout_s) as resp:
+            resp.read()
+
+    def ship_once(self) -> int:
+        """Drain + POST one batch (tests call this directly). The
+        rate cap keeps the NEWEST spans of an over-budget drain —
+        the freshest traces are the ones an exemplar points at."""
+        spans = self.tracer.drain_export()
+        if not spans:
+            return 0
+        now = time.monotonic()
+        elapsed = (self.interval_s if self._last_ship_at is None
+                   else max(0.05, now - self._last_ship_at))
+        self._last_ship_at = now
+        budget = max(1, int(self.max_spans_per_s * elapsed))
+        if len(spans) > budget:
+            self.dropped_spans += len(spans) - budget
+            spans = spans[-budget:]
+        body = json.dumps({"component": self.component,
+                           "spans": spans},
+                          separators=(",", ":")).encode()
+        try:
+            (self._post or self._default_post)(self.url, body)
+        except Exception as e:  # noqa: BLE001 — dead collector: the
+            # batch is dropped (bounded queue already protects memory).
+            self.failed_posts += 1
+            logger.debug("span ship to %s failed: %s", self.url, e)
+            return 0
+        self.shipped += len(spans)
+        return len(spans)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self.interval_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.ship_once()
+            except Exception:  # noqa: BLE001 — keep shipping
+                logger.exception("span shipper cycle failed")
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.tracer.enable_export()
+        self.tracer.on_export_pressure = self._wake.set
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="kft-span-shipper",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self.tracer.on_export_pressure == self._wake.set:
+            self.tracer.on_export_pressure = None
+        self.tracer.disable_export()
 
 
 def fleet_replica_rows(collector: Collector,
@@ -696,6 +1006,10 @@ def fleet_replica_rows(collector: Collector,
             "shed_rate": round(shed_rate, 4),
             "expired_rate": round(expired_rate, 4),
             "resident_models": sorted(m for m in depth_by_model if m),
+            # Span-surface pass-through (ISSUE 15): where this
+            # replica's half of a waterfall lives — the dashboard and
+            # kft-trace link straight here.
+            "tracez": ScrapeTarget(address).tracez_url,
         })
     return rows
 
@@ -715,7 +1029,17 @@ def main(argv=None) -> int:
                         help="series-cardinality cap")
     parser.add_argument("--metrics_port", type=int, default=0,
                         help="expose the collector's OWN /metrics "
-                             "(+ /tracez); 0 disables")
+                             "(+ /tracez, and with --spans the "
+                             "/traces + /trace assembly endpoints "
+                             "and the POST /spans push path); "
+                             "0 disables")
+    parser.add_argument("--spans", action="store_true",
+                        help="collect spans too: scrape each "
+                             "target's /tracez per cycle into the "
+                             "bounded trace store (kft-trace and the "
+                             "dashboard Waterfall page read it)")
+    parser.add_argument("--max_traces", type=int, default=256,
+                        help="trace-store cap (whole traces, LRU)")
     parser.add_argument("--namespace", default="default")
     parser.add_argument("--alerts", action="store_true",
                         help="evaluate the default serving SLOs and "
@@ -734,9 +1058,12 @@ def main(argv=None) -> int:
         source = FileEndpointSource(args.endpoints_file)
     static = parse_static_targets(args.static)
     store = TimeSeriesStore(max_series=args.max_series)
+    span_store = (SpanStore(max_traces=args.max_traces)
+                  if args.spans else None)
     collector = Collector(store, source=source, static_targets=static,
                           interval_s=args.interval,
-                          timeout_s=args.timeout)
+                          timeout_s=args.timeout,
+                          span_store=span_store)
     if args.alerts:
         from kubeflow_tpu.obs.slo import AlertManager, default_slos
         from kubeflow_tpu.operator.http_client import HttpApiClient
@@ -749,8 +1076,10 @@ def main(argv=None) -> int:
     if args.metrics_port:
         from kubeflow_tpu.obs.exposition import start_exposition_server
 
-        start_exposition_server(args.metrics_port)
-        logger.info("collector metrics on :%d", args.metrics_port)
+        start_exposition_server(args.metrics_port,
+                                span_store=span_store)
+        logger.info("collector metrics on :%d%s", args.metrics_port,
+                    " (+ trace assembly)" if span_store else "")
     logger.info("collector: %d static target(s)%s, interval %.1fs",
                 len(static),
                 f" + endpoints file {args.endpoints_file}"

@@ -89,15 +89,29 @@ class TraceContextHandlerMixin:
     def on_finish(self) -> None:
         if self._obs_span and obs_tracing.TRACER.enabled:
             dur = self.request.request_time()
+            ctx = self._obs_ctx
+            # The hop's ROOT span: carries its own span id (children
+            # recorded under this context parent on it via
+            # span_args's parent_id) plus the inbound parent — the
+            # linkage the fleet-wide assembly joins on. Model and
+            # tenant ride request-root spans only (the tenant value
+            # arrives pre-capped via TenantLabelCapper — a
+            # key-sprayer cannot explode span cardinality either).
+            args = obs_tracing.root_span_args(
+                ctx,
+                path=self.request.path,
+                status=self.get_status(),
+                outcome=getattr(self, "_obs_outcome", None)
+                or ("ok" if self.get_status() < 400 else "error"))
+            model = getattr(self, "_obs_model", None)
+            if model:
+                args["model"] = model
+            tenant = getattr(self, "_obs_tenant", None)
+            if tenant:
+                args["tenant"] = tenant
             obs_tracing.TRACER.record(
                 self._obs_span, self._obs_cat,
-                time.monotonic() - dur, dur,
-                {"request_id": self._obs_ctx.request_id,
-                 "trace_id": self._obs_ctx.trace_id,
-                 "path": self.request.path,
-                 "status": self.get_status(),
-                 "outcome": getattr(self, "_obs_outcome", None)
-                 or ("ok" if self.get_status() < 400 else "error")})
+                time.monotonic() - dur, dur, args)
 
 
 def _tracez_filters(get_arg) -> Dict[str, Any]:
@@ -198,12 +212,22 @@ def access_log_function(component: str):
     return log
 
 
+#: Push-body ceiling for POST /spans: a batch bigger than this is a
+#: misbehaving shipper, not traffic — rejected, never buffered.
+MAX_SPAN_PUSH_BYTES = 4 * 1024 * 1024
+
+
 class _ExpositionHandler(BaseHTTPRequestHandler):
-    """stdlib handler: /metrics, /tracez, /healthz. Server attributes
-    carry the registry/tracer (set by start_exposition_server)."""
+    """stdlib handler: /metrics, /tracez, /healthz — plus, when the
+    server carries a ``span_store`` (collector sidecar), the trace
+    assembly surface: GET /traces (ids), GET /trace?trace_id= (spans
+    + attribution, what ``kft-trace`` reads) and POST /spans (the
+    shipper's push path). Server attributes carry the registry/
+    tracer/span_store (set by start_exposition_server)."""
 
     def do_GET(self):  # noqa: N802 — stdlib contract
         path, _, query = self.path.partition("?")
+        span_store = getattr(self.server, "span_store", None)
         if path == "/metrics":
             ctype = obs_metrics.negotiate_content_type(
                 self.headers.get("Accept"))
@@ -225,6 +249,27 @@ class _ExpositionHandler(BaseHTTPRequestHandler):
                 return
             body = _tracez_body(tracer, filters).encode()
             ctype = "application/json"
+        elif path == "/traces" and span_store is not None:
+            body = json.dumps(
+                {"traces": span_store.trace_ids(),
+                 "store": span_store.state()}).encode()
+            ctype = "application/json"
+        elif path == "/trace" and span_store is not None:
+            from urllib.parse import parse_qs
+
+            from kubeflow_tpu.obs import trace as obs_trace
+
+            trace_id = (parse_qs(query).get("trace_id")
+                        or [""])[0]
+            if not trace_id:
+                self.send_error(400, "trace_id is required")
+                return
+            spans = span_store.trace(trace_id)
+            body = json.dumps(
+                {"trace_id": trace_id, "spans": spans,
+                 "attribution": (obs_trace.attribution(spans)
+                                 if spans else None)}).encode()
+            ctype = "application/json"
         elif path == "/healthz":
             body = b'{"status": "ok"}'
             ctype = "application/json"
@@ -237,6 +282,38 @@ class _ExpositionHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def do_POST(self):  # noqa: N802 — stdlib contract
+        path, _, _query = self.path.partition("?")
+        span_store = getattr(self.server, "span_store", None)
+        if path != "/spans" or span_store is None:
+            self.send_error(404)
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        if not 0 < length <= MAX_SPAN_PUSH_BYTES:
+            self.send_error(413 if length else 400,
+                            "span push body outside bounds")
+            return
+        try:
+            doc = json.loads(self.rfile.read(length))
+            spans = doc.get("spans", [])
+            if not isinstance(spans, list) or not all(
+                    isinstance(s, dict) for s in spans):
+                raise ValueError("'spans' must be a list of span "
+                                 "objects")
+            ingested, dropped = span_store.ingest(
+                spans, instance=doc.get("component") or None,
+                path="push")
+        except (ValueError, TypeError) as e:
+            self.send_error(400, f"bad span push: {e}")
+            return
+        body = json.dumps({"ingested": ingested,
+                           "dropped": dropped}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def log_message(self, format, *args):  # noqa: A002 — stdlib sig
         pass  # scrapes every few seconds must not spam stderr
 
@@ -244,15 +321,20 @@ class _ExpositionHandler(BaseHTTPRequestHandler):
 def start_exposition_server(port: int = 0, *,
                             registry: Optional[Any] = None,
                             tracer: Optional[Any] = None,
+                            span_store: Optional[Any] = None,
                             host: str = "0.0.0.0"):
     """Serve /metrics + /tracez + /healthz from a daemon thread (the
-    operator's scrape surface — it runs no tornado). Returns the
-    ``ThreadingHTTPServer``; ``server.server_address[1]`` is the bound
-    port (useful with port=0), ``server.shutdown()`` stops it."""
+    operator's scrape surface — it runs no tornado). With a
+    ``span_store`` (collector sidecar), also serves the trace
+    assembly endpoints (/traces, /trace) and accepts span pushes
+    (POST /spans). Returns the ``ThreadingHTTPServer``;
+    ``server.server_address[1]`` is the bound port (useful with
+    port=0), ``server.shutdown()`` stops it."""
     server = ThreadingHTTPServer((host, port), _ExpositionHandler)
     server.daemon_threads = True
     server.registry = registry
     server.tracer = tracer
+    server.span_store = span_store
     thread = threading.Thread(target=server.serve_forever,
                               name="obs-exposition", daemon=True)
     thread.start()

@@ -1,0 +1,377 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Trace assembly + latency attribution over fleet-collected spans.
+
+One request that crosses the fleet (proxy → server → gRPC server →
+engine, plus the second hop of role-split / hedge / resume) leaves its
+spans in N processes whose ``time.monotonic()`` clocks never met —
+absolute timestamps are NOT comparable across processes, only
+durations and parent links are. This module is the join:
+
+- :func:`assemble` builds the request tree from the ``span_id`` /
+  ``parent_id`` linkage (:func:`obs.tracing.span_args`): each hop's
+  root span carries its own id + its caller's id; spans recorded
+  under a context are leaves parented on that hop.
+- :func:`attribution` buckets the request's end-to-end latency into
+  **queue** (admission wait: engine queue + micro-batcher
+  queue_wait/batch_assembly), **prefill** (prompt passes), **decode**
+  (token slices / batched executes), **relay** (proxy time around its
+  upstream legs) and **gap** (server-side residual the instrumented
+  spans don't explain: transport, JSON, scheduling), and reports how
+  much of the measured wall time the buckets cover.
+- :func:`waterfall_lines` renders the tree as text — the ``kft-trace``
+  CLI's output (``python -m kubeflow_tpu.obs.trace <trace_id>
+  --collector http://host:port`` against a collector sidecar's
+  ``/trace`` endpoint, or ``--spans file`` over a /tracez dump).
+
+The dashboard's Waterfall page (dashboard/server.py) renders the same
+assembly/attribution over the in-process collector's
+:class:`~kubeflow_tpu.obs.collector.SpanStore`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "SERVER_ROOT_SPANS",
+    "assemble",
+    "attribution",
+    "waterfall_lines",
+]
+
+#: Per-hop root spans: one per process a request traversed. The proxy
+#: root is the client-measured wall clock; server roots bound each
+#: upstream leg.
+PROXY_ROOT_SPANS = frozenset({"proxy_request"})
+SERVER_ROOT_SPANS = frozenset({"http_request", "grpc_request",
+                               "grpc_web_request"})
+
+#: Span-name → attribution bucket for duration-carrying spans.
+_BUCKET_BY_NAME = {
+    "queue_wait": "queue",
+    "batch_assembly": "queue",
+    "engine_prefill": "prefill",
+    "execute": "decode",
+}
+
+
+def _args(span: Dict[str, Any]) -> Dict[str, Any]:
+    args = span.get("args")
+    return args if isinstance(args, dict) else {}
+
+
+def _f(value: Any) -> float:
+    """Total float coercion: spans can arrive over the UNvalidated
+    push path (POST /spans takes any dict), and one malformed field
+    must degrade to 0 for that span, never 500 every read of its
+    trace."""
+    try:
+        return float(value or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _dur_ms(span: Dict[str, Any]) -> float:
+    return _f(span.get("dur")) / 1e3
+
+
+def assemble(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Build the request tree for ONE trace's spans.
+
+    Nodes are ``{"span": <event>, "children": [nodes]}``. A span with
+    an ``args.span_id`` is a hop root (it can parent others); spans
+    carrying only ``parent_id`` are leaves of that hop. Roots are
+    spans whose parent id is absent or unknown (the collector may not
+    have scraped every process yet — orphan subtrees surface as extra
+    roots rather than disappearing). Children sort by timestamp
+    (within one process that is meaningful; across processes the
+    parent links, not the timestamps, carry the truth)."""
+    by_id: Dict[str, Dict[str, Any]] = {}
+    nodes = []
+    for span in spans:
+        node = {"span": span, "children": []}
+        nodes.append(node)
+        span_id = _args(span).get("span_id")
+        if span_id and span_id not in by_id:
+            by_id[span_id] = node
+    roots = []
+    for node in nodes:
+        parent_id = _args(node["span"]).get("parent_id")
+        parent = by_id.get(parent_id) if parent_id else None
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    for node in nodes:
+        node["children"].sort(key=lambda n: _f(n["span"].get("ts")))
+    # Proxy root first, then the longest spans — the waterfall's
+    # natural reading order when a trace has stray roots.
+    roots.sort(key=lambda n: (
+        0 if n["span"].get("name") in PROXY_ROOT_SPANS else 1,
+        -_f(n["span"].get("dur"))))
+    return {"roots": roots, "spans": len(spans)}
+
+
+def attribution(spans: List[Dict[str, Any]],
+                total_ms: Optional[float] = None) -> Dict[str, Any]:
+    """Bucket one trace's end-to-end latency.
+
+    ``total_ms`` overrides the measured wall time (a client-side
+    stopwatch); by default it is the proxy root span's duration,
+    falling back to the server legs' sum for direct-to-server traces.
+
+    - **queue / prefill / decode** come from the engine's exact
+      per-request triple (``engine_request``) plus the micro-batcher
+      spans — no cross-process timestamp arithmetic.
+    - **relay** is MEASURED: the proxy root wall minus the proxy's
+      own ``proxy_upstream`` windows (its time outside upstream
+      awaits).
+    - **gap** is the network+server residual of legs whose server
+      span arrived: (upstream window − server wall) + (server wall −
+      engine-attributed time).
+
+    ``coverage`` counts only span-evidenced time: an upstream window
+    whose server-side root never arrived (a process the collector
+    didn't scrape) is NOT covered and lands in ``missing`` — exactly
+    the signal the assembly layer owes you."""
+    proxy_ms = 0.0
+    server_ms = 0.0
+    queue = prefill = decode = 0.0
+    legs: Dict[str, float] = {}
+    upstream: Dict[str, float] = {}
+    engine_seen = any(s.get("name") == "engine_request"
+                      for s in spans)
+    for span in spans:
+        name = span.get("name", "")
+        args = _args(span)
+        if name in PROXY_ROOT_SPANS:
+            proxy_ms += _dur_ms(span)
+            continue
+        if name == "proxy_upstream":
+            leg = str(args.get("leg") or "primary")
+            upstream[leg] = upstream.get(leg, 0.0) + _dur_ms(span)
+            continue
+        if name in SERVER_ROOT_SPANS:
+            server_ms += _dur_ms(span)
+            leg = str(args.get("leg") or "primary")
+            legs[leg] = legs.get(leg, 0.0) + _dur_ms(span)
+            continue
+        if name == "engine_request":
+            # The engine's own per-request attribution (queue wait
+            # before a slot, prefill, decode-slice share) — exact, no
+            # span-interval arithmetic needed.
+            queue += _f(args.get("queue_ms"))
+            prefill += _f(args.get("prefill_ms"))
+            decode += _f(args.get("decode_ms"))
+            continue
+        bucket = _BUCKET_BY_NAME.get(name)
+        if bucket == "queue":
+            queue += _dur_ms(span)
+        elif bucket == "decode":
+            decode += _dur_ms(span)
+        elif bucket == "prefill":
+            # A slot-bound admission's engine_prefill rides inside
+            # its engine_request's prefill_ms — don't double-count it.
+            # The slot-less prefill-role hop (run_prefill, tagged
+            # handoff=True) has no engine_request and ALWAYS counts:
+            # it is the split path's real prefill.
+            if args.get("handoff") or not engine_seen:
+                prefill += _dur_ms(span)
+    if total_ms is None:
+        total_ms = proxy_ms if proxy_ms > 0 else server_ms
+    attributed = queue + prefill + decode
+    server_residual = max(0.0, server_ms - attributed) \
+        if server_ms > 0 else 0.0
+    missing = []
+    if proxy_ms == 0.0:
+        missing.append("proxy_request")
+    if server_ms == 0.0:
+        missing.append("server_root")
+    if not engine_seen and decode == 0.0:
+        missing.append("engine_request")
+    if upstream:
+        upstream_total = sum(upstream.values())
+        relay = (max(0.0, total_ms - upstream_total)
+                 if proxy_ms > 0 else 0.0)
+        explained = net_gap = 0.0
+        for leg, window_ms in sorted(upstream.items()):
+            server_leg = legs.get(leg, 0.0)
+            if server_leg > 0.0:
+                # Window fully evidenced: server wall + network gap.
+                explained += window_ms
+                net_gap += max(0.0, window_ms - server_leg)
+            else:
+                missing.append(f"server_leg:{leg}")
+        gap = net_gap + server_residual
+        covered = min(total_ms, relay + explained)
+    else:
+        # No proxy_upstream evidence (direct-to-server trace, or an
+        # old proxy build): relay degrades to the proxy-vs-server
+        # residual and coverage to what the server spans explain.
+        relay = (max(0.0, total_ms - server_ms)
+                 if proxy_ms > 0 else 0.0)
+        gap = server_residual
+        covered = min(total_ms, server_ms + relay) if server_ms > 0 \
+            else min(total_ms, attributed)
+    return {
+        "total_ms": round(total_ms, 3),
+        "buckets": {
+            "queue_ms": round(queue, 3),
+            "prefill_ms": round(prefill, 3),
+            "decode_ms": round(decode, 3),
+            "relay_ms": round(relay, 3),
+            "gap_ms": round(gap, 3),
+        },
+        "coverage": round(covered / total_ms, 4) if total_ms else 0.0,
+        "legs": {leg: round(ms, 3)
+                 for leg, ms in sorted(legs.items())},
+        "upstream_legs": {leg: round(ms, 3)
+                          for leg, ms in sorted(upstream.items())},
+        "missing": missing,
+    }
+
+
+_INTERESTING_ARGS = ("leg", "model", "tenant", "outcome", "slot",
+                     "reason", "tokens", "prompt_len", "rows",
+                     "program", "shapes", "batch")
+
+
+def waterfall_lines(assembled: Dict[str, Any]) -> List[str]:
+    """Text waterfall of an assembled trace (the CLI's view)."""
+    lines: List[str] = []
+
+    def walk(node: Dict[str, Any], depth: int) -> None:
+        span = node["span"]
+        args = _args(span)
+        extras = " ".join(
+            f"{k}={args[k]}" for k in _INTERESTING_ARGS if k in args)
+        lines.append(
+            f"{'  ' * depth}{span.get('name', '?'):<18} "
+            f"{_dur_ms(span):>9.2f} ms  pid={span.get('pid', '?')}"
+            f"{'  ' + extras if extras else ''}")
+        for child in node["children"]:
+            walk(child, depth + 1)
+
+    for root in assembled["roots"]:
+        walk(root, 0)
+    return lines
+
+
+def _attribution_lines(report: Dict[str, Any]) -> List[str]:
+    total = report["total_ms"] or 1.0
+    lines = [f"e2e wall: {report['total_ms']:.2f} ms, "
+             f"coverage {report['coverage'] * 100:.1f}%"]
+    for key, ms in report["buckets"].items():
+        frac = ms / total
+        bar = "#" * max(0, min(40, int(round(frac * 40))))
+        lines.append(f"  {key.removesuffix('_ms'):<8}"
+                     f"{ms:>9.2f} ms  {frac * 100:>5.1f}%  {bar}")
+    if report["legs"]:
+        lines.append("  legs: " + ", ".join(
+            f"{leg}={ms:.2f}ms" for leg, ms in report["legs"].items()))
+    if report["missing"]:
+        lines.append(f"  missing spans: {', '.join(report['missing'])}")
+    return lines
+
+
+def _spans_from_file(path: str) -> List[Dict[str, Any]]:
+    """Spans from a /tracez JSON document or a JSONL span dump."""
+    with open(path) as f:
+        text = f.read()
+    text = text.strip()
+    if text.startswith("{"):
+        doc = json.loads(text)
+        events = doc.get("traceEvents", doc.get("spans", []))
+    else:
+        events = [json.loads(line) for line in text.splitlines()
+                  if line.strip()]
+    return [e for e in events if e.get("ph", "X") == "X"]
+
+
+def _fetch_json(url: str, timeout_s: float) -> Any:
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8", "replace"))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kft-trace",
+        description="Assemble one request's fleet-wide trace and "
+                    "attribute its latency (docs/observability.md, "
+                    "'Distributed tracing & latency attribution').")
+    parser.add_argument("trace_id", nargs="?", default=None,
+                        help="trace id (or request id) to assemble; "
+                             "omit with --list to enumerate")
+    parser.add_argument("--collector", default="http://localhost:9500",
+                        help="collector exposition base URL (the "
+                             "sidecar's --metrics_port surface)")
+    parser.add_argument("--spans", default=None,
+                        help="read spans from a /tracez JSON or span "
+                             "JSONL file instead of the collector")
+    parser.add_argument("--list", action="store_true",
+                        help="list the trace ids the collector holds")
+    parser.add_argument("--timeout", type=float, default=5.0)
+    parser.add_argument("--json", action="store_true",
+                        help="emit the assembled document as JSON")
+    args = parser.parse_args(argv)
+    base = args.collector.rstrip("/")
+    if "://" not in base:
+        base = f"http://{base}"
+    if args.list:
+        doc = _fetch_json(f"{base}/traces", args.timeout)
+        for row in doc.get("traces", []):
+            print(f"{row['trace_id']}  spans={row['spans']}")
+        return 0
+    if not args.trace_id:
+        parser.error("a trace_id is required (or --list)")
+    if args.spans:
+        spans = [s for s in _spans_from_file(args.spans)
+                 if args.trace_id in (_args(s).get("trace_id"),
+                                      _args(s).get("request_id"))]
+    else:
+        from urllib.parse import quote
+
+        # Request ids are arbitrary client strings (X-Request-Id up
+        # to 128 chars) — quote or metacharacters query the wrong id.
+        doc = _fetch_json(
+            f"{base}/trace?trace_id={quote(args.trace_id, safe='')}",
+            args.timeout)
+        spans = doc.get("spans", [])
+    if not spans:
+        print(f"no spans for trace {args.trace_id}", file=sys.stderr)
+        return 1
+    assembled = assemble(spans)
+    report = attribution(spans)
+    if args.json:
+        print(json.dumps({"trace_id": args.trace_id,
+                          "attribution": report,
+                          "spans": spans}, indent=1))
+        return 0
+    print(f"trace {args.trace_id} — {assembled['spans']} span(s)")
+    for line in waterfall_lines(assembled):
+        print(line)
+    print()
+    for line in _attribution_lines(report):
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

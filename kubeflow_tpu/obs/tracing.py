@@ -39,6 +39,21 @@ Span linkage contract: request-scoped spans (``queue_wait``,
 ``batch_execute`` span per XLA dispatch carries the same ``args.batch``
 id, which is how N request timelines join the single device dispatch
 they shared.
+
+Fleet assembly contract (ISSUE 15): every hop's root span ALSO
+carries ``args.span_id`` (its own id) and ``args.parent_id`` (the
+caller's span id, parsed off the inbound ``traceparent``), and spans
+recorded under a context (:func:`span_args`) carry
+``parent_id = ctx.span_id`` — so the collector's
+:class:`~kubeflow_tpu.obs.collector.SpanStore` can reassemble ONE
+request's full proxy → server → engine tree even when the spans were
+scraped from N processes whose monotonic clocks never met. Multi-leg
+requests (role-split hops, hedge twins, mid-stream resume replays)
+share the trace id with distinct leg-tagged span ids: the proxy mints
+a :meth:`TraceContext.child` per upstream hop with a ``leg`` tag
+(``prefill`` / ``decode`` / ``primary`` / ``hedge`` / ``resume-N``)
+that rides the ``X-KFT-Trace-Leg`` header, so a stitched stream still
+yields one waterfall.
 """
 
 from __future__ import annotations
@@ -50,11 +65,20 @@ import random
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, Iterable, List, Optional, Tuple
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    List,
+    Optional,
+    Tuple,
+)
 
 __all__ = [
     "REQUEST_ID_HEADER",
     "TRACEPARENT_HEADER",
+    "TRACE_LEG_HEADER",
     "TRACER",
     "TraceContext",
     "Tracer",
@@ -66,11 +90,17 @@ __all__ = [
     "from_headers",
     "new_context",
     "parse_traceparent",
+    "root_span_args",
+    "span_args",
     "use_context",
 ]
 
 REQUEST_ID_HEADER = "X-Request-Id"
 TRACEPARENT_HEADER = "traceparent"
+#: Leg tag of a multi-leg request (role-split hop, hedge twin, resume
+#: replay): same trace id, distinct leg — the assembly layer shows one
+#: waterfall with the legs side by side instead of N anonymous trees.
+TRACE_LEG_HEADER = "X-KFT-Trace-Leg"
 
 _HEX = "0123456789abcdef"
 
@@ -97,31 +127,49 @@ def _is_hex(s: str, length: int) -> bool:
 
 class TraceContext:
     """Immutable-ish propagation context: W3C trace/span ids plus the
-    human-greppable request id (the access-log join key)."""
+    human-greppable request id (the access-log join key).
+    ``parent_span_id`` is the CALLER's span id (parsed off the inbound
+    ``traceparent``) — the edge that lets the collector rebuild the
+    cross-process tree; ``leg`` names which leg of a multi-leg request
+    this context rides (empty for single-leg requests)."""
 
-    __slots__ = ("trace_id", "span_id", "request_id")
+    __slots__ = ("trace_id", "span_id", "request_id",
+                 "parent_span_id", "leg")
 
-    def __init__(self, trace_id: str, span_id: str, request_id: str):
+    def __init__(self, trace_id: str, span_id: str, request_id: str,
+                 parent_span_id: Optional[str] = None, leg: str = ""):
         self.trace_id = trace_id
         self.span_id = span_id
         self.request_id = request_id
+        self.parent_span_id = parent_span_id
+        self.leg = leg
 
-    def child(self) -> "TraceContext":
-        """Same trace/request, fresh span id — what each hop sends
-        downstream so parentage is reconstructible."""
-        return TraceContext(self.trace_id, _hex64(), self.request_id)
+    def child(self, leg: Optional[str] = None) -> "TraceContext":
+        """Same trace/request, fresh span id parented on THIS context
+        — what each hop sends downstream so parentage is
+        reconstructible. ``leg`` tags the downstream hop (role-split
+        hop, hedge twin, resume replay); None inherits."""
+        return TraceContext(self.trace_id, _hex64(), self.request_id,
+                            parent_span_id=self.span_id,
+                            leg=self.leg if leg is None else leg)
 
     def traceparent(self) -> str:
         return f"00-{self.trace_id}-{self.span_id}-01"
 
     def headers(self) -> Dict[str, str]:
-        return {REQUEST_ID_HEADER: self.request_id,
-                TRACEPARENT_HEADER: self.traceparent()}
+        out = {REQUEST_ID_HEADER: self.request_id,
+               TRACEPARENT_HEADER: self.traceparent()}
+        if self.leg:
+            out[TRACE_LEG_HEADER] = self.leg
+        return out
 
     def grpc_metadata(self) -> Tuple[Tuple[str, str], ...]:
         """gRPC metadata keys must be lowercase ASCII."""
-        return (("x-request-id", self.request_id),
-                ("traceparent", self.traceparent()))
+        out = (("x-request-id", self.request_id),
+               ("traceparent", self.traceparent()))
+        if self.leg:
+            out += (("x-kft-trace-leg", self.leg),)
+        return out
 
     def __repr__(self) -> str:
         return (f"TraceContext(request_id={self.request_id!r}, "
@@ -132,6 +180,43 @@ def new_context(request_id: Optional[str] = None) -> TraceContext:
     trace_id = _hex128()
     return TraceContext(trace_id, _hex64(),
                         request_id or trace_id[:16])
+
+
+def span_args(ctx: Optional[TraceContext],
+              **extra: Any) -> Dict[str, Any]:
+    """The span-linkage args every context-tagged span carries:
+    request/trace ids for the grep workflow, ``parent_id`` (= the
+    context's own span id) for tree assembly, and the leg tag when the
+    request is multi-leg. ``extra`` keys ride along verbatim; a None
+    context yields just them (the span is then a documented root —
+    scripts/lint.py check_span_discipline enforces the distinction)."""
+    args: Dict[str, Any] = dict(extra)
+    if ctx is not None:
+        args.setdefault("request_id", ctx.request_id)
+        args["trace_id"] = ctx.trace_id
+        args["parent_id"] = ctx.span_id
+        if ctx.leg:
+            args.setdefault("leg", ctx.leg)
+    return args
+
+
+def root_span_args(ctx: Optional[TraceContext],
+                   **extra: Any) -> Dict[str, Any]:
+    """The HOP-ROOT flavor of :func:`span_args`: this span OWNS the
+    context's span id (children recorded under the same context
+    parent on it) and parents on the inbound caller's span id — the
+    cross-process edge of the assembled tree. One helper, used by
+    every hop root (HTTP mixin, native gRPC listener, the proxy's
+    upstream windows), so a linkage change lands everywhere at
+    once."""
+    args = span_args(ctx, **extra)
+    if ctx is not None:
+        args["span_id"] = ctx.span_id
+        if ctx.parent_span_id:
+            args["parent_id"] = ctx.parent_span_id
+        else:
+            args.pop("parent_id", None)
+    return args
 
 
 def parse_traceparent(value: str) -> Optional[Tuple[str, str]]:
@@ -159,14 +244,23 @@ def from_headers(headers) -> Optional[TraceContext]:
     request_id = headers.get(REQUEST_ID_HEADER)
     if request_id:
         request_id = str(request_id)[:128]
+    leg = headers.get(TRACE_LEG_HEADER)
+    leg = str(leg)[:32] if leg else ""
     parent = headers.get(TRACEPARENT_HEADER)
     parsed = parse_traceparent(parent) if parent else None
     if parsed:
-        trace_id, span_id = parsed
-        return TraceContext(trace_id, span_id,
-                            request_id or trace_id[:16])
+        # The inbound traceparent's span id is the CALLER's span — it
+        # becomes this hop's parent, and this hop mints its own span
+        # id, so the assembled tree has one node per hop instead of N
+        # hops claiming one id.
+        trace_id, parent_span_id = parsed
+        return TraceContext(trace_id, _hex64(),
+                            request_id or trace_id[:16],
+                            parent_span_id=parent_span_id, leg=leg)
     if request_id:
-        return new_context(request_id=request_id)
+        ctx = new_context(request_id=request_id)
+        ctx.leg = leg
+        return ctx
     return None
 
 
@@ -224,9 +318,10 @@ def from_grpc_metadata(metadata: Optional[Iterable]
     found = {}
     for item in metadata:
         key, value = item[0], item[1]
-        if key.lower() in ("x-request-id", "traceparent"):
+        if key.lower() in ("x-request-id", "traceparent",
+                           "x-kft-trace-leg"):
             found[key.lower()] = value
-    if not found:
+    if "x-request-id" not in found and "traceparent" not in found:
         return None
 
     class _MD:
@@ -280,6 +375,16 @@ class Tracer:
         self._durations: Dict[str, deque] = {}
         self._dur_seen: Dict[str, int] = {}
         self._slow_thr: Dict[str, float] = {}
+        # Span-shipping export queue (None = off, the default): every
+        # stored span is ALSO appended here for a SpanShipper to drain
+        # and push to the fleet collector. Bounded (oldest dropped,
+        # counted) so a dead collector can never grow this process.
+        self._export: Optional[deque] = None
+        self._export_dropped = 0
+        #: Called (outside the lock) when the export queue crosses
+        #: half capacity — the shipper's wake-early hook, so buffer
+        #: pressure ships spans before the ring evicts them.
+        self.on_export_pressure: Optional[Callable[[], None]] = None
 
     def set_capacity(self, capacity: int) -> None:
         with self._lock:
@@ -308,6 +413,49 @@ class Tracer:
 
     def next_batch_id(self) -> str:
         return f"batch-{self._pid}-{next(self._batch_ids)}"
+
+    # -- span shipping (export queue) ------------------------------------
+
+    def enable_export(self, capacity: int = 2048) -> None:
+        """Turn on the export queue: every span record() stores is
+        also queued for a shipper to drain (collector push path).
+        Bounded — a stalled shipper costs dropped exports, never
+        memory."""
+        with self._lock:
+            self._export = deque(self._export or (),
+                                 maxlen=int(capacity))
+
+    def disable_export(self) -> None:
+        with self._lock:
+            self._export = None
+            self._export_dropped = 0
+
+    def drain_export(self) -> List[Dict[str, Any]]:
+        """Pop everything queued for shipping (the SpanShipper's
+        cycle body). Empty list when export is off."""
+        with self._lock:
+            if not self._export:
+                return []
+            out = list(self._export)
+            self._export.clear()
+        return out
+
+    def export_stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"queued": len(self._export or ()),
+                    "dropped": self._export_dropped}
+
+    def _export_locked(self, event: Dict[str, Any]) -> bool:
+        """Queue one stored span for shipping; True when the queue
+        crossed half capacity (caller fires the pressure hook outside
+        the lock)."""
+        q = self._export
+        if q is None:
+            return False
+        if len(q) == q.maxlen:
+            self._export_dropped += 1
+        q.append(event)
+        return len(q) * 2 >= (q.maxlen or 1)
 
     def _classify_locked(self, name: str, dur_s: float,
                          args: Optional[Dict[str, Any]]) -> Optional[str]:
@@ -363,19 +511,30 @@ class Tracer:
         }
         if args:
             event["args"] = args
+        pressure = False
         with self._lock:
             if self._tail_keep_prob is None:
                 self._spans.append(event)
-                return
-            verdict = self._classify_locked(name, dur_s, args)
-            if verdict is not None:
-                args = dict(args or ())
-                args["retain"] = verdict
-                event["args"] = args
-                self._retained.append(event)
-            elif (self._tail_keep_prob >= 1.0
-                  or _rng.random() < self._tail_keep_prob):
-                self._spans.append(event)
+                pressure = self._export_locked(event)
+            else:
+                verdict = self._classify_locked(name, dur_s, args)
+                if verdict is not None:
+                    args = dict(args or ())
+                    args["retain"] = verdict
+                    event["args"] = args
+                    self._retained.append(event)
+                    pressure = self._export_locked(event)
+                elif (self._tail_keep_prob >= 1.0
+                      or _rng.random() < self._tail_keep_prob):
+                    self._spans.append(event)
+                    pressure = self._export_locked(event)
+        if pressure:
+            cb = self.on_export_pressure
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:  # noqa: BLE001 — a shipper hook bug
+                    pass  # must never fail the recording hot path
 
     class _SpanCtx:
         __slots__ = ("_tracer", "_name", "_cat", "_args", "_t0")
@@ -421,6 +580,8 @@ class Tracer:
             self._durations.clear()
             self._dur_seen.clear()
             self._slow_thr.clear()
+            if self._export is not None:
+                self._export.clear()
 
     def export_chrome(self, spans: Optional[List[Dict[str, Any]]] = None
                       ) -> Dict[str, Any]:

@@ -75,6 +75,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs.collector import ScrapeTarget
 from kubeflow_tpu.obs.tracing import TRACER
 from kubeflow_tpu.scaling.endpoints import (
     normalize_spec,
@@ -478,6 +479,13 @@ class AutoscalerLoop:
             "expired_rate": round(expired_rate, 4),
             "resident_models": sorted(payload.get("saturation") or {}),
             "shards": shards,
+            # Span-endpoint pass-through (ISSUE 15): /tracez rides the
+            # same port as /healthz and /metrics — publishing it in
+            # the fleet snapshot gives the dashboard and kft-trace a
+            # per-replica waterfall link with no extra discovery.
+            # (ScrapeTarget owns the scheme-aware URL grammar — one
+            # source of truth with the collector's span scrape.)
+            "tracez": ScrapeTarget(address).tracez_url,
         }
         role = payload.get("role")
         if isinstance(role, str) and role != "any":

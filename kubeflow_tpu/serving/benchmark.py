@@ -787,13 +787,29 @@ class ObsOverheadConfig:
     rounds: int = 6  # paired off/on rounds for the secondary A/B
     micro_iters: int = 20000
     model_dtype: str = "float32"
+    #: Span shipping ON during the measured drive (ISSUE 15): the
+    #: tracer's export queue + a rate-capped SpanShipper pushing to
+    #: an in-process collector SpanStore over real HTTP. The
+    #: component cost gains ship_us (the hot-path export append);
+    #: the shipper thread's serialization is bounded by its rate cap
+    #: (reported as shipper_core_pct, a flat fraction of one core)
+    #: and its real CPU rides the drive's measured service cost.
+    ship_spans: bool = True
 
 
-def _measure_obs_component_cost_us(iters: int) -> Dict[str, float]:
+def _measure_obs_component_cost_us(iters: int,
+                                   ship_spans: bool = False
+                                   ) -> Dict[str, float]:
     """Tight-loop cost of the obs work ONE dispatched request adds:
     ctx mint + 5 span records + per-request metric updates (two
-    counters, two histogram observes). Deterministic to a few percent
-    — no XLA, no threads, no sockets."""
+    counters, two histogram observes) — and, with ``ship_spans``, the
+    marginal shipping cost (export-queue append per record + the
+    drained batch's JSON serialization, amortized per request).
+    Deterministic to a few percent — no XLA, no threads, no
+    sockets (the POST itself rides the shipper thread and lands in
+    the drive phase's process CPU)."""
+    import json as _json
+
     from kubeflow_tpu.obs import metrics as obs_metrics
     from kubeflow_tpu.obs import tracing as obs_tracing
 
@@ -831,9 +847,47 @@ def _measure_obs_component_cost_us(iters: int) -> Dict[str, float]:
         ha.observe(0.003)
         hb.observe(0.003)
     metrics_us = (time.perf_counter() - t0) / iters * 1e6
-    total = ctx_us + spans_us + metrics_us
+
+    ship_us = 0.0
+    ship_serialize_us_per_span = 0.0
+    if ship_spans:
+        # Hot-path half of shipping: the export-queue append inside
+        # record() — the same 5-record loop with the queue live
+        # (drained out-of-loop so only the append is priced; the
+        # serialization rides the SHIPPER thread and is rate-capped).
+        tracer.enable_export(16384)
+        t0 = time.perf_counter()
+        for i in range(iters):
+            for name in ("queue_wait", "batch_assembly", "execute",
+                         "batch_execute", "http_request"):
+                tracer.record(name, "serving", 1.0, 0.001, args)
+            if i % 1024 == 1023:
+                tracer.drain_export()
+        with_ship_us = (time.perf_counter() - t0) / iters * 1e6
+        ship_us = max(0.0, with_ship_us - spans_us)
+        # Shipper-thread half: JSON serialization per span — the
+        # number the SpanShipper rate cap turns into a flat per-core
+        # budget (cap × this, load-independent). Discard the timing
+        # loop's leftover queue first so the sample is 5×200 spans,
+        # not half a million.
+        tracer.drain_export()
+        for name in ("queue_wait", "batch_assembly", "execute",
+                     "batch_execute", "http_request"):
+            tracer.record(name, "serving", 1.0, 0.001, args)
+        batch = tracer.drain_export() * 200
+        t0 = time.perf_counter()
+        _json.dumps({"component": "bench", "spans": batch},
+                    separators=(",", ":"))
+        ship_serialize_us_per_span = (time.perf_counter() - t0) \
+            / len(batch) * 1e6
+        tracer.disable_export()
+
+    total = ctx_us + spans_us + metrics_us + ship_us
     return {"ctx_us": round(ctx_us, 2), "spans_us": round(spans_us, 2),
             "metrics_us": round(metrics_us, 2),
+            "ship_us": round(ship_us, 2),
+            "ship_serialize_us_per_span": round(
+                ship_serialize_us_per_span, 2),
             "total_us": round(total, 2)}
 
 
@@ -844,7 +898,8 @@ def run_obs_overhead_benchmark(
     from kubeflow_tpu.serving.manager import ServedModel
 
     config = config or ObsOverheadConfig()
-    component = _measure_obs_component_cost_us(config.micro_iters)
+    component = _measure_obs_component_cost_us(
+        config.micro_iters, ship_spans=config.ship_spans)
     base = _export(ServingBenchConfig(
         model=config.model, image_hw=config.image_hw,
         max_batch=config.max_batch, model_dtype=config.model_dtype))
@@ -902,6 +957,26 @@ def run_obs_overhead_benchmark(
     rps_on: List[float] = []
     cpu_on_us: List[float] = []
     wall_ratios: List[float] = []
+    ship_server = shipper = span_store = None
+    if config.ship_spans:
+        # REAL span shipping during the measured phases: the global
+        # tracer's export queue drains over HTTP into an in-process
+        # collector SpanStore — the shipper thread's cost lands in
+        # the drive's process CPU, so the ON phases price the whole
+        # pipeline, not just the record.
+        from kubeflow_tpu.obs.collector import SpanShipper, SpanStore
+        from kubeflow_tpu.obs.exposition import (
+            start_exposition_server,
+        )
+
+        span_store = SpanStore()
+        ship_server = start_exposition_server(
+            0, span_store=span_store, host="127.0.0.1")
+        port = ship_server.server_address[1]
+        shipper = SpanShipper(obs_tracing.TRACER,
+                              f"http://127.0.0.1:{port}",
+                              component="obs-bench", interval_s=0.2)
+        shipper.start()
     try:
         drive(True)  # warmup: compile + page-in, discarded
         for i in range(config.rounds):
@@ -917,6 +992,11 @@ def run_obs_overhead_benchmark(
     finally:
         obs_metrics.set_enabled(was_metrics)
         obs_tracing.TRACER.enabled = was_tracing
+        if shipper is not None:
+            shipper.ship_once()  # drain the tail before stopping
+            shipper.stop()
+        if ship_server is not None:
+            ship_server.shutdown()
         model.stop()
         import shutil
 
@@ -950,6 +1030,21 @@ def run_obs_overhead_benchmark(
         "overhead_pct": round(overhead_pct, 2),
         "ab_wall_overhead_pct": round(ab_wall_overhead_pct, 2),
         "under_2pct": overhead_pct < 2.0,
+        "span_shipping": ({
+            "enabled": True,
+            "shipped": shipper.shipped,
+            "rate_capped_drops": shipper.dropped_spans,
+            "failed_posts": shipper.failed_posts,
+            "max_spans_per_s": shipper.max_spans_per_s,
+            # The shipper thread's flat budget: rate cap × per-span
+            # serialization — a fraction of ONE CORE, by construction
+            # independent of offered load (the collector-cycle bar's
+            # shape, docs/observability.md).
+            "shipper_core_pct": round(
+                shipper.max_spans_per_s
+                * component["ship_serialize_us_per_span"] / 1e4, 3),
+            "store": span_store.state(),
+        } if shipper is not None else {"enabled": False}),
     }
 
 

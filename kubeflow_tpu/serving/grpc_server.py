@@ -44,7 +44,7 @@ import numpy as np
 
 from kubeflow_tpu.obs import tracing as obs_tracing
 from kubeflow_tpu.serving import wire
-from kubeflow_tpu.serving.tenancy import tenant_from_metadata
+from kubeflow_tpu.serving.tenancy import tenant_from_metadata, tenant_label
 from kubeflow_tpu.serving.manager import ModelManager
 from kubeflow_tpu.serving.overload import (
     DeadlineExceededError,
@@ -83,6 +83,24 @@ def _abort_for(context, exc) -> None:
         context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
     logger.exception("unhandled error in gRPC handler")
     context.abort(grpc.StatusCode.INTERNAL, type(exc).__name__)
+
+
+def _record_grpc_span(obs_ctx, t0: float, *, model: str = "",
+                      tenant: str = "", outcome: str = "ok") -> None:
+    """The native listener's per-hop ROOT span — the :9000 half of
+    the fleet waterfall (the REST surface's http_request twin): own
+    span id for children to parent on, the proxy's span as parent,
+    model + capped tenant labels. Context-less calls (no traceparent
+    from the client) record nothing — there is no trace to join."""
+    if obs_ctx is None or not obs_tracing.TRACER.enabled:
+        return
+    args = obs_tracing.root_span_args(obs_ctx, outcome=outcome)
+    if model:
+        args["model"] = model
+    if tenant:
+        args["tenant"] = tenant_label(tenant)
+    obs_tracing.TRACER.record("grpc_request", "serving", t0,
+                              time.monotonic() - t0, args)
 
 
 def _context_deadline(context) -> Optional[float]:
@@ -214,6 +232,8 @@ class PredictionService:
     # -- Predict -----------------------------------------------------------
 
     def Predict(self, request: bytes, context) -> bytes:
+        t0 = time.monotonic()
+        obs_ctx, model, tenant = None, "", ""
         try:
             deadline = _context_deadline(context)
             # The trace context rides gRPC invocation metadata
@@ -229,9 +249,14 @@ class PredictionService:
             spec, loaded, future, output_filter = start_predict(
                 self._manager, request, deadline=deadline,
                 obs_ctx=obs_ctx, tenant=tenant)
+            model = spec["name"]
             outputs = future.result(self._wait_s(deadline))
-            return finish_predict(spec, loaded, outputs, output_filter)
+            body = finish_predict(spec, loaded, outputs, output_filter)
+            _record_grpc_span(obs_ctx, t0, model=model, tenant=tenant)
+            return body
         except Exception as e:  # noqa: BLE001 — mapped to grpc status
+            _record_grpc_span(obs_ctx, t0, model=model, tenant=tenant,
+                              outcome="error")
             _abort_for(context, e)
 
     # -- Classify ----------------------------------------------------------
@@ -270,6 +295,8 @@ class PredictionService:
         the final frame equals the unary Predict response. Runs on the
         gRPC worker thread (grpc's thread-per-RPC model: blocking
         bounded waits are the natural style here)."""
+        t0 = time.monotonic()
+        obs_ctx, tenant = None, ""
         try:
             deadline = _context_deadline(context)
             obs_ctx = obs_tracing.from_grpc_metadata(
@@ -284,14 +311,20 @@ class PredictionService:
                 inputs, sig_name, spec["version"], deadline=deadline,
                 obs_ctx=obs_ctx, tenant=tenant)
         except Exception as e:  # noqa: BLE001 — mapped to grpc status
+            _record_grpc_span(obs_ctx, t0, tenant=tenant,
+                              outcome="error")
             _abort_for(context, e)
             return
         try:
             yield from self._drain_streams(spec, streams, deadline,
                                            context)
+            _record_grpc_span(obs_ctx, t0, model=spec["name"],
+                              tenant=tenant)
         except Exception as e:  # noqa: BLE001
             for s in streams:
                 s.cancel()
+            _record_grpc_span(obs_ctx, t0, model=spec["name"],
+                              tenant=tenant, outcome="error")
             _abort_for(context, e)
 
     def _drain_streams(self, spec, streams, deadline, context):

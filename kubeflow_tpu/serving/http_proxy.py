@@ -79,7 +79,7 @@ from kubeflow_tpu.obs.exposition import (
     TraceContextHandlerMixin,
     access_log_function,
 )
-from kubeflow_tpu.obs.tracing import TRACER
+from kubeflow_tpu.obs.tracing import TRACER, root_span_args, span_args
 from kubeflow_tpu.scaling.balancer import (
     Balancer,
     eligible_endpoints,
@@ -270,12 +270,16 @@ def decode_b64_if_needed(value: Any) -> Any:
 class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
     # The proxy is the tracing EDGE: the mixin's prepare adopts the
     # client's context (X-Request-Id and/or traceparent) or mints a
-    # fresh one, and echoes the id back; _rest_fetch/_grpc_infer then
-    # forward it on every upstream hop (REST headers, gRPC metadata)
-    # so one grep for the id walks proxy access log → server span →
-    # manager batch span. No proxy-side span (_obs_span None): the
-    # access log already carries the proxy's latency, and the
-    # interesting spans live where the work happens.
+    # fresh one, and echoes the id back. Every upstream hop forwards a
+    # leg-tagged CHILD context (fresh span id parented on the proxy's,
+    # X-KFT-Trace-Leg naming the hop: prefill/decode, primary/hedge,
+    # resume-N) so the collector can reassemble one request's full
+    # proxy → server → engine waterfall whatever legs it rode. Infer
+    # verbs record the proxy_request ROOT span (the client-measured
+    # wall clock the attribution buckets must cover, docs/
+    # observability.md); metadata/health handlers stay out of the
+    # ring (_obs_span None).
+    _obs_cat = "router"
 
     @property
     def pool(self) -> EndpointPool:
@@ -354,6 +358,7 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
 
     async def _rest_fetch(self, ep: Endpoint, path: str,
                           deadline: Optional[float] = None,
+                          leg: Optional[str] = None,
                           **kwargs) -> tornado.httpclient.HTTPResponse:
         """One REST fetch against ``ep`` through ITS circuit breaker,
         with the request's remaining deadline capping the timeout.
@@ -370,14 +375,17 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
         if remaining is not None:
             timeout = min(timeout, max(0.001, remaining))
         # Trace propagation on every REST hop (infer AND metadata):
-        # the backend's spans must join this request's id.
+        # a leg-tagged CHILD context, so the backend's spans join this
+        # request's id AND parent on the proxy's root span.
         headers = dict(kwargs.pop("headers", None) or {})
         ctx = getattr(self, "_obs_ctx", None)
-        if ctx is not None:
-            headers.update(ctx.headers())
+        child = ctx.child(leg) if ctx is not None else None
+        if child is not None:
+            headers.update(child.headers())
         headers.update(self.tenant_headers())
         _P_UPSTREAM_REQUESTS.labels("rest").inc()
         client = tornado.httpclient.AsyncHTTPClient()
+        t0 = time.monotonic()
         try:
             response = await client.fetch(f"{ep.url}{path}",
                                           request_timeout=timeout,
@@ -392,7 +400,9 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
             response, failure = None, e
         if failure is None:
             breaker.record_success()
+            self._record_upstream_span(ep, child, leg, t0, "ok")
             return response
+        self._record_upstream_span(ep, child, leg, t0, "error")
         timed_out = "timeout" in str(failure).lower()
         # Connection failures always count; a hang-timeout counts when
         # the burn was substantial (BREAKER_TIMEOUT_FLOOR_S) — a tight
@@ -405,6 +415,24 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
             raise BackendTimeoutError(
                 f"model server timed out after {timeout:.1f}s")
         raise BackendDownError(str(failure))
+
+    def _record_upstream_span(self, ep: Endpoint,
+                              child, leg: Optional[str],
+                              t0: float, outcome: str) -> None:
+        """One ``proxy_upstream`` span per INFER hop (``leg`` set):
+        the proxy-side window around the upstream await. It owns the
+        child context's span id, so the backend's root span nests
+        under it in the assembled tree, and the attribution's
+        ``relay`` bucket is the proxy_request wall MINUS these
+        windows — measured, not a blind residual. Metadata fetches
+        (leg None) stay out: they are cached control traffic, not a
+        leg of the request's latency story."""
+        if child is None or leg is None or not TRACER.enabled:
+            return
+        TRACER.record(
+            "proxy_upstream", "router", t0, time.monotonic() - t0,
+            root_span_args(child, leg=child.leg or "primary",
+                           endpoint=ep.address, outcome=outcome))
 
     def write_backend_error(self, e: Exception) -> None:
         """Uniform JSON mapping for the upstream failure shapes (same
@@ -544,8 +572,10 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
                     _P_ROUTER_FAILOVERS.inc()
                     TRACER.record(
                         "router_failover", "router", time.monotonic(),
-                        0.0, {"from": ep.address, "model": model or "",
-                              "error": type(e).__name__})
+                        0.0, span_args(getattr(self, "_obs_ctx", None),
+                                       **{"from": ep.address},
+                                       model=model or "",
+                                       error=type(e).__name__))
             finally:
                 ep.inflight -= 1
         if last_exc is None:
@@ -849,6 +879,10 @@ class _StreamRelay:
 
 
 class InferProxyHandler(ProxyHandler):
+    #: The request-root span of the whole fleet trace: its duration is
+    #: the client-measured wall the attribution buckets must cover.
+    _obs_span = "proxy_request"
+
     def _grpc_channel(self, ep: Endpoint):
         """Lazily-dialed persistent grpc.aio channel to the replica's
         :9000 (the reference dialed once per process, server.py:41-43;
@@ -929,13 +963,32 @@ class InferProxyHandler(ProxyHandler):
             # propagation with no shared clock.
             timeout = min(timeout, max(0.001, remaining))
         _P_UPSTREAM_REQUESTS.labels("grpc").inc()
-        metadata = list(self._obs_ctx.grpc_metadata())
+        # Child context on the binary hop too: the :9000 listener's
+        # grpc_request span parents on this hop's window like the
+        # REST hop's http_request does.
+        child = self._obs_ctx.child("primary")
+        metadata = list(child.grpc_metadata())
         metadata.extend((k.lower(), v)
                         for k, v in self.tenant_headers().items())
+        t0 = time.monotonic()
         try:
             response = await call(
                 request, timeout=timeout, metadata=metadata)
-        except grpc.aio.AioRpcError as e:
+        except BaseException as e:  # noqa: BLE001 — every ending of
+            # this leg must record its upstream window (the :9000
+            # listener already parented its grpc_request span on it):
+            # an AioRpcError continues into the status-code mapping
+            # below; anything else — cancellation when the downstream
+            # client drops, channel/codec errors — propagates to the
+            # caller with its window recorded.
+            import asyncio
+
+            self._record_upstream_span(
+                ep, child, "primary", t0,
+                "cancelled" if isinstance(e, asyncio.CancelledError)
+                else "error")
+            if not isinstance(e, grpc.aio.AioRpcError):
+                raise
             if e.code() == grpc.StatusCode.UNAVAILABLE:
                 # :9000 unreachable (older server image, firewalled
                 # port, or genuine overload): count it against this
@@ -993,6 +1046,7 @@ class InferProxyHandler(ProxyHandler):
             self.write_json(payload, code)
             return True
         ep.grpc_breaker.record_success()
+        self._record_upstream_span(ep, child, "primary", t0, "ok")
         spec_out, outputs = wire.decode_predict_response(response)
         if not version:
             served = spec_out.get("version")
@@ -1057,7 +1111,7 @@ class InferProxyHandler(ProxyHandler):
             headers[overload.DEADLINE_HEADER] = str(
                 max(1, int(remaining * 1000)))
         response = await self._rest_fetch(
-            ep, path, deadline=deadline,
+            ep, path, deadline=deadline, leg="primary",
             method="POST", headers=headers,
             body=json.dumps(upstream_body))
         payload = json.loads(response.body or b"{}")
@@ -1090,7 +1144,8 @@ class InferProxyHandler(ProxyHandler):
     async def _raw_unary_fetch(self, ep: Endpoint, path: str,
                                payload: bytes,
                                deadline: Optional[float],
-                               box: Dict[str, Any]):
+                               box: Dict[str, Any],
+                               leg: Optional[str] = None):
         """One unary POST over a raw, CLOSABLE connection
         (tornado.tcpclient). AsyncHTTPClient gives no handle to abort
         an in-flight request, and hedging is only honest if the LOSER
@@ -1122,8 +1177,12 @@ class InferProxyHandler(ProxyHandler):
             headers[overload.DEADLINE_HEADER] = str(
                 max(1, int(remaining * 1000)))
         ctx = getattr(self, "_obs_ctx", None)
-        if ctx is not None:
-            headers.update(ctx.headers())
+        # Leg-tagged child context: a hedge twin must share the trace
+        # id with a DISTINCT span id, or the two legs' server spans
+        # collapse into one waterfall node.
+        child = ctx.child(leg) if ctx is not None else None
+        if child is not None:
+            headers.update(child.headers())
         headers.update(self.tenant_headers())
         request = (f"POST {path} HTTP/1.1\r\n" + "".join(
             f"{k}: {v}\r\n" for k, v in headers.items())
@@ -1153,28 +1212,44 @@ class InferProxyHandler(ProxyHandler):
             return status, resp_headers, data
 
         _P_UPSTREAM_REQUESTS.labels("rest").inc()
+        t0 = time.monotonic()
+        # try/finally so the upstream window records whatever ends
+        # the leg — INCLUDING CancelledError when the hedge
+        # orchestrator cancels the loser (the normal end state of
+        # every fired hedge; without its span the hedge server's
+        # subtree would assemble as an orphan root).
+        outcome = "cancelled"
         try:
-            result = await asyncio.wait_for(talk(), timeout)
-        except asyncio.TimeoutError:
-            self._close_box(box)
-            # The same breaker floor as _rest_fetch: a substantial
-            # hang indicts the backend, a tight budget expiring
-            # proves nothing.
-            if timeout >= min(self.rpc_timeout,
-                              BREAKER_TIMEOUT_FLOOR_S):
+            try:
+                result = await asyncio.wait_for(talk(), timeout)
+            except asyncio.TimeoutError:
+                self._close_box(box)
+                outcome = "expired"
+                # The same breaker floor as _rest_fetch: a
+                # substantial hang indicts the backend, a tight
+                # budget expiring proves nothing.
+                if timeout >= min(self.rpc_timeout,
+                                  BREAKER_TIMEOUT_FLOOR_S):
+                    breaker.record_failure()
+                    _P_UPSTREAM_FAILURES.labels("rest").inc()
+                raise BackendTimeoutError(
+                    f"model server timed out after {timeout:.1f}s") \
+                    from None
+            except asyncio.CancelledError:
+                self._close_box(box)
+                raise
+            except Exception as e:  # noqa: BLE001 — transport failure
+                self._close_box(box)
+                outcome = "error"
                 breaker.record_failure()
                 _P_UPSTREAM_FAILURES.labels("rest").inc()
-            raise BackendTimeoutError(
-                f"model server timed out after {timeout:.1f}s") \
-                from None
-        except Exception as e:  # noqa: BLE001 — transport failure
+                raise BackendDownError(str(e)) from None
             self._close_box(box)
-            breaker.record_failure()
-            _P_UPSTREAM_FAILURES.labels("rest").inc()
-            raise BackendDownError(str(e)) from None
-        self._close_box(box)
-        breaker.record_success()
-        return result
+            breaker.record_success()
+            outcome = "ok"
+            return result
+        finally:
+            self._record_upstream_span(ep, child, leg, t0, outcome)
 
     @staticmethod
     def _close_box(box: Dict[str, Any]) -> None:
@@ -1238,11 +1313,11 @@ class InferProxyHandler(ProxyHandler):
 
         legs: Dict[Any, Any] = {}  # task -> (ep, box, started_at)
 
-        def spawn(ep: Endpoint):
+        def spawn(ep: Endpoint, leg: str):
             box: Dict[str, Any] = {}
             task = asyncio.ensure_future(
                 self._raw_unary_fetch(ep, path, payload, deadline,
-                                      box))
+                                      box, leg=leg))
             legs[task] = (ep, box, time.monotonic())
             ep.inflight += 1
             return task
@@ -1250,7 +1325,7 @@ class InferProxyHandler(ProxyHandler):
         hedged = False
         winner = None
         try:
-            spawn(primary)
+            spawn(primary, "primary")
             done, _ = await asyncio.wait(
                 set(legs), timeout=min(p95, remaining))
             if not done:
@@ -1266,11 +1341,12 @@ class InferProxyHandler(ProxyHandler):
                         TRACER.record(
                             "router_hedge", "router",
                             time.monotonic(), 0.0,
-                            {"model": name,
-                             "primary": primary.address,
-                             "hedge": hedge_ep.address,
-                             "delay_ms": round(p95 * 1e3, 1)})
-                    spawn(hedge_ep)
+                            span_args(self._obs_ctx,
+                                      model=name,
+                                      primary=primary.address,
+                                      hedge=hedge_ep.address,
+                                      delay_ms=round(p95 * 1e3, 1)))
+                    spawn(hedge_ep, "hedge")
                 elif hedge_ep is not None:
                     _P_HEDGES.labels("suppressed").inc()
             pending = {t for t in legs if not t.done()}
@@ -1383,7 +1459,8 @@ class InferProxyHandler(ProxyHandler):
         path += ":generate"
         outcome = await self._stream_leg(
             ep, path, upstream_body, deadline, relay,
-            abort_non_200=split_fallback)
+            abort_non_200=split_fallback,
+            leg="decode" if split_fallback else None)
         if outcome == "rejected":
             # Split hop 2 rejected the handoff (version skew, a
             # replica mid-rollout): nothing reached the client yet, so
@@ -1416,14 +1493,20 @@ class InferProxyHandler(ProxyHandler):
             if TRACER.enabled:
                 TRACER.record(
                     "router_stream_resume", "router", time.monotonic(),
-                    0.0, {"model": name, "from": tried[-1].address,
-                          "to": peer.address,
-                          "emitted": relay.total_emitted()})
+                    0.0, span_args(getattr(self, "_obs_ctx", None),
+                                   **{"from": tried[-1].address},
+                                   model=name, to=peer.address,
+                                   emitted=relay.total_emitted()))
             peer.inflight += 1
             try:
+                # The resume replay is a LEG of the original request —
+                # the child context keeps the client's trace id (and
+                # X-Request-Id) on the peer, so kill+resume still
+                # yields exactly one trace fleet-wide.
                 outcome = await self._stream_leg(
                     peer, relay.resume_path(name, version), resume_body,
-                    deadline, relay, abort_non_200=True)
+                    deadline, relay, abort_non_200=True,
+                    leg=f"resume-{relay.legs}")
             except (CircuitOpenError, BackendTimeoutError,
                     BackendDownError):
                 outcome = "dead"  # this peer was no good; try another
@@ -1469,7 +1552,8 @@ class InferProxyHandler(ProxyHandler):
                           upstream_body: Dict[str, Any],
                           deadline: Optional[float],
                           relay: "_StreamRelay",
-                          abort_non_200: bool = False) -> str:
+                          abort_non_200: bool = False,
+                          leg: Optional[str] = None) -> str:
         """One upstream hop of a (possibly multi-leg) relayed stream.
         Returns ``done`` (upstream completed; the caller finishes the
         client stream), ``dead`` (mid-stream failure or stall after
@@ -1480,14 +1564,46 @@ class InferProxyHandler(ProxyHandler):
         errors only while NOTHING has been written to the client, so
         the shared failover loop keeps its contract; raises _Handled
         when the DOWNSTREAM client is gone."""
+        ctx = getattr(self, "_obs_ctx", None)
+        # Streams are infer hops by construction: a leg-less first
+        # placement still gets a named upstream window ("primary").
+        leg = leg or "primary"
+        child = ctx.child(leg) if ctx is not None else None
+        t0 = time.monotonic()
+        # Whatever way the leg ends (done / dead / rejected /
+        # transport raise / client gone), its upstream window joins
+        # the waterfall with its REAL outcome — a kill+resume trace
+        # must show the dead leg as dead (and tail sampling's
+        # RETAIN_OUTCOMES must keep exactly these failure legs).
+        outcome = "error"
+        try:
+            result = await self._stream_leg_inner(
+                ep, path, upstream_body, deadline, relay,
+                abort_non_200, child)
+            outcome = {"done": "ok", "rejected": "rejected"}.get(
+                result, "error")
+            return result
+        except _Handled:
+            # The client response is settled (stream finished, or the
+            # DOWNSTREAM client went away) — this leg did its job.
+            outcome = "client_gone" if relay.client_gone else "ok"
+            raise
+        finally:
+            self._record_upstream_span(ep, child, leg, t0, outcome)
+
+    async def _stream_leg_inner(self, ep: Endpoint, path: str,
+                                upstream_body: Dict[str, Any],
+                                deadline: Optional[float],
+                                relay: "_StreamRelay",
+                                abort_non_200: bool,
+                                child) -> str:
         import asyncio
 
         breaker = ep.rest_breaker
         if not breaker.allow():
             _P_RETRY_AFTER.labels("rest").inc()
             raise CircuitOpenError(breaker.retry_after_s())
-        headers = dict(self._obs_ctx.headers()) \
-            if getattr(self, "_obs_ctx", None) is not None else {}
+        headers = dict(child.headers()) if child is not None else {}
         headers.update(self.tenant_headers())
         timeout = STREAM_TIMEOUT_S
         remaining = overload.remaining_s(deadline)
@@ -1682,6 +1798,7 @@ class InferProxyHandler(ProxyHandler):
         try:
             response = await self._rest_fetch(
                 prefill_ep, path, deadline=deadline, method="POST",
+                leg="prefill",
                 headers=budget_headers(), body=json.dumps(hop1))
         except (CircuitOpenError, BackendTimeoutError,
                 BackendDownError):
@@ -1729,8 +1846,10 @@ class InferProxyHandler(ProxyHandler):
         if TRACER.enabled:
             TRACER.record(
                 "router_kv_handoff", "router", time.monotonic(), 0.0,
-                {"model": name, "prefill": prefill_ep.address,
-                 "decode": decode_ep.address, "rows": len(handoffs)})
+                span_args(self._obs_ctx, model=name,
+                          prefill=prefill_ep.address,
+                          decode=decode_ep.address,
+                          rows=len(handoffs)))
         if wants_stream:
             hop2["stream"] = True
             decode_ep.inflight += 1
@@ -1757,6 +1876,7 @@ class InferProxyHandler(ProxyHandler):
         try:
             response = await self._rest_fetch(
                 decode_ep, path, deadline=deadline, method="POST",
+                leg="decode",
                 headers=budget_headers(), body=json.dumps(hop2))
         except (CircuitOpenError, BackendTimeoutError,
                 BackendDownError):
@@ -1779,6 +1899,12 @@ class InferProxyHandler(ProxyHandler):
     async def _infer(self, name: str, version: Optional[str],
                      verb: str) -> None:
         self._obs_model = name
+        # Tenant label on the request-root span (ISSUE 15 satellite):
+        # capped through the shared TenantLabelCapper, so waterfalls
+        # filter by tenant without a key-sprayer exploding span
+        # cardinality.
+        self._obs_tenant = tenancy.tenant_label(
+            tenancy.tenant_from_headers(self.request.headers))
         try:
             body = json.loads(self.request.body or b"{}")
         except json.JSONDecodeError:

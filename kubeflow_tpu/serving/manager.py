@@ -554,11 +554,13 @@ class ServedModel:
         return self._latency.estimate_s() * (depth / self.max_batch + 1.0)
 
     def _span_args(self, obs_ctx, outcome: str, **extra):
-        args = {"model": self.name, "outcome": outcome, **extra}
-        if obs_ctx is not None:
-            args["request_id"] = obs_ctx.request_id
-            args["trace_id"] = obs_ctx.trace_id
-        return args
+        # span_args carries the request/trace ids plus parent_id (the
+        # transport root span's id) so the fleet collector can hang
+        # the manager trio under the right hop of the waterfall.
+        from kubeflow_tpu.obs.tracing import span_args
+
+        return span_args(obs_ctx, model=self.name, outcome=outcome,
+                         **extra)
 
     def _decode_cost(self, signature_name, method, version) -> int:
         """Requested decode budget for the tenant token bucket: the
@@ -779,7 +781,8 @@ class ServedModel:
                         version: Optional[int], *,
                         deadline: Optional[float] = None,
                         tenant: str = "",
-                        max_new_tokens: Optional[int] = None):
+                        max_new_tokens: Optional[int] = None,
+                        obs_ctx=None):
         """Prefill-only execution (role-split routing's first hop):
         run each request row's prompt prefill and return ``(loaded,
         [PrefillHandoff per row])`` WITHOUT taking a decode slot —
@@ -819,7 +822,8 @@ class ServedModel:
         rngs = loaded.request_rngs(n)
         return loaded, [
             engine.run_prefill(x[i], rng=rngs[i],
-                               max_new_tokens=max_new_tokens)
+                               max_new_tokens=max_new_tokens,
+                               obs_ctx=obs_ctx)
             for i in range(n)]
 
     def submit_handoff(self, handoffs, version: Optional[int], *,

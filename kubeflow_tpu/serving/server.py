@@ -253,6 +253,10 @@ class InferHandler(BaseHandler):
             self._tenant = tenancy.tenant_from_headers(
                 self.request.headers,
                 getattr(self.manager, "tenancy", None))
+            # Tenant + model labels ride the request-root span
+            # (capped: TenantLabelCapper) so waterfalls filter by
+            # tenant (ISSUE 15 satellite).
+            self._obs_tenant = tenancy.tenant_label(self._tenant)
             body = json.loads(self.request.body or b"{}")
             instances = body.get("instances")
             handoffs_b64 = body.get("handoffs")
@@ -462,7 +466,8 @@ class InferHandler(BaseHandler):
         work = loop.run_in_executor(
             None, lambda: model.prefill_handoff(
                 inputs, sig_name, version, deadline=deadline,
-                tenant=self._tenant, max_new_tokens=max_new))
+                tenant=self._tenant, max_new_tokens=max_new,
+                obs_ctx=self._obs_ctx))
         try:
             loaded, handoffs = await asyncio.wait_for(
                 asyncio.shield(work),

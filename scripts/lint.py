@@ -540,6 +540,107 @@ def check_metric_label_discipline() -> list:
     return errors
 
 
+# Span names allowed to be recorded with an inline args dict that
+# carries no parent linkage: DOCUMENTED ROOTS. batch_execute links N
+# requests via args.batch (docs/observability.md "Batch linkage");
+# engine_slice / engine_compile are engine-timeline records no single
+# request owns (requests join them via their own engine_request
+# attribution); process_name is Chrome-trace metadata.
+DOCUMENTED_ROOT_SPANS = {"batch_execute", "engine_slice",
+                         "engine_compile", "process_name"}
+
+
+def check_span_discipline() -> list:
+    """Every serving/engine code path that mints a span must set a
+    parent or be a documented root (ISSUE 15): a ``TRACER.record``
+    whose args are an inline dict with no ``parent_id``/``trace_id``
+    produces a span the fleet assembly can never hang under a request
+    — invisible in every waterfall. Compliance = route the args
+    through :func:`obs.tracing.span_args` (or a ``_span_args``
+    helper, which the enclosing function must call), or record a
+    name from :data:`DOCUMENTED_ROOT_SPANS`."""
+    targets = [
+        *sorted((REPO / "kubeflow_tpu" / "serving").glob("*.py")),
+        *sorted((REPO / "kubeflow_tpu" / "inference"
+                 / "engine").glob("*.py")),
+        REPO / "kubeflow_tpu" / "obs" / "exposition.py",
+        REPO / "kubeflow_tpu" / "dashboard" / "server.py",
+    ]
+
+    def is_span_args_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        func = node.func
+        name = (func.id if isinstance(func, ast.Name)
+                else func.attr if isinstance(func, ast.Attribute)
+                else "")
+        return name.endswith("span_args")
+
+    errors = []
+    for f in targets:
+        tree = ast.parse(f.read_text(), str(f))
+        # Enclosing-function spans: a record() whose args ride a
+        # variable is fine when the function visibly builds them via
+        # a span_args helper.
+        func_spans = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                has_helper = any(is_span_args_call(n)
+                                 for n in ast.walk(node))
+                func_spans.append((node.lineno, node.end_lineno,
+                                   has_helper))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "record"):
+                continue
+            base = node.func.value
+            base_name = (base.id if isinstance(base, ast.Name)
+                         else base.attr
+                         if isinstance(base, ast.Attribute) else "")
+            if base_name != "TRACER":
+                continue
+            span_name = (node.args[0].value
+                         if node.args
+                         and isinstance(node.args[0], ast.Constant)
+                         else None)
+            if span_name in DOCUMENTED_ROOT_SPANS:
+                continue
+            args_expr = (node.args[4] if len(node.args) > 4 else None)
+            for kw in node.keywords:
+                if kw.arg in ("args",):
+                    args_expr = kw.value
+            if args_expr is not None and is_span_args_call(args_expr):
+                continue
+            if isinstance(args_expr, ast.Dict):
+                keys = {k.value for k in args_expr.keys
+                        if isinstance(k, ast.Constant)}
+                if {"parent_id", "trace_id"} & keys:
+                    continue
+                errors.append(
+                    f"span-discipline: {f.relative_to(REPO)}:"
+                    f"{node.lineno}: TRACER.record({span_name!r}) "
+                    f"with an inline args dict carrying no parent/"
+                    f"trace linkage — build args via obs.tracing."
+                    f"span_args (or document the span in lint.py "
+                    f"DOCUMENTED_ROOT_SPANS)")
+                continue
+            # Variable/other args: accept when the enclosing function
+            # demonstrably builds span args through the helper.
+            enclosing_ok = any(
+                lo <= node.lineno <= hi and has_helper
+                for lo, hi, has_helper in func_spans)
+            if not enclosing_ok:
+                errors.append(
+                    f"span-discipline: {f.relative_to(REPO)}:"
+                    f"{node.lineno}: TRACER.record({span_name!r}) in "
+                    f"a function that never calls span_args — every "
+                    f"serving/engine span must set a parent or be a "
+                    f"documented root (DOCUMENTED_ROOT_SPANS)")
+    return errors
+
+
 def check_unused_imports() -> list:
     errors = []
     for f in iter_py_files():
@@ -605,6 +706,7 @@ def main() -> int:
                   check_serving_timeout_discipline,
                   check_service_print_discipline,
                   check_metric_label_discipline,
+                  check_span_discipline,
                   check_boilerplate, check_license_file):
         found = check()
         print(f"{check.__name__}: {'ok' if not found else f'{len(found)} errors'}")

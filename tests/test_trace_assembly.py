@@ -1,0 +1,706 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Fleet-wide trace assembly + latency attribution (ISSUE 15).
+
+Units: trace-context parent links and leg tags, the SpanStore's caps
+under fuzz (drop-counting, never unbounded), tree assembly and
+attribution over synthetic spans, the export queue + SpanShipper push
+path, and the collector exposition trace endpoints.
+
+E2E: a REAL proxy + two REAL role-split servers + a span-scraping
+collector — unary, SSE, and hedged requests must each assemble into
+ONE trace fleet-wide whose queue/prefill/decode/relay/gap buckets
+cover >=95% of the client-measured wall; kill+resume (fault-injected,
+slow tier) keeps one trace id across the resume leg."""
+
+import json
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.obs import tracing
+from kubeflow_tpu.obs import trace as obs_trace
+from kubeflow_tpu.obs.collector import (
+    Collector,
+    SpanShipper,
+    SpanStore,
+    TimeSeriesStore,
+)
+from kubeflow_tpu.models.llama import llama_test
+from kubeflow_tpu.scaling.endpoints import EndpointPool
+from kubeflow_tpu.serving import wire
+
+PROMPT_LEN = 8
+NEW_TOKENS = 6
+CACHE = 32
+
+
+# --- trace context: parent links + leg tags --------------------------------
+
+def test_child_context_parents_and_legs():
+    ctx = tracing.new_context()
+    child = ctx.child("hedge")
+    assert child.trace_id == ctx.trace_id
+    assert child.parent_span_id == ctx.span_id
+    assert child.span_id != ctx.span_id
+    assert child.leg == "hedge"
+    # leg=None inherits; a fresh tag overrides.
+    assert child.child().leg == "hedge"
+    assert child.child("resume-1").leg == "resume-1"
+
+
+def test_from_headers_mints_hop_span_with_parent():
+    ctx = tracing.new_context()
+    hop = tracing.from_headers(ctx.child("decode").headers())
+    assert hop.trace_id == ctx.trace_id
+    # The inbound span id is the CALLER's: this hop's parent, never
+    # its own id (one tree node per hop).
+    assert hop.parent_span_id is not None
+    assert hop.span_id != hop.parent_span_id
+    assert hop.leg == "decode"
+
+
+def test_grpc_metadata_round_trips_leg():
+    ctx = tracing.new_context().child("primary")
+    back = tracing.from_grpc_metadata(ctx.grpc_metadata())
+    assert back.trace_id == ctx.trace_id
+    assert back.parent_span_id == ctx.span_id
+    assert back.leg == "primary"
+
+
+def test_span_args_linkage():
+    ctx = tracing.new_context().child("prefill")
+    args = tracing.span_args(ctx, model="m", outcome="ok")
+    assert args["trace_id"] == ctx.trace_id
+    assert args["parent_id"] == ctx.span_id
+    assert args["leg"] == "prefill"
+    assert args["model"] == "m"
+    # No context → just the extras (a documented root's shape).
+    assert tracing.span_args(None, model="m") == {"model": "m"}
+
+
+# --- SpanStore: bounded, dedup, drop-counted -------------------------------
+
+def _span(trace_id, name="s", ts=None, dur=1000.0, pid=1, tid=1,
+          **args):
+    return {"name": name, "cat": "t", "ph": "X",
+            "ts": ts if ts is not None else random.random() * 1e9,
+            "dur": dur, "pid": pid, "tid": tid,
+            "args": {"trace_id": trace_id, **args}}
+
+
+def test_span_store_caps_fuzz():
+    rng = random.Random(7)
+    store = SpanStore(max_traces=8, max_spans_per_trace=16)
+    for _ in range(3000):
+        trace_id = f"t{rng.randrange(40):02d}"
+        store.ingest([_span(trace_id, ts=rng.random() * 1e9,
+                            tid=rng.randrange(4))])
+        state = store.state()
+        assert state["traces"] <= 8
+        assert state["spans"] <= 8 * 16
+    state = store.state()
+    assert state["evicted_traces"] > 0
+    assert state["ingested"] > 0
+    # Per-trace overflow is COUNTED, never stored — and counted ONCE:
+    # a rescrape of the same overlapping ring must not re-inflate the
+    # drop counter (the cap-discipline signal would become noise).
+    store2 = SpanStore(max_traces=2, max_spans_per_trace=4)
+    batch = [_span("hot", ts=float(i)) for i in range(10)]
+    ingested, dropped = store2.ingest(batch)
+    assert (ingested, dropped) == (4, 6)
+    assert store2.dropped_spans == 6
+    assert store2.ingest(batch) == (0, 0)
+    assert store2.dropped_spans == 6
+
+
+def test_span_store_dedups_rescrape_and_matches_request_id():
+    store = SpanStore()
+    span = _span("abc123", name="http_request", ts=42.0,
+                 request_id="req-9")
+    assert store.ingest([span], instance="a:1") == (1, 0)
+    # The same ring scraped twice (or once via scrape + once via
+    # push) must not double the trace.
+    assert store.ingest([span], instance="b:2") == (0, 0)
+    assert len(store.trace("abc123")) == 1
+    # request-id lookup (the access-log join key a human holds).
+    assert store.trace("req-9")[0]["args"]["instance"] == "a:1"
+    assert store.trace_ids()[0]["trace_id"] == "abc123"
+
+
+# --- assembly + attribution over synthetic spans ---------------------------
+
+def _synthetic_trace():
+    """A role-split request's shape: proxy root, one proxy-side
+    upstream window per hop, server legs under the windows, engine
+    spans under the server legs."""
+    t = "f" * 32
+    spans = [
+        _span(t, name="proxy_request", ts=0.0, dur=100_000.0,
+              span_id="p" * 16, model="m"),
+        _span(t, name="proxy_upstream", ts=1.0, dur=32_000.0,
+              span_id="u" * 16, parent_id="p" * 16, leg="prefill"),
+        _span(t, name="proxy_upstream", ts=2.0, dur=52_000.0,
+              span_id="v" * 16, parent_id="p" * 16, leg="decode"),
+        _span(t, name="http_request", ts=0.0, dur=30_000.0, pid=2,
+              span_id="a" * 16, parent_id="u" * 16, leg="prefill"),
+        _span(t, name="engine_prefill", ts=1.0, dur=25_000.0, pid=2,
+              parent_id="a" * 16, leg="prefill", handoff=True),
+        _span(t, name="http_request", ts=0.0, dur=50_000.0, pid=3,
+              span_id="b" * 16, parent_id="v" * 16, leg="decode"),
+        _span(t, name="engine_request", ts=2.0, dur=45_000.0, pid=3,
+              parent_id="b" * 16, leg="decode", queue_ms=5.0,
+              prefill_ms=1.0, decode_ms=40.0),
+    ]
+    return t, spans
+
+
+def test_assemble_tree_shape():
+    _, spans = _synthetic_trace()
+    assembled = obs_trace.assemble(spans)
+    assert len(assembled["roots"]) == 1
+    root = assembled["roots"][0]
+    assert root["span"]["name"] == "proxy_request"
+    hops = {c["span"]["args"]["leg"]: c for c in root["children"]}
+    assert set(hops) == {"prefill", "decode"}
+    for leg, hop in hops.items():
+        assert hop["span"]["name"] == "proxy_upstream"
+        (server,) = hop["children"]
+        assert server["span"]["name"] == "http_request"
+        assert server["span"]["args"]["leg"] == leg
+    assert hops["prefill"]["children"][0]["children"][0]["span"][
+        "name"] == "engine_prefill"
+    assert hops["decode"]["children"][0]["children"][0]["span"][
+        "name"] == "engine_request"
+
+
+def test_attribution_buckets_cover_wall():
+    _, spans = _synthetic_trace()
+    report = obs_trace.attribution(spans)
+    b = report["buckets"]
+    assert report["total_ms"] == 100.0
+    assert b["queue_ms"] == 5.0
+    # hop1's slot-less prefill (handoff=True) + hop2's adopt.
+    assert b["prefill_ms"] == 26.0
+    assert b["decode_ms"] == 40.0
+    # relay is MEASURED: proxy wall minus its upstream windows.
+    assert b["relay_ms"] == 16.0
+    # gap = per-leg network gaps (2 + 2) + server residual (80 - 71).
+    assert b["gap_ms"] == 13.0
+    assert report["coverage"] == 1.0
+    assert report["legs"] == {"decode": 50.0, "prefill": 30.0}
+    assert report["upstream_legs"] == {"decode": 52.0,
+                                       "prefill": 32.0}
+    assert report["missing"] == []
+    # An upstream window whose server was never scraped is NOT
+    # covered: coverage drops and the leg lands in missing — the
+    # signal the assembly layer owes.
+    partial = [s for s in spans
+               if not (s["name"] in ("http_request", "engine_request")
+                       and s["args"].get("leg") == "decode")]
+    partial_report = obs_trace.attribution(partial)
+    assert partial_report["coverage"] < 0.95
+    assert "server_leg:decode" in partial_report["missing"]
+
+
+def test_attribution_direct_to_server():
+    t = "e" * 32
+    spans = [
+        _span(t, name="http_request", ts=0.0, dur=40_000.0,
+              span_id="a" * 16),
+        _span(t, name="queue_wait", ts=0.0, dur=8_000.0,
+              parent_id="a" * 16),
+        _span(t, name="execute", ts=1.0, dur=30_000.0,
+              parent_id="a" * 16),
+    ]
+    report = obs_trace.attribution(spans)
+    assert report["total_ms"] == 40.0
+    assert report["buckets"]["queue_ms"] == 8.0
+    assert report["buckets"]["decode_ms"] == 30.0
+    assert report["buckets"]["relay_ms"] == 0.0
+    assert report["buckets"]["gap_ms"] == 2.0
+    assert report["coverage"] == 1.0
+    assert "proxy_request" in report["missing"]
+
+
+# --- export queue + shipper (push path) ------------------------------------
+
+def test_tracer_export_queue_bounded_with_pressure_hook():
+    tr = tracing.Tracer(capacity=64)
+    tr.enable_export(8)
+    fired = []
+    tr.on_export_pressure = lambda: fired.append(True)
+    for i in range(20):
+        tr.record("x", "c", float(i), 0.1, {"trace_id": "t" * 32})
+    stats = tr.export_stats()
+    assert stats["queued"] == 8  # bounded
+    assert stats["dropped"] == 12  # counted, never unbounded
+    assert fired  # pressure hook woke the shipper
+    assert len(tr.drain_export()) == 8
+    assert tr.export_stats() == {"queued": 0, "dropped": 12}
+    tr.disable_export()
+    tr.record("x", "c", 0.0, 0.1, {"trace_id": "t" * 32})
+    assert tr.drain_export() == []
+
+
+def test_span_shipper_posts_batches():
+    tr = tracing.Tracer(capacity=64)
+    posts = []
+    shipper = SpanShipper(tr, "127.0.0.1:9", component="unit",
+                          post=lambda url, body: posts.append(
+                              (url, json.loads(body))))
+    tr.enable_export(32)
+    for i in range(5):
+        tr.record("y", "c", float(i), 0.1, {"trace_id": "a" * 32})
+    assert shipper.ship_once() == 5
+    (url, doc), = posts
+    assert url.endswith("/spans")
+    assert doc["component"] == "unit"
+    assert len(doc["spans"]) == 5
+    # A dead collector drops the batch and counts the failure.
+    def boom(url, body):
+        raise OSError("refused")
+    shipper._post = boom
+    tr.record("y", "c", 9.0, 0.1, {"trace_id": "a" * 32})
+    assert shipper.ship_once() == 0
+    assert shipper.failed_posts == 1
+
+
+def test_exposition_trace_endpoints_and_push():
+    import urllib.request
+
+    from kubeflow_tpu.obs.exposition import start_exposition_server
+
+    store = SpanStore()
+    server = start_exposition_server(0, span_store=store,
+                                     host="127.0.0.1")
+    port = server.server_address[1]
+    try:
+        _, spans = _synthetic_trace()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/spans",
+            data=json.dumps({"component": "unit",
+                             "spans": spans}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert json.loads(resp.read())["ingested"] == len(spans)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/traces", timeout=5) as resp:
+            doc = json.loads(resp.read())
+        assert doc["traces"][0]["spans"] == len(spans)
+        trace_id = doc["traces"][0]["trace_id"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/trace?trace_id={trace_id}",
+                timeout=5) as resp:
+            doc = json.loads(resp.read())
+        assert doc["attribution"]["coverage"] == 1.0
+        # The kft-trace CLI speaks exactly this surface.
+        rc = obs_trace.main([trace_id,
+                             "--collector", f"127.0.0.1:{port}"])
+        assert rc == 0
+    finally:
+        server.shutdown()
+
+
+# --- engine cold-start profile: compile events + slice records -------------
+
+def test_engine_cold_start_emits_compile_and_slice_spans():
+    from kubeflow_tpu.inference.engine import DecodeEngine, EngineConfig
+
+    model = llama_test(dtype=jnp.float32, cache_size=CACHE)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, PROMPT_LEN), jnp.int32))
+    engine = DecodeEngine(model, variables["params"], EngineConfig(
+        max_new_tokens=NEW_TOKENS, max_prompt_len=PROMPT_LEN,
+        temperature=0.8, num_slots=2, page_size=4, slice_tokens=2,
+        seed=0), name="trace-asm-cold")
+    ctx = tracing.new_context()
+    try:
+        engine.submit(np.asarray([3, 4, 5], np.int32),
+                      obs_ctx=ctx).result(timeout=120)
+    finally:
+        engine.stop()
+    spans = [s for s in tracing.TRACER.snapshot()
+             if (s.get("args") or {}).get("model") == "trace-asm-cold"]
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    # Cold start: the prefill and first decode slice are jit traces.
+    compiles = {s["args"]["program"]: s["args"]
+                for s in by_name.get("engine_compile", ())}
+    assert "prefill" in compiles
+    assert "decode_slice" in compiles
+    # A request-triggered compile joins THAT request's trace — the
+    # cold-start waterfall contains its compile events.
+    assert compiles["prefill"]["trace_id"] == ctx.trace_id
+    # Per-slice structured profile records.
+    slice_span = by_name["engine_slice"][0]
+    assert slice_span["args"]["slots"] >= 1
+    assert slice_span["args"]["steps"] >= 1
+    assert "free_pages" in slice_span["args"]
+    # Per-request attribution triple, linked to the request's trace.
+    req_span = by_name["engine_request"][0]
+    assert req_span["args"]["trace_id"] == ctx.trace_id
+    assert req_span["args"]["parent_id"] == ctx.span_id
+    for key in ("queue_ms", "prefill_ms", "decode_ms"):
+        assert req_span["args"][key] >= 0.0
+    assert req_span["args"]["decode_ms"] > 0.0
+    stats = engine.stats()
+    assert stats["slices"] >= 1
+    assert stats["compiled_programs"] >= 2
+
+
+# --- multi-process-shaped e2e: proxy + 2 role servers + collector ----------
+
+@pytest.fixture(scope="module")
+def trace_stack(tmp_path_factory):
+    """The role_stack harness (test_role_routing) + a hedging proxy
+    and the span-scraping collector targets."""
+    import asyncio
+
+    from kubeflow_tpu.serving.export import export_model
+    from kubeflow_tpu.serving.manager import ModelManager
+    from kubeflow_tpu.serving.signature import (
+        ModelMetadata,
+        Signature,
+        TensorSpec,
+    )
+
+    base = tmp_path_factory.mktemp("trace") / "m"
+    model = llama_test(dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, PROMPT_LEN), jnp.int32))
+    meta = ModelMetadata(
+        model_name="m", registry_name="llama-test",
+        model_kwargs={"dtype": "float32", "cache_size": CACHE},
+        signatures={"serving_default": Signature(
+            "generate",
+            {"input_ids": TensorSpec("int32", (-1, PROMPT_LEN))},
+            {"tokens": TensorSpec("int32", (-1, NEW_TOKENS))})},
+        generate_config={"max_new_tokens": NEW_TOKENS,
+                         "temperature": 0.8, "seed": 11,
+                         "deterministic": True,
+                         "engine_slots": 2, "engine_page_size": 8,
+                         "engine_slice_tokens": 2})
+    export_model(str(base), 1, meta, {"params": variables["params"]})
+
+    from kubeflow_tpu.serving.http_proxy import make_app as proxy_app
+    from kubeflow_tpu.serving.server import make_app as rest_app
+
+    managers, holders = [], []
+
+    def serve(factory, holder, started):
+        import tornado.ioloop
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        server = factory().listen(0)
+        holder["port"] = next(iter(
+            server._sockets.values())).getsockname()[1]
+        holder["loop"] = tornado.ioloop.IOLoop.current()
+        started.set()
+        holder["loop"].start()
+
+    for role in ("prefill", "decode"):
+        mgr = ModelManager(poll_interval_s=3600)
+        mgr.add_model("m", str(base), max_batch=4,
+                      continuous_batching=True)
+        managers.append(mgr)
+        holder, started = {"role": role}, threading.Event()
+        threading.Thread(
+            target=serve,
+            args=(lambda m=mgr, r=role: rest_app(m, role=r), holder,
+                  started),
+            daemon=True).start()
+        assert started.wait(60)
+        holders.append(holder)
+
+    pool = EndpointPool()
+    for holder in holders:
+        pool.add(f"127.0.0.1:{holder['port']}", None, holder["role"])
+    proxy, started = {}, threading.Event()
+    threading.Thread(
+        target=serve,
+        args=(lambda: proxy_app(pool=pool, balancer="role",
+                                probe_interval_s=3600.0), proxy,
+              started),
+        daemon=True).start()
+    assert started.wait(60)
+
+    # A second, hedging proxy over the SAME two servers (round-robin
+    # ignores roles; both replicas serve full generates).
+    hedge_pool = EndpointPool()
+    for holder in holders:
+        hedge_pool.add(f"127.0.0.1:{holder['port']}", None, "any")
+    hedge_holder, started = {}, threading.Event()
+    hedge_app_box = {}
+
+    def hedge_factory():
+        app = proxy_app(pool=hedge_pool, balancer="round_robin",
+                        probe_interval_s=3600.0, hedge_rate=1.0)
+        hedge_app_box["app"] = app
+        return app
+
+    threading.Thread(target=serve,
+                     args=(hedge_factory, hedge_holder, started),
+                     daemon=True).start()
+    assert started.wait(60)
+
+    targets = [(f"127.0.0.1:{h['port']}", "serving")
+               for h in holders]
+    targets.append((f"127.0.0.1:{proxy['port']}", "router"))
+    targets.append((f"127.0.0.1:{hedge_holder['port']}", "router"))
+    yield {"base": base, "proxy": proxy, "holders": holders,
+           "managers": managers, "pool": pool, "targets": targets,
+           "hedge": hedge_holder, "hedge_app": hedge_app_box}
+    for holder in holders + [proxy, hedge_holder]:
+        holder["loop"].add_callback(holder["loop"].stop)
+    for mgr in managers:
+        mgr.stop()
+
+
+def _collect_trace(stack, trace_id, want_names, timeout=15):
+    """Scrape the fleet until the trace holds ``want_names``."""
+    collector = Collector(TimeSeriesStore(),
+                          static_targets=stack["targets"],
+                          span_store=SpanStore(max_traces=64))
+    try:
+        deadline = time.monotonic() + timeout
+        spans = []
+        while time.monotonic() < deadline:
+            collector.scrape_once()
+            spans = collector.span_store.trace(trace_id)
+            if want_names <= {s["name"] for s in spans}:
+                return spans
+            time.sleep(0.2)
+        names = {s["name"] for s in spans}
+        raise AssertionError(
+            f"trace {trace_id} never assembled {want_names - names}; "
+            f"got {sorted(names)}")
+    finally:
+        collector.stop()
+
+
+def _one_trace_fleetwide(request_id, trace_id):
+    """The continuity regression: every span this request produced —
+    whatever leg it rode — carries ONE trace id."""
+    seen = {(s.get("args") or {}).get("trace_id")
+            for s in tracing.TRACER.snapshot()
+            if (s.get("args") or {}).get("request_id") == request_id}
+    seen.discard(None)
+    assert seen == {trace_id}, f"fleet-wide trace ids: {seen}"
+
+
+def _post_generate(port, body, headers=None, timeout=120):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/model/m:generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def test_unary_split_assembles_one_trace_with_attribution(trace_stack):
+    ctx = tracing.new_context(request_id="trace-asm-unary")
+    out = _post_generate(trace_stack["proxy"]["port"],
+                         {"instances": [[7] * PROMPT_LEN]},
+                         headers=ctx.headers())
+    assert out["predictions"][0]["tokens"]
+    spans = _collect_trace(
+        trace_stack, ctx.trace_id,
+        {"proxy_request", "proxy_upstream", "http_request",
+         "engine_request", "engine_prefill"})
+    _one_trace_fleetwide("trace-asm-unary", ctx.trace_id)
+    # Tree shape: one proxy root; both split hops hang under it as
+    # leg-tagged upstream windows, each carrying its server span.
+    assembled = obs_trace.assemble(spans)
+    roots = [r for r in assembled["roots"]
+             if r["span"]["name"] == "proxy_request"]
+    assert len(roots) == 1
+    hops = {c["span"]["args"].get("leg"): c
+            for c in roots[0]["children"]
+            if c["span"]["name"] == "proxy_upstream"}
+    assert {"prefill", "decode"} <= set(hops)
+    for leg in ("prefill", "decode"):
+        server_children = [n for n in hops[leg]["children"]
+                           if n["span"]["name"] == "http_request"]
+        assert server_children, f"{leg} hop has no server span"
+    # Attribution: buckets cover >=95% of the client-measured wall
+    # (the acceptance bar), with real prefill and decode time.
+    report = obs_trace.attribution(spans)
+    assert report["coverage"] >= 0.95
+    assert report["buckets"]["prefill_ms"] > 0.0
+    assert report["buckets"]["decode_ms"] > 0.0
+    assert report["missing"] == []
+
+
+def test_sse_split_stream_assembles_one_trace(trace_stack):
+    import http.client
+
+    ctx = tracing.new_context(request_id="trace-asm-sse")
+    conn = http.client.HTTPConnection(
+        "127.0.0.1", trace_stack["proxy"]["port"], timeout=120)
+    conn.request(
+        "POST", "/model/m:generate",
+        body=json.dumps({"instances": [[2, 3, 4, 5]],
+                         "stream": True}),
+        headers={"Content-Type": "application/json",
+                 **ctx.headers()})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    done = None
+    for event, data in wire.iter_sse_events(resp):
+        if event == "done":
+            done = data
+    conn.close()
+    assert done is not None
+    spans = _collect_trace(
+        trace_stack, ctx.trace_id,
+        {"proxy_request", "http_request", "engine_request"})
+    _one_trace_fleetwide("trace-asm-sse", ctx.trace_id)
+    legs = {(s.get("args") or {}).get("leg") for s in spans}
+    assert {"prefill", "decode"} <= legs
+    report = obs_trace.attribution(spans)
+    assert report["coverage"] >= 0.95
+    assert report["buckets"]["decode_ms"] > 0.0
+
+
+def test_hedged_twins_share_one_trace_with_distinct_legs(trace_stack):
+    # Prime the hedge window so the delay is ~instant and the twin
+    # always fires (rate cap 1.0; generous budget).
+    app = trace_stack["hedge_app"]["app"]
+    for _ in range(8):
+        app.settings["hedge_latency"].observe(0.0005)
+    ctx = tracing.new_context(request_id="trace-asm-hedge")
+    out = _post_generate(
+        trace_stack["hedge"]["port"],
+        {"instances": [[9] * PROMPT_LEN]},
+        headers={**ctx.headers(), "X-Deadline-Ms": "60000"})
+    assert out["predictions"][0]["tokens"]
+    spans = _collect_trace(trace_stack, ctx.trace_id,
+                           {"proxy_request", "engine_request"})
+    _one_trace_fleetwide("trace-asm-hedge", ctx.trace_id)
+    legs = {(s.get("args") or {}).get("leg") for s in spans}
+    assert "primary" in legs
+    assert "hedge" in legs, f"hedge leg missing; legs={legs}"
+    # Distinct leg-tagged span ids: the twins are separate tree
+    # nodes, one waterfall.
+    parent_ids = {(s.get("args") or {}).get("parent_id")
+                  for s in spans
+                  if s["name"] == "engine_request"}
+    assert len(parent_ids) >= 2
+
+
+# --- kill + resume keeps one trace id (fault-injected, slow tier) ----------
+
+@pytest.mark.slow
+def test_kill_resume_stream_keeps_one_trace_id(trace_stack,
+                                               monkeypatch, tmp_path):
+    """ISSUE 15 satellite regression: one client request through
+    kill+resume produces exactly ONE trace_id fleet-wide, with the
+    resume replay leg-tagged."""
+    import asyncio
+    import http.client
+
+    monkeypatch.setenv("KFT_ENABLE_FAULTS", "1")
+    from kubeflow_tpu.serving.manager import ModelManager
+    from kubeflow_tpu.serving.http_proxy import make_app as proxy_app
+    from kubeflow_tpu.serving.server import make_app as rest_app
+
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps({"rules": [{
+        "match": {"route": "generate", "phase": "stream"},
+        "action": {"kill_after_events": 2},
+    }]}))
+
+    managers, holders = [], []
+    proxy = {}
+
+    def serve(factory, holder, started):
+        import tornado.ioloop
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        server = factory().listen(0)
+        holder["port"] = next(iter(
+            server._sockets.values())).getsockname()[1]
+        holder["loop"] = tornado.ioloop.IOLoop.current()
+        started.set()
+        holder["loop"].start()
+
+    try:
+        for i in range(2):
+            mgr = ModelManager(poll_interval_s=3600)
+            mgr.add_model("m", str(trace_stack["base"]), max_batch=4,
+                          continuous_batching=True)
+            managers.append(mgr)
+            holder, started = {}, threading.Event()
+            threading.Thread(
+                target=serve,
+                args=(lambda m=mgr: rest_app(m, fault_plan=str(plan)),
+                      holder, started),
+                daemon=True).start()
+            assert started.wait(60)
+            holders.append(holder)
+        pool = EndpointPool()
+        for holder in holders:
+            pool.add(f"127.0.0.1:{holder['port']}", None, "any")
+        proxy, started = {}, threading.Event()
+        threading.Thread(
+            target=serve,
+            args=(lambda: proxy_app(pool=pool, balancer="round_robin",
+                                    probe_interval_s=3600.0), proxy,
+                  started),
+            daemon=True).start()
+        assert started.wait(60)
+
+        ctx = tracing.new_context(request_id="trace-asm-resume")
+        conn = http.client.HTTPConnection("127.0.0.1", proxy["port"],
+                                          timeout=180)
+        conn.request(
+            "POST", "/model/m:generate",
+            body=json.dumps({"instances": [[4] * PROMPT_LEN],
+                             "stream": True}),
+            headers={"Content-Type": "application/json",
+                     **ctx.headers()})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        events = list(wire.iter_sse_events(resp))
+        conn.close()
+        assert any(e == "done" for e, _ in events), events
+        assert not any(e == "error" for e, _ in events), events
+        # Exactly one trace id fleet-wide, resume leg tagged.
+        _one_trace_fleetwide("trace-asm-resume", ctx.trace_id)
+        legs = {(s.get("args") or {}).get("leg")
+                for s in tracing.TRACER.snapshot()
+                if (s.get("args") or {}).get("trace_id")
+                == ctx.trace_id}
+        assert any(str(leg).startswith("resume-") for leg in legs), \
+            f"no resume leg recorded; legs={legs}"
+    finally:
+        for holder in holders + [proxy]:
+            if "loop" in holder:
+                holder["loop"].add_callback(holder["loop"].stop)
+        for mgr in managers:
+            mgr.stop()
