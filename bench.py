@@ -549,9 +549,54 @@ def prefix_main() -> int:
             "evicted_pages": result["prefix_stats"]["evicted_pages"],
             "bitwise_greedy_ok": result["bitwise_greedy_ok"],
             "bitwise_sampled_ok": result["bitwise_sampled_ok"],
+            "prefill_role_hits": result["prefill_role_hits"],
+            "bitwise_handoff_ok": result["bitwise_handoff_ok"],
         },
     }))
     return 0 if result["prefix_wins"] else 1
+
+
+def speculative_main() -> int:
+    """`python bench.py --speculative`: vanilla vs strong-draft vs
+    weak-draft decode engines over one request set (ISSUE 16
+    acceptance: bitwise greedy+sampled under speculation, nonzero
+    acceptance, and < 1 verifier forwards per emitted token). Prints
+    ONE JSON line shaped like the headline bench."""
+    from kubeflow_tpu.utils.platform import sync_platform_from_env
+
+    sync_platform_from_env()
+
+    from kubeflow_tpu.serving.benchmark import (
+        SpeculativeBenchConfig,
+        run_speculative_benchmark,
+    )
+
+    result = run_speculative_benchmark(SpeculativeBenchConfig())
+    cfg = result["config"]
+    print(json.dumps({
+        "metric": "spec_decode_verify_forwards_per_token",
+        "value": result["verify_forwards_per_token"],
+        "unit": (f"verifier forwards per emitted token, strong draft "
+                 f"k={cfg['draft_tokens']} "
+                 f"({cfg['num_requests']} requests x "
+                 f"{cfg['new_tokens']} tokens; vanilla = 1.0)"),
+        "vs_baseline": None,  # the vanilla engine IS the baseline
+        "extra": {
+            "acceptance_rate": result["acceptance_rate"],
+            "weak_acceptance_rate":
+                result["rows"]["weak"]["acceptance_rate"],
+            "sampled_acceptance_rate":
+                result["sampled_acceptance_rate"],
+            "wall_ratio_vs_vanilla": result["wall_ratio_vs_vanilla"],
+            "vanilla_tokens_per_s":
+                result["rows"]["vanilla"]["tokens_per_s"],
+            "strong_tokens_per_s":
+                result["rows"]["strong"]["tokens_per_s"],
+            "bitwise_greedy_ok": result["bitwise_greedy_ok"],
+            "bitwise_sampled_ok": result["bitwise_sampled_ok"],
+        },
+    }))
+    return 0 if result["speculative_wins"] else 1
 
 
 def main() -> int:
@@ -567,6 +612,8 @@ def main() -> int:
         return continuous_main()
     if "--prefix" in sys.argv:
         return prefix_main()
+    if "--speculative" in sys.argv:
+        return speculative_main()
     if "--slo" in sys.argv:
         return slo_main()
     if "--chaos" in sys.argv:

@@ -42,6 +42,24 @@ every slice boundary (mid-decode eviction frees the slot's pages for
 the queue). Obs (r9, extended per-token): time-to-first-token and
 inter-token histograms, slot-occupancy / free-page gauges, per-request
 engine spans.
+
+Speculative decoding (ISSUE 16): with a draft model attached, each
+slice becomes a ROUND — k cheap draft steps propose tokens, ONE
+batched verifier forward scores the whole [t0, d1..dk] block, and the
+longest agreeing prefix is accepted. Targets are sampled from the
+VERIFIER's logits with the slot's own step keys, so the emitted
+stream is bitwise the vanilla stream whatever the drafts say;
+acceptance only decides how many verifier weight-reads that stream
+cost. Speculated K/V is written through the page tables and truncated
+back to the accepted length (``paged_kv.truncate_slot``).
+
+Chunked prefill (ISSUE 16): long prompts admit into a slot in the
+PREFILLING state and feed one page-aligned chunk per engine lap,
+interleaved with decode slices — a 4k-token prompt can no longer
+stall a decode slot beyond one chunk's compute. The same slot-bound
+path now serves ``run_prefill`` in prefix mode, so a prefill-role
+replica registers and hits the r15 prefix index (the documented
+"prefill pool stays cold" limitation is gone).
 """
 
 from __future__ import annotations
@@ -61,6 +79,7 @@ import numpy as np
 from kubeflow_tpu.inference.engine.paged_kv import (
     PagedKVCache,
     _gather_logical,
+    _is_kv,
     _scatter_token_range,
 )
 from kubeflow_tpu.inference.engine.prefix_cache import PrefixMatch
@@ -149,6 +168,24 @@ _M_PAGE_OCC = obs_metrics.Gauge(
     "kft_engine_page_occupancy",
     "Fraction of the KV page pool allocated or reserved "
     "(cached-idle pages count as headroom)", ("model",))
+# Speculative-decode families (ISSUE 16): the acceptance economics
+# the draft lane is judged by. drafted = k per live slot per round;
+# accepted = drafted tokens actually emitted (each one a verifier
+# forward NOT paid); the rate gauge is lifetime accepted/drafted via
+# a render-time callback off the engine's counters.
+_M_SPEC_DRAFTED = obs_metrics.Counter(
+    "kft_engine_spec_drafted_tokens_total",
+    "Draft-model tokens proposed to the verifier", ("model",))
+_M_SPEC_ACCEPTED = obs_metrics.Counter(
+    "kft_engine_spec_accepted_tokens_total",
+    "Drafted tokens accepted and emitted (verifier forwards saved)",
+    ("model",))
+_M_SPEC_REJECTED = obs_metrics.Counter(
+    "kft_engine_spec_rejected_tokens_total",
+    "Drafted tokens discarded at verification", ("model",))
+_M_SPEC_RATE = obs_metrics.Gauge(
+    "kft_engine_spec_acceptance_rate",
+    "Lifetime drafted-token acceptance rate", ("model",))
 
 
 @dataclasses.dataclass
@@ -338,6 +375,14 @@ class _Request:
     #: cache (role-split KV handoff); admission copies the pages in
     #: and decode starts at the first slice.
     handoff: Optional[PrefillHandoff] = None
+    #: Prefill-only (ISSUE 16): the prefix-mode ``run_prefill`` path.
+    #: The request binds a slot, prefills (chunked when configured),
+    #: registers its pages in the prefix index, then retires with a
+    #: :class:`PrefillHandoff` in ``prefill_box`` instead of
+    #: decoding — that slot-bound hop is what finally warms the
+    #: prefill-role pool's index.
+    prefill_only: bool = False
+    prefill_box: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -376,6 +421,16 @@ class EngineConfig:
     #: copy-on-write instead of re-prefilled. Output stays bitwise
     #: equal to cold prefill (greedy + sampled).
     prefix_cache: bool = False
+    #: speculative decoding (ISSUE 16): draft tokens per verify round
+    #: (0 = vanilla decode). Takes effect only when the engine is
+    #: built with a draft model; output stays bitwise vanilla either
+    #: way.
+    speculate_tokens: int = 0
+    #: chunked prefill (ISSUE 16): page-aligned prompt tokens fed per
+    #: engine lap for prompts whose unmatched tail exceeds one chunk
+    #: (0 = one-shot prefill). Prefix-cache mode only — chunks
+    #: accumulate in the pad-0 layout.
+    prefill_chunk: int = 0
 
     @staticmethod
     def from_generate_config(cfg: dict, max_prompt_len: int,
@@ -399,6 +454,8 @@ class EngineConfig:
             queue_capacity=(4096 if queue_capacity is None
                             else int(queue_capacity)),
             prefix_cache=bool(cfg.get("engine_prefix_cache", False)),
+            speculate_tokens=int(cfg.get("engine_draft_tokens", 0)),
+            prefill_chunk=int(cfg.get("engine_prefill_chunk", 0)),
         )
 
 
@@ -473,6 +530,107 @@ def _decode_slice(model, params, physical, tables, write_pos,
     return physical, out.swapaxes(0, 1), last_tok, done
 
 
+def _draft_slice(draft_model, draft_params, draft_cache, tokens,
+                 write_pos, pad_lens, done, step_rngs,
+                 *, temperature, eos_id, top_k, top_p):
+    """k single-token draft steps over the persistent DENSE draft
+    cache (B = num_slots, one row per slot — the draft is small
+    enough that paging it would cost more in gather/scatter than the
+    rows hold). Same step math as :func:`_decode_slice` minus the
+    page plumbing, and sampled with the SAME step keys the verifier
+    will use — with similar logits the categorical draw then lands
+    on the same token, which is what acceptance is made of. Rejected
+    rows need no rollback: position validity is the slot's write_pos
+    frontier, and the next round overwrites stale K/V before any
+    query can attend to it.
+
+    The scan runs k+1 steps for k proposals — the extra step exists
+    ONLY to write the k-th draft's K/V into the cache. On a full
+    accept the next round starts past that position, and without the
+    write it would hold zeros forever (never overwritten, silently
+    poisoning every later draft — acceptance collapses while output
+    stays correct). The k+1-th proposal is discarded."""
+    def step(carry, rngs_k):
+        cache, tok, wpos, dn = carry
+        positions = (wpos - pad_lens)[:, None]
+        logits, mutated = draft_model.apply(
+            {"params": draft_params, "cache": cache}, tok[:, None],
+            positions, mutable=["cache"], pad_lengths=pad_lens,
+            decode_positions=wpos)
+        next_tok = _sample_logits(logits[:, 0], rngs_k, temperature,
+                                  top_k, top_p)
+        if eos_id is not None:
+            next_tok = jnp.where(dn, eos_id, next_tok)
+            dn = dn | (next_tok == eos_id)
+        return (mutated["cache"], next_tok, wpos + 1, dn), next_tok
+
+    (cache, _, _, _), drafts = jax.lax.scan(
+        step, (draft_cache, tokens, write_pos, done), step_rngs)
+    return cache, drafts.swapaxes(0, 1)  # [N, k]
+
+
+def _verify_slice(model, params, physical, tables, write_pos,
+                  pad_lens, tokens, drafts, done, step_rngs,
+                  *, temperature, eos_id, top_k, top_p):
+    """ONE batched verifier forward over each slot's speculative
+    block [t0, d1..dk] (k+1 positions), then the sample → EOS-latch
+    chain replayed over the k+1 logit columns with the slot's own
+    step keys — target j is bitwise the token the vanilla slice
+    would have sampled at that step, whatever the drafts proposed.
+    Acceptance is the longest agreeing draft prefix (cumprod of the
+    match mask). The block's K/V is written through the page tables
+    at [write_pos, write_pos + k + 1); the host truncates back to
+    the accepted length (``paged_kv.truncate_slot``). The model runs
+    its l > 1 attention per-query at single-token shapes
+    (models/llama.py unrolls) — one [l, S] GEMM would reassociate
+    the value contraction vs the l == 1 GEMV and break the bitwise
+    token contract."""
+    logical = _gather_logical(physical, tables)
+    block = jnp.concatenate([tokens[:, None], drafts], axis=1)
+    width = block.shape[1]
+    positions = (write_pos - pad_lens)[:, None] + \
+        jnp.arange(width, dtype=jnp.int32)[None, :]
+    logits, mutated = model.apply(
+        {"params": params, "cache": logical}, block, positions,
+        mutable=["cache"], pad_lengths=pad_lens,
+        decode_positions=write_pos)
+
+    def step(dn, xs):
+        col_logits, rngs_k = xs
+        next_tok = _sample_logits(col_logits, rngs_k, temperature,
+                                  top_k, top_p)
+        if eos_id is not None:
+            next_tok = jnp.where(dn, eos_id, next_tok)
+            dn = dn | (next_tok == eos_id)
+        return dn, next_tok
+
+    _, targets = jax.lax.scan(
+        step, done, (logits.swapaxes(0, 1), step_rngs))
+    targets = targets.swapaxes(0, 1)  # [N, k+1]
+    agree = (drafts == targets[:, :-1]).astype(jnp.int32)
+    accepts = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)  # [N]
+    physical = _scatter_token_range(physical, mutated["cache"],
+                                    tables, write_pos,
+                                    num_steps=width)
+    return physical, targets, accepts
+
+
+@jax.jit
+def _insert_cache_row(batched, single, row):
+    """Land a B=1 prefill cache in row ``row`` of a B=N cache — the
+    draft cache's admission write. KV leaves only: the batched
+    cache's scalar index leaves stay untouched because the decode
+    path addresses positions explicitly (``decode_positions``).
+    ``row`` is traced, so every slot shares one compile."""
+    def ins(dst, src):
+        if not _is_kv(dst):
+            return dst
+        return jax.lax.dynamic_update_slice(
+            dst, src.astype(dst.dtype),
+            (row,) + (0,) * (dst.ndim - 1))
+    return jax.tree.map(ins, batched, single)
+
+
 class DecodeEngine:
     """Slot-based continuous-batching decode over one model.
 
@@ -483,15 +641,42 @@ class DecodeEngine:
     """
 
     def __init__(self, model: Any, params: Any, config: EngineConfig,
-                 *, name: str = "engine", mesh: Any = None):
+                 *, name: str = "engine", mesh: Any = None,
+                 draft_model: Any = None, draft_params: Any = None):
         if model.cache_size < config.max_prompt_len + \
                 config.max_new_tokens:
             raise ValueError(
                 f"cache_size {model.cache_size} < max_prompt_len "
                 f"{config.max_prompt_len} + max_new_tokens "
                 f"{config.max_new_tokens}")
+        if config.speculate_tokens < 0:
+            raise ValueError(
+                f"speculate_tokens {config.speculate_tokens} < 0")
+        self._spec_on = (draft_model is not None
+                         and config.speculate_tokens > 0)
+        if config.speculate_tokens > 0 and draft_model is None:
+            # The knob survived export but the draft weights didn't
+            # load (serving/model.py degrades here): vanilla decode,
+            # never a failed engine — output is bitwise identical
+            # either way, only the verifier-forward count differs.
+            logger.warning(
+                "engine %s: engine_draft_tokens=%d but no draft "
+                "model — speculative decoding disabled, decoding "
+                "vanilla", name, config.speculate_tokens)
+        if self._spec_on:
+            if draft_model.cache_size != model.cache_size:
+                raise ValueError(
+                    f"draft cache_size {draft_model.cache_size} != "
+                    f"verifier cache_size {model.cache_size} — the "
+                    f"draft writes at the verifier's slot positions")
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size {draft_model.vocab_size} != "
+                    f"verifier vocab_size {model.vocab_size}")
         self._model = model
         self._params = params
+        self._draft_model = draft_model
+        self._draft_params = draft_params
         self.config = config
         self.name = name
         #: tp/fsdp serving mesh (serving/sharding.py) the params live
@@ -525,6 +710,17 @@ class DecodeEngine:
 
             self.prefix = PrefixCache(config.page_size,
                                       self.kv.allocator)
+        if config.prefill_chunk:
+            if self.prefix is None:
+                raise ValueError(
+                    "engine_prefill_chunk requires engine_prefix_cache"
+                    " — chunks accumulate in the pad-0 layout and "
+                    "land in the prefix index")
+            if config.prefill_chunk % config.page_size:
+                raise ValueError(
+                    f"engine_prefill_chunk {config.prefill_chunk} "
+                    f"must be a multiple of engine_page_size "
+                    f"{config.page_size} (page-aligned slices)")
         self._cv = threading.Condition()
         self._thread: Optional[threading.Thread] = None
         self._closed = False
@@ -549,6 +745,30 @@ class DecodeEngine:
             _decode_slice, model,
             temperature=config.temperature, eos_id=config.eos_id,
             top_k=config.top_k, top_p=config.top_p))
+        # Draft lane (ISSUE 16): a persistent dense draft cache (one
+        # row per slot) plus SPLIT draft/verify dispatches, so the
+        # attribution report can tell draft wall from verify wall
+        # (the spec_verify span / draft_ms-verify_ms request args).
+        self._draft_cache = None
+        self._draft_prefill_template = None
+        self._spec_rounds = 0
+        self._spec_drafted_total = 0
+        self._spec_accepted_total = 0
+        if self._spec_on:
+            self._draft_cache = init_cache(draft_model, draft_params,
+                                           config.num_slots)
+            self._draft_prefill_template = init_cache(
+                draft_model, draft_params, 1)
+            self._draft_jit = jax.jit(functools.partial(
+                _draft_slice, draft_model,
+                temperature=config.temperature,
+                eos_id=config.eos_id, top_k=config.top_k,
+                top_p=config.top_p))
+            self._verify_jit = jax.jit(functools.partial(
+                _verify_slice, model,
+                temperature=config.temperature,
+                eos_id=config.eos_id, top_k=config.top_k,
+                top_p=config.top_p))
         # Metric children (owner-checked gauge callbacks).
         self._m_tokens = _M_TOKENS.labels(name)
         self._m_admitted = _M_ADMITTED.labels(name)
@@ -563,6 +783,12 @@ class DecodeEngine:
         self._g_pages.set_function(self.kv.allocator.available)
         self._g_occupancy = _M_PAGE_OCC.labels(name)
         self._g_occupancy.set_function(self.page_occupancy)
+        if self._spec_on:
+            self._m_spec_drafted = _M_SPEC_DRAFTED.labels(name)
+            self._m_spec_accepted = _M_SPEC_ACCEPTED.labels(name)
+            self._m_spec_rejected = _M_SPEC_REJECTED.labels(name)
+            self._g_spec_rate = _M_SPEC_RATE.labels(name)
+            self._g_spec_rate.set_function(self.spec_acceptance_rate)
         if self.prefix is not None:
             self._m_prefix_hits = _M_PREFIX_HITS.labels(name)
             self._m_prefix_misses = _M_PREFIX_MISSES.labels(name)
@@ -612,6 +838,13 @@ class DecodeEngine:
         total = alloc.num_pages - 1
         return (total - alloc.available()) / total if total else 1.0
 
+    def spec_acceptance_rate(self) -> float:
+        """Lifetime drafted-token acceptance rate (0.0 before the
+        first speculative round)."""
+        if not self._spec_drafted_total:
+            return 0.0
+        return self._spec_accepted_total / self._spec_drafted_total
+
     def _prefix_evicted_total(self) -> float:
         return float(self.prefix.evicted_pages) if self.prefix \
             else 0.0
@@ -627,21 +860,25 @@ class DecodeEngine:
     def run_prefill(self, prompt: np.ndarray, *,
                     rng: Optional[np.ndarray] = None,
                     max_new_tokens: Optional[int] = None,
-                    obs_ctx: Any = None
+                    obs_ctx: Any = None,
+                    timeout_s: float = 300.0
                     ) -> PrefillHandoff:
-        """Run the B=1 prefill WITHOUT binding a slot: the prefill-
-        role half of KV handoff. Purely functional over engine state
-        (no slot, no reservation, no estimator writes), so any
-        request thread may call it concurrently with the decode loop;
-        the returned handoff feeds ``submit(handoff=...)`` on this or
-        ANY engine serving the same export — the adopt path makes the
-        resumed decode bitwise equal to a local one. Deliberately
-        does NOT consult the prefix cache even when one is enabled:
-        the index is engine-thread-owned state and this method's
-        contract is request-thread callability, so a prefill-role
-        replica re-pays the full prefill (documented limitation,
-        docs/streaming.md "Prefix caching"; prefill-side reuse rides
-        the chunked-prefill work, ROADMAP #1)."""
+        """Run the B=1 prefill WITHOUT decoding: the prefill-role
+        half of KV handoff. The returned handoff feeds
+        ``submit(handoff=...)`` on this or ANY engine serving the
+        same export — the adopt path makes the resumed decode bitwise
+        equal to a local one.
+
+        In prefix-cache mode (ISSUE 16) the prefill rides the ENGINE
+        thread as a slot-bound prefill-only request: it matches and
+        REGISTERS in the r15 prefix index (chunked across laps when
+        ``prefill_chunk`` is set), which is what finally warms a
+        prefill-role replica's cache — the old slot-less functional
+        path re-paid every prefill and left the index cold. The call
+        blocks up to ``timeout_s`` (bounded wait, serving
+        discipline). Classic (left-layout) mode keeps the functional
+        path: no prefix index exists to warm, and request-thread
+        callability stays useful there."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if not 1 <= prompt.shape[0] <= self.config.max_prompt_len:
             raise ValueError(
@@ -680,26 +917,39 @@ class DecodeEngine:
             # at [0, L), garbage right-pad masked by causality) so the
             # blob's pages adopt straight into the shared-page layout
             # AND carry the prompt ids for the adopter's index — the
-            # warm-transfer half of the seam.
-            block = np.zeros((1, width), np.int32)
-            block[0, :length] = prompt
-            cache, first, done = _prefill_ctx_jit(
-                self._model, self._params, jnp.asarray(block),
-                self._prefill_template,
-                jnp.asarray(0, jnp.int32),
-                jnp.asarray(length - 1, jnp.int32),
-                jnp.asarray(step_keys[0:1]),
-                temperature=self.config.temperature,
-                eos_id=self.config.eos_id, top_k=self.config.top_k,
-                top_p=self.config.top_p)
-            handoff = PrefillHandoff(
-                cache=jax.tree.map(np.asarray, cache),
-                first_token=int(np.asarray(first)[0]),
-                done=bool(np.asarray(done)[0]),
-                prompt_len=length, prompt_width=length,
-                max_new_tokens=budget, step_keys=step_keys,
-                layout="right", prompt_tokens=prompt.copy())
-            note_spans("prefill_ctx", width)
+            # warm-transfer half of the seam. The work itself runs as
+            # a slot-bound prefill-only admission on the engine
+            # thread, hitting and registering the prefix index.
+            if self.kv.pages_for(length) > \
+                    self.kv.allocator.num_pages - 1:
+                raise ValueError(
+                    f"prompt needs {self.kv.pages_for(length)} pages "
+                    f"but the pool has only "
+                    f"{self.kv.allocator.num_pages - 1}")
+            stream = GenerateStream(budget, obs_ctx=obs_ctx)
+            box: dict = {"handoff": None}
+            req = _Request(
+                prompt=prompt, step_keys=step_keys,
+                max_new_tokens=budget, deadline=None, stream=stream,
+                submitted_at=t0, tenant=tenancy.DEFAULT_TENANT,
+                prefill_only=True, prefill_box=box)
+            with self._cv:
+                if self._closed:
+                    raise RuntimeError("engine is stopped")
+                self.scheduler.pending.append(req)
+                self._cv.notify_all()
+            self._ensure_thread()
+            stream.result(timeout=timeout_s)  # raises on engine error
+            handoff = box["handoff"]
+            if handoff is None:
+                raise RuntimeError(
+                    "prefill-only request finished without a handoff")
+            if TRACER.enabled and obs_ctx is not None:
+                TRACER.record(
+                    "engine_prefill", "engine", t0,
+                    time.monotonic() - t0,
+                    span_args(obs_ctx, model=self.name,
+                              prompt_len=length, handoff=True))
             return handoff
         pad = width - length
         padded = np.zeros((1, width), np.int32)
@@ -983,6 +1233,8 @@ class DecodeEngine:
             # keep exporting its stale stats.
             self._m_prefix_evicted.clear_function(self)
             self._g_prefix_pages.clear_function(self.prefix)
+        if self._spec_on:
+            self._g_spec_rate.clear_function(self)
         self._g_slots.clear_function(self.scheduler)
         self._g_queue.clear_function(self.scheduler)
         self._g_pages.clear_function(self.kv.allocator)
@@ -1017,6 +1269,21 @@ class DecodeEngine:
         }
         if self.prefix is not None:
             out["prefix_cache"] = self.prefix.stats()
+        if self._spec_on:
+            # The acceptance economics: verify_forwards is what the
+            # "< 1 verifier forwards per emitted token" bench claim
+            # divides by.
+            out["spec"] = {
+                "k": self.config.speculate_tokens,
+                "rounds": self._spec_rounds,
+                "verify_forwards": self._spec_rounds,
+                "drafted_tokens": self._spec_drafted_total,
+                "accepted_tokens": self._spec_accepted_total,
+                "acceptance_rate": round(
+                    self.spec_acceptance_rate(), 4),
+            }
+        if self.config.prefill_chunk:
+            out["prefill_chunk"] = self.config.prefill_chunk
         return out
 
     # -- engine thread ---------------------------------------------------
@@ -1033,8 +1300,12 @@ class DecodeEngine:
             try:
                 self._expire()
                 self._admit()
-                if self.scheduler.active_slots():
+                self._advance_prefills()
+                if self.scheduler.decoding_slots():
                     self._run_slice()
+                elif self.scheduler.prefilling_slots():
+                    # Prefill-only laps: work advanced, no nap.
+                    pass
                 else:
                     # Queued-but-unadmittable head with nothing
                     # decoding: bounded nap instead of a hot spin
@@ -1094,7 +1365,10 @@ class DecodeEngine:
             width = req.handoff.prompt_width
         else:
             width = self._prompt_width(len(req.prompt))
-        return self.kv.pages_for(width + req.max_new_tokens)
+        # Prefill-only requests never decode: the prompt's pages are
+        # the whole budget.
+        new_tokens = 0 if req.prefill_only else req.max_new_tokens
+        return self.kv.pages_for(width + new_tokens)
 
     def _tail_width(self, length: int, start: int) -> int:
         """Static block width for the continuation prefill of prompt
@@ -1199,9 +1473,23 @@ class DecodeEngine:
             if i == 0:
                 sched.head_unblocked()
             sched.pending.pop_head(head)
-            self._prefill_and_bind_prefix(head, match)
+            if head.prefill_only or self._chunkable(head, match):
+                # Slot-bound incremental prefill (ISSUE 16): long
+                # tails feed one chunk per engine lap; prefill-only
+                # requests ALWAYS take this path (with chunking off
+                # the whole tail is one "chunk" — same program, one
+                # lap) so their pages register in the prefix index.
+                self._bind_chunked_prefill(head, match)
+            else:
+                self._prefill_and_bind_prefix(head, match)
             return True
         return False
+
+    def _chunkable(self, req: _Request, match: "PrefixMatch") -> bool:
+        return (self.config.prefill_chunk > 0
+                and req.handoff is None
+                and len(req.prompt) - match.matched
+                > self.config.prefill_chunk)
 
     def _prefill_and_bind(self, req: _Request) -> None:
         t0 = time.monotonic()
@@ -1280,6 +1568,8 @@ class DecodeEngine:
         self._emit_token(slot, first)
         if slot.done or slot.remaining == 0:
             self._retire(slot, "eos" if slot.done else "budget")
+        else:
+            self._draft_prefill(slot, req)
 
     def _prefill_and_bind_prefix(self, req: _Request,
                                  match: "PrefixMatch") -> None:
@@ -1396,6 +1686,252 @@ class DecodeEngine:
         self._emit_token(slot, first)
         if slot.done or slot.remaining == 0:
             self._retire(slot, "eos" if slot.done else "budget")
+        else:
+            self._draft_prefill(slot, req)
+
+    def _bind_chunked_prefill(self, req: _Request,
+                              match: "PrefixMatch") -> None:
+        """Admit a prompt WITHOUT running its prefill yet: the slot
+        binds in the PREFILLING state holding the reservation and the
+        pinned prefix match; :meth:`_advance_prefills` feeds one
+        page-aligned chunk per engine lap. Like the one-shot path,
+        the matched prefix (plus a boundary fork) is gathered into
+        the accumulating B=1 cache up front — the fork's donor page
+        is unpinned as soon as the copy is dispatched, and ``match``
+        is narrowed so a mid-prefill retire can blanket-unpin the
+        entries without double-freeing the fork."""
+        t0 = time.monotonic()
+        m = match.matched
+        budget_pages = self._budget_pages(req)
+        fork_pinned = match.fork is not None
+        try:
+            if m > 0:
+                page_row = list(match.shared_pages)
+                if match.fork is not None:
+                    page_row.append(match.fork.page)
+                cache = self.kv.gather_prefix_cache(
+                    page_row, self._prefill_template, m)
+                if fork_pinned:
+                    self.prefix.unpin_fork(match)
+                    fork_pinned = False
+                    match = dataclasses.replace(match, fork=None,
+                                                fork_len=0)
+            else:
+                cache = self._prefill_template
+        except Exception as e:  # noqa: BLE001 — XLA OOM / compile
+            logger.exception("chunked-prefill admission failed; "
+                             "shedding the request")
+            self.kv.allocator.unreserve(
+                budget_pages - len(match.entries))
+            self.prefix.unpin(match, include_fork=fork_pinned)
+            _M_RETIRED.labels(self.name, "error").inc()
+            req.stream._fail(e)
+            return
+        slot = self.scheduler.bind_prefilling(
+            req, prefill_pos=m, prefill_cache=cache,
+            prefill_match=match, budget_pages=budget_pages,
+            deadline=req.deadline)
+        slot.queue_s = max(0.0, t0 - req.submitted_at)
+
+    def _advance_prefills(self) -> None:
+        """Feed ONE chunk to every prefilling slot — one per engine
+        lap, so a long prompt's prefill interleaves with decode
+        slices instead of stalling them (the chunk is the prefill's
+        slice budget). Chunks run at a fixed [1, chunk] width (one
+        compile); the final tail takes the shared bucket policy, the
+        same program widths the one-shot path uses."""
+        chunk = self.config.prefill_chunk
+        for slot in self.scheduler.prefilling_slots():
+            req = slot.request
+            length = len(req.prompt)
+            pos = slot.prefill_pos
+            remaining = length - pos
+            # chunk == 0 only for prefill-only admissions with
+            # chunking disabled: the whole tail is one chunk, which
+            # makes this lap bitwise the old one-shot prefill.
+            step = chunk if chunk else remaining
+            final = remaining <= step
+            t0 = time.monotonic()
+            try:
+                if final:
+                    width = self._tail_width(length, pos)
+                    block = np.zeros((1, width), np.int32)
+                    block[0, :remaining] = req.prompt[pos:]
+                    last_col = remaining - 1
+                else:
+                    width = step
+                    block = np.asarray(
+                        req.prompt[pos:pos + step], np.int32
+                    ).reshape(1, -1)
+                    last_col = width - 1
+                cache, first_a, done_a = _prefill_ctx_jit(
+                    self._model, self._params, jnp.asarray(block),
+                    slot.prefill_cache, jnp.asarray(pos, jnp.int32),
+                    jnp.asarray(last_col, jnp.int32),
+                    jnp.asarray(req.step_keys[0:1]),
+                    temperature=self.config.temperature,
+                    eos_id=self.config.eos_id,
+                    top_k=self.config.top_k,
+                    top_p=self.config.top_p)
+                cache = jax.block_until_ready(cache)
+            except Exception as e:  # noqa: BLE001 — fail only this
+                # slot; its prefilling retire path unwinds the pins
+                # and reservation.
+                logger.exception("chunk prefill failed")
+                self._retire(slot, "error", error=e)
+                continue
+            dur = time.monotonic() - t0
+            slot.prefill_cache = cache
+            slot.prefill_pos = pos + (remaining if final else step)
+            slot.prefill_s += dur
+            self._note_compile("prefill_ctx", f"tokens[1,{width}]",
+                               t0, dur, link=self._span_args(req))
+            if final:
+                self._finish_chunked_prefill(
+                    slot, first=int(np.asarray(first_a)[0]),
+                    done=bool(np.asarray(done_a)[0]))
+
+    def _finish_chunked_prefill(self, slot: Slot, *, first: int,
+                                done: bool) -> None:
+        """Last chunk landed: adopt the accumulated cache into pages,
+        register the prompt in the prefix index, and either join the
+        decode batch (:meth:`SlotScheduler.finish_prefill`) or — for
+        a prefill-only request — package the handoff and retire."""
+        req = slot.request
+        match = slot.prefill_match
+        m = match.matched
+        shared = match.shared_pages
+        length = len(req.prompt)
+        try:
+            allocated = self.kv.adopt(
+                slot.index, slot.prefill_cache, length,
+                slot.budget_pages, shared_pages=shared)
+        except Exception as e:  # noqa: BLE001 — the prefilling
+            # retire branch unpins the match and unreserves.
+            logger.exception("chunked-prefill adopt failed")
+            self._retire(slot, "error", error=e)
+            return
+        # From here the slot owns its pages like any bound slot: the
+        # pins transferred into table refs, release_slot unwinds.
+        SlotScheduler.finish_prefill(slot, prompt_width=length,
+                                     first_token=first, done=done)
+        slot.allocated_pages = allocated
+        self.prefix.register(
+            req.prompt,
+            self.kv.tables[slot.index, :allocated].tolist())
+        t1 = time.monotonic()
+        if m > 0:
+            self.prefix.hits += 1
+            self.prefix.saved_tokens_total += m
+            self._m_prefix_hits.inc()
+            self._m_prefix_saved.observe(float(m))
+        else:
+            self.prefix.misses += 1
+            self._m_prefix_misses.inc()
+            # Deliberately NOT fed to the prefill estimator: a
+            # chunked prefill's wall time spans several laps with
+            # decode slices interleaved — it would price one-shot
+            # TTFT off multi-lap wall.
+        self._m_admitted.inc()
+        if req.prefill_only:
+            self._finish_prefill_handoff(slot, first=first, done=done)
+            return
+        ctx = req.stream.obs_ctx
+        self._m_ttft.observe(t1 - req.submitted_at,
+                             trace_id=ctx.trace_id if ctx else None)
+        tenancy.observe_ttft(req.tenant or tenancy.DEFAULT_TENANT,
+                             t1 - req.submitted_at)
+        if TRACER.enabled:
+            TRACER.record(
+                "engine_prefill", "engine", t1 - slot.prefill_s,
+                slot.prefill_s,
+                self._span_args(req, slot=slot.index,
+                                prompt_len=length, prefix_matched=m,
+                                chunked=True))
+        self._emit_token(slot, first)
+        if slot.done or slot.remaining == 0:
+            self._retire(slot, "eos" if slot.done else "budget")
+        else:
+            self._draft_prefill(slot, req)
+
+    def _finish_prefill_handoff(self, slot: Slot, *, first: int,
+                                done: bool) -> None:
+        """Prefill-only completion: gather the slot's (now adopted
+        and prefix-registered) pages back into a contiguous B=1 cache
+        for the :class:`PrefillHandoff`, hand it to the waiting
+        ``run_prefill`` caller, and retire the slot. Positions past
+        the prompt in the tail page gather as zeros where the old
+        functional path carried right-pad garbage — both are dead
+        cells the adopting decode overwrites or masks, so the resumed
+        decode stays bitwise."""
+        req = slot.request
+        length = len(req.prompt)
+        page_row = self.kv.tables[
+            slot.index, :slot.allocated_pages].tolist()
+        cache = self.kv.gather_prefix_cache(
+            page_row, self._prefill_template, length)
+        req.prefill_box["handoff"] = PrefillHandoff(
+            cache=jax.tree.map(np.asarray, cache),
+            first_token=first, done=done,
+            prompt_len=length, prompt_width=length,
+            max_new_tokens=req.max_new_tokens,
+            step_keys=np.asarray(req.step_keys),
+            layout="right",
+            prompt_tokens=np.asarray(req.prompt, np.int32).copy())
+        self._retire(slot, "prefill_handoff")
+
+    def _draft_prefill(self, slot: Slot, req: _Request) -> None:
+        """Fill the slot's draft-cache row with the DRAFT model's
+        prompt K/V, in the same layout the verifier's slot uses, so
+        the first draft step continues from ``write_pos``. The draft
+        pays its full prompt every admission (no draft-side prefix
+        cache — the draft is llama-test-sized, the prefill is cheap
+        relative to one saved verifier forward). A left-layout
+        handoff carries no prompt ids: the row stays stale, which is
+        CORRECT but useless — drafts become junk, acceptance goes to
+        0, and the output is still bitwise because targets never
+        depend on drafts."""
+        if not self._spec_on:
+            return
+        if req.handoff is not None and req.handoff.prompt_tokens is \
+                None:
+            return
+        length = len(req.prompt)
+        t0 = time.monotonic()
+        if self.prefix is not None:
+            width = self._tail_width(length, 0)
+            block = np.zeros((1, width), np.int32)
+            block[0, :length] = req.prompt
+            cache, _, _ = _prefill_ctx_jit(
+                self._draft_model, self._draft_params,
+                jnp.asarray(block), self._draft_prefill_template,
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(length - 1, jnp.int32),
+                jnp.asarray(req.step_keys[0:1]),
+                temperature=self.config.temperature,
+                eos_id=self.config.eos_id, top_k=self.config.top_k,
+                top_p=self.config.top_p)
+        else:
+            width = slot.prompt_width
+            pad = slot.pad_len
+            padded = np.zeros((1, width), np.int32)
+            padded[0, pad:] = req.prompt
+            carry, _ = _prefill_jit(
+                self._draft_model, self._draft_params,
+                jnp.asarray(padded),
+                jnp.asarray(req.step_keys[0:1]),
+                self._draft_prefill_template,
+                jnp.asarray([pad], jnp.int32),
+                temperature=self.config.temperature,
+                eos_id=self.config.eos_id, top_k=self.config.top_k,
+                top_p=self.config.top_p)
+            cache = carry[0]
+        self._draft_cache = _insert_cache_row(
+            self._draft_cache, cache, slot.index)
+        dur = time.monotonic() - t0
+        slot.draft_s += dur
+        self._note_compile("draft_prefill", f"tokens[1,{width}]",
+                           t0, dur, link=self._span_args(req))
 
     def _emit_token(self, slot: Slot, token: int) -> None:
         slot.emitted += 1
@@ -1411,7 +1947,13 @@ class DecodeEngine:
             slot.done = True
 
     def _run_slice(self) -> None:
-        active = self.scheduler.active_slots()
+        if self._spec_on:
+            self._run_spec_slice()
+        else:
+            self._run_plain_slice()
+
+    def _run_plain_slice(self) -> None:
+        active = self.scheduler.decoding_slots()
         num_steps = min(self.config.slice_tokens,
                         max(s.remaining for s in active))
         n = self.config.num_slots
@@ -1494,15 +2036,174 @@ class DecodeEngine:
             elif s.remaining == 0:
                 self._retire(s, "budget")
 
+    def _run_spec_slice(self) -> None:
+        """One speculative round over the decode batch: k draft
+        steps (dense draft cache) + ONE batched verifier forward per
+        slot over [t0, d1..dk], then accept the agreeing prefix.
+        Emits ``min(accepts + 1, remaining)`` tokens per slot for one
+        verifier weight-read — the perf claim is verifier forwards
+        per emitted token < 1; the CORRECTNESS claim is that targets
+        come from the verifier's own logits under the slot's own
+        step keys, so the stream is bitwise the vanilla slice's
+        whatever the drafts were. Speculated K/V past the accepted
+        length rolls back via ``truncate_slot`` (reservation-safe),
+        keeping the write_pos/steps_done alignment the vanilla path
+        maintains."""
+        active = self.scheduler.decoding_slots()
+        k = self.config.speculate_tokens
+        steps = k + 1
+        n = self.config.num_slots
+        for s in active:
+            s.allocated_pages = self.kv.extend_slot(
+                s.index, s.allocated_pages, s.write_pos + steps,
+                s.budget_pages)
+        tokens = np.zeros((n,), np.int32)
+        wpos = np.zeros((n,), np.int32)
+        pads = np.zeros((n,), np.int32)
+        done = np.ones((n,), bool)  # inactive rows ride latched
+        rngs = np.zeros((steps, n, 2), np.uint32)
+        for s in active:
+            tokens[s.index] = s.last_token
+            wpos[s.index] = s.write_pos
+            pads[s.index] = s.pad_len
+            done[s.index] = s.done
+            rngs[:, s.index] = SlotScheduler.slice_keys(s, steps)
+        t0 = time.monotonic()
+        # Draft proposes with keys [0, k): key j is the key target
+        # j+1 will be sampled with — same key + similar logits means
+        # the same categorical draw, which is what acceptance is.
+        # All k+1 keys go in; the last step only writes K/V (see
+        # _draft_slice) and its proposal is dropped below.
+        draft_cache, drafts = self._draft_jit(
+            self._draft_params, self._draft_cache,
+            jnp.asarray(tokens), jnp.asarray(wpos),
+            jnp.asarray(pads), jnp.asarray(done),
+            jnp.asarray(rngs))
+        self._draft_cache = draft_cache
+        drafts = drafts[:, :k]
+        # Block on the drafts (not the cache) so draft wall and
+        # verify wall are separately attributable — the spec_verify
+        # obs contract.
+        drafts = jax.block_until_ready(drafts)
+        t1 = time.monotonic()
+        physical, targets, accepts = self._verify_jit(
+            self._params, self.kv.physical, self.kv.device_tables(),
+            jnp.asarray(wpos), jnp.asarray(pads),
+            jnp.asarray(tokens), drafts, jnp.asarray(done),
+            jnp.asarray(rngs))
+        self.kv.physical = physical
+        targets = np.asarray(jax.block_until_ready(targets))
+        accepts = np.asarray(accepts)
+        t2 = time.monotonic()
+        t_draft, t_verify = t1 - t0, t2 - t1
+        t_round = t2 - t0
+        self._slices += 1
+        self._spec_rounds += 1
+        self._note_compile("spec_draft", f"steps={k} slots={n}",
+                           t0, t_draft)
+        self._note_compile("spec_verify", f"width={steps} slots={n}",
+                           t1, t_verify)
+        round_drafted = 0
+        round_accepted = 0
+        round_emitted = 0
+        for s in active:
+            a = int(accepts[s.index])
+            take = min(a + 1, s.remaining)
+            used = take - 1  # drafted tokens that saved a forward
+            round_drafted += k
+            round_accepted += used
+            s.spec_drafted += k
+            s.spec_accepted += used
+            s.draft_s += t_draft
+            s.verify_s += t_verify
+            s.decode_s += t_round
+            per_token = t_round / take
+            for j in range(take):
+                if s.done:
+                    break  # post-EOS targets are latched padding
+                s.steps_done += 1
+                self._emit_token(s, int(targets[s.index, j]))
+                self._m_inter.observe(per_token)
+                round_emitted += 1
+            s.write_pos += take
+            s.allocated_pages = self.kv.truncate_slot(
+                s.index, s.allocated_pages, s.write_pos)
+            s.last_token = int(targets[s.index, take - 1])
+            if s.done:
+                self._retire(s, "eos")
+            elif s.remaining == 0:
+                self._retire(s, "budget")
+        self._spec_drafted_total += round_drafted
+        self._spec_accepted_total += round_accepted
+        self._m_spec_drafted.inc(round_drafted)
+        self._m_spec_accepted.inc(round_accepted)
+        self._m_spec_rejected.inc(round_drafted - round_accepted)
+        self._token_est.observe(
+            t_round / max(1.0, round_emitted / max(1, len(active))))
+        if TRACER.enabled:
+            alloc = self.kv.allocator
+            TRACER.record(
+                "engine_slice", "engine", t0, t_round, {
+                    "model": self.name,
+                    "slice": self._slices,
+                    "slots": len(active),
+                    "steps": steps,
+                    "tokens": round_emitted,
+                    "spec": True,
+                    "drafted": round_drafted,
+                    "accepted": round_accepted,
+                    "draft_ms": round(t_draft * 1e3, 3),
+                    "verify_ms": round(t_verify * 1e3, 3),
+                    "free_pages": alloc.available(),
+                    "retained_pages": alloc.retained_pages,
+                    "occupancy": round(self.page_occupancy(), 4),
+                    "admitted": self.scheduler.admitted,
+                    "retired": self.scheduler.retired,
+                    "queue_depth": self.scheduler.queue_depth(),
+                    "prefix_hits": (self.prefix.hits
+                                    if self.prefix is not None
+                                    else 0),
+                })
+            # The spec_verify leg: the verifier-forward share of the
+            # round, the half the attribution report splits out.
+            TRACER.record(
+                "spec_verify", "engine", t1, t_verify, {
+                    "model": self.name,
+                    "slice": self._slices,
+                    "slots": len(active),
+                    "width": steps,
+                })
+
     def _retire(self, slot: Slot, reason: str,
                 error: Optional[BaseException] = None) -> None:
         req = slot.request
-        self.kv.release_slot(
-            slot.index, slot.allocated_pages,
-            slot.budget_pages - slot.allocated_pages)
+        if slot.prefilling:
+            # Mid-chunked-prefill death (deadline / cancel / error /
+            # shutdown): no pages were adopted — the slot holds only
+            # its reservation and the pinned prefix match (fork
+            # already unpinned and narrowed out at bind).
+            match = slot.prefill_match
+            shared = len(match.entries) if match is not None else 0
+            if match is not None and self.prefix is not None:
+                self.prefix.unpin(match, include_fork=False)
+            self.kv.allocator.unreserve(slot.budget_pages - shared)
+            slot.clear_prefill_state()
+        else:
+            self.kv.release_slot(
+                slot.index, slot.allocated_pages,
+                slot.budget_pages - slot.allocated_pages)
         self.scheduler.retire(slot, reason)
         _M_RETIRED.labels(self.name, reason).inc()
         if TRACER.enabled:
+            extra = {}
+            if slot.spec_drafted or slot.draft_s or slot.verify_s:
+                # Draft vs verify split of the decode share, plus the
+                # request's own acceptance economics (ISSUE 16).
+                extra = dict(
+                    draft_ms=round(slot.draft_s * 1e3, 3),
+                    verify_ms=round(slot.verify_s * 1e3, 3),
+                    spec_drafted=slot.spec_drafted,
+                    spec_accepted=slot.spec_accepted)
             TRACER.record(
                 "engine_request", "engine", req.submitted_at,
                 time.monotonic() - req.submitted_at,
@@ -1514,7 +2215,8 @@ class DecodeEngine:
                     # a slot, prefill, decode-slice share).
                     queue_ms=round(slot.queue_s * 1e3, 3),
                     prefill_ms=round(slot.prefill_s * 1e3, 3),
-                    decode_ms=round(slot.decode_s * 1e3, 3)))
+                    decode_ms=round(slot.decode_s * 1e3, 3),
+                    **extra))
         if error is not None:
             req.stream._fail(error)
             return

@@ -455,6 +455,38 @@ class PagedKVCache:
             self.physical, jnp.asarray(row), template,
             jnp.asarray(fill_len, jnp.int32))
 
+    def truncate_slot(self, slot_index: int, allocated: int,
+                      keep_upto_position: int) -> int:
+        """Roll back a speculative multi-token append: keep only the
+        pages backing cache positions ``[0, keep_upto_position)`` and
+        hand the over-allocated tail back — each dropped page loses
+        this slot's reference (a privately allocated decode page goes
+        straight back to the free list; ``unref`` keeps shared /
+        prefix-retained custody correct if a caller ever truncates
+        into shared territory) and its page worth of reservation is
+        restored, so the slot can re-extend over the same range as
+        its sequence re-advances. Rejected K/V left in KEPT pages
+        past ``keep_upto_position`` needs no scrubbing: the decode
+        validity mask never attends past a row's write position, and
+        the next append overwrites it. Returns the new allocated
+        count. Engine thread only (same custody rule as the other
+        slot operations)."""
+        keep = self.pages_for(max(0, keep_upto_position))
+        if keep >= allocated:
+            return allocated
+        dropped = self.tables[slot_index, keep:allocated].tolist()
+        for p in reversed(dropped):
+            self.allocator.unref(int(p))
+        if not self.allocator.reserve(len(dropped)):
+            # Unreachable: unref just returned len(dropped) pages of
+            # availability (free or retained custody) — surface
+            # loudly rather than silently under-reserving.
+            raise RuntimeError(
+                f"truncate_slot: could not restore {len(dropped)} "
+                f"pages of reservation")
+        self.tables[slot_index, keep:allocated] = 0
+        return keep
+
     def release_slot(self, slot_index: int, allocated: int,
                      unreserved_remainder: int) -> None:
         """Retire: drop the slot's reference on every table row

@@ -69,6 +69,32 @@ class Slot:
     queue_s: float = 0.0
     prefill_s: float = 0.0
     decode_s: float = 0.0
+    # Speculative-decode attribution (ISSUE 16): draft vs verify
+    # share of this slot's decode wall, and its drafted/accepted
+    # token counts — the engine_request span reports draft_ms /
+    # verify_ms / spec acceptance alongside the r15 triple.
+    draft_s: float = 0.0
+    verify_s: float = 0.0
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    # Chunked-prefill state (ISSUE 16): an admitted long prompt
+    # occupies its slot while its prefill advances one page-aligned
+    # chunk per engine lap, interleaved with decode slices. While
+    # ``prefilling`` the slot is excluded from decode batches;
+    # ``prefill_pos`` is the next prompt index to feed,
+    # ``prefill_cache`` the accumulating B=1 contiguous cache, and
+    # ``prefill_match`` the pinned prefix-cache match that must be
+    # unpinned if the slot dies before adoption.
+    prefilling: bool = False
+    prefill_pos: int = 0
+    prefill_cache: Any = None
+    prefill_match: Any = None
+
+    def clear_prefill_state(self) -> None:
+        self.prefilling = False
+        self.prefill_pos = 0
+        self.prefill_cache = None
+        self.prefill_match = None
 
     @property
     def max_new_tokens(self) -> int:
@@ -124,6 +150,14 @@ class SlotScheduler:
 
     def active_slots(self) -> List[Slot]:
         return [s for s in self.slots if s.active]
+
+    def decoding_slots(self) -> List[Slot]:
+        """Active slots in the decode batch (a chunk-prefilling slot
+        occupies a slot but has no first token yet)."""
+        return [s for s in self.slots if s.active and not s.prefilling]
+
+    def prefilling_slots(self) -> List[Slot]:
+        return [s for s in self.slots if s.active and s.prefilling]
 
     def occupancy(self) -> int:
         return len(self.slots) - len(self._free)
@@ -216,8 +250,66 @@ class SlotScheduler:
         slot.queue_s = 0.0  # slots are reused: attribution resets
         slot.prefill_s = 0.0
         slot.decode_s = 0.0
+        slot.draft_s = 0.0
+        slot.verify_s = 0.0
+        slot.spec_drafted = 0
+        slot.spec_accepted = 0
+        slot.clear_prefill_state()
         self.admitted += 1
         return slot
+
+    def bind_prefilling(self, request: Any, *, prefill_pos: int,
+                        prefill_cache: Any, prefill_match: Any,
+                        budget_pages: int,
+                        deadline: Optional[float]) -> Slot:
+        """Attach an admitted request whose prompt will prefill in
+        page-aligned chunks ACROSS engine laps (ISSUE 16): the slot
+        is occupied (it holds the reservation and, via
+        ``prefill_match``, the pinned prefix pages) but joins no
+        decode batch until :meth:`finish_prefill`. The caller has
+        already reserved ``budget_pages`` minus the pinned shared
+        pages."""
+        slot = self.slots[self._free.popleft()]
+        assert not slot.active, f"slot {slot.index} double-bound"
+        slot.active = True
+        slot.request = request
+        slot.write_pos = 0
+        slot.pad_len = 0
+        slot.prompt_width = 0
+        slot.last_token = 0
+        slot.steps_done = 0
+        slot.emitted = 0
+        slot.done = False
+        slot.allocated_pages = 0
+        slot.budget_pages = budget_pages
+        slot.deadline = deadline
+        slot.queue_s = 0.0
+        slot.prefill_s = 0.0
+        slot.decode_s = 0.0
+        slot.draft_s = 0.0
+        slot.verify_s = 0.0
+        slot.spec_drafted = 0
+        slot.spec_accepted = 0
+        slot.prefilling = True
+        slot.prefill_pos = prefill_pos
+        slot.prefill_cache = prefill_cache
+        slot.prefill_match = prefill_match
+        self.admitted += 1
+        return slot
+
+    @staticmethod
+    def finish_prefill(slot: Slot, *, prompt_width: int,
+                       first_token: int, done: bool) -> None:
+        """Chunked prefill completed: the slot joins the decode batch
+        with the same state :meth:`bind` would have set (pad-0
+        layout; the prefill consumed step key 0)."""
+        assert slot.prefilling, f"slot {slot.index} not prefilling"
+        slot.write_pos = prompt_width
+        slot.prompt_width = prompt_width
+        slot.last_token = int(first_token)
+        slot.steps_done = 1
+        slot.done = bool(done)
+        slot.clear_prefill_state()
 
     def retire(self, slot: Slot, reason: str) -> None:
         """Return the slot to the free pool. Page release is the

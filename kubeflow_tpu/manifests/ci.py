@@ -180,6 +180,16 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
         "serving-tenancy": [
             py, f"{src}/bench.py", "--tenants",
         ],
+        # Spec-decode gate (ISSUE 16): the speculative-decoding sweep
+        # — a real CPU engine drafting k tokens per slot and verifying
+        # them in one batched forward. Greedy AND sampled outputs must
+        # stay bitwise-equal to vanilla B=1 decode, the strong-draft
+        # acceptance rate must be nonzero, and per-slot verifier
+        # forwards per emitted token must drop below 1.0. Hermetic —
+        # tiny test model on JAX CPU, no cluster, no accelerator.
+        "spec-decode": [
+            py, f"{src}/bench.py", "--speculative",
+        ],
         # Trace-assembly gate (ISSUE 15): the distributed-tracing
         # sweep — a real proxy + two role-split servers + a span-
         # scraping collector; unary, SSE, role-split and hedged
@@ -248,6 +258,7 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             _dag_task("serving-mesh-dryrun", ["checkout"]),
             _dag_task("serving-chaos", ["checkout"]),
             _dag_task("serving-tenancy", ["checkout"]),
+            _dag_task("spec-decode", ["checkout"]),
             _dag_task("trace-assembly", ["checkout"]),
             _dag_task("deploy-test", ["checkout"]),
             _dag_task("deploy-serving", ["deploy-test"]),

@@ -152,16 +152,19 @@ class LlamaAttention(nn.Module):
                 # sits at its OWN cache position — rows joined the
                 # persistent batch at different times — so the write
                 # index is per-row ([B] int32), not the shared scalar.
-                # Single-token steps only: multi-token appends at
-                # per-row offsets would need per-row causal masks that
-                # the single-token case gets for free (the newest token
+                # l == 1 is the classic decode step: the newest token
                 # may attend to every valid slot, so validity alone IS
                 # causality and the masked scores match the scalar
-                # path's causal+valid composition bitwise).
-                if l != 1:
-                    raise ValueError(
-                        f"decode_positions is a one-token decode "
-                        f"contract, got {l} tokens")
+                # path's causal+valid composition bitwise. l > 1 is
+                # the multi-token verify contract (speculative
+                # decoding): row b's block token j sits at cache
+                # position start[b] + j, so each query carries its own
+                # causal frontier — the per-query [B, l, slots] mask.
+                # The j-th row of the block's logits then equals the
+                # single-token step's logits at the same position
+                # bitwise (same masked score set, and every per-
+                # position op is an independent dot over the same
+                # operands).
                 start = decode_positions  # [B] int32
                 cached_k.value = jax.vmap(
                     lambda c, u, s: jax.lax.dynamic_update_slice(
@@ -173,15 +176,41 @@ class LlamaAttention(nn.Module):
                     cached_v.value, v.astype(self.dtype), start)
                 # The scalar index is meaningless across slots; leave
                 # it untouched (the engine carries per-slot positions).
-                valid = (jnp.arange(slots)[None, :]
-                         <= start[:, None]).astype(jnp.int32)
-                if pad_lengths is not None:
-                    valid = valid * (jnp.arange(slots)[None, :]
-                                     >= pad_lengths[:, None]
-                                     ).astype(jnp.int32)
-                out = dense_attention(
-                    q, cached_k.value, cached_v.value, causal=False,
-                    kv_segment_valid=valid)
+                def pos_valid(frontier):
+                    # Validity at one per-row frontier: [B, slots].
+                    v = (jnp.arange(slots)[None, :]
+                         <= frontier[:, None]).astype(jnp.int32)
+                    if pad_lengths is not None:
+                        v = v * (jnp.arange(slots)[None, :]
+                                 >= pad_lengths[:, None]
+                                 ).astype(jnp.int32)
+                    return v
+
+                if l == 1:
+                    out = dense_attention(
+                        q, cached_k.value, cached_v.value,
+                        causal=False, kv_segment_valid=pos_valid(start))
+                else:
+                    # Multi-token verify: per-query attention UNROLLED
+                    # at the single-token shapes ([B, 1, H, D] query
+                    # against the full cache). One [l, S] GEMM would
+                    # be tidier, but its value contraction
+                    # reassociates the S-sum differently than the
+                    # l == 1 GEMV — a 1-ulp drift that breaks the
+                    # engine's bitwise token contract. Unrolling keeps
+                    # every kernel shape identical to the vanilla
+                    # decode step's, which is what makes block row j's
+                    # logits bitwise-equal to the one-token step at
+                    # position start + j; the cost is per-query cache
+                    # attention, negligible next to the weight read
+                    # the verify forward amortizes.
+                    out = jnp.concatenate([
+                        dense_attention(
+                            q[:, j:j + 1], cached_k.value,
+                            cached_v.value, causal=False,
+                            kv_segment_valid=pos_valid(
+                                start + jnp.asarray(j, start.dtype)))
+                        for j in range(l)], axis=1)
             else:
                 start = index.value
                 cached_k.value = jax.lax.dynamic_update_slice(
@@ -284,10 +313,16 @@ class Llama(nn.Module):
         (inference/generate.py owns the matching position offsets).
 
         ``decode_positions`` (optional, [B] int32, cache models only):
-        per-row cache write index for slot-based one-token decode —
-        the continuous-batching engine (inference/engine/) keeps each
+        per-row cache write index for slot-based decode — the
+        continuous-batching engine (inference/engine/) keeps each
         slot at its own position instead of sharing the scalar cache
-        index, so rows can join and retire mid-decode."""
+        index, so rows can join and retire mid-decode. With L == 1
+        this is the classic decode step; with L > 1 it is the
+        multi-token verify contract (speculative decoding): row b's
+        block token j is written at ``decode_positions[b] + j`` and
+        attends under its own per-query causal frontier, so block
+        logits row j equal the one-token step's logits at the same
+        position bitwise."""
         del train
         b, l = input_ids.shape
         if positions is None:

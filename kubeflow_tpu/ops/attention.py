@@ -71,7 +71,9 @@ def dense_attention(
     query/key — this makes the same function usable on sequence shards
     (ring attention's per-step block compute) and on full sequences
     (offsets 0). ``kv_segment_valid`` is an optional [B, Lk] 0/1 mask
-    for padded keys.
+    for padded keys, or [B, Lq, Lk] for a per-query mask (the decode
+    engine's multi-token verify path, where each batch row's queries
+    have their own causal frontier).
     """
     q_heads, kv_heads = q.shape[2], k.shape[2]
     if q_heads != kv_heads:
@@ -88,9 +90,14 @@ def dense_attention(
         k_pos = kv_offset + jnp.arange(k.shape[1])
         s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
     if kv_segment_valid is not None:
-        s = jnp.where(
-            kv_segment_valid[:, None, None, :].astype(bool), s, NEG_INF
-        )
+        # [B, Lk] masks padded keys for every query; [B, Lq, Lk] is
+        # the per-query form (each query row carries its own key
+        # validity — e.g. batch rows at different cache positions
+        # with per-query causal frontiers).
+        mask = kv_segment_valid.astype(bool)
+        mask = (mask[:, None, :, :] if mask.ndim == 3
+                else mask[:, None, None, :])
+        s = jnp.where(mask, s, NEG_INF)
     # Guard fully-masked rows (e.g. ring steps entirely in the causal
     # future): keep the max finite so exp() never sees -inf - -inf.
     m = jnp.maximum(jnp.max(s, axis=-1, keepdims=True), NEG_INF / 2)

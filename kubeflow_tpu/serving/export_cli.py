@@ -67,6 +67,14 @@ _GENERATE_CONFIG_COERCERS = {
     # admissions share cached prompt-prefix pages copy-on-write and
     # prefill only the tail. Boolean — layout changes ride it.
     "engine_prefix_cache": bool,
+    # Speculative decoding + chunked prefill (ISSUE 16,
+    # docs/streaming.md): draft k tokens per slot per round and verify
+    # in one batched forward; admit long prompts in page-aligned
+    # slices. engine_draft_export names the exported version dir the
+    # server loads the draft model from.
+    "engine_draft_tokens": int,
+    "engine_prefill_chunk": int,
+    "engine_draft_export": str,
 }
 
 
@@ -132,6 +140,12 @@ def validate_generate_config(config: Dict[str, Any]) -> Dict[str, Any]:
                 "engine_slice_tokens", "engine_num_pages"):
         if key in out and out[key] < 1:
             raise ValueError(f"{key} must be >= 1; got {out[key]}")
+    for key in ("engine_draft_tokens", "engine_prefill_chunk"):
+        # 0 is the documented "off" value (EngineConfig defaults).
+        if key in out and out[key] < 0:
+            raise ValueError(f"{key} must be >= 0; got {out[key]}")
+    if "engine_draft_export" in out and not out["engine_draft_export"]:
+        raise ValueError("engine_draft_export must be a non-empty path")
     if "temperature" in out and out["temperature"] < 0.0:
         raise ValueError(
             f"temperature must be >= 0; got {out['temperature']}")

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -38,6 +39,8 @@ import numpy as np
 from kubeflow_tpu.models.registry import get_model
 from kubeflow_tpu.serving.export import read_metadata, read_variables
 from kubeflow_tpu.serving.signature import ModelMetadata, Signature
+
+logger = logging.getLogger(__name__)
 
 _NP_DTYPES = {
     "float32": np.float32,
@@ -202,6 +205,39 @@ class LoadedModel:
         return np.asarray(
             jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(n)))
 
+    def _load_draft(self) -> Tuple[Any, Any]:
+        """Load the speculative-decoding draft model named by the
+        export's ``engine_draft_export`` (a version dir produced by
+        export_cli, typically a much smaller model sharing the
+        verifier's vocab). Returns ``(module, params)`` or
+        ``(None, None)`` — any load failure degrades to vanilla
+        decoding with a warning rather than failing the serve path:
+        speculation is an optimization, never a correctness
+        dependency."""
+        cfg = self.metadata.generate_config
+        path = cfg.get("engine_draft_export")
+        if not path or not int(cfg.get("engine_draft_tokens", 0) or 0):
+            return None, None
+        try:
+            meta = read_metadata(path)
+            entry = get_model(meta.registry_name)
+            module = entry.make(**meta.model_kwargs)
+            sig = meta.signatures[ModelMetadata.DEFAULT_SIGNATURE]
+            (_, spec), = sig.inputs.items()
+            sample = jnp.zeros((1, *spec.shape[1:]),
+                               _NP_DTYPES[spec.dtype])
+            template = jax.jit(
+                functools.partial(module.init, train=False))(
+                    jax.random.PRNGKey(0), sample)
+            variables = jax.device_put(read_variables(path, template))
+            return module, variables["params"]
+        except Exception as e:  # noqa: BLE001 — degrade, never fail
+            logger.warning(
+                "model %s: draft model load from %r failed (%s); "
+                "serving with vanilla decoding",
+                self.metadata.model_name, path, e)
+            return None, None
+
     def ensure_engine(self, name: Optional[str] = None,
                       queue_capacity: Optional[int] = None):
         """The version's continuous-batching decode engine
@@ -211,8 +247,11 @@ class LoadedModel:
         for predict/classify exports. Capacity knobs ride the export's
         ``generate_config`` (``engine_slots`` / ``engine_page_size`` /
         ``engine_slice_tokens`` / ``engine_num_pages``, plus
-        ``engine_prefix_cache`` for the cross-request prefix KV cache
-        — see docs/streaming.md)."""
+        ``engine_prefix_cache`` for the cross-request prefix KV
+        cache, ``engine_prefill_chunk`` for sliced long-prompt
+        admission, and ``engine_draft_tokens`` /
+        ``engine_draft_export`` for speculative decoding — see
+        docs/streaming.md)."""
         with self._engine_lock:
             if self._engine is not None:
                 return self._engine
@@ -231,10 +270,27 @@ class LoadedModel:
             config = EngineConfig.from_generate_config(
                 self.metadata.generate_config, spec.shape[1],
                 queue_capacity=queue_capacity)
-            self._engine = DecodeEngine(
-                self._module, self.variables["params"], config,
-                name=name or self.metadata.model_name,
-                mesh=self.mesh)
+            draft_model, draft_params = self._load_draft()
+            try:
+                self._engine = DecodeEngine(
+                    self._module, self.variables["params"], config,
+                    name=name or self.metadata.model_name,
+                    mesh=self.mesh, draft_model=draft_model,
+                    draft_params=draft_params)
+            except ValueError:
+                if draft_model is None:
+                    raise
+                # Incompatible draft (vocab/cache mismatch): the
+                # engine ctor rejected it. Degrade to vanilla — same
+                # policy as a failed load.
+                logger.warning(
+                    "model %s: draft model incompatible with "
+                    "verifier; serving with vanilla decoding",
+                    self.metadata.model_name, exc_info=True)
+                self._engine = DecodeEngine(
+                    self._module, self.variables["params"], config,
+                    name=name or self.metadata.model_name,
+                    mesh=self.mesh)
             return self._engine
 
     @property

@@ -223,7 +223,10 @@ def check_operator_wait_discipline() -> list:
     including prefix_cache.py (ISSUE 11): the prefix index runs ON
     the decode loop's thread, where a stray sleep or wall-clock read
     (LRU stamps must not ride NTP-steppable time) stalls or skews
-    every slot at once."""
+    every slot at once. The speculative draft lane (ISSUE 16) rides
+    the same thread — draft, verify, and rollback all happen inside
+    the slice cadence, so the glob keeps covering engine.py and
+    paged_kv.py as they grow spec-decode paths."""
     # Exempt: the operator's sanctioned wait path; the fault injector
     # (whose time.sleep IS the injected apiserver latency); and the
     # load-bench drivers (their sleeps pace the measurement harness,
@@ -545,9 +548,12 @@ def check_metric_label_discipline() -> list:
 # requests via args.batch (docs/observability.md "Batch linkage");
 # engine_slice / engine_compile are engine-timeline records no single
 # request owns (requests join them via their own engine_request
-# attribution); process_name is Chrome-trace metadata.
+# attribution); spec_verify is the verifier-forward share of an
+# engine_slice (ISSUE 16) — an engine-timeline record like its
+# parent slice; process_name is Chrome-trace metadata.
 DOCUMENTED_ROOT_SPANS = {"batch_execute", "engine_slice",
-                         "engine_compile", "process_name"}
+                         "engine_compile", "spec_verify",
+                         "process_name"}
 
 
 def check_span_discipline() -> list:

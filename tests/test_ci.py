@@ -55,7 +55,7 @@ def test_e2e_workflow_manifest():
     for step in ("checkout", "unit-test", "deploy-test", "tpujob-test",
                  "serving-test", "leader-failover-test",
                  "elastic-kill-test", "serving-chaos",
-                 "serving-tenancy", "teardown",
+                 "serving-tenancy", "spec-decode", "teardown",
                  "copy-artifacts", "e2e"):
         assert step in names, step
     dag = next(t for t in wf["spec"]["templates"] if t["name"] == "e2e")
@@ -66,6 +66,10 @@ def test_e2e_workflow_manifest():
     # Hermetic citests ride the checkout alone (no cluster deploy).
     assert deps["leader-failover-test"] == ["checkout"]
     assert deps["elastic-kill-test"] == ["checkout"]
+    assert deps["spec-decode"] == ["checkout"]
+    spec = next(t for t in wf["spec"]["templates"]
+                if t["name"] == "spec-decode")
+    assert "--speculative" in spec["container"]["command"]
     failover = next(t for t in wf["spec"]["templates"]
                     if t["name"] == "leader-failover-test")
     assert "kubeflow_tpu.citests.leader_failover" in \

@@ -144,6 +144,10 @@ def test_batch_stats_update(trained):
     assert any(float(jnp.abs(l).max()) > 1e-6 for l in leaves if l.ndim)
 
 
+# Throughput/profiler smokes compile a full train loop each and assert
+# no numerics — slow tier so tier-1 spends its budget on correctness
+# tests (ISSUE 16 suite-speed pass).
+@pytest.mark.slow
 def test_benchmark_smoke(cpu_devices):
     from kubeflow_tpu.training.benchmark import BenchConfig, run_benchmark
 
@@ -155,6 +159,7 @@ def test_benchmark_smoke(cpu_devices):
         result["images_per_sec"])
 
 
+@pytest.mark.slow
 def test_benchmark_profile_capture(cpu_devices, tmp_path):
     """--profile_dir writes an XPlane trace of the timed steps that the
     trace scanner (utils/traces.py — the dashboard's source) finds."""
@@ -181,6 +186,10 @@ def test_graft_entry_single(cpu_devices):
     assert out.shape == (8, 1000)
 
 
+# Spawns an 8-device child interpreter (full jax re-import + compile
+# under XLA_FLAGS device forcing) — by far the heaviest single test in
+# the file and exercises no numerics in-process: slow tier.
+@pytest.mark.slow
 def test_graft_dryrun_multichip(cpu_devices):
     import __graft_entry__ as graft
 

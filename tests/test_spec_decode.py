@@ -1,0 +1,479 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Speculative decoding + chunked prefill (ISSUE 16).
+
+The contracts under test:
+
+- Speculation is EXACT: a spec engine's output is bitwise equal to
+  the vanilla engine and the B=1 ``generate`` reference, greedy and
+  sampled, for strong drafts (high acceptance) and garbage drafts
+  (near-zero acceptance) alike — the draft only decides how many
+  verifier-sampled tokens land per forward, never which tokens.
+- Chunked prefill is EXACT: a long prompt admitted in page-aligned
+  slices produces the same stream as one-shot admission, and an
+  in-flight chunked prefill cannot stall a decoding neighbor beyond
+  one slice budget (the no-head-of-line property, white-box).
+- The multi-token append + rollback page accounting
+  (``extend_slot``/``truncate_slot``) keeps every allocator
+  invariant under randomized accept lengths × page boundaries ×
+  prefix pins × cancels, and drains to zero.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.inference.engine import DecodeEngine, EngineConfig
+from kubeflow_tpu.inference.engine.paged_kv import PagedKVCache
+from kubeflow_tpu.inference.engine.prefix_cache import PrefixCache
+from kubeflow_tpu.inference.generate import generate
+from kubeflow_tpu.models.llama import Llama, llama_test
+
+CACHE = 64
+MAX_PROMPT = 24
+NEW_TOKENS = 12
+K = 3
+
+
+@pytest.fixture(scope="module")
+def model():
+    return llama_test(dtype=jnp.float32, cache_size=CACHE)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    ids = jnp.zeros((1, 8), jnp.int32)
+    return model.init(jax.random.PRNGKey(0), ids)["params"]
+
+
+@pytest.fixture(scope="module")
+def weak_draft(model):
+    """A random tiny model sharing the verifier's vocab + cache
+    geometry (the compatibility contract) but nothing else — its
+    proposals are noise, pinning the exactness-under-rejection path."""
+    draft = Llama(vocab_size=model.vocab_size, num_layers=1,
+                  d_model=32, num_heads=2, num_kv_heads=1, mlp_dim=64,
+                  cache_size=CACHE, dtype=jnp.float32)
+    dparams = draft.init(jax.random.PRNGKey(9),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    return draft, dparams
+
+
+def _prompts(*lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 512, (n,)).astype(np.int32) for n in lengths]
+
+
+def _keys(n, base=700):
+    return [np.asarray(jax.random.PRNGKey(base + i)) for i in range(n)]
+
+
+def _reference(model, params, prompt, key, max_new_tokens, **sampling):
+    tokens, _ = generate(
+        model, params, jnp.asarray(prompt)[None, :],
+        max_new_tokens=max_new_tokens, rng=jnp.asarray(key)[None, :],
+        prompt_lengths=jnp.asarray([len(prompt)]), **sampling)
+    return np.asarray(tokens)[0]
+
+
+def _engine(model, params, *, draft=None, k=0, name="spec-test",
+            max_prompt=MAX_PROMPT, new_tokens=NEW_TOKENS, slots=3,
+            page_size=4, slice_tokens=4, **config):
+    draft_model, draft_params = draft if draft else (None, None)
+    return DecodeEngine(model, params, EngineConfig(
+        max_new_tokens=new_tokens, max_prompt_len=max_prompt,
+        num_slots=slots, page_size=page_size,
+        slice_tokens=slice_tokens, speculate_tokens=k, **config),
+        name=name, draft_model=draft_model, draft_params=draft_params)
+
+
+def _assert_pool_clean(engine):
+    st = engine.stats()
+    assert st["active_slots"] == 0, st
+    assert st["free_pages"] + st.get(
+        "prefix_cache", {}).get("cached_pages", 0) \
+        == st["total_pages"], f"leaked pages: {st}"
+    assert st["reserved_pages"] == 0, st
+
+
+# -- speculative decoding: exactness + acceptance economics ---------------
+
+
+def test_strong_draft_bitwise_greedy_with_high_acceptance(
+        model, params):
+    """Draft == verifier: the acceptance ceiling. Outputs stay
+    bitwise equal to the reference, acceptance is high, and each
+    slot needs fewer verifier forwards than tokens it emits."""
+    engine = _engine(model, params, draft=(model, params), k=K,
+                     name="spec-strong")
+    prompts = _prompts(5, 17, 9, seed=1)
+    keys = _keys(3)
+    emitted = 0
+    try:
+        streams = [engine.submit(p, rng=k)
+                   for p, k in zip(prompts, keys)]
+        for p, key, s in zip(prompts, keys, streams):
+            got = s.result(timeout=120)
+            emitted += len(got)
+            np.testing.assert_array_equal(
+                got, _reference(model, params, p, key, NEW_TOKENS))
+        spec = engine.stats()["spec"]
+        assert spec["k"] == K
+        assert spec["acceptance_rate"] > 0.5, spec
+        # Per-slot verifier economics: drafted increments exactly K
+        # per slot per round, so drafted/K is the slot-round count —
+        # the forwards a vanilla slot would have spent 1-per-token.
+        assert spec["drafted_tokens"] // K < emitted, spec
+        _assert_pool_clean(engine)
+    finally:
+        engine.stop()
+
+
+def test_strong_draft_bitwise_sampled(model, params):
+    """Sampled path: targets are drawn from VERIFIER logits with the
+    slot's own step keys, so the draws are bitwise the vanilla
+    schedule no matter what the draft proposed."""
+    sampling = dict(temperature=0.8, top_k=50)
+    engine = _engine(model, params, draft=(model, params), k=K,
+                     name="spec-strong-sampled", **sampling)
+    prompts = _prompts(7, 16, seed=2)
+    keys = _keys(2, base=720)
+    try:
+        streams = [engine.submit(p, rng=k)
+                   for p, k in zip(prompts, keys)]
+        for p, key, s in zip(prompts, keys, streams):
+            np.testing.assert_array_equal(
+                s.result(timeout=120),
+                _reference(model, params, p, key, NEW_TOKENS,
+                           **sampling))
+        assert engine.stats()["spec"]["acceptance_rate"] > 0.5
+        _assert_pool_clean(engine)
+    finally:
+        engine.stop()
+
+
+def test_weak_draft_stays_bitwise_at_near_zero_acceptance(
+        model, params, weak_draft):
+    engine = _engine(model, params, draft=weak_draft, k=K,
+                     name="spec-weak")
+    prompts = _prompts(5, 13, seed=3)
+    keys = _keys(2, base=740)
+    try:
+        streams = [engine.submit(p, rng=k)
+                   for p, k in zip(prompts, keys)]
+        for p, key, s in zip(prompts, keys, streams):
+            np.testing.assert_array_equal(
+                s.result(timeout=120),
+                _reference(model, params, p, key, NEW_TOKENS))
+        spec = engine.stats()["spec"]
+        # Garbage proposals: some rounds emit only the verifier's own
+        # token. Whatever the rate, output equality held above.
+        assert spec["drafted_tokens"] > 0
+        assert spec["acceptance_rate"] < 0.5, spec
+        _assert_pool_clean(engine)
+    finally:
+        engine.stop()
+
+
+def test_spec_knob_without_draft_degrades_to_vanilla(model, params):
+    """engine_draft_tokens > 0 but no draft weights: decode vanilla
+    with a warning, never fail (serving/model.py's degrade path)."""
+    engine = _engine(model, params, k=2, name="spec-degraded")
+    prompt, key = _prompts(6, seed=4)[0], _keys(1, base=760)[0]
+    try:
+        assert "spec" not in engine.stats()
+        np.testing.assert_array_equal(
+            engine.submit(prompt, rng=key).result(timeout=120),
+            _reference(model, params, prompt, key, NEW_TOKENS))
+    finally:
+        engine.stop()
+
+
+def test_incompatible_draft_rejected(model, params):
+    bad_vocab = Llama(vocab_size=model.vocab_size + 1, num_layers=1,
+                      d_model=32, num_heads=2, num_kv_heads=1,
+                      mlp_dim=64, cache_size=CACHE, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="vocab_size"):
+        _engine(model, params, draft=(bad_vocab, None), k=2)
+    bad_cache = Llama(vocab_size=model.vocab_size, num_layers=1,
+                      d_model=32, num_heads=2, num_kv_heads=1,
+                      mlp_dim=64, cache_size=CACHE + 4,
+                      dtype=jnp.float32)
+    with pytest.raises(ValueError, match="cache_size"):
+        _engine(model, params, draft=(bad_cache, None), k=2)
+
+
+def test_spec_metrics_and_spans_emitted(model, params):
+    """Satellite obs: the spec counter families land in the metrics
+    render and the split draft/verify attribution lands on the
+    engine_slice / spec_verify spans."""
+    from kubeflow_tpu.obs import metrics as obs_metrics
+    from kubeflow_tpu.obs import tracing
+
+    engine = _engine(model, params, draft=(model, params), k=K,
+                     name="spec-obs")
+    prompt, key = _prompts(8, seed=5)[0], _keys(1, base=780)[0]
+    try:
+        engine.submit(prompt, rng=key).result(timeout=120)
+    finally:
+        engine.stop()
+    text = obs_metrics.render()
+    for fam in ("kft_engine_spec_drafted_tokens_total",
+                "kft_engine_spec_accepted_tokens_total",
+                "kft_engine_spec_rejected_tokens_total"):
+        assert fam in text
+    spans = [s for s in tracing.TRACER.snapshot()
+             if (s.get("args") or {}).get("model") == "spec-obs"]
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    slice_span = by_name["engine_slice"][0]["args"]
+    assert slice_span["spec"] is True
+    assert slice_span["drafted"] >= K
+    assert slice_span["draft_ms"] >= 0.0
+    assert slice_span["verify_ms"] > 0.0
+    assert by_name["spec_verify"], "no spec_verify span"
+    req_span = by_name["engine_request"][0]["args"]
+    assert req_span["spec_drafted"] > 0
+    assert req_span["verify_ms"] > 0.0
+
+
+# -- chunked prefill: exactness + no-stall --------------------------------
+
+
+def test_chunked_prefill_bitwise_matches_one_shot(model, params):
+    """Sliced admission == one-shot admission == B=1 reference, for
+    prompts landing on and off page boundaries, greedy and sampled,
+    including a chunked admission joining mid-decode."""
+    for sampling in ({}, dict(temperature=0.8, top_k=50)):
+        tag = "s" if sampling else "g"
+        one_shot = _engine(model, params, name=f"chunk-ref-{tag}",
+                           page_size=8, prefix_cache=True, **sampling)
+        chunked = _engine(model, params, name=f"chunk-cut-{tag}",
+                          page_size=8, prefix_cache=True,
+                          prefill_chunk=8, **sampling)
+        prompts = _prompts(17, 24, 9, seed=6)  # straddle + exact + sub
+        keys = _keys(3, base=800)
+        try:
+            # Occupy a decode slot first so the chunked admissions
+            # interleave with live decode laps (the mid-decode join).
+            churn_key = _keys(1, base=820)[0]
+            churn = [e.submit(prompts[0], rng=churn_key)
+                     for e in (one_shot, chunked)]
+            for p, key in zip(prompts, keys):
+                want = _reference(model, params, p, key, NEW_TOKENS,
+                                  **sampling)
+                got_one = one_shot.submit(p, rng=key).result(120)
+                got_cut = chunked.submit(p, rng=key).result(120)
+                np.testing.assert_array_equal(got_cut, got_one)
+                np.testing.assert_array_equal(got_cut, want)
+            for s in churn:
+                s.result(120)
+            _assert_pool_clean(chunked)
+        finally:
+            one_shot.stop()
+            chunked.stop()
+
+
+def test_spec_and_chunked_prefill_compose_bitwise(model, params):
+    """Both ISSUE 16 features on one engine: a long chunked admission
+    joins while speculative rounds run, everything stays bitwise."""
+    engine = _engine(model, params, draft=(model, params), k=K,
+                     name="spec-chunk", page_size=8,
+                     prefix_cache=True, prefill_chunk=8)
+    prompts = _prompts(6, 21, seed=7)
+    keys = _keys(2, base=840)
+    try:
+        streams = [engine.submit(p, rng=k)
+                   for p, k in zip(prompts, keys)]
+        for p, key, s in zip(prompts, keys, streams):
+            np.testing.assert_array_equal(
+                s.result(timeout=120),
+                _reference(model, params, p, key, NEW_TOKENS))
+        assert engine.stats()["spec"]["rounds"] > 0
+        _assert_pool_clean(engine)
+    finally:
+        engine.stop()
+
+
+def test_chunked_4k_prompt_cannot_stall_decode_neighbor():
+    """The no-head-of-line acceptance: with a 4k-token prompt
+    admitted in 256-token chunks, a decoding neighbor's inter-token
+    gap stays bounded by ~one chunk+slice, NOT the whole prefill —
+    and the interleave compiles no new program (the chunk widths were
+    warmed; a full-batch recompile would show in compiled_programs)."""
+    cache = 4096 + NEW_TOKENS + 48
+    model = llama_test(dtype=jnp.float32, cache_size=cache)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = _engine(model, params, name="chunk-4k", slots=2,
+                     max_prompt=4096, page_size=64, slice_tokens=4,
+                     new_tokens=NEW_TOKENS, prefix_cache=True,
+                     prefill_chunk=256)
+    rng = np.random.RandomState(8)
+    short = rng.randint(0, 512, (16,)).astype(np.int32)
+    long_a = rng.randint(0, 512, (4096,)).astype(np.int32)
+    long_b = rng.randint(0, 512, (4096,)).astype(np.int32)
+    try:
+        # Warm every program off the clock: short decode + one full
+        # 4k chunked prefill; then drop its registered pages so the
+        # measured prefill pays all 16 chunks again.
+        engine.submit(short).result(timeout=600)
+        engine.submit(long_a).result(timeout=600)
+        engine.clear_prefix_cache()
+        programs_warm = engine.stats()["compiled_programs"]
+
+        stream_a = engine.submit(short)
+        first = stream_a.next_event(timeout=120)
+        assert first is not None
+        t_b0 = time.perf_counter()
+        stream_b = engine.submit(long_b)
+        gaps, last = [], time.perf_counter()
+        for ev in stream_a.events(timeout_per_event=120):
+            now = time.perf_counter()
+            gaps.append(now - last)
+            last = now
+            if ev.final:
+                break
+        assert stream_b.next_event(timeout=600) is not None
+        ttft_b = time.perf_counter() - t_b0
+        stream_a.result(120)
+        stream_b.result(600)
+
+        # Stalled-behind-the-prefill would make the worst decode gap
+        # ~the whole 16-chunk prefill (== B's TTFT); one-chunk
+        # interleave keeps it a small fraction.
+        assert max(gaps) < 0.5 * ttft_b, (max(gaps), ttft_b)
+        assert engine.stats()["compiled_programs"] == programs_warm, \
+            "interleaving a chunked prefill recompiled a program"
+        _assert_pool_clean(engine)
+    finally:
+        engine.stop()
+
+
+# -- run_prefill rides the engine thread: prefix index warms --------------
+
+
+def test_run_prefill_registers_and_hits_prefix_index(model, params):
+    """The old streaming.md limitation, removed: a prefill-role pool
+    (slot-less run_prefill) now registers its prompts in the prefix
+    index and HITS on repeats, and the handoff resumes bitwise on a
+    decode-role engine."""
+    engine = _engine(model, params, name="prefill-role", page_size=4,
+                     prefix_cache=True, prefill_chunk=8)
+    decode = _engine(model, params, name="decode-role", page_size=4,
+                     prefix_cache=True)
+    rng = np.random.RandomState(9)
+    base = rng.randint(0, 512, (12,)).astype(np.int32)
+    prompts = [np.concatenate([base, rng.randint(0, 512, (4,))
+                               .astype(np.int32)]) for _ in range(2)]
+    key = _keys(1, base=860)[0]
+    try:
+        handoffs = [engine.run_prefill(p, rng=key) for p in prompts]
+        stats = engine.stats()["prefix_cache"]
+        assert stats["hits"] > 0, \
+            f"prefill-role pool stayed cold: {stats}"
+        for p, handoff in zip(prompts, handoffs):
+            assert handoff.layout == "right"
+            np.testing.assert_array_equal(
+                decode.submit(handoff=handoff).result(timeout=120),
+                _reference(model, params, p, key, NEW_TOKENS))
+    finally:
+        engine.stop()
+        decode.stop()
+
+
+# -- multi-token append/rollback accounting fuzz --------------------------
+
+
+def test_append_truncate_fuzz_invariants_and_drain_to_zero():
+    """Randomized spec rounds over a tiny pool: admit (with prefix
+    pins) → repeated extend-by-(k+1)/accept-some/truncate cycles ×
+    random cancels, allocator + index invariants checked after EVERY
+    step, then drain to zero resident pages."""
+    rng = np.random.RandomState(16)
+    P, CACHE_SLOTS, SLOTS = 4, 24, 3
+    template = {"k": np.zeros((1, CACHE_SLOTS, 2, 2), np.float32),
+                "index": np.zeros((), np.int32)}
+    kv = PagedKVCache(template, num_slots=SLOTS, page_size=P,
+                      cache_size=CACHE_SLOTS, num_pages=14)
+    alloc = kv.allocator
+    cache = PrefixCache(P, alloc)
+    bases = [list(rng.randint(0, 50, (8,))) for _ in range(2)]
+    prompts = [b + list(rng.randint(0, 50, (rng.randint(0, 5),)))
+               for b in bases for _ in range(4)]
+    free_slots = list(range(SLOTS))
+    live = {}  # slot -> dict(allocated, budget, wpos, remaining)
+
+    def check():
+        alloc.check_invariants()
+        cache.check_invariants()
+
+    def try_admit(prompt):
+        remaining = int(rng.randint(2, 9))
+        budget = kv.pages_for(len(prompt) + remaining + K)
+        match = cache.pin(cache.match(prompt))
+        if not alloc.reserve(budget - len(match.entries)):
+            cache.unpin(match)
+            return False
+        cache.unpin_fork(match)
+        shared = len(match.entries)
+        idx = free_slots.pop()
+        kv.tables[idx, :shared] = match.shared_pages
+        allocated = kv.extend_slot(idx, shared, len(prompt), budget)
+        cache.register(prompt, kv.tables[idx, :allocated].tolist())
+        live[idx] = dict(allocated=allocated, budget=budget,
+                         wpos=len(prompt), remaining=remaining)
+        return True
+
+    def spec_round(idx):
+        s = live[idx]
+        s["allocated"] = kv.extend_slot(
+            idx, s["allocated"], s["wpos"] + K + 1, s["budget"])
+        take = min(int(rng.randint(1, K + 2)), s["remaining"])
+        s["wpos"] += take
+        s["remaining"] -= take
+        s["allocated"] = kv.truncate_slot(idx, s["allocated"],
+                                          s["wpos"])
+        if s["remaining"] == 0:
+            retire(idx)
+
+    def retire(idx):
+        s = live.pop(idx)
+        kv.release_slot(idx, s["allocated"],
+                        s["budget"] - s["allocated"])
+        free_slots.append(idx)
+
+    for _ in range(800):
+        op = rng.rand()
+        if op < 0.4 and free_slots:
+            try_admit(prompts[rng.randint(len(prompts))])
+        elif op < 0.9 and live:
+            spec_round(int(rng.choice(list(live))))
+        elif live:  # cancel mid-flight
+            retire(int(rng.choice(list(live))))
+        check()
+
+    for idx in list(live):
+        retire(idx)
+        check()
+    cache.clear()
+    check()
+    assert alloc.free_pages == 13, alloc.free_pages
+    assert alloc.reserved_pages == 0
+    assert not np.any(kv.tables), kv.tables
