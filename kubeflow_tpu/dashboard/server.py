@@ -1083,6 +1083,20 @@ def _fleet_section_html_unsafe(fleet) -> str:
         prefix = (f"Last autoscaler decision ({html.escape(label)})"
                   if label else "Last autoscaler decision")
         signal = str(d.get("signal", "queue_wait"))
+        # The decision's published inputs (docs/capacity.md): what the
+        # forecaster believed and which clamp bit, so a surprising
+        # scale event is explainable from this page alone.
+        inputs = d.get("inputs") or {}
+        extra = ""
+        forecast = inputs.get("forecast")
+        if isinstance(forecast, dict):
+            extra += (
+                f" Forecast: "
+                f"{float(forecast.get('rate_rps', 0.0)):.1f} rps "
+                f"at +{float(forecast.get('horizon_s', 0.0)):.0f}s "
+                f"→ {int(forecast.get('replicas', 0))} replicas.")
+        if inputs.get("clamp"):
+            extra += f" Clamp: {html.escape(str(inputs['clamp']))}."
         return (
             f"<p>{prefix}: <strong>"
             f"{html.escape(str(d.get('action', '-')))}</strong> "
@@ -1092,7 +1106,7 @@ def _fleet_section_html_unsafe(fleet) -> str:
             f"{float(d.get('mean_queue_wait_ms', 0.0)):.0f} ms vs "
             f"target "
             f"{float(d.get('target_queue_wait_ms', 0.0)):.0f} ms, "
-            f"{float(d.get('age_s', 0.0)):.0f}s ago.</p>")
+            f"{float(d.get('age_s', 0.0)):.0f}s ago.{extra}</p>")
 
     decisions = fleet.get("decisions")
     if isinstance(decisions, dict) and decisions:

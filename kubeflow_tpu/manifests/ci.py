@@ -190,6 +190,20 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
         "spec-decode": [
             py, f"{src}/bench.py", "--speculative",
         ],
+        # Fleet-sim gate (ISSUE 19): the trace-calibrated simulator
+        # sweep — record three closed-loop workloads against a stub
+        # fleet through the real router, calibrate the sim's service
+        # distribution from each recording (Little's law), and assert
+        # replayed p99 within 10% of measured for every workload; then
+        # replay a ramped traffic spike through the production
+        # autoscaler reactive vs predictive and assert predictive cuts
+        # time-over-SLO without exceeding the replica budget. Writes
+        # sim_validation.json under $KFT_OBS_DIR for the collect-obs
+        # sweep. Hermetic — sleep-based stub replicas + a pure
+        # deterministic sim, no cluster, no accelerator.
+        "fleet-sim": [
+            py, f"{src}/bench.py", "--sim",
+        ],
         # Trace-assembly gate (ISSUE 15): the distributed-tracing
         # sweep — a real proxy + two role-split servers + a span-
         # scraping collector; unary, SSE, role-split and hedged
@@ -259,6 +273,7 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             _dag_task("serving-chaos", ["checkout"]),
             _dag_task("serving-tenancy", ["checkout"]),
             _dag_task("spec-decode", ["checkout"]),
+            _dag_task("fleet-sim", ["checkout"]),
             _dag_task("trace-assembly", ["checkout"]),
             _dag_task("deploy-test", ["checkout"]),
             _dag_task("deploy-serving", ["deploy-test"]),

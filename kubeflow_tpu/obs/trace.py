@@ -53,6 +53,7 @@ __all__ = [
     "SERVER_ROOT_SPANS",
     "assemble",
     "attribution",
+    "export_workload",
     "waterfall_lines",
 ]
 
@@ -291,15 +292,78 @@ def _attribution_lines(report: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def export_workload(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Workload document for the fleet simulator (ISSUE 19): one row
+    per traced request — relative arrival seconds, request class
+    (model / tenant / phase hints) and the engine's EXACT service
+    attribution triple, so ``scaling/simulator.py`` can replay the
+    recorded traffic against a modeled fleet.
+
+    Arrival anchors are each trace's root span timestamp, proxy root
+    preferred: absolute ``ts`` values are only comparable within one
+    process, and a fleet's proxy roots all come from the proxy.
+    Traces anchored on different processes still export (a degraded
+    arrival order beats a dropped request), the first arrival defines
+    t=0."""
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for span in spans:
+        args = _args(span)
+        tid = args.get("trace_id") or args.get("request_id")
+        if tid:
+            by_trace.setdefault(str(tid), []).append(span)
+    rows: List[Dict[str, Any]] = []
+    for tid, tspans in by_trace.items():
+        root = None
+        for names in (PROXY_ROOT_SPANS, SERVER_ROOT_SPANS,
+                      frozenset({"engine_request"})):
+            anchored = [s for s in tspans if s.get("name") in names]
+            if anchored:
+                root = min(anchored, key=lambda s: _f(s.get("ts")))
+                break
+        if root is None:
+            continue
+        model = tenant = None
+        for span in tspans:
+            args = _args(span)
+            model = model or args.get("model")
+            tenant = tenant or args.get("tenant")
+        report = attribution(tspans)
+        buckets = report["buckets"]
+        rows.append({
+            "trace_id": tid,
+            "ts_us": _f(root.get("ts")),
+            "model": model,
+            "tenant": tenant,
+            "total_ms": report["total_ms"],
+            "queue_ms": buckets["queue_ms"],
+            "prefill_ms": buckets["prefill_ms"],
+            "decode_ms": buckets["decode_ms"],
+        })
+    rows.sort(key=lambda r: (r["ts_us"], r["trace_id"]))
+    t0 = rows[0]["ts_us"] if rows else 0.0
+    for row in rows:
+        row["arrival_s"] = round((row.pop("ts_us") - t0) / 1e6, 6)
+    return {"version": 1, "requests": rows}
+
+
 def _spans_from_file(path: str) -> List[Dict[str, Any]]:
     """Spans from a /tracez JSON document or a JSONL span dump."""
     with open(path) as f:
         text = f.read()
     text = text.strip()
+    events: Any = None
     if text.startswith("{"):
-        doc = json.loads(text)
-        events = doc.get("traceEvents", doc.get("spans", []))
-    else:
+        # A /tracez document is one JSON object; a JSONL dump's first
+        # line is ALSO an object, so fall through on trailing data.
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError:
+            pass
+        else:
+            events = doc.get("traceEvents", doc.get("spans", []))
+    elif text.startswith("["):
+        events = json.loads(text)
+    if events is None:
         events = [json.loads(line) for line in text.splitlines()
                   if line.strip()]
     return [e for e in events if e.get("ph", "X") == "X"]
@@ -327,6 +391,13 @@ def main(argv=None) -> int:
                              "JSONL file instead of the collector")
     parser.add_argument("--list", action="store_true",
                         help="list the trace ids the collector holds")
+    parser.add_argument("--export-workload", default=None,
+                        metavar="PATH", dest="export_workload",
+                        help="write a simulator workload JSON (one "
+                             "row per traced request: arrival time + "
+                             "class + exact service attribution) from "
+                             "ALL traces the collector (or --spans "
+                             "file) holds; see docs/capacity.md")
     parser.add_argument("--timeout", type=float, default=5.0)
     parser.add_argument("--json", action="store_true",
                         help="emit the assembled document as JSON")
@@ -339,6 +410,25 @@ def main(argv=None) -> int:
         for row in doc.get("traces", []):
             print(f"{row['trace_id']}  spans={row['spans']}")
         return 0
+    if args.export_workload:
+        if args.spans:
+            spans = _spans_from_file(args.spans)
+        else:
+            from urllib.parse import quote
+
+            doc = _fetch_json(f"{base}/traces", args.timeout)
+            spans = []
+            for row in doc.get("traces", []):
+                tid = quote(str(row["trace_id"]), safe="")
+                trace_doc = _fetch_json(
+                    f"{base}/trace?trace_id={tid}", args.timeout)
+                spans.extend(trace_doc.get("spans", []))
+        workload = export_workload(spans)
+        with open(args.export_workload, "w") as f:
+            json.dump(workload, f, indent=1, sort_keys=True)
+        print(f"wrote {len(workload['requests'])} request(s) to "
+              f"{args.export_workload}")
+        return 0 if workload["requests"] else 1
     if not args.trace_id:
         parser.error("a trace_id is required (or --list)")
     if args.spans:

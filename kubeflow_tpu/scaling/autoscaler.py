@@ -71,12 +71,14 @@ import logging
 import math
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from kubeflow_tpu.obs import metrics as obs_metrics
 from kubeflow_tpu.obs.collector import ScrapeTarget
 from kubeflow_tpu.obs.tracing import TRACER
+from kubeflow_tpu.scaling import policy
 from kubeflow_tpu.scaling.endpoints import (
     normalize_spec,
     scrape_healthz,
@@ -127,12 +129,46 @@ class AutoscalerConfig:
     signal: str = "queue_wait"
     #: Target mean slot occupancy when ``signal="slot_occupancy"``.
     target_slot_occupancy: float = 0.8
+    #: Predictive mode (ISSUE 19): fit a short-horizon arrival-rate
+    #: forecast from ``observe_arrivals`` samples and pre-scale AHEAD
+    #: of the ramp the reactive signal would only confirm after
+    #: queues build. The forecast only ever RAISES the reactive
+    #: ratio (``max(reactive, forecast)``), so every reactive clamp,
+    #: cooldown and hysteresis invariant still applies unchanged.
+    predictive: bool = False
+    #: How far past ``now`` the forecast is evaluated. Rule of thumb:
+    #: one replica cold-start (the lead time pre-scaling must buy).
+    forecast_horizon_s: float = 60.0
+    #: Sliding window of arrival samples the forecast fits over.
+    forecast_window_s: float = 300.0
+    #: Requests/s one replica sustains at target saturation — the
+    #: unit that converts a forecast rate into a replica count.
+    #: Calibrate from bench or the fleet simulator (docs/capacity.md).
+    replica_capacity_rps: float = 1.0
+    #: Allow the fleet to collapse to ZERO replicas after
+    #: ``idle_quiet_s`` of provable silence (predictive mode only —
+    #: waking needs a forecast to scale back up on). Requires
+    #: ``min_replicas=0``.
+    scale_to_zero: bool = False
+    #: Silence (no arrivals, no queue, no shedding) required before a
+    #: scale-to-zero decision.
+    idle_quiet_s: float = 300.0
 
     def validate(self) -> None:
-        if not (1 <= self.min_replicas <= self.max_replicas):
+        floor = 0 if (self.scale_to_zero and self.predictive) else 1
+        if not (floor <= self.min_replicas <= self.max_replicas):
             raise ValueError(
-                f"need 1 <= min_replicas <= max_replicas, got "
+                f"need {floor} <= min_replicas <= max_replicas, got "
                 f"{self.min_replicas}..{self.max_replicas}")
+        if self.scale_to_zero and not self.predictive:
+            raise ValueError(
+                "scale_to_zero requires predictive=True (waking a "
+                "zero-replica fleet needs the arrival forecast)")
+        if self.predictive and self.replica_capacity_rps <= 0:
+            raise ValueError(
+                "predictive mode needs replica_capacity_rps > 0")
+        if self.predictive and self.forecast_horizon_s <= 0:
+            raise ValueError("forecast_horizon_s must be > 0")
         if self.target_queue_wait_ms <= 0:
             raise ValueError("target_queue_wait_ms must be > 0")
         if not (0 < self.hysteresis < 1):
@@ -192,6 +228,26 @@ class Autoscaler:
         self._last_up_at: Optional[float] = None
         self._last_action_at: Optional[float] = None
         self.last_decision: Optional[Dict[str, Any]] = None
+        # (t, requests/s) observations the predictive forecast fits
+        # over; bounded by forecast_window_s at evaluate time.
+        self._arrivals: deque = deque(maxlen=4096)
+        self._idle_since: Optional[float] = None
+
+    def observe_arrivals(self, rate_rps: float,
+                         now: Optional[float] = None) -> None:
+        """Feed one fleet arrival-rate observation (requests/s over
+        the caller's sampling interval) into the forecast window. The
+        loop calls this from the collector's request-counter rates;
+        the simulator calls it from its modeled arrival stream."""
+        now = self._clock() if now is None else now
+        self._arrivals.append((now, max(0.0, float(rate_rps))))
+
+    def _arrival_samples(self, now: float
+                         ) -> List[Tuple[float, float]]:
+        window = self.config.forecast_window_s
+        while self._arrivals and now - self._arrivals[0][0] > window:
+            self._arrivals.popleft()
+        return list(self._arrivals)
 
     def evaluate(self, replica_metrics: Sequence[Dict[str, Any]],
                  now: Optional[float] = None, *,
@@ -214,9 +270,15 @@ class Autoscaler:
         now = self._clock() if now is None else now
         current = self.scaler.get_replicas()
         t0 = now
+        # The decision's INPUTS ride along in the published record so
+        # a surprising scale event is explainable from the dashboard:
+        # which signal values produced it, what the forecast said (if
+        # predictive), and which clamp bit.
+        inputs: Dict[str, Any] = {}
 
         def decide(action: str, desired: int, reason: str,
-                   mean_wait: float, ratio: float) -> Dict[str, Any]:
+                   mean_wait: float, ratio: float,
+                   clamp: Optional[str] = None) -> Dict[str, Any]:
             decision = {
                 "at_monotonic": now,
                 "current": current,
@@ -229,6 +291,7 @@ class Autoscaler:
                 "ratio": round(ratio, 4),
                 "replicas_reporting": len(replica_metrics),
                 "replicas_unreachable": unreachable,
+                "inputs": dict(inputs, clamp=clamp),
             }
             _C_DECISIONS.labels(action).inc()
             _G_DESIRED.set(float(desired))
@@ -253,10 +316,38 @@ class Autoscaler:
                     float(m.get("slot_occupancy", 1.0))
                     for m in replica_metrics) / len(replica_metrics)
                 ratio = occupancy / cfg.target_slot_occupancy
+                inputs["slot_occupancy"] = round(occupancy, 4)
             else:
                 ratio = mean_wait / cfg.target_queue_wait_ms
         else:
             mean_wait = shed_rate = ratio = 0.0
+        inputs["mean_queue_wait_ms"] = round(mean_wait, 3)
+        inputs["shed_rate"] = round(shed_rate, 4)
+
+        # Predictive pre-scaling (ISSUE 19): fit the arrival forecast
+        # BEFORE any branch so both the wake-from-zero path and the
+        # ratio merge below see it, and so every decision record
+        # carries what the forecaster believed.
+        forecast_replicas = 0
+        recent_rate = 0.0
+        if cfg.predictive:
+            samples = self._arrival_samples(now)
+            recent_rate = samples[-1][1] if samples else 0.0
+            forecast_rate = policy.fit_arrival_forecast(
+                samples, cfg.forecast_horizon_s, now=now)
+            forecast_replicas = policy.forecast_desired_replicas(
+                forecast_rate, cfg.replica_capacity_rps)
+            inputs["forecast"] = {
+                "rate_rps": round(forecast_rate, 4),
+                "horizon_s": cfg.forecast_horizon_s,
+                "replicas": forecast_replicas,
+                "samples": len(samples),
+            }
+        busy = mean_wait > 0 or shed_rate > 0 or recent_rate > 0
+        if busy:
+            self._idle_since = None
+        elif self._idle_since is None:
+            self._idle_since = now
         # min/max are hard clamps on the FLEET, not just on decisions:
         # enforce them before (and regardless of) any load math —
         # even blind, and without cooldown gating. The load branches
@@ -270,12 +361,32 @@ class Autoscaler:
             self.scaler.set_replicas(cfg.min_replicas)
             self._last_up_at = self._last_action_at = now
             return decide("scale_up", cfg.min_replicas,
-                          "below_min_replicas", mean_wait, ratio)
+                          "below_min_replicas", mean_wait, ratio,
+                          clamp="min_replicas")
         if current > cfg.max_replicas:
             self.scaler.set_replicas(cfg.max_replicas)
             self._last_action_at = now
             return decide("scale_down", cfg.max_replicas,
-                          "above_max_replicas", mean_wait, ratio)
+                          "above_max_replicas", mean_wait, ratio,
+                          clamp="max_replicas")
+        if current == 0:
+            # Scaled-to-zero fleet (min_replicas=0, predictive): wake
+            # the moment the forecast (or the raw recent rate — one
+            # request must not wait a full fit) shows demand. The
+            # double-up clamp is meaningless from zero; the forecast
+            # count bounded by max_replicas is the wake size.
+            if forecast_replicas > 0 or recent_rate > 0:
+                desired = min(max(1, forecast_replicas),
+                              cfg.max_replicas)
+                self.scaler.set_replicas(desired)
+                self._last_up_at = self._last_action_at = now
+                return decide("scale_up", desired, "wake_from_zero",
+                              mean_wait, ratio,
+                              clamp=("max_replicas"
+                                     if forecast_replicas
+                                     > cfg.max_replicas else None))
+            return decide("hold", 0, "scaled_to_zero", mean_wait,
+                          ratio)
         if not replica_metrics:
             return decide("hold", current, "no_replica_metrics", 0.0, 0.0)
         reason = "queue_wait"
@@ -285,22 +396,36 @@ class Autoscaler:
             # overloaded). Escalate to at least one step up.
             ratio = max(ratio, 1.0 + cfg.hysteresis + 0.01)
             reason = "shedding"
+        if forecast_replicas > current:
+            # The forecast only ever RAISES the reactive ratio, so
+            # the clamps/cooldowns/hysteresis below apply to the
+            # merged signal unchanged — predictive mode cannot shrink
+            # a fleet the reactive law would keep.
+            pred_ratio = forecast_replicas / float(current)
+            if pred_ratio > ratio:
+                ratio = pred_ratio
+                reason = "forecast"
 
         if ratio > 1.0 + cfg.hysteresis:
-            desired = math.ceil(current * ratio)
-            desired = min(desired, current * 2, cfg.max_replicas)
+            raw = math.ceil(current * ratio)
+            desired = min(raw, current * 2, cfg.max_replicas)
+            clamp = None
+            if desired < raw:
+                clamp = ("max_replicas"
+                         if desired == cfg.max_replicas else "double_up")
             desired = max(desired, min(current + 1, cfg.max_replicas))
             if desired <= current:
                 return decide("hold", current, "at_max_replicas",
-                              mean_wait, ratio)
+                              mean_wait, ratio, clamp="max_replicas")
             if (self._last_up_at is not None
                     and now - self._last_up_at
                     < cfg.scale_up_cooldown_s):
                 return decide("hold", current, "scale_up_cooldown",
-                              mean_wait, ratio)
+                              mean_wait, ratio, clamp=clamp)
             self.scaler.set_replicas(desired)
             self._last_up_at = self._last_action_at = now
-            return decide("scale_up", desired, reason, mean_wait, ratio)
+            return decide("scale_up", desired, reason, mean_wait,
+                          ratio, clamp=clamp)
 
         if ratio < 1.0 - cfg.hysteresis:
             if unreachable > 0:
@@ -310,27 +435,50 @@ class Autoscaler:
                 # LIVE pods and compound it.
                 return decide("hold", current, "unreachable_replicas",
                               mean_wait, ratio)
-            desired = max(math.ceil(current * ratio), cfg.min_replicas)
+            if (cfg.scale_to_zero and cfg.min_replicas == 0
+                    and forecast_replicas == 0 and not busy
+                    and self._idle_since is not None
+                    and now - self._idle_since >= cfg.idle_quiet_s):
+                # Scale-to-zero is an explicit verdict, not the halve
+                # clamp's limit: idle_quiet_s of provable silence (no
+                # arrivals, queue, shed — and no forecast demand)
+                # justifies full collapse; anything less holds the
+                # normal floor below.
+                if (self._last_action_at is not None
+                        and now - self._last_action_at
+                        < cfg.scale_down_cooldown_s):
+                    return decide("hold", current,
+                                  "scale_down_cooldown", mean_wait,
+                                  ratio)
+                self.scaler.set_replicas(0)
+                self._last_action_at = now
+                return decide("scale_down", 0, "scale_to_zero",
+                              mean_wait, ratio)
+            desired = max(math.ceil(current * ratio),
+                          max(cfg.min_replicas, 1))
             # Symmetric step clamp: one decision may at most HALVE
             # the fleet, as scale-up may at most double it. A single
             # zero-queue sample (a scrape landing between dispatches)
             # must not collapse max→min in one write when cold
             # replicas take minutes to come back.
+            clamp = None
+            if desired < math.ceil(current / 2):
+                clamp = "halve_down"
             desired = max(desired, math.ceil(current / 2))
             if desired >= current:
                 return decide("hold", current, "at_min_replicas",
-                              mean_wait, ratio)
+                              mean_wait, ratio, clamp="min_replicas")
             # Downscale needs quiet since ANY action: an up followed
             # promptly by a down is oscillation, not control.
             if (self._last_action_at is not None
                     and now - self._last_action_at
                     < cfg.scale_down_cooldown_s):
                 return decide("hold", current, "scale_down_cooldown",
-                              mean_wait, ratio)
+                              mean_wait, ratio, clamp=clamp)
             self.scaler.set_replicas(desired)
             self._last_action_at = now
             return decide("scale_down", desired, reason, mean_wait,
-                          ratio)
+                          ratio, clamp=clamp)
 
         return decide("hold", current, "within_hysteresis_band",
                       mean_wait, ratio)
@@ -578,10 +726,21 @@ class AutoscalerLoop:
         healthz path — the decision core can't tell the difference)."""
         from kubeflow_tpu.obs.collector import fleet_replica_rows
 
+        now = time.monotonic()
         fleet = fleet_replica_rows(self.collector, specs)
         metrics = [row for row in fleet if row.get("reachable")]
+        if self.autoscaler.config.predictive:
+            # Forecast input: the fleet-wide request rate from the
+            # collector's r13 store (restart-clamped, cross-replica) —
+            # the same series the SLO evaluator burns against.
+            store = getattr(self.collector, "store", self.collector)
+            window = max(4 * self.interval_s, 10.0)
+            rate = store.sum_rate("kft_tenant_requests_total",
+                                  window, now)
+            if rate is not None:
+                self.autoscaler.observe_arrivals(rate, now=now)
         decision = self.autoscaler.evaluate(
-            metrics, now=time.monotonic(),
+            metrics, now=now,
             unreachable=len(fleet) - len(metrics))
         self.last_fleet = fleet
         if publish:
@@ -828,6 +987,23 @@ def main(argv=None) -> int:
     parser.add_argument("--scale_down_cooldown", type=float,
                         default=60.0)
     parser.add_argument("--interval", type=float, default=2.0)
+    parser.add_argument("--predictive", action="store_true",
+                        help="pre-scale on a short-horizon arrival "
+                             "forecast (runbook: docs/capacity.md)")
+    parser.add_argument("--forecast_horizon", type=float, default=60.0,
+                        help="seconds ahead the forecast is evaluated "
+                             "(one replica cold-start)")
+    parser.add_argument("--replica_capacity_rps", type=float,
+                        default=1.0,
+                        help="requests/s one replica sustains at "
+                             "target saturation (calibrate with "
+                             "bench.py --sim)")
+    parser.add_argument("--scale_to_zero", action="store_true",
+                        help="collapse an idle fleet to 0 replicas "
+                             "(predictive only; pair with "
+                             "--min_replicas 0)")
+    parser.add_argument("--idle_quiet", type=float, default=300.0,
+                        help="seconds of silence before scale-to-zero")
     parser.add_argument("--write_endpoints", default=None,
                         help="atomically rewrite this JSON file with "
                              "the discovered membership each cycle "
@@ -865,7 +1041,12 @@ def main(argv=None) -> int:
             scale_up_cooldown_s=args.scale_up_cooldown,
             scale_down_cooldown_s=args.scale_down_cooldown,
             signal=signal,
-            target_slot_occupancy=args.target_slot_occupancy)
+            target_slot_occupancy=args.target_slot_occupancy,
+            predictive=args.predictive,
+            forecast_horizon_s=args.forecast_horizon,
+            replica_capacity_rps=args.replica_capacity_rps,
+            scale_to_zero=args.scale_to_zero,
+            idle_quiet_s=args.idle_quiet)
 
     def make_discover(deployment: str):
         selector: Dict[str, Optional[str]] = {"app": deployment}

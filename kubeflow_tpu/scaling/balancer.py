@@ -47,7 +47,12 @@ whose REST breaker is open-and-not-yet-due, but degrade gracefully —
 when the filter empties the candidate set, fall back to the least-bad
 tier rather than refusing to route (a fleet that is all-ejected must
 still place probe traffic, or nothing ever readmits without the
-prober)."""
+prober).
+
+The pick *decisions* are pure functions in scaling/policy.py
+(ISSUE 19) — the classes here own only the rotating pick counters and
+delegate, so the fleet simulator routes with the same code production
+does."""
 
 from __future__ import annotations
 
@@ -55,6 +60,7 @@ import hashlib
 import threading
 from typing import Any, List, Optional, Sequence
 
+from kubeflow_tpu.scaling import policy
 from kubeflow_tpu.scaling.endpoints import Endpoint, EndpointPool
 
 __all__ = [
@@ -170,7 +176,8 @@ class RoundRobinBalancer(Balancer):
              prefix_key: Optional[str] = None) -> Optional[Endpoint]:
         if not candidates:
             return None
-        return candidates[self._next_index(len(candidates))]
+        return policy.pick_round_robin(
+            candidates, self._next_index(len(candidates)))
 
 
 class LeastSaturationBalancer(Balancer):
@@ -182,11 +189,9 @@ class LeastSaturationBalancer(Balancer):
              prefix_key: Optional[str] = None) -> Optional[Endpoint]:
         if not candidates:
             return None
-        offset = self._next_index(len(candidates))  # rotating tiebreak
-        return min(
-            (candidates[(offset + i) % len(candidates)]
-             for i in range(len(candidates))),
-            key=lambda ep: ep.saturation_score())
+        return policy.pick_least_saturated(
+            candidates,
+            offset=self._next_index(len(candidates)))  # rotating tiebreak
 
 
 class ResidentAffinityBalancer(Balancer):
@@ -200,7 +205,6 @@ class ResidentAffinityBalancer(Balancer):
     def __init__(self, overload_ms: float = 500.0):
         super().__init__()
         self.overload_ms = overload_ms
-        self._fallback = LeastSaturationBalancer()
 
     def pick(self, candidates: Sequence[Endpoint],
              model: Optional[str] = None,
@@ -208,13 +212,10 @@ class ResidentAffinityBalancer(Balancer):
              prefix_key: Optional[str] = None) -> Optional[Endpoint]:
         if not candidates:
             return None
-        if model:
-            resident = [ep for ep in candidates
-                        if model in ep.saturation
-                        and ep.saturation_score() < self.overload_ms]
-            if resident:
-                return self._fallback.pick(resident, model)
-        return self._fallback.pick(candidates, model)
+        offset = self._next_index(len(candidates))
+        return policy.pick_resident_affinity(
+            candidates, model, self.overload_ms,
+            offset=offset, fallback_offset=offset)
 
 
 class PrefixAffinityBalancer(Balancer):
@@ -238,15 +239,10 @@ class PrefixAffinityBalancer(Balancer):
     def __init__(self, overload_ms: float = 500.0):
         super().__init__()
         self.overload_ms = overload_ms
-        self._fallback = LeastSaturationBalancer()
 
-    @staticmethod
-    def _weight(prefix_key: str, address: str) -> int:
-        h = hashlib.blake2b(digest_size=8)
-        h.update(prefix_key.encode("utf-8"))
-        h.update(b"\x00")
-        h.update(address.encode("utf-8"))
-        return int.from_bytes(h.digest(), "little")
+    # Kept as an alias: tests and external callers probe the
+    # placement function directly to prove stability under churn.
+    _weight = staticmethod(policy.rendezvous_weight)
 
     def pick(self, candidates: Sequence[Endpoint],
              model: Optional[str] = None,
@@ -254,13 +250,9 @@ class PrefixAffinityBalancer(Balancer):
              prefix_key: Optional[str] = None) -> Optional[Endpoint]:
         if not candidates:
             return None
-        if prefix_key:
-            home = max(candidates,
-                       key=lambda ep: self._weight(prefix_key,
-                                                   ep.address))
-            if home.saturation_score() < self.overload_ms:
-                return home
-        return self._fallback.pick(candidates, model)
+        return policy.pick_prefix_affinity(
+            candidates, prefix_key, self.overload_ms,
+            fallback_offset=self._next_index(len(candidates)))
 
 
 class RoleAwareBalancer(Balancer):
@@ -280,8 +272,6 @@ class RoleAwareBalancer(Balancer):
     def __init__(self, overload_ms: float = 500.0):
         super().__init__()
         self.overload_ms = overload_ms
-        self._fallback = LeastSaturationBalancer()
-        self._prefix = PrefixAffinityBalancer(overload_ms)
 
     def pick(self, candidates: Sequence[Endpoint],
              model: Optional[str] = None,
@@ -289,29 +279,15 @@ class RoleAwareBalancer(Balancer):
              prefix_key: Optional[str] = None) -> Optional[Endpoint]:
         if not candidates:
             return None
-        if phase:
-            matching = [ep for ep in candidates
-                        if ep.serves_phase(phase)]
-            healthy = [ep for ep in matching
-                       if ep.saturation_score() < self.overload_ms]
-            if healthy:
-                # Prefix affinity INSIDE the role pool (ISSUE 11):
-                # the decode hop carries the request's prefix key, and
-                # decode replicas are where adopted pages live —
-                # rendezvous-place within the healthy matching set so
-                # repeat-prefix traffic finds its cache (the inner
-                # policy degrades to least-saturation when keyless or
-                # when the home replica is overloaded).
-                return self._prefix.pick(healthy, model,
-                                         prefix_key=prefix_key)
-            if matching:
-                # Whole pool overloaded: still prefer the role pool
-                # unless the rest of the fleet has headroom.
-                rest = [ep for ep in candidates
-                        if ep.saturation_score() < self.overload_ms]
-                pool = rest or matching
-                return self._fallback.pick(pool, model)
-        return self._fallback.pick(candidates, model)
+        # Prefix affinity rides INSIDE the role pool (ISSUE 11): the
+        # decode hop carries the request's prefix key, and decode
+        # replicas are where adopted pages live — the pure policy
+        # rendezvous-places within the healthy matching set and
+        # degrades to least-saturation when keyless, overloaded, or
+        # role-starved (specialization never beats availability).
+        return policy.pick_role_aware(
+            candidates, phase, prefix_key, self.overload_ms,
+            fallback_offset=self._next_index(len(candidates)))
 
 
 _POLICIES = {

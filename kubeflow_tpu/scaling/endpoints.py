@@ -57,6 +57,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from kubeflow_tpu.obs import metrics as obs_metrics
 from kubeflow_tpu.obs.tracing import TRACER
+from kubeflow_tpu.scaling import policy
 
 logger = logging.getLogger(__name__)
 
@@ -374,14 +375,10 @@ class Endpoint:
         were routed here: the healthz-reported per-model estimate
         (queue_depth × est_batch_latency_ms, summed — one accelerator
         serializes all models) plus this proxy's own in-flight count
-        priced at one batch latency each. Lower = emptier."""
-        probe_ms = 0.0
-        latency_ms = 1.0
-        for stats in self.saturation.values():
-            batch_ms = float(stats.get("est_batch_latency_ms", 0.0))
-            latency_ms = max(latency_ms, batch_ms)
-            probe_ms += float(stats.get("queue_depth", 0.0)) * batch_ms
-        return probe_ms + self.inflight * latency_ms
+        priced at one batch latency each. Lower = emptier. The
+        arithmetic is the pure policy's (scaling/policy.py) — the
+        simulator scores its modeled replicas with the same code."""
+        return policy.saturation_score(self.saturation, self.inflight)
 
     def mark_probe_success(self, payload: Dict[str, Any],
                            now: Optional[float] = None) -> bool:
@@ -752,35 +749,31 @@ class BrownoutPolicy:
         #: and a streaming-only fleet never produces them anyway.
         self.stall_quiet_s = stall_quiet_s
 
-    @staticmethod
-    def _median(values: List[float]) -> float:
-        values = sorted(values)
-        n = len(values)
-        mid = n // 2
-        return (values[mid] if n % 2
-                else (values[mid - 1] + values[mid]) / 2.0)
+    _median = staticmethod(policy.median)
 
     def threshold_s(self, pool: EndpointPool) -> Optional[float]:
         """The pool-relative outlier bar: median(p50) + k × MAD
         (MAD floored — a microsecond-uniform pool must not convict
         nanosecond noise), and never below ``min_ratio`` × the pool
         median (a replica twice as slow as an already-slow pool is
-        load skew, not a brownout)."""
+        load skew, not a brownout). The arithmetic is the pure
+        policy's (scaling/policy.py) over the routable members'
+        latency medians."""
         p50s = [p for ep in pool.endpoints()
                 if ep.routable()
                 and (p := ep.latency_p50(
                     min_samples=self.min_samples)) is not None]
-        if len(p50s) < 2:
-            return None
-        med = self._median(p50s)
-        mad = self._median([abs(p - med) for p in p50s])
-        return max(med + self.k * max(mad, self.mad_floor_s),
-                   med * self.min_ratio)
+        return policy.brownout_threshold_s(
+            p50s, k=self.k, mad_floor_s=self.mad_floor_s,
+            min_ratio=self.min_ratio)
 
-    def evaluate(self, pool: EndpointPool) -> None:
+    def evaluate(self, pool: EndpointPool,
+                 now: Optional[float] = None) -> None:
         """One sweep: convict new outliers (floor-vetoed), readmit
         recovered ones. Called from the prober after each probe
-        cycle."""
+        cycle. ``now`` is injectable (simulator/tests); production
+        omits it and rides the monotonic clock."""
+        now = time.monotonic() if now is None else now
         members = [ep for ep in pool.endpoints() if ep.routable()]
         if not members:
             return
@@ -790,13 +783,13 @@ class BrownoutPolicy:
                             // 1)))  # ceil
         for ep in members:
             if ep.soft_ejected:
-                self._maybe_readmit(ep, threshold)
+                self._maybe_readmit(ep, threshold, now=now)
                 continue
             p50 = ep.latency_p50(min_samples=self.min_samples)
-            slow = (threshold is not None and p50 is not None
-                    and p50 > threshold)
-            stalled = ep.recent_stalls() >= self.stall_strikes
-            if not (slow or stalled):
+            slow, convict = policy.brownout_should_convict(
+                p50, threshold, ep.recent_stalls(now=now),
+                stall_strikes=self.stall_strikes)
+            if not convict:
                 continue
             if bright - 1 < floor:
                 # Vetoed: ejecting would hollow out the pool below
@@ -816,17 +809,17 @@ class BrownoutPolicy:
                     "threshold=%s stalls=%d", ep.address,
                     f"{p50 * 1e3:.1f}ms" if p50 else None,
                     f"{threshold * 1e3:.1f}ms" if threshold else None,
-                    ep.recent_stalls())
+                    ep.recent_stalls(now=now))
                 TRACER.record(
-                    "endpoint_soft_eject", "router", time.monotonic(),
+                    "endpoint_soft_eject", "router", now,
                     0.0, {"endpoint": ep.address,
                           "p50_ms": round((p50 or 0.0) * 1e3, 1),
-                          "stalls": ep.recent_stalls()})
+                          "stalls": ep.recent_stalls(now=now)})
 
     def _maybe_readmit(self, ep: Endpoint,
-                       threshold: Optional[float]) -> None:
-        if ep.recent_stalls() > 0:
-            return  # stall evidence must fully decay before readmit
+                       threshold: Optional[float],
+                       now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
         if not ep.eject_was_slow:
             # Stall-only conviction: recovery is stall SILENCE, not a
             # latency ratio — latency samples can't speak to wedged
@@ -837,10 +830,10 @@ class BrownoutPolicy:
             # wedges streams, two fresh strikes re-convict it and any
             # stalled stream resumes on a peer — the client impact of
             # a wrong readmit is bounded by the resume machinery.
-            now = time.monotonic()
-            if (ep.soft_ejected_at is not None
-                    and now - ep.soft_ejected_at >= self.stall_quiet_s
-                    and ep.soft_readmit()):
+            if policy.brownout_should_readmit_stall(
+                    ep.soft_ejected_at, ep.recent_stalls(now=now),
+                    now, stall_quiet_s=self.stall_quiet_s) \
+                    and ep.soft_readmit():
                 logger.info("endpoint %s soft-readmitted (stall-free "
                             "for %.0fs)", ep.address,
                             now - (ep.soft_ejected_at or now))
@@ -848,12 +841,12 @@ class BrownoutPolicy:
                     "endpoint_soft_readmit", "router", now, 0.0,
                     {"endpoint": ep.address, "reason": "stall_quiet"})
             return
+        if ep.recent_stalls(now=now) > 0:
+            return  # stall evidence must fully decay before readmit
         if ep.samples_since_eject < self.recover_samples:
             return
         recent = ep.latency_p50(min_samples=self.recover_samples,
                                 last=ep.samples_since_eject)
-        if recent is None:
-            return
         # With no pool threshold (pool too small/quiet to judge —
         # the threshold needs 2 bright replicas, so a 2-member pool
         # with one ejected can never re-derive it), judge against the
@@ -863,14 +856,15 @@ class BrownoutPolicy:
         # p50 × ratio would become unsatisfiable once the window
         # fills post-eject.
         bar = threshold if threshold is not None else ep.eject_threshold_s
-        if bar is not None and recent <= bar * self.recover_ratio:
+        if policy.brownout_should_readmit_latency(
+                recent, bar, recover_ratio=self.recover_ratio):
             if ep.soft_readmit():
                 logger.info("endpoint %s soft-readmitted (recovered: "
                             "recent p50 %.1fms)", ep.address,
                             recent * 1e3)
                 TRACER.record(
                     "endpoint_soft_readmit", "router",
-                    time.monotonic(), 0.0,
+                    now, 0.0,
                     {"endpoint": ep.address,
                      "recent_p50_ms": round(recent * 1e3, 1)})
 

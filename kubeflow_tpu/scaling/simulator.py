@@ -1,0 +1,593 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Deterministic discrete-event fleet simulator (ISSUE 19).
+
+Replays a workload — arrival times + request classes extracted from
+assembled traces (``kft-trace --export-workload``) or synthetic
+mixes — against a modeled fleet of replicas × roles × slots, with
+service-time distributions calibrated from the collector's histograms
+and the engine's queue/prefill/decode attribution triples. What-if
+questions ("will 2× traffic hold SLO?", "does predictive pre-scaling
+beat reactive on this spike?") answer in seconds of CPU instead of
+cluster-hours — the evaluation methodology of PAPERS 2602.04900 run
+continuously against a modeled fleet.
+
+The sim routes with the SAME policy code production runs: replicas
+satisfy the endpoint-snapshot protocol (``saturation`` / ``inflight``
+/ ``address`` / ``serves_phase``) that :mod:`scaling.policy`'s pure
+pick functions consume, and the autoscaler-in-the-loop is the
+production :class:`~kubeflow_tpu.scaling.autoscaler.Autoscaler` with
+an injected clock — a sim result is evidence about the deployed
+policies, not about a reimplementation.
+
+Determinism is the contract (and a test): no wall-clock reads, one
+injected ``random.Random(seed)``, events ordered by ``(time, seq)``.
+Two runs with the same seed produce identical event logs.
+``scripts/lint.py check_sim_purity`` enforces the no-wall-clock /
+no-global-rng rule statically.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from kubeflow_tpu.scaling import policy
+
+__all__ = [
+    "FleetSimulator",
+    "ServiceModel",
+    "SimReplica",
+    "SimRequest",
+    "SimResult",
+    "SimScaler",
+    "Workload",
+    "percentile",
+]
+
+
+def percentile(values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over ``q`` in percent — the exact
+    convention of the bench driver's ``_pct`` (index ``int(q·n)``
+    clamped), so sim-vs-measured comparisons never disagree about
+    what "p99" means."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    return ordered[min(len(ordered) - 1,
+                       int(q / 100.0 * len(ordered)))]
+
+
+@dataclass
+class SimRequest:
+    """One modeled request. ``service_s`` pins the service time (a
+    trace replay carries the engine's exact attribution); None samples
+    from the replica's :class:`ServiceModel`."""
+
+    arrival_s: float
+    model: Optional[str] = None
+    phase: Optional[str] = None
+    prefix_key: Optional[str] = None
+    tenant: Optional[str] = None
+    service_s: Optional[float] = None
+
+
+@dataclass
+class Workload:
+    """The traffic the sim replays.
+
+    Open-loop: ``requests`` arrive at their recorded times whatever
+    the fleet does (the spike does not slow down because you queued
+    it). Closed-loop: ``clients`` virtual clients each keep exactly
+    one request in flight until ``duration_s`` — the shape the bench
+    driver (`scaling/benchmark.py`) measures, used for sim-vs-measured
+    validation."""
+
+    requests: List[SimRequest] = field(default_factory=list)
+    closed_loop: bool = False
+    clients: int = 0
+    duration_s: float = 0.0
+
+    @classmethod
+    def closed(cls, clients: int, duration_s: float) -> "Workload":
+        return cls(closed_loop=True, clients=int(clients),
+                   duration_s=float(duration_s))
+
+    @classmethod
+    def open_loop(cls, rate_rps: float, duration_s: float,
+                  rng: random.Random, *,
+                  model: Optional[str] = None) -> "Workload":
+        """Poisson arrivals at ``rate_rps`` for ``duration_s``."""
+        t = 0.0
+        requests = []
+        while True:
+            t += rng.expovariate(rate_rps)
+            if t >= duration_s:
+                break
+            requests.append(SimRequest(arrival_s=t, model=model))
+        return cls(requests=requests, duration_s=float(duration_s))
+
+    @classmethod
+    def bursty(cls, base_rps: float, spike_rps: float,
+               spike_start_s: float, spike_end_s: float,
+               duration_s: float, rng: random.Random, *,
+               ramp_s: float = 0.0) -> "Workload":
+        """Base-rate Poisson traffic with one spike window, led in by
+        a linear ramp of ``ramp_s`` seconds — the predictive-vs-
+        reactive replay shape: real traffic spikes RAMP (users arrive
+        over seconds, not one clock edge), the ramp is the trend the
+        forecast extrapolates ahead of, and the reactive law can only
+        chase the queues it leaves behind."""
+        t = 0.0
+        requests = []
+        while True:
+            if spike_start_s <= t < spike_end_s:
+                rate = spike_rps
+            elif ramp_s > 0 and spike_start_s - ramp_s <= t \
+                    < spike_start_s:
+                frac = (t - (spike_start_s - ramp_s)) / ramp_s
+                rate = base_rps + (spike_rps - base_rps) * frac
+            else:
+                rate = base_rps
+            t += rng.expovariate(rate)
+            if t >= duration_s:
+                break
+            requests.append(SimRequest(arrival_s=t))
+        return cls(requests=requests, duration_s=float(duration_s))
+
+    @classmethod
+    def from_export(cls, doc: Dict[str, Any]) -> "Workload":
+        """A ``kft-trace --export-workload`` document: recorded
+        arrivals + request classes + exact per-request service time
+        (prefill + decode from the engine's attribution; total wall
+        as fallback when the engine spans are missing)."""
+        requests = []
+        for row in doc.get("requests", []):
+            service_ms = (float(row.get("prefill_ms") or 0.0)
+                          + float(row.get("decode_ms") or 0.0))
+            if service_ms <= 0.0:
+                service_ms = float(row.get("total_ms") or 0.0)
+            requests.append(SimRequest(
+                arrival_s=float(row.get("arrival_s", 0.0)),
+                model=row.get("model"),
+                tenant=row.get("tenant"),
+                service_s=(service_ms / 1e3 if service_ms > 0
+                           else None)))
+        requests.sort(key=lambda r: r.arrival_s)
+        duration = requests[-1].arrival_s if requests else 0.0
+        return cls(requests=requests, duration_s=duration)
+
+
+class ServiceModel:
+    """Per-request service-time distribution (seconds), sampled with
+    the sim's injected rng. Calibrate from whichever evidence the
+    fleet recorded: the engine's exact queue/prefill/decode triples
+    (:meth:`from_attribution`), the collector's latency histograms
+    (:meth:`from_histogram`), or measured bench latencies rescaled to
+    a Little's-law service mean (:meth:`scaled_to_mean`)."""
+
+    def __init__(self, samples: Sequence[float]):
+        cleaned = sorted(float(s) for s in samples if float(s) > 0.0)
+        if not cleaned:
+            raise ValueError("service model needs > 0 samples")
+        self._samples = cleaned
+        self.mean = sum(cleaned) / len(cleaned)
+
+    @classmethod
+    def constant(cls, service_s: float) -> "ServiceModel":
+        return cls([service_s])
+
+    @classmethod
+    def from_attribution(cls, triples: Sequence[Sequence[float]]
+                         ) -> "ServiceModel":
+        """``(queue_ms, prefill_ms, decode_ms)`` rows — the engine's
+        exact per-request attribution (engine_request spans, or the
+        export-workload rows). Service time is prefill + decode;
+        queue time is the SIM's to produce, not an input."""
+        samples = [(float(p) + float(d)) / 1e3
+                   for _q, p, d in triples]
+        return cls(samples)
+
+    @classmethod
+    def from_histogram(cls, buckets: Dict[float, float],
+                       samples_per_bucket: int = 8) -> "ServiceModel":
+        """Prometheus-style cumulative ``le → count`` histogram
+        buckets (the collector's ``bucket_rates`` shape, seconds).
+        Each bucket contributes weighted midpoint samples; the +Inf
+        bucket rides at 1.5× the last finite bound."""
+        finite = sorted(b for b in buckets if b != float("inf"))
+        if not finite:
+            raise ValueError("histogram needs a finite bucket")
+        samples: List[float] = []
+        prev_bound = 0.0
+        prev_cum = 0.0
+        total = max(buckets.values())
+        top = finite[-1] * 1.5
+        for bound in sorted(buckets):
+            count = max(0.0, buckets[bound] - prev_cum)
+            prev_cum = max(prev_cum, buckets[bound])
+            mid = ((prev_bound + min(bound, top)) / 2.0
+                   if bound != float("inf") else top)
+            if count > 0 and total > 0:
+                n = max(1, int(round(samples_per_bucket
+                                     * count / total * len(buckets))))
+                samples.extend([mid] * n)
+            prev_bound = bound if bound != float("inf") else prev_bound
+        return cls(samples)
+
+    def scaled_to_mean(self, mean_s: float) -> "ServiceModel":
+        """The same distribution SHAPE rescaled to a target mean —
+        the calibration step that turns measured end-to-end latencies
+        (service + queueing) into a service-time distribution whose
+        mean Little's law pinned."""
+        if mean_s <= 0:
+            raise ValueError("mean_s must be > 0")
+        factor = mean_s / self.mean
+        return ServiceModel([s * factor for s in self._samples])
+
+    def sample(self, rng: random.Random) -> float:
+        return self._samples[rng.randrange(len(self._samples))]
+
+
+class SimReplica:
+    """One modeled replica: ``slots`` concurrent service slots + a
+    FIFO queue. Satisfies the endpoint-snapshot protocol the pure
+    pick functions consume, so the sim and production route through
+    the same `scaling/policy.py` code."""
+
+    def __init__(self, address: str, service: ServiceModel, *,
+                 slots: int = 1, role: str = "any"):
+        self.address = address
+        self.service = service
+        self.slots = int(slots)
+        self.role = role
+        self.queue: deque = deque()
+        self.active = 0
+        self.alive = True
+        self.draining = False
+        self.soft_ejected = False
+        self.busy_s = 0.0
+        self.completed = 0
+
+    # -- endpoint snapshot protocol (scaling/policy.py) -----------
+
+    @property
+    def inflight(self) -> int:
+        return self.active
+
+    @property
+    def saturation(self) -> Dict[str, Dict[str, float]]:
+        return {"sim": {"queue_depth": float(len(self.queue)),
+                        "est_batch_latency_ms":
+                            self.service.mean * 1e3}}
+
+    def saturation_score(self) -> float:
+        return policy.saturation_score(self.saturation, self.inflight)
+
+    def serves_phase(self, phase: Optional[str]) -> bool:
+        return self.role == "any" or phase is None \
+            or self.role == phase
+
+    def routable(self) -> bool:
+        return self.alive and not self.draining
+
+
+class SimScaler:
+    """The `Scaler` actuation surface wired into the sim: the
+    production Autoscaler writes its desired count here and the sim
+    turns it into provisioning (after ``provision_delay_s``) or
+    draining events."""
+
+    def __init__(self, replicas: int):
+        self.desired = int(replicas)
+        self.sim: Optional["FleetSimulator"] = None
+
+    def get_replicas(self) -> int:
+        return self.desired
+
+    def set_replicas(self, replicas: int) -> None:
+        self.desired = int(replicas)
+        if self.sim is not None:
+            self.sim._on_scale(self.desired)
+
+
+@dataclass
+class SimResult:
+    completed: int
+    latencies_s: List[float]
+    mean_ms: float
+    p50_ms: float
+    p99_ms: float
+    duration_s: float
+    max_replicas: int
+    replica_seconds: float
+    time_over_slo_s: float
+    decisions: List[Dict[str, Any]]
+    event_log: List[Tuple]
+
+
+class FleetSimulator:
+    """The event loop. Events are ``(time, seq, kind, payload)`` on a
+    heap — ties break on insertion order, never on object identity,
+    so same-seed runs replay identically."""
+
+    def __init__(self, workload: Workload, service: ServiceModel, *,
+                 replicas: int = 1, slots: int = 1,
+                 roles: Optional[Sequence[str]] = None,
+                 balancer: str = "least_saturation",
+                 seed: int = 0,
+                 slo_s: Optional[float] = None,
+                 autoscaler: Optional[Any] = None,
+                 autoscaler_interval_s: float = 2.0,
+                 provision_delay_s: float = 10.0,
+                 drain_tail_s: float = 120.0):
+        self.workload = workload
+        self.service = service
+        self.initial_replicas = int(replicas)
+        self.slots = int(slots)
+        self.roles = list(roles) if roles else None
+        self.balancer = balancer
+        self.seed = int(seed)
+        self.slo_s = slo_s
+        self.autoscaler = autoscaler
+        self.autoscaler_interval_s = float(autoscaler_interval_s)
+        self.provision_delay_s = float(provision_delay_s)
+        self.drain_tail_s = float(drain_tail_s)
+        self.event_log: List[Tuple] = []
+        self.decisions: List[Dict[str, Any]] = []
+
+    # -- fleet mutation -------------------------------------------
+
+    def _new_replica(self) -> SimReplica:
+        idx = self._replica_seq
+        self._replica_seq += 1
+        role = (self.roles[idx % len(self.roles)]
+                if self.roles else "any")
+        return SimReplica(f"sim-{idx}:8500", self.service,
+                          slots=self.slots, role=role)
+
+    def _live(self) -> List[SimReplica]:
+        return [r for r in self._replicas if r.routable()]
+
+    def _on_scale(self, desired: int) -> None:
+        """Actuation: provision up to ``desired`` live replicas (each
+        becomes routable after ``provision_delay_s`` — the pod
+        cold-start the autoscaler's lead time has to beat) or mark
+        the newest replicas draining (finish their queue, take no new
+        routes)."""
+        live = [r for r in self._replicas if r.alive
+                and not r.draining]
+        current = len(live) + self._provisioning
+        if desired > current:
+            for _ in range(desired - current):
+                self._provisioning += 1
+                self._push(self._now + self.provision_delay_s,
+                           "provision", None)
+            self._log("scale_up", f"to={desired}")
+        elif desired < current:
+            for replica in list(reversed(live))[:current - desired]:
+                replica.draining = True
+                self._maybe_retire(replica)
+            self._log("scale_down", f"to={desired}")
+
+    def _maybe_retire(self, replica: SimReplica) -> None:
+        if replica.draining and replica.active == 0 \
+                and not replica.queue:
+            replica.alive = False
+
+    # -- event plumbing -------------------------------------------
+
+    def _push(self, t: float, kind: str, payload: Any) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (t, self._seq, kind, payload))
+
+    def _log(self, kind: str, detail: str) -> None:
+        self.event_log.append((round(self._now, 9), kind, detail))
+
+    # -- request lifecycle ----------------------------------------
+
+    def _route(self, req: SimRequest, req_id: int) -> None:
+        candidates = self._live()
+        if not candidates:
+            # Scaled to zero (or every replica draining): requests
+            # wait at the door until capacity wakes.
+            self._lobby.append((req, req_id))
+            self._log("lobby", f"r{req_id}")
+            return
+        self._picks += 1
+        offset = self._picks - 1
+        name = self.balancer
+        if name == "round_robin":
+            chosen = policy.pick_round_robin(candidates, offset)
+        elif name == "affinity":
+            chosen = policy.pick_resident_affinity(
+                candidates, req.model, self._overload_ms,
+                offset=offset, fallback_offset=offset)
+        elif name == "prefix":
+            chosen = policy.pick_prefix_affinity(
+                candidates, req.prefix_key, self._overload_ms,
+                fallback_offset=offset)
+        elif name == "role":
+            chosen = policy.pick_role_aware(
+                candidates, req.phase, req.prefix_key,
+                self._overload_ms, fallback_offset=offset)
+        else:
+            chosen = policy.pick_least_saturated(candidates,
+                                                 offset=offset)
+        chosen.queue.append((req, req_id, self._now))
+        self._log("route", f"r{req_id}->{chosen.address}")
+        self._maybe_start(chosen)
+
+    def _maybe_start(self, replica: SimReplica) -> None:
+        while replica.active < replica.slots and replica.queue:
+            req, req_id, _enq_t = replica.queue.popleft()
+            replica.active += 1
+            service = (req.service_s if req.service_s is not None
+                       else self.service.sample(self._rng))
+            replica.busy_s += service
+            self._push(self._now + service, "finish",
+                       (replica, req, req_id))
+            self._log("start", f"r{req_id}@{replica.address}"
+                               f" svc={service:.6f}")
+
+    def _on_finish(self, replica: SimReplica, req: SimRequest,
+                   req_id: int) -> None:
+        replica.active -= 1
+        replica.completed += 1
+        latency = self._now - req.arrival_s
+        self._latencies.append(latency)
+        self._completions.append((self._now, latency))
+        self._log("finish", f"r{req_id} lat={latency:.6f}")
+        self._maybe_start(replica)
+        self._maybe_retire(replica)
+        if (self.workload.closed_loop
+                and self._now < self.workload.duration_s):
+            nxt = SimRequest(arrival_s=self._now, model=req.model,
+                             phase=req.phase,
+                             prefix_key=req.prefix_key,
+                             tenant=req.tenant)
+            self._arrived += 1
+            self._route(nxt, self._next_req_id())
+
+    def _next_req_id(self) -> int:
+        self._req_seq += 1
+        return self._req_seq
+
+    # -- autoscaler-in-the-loop -----------------------------------
+
+    def _work_remains(self) -> bool:
+        if self._lobby or self._arrivals_left > 0:
+            return True
+        return any(r.active or r.queue for r in self._replicas)
+
+    def _on_tick(self) -> None:
+        scaler = self.autoscaler.scaler
+        live = [r for r in self._replicas if r.alive
+                and not r.draining]
+        # What production sees: per-replica estimated queue wait from
+        # the healthz saturation schema (the sim's replicas expose
+        # the same mapping).
+        metrics = [{"address": r.address,
+                    "queue_wait_ms":
+                        len(r.queue) * r.service.mean * 1e3,
+                    "shed_rate": 0.0, "expired_rate": 0.0}
+                   for r in live]
+        interval = self.autoscaler_interval_s
+        rate = (self._arrived - self._arrived_at_tick) / interval
+        self._arrived_at_tick = self._arrived
+        if getattr(self.autoscaler.config, "predictive", False):
+            self.autoscaler.observe_arrivals(rate, now=self._now)
+        scaler.desired = len(live) + self._provisioning
+        decision = self.autoscaler.evaluate(metrics, now=self._now)
+        self.decisions.append(dict(decision, at_s=round(self._now, 3)))
+        self._log("tick", f"action={decision['action']}"
+                          f" desired={decision['desired']}"
+                          f" rate={rate:.3f}")
+        if self._work_remains() \
+                or self._now < self.workload.duration_s:
+            self._push(self._now + interval, "tick", None)
+
+    # -- the run --------------------------------------------------
+
+    def run(self) -> SimResult:
+        self._rng = random.Random(self.seed)
+        self._heap: List[Tuple] = []
+        self._seq = 0
+        self._now = 0.0
+        self._picks = 0
+        self._req_seq = 0
+        self._replica_seq = 0
+        self._provisioning = 0
+        self._arrived = 0
+        self._arrived_at_tick = 0
+        self._overload_ms = 500.0
+        self._lobby: deque = deque()
+        self._latencies: List[float] = []
+        self._completions: List[Tuple[float, float]] = []
+        self.event_log = []
+        self.decisions = []
+        self._replicas: List[SimReplica] = [
+            self._new_replica() for _ in range(self.initial_replicas)]
+        max_replicas = len(self._replicas)
+
+        if self.workload.closed_loop:
+            self._arrivals_left = 0
+            for _ in range(self.workload.clients):
+                self._push(0.0, "arrival", SimRequest(arrival_s=0.0))
+        else:
+            self._arrivals_left = len(self.workload.requests)
+            for req in self.workload.requests:
+                self._push(req.arrival_s, "arrival", req)
+        if self.autoscaler is not None:
+            scaler = self.autoscaler.scaler
+            if not isinstance(scaler, SimScaler):
+                raise TypeError("autoscaler-in-the-loop needs a "
+                                "SimScaler actuation surface")
+            scaler.sim = self
+            scaler.desired = len(self._replicas)
+            self._push(self.autoscaler_interval_s, "tick", None)
+
+        horizon = self.workload.duration_s + self.drain_tail_s
+        while self._heap:
+            t, _seq, kind, payload = heapq.heappop(self._heap)
+            if t > horizon:
+                break
+            self._now = t
+            if kind == "arrival":
+                self._arrived += 1
+                if not self.workload.closed_loop:
+                    self._arrivals_left -= 1
+                self._route(payload, self._next_req_id())
+            elif kind == "finish":
+                self._on_finish(*payload)
+            elif kind == "provision":
+                self._provisioning -= 1
+                replica = self._new_replica()
+                self._replicas.append(replica)
+                self._log("provision", replica.address)
+                while self._lobby and self._live():
+                    req, req_id = self._lobby.popleft()
+                    self._log("unlobby", f"r{req_id}")
+                    self._route(req, req_id)
+            elif kind == "tick":
+                self._on_tick()
+            live_now = len([r for r in self._replicas
+                            if r.alive and not r.draining])
+            max_replicas = max(max_replicas,
+                               live_now + self._provisioning)
+
+        duration = max(self._now, self.workload.duration_s)
+        time_over_slo = 0.0
+        if self.slo_s is not None and self._completions:
+            violated = {int(t) for t, lat in self._completions
+                        if lat > self.slo_s}
+            time_over_slo = float(len(violated))
+        lats_ms = [v * 1e3 for v in self._latencies]
+        return SimResult(
+            completed=len(self._latencies),
+            latencies_s=list(self._latencies),
+            mean_ms=(sum(lats_ms) / len(lats_ms)) if lats_ms else 0.0,
+            p50_ms=percentile(lats_ms, 50),
+            p99_ms=percentile(lats_ms, 99),
+            duration_s=round(duration, 6),
+            max_replicas=max_replicas,
+            replica_seconds=sum(r.busy_s for r in self._replicas),
+            time_over_slo_s=time_over_slo,
+            decisions=self.decisions,
+            event_log=self.event_log,
+        )

@@ -52,6 +52,7 @@ import numpy as np
 
 from kubeflow_tpu.obs import metrics as obs_metrics
 from kubeflow_tpu.obs.tracing import TRACER
+from kubeflow_tpu.scaling import policy as scaling_policy
 from kubeflow_tpu.serving import _native, remote, tenancy
 from kubeflow_tpu.serving.model import LoadedModel, load_version
 from kubeflow_tpu.serving.overload import (
@@ -668,7 +669,8 @@ class ServedModel:
                     "deadline expired before enqueue"))
                 return future
             est_wait = self.estimated_wait_s()
-            if est_wait > remaining * ADMISSION_SAFETY:
+            if scaling_policy.admission_should_shed(
+                    est_wait, remaining, ADMISSION_SAFETY):
                 with self._pending_lock:
                     self._stat_shed += 1
                 self._m_shed.inc()

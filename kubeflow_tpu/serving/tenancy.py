@@ -72,6 +72,7 @@ from typing import (
 )
 
 from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.scaling import policy
 from kubeflow_tpu.serving.overload import QuotaExceededError
 
 __all__ = [
@@ -349,11 +350,10 @@ class TokenBucket:
         self._lock = threading.Lock()
 
     def _refill(self, now: float) -> None:
-        if self.rate is None:
-            return
-        elapsed = max(0.0, now - self._last)
+        self._level = policy.token_bucket_refill(
+            self._level, self._last, now,
+            rate=self.rate, burst=self.burst)
         self._last = now
-        self._level = min(self.burst, self._level + elapsed * self.rate)
 
     def try_take(self, cost: float = 1.0) -> bool:
         if self.rate is None:
@@ -376,8 +376,9 @@ class TokenBucket:
         with self._lock:
             now = self._clock()
             self._refill(now)
-            missing = min(cost, self.burst) - self._level
-            return max(0.001, missing / self.rate)
+            return policy.token_bucket_retry_after_s(
+                self._level, rate=self.rate, burst=self.burst,
+                cost=cost)
 
     def level(self) -> float:
         if self.rate is None:

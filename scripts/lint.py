@@ -647,6 +647,67 @@ def check_span_discipline() -> list:
     return errors
 
 
+# Modules the fleet simulator imports for POLICY decisions — they
+# must stay pure so a sim run is deterministic and the sim exercises
+# the SAME decision code production runs (ISSUE 19). Forbidden
+# imports: I/O and concurrency (the sim owns the clock and the event
+# order), plus `time` itself (all timing is event-time, injected).
+SIM_PURE_MODULES = ("kubeflow_tpu/scaling/simulator.py",
+                    "kubeflow_tpu/scaling/policy.py")
+SIM_FORBIDDEN_IMPORTS = {"tornado", "grpc", "threading", "socket",
+                         "asyncio", "time", "subprocess", "requests"}
+
+
+def check_sim_purity() -> list:
+    """The simulator and the extracted policy layer are pure (ISSUE
+    19): in :data:`SIM_PURE_MODULES` forbid (a) importing any of
+    :data:`SIM_FORBIDDEN_IMPORTS` — no sockets, no threads, no
+    wall-clock module; (b) any ``time.time()`` / ``time.monotonic()``
+    / ``time.sleep()`` call — sim time is event time, advanced only by
+    the event heap; (c) any module-level ``random.<fn>()`` call other
+    than ``random.Random(seed)`` — randomness must flow through an
+    injected, seeded generator or same-seed runs stop producing
+    identical event logs (the determinism contract
+    tests/test_simulator.py pins)."""
+    errors = []
+    for rel in SIM_PURE_MODULES:
+        f = REPO / rel
+        tree = ast.parse(f.read_text(), str(f))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                names = [a.name.split(".")[0] for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                names = [(node.module or "").split(".")[0]]
+            else:
+                names = []
+            for name in names:
+                if name in SIM_FORBIDDEN_IMPORTS:
+                    errors.append(
+                        f"sim-purity: {rel}:{node.lineno}: import "
+                        f"{name} — simulator/policy modules are pure "
+                        f"(no I/O, no threads, no wall clock); inject "
+                        f"clocks and rngs from the caller")
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)):
+                continue
+            if func.value.id == "time":
+                errors.append(
+                    f"sim-purity: {rel}:{node.lineno}: time."
+                    f"{func.attr}() — sim/policy time is event time "
+                    f"(pass `now` in; never read a clock)")
+            elif func.value.id == "random" and func.attr != "Random":
+                errors.append(
+                    f"sim-purity: {rel}:{node.lineno}: random."
+                    f"{func.attr}() rides the shared global generator "
+                    f"— draw from an injected random.Random(seed) so "
+                    f"same-seed runs replay identically")
+    return errors
+
+
 def check_unused_imports() -> list:
     errors = []
     for f in iter_py_files():
@@ -712,7 +773,7 @@ def main() -> int:
                   check_serving_timeout_discipline,
                   check_service_print_discipline,
                   check_metric_label_discipline,
-                  check_span_discipline,
+                  check_span_discipline, check_sim_purity,
                   check_boilerplate, check_license_file):
         found = check()
         print(f"{check.__name__}: {'ok' if not found else f'{len(found)} errors'}")

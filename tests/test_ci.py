@@ -55,8 +55,8 @@ def test_e2e_workflow_manifest():
     for step in ("checkout", "unit-test", "deploy-test", "tpujob-test",
                  "serving-test", "leader-failover-test",
                  "elastic-kill-test", "serving-chaos",
-                 "serving-tenancy", "spec-decode", "teardown",
-                 "copy-artifacts", "e2e"):
+                 "serving-tenancy", "spec-decode", "fleet-sim",
+                 "teardown", "copy-artifacts", "e2e"):
         assert step in names, step
     dag = next(t for t in wf["spec"]["templates"] if t["name"] == "e2e")
     deps = {t["name"]: t.get("dependencies", [])
@@ -67,9 +67,14 @@ def test_e2e_workflow_manifest():
     assert deps["leader-failover-test"] == ["checkout"]
     assert deps["elastic-kill-test"] == ["checkout"]
     assert deps["spec-decode"] == ["checkout"]
+    # Fleet-sim gate (ISSUE 19): hermetic — stub fleet + pure sim.
+    assert deps["fleet-sim"] == ["checkout"]
     spec = next(t for t in wf["spec"]["templates"]
                 if t["name"] == "spec-decode")
     assert "--speculative" in spec["container"]["command"]
+    sim = next(t for t in wf["spec"]["templates"]
+               if t["name"] == "fleet-sim")
+    assert "--sim" in sim["container"]["command"]
     failover = next(t for t in wf["spec"]["templates"]
                     if t["name"] == "leader-failover-test")
     assert "kubeflow_tpu.citests.leader_failover" in \

@@ -1,0 +1,271 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""kubeflow_tpu/scaling/policy.py: the extracted pure policy layer.
+
+Every routing, brownout, quota, admission, and forecasting decision
+here is a plain function over plain values — no servers, no sockets,
+no sleeps, no clocks (time is always a ``now`` argument). These are
+the SAME functions the production balancer/endpoints/tenancy/manager
+code delegates to and the fleet simulator replays against
+(scripts/lint.py check_sim_purity pins the no-I/O/no-wall-clock
+contract; this file pins the decisions themselves).
+
+Snapshot stand-ins: the picks are duck-typed over the endpoint
+snapshot protocol (``saturation`` mapping, ``address``,
+``saturation_score()``, ``serves_phase``) — ``Snap`` below satisfies
+it, exactly like production ``Endpoint`` and sim ``SimReplica`` do.
+"""
+
+from kubeflow_tpu.scaling import policy
+
+
+class Snap:
+    """Minimal endpoint snapshot satisfying the pick protocol."""
+
+    def __init__(self, address, score=0.0, saturation=None,
+                 role="any"):
+        self.address = address
+        self._score = score
+        self.saturation = saturation if saturation is not None else {}
+        self.role = role
+
+    def saturation_score(self):
+        return self._score
+
+    def serves_phase(self, phase):
+        return self.role == "any" or phase is None or \
+            self.role == phase
+
+    def __repr__(self):
+        return f"Snap({self.address})"
+
+
+# -- saturation score --------------------------------------------------
+
+def test_saturation_score_sums_queues_and_prices_inflight():
+    sat = {"m1": {"queue_depth": 2.0, "est_batch_latency_ms": 10.0},
+           "m2": {"queue_depth": 1.0, "est_batch_latency_ms": 40.0}}
+    # 2*10 + 1*40 queued, plus 3 inflight at the max batch latency.
+    assert policy.saturation_score(sat, 3) == 60.0 + 3 * 40.0
+
+
+def test_saturation_score_empty_prices_inflight_at_floor():
+    assert policy.saturation_score({}, 2) == 2.0  # 1ms floor each
+
+
+# -- balancer picks ----------------------------------------------------
+
+def test_round_robin_rotates_and_wraps():
+    eps = [Snap("a"), Snap("b"), Snap("c")]
+    picks = [policy.pick_round_robin(eps, i).address for i in range(6)]
+    assert picks == ["a", "b", "c", "a", "b", "c"]
+    assert policy.pick_round_robin([], 0) is None
+
+
+def test_least_saturated_picks_min_score():
+    eps = [Snap("a", 30.0), Snap("b", 10.0), Snap("c", 20.0)]
+    assert policy.pick_least_saturated(eps).address == "b"
+
+
+def test_least_saturated_rotating_tiebreak():
+    eps = [Snap("a", 5.0), Snap("b", 5.0), Snap("c", 5.0)]
+    picks = {policy.pick_least_saturated(eps, offset).address
+             for offset in range(3)}
+    # All tied: a different member per offset, never a fixed favorite.
+    assert picks == {"a", "b", "c"}
+
+
+def test_resident_affinity_prefers_loaded_model():
+    cold = Snap("cold", 1.0)
+    warm = Snap("warm", 50.0, saturation={"llama": {}})
+    assert policy.pick_resident_affinity(
+        [cold, warm], "llama", overload_ms=500.0).address == "warm"
+
+
+def test_resident_affinity_falls_back_when_overloaded():
+    cold = Snap("cold", 1.0)
+    warm = Snap("warm", 900.0, saturation={"llama": {}})
+    # Affinity buys cache hits, never unavailability.
+    assert policy.pick_resident_affinity(
+        [cold, warm], "llama", overload_ms=500.0).address == "cold"
+
+
+def test_rendezvous_weight_is_stable_and_spreads():
+    w1 = policy.rendezvous_weight("prefix-1", "10.0.0.1:9000")
+    assert w1 == policy.rendezvous_weight("prefix-1", "10.0.0.1:9000")
+    assert w1 != policy.rendezvous_weight("prefix-1", "10.0.0.2:9000")
+    # Over many keys the pool splits: no address owns everything.
+    addrs = ["a:1", "b:1", "c:1"]
+    owners = {max(addrs, key=lambda a: policy.rendezvous_weight(
+        f"key-{i}", a)) for i in range(64)}
+    assert owners == set(addrs)
+
+
+def test_prefix_affinity_home_stable_under_membership_churn():
+    eps = [Snap("a:1"), Snap("b:1"), Snap("c:1")]
+    home = policy.pick_prefix_affinity(eps, "chat-42", 500.0).address
+    # Removing a NON-home member must not move the key (the rendezvous
+    # property: only keys owned by a departed replica move).
+    survivors = [ep for ep in eps if ep.address != home]
+    loser = policy.pick_prefix_affinity(
+        survivors, "chat-42", 500.0).address
+    assert home != loser  # it moved somewhere...
+    bigger = eps + [Snap("d:1", 999.0)]
+    assert policy.pick_prefix_affinity(
+        bigger, "chat-42", 500.0).address == home
+
+
+def test_prefix_affinity_overloaded_home_falls_back():
+    eps = [Snap("a:1"), Snap("b:1"), Snap("c:1")]
+    home = policy.pick_prefix_affinity(eps, "chat-42", 500.0).address
+    for ep in eps:
+        if ep.address == home:
+            ep._score = 900.0
+    assert policy.pick_prefix_affinity(
+        eps, "chat-42", 500.0).address != home
+
+
+def test_role_aware_prefers_matching_phase():
+    pre = Snap("pre", 10.0, role="prefill")
+    dec = Snap("dec", 1.0, role="decode")
+    got = policy.pick_role_aware([pre, dec], "prefill", None, 500.0)
+    assert got.address == "pre"
+
+
+def test_role_aware_saturated_pool_falls_back_to_rest():
+    pre = Snap("pre", 900.0, role="prefill")
+    dec = Snap("dec", 1.0, role="decode")
+    # Matching pool saturated: specialization never beats availability.
+    got = policy.pick_role_aware([pre, dec], "prefill", None, 500.0)
+    assert got.address == "dec"
+
+
+# -- brownout ----------------------------------------------------------
+
+def test_brownout_threshold_needs_two_members():
+    assert policy.brownout_threshold_s(
+        [0.1], k=3.0, mad_floor_s=0.01, min_ratio=2.0) is None
+
+
+def test_brownout_threshold_floors_mad_and_ratio():
+    # Uniform pool: MAD=0, floored — the bar sits k*floor above the
+    # median, but never below min_ratio * median.
+    bar = policy.brownout_threshold_s(
+        [0.1, 0.1, 0.1], k=3.0, mad_floor_s=0.005, min_ratio=2.0)
+    assert bar == max(0.1 + 3.0 * 0.005, 0.2)
+
+
+def test_brownout_convict_on_latency_or_stalls():
+    slow, convict = policy.brownout_should_convict(
+        0.5, 0.2, 0, stall_strikes=2)
+    assert (slow, convict) == (True, True)
+    slow, convict = policy.brownout_should_convict(
+        0.1, 0.2, 2, stall_strikes=2)
+    assert (slow, convict) == (False, True)
+    slow, convict = policy.brownout_should_convict(
+        0.1, 0.2, 1, stall_strikes=2)
+    assert (slow, convict) == (False, False)
+    # No threshold (pool too small): latency alone never convicts.
+    slow, convict = policy.brownout_should_convict(
+        9.9, None, 0, stall_strikes=2)
+    assert (slow, convict) == (False, False)
+
+
+def test_brownout_stall_readmit_needs_quiet_window():
+    assert not policy.brownout_should_readmit_stall(
+        100.0, 0, 129.0, stall_quiet_s=30.0)
+    assert policy.brownout_should_readmit_stall(
+        100.0, 0, 130.0, stall_quiet_s=30.0)
+    # A fresh strike resets the verdict regardless of elapsed time.
+    assert not policy.brownout_should_readmit_stall(
+        100.0, 1, 999.0, stall_quiet_s=30.0)
+
+
+def test_brownout_latency_readmit_inside_recover_ratio():
+    assert policy.brownout_should_readmit_latency(
+        0.08, 0.1, recover_ratio=0.9)
+    assert not policy.brownout_should_readmit_latency(
+        0.095, 0.1, recover_ratio=0.9)
+    assert not policy.brownout_should_readmit_latency(
+        None, 0.1, recover_ratio=0.9)
+
+
+# -- quota token bucket ------------------------------------------------
+
+def test_token_bucket_refill_caps_at_burst():
+    assert policy.token_bucket_refill(
+        1.0, 10.0, 12.0, rate=2.0, burst=4.0) == 4.0
+    assert policy.token_bucket_refill(
+        1.0, 10.0, 10.5, rate=2.0, burst=4.0) == 2.0
+
+
+def test_token_bucket_refill_monotonic_and_unlimited():
+    # Clock stepping backwards refills nothing.
+    assert policy.token_bucket_refill(
+        1.0, 10.0, 9.0, rate=2.0, burst=4.0) == 1.0
+    # rate=None (unlimited tenant) leaves the level untouched.
+    assert policy.token_bucket_refill(
+        1.0, 10.0, 99.0, rate=None, burst=4.0) == 1.0
+
+
+def test_token_bucket_retry_after():
+    # 0.25 tokens short of cost 1 at 2 tokens/s -> 0.125s.
+    assert policy.token_bucket_retry_after_s(
+        0.75, rate=2.0, burst=4.0) == 0.125
+    assert policy.token_bucket_retry_after_s(
+        0.0, rate=None, burst=4.0) == 0.0
+    # Cost deeper than the bucket: the full-bucket refill bounds the
+    # client's backoff even though the request can never succeed.
+    assert policy.token_bucket_retry_after_s(
+        0.0, rate=2.0, burst=4.0, cost=10.0) == 2.0
+
+
+# -- deadline admission ------------------------------------------------
+
+def test_admission_shed_verdict():
+    assert policy.admission_should_shed(1.0, 1.0, 0.8)
+    assert not policy.admission_should_shed(0.7, 1.0, 0.8)
+    # Expired budget: any wait sheds.
+    assert policy.admission_should_shed(0.01, 0.0, 0.8)
+
+
+# -- arrival forecasting -----------------------------------------------
+
+def test_forecast_extrapolates_a_ramp():
+    # 1 rps/s ramp: 10s past the newest sample forecasts +10 rps.
+    samples = [(float(t), 10.0 + t) for t in range(8)]
+    got = policy.fit_arrival_forecast(samples, 10.0)
+    assert abs(got - (10.0 + 7.0 + 10.0)) < 1e-9
+
+
+def test_forecast_flat_traffic_predicts_the_mean():
+    samples = [(float(t), 5.0) for t in range(8)]
+    assert policy.fit_arrival_forecast(samples, 60.0) == 5.0
+
+
+def test_forecast_never_negative_and_degrades_gracefully():
+    # Steep cooldown extrapolates below zero -> clamped idle.
+    samples = [(0.0, 10.0), (1.0, 5.0), (2.0, 0.0)]
+    assert policy.fit_arrival_forecast(samples, 30.0) == 0.0
+    # One sample: last observation, never a trend.
+    assert policy.fit_arrival_forecast([(0.0, 7.0)], 30.0) == 7.0
+    assert policy.fit_arrival_forecast([], 30.0) == 0.0
+
+
+def test_forecast_desired_replicas_ceil_and_guards():
+    assert policy.forecast_desired_replicas(21.0, 10.0) == 3
+    assert policy.forecast_desired_replicas(20.0, 10.0) == 2
+    assert policy.forecast_desired_replicas(0.0, 10.0) == 0
+    assert policy.forecast_desired_replicas(5.0, 0.0) == 0

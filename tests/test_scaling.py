@@ -749,6 +749,103 @@ def test_discover_pod_endpoints_filters_unready_pods():
 
 
 # ---------------------------------------------------------------------------
+# Predictive mode (ISSUE 19): forecast merge, wake/collapse, inputs
+
+
+def _predictive(scaler, clock, **overrides):
+    defaults = dict(predictive=True, forecast_horizon_s=30.0,
+                    forecast_window_s=60.0, replica_capacity_rps=10.0)
+    defaults.update(overrides)
+    return _autoscaler(scaler, clock, **defaults)
+
+
+def test_predictive_config_validation():
+    with pytest.raises(ValueError):  # waking from zero needs a forecast
+        AutoscalerConfig(min_replicas=0, scale_to_zero=True).validate()
+    with pytest.raises(ValueError):
+        AutoscalerConfig(predictive=True,
+                         replica_capacity_rps=0.0).validate()
+    with pytest.raises(ValueError):  # min 0 only with scale-to-zero
+        AutoscalerConfig(min_replicas=0).validate()
+    AutoscalerConfig(min_replicas=0, predictive=True,
+                     scale_to_zero=True).validate()
+
+
+def test_forecast_raises_reactive_ratio_and_records_inputs():
+    scaler, clock = FakeScaler(1), FakeClock()
+    asc = _predictive(scaler, clock)
+    # A 2 rps/s ramp: 30s past now forecasts ~+60 rps -> replicas.
+    for t in range(5):
+        clock.t = float(t)
+        asc.observe_arrivals(10.0 + 2.0 * t)
+    d = asc.evaluate([{"queue_wait_ms": 100.0}])  # reactive says hold
+    assert d["action"] == "scale_up"
+    assert d["reason"] == "forecast"
+    # The decision record explains itself: signal values + what the
+    # forecaster believed + which clamp bit (satellite: ConfigMap
+    # decision records gain inputs).
+    inputs = d["inputs"]
+    assert inputs["mean_queue_wait_ms"] == 100.0
+    assert inputs["forecast"]["samples"] == 5
+    assert inputs["forecast"]["replicas"] >= 2
+    assert inputs["forecast"]["rate_rps"] > 10.0
+    assert inputs["clamp"] == "double_up"  # forecast wanted > 2x
+
+
+def test_forecast_never_shrinks_what_reactive_keeps():
+    scaler, clock = FakeScaler(4), FakeClock()
+    asc = _predictive(scaler, clock)
+    asc.observe_arrivals(0.0)  # forecast says zero replicas needed
+    # Reactive signal in band: predictive mode must not shrink.
+    d = asc.evaluate([{"queue_wait_ms": 100.0}])
+    assert d["action"] == "hold"
+    assert scaler.writes == []
+
+
+def test_wake_from_zero_on_demand():
+    scaler, clock = FakeScaler(0), FakeClock()
+    asc = _predictive(scaler, clock, min_replicas=0,
+                      scale_to_zero=True)
+    # Silent fleet at zero: hold (and say so).
+    d = asc.evaluate([])
+    assert (d["action"], d["reason"]) == ("hold", "scaled_to_zero")
+    # One observed request wakes the fleet without waiting for a fit.
+    asc.observe_arrivals(0.5)
+    d = asc.evaluate([])
+    assert (d["action"], d["reason"]) == ("scale_up", "wake_from_zero")
+    assert scaler.replicas == 1
+
+
+def test_scale_to_zero_needs_provable_quiet():
+    scaler, clock = FakeScaler(1), FakeClock()
+    asc = _predictive(scaler, clock, min_replicas=0,
+                      scale_to_zero=True, idle_quiet_s=120.0,
+                      scale_down_cooldown_s=30.0)
+    clock.t = 50.0
+    assert asc.evaluate([{"queue_wait_ms": 0.0}])["action"] == "hold"
+    clock.t = 100.0  # only 50s of silence: not enough
+    assert asc.evaluate([{"queue_wait_ms": 0.0}])["action"] == "hold"
+    clock.t = 200.0  # 150s of silence >= idle_quiet_s
+    d = asc.evaluate([{"queue_wait_ms": 0.0}])
+    assert (d["action"], d["desired"], d["reason"]) == \
+        ("scale_down", 0, "scale_to_zero")
+    assert scaler.replicas == 0
+
+
+def test_reactive_path_never_reaches_zero():
+    scaler, clock = FakeScaler(2), FakeClock()
+    # Without scale-to-zero, min_replicas=0 is invalid; with min 1 the
+    # normal halve path floors at 1 forever.
+    asc = _autoscaler(scaler, clock, min_replicas=1)
+    clock.t = 100.0
+    d = asc.evaluate([{"queue_wait_ms": 0.0}])
+    assert d["action"] == "scale_down" and d["desired"] == 1
+    clock.t = 200.0
+    d = asc.evaluate([{"queue_wait_ms": 0.0}])
+    assert d["action"] == "hold" and d["reason"] == "at_min_replicas"
+    assert d["inputs"]["clamp"] == "min_replicas"
+
+
 # AutoscalerLoop: scrape → rates → decide → publish
 
 
@@ -794,6 +891,11 @@ def test_loop_tick_publishes_fleet_and_decision():
     assert not rows["b:8500"]["reachable"]
     assert fleet["decision"]["action"] == "hold"
     assert "age_s" in fleet["decision"]  # monotonic time never ships
+    # Published decisions carry their INPUTS (ISSUE 19): the signal
+    # values and clamp that produced the verdict, dashboard-readable.
+    inputs = fleet["decision"]["inputs"]
+    assert inputs["mean_queue_wait_ms"] == pytest.approx(100.0)
+    assert "shed_rate" in inputs and "clamp" in inputs
 
 
 def test_loop_differentiates_cumulative_shed_counters():

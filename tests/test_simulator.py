@@ -1,0 +1,331 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""kubeflow_tpu/scaling/simulator.py: the deterministic fleet sim.
+
+Hermetic and instant: every test here is pure event-time — no
+sockets, no sleeps, no wall clock (scripts/lint.py check_sim_purity
+enforces the same statically). The determinism test IS the contract:
+two same-seed runs must produce byte-identical event logs, or sim
+results stop being reproducible evidence.
+
+The autoscaler-in-the-loop tests drive the PRODUCTION
+:class:`~kubeflow_tpu.scaling.autoscaler.Autoscaler` (injected clock,
+SimScaler actuation) — the sim validates deployed policy code, not a
+reimplementation. The sim-vs-MEASURED validation (p99 within 10% of
+three recorded workloads) is the fleet-sim CI gate:
+``bench.py --sim`` (manifests/ci.py, PERF.md).
+"""
+
+import json
+import random
+
+import pytest
+
+from kubeflow_tpu.obs import trace as obs_trace
+from kubeflow_tpu.scaling.autoscaler import Autoscaler, AutoscalerConfig
+from kubeflow_tpu.scaling.simulator import (
+    FleetSimulator,
+    ServiceModel,
+    SimRequest,
+    SimScaler,
+    Workload,
+    percentile,
+)
+
+
+# -- determinism (the contract) ---------------------------------------
+
+def _bursty_sim(seed):
+    rng = random.Random(99)  # workload fixed; only the SIM seed varies
+    workload = Workload.bursty(5.0, 40.0, 20.0, 40.0, 60.0, rng,
+                               ramp_s=10.0)
+    service = ServiceModel([0.03, 0.05, 0.08, 0.12])
+    return FleetSimulator(workload, service, replicas=2, seed=seed)
+
+
+def test_same_seed_runs_produce_identical_event_logs():
+    a, b = _bursty_sim(7).run(), _bursty_sim(7).run()
+    assert a.event_log == b.event_log
+    assert a.latencies_s == b.latencies_s
+    assert a.completed == b.completed > 0
+
+
+def test_different_seed_changes_service_draws_only():
+    a, b = _bursty_sim(7).run(), _bursty_sim(8).run()
+    assert a.completed == b.completed  # same arrivals either way
+    assert a.event_log != b.event_log  # different service draws
+
+
+def test_rerunning_the_same_instance_is_deterministic():
+    sim = _bursty_sim(7)
+    assert sim.run().event_log == sim.run().event_log
+
+
+# -- closed loop: exact queueing math ---------------------------------
+
+def test_closed_loop_constant_service_is_exact():
+    # 6 clients over 2 single-slot replicas at a constant 40ms: each
+    # replica carries 3 clients, steady-state sojourn = 3 x 40ms.
+    sim = FleetSimulator(Workload.closed(6, 2.0),
+                         ServiceModel.constant(0.04), replicas=2)
+    res = sim.run()
+    assert res.p50_ms == pytest.approx(120.0)
+    assert res.p99_ms == pytest.approx(120.0)
+    # Both replicas saturated for the whole window: throughput =
+    # 2 replicas / 40ms = 50 rps over 2s.
+    assert res.completed == pytest.approx(100, abs=4)
+
+
+def test_doubling_replicas_halves_closed_loop_latency():
+    def p50(n):
+        return FleetSimulator(Workload.closed(8, 2.0),
+                              ServiceModel.constant(0.05),
+                              replicas=n).run().p50_ms
+    assert p50(2) == pytest.approx(2 * p50(4))
+
+
+# -- service model calibration ----------------------------------------
+
+def test_scaled_to_mean_preserves_shape():
+    base = ServiceModel([0.1, 0.2, 0.3])
+    scaled = base.scaled_to_mean(0.4)
+    assert scaled.mean == pytest.approx(0.4)
+    rng = random.Random(0)
+    draws = sorted({scaled.sample(rng) for _ in range(64)})
+    assert draws == pytest.approx([0.2, 0.4, 0.6])
+
+
+def test_from_attribution_sums_prefill_and_decode():
+    model = ServiceModel.from_attribution(
+        [(5.0, 30.0, 50.0), (2.0, 10.0, 20.0)])  # queue excluded
+    assert model.mean == pytest.approx((0.08 + 0.03) / 2)
+
+
+def test_from_histogram_midpoints():
+    model = ServiceModel.from_histogram(
+        {0.1: 4.0, 0.2: 8.0, float("inf"): 8.0})
+    assert 0.05 <= model.mean <= 0.2
+    with pytest.raises(ValueError):
+        ServiceModel.from_histogram({float("inf"): 3.0})
+
+
+def test_service_model_rejects_empty():
+    with pytest.raises(ValueError):
+        ServiceModel([0.0, -1.0])
+
+
+def test_percentile_matches_bench_convention():
+    xs = list(range(1, 101))
+    # benchmark._pct: index int(q*n) clamped — p50 of 1..100 is 51.
+    assert percentile(xs, 50) == 51
+    assert percentile(xs, 99) == 100
+    assert percentile([], 99) == 0.0
+
+
+# -- workload shapes ---------------------------------------------------
+
+def test_open_loop_poisson_rate():
+    rng = random.Random(3)
+    w = Workload.open_loop(50.0, 20.0, rng)
+    assert len(w.requests) == pytest.approx(1000, rel=0.15)
+    assert all(0 < r.arrival_s < 20.0 for r in w.requests)
+
+
+def test_bursty_ramp_raises_rate_between_base_and_spike():
+    rng = random.Random(3)
+    w = Workload.bursty(5.0, 50.0, 30.0, 50.0, 60.0, rng, ramp_s=10.0)
+
+    def count(lo, hi):
+        return sum(lo <= r.arrival_s < hi for r in w.requests)
+
+    base, ramp, spike = count(0, 20), count(20, 30), count(50, 60)
+    assert base / 20.0 < ramp / 10.0 < count(30, 50) / 20.0
+    assert spike / 10.0 < count(30, 50) / 20.0  # spike window ended
+
+
+# -- trace replay: export_workload round trip -------------------------
+
+def _request_spans(trace_id, ts_us, queue_us, exec_us, model):
+    """One direct-to-server traced request: http_request root with a
+    queue_wait + execute child — the assembled-trace shape
+    kft-trace --export-workload consumes."""
+    root_id = f"{trace_id[:15]}a"
+    common = {"cat": "t", "ph": "X", "pid": 1, "tid": 1}
+    return [
+        dict(common, name="http_request", ts=ts_us,
+             dur=queue_us + exec_us,
+             args={"trace_id": trace_id, "span_id": root_id,
+                   "model": model}),
+        dict(common, name="queue_wait", ts=ts_us, dur=queue_us,
+             args={"trace_id": trace_id, "parent_id": root_id}),
+        dict(common, name="execute", ts=ts_us + queue_us, dur=exec_us,
+             args={"trace_id": trace_id, "parent_id": root_id}),
+    ]
+
+
+def test_export_workload_rows_and_sim_replay():
+    spans = (
+        _request_spans("a" * 32, 1_000_000.0, 5_000.0, 30_000.0, "m1")
+        + _request_spans("b" * 32, 3_000_000.0, 0.0, 50_000.0, "m2"))
+    doc = obs_trace.export_workload(spans)
+    assert doc["version"] == 1
+    rows = doc["requests"]
+    assert [r["trace_id"] for r in rows] == ["a" * 32, "b" * 32]
+    # t=0 is the first arrival; the second request landed 2s later.
+    assert rows[0]["arrival_s"] == 0.0
+    assert rows[1]["arrival_s"] == pytest.approx(2.0)
+    assert rows[0]["model"] == "m1"
+    assert rows[0]["queue_ms"] == pytest.approx(5.0)
+    assert rows[0]["decode_ms"] == pytest.approx(30.0)
+
+    # Replay: service times are the EXACT recorded attribution (queue
+    # time is the sim's to produce), so an uncontended replay returns
+    # each request's service component as its latency.
+    workload = Workload.from_export(doc)
+    assert [r.service_s for r in workload.requests] == \
+        pytest.approx([0.030, 0.050])
+    res = FleetSimulator(workload, ServiceModel.constant(1.0),
+                         replicas=1).run()
+    assert res.completed == 2
+    assert sorted(res.latencies_s) == pytest.approx([0.030, 0.050])
+
+
+def test_export_workload_skips_rootless_traces():
+    orphan = {"name": "queue_wait", "cat": "t", "ph": "X", "ts": 0.0,
+              "dur": 100.0, "args": {"trace_id": "c" * 32}}
+    doc = obs_trace.export_workload([orphan])
+    assert doc["requests"] == []
+
+
+def test_spans_from_file_accepts_all_three_dump_forms(tmp_path):
+    # A JSONL dump's first line starts with "{" just like a /tracez
+    # document — the loader must fall through to line-by-line instead
+    # of dying on "Extra data".
+    spans = _request_spans("a" * 32, 1_000_000.0, 5_000.0, 30_000.0,
+                           "m1")
+    jsonl = tmp_path / "spans.jsonl"
+    jsonl.write_text("\n".join(json.dumps(s) for s in spans))
+    doc = tmp_path / "tracez.json"
+    doc.write_text(json.dumps({"spans": spans}))
+    arr = tmp_path / "spans_array.json"
+    arr.write_text(json.dumps(spans))
+    for path in (jsonl, doc, arr):
+        loaded = obs_trace._spans_from_file(str(path))
+        assert len(loaded) == len(spans), path
+
+
+# -- autoscaler in the loop -------------------------------------------
+
+def _predictive_cfg(**overrides):
+    defaults = dict(min_replicas=1, max_replicas=6,
+                    target_queue_wait_ms=300.0, hysteresis=0.2,
+                    scale_up_cooldown_s=10.0,
+                    scale_down_cooldown_s=40.0, predictive=True,
+                    forecast_horizon_s=40.0, forecast_window_s=20.0,
+                    replica_capacity_rps=20.0)
+    defaults.update(overrides)
+    return AutoscalerConfig(**defaults)
+
+
+def test_sim_requires_sim_scaler():
+    class NotASimScaler:
+        def get_replicas(self):
+            return 1
+
+        def set_replicas(self, n):
+            pass
+
+    asc = Autoscaler(_predictive_cfg(), NotASimScaler(),
+                     clock=lambda: 0.0)
+    sim = FleetSimulator(Workload.closed(2, 1.0),
+                         ServiceModel.constant(0.01), autoscaler=asc)
+    with pytest.raises(TypeError):
+        sim.run()
+
+
+def test_autoscaler_in_loop_scales_up_on_a_spike():
+    rng = random.Random(11)
+    workload = Workload.bursty(4.0, 60.0, 60.0, 100.0, 130.0, rng,
+                               ramp_s=40.0)
+    asc = Autoscaler(_predictive_cfg(), SimScaler(1),
+                     clock=lambda: 0.0)
+    sim = FleetSimulator(workload, ServiceModel.constant(0.05),
+                         replicas=1, seed=11, slo_s=0.5,
+                         autoscaler=asc, provision_delay_s=10.0)
+    res = sim.run()
+    assert res.max_replicas > 1
+    assert res.max_replicas <= 6  # the budget clamp held in-loop
+    ups = [d for d in res.decisions if d["action"] == "scale_up"]
+    assert ups, res.decisions
+    # Every decision record carries its inputs, forecast included.
+    assert all("forecast" in d["inputs"] for d in res.decisions)
+    assert any(d["reason"] == "forecast" for d in ups)
+
+
+def test_predictive_beats_reactive_on_the_ramped_spike():
+    # The acceptance scenario (bench.py --sim phase 2), small: the
+    # forecast extrapolates the ramp and pre-scales a provision-delay
+    # ahead; the reactive law waits for queues it can already see.
+    def run(predictive):
+        rng = random.Random(11)
+        workload = Workload.bursty(4.0, 60.0, 60.0, 100.0, 130.0, rng,
+                                   ramp_s=40.0)
+        cfg = (_predictive_cfg() if predictive else
+               _predictive_cfg(predictive=False, scale_to_zero=False))
+        asc = Autoscaler(cfg, SimScaler(1), clock=lambda: 0.0)
+        return FleetSimulator(workload, ServiceModel.constant(0.05),
+                              replicas=1, seed=11, slo_s=0.5,
+                              autoscaler=asc,
+                              provision_delay_s=10.0).run()
+
+    reactive, predictive = run(False), run(True)
+    assert predictive.time_over_slo_s < reactive.time_over_slo_s
+    assert predictive.max_replicas <= 6
+
+
+def test_wake_from_zero_serves_the_lobby():
+    # A scaled-to-zero fleet: arrivals wait at the door, the forecast
+    # wakes capacity, the lobby drains after the provision delay.
+    requests = [SimRequest(arrival_s=t) for t in (1.0, 1.5, 2.0)]
+    workload = Workload(requests=requests, duration_s=30.0)
+    cfg = _predictive_cfg(min_replicas=0, scale_to_zero=True,
+                          idle_quiet_s=300.0)
+    asc = Autoscaler(cfg, SimScaler(0), clock=lambda: 0.0)
+    sim = FleetSimulator(workload, ServiceModel.constant(0.02),
+                         replicas=0, seed=1, autoscaler=asc,
+                         autoscaler_interval_s=2.0,
+                         provision_delay_s=5.0)
+    res = sim.run()
+    assert res.completed == 3
+    kinds = [kind for _, kind, _ in res.event_log]
+    assert "lobby" in kinds and "unlobby" in kinds
+    assert any(d["reason"] == "wake_from_zero" for d in res.decisions)
+    # Lobby wait = wake tick + provision delay, so latencies include
+    # the cold start the autoscaler's lead time has to beat.
+    assert min(res.latencies_s) > 5.0
+
+
+def test_scale_to_zero_collapses_an_idle_fleet():
+    workload = Workload(requests=[SimRequest(arrival_s=0.5)],
+                        duration_s=120.0)
+    cfg = _predictive_cfg(min_replicas=0, scale_to_zero=True,
+                          idle_quiet_s=20.0, scale_down_cooldown_s=10.0)
+    asc = Autoscaler(cfg, SimScaler(1), clock=lambda: 0.0)
+    sim = FleetSimulator(workload, ServiceModel.constant(0.02),
+                         replicas=1, seed=1, autoscaler=asc)
+    res = sim.run()
+    assert res.completed == 1
+    assert any(d["reason"] == "scale_to_zero" for d in res.decisions)
+    assert not sim._live()  # the fleet really collapsed
