@@ -1066,6 +1066,26 @@ def _fleet_section_html_unsafe(fleet) -> str:
                                f"prefix hits)")
         except (TypeError, ValueError):
             pass
+        # Tiered KV memory (ISSUE 20): the per-tier breakdown rides
+        # the same cell — HBM page occupancy above, host-tier pool
+        # fill and fleet-fetch hits here. Same per-value degrade
+        # rule: each malformed value drops only its own fragment.
+        try:
+            host_occ = r.get("host_kv_occupancy")
+            if host_occ is not None:
+                frag = f"host {float(host_occ) * 100:.0f}%"
+                pages_cell = (frag if pages_cell == "-"
+                              else f"{pages_cell}, {frag}")
+        except (TypeError, ValueError):
+            pass
+        try:
+            fetches = r.get("kv_fetch_hits")
+            if fetches is not None and float(fetches) > 0:
+                frag = f"{float(fetches):.0f} fleet fetches"
+                pages_cell = (frag if pages_cell == "-"
+                              else f"{pages_cell}, {frag}")
+        except (TypeError, ValueError):
+            pass
         rows.append(
             "<tr>"
             f"<td><code>{html.escape(str(r.get('address', '')))}"

@@ -82,7 +82,15 @@ from kubeflow_tpu.inference.engine.paged_kv import (
     _is_kv,
     _scatter_token_range,
 )
-from kubeflow_tpu.inference.engine.prefix_cache import PrefixMatch
+from kubeflow_tpu.inference.engine.kv_tier import (
+    HostKVTier,
+    splice_host_blocks,
+)
+from kubeflow_tpu.inference.engine.prefix_cache import (
+    _ROOT,
+    PrefixMatch,
+    _block_key,
+)
 from kubeflow_tpu.inference.engine.slots import Slot, SlotScheduler
 from kubeflow_tpu.inference.generate import (
     _prefill_jit,
@@ -186,6 +194,37 @@ _M_SPEC_REJECTED = obs_metrics.Counter(
 _M_SPEC_RATE = obs_metrics.Gauge(
     "kft_engine_spec_acceptance_rate",
     "Lifetime drafted-token acceptance rate", ("model",))
+# Tiered KV memory families (ISSUE 20): the host tier's block flow
+# (spill in, re-adopt out, LRU eviction) plus the fleet pull-through
+# counters. All ride render-time callbacks off the live tier — one
+# source of truth, owner-checked clears at stop(), same discipline as
+# the prefix-cache families above.
+_M_HOST_SPILLED = obs_metrics.Counter(
+    "kft_engine_kv_host_spilled_blocks_total",
+    "Prefix KV blocks evicted from HBM into the host-RAM tier",
+    ("model",))
+_M_HOST_READOPTED = obs_metrics.Counter(
+    "kft_engine_kv_host_readopted_blocks_total",
+    "Host-tier KV blocks spliced back HBM-ward on a prefix match",
+    ("model",))
+_M_HOST_EVICTED = obs_metrics.Counter(
+    "kft_engine_kv_host_evicted_blocks_total",
+    "Host-tier KV blocks dropped by the byte-budget LRU", ("model",))
+_M_HOST_BYTES = obs_metrics.Gauge(
+    "kft_engine_kv_host_resident_bytes",
+    "Bytes of KV blocks resident in the host-RAM tier", ("model",))
+_M_HOST_BLOCKS = obs_metrics.Gauge(
+    "kft_engine_kv_host_resident_blocks",
+    "KV blocks resident in the host-RAM tier", ("model",))
+_M_KV_FETCH = obs_metrics.Counter(
+    "kft_engine_kv_fetch_total",
+    "Fleet KV pull-through fetches, by outcome (a 'miss' or 'error' "
+    "outcome always falls back to local prefill — never an error)",
+    ("model", "outcome"))
+_M_KV_FETCH_BLOCKS = obs_metrics.Counter(
+    "kft_engine_kv_fetched_blocks_total",
+    "KV blocks imported from fleet peers into the host tier",
+    ("model",))
 
 
 @dataclasses.dataclass
@@ -383,6 +422,12 @@ class _Request:
     #: prefill-role pool's index.
     prefill_only: bool = False
     prefill_box: Optional[dict] = None
+    #: Fleet KV fetch wall (ISSUE 20): seconds the serving layer
+    #: spent pulling this request's prefix blocks from the rendezvous
+    #: owner before submit. Attributed as its own ``kv_fetch_ms``
+    #: bucket in the engine_request span so a tier fetch is never
+    #: mistaken for queue wait or decode time.
+    kv_fetch_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -431,6 +476,13 @@ class EngineConfig:
     #: (0 = one-shot prefill). Prefix-cache mode only — chunks
     #: accumulate in the pad-0 layout.
     prefill_chunk: int = 0
+    #: tiered KV memory (ISSUE 20): byte budget for the host-RAM
+    #: prefix tier (0 = off). With a budget, LRU eviction of zero-ref
+    #: retained pages becomes evict-to-host, matches continue past
+    #: the HBM chain into host blocks (spliced back bitwise), and the
+    #: fleet pull-through endpoint (:kvfetch) can import blocks from
+    #: peer replicas. Prefix-cache mode only.
+    host_cache_bytes: int = 0
 
     @staticmethod
     def from_generate_config(cfg: dict, max_prompt_len: int,
@@ -456,6 +508,8 @@ class EngineConfig:
             prefix_cache=bool(cfg.get("engine_prefix_cache", False)),
             speculate_tokens=int(cfg.get("engine_draft_tokens", 0)),
             prefill_chunk=int(cfg.get("engine_prefill_chunk", 0)),
+            host_cache_bytes=int(
+                cfg.get("engine_host_cache_bytes", 0)),
         )
 
 
@@ -721,7 +775,46 @@ class DecodeEngine:
                     f"engine_prefill_chunk {config.prefill_chunk} "
                     f"must be a multiple of engine_page_size "
                     f"{config.page_size} (page-aligned slices)")
+        if config.host_cache_bytes < 0:
+            raise ValueError(
+                f"engine_host_cache_bytes {config.host_cache_bytes} "
+                f"< 0 (0 disables the host tier)")
+        #: Host-RAM KV tier (ISSUE 20) or None. Wired here so the
+        #: prefix cache's reclaim spills from the first eviction.
+        self.host_tier: Optional[HostKVTier] = None
+        if config.host_cache_bytes > 0:
+            if self.prefix is None:
+                # The knob survived export without the prefix cache:
+                # there is no index to tier — degrade, never a failed
+                # engine (same contract as the draft-tokens knob).
+                logger.warning(
+                    "engine %s: engine_host_cache_bytes=%d but "
+                    "engine_prefix_cache is off — host KV tier "
+                    "disabled", name, config.host_cache_bytes)
+            else:
+                self.host_tier = HostKVTier(config.host_cache_bytes)
+                self.prefix.set_host_tier(self.host_tier)
+                self.prefix.set_spill(self._spill_entry)
+        # Expected per-page host layer shapes ([page_size, heads,
+        # dim] per KV leaf, tree-flatten order): the shape gate every
+        # fleet-fetched block must pass before it can be spliced.
+        self._kv_leaf_shapes = [
+            tuple(leaf.shape[1:])
+            for leaf in jax.tree_util.tree_leaves(self.kv.physical)
+            if _is_kv(leaf)]
+        # Fleet pull-through accounting (GIL-consistent ints; the
+        # serving layer increments via note_kv_fetch from request
+        # threads).
+        self._kv_fetch_hits = 0
+        self._kv_fetch_misses = 0
+        self._kv_fetch_errors = 0
+        self._kv_fetched_blocks_total = 0
         self._cv = threading.Condition()
+        # Engine-thread control queue (ISSUE 20): closures posted by
+        # _run_on_engine and drained at the top of each lap, so
+        # request threads can read engine-owned state (the prefix
+        # index, live pool pages) without torn reads.
+        self._control: Deque[Callable[[], None]] = deque()
         self._thread: Optional[threading.Thread] = None
         self._closed = False
         self._rng_counter = 0
@@ -799,6 +892,24 @@ class DecodeEngine:
             self._g_prefix_pages = _M_PREFIX_PAGES.labels(name)
             self._g_prefix_pages.set_function(
                 self.prefix.resident_pages)
+        if self.host_tier is not None:
+            self._m_host_spilled = _M_HOST_SPILLED.labels(name)
+            self._m_host_spilled.set_function(self._host_spilled)
+            self._m_host_readopted = _M_HOST_READOPTED.labels(name)
+            self._m_host_readopted.set_function(self._host_readopted)
+            self._m_host_evicted = _M_HOST_EVICTED.labels(name)
+            self._m_host_evicted.set_function(self._host_evicted)
+            self._g_host_bytes = _M_HOST_BYTES.labels(name)
+            self._g_host_bytes.set_function(
+                self.host_tier.resident_bytes)
+            self._g_host_blocks = _M_HOST_BLOCKS.labels(name)
+            self._g_host_blocks.set_function(
+                self.host_tier.resident_blocks)
+            self._m_kv_fetch_hit = _M_KV_FETCH.labels(name, "hit")
+            self._m_kv_fetch_miss = _M_KV_FETCH.labels(name, "miss")
+            self._m_kv_fetch_error = _M_KV_FETCH.labels(name, "error")
+            self._m_kv_fetched_blocks = _M_KV_FETCH_BLOCKS.labels(
+                name)
 
     # -- submit side -----------------------------------------------------
 
@@ -848,6 +959,164 @@ class DecodeEngine:
     def _prefix_evicted_total(self) -> float:
         return float(self.prefix.evicted_pages) if self.prefix \
             else 0.0
+
+    def _host_spilled(self) -> float:
+        return float(self.host_tier.spilled_blocks) \
+            if self.host_tier else 0.0
+
+    def _host_readopted(self) -> float:
+        return float(self.host_tier.readopted_blocks) \
+            if self.host_tier else 0.0
+
+    def _host_evicted(self) -> float:
+        return float(self.host_tier.evicted_blocks) \
+            if self.host_tier else 0.0
+
+    def _spill_entry(self, entry) -> None:
+        """Evict-to-host hook (PrefixCache.set_spill): snapshot a
+        full block's page to host buffers under its chain key. Runs
+        INSIDE reclaim on the engine thread, before the page id
+        returns to the free list — the copy reads valid K/V. Never
+        raises: a failed spill degrades to the r15 drop (the next
+        match re-prefills), it must not poison the allocation that
+        triggered the eviction."""
+        try:
+            self.host_tier.put(
+                entry.key, entry.tokens,
+                self.kv.read_page_layers(entry.page))
+        except Exception:  # noqa: BLE001 — degrade to plain drop
+            logger.exception(
+                "engine %s: host-tier spill failed; page dropped "
+                "cold", self.name)
+
+    def note_kv_fetch(self, outcome: str, *, blocks: int = 0) -> None:
+        """Record one fleet pull-through attempt from the serving
+        layer (``hit`` / ``miss`` / ``error``). Thread-safe (GIL
+        ints + metric children)."""
+        if self.host_tier is None:
+            return
+        if outcome == "hit":
+            self._kv_fetch_hits += 1
+            self._m_kv_fetch_hit.inc()
+            if blocks:
+                self._kv_fetched_blocks_total += blocks
+                self._m_kv_fetched_blocks.inc(blocks)
+        elif outcome == "miss":
+            self._kv_fetch_misses += 1
+            self._m_kv_fetch_miss.inc()
+        else:
+            self._kv_fetch_errors += 1
+            self._m_kv_fetch_error.inc()
+
+    # -- fleet KV tier (ISSUE 20) ----------------------------------------
+
+    def _run_on_engine(self, fn: Callable[[], Any],
+                       timeout_s: float = 5.0) -> Any:
+        """Run ``fn`` on the engine thread between laps and return
+        its result (bounded wait). The engine's single-mutator
+        discipline covers the prefix index and the pool's page
+        custody; a request thread that walked them directly could
+        read a page id mid-reassignment. Inline when the engine
+        thread isn't running — nothing else owns the state then."""
+        with self._cv:
+            thread = self._thread
+        if thread is None or not thread.is_alive():
+            return fn()
+        done = threading.Event()
+        box: dict = {}
+
+        def wrapped() -> None:
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — carried back
+                box["error"] = e
+            finally:
+                done.set()
+
+        with self._cv:
+            self._control.append(wrapped)
+            self._cv.notify_all()
+        if not done.wait(timeout_s):
+            raise TimeoutError(
+                f"engine {self.name} control op timed out after "
+                f"{timeout_s:.1f}s")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def probe_prefix(self, prompt: np.ndarray) -> int:
+        """Cheap, lock-free estimate of how many prompt tokens this
+        engine could serve from its own tiers (HBM chain + host
+        continuation + one boundary partial). Dict reads off the
+        engine thread — a torn read costs one suboptimal fetch
+        decision, never correctness (the authoritative match runs at
+        admission). 0 when prefix caching is off."""
+        if self.prefix is None:
+            return 0
+        try:
+            return self.prefix.match(
+                np.asarray(prompt, np.int32).reshape(-1)).matched
+        except Exception:  # noqa: BLE001 — benign-race probe
+            return 0
+
+    def export_prefix_blocks(self, prompt: np.ndarray, *,
+                             timeout_s: float = 5.0
+                             ) -> List[tuple]:
+        """Owner-side half of the fleet pull-through: every resident
+        FULL block of ``prompt`` (HBM or host tier), chain order, as
+        ``(block_tokens, layers)`` pairs ready for the wire codec.
+        Runs the walk + page snapshots on the engine thread (torn
+        page reads are wrong K/V — not acceptable even on a
+        best-effort path); any failure or timeout returns [] and the
+        fetcher falls back to prefill."""
+        if self.prefix is None:
+            return []
+        tokens = np.asarray(prompt, np.int32).reshape(-1)
+
+        def walk() -> List[tuple]:
+            out = []
+            for block, entry, is_hbm in self.prefix.chain_blocks(
+                    tokens):
+                layers = (self.kv.read_page_layers(entry.page)
+                          if is_hbm else entry.layers)
+                out.append((block, layers))
+            return out
+
+        try:
+            return self._run_on_engine(walk, timeout_s=timeout_s)
+        except Exception:  # noqa: BLE001 — best-effort export
+            logger.warning(
+                "engine %s: prefix-block export failed; peer will "
+                "prefill cold", self.name, exc_info=True)
+            return []
+
+    def import_prefix_blocks(self, blocks: Sequence[tuple]) -> int:
+        """Fleet-fetch landing: index carried ``(tokens, layers)``
+        blocks in the HOST tier under chain keys recomputed from the
+        carried tokens (never trusting the peer's hashes), after a
+        shape gate against this engine's pool. Import stops at the
+        first malformed block — a chain is only as good as its
+        prefix. Thread-safe: the host tier locks internally, and the
+        engine thread only ever reads blocks it got back from its
+        own match. Returns blocks actually inserted."""
+        if self.host_tier is None:
+            return 0
+        p = self.config.page_size
+        parent = _ROOT
+        imported = 0
+        for tokens, layers in blocks:
+            block = tuple(int(t) for t in np.asarray(tokens).reshape(-1))
+            if len(block) != p:
+                break
+            arrays = [np.asarray(a) for a in layers]
+            if [tuple(a.shape) for a in arrays] != \
+                    self._kv_leaf_shapes:
+                break
+            key = _block_key(parent, block)
+            if self.host_tier.put(key, block, arrays, imported=True):
+                imported += 1
+            parent = key
+        return imported
 
     def clear_prefix_cache(self) -> int:
         """Drop every cached prefix (idle pages return to the free
@@ -979,7 +1248,8 @@ class DecodeEngine:
                request_id: str = "",
                tenant: str = "",
                handoff: Optional[PrefillHandoff] = None,
-               step_keys: Optional[np.ndarray] = None
+               step_keys: Optional[np.ndarray] = None,
+               kv_fetch_s: float = 0.0
                ) -> GenerateStream:
         """Queue one request; tokens stream on the returned handle.
 
@@ -1175,7 +1445,8 @@ class DecodeEngine:
                        max_new_tokens=budget, deadline=deadline,
                        stream=stream, submitted_at=now,
                        request_id=request_id, tenant=tenant,
-                       handoff=handoff)
+                       handoff=handoff,
+                       kv_fetch_s=max(0.0, float(kv_fetch_s)))
         with self._cv:
             if self._closed:
                 raise RuntimeError("engine is stopped")
@@ -1233,6 +1504,14 @@ class DecodeEngine:
             # keep exporting its stale stats.
             self._m_prefix_evicted.clear_function(self)
             self._g_prefix_pages.clear_function(self.prefix)
+        if self.host_tier is not None:
+            if not still_running:
+                self.host_tier.clear()
+            self._m_host_spilled.clear_function(self)
+            self._m_host_readopted.clear_function(self)
+            self._m_host_evicted.clear_function(self)
+            self._g_host_bytes.clear_function(self.host_tier)
+            self._g_host_blocks.clear_function(self.host_tier)
         if self._spec_on:
             self._g_spec_rate.clear_function(self)
         self._g_slots.clear_function(self.scheduler)
@@ -1284,9 +1563,35 @@ class DecodeEngine:
             }
         if self.config.prefill_chunk:
             out["prefill_chunk"] = self.config.prefill_chunk
+        if self.host_tier is not None:
+            # The tiered-KV block /healthz saturation (and through
+            # it the dashboard's per-tier Pages breakdown and the
+            # autoscaler's host-occupancy sample) reads.
+            out["kv_tier"] = {
+                "host": self.host_tier.stats(),
+                "fetch_hits": self._kv_fetch_hits,
+                "fetch_misses": self._kv_fetch_misses,
+                "fetch_errors": self._kv_fetch_errors,
+                "fetched_blocks": self._kv_fetched_blocks_total,
+            }
         return out
 
     # -- engine thread ---------------------------------------------------
+
+    def _drain_control(self) -> None:
+        """Run every posted control closure (engine thread). The
+        closures carry their own error boxes (_run_on_engine); the
+        belt-and-braces except keeps a broken closure from killing
+        innocent in-flight slots via _loop's handler."""
+        while True:
+            with self._cv:
+                if not self._control:
+                    return
+                fn = self._control.popleft()
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — already boxed
+                logger.exception("engine control op failed")
 
     def _loop(self) -> None:
         while True:
@@ -1294,10 +1599,12 @@ class DecodeEngine:
                 if self._closed:
                     return
                 if (not self.scheduler.pending
-                        and not self.scheduler.active_slots()):
+                        and not self.scheduler.active_slots()
+                        and not self._control):
                     self._cv.wait(timeout=0.05)
                     continue
             try:
+                self._drain_control()
                 self._expire()
                 self._admit()
                 self._advance_prefills()
@@ -1596,11 +1903,25 @@ class DecodeEngine:
                 done = bool(req.handoff.done)
             else:
                 if m > 0:
-                    page_row = list(shared)
+                    # Host-tier blocks (ISSUE 20) continue the chain
+                    # past the shared HBM pages: their table rows
+                    # gather as null-page zeros, then the host copies
+                    # are spliced over those rows — byte-equal to
+                    # having kept the pages, so the tail prefill (and
+                    # the decode) is bitwise the cold run's.
+                    host_blocks = list(match.host_entries)
+                    page_row = list(shared) + [0] * len(host_blocks)
                     if match.fork is not None:
                         page_row.append(match.fork.page)
                     cache = self.kv.gather_prefix_cache(
                         page_row, self._prefill_template, m)
+                    if host_blocks:
+                        cache = splice_host_blocks(
+                            cache,
+                            [hb.layers for hb in host_blocks],
+                            len(shared), self.kv.page_size)
+                        self.host_tier.note_readopted(
+                            len(host_blocks))
                     if fork_pinned:
                         # The fork copy is dispatched (device ops
                         # serialize in thread order); the donor page
@@ -1706,11 +2027,22 @@ class DecodeEngine:
         fork_pinned = match.fork is not None
         try:
             if m > 0:
-                page_row = list(match.shared_pages)
+                # Same gather + host-splice as the one-shot path: the
+                # accumulating B=1 cache starts with every matched
+                # tier's rows in place, and the chunks append past
+                # them.
+                host_blocks = list(match.host_entries)
+                page_row = list(match.shared_pages) + \
+                    [0] * len(host_blocks)
                 if match.fork is not None:
                     page_row.append(match.fork.page)
                 cache = self.kv.gather_prefix_cache(
                     page_row, self._prefill_template, m)
+                if host_blocks:
+                    cache = splice_host_blocks(
+                        cache, [hb.layers for hb in host_blocks],
+                        len(match.shared_pages), self.kv.page_size)
+                    self.host_tier.note_readopted(len(host_blocks))
                 if fork_pinned:
                     self.prefix.unpin_fork(match)
                     fork_pinned = False
@@ -2204,6 +2536,12 @@ class DecodeEngine:
                     verify_ms=round(slot.verify_s * 1e3, 3),
                     spec_drafted=slot.spec_drafted,
                     spec_accepted=slot.spec_accepted)
+            if req.kv_fetch_s:
+                # Fleet pull-through wall (ISSUE 20): its own
+                # attribution bucket, so a tier fetch is never
+                # mistaken for queue wait or decode time in the r19
+                # report.
+                extra["kv_fetch_ms"] = round(req.kv_fetch_s * 1e3, 3)
             TRACER.record(
                 "engine_request", "engine", req.submitted_at,
                 time.monotonic() - req.submitted_at,

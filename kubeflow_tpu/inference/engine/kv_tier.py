@@ -1,0 +1,243 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Tier 1 of the tiered KV memory (ISSUE 20): a byte-budgeted
+host-RAM pool behind the r15 HBM prefix cache.
+
+The r15 radix cache lives and dies inside one replica's HBM page
+pool: under page pressure ``PrefixCache.reclaim`` DROPS zero-ref
+retained pages, and every drop costs a full re-prefill to rebuild.
+Here the drop becomes **evict-to-host**: the page's K/V rows are
+snapshotted to host buffers (one ``[page_size, heads, dim]`` array
+per KV leaf, the same per-page shape ``_gather_pages_to_cache``
+reads), indexed under the SAME chain hash the radix index uses, and
+**re-adopted** HBM-ward on a later match — a host→HBM copy is cheap
+next to a re-prefill.
+
+Custody model — deliberately simpler than the allocator's:
+
+- A host block has no refcounts and no pin protocol. A match hands
+  back the ``_HostBlock`` object itself; the admission path holds a
+  Python reference until the splice lands, so LRU eviction between
+  match and splice can never free the arrays out from under it
+  (numpy keeps them alive) — it only makes the block unmatchable for
+  the NEXT request. No pins means no new deadlock surface: the r15
+  no-deadlock rule is untouched because host blocks never consume
+  allocator availability.
+- Only FULL blocks spill. A partial boundary block is one request's
+  private tail — its chain key names a *parent*, not itself, and the
+  CoW fork machinery only pays off against resident HBM pages.
+- The tier is locked (``threading.RLock``) because fleet-fetch
+  imports land from server request threads while the engine thread
+  matches and spills. Every public method takes the lock; the
+  engine-side single-mutator discipline still governs everything
+  HBM-side.
+
+Bitwise correctness: K/V at position ``i`` is a pure function of
+tokens ``[0, i]`` (the prefix-cache contract), and a spill snapshot
+is taken inside ``reclaim`` BEFORE the page id returns to the free
+list — jax arrays are immutable, so the copy reads exactly the bytes
+the retired slots wrote. Splicing those bytes back is therefore
+indistinguishable from having kept the page.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.inference.engine.paged_kv import _is_kv
+
+__all__ = ["HostKVTier", "splice_host_blocks"]
+
+
+@dataclasses.dataclass
+class _HostBlock:
+    """One spilled (or fleet-fetched) full token block: the chain key
+    it is indexed under, the block's token content (compared on match
+    so a hash collision degrades to a miss), and one host array per
+    KV leaf in tree-flatten order."""
+
+    key: bytes
+    tokens: Tuple[int, ...]
+    layers: List[np.ndarray]  # [page_size, heads, dim] per KV leaf
+    nbytes: int
+
+
+class HostKVTier:
+    """Byte-budgeted LRU of host-resident KV blocks, keyed by the
+    prefix cache's chain hashes. ``put`` inserts at the MRU end and
+    evicts LRU-first past the budget; ``get`` is a token-compared
+    lookup that refreshes recency. Thread-safe (see module doc)."""
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes < 0:
+            raise ValueError(
+                f"host cache budget must be >= 0 bytes, got "
+                f"{budget_bytes}")
+        self.budget_bytes = int(budget_bytes)
+        self._lock = threading.RLock()
+        self._blocks: "OrderedDict[bytes, _HostBlock]" = OrderedDict()
+        self._bytes = 0
+        # Monotonic counters (stats()/metrics families).
+        self.spilled_blocks = 0
+        self.imported_blocks = 0
+        self.evicted_blocks = 0
+        self.readopted_blocks = 0
+
+    # -- queries ---------------------------------------------------------
+
+    def resident_blocks(self) -> int:
+        with self._lock:
+            return len(self._blocks)
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def get(self, key: bytes, tokens: Sequence[int]):
+        """Token-compared lookup: the stored block must carry exactly
+        ``tokens`` (collision guard — same degrade-to-miss rule as
+        the HBM index). A hit refreshes LRU recency. Returns the
+        :class:`_HostBlock` or None."""
+        block = tuple(int(t) for t in tokens)
+        with self._lock:
+            hb = self._blocks.get(key)
+            if hb is None or hb.tokens != block:
+                return None
+            self._blocks.move_to_end(key)
+            return hb
+
+    # -- mutation --------------------------------------------------------
+
+    def put(self, key: bytes, tokens: Sequence[int],
+            layers: Sequence[np.ndarray], *,
+            imported: bool = False) -> bool:
+        """Insert one full block (spill path, or ``imported=True``
+        for a fleet fetch landing). A key already resident just
+        refreshes recency (dedupe — a re-adopted block that evicts
+        again finds its host copy still here). Returns True only on a
+        real insert."""
+        block = tuple(int(t) for t in tokens)
+        arrays = [np.asarray(a) for a in layers]
+        nbytes = sum(int(a.nbytes) for a in arrays)
+        with self._lock:
+            if self.budget_bytes <= 0 or nbytes > self.budget_bytes:
+                return False
+            existing = self._blocks.get(key)
+            if existing is not None:
+                self._blocks.move_to_end(key)
+                return False
+            self._blocks[key] = _HostBlock(
+                key=key, tokens=block, layers=arrays, nbytes=nbytes)
+            self._bytes += nbytes
+            if imported:
+                self.imported_blocks += 1
+            else:
+                self.spilled_blocks += 1
+            while self._bytes > self.budget_bytes and self._blocks:
+                _, victim = self._blocks.popitem(last=False)
+                self._bytes -= victim.nbytes
+                self.evicted_blocks += 1
+            return True
+
+    def note_readopted(self, n: int) -> None:
+        """The admission path spliced ``n`` host blocks HBM-ward."""
+        with self._lock:
+            self.readopted_blocks += int(n)
+
+    def clear(self) -> int:
+        """Drop every resident block (engine stop / tests). Returns
+        the number of blocks released."""
+        with self._lock:
+            n = len(self._blocks)
+            self._blocks.clear()
+            self._bytes = 0
+            return n
+
+    # -- reporting -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "budget_bytes": self.budget_bytes,
+                "resident_bytes": self._bytes,
+                "resident_blocks": len(self._blocks),
+                "spilled_blocks": self.spilled_blocks,
+                "imported_blocks": self.imported_blocks,
+                "evicted_blocks": self.evicted_blocks,
+                "readopted_blocks": self.readopted_blocks,
+            }
+
+    def check_accounting(self) -> None:
+        """Fuzz-harness half for the host pool: byte ledger vs the
+        resident set, budget respected, every block well-formed."""
+        with self._lock:
+            total = sum(b.nbytes for b in self._blocks.values())
+            assert total == self._bytes, \
+                f"host byte ledger drifted: {self._bytes} != {total}"
+            assert self._bytes <= max(0, self.budget_bytes), \
+                f"host pool over budget: {self._bytes} > " \
+                f"{self.budget_bytes}"
+            for key, b in self._blocks.items():
+                assert b.key == key, f"host block keyed under a " \
+                    f"foreign key: {b.key!r} != {key!r}"
+                assert b.nbytes == sum(int(a.nbytes)
+                                       for a in b.layers), \
+                    f"host block {key!r} nbytes drifted"
+                assert b.tokens, f"host block {key!r} carries no " \
+                    f"tokens"
+
+
+@jax.jit
+def _splice_block(cache: Any, layers: Any, row: jax.Array) -> Any:
+    """Write one host block's K/V over the gathered B=1 cache at rows
+    ``[row, row + page_size)``. ``row`` is traced, so every block
+    offset (and every prefix depth) shares one compile; KV leaves
+    pair with ``layers`` in tree-flatten order — the same
+    deterministic order :meth:`PagedKVCache.read_page_layers`
+    snapshots in. Scalar index leaves ride through untouched (the
+    gather already set them to the full matched length)."""
+    it = iter(layers)
+
+    def s(leaf):
+        if not _is_kv(leaf):
+            return leaf
+        seg = next(it)
+        return jax.lax.dynamic_update_slice(
+            leaf, seg[None].astype(leaf.dtype), (0, row, 0, 0))
+
+    return jax.tree.map(s, cache)
+
+
+def splice_host_blocks(cache: Any,
+                       blocks_layers: Sequence[Sequence[np.ndarray]],
+                       first_block: int, page_size: int) -> Any:
+    """Land consecutive host blocks into a gathered B=1 prefix cache:
+    block ``i`` of ``blocks_layers`` covers cache rows
+    ``[(first_block + i)·P, (first_block + i + 1)·P)`` — exactly the
+    null-page placeholder rows the gather left as zeros. The result
+    is byte-equal to the cache a pure-HBM match of the same depth
+    would have gathered (the host copies ARE the evicted pages'
+    bytes), which is what keeps tier hits bitwise."""
+    for i, layers in enumerate(blocks_layers):
+        row = jnp.asarray((first_block + i) * page_size, jnp.int32)
+        cache = _splice_block(cache, list(layers), row)
+    return cache

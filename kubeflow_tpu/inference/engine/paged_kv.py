@@ -444,6 +444,21 @@ class PagedKVCache:
                 jnp.asarray(shared, jnp.int32), n_pages=n_priv)
         return n_pages
 
+    def read_page_layers(self, page: int) -> List[np.ndarray]:
+        """Snapshot one pool page's K/V to host memory: one
+        ``[page_size, heads, dim]`` array per KV leaf, in
+        tree-flatten order (the same deterministic order
+        ``kv_tier.splice_host_blocks`` writes back in). The
+        evict-to-host copy (ISSUE 20): called inside the prefix
+        cache's ``reclaim`` while the page still holds valid K/V —
+        jax arrays are immutable, so the snapshot is exact whatever
+        the pool does next."""
+        out: List[np.ndarray] = []
+        for leaf in jax.tree_util.tree_leaves(self.physical):
+            if _is_kv(leaf):
+                out.append(np.asarray(leaf[int(page)]))
+        return out
+
     def gather_prefix_cache(self, page_ids: Sequence[int],
                             template: Any, fill_len: int) -> Any:
         """Shared prefix pages (padded with the null page to the full

@@ -59,7 +59,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -88,12 +88,20 @@ class PrefixMatch:
     matched FULL blocks in chain order; ``fork`` is the partially
     matched boundary entry (``fork_len`` of its tokens are common) —
     its page is read once for the CoW copy, never placed in the
-    sharer's table. ``matched`` counts prefix tokens covered."""
+    sharer's table. ``matched`` counts prefix tokens covered.
+
+    ``host_entries`` (ISSUE 20) are host-tier blocks continuing the
+    chain past the last HBM-resident block: their K/V is spliced into
+    the gathered prefill cache and re-adopted into PRIVATE pages, so
+    they appear after ``entries`` in chain order but never in
+    ``shared_pages`` (they hold no allocator custody and need no
+    pin — the match's Python reference keeps the arrays alive)."""
 
     entries: List[_Entry]
     fork: Optional[_Entry]
     fork_len: int
     matched: int
+    host_entries: List[Any] = dataclasses.field(default_factory=list)
 
     @property
     def shared_pages(self) -> List[int]:
@@ -122,7 +130,24 @@ class PrefixCache:
         self.misses = 0
         self.evicted_pages = 0
         self.saved_tokens_total = 0
+        # Tiered KV memory (ISSUE 20): the host-RAM tier behind this
+        # index, and the spill hook ``reclaim`` calls for each FULL
+        # entry BEFORE its page returns to the free list (the page's
+        # K/V is still valid there — the snapshot races nothing).
+        # Both stay None on a single-tier engine, which keeps every
+        # r15 path bitwise untouched.
+        self.host = None
+        self._spill = None
         allocator.set_cache(self)
+
+    def set_host_tier(self, tier) -> None:
+        """Attach the host-RAM tier ``match`` continues into."""
+        self.host = tier
+
+    def set_spill(self, fn) -> None:
+        """Install the evict-to-host hook (``fn(entry)``); the callee
+        must never raise — a failed spill degrades to the r15 drop."""
+        self._spill = fn
 
     # -- queries ---------------------------------------------------------
 
@@ -169,6 +194,23 @@ class PrefixCache:
             entries.append(entry)
             parent = entry.key
             covered += p
+        # Tier continuation (ISSUE 20): where the HBM chain ends, the
+        # host tier may carry the next blocks (they were evicted
+        # here, or fleet-fetched in). Once the walk goes host it
+        # STAYS host — shared pages must be a contiguous table
+        # prefix, so a deeper HBM block past a host block cannot be
+        # shared in place (rare by construction: children idle, and
+        # therefore evict, before their parents).
+        host_entries: List[Any] = []
+        if self.host is not None:
+            while covered + p <= limit:
+                block = tuple(tokens[covered:covered + p])
+                hb = self.host.get(_block_key(parent, block), block)
+                if hb is None:
+                    break
+                host_entries.append(hb)
+                parent = hb.key
+                covered += p
         fork = None
         fork_len = 0
         partial = self._partial.get(parent)
@@ -183,7 +225,38 @@ class PrefixCache:
                 fork, fork_len = partial, common
         return PrefixMatch(entries=entries, fork=fork,
                            fork_len=fork_len,
-                           matched=covered + fork_len)
+                           matched=covered + fork_len,
+                           host_entries=host_entries)
+
+    def chain_blocks(self, prompt: Sequence[int]):
+        """Full-block chain walk WITHOUT :meth:`match`'s ``len-1``
+        cap — the fleet export side (serving/kv_store.py) wants every
+        resident full block of ``prompt``, including one ending
+        exactly at the prompt's end. Yields ``(block_tokens, entry,
+        is_hbm)`` in chain order, stopping at the first gap; entries
+        are HBM ``_Entry`` (``is_hbm=True``) until the chain crosses
+        into the host tier, then host blocks (same stickiness rule
+        as ``match``). Engine thread only (reads the live index)."""
+        tokens = [int(t) for t in prompt]
+        p = self.page_size
+        parent = _ROOT
+        covered = 0
+        in_host = False
+        while covered + p <= len(tokens):
+            block = tuple(tokens[covered:covered + p])
+            key = _block_key(parent, block)
+            entry = None if in_host else self._full.get(key)
+            if entry is not None and entry.tokens == block:
+                yield block, entry, True
+            else:
+                hb = (self.host.get(key, block)
+                      if self.host is not None else None)
+                if hb is None:
+                    return
+                in_host = True
+                yield block, hb, False
+            parent = key
+            covered += p
 
     def pin(self, match: PrefixMatch) -> PrefixMatch:
         """Take a slot reference on every matched page, shallowest
@@ -194,14 +267,22 @@ class PrefixCache:
         pinned: List[_Entry] = []
         for e in match.entries:
             if not self.allocator.ref(e.page):
+                # The chain is broken at an unpinnable HBM block —
+                # host blocks hanging past it are unreachable too.
                 return PrefixMatch(entries=pinned, fork=None,
                                    fork_len=0,
                                    matched=len(pinned) * self.page_size)
             pinned.append(e)
         if match.fork is not None and \
                 not self.allocator.ref(match.fork.page):
+            # Host blocks need no pin (no allocator custody): a
+            # refused FORK pin only sheds the fork, never the host
+            # chain already matched under it.
+            covered = (len(pinned) + len(match.host_entries)) * \
+                self.page_size
             return PrefixMatch(entries=pinned, fork=None, fork_len=0,
-                               matched=len(pinned) * self.page_size)
+                               matched=covered,
+                               host_entries=list(match.host_entries))
         return match
 
     def unpin(self, match: PrefixMatch,
@@ -284,12 +365,18 @@ class PrefixCache:
     def reclaim(self, n: int) -> List[int]:
         """Evict up to ``n`` least-recently-used idle pages: drop
         their index entries and hand the page ids back to the
-        allocator (which moves them retained → free)."""
+        allocator (which moves them retained → free). With a host
+        tier attached (ISSUE 20) a FULL entry's K/V is spilled to
+        host buffers FIRST — the page is still resident here, so the
+        snapshot reads exactly the bytes a sharer would have; the
+        drop then proceeds as before (evict-to-host, not drop)."""
         out: List[int] = []
         while len(out) < n and self._idle:
             page, _ = self._idle.popitem(last=False)
             entry = self._by_page.get(page)
             if entry is not None:
+                if self._spill is not None and entry.full:
+                    self._spill(entry)
                 self._drop_entry(entry, free_idle=False)
             out.append(page)
             self.evicted_pages += 1

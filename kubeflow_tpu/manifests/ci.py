@@ -204,6 +204,21 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
         "fleet-sim": [
             py, f"{src}/bench.py", "--sim",
         ],
+        # Tiered-KV gate (ISSUE 20): the tiered prefix-cache sweep —
+        # a chat replay whose prefix working set is 4x the HBM page
+        # pool, r15 HBM-only baseline vs the host-RAM spill tier at
+        # a tiny pool. Tiering must hold >= 70% effective hit rate
+        # where the baseline collapses, host re-adopts must be doing
+        # the holding, and outputs must stay bitwise-equal to B=1
+        # generate, greedy and sampled. Writes kv_tier_stats.json
+        # under $KFT_OBS_DIR for the collect-obs sweep (the fleet
+        # sim's prefix-hit service class calibrates from it).
+        # Hermetic — tiny test model on JAX CPU, no cluster, no
+        # accelerator.
+        "kv-tier": [
+            py, f"{src}/bench.py", "--prefix",
+            "--working-set-multiple",
+        ],
         # Trace-assembly gate (ISSUE 15): the distributed-tracing
         # sweep — a real proxy + two role-split servers + a span-
         # scraping collector; unary, SSE, role-split and hedged
@@ -274,6 +289,7 @@ def e2e_workflow(params: Dict[str, Any]) -> Dict[str, Any]:
             _dag_task("serving-tenancy", ["checkout"]),
             _dag_task("spec-decode", ["checkout"]),
             _dag_task("fleet-sim", ["checkout"]),
+            _dag_task("kv-tier", ["checkout"]),
             _dag_task("trace-assembly", ["checkout"]),
             _dag_task("deploy-test", ["checkout"]),
             _dag_task("deploy-serving", ["deploy-test"]),

@@ -138,9 +138,11 @@ def attribution(spans: List[Dict[str, Any]],
     stopwatch); by default it is the proxy root span's duration,
     falling back to the server legs' sum for direct-to-server traces.
 
-    - **queue / prefill / decode** come from the engine's exact
-      per-request triple (``engine_request``) plus the micro-batcher
-      spans — no cross-process timestamp arithmetic.
+    - **queue / kv_fetch / prefill / decode** come from the engine's
+      exact per-request figures (``engine_request``) plus the
+      micro-batcher spans — no cross-process timestamp arithmetic.
+      ``kv_fetch`` is the fleet KV tier's pull-through spend (ISSUE
+      20), bucketed apart so it is never mistaken for decode time.
     - **relay** is MEASURED: the proxy root wall minus the proxy's
       own ``proxy_upstream`` windows (its time outside upstream
       awaits).
@@ -154,7 +156,7 @@ def attribution(spans: List[Dict[str, Any]],
     the signal the assembly layer owes you."""
     proxy_ms = 0.0
     server_ms = 0.0
-    queue = prefill = decode = 0.0
+    queue = prefill = decode = kv_fetch = 0.0
     legs: Dict[str, float] = {}
     upstream: Dict[str, float] = {}
     engine_seen = any(s.get("name") == "engine_request"
@@ -181,6 +183,10 @@ def attribution(spans: List[Dict[str, Any]],
             queue += _f(args.get("queue_ms"))
             prefill += _f(args.get("prefill_ms"))
             decode += _f(args.get("decode_ms"))
+            # Fleet KV fetch spend (ISSUE 20) gets its OWN bucket:
+            # pulling prefix pages from the rendezvous owner happens
+            # before prefill and must never read as decode time.
+            kv_fetch += _f(args.get("kv_fetch_ms"))
             continue
         bucket = _BUCKET_BY_NAME.get(name)
         if bucket == "queue":
@@ -197,7 +203,7 @@ def attribution(spans: List[Dict[str, Any]],
                 prefill += _dur_ms(span)
     if total_ms is None:
         total_ms = proxy_ms if proxy_ms > 0 else server_ms
-    attributed = queue + prefill + decode
+    attributed = queue + kv_fetch + prefill + decode
     server_residual = max(0.0, server_ms - attributed) \
         if server_ms > 0 else 0.0
     missing = []
@@ -235,6 +241,7 @@ def attribution(spans: List[Dict[str, Any]],
         "total_ms": round(total_ms, 3),
         "buckets": {
             "queue_ms": round(queue, 3),
+            "kv_fetch_ms": round(kv_fetch, 3),
             "prefill_ms": round(prefill, 3),
             "decode_ms": round(decode, 3),
             "relay_ms": round(relay, 3),

@@ -569,6 +569,8 @@ class AutoscalerLoop:
         slots = active_slots = 0.0
         page_occupancy = None
         prefix_hits = prefix_misses = 0.0
+        host_occupancy = None
+        kv_fetch_hits = 0.0
         shards = 1
         for stats in (payload.get("saturation") or {}).values():
             queue_wait += (float(stats.get("queue_depth", 0.0))
@@ -597,6 +599,20 @@ class AutoscalerLoop:
                 prefix = engine.get("prefix_cache") or {}
                 prefix_hits += float(prefix.get("hits", 0.0))
                 prefix_misses += float(prefix.get("misses", 0.0))
+                # Host-tier occupancy (ISSUE 20): a full host pool
+                # means evictions now drop prefixes cold — the
+                # tiering headroom signal, reported like page
+                # occupancy (worst engine wins).
+                host = (engine.get("kv_tier") or {}).get("host") or {}
+                budget = float(host.get("budget_bytes", 0.0))
+                if budget > 0:
+                    occ = float(host.get("resident_bytes",
+                                         0.0)) / budget
+                    host_occupancy = (occ if host_occupancy is None
+                                      else max(host_occupancy, occ))
+                kv_fetch_hits += float(
+                    (engine.get("kv_tier") or {}).get(
+                        "fetch_hits", 0.0))
             except (TypeError, ValueError):
                 pass  # malformed engine stats degrade, never raise
             try:
@@ -648,6 +664,10 @@ class AutoscalerLoop:
         if prefix_hits + prefix_misses > 0:
             row["prefix_hit_rate"] = round(
                 prefix_hits / (prefix_hits + prefix_misses), 4)
+        if host_occupancy is not None:
+            row["host_kv_occupancy"] = round(host_occupancy, 4)
+        if kv_fetch_hits > 0:
+            row["kv_fetch_hits"] = round(kv_fetch_hits, 1)
         return row
 
     def _scrape_one(self, address: str

@@ -73,6 +73,7 @@ __all__ = [
     "eligible_endpoints",
     "make_balancer",
     "normalize_prefix_key",
+    "rendezvous_owner",
 ]
 
 #: Tokens of prompt prefix that name a request's affinity bucket.
@@ -104,6 +105,26 @@ def normalize_prefix_key(instances: Any,
     except (TypeError, ValueError, IndexError, KeyError,
             OverflowError):
         return None
+
+def rendezvous_owner(endpoints: Sequence[Endpoint],
+                     prefix_key: Optional[str]) -> Optional[Endpoint]:
+    """The prefix key's rendezvous-hash HOME over the routable pool —
+    the replica whose caches accumulate this prefix's KV pages,
+    because :class:`PrefixAffinityBalancer` steers its traffic there
+    by the SAME ``rendezvous_weight`` placement. The fleet KV tier
+    (ISSUE 20) asks this owner for pages when a request lands
+    elsewhere (overload fallback, hedging, failover). Deliberately
+    computed over ALL routable members, not one attempt's candidate
+    set: the owner of a key must not drift with per-request exclusion
+    lists. None when keyless or the pool is empty."""
+    if not prefix_key:
+        return None
+    pool = [ep for ep in endpoints if ep.routable()]
+    if not pool:
+        return None
+    return max(pool, key=lambda ep: policy.rendezvous_weight(
+        prefix_key, ep.address))
+
 
 #: A breaker-open endpoint re-enters the candidate set this close to
 #: (or past) its half-open due time — the pick that lands on it IS the

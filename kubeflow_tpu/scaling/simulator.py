@@ -51,6 +51,7 @@ from kubeflow_tpu.scaling import policy
 
 __all__ = [
     "FleetSimulator",
+    "PrefixHitServiceModel",
     "ServiceModel",
     "SimReplica",
     "SimRequest",
@@ -242,6 +243,78 @@ class ServiceModel:
 
     def sample(self, rng: random.Random) -> float:
         return self._samples[rng.randrange(len(self._samples))]
+
+
+class PrefixHitServiceModel(ServiceModel):
+    """Prefix-hit-conditioned service class (ROADMAP #7a, the tiered
+    KV memory of ISSUE 20): a request that hits the prefix cache
+    skips (most of) prefill, so its service draw comes from a
+    different distribution than a cold miss. One blended distribution
+    gets the MEAN right but not the shape — and bimodal service times
+    are exactly what queueing percentiles are sensitive to, so the
+    sim draws a Bernoulli(hit_rate) per request and samples the
+    matching sub-model. ``mean`` stays the blend, which is what
+    ``SimReplica.saturation`` and the autoscaler-tick queue-wait
+    estimate read."""
+
+    def __init__(self, hit: ServiceModel, miss: ServiceModel,
+                 hit_rate: float):
+        if not 0.0 <= float(hit_rate) <= 1.0:
+            raise ValueError(
+                f"hit_rate must be in [0, 1]; got {hit_rate}")
+        self.hit = hit
+        self.miss = miss
+        self.hit_rate = float(hit_rate)
+        self._samples = sorted(hit._samples + miss._samples)
+        self.mean = (hit.mean * self.hit_rate
+                     + miss.mean * (1.0 - self.hit_rate))
+
+    @classmethod
+    def from_tier_stats(cls, miss: ServiceModel,
+                        stats: Dict[str, Any], *,
+                        prefill_share: float = 0.5,
+                        fetch_penalty_s: float = 0.0
+                        ) -> "PrefixHitServiceModel":
+        """Calibrate from an engine ``stats()`` mapping (the healthz
+        ``engines[*]`` block, or the tier-stats dump the bench
+        writes). ``hit_rate`` is the prefix cache's *effective* rate
+        — host re-adopts and fleet fetches land as cache hits after
+        import, so the counters already fold the tiers in. The
+        hit-path distribution is the miss distribution with the
+        prefill share removed, plus ``fetch_penalty_s`` weighted by
+        how often a hit was served through a fleet fetch."""
+        if not 0.0 <= float(prefill_share) < 1.0:
+            raise ValueError(
+                f"prefill_share must be in [0, 1); got {prefill_share}")
+        prefix = (stats or {}).get("prefix_cache") or {}
+        hits = max(0.0, float(prefix.get("hits", 0.0)))
+        misses = max(0.0, float(prefix.get("misses", 0.0)))
+        lookups = hits + misses
+        hit_rate = hits / lookups if lookups > 0 else 0.0
+        tier = (stats or {}).get("kv_tier") or {}
+        fetch_hits = max(0.0, float(tier.get("fetch_hits", 0.0)))
+        remote_share = min(1.0, fetch_hits / hits) if hits > 0 else 0.0
+        hit_mean = (miss.mean * (1.0 - float(prefill_share))
+                    + remote_share * max(0.0, float(fetch_penalty_s)))
+        hit = miss.scaled_to_mean(max(hit_mean, 1e-9))
+        return cls(hit, miss, hit_rate)
+
+    def sample(self, rng: random.Random) -> float:
+        branch = (self.hit if rng.random() < self.hit_rate
+                  else self.miss)
+        return branch.sample(rng)
+
+    def scaled_to_mean(self, mean_s: float) -> "PrefixHitServiceModel":
+        # Rescale BOTH branches by the same factor so the blend hits
+        # the target mean without flattening the bimodality — the
+        # whole point of conditioning on the hit.
+        if mean_s <= 0:
+            raise ValueError("mean_s must be > 0")
+        factor = mean_s / self.mean
+        return PrefixHitServiceModel(
+            self.hit.scaled_to_mean(self.hit.mean * factor),
+            self.miss.scaled_to_mean(self.miss.mean * factor),
+            self.hit_rate)
 
 
 class SimReplica:

@@ -1559,6 +1559,206 @@ def run_prefix_benchmark(config: PrefixBenchConfig) -> Dict[str, Any]:
 
 
 @dataclasses.dataclass
+class TieredPrefixBenchConfig:
+    """`bench.py --prefix --working-set-multiple`: the ISSUE 20
+    acceptance sweep. A chat replay whose PREFIX WORKING SET is a
+    multiple of the HBM page pool — the traffic shape where the r15
+    HBM-only prefix cache structurally collapses (cyclic access over
+    a working set bigger than an LRU pool evicts every entry before
+    its revisit) — driven against two engines built from one model:
+    the r15 baseline (host tier off) and the tiered engine (host-RAM
+    spill pool). The asserted number is the measured-phase effective
+    hit rate: tiering must hold ≥ 70% where the baseline collapses
+    (< 30%), with host re-adopts doing the holding
+    (``readopted_blocks`` > 0), and warm outputs bitwise-equal to the
+    monolithic B=1 ``generate`` — greedy and sampled."""
+
+    #: Conversation shape: a shared per-conversation prefix of
+    #: ``prefix_blocks`` full pages + a distinct short suffix per
+    #: request (the suffix tail stays partial, so the retained
+    #: working set is exactly conversations × prefix_blocks pages).
+    prefix_blocks: int = 3
+    suffix_len: int = 2
+    page_size: int = 4
+    #: HBM pool: 10 pages (9 usable — page 0 is the null page), so 12
+    #: conversations × 3 prefix blocks = 36 pages of working set is
+    #: 4.0× the pool.
+    num_pages: int = 10
+    conversations: int = 12
+    #: Measured cycles over the conversation set after one off-the-
+    #: books warm cycle (the warm cycle pays the compulsory misses).
+    cycles: int = 3
+    new_tokens: int = 7
+    max_prompt_len: int = 24
+    num_slots: int = 1
+    slice_tokens: int = 3
+    host_cache_bytes: int = 64 * 1024 * 1024
+    equality_rows: int = 3
+    model_dtype: str = "float32"
+
+
+def run_tiered_prefix_benchmark(config: TieredPrefixBenchConfig
+                                ) -> Dict[str, Any]:
+    """The ISSUE 20 acceptance sweep: same model, same prompts, same
+    cyclic schedule; host tier off (the r15 baseline) vs on. Returns
+    per-engine measured-phase hit rates, the tier counters, and the
+    bitwise verdicts. The returned ``tier_stats`` block is the
+    calibration document the fleet simulator's prefix-hit service
+    class reads (``PrefixHitServiceModel.from_tier_stats``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.inference.engine import DecodeEngine, EngineConfig
+    from kubeflow_tpu.inference.generate import generate
+    from kubeflow_tpu.models.llama import llama_test
+
+    prefix_len = config.prefix_blocks * config.page_size
+    cache_size = config.max_prompt_len + config.new_tokens + 1
+    model = llama_test(dtype=getattr(jnp, config.model_dtype),
+                       cache_size=cache_size)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    rng = np.random.RandomState(20)
+    prefixes = [rng.randint(0, 512, (prefix_len,)).astype(np.int32)
+                for _ in range(config.conversations)]
+
+    def prompt_for(conv: int) -> np.ndarray:
+        suffix = rng.randint(0, 512,
+                             (config.suffix_len,)).astype(np.int32)
+        return np.concatenate([prefixes[conv], suffix])
+
+    def build(host_on: bool, name: str, **sampling) -> DecodeEngine:
+        return DecodeEngine(model, params, EngineConfig(
+            max_new_tokens=config.new_tokens,
+            max_prompt_len=config.max_prompt_len,
+            num_slots=config.num_slots, page_size=config.page_size,
+            slice_tokens=config.slice_tokens, prefix_cache=True,
+            num_pages=config.num_pages,
+            host_cache_bytes=(config.host_cache_bytes
+                              if host_on else 0),
+            **sampling), name=name)
+
+    def drive(engine: DecodeEngine) -> Dict[str, Any]:
+        # Warm cycle: one request per conversation — the compulsory
+        # misses that populate (and overflow) the pools. Off the
+        # books: measured-phase counters start after it.
+        for conv in range(config.conversations):
+            engine.submit(prompt_for(conv)).result(300)
+        before = engine.stats()["prefix_cache"]
+        t0 = time.perf_counter()
+        for _cycle in range(config.cycles):
+            for conv in range(config.conversations):
+                engine.submit(prompt_for(conv)).result(300)
+        wall_s = time.perf_counter() - t0
+        stats = engine.stats()
+        after = stats["prefix_cache"]
+        hits = after["hits"] - before["hits"]
+        misses = after["misses"] - before["misses"]
+        lookups = hits + misses
+        n = config.cycles * config.conversations
+        return {
+            "requests": n,
+            "measured_hits": hits,
+            "measured_misses": misses,
+            "effective_hit_rate": round(
+                hits / lookups if lookups else 0.0, 4),
+            "mean_request_ms": round(wall_s / n * 1e3, 2),
+            "stats": stats,
+        }
+
+    baseline = build(False, "bench-tier-baseline")
+    tiered = build(True, "bench-tier-host")
+    try:
+        base_row = drive(baseline)
+        tier_row = drive(tiered)
+
+        # Bitwise: tiered outputs (which rode host re-adopts) vs the
+        # monolithic B=1 generate, greedy — the serving config.
+        greedy_ok = True
+        for i in range(config.equality_rows):
+            key = np.asarray(jax.random.PRNGKey(7000 + i))
+            prompt = prompt_for(i)
+            got = tiered.submit(prompt, rng=key).result(300)
+            want, _ = generate(
+                model, params, jnp.asarray(prompt)[None, :],
+                max_new_tokens=config.new_tokens,
+                rng=jnp.asarray(key)[None, :],
+                prompt_lengths=jnp.asarray([len(prompt)]))
+            greedy_ok &= bool(np.array_equal(got,
+                                             np.asarray(want)[0]))
+    finally:
+        baseline.stop()
+        tiered.stop()
+
+    # Sampled: dedicated tiered engine, smaller sweep (enough
+    # conversations to overflow the pool and force re-adopts), then
+    # equality against the sampled B=1 generate.
+    sampling = dict(temperature=0.8, top_k=50)
+    s_tiered = build(True, "bench-tier-sampled", **sampling)
+    sampled_ok = True
+    try:
+        for conv in range(config.conversations):
+            s_tiered.submit(prompt_for(conv)).result(300)
+        sampled_readopts_before = \
+            s_tiered.stats()["kv_tier"]["host"]["readopted_blocks"]
+        for i in range(config.equality_rows):
+            key = np.asarray(jax.random.PRNGKey(8000 + i))
+            prompt = prompt_for(i)
+            got = s_tiered.submit(prompt, rng=key).result(300)
+            want, _ = generate(
+                model, params, jnp.asarray(prompt)[None, :],
+                max_new_tokens=config.new_tokens,
+                rng=jnp.asarray(key)[None, :],
+                prompt_lengths=jnp.asarray([len(prompt)]),
+                **sampling)
+            sampled_ok &= bool(np.array_equal(got,
+                                              np.asarray(want)[0]))
+        sampled_readopts = (
+            s_tiered.stats()["kv_tier"]["host"]["readopted_blocks"]
+            - sampled_readopts_before)
+    finally:
+        s_tiered.stop()
+
+    working_set_pages = config.conversations * config.prefix_blocks
+    hbm_pool_pages = config.num_pages - 1
+    tier_host = tier_row["stats"]["kv_tier"]["host"]
+    tiering_holds = bool(
+        tier_row["effective_hit_rate"] >= 0.7
+        and base_row["effective_hit_rate"] < 0.3
+        and tier_host["readopted_blocks"] > 0
+        and sampled_readopts > 0
+        and greedy_ok and sampled_ok)
+    return {
+        "config": dataclasses.asdict(config),
+        "working_set_pages": working_set_pages,
+        "hbm_pool_pages": hbm_pool_pages,
+        "working_set_multiple": round(
+            working_set_pages / hbm_pool_pages, 2),
+        "baseline": {k: v for k, v in base_row.items()
+                     if k != "stats"},
+        "tiered": {k: v for k, v in tier_row.items()
+                   if k != "stats"},
+        "host_tier": tier_host,
+        "sampled_readopted_blocks": sampled_readopts,
+        "bitwise_greedy_ok": greedy_ok,
+        "bitwise_sampled_ok": sampled_ok,
+        # The simulator-calibration document (PrefixHitServiceModel
+        # .from_tier_stats): measured-phase prefix counters + tier
+        # counters from the tiered engine.
+        "tier_stats": {
+            "prefix_cache": {
+                "hits": tier_row["measured_hits"],
+                "misses": tier_row["measured_misses"],
+                "hit_rate": tier_row["effective_hit_rate"],
+            },
+            "kv_tier": tier_row["stats"]["kv_tier"],
+        },
+        "tiering_holds": tiering_holds,
+    }
+
+
+@dataclasses.dataclass
 class SpeculativeBenchConfig:
     """`bench.py --speculative`: the ISSUE 16 acceptance sweep.
     One verifier model, three engines: vanilla decode (the baseline),

@@ -75,6 +75,12 @@ _GENERATE_CONFIG_COERCERS = {
     "engine_draft_tokens": int,
     "engine_prefill_chunk": int,
     "engine_draft_export": str,
+    # Tiered KV memory (ISSUE 20, docs/streaming.md): host-RAM spill
+    # pool budget (bytes, 0 = off) and the fleet pull-through fetch
+    # deadline (ms, 0 = off). Both serving-side capacity knobs that
+    # ride the version dir like the engine_* family above.
+    "engine_host_cache_bytes": int,
+    "kv_fetch_deadline_ms": int,
 }
 
 
@@ -140,7 +146,8 @@ def validate_generate_config(config: Dict[str, Any]) -> Dict[str, Any]:
                 "engine_slice_tokens", "engine_num_pages"):
         if key in out and out[key] < 1:
             raise ValueError(f"{key} must be >= 1; got {out[key]}")
-    for key in ("engine_draft_tokens", "engine_prefill_chunk"):
+    for key in ("engine_draft_tokens", "engine_prefill_chunk",
+                "engine_host_cache_bytes", "kv_fetch_deadline_ms"):
         # 0 is the documented "off" value (EngineConfig defaults).
         if key in out and out[key] < 0:
             raise ValueError(f"{key} must be >= 0; got {out[key]}")

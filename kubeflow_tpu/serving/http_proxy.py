@@ -85,6 +85,7 @@ from kubeflow_tpu.scaling.balancer import (
     eligible_endpoints,
     make_balancer,
     normalize_prefix_key,
+    rendezvous_owner,
 )
 from kubeflow_tpu.scaling.endpoints import (
     Endpoint,
@@ -309,6 +310,29 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
                 out[header] = value
         return out
 
+    def note_kv_owner(self, prefix_key: Optional[str]) -> None:
+        """Resolve this request's fleet-KV owner (ISSUE 20): the
+        prefix key's rendezvous home over the routable pool — the
+        replica whose caches the affinity balancer has been filling
+        with this prefix's pages. Upstream hops attach it as
+        ``X-KFT-KV-Owner`` whenever they land ELSEWHERE (overload
+        fallback, hedging, failover), so the off-home replica can
+        pull the pages instead of re-prefilling. Single-member pools
+        resolve to the member itself, and the ep-equality gate at
+        attach time keeps the header off same-replica hops."""
+        self._kv_owner_url = None
+        owner = rendezvous_owner(self.pool.endpoints(), prefix_key)
+        if owner is not None:
+            self._kv_owner_url = owner.url
+
+    def _kv_owner_headers(self, ep: Endpoint) -> Dict[str, str]:
+        owner = getattr(self, "_kv_owner_url", None)
+        if owner and owner != ep.url:
+            from kubeflow_tpu.serving import kv_store
+
+            return {kv_store.KV_OWNER_HEADER: owner}
+        return {}
+
     def pick_endpoint(self, tried: Sequence[Endpoint],
                       model: Optional[str] = None,
                       phase: Optional[str] = None,
@@ -383,6 +407,7 @@ class ProxyHandler(TraceContextHandlerMixin, tornado.web.RequestHandler):
         if child is not None:
             headers.update(child.headers())
         headers.update(self.tenant_headers())
+        headers.update(self._kv_owner_headers(ep))
         _P_UPSTREAM_REQUESTS.labels("rest").inc()
         client = tornado.httpclient.AsyncHTTPClient()
         t0 = time.monotonic()
@@ -1184,6 +1209,7 @@ class InferProxyHandler(ProxyHandler):
         if child is not None:
             headers.update(child.headers())
         headers.update(self.tenant_headers())
+        headers.update(self._kv_owner_headers(ep))
         request = (f"POST {path} HTTP/1.1\r\n" + "".join(
             f"{k}: {v}\r\n" for k, v in headers.items())
             + "\r\n").encode("latin-1") + payload
@@ -1605,6 +1631,7 @@ class InferProxyHandler(ProxyHandler):
             raise CircuitOpenError(breaker.retry_after_s())
         headers = dict(child.headers()) if child is not None else {}
         headers.update(self.tenant_headers())
+        headers.update(self._kv_owner_headers(ep))
         timeout = STREAM_TIMEOUT_S
         remaining = overload.remaining_s(deadline)
         if remaining is not None:
@@ -1945,6 +1972,10 @@ class InferProxyHandler(ProxyHandler):
             # KV pages live. None on malformed input — routing
             # degrades to the policy's fallback, never 500s.
             prefix_key = normalize_prefix_key(instances)
+            # Fleet KV tier (ISSUE 20): name the key's rendezvous
+            # owner so an off-home placement can pull the prefix
+            # pages instead of re-prefilling them.
+            self.note_kv_owner(prefix_key)
             if (self.application.settings.get("split_generate")
                     and await self._split_generate(
                         name, version, instances, body, deadline,

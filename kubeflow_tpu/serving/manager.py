@@ -600,7 +600,8 @@ class ServedModel:
                deadline: Optional[float] = None,
                obs_ctx=None,
                tenant: str = "",
-               on_streams=None) -> Future:
+               on_streams=None,
+               kv_fetch_s: float = 0.0) -> Future:
         """Enqueue one request for micro-batching; resolves to the
         output dict for exactly this request's rows.
 
@@ -652,7 +653,8 @@ class ServedModel:
                     return self._submit_engine(
                         loaded, inputs, signature_name,
                         deadline=deadline, obs_ctx=obs_ctx,
-                        tenant=tenant, on_streams=on_streams)
+                        tenant=tenant, on_streams=on_streams,
+                        kv_fetch_s=kv_fetch_s)
         future: Future = Future()
         t_enqueue = time.monotonic()
         if deadline is not None:
@@ -730,7 +732,8 @@ class ServedModel:
                       deadline: Optional[float] = None,
                       obs_ctx=None,
                       tenant: str = "",
-                      max_new_tokens: Optional[int] = None):
+                      max_new_tokens: Optional[int] = None,
+                      kv_fetch_s: float = 0.0):
         """Streaming generate: submit every request row to the decode
         engine and return ``(loaded, [GenerateStream per row])`` — the
         transports (SSE on REST, gRPC server streaming) drain the
@@ -771,7 +774,8 @@ class ServedModel:
                 streams.append(engine.submit(
                     x[i], rng=rngs[i], deadline=deadline,
                     obs_ctx=obs_ctx, tenant=tenant,
-                    max_new_tokens=max_new_tokens))
+                    max_new_tokens=max_new_tokens,
+                    kv_fetch_s=kv_fetch_s if i == 0 else 0.0))
         except BaseException:
             for s in streams:  # free the slots already taken
                 s.cancel()
@@ -929,11 +933,79 @@ class ServedModel:
             raise
         return loaded, streams
 
+    def export_kv_blocks(self, tokens, version: Optional[int] = None):
+        """Owner-side half of the fleet KV tier (ISSUE 20): walk the
+        resident engine's prefix chain for ``tokens`` and return
+        ``(loaded, [(block_tokens, layers)])``. An empty chain is a
+        clean miss the asker pays prefill for — so a version that is
+        not resident, a model without an engine yet (nothing could be
+        cached), or zero coverage all answer ``(loaded-or-None, [])``
+        rather than erroring. Engine (continuous-batching) models
+        only: the prefix chain IS the engine's radix index."""
+        if not self.continuous_batching:
+            raise ValueError(
+                f"model {self.name!r} is not served with continuous "
+                f"batching; the fleet KV tier rides the decode "
+                f"engine's prefix cache (--continuous_batching)")
+        loaded = self.get_resident(version)
+        if loaded is None:
+            return None, []
+        engine = loaded.engine
+        if engine is None:
+            return loaded, []
+        return loaded, engine.export_prefix_blocks(
+            np.asarray(tokens, np.int32))
+
+    def kv_prefetch(self, tokens, owner_url: str,
+                    version: Optional[int] = None,
+                    deadline: Optional[float] = None) -> float:
+        """Asker-side half of the fleet KV tier (ISSUE 20): before a
+        generate pays prefill, pull the prompt's prefix blocks from
+        the rendezvous owner the proxy named (``X-KFT-KV-Owner``)
+        into this replica's host tier. Returns the seconds spent —
+        the transport threads it into the request's ``kv_fetch``
+        attribution bucket — and NEVER raises: a fleet fetch is an
+        optimisation, so every failure (and every model this doesn't
+        apply to) is a silent 0.0 and the request prefills locally.
+        ``kv_fetch_deadline_ms`` in the export's generate_config
+        bounds the fetch (0 disables it for the model)."""
+        from kubeflow_tpu.serving import kv_store
+
+        if not self.continuous_batching or not owner_url \
+                or tokens is None:
+            return 0.0
+        try:
+            loaded = self.get_resident(version)
+            if loaded is None:
+                return 0.0
+            sig = loaded.signature(None)
+            if sig.method != "generate":
+                return 0.0
+            cfg = getattr(loaded.metadata, "generate_config",
+                          None) or {}
+            deadline_ms = int(cfg.get(
+                "kv_fetch_deadline_ms",
+                kv_store.DEFAULT_FETCH_DEADLINE_MS))
+            if deadline_ms <= 0:
+                return 0.0
+            # _engine_for (not loaded.engine): the submit that
+            # follows this fetch constructs the engine anyway, so
+            # building it a moment early costs nothing and lets the
+            # very first request on a cold replica still import.
+            engine = self._engine_for(loaded)
+            return kv_store.prefetch_into(
+                engine, self.name, int(loaded.version), owner_url,
+                tokens, deadline_ms=deadline_ms, deadline=deadline)
+        except Exception:  # noqa: BLE001 — never user-visible
+            logger.debug("kv prefetch skipped", exc_info=True)
+            return 0.0
+
     def _submit_engine(self, loaded, inputs: Dict[str, np.ndarray],
                        signature_name: Optional[str], *,
                        deadline: Optional[float],
                        obs_ctx, tenant: str = "",
-                       on_streams=None) -> Future:
+                       on_streams=None,
+                       kv_fetch_s: float = 0.0) -> Future:
         """Non-streaming generate over the engine: the classic
         future-of-{"tokens": [n, T]} contract, built by combining the
         per-row streams (so REST/gRPC unary clients transparently gain
@@ -952,9 +1024,13 @@ class ServedModel:
             streams = []
             try:
                 for i in range(n):
+                    # The fleet KV fetch ran once for the whole
+                    # request; attribute it to row 0 only so the
+                    # waterfall's bucket sum stays the wall time.
                     streams.append(engine.submit(
                         x[i], rng=rngs[i], deadline=deadline,
-                        obs_ctx=obs_ctx, tenant=tenant))
+                        obs_ctx=obs_ctx, tenant=tenant,
+                        kv_fetch_s=kv_fetch_s if i == 0 else 0.0))
             except BaseException:
                 for s in streams:
                     s.cancel()

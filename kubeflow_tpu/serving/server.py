@@ -35,6 +35,7 @@ HTTP endpoints:
   POST /v1/models/<name>[/versions/<v>]:predict   {"instances": ...}
   POST /v1/models/<name>[/versions/<v>]:classify  {"instances": ...}
   POST /v1/models/<name>[/versions/<v>]:generate  {"instances": ...}
+  POST /v1/models/<name>[/versions/<v>]:kv/fetch  {"tokens": ...}
   POST /tensorflow.serving.PredictionService/
        (Predict|Classify|GetModelMetadata)           (grpc-web+proto)
   GET  /healthz
@@ -59,7 +60,7 @@ from kubeflow_tpu.obs.exposition import (
     TraceContextHandlerMixin,
     access_log_function,
 )
-from kubeflow_tpu.serving import overload, tenancy
+from kubeflow_tpu.serving import kv_store, overload, tenancy
 from kubeflow_tpu.serving.manager import ModelManager
 
 logger = logging.getLogger(__name__)
@@ -378,6 +379,25 @@ class InferHandler(BaseHandler):
             sig = loaded.signature(sig_name)
             input_name = next(iter(sig.inputs))
             batch = _instances_to_batch(instances, input_name)
+            # Fleet KV pull-through (ISSUE 20): the proxy names the
+            # prefix key's rendezvous owner when this replica isn't
+            # it; pull the prefix blocks into the host tier BEFORE
+            # paying prefill. Bounded by kv_fetch_deadline_ms and the
+            # request budget; every failure silently degrades to the
+            # local prefill this path was about to run anyway. Pool
+            # thread: the fetch is blocking I/O plus an engine-thread
+            # export wait.
+            kv_fetch_s = 0.0
+            kv_owner = self.request.headers.get(
+                kv_store.KV_OWNER_HEADER)
+            if kv_owner and verb == "generate":
+                prompt = kv_store.prompt_of(instances)
+                if prompt is not None:
+                    loop = tornado.ioloop.IOLoop.current()
+                    kv_fetch_s = await loop.run_in_executor(
+                        None, lambda: model.kv_prefetch(
+                            prompt, kv_owner, version=want,
+                            deadline=deadline))
             if prefill_only:
                 return await self._prefill_only(
                     name, model, loaded, {input_name: batch},
@@ -385,7 +405,8 @@ class InferHandler(BaseHandler):
             if wants_stream:
                 return await self._stream_generate(
                     name, model, loaded, {input_name: batch},
-                    sig_name, want, body, deadline)
+                    sig_name, want, body, deadline,
+                    kv_fetch_s=kv_fetch_s)
             # on_streams registers live engine streams so a client
             # hang-up cancels the UNARY decode too (ISSUE 13: hedged
             # requests' losers are cancelled by closing this
@@ -395,7 +416,8 @@ class InferHandler(BaseHandler):
                                   want, deadline=deadline,
                                   obs_ctx=self._obs_ctx,
                                   tenant=self._tenant,
-                                  on_streams=self._register_streams)
+                                  on_streams=self._register_streams,
+                                  kv_fetch_s=kv_fetch_s)
             # Never hold the connection past the budget.
             result = await _await_future(
                 future, overload.clamp_wait_s(deadline,
@@ -568,7 +590,7 @@ class InferHandler(BaseHandler):
 
     async def _stream_generate(self, name, model, loaded, inputs,
                                sig_name, version, body, deadline,
-                               streams=None):
+                               streams=None, kv_fetch_s: float = 0.0):
         """SSE token streaming over the continuous-batching engine.
 
         Wire (serving/wire.py SSE codec; docs/streaming.md):
@@ -590,7 +612,7 @@ class InferHandler(BaseHandler):
             _, streams = model.submit_stream(
                 inputs, sig_name, version, deadline=deadline,
                 obs_ctx=self._obs_ctx, tenant=self._tenant,
-                max_new_tokens=max_new)
+                max_new_tokens=max_new, kv_fetch_s=kv_fetch_s)
         self._live_streams = streams
         self.set_header("Content-Type", wire.SSE_CONTENT_TYPE)
         self.set_header("Cache-Control", "no-cache")
@@ -720,6 +742,73 @@ class InferHandler(BaseHandler):
         except tornado.iostream.StreamClosedError:
             for s in streams:
                 s.cancel()
+
+
+class KVFetchHandler(BaseHandler):
+    """``:kv/fetch`` — the owner side of the fleet KV tier (ISSUE
+    20). A peer replica that missed locally POSTs the prompt's token
+    ids; this replica walks its engine's prefix chain (HBM radix
+    index, then its host tier) and answers the covered full blocks as
+    one opaque wire.py ``kv_blocks`` blob. A clean miss (version not
+    resident, no engine yet, zero coverage) is a 200 with
+    ``count: 0`` — only malformed requests 400, and the asker treats
+    EVERY non-ideal answer as fall-back-to-prefill."""
+
+    _obs_span = "kv_fetch"
+
+    async def post(self, name: str, version: Optional[str]):
+        import base64
+
+        from kubeflow_tpu.serving import wire
+
+        self._obs_model = name
+        try:
+            model = self.manager.get_model(name)
+        except KeyError as e:
+            return self.write_json({"error": e.args[0]}, 404)
+        try:
+            body = json.loads(self.request.body or b"{}")
+        except json.JSONDecodeError:
+            return self.write_json(
+                {"error": "request is not valid JSON"}, 400)
+        tokens = body.get("tokens")
+        try:
+            tokens = [int(t) for t in tokens]
+        except (TypeError, ValueError):
+            tokens = None
+        if not tokens:
+            return self.write_json(
+                {"error": "request body needs 'tokens': a non-empty "
+                          "list of token ids"}, 400)
+        if not getattr(model, "continuous_batching", False):
+            return self.write_json(
+                {"error": f"model {name!r} is not served with "
+                          f"continuous batching; the fleet KV tier "
+                          f"rides the decode engine",
+                 "code": "UNIMPLEMENTED"}, 400)
+        want = int(version) if version else None
+        try:
+            # Pool thread: the export waits on the engine thread (the
+            # chain walk + page reads must see untorn pages).
+            loop = tornado.ioloop.IOLoop.current()
+            loaded, blocks = await loop.run_in_executor(
+                None, lambda: model.export_kv_blocks(tokens, want))
+        except ValueError as e:
+            return self.write_json({"error": str(e)}, 400)
+        if loaded is None or not blocks:
+            self._obs_outcome = "miss"
+            return self.write_json({
+                "model_spec": {"name": name},
+                "blocks": None, "count": 0})
+        engine = loaded.engine
+        blob = wire.encode_kv_blocks(
+            name, int(loaded.version), int(engine.config.page_size),
+            blocks)
+        self.write_json({
+            "model_spec": {"name": name,
+                           "version": str(loaded.version)},
+            "blocks": base64.b64encode(blob).decode("ascii"),
+            "count": len(blocks)})
 
 
 def _stream_error_code(error: BaseException) -> str:
@@ -913,6 +1002,8 @@ def make_app(manager: ModelManager,
         (r"/v1/models/([^/:]+)/metadata", MetadataHandler),
         (r"/v1/models/([^/:]+)(?:/versions/(\d+))?:(predict|classify|generate)",
          InferHandler),
+        (r"/v1/models/([^/:]+)(?:/versions/(\d+))?:kv/fetch",
+         KVFetchHandler),
         (r"/tensorflow\.serving\.PredictionService/"
          r"(Predict|Classify|GetModelMetadata)",
          GrpcWebPredictHandler),

@@ -976,3 +976,95 @@ def decode_kv_handoff(data: bytes, *, model: str,
         layout=layout,
         prompt_tokens=(None if tokens is None
                        else np.asarray(tokens, np.int32)))
+
+
+# --- Fleet KV block fetch (tiered KV memory) -------------------------------
+
+#: Version tag of the ``:kv/fetch`` response payload (ISSUE 20). The
+#: asking replica and the rendezvous owner may be mid-rollout on
+#: different builds; an unknown format fails the fetch with a clear
+#: 400 and the asker simply pays local prefill — a fetch is always an
+#: optimisation, never load-bearing.
+KV_BLOCKS_FORMAT = 1
+
+
+def encode_kv_blocks(model: str, version: int, page_size: int,
+                     blocks) -> bytes:
+    """Serialize a chain of full KV blocks for a fleet pull-through
+    fetch. ``blocks`` is ``[(block_tokens, layers)]`` straight from
+    ``DecodeEngine.export_prefix_blocks`` — consecutive full blocks
+    from the prompt root, each with one ``[page_size, heads, dim]``
+    host array per KV leaf in tree-flatten order. flax-msgpack
+    carries the arrays byte-exact (bf16 included), the same property
+    that keeps handoff adoption bitwise. ``model``/``version``/
+    ``page_size`` pin the export geometry — splicing a foreign
+    model's K/V would read garbage."""
+    from flax import serialization
+
+    return serialization.msgpack_serialize({
+        "format": np.int32(KV_BLOCKS_FORMAT),
+        "kind": "kv_blocks",
+        "model": model,
+        "version": np.int32(version),
+        "page_size": np.int32(page_size),
+        "blocks": [
+            {
+                "tokens": np.asarray(tokens, np.int32),
+                "layers": [np.asarray(a) for a in layers],
+            }
+            for tokens, layers in blocks
+        ],
+    })
+
+
+def decode_kv_blocks(data: bytes, *, model: str,
+                     version: Optional[int] = None,
+                     page_size: Optional[int] = None):
+    """Parse + validate a ``:kv/fetch`` payload against the importing
+    replica's (model, version, page_size). Returns
+    ``[(block_tokens, layers)]`` ready for
+    ``DecodeEngine.import_prefix_blocks`` (which re-derives the chain
+    hashes itself — peer-supplied keys are never trusted). Raises
+    ValueError on any mismatch or malformed payload; the fetching
+    client swallows that and falls back to local prefill."""
+    from flax import serialization
+
+    try:
+        doc = serialization.msgpack_restore(data)
+        fmt = int(doc["format"])
+        kind = str(doc.get("kind"))
+    except Exception as e:  # noqa: BLE001 — malformed blob = 400
+        raise ValueError(f"malformed KV blocks payload: {e}") from None
+    if fmt != KV_BLOCKS_FORMAT or kind != "kv_blocks":
+        raise ValueError(
+            f"KV blocks format {fmt}/{kind!r} unsupported (this "
+            f"replica speaks format {KV_BLOCKS_FORMAT})")
+    if doc["model"] != model:
+        raise ValueError(
+            f"KV blocks are for model {doc['model']!r}, not {model!r}")
+    if version is not None and int(doc["version"]) != int(version):
+        raise ValueError(
+            f"KV blocks came from version {int(doc['version'])} but "
+            f"this replica serves version {version} — cache bytes "
+            f"are version-bound")
+    if page_size is not None and int(doc["page_size"]) != int(page_size):
+        raise ValueError(
+            f"KV blocks use page_size {int(doc['page_size'])} but "
+            f"this replica pages at {page_size}")
+    psize = int(doc["page_size"])
+    out = []
+    for i, b in enumerate(doc.get("blocks") or []):
+        try:
+            tokens = np.asarray(b["tokens"], np.int32)
+            layers = [np.asarray(a) for a in b["layers"]]
+        except Exception as e:  # noqa: BLE001 — malformed block = 400
+            raise ValueError(
+                f"malformed KV block {i}: {e}") from None
+        if tokens.ndim != 1 or tokens.shape[0] != psize:
+            raise ValueError(
+                f"KV block {i} carries {tokens.shape} tokens, "
+                f"expected [{psize}]")
+        if not layers:
+            raise ValueError(f"KV block {i} carries no KV layers")
+        out.append((tuple(int(t) for t in tokens), layers))
+    return out

@@ -15,6 +15,7 @@
 """CI plane: junit emission, workflow manifests, E2E drivers in fake
 mode (the full presubmit DAG exercised hermetically)."""
 
+import json
 import xml.etree.ElementTree as ET
 
 import pytest
@@ -56,7 +57,7 @@ def test_e2e_workflow_manifest():
                  "serving-test", "leader-failover-test",
                  "elastic-kill-test", "serving-chaos",
                  "serving-tenancy", "spec-decode", "fleet-sim",
-                 "teardown", "copy-artifacts", "e2e"):
+                 "kv-tier", "teardown", "copy-artifacts", "e2e"):
         assert step in names, step
     dag = next(t for t in wf["spec"]["templates"] if t["name"] == "e2e")
     deps = {t["name"]: t.get("dependencies", [])
@@ -75,6 +76,12 @@ def test_e2e_workflow_manifest():
     sim = next(t for t in wf["spec"]["templates"]
                if t["name"] == "fleet-sim")
     assert "--sim" in sim["container"]["command"]
+    # Tiered-KV gate (ISSUE 20): hermetic — tiny model, tiny pool.
+    assert deps["kv-tier"] == ["checkout"]
+    tier = next(t for t in wf["spec"]["templates"]
+                if t["name"] == "kv-tier")
+    assert "--prefix" in tier["container"]["command"]
+    assert "--working-set-multiple" in tier["container"]["command"]
     failover = next(t for t in wf["spec"]["templates"]
                     if t["name"] == "leader-failover-test")
     assert "kubeflow_tpu.citests.leader_failover" in \
@@ -163,6 +170,27 @@ def test_serving_fake_e2e(tmp_path):
     junit_path = tmp_path / "junit_serving.xml"
     rc = ci_serving.main(["--fake", "--junit_path", str(junit_path)])
     assert rc == 0
+
+
+def test_collect_obs_sweeps_tier_stats(tmp_path, monkeypatch):
+    """The kv-tier bench's tier-stats calibration dump travels with
+    the CI artifacts (ISSUE 20): collect-obs sweeps
+    kv_tier_stats.json from the $KFT_OBS_DIR drop-box like every
+    other obs JSON, so the fleet sim's prefix-hit service class can
+    calibrate from a real run's per-tier hit metrics."""
+    from kubeflow_tpu.citests import artifacts as ci_artifacts
+
+    obs = tmp_path / "obs-drop"
+    obs.mkdir()
+    doc = {"prefix_cache": {"hits": 36, "misses": 0, "hit_rate": 1.0},
+           "kv_tier": {"host": {"readopted_blocks": 108},
+                       "fetch_hits": 0}}
+    (obs / "kv_tier_stats.json").write_text(json.dumps(doc))
+    monkeypatch.setenv("KFT_OBS_DIR", str(obs))
+    monkeypatch.setenv("KFT_ARTIFACTS_DIR", str(tmp_path / "art"))
+    copied = ci_artifacts.collect_obs()
+    swept = next(p for p in copied if p.name == "kv_tier_stats.json")
+    assert json.loads(swept.read_text()) == doc
 
 
 def test_dashboard_fake_e2e(tmp_path):

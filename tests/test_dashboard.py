@@ -571,6 +571,50 @@ class DashboardTest(tornado.testing.AsyncHTTPTestCase):
         assert resp.code == 200
         assert "Fleet ConfigMap unreadable" in resp.body.decode()
 
+    def test_fleet_table_pages_cell_breaks_down_kv_tiers(self):
+        """The Pages cell shows the TIERED picture (ISSUE 20): HBM
+        page occupancy, prefix hit rate, host-pool fill and fleet
+        fetches — each fragment degrading independently on malformed
+        values, and the whole page never 500ing."""
+        from kubeflow_tpu.scaling.autoscaler import (
+            FLEET_CONFIGMAP,
+            FLEET_KEY,
+        )
+
+        fleet = {
+            "replicas": [
+                {"address": "10.0.0.1:8500", "reachable": True,
+                 "status": "ok", "role": "decode",
+                 "page_occupancy": 0.625, "prefix_hit_rate": 0.9,
+                 "host_kv_occupancy": 0.4, "kv_fetch_hits": 12},
+                # Host tier only (HBM occupancy not reported).
+                {"address": "10.0.0.2:8500", "reachable": True,
+                 "status": "ok", "host_kv_occupancy": 0.05},
+                # Malformed tier values: the valid HBM fragment must
+                # survive; the broken ones just drop out.
+                {"address": "10.0.0.3:8500", "reachable": True,
+                 "status": "ok", "page_occupancy": 0.5,
+                 "host_kv_occupancy": "full",
+                 "kv_fetch_hits": "lots"},
+            ],
+            "decision": {},
+        }
+        self.api.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": FLEET_CONFIGMAP,
+                         "namespace": "default"},
+            "data": {FLEET_KEY: json.dumps(fleet)},
+        })
+        resp = self.fetch("/tpujobs/ui")
+        assert resp.code == 200
+        page = resp.body.decode()
+        assert "62%" in page and "(90% prefix hits)" in page
+        assert "host 40%" in page and "12 fleet fetches" in page
+        assert "host 5%" in page
+        assert "50%" in page
+        assert "host full" not in page
+        assert "lots fleet fetches" not in page
+
 
 class TraceTabTest(tornado.testing.AsyncHTTPTestCase):
     """Profiler traces surfaced through the dashboard (SURVEY §5's

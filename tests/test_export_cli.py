@@ -205,6 +205,27 @@ def test_generate_config_validation(tmp_path):
         validate_generate_config({"prompt_buckets": [0, 8]})
     with pytest.raises(ValueError, match="prompt_buckets"):
         validate_generate_config({"prompt_buckets": "128,512"})
+    # Tiered-KV knobs (ISSUE 20): both ride the version dir like the
+    # engine_* family; 0 is the documented "off", negatives rejected,
+    # bools never coerce to ints.
+    cfg = validate_generate_config(
+        {"engine_host_cache_bytes": 2 ** 30,
+         "kv_fetch_deadline_ms": 250.0})
+    assert cfg["engine_host_cache_bytes"] == 2 ** 30
+    assert cfg["kv_fetch_deadline_ms"] == 250
+    assert isinstance(cfg["kv_fetch_deadline_ms"], int)
+    assert validate_generate_config(
+        {"engine_host_cache_bytes": 0,
+         "kv_fetch_deadline_ms": 0}) == \
+        {"engine_host_cache_bytes": 0, "kv_fetch_deadline_ms": 0}
+    with pytest.raises(ValueError, match="engine_host_cache_bytes"):
+        validate_generate_config({"engine_host_cache_bytes": -1})
+    with pytest.raises(ValueError, match="kv_fetch_deadline_ms"):
+        validate_generate_config({"kv_fetch_deadline_ms": -250})
+    with pytest.raises(ValueError, match="int-like"):
+        validate_generate_config({"engine_host_cache_bytes": True})
+    with pytest.raises(ValueError, match="int-like"):
+        validate_generate_config({"kv_fetch_deadline_ms": "fast"})
     # And the exporter runs it: a bad config must not produce a
     # version dir.
     with pytest.raises(ValueError, match="unknown generate config"):

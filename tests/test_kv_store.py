@@ -1,0 +1,243 @@
+# Copyright 2026 The kubeflow-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Fleet pull-through KV store (ISSUE 20, tier 2) — the serving-layer
+half, model-free: owner addressing, fetch gating, and above all the
+failure semantics. A fleet fetch is an optimisation, never
+load-bearing: every failure mode here must degrade to "pay local
+prefill" with zero raises out of :func:`prefetch_into`."""
+
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving import kv_store, wire
+
+
+# -- addressing ------------------------------------------------------------
+
+
+def test_kv_fetch_path_pins_version():
+    assert kv_store.kv_fetch_path("m") == "/v1/models/m:kv/fetch"
+    assert kv_store.kv_fetch_path("m", 3) == \
+        "/v1/models/m/versions/3:kv/fetch"
+
+
+def test_prompt_of_first_row_or_none():
+    assert kv_store.prompt_of([[1, 2, 3], [9]]) == [1, 2, 3]
+    assert kv_store.prompt_of(np.asarray([[4, 5]])) == [4, 5]
+    assert kv_store.prompt_of([[]]) is None
+    assert kv_store.prompt_of([]) is None
+    assert kv_store.prompt_of("garbage") is None
+    assert kv_store.prompt_of([["x", "y"]]) is None
+    assert kv_store.prompt_of(None) is None
+
+
+def test_rendezvous_owner_is_stable_and_matches_affinity():
+    """The owner the proxy names in X-KFT-KV-Owner must be the SAME
+    replica the prefix-affinity balancer steers traffic to — that
+    coupling is what makes the owner's caches worth asking. It must
+    also hold over the full routable pool, not drift with exclusions,
+    and survive membership churn for keys whose owner stayed."""
+    from kubeflow_tpu.scaling.balancer import (
+        PrefixAffinityBalancer,
+        rendezvous_owner,
+    )
+    from kubeflow_tpu.scaling.endpoints import Endpoint
+
+    eps = [Endpoint(f"replica-{i}:900{i}", register_metrics=False)
+           for i in range(3)]
+    bal = PrefixAffinityBalancer(overload_ms=100.0)
+    for i in range(20):
+        key = f"conv-{i}"
+        owner = rendezvous_owner(eps, key)
+        assert owner is not None
+        # Stable across calls...
+        assert rendezvous_owner(eps, key).address == owner.address
+        # ...and identical to where the balancer routes the key.
+        assert bal.pick(eps, prefix_key=key).address == owner.address
+    # Churn: keys not owned by the departed replica keep their owner.
+    gone = rendezvous_owner(eps, "conv-0").address
+    survivors = [ep for ep in eps if ep.address != gone]
+    for i in range(20):
+        key = f"conv-{i}"
+        if rendezvous_owner(eps, key).address != gone:
+            assert rendezvous_owner(survivors, key).address == \
+                rendezvous_owner(eps, key).address
+    assert rendezvous_owner(eps, None) is None
+    assert rendezvous_owner([], "k") is None
+
+
+# -- prefetch_into: gating + failure semantics -----------------------------
+
+
+class _StubEngine:
+    """Just the surface prefetch_into touches, with call recording."""
+
+    class _Cfg:
+        page_size = 4
+
+    def __init__(self, *, host_tier=object(), probe=0, imports=None):
+        self.host_tier = host_tier
+        self.config = self._Cfg()
+        self._probe = probe
+        self._imports = imports
+        self.fetch_notes = []
+        self.imported_payloads = []
+
+    def probe_prefix(self, prompt):
+        return self._probe
+
+    def import_prefix_blocks(self, blocks):
+        self.imported_payloads.append(blocks)
+        if isinstance(self._imports, Exception):
+            raise self._imports
+        return len(blocks) if self._imports is None else self._imports
+
+    def note_kv_fetch(self, outcome, *, blocks=0):
+        self.fetch_notes.append((outcome, blocks))
+
+
+def test_prefetch_skips_when_it_cannot_pay_off():
+    """Every skip gate returns 0.0 WITHOUT touching the network (the
+    owner_url below would raise instantly if dialled) and without
+    noting a fetch — skips are not misses."""
+    url = "http://owner.invalid:1"
+    tokens = list(range(12))
+    # No engine / no host tier.
+    assert kv_store.prefetch_into(None, "m", 1, url, tokens) == 0.0
+    e = _StubEngine(host_tier=None)
+    assert kv_store.prefetch_into(e, "m", 1, url, tokens) == 0.0
+    # Un-int-able prompt.
+    e = _StubEngine()
+    assert kv_store.prefetch_into(e, "m", 1, url, ["x"]) == 0.0
+    # Too short to span one full block (page_size=4: 4 tokens = the
+    # final token excluded → 0 consumable blocks).
+    assert kv_store.prefetch_into(e, "m", 1, url, [1, 2, 3, 4]) == 0.0
+    # Local match already covers every consumable block.
+    e = _StubEngine(probe=8)
+    assert kv_store.prefetch_into(e, "m", 1, url,
+                                  list(range(9))) == 0.0
+    # Deadline already spent / fetching disabled.
+    e = _StubEngine()
+    assert kv_store.prefetch_into(e, "m", 1, url, tokens,
+                                  deadline_ms=0) == 0.0
+    assert kv_store.prefetch_into(
+        e, "m", 1, url, tokens,
+        deadline=time.monotonic() - 1.0) == 0.0
+    assert e.fetch_notes == [] and e.imported_payloads == []
+
+
+def test_prefetch_dead_owner_is_an_error_note_never_a_raise():
+    """THE chaos acceptance for this tier: the owner is unreachable
+    and the asker's request proceeds to local prefill — prefetch_into
+    returns elapsed seconds, notes one 'error', and raises nothing."""
+    e = _StubEngine()
+    spent = kv_store.prefetch_into(
+        e, "m", 1, "http://127.0.0.1:1", list(range(12)),
+        deadline_ms=200)
+    assert spent >= 0.0
+    assert e.fetch_notes == [("error", 0)]
+    assert e.imported_payloads == []
+
+
+def test_prefetch_import_failure_is_an_error_note_never_a_raise(
+        monkeypatch):
+    e = _StubEngine(imports=RuntimeError("pool shape moved"))
+    monkeypatch.setattr(
+        kv_store, "fetch_blocks",
+        lambda *a, **k: [((1, 2, 3, 4),
+                          [np.zeros((4, 2, 2), np.float32)])])
+    spent = kv_store.prefetch_into(e, "m", 1, "http://x", range(12))
+    assert spent >= 0.0
+    assert e.fetch_notes == [("error", 0)]
+
+
+def test_prefetch_outcomes_hit_and_miss(monkeypatch):
+    blocks = [((1, 2, 3, 4), [np.zeros((4, 2, 2), np.float32)])] * 2
+    # Owner answered with adoptable blocks → hit with the count.
+    e = _StubEngine()
+    monkeypatch.setattr(kv_store, "fetch_blocks",
+                        lambda *a, **k: list(blocks))
+    assert kv_store.prefetch_into(e, "m", 1, "http://x",
+                                  range(12)) >= 0.0
+    assert e.fetch_notes == [("hit", 2)]
+    # Owner answered cleanly but held nothing → miss.
+    e = _StubEngine()
+    monkeypatch.setattr(kv_store, "fetch_blocks", lambda *a, **k: [])
+    kv_store.prefetch_into(e, "m", 1, "http://x", range(12))
+    assert e.fetch_notes == [("miss", 0)]
+    # Blocks arrived but none survived the import shape gate → miss.
+    e = _StubEngine(imports=0)
+    monkeypatch.setattr(kv_store, "fetch_blocks",
+                        lambda *a, **k: list(blocks))
+    kv_store.prefetch_into(e, "m", 1, "http://x", range(12))
+    assert e.fetch_notes == [("miss", 0)]
+
+
+def test_fetch_blocks_round_trip_against_live_owner():
+    """fetch_blocks speaks real HTTP to a real (stub) owner: the
+    request body carries the token ids, the response's b64 msgpack
+    decodes byte-exact, and an empty answer is a clean []."""
+    payload = wire.encode_kv_blocks(
+        "m", 2, 4,
+        [((5, 6, 7, 8), [np.arange(16, dtype=np.float32
+                                   ).reshape(4, 2, 2)])])
+    seen = {}
+
+    class _Owner(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = json.loads(self.rfile.read(
+                int(self.headers["Content-Length"])))
+            seen["path"] = self.path
+            seen["tokens"] = body["tokens"]
+            blob = (base64.b64encode(payload).decode()
+                    if body["tokens"] else None)
+            out = json.dumps({"blocks": blob}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Owner)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_port}"
+        got = kv_store.fetch_blocks(url, "m", 2, 4, [5, 6, 7, 8, 9],
+                                    timeout_s=5.0)
+        assert seen["path"] == "/v1/models/m/versions/2:kv/fetch"
+        assert seen["tokens"] == [5, 6, 7, 8, 9]
+        assert len(got) == 1 and got[0][0] == (5, 6, 7, 8)
+        np.testing.assert_array_equal(
+            got[0][1][0],
+            np.arange(16, dtype=np.float32).reshape(4, 2, 2))
+        assert kv_store.fetch_blocks(url, "m", 2, 4, [],
+                                     timeout_s=5.0) == []
+        # Version skew: the asker pins ITS version; a payload built
+        # for another one must raise (prefetch_into maps it to a
+        # fall-back, tested above).
+        with pytest.raises(ValueError):
+            kv_store.fetch_blocks(url, "m", 3, 4, [5, 6, 7, 8, 9],
+                                  timeout_s=5.0)
+    finally:
+        srv.shutdown()
+        srv.server_close()

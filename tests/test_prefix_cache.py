@@ -562,6 +562,288 @@ def test_eviction_fuzz_no_deadlock_no_leak():
     alloc.check_invariants()
 
 
+# -- tiered KV memory: host-RAM spill + re-adopt (ISSUE 20) ----------------
+
+
+def test_tiered_eviction_fuzz_no_leak_across_tiers():
+    """The r15 fuzz extended ACROSS TIERS: the same random
+    admit/retire interleavings over a tiny pool, with a host tier
+    attached — reclaim spills full entries host-ward, matches walk
+    into the host tier and re-adopt (host blocks come back as private
+    pages and re-register), and a random fleet-'fetch' op imports
+    chain blocks as a peer would. Allocator + index invariants AND
+    the host pool's byte ledger checked after every step; a tiny host
+    budget forces host-side LRU evictions too; at the end BOTH tiers
+    drain to zero."""
+    from kubeflow_tpu.inference.engine.kv_tier import HostKVTier
+    from kubeflow_tpu.inference.engine.prefix_cache import (
+        _ROOT,
+        _block_key,
+    )
+
+    rng = np.random.RandomState(23)
+    P = 4
+    alloc = PageAllocator(14)  # 13 usable
+    cache = PrefixCache(P, alloc)
+    # ~32 bytes per fuzz block; a 12-block budget forces host-side
+    # evictions under the ~24-block universe below.
+    host = HostKVTier(12 * 32)
+    cache.set_host_tier(host)
+
+    def fake_layers(block):
+        # Model-free stand-in for the KV rows: content keyed by the
+        # block tokens so a wrong-block splice would be detectable.
+        return [np.full((P, 2), block[0], np.float32)]
+
+    cache.set_spill(
+        lambda e: host.put(e.key, e.tokens, fake_layers(e.tokens)))
+
+    bases = [list(rng.randint(0, 50, (10,))) for _ in range(3)]
+    prompts = []
+    for b in bases:
+        for _s in range(4):
+            suffix = list(rng.randint(0, 50, (rng.randint(0, 5),)))
+            prompts.append(b + suffix)
+    live = []
+    pending = []
+
+    def pages_for(n):
+        return -(-n // P)
+
+    def try_admit(prompt):
+        budget = pages_for(len(prompt) + 6)
+        match = cache.pin(cache.match(prompt))
+        if not alloc.reserve(budget - len(match.entries)):
+            cache.unpin(match)
+            return False
+        if match.fork is not None:
+            cache.unpin_fork(match)
+        n_prompt = pages_for(len(prompt))
+        priv = alloc.alloc(n_prompt - len(match.entries))
+        rows = match.shared_pages + priv
+        cache.register(prompt, rows)
+        # The re-adopt half: host-matched blocks came back as private
+        # pages and re-registered HBM-ward (the engine's splice path,
+        # minus the model).
+        host.note_readopted(len(match.host_entries))
+        live.append((rows, budget, len(match.entries)))
+        return True
+
+    def retire(i):
+        rows, budget, _shared = live.pop(i)
+        for p in reversed(rows):
+            alloc.unref(p)
+        alloc.unreserve(budget - len(rows))
+
+    def fleet_import(prompt):
+        # What a peer's export→import lands: the chain keys re-derived
+        # from token content, full blocks only.
+        parent = _ROOT
+        for j in range(len(prompt) // P):
+            block = tuple(prompt[j * P:(j + 1) * P])
+            key = _block_key(parent, block)
+            host.put(key, block, fake_layers(block), imported=True)
+            parent = key
+
+    for _ in range(600):
+        op = rng.rand()
+        if op < 0.45 and len(live) < 3:
+            prompt = prompts[rng.randint(len(prompts))]
+            if not try_admit(prompt):
+                pending.append(prompt)
+        elif op < 0.75 and live:
+            retire(rng.randint(len(live)))
+        elif op < 0.85:
+            fleet_import(prompts[rng.randint(len(prompts))])
+        elif pending:
+            while pending and try_admit(pending[0]):
+                pending.pop(0)
+        alloc.check_invariants()
+        cache.check_invariants()
+        host.check_accounting()
+    # No deadlock: retire everything, then every blocked admission
+    # must admit (evicting across BOTH tiers as needed).
+    while live:
+        retire(0)
+        alloc.check_invariants()
+    while pending:
+        assert try_admit(pending[0]), \
+            "FIFO head blocked with an empty engine — deadlock"
+        pending.pop(0)
+        while live:
+            retire(0)
+        alloc.check_invariants()
+        cache.check_invariants()
+        host.check_accounting()
+    assert host.spilled_blocks > 0, "pool was sized to force spills"
+    assert host.readopted_blocks > 0, \
+        "overlapping prompts must have re-adopted host blocks"
+    # Drain to zero: the HBM index clears its pages, the host pool
+    # clears its bytes, and both ledgers agree on empty.
+    assert alloc.reserved_pages == 0
+    assert alloc.inuse_pages == 0
+    cache.clear()
+    assert alloc.free_pages == 13, \
+        f"pages leaked after drain: free={alloc.free_pages}"
+    alloc.check_invariants()
+    host.check_accounting()
+    host.clear()
+    assert host.resident_blocks() == 0 and host.resident_bytes() == 0
+    host.check_accounting()
+
+
+def test_host_tier_spill_readopt_bitwise_greedy(model, params):
+    """Evict-to-host instead of drop: a pool too small to retain
+    every conversation spills full prefix blocks to host RAM; a
+    revisit walks the index INTO the host tier, splices the blocks
+    back HBM-ward, and still comes out bitwise equal to B=1 —
+    including the non-aligned-prefix (CoW fork) shape. The kv_tier
+    stats block rides engine.stats() for healthz/dashboard."""
+    cfg = EngineConfig(max_new_tokens=7, max_prompt_len=MAX_PROMPT,
+                       temperature=0.0, num_slots=1, page_size=PAGE,
+                       slice_tokens=3, num_pages=10, prefix_cache=True,
+                       host_cache_bytes=64 * 1024 * 1024)
+    engine = DecodeEngine(model, params, cfg, name="px-tier-greedy")
+    try:
+        assert engine.host_tier is not None
+        # Three conversations with non-aligned 10-token prefixes
+        # (2 full blocks + a 2-token boundary): cycling them through
+        # a 9-usable-page pool forces evict-to-host.
+        groups = [_prefixed_prompts(10, [2, 1], seed=s)
+                  for s in (31, 32, 33)]
+        keys = _keys(6, base=3100)
+        k = 0
+        for group in groups:
+            for prompt in group:
+                got = engine.submit(prompt, rng=keys[k]).result(180.0)
+                np.testing.assert_array_equal(
+                    got, _reference(model, params, prompt, keys[k], 7),
+                    err_msg=f"request {k} diverged with host tier on")
+                engine.kv.allocator.check_invariants()
+                engine.prefix.check_invariants()
+                engine.host_tier.check_accounting()
+                k += 1
+        tier = engine.stats()["kv_tier"]
+        assert tier["host"]["spilled_blocks"] > 0, tier
+        # Revisit the FIRST conversation: its blocks are host-resident
+        # now; the revisit must re-adopt (not re-prefill) and stay
+        # bitwise.
+        readopts_before = tier["host"]["readopted_blocks"]
+        hits_before = engine.stats()["prefix_cache"]["hits"]
+        revisit = _prefixed_prompts(10, [3], seed=31)[0]
+        key = _keys(1, base=3200)[0]
+        got = engine.submit(revisit, rng=key).result(180.0)
+        np.testing.assert_array_equal(
+            got, _reference(model, params, revisit, key, 7),
+            err_msg="host re-adopt diverged from B=1")
+        tier = engine.stats()["kv_tier"]
+        assert tier["host"]["readopted_blocks"] > readopts_before, tier
+        assert engine.stats()["prefix_cache"]["hits"] > hits_before
+        # The saturation surface carries the whole tier block.
+        for key_name in ("budget_bytes", "resident_bytes",
+                         "resident_blocks", "spilled_blocks",
+                         "evicted_blocks", "readopted_blocks",
+                         "imported_blocks"):
+            assert key_name in tier["host"], tier
+        _assert_drained(engine)
+        engine.host_tier.check_accounting()
+    finally:
+        engine.stop()
+
+
+def test_host_tier_sampled_mid_decode_join_bitwise(model, params):
+    """Sampled decode over re-adopted host blocks, with a LIVE
+    mid-decode join: the donor re-adopts a spilled conversation and
+    is still decoding when a sharer pins its freshly re-registered
+    pages. Both outputs bitwise equal to B=1 — re-adoption must not
+    perturb any rng stream."""
+    sampling = dict(temperature=0.8, top_k=50, top_p=0.95)
+    cfg = EngineConfig(max_new_tokens=7, max_prompt_len=MAX_PROMPT,
+                       num_slots=2, page_size=PAGE, slice_tokens=3,
+                       num_pages=13, prefix_cache=True,
+                       host_cache_bytes=64 * 1024 * 1024, **sampling)
+    engine = DecodeEngine(model, params, cfg, name="px-tier-sampled")
+    try:
+        conv = _prefixed_prompts(12, [2, 3, 2], seed=41)
+        fills = [_prefixed_prompts(12, [2], seed=s)[0]
+                 for s in (42, 43, 44)]
+        keys = _keys(6, base=4100)
+        # Warm conversation A, then churn B/C/D through the pool to
+        # evict A's prefix host-ward.
+        engine.submit(conv[0], rng=keys[0]).result(180.0)
+        for i, fill in enumerate(fills):
+            engine.submit(fill, rng=keys[1 + i]).result(180.0)
+        host = engine.stats()["kv_tier"]["host"]
+        assert host["spilled_blocks"] > 0, host
+        readopts_before = host["readopted_blocks"]
+        # Donor re-adopts; joiner lands while the donor is mid-decode.
+        donor = engine.submit(conv[1], rng=keys[4])
+        assert donor.next_event(timeout=120.0) is not None
+        joiner = engine.submit(conv[2], rng=keys[5])
+        results = [donor.result(120.0), joiner.result(120.0)]
+        for got, prompt, key in zip(results, conv[1:], keys[4:]):
+            np.testing.assert_array_equal(
+                got, _reference(model, params, prompt, key, 7,
+                                **sampling),
+                err_msg="sampled tier re-adopt/join diverged")
+        host = engine.stats()["kv_tier"]["host"]
+        assert host["readopted_blocks"] > readopts_before, host
+        _assert_drained(engine)
+        engine.host_tier.check_accounting()
+    finally:
+        engine.stop()
+
+
+def test_fleet_export_import_roundtrip_bitwise(model, params):
+    """Tier 2's engine half: replica A exports a warmed prompt's full
+    blocks (`export_prefix_blocks`), replica B imports them into its
+    host tier (`import_prefix_blocks`, chain keys re-derived from
+    token content — peer hashes never trusted), and B's first-ever
+    request on that conversation HITS and stays bitwise equal to B=1
+    cold prefill. Malformed payloads import zero blocks and raise
+    nothing."""
+    cfg = EngineConfig(max_new_tokens=7, max_prompt_len=MAX_PROMPT,
+                       temperature=0.0, num_slots=1, page_size=PAGE,
+                       slice_tokens=3, num_pages=10, prefix_cache=True,
+                       host_cache_bytes=64 * 1024 * 1024)
+    owner = DecodeEngine(model, params, cfg, name="px-kv-owner")
+    asker = DecodeEngine(model, params, cfg, name="px-kv-asker")
+    try:
+        prompts = _prefixed_prompts(12, [2, 3], seed=51)
+        keys = _keys(2, base=5100)
+        owner.submit(prompts[0], rng=keys[0]).result(180.0)
+        blocks = owner.export_prefix_blocks(
+            np.asarray(prompts[0], np.int32))
+        assert len(blocks) == 3, \
+            f"12-token prefix should export 3 full blocks: " \
+            f"{len(blocks)}"
+        imported = asker.import_prefix_blocks(blocks)
+        assert imported == 3
+        asker.note_kv_fetch("hit", blocks=imported)
+        hits_before = asker.stats()["prefix_cache"]["hits"]
+        got = asker.submit(prompts[1], rng=keys[1]).result(180.0)
+        np.testing.assert_array_equal(
+            got, _reference(model, params, prompts[1], keys[1], 7),
+            err_msg="fleet-fetched blocks diverged from cold prefill")
+        st = asker.stats()
+        assert st["prefix_cache"]["hits"] > hits_before
+        assert st["kv_tier"]["fetch_hits"] == 1
+        assert st["kv_tier"]["fetched_blocks"] == 3
+        assert st["kv_tier"]["host"]["imported_blocks"] == 3
+        # Malformed import attempts: wrong block length, wrong layer
+        # count — all land zero blocks, raise nothing.
+        assert asker.import_prefix_blocks([]) == 0
+        bad_len = [(tuple(range(PAGE + 1)), blocks[0][1])]
+        assert asker.import_prefix_blocks(bad_len) == 0
+        bad_layers = [(blocks[0][0], blocks[0][1][:1])]
+        assert asker.import_prefix_blocks(bad_layers) == 0
+        _assert_drained(asker)
+        _assert_drained(owner)
+    finally:
+        owner.stop()
+        asker.stop()
+
+
 # -- autoscaler + healthz: page pressure visibility ------------------------
 
 
@@ -593,23 +875,46 @@ def test_replica_sample_reports_page_pressure_and_hit_rate():
             "engine": {"slots": 4, "active_slots": 1,
                        "queue_depth": 0, "est_ttft_ms": 1.0,
                        "page_occupancy": 0.625,
-                       "prefix_cache": {"hits": 30, "misses": 10}},
+                       "prefix_cache": {"hits": 30, "misses": 10},
+                       "kv_tier": {
+                           "fetch_hits": 4,
+                           "host": {"budget_bytes": 1000,
+                                    "resident_bytes": 250}}},
         }}}, now=1.0)
     assert row["page_occupancy"] == 0.625
     assert row["prefix_hit_rate"] == 0.75
-    # No engine / no prefix cache → fields absent, row intact.
+    # Host-tier headroom + fleet-fetch activity (ISSUE 20) ride the
+    # same scrape for the scaler and the dashboard fleet table.
+    assert row["host_kv_occupancy"] == 0.25
+    assert row["kv_fetch_hits"] == 4
+    # No engine / no prefix cache / no host tier → fields absent,
+    # row intact.
     row2 = loop._replica_sample("b:1", {
         "status": "ok", "saturation": {"m": {"queue_depth": 0}}},
         now=2.0)
     assert "page_occupancy" not in row2
     assert "prefix_hit_rate" not in row2
+    assert "host_kv_occupancy" not in row2
+    assert "kv_fetch_hits" not in row2
+    # A tier with budget 0 (off) must not report occupancy.
+    row2b = loop._replica_sample("b:2", {
+        "status": "ok", "saturation": {"m": {"engine": {
+            "kv_tier": {"fetch_hits": 0,
+                        "host": {"budget_bytes": 0,
+                                 "resident_bytes": 0}}}}}}, now=2.5)
+    assert "host_kv_occupancy" not in row2b
+    assert "kv_fetch_hits" not in row2b
     # Malformed values degrade, never raise.
     row3 = loop._replica_sample("c:1", {
         "status": "ok",
         "saturation": {"m": {"engine": {
             "page_occupancy": "hot",
-            "prefix_cache": {"hits": "many"}}}}}, now=3.0)
+            "prefix_cache": {"hits": "many"},
+            "kv_tier": {"fetch_hits": "lots",
+                        "host": {"budget_bytes": "big"}}}}}},
+        now=3.0)
     assert row3["reachable"] and "page_occupancy" not in row3
+    assert "host_kv_occupancy" not in row3
 
 
 # -- balancer: prefix affinity ---------------------------------------------

@@ -126,6 +126,97 @@ def test_service_model_rejects_empty():
         ServiceModel([0.0, -1.0])
 
 
+# -- prefix-hit service class (ROADMAP #7a / ISSUE 20) -----------------
+
+def test_prefix_hit_model_blends_mean_and_splits_draws():
+    """Per-request Bernoulli(hit_rate) branch selection: the blended
+    ``mean`` is what the saturation math reads, but the DRAWS stay
+    bimodal — every sample comes from exactly one branch, never an
+    average of the two."""
+    from kubeflow_tpu.scaling.simulator import PrefixHitServiceModel
+
+    hit = ServiceModel([0.01, 0.02])
+    miss = ServiceModel([0.10, 0.20])
+    m = PrefixHitServiceModel(hit, miss, 0.75)
+    assert m.mean == pytest.approx(0.75 * 0.015 + 0.25 * 0.15)
+    rng = random.Random(3)
+    draws = [m.sample(rng) for _ in range(400)]
+    assert set(draws) <= {0.01, 0.02, 0.10, 0.20}
+    hit_frac = sum(1 for d in draws if d < 0.05) / len(draws)
+    assert 0.65 <= hit_frac <= 0.85
+    with pytest.raises(ValueError):
+        PrefixHitServiceModel(hit, miss, 1.5)
+    # Degenerate rates collapse to a single branch.
+    always_miss = PrefixHitServiceModel(hit, miss, 0.0)
+    assert {always_miss.sample(rng) for _ in range(32)} <= {0.10, 0.20}
+
+
+def test_prefix_hit_model_from_tier_stats():
+    """Calibration straight off the tier-stats dump the kv-tier bench
+    writes (collect-obs ships it as kv_tier_stats.json): hit_rate
+    from the prefix counters, hit-path mean = miss mean with the
+    prefill share removed plus the fleet-fetch penalty weighted by
+    remote share."""
+    from kubeflow_tpu.scaling.simulator import PrefixHitServiceModel
+
+    miss = ServiceModel([0.08, 0.10, 0.12])
+    stats = {"prefix_cache": {"hits": 60, "misses": 40},
+             "kv_tier": {"fetch_hits": 30}}
+    m = PrefixHitServiceModel.from_tier_stats(
+        miss, stats, prefill_share=0.5, fetch_penalty_s=0.01)
+    assert m.hit_rate == pytest.approx(0.6)
+    # remote_share = 30/60: half the hits paid the fetch penalty.
+    assert m.hit.mean == pytest.approx(0.1 * 0.5 + 0.5 * 0.01)
+    assert m.hit.mean < m.miss.mean
+    # No lookups at all → a cold fleet: everything is a miss.
+    cold = PrefixHitServiceModel.from_tier_stats(miss, {})
+    assert cold.hit_rate == 0.0
+    with pytest.raises(ValueError):
+        PrefixHitServiceModel.from_tier_stats(miss, stats,
+                                              prefill_share=1.0)
+
+
+def test_prefix_hit_model_rescale_preserves_bimodality():
+    """scaled_to_mean moves BOTH branches by one factor: the blend
+    lands on the target while hit/miss separation (what the queueing
+    percentiles are sensitive to) and the hit rate survive."""
+    from kubeflow_tpu.scaling.simulator import PrefixHitServiceModel
+
+    m = PrefixHitServiceModel(ServiceModel([0.02]),
+                              ServiceModel([0.10]), 0.5)
+    scaled = m.scaled_to_mean(0.12)
+    assert scaled.mean == pytest.approx(0.12)
+    assert scaled.hit_rate == 0.5
+    assert scaled.hit.mean / scaled.miss.mean == \
+        pytest.approx(m.hit.mean / m.miss.mean)
+    assert scaled.miss.mean > scaled.hit.mean
+
+
+def test_prefix_hit_model_drives_fleet_sim_deterministically():
+    """The conditioned class plugs into FleetSimulator through the
+    ordinary ServiceModel seam; same seed → byte-identical event
+    logs, and the conditioned tail beats a flat model with the SAME
+    mean (the bimodality is load-bearing, not cosmetic)."""
+    from kubeflow_tpu.scaling.simulator import PrefixHitServiceModel
+
+    def build(service):
+        rng = random.Random(11)
+        return FleetSimulator(Workload.open_loop(18.0, 30.0, rng),
+                              service, replicas=2, seed=5)
+
+    def conditioned():
+        return PrefixHitServiceModel(
+            ServiceModel([0.02, 0.03]),
+            ServiceModel([0.14, 0.18, 0.22]), 0.7)
+
+    a = build(conditioned()).run()
+    b = build(conditioned()).run()
+    assert a.event_log == b.event_log
+    flat = build(ServiceModel([conditioned().mean])).run()
+    assert a.completed > 0 and flat.completed > 0
+    assert a.p99_ms > flat.p99_ms
+
+
 def test_percentile_matches_bench_convention():
     xs = list(range(1, 101))
     # benchmark._pct: index int(q*n) clamped — p50 of 1..100 is 51.

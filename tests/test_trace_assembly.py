@@ -221,6 +221,32 @@ def test_attribution_buckets_cover_wall():
     assert "server_leg:decode" in partial_report["missing"]
 
 
+def test_attribution_kv_fetch_gets_its_own_bucket():
+    """The fleet KV pull-through's spend (ISSUE 20) rides the
+    engine's exact per-request figure into its OWN bucket — never
+    folded into prefill or decode, so a slow owner shows up as
+    kv_fetch time in the waterfall, not as a phantom decode
+    regression."""
+    t = "d" * 32
+    spans = [
+        _span(t, name="http_request", ts=0.0, dur=60_000.0, pid=3,
+              span_id="b" * 16, leg="decode"),
+        _span(t, name="engine_request", ts=2.0, dur=55_000.0, pid=3,
+              parent_id="b" * 16, leg="decode", queue_ms=5.0,
+              kv_fetch_ms=6.0, prefill_ms=4.0, decode_ms=40.0),
+    ]
+    report = obs_trace.attribution(spans)
+    b = report["buckets"]
+    assert b["kv_fetch_ms"] == 6.0
+    assert b["queue_ms"] == 5.0
+    assert b["prefill_ms"] == 4.0
+    assert b["decode_ms"] == 40.0
+    # A trace with no fetch reports the bucket as plain zero (the
+    # column is always present for dashboards to sum).
+    no_fetch = obs_trace.attribution(_synthetic_trace()[1])
+    assert no_fetch["buckets"]["kv_fetch_ms"] == 0.0
+
+
 def test_attribution_direct_to_server():
     t = "e" * 32
     spans = [

@@ -100,3 +100,86 @@ def test_small_tensor_val_fields_decode():
         np.full((2, 2), 7, np.int32)).SerializeToString()
     np.testing.assert_array_equal(
         wire.decode_tensor(filled), np.full((2, 2), 7, np.int32))
+
+
+# -- fleet KV pull-through codec (ISSUE 20) --------------------------------
+
+
+def _kv_blocks(n=2, page=4, seed=0):
+    rng = np.random.RandomState(seed)
+    blocks = []
+    for j in range(n):
+        tokens = tuple(int(t) for t in rng.randint(0, 100, (page,)))
+        layers = [rng.rand(page, 2, 3).astype(np.float32),
+                  rng.rand(page, 2, 3).astype(np.float32)]
+        blocks.append((tokens, layers))
+    return blocks
+
+
+def test_kv_blocks_roundtrip_byte_exact():
+    """encode_kv_blocks → decode_kv_blocks is byte-exact on the KV
+    arrays (the same msgpack property that keeps handoff adoption
+    bitwise) and preserves token chains and block order."""
+    blocks = _kv_blocks(n=3)
+    data = wire.encode_kv_blocks("llama_test", 7, 4, blocks)
+    out = wire.decode_kv_blocks(data, model="llama_test", version=7,
+                                page_size=4)
+    assert len(out) == 3
+    for (tok_in, lay_in), (tok_out, lay_out) in zip(blocks, out):
+        assert tok_out == tok_in
+        assert len(lay_out) == len(lay_in)
+        for a, b in zip(lay_in, lay_out):
+            assert b.dtype == a.dtype
+            np.testing.assert_array_equal(b, a)
+
+
+def test_kv_blocks_roundtrip_bf16_byte_exact():
+    """bf16 KV survives the wire bit-for-bit — the dtype real pools
+    run; any up/down-cast would silently break the bitwise-equal
+    acceptance on the fetch path."""
+    jnp = pytest.importorskip("jax.numpy")
+
+    layer = np.asarray(jnp.linspace(-3.0, 3.0, 24,
+                                    dtype=jnp.bfloat16)).reshape(4, 2, 3)
+    data = wire.encode_kv_blocks(
+        "m", 1, 4, [((1, 2, 3, 4), [layer])])
+    [(tokens, layers)] = wire.decode_kv_blocks(data, model="m",
+                                               version=1, page_size=4)
+    assert tokens == (1, 2, 3, 4)
+    assert layers[0].dtype == layer.dtype
+    np.testing.assert_array_equal(layers[0], layer)
+
+
+def test_kv_blocks_rejects_geometry_and_identity_mismatch():
+    """A fetched payload splices into live attention state — every
+    identity/geometry mismatch must be a hard ValueError (the client
+    swallows it and prefills cold), never a silent partial parse."""
+    data = wire.encode_kv_blocks("llama_test", 7, 4, _kv_blocks())
+    # Happy path parses with unpinned version/page_size.
+    assert len(wire.decode_kv_blocks(data, model="llama_test")) == 2
+    with pytest.raises(ValueError, match="model"):
+        wire.decode_kv_blocks(data, model="other-model")
+    with pytest.raises(ValueError, match="version"):
+        wire.decode_kv_blocks(data, model="llama_test", version=8)
+    with pytest.raises(ValueError, match="page"):
+        wire.decode_kv_blocks(data, model="llama_test", page_size=8)
+    with pytest.raises(ValueError, match="malformed"):
+        wire.decode_kv_blocks(b"not msgpack at all", model="llama_test")
+    # Wrong token count inside a block (truncated chain link).
+    bad = wire.encode_kv_blocks(
+        "llama_test", 7, 4,
+        [((1, 2, 3), [np.zeros((3, 2, 2), np.float32)])])
+    with pytest.raises(ValueError, match="tokens"):
+        wire.decode_kv_blocks(bad, model="llama_test")
+    # A block with no KV layers carries nothing adoptable.
+    empty = wire.encode_kv_blocks("llama_test", 7, 4,
+                                  [((1, 2, 3, 4), [])])
+    with pytest.raises(ValueError, match="no KV layers"):
+        wire.decode_kv_blocks(empty, model="llama_test")
+    # Format/kind gate: a foreign or future format is a clear 400.
+    from flax import serialization
+    alien = serialization.msgpack_serialize(
+        {"format": np.int32(99), "kind": "kv_blocks", "model": "m",
+         "version": np.int32(1), "page_size": np.int32(4), "blocks": []})
+    with pytest.raises(ValueError, match="format"):
+        wire.decode_kv_blocks(alien, model="m")
